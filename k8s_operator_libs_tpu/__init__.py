@@ -1,0 +1,29 @@
+"""k8s_operator_libs_tpu — a TPU-first Kubernetes operator library.
+
+A ground-up re-design of the capabilities of NVIDIA's ``k8s-operator-libs``
+(reference: /root/reference, pure Go): a cluster-wide rolling-upgrade state
+machine for node-resident driver/runtime DaemonSets, plus a device-agnostic
+CRD apply/delete utility — extended with a first-class **TPU device class**:
+
+* GKE TPU node-pool detection and ICI slice topology modelling,
+* slice-aligned upgrade grouping (unavailability budgets measured in ICI
+  slices, not bare nodes),
+* a libtpu DaemonSet manager,
+* an ICI link-health validation gate that runs real JAX collectives across
+  the slice as the post-upgrade health check.
+
+Layout:
+
+* ``api``      — upgrade policy types (reference: api/upgrade/v1alpha1).
+* ``kube``     — minimal Kubernetes object model, client interface, in-memory
+  apiserver for tests, drain helper, REST client for real clusters.
+* ``upgrade``  — the rolling-upgrade state machine (reference: pkg/upgrade).
+* ``crdutil``  — CRD apply/delete utility (reference: pkg/crdutil).
+* ``tpu``      — the TPU device class (new; no reference analog).
+* ``parallel`` — TPU topology and jax.sharding Mesh construction.
+* ``ops``      — probe ops: ICI collectives, MXU matmul (Pallas).
+* ``models``   — burn-in workloads used by the health gate.
+* ``utils``    — concurrency primitives, int-or-percent, logging.
+"""
+
+__version__ = "0.1.0"

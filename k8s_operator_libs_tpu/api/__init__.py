@@ -1,0 +1,13 @@
+from .upgrade_v1alpha1 import (
+    DrainSpec,
+    DriverUpgradePolicySpec,
+    PodDeletionSpec,
+    WaitForCompletionSpec,
+)
+
+__all__ = [
+    "DrainSpec",
+    "DriverUpgradePolicySpec",
+    "PodDeletionSpec",
+    "WaitForCompletionSpec",
+]

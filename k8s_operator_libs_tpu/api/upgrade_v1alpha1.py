@@ -1,0 +1,198 @@
+"""Driver upgrade policy types (v1alpha1).
+
+Field/default parity with reference: api/upgrade/v1alpha1/upgrade_spec.go:27-110
+(kubebuilder defaults: autoUpgrade=false, maxParallelUpgrades=1,
+maxUnavailable="25%", drain/podDeletion timeouts 300s). The spec is meant to be
+embedded in a consumer operator's CRD, so ``from_dict``/``to_dict`` speak the
+same camelCase JSON the reference's CRD schema does. Unlike the reference,
+construction validates eagerly (the reference relies on kubebuilder schema
+validation at admission time, which a library consumer can bypass).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Mapping, Optional
+
+from ..utils.intstr import IntOrString
+
+DEFAULT_MAX_UNAVAILABLE = IntOrString("25%")
+DEFAULT_DRAIN_TIMEOUT_SECONDS = 300
+DEFAULT_POD_DELETION_TIMEOUT_SECONDS = 300
+
+
+def _require_non_negative(name: str, value: int) -> None:
+    if value < 0:
+        raise ValueError(f"{name} must be >= 0, got {value}")
+
+
+@dataclass(frozen=True)
+class WaitForCompletionSpec:
+    """Wait for selected workload pods to complete before upgrading.
+
+    Reference: api/upgrade/v1alpha1/upgrade_spec.go:52-64.
+    """
+
+    pod_selector: str = ""
+    #: Zero means wait forever.
+    timeout_seconds: int = 0
+
+    def __post_init__(self) -> None:
+        _require_non_negative("waitForCompletion.timeoutSeconds", self.timeout_seconds)
+
+    @staticmethod
+    def from_dict(d: Mapping[str, Any]) -> "WaitForCompletionSpec":
+        return WaitForCompletionSpec(
+            pod_selector=d.get("podSelector", ""),
+            timeout_seconds=int(d.get("timeoutSeconds", 0)),
+        )
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"podSelector": self.pod_selector, "timeoutSeconds": self.timeout_seconds}
+
+
+@dataclass(frozen=True)
+class PodDeletionSpec:
+    """Deletion of pods using special resources during automatic upgrade.
+
+    Reference: api/upgrade/v1alpha1/upgrade_spec.go:67-83.
+    """
+
+    force: bool = False
+    timeout_seconds: int = DEFAULT_POD_DELETION_TIMEOUT_SECONDS
+    delete_empty_dir: bool = False
+
+    def __post_init__(self) -> None:
+        _require_non_negative("podDeletion.timeoutSeconds", self.timeout_seconds)
+
+    @staticmethod
+    def from_dict(d: Mapping[str, Any]) -> "PodDeletionSpec":
+        return PodDeletionSpec(
+            force=bool(d.get("force", False)),
+            timeout_seconds=int(
+                d.get("timeoutSeconds", DEFAULT_POD_DELETION_TIMEOUT_SECONDS)
+            ),
+            delete_empty_dir=bool(d.get("deleteEmptyDir", False)),
+        )
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "force": self.force,
+            "timeoutSeconds": self.timeout_seconds,
+            "deleteEmptyDir": self.delete_empty_dir,
+        }
+
+
+@dataclass(frozen=True)
+class DrainSpec:
+    """Node drain configuration during automatic upgrade.
+
+    Reference: api/upgrade/v1alpha1/upgrade_spec.go:86-110.
+    """
+
+    enable: bool = False
+    force: bool = False
+    pod_selector: str = ""
+    timeout_seconds: int = DEFAULT_DRAIN_TIMEOUT_SECONDS
+    delete_empty_dir: bool = False
+
+    def __post_init__(self) -> None:
+        _require_non_negative("drain.timeoutSeconds", self.timeout_seconds)
+
+    @staticmethod
+    def from_dict(d: Mapping[str, Any]) -> "DrainSpec":
+        return DrainSpec(
+            enable=bool(d.get("enable", False)),
+            force=bool(d.get("force", False)),
+            pod_selector=d.get("podSelector", ""),
+            timeout_seconds=int(d.get("timeoutSeconds", DEFAULT_DRAIN_TIMEOUT_SECONDS)),
+            delete_empty_dir=bool(d.get("deleteEmptyDir", False)),
+        )
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "enable": self.enable,
+            "force": self.force,
+            "podSelector": self.pod_selector,
+            "timeoutSeconds": self.timeout_seconds,
+            "deleteEmptyDir": self.delete_empty_dir,
+        }
+
+
+@dataclass(frozen=True)
+class DriverUpgradePolicySpec:
+    """Policy for automatic driver upgrades.
+
+    Reference: api/upgrade/v1alpha1/upgrade_spec.go:27-49. ``auto_upgrade`` is
+    the global switch: when false, every other option is ignored
+    (reference: pkg/upgrade/upgrade_state.go:176-182).
+    """
+
+    auto_upgrade: bool = False
+    #: 0 means no limit — all nodes upgraded in parallel.
+    max_parallel_upgrades: int = 1
+    #: Absolute count or percentage of total nodes, rounded up.
+    max_unavailable: Optional[IntOrString] = field(
+        default_factory=lambda: DEFAULT_MAX_UNAVAILABLE
+    )
+    pod_deletion: Optional[PodDeletionSpec] = None
+    wait_for_completion: Optional[WaitForCompletionSpec] = None
+    drain: Optional[DrainSpec] = None
+
+    def __post_init__(self) -> None:
+        _require_non_negative("maxParallelUpgrades", self.max_parallel_upgrades)
+
+    def resolved_max_unavailable(self, total_nodes: int) -> int:
+        """Scale ``max_unavailable`` against the cluster size, rounding up,
+        clamped to [0, total_nodes] (reference: upgrade_inplace.go:54-69)."""
+        if self.max_unavailable is None:
+            return total_nodes
+        scaled = self.max_unavailable.scaled_value(total_nodes, round_up=True)
+        return max(0, min(scaled, total_nodes))
+
+    @staticmethod
+    def from_dict(d: Mapping[str, Any]) -> "DriverUpgradePolicySpec":
+        # An explicit null means "no limit" and must survive round-trips;
+        # a *missing* key takes the kubebuilder default of "25%".
+        if "maxUnavailable" in d:
+            max_unavailable = d["maxUnavailable"]
+        else:
+            max_unavailable = DEFAULT_MAX_UNAVAILABLE.value
+        return DriverUpgradePolicySpec(
+            auto_upgrade=bool(d.get("autoUpgrade", False)),
+            max_parallel_upgrades=int(d.get("maxParallelUpgrades", 1)),
+            max_unavailable=IntOrString.parse(max_unavailable),
+            pod_deletion=(
+                PodDeletionSpec.from_dict(d["podDeletion"])
+                if d.get("podDeletion") is not None
+                else None
+            ),
+            wait_for_completion=(
+                WaitForCompletionSpec.from_dict(d["waitForCompletion"])
+                if d.get("waitForCompletion") is not None
+                else None
+            ),
+            drain=(
+                DrainSpec.from_dict(d["drain"]) if d.get("drain") is not None else None
+            ),
+        )
+
+    def to_dict(self) -> dict[str, Any]:
+        out: dict[str, Any] = {
+            "autoUpgrade": self.auto_upgrade,
+            "maxParallelUpgrades": self.max_parallel_upgrades,
+            # None (no limit) serializes as an explicit null so the
+            # round-trip does not resurrect the "25%" default.
+            "maxUnavailable": (
+                self.max_unavailable.to_json()
+                if self.max_unavailable is not None
+                else None
+            ),
+        }
+        if self.pod_deletion is not None:
+            out["podDeletion"] = self.pod_deletion.to_dict()
+        if self.wait_for_completion is not None:
+            out["waitForCompletion"] = self.wait_for_completion.to_dict()
+        if self.drain is not None:
+            out["drain"] = self.drain.to_dict()
+        return out

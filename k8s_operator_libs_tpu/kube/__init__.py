@@ -1,0 +1,3 @@
+from .selectors import LabelSelector, parse_selector
+
+__all__ = ["LabelSelector", "parse_selector"]

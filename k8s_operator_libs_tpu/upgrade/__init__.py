@@ -1,0 +1,3 @@
+from .consts import UpgradeState, DeviceClass, UpgradeKeys
+
+__all__ = ["UpgradeState", "DeviceClass", "UpgradeKeys"]

@@ -1,0 +1,164 @@
+"""Upgrade states and label/annotation key builders.
+
+State-name parity with the reference's 13-state machine
+(reference: pkg/upgrade/consts.go:48-83). The key *scheme* is deliberately
+re-designed: the reference keys every label/annotation off a process-global
+``DriverName`` via printf formats like ``nvidia.com/%s-driver-upgrade-state``
+(reference: pkg/upgrade/consts.go:20-47, util.go:91-99), hard-wiring one
+driver per process and the ``nvidia.com`` domain. Here the device class is a
+first-class value object — GPU, NIC and TPU drivers are peers, several can be
+managed from one process, and the key domain is part of the device class.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+
+class UpgradeState(enum.StrEnum):
+    """Per-node upgrade state, stored in a node label.
+
+    Value parity with reference: pkg/upgrade/consts.go:48-83.
+    """
+
+    # The upgrade flow is disabled or the node hasn't been processed yet.
+    UNKNOWN = ""
+    # Driver pod on the node is out of date; nothing has been done yet.
+    UPGRADE_REQUIRED = "upgrade-required"
+    # Node must be made unschedulable before the driver upgrade.
+    CORDON_REQUIRED = "cordon-required"
+    # Waiting (up to a timeout) for selected workload jobs to finish.
+    WAIT_FOR_JOBS_REQUIRED = "wait-for-jobs-required"
+    # Workload pods matching the deletion filter must be evicted first.
+    POD_DELETION_REQUIRED = "pod-deletion-required"
+    # Node is scheduled for drain.
+    DRAIN_REQUIRED = "drain-required"
+    # Maintenance (cordon/drain/...) delegated to an external operator.
+    NODE_MAINTENANCE_REQUIRED = "node-maintenance-required"
+    # External maintenance finished; requestor must do post-maintenance work.
+    POST_MAINTENANCE_REQUIRED = "post-maintenance-required"
+    # Driver pod on the node is scheduled for restart / safe-load unblock.
+    POD_RESTART_REQUIRED = "pod-restart-required"
+    # New driver must pass validation before uncordon.
+    VALIDATION_REQUIRED = "validation-required"
+    # Driver pod is up to date and Ready; node must be uncordoned.
+    UNCORDON_REQUIRED = "uncordon-required"
+    # Driver pod is up to date and running; node is schedulable.
+    DONE = "upgrade-done"
+    # Something failed; auto-recovers once the driver pod is back in sync.
+    FAILED = "upgrade-failed"
+
+
+#: States counted as "managed" (reference: pkg/upgrade/common_manager.go:714-731).
+MANAGED_STATES: tuple[UpgradeState, ...] = (
+    UpgradeState.UNKNOWN,
+    UpgradeState.DONE,
+    UpgradeState.UPGRADE_REQUIRED,
+    UpgradeState.CORDON_REQUIRED,
+    UpgradeState.WAIT_FOR_JOBS_REQUIRED,
+    UpgradeState.POD_DELETION_REQUIRED,
+    UpgradeState.FAILED,
+    UpgradeState.DRAIN_REQUIRED,
+    UpgradeState.POD_RESTART_REQUIRED,
+    UpgradeState.UNCORDON_REQUIRED,
+    UpgradeState.VALIDATION_REQUIRED,
+)
+
+#: States that do NOT count as "upgrade in progress"
+#: (reference: pkg/upgrade/common_manager.go:733-739).
+IDLE_STATES: frozenset[UpgradeState] = frozenset(
+    {UpgradeState.UNKNOWN, UpgradeState.DONE, UpgradeState.UPGRADE_REQUIRED}
+)
+
+TRUE_STRING = "true"
+#: Annotation value requesting deletion of the key via merge patch
+#: (reference: pkg/upgrade/node_upgrade_state_provider.go:147-150).
+NULL_STRING = "null"
+
+
+@dataclass(frozen=True)
+class DeviceClass:
+    """Identity of a managed device driver: class name, key domain, driver.
+
+    Replaces the reference's process-global ``DriverName`` + printf key
+    formats (reference: pkg/upgrade/util.go:91-99, consts.go:20-47) with a
+    value object so multiple device classes coexist in one process.
+    """
+
+    name: str  # e.g. "tpu", "gpu", "nic"
+    driver: str  # e.g. "libtpu", "gpu", "ofed"
+    domain: str = "tpu-operator.dev"
+
+    def __post_init__(self) -> None:
+        for attr in ("name", "driver", "domain"):
+            v = getattr(self, attr)
+            if not v or "/" in v:
+                raise ValueError(f"invalid DeviceClass.{attr}: {v!r}")
+
+    @staticmethod
+    def tpu(driver: str = "libtpu") -> "DeviceClass":
+        return DeviceClass(name="tpu", driver=driver)
+
+    @staticmethod
+    def nvidia(driver: str) -> "DeviceClass":
+        """Compatibility constructor producing the reference's nvidia.com keys
+        (reference: pkg/upgrade/consts.go:20-47) for migration scenarios."""
+        return DeviceClass(name="gpu", driver=driver, domain="nvidia.com")
+
+
+@dataclass(frozen=True)
+class UpgradeKeys:
+    """All label/annotation keys for one device class.
+
+    Key-shape parity with reference: pkg/upgrade/consts.go:20-47 and the
+    builder functions in pkg/upgrade/util.go:102-155, but instance-scoped.
+    """
+
+    device: DeviceClass
+
+    def _key(self, suffix: str) -> str:
+        return f"{self.device.domain}/{self.device.driver}-driver-{suffix}"
+
+    @property
+    def state_label(self) -> str:
+        return self._key("upgrade-state")
+
+    @property
+    def skip_label(self) -> str:
+        return self._key("upgrade.skip")
+
+    @property
+    def skip_drain_pod_label(self) -> str:
+        """Pod label excluding a pod from drain (reference: consts.go:25-27)."""
+        return self._key("upgrade-drain.skip")
+
+    @property
+    def safe_driver_load_annotation(self) -> str:
+        return self._key("upgrade.driver-wait-for-safe-load")
+
+    @property
+    def initial_state_annotation(self) -> str:
+        return self._key("upgrade.node-initial-state.unschedulable")
+
+    @property
+    def wait_for_pod_completion_start_annotation(self) -> str:
+        return self._key("upgrade-wait-for-pod-completion-start-time")
+
+    @property
+    def validation_start_annotation(self) -> str:
+        return self._key("upgrade-validation-start-time")
+
+    @property
+    def upgrade_requested_annotation(self) -> str:
+        return self._key("upgrade-requested")
+
+    @property
+    def requestor_mode_annotation(self) -> str:
+        return self._key("upgrade-requestor-mode")
+
+    def event_reason(self) -> str:
+        """Event reason with the driver name upper-cased, e.g.
+        ``LIBTPUDriverUpgrade`` / ``GPUDriverUpgrade``
+        (reference: pkg/upgrade/util.go:158-160 uses strings.ToUpper)."""
+        return f"{self.device.driver.upper()}DriverUpgrade"

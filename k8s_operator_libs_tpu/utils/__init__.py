@@ -1,0 +1,4 @@
+from .sync import KeyedMutex, StringSet
+from .intstr import IntOrString
+
+__all__ = ["KeyedMutex", "StringSet", "IntOrString"]

@@ -1,0 +1,38 @@
+"""Logging helpers following the reference's logr verbosity convention.
+
+Reference: pkg/consts/consts.go:24-29 — Error=-2, Warning=-1, Info=0, Debug=1
+(zap-compatible numeric levels). We map those onto stdlib logging levels so the
+rest of the framework reads the same as the reference while staying idiomatic
+Python.
+"""
+
+from __future__ import annotations
+
+import logging
+
+LOG_LEVEL_ERROR = -2
+LOG_LEVEL_WARNING = -1
+LOG_LEVEL_INFO = 0
+LOG_LEVEL_DEBUG = 1
+
+_LEVEL_MAP = {
+    LOG_LEVEL_ERROR: logging.ERROR,
+    LOG_LEVEL_WARNING: logging.WARNING,
+    LOG_LEVEL_INFO: logging.INFO,
+    LOG_LEVEL_DEBUG: logging.DEBUG,
+}
+
+
+def std_level(logr_level: int) -> int:
+    """Translate a logr verbosity into a stdlib logging level.
+
+    Levels above Debug (higher V() = more verbose in logr) stay at DEBUG
+    rather than escalating back to INFO.
+    """
+    if logr_level > LOG_LEVEL_DEBUG:
+        return logging.DEBUG
+    return _LEVEL_MAP.get(logr_level, logging.INFO)
+
+
+def get_logger(name: str) -> logging.Logger:
+    return logging.getLogger(f"k8s_operator_libs_tpu.{name}")

@@ -1,0 +1,84 @@
+"""Concurrency primitives for per-node async operations.
+
+Behavioral parity with the reference's upgrade utilities
+(reference: pkg/upgrade/util.go:30-89): a thread-safe string set used to
+deduplicate in-flight per-node operations, and a keyed mutex that serializes
+all state writes for a given node.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Iterator
+
+
+class StringSet:
+    """A thread-safe set of strings (reference: pkg/upgrade/util.go:30-70).
+
+    Used by the drain and pod managers as an "in progress" set so a node whose
+    async operation is still running is not scheduled twice
+    (reference: pkg/upgrade/drain_manager.go:104, pod_manager.go:160).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._items: set[str] = set()
+
+    def add(self, item: str) -> None:
+        with self._lock:
+            self._items.add(item)
+
+    def remove(self, item: str) -> None:
+        with self._lock:
+            self._items.discard(item)
+
+    def has(self, item: str) -> bool:
+        with self._lock:
+            return item in self._items
+
+    def __contains__(self, item: object) -> bool:
+        return isinstance(item, str) and self.has(item)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    def snapshot(self) -> frozenset[str]:
+        with self._lock:
+            return frozenset(self._items)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._items.clear()
+
+
+class KeyedMutex:
+    """A mutex per key (reference: pkg/upgrade/util.go:73-89).
+
+    Serializes state label/annotation writes per node so concurrent async
+    managers cannot interleave patches for the same node. Locks are created
+    lazily and retained for the lifetime of the instance (bounded by the node
+    count of the cluster, as in the reference).
+    """
+
+    def __init__(self) -> None:
+        self._guard = threading.Lock()
+        self._locks: dict[str, threading.Lock] = {}
+
+    def _lock_for(self, key: str) -> threading.Lock:
+        with self._guard:
+            lock = self._locks.get(key)
+            if lock is None:
+                lock = threading.Lock()
+                self._locks[key] = lock
+            return lock
+
+    @contextmanager
+    def locked(self, key: str) -> Iterator[None]:
+        lock = self._lock_for(key)
+        lock.acquire()
+        try:
+            yield
+        finally:
+            lock.release()

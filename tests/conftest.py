@@ -1,0 +1,16 @@
+"""Test harness configuration.
+
+JAX-dependent tests run on a virtual 8-device CPU mesh: multi-chip TPU
+hardware is not available in CI, so shardings/collectives are validated on
+host devices (the same XLA partitioner runs either way). Environment must be
+set before jax initializes its backends, hence module scope here.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

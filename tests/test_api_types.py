@@ -1,0 +1,100 @@
+"""Tests for DriverUpgradePolicySpec and friends.
+
+Default parity: reference api/upgrade/v1alpha1/upgrade_spec.go:27-110.
+"""
+
+import pytest
+
+from k8s_operator_libs_tpu.api import (
+    DrainSpec,
+    DriverUpgradePolicySpec,
+    PodDeletionSpec,
+    WaitForCompletionSpec,
+)
+from k8s_operator_libs_tpu.utils import IntOrString
+
+
+class TestDefaults:
+    def test_policy_defaults(self):
+        p = DriverUpgradePolicySpec()
+        assert p.auto_upgrade is False
+        assert p.max_parallel_upgrades == 1
+        assert p.max_unavailable == IntOrString("25%")
+        assert p.pod_deletion is None
+        assert p.wait_for_completion is None
+        assert p.drain is None
+
+    def test_drain_defaults(self):
+        d = DrainSpec()
+        assert d.enable is False
+        assert d.force is False
+        assert d.timeout_seconds == 300
+        assert d.delete_empty_dir is False
+
+    def test_pod_deletion_defaults(self):
+        d = PodDeletionSpec()
+        assert d.force is False
+        assert d.timeout_seconds == 300
+        assert d.delete_empty_dir is False
+
+    def test_wait_for_completion_defaults(self):
+        w = WaitForCompletionSpec()
+        assert w.pod_selector == ""
+        assert w.timeout_seconds == 0
+
+
+class TestResolvedMaxUnavailable:
+    def test_default_percent_scales(self):
+        p = DriverUpgradePolicySpec()
+        assert p.resolved_max_unavailable(3) == 1  # ceil(0.75)
+        assert p.resolved_max_unavailable(16) == 4
+
+    def test_absolute_clamped_to_total(self):
+        p = DriverUpgradePolicySpec(max_unavailable=IntOrString(50))
+        assert p.resolved_max_unavailable(3) == 3
+
+    def test_none_means_all(self):
+        p = DriverUpgradePolicySpec(max_unavailable=None)
+        assert p.resolved_max_unavailable(7) == 7
+
+    def test_none_survives_round_trip(self):
+        p = DriverUpgradePolicySpec(max_unavailable=None)
+        rt = DriverUpgradePolicySpec.from_dict(p.to_dict())
+        assert rt.max_unavailable is None
+        assert rt.resolved_max_unavailable(100) == 100
+
+
+class TestRoundTrip:
+    def test_from_dict_defaults(self):
+        p = DriverUpgradePolicySpec.from_dict({})
+        assert p == DriverUpgradePolicySpec()
+
+    def test_from_dict_full(self):
+        d = {
+            "autoUpgrade": True,
+            "maxParallelUpgrades": 4,
+            "maxUnavailable": 2,
+            "podDeletion": {"force": True, "timeoutSeconds": 60, "deleteEmptyDir": True},
+            "waitForCompletion": {"podSelector": "app=batch", "timeoutSeconds": 120},
+            "drain": {
+                "enable": True,
+                "force": True,
+                "podSelector": "app!=critical",
+                "timeoutSeconds": 90,
+                "deleteEmptyDir": True,
+            },
+        }
+        p = DriverUpgradePolicySpec.from_dict(d)
+        assert p.auto_upgrade and p.max_parallel_upgrades == 4
+        assert p.max_unavailable == IntOrString(2)
+        assert p.pod_deletion == PodDeletionSpec(True, 60, True)
+        assert p.wait_for_completion == WaitForCompletionSpec("app=batch", 120)
+        assert p.drain is not None and p.drain.enable and p.drain.timeout_seconds == 90
+        # Round trip preserves everything.
+        assert DriverUpgradePolicySpec.from_dict(p.to_dict()) == p
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            DriverUpgradePolicySpec(max_parallel_upgrades=-1)
+        with pytest.raises(ValueError):
+            DrainSpec(timeout_seconds=-5)

@@ -1,0 +1,80 @@
+"""Tests for upgrade states and device-class key builders.
+
+State-value parity: reference pkg/upgrade/consts.go:48-83; key-shape parity:
+consts.go:20-47 with the nvidia compat constructor.
+"""
+
+import pytest
+
+from k8s_operator_libs_tpu.upgrade import DeviceClass, UpgradeKeys, UpgradeState
+from k8s_operator_libs_tpu.upgrade.consts import IDLE_STATES, MANAGED_STATES
+
+
+class TestStates:
+    def test_all_thirteen_states(self):
+        assert len(list(UpgradeState)) == 13
+
+    def test_state_values_match_reference(self):
+        assert UpgradeState.UNKNOWN == ""
+        assert UpgradeState.UPGRADE_REQUIRED == "upgrade-required"
+        assert UpgradeState.CORDON_REQUIRED == "cordon-required"
+        assert UpgradeState.WAIT_FOR_JOBS_REQUIRED == "wait-for-jobs-required"
+        assert UpgradeState.POD_DELETION_REQUIRED == "pod-deletion-required"
+        assert UpgradeState.DRAIN_REQUIRED == "drain-required"
+        assert UpgradeState.NODE_MAINTENANCE_REQUIRED == "node-maintenance-required"
+        assert UpgradeState.POST_MAINTENANCE_REQUIRED == "post-maintenance-required"
+        assert UpgradeState.POD_RESTART_REQUIRED == "pod-restart-required"
+        assert UpgradeState.VALIDATION_REQUIRED == "validation-required"
+        assert UpgradeState.UNCORDON_REQUIRED == "uncordon-required"
+        assert UpgradeState.DONE == "upgrade-done"
+        assert UpgradeState.FAILED == "upgrade-failed"
+
+    def test_idle_vs_managed(self):
+        assert UpgradeState.POST_MAINTENANCE_REQUIRED not in MANAGED_STATES
+        assert UpgradeState.NODE_MAINTENANCE_REQUIRED not in MANAGED_STATES
+        for s in IDLE_STATES:
+            assert s in MANAGED_STATES
+
+
+class TestDeviceClassKeys:
+    def test_tpu_keys(self):
+        keys = UpgradeKeys(DeviceClass.tpu())
+        assert keys.state_label == "tpu-operator.dev/libtpu-driver-upgrade-state"
+        assert keys.skip_label == "tpu-operator.dev/libtpu-driver-upgrade.skip"
+        assert (
+            keys.safe_driver_load_annotation
+            == "tpu-operator.dev/libtpu-driver-upgrade.driver-wait-for-safe-load"
+        )
+        assert keys.event_reason() == "LIBTPUDriverUpgrade"
+
+    def test_nvidia_compat_keys_match_reference_format(self):
+        # reference: pkg/upgrade/consts.go:20-47 printf formats.
+        keys = UpgradeKeys(DeviceClass.nvidia("gpu"))
+        assert keys.state_label == "nvidia.com/gpu-driver-upgrade-state"
+        assert keys.skip_label == "nvidia.com/gpu-driver-upgrade.skip"
+        assert keys.skip_drain_pod_label == "nvidia.com/gpu-driver-upgrade-drain.skip"
+        assert (
+            keys.initial_state_annotation
+            == "nvidia.com/gpu-driver-upgrade.node-initial-state.unschedulable"
+        )
+        assert (
+            keys.wait_for_pod_completion_start_annotation
+            == "nvidia.com/gpu-driver-upgrade-wait-for-pod-completion-start-time"
+        )
+        assert (
+            keys.validation_start_annotation
+            == "nvidia.com/gpu-driver-upgrade-validation-start-time"
+        )
+        assert keys.upgrade_requested_annotation == "nvidia.com/gpu-driver-upgrade-requested"
+        assert keys.requestor_mode_annotation == "nvidia.com/gpu-driver-upgrade-requestor-mode"
+
+    def test_two_device_classes_coexist(self):
+        tpu = UpgradeKeys(DeviceClass.tpu())
+        nic = UpgradeKeys(DeviceClass(name="nic", driver="ofed", domain="nvidia.com"))
+        assert tpu.state_label != nic.state_label
+
+    def test_invalid_device_class(self):
+        with pytest.raises(ValueError):
+            DeviceClass(name="", driver="x")
+        with pytest.raises(ValueError):
+            DeviceClass(name="tpu", driver="a/b")

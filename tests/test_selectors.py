@@ -1,0 +1,86 @@
+"""Tests for label/field selector parsing and matching."""
+
+import pytest
+
+from k8s_operator_libs_tpu.kube.selectors import (
+    LabelSelector,
+    SelectorError,
+    parse_field_selector,
+    parse_selector,
+)
+
+
+class TestParseAndMatch:
+    def test_empty_matches_everything(self):
+        sel = parse_selector("")
+        assert sel.empty
+        assert sel.matches({"a": "b"})
+        assert sel.matches(None)
+
+    def test_equality(self):
+        sel = parse_selector("app=driver")
+        assert sel.matches({"app": "driver"})
+        assert not sel.matches({"app": "other"})
+        assert not sel.matches({})
+
+    def test_double_equals(self):
+        assert parse_selector("app==driver").matches({"app": "driver"})
+
+    def test_not_equals_matches_absent_key(self):
+        sel = parse_selector("app!=driver")
+        assert sel.matches({"app": "x"})
+        assert sel.matches({})  # apimachinery semantics
+        assert not sel.matches({"app": "driver"})
+
+    def test_in_operator(self):
+        sel = parse_selector("env in (prod, staging)")
+        assert sel.matches({"env": "prod"})
+        assert sel.matches({"env": "staging"})
+        assert not sel.matches({"env": "dev"})
+        assert not sel.matches({})
+
+    def test_notin_operator(self):
+        sel = parse_selector("env notin (prod)")
+        assert sel.matches({"env": "dev"})
+        assert sel.matches({})
+        assert not sel.matches({"env": "prod"})
+
+    def test_exists_and_not_exists(self):
+        assert parse_selector("gpu").matches({"gpu": ""})
+        assert not parse_selector("gpu").matches({})
+        assert parse_selector("!gpu").matches({})
+        assert not parse_selector("!gpu").matches({"gpu": "1"})
+
+    def test_conjunction(self):
+        sel = parse_selector("app=driver,env in (prod,dev),!legacy")
+        assert sel.matches({"app": "driver", "env": "prod"})
+        assert not sel.matches({"app": "driver", "env": "prod", "legacy": "1"})
+        assert not sel.matches({"app": "driver", "env": "qa"})
+
+    def test_set_values_not_split_as_terms(self):
+        sel = parse_selector("env in (a,b),app=x")
+        assert len(sel.requirements) == 2
+
+    def test_invalid(self):
+        with pytest.raises(SelectorError):
+            parse_selector("env in ()")
+
+    def test_from_match_labels(self):
+        sel = LabelSelector.from_match_labels({"k8s-app": "libtpu"})
+        assert sel.matches({"k8s-app": "libtpu", "extra": "1"})
+        assert not sel.matches({"k8s-app": "other"})
+
+
+class TestFieldSelector:
+    def test_node_name(self):
+        assert parse_field_selector("spec.nodeName=node-1") == {
+            "spec.nodeName": "node-1"
+        }
+
+    def test_empty(self):
+        assert parse_field_selector(None) == {}
+        assert parse_field_selector("") == {}
+
+    def test_unsupported(self):
+        with pytest.raises(SelectorError):
+            parse_field_selector("metadata.name!=x")
