@@ -1,3 +1,55 @@
+from .client import (
+    AlreadyExistsError,
+    ApiError,
+    Client,
+    ConflictError,
+    InvalidError,
+    NotFoundError,
+    retry_on_conflict,
+)
+from .objects import (
+    ControllerRevision,
+    CustomResourceDefinition,
+    DaemonSet,
+    Event,
+    KubeObject,
+    Node,
+    NodeMaintenance,
+    Pod,
+    wrap,
+)
 from .selectors import LabelSelector, parse_selector
+from .fake import FakeCluster, merge_patch
+from .cache import CachedClient
+from .drain import DrainConfig, DrainError, DrainHelper, DrainTimeoutError
+from .events import EventRecorder, FakeRecorder
 
-__all__ = ["LabelSelector", "parse_selector"]
+__all__ = [
+    "AlreadyExistsError",
+    "ApiError",
+    "CachedClient",
+    "Client",
+    "ConflictError",
+    "ControllerRevision",
+    "CustomResourceDefinition",
+    "DaemonSet",
+    "DrainConfig",
+    "DrainError",
+    "DrainHelper",
+    "DrainTimeoutError",
+    "Event",
+    "EventRecorder",
+    "FakeCluster",
+    "FakeRecorder",
+    "InvalidError",
+    "KubeObject",
+    "LabelSelector",
+    "merge_patch",
+    "Node",
+    "NodeMaintenance",
+    "NotFoundError",
+    "parse_selector",
+    "Pod",
+    "retry_on_conflict",
+    "wrap",
+]

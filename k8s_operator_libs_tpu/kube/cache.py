@@ -1,0 +1,178 @@
+"""A read cache with explicit, controllable staleness.
+
+The reference reads through a controller-runtime watch cache that may lag the
+apiserver; the state provider's correctness hinges on waiting until its own
+write becomes visible in that cache (reference:
+pkg/upgrade/node_upgrade_state_provider.go:92-117). This module makes that
+staleness a first-class, testable property instead of an accident of the
+environment:
+
+* ``sync_mode="passthrough"`` — reads hit the backing store directly,
+* ``sync_mode="manual"`` — reads serve a snapshot; tests advance it with
+  :meth:`sync` to provoke exactly the staleness window the reference's
+  cache-coherence poll exists for,
+* ``sync_mode="auto"`` — a background thread applies watch events after
+  ``lag_seconds``, emulating a live watch cache.
+
+Writes always go straight to the backing cluster (as with controller-runtime,
+where only reads are cached).
+
+This cache intentionally wraps :class:`~.fake.FakeCluster` only — it is the
+test/simulation harness's staleness model. Against a real cluster the REST
+client reads the apiserver directly; a production watch cache is out of scope
+for the framework (consumers embed it in their own controller runtime).
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+import time
+from typing import Any, Callable, Mapping, Optional
+
+from .client import Client, NotFoundError
+from .fake import FakeCluster
+from .objects import KubeObject, wrap
+from .selectors import LabelSelector, parse_field_selector, parse_selector
+from .fake import _field_value  # shared field-selector traversal
+
+
+class CachedClient(Client):
+    def __init__(
+        self,
+        backing: FakeCluster,
+        sync_mode: str = "passthrough",
+        lag_seconds: float = 0.05,
+    ) -> None:
+        if sync_mode not in ("passthrough", "manual", "auto"):
+            raise ValueError(f"unknown sync_mode {sync_mode!r}")
+        self.backing = backing
+        self.sync_mode = sync_mode
+        self.lag_seconds = lag_seconds
+        self._lock = threading.Condition()
+        self._snapshot: dict[tuple[str, str, str], dict[str, Any]] = {}
+        self._stop = threading.Event()
+        if sync_mode != "passthrough":
+            self.sync()
+        if sync_mode == "auto":
+            self._thread = threading.Thread(target=self._auto_sync, daemon=True)
+            self._thread.start()
+
+    # -- cache control -----------------------------------------------------
+    def sync(self) -> None:
+        """Make the cache consistent with the backing store right now."""
+        with self.backing._lock:
+            fresh = copy.deepcopy(self.backing._store)
+        with self._lock:
+            self._snapshot = fresh
+            self._lock.notify_all()
+
+    def _auto_sync(self) -> None:
+        # Track the backing write generation so a notification lost while we
+        # were outside wait_for_change cannot leave the cache stale forever.
+        seen = -1
+        while not self._stop.is_set():
+            gen = self.backing.wait_for_change(timeout=0.2, after_generation=seen)
+            if self._stop.is_set():
+                return
+            if gen > seen:
+                # Apply the change only after the configured lag.
+                self._stop.wait(self.lag_seconds)
+                self.sync()
+                seen = gen
+
+    def close(self) -> None:
+        self._stop.set()
+
+    def wait_until(
+        self, predicate: Callable[["CachedClient"], bool], timeout: float
+    ) -> bool:
+        """Block until ``predicate(self)`` holds, waking on every cache sync.
+
+        This replaces the reference's fixed 1 s cache-coherence polling loop
+        (reference: node_upgrade_state_provider.go:100-117) with an
+        event-driven wait: the caller wakes as soon as the cache catches up
+        instead of on the next poll tick.
+        """
+        if self.sync_mode == "passthrough":
+            return predicate(self)
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while True:
+                if predicate(self):
+                    return True
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return predicate(self)
+                self._lock.wait(min(remaining, 0.5))
+
+    # -- reads (cached) ----------------------------------------------------
+    def get(self, kind: str, name: str, namespace: str = "") -> KubeObject:
+        if self.sync_mode == "passthrough":
+            return self.backing.get(kind, name, namespace)
+        key = FakeCluster._key(kind, namespace, name)
+        with self._lock:
+            data = self._snapshot.get(key)
+            if data is None:
+                raise NotFoundError(f"{kind} {namespace}/{name} not found (cache)")
+            return wrap(copy.deepcopy(data))
+
+    def list(
+        self,
+        kind: str,
+        namespace: str = "",
+        label_selector: Optional[str | Mapping[str, str]] = None,
+        field_selector: Optional[str] = None,
+    ) -> list[KubeObject]:
+        if self.sync_mode == "passthrough":
+            return self.backing.list(kind, namespace, label_selector, field_selector)
+        if isinstance(label_selector, Mapping):
+            selector = LabelSelector.from_match_labels(label_selector)
+        else:
+            selector = parse_selector(label_selector)
+        fields = parse_field_selector(field_selector)
+        out = []
+        with self._lock:
+            for (k, ns, _), data in sorted(self._snapshot.items()):
+                if k != kind:
+                    continue
+                if namespace and ns != namespace:
+                    continue
+                labels = (data.get("metadata") or {}).get("labels") or {}
+                if not selector.matches(labels):
+                    continue
+                if any(_field_value(data, f) != v for f, v in fields.items()):
+                    continue
+                out.append(wrap(copy.deepcopy(data)))
+        return out
+
+    # -- writes (pass through) ---------------------------------------------
+    def create(self, obj: KubeObject) -> KubeObject:
+        return self.backing.create(obj)
+
+    def update(self, obj: KubeObject) -> KubeObject:
+        return self.backing.update(obj)
+
+    def update_status(self, obj: KubeObject) -> KubeObject:
+        return self.backing.update_status(obj)
+
+    def patch(
+        self,
+        kind: str,
+        name: str,
+        namespace: str = "",
+        patch: Optional[Mapping[str, Any]] = None,
+    ) -> KubeObject:
+        return self.backing.patch(kind, name, namespace, patch)
+
+    def delete(
+        self,
+        kind: str,
+        name: str,
+        namespace: str = "",
+        grace_period_seconds: Optional[int] = None,
+    ) -> None:
+        return self.backing.delete(kind, name, namespace, grace_period_seconds)
+
+    def evict(self, pod_name: str, namespace: str = "") -> None:
+        return self.backing.evict(pod_name, namespace)

@@ -1,0 +1,129 @@
+"""Client interface and API errors.
+
+The reference deliberately keeps two client flavors side by side — a cached
+controller-runtime client and a typed client-go clientset (reference:
+pkg/upgrade/common_manager.go:108-116). Here a single abstract ``Client``
+covers both roles. In tests and simulation, ``kube.cache.CachedClient`` wraps
+the in-memory cluster to make read staleness explicit and controllable; the
+REST client for real clusters reads the apiserver directly.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Mapping, Optional, Sequence
+
+from .objects import KubeObject
+
+
+class ApiError(Exception):
+    """Base error carrying an HTTP-ish status code."""
+
+    status = 500
+    reason = "InternalError"
+
+    def __init__(self, message: str = "") -> None:
+        super().__init__(message or self.reason)
+        self.message = message or self.reason
+
+
+class NotFoundError(ApiError):
+    status = 404
+    reason = "NotFound"
+
+
+class AlreadyExistsError(ApiError):
+    status = 409
+    reason = "AlreadyExists"
+
+
+class ConflictError(ApiError):
+    """Optimistic-concurrency failure (stale resourceVersion)."""
+
+    status = 409
+    reason = "Conflict"
+
+
+class InvalidError(ApiError):
+    status = 422
+    reason = "Invalid"
+
+
+class Client(abc.ABC):
+    """Minimal typed Kubernetes client surface used by the framework."""
+
+    @abc.abstractmethod
+    def get(self, kind: str, name: str, namespace: str = "") -> KubeObject: ...
+
+    @abc.abstractmethod
+    def list(
+        self,
+        kind: str,
+        namespace: str = "",
+        label_selector: Optional[str | Mapping[str, str]] = None,
+        field_selector: Optional[str] = None,
+    ) -> list[KubeObject]: ...
+
+    @abc.abstractmethod
+    def create(self, obj: KubeObject) -> KubeObject: ...
+
+    @abc.abstractmethod
+    def update(self, obj: KubeObject) -> KubeObject:
+        """Full replace; raises ConflictError on stale resourceVersion."""
+
+    @abc.abstractmethod
+    def update_status(self, obj: KubeObject) -> KubeObject:
+        """Replace only the status subresource."""
+
+    @abc.abstractmethod
+    def patch(
+        self,
+        kind: str,
+        name: str,
+        namespace: str = "",
+        patch: Optional[Mapping[str, Any]] = None,
+    ) -> KubeObject:
+        """RFC 7386 merge patch (null deletes a key)."""
+
+    @abc.abstractmethod
+    def delete(
+        self,
+        kind: str,
+        name: str,
+        namespace: str = "",
+        grace_period_seconds: Optional[int] = None,
+    ) -> None:
+        """Delete; raises NotFoundError if absent."""
+
+    @abc.abstractmethod
+    def evict(self, pod_name: str, namespace: str = "") -> None:
+        """Evict a pod via the eviction subresource semantics."""
+
+    # -- convenience -------------------------------------------------------
+    def get_or_none(self, kind: str, name: str, namespace: str = "") -> Optional[KubeObject]:
+        try:
+            return self.get(kind, name, namespace)
+        except NotFoundError:
+            return None
+
+    def delete_if_exists(self, kind: str, name: str, namespace: str = "") -> bool:
+        try:
+            self.delete(kind, name, namespace)
+            return True
+        except NotFoundError:
+            return False
+
+
+def retry_on_conflict(fn, attempts: int = 5):
+    """Run ``fn`` retrying on ConflictError, mirroring client-go's
+    retry.RetryOnConflict used by crdutil (reference: pkg/crdutil/crdutil.go:222-247)
+    and the requestor's optimistic-lock patches
+    (reference: pkg/upgrade/upgrade_requestor.go:344-357)."""
+    last: Optional[ConflictError] = None
+    for _ in range(attempts):
+        try:
+            return fn()
+        except ConflictError as e:
+            last = e
+    assert last is not None
+    raise last

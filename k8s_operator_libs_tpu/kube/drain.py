@@ -1,0 +1,151 @@
+"""Node cordon/uncordon and drain helper.
+
+The reference delegates to ``k8s.io/kubectl/pkg/drain`` (reference:
+pkg/upgrade/cordon_manager.go:39-48, drain_manager.go:76-96); this module
+implements the same contract natively:
+
+* cordon/uncordon = patch of ``spec.unschedulable``,
+* drain = cordon + evict every pod on the node that passes the filter chain,
+  then wait for the evicted pods to disappear, bounded by a timeout,
+* kubectl's filter semantics: DaemonSet-owned pods are skipped, mirror pods
+  are skipped, finished pods are deleted freely, unmanaged (controller-less)
+  pods are an error unless ``force``, pods with emptyDir volumes are an error
+  unless ``delete_empty_dir``.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from .client import Client, NotFoundError
+from .objects import Pod
+from .selectors import parse_selector
+
+
+class DrainError(Exception):
+    pass
+
+
+class DrainTimeoutError(DrainError):
+    pass
+
+
+#: Extra per-pod veto/accept hook: return False to leave the pod in place.
+PodFilter = Callable[[Pod], bool]
+
+
+@dataclass
+class DrainConfig:
+    """Mirror of the drain.Helper knobs the reference sets
+    (reference: pkg/upgrade/drain_manager.go:76-96)."""
+
+    force: bool = False
+    delete_empty_dir: bool = False
+    #: 0 means no timeout (reference: DrainSpec.TimeoutSecond zero semantics).
+    timeout_seconds: int = 0
+    grace_period_seconds: Optional[int] = None
+    pod_selector: str = ""
+    ignore_daemonset_pods: bool = True
+    #: Additional filters ANDed onto the kubectl chain (reference:
+    #: pod_manager.go:136-157 uses this for the custom deletion filter).
+    extra_filters: tuple[PodFilter, ...] = field(default_factory=tuple)
+    #: Poll interval while waiting for evicted pods to vanish.
+    poll_interval_seconds: float = 0.05
+
+
+class DrainHelper:
+    def __init__(self, client: Client) -> None:
+        self._client = client
+
+    # -- cordon ------------------------------------------------------------
+    def cordon(self, node_name: str) -> None:
+        self._set_unschedulable(node_name, True)
+
+    def uncordon(self, node_name: str) -> None:
+        self._set_unschedulable(node_name, False)
+
+    def _set_unschedulable(self, node_name: str, value: bool) -> None:
+        self._client.patch("Node", node_name, patch={"spec": {"unschedulable": value}})
+
+    # -- drain -------------------------------------------------------------
+    def pods_to_evict(self, node_name: str, cfg: DrainConfig) -> list[Pod]:
+        """Apply the kubectl filter chain and return the pods to remove.
+
+        Raises DrainError when a pod is ineligible (unmanaged without force,
+        emptyDir without delete_empty_dir) — matching kubectl, the node drain
+        fails as a whole rather than silently skipping.
+        """
+        selector = parse_selector(cfg.pod_selector)
+        pods = self._client.list(
+            "Pod", field_selector=f"spec.nodeName={node_name}"
+        )
+        out: list[Pod] = []
+        for obj in pods:
+            pod = Pod(obj.raw)
+            if not selector.matches(pod.metadata.get("labels") or {}):
+                continue
+            if pod.is_mirror_pod():
+                continue
+            if pod.is_daemonset_pod() and cfg.ignore_daemonset_pods:
+                continue
+            if pod.deletion_timestamp is not None:
+                continue  # already terminating
+            # Custom filters veto before eligibility errors: a pod the caller
+            # never wanted to evict must not fail the whole drain (the
+            # reference's custom deletion filter selects only device-using
+            # pods, pod_manager.go:136-157).
+            if any(not f(pod) for f in cfg.extra_filters):
+                continue
+            if pod.is_finished():
+                out.append(pod)
+                continue
+            if not pod.has_controller() and not cfg.force:
+                raise DrainError(
+                    f"pod {pod.namespace}/{pod.name} is unmanaged; "
+                    "use force to evict"
+                )
+            if pod.has_empty_dir() and not cfg.delete_empty_dir:
+                raise DrainError(
+                    f"pod {pod.namespace}/{pod.name} uses emptyDir; "
+                    "use delete_empty_dir to evict"
+                )
+            out.append(pod)
+        return out
+
+    def drain(self, node_name: str, cfg: Optional[DrainConfig] = None) -> int:
+        """Cordon the node, evict eligible pods, wait for them to terminate.
+
+        Returns the number of pods evicted. Raises DrainTimeoutError if pods
+        are still present at the deadline.
+        """
+        cfg = cfg or DrainConfig()
+        deadline = (
+            time.monotonic() + cfg.timeout_seconds if cfg.timeout_seconds else None
+        )
+        self.cordon(node_name)
+        pods = self.pods_to_evict(node_name, cfg)
+        for pod in pods:
+            try:
+                self._client.evict(pod.name, pod.namespace)
+            except NotFoundError:
+                continue
+        remaining = {(p.namespace, p.name) for p in pods}
+        while remaining:
+            gone = set()
+            for ns, name in remaining:
+                try:
+                    self._client.get("Pod", name, ns)
+                except NotFoundError:
+                    gone.add((ns, name))
+            remaining -= gone
+            if not remaining:
+                break
+            if deadline is not None and time.monotonic() > deadline:
+                raise DrainTimeoutError(
+                    f"drain of {node_name} timed out with {len(remaining)} "
+                    f"pods remaining"
+                )
+            time.sleep(cfg.poll_interval_seconds)
+        return len(pods)

@@ -1,0 +1,88 @@
+"""Kubernetes Event recording.
+
+The reference plumbs an EventRecorder through every manager and emits
+``Normal``/``Warning`` events on nodes for each state transition (reference:
+pkg/upgrade/util.go:163-176, node_upgrade_state_provider.go:123-131). Tests
+use a bounded fake recorder drained between specs (reference:
+upgrade_suit_test.go:69, 203-206).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Deque, Optional
+
+from .client import Client
+from .objects import Event, KubeObject
+
+EVENT_TYPE_NORMAL = "Normal"
+EVENT_TYPE_WARNING = "Warning"
+
+
+class EventRecorder:
+    """Records events as real Event objects in a cluster."""
+
+    def __init__(self, client: Client, namespace: str = "default") -> None:
+        self._client = client
+        self._namespace = namespace
+
+    def event(
+        self,
+        obj: KubeObject,
+        event_type: str,
+        reason: str,
+        message: str,
+    ) -> None:
+        ev = Event()
+        ev.name = f"{obj.name}.{uuid.uuid4().hex[:10]}"
+        ev.namespace = obj.namespace or self._namespace
+        ev.raw.update(
+            {
+                "type": event_type,
+                "reason": reason,
+                "message": message,
+                "involvedObject": {
+                    "kind": obj.raw.get("kind", ""),
+                    "name": obj.name,
+                    "namespace": obj.namespace,
+                    "uid": obj.uid,
+                },
+                "firstTimestamp": time.time(),
+            }
+        )
+        self._client.create(ev)
+
+    def eventf(
+        self, obj: KubeObject, event_type: str, reason: str, fmt: str, *args
+    ) -> None:
+        self.event(obj, event_type, reason, fmt % args if args else fmt)
+
+
+class FakeRecorder:
+    """In-memory recorder with a bounded buffer, mirroring
+    record.FakeRecorder(100) in the reference suites."""
+
+    def __init__(self, capacity: int = 100) -> None:
+        self._lock = threading.Lock()
+        self.capacity = capacity
+        self.messages: Deque[str] = deque(maxlen=capacity)
+
+    def event(
+        self, obj: KubeObject, event_type: str, reason: str, message: str
+    ) -> None:
+        with self._lock:
+            self.messages.append(f"{event_type} {reason} {message}")
+
+    def eventf(
+        self, obj: KubeObject, event_type: str, reason: str, fmt: str, *args
+    ) -> None:
+        self.event(obj, event_type, reason, fmt % args if args else fmt)
+
+    def drain(self) -> list[str]:
+        with self._lock:
+            out = list(self.messages)
+            self.messages.clear()
+            return out

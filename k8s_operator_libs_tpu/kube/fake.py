@@ -1,0 +1,307 @@
+"""An in-memory Kubernetes apiserver for tests and simulation.
+
+Plays the role envtest plays in the reference's suites — a real apiserver+etcd
+booted locally with no kubelets, against which Node/Pod/CR objects are plain
+API objects (reference: upgrade_suit_test.go:87-93, §4 of SURVEY.md). This
+implementation keeps the apiserver *semantics* the framework depends on:
+
+* monotonic resourceVersion, bumped on every write,
+* optimistic concurrency (Conflict on stale resourceVersion for updates),
+* RFC 7386 merge patch with ``null`` deleting keys,
+* finalizers: delete marks ``deletionTimestamp`` and the object lingers until
+  finalizers are cleared (the reference's suites strip NodeMaintenance
+  finalizers in cleanup, upgrade_state_test.go:1797-1813),
+* label/field selector list filtering,
+* watch events for cache emulation,
+* injectable reactors for fault injection (client-go fake style).
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import threading
+import time
+import uuid
+from typing import Any, Callable, Mapping, Optional
+
+from .client import (
+    AlreadyExistsError,
+    ApiError,
+    Client,
+    ConflictError,
+    InvalidError,
+    NotFoundError,
+)
+from .objects import KINDS, KubeObject, wrap
+from .selectors import LabelSelector, parse_field_selector, parse_selector
+
+#: reactor signature: (verb, kind, payload) -> None; raise to inject a failure.
+Reactor = Callable[[str, str, dict[str, Any]], None]
+
+_WATCH_ADDED = "ADDED"
+_WATCH_MODIFIED = "MODIFIED"
+_WATCH_DELETED = "DELETED"
+
+
+def merge_patch(target: dict[str, Any], patch: Mapping[str, Any]) -> dict[str, Any]:
+    """Apply an RFC 7386 JSON merge patch in place; null values delete keys.
+
+    This is the write primitive the whole state machine rides on — label and
+    annotation writes are merge patches with ``null`` used for key deletion
+    (reference: pkg/upgrade/node_upgrade_state_provider.go:80-82, 147-150).
+    """
+    for key, value in patch.items():
+        if value is None:
+            target.pop(key, None)
+        elif isinstance(value, Mapping):
+            existing = target.get(key)
+            if not isinstance(existing, dict):
+                existing = {}
+                target[key] = existing
+            merge_patch(existing, value)
+        else:
+            target[key] = copy.deepcopy(value)
+    return target
+
+
+def _field_value(data: Mapping[str, Any], dotted: str) -> Any:
+    cur: Any = data
+    for part in dotted.split("."):
+        if not isinstance(cur, Mapping):
+            return None
+        cur = cur.get(part)
+    return cur
+
+
+class FakeCluster(Client):
+    """Thread-safe in-memory object store with apiserver semantics."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._store: dict[tuple[str, str, str], dict[str, Any]] = {}
+        self._rv = itertools.count(1)
+        self._reactors: list[tuple[str, str, Reactor]] = []
+        self._watchers: list[Callable[[str, dict[str, Any]], None]] = []
+        self._changed = threading.Condition(self._lock)
+        self._generation = 0
+
+    # -- fault injection ---------------------------------------------------
+    def add_reactor(self, verb: str, kind: str, fn: Reactor) -> None:
+        """Install a hook run before ``verb`` ("*" matches all) on ``kind``."""
+        self._reactors.append((verb, kind, fn))
+
+    def _react(self, verb: str, kind: str, payload: dict[str, Any]) -> None:
+        for v, k, fn in self._reactors:
+            if v in ("*", verb) and k in ("*", kind):
+                fn(verb, kind, payload)
+
+    # -- watch -------------------------------------------------------------
+    def subscribe(self, fn: Callable[[str, dict[str, Any]], None]) -> None:
+        """Register a watcher receiving (event_type, object_dict) on every write."""
+        with self._lock:
+            self._watchers.append(fn)
+
+    def _emit(self, event: str, data: dict[str, Any]) -> None:
+        snapshot = copy.deepcopy(data)
+        for fn in list(self._watchers):
+            fn(event, snapshot)
+        with self._changed:
+            self._generation += 1
+            self._changed.notify_all()
+
+    @property
+    def generation(self) -> int:
+        """Monotonic write counter; compare across calls to detect changes
+        without relying on notification delivery."""
+        with self._changed:
+            return self._generation
+
+    def wait_for_change(self, timeout: float, after_generation: int = -1) -> int:
+        """Block until the write generation exceeds ``after_generation`` (or
+        the timeout elapses) and return the current generation. Immune to
+        lost notifications: callers track the generation they last saw."""
+        deadline = time.monotonic() + timeout
+        with self._changed:
+            while self._generation <= after_generation:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._changed.wait(remaining)
+            return self._generation
+
+    # -- helpers -----------------------------------------------------------
+    @staticmethod
+    def _key(kind: str, namespace: str, name: str) -> tuple[str, str, str]:
+        cls = KINDS.get(kind)
+        if cls is not None and not cls.NAMESPACED:
+            namespace = ""
+        return (kind, namespace, name)
+
+    def _bump(self, data: dict[str, Any]) -> None:
+        data.setdefault("metadata", {})["resourceVersion"] = str(next(self._rv))
+
+    def _get_raw(self, kind: str, name: str, namespace: str) -> dict[str, Any]:
+        key = self._key(kind, namespace, name)
+        data = self._store.get(key)
+        if data is None:
+            raise NotFoundError(f"{kind} {namespace}/{name} not found")
+        return data
+
+    def _finalize_delete_if_due(self, kind: str, name: str, namespace: str) -> None:
+        """Remove a deletionTimestamp-marked object once finalizers are gone."""
+        key = self._key(kind, namespace, name)
+        data = self._store.get(key)
+        if data is None:
+            return
+        meta = data.get("metadata", {})
+        if meta.get("deletionTimestamp") and not meta.get("finalizers"):
+            del self._store[key]
+            self._emit(_WATCH_DELETED, data)
+
+    # -- Client API --------------------------------------------------------
+    def get(self, kind: str, name: str, namespace: str = "") -> KubeObject:
+        with self._lock:
+            self._react("get", kind, {"name": name, "namespace": namespace})
+            return wrap(copy.deepcopy(self._get_raw(kind, name, namespace)))
+
+    def list(
+        self,
+        kind: str,
+        namespace: str = "",
+        label_selector: Optional[str | Mapping[str, str]] = None,
+        field_selector: Optional[str] = None,
+    ) -> list[KubeObject]:
+        if isinstance(label_selector, Mapping):
+            selector = LabelSelector.from_match_labels(label_selector)
+        else:
+            selector = parse_selector(label_selector)
+        fields = parse_field_selector(field_selector)
+        with self._lock:
+            self._react("list", kind, {"namespace": namespace})
+            out = []
+            for (k, ns, _), data in sorted(self._store.items()):
+                if k != kind:
+                    continue
+                if namespace and ns != namespace:
+                    continue
+                labels = (data.get("metadata") or {}).get("labels") or {}
+                if not selector.matches(labels):
+                    continue
+                if any(_field_value(data, f) != v for f, v in fields.items()):
+                    continue
+                out.append(wrap(copy.deepcopy(data)))
+            return out
+
+    def create(self, obj: KubeObject) -> KubeObject:
+        kind = obj.raw.get("kind", "")
+        if not kind or not obj.name:
+            raise InvalidError("object must have kind and metadata.name")
+        with self._lock:
+            self._react("create", kind, obj.raw)
+            key = self._key(kind, obj.namespace, obj.name)
+            if key in self._store:
+                raise AlreadyExistsError(f"{kind} {obj.name} already exists")
+            data = copy.deepcopy(obj.raw)
+            meta = data.setdefault("metadata", {})
+            meta.setdefault("uid", str(uuid.uuid4()))
+            meta.setdefault("creationTimestamp", time.time())
+            self._bump(data)
+            self._store[key] = data
+            self._emit(_WATCH_ADDED, data)
+            return wrap(copy.deepcopy(data))
+
+    def _replace(self, obj: KubeObject, status_only: bool) -> KubeObject:
+        kind = obj.raw.get("kind", "")
+        with self._lock:
+            verb = "update_status" if status_only else "update"
+            self._react(verb, kind, obj.raw)
+            current = self._get_raw(kind, obj.name, obj.namespace)
+            sent_rv = obj.resource_version
+            if sent_rv and sent_rv != current.get("metadata", {}).get("resourceVersion"):
+                raise ConflictError(
+                    f"{kind} {obj.name}: resourceVersion {sent_rv} is stale"
+                )
+            if status_only:
+                current["status"] = copy.deepcopy(obj.raw.get("status") or {})
+                data = current
+            else:
+                data = copy.deepcopy(obj.raw)
+                # Immutable/server-owned fields survive a replace.
+                meta = data.setdefault("metadata", {})
+                cur_meta = current.get("metadata", {})
+                meta["uid"] = cur_meta.get("uid")
+                meta["creationTimestamp"] = cur_meta.get("creationTimestamp")
+                if cur_meta.get("deletionTimestamp"):
+                    meta["deletionTimestamp"] = cur_meta["deletionTimestamp"]
+                # The status subresource is ignored on a main-resource update,
+                # as on a real apiserver with subresources enabled.
+                if "status" in current:
+                    data["status"] = current["status"]
+                else:
+                    data.pop("status", None)
+                self._store[self._key(kind, obj.namespace, obj.name)] = data
+            self._bump(data)
+            self._emit(_WATCH_MODIFIED, data)
+            self._finalize_delete_if_due(kind, obj.name, obj.namespace)
+            return wrap(copy.deepcopy(data))
+
+    def update(self, obj: KubeObject) -> KubeObject:
+        return self._replace(obj, status_only=False)
+
+    def update_status(self, obj: KubeObject) -> KubeObject:
+        return self._replace(obj, status_only=True)
+
+    def patch(
+        self,
+        kind: str,
+        name: str,
+        namespace: str = "",
+        patch: Optional[Mapping[str, Any]] = None,
+    ) -> KubeObject:
+        with self._lock:
+            self._react("patch", kind, {"name": name, "namespace": namespace,
+                                        "patch": dict(patch or {})})
+            current = self._get_raw(kind, name, namespace)
+            merge_patch(current, patch or {})
+            # A patch cannot rename or unscope the object.
+            meta = current.setdefault("metadata", {})
+            meta["name"] = name
+            self._bump(current)
+            self._emit(_WATCH_MODIFIED, current)
+            self._finalize_delete_if_due(kind, name, namespace)
+            return wrap(copy.deepcopy(current))
+
+    def delete(
+        self,
+        kind: str,
+        name: str,
+        namespace: str = "",
+        grace_period_seconds: Optional[int] = None,
+    ) -> None:
+        with self._lock:
+            self._react("delete", kind, {"name": name, "namespace": namespace})
+            key = self._key(kind, namespace, name)
+            data = self._get_raw(kind, name, namespace)
+            meta = data.setdefault("metadata", {})
+            if meta.get("finalizers"):
+                if not meta.get("deletionTimestamp"):
+                    meta["deletionTimestamp"] = time.time()
+                    self._bump(data)
+                    self._emit(_WATCH_MODIFIED, data)
+                return
+            del self._store[key]
+            self._emit(_WATCH_DELETED, data)
+
+    def evict(self, pod_name: str, namespace: str = "") -> None:
+        with self._lock:
+            self._react("evict", "Pod", {"name": pod_name, "namespace": namespace})
+            self.delete("Pod", pod_name, namespace)
+
+    # -- test conveniences -------------------------------------------------
+    def load(self, *objs: KubeObject) -> list[KubeObject]:
+        return [self.create(o) for o in objs]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._store)

@@ -1,0 +1,392 @@
+"""A minimal, dict-backed Kubernetes object model.
+
+Kubernetes objects are JSON documents; this model embraces that instead of
+mirroring Go structs. Every object is a thin typed view over its own dict —
+round-tripping, deep-copying and merge-patching are therefore exact by
+construction, and only the fields the framework actually reads get accessors.
+
+Kinds covered are the ones the reference touches: Node, Pod, DaemonSet,
+ControllerRevision, Event (reference: pkg/upgrade), CustomResourceDefinition
+(reference: pkg/crdutil), and the external NodeMaintenance CR (reference:
+Mellanox maintenance-operator API, used by pkg/upgrade/upgrade_requestor.go).
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+from typing import Any, Iterable, Mapping, MutableMapping, Optional, Type
+
+
+def _ensure(d: MutableMapping[str, Any], key: str) -> dict[str, Any]:
+    if key not in d or d[key] is None:
+        d[key] = {}
+    return d[key]
+
+
+def _ensure_list(d: MutableMapping[str, Any], key: str) -> list[Any]:
+    if key not in d or d[key] is None:
+        d[key] = []
+    return d[key]
+
+
+class KubeObject:
+    """Typed view over a Kubernetes object dict."""
+
+    KIND = ""
+    API_VERSION = ""
+    NAMESPACED = True
+
+    def __init__(self, data: Optional[dict[str, Any]] = None) -> None:
+        self.raw: dict[str, Any] = data if data is not None else {}
+        self.raw.setdefault("apiVersion", self.API_VERSION)
+        self.raw.setdefault("kind", self.KIND)
+        self.raw.setdefault("metadata", {})
+
+    # -- metadata ----------------------------------------------------------
+    @property
+    def metadata(self) -> dict[str, Any]:
+        return _ensure(self.raw, "metadata")
+
+    @property
+    def name(self) -> str:
+        return self.metadata.get("name", "")
+
+    @name.setter
+    def name(self, value: str) -> None:
+        self.metadata["name"] = value
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.get("namespace", "")
+
+    @namespace.setter
+    def namespace(self, value: str) -> None:
+        self.metadata["namespace"] = value
+
+    @property
+    def uid(self) -> str:
+        return self.metadata.get("uid", "")
+
+    @property
+    def resource_version(self) -> str:
+        return self.metadata.get("resourceVersion", "")
+
+    @property
+    def labels(self) -> dict[str, str]:
+        return _ensure(self.metadata, "labels")
+
+    @property
+    def annotations(self) -> dict[str, str]:
+        return _ensure(self.metadata, "annotations")
+
+    @property
+    def finalizers(self) -> list[str]:
+        return _ensure_list(self.metadata, "finalizers")
+
+    @property
+    def deletion_timestamp(self) -> Optional[float]:
+        return self.metadata.get("deletionTimestamp")
+
+    @property
+    def owner_references(self) -> list[dict[str, Any]]:
+        return _ensure_list(self.metadata, "ownerReferences")
+
+    def owned_by(self, owner: "KubeObject") -> bool:
+        return any(ref.get("uid") == owner.uid for ref in self.owner_references)
+
+    def add_owner_reference(self, owner: "KubeObject", controller: bool = True) -> None:
+        self.owner_references.append(
+            {
+                "apiVersion": owner.raw.get("apiVersion", ""),
+                "kind": owner.raw.get("kind", ""),
+                "name": owner.name,
+                "uid": owner.uid,
+                "controller": controller,
+            }
+        )
+
+    # -- common sections ---------------------------------------------------
+    @property
+    def spec(self) -> dict[str, Any]:
+        return _ensure(self.raw, "spec")
+
+    @property
+    def status(self) -> dict[str, Any]:
+        return _ensure(self.raw, "status")
+
+    # -- plumbing ----------------------------------------------------------
+    def deep_copy(self):
+        return type(self)(copy.deepcopy(self.raw))
+
+    def to_dict(self) -> dict[str, Any]:
+        return copy.deepcopy(self.raw)
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        ns = f"{self.namespace}/" if self.namespace else ""
+        return f"<{type(self).__name__} {ns}{self.name} rv={self.resource_version}>"
+
+    @classmethod
+    def new(
+        cls,
+        name: str,
+        namespace: str = "",
+        labels: Optional[Mapping[str, str]] = None,
+        annotations: Optional[Mapping[str, str]] = None,
+    ):
+        obj = cls()
+        obj.name = name
+        if namespace:
+            obj.namespace = namespace
+        if labels:
+            obj.labels.update(labels)
+        if annotations:
+            obj.annotations.update(annotations)
+        return obj
+
+
+def condition_status(obj_status: Mapping[str, Any], cond_type: str) -> Optional[str]:
+    """Return the status ("True"/"False"/"Unknown") of a condition, if set."""
+    for cond in obj_status.get("conditions") or []:
+        if cond.get("type") == cond_type:
+            return cond.get("status")
+    return None
+
+
+def set_condition(
+    obj_status: MutableMapping[str, Any],
+    cond_type: str,
+    status: str,
+    reason: str = "",
+    message: str = "",
+) -> None:
+    conds = _ensure_list(obj_status, "conditions")
+    for cond in conds:
+        if cond.get("type") == cond_type:
+            cond.update(
+                {"status": status, "reason": reason, "message": message,
+                 "lastTransitionTime": time.time()}
+            )
+            return
+    conds.append(
+        {"type": cond_type, "status": status, "reason": reason, "message": message,
+         "lastTransitionTime": time.time()}
+    )
+
+
+class Node(KubeObject):
+    KIND = "Node"
+    API_VERSION = "v1"
+    NAMESPACED = False
+
+    @property
+    def unschedulable(self) -> bool:
+        return bool(self.spec.get("unschedulable", False))
+
+    @unschedulable.setter
+    def unschedulable(self, value: bool) -> None:
+        self.spec["unschedulable"] = bool(value)
+
+    def is_ready(self) -> bool:
+        """Node readiness; an absent Ready condition counts as ready
+        (reference: pkg/upgrade/common_manager.go:656-663)."""
+        status = condition_status(self.status, "Ready")
+        return status is None or status == "True"
+
+    def set_ready(self, ready: bool) -> None:
+        set_condition(self.status, "Ready", "True" if ready else "False")
+
+
+MIRROR_POD_ANNOTATION = "kubernetes.io/config.mirror"
+
+
+class Pod(KubeObject):
+    KIND = "Pod"
+    API_VERSION = "v1"
+
+    @property
+    def node_name(self) -> str:
+        return self.spec.get("nodeName", "")
+
+    @node_name.setter
+    def node_name(self, value: str) -> None:
+        self.spec["nodeName"] = value
+
+    @property
+    def phase(self) -> str:
+        return self.status.get("phase", "")
+
+    @phase.setter
+    def phase(self, value: str) -> None:
+        self.status["phase"] = value
+
+    def is_ready(self) -> bool:
+        return self.phase == "Running" and condition_status(self.status, "Ready") == "True"
+
+    def is_finished(self) -> bool:
+        return self.phase in ("Succeeded", "Failed")
+
+    def is_mirror_pod(self) -> bool:
+        return MIRROR_POD_ANNOTATION in (self.metadata.get("annotations") or {})
+
+    def is_daemonset_pod(self) -> bool:
+        return any(
+            ref.get("kind") == "DaemonSet" and ref.get("controller")
+            for ref in self.owner_references
+        )
+
+    def has_controller(self) -> bool:
+        return any(ref.get("controller") for ref in self.owner_references)
+
+    def has_empty_dir(self) -> bool:
+        return any(
+            "emptyDir" in (vol or {}) for vol in self.spec.get("volumes") or []
+        )
+
+    @property
+    def container_statuses(self) -> list[dict[str, Any]]:
+        return self.status.get("containerStatuses") or []
+
+    @property
+    def init_container_statuses(self) -> list[dict[str, Any]]:
+        return self.status.get("initContainerStatuses") or []
+
+    def controller_revision_hash(self) -> str:
+        """DaemonSet rollout hash from the pod-template label
+        (reference: pkg/upgrade/pod_manager.go:84-89)."""
+        return self.labels.get("controller-revision-hash", "")
+
+
+class DaemonSet(KubeObject):
+    KIND = "DaemonSet"
+    API_VERSION = "apps/v1"
+
+    @property
+    def match_labels(self) -> dict[str, str]:
+        return (self.spec.get("selector") or {}).get("matchLabels") or {}
+
+    @match_labels.setter
+    def match_labels(self, value: Mapping[str, str]) -> None:
+        _ensure(self.spec, "selector")["matchLabels"] = dict(value)
+
+    @property
+    def desired_number_scheduled(self) -> int:
+        return int(self.status.get("desiredNumberScheduled", 0))
+
+    @desired_number_scheduled.setter
+    def desired_number_scheduled(self, value: int) -> None:
+        self.status["desiredNumberScheduled"] = int(value)
+
+    @property
+    def template(self) -> dict[str, Any]:
+        return _ensure(self.spec, "template")
+
+
+class ControllerRevision(KubeObject):
+    KIND = "ControllerRevision"
+    API_VERSION = "apps/v1"
+
+    @property
+    def revision(self) -> int:
+        return int(self.raw.get("revision", 0))
+
+    @revision.setter
+    def revision(self, value: int) -> None:
+        self.raw["revision"] = int(value)
+
+    def hash_label(self) -> str:
+        return self.labels.get("controller-revision-hash", "")
+
+
+class Event(KubeObject):
+    KIND = "Event"
+    API_VERSION = "v1"
+
+
+class CustomResourceDefinition(KubeObject):
+    KIND = "CustomResourceDefinition"
+    API_VERSION = "apiextensions.k8s.io/v1"
+    NAMESPACED = False
+
+    @property
+    def group(self) -> str:
+        return self.spec.get("group", "")
+
+    @property
+    def served_versions(self) -> list[str]:
+        return [
+            v.get("name", "")
+            for v in self.spec.get("versions") or []
+            if v.get("served", False)
+        ]
+
+    def is_established(self) -> bool:
+        return condition_status(self.status, "Established") == "True"
+
+
+class NodeMaintenance(KubeObject):
+    """External maintenance-operator CR (protocol surface, not vendored).
+
+    Field parity with the Mellanox maintenance-operator API v0.3.0 as consumed
+    by reference: pkg/upgrade/upgrade_requestor.go:161-174, 497-524.
+    """
+
+    KIND = "NodeMaintenance"
+    API_VERSION = "maintenance.nvidia.com/v1alpha1"
+
+    CONDITION_READY = "Ready"
+    CONDITION_REASON_READY = "Ready"
+
+    @property
+    def requestor_id(self) -> str:
+        return self.spec.get("requestorID", "")
+
+    @requestor_id.setter
+    def requestor_id(self, value: str) -> None:
+        self.spec["requestorID"] = value
+
+    @property
+    def additional_requestors(self) -> list[str]:
+        return _ensure_list(self.spec, "additionalRequestors")
+
+    @additional_requestors.setter
+    def additional_requestors(self, value: Iterable[str]) -> None:
+        self.spec["additionalRequestors"] = list(value)
+
+    @property
+    def node_name(self) -> str:
+        return self.spec.get("nodeName", "")
+
+    @node_name.setter
+    def node_name(self, value: str) -> None:
+        self.spec["nodeName"] = value
+
+    def is_ready(self) -> bool:
+        return condition_status(self.status, self.CONDITION_READY) == "True"
+
+    def ready_reason(self) -> str:
+        for cond in self.status.get("conditions") or []:
+            if cond.get("type") == self.CONDITION_READY:
+                return cond.get("reason", "")
+        return ""
+
+
+#: Registry used by clients to construct typed wrappers from raw dicts.
+KINDS: dict[str, Type[KubeObject]] = {
+    cls.KIND: cls
+    for cls in (
+        Node,
+        Pod,
+        DaemonSet,
+        ControllerRevision,
+        Event,
+        CustomResourceDefinition,
+        NodeMaintenance,
+    )
+}
+
+
+def wrap(data: dict[str, Any]) -> KubeObject:
+    """Wrap a raw dict in its typed class (falls back to KubeObject)."""
+    cls = KINDS.get(data.get("kind", ""), KubeObject)
+    return cls(data)

@@ -1,0 +1,114 @@
+"""Fixture builders shared across suites.
+
+Model: the reference's fluent test builders — NewNode/NewDaemonSet/NewPod
+(auto Running+Ready)/NewNodeMaintenance (reference:
+upgrade_suit_test.go:216-428).
+"""
+
+from __future__ import annotations
+
+import itertools
+import uuid
+from typing import Mapping, Optional
+
+from k8s_operator_libs_tpu.kube import (
+    ControllerRevision,
+    DaemonSet,
+    Node,
+    NodeMaintenance,
+    Pod,
+)
+
+_seq = itertools.count(1)
+
+
+def unique(prefix: str) -> str:
+    return f"{prefix}-{next(_seq)}-{uuid.uuid4().hex[:6]}"
+
+
+def make_node(
+    name: Optional[str] = None,
+    labels: Optional[Mapping[str, str]] = None,
+    annotations: Optional[Mapping[str, str]] = None,
+    unschedulable: bool = False,
+    ready: bool = True,
+) -> Node:
+    node = Node.new(name or unique("node"), labels=labels, annotations=annotations)
+    node.unschedulable = unschedulable
+    node.set_ready(ready)
+    return node
+
+
+def make_daemonset(
+    name: Optional[str] = None,
+    namespace: str = "driver-ns",
+    match_labels: Optional[Mapping[str, str]] = None,
+    desired: int = 0,
+) -> DaemonSet:
+    ds = DaemonSet.new(name or unique("ds"), namespace=namespace)
+    ds.match_labels = dict(match_labels or {"app": "driver"})
+    ds.labels.update(ds.match_labels)
+    ds.desired_number_scheduled = desired
+    return ds
+
+
+def make_pod(
+    name: Optional[str] = None,
+    namespace: str = "driver-ns",
+    node_name: str = "",
+    labels: Optional[Mapping[str, str]] = None,
+    phase: str = "Running",
+    ready: bool = True,
+    owner: Optional[DaemonSet] = None,
+    revision_hash: str = "",
+    empty_dir: bool = False,
+    controlled: bool = False,
+) -> Pod:
+    pod = Pod.new(name or unique("pod"), namespace=namespace, labels=labels)
+    pod.node_name = node_name
+    pod.phase = phase
+    if ready and phase == "Running":
+        pod.status["conditions"] = [{"type": "Ready", "status": "True"}]
+    if owner is not None:
+        pod.add_owner_reference(owner)
+        pod.labels.update(owner.match_labels)
+    elif controlled:
+        pod.owner_references.append(
+            {"apiVersion": "apps/v1", "kind": "ReplicaSet",
+             "name": unique("rs"), "uid": str(uuid.uuid4()), "controller": True}
+        )
+    if revision_hash:
+        pod.labels["controller-revision-hash"] = revision_hash
+    if empty_dir:
+        pod.spec["volumes"] = [{"name": "scratch", "emptyDir": {}}]
+    return pod
+
+
+def make_controller_revision(
+    owner: DaemonSet, revision: int, hash_value: str
+) -> ControllerRevision:
+    cr = ControllerRevision.new(
+        f"{owner.name}-{hash_value}", namespace=owner.namespace
+    )
+    cr.revision = revision
+    cr.labels.update(owner.match_labels)
+    cr.labels["controller-revision-hash"] = hash_value
+    cr.add_owner_reference(owner)
+    return cr
+
+
+def make_node_maintenance(
+    name: Optional[str] = None,
+    namespace: str = "maintenance-ns",
+    node_name: str = "",
+    requestor_id: str = "tpu.operator.dev",
+    ready: bool = False,
+) -> NodeMaintenance:
+    nm = NodeMaintenance.new(name or unique("nm"), namespace=namespace)
+    nm.requestor_id = requestor_id
+    nm.node_name = node_name
+    if ready:
+        nm.status["conditions"] = [
+            {"type": "Ready", "status": "True", "reason": "Ready"}
+        ]
+    return nm

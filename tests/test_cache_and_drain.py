@@ -1,0 +1,184 @@
+"""Tests for the cached client's controllable staleness and the drain helper's
+kubectl filter-chain semantics."""
+
+import threading
+
+import pytest
+
+from k8s_operator_libs_tpu.kube import (
+    CachedClient,
+    DrainConfig,
+    DrainError,
+    DrainHelper,
+    DrainTimeoutError,
+    FakeCluster,
+    NotFoundError,
+)
+from builders import make_daemonset, make_node, make_pod
+
+
+@pytest.fixture
+def cluster():
+    return FakeCluster()
+
+
+class TestCachedClient:
+    def test_passthrough_reads_fresh(self, cluster):
+        cached = CachedClient(cluster, sync_mode="passthrough")
+        cluster.create(make_node("n1"))
+        assert cached.get("Node", "n1").name == "n1"
+
+    def test_manual_cache_is_stale_until_sync(self, cluster):
+        cached = CachedClient(cluster, sync_mode="manual")
+        cluster.create(make_node("n1"))
+        with pytest.raises(NotFoundError):
+            cached.get("Node", "n1")
+        cached.sync()
+        assert cached.get("Node", "n1").name == "n1"
+
+    def test_manual_cache_stale_labels(self, cluster):
+        cluster.create(make_node("n1"))
+        cached = CachedClient(cluster, sync_mode="manual")
+        cluster.patch("Node", "n1", patch={"metadata": {"labels": {"s": "new"}}})
+        assert "s" not in cached.get("Node", "n1").labels
+        cached.sync()
+        assert cached.get("Node", "n1").labels["s"] == "new"
+
+    def test_writes_bypass_cache(self, cluster):
+        cached = CachedClient(cluster, sync_mode="manual")
+        cached.create(make_node("n1"))
+        # Visible in backing immediately, not in cache until sync.
+        assert cluster.get("Node", "n1").name == "n1"
+        with pytest.raises(NotFoundError):
+            cached.get("Node", "n1")
+
+    def test_wait_until_wakes_on_sync(self, cluster):
+        cluster.create(make_node("n1"))
+        cached = CachedClient(cluster, sync_mode="manual")
+        cluster.patch("Node", "n1", patch={"metadata": {"labels": {"x": "1"}}})
+
+        def syncer():
+            cached.sync()
+
+        t = threading.Timer(0.1, syncer)
+        t.start()
+        ok = cached.wait_until(
+            lambda c: "x" in c.get("Node", "n1").labels, timeout=5
+        )
+        t.join()
+        assert ok
+
+    def test_wait_until_times_out(self, cluster):
+        cluster.create(make_node("n1"))
+        cached = CachedClient(cluster, sync_mode="manual")
+        cluster.patch("Node", "n1", patch={"metadata": {"labels": {"x": "1"}}})
+        ok = cached.wait_until(
+            lambda c: "x" in c.get("Node", "n1").labels, timeout=0.2
+        )
+        assert not ok
+
+    def test_auto_mode_catches_up(self, cluster):
+        cached = CachedClient(cluster, sync_mode="auto", lag_seconds=0.01)
+        try:
+            cluster.create(make_node("n1"))
+            ok = cached.wait_until(
+                lambda c: c.get_or_none("Node", "n1") is not None, timeout=5
+            )
+            assert ok
+        finally:
+            cached.close()
+
+
+class TestDrainFilters:
+    def make_helper(self, cluster):
+        return DrainHelper(cluster)
+
+    def test_cordon_uncordon(self, cluster):
+        cluster.create(make_node("n1"))
+        h = self.make_helper(cluster)
+        h.cordon("n1")
+        assert cluster.get("Node", "n1").unschedulable
+        h.uncordon("n1")
+        assert not cluster.get("Node", "n1").unschedulable
+
+    def test_daemonset_pods_skipped(self, cluster):
+        ds = cluster.create(make_daemonset("driver"))
+        cluster.create(make_node("n1"))
+        cluster.create(make_pod("driver-pod", node_name="n1", owner=ds))
+        cluster.create(make_pod("workload", node_name="n1", controlled=True))
+        h = self.make_helper(cluster)
+        evicted = h.drain("n1", DrainConfig())
+        assert evicted == 1
+        assert cluster.get_or_none("Pod", "driver-pod", "driver-ns") is not None
+        assert cluster.get_or_none("Pod", "workload", "driver-ns") is None
+
+    def test_unmanaged_pod_requires_force(self, cluster):
+        cluster.create(make_node("n1"))
+        cluster.create(make_pod("naked", node_name="n1"))
+        h = self.make_helper(cluster)
+        with pytest.raises(DrainError):
+            h.drain("n1", DrainConfig(force=False))
+        assert h.drain("n1", DrainConfig(force=True)) == 1
+
+    def test_empty_dir_requires_flag(self, cluster):
+        cluster.create(make_node("n1"))
+        cluster.create(
+            make_pod("scratchy", node_name="n1", controlled=True, empty_dir=True)
+        )
+        h = self.make_helper(cluster)
+        with pytest.raises(DrainError):
+            h.drain("n1", DrainConfig())
+        assert h.drain("n1", DrainConfig(delete_empty_dir=True)) == 1
+
+    def test_finished_pods_removed_without_force(self, cluster):
+        cluster.create(make_node("n1"))
+        cluster.create(make_pod("done", node_name="n1", phase="Succeeded"))
+        h = self.make_helper(cluster)
+        assert h.drain("n1", DrainConfig()) == 1
+
+    def test_pod_selector_limits_scope(self, cluster):
+        cluster.create(make_node("n1"))
+        cluster.create(
+            make_pod("a", node_name="n1", controlled=True, labels={"app": "x"})
+        )
+        cluster.create(
+            make_pod("b", node_name="n1", controlled=True, labels={"app": "y"})
+        )
+        h = self.make_helper(cluster)
+        assert h.drain("n1", DrainConfig(pod_selector="app=x")) == 1
+        assert cluster.get_or_none("Pod", "b", "driver-ns") is not None
+
+    def test_extra_filter_vetoes_before_eligibility_errors(self, cluster):
+        # A vetoed pod must not fail the drain even if it would be ineligible.
+        cluster.create(make_node("n1"))
+        cluster.create(make_pod("naked-debug", node_name="n1"))  # unmanaged
+        cfg = DrainConfig(extra_filters=(lambda p: p.name != "naked-debug",))
+        assert DrainHelper(cluster).drain("n1", cfg) == 0
+        assert cluster.get_or_none("Pod", "naked-debug", "driver-ns") is not None
+
+    def test_extra_filter_vetoes(self, cluster):
+        cluster.create(make_node("n1"))
+        cluster.create(
+            make_pod("keep", node_name="n1", controlled=True, labels={"keep": "1"})
+        )
+        cluster.create(make_pod("evict", node_name="n1", controlled=True))
+        cfg = DrainConfig(extra_filters=(lambda p: "keep" not in p.labels,))
+        assert self.make_helper(cluster).drain("n1", cfg) == 1
+        assert cluster.get_or_none("Pod", "keep", "driver-ns") is not None
+
+    def test_drain_timeout_when_pod_stuck(self, cluster, monkeypatch):
+        cluster.create(make_node("n1"))
+        cluster.create(make_pod("stuck", node_name="n1", controlled=True))
+        # Eviction "succeeds" but the pod never actually goes away.
+        monkeypatch.setattr(cluster, "evict", lambda name, ns="": None)
+        with pytest.raises(DrainTimeoutError):
+            DrainHelper(cluster).drain(
+                "n1", DrainConfig(timeout_seconds=1, poll_interval_seconds=0.02)
+            )
+
+    def test_other_nodes_untouched(self, cluster):
+        cluster.create(make_node("n1"))
+        cluster.create(make_node("n2"))
+        cluster.create(make_pod("on-n2", node_name="n2", controlled=True))
+        assert DrainHelper(cluster).drain("n1", DrainConfig()) == 0
+        assert cluster.get_or_none("Pod", "on-n2", "driver-ns") is not None

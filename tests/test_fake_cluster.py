@@ -1,0 +1,219 @@
+"""Tests for the in-memory apiserver: resourceVersion semantics, merge
+patches, finalizers, selectors, watch, reactors."""
+
+import threading
+
+import pytest
+
+from k8s_operator_libs_tpu.kube import (
+    AlreadyExistsError,
+    ConflictError,
+    FakeCluster,
+    Node,
+    NotFoundError,
+    Pod,
+    merge_patch,
+    retry_on_conflict,
+)
+from builders import make_node, make_pod
+
+
+@pytest.fixture
+def cluster():
+    return FakeCluster()
+
+
+class TestCrud:
+    def test_create_get(self, cluster):
+        created = cluster.create(make_node("n1"))
+        assert created.uid and created.resource_version
+        got = cluster.get("Node", "n1")
+        assert got.name == "n1"
+        assert got.uid == created.uid
+
+    def test_create_duplicate(self, cluster):
+        cluster.create(make_node("n1"))
+        with pytest.raises(AlreadyExistsError):
+            cluster.create(make_node("n1"))
+
+    def test_get_missing(self, cluster):
+        with pytest.raises(NotFoundError):
+            cluster.get("Node", "nope")
+
+    def test_returned_objects_are_copies(self, cluster):
+        cluster.create(make_node("n1"))
+        got = cluster.get("Node", "n1")
+        got.labels["mutated"] = "yes"
+        again = cluster.get("Node", "n1")
+        assert "mutated" not in again.labels
+
+    def test_delete(self, cluster):
+        cluster.create(make_node("n1"))
+        cluster.delete("Node", "n1")
+        with pytest.raises(NotFoundError):
+            cluster.get("Node", "n1")
+
+    def test_namespaced_kinds_isolated(self, cluster):
+        cluster.create(make_pod("p", namespace="ns1", node_name="n1"))
+        cluster.create(make_pod("p", namespace="ns2", node_name="n1"))
+        assert cluster.get("Pod", "p", "ns1").namespace == "ns1"
+        assert len(cluster.list("Pod")) == 2
+        assert len(cluster.list("Pod", namespace="ns1")) == 1
+
+
+class TestOptimisticConcurrency:
+    def test_update_bumps_rv(self, cluster):
+        n = cluster.create(make_node("n1"))
+        rv1 = n.resource_version
+        n.labels["x"] = "1"
+        n2 = cluster.update(n)
+        assert n2.resource_version != rv1
+
+    def test_stale_update_conflicts(self, cluster):
+        n = cluster.create(make_node("n1"))
+        stale = cluster.get("Node", "n1")
+        n.labels["x"] = "1"
+        cluster.update(n)
+        stale.labels["y"] = "2"
+        with pytest.raises(ConflictError):
+            cluster.update(stale)
+
+    def test_retry_on_conflict(self, cluster):
+        cluster.create(make_node("n1"))
+
+        def bump():
+            fresh = cluster.get("Node", "n1")
+            fresh.labels["count"] = str(int(fresh.labels.get("count", "0")) + 1)
+            cluster.update(fresh)
+
+        # Interleave writers; retry_on_conflict must converge.
+        def writer():
+            for _ in range(10):
+                retry_on_conflict(bump, attempts=50)
+
+        threads = [threading.Thread(target=writer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert cluster.get("Node", "n1").labels["count"] == "40"
+
+    def test_update_does_not_touch_status_subresource(self, cluster):
+        n = make_node("n1", ready=True)
+        cluster.create(n)
+        got = cluster.get("Node", "n1")
+        got.labels["x"] = "1"
+        got.raw["status"] = {}  # attempt to wipe status via main update
+        cluster.update(got)
+        fresh = cluster.get("Node", "n1")
+        assert fresh.labels["x"] == "1"
+        assert fresh.status.get("conditions"), "status must survive main update"
+
+    def test_update_status_only_touches_status(self, cluster):
+        cluster.create(make_node("n1", ready=True))
+        got = cluster.get("Node", "n1")
+        got.labels["x"] = "1"
+        got.set_ready(False)
+        cluster.update_status(got)
+        fresh = cluster.get("Node", "n1")
+        assert not fresh.is_ready()
+        assert "x" not in fresh.labels
+
+    def test_update_preserves_server_fields(self, cluster):
+        created = cluster.create(make_node("n1"))
+        got = cluster.get("Node", "n1")
+        del got.metadata["uid"]
+        updated = cluster.update(got)
+        assert updated.uid == created.uid
+
+
+class TestMergePatch:
+    def test_patch_adds_label(self, cluster):
+        cluster.create(make_node("n1"))
+        cluster.patch("Node", "n1", patch={"metadata": {"labels": {"a": "b"}}})
+        assert cluster.get("Node", "n1").labels["a"] == "b"
+
+    def test_null_deletes_key(self, cluster):
+        cluster.create(make_node("n1", annotations={"keep": "1", "drop": "2"}))
+        cluster.patch(
+            "Node", "n1", patch={"metadata": {"annotations": {"drop": None}}}
+        )
+        ann = cluster.get("Node", "n1").annotations
+        assert "drop" not in ann and ann["keep"] == "1"
+
+    def test_patch_missing_object(self, cluster):
+        with pytest.raises(NotFoundError):
+            cluster.patch("Node", "ghost", patch={"metadata": {}})
+
+    def test_patch_cannot_rename(self, cluster):
+        cluster.create(make_node("n1"))
+        cluster.patch("Node", "n1", patch={"metadata": {"name": "evil"}})
+        assert cluster.get("Node", "n1").name == "n1"
+
+    def test_merge_patch_unit(self):
+        target = {"a": {"b": 1, "c": 2}, "keep": True}
+        merge_patch(target, {"a": {"b": None, "d": 3}})
+        assert target == {"a": {"c": 2, "d": 3}, "keep": True}
+
+
+class TestFinalizers:
+    def test_delete_with_finalizer_lingers(self, cluster):
+        nm = make_node("n1")
+        nm.finalizers.append("test/finalizer")
+        cluster.create(nm)
+        cluster.delete("Node", "n1")
+        lingering = cluster.get("Node", "n1")
+        assert lingering.deletion_timestamp is not None
+        # Clearing the finalizer completes the deletion.
+        cluster.patch("Node", "n1", patch={"metadata": {"finalizers": None}})
+        with pytest.raises(NotFoundError):
+            cluster.get("Node", "n1")
+
+
+class TestListSelectors:
+    def test_label_selector_string(self, cluster):
+        cluster.create(make_node("n1", labels={"pool": "tpu"}))
+        cluster.create(make_node("n2", labels={"pool": "cpu"}))
+        names = [o.name for o in cluster.list("Node", label_selector="pool=tpu")]
+        assert names == ["n1"]
+
+    def test_match_labels_mapping(self, cluster):
+        cluster.create(make_node("n1", labels={"a": "1", "b": "2"}))
+        cluster.create(make_node("n2", labels={"a": "1"}))
+        names = [o.name for o in cluster.list("Node", label_selector={"a": "1", "b": "2"})]
+        assert names == ["n1"]
+
+    def test_field_selector_node_name(self, cluster):
+        cluster.create(make_pod("p1", node_name="n1"))
+        cluster.create(make_pod("p2", node_name="n2"))
+        pods = cluster.list("Pod", field_selector="spec.nodeName=n1")
+        assert [p.name for p in pods] == ["p1"]
+
+
+class TestWatchAndReactors:
+    def test_watch_events(self, cluster):
+        events = []
+        cluster.subscribe(lambda e, o: events.append((e, o["metadata"]["name"])))
+        cluster.create(make_node("n1"))
+        cluster.patch("Node", "n1", patch={"metadata": {"labels": {"a": "b"}}})
+        cluster.delete("Node", "n1")
+        assert events == [("ADDED", "n1"), ("MODIFIED", "n1"), ("DELETED", "n1")]
+
+    def test_reactor_injects_failure(self, cluster):
+        calls = {"n": 0}
+
+        def explode(verb, kind, payload):
+            calls["n"] += 1
+            raise ConflictError("injected")
+
+        cluster.add_reactor("patch", "Node", explode)
+        cluster.create(make_node("n1"))
+        with pytest.raises(ConflictError):
+            cluster.patch("Node", "n1", patch={})
+        assert calls["n"] == 1
+
+    def test_evict_deletes_pod(self, cluster):
+        cluster.create(make_pod("p1", node_name="n1"))
+        cluster.evict("p1", "driver-ns")
+        with pytest.raises(NotFoundError):
+            cluster.get("Pod", "p1", "driver-ns")
