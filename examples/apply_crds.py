@@ -1,0 +1,80 @@
+"""apply-crds: apply or delete CRDs from YAML files/directories.
+
+CLI parity with reference: examples/apply-crds/main.go:34-61 (flags
+``--crds-path`` (repeatable) and ``--operation apply|delete``), extended with
+``--demo`` which runs against the in-memory cluster — the zero-dependency
+end-to-end path (BASELINE config #1 analog without a kind cluster).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+# Allow running straight from a checkout without installation.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from k8s_operator_libs_tpu.crdutil import (
+    CRDOperation,
+    CRDProcessingError,
+    process_crds,
+)
+from k8s_operator_libs_tpu.kube import FakeCluster
+
+
+def build_client(args: argparse.Namespace):
+    if args.demo:
+        return FakeCluster(crd_establish_delay=0.05)
+    try:
+        from k8s_operator_libs_tpu.kube.rest import RestClient
+
+        return RestClient.from_environment()
+    except Exception as e:  # RestConfigError / ImportError until rest lands
+        raise SystemExit(
+            f"no cluster access configured ({e}); use --demo for the "
+            "in-memory cluster"
+        )
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="apply-crds", description=__doc__)
+    parser.add_argument(
+        "--crds-path",
+        action="append",
+        required=True,
+        help="file or directory with CRD YAML (repeatable, recursed)",
+    )
+    parser.add_argument(
+        "--operation",
+        choices=[op.value for op in CRDOperation],
+        default=CRDOperation.APPLY.value,
+    )
+    parser.add_argument(
+        "--no-wait",
+        action="store_true",
+        help="do not wait for CRDs to become established",
+    )
+    parser.add_argument(
+        "--demo",
+        action="store_true",
+        help="run against an in-memory cluster (no kubeconfig needed)",
+    )
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO, format="%(levelname)s %(message)s")
+
+    client = build_client(args)
+    try:
+        count = process_crds(
+            client, args.crds_path, args.operation, wait=not args.no_wait
+        )
+    except CRDProcessingError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    print(f"{args.operation}: processed {count} CRD(s)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

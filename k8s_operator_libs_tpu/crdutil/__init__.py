@@ -1,0 +1,23 @@
+from .crdutil import (
+    CRDOperation,
+    CRDProcessingError,
+    apply_crds,
+    delete_crds,
+    parse_crds_from_file,
+    parse_crds_from_paths,
+    process_crds,
+    wait_for_crds,
+    walk_crd_paths,
+)
+
+__all__ = [
+    "CRDOperation",
+    "CRDProcessingError",
+    "apply_crds",
+    "delete_crds",
+    "parse_crds_from_file",
+    "parse_crds_from_paths",
+    "process_crds",
+    "wait_for_crds",
+    "walk_crd_paths",
+]

@@ -1,0 +1,188 @@
+"""CRD apply/delete utility.
+
+Behavioral parity with reference: pkg/crdutil/crdutil.go:44-319 — apply or
+delete CustomResourceDefinitions from YAML files or directories (recursive),
+multi-document YAML with non-CRD documents skipped silently, create-or-update
+with retry-on-conflict and a fresh resourceVersion per attempt, deletion
+tolerating not-found, and wait-for-established polling each served version.
+
+Exists for the same reason the reference does (pkg/crdutil/README.md:8-15):
+Helm does not upgrade CRDs on chart upgrade, so operators need a first-class
+CRD lifecycle tool — device-agnostic, driving TPU CRDs on clusters with no GPU
+(BASELINE.json north star).
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+import time
+from typing import Iterable, Sequence
+
+import yaml
+
+from ..kube.client import Client, NotFoundError, retry_on_conflict
+from ..kube.objects import CustomResourceDefinition
+from ..utils.log import get_logger
+
+log = get_logger("crdutil")
+
+#: Poll cadence for wait-for-established (reference: crdutil.go:284-286).
+ESTABLISH_POLL_INTERVAL_SECONDS = 0.1
+ESTABLISH_TIMEOUT_SECONDS = 10.0
+
+CRD_KIND = "CustomResourceDefinition"
+_YAML_EXTENSIONS = (".yaml", ".yml")
+
+
+class CRDOperation(enum.StrEnum):
+    """Supported operations (reference: crdutil.go:44-51)."""
+
+    APPLY = "apply"
+    DELETE = "delete"
+
+
+class CRDProcessingError(Exception):
+    pass
+
+
+def walk_crd_paths(paths: Iterable[str]) -> list[str]:
+    """Expand files/directories into YAML file paths, recursing into
+    subdirectories (reference: crdutil.go:126-154). Missing paths error."""
+    out: list[str] = []
+    for path in paths:
+        if not os.path.exists(path):
+            raise CRDProcessingError(f"CRD path does not exist: {path}")
+        if os.path.isfile(path):
+            out.append(path)
+            continue
+        for dirpath, _, filenames in sorted(os.walk(path)):
+            for fname in sorted(filenames):
+                if fname.lower().endswith(_YAML_EXTENSIONS):
+                    out.append(os.path.join(dirpath, fname))
+    return out
+
+
+def parse_crds_from_file(path: str) -> list[CustomResourceDefinition]:
+    """Parse all CRD documents from one (possibly multi-document) YAML file.
+
+    Non-CRD documents and empty documents are skipped silently
+    (reference: crdutil.go:196-207 — the file may bundle other manifests).
+    """
+    crds: list[CustomResourceDefinition] = []
+    with open(path, "r", encoding="utf-8") as fh:
+        try:
+            docs = list(yaml.safe_load_all(fh))
+        except yaml.YAMLError as e:
+            raise CRDProcessingError(f"invalid YAML in {path}: {e}") from e
+    for doc in docs:
+        if not isinstance(doc, dict):
+            continue
+        if doc.get("kind") != CRD_KIND:
+            continue
+        if not (doc.get("metadata") or {}).get("name"):
+            raise CRDProcessingError(f"CRD document without metadata.name in {path}")
+        crds.append(CustomResourceDefinition(doc))
+    return crds
+
+
+def parse_crds_from_paths(paths: Iterable[str]) -> list[CustomResourceDefinition]:
+    files = walk_crd_paths(paths)
+    crds: list[CustomResourceDefinition] = []
+    for f in files:
+        crds.extend(parse_crds_from_file(f))
+    return crds
+
+
+def apply_crds(
+    client: Client,
+    crds: Sequence[CustomResourceDefinition],
+    wait: bool = True,
+    timeout_seconds: float | None = None,
+) -> None:
+    """Create or update each CRD, then optionally wait for establishment.
+
+    Update path refreshes the resourceVersion on every attempt and retries on
+    conflict (reference: crdutil.go:214-249).
+    """
+    for crd in crds:
+        existing = client.get_or_none(CRD_KIND, crd.name)
+        if existing is None:
+            log.info("creating CRD %s", crd.name)
+            client.create(crd.deep_copy())
+        else:
+            log.info("updating CRD %s", crd.name)
+
+            def attempt(crd=crd):
+                fresh = client.get(CRD_KIND, crd.name)
+                desired = crd.deep_copy()
+                desired.metadata["resourceVersion"] = fresh.resource_version
+                client.update(desired)
+
+            retry_on_conflict(attempt)
+    if wait:
+        wait_for_crds(client, crds, timeout_seconds=timeout_seconds)
+
+
+def wait_for_crds(
+    client: Client,
+    crds: Sequence[CustomResourceDefinition],
+    timeout_seconds: float | None = None,
+) -> None:
+    """Poll until every CRD is Established with all its served versions
+    present (reference: crdutil.go:275-319 polls discovery per version).
+
+    ``timeout_seconds=None`` reads ESTABLISH_TIMEOUT_SECONDS at call time so
+    it can be overridden module-wide."""
+    if timeout_seconds is None:
+        timeout_seconds = ESTABLISH_TIMEOUT_SECONDS
+    deadline = time.monotonic() + timeout_seconds
+    pending = {crd.name: crd for crd in crds}
+    while pending:
+        for name in list(pending):
+            current = client.get_or_none(CRD_KIND, name)
+            if current is None:
+                continue
+            cur = CustomResourceDefinition(current.raw)
+            wanted = set(pending[name].served_versions)
+            if cur.is_established() and wanted.issubset(set(cur.served_versions)):
+                del pending[name]
+        if not pending:
+            return
+        if time.monotonic() > deadline:
+            raise CRDProcessingError(
+                f"timed out waiting for CRDs to become established: "
+                f"{sorted(pending)}"
+            )
+        time.sleep(ESTABLISH_POLL_INTERVAL_SECONDS)
+
+
+def delete_crds(client: Client, crds: Sequence[CustomResourceDefinition]) -> None:
+    """Delete each CRD, tolerating already-absent ones
+    (reference: crdutil.go:252-272)."""
+    for crd in crds:
+        try:
+            client.delete(CRD_KIND, crd.name)
+            log.info("deleted CRD %s", crd.name)
+        except NotFoundError:
+            log.info("CRD %s already absent", crd.name)
+
+
+def process_crds(
+    client: Client,
+    paths: Iterable[str],
+    operation: CRDOperation | str,
+    wait: bool = True,
+    timeout_seconds: float | None = None,
+) -> int:
+    """Entry point mirroring ProcessCRDs (reference: crdutil.go:56-121).
+
+    Returns the number of CRD documents processed.
+    """
+    op = CRDOperation(operation)
+    crds = parse_crds_from_paths(paths)
+    if op is CRDOperation.APPLY:
+        apply_crds(client, crds, wait=wait, timeout_seconds=timeout_seconds)
+    else:
+        delete_crds(client, crds)
+    return len(crds)

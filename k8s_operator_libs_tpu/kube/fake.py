@@ -77,7 +77,11 @@ def _field_value(data: Mapping[str, Any], dotted: str) -> Any:
 class FakeCluster(Client):
     """Thread-safe in-memory object store with apiserver semantics."""
 
-    def __init__(self) -> None:
+    def __init__(
+        self,
+        auto_establish_crds: bool = True,
+        crd_establish_delay: float = 0.0,
+    ) -> None:
         self._lock = threading.RLock()
         self._store: dict[tuple[str, str, str], dict[str, Any]] = {}
         self._rv = itertools.count(1)
@@ -85,6 +89,12 @@ class FakeCluster(Client):
         self._watchers: list[Callable[[str, dict[str, Any]], None]] = []
         self._changed = threading.Condition(self._lock)
         self._generation = 0
+        # Emulate the apiserver's CRD controller: created CRDs gain the
+        # Established condition (immediately, or after a delay to exercise
+        # wait-for-established logic, reference: pkg/crdutil/crdutil.go:275-319).
+        self._auto_establish_crds = auto_establish_crds
+        self._crd_establish_delay = crd_establish_delay
+        self._pending_timers: list[threading.Timer] = []
 
     # -- fault injection ---------------------------------------------------
     def add_reactor(self, verb: str, kind: str, fn: Reactor) -> None:
@@ -209,7 +219,32 @@ class FakeCluster(Client):
             self._bump(data)
             self._store[key] = data
             self._emit(_WATCH_ADDED, data)
+            if kind == "CustomResourceDefinition" and self._auto_establish_crds:
+                if self._crd_establish_delay > 0:
+                    timer = threading.Timer(
+                        self._crd_establish_delay, self._establish_crd, (obj.name,)
+                    )
+                    timer.daemon = True
+                    self._pending_timers.append(timer)
+                    timer.start()
+                else:
+                    self._establish_crd_locked(data)
             return wrap(copy.deepcopy(data))
+
+    def _establish_crd_locked(self, data: dict[str, Any]) -> None:
+        status = data.setdefault("status", {})
+        conds = status.setdefault("conditions", [])
+        if not any(c.get("type") == "Established" for c in conds):
+            conds.append({"type": "Established", "status": "True"})
+            self._bump(data)
+            self._emit(_WATCH_MODIFIED, data)
+
+    def _establish_crd(self, name: str) -> None:
+        with self._lock:
+            key = self._key("CustomResourceDefinition", "", name)
+            data = self._store.get(key)
+            if data is not None:
+                self._establish_crd_locked(data)
 
     def _replace(self, obj: KubeObject, status_only: bool) -> KubeObject:
         kind = obj.raw.get("kind", "")
@@ -299,6 +334,12 @@ class FakeCluster(Client):
             self.delete("Pod", pod_name, namespace)
 
     # -- test conveniences -------------------------------------------------
+    def close(self) -> None:
+        """Cancel pending delayed-establish timers (test teardown hygiene)."""
+        for timer in self._pending_timers:
+            timer.cancel()
+        self._pending_timers.clear()
+
     def load(self, *objs: KubeObject) -> list[KubeObject]:
         return [self.create(o) for o in objs]
 

@@ -1,3 +1,10 @@
 from .consts import UpgradeState, DeviceClass, UpgradeKeys
+from .state_provider import NodeUpgradeStateProvider, StateWriteError
 
-__all__ = ["UpgradeState", "DeviceClass", "UpgradeKeys"]
+__all__ = [
+    "UpgradeState",
+    "DeviceClass",
+    "UpgradeKeys",
+    "NodeUpgradeStateProvider",
+    "StateWriteError",
+]

@@ -1,0 +1,139 @@
+"""crdutil integration tests against the in-memory apiserver.
+
+Coverage model: reference pkg/crdutil/crdutil_test.go:60-215 —
+create/update/delete/idempotency/recursive-walk/multi-path, plus
+wait-for-established behavior and the apply-crds example CLI.
+"""
+
+import os
+import sys
+import threading
+
+import pytest
+
+from k8s_operator_libs_tpu.crdutil import (
+    CRDOperation,
+    CRDProcessingError,
+    parse_crds_from_file,
+    process_crds,
+    wait_for_crds,
+    walk_crd_paths,
+)
+from k8s_operator_libs_tpu.kube import FakeCluster
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "crd_fixtures")
+CRDS = os.path.join(FIXTURES, "crds")
+UPDATED = os.path.join(FIXTURES, "updated")
+NESTED = os.path.join(FIXTURES, "nested")
+
+
+@pytest.fixture
+def cluster():
+    return FakeCluster()
+
+
+class TestWalkAndParse:
+    def test_walk_recursive_and_filtered(self):
+        files = walk_crd_paths([NESTED])
+        assert [os.path.basename(f) for f in files] == ["deep.yml"]
+
+    def test_walk_missing_path_errors(self):
+        with pytest.raises(CRDProcessingError):
+            walk_crd_paths([os.path.join(FIXTURES, "ghost")])
+
+    def test_walk_single_file(self):
+        f = os.path.join(CRDS, "widgets.yaml")
+        assert walk_crd_paths([f]) == [f]
+
+    def test_parse_multi_doc_skips_non_crds(self):
+        crds = parse_crds_from_file(os.path.join(CRDS, "widgets.yaml"))
+        assert [c.name for c in crds] == ["widgets.example.dev", "gadgets.example.dev"]
+
+    def test_parse_bad_yaml(self, tmp_path):
+        bad = tmp_path / "bad.yaml"
+        bad.write_text("kind: CustomResourceDefinition\n  broken: [indent")
+        with pytest.raises(CRDProcessingError):
+            parse_crds_from_file(str(bad))
+
+
+class TestApply:
+    def test_apply_creates_and_establishes(self, cluster):
+        count = process_crds(cluster, [CRDS], CRDOperation.APPLY)
+        assert count == 2
+        crd = cluster.get("CustomResourceDefinition", "widgets.example.dev")
+        assert crd.is_established()
+
+    def test_apply_is_idempotent(self, cluster):
+        process_crds(cluster, [CRDS], "apply")
+        rv1 = cluster.get("CustomResourceDefinition", "widgets.example.dev").resource_version
+        process_crds(cluster, [CRDS], "apply")
+        # Second apply updates (bumps rv) but must not duplicate or fail.
+        assert len(cluster.list("CustomResourceDefinition")) == 2
+
+    def test_apply_updates_existing(self, cluster):
+        process_crds(cluster, [CRDS], "apply")
+        process_crds(cluster, [UPDATED], "apply")
+        crd = cluster.get("CustomResourceDefinition", "widgets.example.dev")
+        assert crd.labels.get("rev") == "2"
+        assert crd.raw["spec"]["versions"][1]["name"] == "v2"
+
+    def test_apply_multiple_paths(self, cluster):
+        count = process_crds(cluster, [CRDS, NESTED], "apply")
+        assert count == 3
+
+    def test_wait_for_established_with_delay(self):
+        cluster = FakeCluster(crd_establish_delay=0.2)
+        count = process_crds(cluster, [NESTED], "apply")
+        assert count == 1
+        assert cluster.get("CustomResourceDefinition", "deeps.example.dev").is_established()
+
+    def test_wait_times_out_when_never_established(self, monkeypatch):
+        cluster = FakeCluster(auto_establish_crds=False)
+        monkeypatch.setattr(
+            "k8s_operator_libs_tpu.crdutil.crdutil.ESTABLISH_TIMEOUT_SECONDS", 0.3
+        )
+        with pytest.raises(CRDProcessingError, match="timed out"):
+            process_crds(cluster, [NESTED], "apply")
+
+    def test_update_waits_for_new_served_version(self, cluster):
+        process_crds(cluster, [CRDS], "apply")
+        crds = parse_crds_from_file(os.path.join(UPDATED, "widgets.yaml"))
+        # The fake stores whatever spec we write, so v2 is immediately served;
+        # wait_for_crds must check the *desired* versions, not just any.
+        process_crds(cluster, [UPDATED], "apply")
+        wait_for_crds(cluster, crds, timeout_seconds=1)
+
+
+class TestDelete:
+    def test_delete(self, cluster):
+        process_crds(cluster, [CRDS], "apply")
+        count = process_crds(cluster, [CRDS], CRDOperation.DELETE)
+        assert count == 2
+        assert cluster.list("CustomResourceDefinition") == []
+
+    def test_delete_tolerates_absent(self, cluster):
+        count = process_crds(cluster, [CRDS], "delete")
+        assert count == 2
+
+    def test_invalid_operation(self, cluster):
+        with pytest.raises(ValueError):
+            process_crds(cluster, [CRDS], "explode")
+
+
+class TestExampleCli:
+    def test_demo_apply(self, capsys):
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "examples"))
+        import apply_crds
+
+        rc = apply_crds.main(["--crds-path", CRDS, "--operation", "apply", "--demo"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "processed 2 CRD(s)" in out
+
+    def test_demo_missing_path_clean_error(self, capsys):
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "examples"))
+        import apply_crds
+
+        rc = apply_crds.main(["--crds-path", "/nope", "--demo"])
+        assert rc == 1
+        assert "does not exist" in capsys.readouterr().err

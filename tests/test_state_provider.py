@@ -1,0 +1,171 @@
+"""Tests for NodeUpgradeStateProvider — the cache-coherence keystone.
+
+Coverage model: reference node_upgrade_state_provider_test.go plus the
+stale-cache scenarios the reference can only document in comments
+(node_upgrade_state_provider.go:92-117); here the cache lag is provoked
+deliberately via CachedClient manual/auto modes.
+"""
+
+import threading
+
+import pytest
+
+from k8s_operator_libs_tpu.kube import CachedClient, FakeCluster, FakeRecorder
+from k8s_operator_libs_tpu.upgrade import DeviceClass, UpgradeKeys, UpgradeState
+from k8s_operator_libs_tpu.upgrade.state_provider import (
+    NodeUpgradeStateProvider,
+    StateWriteError,
+)
+from builders import make_node
+
+KEYS = UpgradeKeys(DeviceClass.tpu())
+
+
+@pytest.fixture
+def cluster():
+    return FakeCluster()
+
+
+def make_provider(cluster, reader=None, recorder=None, timeout=5.0):
+    return NodeUpgradeStateProvider(
+        cluster, KEYS, reader=reader, recorder=recorder, cache_sync_timeout=timeout
+    )
+
+
+class TestStateWrites:
+    def test_change_state_passthrough(self, cluster):
+        cluster.create(make_node("n1"))
+        p = make_provider(cluster)
+        node = p.get_node("n1")
+        p.change_node_upgrade_state(node, UpgradeState.UPGRADE_REQUIRED)
+        stored = cluster.get("Node", "n1")
+        assert stored.labels[KEYS.state_label] == "upgrade-required"
+        # Caller's object stays coherent.
+        assert node.labels[KEYS.state_label] == "upgrade-required"
+
+    def test_change_state_to_unknown_clears_label(self, cluster):
+        cluster.create(
+            make_node("n1", labels={KEYS.state_label: "upgrade-done"})
+        )
+        p = make_provider(cluster)
+        node = p.get_node("n1")
+        p.change_node_upgrade_state(node, UpgradeState.UNKNOWN)
+        assert KEYS.state_label not in cluster.get("Node", "n1").labels
+
+    def test_waits_for_stale_cache_to_catch_up(self, cluster):
+        cluster.create(make_node("n1"))
+        cached = CachedClient(cluster, sync_mode="manual")
+        p = make_provider(cluster, reader=cached)
+        node = Node_from(cached, "n1")
+        t = threading.Timer(0.15, cached.sync)
+        t.start()
+        # Must block ~0.15s then succeed rather than fail immediately.
+        p.change_node_upgrade_state(node, UpgradeState.CORDON_REQUIRED)
+        t.join()
+        assert (
+            cached.get("Node", "n1").labels[KEYS.state_label] == "cordon-required"
+        )
+
+    def test_raises_when_cache_never_syncs(self, cluster):
+        cluster.create(make_node("n1"))
+        cached = CachedClient(cluster, sync_mode="manual")
+        p = make_provider(cluster, reader=cached, timeout=0.3)
+        node = Node_from(cached, "n1")
+        with pytest.raises(StateWriteError):
+            p.change_node_upgrade_state(node, UpgradeState.CORDON_REQUIRED)
+        # The write itself DID land on the apiserver (ambiguity is surfaced,
+        # not rolled back) — matching the reference's error-after-patch shape.
+        assert cluster.get("Node", "n1").labels[KEYS.state_label] == "cordon-required"
+
+    def test_auto_cache_mode_end_to_end(self, cluster):
+        cluster.create(make_node("n1"))
+        cached = CachedClient(cluster, sync_mode="auto", lag_seconds=0.02)
+        try:
+            p = make_provider(cluster, reader=cached)
+            node = p.get_node("n1")
+            for state in (
+                UpgradeState.UPGRADE_REQUIRED,
+                UpgradeState.CORDON_REQUIRED,
+                UpgradeState.WAIT_FOR_JOBS_REQUIRED,
+            ):
+                p.change_node_upgrade_state(node, state)
+            assert (
+                cluster.get("Node", "n1").labels[KEYS.state_label]
+                == "wait-for-jobs-required"
+            )
+        finally:
+            cached.close()
+
+    def test_concurrent_writers_serialized(self, cluster):
+        cluster.create(make_node("n1"))
+        p = make_provider(cluster)
+        states = [UpgradeState.CORDON_REQUIRED, UpgradeState.DRAIN_REQUIRED,
+                  UpgradeState.POD_RESTART_REQUIRED, UpgradeState.DONE]
+        errors = []
+
+        def writer(state):
+            try:
+                node = p.get_node("n1")
+                p.change_node_upgrade_state(node, state)
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=writer, args=(s,)) for s in states]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        final = cluster.get("Node", "n1").labels[KEYS.state_label]
+        assert final in {str(s) for s in states}
+
+
+class TestAnnotations:
+    def test_set_and_delete_annotation(self, cluster):
+        cluster.create(make_node("n1"))
+        p = make_provider(cluster)
+        node = p.get_node("n1")
+        key = KEYS.validation_start_annotation
+        p.change_node_upgrade_annotation(node, key, "1234567")
+        assert cluster.get("Node", "n1").annotations[key] == "1234567"
+        p.change_node_upgrade_annotation(node, key, "null")
+        assert key not in cluster.get("Node", "n1").annotations
+        assert key not in node.annotations
+
+    def test_delete_absent_annotation_is_noop(self, cluster):
+        cluster.create(make_node("n1"))
+        p = make_provider(cluster)
+        node = p.get_node("n1")
+        p.change_node_upgrade_annotation(node, KEYS.upgrade_requested_annotation, "null")
+
+
+class TestReadsAndEvents:
+    def test_get_upgrade_state_garbage_is_unknown(self, cluster):
+        cluster.create(make_node("n1", labels={KEYS.state_label: "bogus-state"}))
+        p = make_provider(cluster)
+        assert p.get_upgrade_state(p.get_node("n1")) == UpgradeState.UNKNOWN
+
+    def test_get_upgrade_state_missing_is_unknown(self, cluster):
+        cluster.create(make_node("n1"))
+        p = make_provider(cluster)
+        assert p.get_upgrade_state(p.get_node("n1")) == UpgradeState.UNKNOWN
+
+    def test_events_recorded(self, cluster):
+        recorder = FakeRecorder()
+        cluster.create(make_node("n1"))
+        p = make_provider(cluster, recorder=recorder)
+        node = p.get_node("n1")
+        p.change_node_upgrade_state(node, UpgradeState.UPGRADE_REQUIRED)
+        msgs = recorder.drain()
+        assert len(msgs) == 1
+        assert "upgrade-required" in msgs[0]
+        assert "TPUDriverUpgrade".lower() in msgs[0].lower() or "LIBTPU" in msgs[0]
+
+
+def Node_from(client, name):
+    from k8s_operator_libs_tpu.kube import Node
+
+    # Read through the backing store regardless of cache staleness.
+    return Node(client.backing.get("Node", name).raw) if isinstance(
+        client, CachedClient
+    ) else Node(client.get("Node", name).raw)
