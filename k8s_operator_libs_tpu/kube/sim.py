@@ -1,0 +1,156 @@
+"""Cluster-behavior simulators for tests and benchmarks.
+
+The reference tests against envtest — a real apiserver with **no controllers
+and no kubelets** (SURVEY.md §4), simulating controller behavior by mutating
+objects directly. This module packages that simulation: a DaemonSet
+controller + kubelet stand-in that keeps one driver pod per node at the
+latest template revision, so multi-pass rolling-upgrade scenarios (and the
+bench's v5e-pool simulation) can run end-to-end against the in-memory
+apiserver.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .client import NotFoundError
+from .fake import FakeCluster
+from .objects import ControllerRevision, DaemonSet, Pod
+
+
+class DaemonSetSimulator:
+    """Emulates the DaemonSet controller and kubelet for a driver DaemonSet.
+
+    * ``set_template_hash`` models a driver-image update: a new
+      ControllerRevision is created and existing pods become stale.
+    * ``step`` models one controller+kubelet tick: every node gets a pod at
+      the latest revision if missing, and fresh pods become Ready after
+      ``readiness_steps`` ticks (0 = immediately).
+    """
+
+    def __init__(
+        self,
+        cluster: FakeCluster,
+        name: str = "driver",
+        namespace: str = "driver-ns",
+        match_labels: Optional[dict[str, str]] = None,
+        readiness_steps: int = 0,
+        initial_hash: str = "rev-1",
+    ) -> None:
+        self.cluster = cluster
+        self.namespace = namespace
+        self.readiness_steps = readiness_steps
+        self._pending_ready: dict[str, int] = {}
+        self._revision = 0
+        ds = DaemonSet.new(name, namespace=namespace)
+        ds.match_labels = dict(match_labels or {"app": name})
+        ds.labels.update(ds.match_labels)
+        self.ds = DaemonSet(cluster.create(ds).raw)
+        self.current_hash = ""
+        self.set_template_hash(initial_hash)
+
+    # -- driver rollout control -------------------------------------------
+    def set_template_hash(self, hash_value: str) -> None:
+        """Publish a new driver template revision (an 'image update')."""
+        self._revision += 1
+        cr = ControllerRevision.new(
+            f"{self.ds.name}-{hash_value}", namespace=self.namespace
+        )
+        cr.revision = self._revision
+        cr.labels.update(self.ds.match_labels)
+        cr.labels["controller-revision-hash"] = hash_value
+        cr.add_owner_reference(self.ds)
+        self.cluster.create(cr)
+        self.current_hash = hash_value
+
+    # -- controller/kubelet tick ------------------------------------------
+    def pod_name(self, node_name: str) -> str:
+        return f"{self.ds.name}-{node_name}"
+
+    def step(self) -> None:
+        nodes = self.cluster.list("Node")
+        desired = 0
+        for node in nodes:
+            desired += 1
+            self._ensure_pod(node.name)
+        self._advance_readiness()
+        self.cluster.patch(
+            "DaemonSet",
+            self.ds.name,
+            self.namespace,
+            patch={"status": {"desiredNumberScheduled": desired}},
+        )
+
+    def settle(self, max_steps: int = 10) -> None:
+        """Tick until every node has a Ready pod at the current revision."""
+        for _ in range(max_steps):
+            self.step()
+            if self.all_pods_ready_and_current():
+                return
+
+    def _ensure_pod(self, node_name: str) -> None:
+        name = self.pod_name(node_name)
+        try:
+            self.cluster.get("Pod", name, self.namespace)
+            return
+        except NotFoundError:
+            pass
+        pod = Pod.new(name, namespace=self.namespace)
+        pod.node_name = node_name
+        pod.labels.update(self.ds.match_labels)
+        pod.labels["controller-revision-hash"] = self.current_hash
+        pod.add_owner_reference(self.ds)
+        if self.readiness_steps == 0:
+            self._make_ready(pod)
+        else:
+            pod.phase = "Pending"
+            self._pending_ready[name] = self.readiness_steps
+        self.cluster.create(pod)
+
+    @staticmethod
+    def _make_ready(pod: Pod) -> None:
+        pod.phase = "Running"
+        pod.status["conditions"] = [{"type": "Ready", "status": "True"}]
+        pod.status["containerStatuses"] = [
+            {"name": "driver", "ready": True, "restartCount": 0}
+        ]
+
+    def _advance_readiness(self) -> None:
+        for name in list(self._pending_ready):
+            self._pending_ready[name] -= 1
+            if self._pending_ready[name] > 0:
+                continue
+            del self._pending_ready[name]
+            try:
+                self.cluster.patch(
+                    "Pod",
+                    name,
+                    self.namespace,
+                    patch={
+                        "status": {
+                            "phase": "Running",
+                            "conditions": [{"type": "Ready", "status": "True"}],
+                            "containerStatuses": [
+                                {"name": "driver", "ready": True, "restartCount": 0}
+                            ],
+                        }
+                    },
+                )
+            except NotFoundError:
+                continue
+
+    # -- assertions helpers ------------------------------------------------
+    def all_pods_ready_and_current(self) -> bool:
+        nodes = self.cluster.list("Node")
+        for node in nodes:
+            try:
+                pod = Pod(
+                    self.cluster.get("Pod", self.pod_name(node.name), self.namespace).raw
+                )
+            except NotFoundError:
+                return False
+            if pod.labels.get("controller-revision-hash") != self.current_hash:
+                return False
+            if not pod.is_ready():
+                return False
+        return True

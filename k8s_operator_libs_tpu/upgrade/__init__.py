@@ -1,10 +1,51 @@
 from .consts import UpgradeState, DeviceClass, UpgradeKeys
 from .state_provider import NodeUpgradeStateProvider, StateWriteError
+from .task_runner import TaskRunner
+from .cordon_manager import CordonManager
+from .drain_manager import DrainConfiguration, DrainManager
+from .pod_manager import (
+    PodManager,
+    PodManagerConfig,
+    PodDeletionFilter,
+    RevisionHashError,
+)
+from .validation_manager import ValidationManager, VALIDATION_TIMEOUT_SECONDS
+from .safe_driver_load import SafeDriverLoadManager
+from .common_manager import (
+    ClusterUpgradeState,
+    CommonUpgradeManager,
+    NodeUpgradeState,
+)
+from .inplace import InplaceNodeStateManager, ProcessNodeStateManager
+from .state_manager import (
+    BuildStateError,
+    ClusterUpgradeStateManager,
+    StateOptions,
+)
 
 __all__ = [
-    "UpgradeState",
+    "BuildStateError",
+    "ClusterUpgradeState",
+    "ClusterUpgradeStateManager",
+    "CommonUpgradeManager",
+    "InplaceNodeStateManager",
+    "NodeUpgradeState",
+    "ProcessNodeStateManager",
+    "RevisionHashError",
+    "StateOptions",
+    "CordonManager",
     "DeviceClass",
-    "UpgradeKeys",
+    "DrainConfiguration",
+    "DrainManager",
     "NodeUpgradeStateProvider",
+    "PodDeletionFilter",
+    "PodManager",
+    "PodManagerConfig",
+    "SafeDriverLoadManager",
     "StateWriteError",
+    "TaskRunner",
+    "UpgradeKeys",
+    "UpgradeState",
+    "VALIDATION_TIMEOUT_SECONDS",
+    "ValidationManager",
 ]

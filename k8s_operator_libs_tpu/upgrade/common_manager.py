@@ -1,0 +1,421 @@
+"""CommonUpgradeManager — shared state-transition logic for both modes.
+
+Parity: reference pkg/upgrade/common_manager.go:23-788. Holds the injected
+node-op managers and implements every per-state processor plus the
+scheduling/budget counters. Mode strategies (in-place, requestor) and the
+orchestrator compose on top.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from ..api.upgrade_v1alpha1 import (
+    DrainSpec,
+    PodDeletionSpec,
+    WaitForCompletionSpec,
+)
+from ..kube.client import Client
+from ..kube.objects import DaemonSet, KubeObject, Node, Pod
+from ..utils.log import get_logger
+from .consts import (
+    IDLE_STATES,
+    MANAGED_STATES,
+    TRUE_STRING,
+    UpgradeKeys,
+    UpgradeState,
+)
+from .cordon_manager import CordonManager
+from .drain_manager import DrainConfiguration, DrainManager
+from .pod_manager import PodManager, PodManagerConfig, RevisionHashError
+from .safe_driver_load import SafeDriverLoadManager
+from .state_provider import NodeUpgradeStateProvider
+from .validation_manager import ValidationManager
+
+log = get_logger("upgrade.common")
+
+
+@dataclass
+class NodeUpgradeState:
+    """A node, the driver pod on it, and that pod's owning DaemonSet
+    (reference: common_manager.go:58-68)."""
+
+    node: Node
+    driver_pod: Pod
+    driver_daemonset: Optional[DaemonSet]
+    #: Requestor mode only: the NodeMaintenance CR for this node, if any.
+    node_maintenance: Optional[KubeObject] = None
+
+    def is_orphaned_pod(self) -> bool:
+        return self.driver_daemonset is None
+
+
+@dataclass
+class ClusterUpgradeState:
+    """Point-in-time snapshot, bucketed by per-node state
+    (reference: common_manager.go:70-75)."""
+
+    node_states: dict[UpgradeState, list[NodeUpgradeState]] = field(
+        default_factory=lambda: defaultdict(list)
+    )
+
+    def nodes_in(self, state: UpgradeState) -> list[NodeUpgradeState]:
+        return self.node_states.get(state, [])
+
+
+class CommonUpgradeManager:
+    def __init__(
+        self,
+        client: Client,
+        state_provider: NodeUpgradeStateProvider,
+        keys: UpgradeKeys,
+        cordon_manager: CordonManager,
+        drain_manager: DrainManager,
+        pod_manager: PodManager,
+        validation_manager: ValidationManager,
+        safe_load_manager: SafeDriverLoadManager,
+        recorder=None,
+    ) -> None:
+        self.client = client
+        self.provider = state_provider
+        self.keys = keys
+        self.cordon_manager = cordon_manager
+        self.drain_manager = drain_manager
+        self.pod_manager = pod_manager
+        self.validation_manager = validation_manager
+        self.safe_load_manager = safe_load_manager
+        self.recorder = recorder
+        self.pod_deletion_enabled = False
+        self.validation_enabled = False
+
+    # ------------------------------------------------------------------
+    # Counters / scheduling math (reference: common_manager.go:714-788)
+    # ------------------------------------------------------------------
+    def get_total_managed_nodes(self, state: ClusterUpgradeState) -> int:
+        return sum(len(state.nodes_in(s)) for s in MANAGED_STATES)
+
+    def get_upgrades_in_progress(self, state: ClusterUpgradeState) -> int:
+        total = self.get_total_managed_nodes(state)
+        return total - sum(len(state.nodes_in(s)) for s in IDLE_STATES)
+
+    def get_upgrades_done(self, state: ClusterUpgradeState) -> int:
+        return len(state.nodes_in(UpgradeState.DONE))
+
+    def get_upgrades_failed(self, state: ClusterUpgradeState) -> int:
+        return len(state.nodes_in(UpgradeState.FAILED))
+
+    def get_upgrades_pending(self, state: ClusterUpgradeState) -> int:
+        return len(state.nodes_in(UpgradeState.UPGRADE_REQUIRED))
+
+    def get_current_unavailable_nodes(self, state: ClusterUpgradeState) -> int:
+        """Cordoned or not-Ready nodes across the snapshot
+        (reference: :146-165)."""
+        count = 0
+        for states in state.node_states.values():
+            for ns in states:
+                if ns.node.unschedulable or not ns.node.is_ready():
+                    count += 1
+        return count
+
+    def get_upgrades_available(
+        self,
+        state: ClusterUpgradeState,
+        max_parallel_upgrades: int,
+        max_unavailable: int,
+    ) -> int:
+        """Budget math (reference: :748-776): parallel-slot limit, then the
+        unavailability clamp counting nodes already unavailable plus nodes
+        about to be cordoned."""
+        in_progress = self.get_upgrades_in_progress(state)
+        total = self.get_total_managed_nodes(state)
+        if max_parallel_upgrades == 0:
+            available = len(state.nodes_in(UpgradeState.UPGRADE_REQUIRED))
+        else:
+            available = max_parallel_upgrades - in_progress
+        current_unavailable = self.get_current_unavailable_nodes(state) + len(
+            state.nodes_in(UpgradeState.CORDON_REQUIRED)
+        )
+        if available > max_unavailable:
+            available = max_unavailable
+        if current_unavailable >= max_unavailable:
+            available = 0
+        elif (
+            max_unavailable < total
+            and current_unavailable + available > max_unavailable
+        ):
+            available = max_unavailable - current_unavailable
+        return available
+
+    # ------------------------------------------------------------------
+    # Node predicates
+    # ------------------------------------------------------------------
+    def is_upgrade_requested(self, node: Node) -> bool:
+        """(reference: :322-325)"""
+        return (
+            node.annotations.get(self.keys.upgrade_requested_annotation)
+            == TRUE_STRING
+        )
+
+    def skip_node_upgrade(self, node: Node) -> bool:
+        """(reference: :665-668)"""
+        return node.labels.get(self.keys.skip_label) == TRUE_STRING
+
+    def pod_in_sync_with_ds(
+        self, node_state: NodeUpgradeState
+    ) -> tuple[bool, bool]:
+        """Return (is_pod_synced, is_orphaned) (reference: :299-320)."""
+        if node_state.is_orphaned_pod():
+            return False, True
+        pod_hash = self.pod_manager.get_pod_controller_revision_hash(
+            node_state.driver_pod
+        )
+        assert node_state.driver_daemonset is not None
+        ds_hash = self.pod_manager.get_daemonset_controller_revision_hash(
+            node_state.driver_daemonset
+        )
+        return pod_hash == ds_hash, False
+
+    def is_driver_pod_in_sync(self, node_state: NodeUpgradeState) -> bool:
+        """Synced revision AND Running AND all containers ready
+        (reference: :606-634)."""
+        synced, orphaned = self.pod_in_sync_with_ds(node_state)
+        if orphaned or not synced:
+            return False
+        pod = node_state.driver_pod
+        if pod.phase != "Running":
+            return False
+        statuses = pod.container_statuses
+        if not statuses:
+            return False
+        return all(s.get("ready", False) for s in statuses)
+
+    @staticmethod
+    def is_driver_pod_failing(pod: Pod) -> bool:
+        """Any container (init or main) not ready with >10 restarts
+        (reference: :636-648)."""
+        for status in list(pod.init_container_statuses) + list(
+            pod.container_statuses
+        ):
+            if not status.get("ready", False) and status.get("restartCount", 0) > 10:
+                return True
+        return False
+
+    # ------------------------------------------------------------------
+    # Per-state processors
+    # ------------------------------------------------------------------
+    def process_done_or_unknown_nodes(
+        self, state: ClusterUpgradeState, bucket: UpgradeState
+    ) -> None:
+        """Classify unknown/done nodes: out-of-sync pod, safe-load wait or
+        explicit request ⇒ upgrade-required (recording the initial cordon
+        state); in-sync unknown ⇒ done (reference: :229-291)."""
+        for ns in state.nodes_in(bucket):
+            synced, orphaned = self.pod_in_sync_with_ds(ns)
+            upgrade_requested = self.is_upgrade_requested(ns.node)
+            waiting_safe_load = self.safe_load_manager.is_waiting_for_safe_driver_load(
+                ns.node
+            )
+            if (not synced and not orphaned) or waiting_safe_load or upgrade_requested:
+                if ns.node.unschedulable:
+                    # Track that the node started cordoned so the upgrade
+                    # ends without uncordoning it (reference: :250-264).
+                    self.provider.change_node_upgrade_annotation(
+                        ns.node,
+                        self.keys.initial_state_annotation,
+                        TRUE_STRING,
+                    )
+                self.provider.change_node_upgrade_state(
+                    ns.node, UpgradeState.UPGRADE_REQUIRED
+                )
+                log.info("node %s requires upgrade", ns.node.name)
+                continue
+            if bucket == UpgradeState.UNKNOWN:
+                self.provider.change_node_upgrade_state(ns.node, UpgradeState.DONE)
+                log.info("node %s moved unknown -> done", ns.node.name)
+
+    def process_cordon_required_nodes(self, state: ClusterUpgradeState) -> None:
+        """(reference: :361-380)"""
+        for ns in state.nodes_in(UpgradeState.CORDON_REQUIRED):
+            self.cordon_manager.cordon(ns.node)
+            self.provider.change_node_upgrade_state(
+                ns.node, UpgradeState.WAIT_FOR_JOBS_REQUIRED
+            )
+
+    def process_wait_for_jobs_required_nodes(
+        self,
+        state: ClusterUpgradeState,
+        wait_spec: Optional[WaitForCompletionSpec],
+    ) -> None:
+        """(reference: :384-419)"""
+        nodes = [ns.node for ns in state.nodes_in(UpgradeState.WAIT_FOR_JOBS_REQUIRED)]
+        if wait_spec is None or not wait_spec.pod_selector:
+            next_state = (
+                UpgradeState.POD_DELETION_REQUIRED
+                if self.pod_deletion_enabled
+                else UpgradeState.DRAIN_REQUIRED
+            )
+            for node in nodes:
+                self.provider.change_node_upgrade_state(node, next_state)
+            return
+        if not nodes:
+            return
+        self.pod_manager.schedule_check_on_pod_completion(
+            PodManagerConfig(nodes=nodes, wait_for_completion_spec=wait_spec)
+        )
+
+    def process_pod_deletion_required_nodes(
+        self,
+        state: ClusterUpgradeState,
+        deletion_spec: Optional[PodDeletionSpec],
+        drain_enabled: bool,
+    ) -> None:
+        """(reference: :424-453)"""
+        nodes = [ns.node for ns in state.nodes_in(UpgradeState.POD_DELETION_REQUIRED)]
+        if not self.pod_deletion_enabled:
+            for node in nodes:
+                self.provider.change_node_upgrade_state(
+                    node, UpgradeState.DRAIN_REQUIRED
+                )
+            return
+        if not nodes:
+            return
+        self.pod_manager.schedule_pod_eviction(
+            PodManagerConfig(
+                nodes=nodes,
+                deletion_spec=deletion_spec or PodDeletionSpec(),
+                drain_enabled=drain_enabled,
+            )
+        )
+
+    def process_drain_nodes(
+        self, state: ClusterUpgradeState, drain_spec: Optional[DrainSpec]
+    ) -> None:
+        """(reference: :329-357)"""
+        nodes = [ns.node for ns in state.nodes_in(UpgradeState.DRAIN_REQUIRED)]
+        if drain_spec is None or not drain_spec.enable:
+            for node in nodes:
+                self.provider.change_node_upgrade_state(
+                    node, UpgradeState.POD_RESTART_REQUIRED
+                )
+            return
+        if not nodes:
+            return
+        self.drain_manager.schedule_nodes_drain(
+            DrainConfiguration(spec=drain_spec, nodes=nodes)
+        )
+
+    def process_pod_restart_nodes(self, state: ClusterUpgradeState) -> None:
+        """Restart out-of-sync driver pods; unblock safe load; advance
+        in-sync+Ready nodes; fail repeatedly-restarting pods
+        (reference: :457-524)."""
+        pods_to_restart: list[Pod] = []
+        for ns in state.nodes_in(UpgradeState.POD_RESTART_REQUIRED):
+            synced, orphaned = self.pod_in_sync_with_ds(ns)
+            if not synced or orphaned:
+                if ns.driver_pod.deletion_timestamp is None:
+                    pods_to_restart.append(ns.driver_pod)
+                continue
+            self.safe_load_manager.unblock_loading(ns.node)
+            if self.is_driver_pod_in_sync(ns):
+                if not self.validation_enabled:
+                    self.update_node_to_uncordon_or_done_state(ns)
+                    continue
+                self.provider.change_node_upgrade_state(
+                    ns.node, UpgradeState.VALIDATION_REQUIRED
+                )
+            elif self.is_driver_pod_failing(ns.driver_pod):
+                log.info(
+                    "driver pod failing with repeated restarts on node %s",
+                    ns.node.name,
+                )
+                self.provider.change_node_upgrade_state(
+                    ns.node, UpgradeState.FAILED
+                )
+        self.pod_manager.schedule_pods_restart(pods_to_restart)
+
+    def process_upgrade_failed_nodes(self, state: ClusterUpgradeState) -> None:
+        """Auto-recovery: failed nodes whose driver pod is back in sync
+        resume at uncordon (or done if initially cordoned)
+        (reference: :528-570)."""
+        for ns in state.nodes_in(UpgradeState.FAILED):
+            if not self.is_driver_pod_in_sync(ns):
+                continue
+            new_state = UpgradeState.UNCORDON_REQUIRED
+            if self.keys.initial_state_annotation in ns.node.annotations:
+                new_state = UpgradeState.DONE
+            self.provider.change_node_upgrade_state(ns.node, new_state)
+            if new_state == UpgradeState.DONE:
+                self.provider.change_node_upgrade_annotation(
+                    ns.node, self.keys.initial_state_annotation, "null"
+                )
+
+    def process_validation_required_nodes(self, state: ClusterUpgradeState) -> None:
+        """(reference: :573-604)"""
+        for ns in state.nodes_in(UpgradeState.VALIDATION_REQUIRED):
+            # The driver may have restarted after reaching this state and be
+            # blocked on safe load again (reference: :578-585).
+            self.safe_load_manager.unblock_loading(ns.node)
+            if not self.validation_manager.validate(ns.node):
+                log.info("validation not complete on node %s", ns.node.name)
+                continue
+            self.update_node_to_uncordon_or_done_state(ns)
+
+    def update_node_to_uncordon_or_done_state(
+        self, node_state: NodeUpgradeState
+    ) -> None:
+        """Skip uncordon for nodes that began the upgrade cordoned
+        (reference: :670-708). Requestor-mode nodes keep the annotation;
+        their uncordon flow owns the cleanup."""
+        node = node_state.node
+        new_state = UpgradeState.UNCORDON_REQUIRED
+        in_requestor_mode = self.is_node_in_requestor_mode(node)
+        if self.keys.initial_state_annotation in node.annotations:
+            if not in_requestor_mode:
+                log.info(
+                    "node %s was unschedulable at upgrade start, skipping uncordon",
+                    node.name,
+                )
+                new_state = UpgradeState.DONE
+        self.provider.change_node_upgrade_state(node, new_state)
+        if new_state == UpgradeState.DONE or in_requestor_mode:
+            self.provider.change_node_upgrade_annotation(
+                node, self.keys.initial_state_annotation, "null"
+            )
+
+    def is_node_in_requestor_mode(self, node: Node) -> bool:
+        """Key presence, any value (reference: util.go:134-138)."""
+        return self.keys.requestor_mode_annotation in node.annotations
+
+    # ------------------------------------------------------------------
+    # Snapshot helpers (reference: :168-221)
+    # ------------------------------------------------------------------
+    def get_driver_daemonsets(
+        self, namespace: str, labels: dict[str, str]
+    ) -> dict[str, DaemonSet]:
+        """UID → DaemonSet map for the driver DaemonSets."""
+        out: dict[str, DaemonSet] = {}
+        for obj in self.client.list(
+            "DaemonSet", namespace=namespace, label_selector=labels
+        ):
+            ds = DaemonSet(obj.raw)
+            out[ds.uid] = ds
+        return out
+
+    @staticmethod
+    def is_orphaned_pod(pod: Pod) -> bool:
+        return len(pod.owner_references) < 1
+
+    def get_pods_owned_by_ds(
+        self, ds: DaemonSet, pods: Sequence[Pod]
+    ) -> list[Pod]:
+        return [
+            p
+            for p in pods
+            if not self.is_orphaned_pod(p)
+            and p.owner_references[0].get("uid") == ds.uid
+        ]
+
+    def get_orphaned_pods(self, pods: Sequence[Pod]) -> list[Pod]:
+        return [p for p in pods if self.is_orphaned_pod(p)]

@@ -1,0 +1,103 @@
+"""In-place mode strategy: the library itself cordons/drains/uncordons.
+
+Parity: reference pkg/upgrade/upgrade_inplace.go:29-147. Enforces the
+maxParallelUpgrades + maxUnavailable budget and lets manually-cordoned nodes
+proceed even when the budget is exhausted (they are already unavailable, so
+upgrading them costs nothing extra).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Protocol
+
+from ..api.upgrade_v1alpha1 import DriverUpgradePolicySpec
+from ..utils.log import get_logger
+from .common_manager import ClusterUpgradeState, CommonUpgradeManager
+from .consts import UpgradeState
+
+log = get_logger("upgrade.inplace")
+
+
+class ProcessNodeStateManager(Protocol):
+    """Mode-strategy interface (reference: common_manager.go:47-54)."""
+
+    def process_upgrade_required_nodes(
+        self,
+        state: ClusterUpgradeState,
+        policy: DriverUpgradePolicySpec,
+    ) -> None: ...
+
+    def process_node_maintenance_required_nodes(
+        self, state: ClusterUpgradeState
+    ) -> None: ...
+
+    def process_uncordon_required_nodes(self, state: ClusterUpgradeState) -> None: ...
+
+
+class InplaceNodeStateManager:
+    def __init__(self, common: CommonUpgradeManager) -> None:
+        self.common = common
+
+    def process_upgrade_required_nodes(
+        self,
+        state: ClusterUpgradeState,
+        policy: DriverUpgradePolicySpec,
+    ) -> None:
+        """Move upgrade-required nodes to cordon-required within budget
+        (reference: upgrade_inplace.go:44-112)."""
+        common = self.common
+        total = common.get_total_managed_nodes(state)
+        max_unavailable = policy.resolved_max_unavailable(total)
+        available = common.get_upgrades_available(
+            state, policy.max_parallel_upgrades, max_unavailable
+        )
+        log.info(
+            "upgrade slots: in_progress=%d max_parallel=%d available=%d "
+            "unavailable=%d total=%d max_unavailable=%d",
+            common.get_upgrades_in_progress(state),
+            policy.max_parallel_upgrades,
+            available,
+            common.get_current_unavailable_nodes(state),
+            total,
+            max_unavailable,
+        )
+        for ns in state.nodes_in(UpgradeState.UPGRADE_REQUIRED):
+            node = ns.node
+            if common.is_upgrade_requested(node):
+                # Clear the one-shot request annotation (reference: :72-80).
+                common.provider.change_node_upgrade_annotation(
+                    node, common.keys.upgrade_requested_annotation, "null"
+                )
+            if common.skip_node_upgrade(node):
+                log.info("node %s is marked to skip upgrades", node.name)
+                continue
+            if available <= 0:
+                # Budget exhausted: only already-cordoned nodes proceed —
+                # upgrading them adds no new unavailability
+                # (reference: :87-97).
+                if not node.unschedulable:
+                    continue
+                log.info(
+                    "node %s already cordoned, proceeding despite budget",
+                    node.name,
+                )
+            common.provider.change_node_upgrade_state(
+                node, UpgradeState.CORDON_REQUIRED
+            )
+            available -= 1
+
+    def process_node_maintenance_required_nodes(
+        self, state: ClusterUpgradeState
+    ) -> None:
+        """No-op in in-place mode (reference: upgrade_inplace.go:114-120)."""
+
+    def process_uncordon_required_nodes(self, state: ClusterUpgradeState) -> None:
+        """Uncordon and finish (reference: upgrade_inplace.go:124-147).
+        Nodes handled by requestor mode are skipped — their uncordon flow
+        owns completion."""
+        common = self.common
+        for ns in state.nodes_in(UpgradeState.UNCORDON_REQUIRED):
+            if common.is_node_in_requestor_mode(ns.node):
+                continue
+            common.cordon_manager.uncordon(ns.node)
+            common.provider.change_node_upgrade_state(ns.node, UpgradeState.DONE)

@@ -1,0 +1,295 @@
+"""PodManager — driver-pod sync detection, workload eviction, restarts and
+completion waits.
+
+Parity: reference pkg/upgrade/pod_manager.go:53-422.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional, Sequence
+
+from ..api.upgrade_v1alpha1 import PodDeletionSpec, WaitForCompletionSpec
+from ..kube.client import Client, NotFoundError
+from ..kube.drain import DrainConfig, DrainError, DrainHelper
+from ..kube.objects import ControllerRevision, DaemonSet, Node, Pod
+from ..utils.log import get_logger
+from .consts import UpgradeKeys, UpgradeState
+from .state_provider import NodeUpgradeStateProvider
+from .task_runner import TaskRunner
+
+log = get_logger("upgrade.pod")
+
+#: Pod label carrying the DaemonSet rollout hash
+#: (reference: pod_manager.go:71-73).
+POD_CONTROLLER_REVISION_HASH_LABEL = "controller-revision-hash"
+
+#: Returns True if the pod should be deleted before the driver upgrade
+#: (reference: pod_manager.go:76).
+PodDeletionFilter = Callable[[Pod], bool]
+
+
+class RevisionHashError(Exception):
+    pass
+
+
+@dataclass
+class PodManagerConfig:
+    """(reference: pod_manager.go:63-68)"""
+
+    nodes: Sequence[Node]
+    deletion_spec: Optional[PodDeletionSpec] = None
+    wait_for_completion_spec: Optional[WaitForCompletionSpec] = None
+    drain_enabled: bool = False
+
+
+class PodManager:
+    def __init__(
+        self,
+        client: Client,
+        state_provider: NodeUpgradeStateProvider,
+        keys: UpgradeKeys,
+        pod_deletion_filter: Optional[PodDeletionFilter] = None,
+        runner: Optional[TaskRunner] = None,
+        recorder=None,
+    ) -> None:
+        self._client = client
+        self._provider = state_provider
+        self._keys = keys
+        self._filter = pod_deletion_filter
+        self._runner = runner if runner is not None else TaskRunner()
+        self._recorder = recorder
+
+    @property
+    def pod_deletion_filter(self) -> Optional[PodDeletionFilter]:
+        return self._filter
+
+    # -- revision-hash sync (reference: :84-118) ---------------------------
+    def get_pod_controller_revision_hash(self, pod: Pod) -> str:
+        hash_value = pod.labels.get(POD_CONTROLLER_REVISION_HASH_LABEL, "")
+        if not hash_value:
+            raise RevisionHashError(
+                f"controller-revision-hash label not present for pod {pod.name}"
+            )
+        return hash_value
+
+    def get_daemonset_controller_revision_hash(self, daemonset: DaemonSet) -> str:
+        """Latest rollout hash: list the DaemonSet's ControllerRevisions,
+        take the highest revision, strip the ``<ds-name>-`` prefix."""
+        revisions = [
+            ControllerRevision(o.raw)
+            for o in self._client.list(
+                "ControllerRevision",
+                namespace=daemonset.namespace,
+                label_selector=daemonset.match_labels,
+            )
+            if o.name.startswith(daemonset.name)
+        ]
+        if not revisions:
+            raise RevisionHashError(
+                f"no revision found for daemonset {daemonset.name}"
+            )
+        latest = max(revisions, key=lambda r: r.revision)
+        return latest.name.removeprefix(f"{daemonset.name}-")
+
+    # -- workload eviction (reference: :122-229) ---------------------------
+    def schedule_pod_eviction(self, config: PodManagerConfig) -> None:
+        if not config.nodes:
+            log.info("no nodes scheduled for pod deletion")
+            return
+        if config.deletion_spec is None:
+            raise ValueError("pod deletion spec should not be empty")
+        if self._filter is None:
+            raise ValueError("pod deletion filter not configured")
+        spec = config.deletion_spec
+        for node in config.nodes:
+            if not self._runner.submit(
+                node.name, lambda node=node: self._evict_one(node, spec, config)
+            ):
+                log.info("node %s already getting pods deleted, skipping", node.name)
+
+    def _evict_one(
+        self, node: Node, spec: PodDeletionSpec, config: PodManagerConfig
+    ) -> None:
+        assert self._filter is not None
+        pods = self.list_pods(node_name=node.name)
+        to_delete = [p for p in pods if self._filter(p)]
+        if not to_delete:
+            log.info("no pods require deletion on node %s", node.name)
+            self._provider.change_node_upgrade_state(
+                node, UpgradeState.POD_RESTART_REQUIRED
+            )
+            return
+        helper = DrainHelper(self._client)
+        drain_cfg = DrainConfig(
+            force=spec.force,
+            delete_empty_dir=spec.delete_empty_dir,
+            timeout_seconds=spec.timeout_seconds,
+            ignore_daemonset_pods=True,
+            extra_filters=(self._filter,),
+        )
+        try:
+            eligible = helper.pods_to_evict(node.name, drain_cfg)
+        except DrainError as e:
+            # Some pod selected for deletion is ineligible — the upgrade
+            # cannot proceed by deletion alone (reference: :185-201).
+            log.error("cannot delete all required pods on %s: %s", node.name, e)
+            self._update_node_to_drain_or_failed(node, config.drain_enabled)
+            return
+        try:
+            for pod in eligible:
+                self._client.evict(pod.name, pod.namespace)
+            self._wait_pods_gone(eligible, spec.timeout_seconds)
+        except (DrainError, TimeoutError) as e:
+            log.error("failed to delete pods on node %s: %s", node.name, e)
+            self._event(
+                node, "Warning",
+                f"Failed to delete workload pods on the node for the driver upgrade, {e}",
+            )
+            self._update_node_to_drain_or_failed(node, config.drain_enabled)
+            return
+        log.info("deleted %d pods on node %s", len(eligible), node.name)
+        self._event(
+            node, "Normal",
+            "Deleted workload pods on the node for the driver upgrade",
+        )
+        self._provider.change_node_upgrade_state(
+            node, UpgradeState.POD_RESTART_REQUIRED
+        )
+
+    def _wait_pods_gone(
+        self, pods: Sequence[Pod], timeout_seconds: int, poll: float = 0.05
+    ) -> None:
+        deadline = time.monotonic() + timeout_seconds if timeout_seconds else None
+        remaining = {(p.namespace, p.name) for p in pods}
+        while remaining:
+            remaining = {
+                (ns, name)
+                for ns, name in remaining
+                if self._client.get_or_none("Pod", name, ns) is not None
+            }
+            if not remaining:
+                return
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"{len(remaining)} pods still present after {timeout_seconds}s"
+                )
+            time.sleep(poll)
+
+    def _update_node_to_drain_or_failed(
+        self, node: Node, drain_enabled: bool
+    ) -> None:
+        """(reference: :393-403)"""
+        next_state = UpgradeState.FAILED
+        if drain_enabled:
+            log.info(
+                "pod deletion failed on %s but drain is enabled; will drain",
+                node.name,
+            )
+            self._event(
+                node, "Warning",
+                "Pod deletion failed but drain is enabled in spec. "
+                "Will attempt a node drain",
+            )
+            next_state = UpgradeState.DRAIN_REQUIRED
+        self._provider.change_node_upgrade_state(node, next_state)
+
+    # -- driver pod restart (reference: :233-251) --------------------------
+    def schedule_pods_restart(self, pods: Sequence[Pod]) -> None:
+        """Delete driver pods so their DaemonSet recreates them at the new
+        revision. Synchronous and fail-fast, as in the reference."""
+        if not pods:
+            log.info("no pods scheduled to restart")
+            return
+        for pod in pods:
+            log.info("deleting pod %s/%s", pod.namespace, pod.name)
+            try:
+                self._client.delete("Pod", pod.name, pod.namespace)
+            except NotFoundError:
+                continue  # already gone — restart goal achieved
+            except Exception as e:
+                self._event(
+                    pod, "Warning", f"Failed to restart driver pod {e}"
+                )
+                raise
+
+    # -- completion waits (reference: :256-317) ----------------------------
+    def schedule_check_on_pod_completion(self, config: PodManagerConfig) -> None:
+        """Move each node whose awaited workload pods have finished to
+        ``pod-deletion-required``; otherwise leave it, tracking the timeout.
+
+        Unlike eviction/drain this is joined before returning
+        (reference: :258-317 WaitGroup)."""
+        if config.wait_for_completion_spec is None:
+            raise ValueError("wait-for-completion spec should not be empty")
+        spec = config.wait_for_completion_spec
+        for node in config.nodes:
+            pods = self.list_pods(
+                selector=spec.pod_selector, node_name=node.name
+            )
+            running = any(self.is_pod_running_or_pending(p) for p in pods)
+            if running:
+                log.info("workload pods still running on node %s", node.name)
+                if spec.timeout_seconds != 0:
+                    self.handle_timeout_on_pod_completions(
+                        node, spec.timeout_seconds
+                    )
+                continue
+            self._provider.change_node_upgrade_annotation(
+                node,
+                self._keys.wait_for_pod_completion_start_annotation,
+                "null",
+            )
+            self._provider.change_node_upgrade_state(
+                node, UpgradeState.POD_DELETION_REQUIRED
+            )
+
+    def handle_timeout_on_pod_completions(
+        self, node: Node, timeout_seconds: int
+    ) -> None:
+        """Start or check the durable start-time annotation
+        (reference: :331-368)."""
+        key = self._keys.wait_for_pod_completion_start_annotation
+        now = int(time.time())
+        start_raw = node.annotations.get(key)
+        if start_raw is None:
+            self._provider.change_node_upgrade_annotation(node, key, str(now))
+            return
+        try:
+            start = int(start_raw)
+        except ValueError:
+            log.error(
+                "node %s has invalid completion start-time %r; resetting",
+                node.name, start_raw,
+            )
+            self._provider.change_node_upgrade_annotation(node, key, str(now))
+            return
+        if now > start + timeout_seconds:
+            self._provider.change_node_upgrade_state(
+                node, UpgradeState.POD_DELETION_REQUIRED
+            )
+            self._provider.change_node_upgrade_annotation(node, key, "null")
+
+    # -- helpers -----------------------------------------------------------
+    def list_pods(self, selector: str = "", node_name: str = "") -> list[Pod]:
+        """All-namespaces pod list by label selector and node
+        (reference: :321-329)."""
+        field_selector = f"spec.nodeName={node_name}" if node_name else None
+        return [
+            Pod(o.raw)
+            for o in self._client.list(
+                "Pod", label_selector=selector or None, field_selector=field_selector
+            )
+        ]
+
+    @staticmethod
+    def is_pod_running_or_pending(pod: Pod) -> bool:
+        """(reference: :371-391)"""
+        return pod.phase in ("Running", "Pending")
+
+    def _event(self, obj, event_type: str, message: str) -> None:
+        if self._recorder is not None:
+            self._recorder.eventf(
+                obj, event_type, self._keys.event_reason(), message
+            )

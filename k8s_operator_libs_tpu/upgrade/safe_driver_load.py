@@ -1,0 +1,46 @@
+"""SafeDriverLoadManager — the safe-load handshake.
+
+Parity: reference pkg/upgrade/safe_driver_load_manager.go:29-89. Protocol
+(two-step, cross-process): the driver pod's init container sets the
+safe-load annotation on its node and blocks; the state machine treats that
+node as upgrade-required, cordons/drains it per policy, and at
+``pod-restart-required`` removes the annotation instead of restarting the
+pod; the init container unblocks and the driver loads into a quiesced node.
+
+For the TPU device class this is how libtpu is swapped without yanking it out
+from under a running workload: the libtpu DaemonSet's init container holds
+the new runtime back until the node has been drained of TPU jobs.
+"""
+
+from __future__ import annotations
+
+from ..kube.objects import Node
+from ..utils.log import get_logger
+from .consts import UpgradeKeys
+from .state_provider import NodeUpgradeStateProvider
+
+log = get_logger("upgrade.safe_load")
+
+
+class SafeDriverLoadManager:
+    def __init__(
+        self, state_provider: NodeUpgradeStateProvider, keys: UpgradeKeys
+    ) -> None:
+        self._provider = state_provider
+        self._keys = keys
+
+    def is_waiting_for_safe_driver_load(self, node: Node) -> bool:
+        """(reference: :51-53)"""
+        return bool(
+            node.annotations.get(self._keys.safe_driver_load_annotation, "")
+        )
+
+    def unblock_loading(self, node: Node) -> None:
+        """Remove the annotation, releasing the blocked init container
+        (reference: :57-71)."""
+        if not self.is_waiting_for_safe_driver_load(node):
+            return
+        log.info("unblocking safe driver load on node %s", node.name)
+        self._provider.change_node_upgrade_annotation(
+            node, self._keys.safe_driver_load_annotation, "null"
+        )

@@ -1,0 +1,284 @@
+"""ClusterUpgradeStateManager — the orchestrator.
+
+Parity: reference pkg/upgrade/upgrade_state.go:35-378. ``build_state`` takes
+a point-in-time snapshot of driver DaemonSets/pods/nodes; ``apply_state``
+runs one stateless, idempotent pass of the state machine — any error aborts
+the pass and the next reconcile resumes from the node labels
+(reference: upgrade_state.go:49-52, 166-170).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Mapping, Optional
+
+from ..api.upgrade_v1alpha1 import DriverUpgradePolicySpec
+from ..kube.client import Client
+from ..kube.objects import DaemonSet, Node, Pod
+from ..utils.log import get_logger
+from .common_manager import (
+    ClusterUpgradeState,
+    CommonUpgradeManager,
+    NodeUpgradeState,
+)
+from .consts import DeviceClass, UpgradeKeys, UpgradeState
+from .cordon_manager import CordonManager
+from .drain_manager import DrainManager
+from .inplace import InplaceNodeStateManager, ProcessNodeStateManager
+from .pod_manager import PodDeletionFilter, PodManager
+from .safe_driver_load import SafeDriverLoadManager
+from .state_provider import NodeUpgradeStateProvider
+from .task_runner import TaskRunner
+from .validation_manager import ValidationHook, ValidationManager
+
+log = get_logger("upgrade.state_manager")
+
+
+class BuildStateError(Exception):
+    pass
+
+
+@dataclass
+class StateOptions:
+    """(reference: upgrade_state.go:94-96; RequestorOptions
+    upgrade_requestor.go:68-82)"""
+
+    use_maintenance_operator: bool = False
+    maintenance_namespace: str = "default"
+    requestor_id: str = "tpu.operator.dev"
+    node_maintenance_name_prefix: str = ""
+
+
+class ClusterUpgradeStateManager:
+    """Public entry point (reference: upgrade_state.go:35-53)."""
+
+    def __init__(
+        self,
+        client: Client,
+        device: DeviceClass,
+        reader: Optional[Client] = None,
+        recorder=None,
+        options: Optional[StateOptions] = None,
+        runner: Optional[TaskRunner] = None,
+        requestor: Optional[ProcessNodeStateManager] = None,
+    ) -> None:
+        self.keys = UpgradeKeys(device)
+        self.options = options or StateOptions()
+        runner = runner or TaskRunner()
+        provider = NodeUpgradeStateProvider(
+            client, self.keys, reader=reader, recorder=recorder
+        )
+        self.provider = provider
+        self.common = CommonUpgradeManager(
+            client=client,
+            state_provider=provider,
+            keys=self.keys,
+            cordon_manager=CordonManager(client, self.keys, recorder=recorder),
+            drain_manager=DrainManager(
+                client, provider, self.keys, runner=runner, recorder=recorder
+            ),
+            pod_manager=PodManager(
+                client, provider, self.keys, runner=runner, recorder=recorder
+            ),
+            validation_manager=ValidationManager(
+                client, provider, self.keys, recorder=recorder
+            ),
+            safe_load_manager=SafeDriverLoadManager(provider, self.keys),
+            recorder=recorder,
+        )
+        self.client = client
+        self.recorder = recorder
+        self.runner = runner
+        self.inplace: ProcessNodeStateManager = InplaceNodeStateManager(self.common)
+        self.requestor: Optional[ProcessNodeStateManager] = requestor
+
+    # ------------------------------------------------------------------
+    # Optional-state configuration (reference: upgrade_state.go:329-350)
+    # ------------------------------------------------------------------
+    def with_pod_deletion_enabled(
+        self, pod_deletion_filter: PodDeletionFilter
+    ) -> "ClusterUpgradeStateManager":
+        if pod_deletion_filter is None:
+            log.warning("cannot enable pod deletion: filter is None")
+            return self
+        self.common.pod_manager = PodManager(
+            self.client,
+            self.provider,
+            self.keys,
+            pod_deletion_filter=pod_deletion_filter,
+            runner=self.runner,
+            recorder=self.recorder,
+        )
+        self.common.pod_deletion_enabled = True
+        return self
+
+    def with_validation_enabled(
+        self,
+        pod_selector: str = "",
+        validation_hook: Optional[ValidationHook] = None,
+        timeout_seconds: Optional[int] = None,
+    ) -> "ClusterUpgradeStateManager":
+        """Enable the validation state via a pod selector (reference
+        behavior) and/or an in-process hook (TPU ICI health gate)."""
+        if not pod_selector and validation_hook is None:
+            log.warning("cannot enable validation: no selector and no hook")
+            return self
+        kwargs = {}
+        if timeout_seconds is not None:
+            kwargs["timeout_seconds"] = timeout_seconds
+        self.common.validation_manager = ValidationManager(
+            self.client,
+            self.provider,
+            self.keys,
+            pod_selector=pod_selector,
+            validation_hook=validation_hook,
+            recorder=self.recorder,
+            **kwargs,
+        )
+        self.common.validation_enabled = True
+        return self
+
+    # -- metrics passthrough (reference: common_manager.go:23-41) ----------
+    def get_total_managed_nodes(self, state: ClusterUpgradeState) -> int:
+        return self.common.get_total_managed_nodes(state)
+
+    def get_upgrades_in_progress(self, state: ClusterUpgradeState) -> int:
+        return self.common.get_upgrades_in_progress(state)
+
+    def get_upgrades_done(self, state: ClusterUpgradeState) -> int:
+        return self.common.get_upgrades_done(state)
+
+    def get_upgrades_failed(self, state: ClusterUpgradeState) -> int:
+        return self.common.get_upgrades_failed(state)
+
+    def get_upgrades_pending(self, state: ClusterUpgradeState) -> int:
+        return self.common.get_upgrades_pending(state)
+
+    def is_pod_deletion_enabled(self) -> bool:
+        return self.common.pod_deletion_enabled
+
+    def is_validation_enabled(self) -> bool:
+        return self.common.validation_enabled
+
+    # ------------------------------------------------------------------
+    # BuildState (reference: upgrade_state.go:99-164)
+    # ------------------------------------------------------------------
+    def build_state(
+        self, namespace: str, driver_labels: Mapping[str, str]
+    ) -> ClusterUpgradeState:
+        state = ClusterUpgradeState()
+        daemonsets = self.common.get_driver_daemonsets(
+            namespace, dict(driver_labels)
+        )
+        pods = [
+            Pod(o.raw)
+            for o in self.client.list(
+                "Pod", namespace=namespace, label_selector=dict(driver_labels)
+            )
+        ]
+        selected: list[Pod] = []
+        for ds in daemonsets.values():
+            ds_pods = self.common.get_pods_owned_by_ds(ds, pods)
+            if ds.desired_number_scheduled != len(ds_pods):
+                # The snapshot must be complete: a missing driver pod means
+                # a node would silently escape management
+                # (reference: upgrade_state.go:128-131).
+                raise BuildStateError(
+                    f"driver DaemonSet {ds.name} should not have unscheduled "
+                    f"pods (desired {ds.desired_number_scheduled}, "
+                    f"found {len(ds_pods)})"
+                )
+            selected.extend(ds_pods)
+        selected.extend(self.common.get_orphaned_pods(pods))
+
+        for pod in selected:
+            if not pod.node_name and pod.phase == "Pending":
+                log.info("driver pod %s has no node yet, skipping", pod.name)
+                continue
+            owner = None
+            if not self.common.is_orphaned_pod(pod):
+                owner = daemonsets.get(pod.owner_references[0].get("uid"))
+            ns = self._build_node_upgrade_state(pod, owner)
+            bucket = self.provider.get_upgrade_state(ns.node)
+            state.node_states[bucket].append(ns)
+        return state
+
+    def _build_node_upgrade_state(
+        self, pod: Pod, ds: Optional[DaemonSet]
+    ) -> NodeUpgradeState:
+        """(reference: upgrade_state.go:352-378)"""
+        node = self.provider.get_node(pod.node_name)
+        maintenance = None
+        if self.options.use_maintenance_operator and self.requestor is not None:
+            get_nm = getattr(self.requestor, "get_node_maintenance_obj", None)
+            if callable(get_nm):
+                maintenance = get_nm(node.name)
+        return NodeUpgradeState(
+            node=node,
+            driver_pod=pod,
+            driver_daemonset=ds,
+            node_maintenance=maintenance,
+        )
+
+    # ------------------------------------------------------------------
+    # ApplyState (reference: upgrade_state.go:171-281)
+    # ------------------------------------------------------------------
+    def apply_state(
+        self,
+        state: ClusterUpgradeState,
+        policy: Optional[DriverUpgradePolicySpec],
+    ) -> None:
+        if state is None:
+            raise ValueError("currentState should not be empty")
+        if policy is None or not policy.auto_upgrade:
+            log.info("driver auto upgrade is disabled, skipping")
+            return
+        log.info(
+            "node states: %s",
+            {
+                str(k) or "unknown": len(v)
+                for k, v in state.node_states.items()
+                if v
+            },
+        )
+        common = self.common
+        common.process_done_or_unknown_nodes(state, UpgradeState.UNKNOWN)
+        common.process_done_or_unknown_nodes(state, UpgradeState.DONE)
+        self._process_upgrade_required_nodes(state, policy)
+        common.process_cordon_required_nodes(state)
+        common.process_wait_for_jobs_required_nodes(
+            state, policy.wait_for_completion
+        )
+        drain_enabled = policy.drain is not None and policy.drain.enable
+        common.process_pod_deletion_required_nodes(
+            state, policy.pod_deletion, drain_enabled
+        )
+        common.process_drain_nodes(state, policy.drain)
+        self._process_node_maintenance_required_nodes(state)
+        common.process_pod_restart_nodes(state)
+        common.process_upgrade_failed_nodes(state)
+        common.process_validation_required_nodes(state)
+        self._process_uncordon_required_nodes(state)
+        log.info("state manager finished processing")
+
+    # -- mode dispatch (reference: upgrade_state.go:287-325) ---------------
+    def _process_upgrade_required_nodes(
+        self, state: ClusterUpgradeState, policy: DriverUpgradePolicySpec
+    ) -> None:
+        if self.options.use_maintenance_operator and self.requestor is not None:
+            self.requestor.process_upgrade_required_nodes(state, policy)
+        else:
+            self.inplace.process_upgrade_required_nodes(state, policy)
+
+    def _process_node_maintenance_required_nodes(
+        self, state: ClusterUpgradeState
+    ) -> None:
+        if self.options.use_maintenance_operator and self.requestor is not None:
+            self.requestor.process_node_maintenance_required_nodes(state)
+
+    def _process_uncordon_required_nodes(self, state: ClusterUpgradeState) -> None:
+        # Both modes run so in-flight in-place upgrades can finish after
+        # requestor mode is enabled (reference: upgrade_state.go:311-325).
+        self.inplace.process_uncordon_required_nodes(state)
+        if self.options.use_maintenance_operator and self.requestor is not None:
+            self.requestor.process_uncordon_required_nodes(state)

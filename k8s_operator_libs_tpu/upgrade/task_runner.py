@@ -1,0 +1,92 @@
+"""Async per-node task execution with in-progress deduplication.
+
+The reference's concurrency runtime is "goroutine per node, guarded by a
+StringSet so a node with an operation still in flight is skipped on the next
+reconcile pass" (reference: drain_manager.go:104-133, pod_manager.go:159-227;
+SURVEY.md §2.5). TaskRunner centralizes that pattern: managers submit keyed
+tasks; a key already in flight is refused; outcomes are written back as state
+labels by the task itself, never returned.
+
+``inline=True`` executes tasks synchronously on the caller's thread — used by
+deterministic tests and by the bench's simulated clusters, where real thread
+interleaving would only add noise.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Callable, Optional
+
+from ..utils.log import get_logger
+from ..utils.sync import StringSet
+
+log = get_logger("upgrade.task_runner")
+
+
+class TaskRunner:
+    def __init__(self, max_workers: int = 16, inline: bool = False) -> None:
+        self._inline = inline
+        self._in_progress = StringSet()
+        self._executor: Optional[ThreadPoolExecutor] = None
+        if not inline:
+            self._executor = ThreadPoolExecutor(
+                max_workers=max_workers, thread_name_prefix="upgrade-task"
+            )
+        self._futures_lock = threading.Lock()
+        self._futures: set[Future] = set()
+
+    @property
+    def inline(self) -> bool:
+        return self._inline
+
+    def in_progress(self, key: str) -> bool:
+        return self._in_progress.has(key)
+
+    def submit(self, key: str, fn: Callable[[], None]) -> bool:
+        """Run ``fn`` under ``key``; refuse (return False) if an operation
+        with the same key is still in flight."""
+        if self._in_progress.has(key):
+            log.debug("task %s already in progress, skipping", key)
+            return False
+        self._in_progress.add(key)
+        if self._inline:
+            try:
+                fn()
+            finally:
+                self._in_progress.remove(key)
+            return True
+
+        def run() -> None:
+            try:
+                fn()
+            except Exception:  # tasks own their error handling; never bubble
+                log.exception("task %s raised unexpectedly", key)
+            finally:
+                self._in_progress.remove(key)
+
+        assert self._executor is not None
+        future = self._executor.submit(run)
+        with self._futures_lock:
+            self._futures.add(future)
+        future.add_done_callback(self._discard_future)
+        return True
+
+    def _discard_future(self, future: Future) -> None:
+        with self._futures_lock:
+            self._futures.discard(future)
+
+    def wait_idle(self, timeout: Optional[float] = None) -> bool:
+        """Block until all submitted tasks have finished (tests/benches)."""
+        import concurrent.futures as cf
+
+        with self._futures_lock:
+            pending = list(self._futures)
+        if not pending:
+            return True
+        done, not_done = cf.wait(pending, timeout=timeout)
+        return not not_done
+
+    def shutdown(self) -> None:
+        if self._executor is not None:
+            self._executor.shutdown(wait=True)

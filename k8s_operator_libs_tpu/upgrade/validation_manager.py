@@ -1,0 +1,144 @@
+"""ValidationManager — post-upgrade health gate.
+
+Parity: reference pkg/upgrade/validation_manager.go:26-175. After the driver
+pod restarts at the new revision, the node must pass validation before being
+uncordoned: every pod matching ``pod_selector`` on the node must be Running
+with all containers Ready. A durable start-time annotation bounds the wait;
+on timeout the node moves to ``upgrade-failed``.
+
+The TPU device class plugs its ICI link-health gate in here: the validation
+pod runs a JAX collective across the slice, so "validation passed" means the
+ICI links of the freshly upgraded node carry traffic (BASELINE.json: the
+OFED/NCCL link-health hook becomes an ICI link-health hook).
+
+Deviation from the reference: when *no* validation pod is found on the node,
+the reference returns not-done without starting the timeout clock, so a node
+whose validator was never scheduled waits forever
+(validation_manager.go:84-89). Here the clock starts in that case too — the
+node fails after the timeout instead of hanging.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from ..kube.client import Client
+from ..kube.objects import Node, Pod
+from ..utils.log import get_logger
+from .consts import UpgradeKeys, UpgradeState
+from .state_provider import NodeUpgradeStateProvider
+
+log = get_logger("upgrade.validation")
+
+#: (reference: validation_manager.go:31-33)
+VALIDATION_TIMEOUT_SECONDS = 600
+
+#: Optional programmatic gate run in addition to the pod-readiness check.
+#: Returns True when the node passes. Used for the in-process ICI health probe.
+ValidationHook = Callable[[Node], bool]
+
+
+class ValidationManager:
+    def __init__(
+        self,
+        client: Client,
+        state_provider: NodeUpgradeStateProvider,
+        keys: UpgradeKeys,
+        pod_selector: str = "",
+        validation_hook: Optional[ValidationHook] = None,
+        timeout_seconds: int = VALIDATION_TIMEOUT_SECONDS,
+        recorder=None,
+    ) -> None:
+        self._client = client
+        self._provider = state_provider
+        self._keys = keys
+        self._pod_selector = pod_selector
+        self._hook = validation_hook
+        self._timeout = timeout_seconds
+        self._recorder = recorder
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self._pod_selector) or self._hook is not None
+
+    def validate(self, node: Node) -> bool:
+        """True when the node passes validation (reference: :71-116)."""
+        if not self.enabled:
+            return True
+        if self._pod_selector:
+            pods = [
+                Pod(o.raw)
+                for o in self._client.list(
+                    "Pod",
+                    label_selector=self._pod_selector,
+                    field_selector=f"spec.nodeName={node.name}",
+                )
+            ]
+            if not pods:
+                log.warning(
+                    "no validation pods found on node %s (selector %r)",
+                    node.name, self._pod_selector,
+                )
+                self._handle_timeout(node)
+                return False
+            for pod in pods:
+                if not self._is_pod_ready(pod):
+                    self._handle_timeout(node)
+                    return False
+        if self._hook is not None:
+            try:
+                ok = self._hook(node)
+            except Exception as e:
+                log.error("validation hook failed on node %s: %s", node.name, e)
+                ok = False
+            if not ok:
+                self._event(node, "Warning", "Validation hook failed for the node")
+                self._handle_timeout(node)
+                return False
+        # Validation passed — clear the start-time annotation.
+        self._provider.change_node_upgrade_annotation(
+            node, self._keys.validation_start_annotation, "null"
+        )
+        return True
+
+    @staticmethod
+    def _is_pod_ready(pod: Pod) -> bool:
+        """Running with all containers ready (reference: :118-136)."""
+        if pod.phase != "Running":
+            return False
+        statuses = pod.container_statuses
+        if not statuses:
+            return False
+        return all(s.get("ready", False) for s in statuses)
+
+    def _handle_timeout(self, node: Node) -> None:
+        """Durable start-time tracking; timeout → failed (reference: :139-175)."""
+        key = self._keys.validation_start_annotation
+        now = int(time.time())
+        start_raw = node.annotations.get(key)
+        if start_raw is None:
+            self._provider.change_node_upgrade_annotation(node, key, str(now))
+            return
+        try:
+            start = int(start_raw)
+        except ValueError:
+            log.error(
+                "node %s has invalid validation start-time %r; resetting",
+                node.name, start_raw,
+            )
+            self._provider.change_node_upgrade_annotation(node, key, str(now))
+            return
+        if now > start + self._timeout:
+            self._provider.change_node_upgrade_state(node, UpgradeState.FAILED)
+            log.info("validation timeout exceeded on node %s", node.name)
+            self._event(
+                node, "Warning", "Validation timed out for the driver upgrade"
+            )
+            self._provider.change_node_upgrade_annotation(node, key, "null")
+
+    def _event(self, node: Node, event_type: str, message: str) -> None:
+        if self._recorder is not None:
+            self._recorder.eventf(
+                node, event_type, self._keys.event_reason(), message
+            )

@@ -1,0 +1,455 @@
+"""Manager integration tests against the in-memory apiserver.
+
+Coverage model: reference drain_manager_test.go, pod_manager_test.go,
+cordon_manager_test.go, validation_manager_test.go,
+safe_driver_load_manager_test.go — real managers, real (fake-apiserver)
+cluster, state transitions asserted on the node labels.
+"""
+
+import time
+
+import pytest
+
+from k8s_operator_libs_tpu.api import (
+    DrainSpec,
+    PodDeletionSpec,
+    WaitForCompletionSpec,
+)
+from k8s_operator_libs_tpu.kube import FakeCluster, FakeRecorder
+from k8s_operator_libs_tpu.upgrade import (
+    CordonManager,
+    DeviceClass,
+    DrainConfiguration,
+    DrainManager,
+    NodeUpgradeStateProvider,
+    PodManager,
+    PodManagerConfig,
+    SafeDriverLoadManager,
+    TaskRunner,
+    UpgradeKeys,
+    UpgradeState,
+    ValidationManager,
+)
+from builders import (
+    make_controller_revision,
+    make_daemonset,
+    make_node,
+    make_pod,
+)
+
+KEYS = UpgradeKeys(DeviceClass.tpu())
+
+
+@pytest.fixture
+def cluster():
+    return FakeCluster()
+
+
+@pytest.fixture
+def provider(cluster):
+    return NodeUpgradeStateProvider(cluster, KEYS)
+
+
+@pytest.fixture
+def runner():
+    return TaskRunner(inline=True)
+
+
+def state_of(cluster, name):
+    return cluster.get("Node", name).labels.get(KEYS.state_label)
+
+
+class TestCordonManager:
+    def test_cordon_uncordon_roundtrip(self, cluster, provider):
+        cluster.create(make_node("n1"))
+        m = CordonManager(cluster, KEYS)
+        node = provider.get_node("n1")
+        m.cordon(node)
+        assert cluster.get("Node", "n1").unschedulable
+        assert node.unschedulable
+        m.uncordon(node)
+        assert not cluster.get("Node", "n1").unschedulable
+
+
+class TestDrainManager:
+    def make_manager(self, cluster, provider, runner):
+        return DrainManager(cluster, provider, KEYS, runner=runner)
+
+    def test_successful_drain_moves_to_pod_restart(self, cluster, provider, runner):
+        cluster.create(make_node("n1"))
+        cluster.create(make_pod("w", node_name="n1", controlled=True))
+        m = self.make_manager(cluster, provider, runner)
+        node = provider.get_node("n1")
+        m.schedule_nodes_drain(
+            DrainConfiguration(spec=DrainSpec(enable=True), nodes=[node])
+        )
+        assert state_of(cluster, "n1") == "pod-restart-required"
+        assert cluster.get("Node", "n1").unschedulable
+        assert cluster.get_or_none("Pod", "w", "driver-ns") is None
+
+    def test_failed_drain_moves_to_failed(self, cluster, provider, runner):
+        cluster.create(make_node("n1"))
+        cluster.create(make_pod("naked", node_name="n1"))  # unmanaged, no force
+        m = self.make_manager(cluster, provider, runner)
+        node = provider.get_node("n1")
+        m.schedule_nodes_drain(
+            DrainConfiguration(spec=DrainSpec(enable=True, force=False), nodes=[node])
+        )
+        assert state_of(cluster, "n1") == "upgrade-failed"
+
+    def test_drain_disabled_is_noop(self, cluster, provider, runner):
+        cluster.create(make_node("n1"))
+        m = self.make_manager(cluster, provider, runner)
+        node = provider.get_node("n1")
+        m.schedule_nodes_drain(
+            DrainConfiguration(spec=DrainSpec(enable=False), nodes=[node])
+        )
+        assert state_of(cluster, "n1") is None
+
+    def test_missing_spec_errors(self, cluster, provider, runner):
+        cluster.create(make_node("n1"))
+        m = self.make_manager(cluster, provider, runner)
+        with pytest.raises(ValueError):
+            m.schedule_nodes_drain(
+                DrainConfiguration(spec=None, nodes=[provider.get_node("n1")])
+            )
+
+    def test_empty_nodes_is_noop(self, cluster, provider, runner):
+        self.make_manager(cluster, provider, runner).schedule_nodes_drain(
+            DrainConfiguration(spec=DrainSpec(enable=True), nodes=[])
+        )
+
+    def test_skip_drain_pod_label_respected(self, cluster, provider, runner):
+        cluster.create(make_node("n1"))
+        cluster.create(
+            make_pod(
+                "keep", node_name="n1", controlled=True,
+                labels={KEYS.skip_drain_pod_label: "true"},
+            )
+        )
+        m = self.make_manager(cluster, provider, runner)
+        node = provider.get_node("n1")
+        m.schedule_nodes_drain(
+            DrainConfiguration(spec=DrainSpec(enable=True), nodes=[node])
+        )
+        assert state_of(cluster, "n1") == "pod-restart-required"
+        assert cluster.get_or_none("Pod", "keep", "driver-ns") is not None
+
+    def test_async_dedup(self, cluster, provider):
+        # With a real (non-inline) runner, a second schedule while in
+        # progress must be refused.
+        cluster.create(make_node("n1"))
+        slow_runner = TaskRunner()
+        m = DrainManager(cluster, provider, KEYS, runner=slow_runner)
+        node = provider.get_node("n1")
+        cfg = DrainConfiguration(spec=DrainSpec(enable=True), nodes=[node])
+        m.schedule_nodes_drain(cfg)
+        m.schedule_nodes_drain(cfg)  # no crash, deduped
+        assert slow_runner.wait_idle(timeout=5)
+        assert state_of(cluster, "n1") == "pod-restart-required"
+
+
+class TestPodManagerRevisions:
+    def test_daemonset_revision_hash(self, cluster, provider, runner):
+        ds = cluster.create(make_daemonset("driver"))
+        from k8s_operator_libs_tpu.kube import DaemonSet
+
+        ds = DaemonSet(ds.raw)
+        cluster.create(make_controller_revision(ds, 1, "aaa111"))
+        cluster.create(make_controller_revision(ds, 2, "bbb222"))
+        m = PodManager(cluster, provider, KEYS)
+        assert m.get_daemonset_controller_revision_hash(ds) == "bbb222"
+
+    def test_pod_revision_hash(self, cluster, provider):
+        from k8s_operator_libs_tpu.upgrade import RevisionHashError
+
+        m = PodManager(cluster, provider, KEYS)
+        pod = make_pod("p", revision_hash="abc")
+        assert m.get_pod_controller_revision_hash(pod) == "abc"
+        with pytest.raises(RevisionHashError):
+            m.get_pod_controller_revision_hash(make_pod("q"))
+
+    def test_no_revisions_errors(self, cluster, provider):
+        from k8s_operator_libs_tpu.kube import DaemonSet
+        from k8s_operator_libs_tpu.upgrade import RevisionHashError
+
+        ds = DaemonSet(cluster.create(make_daemonset("driver")).raw)
+        m = PodManager(cluster, provider, KEYS)
+        with pytest.raises(RevisionHashError):
+            m.get_daemonset_controller_revision_hash(ds)
+
+
+class TestPodEviction:
+    def make_manager(self, cluster, provider, runner, filter=None):
+        return PodManager(
+            cluster, provider, KEYS,
+            pod_deletion_filter=filter or (lambda p: p.labels.get("evict") == "yes"),
+            runner=runner,
+        )
+
+    def test_eviction_moves_to_pod_restart(self, cluster, provider, runner):
+        cluster.create(make_node("n1"))
+        cluster.create(
+            make_pod("victim", node_name="n1", controlled=True, labels={"evict": "yes"})
+        )
+        cluster.create(make_pod("bystander", node_name="n1", controlled=True))
+        m = self.make_manager(cluster, provider, runner)
+        m.schedule_pod_eviction(
+            PodManagerConfig(
+                nodes=[provider.get_node("n1")], deletion_spec=PodDeletionSpec()
+            )
+        )
+        assert state_of(cluster, "n1") == "pod-restart-required"
+        assert cluster.get_or_none("Pod", "victim", "driver-ns") is None
+        assert cluster.get_or_none("Pod", "bystander", "driver-ns") is not None
+
+    def test_no_matching_pods_still_advances(self, cluster, provider, runner):
+        cluster.create(make_node("n1"))
+        m = self.make_manager(cluster, provider, runner)
+        m.schedule_pod_eviction(
+            PodManagerConfig(
+                nodes=[provider.get_node("n1")], deletion_spec=PodDeletionSpec()
+            )
+        )
+        assert state_of(cluster, "n1") == "pod-restart-required"
+
+    def test_ineligible_pod_fails_or_drains(self, cluster, provider, runner):
+        # emptyDir pod matching the filter, deleteEmptyDir=False.
+        cluster.create(make_node("n1"))
+        cluster.create(
+            make_pod(
+                "scratchy", node_name="n1", controlled=True,
+                labels={"evict": "yes"}, empty_dir=True,
+            )
+        )
+        m = self.make_manager(cluster, provider, runner)
+        m.schedule_pod_eviction(
+            PodManagerConfig(
+                nodes=[provider.get_node("n1")],
+                deletion_spec=PodDeletionSpec(delete_empty_dir=False),
+                drain_enabled=False,
+            )
+        )
+        assert state_of(cluster, "n1") == "upgrade-failed"
+        # Same, but drain enabled → drain-required instead.
+        cluster.create(make_node("n2"))
+        cluster.create(
+            make_pod(
+                "scratchy2", node_name="n2", controlled=True,
+                labels={"evict": "yes"}, empty_dir=True,
+            )
+        )
+        m.schedule_pod_eviction(
+            PodManagerConfig(
+                nodes=[provider.get_node("n2")],
+                deletion_spec=PodDeletionSpec(delete_empty_dir=False),
+                drain_enabled=True,
+            )
+        )
+        assert state_of(cluster, "n2") == "drain-required"
+
+    def test_force_and_empty_dir_matrix(self, cluster, provider, runner):
+        cluster.create(make_node("n1"))
+        cluster.create(
+            make_pod(
+                "scratchy", node_name="n1", controlled=True,
+                labels={"evict": "yes"}, empty_dir=True,
+            )
+        )
+        m = self.make_manager(cluster, provider, runner)
+        m.schedule_pod_eviction(
+            PodManagerConfig(
+                nodes=[provider.get_node("n1")],
+                deletion_spec=PodDeletionSpec(delete_empty_dir=True),
+            )
+        )
+        assert state_of(cluster, "n1") == "pod-restart-required"
+
+    def test_missing_spec_errors(self, cluster, provider, runner):
+        with pytest.raises(ValueError):
+            self.make_manager(cluster, provider, runner).schedule_pod_eviction(
+                PodManagerConfig(nodes=[make_node("x")], deletion_spec=None)
+            )
+
+
+class TestPodRestart:
+    def test_restart_deletes_pods(self, cluster, provider):
+        cluster.create(make_pod("d1", node_name="n1", controlled=True))
+        m = PodManager(cluster, provider, KEYS)
+        m.schedule_pods_restart([make_pod("d1", node_name="n1")])
+        assert cluster.get_or_none("Pod", "d1", "driver-ns") is None
+
+    def test_restart_tolerates_gone_pod(self, cluster, provider):
+        m = PodManager(cluster, provider, KEYS)
+        m.schedule_pods_restart([make_pod("ghost")])
+
+
+class TestCompletionWait:
+    def make_manager(self, cluster, provider, runner):
+        return PodManager(cluster, provider, KEYS, runner=runner)
+
+    def test_no_running_pods_advances(self, cluster, provider, runner):
+        cluster.create(make_node("n1"))
+        m = self.make_manager(cluster, provider, runner)
+        m.schedule_check_on_pod_completion(
+            PodManagerConfig(
+                nodes=[provider.get_node("n1")],
+                wait_for_completion_spec=WaitForCompletionSpec(pod_selector="job=batch"),
+            )
+        )
+        assert state_of(cluster, "n1") == "pod-deletion-required"
+
+    def test_running_pods_block_without_timeout(self, cluster, provider, runner):
+        cluster.create(make_node("n1"))
+        cluster.create(
+            make_pod("job", node_name="n1", controlled=True, labels={"job": "batch"})
+        )
+        m = self.make_manager(cluster, provider, runner)
+        m.schedule_check_on_pod_completion(
+            PodManagerConfig(
+                nodes=[provider.get_node("n1")],
+                wait_for_completion_spec=WaitForCompletionSpec(pod_selector="job=batch"),
+            )
+        )
+        assert state_of(cluster, "n1") is None  # stays put, no timer
+
+    def test_timeout_annotation_lifecycle(self, cluster, provider, runner):
+        cluster.create(make_node("n1"))
+        cluster.create(
+            make_pod("job", node_name="n1", controlled=True, labels={"job": "batch"})
+        )
+        m = self.make_manager(cluster, provider, runner)
+        spec = WaitForCompletionSpec(pod_selector="job=batch", timeout_seconds=3600)
+        cfg = PodManagerConfig(
+            nodes=[provider.get_node("n1")], wait_for_completion_spec=spec
+        )
+        m.schedule_check_on_pod_completion(cfg)
+        ann_key = KEYS.wait_for_pod_completion_start_annotation
+        start = cluster.get("Node", "n1").annotations.get(ann_key)
+        assert start is not None  # timer started, state unchanged
+        assert state_of(cluster, "n1") is None
+
+        # Simulate an expired timer by rewriting the start annotation.
+        past = str(int(time.time()) - 7200)
+        cluster.patch(
+            "Node", "n1", patch={"metadata": {"annotations": {ann_key: past}}}
+        )
+        m.schedule_check_on_pod_completion(
+            PodManagerConfig(
+                nodes=[provider.get_node("n1")], wait_for_completion_spec=spec
+            )
+        )
+        assert state_of(cluster, "n1") == "pod-deletion-required"
+        assert ann_key not in cluster.get("Node", "n1").annotations
+
+    def test_completion_clears_annotation(self, cluster, provider, runner):
+        cluster.create(make_node("n1"))
+        ann_key = KEYS.wait_for_pod_completion_start_annotation
+        cluster.patch(
+            "Node", "n1", patch={"metadata": {"annotations": {ann_key: "123"}}}
+        )
+        m = self.make_manager(cluster, provider, runner)
+        m.schedule_check_on_pod_completion(
+            PodManagerConfig(
+                nodes=[provider.get_node("n1")],
+                wait_for_completion_spec=WaitForCompletionSpec(pod_selector="job=batch"),
+            )
+        )
+        assert state_of(cluster, "n1") == "pod-deletion-required"
+        assert ann_key not in cluster.get("Node", "n1").annotations
+
+
+class TestValidationManager:
+    def make_manager(self, cluster, provider, **kw):
+        kw.setdefault("pod_selector", "app=validator")
+        return ValidationManager(cluster, provider, KEYS, **kw)
+
+    def test_disabled_always_passes(self, cluster, provider):
+        m = ValidationManager(cluster, provider, KEYS)
+        assert not m.enabled
+        assert m.validate(make_node("n1"))
+
+    def test_ready_pod_passes(self, cluster, provider):
+        cluster.create(make_node("n1"))
+        pod = make_pod("v", node_name="n1", labels={"app": "validator"})
+        pod.status["containerStatuses"] = [{"name": "c", "ready": True}]
+        cluster.create(pod)
+        m = self.make_manager(cluster, provider)
+        assert m.validate(provider.get_node("n1"))
+
+    def test_unready_pod_fails_and_starts_timer(self, cluster, provider):
+        cluster.create(make_node("n1"))
+        pod = make_pod("v", node_name="n1", labels={"app": "validator"})
+        pod.status["containerStatuses"] = [{"name": "c", "ready": False}]
+        cluster.create(pod)
+        m = self.make_manager(cluster, provider)
+        assert not m.validate(provider.get_node("n1"))
+        assert (
+            KEYS.validation_start_annotation
+            in cluster.get("Node", "n1").annotations
+        )
+
+    def test_no_pods_starts_timer_too(self, cluster, provider):
+        # Deviation from the reference (documented): absent validator also
+        # starts the clock instead of hanging forever.
+        cluster.create(make_node("n1"))
+        m = self.make_manager(cluster, provider)
+        assert not m.validate(provider.get_node("n1"))
+        assert (
+            KEYS.validation_start_annotation
+            in cluster.get("Node", "n1").annotations
+        )
+
+    def test_timeout_moves_to_failed(self, cluster, provider):
+        cluster.create(make_node("n1"))
+        key = KEYS.validation_start_annotation
+        past = str(int(time.time()) - 1000)
+        cluster.patch("Node", "n1", patch={"metadata": {"annotations": {key: past}}})
+        m = self.make_manager(cluster, provider, timeout_seconds=600)
+        assert not m.validate(provider.get_node("n1"))
+        assert state_of(cluster, "n1") == "upgrade-failed"
+        assert key not in cluster.get("Node", "n1").annotations
+
+    def test_hook_gate(self, cluster, provider):
+        cluster.create(make_node("n1"))
+        calls = []
+
+        def hook(node):
+            calls.append(node.name)
+            return len(calls) >= 2
+
+        m = ValidationManager(
+            cluster, provider, KEYS, validation_hook=hook
+        )
+        assert m.enabled
+        node = provider.get_node("n1")
+        assert not m.validate(node)  # first call fails
+        node = provider.get_node("n1")
+        assert m.validate(node)  # second passes, annotation cleared
+        assert (
+            KEYS.validation_start_annotation
+            not in cluster.get("Node", "n1").annotations
+        )
+
+
+class TestSafeDriverLoad:
+    def test_waiting_detection_and_unblock(self, cluster, provider):
+        cluster.create(
+            make_node(
+                "n1", annotations={KEYS.safe_driver_load_annotation: "true"}
+            )
+        )
+        m = SafeDriverLoadManager(provider, KEYS)
+        node = provider.get_node("n1")
+        assert m.is_waiting_for_safe_driver_load(node)
+        m.unblock_loading(node)
+        assert (
+            KEYS.safe_driver_load_annotation
+            not in cluster.get("Node", "n1").annotations
+        )
+        assert not m.is_waiting_for_safe_driver_load(node)
+
+    def test_unblock_noop_when_not_waiting(self, cluster, provider):
+        cluster.create(make_node("n1"))
+        m = SafeDriverLoadManager(provider, KEYS)
+        m.unblock_loading(provider.get_node("n1"))  # no error
