@@ -22,8 +22,22 @@ from .state_manager import (
     ClusterUpgradeStateManager,
     StateOptions,
 )
+from .requestor import (
+    DEFAULT_NODE_MAINTENANCE_NAME_PREFIX,
+    RequestorNodeStateManager,
+    RequestorOptions,
+    condition_changed_predicate,
+    enable_requestor_mode,
+    requestor_id_predicate,
+)
 
 __all__ = [
+    "DEFAULT_NODE_MAINTENANCE_NAME_PREFIX",
+    "RequestorNodeStateManager",
+    "RequestorOptions",
+    "condition_changed_predicate",
+    "enable_requestor_mode",
+    "requestor_id_predicate",
     "BuildStateError",
     "ClusterUpgradeState",
     "ClusterUpgradeStateManager",

@@ -1,0 +1,351 @@
+"""Requestor mode: delegate node maintenance to an external operator.
+
+Parity: reference pkg/upgrade/upgrade_requestor.go:29-551. Instead of
+cordoning/draining itself, the library creates a ``NodeMaintenance`` CR and
+an external maintenance operator performs cordon/wait/drain, reporting
+completion through a ``Ready`` status condition. Multiple operators (GPU
+driver, NIC firmware, libtpu) coordinate on a *shared* CR: the first becomes
+its ``requestorID`` owner, later ones append themselves to
+``additionalRequestors`` via optimistic-lock patches; the owner deletes the
+CR at the end, non-owners merely remove themselves.
+
+On GKE TPU pools the same protocol targets a maintenance controller that
+understands slice topology — the CR's node set is the unit the external
+operator may take down together.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..api.upgrade_v1alpha1 import DriverUpgradePolicySpec
+from ..kube.client import AlreadyExistsError, Client, retry_on_conflict
+from ..kube.objects import NodeMaintenance
+from ..utils.log import get_logger
+from .common_manager import (
+    ClusterUpgradeState,
+    CommonUpgradeManager,
+    NodeUpgradeState,
+)
+from .consts import TRUE_STRING, UpgradeState
+from .state_manager import StateOptions
+
+log = get_logger("upgrade.requestor")
+
+#: (reference: upgrade_requestor.go:52)
+DEFAULT_NODE_MAINTENANCE_NAME_PREFIX = "tpu-operator"
+
+
+@dataclass
+class RequestorOptions:
+    """(reference: upgrade_requestor.go:68-82)"""
+
+    use_maintenance_operator: bool = False
+    requestor_id: str = "tpu.operator.dev"
+    namespace: str = "default"
+    node_maintenance_name_prefix: str = DEFAULT_NODE_MAINTENANCE_NAME_PREFIX
+    #: Pod eviction filters forwarded to the maintenance operator when the
+    #: policy enables pod deletion (maintenance-operator API field
+    #: spec.drainSpec.podEvictionFilters).
+    pod_eviction_filters: list[dict] = field(default_factory=list)
+
+    @staticmethod
+    def from_env() -> "RequestorOptions":
+        """(reference: upgrade_requestor.go:527-546)"""
+        return RequestorOptions(
+            use_maintenance_operator=(
+                os.environ.get("MAINTENANCE_OPERATOR_ENABLED") == TRUE_STRING
+            ),
+            # Fall back to the dataclass default: an empty requestor ID would
+            # make every operator look like the owner of every CR.
+            requestor_id=(
+                os.environ.get("MAINTENANCE_OPERATOR_REQUESTOR_ID")
+                or RequestorOptions.requestor_id
+            ),
+            namespace=os.environ.get(
+                "MAINTENANCE_OPERATOR_REQUESTOR_NAMESPACE", "default"
+            ),
+            node_maintenance_name_prefix=os.environ.get(
+                "MAINTENANCE_OPERATOR_NODE_MAINTENANCE_PREFIX",
+                DEFAULT_NODE_MAINTENANCE_NAME_PREFIX,
+            ),
+        )
+
+    def to_state_options(self) -> StateOptions:
+        return StateOptions(
+            use_maintenance_operator=self.use_maintenance_operator,
+            maintenance_namespace=self.namespace,
+            requestor_id=self.requestor_id,
+            node_maintenance_name_prefix=self.node_maintenance_name_prefix,
+        )
+
+
+def condition_changed_predicate(old: Optional[dict], new: Optional[dict]) -> bool:
+    """Watch predicate for consumer controllers: react only when status
+    conditions changed or deletion started
+    (reference: upgrade_requestor.go:115-159)."""
+    if old is None or new is None:
+        return False
+
+    def conds(obj: dict) -> list[tuple]:
+        return sorted(
+            (c.get("type", ""), c.get("status", ""), c.get("reason", ""),
+             c.get("message", ""))
+            for c in (obj.get("status") or {}).get("conditions") or []
+        )
+
+    cond_changed = conds(old) != conds(new)
+    old_meta = old.get("metadata") or {}
+    new_meta = new.get("metadata") or {}
+    deleting = (
+        bool(old_meta.get("finalizers"))
+        and not new_meta.get("finalizers")
+        and new_meta.get("deletionTimestamp") is not None
+    )
+    return cond_changed or deleting
+
+
+def requestor_id_predicate(obj: dict, requestor_id: str) -> bool:
+    """True when the CR is owned by or shared with ``requestor_id``
+    (reference: upgrade_requestor.go:93-103)."""
+    spec = obj.get("spec") or {}
+    return requestor_id == spec.get("requestorID") or requestor_id in (
+        spec.get("additionalRequestors") or []
+    )
+
+
+def enable_requestor_mode(manager, opts: RequestorOptions):
+    """Wire requestor mode into an existing ClusterUpgradeStateManager
+    (reference: NewClusterUpgradeStateManager wires both strategies,
+    upgrade_state.go:65-92). Returns the manager for chaining.
+
+    Validation happens before any mutation so a rejected opts object leaves
+    the manager untouched."""
+    requestor = RequestorNodeStateManager(manager.client, manager.common, opts)
+    manager.options = opts.to_state_options()
+    manager.requestor = requestor
+    return manager
+
+
+class RequestorNodeStateManager:
+    def __init__(
+        self,
+        client: Client,
+        common: CommonUpgradeManager,
+        opts: RequestorOptions,
+    ) -> None:
+        if not opts.use_maintenance_operator:
+            raise ValueError("node maintenance upgrade mode is disabled")
+        self.client = client
+        self.common = common
+        self.opts = opts
+
+    # ------------------------------------------------------------------
+    # NodeMaintenance object lifecycle
+    # ------------------------------------------------------------------
+    def node_maintenance_name(self, node_name: str) -> str:
+        return f"{self.opts.node_maintenance_name_prefix}-{node_name}"
+
+    def new_node_maintenance(
+        self, node_name: str, policy: Optional[DriverUpgradePolicySpec]
+    ) -> NodeMaintenance:
+        """Build the CR from the upgrade policy
+        (reference: upgrade_requestor.go:161-180, 497-524)."""
+        nm = NodeMaintenance.new(
+            self.node_maintenance_name(node_name), namespace=self.opts.namespace
+        )
+        nm.requestor_id = self.opts.requestor_id
+        nm.node_name = node_name
+        if policy is not None:
+            drain: dict = {}
+            if policy.drain is not None:
+                drain = {
+                    "force": policy.drain.force,
+                    "podSelector": policy.drain.pod_selector,
+                    "timeoutSeconds": policy.drain.timeout_seconds,
+                    "deleteEmptyDir": policy.drain.delete_empty_dir,
+                }
+            if policy.pod_deletion is not None and self.opts.pod_eviction_filters:
+                drain["podEvictionFilters"] = list(self.opts.pod_eviction_filters)
+            if drain:
+                nm.spec["drainSpec"] = drain
+            if policy.wait_for_completion is not None:
+                nm.spec["waitForPodCompletion"] = {
+                    "podSelector": policy.wait_for_completion.pod_selector,
+                    "timeoutSeconds": policy.wait_for_completion.timeout_seconds,
+                }
+        return nm
+
+    def get_node_maintenance_obj(self, node_name: str) -> Optional[NodeMaintenance]:
+        """(reference: upgrade_requestor.go:203-218)"""
+        obj = self.client.get_or_none(
+            "NodeMaintenance",
+            self.node_maintenance_name(node_name),
+            self.opts.namespace,
+        )
+        return NodeMaintenance(obj.raw) if obj is not None else None
+
+    def _create_node_maintenance(
+        self, node_state: NodeUpgradeState, policy: Optional[DriverUpgradePolicySpec]
+    ) -> None:
+        """(reference: upgrade_requestor.go:185-201)"""
+        nm = self.new_node_maintenance(node_state.node.name, policy)
+        node_state.node_maintenance = nm
+        try:
+            self.client.create(nm)
+        except AlreadyExistsError:
+            log.warning("nodeMaintenance %s already exists", nm.name)
+
+    def _delete_node_maintenance(self, node_state: NodeUpgradeState) -> None:
+        """Request deletion; the maintenance operator owns actual teardown
+        (reference: upgrade_requestor.go:221-246)."""
+        if node_state.node_maintenance is None:
+            raise ValueError(
+                f"missing nodeMaintenance for node {node_state.node.name}"
+            )
+        name = self.node_maintenance_name(node_state.node.name)
+        current = self.client.get_or_none("NodeMaintenance", name, self.opts.namespace)
+        if current is None:
+            return
+        if current.deletion_timestamp is None:
+            self.client.delete("NodeMaintenance", name, self.opts.namespace)
+
+    def create_or_update_node_maintenance(
+        self, node_state: NodeUpgradeState, policy: Optional[DriverUpgradePolicySpec]
+    ) -> None:
+        """Shared-requestor append protocol
+        (reference: upgrade_requestor.go:320-368): with the default name
+        prefix, an existing CR owned by another operator gets this requestor
+        appended to additionalRequestors under an optimistic-lock patch."""
+        existing = node_state.node_maintenance
+        shared_naming = (
+            self.opts.node_maintenance_name_prefix
+            == DEFAULT_NODE_MAINTENANCE_NAME_PREFIX
+        )
+        if existing is None or not shared_naming:
+            self._create_node_maintenance(node_state, policy)
+            return
+        nm = NodeMaintenance(existing.raw)
+        if nm.requestor_id == self.opts.requestor_id:
+            log.info("nodeMaintenance %s already exists, skip creation", nm.name)
+            return
+        if self.opts.requestor_id in nm.additional_requestors:
+            log.info(
+                "requestor %s already in additionalRequestors", self.opts.requestor_id
+            )
+            return
+
+        def patch_append():
+            fresh_obj = self.client.get("NodeMaintenance", nm.name, nm.namespace)
+            fresh = NodeMaintenance(fresh_obj.raw)
+            if self.opts.requestor_id in fresh.additional_requestors:
+                return
+            fresh.additional_requestors = list(fresh.additional_requestors) + [
+                self.opts.requestor_id
+            ]
+            # Full update with the read resourceVersion = optimistic lock.
+            self.client.update(fresh)
+
+        retry_on_conflict(patch_append)
+
+    def delete_or_update_node_maintenance(self, node_state: NodeUpgradeState) -> None:
+        """Owner deletes the CR; a non-owner removes itself from
+        additionalRequestors (reference: upgrade_requestor.go:370-410)."""
+        if node_state.node_maintenance is None:
+            return
+        nm = NodeMaintenance(node_state.node_maintenance.raw)
+        if nm.requestor_id == self.opts.requestor_id:
+            self._delete_node_maintenance(node_state)
+            return
+        if self.opts.requestor_id not in nm.additional_requestors:
+            return
+
+        def patch_remove():
+            fresh_obj = self.client.get_or_none(
+                "NodeMaintenance", nm.name, nm.namespace
+            )
+            if fresh_obj is None:
+                return
+            fresh = NodeMaintenance(fresh_obj.raw)
+            if self.opts.requestor_id not in fresh.additional_requestors:
+                return
+            fresh.additional_requestors = [
+                r for r in fresh.additional_requestors if r != self.opts.requestor_id
+            ]
+            self.client.update(fresh)
+
+        retry_on_conflict(patch_remove)
+
+    # ------------------------------------------------------------------
+    # ProcessNodeStateManager implementation
+    # ------------------------------------------------------------------
+    def process_upgrade_required_nodes(
+        self,
+        state: ClusterUpgradeState,
+        policy: DriverUpgradePolicySpec,
+    ) -> None:
+        """Create/join the CR, mark the node requestor-mode, move it to
+        node-maintenance-required (reference: upgrade_requestor.go:277-319)."""
+        common = self.common
+        for ns in state.nodes_in(UpgradeState.UPGRADE_REQUIRED):
+            node = ns.node
+            if common.is_upgrade_requested(node):
+                common.provider.change_node_upgrade_annotation(
+                    node, common.keys.upgrade_requested_annotation, "null"
+                )
+            if common.skip_node_upgrade(node):
+                log.info("node %s is marked to skip upgrades", node.name)
+                continue
+            self.create_or_update_node_maintenance(ns, policy)
+            common.provider.change_node_upgrade_annotation(
+                node, common.keys.requestor_mode_annotation, TRUE_STRING
+            )
+            common.provider.change_node_upgrade_state(
+                node, UpgradeState.NODE_MAINTENANCE_REQUIRED
+            )
+
+    def process_node_maintenance_required_nodes(
+        self, state: ClusterUpgradeState
+    ) -> None:
+        """Ready condition ⇒ pod-restart-required; missing CR ⇒ requeue to
+        upgrade-required (reference: upgrade_requestor.go:416-452)."""
+        common = self.common
+        for ns in state.nodes_in(UpgradeState.NODE_MAINTENANCE_REQUIRED):
+            if ns.node_maintenance is None:
+                if not common.is_node_in_requestor_mode(ns.node):
+                    log.warning(
+                        "node %s missing requestor-mode annotation", ns.node.name
+                    )
+                common.provider.change_node_upgrade_state(
+                    ns.node, UpgradeState.UPGRADE_REQUIRED
+                )
+                continue
+            nm = NodeMaintenance(ns.node_maintenance.raw)
+            if nm.ready_reason() == NodeMaintenance.CONDITION_REASON_READY:
+                log.info(
+                    "node maintenance completed for node %s", nm.node_name
+                )
+                common.provider.change_node_upgrade_state(
+                    ns.node, UpgradeState.POD_RESTART_REQUIRED
+                )
+
+    def process_uncordon_required_nodes(self, state: ClusterUpgradeState) -> None:
+        """Finish requestor-mode nodes: release the CR, strip the mode
+        annotation, then mark done (reference: upgrade_requestor.go:454-488).
+
+        Deviation from the reference, which sets DONE *first*: a cleanup
+        failure there leaves a DONE node with an orphaned CR that nothing
+        revisits, so the external operator never uncordons it. Releasing the
+        CR first keeps the node in uncordon-required on failure, and every
+        later step is idempotent — the flow self-heals on the next pass."""
+        common = self.common
+        for ns in state.nodes_in(UpgradeState.UNCORDON_REQUIRED):
+            if not common.is_node_in_requestor_mode(ns.node):
+                continue
+            self.delete_or_update_node_maintenance(ns)
+            common.provider.change_node_upgrade_annotation(
+                ns.node, common.keys.requestor_mode_annotation, "null"
+            )
+            common.provider.change_node_upgrade_state(ns.node, UpgradeState.DONE)

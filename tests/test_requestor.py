@@ -1,0 +1,383 @@
+"""Requestor-mode tests: NodeMaintenance CR protocol, shared-requestor
+coordination, dual-mode coexistence.
+
+Coverage model: reference upgrade_state_test.go requestor specs (incl.
+shared-requestor AdditionalRequestors) and upgrade_requestor.go behavior.
+The external maintenance operator is simulated by setting Status.Conditions
+on CRs directly, exactly as the reference suite does
+(upgrade_suit_test.go:282-293).
+"""
+
+import pytest
+
+from k8s_operator_libs_tpu.api import DrainSpec, DriverUpgradePolicySpec
+from k8s_operator_libs_tpu.kube import FakeCluster, NodeMaintenance
+from k8s_operator_libs_tpu.kube.sim import DaemonSetSimulator
+from k8s_operator_libs_tpu.upgrade import (
+    ClusterUpgradeStateManager,
+    DeviceClass,
+    RequestorNodeStateManager,
+    RequestorOptions,
+    TaskRunner,
+    UpgradeKeys,
+    condition_changed_predicate,
+    enable_requestor_mode,
+    requestor_id_predicate,
+)
+from k8s_operator_libs_tpu.utils import IntOrString
+from builders import make_node
+
+DEVICE = DeviceClass.tpu()
+KEYS = UpgradeKeys(DEVICE)
+NS = "driver-ns"
+LABELS = {"app": "driver"}
+MAINT_NS = "maintenance-ns"
+
+POLICY = DriverUpgradePolicySpec(
+    auto_upgrade=True,
+    max_parallel_upgrades=0,
+    max_unavailable=IntOrString("100%"),
+    drain=DrainSpec(enable=True, force=True, timeout_seconds=120),
+)
+
+
+def make_harness(node_count=1, node_states=None, requestor_id="tpu.operator.dev"):
+    cluster = FakeCluster()
+    for i in range(node_count):
+        labels = {}
+        if node_states and node_states[i]:
+            labels[KEYS.state_label] = node_states[i]
+        cluster.create(make_node(f"node-{i}", labels=labels))
+    sim = DaemonSetSimulator(
+        cluster, name="driver", namespace=NS, match_labels=LABELS
+    )
+    sim.settle()
+    opts = RequestorOptions(
+        use_maintenance_operator=True,
+        requestor_id=requestor_id,
+        namespace=MAINT_NS,
+    )
+    mgr = ClusterUpgradeStateManager(
+        cluster, DEVICE, runner=TaskRunner(inline=True)
+    )
+    enable_requestor_mode(mgr, opts)
+    return cluster, sim, mgr, opts
+
+
+def state_of(cluster, name):
+    return cluster.get("Node", name).labels.get(KEYS.state_label, "")
+
+
+def simulate_maintenance_ready(cluster, nm_name, namespace=MAINT_NS):
+    """Play the external maintenance operator: cordon done, Ready."""
+    cluster.patch(
+        "NodeMaintenance",
+        nm_name,
+        namespace,
+        patch={
+            "status": {
+                "conditions": [
+                    {"type": "Ready", "status": "True", "reason": "Ready"}
+                ]
+            }
+        },
+    )
+
+
+class TestUpgradeRequiredFlow:
+    def test_creates_cr_and_moves_to_maintenance_required(self):
+        cluster, sim, mgr, opts = make_harness(
+            node_count=1, node_states=["upgrade-required"]
+        )
+        sim.set_template_hash("rev-2")
+        mgr.apply_state(mgr.build_state(NS, LABELS), POLICY)
+        node = cluster.get("Node", "node-0")
+        assert node.labels[KEYS.state_label] == "node-maintenance-required"
+        assert KEYS.requestor_mode_annotation in node.annotations
+        nm = cluster.get(
+            "NodeMaintenance", "tpu-operator-node-0", MAINT_NS
+        )
+        nm = NodeMaintenance(nm.raw)
+        assert nm.requestor_id == "tpu.operator.dev"
+        assert nm.node_name == "node-0"
+        # Policy conversion carried the drain spec.
+        assert nm.spec["drainSpec"]["timeoutSeconds"] == 120
+        assert nm.spec["drainSpec"]["force"] is True
+
+    def test_skip_label_respected(self):
+        cluster, sim, mgr, opts = make_harness(
+            node_count=1, node_states=["upgrade-required"]
+        )
+        cluster.patch(
+            "Node", "node-0",
+            patch={"metadata": {"labels": {KEYS.skip_label: "true"}}},
+        )
+        sim.set_template_hash("rev-2")
+        mgr.apply_state(mgr.build_state(NS, LABELS), POLICY)
+        assert state_of(cluster, "node-0") == "upgrade-required"
+        assert (
+            cluster.get_or_none("NodeMaintenance", "tpu-operator-node-0", MAINT_NS)
+            is None
+        )
+
+
+class TestMaintenanceRequiredFlow:
+    def test_ready_condition_advances_to_pod_restart(self):
+        cluster, sim, mgr, opts = make_harness(
+            node_count=1, node_states=["upgrade-required"]
+        )
+        sim.set_template_hash("rev-2")
+        mgr.apply_state(mgr.build_state(NS, LABELS), POLICY)  # creates CR
+        mgr.apply_state(mgr.build_state(NS, LABELS), POLICY)  # not ready yet
+        assert state_of(cluster, "node-0") == "node-maintenance-required"
+        simulate_maintenance_ready(cluster, "tpu-operator-node-0")
+        mgr.apply_state(mgr.build_state(NS, LABELS), POLICY)
+        assert state_of(cluster, "node-0") == "pod-restart-required"
+
+    def test_missing_cr_requeues_to_upgrade_required(self):
+        cluster, sim, mgr, opts = make_harness(
+            node_count=1, node_states=["node-maintenance-required"]
+        )
+        # No CR exists; node must fall back to upgrade-required.
+        mgr.apply_state(mgr.build_state(NS, LABELS), POLICY)
+        assert state_of(cluster, "node-0") == "upgrade-required"
+
+
+class TestUncordonFlow:
+    def test_owner_deletes_cr_on_completion(self):
+        cluster, sim, mgr, opts = make_harness(
+            node_count=1, node_states=["uncordon-required"]
+        )
+        # Node finished via requestor mode.
+        cluster.patch(
+            "Node", "node-0",
+            patch={"metadata": {"annotations": {
+                KEYS.requestor_mode_annotation: "true"}}},
+        )
+        req: RequestorNodeStateManager = mgr.requestor
+        nm = req.new_node_maintenance("node-0", POLICY)
+        cluster.create(nm)
+        mgr.apply_state(mgr.build_state(NS, LABELS), POLICY)
+        node = cluster.get("Node", "node-0")
+        assert node.labels[KEYS.state_label] == "upgrade-done"
+        assert KEYS.requestor_mode_annotation not in node.annotations
+        assert (
+            cluster.get_or_none("NodeMaintenance", nm.name, MAINT_NS) is None
+        )
+
+    def test_inplace_node_unaffected_by_requestor_uncordon(self):
+        # Dual-mode coexistence: a node NOT in requestor mode at
+        # uncordon-required is finished by the in-place flow even though
+        # requestor mode is enabled (reference: upgrade_state.go:311-325).
+        cluster, sim, mgr, opts = make_harness(
+            node_count=1, node_states=["uncordon-required"]
+        )
+        cluster.patch("Node", "node-0", patch={"spec": {"unschedulable": True}})
+        mgr.apply_state(mgr.build_state(NS, LABELS), POLICY)
+        node = cluster.get("Node", "node-0")
+        assert node.labels[KEYS.state_label] == "upgrade-done"
+        assert not node.unschedulable  # in-place flow uncordoned it
+
+
+class TestSharedRequestorProtocol:
+    def test_second_requestor_appends_to_additional(self):
+        cluster, sim, mgr, opts = make_harness(
+            node_count=1, node_states=["upgrade-required"]
+        )
+        sim.set_template_hash("rev-2")
+        # Another operator (e.g. NIC firmware) already owns the CR.
+        other = NodeMaintenance.new("tpu-operator-node-0", namespace=MAINT_NS)
+        other.requestor_id = "nic.operator.dev"
+        other.node_name = "node-0"
+        cluster.create(other)
+        mgr.apply_state(mgr.build_state(NS, LABELS), POLICY)
+        nm = NodeMaintenance(
+            cluster.get("NodeMaintenance", "tpu-operator-node-0", MAINT_NS).raw
+        )
+        assert nm.requestor_id == "nic.operator.dev"
+        assert "tpu.operator.dev" in nm.additional_requestors
+
+    def test_append_is_idempotent(self):
+        cluster, sim, mgr, opts = make_harness(
+            node_count=1, node_states=["upgrade-required"]
+        )
+        sim.set_template_hash("rev-2")
+        other = NodeMaintenance.new("tpu-operator-node-0", namespace=MAINT_NS)
+        other.requestor_id = "nic.operator.dev"
+        other.additional_requestors = ["tpu.operator.dev"]
+        cluster.create(other)
+        rv_before = cluster.get(
+            "NodeMaintenance", "tpu-operator-node-0", MAINT_NS
+        ).resource_version
+        mgr.apply_state(mgr.build_state(NS, LABELS), POLICY)
+        nm = NodeMaintenance(
+            cluster.get("NodeMaintenance", "tpu-operator-node-0", MAINT_NS).raw
+        )
+        assert nm.additional_requestors.count("tpu.operator.dev") == 1
+        assert nm.resource_version == rv_before  # no write happened
+
+    def test_non_owner_removes_itself_on_completion(self):
+        cluster, sim, mgr, opts = make_harness(
+            node_count=1, node_states=["uncordon-required"]
+        )
+        cluster.patch(
+            "Node", "node-0",
+            patch={"metadata": {"annotations": {
+                KEYS.requestor_mode_annotation: "true"}}},
+        )
+        shared = NodeMaintenance.new("tpu-operator-node-0", namespace=MAINT_NS)
+        shared.requestor_id = "nic.operator.dev"
+        shared.additional_requestors = ["tpu.operator.dev"]
+        cluster.create(shared)
+        mgr.apply_state(mgr.build_state(NS, LABELS), POLICY)
+        nm_obj = cluster.get_or_none(
+            "NodeMaintenance", "tpu-operator-node-0", MAINT_NS
+        )
+        assert nm_obj is not None  # owner keeps the CR
+        nm = NodeMaintenance(nm_obj.raw)
+        assert "tpu.operator.dev" not in nm.additional_requestors
+
+    def test_custom_prefix_creates_own_cr(self):
+        cluster, sim, mgr, opts = make_harness(
+            node_count=1, node_states=["upgrade-required"]
+        )
+        opts.node_maintenance_name_prefix = "my-prefix"
+        enable_requestor_mode(mgr, opts)
+        sim.set_template_hash("rev-2")
+        mgr.apply_state(mgr.build_state(NS, LABELS), POLICY)
+        assert (
+            cluster.get_or_none("NodeMaintenance", "my-prefix-node-0", MAINT_NS)
+            is not None
+        )
+
+
+class TestEndToEndRequestorUpgrade:
+    def test_full_roll_with_simulated_maintenance_operator(self):
+        cluster, sim, mgr, opts = make_harness(node_count=3)
+        sim.set_template_hash("rev-2")
+        for _ in range(30):
+            sim.step()
+            # The external maintenance operator: mark any pending CR Ready.
+            for obj in cluster.list("NodeMaintenance", namespace=MAINT_NS):
+                nm = NodeMaintenance(obj.raw)
+                if not nm.is_ready() and nm.deletion_timestamp is None:
+                    simulate_maintenance_ready(cluster, nm.name)
+            mgr.apply_state(mgr.build_state(NS, LABELS), POLICY)
+            sim.step()
+            states = {
+                n.name: n.labels.get(KEYS.state_label, "")
+                for n in cluster.list("Node")
+            }
+            if all(s == "upgrade-done" for s in states.values()):
+                break
+        else:
+            raise AssertionError(f"requestor roll did not converge: {states}")
+        # All CRs cleaned up, no annotations left.
+        assert cluster.list("NodeMaintenance", namespace=MAINT_NS) == []
+        for n in cluster.list("Node"):
+            assert KEYS.requestor_mode_annotation not in (
+                n.metadata.get("annotations") or {}
+            )
+        assert sim.all_pods_ready_and_current()
+
+
+class TestPredicates:
+    def test_requestor_id_predicate(self):
+        obj = {"spec": {"requestorID": "a", "additionalRequestors": ["b"]}}
+        assert requestor_id_predicate(obj, "a")
+        assert requestor_id_predicate(obj, "b")
+        assert not requestor_id_predicate(obj, "c")
+
+    def test_condition_changed(self):
+        old = {"status": {"conditions": [{"type": "Ready", "status": "False"}]},
+               "metadata": {}}
+        new = {"status": {"conditions": [{"type": "Ready", "status": "True"}]},
+               "metadata": {}}
+        assert condition_changed_predicate(old, new)
+
+    def test_condition_order_insensitive(self):
+        old = {"status": {"conditions": [
+            {"type": "A", "status": "True"}, {"type": "B", "status": "False"}]},
+            "metadata": {}}
+        new = {"status": {"conditions": [
+            {"type": "B", "status": "False"}, {"type": "A", "status": "True"}]},
+            "metadata": {}}
+        assert not condition_changed_predicate(old, new)
+
+    def test_deletion_detected(self):
+        old = {"status": {}, "metadata": {"finalizers": ["x"]}}
+        new = {"status": {}, "metadata": {"deletionTimestamp": 123.0}}
+        assert condition_changed_predicate(old, new)
+
+    def test_nil_objects_ignored(self):
+        assert not condition_changed_predicate(None, {"metadata": {}})
+        assert not condition_changed_predicate({"metadata": {}}, None)
+
+    def test_from_env_defaults_requestor_id(self, monkeypatch):
+        monkeypatch.setenv("MAINTENANCE_OPERATOR_ENABLED", "true")
+        monkeypatch.delenv("MAINTENANCE_OPERATOR_REQUESTOR_ID", raising=False)
+        opts = RequestorOptions.from_env()
+        # An empty ID would make every operator look like every CR's owner.
+        assert opts.requestor_id == "tpu.operator.dev"
+
+    def test_enable_requestor_mode_rejects_without_mutating(self):
+        cluster = FakeCluster()
+        mgr = ClusterUpgradeStateManager(cluster, DEVICE)
+        original_options = mgr.options
+        with pytest.raises(ValueError):
+            enable_requestor_mode(
+                mgr, RequestorOptions(use_maintenance_operator=False)
+            )
+        assert mgr.options is original_options
+        assert mgr.requestor is None
+
+    def test_cr_cleanup_failure_leaves_node_resumable(self):
+        # CR release precedes the DONE transition: if release fails the node
+        # stays in uncordon-required and the next pass self-heals.
+        cluster, sim, mgr, opts = make_harness(
+            node_count=1, node_states=["uncordon-required"]
+        )
+        cluster.patch(
+            "Node", "node-0",
+            patch={"metadata": {"annotations": {
+                KEYS.requestor_mode_annotation: "true"}}},
+        )
+        req: RequestorNodeStateManager = mgr.requestor
+        cluster.create(req.new_node_maintenance("node-0", POLICY))
+        from k8s_operator_libs_tpu.kube import ApiError
+
+        boom = {"armed": True}
+
+        def fail_once(verb, kind, payload):
+            if boom["armed"]:
+                boom["armed"] = False
+                raise ApiError("transient")
+
+        cluster.add_reactor("delete", "NodeMaintenance", fail_once)
+        with pytest.raises(ApiError):
+            mgr.apply_state(mgr.build_state(NS, LABELS), POLICY)
+        # Node unchanged -> retried next pass, which now succeeds.
+        assert state_of(cluster, "node-0") == "uncordon-required"
+        mgr.apply_state(mgr.build_state(NS, LABELS), POLICY)
+        assert state_of(cluster, "node-0") == "upgrade-done"
+        assert cluster.get_or_none(
+            "NodeMaintenance", "tpu-operator-node-0", MAINT_NS
+        ) is None
+
+    def test_options_from_env(self, monkeypatch):
+        monkeypatch.setenv("MAINTENANCE_OPERATOR_ENABLED", "true")
+        monkeypatch.setenv("MAINTENANCE_OPERATOR_REQUESTOR_ID", "me")
+        monkeypatch.setenv("MAINTENANCE_OPERATOR_REQUESTOR_NAMESPACE", "ns1")
+        opts = RequestorOptions.from_env()
+        assert opts.use_maintenance_operator
+        assert opts.requestor_id == "me"
+        assert opts.namespace == "ns1"
+        assert opts.node_maintenance_name_prefix == "tpu-operator"
+
+    def test_disabled_mode_rejected(self):
+        cluster = FakeCluster()
+        mgr = ClusterUpgradeStateManager(cluster, DEVICE)
+        with pytest.raises(ValueError):
+            RequestorNodeStateManager(
+                cluster, mgr.common, RequestorOptions(use_maintenance_operator=False)
+            )
