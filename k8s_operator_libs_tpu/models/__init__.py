@@ -1,0 +1,23 @@
+from .burnin import (
+    BurninConfig,
+    batch_spec,
+    forward,
+    init_params,
+    loss_fn,
+    make_sharded_train_step,
+    param_specs,
+    synthetic_batch,
+    train_step,
+)
+
+__all__ = [
+    "BurninConfig",
+    "batch_spec",
+    "forward",
+    "init_params",
+    "loss_fn",
+    "make_sharded_train_step",
+    "param_specs",
+    "synthetic_batch",
+    "train_step",
+]

@@ -1,0 +1,200 @@
+"""Burn-in workload: a small sharded transformer LM train step.
+
+This is the framework's flagship probe model — the full-stack half of the
+post-upgrade ICI health gate. Where ``ops.collectives`` checks links one
+primitive at a time, the burn-in runs a real training step whose sharding
+makes XLA weave matmuls (MXU), all-reduces (ICI) and data-parallel gradient
+sync into one program: if a freshly upgraded libtpu can train this, the node
+is healthy end to end. No reference analog (the reference has no model code;
+SURVEY.md §2.5) — its OFED validation pod plays this role.
+
+Sharding layout (Megatron-style tensor parallelism over ``tp``, data
+parallelism over ``dp``):
+
+* attention qkv projections sharded on the head dimension → P(None, "tp"),
+* attention output projection P("tp", None) (psum over tp follows),
+* MLP up-projection P(None, "tp"), down-projection P("tp", None),
+* embeddings and norms replicated, batch sharded P("dp").
+
+Everything is plain JAX (no flax): params are a pytree dict, the step is a
+pure function, and the whole thing jits into one XLA program.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Params = dict[str, Any]
+
+
+@dataclass(frozen=True)
+class BurninConfig:
+    vocab: int = 512
+    d_model: int = 128
+    n_heads: int = 4
+    d_ff: int = 512
+    n_layers: int = 2
+    seq_len: int = 128
+    batch: int = 8
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+
+def init_params(key: jax.Array, cfg: BurninConfig) -> Params:
+    keys = jax.random.split(key, 2 + cfg.n_layers)
+    scale = cfg.d_model**-0.5
+
+    def dense(k, shape):
+        return (jax.random.normal(k, shape) * scale).astype(cfg.dtype)
+
+    layers = []
+    for i in range(cfg.n_layers):
+        lk = jax.random.split(keys[2 + i], 6)
+        layers.append(
+            {
+                "ln1": jnp.ones((cfg.d_model,), dtype=jnp.float32),
+                "wqkv": dense(lk[0], (cfg.d_model, 3 * cfg.d_model)),
+                "wo": dense(lk[1], (cfg.d_model, cfg.d_model)),
+                "ln2": jnp.ones((cfg.d_model,), dtype=jnp.float32),
+                "w_up": dense(lk[2], (cfg.d_model, cfg.d_ff)),
+                "w_down": dense(lk[3], (cfg.d_ff, cfg.d_model)),
+            }
+        )
+    return {
+        "embed": dense(keys[0], (cfg.vocab, cfg.d_model)),
+        "ln_f": jnp.ones((cfg.d_model,), dtype=jnp.float32),
+        "layers": layers,
+    }
+
+
+def _rms_norm(x: jax.Array, gain: jax.Array) -> jax.Array:
+    norm = jax.lax.rsqrt(
+        jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True) + 1e-6
+    )
+    return (x.astype(jnp.float32) * norm * gain).astype(x.dtype)
+
+
+def _attention(layer: Params, x: jax.Array, cfg: BurninConfig) -> jax.Array:
+    b, s, d = x.shape
+    qkv = x @ layer["wqkv"]  # (b, s, 3d) — MXU, sharded on tp
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(t):
+        return t.reshape(b, s, cfg.n_heads, cfg.head_dim).transpose(0, 2, 1, 3)
+
+    q, k, v = heads(q), heads(k), heads(v)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / (cfg.head_dim**0.5)
+    causal = jnp.tril(jnp.ones((s, s), dtype=bool))
+    scores = jnp.where(causal, scores, jnp.finfo(scores.dtype).min)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    out = out.transpose(0, 2, 1, 3).reshape(b, s, d)
+    return out @ layer["wo"]  # psum over tp follows this matmul
+
+
+def _mlp(layer: Params, x: jax.Array) -> jax.Array:
+    return jax.nn.gelu(x @ layer["w_up"]) @ layer["w_down"]
+
+
+def forward(params: Params, tokens: jax.Array, cfg: BurninConfig) -> jax.Array:
+    """Token ids (b, s) → logits (b, s, vocab)."""
+    x = params["embed"][tokens]
+    for layer in params["layers"]:
+        x = x + _attention(layer, _rms_norm(x, layer["ln1"]), cfg)
+        x = x + _mlp(layer, _rms_norm(x, layer["ln2"]))
+    x = _rms_norm(x, params["ln_f"])
+    return (x @ params["embed"].T).astype(jnp.float32)
+
+
+def loss_fn(params: Params, batch: dict[str, jax.Array], cfg: BurninConfig) -> jax.Array:
+    logits = forward(params, batch["tokens"], cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, batch["targets"][..., None], axis=-1)
+    return jnp.mean(nll)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def train_step(
+    params: Params, batch: dict[str, jax.Array], cfg: BurninConfig, lr: float = 1e-2
+) -> tuple[Params, jax.Array]:
+    """One SGD step; jits into a single XLA program."""
+    loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg)
+    new_params = jax.tree_util.tree_map(
+        lambda p, g: (p - lr * g.astype(jnp.float32)).astype(p.dtype), params, grads
+    )
+    return new_params, loss
+
+
+def synthetic_batch(key: jax.Array, cfg: BurninConfig) -> dict[str, jax.Array]:
+    tokens = jax.random.randint(key, (cfg.batch, cfg.seq_len), 0, cfg.vocab)
+    targets = jnp.roll(tokens, -1, axis=-1)
+    return {"tokens": tokens, "targets": targets}
+
+
+# ----------------------------------------------------------------------
+# Sharding
+# ----------------------------------------------------------------------
+def param_specs(cfg: BurninConfig) -> Params:
+    """Megatron-style tensor-parallel PartitionSpecs for the param tree."""
+    layer_spec = {
+        "ln1": P(),
+        "wqkv": P(None, "tp"),
+        "wo": P("tp", None),
+        "ln2": P(),
+        "w_up": P(None, "tp"),
+        "w_down": P("tp", None),
+    }
+    return {
+        "embed": P(),
+        "ln_f": P(),
+        "layers": [layer_spec] * cfg.n_layers,
+    }
+
+
+def batch_spec() -> dict[str, P]:
+    return {"tokens": P("dp", None), "targets": P("dp", None)}
+
+
+def make_sharded_train_step(mesh: Mesh, cfg: BurninConfig, lr: float = 1e-2):
+    """Jit the train step with explicit dp/tp shardings over ``mesh``.
+
+    Returns (step_fn, sharded_params, sharded_batch): the initial state is
+    already placed according to the specs, so the first call runs the real
+    multi-chip program (collectives over ICI on hardware, or the virtual
+    mesh in tests/dry runs).
+    """
+
+    def to_sharding(tree_spec):
+        return jax.tree_util.tree_map(
+            lambda spec: NamedSharding(mesh, spec),
+            tree_spec,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
+    param_sh = to_sharding(param_specs(cfg))
+    batch_sh = to_sharding(batch_spec())
+
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    params = jax.device_put(params, param_sh)
+    batch = jax.device_put(synthetic_batch(jax.random.PRNGKey(1), cfg), batch_sh)
+
+    @partial(jax.jit, in_shardings=(param_sh, batch_sh),
+             out_shardings=(param_sh, NamedSharding(mesh, P())))
+    def step(p, b):
+        loss, grads = jax.value_and_grad(loss_fn)(p, b, cfg)
+        new_p = jax.tree_util.tree_map(
+            lambda x, g: (x - lr * g.astype(jnp.float32)).astype(x.dtype), p, grads
+        )
+        return new_p, loss
+
+    return step, params, batch

@@ -1,0 +1,4 @@
+from .collectives import CollectiveReport, run_ici_probes
+from .matmul import matmul, mxu_probe
+
+__all__ = ["CollectiveReport", "matmul", "mxu_probe", "run_ici_probes"]

@@ -1,0 +1,140 @@
+"""MXU probe: a Pallas tiled matmul and a throughput measurement.
+
+The compute half of the post-upgrade health gate: after libtpu is swapped,
+the MXU must still deliver — a mis-installed runtime typically shows up as
+wrong numerics or a collapse in sustained TFLOP/s. The kernel follows the
+TPU tiling rules (/opt/skills/guides/pallas_guide.md): last dim 128, bf16
+inputs, f32 accumulation in the MXU.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.log import get_logger
+
+log = get_logger("ops.matmul")
+
+try:  # Pallas is TPU/GPU-oriented; interpret mode covers CPU tests.
+    from jax.experimental import pallas as pl
+
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover - pallas ships with jax
+    _HAS_PALLAS = False
+
+
+def _matmul_kernel(a_ref, b_ref, out_ref):
+    # One (bm, bn) output tile per grid step; full-K dot on the MXU with
+    # f32 accumulation.
+    out_ref[:] = jnp.dot(
+        a_ref[:], b_ref[:], preferred_element_type=jnp.float32
+    ).astype(out_ref.dtype)
+
+
+@partial(jax.jit, static_argnames=("block_m", "block_n", "interpret"))
+def matmul(
+    a: jax.Array,
+    b: jax.Array,
+    block_m: int = 256,
+    block_n: int = 256,
+    interpret: bool = False,
+):
+    """Tiled Pallas matmul: C[M,N] = A[M,K] @ B[K,N].
+
+    Grid over output tiles; each instance streams its A-row-block and
+    B-col-block through VMEM. Shapes must divide the block sizes (the probe
+    controls its own shapes, so no ragged-edge handling is needed).
+    """
+    m, k = a.shape
+    k2, n = b.shape
+    assert k == k2, f"inner dims mismatch: {k} vs {k2}"
+    assert m % block_m == 0 and n % block_n == 0, "probe shapes must tile"
+    grid = (m // block_m, n // block_n)
+    return pl.pallas_call(
+        _matmul_kernel,
+        out_shape=jax.ShapeDtypeStruct((m, n), jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_m, k), lambda i, j: (i, 0)),
+            pl.BlockSpec((k, block_n), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((block_m, block_n), lambda i, j: (i, j)),
+        interpret=interpret,
+    )(a, b)
+
+
+@dataclass
+class MxuReport:
+    ok: bool
+    tflops: float = 0.0
+    max_abs_err: float = 0.0
+    error: str = ""
+
+
+def mxu_probe(
+    size: int = 2048,
+    dtype=jnp.bfloat16,
+    use_pallas: bool = True,
+    interpret: bool = False,
+    iters: int = 3,
+    device=None,
+) -> MxuReport:
+    """Numerics-checked matmul throughput measurement.
+
+    ``use_pallas=False`` falls back to the XLA-native dot — used on
+    platforms where the Pallas TPU lowering is unavailable (the probe should
+    degrade, not die, on exotic runtimes). ``device`` pins the probe to a
+    specific device (default: the platform default).
+    """
+    if device is not None:
+        with jax.default_device(device):
+            return mxu_probe(
+                size=size, dtype=dtype, use_pallas=use_pallas,
+                interpret=interpret, iters=iters, device=None,
+            )
+    try:
+        key_a, key_b = jax.random.split(jax.random.PRNGKey(0))
+        a = jax.random.normal(key_a, (size, size), dtype=jnp.float32)
+        b = jax.random.normal(key_b, (size, size), dtype=jnp.float32)
+        a_lp, b_lp = a.astype(dtype), b.astype(dtype)
+
+        if use_pallas and _HAS_PALLAS:
+            run = lambda: matmul(a_lp, b_lp, interpret=interpret)  # noqa: E731
+        else:
+            run = lambda: jnp.dot(  # noqa: E731
+                a_lp, b_lp, preferred_element_type=jnp.float32
+            )
+
+        out = np.asarray(run().block_until_ready())
+        reference = np.asarray(
+            jnp.dot(a_lp, b_lp, preferred_element_type=jnp.float32)
+        )
+        max_err = float(np.max(np.abs(out - reference)))
+        # bf16 inputs with f32 accumulation: both paths see identical
+        # quantized inputs, so the tolerance only covers reduction-order
+        # differences.
+        tol = 1e-2 * size ** 0.5
+        if max_err > tol:
+            return MxuReport(
+                ok=False, max_abs_err=max_err,
+                error=f"numerics mismatch: max_abs_err={max_err:.4f} > {tol:.4f}",
+            )
+
+        samples = []
+        for _ in range(iters):
+            start = time.perf_counter()
+            run().block_until_ready()
+            samples.append(time.perf_counter() - start)
+        elapsed = float(np.median(samples))
+        flops = 2.0 * size**3
+        report = MxuReport(ok=True, tflops=flops / elapsed / 1e12, max_abs_err=max_err)
+        log.info("MXU probe: %.2f TFLOP/s (max_abs_err %.2e)", report.tflops, max_err)
+        return report
+    except Exception as e:  # noqa: BLE001 - a dead MXU is a failed probe
+        return MxuReport(ok=False, error=str(e))

@@ -1,0 +1,25 @@
+from .topology import (
+    GKE_TPU_ACCELERATOR_LABEL,
+    GKE_TPU_TOPOLOGY_LABEL,
+    SliceTopology,
+    TpuAccelerator,
+    parse_topology,
+)
+from .mesh import (
+    available_devices,
+    build_mesh,
+    mesh_axes_for_topology,
+    single_axis_mesh,
+)
+
+__all__ = [
+    "GKE_TPU_ACCELERATOR_LABEL",
+    "GKE_TPU_TOPOLOGY_LABEL",
+    "SliceTopology",
+    "TpuAccelerator",
+    "available_devices",
+    "single_axis_mesh",
+    "build_mesh",
+    "mesh_axes_for_topology",
+    "parse_topology",
+]

@@ -1,0 +1,81 @@
+"""jax.sharding Mesh construction for probe workloads.
+
+The health gate and burn-in model shard over a named device mesh; XLA
+inserts the collectives and routes them over ICI (the scaling-book recipe:
+pick a mesh, annotate shardings, let the compiler do the rest). Axis
+convention: ``dp`` (data), ``tp`` (tensor/model), ``sp`` (sequence) — the
+probes use whichever axes the caller lays out.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Mapping, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from .topology import SliceTopology
+
+
+def mesh_axes_for_topology(
+    topology: SliceTopology, devices: Optional[int] = None
+) -> dict[str, int]:
+    """Default probe mesh axes for a slice: tensor parallelism within a host
+    (chips sharing a board / fastest links), data parallelism across hosts.
+
+    On a v5e-16 (4 hosts × 4 chips): {"dp": 4, "tp": 4}.
+    """
+    n = devices if devices is not None else topology.total_chips
+    tp = math.gcd(topology.chips_per_host, n)
+    return {"dp": max(1, n // tp), "tp": tp}
+
+
+def available_devices(min_count: int = 1, platform: Optional[str] = None):
+    """Devices for probe meshes: the default platform, falling back to host
+    (CPU) devices when it cannot supply ``min_count`` — e.g. validating an
+    N-chip sharding on a machine with one real chip
+    (``--xla_force_host_platform_device_count`` controls the host count)."""
+    if platform is not None:
+        return list(jax.devices(platform))
+    devs = list(jax.devices())
+    if len(devs) >= min_count:
+        return devs
+    try:
+        cpus = list(jax.devices("cpu"))
+    except RuntimeError:
+        return devs
+    return cpus if len(cpus) >= min_count else devs
+
+
+def build_mesh(
+    axes: Mapping[str, int],
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a Mesh from named axis sizes over the available devices.
+
+    The axis product must equal the device count used. Axis order in ``axes``
+    is the device-grid order: keep the fastest-varying (innermost) axis the
+    one carrying the heaviest communication so it rides the shortest ICI
+    hops.
+    """
+    sizes0 = list(axes.values())
+    needed = math.prod(sizes0)
+    devs = list(devices) if devices is not None else available_devices(needed)
+    sizes = list(axes.values())
+    count = math.prod(sizes)
+    if count > len(devs):
+        raise ValueError(
+            f"mesh axes {dict(axes)} need {count} devices, "
+            f"only {len(devs)} available"
+        )
+    grid = np.array(devs[:count]).reshape(sizes)
+    return Mesh(grid, axis_names=tuple(axes.keys()))
+
+
+def single_axis_mesh(name: str = "x", devices: Optional[Sequence] = None) -> Mesh:
+    """All devices of the default platform on one axis — the shape the ICI
+    ring probes use."""
+    devs = list(devices) if devices is not None else list(jax.devices())
+    return build_mesh({name: len(devs)}, devs)

@@ -1,0 +1,133 @@
+"""TPU slice topology model.
+
+No reference analog (the reference manages GPU/NIC drivers and never reasons
+about accelerator interconnect; SURVEY.md §2.5). On TPU pools this model is
+what makes upgrade scheduling honest: ICI (inter-chip interconnect) links are
+wired within a *slice*, so taking down one node severs the collectives of
+every node in that slice — unavailability must be accounted per slice, not
+per node (BASELINE.json: ICI-topology-aware budget).
+
+Topology facts follow the public GKE/TPU documentation: node labels
+``cloud.google.com/gke-tpu-accelerator`` and
+``cloud.google.com/gke-tpu-topology``, e.g. a v5e-16 pool is accelerator
+``tpu-v5-lite-podslice`` with topology ``4x4`` = 16 chips on 4 hosts of 4
+chips each.
+"""
+
+from __future__ import annotations
+
+import enum
+import math
+from dataclasses import dataclass
+from functools import reduce
+from typing import Mapping, Optional
+
+GKE_TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
+GKE_TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
+#: GKE schedules one multi-host slice per node pool; the node pool label is
+#: therefore the default slice identity.
+GKE_NODEPOOL_LABEL = "cloud.google.com/gke-nodepool"
+
+
+class TpuAccelerator(enum.StrEnum):
+    """GKE accelerator label values for TPU generations."""
+
+    V4 = "tpu-v4-podslice"
+    V5E = "tpu-v5-lite-podslice"
+    V5E_DEVICE = "tpu-v5-lite-device"  # single-host v5e
+    V5P = "tpu-v5p-slice"
+    V6E = "tpu-v6e-slice"
+
+
+#: Chips per host machine by generation (public platform facts: v4/v5p host
+#: boards carry 4 chips; v5e/v6e pod-slice hosts carry up to 8, with 4 the
+#: common GKE machine shape for v5e (ct5lp-hightpu-4t)).
+_CHIPS_PER_HOST: dict[TpuAccelerator, int] = {
+    TpuAccelerator.V4: 4,
+    TpuAccelerator.V5E: 4,
+    TpuAccelerator.V5E_DEVICE: 8,
+    TpuAccelerator.V5P: 4,
+    TpuAccelerator.V6E: 4,
+}
+
+#: Generations whose topology is a 3D torus (v4/v5p); v5e/v6e are 2D.
+_3D_TOPOLOGY = {TpuAccelerator.V4, TpuAccelerator.V5P}
+
+
+def parse_topology(topology: str) -> tuple[int, ...]:
+    """Parse a GKE topology string like ``4x4`` or ``2x2x2`` into dims."""
+    try:
+        dims = tuple(int(part) for part in topology.lower().split("x"))
+    except ValueError:
+        raise ValueError(f"invalid TPU topology string: {topology!r}") from None
+    if not dims or any(d <= 0 for d in dims):
+        raise ValueError(f"invalid TPU topology string: {topology!r}")
+    return dims
+
+
+@dataclass(frozen=True)
+class SliceTopology:
+    """One ICI slice: accelerator generation + chip grid + host layout."""
+
+    accelerator: TpuAccelerator
+    topology: tuple[int, ...]
+    chips_per_host: int
+
+    @staticmethod
+    def from_labels(labels: Mapping[str, str]) -> Optional["SliceTopology"]:
+        """Build from GKE node labels; None when not a TPU node."""
+        acc_raw = labels.get(GKE_TPU_ACCELERATOR_LABEL)
+        if not acc_raw:
+            return None
+        try:
+            acc = TpuAccelerator(acc_raw)
+        except ValueError:
+            # Unknown generation: still a TPU node; assume 4 chips/host.
+            return SliceTopology(
+                accelerator=TpuAccelerator.V5E,
+                topology=parse_topology(
+                    labels.get(GKE_TPU_TOPOLOGY_LABEL, "1x1")
+                ),
+                chips_per_host=4,
+            )
+        topo = parse_topology(labels.get(GKE_TPU_TOPOLOGY_LABEL, "1x1"))
+        return SliceTopology(
+            accelerator=acc,
+            topology=topo,
+            chips_per_host=_CHIPS_PER_HOST[acc],
+        )
+
+    @staticmethod
+    def v5e(chips: int) -> "SliceTopology":
+        """Convenience: a square-ish v5e slice of ``chips`` chips
+        (e.g. 16 → 4x4, the BASELINE v5e-16 pool)."""
+        side = int(math.isqrt(chips))
+        if side * side == chips:
+            topo = (side, side)
+        else:
+            topo = (chips, 1)
+        return SliceTopology(
+            accelerator=TpuAccelerator.V5E,
+            topology=topo,
+            chips_per_host=min(4, chips),
+        )
+
+    @property
+    def total_chips(self) -> int:
+        return reduce(lambda a, b: a * b, self.topology, 1)
+
+    @property
+    def num_hosts(self) -> int:
+        return max(1, self.total_chips // self.chips_per_host)
+
+    @property
+    def is_multi_host(self) -> bool:
+        return self.num_hosts > 1
+
+    @property
+    def is_3d(self) -> bool:
+        return self.accelerator in _3D_TOPOLOGY or len(self.topology) == 3
+
+    def __str__(self) -> str:  # pragma: no cover - debug aid
+        dims = "x".join(str(d) for d in self.topology)
+        return f"{self.accelerator.value}:{dims} ({self.num_hosts} hosts)"

@@ -1,0 +1,16 @@
+from .detector import TpuNodeDetector, TpuNodeInfo
+from .planner import SliceAwareInplaceManager, enable_slice_aware_planning
+from .libtpu import LibtpuDaemonSetManager, LibtpuSpec
+from .health import HealthReport, IciHealthGate, SliceScopedGate
+
+__all__ = [
+    "HealthReport",
+    "IciHealthGate",
+    "SliceScopedGate",
+    "LibtpuDaemonSetManager",
+    "LibtpuSpec",
+    "SliceAwareInplaceManager",
+    "TpuNodeDetector",
+    "TpuNodeInfo",
+    "enable_slice_aware_planning",
+]

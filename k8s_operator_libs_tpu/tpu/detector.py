@@ -1,0 +1,71 @@
+"""GKE TPU node detection and slice grouping.
+
+The BASELINE.json north star requires a "TPU node detector": recognize GKE
+TPU nodes from their labels, recover the slice topology, and group nodes by
+the ICI slice they belong to (GKE schedules one multi-host slice per node
+pool, so the node-pool label is the default slice identity).
+
+No reference analog — the reference keys everything off a driver DaemonSet's
+pods and never inspects accelerator labels.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Mapping, Optional, Sequence
+
+from ..kube.objects import Node
+from ..parallel.topology import (
+    GKE_NODEPOOL_LABEL,
+    GKE_TPU_ACCELERATOR_LABEL,
+    SliceTopology,
+)
+
+#: Optional explicit slice-identity label (takes precedence over node pool).
+TPU_SLICE_ID_LABEL = "tpu-operator.dev/slice-id"
+
+
+@dataclass(frozen=True)
+class TpuNodeInfo:
+    node_name: str
+    topology: SliceTopology
+    slice_id: str
+
+    @property
+    def chips(self) -> int:
+        return self.topology.chips_per_host
+
+
+class TpuNodeDetector:
+    def __init__(self, slice_id_label: str = TPU_SLICE_ID_LABEL) -> None:
+        self._slice_id_label = slice_id_label
+
+    @staticmethod
+    def is_tpu_node(node: Node) -> bool:
+        return GKE_TPU_ACCELERATOR_LABEL in (node.metadata.get("labels") or {})
+
+    def detect(self, node: Node) -> Optional[TpuNodeInfo]:
+        labels: Mapping[str, str] = node.metadata.get("labels") or {}
+        topology = SliceTopology.from_labels(labels)
+        if topology is None:
+            return None
+        slice_id = (
+            labels.get(self._slice_id_label)
+            or labels.get(GKE_NODEPOOL_LABEL)
+            or node.name  # single-host / unlabeled: its own slice
+        )
+        return TpuNodeInfo(
+            node_name=node.name, topology=topology, slice_id=slice_id
+        )
+
+    def group_by_slice(
+        self, nodes: Sequence[Node]
+    ) -> dict[str, list[Node]]:
+        """Slice id → nodes. Non-TPU nodes get singleton groups keyed by
+        node name (per-node semantics degrade gracefully)."""
+        groups: dict[str, list[Node]] = {}
+        for node in nodes:
+            info = self.detect(node)
+            key = info.slice_id if info is not None else node.name
+            groups.setdefault(key, []).append(node)
+        return groups

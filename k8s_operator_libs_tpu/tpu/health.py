@@ -1,0 +1,216 @@
+"""ICI link-health gate — the TPU validation hook.
+
+BASELINE.json: "the OFED/NCCL link-health hook becomes an ICI link-health
+hook". Where the reference gates uncordon on a validation *pod* becoming
+Ready (validation_manager.go:71-116), the TPU gate demands proof the fabric
+actually carries traffic after the libtpu swap:
+
+1. **collective battery** (`ops.collectives`): psum / all_gather /
+   reduce_scatter verified exactly, plus a ring ppermute with a bandwidth
+   floor — a degraded ICI link fails numerics or throughput;
+2. **MXU probe** (`ops.matmul`): numerics-checked matmul throughput — a
+   mis-installed runtime shows up here;
+3. **burn-in step** (`models.burnin`): one real sharded train step so the
+   whole compile→collective→optimizer path is exercised end to end.
+
+Deployment shapes: in-process (the controller runs the probes on devices it
+can see — single-host pools, tests, bench) or as the payload of a validation
+pod scheduled on the upgraded node, with the reference-style pod_selector
+gate watching its readiness. ``IciHealthGate.validation_hook()`` plugs
+directly into ``ClusterUpgradeStateManager.with_validation_enabled``.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..ops.collectives import CollectiveReport, run_ici_probes
+from ..ops.matmul import MxuReport, mxu_probe
+from ..utils.log import get_logger
+
+log = get_logger("tpu.health")
+
+
+@dataclass
+class HealthReport:
+    ok: bool
+    collectives: list[CollectiveReport] = field(default_factory=list)
+    mxu: Optional[MxuReport] = None
+    burnin_ok: Optional[bool] = None
+    elapsed_s: float = 0.0
+    failures: list[str] = field(default_factory=list)
+
+    def summary(self) -> str:
+        parts = [f"ok={self.ok}", f"elapsed={self.elapsed_s:.2f}s"]
+        ring = next(
+            (c for c in self.collectives if c.op == "ppermute_ring"), None
+        )
+        if ring is not None and ring.gbytes_per_s:
+            parts.append(f"ring={ring.gbytes_per_s:.2f}GB/s")
+        if self.mxu is not None and self.mxu.ok:
+            parts.append(f"mxu={self.mxu.tflops:.1f}TFLOP/s")
+        if self.failures:
+            parts.append("failures=" + "; ".join(self.failures))
+        return " ".join(parts)
+
+
+class IciHealthGate:
+    def __init__(
+        self,
+        min_ring_gbytes_per_s: float = 0.0,
+        min_mxu_tflops: float = 0.0,
+        payload_mb: float = 4.0,
+        matmul_size: int = 1024,
+        use_pallas_matmul: bool = False,
+        run_burnin: bool = True,
+        devices: Optional[list] = None,
+    ) -> None:
+        self.min_ring_gbytes_per_s = min_ring_gbytes_per_s
+        self.min_mxu_tflops = min_mxu_tflops
+        self.payload_mb = payload_mb
+        self.matmul_size = matmul_size
+        self.use_pallas_matmul = use_pallas_matmul
+        self.run_burnin = run_burnin
+        self.devices = devices
+
+    def run(self) -> HealthReport:
+        start = time.perf_counter()
+        failures: list[str] = []
+
+        from ..parallel.mesh import single_axis_mesh
+
+        mesh = single_axis_mesh("x", devices=self.devices)
+        collectives = run_ici_probes(mesh, "x", payload_mb=self.payload_mb)
+        for c in collectives:
+            if not c.ok:
+                failures.append(f"{c.op}: {c.error}")
+        ring = next((c for c in collectives if c.op == "ppermute_ring"), None)
+        if (
+            ring is not None
+            and ring.ok
+            and self.min_ring_gbytes_per_s > 0
+            and ring.gbytes_per_s < self.min_ring_gbytes_per_s
+        ):
+            failures.append(
+                f"ring bandwidth {ring.gbytes_per_s:.2f} GB/s below floor "
+                f"{self.min_ring_gbytes_per_s:.2f}"
+            )
+
+        mxu = mxu_probe(
+            size=self.matmul_size,
+            use_pallas=self.use_pallas_matmul,
+            device=self.devices[0] if self.devices else None,
+        )
+        if not mxu.ok:
+            failures.append(f"mxu: {mxu.error}")
+        elif self.min_mxu_tflops > 0 and mxu.tflops < self.min_mxu_tflops:
+            failures.append(
+                f"mxu {mxu.tflops:.2f} TFLOP/s below floor "
+                f"{self.min_mxu_tflops:.2f}"
+            )
+
+        burnin_ok: Optional[bool] = None
+        if self.run_burnin:
+            burnin_ok = self._burnin(mesh)
+            if not burnin_ok:
+                failures.append("burn-in train step failed")
+
+        report = HealthReport(
+            ok=not failures,
+            collectives=collectives,
+            mxu=mxu,
+            burnin_ok=burnin_ok,
+            elapsed_s=time.perf_counter() - start,
+            failures=failures,
+        )
+        log.info("ICI health gate: %s", report.summary())
+        return report
+
+    def _burnin(self, mesh) -> bool:
+        """One sharded train step; loss must be finite and decrease."""
+        try:
+            import numpy as np
+
+            from ..models.burnin import BurninConfig, make_sharded_train_step
+            from ..parallel.mesh import build_mesh
+
+            n = mesh.devices.size
+            tp = 2 if n % 2 == 0 and n > 1 else 1
+            burn_mesh = build_mesh(
+                {"dp": n // tp, "tp": tp},
+                devices=list(mesh.devices.flat),
+            )
+            cfg = BurninConfig(
+                d_model=64, n_heads=4, d_ff=128, n_layers=1,
+                seq_len=32, batch=max(2, (n // tp) * 2),
+            )
+            step, params, batch = make_sharded_train_step(burn_mesh, cfg)
+            params, loss1 = step(params, batch)
+            _, loss2 = step(params, batch)
+            l1, l2 = float(np.asarray(loss1)), float(np.asarray(loss2))
+            return np.isfinite(l1) and np.isfinite(l2) and l2 < l1
+        except Exception as e:  # noqa: BLE001 - any crash = unhealthy node
+            log.error("burn-in failed: %s", e)
+            return False
+
+    def validation_hook(self):
+        """A ValidationHook for with_validation_enabled: node → healthy?"""
+
+        def hook(node) -> bool:
+            report = self.run()
+            if not report.ok:
+                log.warning(
+                    "node %s failed ICI health gate: %s",
+                    node.name, "; ".join(report.failures),
+                )
+            return report.ok
+
+        return hook
+
+
+class SliceScopedGate:
+    """Slice-granular memoization of the health gate.
+
+    The ICI probes are collectives across the *slice's* fabric — one passing
+    run already proves every host of that slice. Running the identical
+    battery once per node (the reference's per-node validation shape,
+    validation_manager.go:71-116) multiplies post-upgrade wall-clock by the
+    host count for no additional signal. This wrapper runs the gate once per
+    (slice, result) and serves cached passes to the slice's remaining nodes;
+    failures are NOT cached, so a flapping link is re-probed every pass.
+    """
+
+    def __init__(
+        self,
+        gate: IciHealthGate,
+        detector=None,
+    ) -> None:
+        from .detector import TpuNodeDetector
+
+        self.gate = gate
+        self.detector = detector or TpuNodeDetector()
+        self._passed: set[str] = set()
+
+    def reset(self) -> None:
+        """Forget cached passes (call at the start of a new rollout)."""
+        self._passed.clear()
+
+    def validation_hook(self):
+        def hook(node) -> bool:
+            info = self.detector.detect(node)
+            slice_id = info.slice_id if info is not None else node.name
+            if slice_id in self._passed:
+                return True
+            report = self.gate.run()
+            if report.ok:
+                self._passed.add(slice_id)
+            else:
+                log.warning(
+                    "slice %s failed ICI health gate: %s",
+                    slice_id, "; ".join(report.failures),
+                )
+            return report.ok
+
+        return hook

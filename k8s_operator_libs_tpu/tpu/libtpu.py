@@ -1,0 +1,163 @@
+"""libtpu DaemonSet manager.
+
+The TPU analog of the GPU/OFED driver DaemonSets the reference rolls: a
+node-resident installer DaemonSet that places a versioned libtpu (and
+optionally TPU-VM runtime bits) on every GKE TPU node, wired for the
+safe-load handshake (reference protocol:
+docs/automatic-ofed-upgrade.md:43-66; safe_driver_load_manager.go:29-43):
+
+* an init container ("safe-load gate") annotates the node with the
+  safe-driver-load key and blocks until the upgrade state machine has
+  drained the node and removed the annotation,
+* the main container installs libtpu onto the host and then sleeps as the
+  liveness anchor — its Ready status is what the state machine reads as
+  "driver healthy", and its controller-revision-hash label is the rollout
+  sync signal (pod_manager.go:84-118 semantics).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..kube.client import Client, NotFoundError, retry_on_conflict
+from ..kube.objects import DaemonSet
+from ..parallel.topology import GKE_TPU_ACCELERATOR_LABEL
+from ..upgrade.consts import DeviceClass, UpgradeKeys
+from ..utils.log import get_logger
+
+log = get_logger("tpu.libtpu")
+
+#: GKE extended resource + taint for TPU nodes.
+TPU_RESOURCE = "google.com/tpu"
+
+
+@dataclass
+class LibtpuSpec:
+    version: str
+    image: str = "tpu-operator.dev/libtpu-installer"
+    namespace: str = "kube-system"
+    device: DeviceClass = field(default_factory=DeviceClass.tpu)
+    host_lib_path: str = "/home/kubernetes/bin"
+    enable_safe_load: bool = True
+
+    @property
+    def full_image(self) -> str:
+        return f"{self.image}:{self.version}"
+
+
+class LibtpuDaemonSetManager:
+    def __init__(self, client: Client, spec: LibtpuSpec) -> None:
+        self.client = client
+        self.spec = spec
+        self.keys = UpgradeKeys(spec.device)
+
+    @property
+    def name(self) -> str:
+        return f"{self.spec.device.driver}-installer"
+
+    @property
+    def match_labels(self) -> dict[str, str]:
+        return {"app": self.name}
+
+    def build_daemonset(self) -> DaemonSet:
+        spec = self.spec
+        ds = DaemonSet.new(self.name, namespace=spec.namespace)
+        ds.match_labels = self.match_labels
+        ds.labels.update(self.match_labels)
+        pod_labels = dict(self.match_labels)
+        pod_labels["version"] = spec.version
+        containers = [
+            {
+                "name": "installer",
+                "image": spec.full_image,
+                # Install then park: the running container is the health
+                # anchor the state machine watches.
+                "command": ["/bin/sh", "-c",
+                            "install-libtpu --dest " + spec.host_lib_path
+                            + " && sleep infinity"],
+                "volumeMounts": [{"name": "host-lib", "mountPath": spec.host_lib_path}],
+                "resources": {"requests": {"cpu": "50m", "memory": "64Mi"}},
+            }
+        ]
+        init_containers = []
+        if spec.enable_safe_load:
+            init_containers.append(
+                {
+                    "name": "safe-load-gate",
+                    "image": spec.full_image,
+                    # Sets the safe-load annotation then blocks until the
+                    # state machine removes it (drain done).
+                    "command": [
+                        "/bin/sh", "-c",
+                        f"safe-load-gate --annotation "
+                        f"{self.keys.safe_driver_load_annotation}",
+                    ],
+                    "env": [
+                        {"name": "NODE_NAME",
+                         "valueFrom": {"fieldRef": {"fieldPath": "spec.nodeName"}}},
+                    ],
+                }
+            )
+        ds.spec["template"] = {
+            "metadata": {"labels": pod_labels},
+            "spec": {
+                "nodeSelector": {},
+                # Run only on TPU nodes; tolerate the TPU taint and stay
+                # resident through cordons (DaemonSet pods always do).
+                "affinity": {
+                    "nodeAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": {
+                            "nodeSelectorTerms": [
+                                {"matchExpressions": [
+                                    {"key": GKE_TPU_ACCELERATOR_LABEL,
+                                     "operator": "Exists"}
+                                ]}
+                            ]
+                        }
+                    }
+                },
+                "tolerations": [
+                    {"key": TPU_RESOURCE, "operator": "Exists",
+                     "effect": "NoSchedule"},
+                    {"operator": "Exists", "effect": "NoExecute"},
+                ],
+                "priorityClassName": "system-node-critical",
+                "hostPID": True,
+                "initContainers": init_containers,
+                "containers": containers,
+                "volumes": [
+                    {"name": "host-lib",
+                     "hostPath": {"path": spec.host_lib_path,
+                                  "type": "DirectoryOrCreate"}},
+                ],
+            },
+        }
+        return ds
+
+    def apply(self) -> DaemonSet:
+        """Create or update the installer DaemonSet (a version bump here is
+        what kicks off a rolling upgrade via the state machine)."""
+        desired = self.build_daemonset()
+        existing = self.client.get_or_none(
+            "DaemonSet", desired.name, desired.namespace
+        )
+        if existing is None:
+            log.info("creating %s DaemonSet (libtpu %s)", self.name, self.spec.version)
+            return DaemonSet(self.client.create(desired).raw)
+
+        def attempt():
+            fresh = self.client.get("DaemonSet", desired.name, desired.namespace)
+            update = desired.deep_copy()
+            update.metadata["resourceVersion"] = fresh.resource_version
+            # Preserve server-side status.
+            return self.client.update(update)
+
+        log.info("updating %s DaemonSet to libtpu %s", self.name, self.spec.version)
+        return DaemonSet(retry_on_conflict(attempt).raw)
+
+    def delete(self) -> bool:
+        try:
+            self.client.delete("DaemonSet", self.name, self.spec.namespace)
+            return True
+        except NotFoundError:
+            return False

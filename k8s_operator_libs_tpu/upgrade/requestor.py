@@ -64,21 +64,22 @@ class RequestorOptions:
                 os.environ.get("MAINTENANCE_OPERATOR_REQUESTOR_ID")
                 or RequestorOptions.requestor_id
             ),
-            namespace=os.environ.get(
-                "MAINTENANCE_OPERATOR_REQUESTOR_NAMESPACE", "default"
+            # Set-but-empty env vars fall back too (reference:
+            # upgrade_requestor.go:533-545) — an empty prefix would produce
+            # invalid CR names like "-node-0".
+            namespace=(
+                os.environ.get("MAINTENANCE_OPERATOR_REQUESTOR_NAMESPACE")
+                or "default"
             ),
-            node_maintenance_name_prefix=os.environ.get(
-                "MAINTENANCE_OPERATOR_NODE_MAINTENANCE_PREFIX",
-                DEFAULT_NODE_MAINTENANCE_NAME_PREFIX,
+            node_maintenance_name_prefix=(
+                os.environ.get("MAINTENANCE_OPERATOR_NODE_MAINTENANCE_PREFIX")
+                or DEFAULT_NODE_MAINTENANCE_NAME_PREFIX
             ),
         )
 
     def to_state_options(self) -> StateOptions:
         return StateOptions(
             use_maintenance_operator=self.use_maintenance_operator,
-            maintenance_namespace=self.namespace,
-            requestor_id=self.requestor_id,
-            node_maintenance_name_prefix=self.node_maintenance_name_prefix,
         )
 
 

@@ -40,13 +40,12 @@ class BuildStateError(Exception):
 
 @dataclass
 class StateOptions:
-    """(reference: upgrade_state.go:94-96; RequestorOptions
-    upgrade_requestor.go:68-82)"""
+    """Mode switches read by the orchestrator (reference:
+    upgrade_state.go:94-96). Requestor-specific configuration lives on
+    RequestorOptions — the requestor strategy is the single owner of those
+    values."""
 
     use_maintenance_operator: bool = False
-    maintenance_namespace: str = "default"
-    requestor_id: str = "tpu.operator.dev"
-    node_maintenance_name_prefix: str = ""
 
 
 class ClusterUpgradeStateManager:

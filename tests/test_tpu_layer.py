@@ -1,0 +1,260 @@
+"""TPU device-class tests: node detection, slice-aware planning, libtpu
+DaemonSet management. Pure control-plane — no JAX needed."""
+
+import pytest
+
+from k8s_operator_libs_tpu.api import DriverUpgradePolicySpec
+from k8s_operator_libs_tpu.kube import DaemonSet, FakeCluster
+from k8s_operator_libs_tpu.kube.sim import DaemonSetSimulator
+from k8s_operator_libs_tpu.parallel.topology import (
+    GKE_NODEPOOL_LABEL,
+    GKE_TPU_ACCELERATOR_LABEL,
+    GKE_TPU_TOPOLOGY_LABEL,
+)
+from k8s_operator_libs_tpu.tpu import (
+    LibtpuDaemonSetManager,
+    LibtpuSpec,
+    TpuNodeDetector,
+    enable_slice_aware_planning,
+)
+from k8s_operator_libs_tpu.upgrade import (
+    ClusterUpgradeStateManager,
+    DeviceClass,
+    TaskRunner,
+    UpgradeKeys,
+)
+from k8s_operator_libs_tpu.utils import IntOrString
+from builders import make_node
+
+DEVICE = DeviceClass.tpu()
+KEYS = UpgradeKeys(DEVICE)
+NS = "driver-ns"
+LABELS = {"app": "driver"}
+
+
+def tpu_labels(pool: str, topology: str = "4x4") -> dict[str, str]:
+    return {
+        GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+        GKE_TPU_TOPOLOGY_LABEL: topology,
+        GKE_NODEPOOL_LABEL: pool,
+    }
+
+
+class TestDetector:
+    def test_detects_v5e_node(self):
+        node = make_node("n1", labels=tpu_labels("pool-a"))
+        det = TpuNodeDetector()
+        assert det.is_tpu_node(node)
+        info = det.detect(node)
+        assert info is not None
+        assert info.slice_id == "pool-a"
+        assert info.topology.total_chips == 16
+        assert info.topology.num_hosts == 4
+
+    def test_non_tpu_node(self):
+        node = make_node("n1", labels={"foo": "bar"})
+        det = TpuNodeDetector()
+        assert not det.is_tpu_node(node)
+        assert det.detect(node) is None
+
+    def test_explicit_slice_label_wins(self):
+        labels = tpu_labels("pool-a")
+        labels["tpu-operator.dev/slice-id"] = "slice-7"
+        info = TpuNodeDetector().detect(make_node("n1", labels=labels))
+        assert info.slice_id == "slice-7"
+
+    def test_group_by_slice(self):
+        det = TpuNodeDetector()
+        nodes = [
+            make_node("a0", labels=tpu_labels("pool-a")),
+            make_node("a1", labels=tpu_labels("pool-a")),
+            make_node("b0", labels=tpu_labels("pool-b")),
+            make_node("plain"),
+        ]
+        groups = det.group_by_slice(nodes)
+        assert {k: len(v) for k, v in groups.items()} == {
+            "pool-a": 2, "pool-b": 1, "plain": 1,
+        }
+
+    def test_unknown_accelerator_still_tpu(self):
+        node = make_node(
+            "n1",
+            labels={
+                GKE_TPU_ACCELERATOR_LABEL: "tpu-v9-hyperslice",
+                GKE_TPU_TOPOLOGY_LABEL: "2x2",
+            },
+        )
+        info = TpuNodeDetector().detect(node)
+        assert info is not None
+        assert info.topology.total_chips == 4
+
+
+def make_tpu_harness(pools, node_states=None):
+    """pools: dict slice_id -> node count. All nodes host driver pods."""
+    cluster = FakeCluster()
+    idx = 0
+    for pool, count in pools.items():
+        for i in range(count):
+            labels = tpu_labels(pool, topology="2x2")
+            if node_states and node_states.get(f"{pool}-{i}"):
+                labels[KEYS.state_label] = node_states[f"{pool}-{i}"]
+            cluster.create(make_node(f"{pool}-{i}", labels=labels))
+            idx += 1
+    sim = DaemonSetSimulator(cluster, name="driver", namespace=NS, match_labels=LABELS)
+    sim.settle()
+    mgr = ClusterUpgradeStateManager(cluster, DEVICE, runner=TaskRunner(inline=True))
+    enable_slice_aware_planning(mgr)
+    return cluster, sim, mgr
+
+
+def states(cluster):
+    return {
+        n.name: n.labels.get(KEYS.state_label, "") for n in cluster.list("Node")
+    }
+
+
+class TestSliceAwarePlanner:
+    def test_whole_slice_starts_together(self):
+        cluster, sim, mgr = make_tpu_harness({"pool-a": 2, "pool-b": 2})
+        sim.set_template_hash("rev-2")
+        policy = DriverUpgradePolicySpec(
+            auto_upgrade=True, max_parallel_upgrades=1,
+            max_unavailable=IntOrString(1),
+        )
+        mgr.apply_state(mgr.build_state(NS, LABELS), policy)  # unknown->required
+        mgr.apply_state(mgr.build_state(NS, LABELS), policy)  # slice selection
+        st = states(cluster)
+        # Exactly ONE slice (both its nodes) moved to cordon-required.
+        cordoned_pools = {
+            name.rsplit("-", 1)[0]
+            for name, s in st.items() if s == "cordon-required"
+        }
+        assert len(cordoned_pools) == 1
+        pool = cordoned_pools.pop()
+        assert st[f"{pool}-0"] == "cordon-required"
+        assert st[f"{pool}-1"] == "cordon-required"
+
+    def test_budget_counts_slices_not_nodes(self):
+        # 2 slices of 2 nodes; maxUnavailable=1 (slice!) must allow both
+        # nodes of one slice at once but never touch the second slice.
+        cluster, sim, mgr = make_tpu_harness({"pool-a": 2, "pool-b": 2})
+        sim.set_template_hash("rev-2")
+        policy = DriverUpgradePolicySpec(
+            auto_upgrade=True, max_parallel_upgrades=0,
+            max_unavailable=IntOrString(1),
+        )
+        mgr.apply_state(mgr.build_state(NS, LABELS), policy)
+        mgr.apply_state(mgr.build_state(NS, LABELS), policy)
+        st = states(cluster)
+        pools_started = {
+            name.rsplit("-", 1)[0]
+            for name, s in st.items() if s == "cordon-required"
+        }
+        assert len(pools_started) == 1
+
+    def test_disrupted_slice_preferred(self):
+        cluster, sim, mgr = make_tpu_harness({"pool-a": 2, "pool-b": 2})
+        # pool-b already has one cordoned node -> its slice is disrupted.
+        cluster.patch("Node", "pool-b-0", patch={"spec": {"unschedulable": True}})
+        sim.set_template_hash("rev-2")
+        policy = DriverUpgradePolicySpec(
+            auto_upgrade=True, max_parallel_upgrades=1,
+            max_unavailable=IntOrString(1),
+        )
+        mgr.apply_state(mgr.build_state(NS, LABELS), policy)
+        mgr.apply_state(mgr.build_state(NS, LABELS), policy)
+        st = states(cluster)
+        # The disrupted slice proceeds (even though budget is consumed by
+        # its own unavailability); the healthy slice waits.
+        assert st["pool-b-0"] == "cordon-required"
+        assert st["pool-b-1"] == "cordon-required"
+        assert st["pool-a-0"] == "upgrade-required"
+        assert st["pool-a-1"] == "upgrade-required"
+
+    def test_full_roll_one_slice_at_a_time(self):
+        cluster, sim, mgr = make_tpu_harness({"pool-a": 2, "pool-b": 2})
+        sim.set_template_hash("rev-2")
+        policy = DriverUpgradePolicySpec(
+            auto_upgrade=True, max_parallel_upgrades=1,
+            max_unavailable=IntOrString(1),
+        )
+        det = TpuNodeDetector()
+        max_disrupted_slices = 0
+        for _ in range(40):
+            sim.step()
+            mgr.apply_state(mgr.build_state(NS, LABELS), policy)
+            sim.step()
+            # Count disrupted slices (any node cordoned/not-ready).
+            groups = det.group_by_slice(
+                [type(n)(n.raw) for n in cluster.list("Node")]
+            )
+            disrupted = sum(
+                1 for nodes in groups.values()
+                if any(n.raw["spec"].get("unschedulable") for n in nodes)
+            )
+            max_disrupted_slices = max(max_disrupted_slices, disrupted)
+            if all(s == "upgrade-done" for s in states(cluster).values()):
+                break
+        assert all(s == "upgrade-done" for s in states(cluster).values())
+        assert max_disrupted_slices == 1
+        assert sim.all_pods_ready_and_current()
+
+    def test_non_tpu_nodes_degrade_to_per_node(self):
+        cluster, sim, mgr = make_tpu_harness({})
+        for i in range(3):
+            cluster.create(make_node(f"plain-{i}"))
+        sim.settle()  # pods land at the current revision first
+        sim.set_template_hash("rev-2")  # ...then go stale
+        policy = DriverUpgradePolicySpec(
+            auto_upgrade=True, max_parallel_upgrades=1,
+            max_unavailable=IntOrString(1),
+        )
+        mgr.apply_state(mgr.build_state(NS, LABELS), policy)
+        mgr.apply_state(mgr.build_state(NS, LABELS), policy)
+        st = states(cluster)
+        assert list(st.values()).count("cordon-required") == 1
+
+
+class TestLibtpuDaemonSet:
+    def test_build_shape(self):
+        spec = LibtpuSpec(version="1.2.3")
+        mgr = LibtpuDaemonSetManager(FakeCluster(), spec)
+        ds = mgr.build_daemonset()
+        tmpl = ds.spec["template"]["spec"]
+        assert tmpl["initContainers"][0]["name"] == "safe-load-gate"
+        assert KEYS.safe_driver_load_annotation in " ".join(
+            tmpl["initContainers"][0]["command"]
+        )
+        assert any(
+            t.get("key") == "google.com/tpu" for t in tmpl["tolerations"]
+        )
+        sel = tmpl["affinity"]["nodeAffinity"][
+            "requiredDuringSchedulingIgnoredDuringExecution"
+        ]["nodeSelectorTerms"][0]["matchExpressions"][0]
+        assert sel["key"] == GKE_TPU_ACCELERATOR_LABEL
+        assert ds.spec["template"]["metadata"]["labels"]["version"] == "1.2.3"
+
+    def test_apply_create_then_update(self):
+        cluster = FakeCluster()
+        mgr = LibtpuDaemonSetManager(cluster, LibtpuSpec(version="1.0.0"))
+        ds1 = mgr.apply()
+        assert ds1.uid
+        mgr2 = LibtpuDaemonSetManager(cluster, LibtpuSpec(version="2.0.0"))
+        ds2 = mgr2.apply()
+        assert ds2.uid == ds1.uid  # updated, not recreated
+        stored = DaemonSet(
+            cluster.get("DaemonSet", mgr2.name, "kube-system").raw
+        )
+        assert stored.spec["template"]["metadata"]["labels"]["version"] == "2.0.0"
+
+    def test_disable_safe_load(self):
+        spec = LibtpuSpec(version="1.0.0", enable_safe_load=False)
+        ds = LibtpuDaemonSetManager(FakeCluster(), spec).build_daemonset()
+        assert ds.spec["template"]["spec"]["initContainers"] == []
+
+    def test_delete(self):
+        cluster = FakeCluster()
+        mgr = LibtpuDaemonSetManager(cluster, LibtpuSpec(version="1.0.0"))
+        mgr.apply()
+        assert mgr.delete() is True
+        assert mgr.delete() is False
