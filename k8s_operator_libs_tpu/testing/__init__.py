@@ -1,0 +1,30 @@
+"""First-class test doubles for the five injectable manager interfaces.
+
+Parity surface: the reference ships mockery-generated testify mocks as a
+public package (reference: pkg/upgrade/mocks/{CordonManager,DrainManager,
+NodeUpgradeStateProvider,PodManager,ValidationManager}.go) so consumer
+operators can unit-test their reconcile loops without a cluster. This package
+is the same contract, Python-idiomatic: recording mocks with configurable
+outcomes plus a stateful provider mock that mutates in-memory node labels the
+way the reference suite's fake does (reference: upgrade_suit_test.go:114-130).
+"""
+
+from .mocks import (
+    Call,
+    MockCordonManager,
+    MockDrainManager,
+    MockNodeUpgradeStateProvider,
+    MockPodManager,
+    MockValidationManager,
+    install_mocks,
+)
+
+__all__ = [
+    "Call",
+    "MockCordonManager",
+    "MockDrainManager",
+    "MockNodeUpgradeStateProvider",
+    "MockPodManager",
+    "MockValidationManager",
+    "install_mocks",
+]

@@ -1,0 +1,67 @@
+"""The shipped manifests/ CRDs are valid and drive end-to-end through
+crdutil — the TPU analog of the reference booting envtest from its checked-in
+fixture (reference: upgrade_suit_test.go:87-89,
+hack/crd/bases/maintenance.nvidia.com_nodemaintenances.yaml) and of
+examples/apply-crds as crdutil's e2e driver (reference:
+examples/apply-crds/main.go:34-61).
+"""
+
+import os
+
+from k8s_operator_libs_tpu.crdutil import parse_crds_from_file, process_crds
+from k8s_operator_libs_tpu.kube import FakeCluster, NodeMaintenance
+
+MANIFESTS = os.path.join(os.path.dirname(__file__), "..", "manifests", "crds")
+
+
+def test_manifests_apply_and_establish():
+    cluster = FakeCluster()
+    count = process_crds(cluster, [MANIFESTS], "apply")
+    assert count == 2
+    for name in (
+        "tpuupgradepolicies.tpu-operator.dev",
+        "nodemaintenances.maintenance.nvidia.com",
+    ):
+        assert cluster.get("CustomResourceDefinition", name).is_established()
+
+
+def test_nodemaintenance_fixture_matches_protocol_surface():
+    """Every field the requestor protocol reads/writes exists in the CRD
+    schema — the fixture can't drift from the code silently."""
+    path = os.path.join(MANIFESTS, "nodemaintenances.yaml")
+    (crd,) = parse_crds_from_file(path)
+    assert crd.raw["spec"]["group"] == "maintenance.nvidia.com"
+    version = crd.raw["spec"]["versions"][0]
+    assert (
+        f"{crd.raw['spec']['group']}/{version['name']}"
+        == NodeMaintenance.API_VERSION
+    )
+    props = version["schema"]["openAPIV3Schema"]["properties"]
+    spec_props = props["spec"]["properties"]
+    for field in (
+        "nodeName",
+        "requestorID",
+        "additionalRequestors",
+        "waitForPodCompletion",
+        "drainSpec",
+    ):
+        assert field in spec_props, field
+    drain_props = spec_props["drainSpec"]["properties"]
+    for field in (
+        "force",
+        "podSelector",
+        "timeoutSeconds",
+        "deleteEmptyDir",
+        "podEvictionFilters",
+    ):
+        assert field in drain_props, field
+    assert "conditions" in props["status"]["properties"]
+
+
+def test_nodemaintenance_fixture_delete_tolerates_absence():
+    cluster = FakeCluster()
+    # Delete-before-apply must not fail (reference: crdutil.go:252-272).
+    process_crds(cluster, [MANIFESTS], "delete")
+    process_crds(cluster, [MANIFESTS], "apply")
+    process_crds(cluster, [MANIFESTS], "delete")
+    assert cluster.list("CustomResourceDefinition") == []
