@@ -134,6 +134,12 @@ def ppermute_ring(
 
     try:
         x = jnp.arange(n * elems, dtype=jnp.float32).reshape(n * elems)
+        # Pre-shard the payload onto the mesh: timing an unsharded input
+        # would fold the initial scatter from the default device into every
+        # sample and understate ring bandwidth.
+        x = jax.device_put(
+            x, jax.sharding.NamedSharding(mesh, P(axis))
+        )
         elapsed = _timed(lambda: hop(x))
         # Correctness: n hops return every shard to its origin.
         y = x

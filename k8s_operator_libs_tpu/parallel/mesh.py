@@ -60,17 +60,15 @@ def build_mesh(
     one carrying the heaviest communication so it rides the shortest ICI
     hops.
     """
-    sizes0 = list(axes.values())
-    needed = math.prod(sizes0)
-    devs = list(devices) if devices is not None else available_devices(needed)
     sizes = list(axes.values())
-    count = math.prod(sizes)
-    if count > len(devs):
+    needed = math.prod(sizes)
+    devs = list(devices) if devices is not None else available_devices(needed)
+    if needed > len(devs):
         raise ValueError(
-            f"mesh axes {dict(axes)} need {count} devices, "
+            f"mesh axes {dict(axes)} need {needed} devices, "
             f"only {len(devs)} available"
         )
-    grid = np.array(devs[:count]).reshape(sizes)
+    grid = np.array(devs[:needed]).reshape(sizes)
     return Mesh(grid, axis_names=tuple(axes.keys()))
 
 

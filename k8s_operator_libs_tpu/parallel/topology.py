@@ -69,7 +69,10 @@ def parse_topology(topology: str) -> tuple[int, ...]:
 class SliceTopology:
     """One ICI slice: accelerator generation + chip grid + host layout."""
 
-    accelerator: TpuAccelerator
+    #: Known generations are TpuAccelerator members; an unrecognized GKE
+    #: label value is preserved verbatim as a plain str rather than being
+    #: misreported as some known generation.
+    accelerator: TpuAccelerator | str
     topology: tuple[int, ...]
     chips_per_host: int
 
@@ -82,9 +85,10 @@ class SliceTopology:
         try:
             acc = TpuAccelerator(acc_raw)
         except ValueError:
-            # Unknown generation: still a TPU node; assume 4 chips/host.
+            # Unknown generation: still a TPU node; keep the raw label and
+            # assume the common 4-chips/host GKE machine shape.
             return SliceTopology(
-                accelerator=TpuAccelerator.V5E,
+                accelerator=acc_raw,
                 topology=parse_topology(
                     labels.get(GKE_TPU_TOPOLOGY_LABEL, "1x1")
                 ),
@@ -130,4 +134,4 @@ class SliceTopology:
 
     def __str__(self) -> str:  # pragma: no cover - debug aid
         dims = "x".join(str(d) for d in self.topology)
-        return f"{self.accelerator.value}:{dims} ({self.num_hosts} hosts)"
+        return f"{self.accelerator}:{dims} ({self.num_hosts} hosts)"

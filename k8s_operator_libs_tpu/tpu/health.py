@@ -74,6 +74,10 @@ class IciHealthGate:
         self.use_pallas_matmul = use_pallas_matmul
         self.run_burnin = run_burnin
         self.devices = devices
+        # (step, params, batch) keyed by the device set: the burn-in program
+        # is identical across gate runs, so re-jitting it per validation
+        # call would pay a full XLA compile for every node of every pass.
+        self._burnin_cache: dict[tuple, tuple] = {}
 
     def run(self) -> HealthReport:
         start = time.perf_counter()
@@ -136,19 +140,29 @@ class IciHealthGate:
             from ..models.burnin import BurninConfig, make_sharded_train_step
             from ..parallel.mesh import build_mesh
 
-            n = mesh.devices.size
-            tp = 2 if n % 2 == 0 and n > 1 else 1
-            burn_mesh = build_mesh(
-                {"dp": n // tp, "tp": tp},
-                devices=list(mesh.devices.flat),
-            )
-            cfg = BurninConfig(
-                d_model=64, n_heads=4, d_ff=128, n_layers=1,
-                seq_len=32, batch=max(2, (n // tp) * 2),
-            )
-            step, params, batch = make_sharded_train_step(burn_mesh, cfg)
-            params, loss1 = step(params, batch)
-            _, loss2 = step(params, batch)
+            devices = list(mesh.devices.flat)
+            cache_key = tuple(d.id for d in devices)
+            if cache_key in self._burnin_cache:
+                step, params, batch = self._burnin_cache[cache_key]
+            else:
+                n = mesh.devices.size
+                tp = 2 if n % 2 == 0 and n > 1 else 1
+                burn_mesh = build_mesh({"dp": n // tp, "tp": tp}, devices=devices)
+                cfg = BurninConfig(
+                    d_model=64, n_heads=4, d_ff=128, n_layers=1,
+                    seq_len=32, batch=max(2, (n // tp) * 2),
+                )
+                step, params, batch = make_sharded_train_step(burn_mesh, cfg)
+                self._burnin_cache[cache_key] = (step, params, batch)
+            try:
+                params, loss1 = step(params, batch)
+                _, loss2 = step(params, batch)
+            except Exception:
+                # A cached executable can outlive its backend (e.g. the
+                # runtime this operator itself restarts); drop the entry so
+                # the next run rebuilds instead of failing forever.
+                self._burnin_cache.pop(cache_key, None)
+                raise
             l1, l2 = float(np.asarray(loss1)), float(np.asarray(loss2))
             return np.isfinite(l1) and np.isfinite(l2) and l2 < l1
         except Exception as e:  # noqa: BLE001 - any crash = unhealthy node

@@ -89,6 +89,14 @@ class SliceAwareInplaceManager(InplaceNodeStateManager):
                 if bucket == UpgradeState.UPGRADE_REQUIRED:
                     candidate_nodes.setdefault(slice_id, []).append(ns)
 
+        # A slice whose nodes have entered the pipeline (cordon-required
+        # onward) is disrupted even before the cordon lands — the base
+        # manager counts CORDON_REQUIRED nodes as unavailable for exactly
+        # this reason (common_manager.go:762-764); dropping that here would
+        # let consecutive passes start a new slice while the previous one is
+        # still between the label write and the cordon.
+        disrupted_slices = unavailable_slices | in_progress_slices
+
         # Parallel-slice budget (shape parity with GetUpgradesAvailable,
         # common_manager.go:748-776, in slice units).
         if policy.max_parallel_upgrades == 0:
@@ -97,7 +105,7 @@ class SliceAwareInplaceManager(InplaceNodeStateManager):
             available = policy.max_parallel_upgrades - len(in_progress_slices)
         if available > max_unavailable:
             available = max_unavailable
-        currently_unavailable = len(unavailable_slices)
+        currently_unavailable = len(disrupted_slices)
         if currently_unavailable >= max_unavailable:
             available = 0
         elif (
@@ -116,7 +124,7 @@ class SliceAwareInplaceManager(InplaceNodeStateManager):
         # Already-disrupted slices first: their collective is down anyway.
         ordered = sorted(
             candidate_nodes.items(),
-            key=lambda item: (item[0] not in unavailable_slices, item[0]),
+            key=lambda item: (item[0] not in disrupted_slices, item[0]),
         )
         for slice_id, members in ordered:
             # Per-node bookkeeping shared with the base planner.
@@ -134,7 +142,7 @@ class SliceAwareInplaceManager(InplaceNodeStateManager):
                 startable.append(ns)
             if not startable:
                 continue
-            already_disrupted = slice_id in unavailable_slices
+            already_disrupted = slice_id in disrupted_slices
             if available <= 0 and not already_disrupted:
                 continue
             # Start the WHOLE slice: one disruption window per slice.

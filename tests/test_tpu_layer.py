@@ -87,6 +87,9 @@ class TestDetector:
         info = TpuNodeDetector().detect(node)
         assert info is not None
         assert info.topology.total_chips == 4
+        # The unknown generation label is preserved verbatim, not mapped to
+        # some known generation.
+        assert info.topology.accelerator == "tpu-v9-hyperslice"
 
 
 def make_tpu_harness(pools, node_states=None):
@@ -197,6 +200,39 @@ class TestSliceAwarePlanner:
                 break
         assert all(s == "upgrade-done" for s in states(cluster).values())
         assert max_disrupted_slices == 1
+        assert sim.all_pods_ready_and_current()
+
+    def test_unlimited_parallel_still_respects_slice_budget(self):
+        # Regression: with max_parallel_upgrades=0 (unlimited) the budget
+        # clamp must count slices that are committed to the pipeline
+        # (cordon-required label written, cordon not yet landed) as
+        # disrupted. Before the fix, pass N started slice A, pass N+1 saw
+        # unavailable_slices empty and started slice B — two slices down
+        # at once under maxUnavailable=1.
+        cluster, sim, mgr = make_tpu_harness(
+            {"pool-a": 2, "pool-b": 2, "pool-c": 2}
+        )
+        sim.set_template_hash("rev-2")
+        policy = DriverUpgradePolicySpec(
+            auto_upgrade=True, max_parallel_upgrades=0,
+            max_unavailable=IntOrString(1),
+        )
+        max_pipeline_slices = 0
+        for _ in range(60):
+            sim.step()
+            mgr.apply_state(mgr.build_state(NS, LABELS), policy)
+            sim.step()
+            st = states(cluster)
+            in_pipeline = {
+                name.rsplit("-", 1)[0]
+                for name, s in st.items()
+                if s not in ("", "upgrade-done", "upgrade-required")
+            }
+            max_pipeline_slices = max(max_pipeline_slices, len(in_pipeline))
+            if all(s == "upgrade-done" for s in st.values()):
+                break
+        assert all(s == "upgrade-done" for s in states(cluster).values())
+        assert max_pipeline_slices == 1
         assert sim.all_pods_ready_and_current()
 
     def test_non_tpu_nodes_degrade_to_per_node(self):
