@@ -157,13 +157,15 @@ class IciHealthGate:
             try:
                 params, loss1 = step(params, batch)
                 _, loss2 = step(params, batch)
+                # Materialize inside the try: dispatch is async, so a stale
+                # executable's runtime error can surface only here.
+                l1, l2 = float(np.asarray(loss1)), float(np.asarray(loss2))
             except Exception:
                 # A cached executable can outlive its backend (e.g. the
                 # runtime this operator itself restarts); drop the entry so
                 # the next run rebuilds instead of failing forever.
                 self._burnin_cache.pop(cache_key, None)
                 raise
-            l1, l2 = float(np.asarray(loss1)), float(np.asarray(loss2))
             return np.isfinite(l1) and np.isfinite(l2) and l2 < l1
         except Exception as e:  # noqa: BLE001 - any crash = unhealthy node
             log.error("burn-in failed: %s", e)
