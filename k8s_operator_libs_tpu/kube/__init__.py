@@ -23,6 +23,9 @@ from .fake import FakeCluster, merge_patch
 from .cache import CachedClient
 from .drain import DrainConfig, DrainError, DrainHelper, DrainTimeoutError
 from .events import EventRecorder, FakeRecorder
+from .resources import ResourceInfo, register_resource, resource_for_kind
+from .rest import RestClient, RestConfig, RestConfigError
+from .apiserver import LocalApiServer
 
 __all__ = [
     "AlreadyExistsError",
@@ -44,12 +47,19 @@ __all__ = [
     "InvalidError",
     "KubeObject",
     "LabelSelector",
+    "LocalApiServer",
     "merge_patch",
     "Node",
     "NodeMaintenance",
     "NotFoundError",
     "parse_selector",
     "Pod",
+    "register_resource",
+    "resource_for_kind",
+    "ResourceInfo",
+    "RestClient",
+    "RestConfig",
+    "RestConfigError",
     "retry_on_conflict",
     "wrap",
 ]

@@ -1,0 +1,309 @@
+"""LocalApiServer — the in-memory apiserver served over real HTTP.
+
+The reference's test strategy is "the cluster is real, the cluster is local":
+envtest boots a genuine kube-apiserver + etcd with no nodes
+(reference: pkg/upgrade/upgrade_suit_test.go:87-93, Makefile:76-78). This is
+the equivalent harness: ``FakeCluster`` (finalizers, optimistic concurrency,
+merge-patch, CRD establishment) exposed with Kubernetes REST conventions so
+``RestClient`` — and any kubeconfig-speaking tool — exercises the genuine
+wire path: URLs, verbs, selectors as query params, Status errors, the
+eviction subresource, and bearer-token auth.
+
+Also a deployment artifact, not only a fixture: ``python -m
+k8s_operator_libs_tpu.kube.apiserver --port 8001`` serves a scratch cluster
+for demos of the apply-crds CLI and the upgrade controller.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import ssl
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+from .client import ApiError, NotFoundError
+from .fake import FakeCluster
+from .objects import wrap
+from .resources import resource_for_plural
+
+_PATH_RE = re.compile(
+    r"^/(?:api|apis)(?:/(?P<group>[^/]+(?:\.[^/]+)*))?/(?P<version>v[^/]+)"
+    r"(?:/namespaces/(?P<namespace>[^/]+))?"
+    r"/(?P<plural>[^/]+)"
+    r"(?:/(?P<name>[^/]+))?"
+    r"(?:/(?P<subresource>status|eviction))?$"
+)
+
+
+def _status_body(code: int, reason: str, message: str) -> dict[str, Any]:
+    return {
+        "kind": "Status",
+        "apiVersion": "v1",
+        "status": "Failure",
+        "code": code,
+        "reason": reason,
+        "message": message,
+    }
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server: "LocalApiServer"
+
+    # -- helpers -----------------------------------------------------------
+    def _send_json(self, code: int, body: dict[str, Any]) -> None:
+        payload = json.dumps(body).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _send_error(self, e: ApiError) -> None:
+        self._send_json(e.status, _status_body(e.status, e.reason, e.message))
+
+    def _read_body(self) -> dict[str, Any]:
+        if not self._body:
+            return {}
+        return json.loads(self._body)
+
+    def _authorized(self) -> bool:
+        token = self.server.token
+        if not token:
+            return True
+        return self.headers.get("Authorization") == f"Bearer {token}"
+
+    def _route(self):
+        parsed = urllib.parse.urlparse(self.path)
+        m = _PATH_RE.match(parsed.path)
+        if m is None:
+            return None
+        group = m.group("group") or ""
+        # /api/v1 has no group segment; the regex puts "v1" in version there.
+        try:
+            info = resource_for_plural(group, m.group("plural"))
+        except KeyError:
+            return None
+        query = dict(urllib.parse.parse_qsl(parsed.query))
+        return (
+            info,
+            m.group("namespace") or "",
+            m.group("name") or "",
+            m.group("subresource") or "",
+            query,
+        )
+
+    def _handle(self, verb: str) -> None:
+        # Drain the body FIRST, fresh for every request: the handler
+        # instance is reused across keep-alive requests, and replying with
+        # unread body bytes on the socket corrupts the next request.
+        length = int(self.headers.get("Content-Length") or 0)
+        self._body = self.rfile.read(length) if length else b""
+        if not self._authorized():
+            self._send_json(
+                401, _status_body(401, "Unauthorized", "invalid bearer token")
+            )
+            return
+        route = self._route()
+        if route is None:
+            self._send_json(
+                404, _status_body(404, "NotFound", f"no route for {self.path}")
+            )
+            return
+        info, namespace, name, subresource, query = route
+        cluster = self.server.cluster
+        try:
+            getattr(self, f"_do_{verb.lower()}")(
+                cluster, info, namespace, name, subresource, query
+            )
+        except ApiError as e:
+            self._send_error(e)
+        except Exception as e:  # noqa: BLE001 - surfaced as 500 Status
+            self._send_json(500, _status_body(500, "InternalError", str(e)))
+
+    # -- verbs -------------------------------------------------------------
+    def _do_get(self, cluster, info, namespace, name, subresource, query):
+        if name:
+            obj = cluster.get(info.kind, name, namespace)
+            self._send_json(200, obj.raw)
+            return
+        items = cluster.list(
+            info.kind,
+            namespace=namespace,
+            label_selector=query.get("labelSelector") or None,
+            field_selector=query.get("fieldSelector") or None,
+        )
+        self._send_json(
+            200,
+            {
+                "apiVersion": info.api_version,
+                "kind": f"{info.kind}List",
+                "items": [o.raw for o in items],
+            },
+        )
+
+    def _do_post(self, cluster, info, namespace, name, subresource, query):
+        body = self._read_body()
+        if subresource == "eviction":
+            cluster.evict(name, namespace)
+            self._send_json(200, _ok_status())
+            return
+        meta = body.setdefault("metadata", {})
+        if info.namespaced and not meta.get("namespace"):
+            meta["namespace"] = namespace
+        created = cluster.create(wrap(body))
+        self._send_json(201, created.raw)
+
+    def _do_put(self, cluster, info, namespace, name, subresource, query):
+        obj = wrap(self._read_body())
+        if subresource == "status":
+            updated = cluster.update_status(obj)
+        else:
+            updated = cluster.update(obj)
+        self._send_json(200, updated.raw)
+
+    def _do_patch(self, cluster, info, namespace, name, subresource, query):
+        patched = cluster.patch(
+            info.kind, name, namespace, patch=self._read_body()
+        )
+        self._send_json(200, patched.raw)
+
+    def _do_delete(self, cluster, info, namespace, name, subresource, query):
+        if not name:
+            raise NotFoundError("collection delete not supported")
+        cluster.delete(info.kind, name, namespace)
+        self._send_json(200, _ok_status())
+
+    def do_GET(self):  # noqa: N802 - http.server API
+        self._handle("GET")
+
+    def do_POST(self):  # noqa: N802
+        self._handle("POST")
+
+    def do_PUT(self):  # noqa: N802
+        self._handle("PUT")
+
+    def do_PATCH(self):  # noqa: N802
+        self._handle("PATCH")
+
+    def do_DELETE(self):  # noqa: N802
+        self._handle("DELETE")
+
+    def log_message(self, fmt, *args):  # noqa: D102 - silence default logging
+        pass
+
+
+def _ok_status() -> dict[str, Any]:
+    return {
+        "kind": "Status",
+        "apiVersion": "v1",
+        "status": "Success",
+        "code": 200,
+    }
+
+
+class LocalApiServer(ThreadingHTTPServer):
+    """Serve a FakeCluster on 127.0.0.1; use as a context manager in tests."""
+
+    daemon_threads = True
+
+    def __init__(
+        self,
+        cluster: Optional[FakeCluster] = None,
+        port: int = 0,
+        token: str = "",
+        certfile: str = "",
+        keyfile: str = "",
+    ) -> None:
+        super().__init__(("127.0.0.1", port), _Handler)
+        self.cluster = cluster if cluster is not None else FakeCluster()
+        self.token = token
+        self.tls = bool(certfile)
+        if certfile:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(certfile, keyfile or None)
+            self.socket = ctx.wrap_socket(self.socket, server_side=True)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        scheme = "https" if self.tls else "http"
+        return f"{scheme}://127.0.0.1:{self.server_address[1]}"
+
+    def start(self) -> "LocalApiServer":
+        self._thread = threading.Thread(target=self.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.shutdown()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self.server_close()
+        self.cluster.close()
+
+    def __enter__(self) -> "LocalApiServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- kubeconfig emission ----------------------------------------------
+    def write_kubeconfig(self, path: str, ca_file: str = "") -> str:
+        """Write a kubeconfig pointing at this server (envtest does the
+        same for its booted apiserver)."""
+        import yaml
+
+        cluster_entry: dict[str, Any] = {"server": self.url}
+        if self.tls:
+            if ca_file:
+                cluster_entry["certificate-authority"] = ca_file
+            else:
+                cluster_entry["insecure-skip-tls-verify"] = True
+        user: dict[str, Any] = {}
+        if self.token:
+            user["token"] = self.token
+        doc = {
+            "apiVersion": "v1",
+            "kind": "Config",
+            "current-context": "local",
+            "clusters": [{"name": "local", "cluster": cluster_entry}],
+            "users": [{"name": "local-user", "user": user}],
+            "contexts": [
+                {
+                    "name": "local",
+                    "context": {"cluster": "local", "user": "local-user"},
+                }
+            ],
+        }
+        with open(path, "w") as f:
+            yaml.safe_dump(doc, f)
+        return path
+
+
+def main() -> None:  # pragma: no cover - manual demo entry point
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--port", type=int, default=8001)
+    parser.add_argument("--token", default="")
+    parser.add_argument(
+        "--kubeconfig", default="", help="write a kubeconfig to this path"
+    )
+    args = parser.parse_args()
+    server = LocalApiServer(port=args.port, token=args.token)
+    if args.kubeconfig:
+        server.write_kubeconfig(args.kubeconfig)
+        print(f"kubeconfig written to {args.kubeconfig}")
+    print(f"serving in-memory cluster at {server.url}")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -1,0 +1,80 @@
+"""Kind ⇄ REST-resource mapping shared by the REST client and the local
+apiserver.
+
+The reference gets this for free from client-go's scheme + RESTMapper; here a
+small explicit registry covers the kinds the framework touches, with a
+``register_resource`` hook for consumer CRDs (the reference's analog is
+registering types into the package Scheme, upgrade_requestor.go:548-551).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .objects import KINDS
+
+
+@dataclass(frozen=True)
+class ResourceInfo:
+    kind: str
+    api_version: str  # "v1" or "group/version"
+    plural: str
+    namespaced: bool = True
+
+    @property
+    def group(self) -> str:
+        return self.api_version.rpartition("/")[0]
+
+    @property
+    def path_prefix(self) -> str:
+        """URL prefix for this resource's API group."""
+        if "/" in self.api_version:
+            return f"/apis/{self.api_version}"
+        return f"/api/{self.api_version}"
+
+
+_REGISTRY: dict[str, ResourceInfo] = {}
+_BY_PLURAL: dict[tuple[str, str], ResourceInfo] = {}
+
+
+def register_resource(
+    kind: str, api_version: str, plural: str, namespaced: bool = True
+) -> ResourceInfo:
+    info = ResourceInfo(kind, api_version, plural, namespaced)
+    _REGISTRY[kind] = info
+    _BY_PLURAL[(info.group, plural)] = info
+    return info
+
+
+def resource_for_kind(kind: str) -> ResourceInfo:
+    try:
+        return _REGISTRY[kind]
+    except KeyError:
+        raise KeyError(
+            f"kind {kind!r} has no registered REST resource; call "
+            "kube.resources.register_resource(kind, apiVersion, plural)"
+        ) from None
+
+
+def resource_for_plural(group: str, plural: str) -> ResourceInfo:
+    try:
+        return _BY_PLURAL[(group, plural)]
+    except KeyError:
+        raise KeyError(f"no resource for {group!r}/{plural!r}") from None
+
+
+def _bootstrap() -> None:
+    specials = {
+        "CustomResourceDefinition": "customresourcedefinitions",
+        "NodeMaintenance": "nodemaintenances",
+    }
+    for kind, cls in KINDS.items():
+        register_resource(
+            kind,
+            cls.API_VERSION,
+            specials.get(kind, kind.lower() + "s"),
+            cls.NAMESPACED,
+        )
+
+
+_bootstrap()

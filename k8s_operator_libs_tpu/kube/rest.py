@@ -1,0 +1,464 @@
+"""REST client for real clusters: kubeconfig / in-cluster config + the
+``Client`` protocol over the Kubernetes HTTP API.
+
+This is the L0 the reference gets from controller-runtime + client-go
+(reference: pkg/upgrade/common_manager.go:108-116 creates both flavors from a
+``rest.Config``; pkg/crdutil/crdutil.go:61 resolves it via ``ctrl.GetConfig``
+— kubeconfig or in-cluster). Implemented on the standard library only
+(urllib + ssl): no vendored SDK.
+
+Error mapping mirrors apimachinery: HTTP Status ``reason`` drives the typed
+error (NotFound / AlreadyExists / Conflict / Invalid), so
+``retry_on_conflict`` and crdutil's create-or-update work unchanged against a
+real apiserver.
+"""
+
+from __future__ import annotations
+
+import atexit
+import base64
+import http.client
+import json
+import os
+import ssl
+import tempfile
+import threading
+import urllib.parse
+from dataclasses import dataclass, field
+from typing import Any, Mapping, Optional
+
+from .client import (
+    AlreadyExistsError,
+    ApiError,
+    Client,
+    ConflictError,
+    InvalidError,
+    NotFoundError,
+)
+from .objects import KubeObject, wrap
+from .resources import ResourceInfo, resource_for_kind
+
+_SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class RestConfigError(Exception):
+    pass
+
+
+@dataclass
+class RestConfig:
+    """Connection settings resolved from a kubeconfig or the pod filesystem."""
+
+    server: str
+    token: str = ""
+    ca_file: str = ""
+    ca_data: str = ""  # PEM text
+    client_cert_file: str = ""
+    client_key_file: str = ""
+    insecure_skip_tls_verify: bool = False
+    namespace: str = "default"
+    #: Paths of temp files backing *-data kubeconfig fields (private key
+    #: material) — unlinked by close() and, as a backstop, at process exit.
+    _temp_files: list = field(default_factory=list, repr=False)
+
+    def close(self) -> None:
+        """Remove temp files holding decoded client cert/key material."""
+        while self._temp_files:
+            path = self._temp_files.pop()
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    def __del__(self):  # pragma: no cover - GC timing dependent
+        self.close()
+
+    # -- loaders -----------------------------------------------------------
+    @classmethod
+    def from_environment(cls, context: str = "") -> "RestConfig":
+        """In-cluster if the serviceaccount mount exists, else kubeconfig —
+        the resolution order of ctrl.GetConfig (crdutil.go:61)."""
+        errors = []
+        try:
+            return cls.in_cluster()
+        except RestConfigError as e:
+            errors.append(str(e))
+        try:
+            return cls.from_kubeconfig(context=context)
+        except RestConfigError as e:
+            errors.append(str(e))
+        raise RestConfigError("; ".join(errors))
+
+    @classmethod
+    def in_cluster(cls) -> "RestConfig":
+        host = os.environ.get("KUBERNETES_SERVICE_HOST")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        token_path = os.path.join(_SA_DIR, "token")
+        if not host or not os.path.exists(token_path):
+            raise RestConfigError("not running in a cluster")
+        with open(token_path) as f:
+            token = f.read().strip()
+        ns_path = os.path.join(_SA_DIR, "namespace")
+        namespace = "default"
+        if os.path.exists(ns_path):
+            with open(ns_path) as f:
+                namespace = f.read().strip() or "default"
+        return cls(
+            server=f"https://{host}:{port}",
+            token=token,
+            ca_file=os.path.join(_SA_DIR, "ca.crt"),
+            namespace=namespace,
+        )
+
+    @classmethod
+    def from_kubeconfig(
+        cls, path: str = "", context: str = ""
+    ) -> "RestConfig":
+        if path:
+            paths = [path]
+        else:
+            env = os.environ.get("KUBECONFIG", "")
+            paths = [p for p in env.split(os.pathsep) if p] or [
+                os.path.expanduser("~/.kube/config")
+            ]
+        existing = [p for p in paths if os.path.exists(p)]
+        if not existing:
+            raise RestConfigError(
+                f"kubeconfig not found at {os.pathsep.join(paths)}"
+            )
+        doc = _merge_kubeconfigs(existing)
+        path = os.pathsep.join(existing)
+        ctx_name = context or doc.get("current-context", "")
+        ctx = _named(doc, "contexts", ctx_name)
+        if ctx is None:
+            raise RestConfigError(f"context {ctx_name!r} not found in {path}")
+        cluster = _named(doc, "clusters", ctx.get("cluster", ""))
+        if cluster is None:
+            raise RestConfigError(f"cluster for context {ctx_name!r} not found")
+        user = _named(doc, "users", ctx.get("user", "")) or {}
+
+        cfg = cls(
+            server=cluster.get("server", ""),
+            ca_file=cluster.get("certificate-authority", ""),
+            insecure_skip_tls_verify=bool(
+                cluster.get("insecure-skip-tls-verify", False)
+            ),
+            namespace=ctx.get("namespace", "default"),
+        )
+        if not cfg.server:
+            raise RestConfigError(f"cluster in {path} has no server")
+        if cluster.get("certificate-authority-data"):
+            cfg.ca_data = _b64_pem(cluster["certificate-authority-data"])
+        cfg.token = user.get("token", "")
+        if user.get("exec") or user.get("auth-provider"):
+            raise RestConfigError(
+                "exec/auth-provider credential plugins are not supported; "
+                "use a token or client certificates"
+            )
+        cfg.client_cert_file = user.get("client-certificate", "")
+        cfg.client_key_file = user.get("client-key", "")
+        if user.get("client-certificate-data"):
+            cfg.client_cert_file = cfg._temp_pem(
+                _b64_pem(user["client-certificate-data"])
+            )
+        if user.get("client-key-data"):
+            cfg.client_key_file = cfg._temp_pem(_b64_pem(user["client-key-data"]))
+        return cfg
+
+    def _temp_pem(self, pem: str) -> str:
+        # 0600 by default (NamedTemporaryFile); closed immediately, removed
+        # by close() or the atexit backstop.
+        with tempfile.NamedTemporaryFile(
+            mode="w", suffix=".pem", delete=False, prefix="kubecfg-"
+        ) as tf:
+            tf.write(pem)
+            path = tf.name
+        self._temp_files.append(path)
+        atexit.register(_unlink_quiet, path)
+        return path
+
+    # -- TLS ---------------------------------------------------------------
+    def ssl_context(self) -> Optional[ssl.SSLContext]:
+        if not self.server.startswith("https"):
+            return None
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        if self.insecure_skip_tls_verify:
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        elif self.ca_file or self.ca_data:
+            ctx.load_verify_locations(
+                cafile=self.ca_file or None, cadata=self.ca_data or None
+            )
+        else:
+            ctx.load_default_certs()
+        if self.client_cert_file:
+            ctx.load_cert_chain(
+                self.client_cert_file, self.client_key_file or None
+            )
+        return ctx
+
+
+def _merge_kubeconfigs(paths: list[str]) -> dict:
+    """kubectl merge semantics: first occurrence of a named entry wins;
+    current-context comes from the first file that sets one."""
+    import yaml
+
+    merged: dict = {"clusters": [], "contexts": [], "users": []}
+    for p in paths:
+        with open(p) as f:
+            doc = yaml.safe_load(f) or {}
+        if doc.get("current-context") and "current-context" not in merged:
+            merged["current-context"] = doc["current-context"]
+        for section in ("clusters", "contexts", "users"):
+            have = {e.get("name") for e in merged[section]}
+            for entry in doc.get(section) or []:
+                if entry.get("name") not in have:
+                    merged[section].append(entry)
+    return merged
+
+
+def _named(doc: Mapping, section: str, name: str) -> Optional[dict]:
+    for entry in doc.get(section) or []:
+        if entry.get("name") == name:
+            return entry.get(section.rstrip("s"), {})
+    return None
+
+
+def _b64_pem(data: str) -> str:
+    return base64.b64decode(data).decode()
+
+
+def _unlink_quiet(path: str) -> None:
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+
+
+_ERRORS_BY_REASON = {
+    "NotFound": NotFoundError,
+    "AlreadyExists": AlreadyExistsError,
+    "Conflict": ConflictError,
+    "Invalid": InvalidError,
+}
+_ERRORS_BY_CODE = {404: NotFoundError, 409: ConflictError, 422: InvalidError}
+
+
+class RestClient(Client):
+    """The ``Client`` protocol over HTTP. One instance per cluster."""
+
+    def __init__(self, config: RestConfig, timeout: float = 30.0) -> None:
+        self.config = config
+        self.timeout = timeout
+        self._ssl = config.ssl_context()
+        parsed = urllib.parse.urlsplit(config.server)
+        if not parsed.hostname:
+            raise RestConfigError(f"invalid server URL {config.server!r}")
+        self._https = parsed.scheme == "https"
+        self._host = parsed.hostname
+        self._port = parsed.port or (443 if self._https else 80)
+        self._base_path = parsed.path.rstrip("/")
+        # One keep-alive connection per thread: the reconcile loop issues
+        # many serial calls, and async managers run on their own threads.
+        self._local = threading.local()
+
+    @classmethod
+    def from_environment(cls, context: str = "") -> "RestClient":
+        return cls(RestConfig.from_environment(context=context))
+
+    # -- HTTP plumbing -----------------------------------------------------
+    def _connection(self) -> http.client.HTTPConnection:
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            if self._https:
+                conn = http.client.HTTPSConnection(
+                    self._host, self._port,
+                    timeout=self.timeout, context=self._ssl,
+                )
+            else:
+                conn = http.client.HTTPConnection(
+                    self._host, self._port, timeout=self.timeout
+                )
+            self._local.conn = conn
+        return conn
+
+    def _drop_connection(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            conn.close()
+            self._local.conn = None
+
+    def close(self) -> None:
+        """Close this thread's pooled connection and temp credential files."""
+        self._drop_connection()
+        self.config.close()
+
+    def _request(
+        self,
+        method: str,
+        path: str,
+        query: Optional[Mapping[str, str]] = None,
+        body: Optional[Mapping[str, Any]] = None,
+        content_type: str = "application/json",
+    ) -> dict[str, Any]:
+        url = self._base_path + path
+        if query:
+            url += "?" + urllib.parse.urlencode(query)
+        data = json.dumps(body).encode() if body is not None else None
+        headers = {"Accept": "application/json"}
+        if data is not None:
+            headers["Content-Type"] = content_type
+        if self.config.token:
+            headers["Authorization"] = f"Bearer {self.config.token}"
+        for attempt in (0, 1):
+            conn = self._connection()
+            try:
+                conn.request(method, url, body=data, headers=headers)
+            except (http.client.HTTPException, OSError) as e:
+                # A stale keep-alive socket fails on first reuse; nothing
+                # was sent, so any method is safe to retry once fresh.
+                self._drop_connection()
+                if attempt == 0:
+                    continue
+                raise ApiError(f"{method} {url}: {e}") from None
+            try:
+                resp = conn.getresponse()
+                payload = resp.read()
+            except (http.client.HTTPException, OSError) as e:
+                self._drop_connection()
+                # The request may have been processed; only retry methods
+                # that are idempotent (POST create is not).
+                if attempt == 0 and method != "POST":
+                    continue
+                raise ApiError(f"{method} {url}: {e}") from None
+            if resp.will_close:
+                self._drop_connection()
+            break
+        if resp.status >= 400:
+            raise self._api_error(resp.status, payload)
+        if not payload:
+            return {}
+        return json.loads(payload)
+
+    @staticmethod
+    def _api_error(code: int, payload: bytes) -> ApiError:
+        reason, message = "", ""
+        try:
+            status = json.loads(payload)
+            reason = status.get("reason", "")
+            message = status.get("message", "")
+        except Exception:
+            pass
+        cls = _ERRORS_BY_REASON.get(reason) or _ERRORS_BY_CODE.get(code, ApiError)
+        return cls(message or f"HTTP {code}")
+
+    def _path(
+        self, info: ResourceInfo, namespace: str, name: str = ""
+    ) -> str:
+        parts = [info.path_prefix]
+        if info.namespaced:
+            parts.append(f"namespaces/{namespace or self.config.namespace}")
+        parts.append(info.plural)
+        if name:
+            parts.append(name)
+        return "/" + "/".join(p.strip("/") for p in parts if p)
+
+    # -- Client protocol ---------------------------------------------------
+    def get(self, kind: str, name: str, namespace: str = "") -> KubeObject:
+        info = resource_for_kind(kind)
+        return wrap(self._request("GET", self._path(info, namespace, name)))
+
+    def list(
+        self,
+        kind: str,
+        namespace: str = "",
+        label_selector: Optional[str | Mapping[str, str]] = None,
+        field_selector: Optional[str] = None,
+    ) -> list[KubeObject]:
+        info = resource_for_kind(kind)
+        query: dict[str, str] = {}
+        if label_selector:
+            if isinstance(label_selector, Mapping):
+                query["labelSelector"] = ",".join(
+                    f"{k}={v}" for k, v in sorted(label_selector.items())
+                )
+            else:
+                query["labelSelector"] = label_selector
+        if field_selector:
+            query["fieldSelector"] = field_selector
+        if info.namespaced and not namespace:
+            # All-namespaces list: /{prefix}/{plural}
+            path = f"{info.path_prefix}/{info.plural}"
+        else:
+            path = self._path(info, namespace)
+        out = self._request("GET", path, query=query)
+        return [wrap(item) for item in out.get("items") or []]
+
+    def create(self, obj: KubeObject) -> KubeObject:
+        info = resource_for_kind(obj.raw.get("kind", ""))
+        return wrap(
+            self._request(
+                "POST", self._path(info, obj.namespace), body=obj.raw
+            )
+        )
+
+    def update(self, obj: KubeObject) -> KubeObject:
+        info = resource_for_kind(obj.raw.get("kind", ""))
+        return wrap(
+            self._request(
+                "PUT", self._path(info, obj.namespace, obj.name), body=obj.raw
+            )
+        )
+
+    def update_status(self, obj: KubeObject) -> KubeObject:
+        info = resource_for_kind(obj.raw.get("kind", ""))
+        path = self._path(info, obj.namespace, obj.name) + "/status"
+        return wrap(self._request("PUT", path, body=obj.raw))
+
+    def patch(
+        self,
+        kind: str,
+        name: str,
+        namespace: str = "",
+        patch: Optional[Mapping[str, Any]] = None,
+    ) -> KubeObject:
+        info = resource_for_kind(kind)
+        return wrap(
+            self._request(
+                "PATCH",
+                self._path(info, namespace, name),
+                body=dict(patch or {}),
+                content_type="application/merge-patch+json",
+            )
+        )
+
+    def delete(
+        self,
+        kind: str,
+        name: str,
+        namespace: str = "",
+        grace_period_seconds: Optional[int] = None,
+    ) -> None:
+        info = resource_for_kind(kind)
+        query = (
+            {"gracePeriodSeconds": str(grace_period_seconds)}
+            if grace_period_seconds is not None
+            else None
+        )
+        self._request(
+            "DELETE", self._path(info, namespace, name), query=query
+        )
+
+    def evict(self, pod_name: str, namespace: str = "") -> None:
+        """policy/v1 Eviction subresource (what kubectl drain uses)."""
+        info = resource_for_kind("Pod")
+        path = self._path(info, namespace, pod_name) + "/eviction"
+        body = {
+            "apiVersion": "policy/v1",
+            "kind": "Eviction",
+            "metadata": {
+                "name": pod_name,
+                "namespace": namespace or self.config.namespace,
+            },
+        }
+        self._request("POST", path, body=body)
