@@ -1,0 +1,35 @@
+# Build/test harness (SURVEY.md §2 component 19; reference: Makefile:62-93).
+PYTHON ?= python
+
+.PHONY: all lint test bench dryrun demo install
+
+all: lint test
+
+install:
+	$(PYTHON) -m pip install -e . -q --no-deps --no-build-isolation
+
+lint:
+	$(PYTHON) -m compileall -q k8s_operator_libs_tpu tests examples bench.py __graft_entry__.py
+	$(PYTHON) -c "import k8s_operator_libs_tpu"
+
+test:
+	$(PYTHON) -m pytest tests/ -x -q
+
+bench:
+	$(PYTHON) bench.py
+
+dryrun:
+	$(PYTHON) __graft_entry__.py
+
+# End-to-end demo: local apiserver + apply-crds CLI over a real kubeconfig.
+demo:
+	@set -e; \
+	$(PYTHON) -m k8s_operator_libs_tpu.kube.apiserver --port 18001 \
+	    --kubeconfig /tmp/tpu-operator-demo-kubeconfig & \
+	SERVER_PID=$$!; \
+	sleep 1; \
+	KUBECONFIG=/tmp/tpu-operator-demo-kubeconfig $(PYTHON) examples/apply_crds.py \
+	    --crds-path tests/crd_fixtures/crds --operation apply; \
+	KUBECONFIG=/tmp/tpu-operator-demo-kubeconfig $(PYTHON) examples/apply_crds.py \
+	    --crds-path tests/crd_fixtures/crds --operation delete; \
+	kill $$SERVER_PID
