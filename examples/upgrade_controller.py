@@ -1,0 +1,201 @@
+"""upgrade-controller: a reconcile-loop daemon over the upgrade library.
+
+The reference is consumed by operators that call BuildState()/ApplyState()
+from their controller's Reconcile() (SURVEY.md §1 L6; reference:
+pkg/upgrade/upgrade_state.go:35-53). This example is that consumer as a
+standalone daemon: every interval it snapshots the cluster, runs one
+idempotent pass of the state machine, and prints the per-state node counts.
+
+``--demo`` runs the whole thing end to end with zero dependencies: an
+in-memory v5e-16 GKE pool (4 hosts), a simulated libtpu DaemonSet, a version
+bump, and the ICI health gate (real JAX probes on visible devices) gating
+each uncordon — the BASELINE config #5 shape, watchable from a terminal.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import time
+
+# Allow running straight from a checkout without installation.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from k8s_operator_libs_tpu.api import DriverUpgradePolicySpec
+from k8s_operator_libs_tpu.upgrade import (
+    ClusterUpgradeStateManager,
+    DeviceClass,
+    TaskRunner,
+)
+
+
+def parse_selector(raw: str) -> dict[str, str]:
+    labels = {}
+    for part in filter(None, raw.split(",")):
+        key, _, value = part.partition("=")
+        labels[key.strip()] = value.strip()
+    return labels
+
+
+def load_policy(path: str | None) -> DriverUpgradePolicySpec:
+    if path is None:
+        return DriverUpgradePolicySpec(auto_upgrade=True)
+    import yaml
+
+    with open(path) as f:
+        doc = yaml.safe_load(f) or {}
+    return DriverUpgradePolicySpec.from_dict(doc)
+
+
+def state_counts(state) -> str:
+    parts = []
+    for name, nodes in sorted(state.node_states.items()):
+        parts.append(f"{name or 'unknown'}={len(nodes)}")
+    return " ".join(parts) if parts else "(no managed nodes)"
+
+
+def build_demo(args):
+    """In-memory v5e-16 pool + simulated libtpu DaemonSet mid-upgrade."""
+    from k8s_operator_libs_tpu.kube import FakeCluster, Node
+    from k8s_operator_libs_tpu.kube.sim import DaemonSetSimulator
+    from k8s_operator_libs_tpu.parallel.topology import (
+        GKE_NODEPOOL_LABEL,
+        GKE_TPU_ACCELERATOR_LABEL,
+        GKE_TPU_TOPOLOGY_LABEL,
+    )
+
+    cluster = FakeCluster()
+    for i in range(4):
+        node = Node.new(
+            f"v5e-16-pool-{i}",
+            labels={
+                GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+                GKE_TPU_TOPOLOGY_LABEL: "4x4",
+                GKE_NODEPOOL_LABEL: "v5e-16-pool",
+            },
+        )
+        node.set_ready(True)
+        cluster.create(node)
+    sim = DaemonSetSimulator(
+        cluster,
+        name="libtpu-installer",
+        namespace=args.namespace,
+        match_labels=parse_selector(args.selector),
+        initial_hash="libtpu-v1",
+    )
+    sim.settle()
+    sim.set_template_hash("libtpu-v2")  # the update the controller must roll
+    return cluster, sim
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="upgrade-controller", description=__doc__
+    )
+    parser.add_argument("--device", choices=["tpu", "nvidia"], default="tpu")
+    parser.add_argument("--namespace", default="kube-system")
+    parser.add_argument(
+        "--selector",
+        default="app=libtpu-installer",
+        help="driver DaemonSet labels, k=v[,k=v...]",
+    )
+    parser.add_argument(
+        "--policy", help="YAML file with a DriverUpgradePolicySpec", default=None
+    )
+    parser.add_argument("--interval", type=float, default=30.0)
+    parser.add_argument(
+        "--once", action="store_true", help="one reconcile pass, then exit"
+    )
+    parser.add_argument(
+        "--demo",
+        action="store_true",
+        help="roll a simulated v5e-16 libtpu upgrade in-memory, no cluster",
+    )
+    parser.add_argument(
+        "--slice-aware",
+        action="store_true",
+        help="ICI-slice-aware planning (whole slice per disruption window)",
+    )
+    parser.add_argument(
+        "--ici-gate",
+        action="store_true",
+        help="gate uncordon on the JAX ICI/MXU health probes",
+    )
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO, format="%(levelname)s %(message)s")
+
+    device = DeviceClass.tpu() if args.device == "tpu" else DeviceClass.nvidia()
+    policy = load_policy(args.policy)
+    selector = parse_selector(args.selector)
+
+    sim = None
+    if args.demo:
+        client, sim = build_demo(args)
+    else:
+        try:
+            from k8s_operator_libs_tpu.kube.rest import RestClient
+
+            client = RestClient.from_environment()
+        except Exception as e:  # RestConfigError when unconfigured
+            raise SystemExit(
+                f"no cluster access configured ({e}); use --demo for the "
+                "in-memory pool"
+            )
+
+    mgr = ClusterUpgradeStateManager(
+        client, device, runner=TaskRunner(inline=args.demo)
+    )
+    if args.ici_gate or (args.demo and args.device == "tpu"):
+        from k8s_operator_libs_tpu.tpu import IciHealthGate, SliceScopedGate
+
+        gate = IciHealthGate(payload_mb=1.0, matmul_size=1024, run_burnin=True)
+        hook = (
+            SliceScopedGate(gate).validation_hook()
+            if args.slice_aware
+            else gate.validation_hook()
+        )
+        mgr.with_validation_enabled(validation_hook=hook)
+    if args.slice_aware:
+        from k8s_operator_libs_tpu.tpu import enable_slice_aware_planning
+
+        enable_slice_aware_planning(mgr)
+
+    passes = 0
+    max_demo_passes = 100  # a 4-node roll converges in <15; 100 = stuck
+    while True:
+        passes += 1
+        if sim is not None and passes > max_demo_passes:
+            print(
+                f"demo: did not converge within {max_demo_passes} passes",
+                file=sys.stderr,
+            )
+            return 1
+        if sim is not None:
+            sim.step()
+        state = mgr.build_state(args.namespace, selector)
+        mgr.apply_state(state, policy)
+        if sim is not None:
+            sim.step()
+        print(
+            f"pass {passes}: {state_counts(state)} | "
+            f"in-progress={mgr.get_upgrades_in_progress(state)} "
+            f"done={mgr.get_upgrades_done(state)} "
+            f"failed={mgr.get_upgrades_failed(state)}"
+        )
+        if sim is not None:
+            fresh = mgr.build_state(args.namespace, selector)
+            all_done = fresh.node_states and all(
+                s == "upgrade-done" for s in fresh.node_states
+            )
+            if all_done and sim.all_pods_ready_and_current():
+                print(f"demo: rolling upgrade complete in {passes} passes")
+                return 0
+        if args.once:
+            return 0
+        time.sleep(args.interval if sim is None else 0.0)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -84,7 +84,19 @@ def _rms_norm(x: jax.Array, gain: jax.Array) -> jax.Array:
     return (x.astype(jnp.float32) * norm * gain).astype(x.dtype)
 
 
-def _attention(layer: Params, x: jax.Array, cfg: BurninConfig) -> jax.Array:
+def _local_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+    """Causal softmax attention over (b, h, s, head_dim)."""
+    s = q.shape[2]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / (q.shape[-1] ** 0.5)
+    causal = jnp.tril(jnp.ones((s, s), dtype=bool))
+    scores = jnp.where(causal, scores, jnp.finfo(scores.dtype).min)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def _attention(
+    layer: Params, x: jax.Array, cfg: BurninConfig, attn_core=None
+) -> jax.Array:
     b, s, d = x.shape
     qkv = x @ layer["wqkv"]  # (b, s, 3d) — MXU, sharded on tp
     q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -92,12 +104,7 @@ def _attention(layer: Params, x: jax.Array, cfg: BurninConfig) -> jax.Array:
     def heads(t):
         return t.reshape(b, s, cfg.n_heads, cfg.head_dim).transpose(0, 2, 1, 3)
 
-    q, k, v = heads(q), heads(k), heads(v)
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / (cfg.head_dim**0.5)
-    causal = jnp.tril(jnp.ones((s, s), dtype=bool))
-    scores = jnp.where(causal, scores, jnp.finfo(scores.dtype).min)
-    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
-    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    out = (attn_core or _local_attention)(heads(q), heads(k), heads(v))
     out = out.transpose(0, 2, 1, 3).reshape(b, s, d)
     return out @ layer["wo"]  # psum over tp follows this matmul
 
@@ -106,18 +113,34 @@ def _mlp(layer: Params, x: jax.Array) -> jax.Array:
     return jax.nn.gelu(x @ layer["w_up"]) @ layer["w_down"]
 
 
-def forward(params: Params, tokens: jax.Array, cfg: BurninConfig) -> jax.Array:
-    """Token ids (b, s) → logits (b, s, vocab)."""
+def forward(
+    params: Params,
+    tokens: jax.Array,
+    cfg: BurninConfig,
+    attn_core=None,
+) -> jax.Array:
+    """Token ids (b, s) → logits (b, s, vocab).
+
+    ``attn_core`` swaps the attention inner op — the sequence-parallel step
+    passes ``ops.ring_attention`` here so long sequences shard over the
+    ``sp`` mesh axis; everything else in the model is position-local and
+    shards without code changes.
+    """
     x = params["embed"][tokens]
     for layer in params["layers"]:
-        x = x + _attention(layer, _rms_norm(x, layer["ln1"]), cfg)
+        x = x + _attention(layer, _rms_norm(x, layer["ln1"]), cfg, attn_core)
         x = x + _mlp(layer, _rms_norm(x, layer["ln2"]))
     x = _rms_norm(x, params["ln_f"])
     return (x @ params["embed"].T).astype(jnp.float32)
 
 
-def loss_fn(params: Params, batch: dict[str, jax.Array], cfg: BurninConfig) -> jax.Array:
-    logits = forward(params, batch["tokens"], cfg)
+def loss_fn(
+    params: Params,
+    batch: dict[str, jax.Array],
+    cfg: BurninConfig,
+    attn_core=None,
+) -> jax.Array:
+    logits = forward(params, batch["tokens"], cfg, attn_core)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, batch["targets"][..., None], axis=-1)
     return jnp.mean(nll)
@@ -144,15 +167,19 @@ def synthetic_batch(key: jax.Array, cfg: BurninConfig) -> dict[str, jax.Array]:
 # ----------------------------------------------------------------------
 # Sharding
 # ----------------------------------------------------------------------
-def param_specs(cfg: BurninConfig) -> Params:
-    """Megatron-style tensor-parallel PartitionSpecs for the param tree."""
+def param_specs(cfg: BurninConfig, tp_axis: Optional[str] = "tp") -> Params:
+    """Megatron-style tensor-parallel PartitionSpecs for the param tree.
+
+    ``tp_axis=None`` replicates the weights (data/sequence-parallel-only
+    meshes)."""
+    tp = tp_axis
     layer_spec = {
         "ln1": P(),
-        "wqkv": P(None, "tp"),
-        "wo": P("tp", None),
+        "wqkv": P(None, tp),
+        "wo": P(tp, None),
         "ln2": P(),
-        "w_up": P(None, "tp"),
-        "w_down": P("tp", None),
+        "w_up": P(None, tp),
+        "w_down": P(tp, None),
     }
     return {
         "embed": P(),
@@ -161,18 +188,45 @@ def param_specs(cfg: BurninConfig) -> Params:
     }
 
 
-def batch_spec() -> dict[str, P]:
-    return {"tokens": P("dp", None), "targets": P("dp", None)}
+def batch_spec(
+    seq_axis: Optional[str] = None, batch_axis: Optional[str] = "dp"
+) -> dict[str, P]:
+    return {
+        "tokens": P(batch_axis, seq_axis),
+        "targets": P(batch_axis, seq_axis),
+    }
 
 
 def make_sharded_train_step(mesh: Mesh, cfg: BurninConfig, lr: float = 1e-2):
-    """Jit the train step with explicit dp/tp shardings over ``mesh``.
+    """Jit the train step with explicit shardings over ``mesh``.
+
+    Axes used if present: ``dp`` (batch), ``tp`` (Megatron tensor
+    parallelism), ``sp`` (sequence/context parallelism — attention switches
+    to ``ops.ring_attention`` so K/V blocks rotate over the ICI ring).
 
     Returns (step_fn, sharded_params, sharded_batch): the initial state is
     already placed according to the specs, so the first call runs the real
     multi-chip program (collectives over ICI on hardware, or the virtual
     mesh in tests/dry runs).
     """
+    axes = set(mesh.axis_names)
+    sp = mesh.shape["sp"] if "sp" in axes else 1
+    attn_core = None
+    if sp > 1:
+        from ..ops.ring_attention import ring_attention
+
+        assert cfg.seq_len % sp == 0, (
+            f"sp axis size {sp} must divide seq_len ({cfg.seq_len})"
+        )
+        qkv_spec = P(
+            "dp" if "dp" in axes else None,
+            "tp" if "tp" in axes else None,
+            "sp",
+            None,
+        )
+        attn_core = partial(
+            ring_attention, mesh=mesh, axis="sp", causal=True, spec=qkv_spec
+        )
 
     def to_sharding(tree_spec):
         return jax.tree_util.tree_map(
@@ -181,8 +235,15 @@ def make_sharded_train_step(mesh: Mesh, cfg: BurninConfig, lr: float = 1e-2):
             is_leaf=lambda x: isinstance(x, P),
         )
 
-    param_sh = to_sharding(param_specs(cfg))
-    batch_sh = to_sharding(batch_spec())
+    param_sh = to_sharding(
+        param_specs(cfg, tp_axis="tp" if "tp" in axes else None)
+    )
+    batch_sh = to_sharding(
+        batch_spec(
+            seq_axis="sp" if sp > 1 else None,
+            batch_axis="dp" if "dp" in axes else None,
+        )
+    )
 
     params = init_params(jax.random.PRNGKey(0), cfg)
     params = jax.device_put(params, param_sh)
@@ -191,7 +252,7 @@ def make_sharded_train_step(mesh: Mesh, cfg: BurninConfig, lr: float = 1e-2):
     @partial(jax.jit, in_shardings=(param_sh, batch_sh),
              out_shardings=(param_sh, NamedSharding(mesh, P())))
     def step(p, b):
-        loss, grads = jax.value_and_grad(loss_fn)(p, b, cfg)
+        loss, grads = jax.value_and_grad(loss_fn)(p, b, cfg, attn_core)
         new_p = jax.tree_util.tree_map(
             lambda x, g: (x - lr * g.astype(jnp.float32)).astype(x.dtype), p, grads
         )

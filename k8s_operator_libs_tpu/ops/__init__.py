@@ -1,4 +1,19 @@
 from .collectives import CollectiveReport, run_ici_probes
 from .matmul import matmul, mxu_probe
+from .ring_attention import (
+    RingAttentionReport,
+    reference_attention,
+    ring_attention,
+    ring_attention_probe,
+)
 
-__all__ = ["CollectiveReport", "matmul", "mxu_probe", "run_ici_probes"]
+__all__ = [
+    "CollectiveReport",
+    "RingAttentionReport",
+    "matmul",
+    "mxu_probe",
+    "reference_attention",
+    "ring_attention",
+    "ring_attention_probe",
+    "run_ici_probes",
+]

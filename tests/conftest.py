@@ -8,7 +8,11 @@ set before jax initializes its backends, hence module scope here.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard-set, not setdefault: the ambient environment pins JAX_PLATFORMS to
+# the single-chip TPU backend, but this suite is defined to run on the
+# virtual CPU mesh (multi-device shardings need 8 devices, and test runs
+# must not contend with bench/demo processes for the one real chip).
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

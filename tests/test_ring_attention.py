@@ -1,0 +1,139 @@
+"""Ring attention: numerics vs host oracle, gradients, probe report.
+
+Runs on the virtual 8-device CPU mesh (conftest.py) — the same SPMD
+partitioner and collectives XLA emits on a TPU slice.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from k8s_operator_libs_tpu.ops import (
+    reference_attention,
+    ring_attention,
+    ring_attention_probe,
+)
+from k8s_operator_libs_tpu.parallel import build_mesh
+
+
+def _oracle_grads(q, k, v):
+    """d/d{q,k,v} of sum(attention(q,k,v)^2), causal, in numpy float64.
+
+    Standard softmax-attention backward: with P = softmax(S), O = P V and
+    L = sum(O^2): dO = 2O; dV = Pᵀ dO; dP = dO Vᵀ;
+    dS = P ∘ (dP − rowsum(dP ∘ P)); dQ = scale · dS K; dK = scale · dSᵀ Q.
+    """
+    qn, kn, vn = (np.asarray(t, np.float64) for t in (q, k, v))
+    scale = qn.shape[-1] ** -0.5
+    s = qn.shape[2]
+    scores = np.einsum("bhqd,bhkd->bhqk", qn * scale, kn)
+    scores = np.where(np.tril(np.ones((s, s), dtype=bool)), scores, -np.inf)
+    scores -= scores.max(axis=-1, keepdims=True)
+    p = np.exp(scores)
+    p /= p.sum(axis=-1, keepdims=True)
+    out = np.einsum("bhqk,bhkd->bhqd", p, vn)
+    d_out = 2.0 * out
+    d_v = np.einsum("bhqk,bhqd->bhkd", p, d_out)
+    d_p = np.einsum("bhqd,bhkd->bhqk", d_out, vn)
+    d_s = p * (d_p - np.sum(d_p * p, axis=-1, keepdims=True))
+    d_q = scale * np.einsum("bhqk,bhkd->bhqd", d_s, kn)
+    d_k = scale * np.einsum("bhqk,bhqd->bhkd", d_s, qn)
+    return d_q, d_k, d_v
+
+
+def _qkv(shape, dtype=jnp.float32, seed=0):
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return (
+        jax.random.normal(kq, shape, dtype=jnp.float32).astype(dtype),
+        jax.random.normal(kk, shape, dtype=jnp.float32).astype(dtype),
+        jax.random.normal(kv, shape, dtype=jnp.float32).astype(dtype),
+    )
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    @pytest.mark.parametrize("sp", [2, 4, 8])
+    def test_matches_reference(self, sp, causal):
+        mesh = build_mesh({"sp": sp})
+        q, k, v = _qkv((2, 4, 16 * sp, 8))
+        out = ring_attention(q, k, v, mesh, "sp", causal=causal)
+        expected = reference_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(
+            np.asarray(out), expected, atol=1e-5, rtol=1e-4
+        )
+
+    def test_bf16_within_tolerance(self):
+        mesh = build_mesh({"sp": 4})
+        q, k, v = _qkv((1, 2, 64, 32), dtype=jnp.bfloat16)
+        out = ring_attention(q, k, v, mesh, "sp", causal=True)
+        expected = reference_attention(q, k, v, causal=True)
+        err = np.max(np.abs(np.asarray(out, np.float32) - expected))
+        assert err < 2e-2
+
+    def test_composes_with_dp_and_tp(self):
+        """Full 3D layout: batch over dp, heads over tp, sequence over sp."""
+        mesh = build_mesh({"dp": 2, "tp": 2, "sp": 2})
+        spec = P("dp", "tp", "sp", None)
+        q, k, v = _qkv((2, 2, 32, 16))
+        sharding = NamedSharding(mesh, spec)
+        q, k, v = (jax.device_put(t, sharding) for t in (q, k, v))
+        out = ring_attention(q, k, v, mesh, "sp", causal=True, spec=spec)
+        expected = reference_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(out), expected, atol=1e-5, rtol=1e-4
+        )
+
+    def test_gradients_flow_and_match(self):
+        """Grad through the ring (reverse rotation over the same links)
+        matches a hand-derived float64 host oracle.
+
+        The oracle is numpy, not jnp: an f32 jnp softmax-attention grad is
+        itself noisy to ~1e-2 here, while the ring grad lands within 1e-6 of
+        the f64 truth — so the test compares against the truth directly.
+        """
+        mesh = build_mesh({"sp": 4})
+        q, k, v = _qkv((1, 2, 32, 8))
+
+        def ring_loss(q, k, v):
+            return jnp.sum(
+                ring_attention(q, k, v, mesh, "sp", causal=True) ** 2
+            )
+
+        g_ring = jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v)
+        g_truth = _oracle_grads(q, k, v)
+        for gr, gd in zip(g_ring, g_truth):
+            assert np.all(np.isfinite(np.asarray(gr)))
+            np.testing.assert_allclose(
+                np.asarray(gr, np.float64), gd, atol=1e-4, rtol=1e-3
+            )
+
+    def test_jits_into_single_program(self):
+        mesh = build_mesh({"sp": 8})
+        q, k, v = _qkv((1, 1, 64, 8))
+        jitted = jax.jit(
+            lambda q, k, v: ring_attention(q, k, v, mesh, "sp")
+        )
+        out = jitted(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(out),
+            reference_attention(q, k, v),
+            atol=1e-5,
+            rtol=1e-4,
+        )
+
+
+class TestRingAttentionProbe:
+    def test_probe_passes_on_healthy_mesh(self):
+        mesh = build_mesh({"sp": 4})
+        report = ring_attention_probe(
+            mesh, "sp", seq_per_device=32, head_dim=16
+        )
+        assert report.ok, report.error
+        assert report.max_abs_err < 2e-2
+        assert report.tokens_per_s > 0
+
+    def test_probe_defaults_to_all_devices(self):
+        report = ring_attention_probe(seq_per_device=16, head_dim=8)
+        assert report.ok, report.error
