@@ -43,6 +43,11 @@ class BurninConfig:
     seq_len: int = 128
     batch: int = 8
     dtype: Any = jnp.bfloat16
+    # Use the Pallas flash kernel (ops.flash_attention) as the attention
+    # core instead of the XLA-native softmax attention. TPU-only (the
+    # kernel has no CPU lowering outside interpret mode); ignored when a
+    # sequence-parallel attention is active.
+    use_flash_attention: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -84,16 +89,6 @@ def _rms_norm(x: jax.Array, gain: jax.Array) -> jax.Array:
     return (x.astype(jnp.float32) * norm * gain).astype(x.dtype)
 
 
-def _local_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
-    """Causal softmax attention over (b, h, s, head_dim)."""
-    s = q.shape[2]
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / (q.shape[-1] ** 0.5)
-    causal = jnp.tril(jnp.ones((s, s), dtype=bool))
-    scores = jnp.where(causal, scores, jnp.finfo(scores.dtype).min)
-    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
-
-
 def _attention(
     layer: Params, x: jax.Array, cfg: BurninConfig, attn_core=None
 ) -> jax.Array:
@@ -104,7 +99,14 @@ def _attention(
     def heads(t):
         return t.reshape(b, s, cfg.n_heads, cfg.head_dim).transpose(0, 2, 1, 3)
 
-    out = (attn_core or _local_attention)(heads(q), heads(k), heads(v))
+    if attn_core is None:
+        if cfg.use_flash_attention:
+            from ..ops.flash_attention import flash_attention as attn_core
+        else:
+            # Shared with the Ulysses per-device core — one canonical
+            # causal-attention implementation.
+            from ..ops.ulysses import local_causal_attention as attn_core
+    out = attn_core(heads(q), heads(k), heads(v))
     out = out.transpose(0, 2, 1, 3).reshape(b, s, d)
     return out @ layer["wo"]  # psum over tp follows this matmul
 
@@ -197,12 +199,16 @@ def batch_spec(
     }
 
 
-def make_sharded_train_step(mesh: Mesh, cfg: BurninConfig, lr: float = 1e-2):
+def make_sharded_train_step(
+    mesh: Mesh, cfg: BurninConfig, lr: float = 1e-2, sp_impl: str = "ring"
+):
     """Jit the train step with explicit shardings over ``mesh``.
 
     Axes used if present: ``dp`` (batch), ``tp`` (Megatron tensor
-    parallelism), ``sp`` (sequence/context parallelism — attention switches
-    to ``ops.ring_attention`` so K/V blocks rotate over the ICI ring).
+    parallelism), ``sp`` (sequence/context parallelism). ``sp_impl`` picks
+    the sequence-parallel attention: ``"ring"`` (ops.ring_attention — K/V
+    blocks rotate over neighbor ICI links) or ``"ulysses"``
+    (ops.ulysses — head/sequence all-to-all).
 
     Returns (step_fn, sharded_params, sharded_batch): the initial state is
     already placed according to the specs, so the first call runs the real
@@ -213,8 +219,6 @@ def make_sharded_train_step(mesh: Mesh, cfg: BurninConfig, lr: float = 1e-2):
     sp = mesh.shape["sp"] if "sp" in axes else 1
     attn_core = None
     if sp > 1:
-        from ..ops.ring_attention import ring_attention
-
         assert cfg.seq_len % sp == 0, (
             f"sp axis size {sp} must divide seq_len ({cfg.seq_len})"
         )
@@ -224,8 +228,14 @@ def make_sharded_train_step(mesh: Mesh, cfg: BurninConfig, lr: float = 1e-2):
             "sp",
             None,
         )
+        if sp_impl == "ring":
+            from ..ops.ring_attention import ring_attention as sp_attention
+        elif sp_impl == "ulysses":
+            from ..ops.ulysses import ulysses_attention as sp_attention
+        else:
+            raise ValueError(f"unknown sp_impl {sp_impl!r}")
         attn_core = partial(
-            ring_attention, mesh=mesh, axis="sp", causal=True, spec=qkv_spec
+            sp_attention, mesh=mesh, axis="sp", causal=True, spec=qkv_spec
         )
 
     def to_sharding(tree_spec):

@@ -1,4 +1,9 @@
 from .collectives import CollectiveReport, run_ici_probes
+from .flash_attention import (
+    FlashAttentionReport,
+    flash_attention,
+    flash_attention_probe,
+)
 from .matmul import matmul, mxu_probe
 from .ring_attention import (
     RingAttentionReport,
@@ -6,14 +11,21 @@ from .ring_attention import (
     ring_attention,
     ring_attention_probe,
 )
+from .ulysses import UlyssesReport, ulysses_attention, ulysses_probe
 
 __all__ = [
     "CollectiveReport",
+    "FlashAttentionReport",
     "RingAttentionReport",
+    "UlyssesReport",
+    "flash_attention",
+    "flash_attention_probe",
     "matmul",
     "mxu_probe",
     "reference_attention",
     "ring_attention",
     "ring_attention_probe",
     "run_ici_probes",
+    "ulysses_attention",
+    "ulysses_probe",
 ]

@@ -1,0 +1,184 @@
+"""Pallas flash attention for the probe/burn-in stack.
+
+Tiled causal attention following the TPU kernel rules
+(/opt/skills/guides/pallas_guide.md): the grid walks (batch*heads,
+q-tiles); each instance streams K/V tiles through VMEM with an
+online-softmax accumulator, so peak memory is O(block_q * seq) instead of
+O(seq²), the dots run on the MXU in f32 accumulation, and causally-dead K/V
+tiles above the diagonal are skipped outright (the fori_loop upper bound is
+computed from the q-tile index).
+
+Used as the attention core of the burn-in model on real TPU hardware and as
+an MXU+VMEM pipeline probe (``flash_attention_probe``); CPU tests run it in
+interpret mode. No reference analog (SURVEY.md §2.5).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.log import get_logger
+from .probe_harness import (
+    ProbeReport,
+    host_qkv,
+    quantize,
+    run_checked_probe,
+)
+from .ring_attention import reference_attention
+
+log = get_logger("ops.flash_attention")
+
+try:  # Pallas ships with jax; interpret mode covers CPU tests.
+    from jax.experimental import pallas as pl
+
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+_MASKED = -1e30
+
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, out_ref, *, block_q: int, block_k: int, causal: bool
+):
+    """One (batch*head, q-tile) instance. q_ref: (1, block_q, d);
+    k_ref/v_ref: (1, seq, d) resident in VMEM; out_ref: (1, block_q, d)."""
+    iq = pl.program_id(1)
+    seq = k_ref.shape[1]
+    d = q_ref.shape[2]
+    q = q_ref[0].astype(jnp.float32) * (d**-0.5)  # (bq, d)
+    row = iq * block_q + jax.lax.iota(jnp.int32, block_q)
+
+    m0 = jnp.full((block_q,), _MASKED, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+
+    def body(jk, carry):
+        m, l, acc = carry
+        start = jk * block_k
+        k_blk = k_ref[0, pl.ds(start, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(start, block_k), :].astype(jnp.float32)
+        scores = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (bq, bk)
+        if causal:
+            col = start + jax.lax.iota(jnp.int32, block_k)
+            scores = jnp.where(
+                row[:, None] >= col[None, :], scores, _MASKED
+            )
+        blk_max = jnp.max(scores, axis=-1)
+        new_m = jnp.maximum(m, blk_max)
+        p = jnp.exp(scores - new_m[:, None])
+        corr = jnp.exp(m - new_m)
+        l = l * corr + jnp.sum(p, axis=-1)
+        acc = acc * corr[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return new_m, l, acc
+
+    if causal:
+        # K/V tiles past this q-tile's diagonal are fully masked: don't
+        # stream them at all. Tile 0 always runs (the diagonal block's
+        # unmasked entries seed the running max; see ring_attention._MASKED).
+        n_kv = pl.cdiv((iq + 1) * block_q, block_k)
+    else:
+        n_kv = seq // block_k
+    _, l, acc = jax.lax.fori_loop(0, n_kv, body, (m0, l0, acc0))
+    out_ref[0] = (acc / l[:, None]).astype(out_ref.dtype)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("block_q", "block_k", "causal", "interpret"),
+)
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    block_q: int = 128,
+    block_k: int = 128,
+    causal: bool = True,
+    interpret: bool = False,
+) -> jax.Array:
+    """Tiled attention over (batch, heads, seq, head_dim).
+
+    Block sizes clamp to the sequence length; seq must divide the (clamped)
+    blocks — the probe and burn-in control their own shapes, so no
+    ragged-edge handling.
+    """
+    b, h, s, d = q.shape
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    assert s % block_q == 0 and s % block_k == 0, (
+        f"seq {s} must tile by block_q={block_q}, block_k={block_k}"
+    )
+    bh = b * h
+    qf, kf, vf = (t.reshape(bh, s, d) for t in (q, k, v))
+    grid = (bh, s // block_q)
+    out = pl.pallas_call(
+        partial(
+            _flash_kernel, block_q=block_q, block_k=block_k, causal=causal
+        ),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, s, d)
+
+
+# Field-compatible alias kept for the public API (tpu.health report types).
+FlashAttentionReport = ProbeReport
+
+
+def flash_attention_probe(
+    *,
+    batch: int = 1,
+    heads: int = 4,
+    seq: int = 1024,
+    head_dim: int = 128,
+    dtype=jnp.bfloat16,
+    interpret: bool = False,
+    tol: float = 2e-2,
+    device=None,
+) -> ProbeReport:
+    """Numerics-checked flash attention throughput on one device — exercises
+    the MXU and the HBM→VMEM tile pipeline together."""
+    if device is not None:
+        with jax.default_device(device):
+            return flash_attention_probe(
+                batch=batch, heads=heads, seq=seq, head_dim=head_dim,
+                dtype=dtype, interpret=interpret, tol=tol, device=None,
+            )
+    try:
+        q_host, k_host, v_host = host_qkv((batch, heads, seq, head_dim), seed=2)
+        q, k, v = (
+            jnp.asarray(t).astype(dtype) for t in (q_host, k_host, v_host)
+        )
+        expected = reference_attention(
+            quantize(q_host, dtype),
+            quantize(k_host, dtype),
+            quantize(v_host, dtype),
+            causal=True,
+        )
+        # flash_attention is module-level @jax.jit, so repeated probe calls
+        # hit the trace cache.
+        return run_checked_probe(
+            "flash attention",
+            lambda: flash_attention(q, k, v, interpret=interpret),
+            expected,
+            tokens=batch * seq,
+            tol=tol,
+        )
+    except Exception as e:  # noqa: BLE001 - a broken kernel is a failed probe
+        return ProbeReport(ok=False, error=str(e))

@@ -1,0 +1,92 @@
+"""Shared harness for numerics-checked attention probes.
+
+All three attention probes (ring, ulysses, flash) follow the same contract:
+run the op on device, compare against the host float64-free oracle
+(``reference_attention``) on the same quantized inputs, then time 3 samples
+with compile excluded. The comparison walks *addressable* shards so
+multi-host slices verify their local devices instead of materializing a
+non-addressable global array.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.log import get_logger
+
+log = get_logger("ops.probe")
+
+
+@dataclass
+class ProbeReport:
+    ok: bool
+    max_abs_err: float = 0.0
+    elapsed_s: float = 0.0
+    tokens_per_s: float = 0.0
+    error: str = ""
+
+
+def host_qkv(shape: tuple[int, ...], seed: int) -> tuple[np.ndarray, ...]:
+    """Host-generated q/k/v so every process holds the oracle's operands."""
+    rng = np.random.default_rng(seed)
+    return tuple(
+        rng.standard_normal(shape, dtype=np.float32) for _ in range(3)
+    )
+
+
+def quantize(t: np.ndarray, dtype) -> np.ndarray:
+    """The values the device actually saw, back in f32 for the oracle."""
+    return np.asarray(jnp.asarray(t).astype(dtype), np.float32)
+
+
+def shard_max_abs_err(out: jax.Array, expected: np.ndarray) -> float:
+    """Max |out - expected| over this process's addressable output shards."""
+    max_err = 0.0
+    for shard in out.addressable_shards:
+        got = np.asarray(shard.data, np.float32)
+        max_err = max(
+            max_err, float(np.max(np.abs(got - expected[shard.index])))
+        )
+    return max_err
+
+
+def run_checked_probe(
+    name: str,
+    run: Callable[[], jax.Array],
+    expected: np.ndarray,
+    *,
+    tokens: int,
+    tol: float,
+) -> ProbeReport:
+    """Execute, verify against ``expected``, then time 3 post-compile runs."""
+    out = run().block_until_ready()
+    max_err = shard_max_abs_err(out, expected)
+    if not np.isfinite(max_err) or max_err > tol:
+        return ProbeReport(
+            ok=False,
+            max_abs_err=max_err,
+            error=f"numerics mismatch: max_abs_err={max_err:.4f} > {tol}",
+        )
+    samples = []
+    for _ in range(3):
+        start = time.perf_counter()
+        run().block_until_ready()
+        samples.append(time.perf_counter() - start)
+    elapsed = float(np.median(samples))
+    report = ProbeReport(
+        ok=True,
+        max_abs_err=max_err,
+        elapsed_s=elapsed,
+        tokens_per_s=tokens / elapsed if elapsed > 0 else 0.0,
+    )
+    log.info(
+        "%s probe: ok, %.0f tok/s, max_abs_err %.2e",
+        name, report.tokens_per_s, max_err,
+    )
+    return report

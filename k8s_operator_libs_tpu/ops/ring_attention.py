@@ -18,9 +18,7 @@ SURVEY.md §2.5 maps its "distributed comm backend" slot to these probes).
 
 from __future__ import annotations
 
-import time
-from dataclasses import dataclass
-from functools import partial
+from functools import lru_cache, partial
 from typing import Optional
 
 import jax
@@ -34,6 +32,12 @@ except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
 
 from ..utils.log import get_logger
+from .probe_harness import (
+    ProbeReport,
+    host_qkv,
+    quantize,
+    run_checked_probe,
+)
 
 log = get_logger("ops.ring_attention")
 
@@ -173,13 +177,16 @@ def reference_attention(
     return np.einsum("bhqk,bhkd->bhqd", probs, vn)
 
 
-@dataclass
-class RingAttentionReport:
-    ok: bool
-    max_abs_err: float = 0.0
-    elapsed_s: float = 0.0
-    tokens_per_s: float = 0.0
-    error: str = ""
+# Field-compatible alias kept for the public API (tpu.health report types).
+RingAttentionReport = ProbeReport
+
+
+@lru_cache(maxsize=8)
+def _jitted_ring(mesh: Mesh, axis: str):
+    # Cached per (mesh, axis): the gate runs this probe once per node of a
+    # roll, and a fresh jit(partial(...)) every call would re-trace and
+    # re-compile each time.
+    return jax.jit(partial(ring_attention, mesh=mesh, axis=axis, causal=True))
 
 
 def ring_attention_probe(
@@ -192,16 +199,12 @@ def ring_attention_probe(
     head_dim: int = 64,
     dtype=jnp.bfloat16,
     tol: float = 2e-2,
-) -> RingAttentionReport:
+) -> ProbeReport:
     """Numerics-checked ring attention across the slice's fabric.
 
     Every neighbor link carries ``n-1`` K/V rotations; the output is compared
-    elementwise against the host oracle on the same quantized inputs.
-
-    Inputs are generated host-side (numpy) so every process holds the full
-    arrays, and the comparison walks the *addressable* output shards — on a
-    multi-host slice each controller checks its own devices' shards instead
-    of materializing the (non-addressable) global array.
+    elementwise against the host oracle on the same quantized inputs
+    (multi-host safe — see ops.probe_harness).
     """
     try:
         if mesh is None:
@@ -210,56 +213,25 @@ def ring_attention_probe(
             mesh = single_axis_mesh(axis)
         n = mesh.shape[axis]
         seq = seq_per_device * n
-        shape = (batch, heads, seq, head_dim)
-        rng = np.random.default_rng(0)
-        q_host, k_host, v_host = (
-            rng.standard_normal(shape, dtype=np.float32) for _ in range(3)
-        )
-        spec = P(None, None, axis, None)
-        sharding = jax.sharding.NamedSharding(mesh, spec)
+        q_host, k_host, v_host = host_qkv((batch, heads, seq, head_dim), seed=0)
+        sharding = jax.sharding.NamedSharding(mesh, P(None, None, axis, None))
         q, k, v = (
             jax.device_put(jnp.asarray(t).astype(dtype), sharding)
             for t in (q_host, k_host, v_host)
         )
-
-        run = jax.jit(
-            partial(ring_attention, mesh=mesh, axis=axis, causal=True)
-        )
-        out = run(q, k, v).block_until_ready()
-        # Oracle on the SAME quantized values the devices saw.
-        quantize = lambda t: np.asarray(  # noqa: E731
-            jnp.asarray(t).astype(dtype), np.float32
-        )
         expected = reference_attention(
-            quantize(q_host), quantize(k_host), quantize(v_host), causal=True
+            quantize(q_host, dtype),
+            quantize(k_host, dtype),
+            quantize(v_host, dtype),
+            causal=True,
         )
-        max_err = 0.0
-        for shard in out.addressable_shards:
-            got = np.asarray(shard.data, np.float32)
-            want = expected[shard.index]
-            max_err = max(max_err, float(np.max(np.abs(got - want))))
-        if not np.isfinite(max_err) or max_err > tol:
-            return RingAttentionReport(
-                ok=False,
-                max_abs_err=max_err,
-                error=f"numerics mismatch: max_abs_err={max_err:.4f} > {tol}",
-            )
-        samples = []
-        for _ in range(3):
-            start = time.perf_counter()
-            run(q, k, v).block_until_ready()
-            samples.append(time.perf_counter() - start)
-        elapsed = float(np.median(samples))
-        report = RingAttentionReport(
-            ok=True,
-            max_abs_err=max_err,
-            elapsed_s=elapsed,
-            tokens_per_s=batch * seq / elapsed if elapsed > 0 else 0.0,
+        run = _jitted_ring(mesh, axis)
+        return run_checked_probe(
+            "ring attention",
+            lambda: run(q, k, v),
+            expected,
+            tokens=batch * seq,
+            tol=tol,
         )
-        log.info(
-            "ring attention probe: ok, %.0f tok/s, max_abs_err %.2e",
-            report.tokens_per_s, max_err,
-        )
-        return report
     except Exception as e:  # noqa: BLE001 - a failed lowering is a failed link
-        return RingAttentionReport(ok=False, error=str(e))
+        return ProbeReport(ok=False, error=str(e))

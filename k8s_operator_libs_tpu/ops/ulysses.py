@@ -1,0 +1,182 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence exchange.
+
+The complementary scheme to ``ops.ring_attention``: instead of rotating K/V
+blocks around the ring, one ``all_to_all`` re-shards q/k/v from
+sequence-sharded to head-sharded, every device computes ordinary full-sequence
+attention for its subset of heads, and a second ``all_to_all`` restores the
+sequence sharding. Two collectives total (plus two in grad), each moving
+payload across *every* device pair — which makes it the all-to-all ICI
+fabric probe, where the ring probe exercises neighbor links.
+
+Trade-off vs ring: Ulysses needs ``n_heads % sp == 0`` and O(seq²) per-device
+attention FLOPs/memory, but only 2 collectives; ring has per-device O(seq²/n)
+memory and n-1 neighbor hops. Both are exposed; the burn-in model can train
+with either (models/burnin.py).
+
+No reference analog (K8s control-plane library; SURVEY.md §2.5).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map  # jax >= 0.8
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+from ..utils.log import get_logger
+from .probe_harness import (
+    ProbeReport,
+    host_qkv,
+    quantize,
+    run_checked_probe,
+)
+from .ring_attention import reference_attention
+
+log = get_logger("ops.ulysses")
+
+
+def local_causal_attention(q, k, v):
+    """Plain causal softmax attention on (b, h_local, s_full, d), f32 core."""
+    s = q.shape[2]
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk",
+        q.astype(jnp.float32) * scale,
+        k.astype(jnp.float32),
+    )
+    causal = jnp.tril(jnp.ones((s, s), dtype=bool))
+    scores = jnp.where(causal, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum(
+        "bhqk,bhkd->bhqd", probs, v.astype(jnp.float32)
+    ).astype(q.dtype)
+
+
+def _ulysses_body(q, k, v, *, axis: str, causal: bool):
+    """Per-device: seq-sharded (b, h, s_local, d) → head-sharded
+    (b, h/n, s_full, d) via all_to_all, attend, and swap back."""
+    if not causal:
+        raise NotImplementedError("ulysses probe is causal-only")
+
+    def seq_to_heads(t):
+        return jax.lax.all_to_all(
+            t, axis, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    def heads_to_seq(t):
+        return jax.lax.all_to_all(
+            t, axis, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    out = local_causal_attention(
+        seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    )
+    return heads_to_seq(out)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis: str = "sp",
+    *,
+    causal: bool = True,
+    spec: Optional[P] = None,
+) -> jax.Array:
+    """Sequence-parallel attention via head/sequence all-to-all.
+
+    q/k/v are (batch, heads, seq, head_dim) global arrays with seq sharded
+    over ``axis``; ``heads`` must be divisible by the axis size. ``spec``
+    overrides the full PartitionSpec (e.g. ``P("dp", None, "sp", None)``);
+    the head dim must NOT be sharded over ``axis`` in it — the all_to_all
+    does that internally.
+    """
+    n = mesh.shape[axis]
+    if spec is None:
+        spec = P(None, None, axis, None)
+    # The all_to_all splits each shard's LOCAL head count: when ``spec``
+    # also shards the head dim over other axes (e.g. tp), divide those out
+    # before the divisibility check — a global-count check would pass and
+    # then die inside XLA with an opaque split error.
+    local_heads = q.shape[1]
+    head_entry = spec[1] if len(spec) > 1 else None
+    for name in (
+        (head_entry,) if isinstance(head_entry, str) else (head_entry or ())
+    ):
+        local_heads //= mesh.shape[name]
+    if local_heads % n != 0:
+        raise ValueError(
+            f"ulysses needs per-shard heads ({local_heads}) divisible by "
+            f"mesh axis '{axis}' ({n})"
+        )
+    body = partial(_ulysses_body, axis=axis, causal=causal)
+    return shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )(q, k, v)
+
+
+# Field-compatible alias kept for the public API (tpu.health report types).
+UlyssesReport = ProbeReport
+
+
+@lru_cache(maxsize=8)
+def _jitted_ulysses(mesh: Mesh, axis: str):
+    # Cached per (mesh, axis) — same rationale as ring_attention._jitted_ring.
+    return jax.jit(
+        partial(ulysses_attention, mesh=mesh, axis=axis, causal=True)
+    )
+
+
+def ulysses_probe(
+    mesh: Optional[Mesh] = None,
+    axis: str = "sp",
+    *,
+    batch: int = 2,
+    heads: int = 8,
+    seq_per_device: int = 128,
+    head_dim: int = 64,
+    dtype=jnp.bfloat16,
+    tol: float = 2e-2,
+) -> ProbeReport:
+    """Numerics-checked all-to-all attention across the slice's fabric
+    (multi-host safe — see ops.probe_harness)."""
+    try:
+        if mesh is None:
+            from ..parallel.mesh import single_axis_mesh
+
+            mesh = single_axis_mesh(axis)
+        n = mesh.shape[axis]
+        if heads % n != 0:
+            heads = n  # one head per device keeps the probe runnable
+        seq = seq_per_device * n
+        q_host, k_host, v_host = host_qkv((batch, heads, seq, head_dim), seed=1)
+        sharding = jax.sharding.NamedSharding(mesh, P(None, None, axis, None))
+        q, k, v = (
+            jax.device_put(jnp.asarray(t).astype(dtype), sharding)
+            for t in (q_host, k_host, v_host)
+        )
+        expected = reference_attention(
+            quantize(q_host, dtype),
+            quantize(k_host, dtype),
+            quantize(v_host, dtype),
+            causal=True,
+        )
+        run = _jitted_ulysses(mesh, axis)
+        return run_checked_probe(
+            "ulysses",
+            lambda: run(q, k, v),
+            expected,
+            tokens=batch * seq,
+            tol=tol,
+        )
+    except Exception as e:  # noqa: BLE001 - a failed lowering is a failed link
+        return ProbeReport(ok=False, error=str(e))

@@ -27,7 +27,10 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from ..ops.collectives import CollectiveReport, run_ici_probes
+from ..ops.flash_attention import FlashAttentionReport, flash_attention_probe
 from ..ops.matmul import MxuReport, mxu_probe
+from ..ops.ring_attention import RingAttentionReport, ring_attention_probe
+from ..ops.ulysses import UlyssesReport, ulysses_probe
 from ..utils.log import get_logger
 
 log = get_logger("tpu.health")
@@ -39,6 +42,9 @@ class HealthReport:
     collectives: list[CollectiveReport] = field(default_factory=list)
     mxu: Optional[MxuReport] = None
     burnin_ok: Optional[bool] = None
+    ring_attention: Optional[RingAttentionReport] = None
+    ulysses: Optional[UlyssesReport] = None
+    flash: Optional[FlashAttentionReport] = None
     elapsed_s: float = 0.0
     failures: list[str] = field(default_factory=list)
 
@@ -65,6 +71,8 @@ class IciHealthGate:
         matmul_size: int = 1024,
         use_pallas_matmul: bool = False,
         run_burnin: bool = True,
+        run_seq_parallel_probes: bool = False,
+        run_flash_attention: bool = False,
         devices: Optional[list] = None,
     ) -> None:
         self.min_ring_gbytes_per_s = min_ring_gbytes_per_s
@@ -73,6 +81,13 @@ class IciHealthGate:
         self.matmul_size = matmul_size
         self.use_pallas_matmul = use_pallas_matmul
         self.run_burnin = run_burnin
+        # Off by default: the ring/ulysses attention probes are the deep
+        # fabric exercise (every link / every pair) but add two more XLA
+        # compiles to the gate's first run.
+        self.run_seq_parallel_probes = run_seq_parallel_probes
+        # Off by default for the same reason as use_pallas_matmul: the
+        # Pallas kernels only lower on TPU hardware.
+        self.run_flash_attention = run_flash_attention
         self.devices = devices
         # (step, params, batch) keyed by the device set: the burn-in program
         # is identical across gate runs, so re-jitting it per validation
@@ -121,11 +136,45 @@ class IciHealthGate:
             if not burnin_ok:
                 failures.append("burn-in train step failed")
 
+        ring_attn: Optional[RingAttentionReport] = None
+        ulysses: Optional[UlyssesReport] = None
+        if self.run_seq_parallel_probes:
+            if mesh.devices.size > 1:
+                ring_attn = ring_attention_probe(
+                    mesh, "x", seq_per_device=64, head_dim=32
+                )
+                if not ring_attn.ok:
+                    failures.append(f"ring attention: {ring_attn.error}")
+                ulysses = ulysses_probe(
+                    mesh, "x", seq_per_device=64, head_dim=32
+                )
+                if not ulysses.ok:
+                    failures.append(f"ulysses: {ulysses.error}")
+            else:
+                # Not a failure — there is no fabric to probe — but say so:
+                # report fields stay None and a silent skip would read as
+                # "ran and passed" to an operator who enabled these.
+                log.warning(
+                    "seq-parallel probes skipped: single-device mesh has "
+                    "no ICI links to exercise"
+                )
+
+        flash: Optional[FlashAttentionReport] = None
+        if self.run_flash_attention:
+            flash = flash_attention_probe(
+                device=self.devices[0] if self.devices else None
+            )
+            if not flash.ok:
+                failures.append(f"flash attention: {flash.error}")
+
         report = HealthReport(
             ok=not failures,
             collectives=collectives,
             mxu=mxu,
             burnin_ok=burnin_ok,
+            ring_attention=ring_attn,
+            ulysses=ulysses,
+            flash=flash,
             elapsed_s=time.perf_counter() - start,
             failures=failures,
         )

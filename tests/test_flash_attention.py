@@ -1,0 +1,73 @@
+"""Pallas flash attention (interpret mode on CPU): numerics + probe."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_operator_libs_tpu.ops import reference_attention
+from k8s_operator_libs_tpu.ops.flash_attention import (
+    flash_attention,
+    flash_attention_probe,
+)
+
+
+def _qkv(shape, dtype=jnp.float32, seed=7):
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return (
+        jax.random.normal(kq, shape, dtype=jnp.float32).astype(dtype),
+        jax.random.normal(kk, shape, dtype=jnp.float32).astype(dtype),
+        jax.random.normal(kv, shape, dtype=jnp.float32).astype(dtype),
+    )
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_reference(self, causal):
+        q, k, v = _qkv((2, 2, 64, 16))
+        out = flash_attention(
+            q, k, v, block_q=16, block_k=16, causal=causal, interpret=True
+        )
+        expected = reference_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(
+            np.asarray(out), expected, atol=1e-5, rtol=1e-4
+        )
+
+    def test_uneven_blocks(self):
+        """block_q != block_k exercises the causal tile-skip bound."""
+        q, k, v = _qkv((1, 2, 128, 8))
+        out = flash_attention(
+            q, k, v, block_q=32, block_k=16, causal=True, interpret=True
+        )
+        expected = reference_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(out), expected, atol=1e-5, rtol=1e-4
+        )
+
+    def test_block_larger_than_seq_clamps(self):
+        q, k, v = _qkv((1, 1, 32, 8))
+        out = flash_attention(
+            q, k, v, block_q=128, block_k=128, causal=True, interpret=True
+        )
+        expected = reference_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(out), expected, atol=1e-5, rtol=1e-4
+        )
+
+    def test_bf16_within_tolerance(self):
+        q, k, v = _qkv((1, 2, 64, 32), dtype=jnp.bfloat16)
+        out = flash_attention(
+            q, k, v, block_q=16, block_k=16, causal=True, interpret=True
+        )
+        expected = reference_attention(q, k, v, causal=True)
+        err = np.max(np.abs(np.asarray(out, np.float32) - expected))
+        assert err < 2e-2
+
+
+class TestFlashAttentionProbe:
+    def test_probe_passes_interpret(self):
+        report = flash_attention_probe(
+            batch=1, heads=2, seq=64, head_dim=16, interpret=True
+        )
+        assert report.ok, report.error
+        assert report.tokens_per_s > 0

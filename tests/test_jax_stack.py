@@ -175,6 +175,19 @@ class TestBurnin:
 
 
 class TestHealthGate:
+    def test_gate_with_seq_parallel_probes(self, cpus):
+        gate = IciHealthGate(
+            payload_mb=0.1,
+            matmul_size=128,
+            run_burnin=False,
+            run_seq_parallel_probes=True,
+            devices=cpus[:4],
+        )
+        report = gate.run()
+        assert report.ok, report.failures
+        assert report.ring_attention is not None and report.ring_attention.ok
+        assert report.ulysses is not None and report.ulysses.ok
+
     def test_gate_passes_on_healthy_devices(self, cpus):
         gate = IciHealthGate(
             payload_mb=0.1, matmul_size=128, run_burnin=False, devices=cpus
