@@ -9,6 +9,7 @@ from .burnin import (
     synthetic_batch,
     train_step,
 )
+from .pipeline import make_pipeline_train_step, stack_layers
 
 __all__ = [
     "BurninConfig",
@@ -16,8 +17,10 @@ __all__ = [
     "forward",
     "init_params",
     "loss_fn",
+    "make_pipeline_train_step",
     "make_sharded_train_step",
     "param_specs",
+    "stack_layers",
     "synthetic_batch",
     "train_step",
 ]

@@ -8,13 +8,17 @@ sync into one program: if a freshly upgraded libtpu can train this, the node
 is healthy end to end. No reference analog (the reference has no model code;
 SURVEY.md §2.5) — its OFED validation pod plays this role.
 
-Sharding layout (Megatron-style tensor parallelism over ``tp``, data
-parallelism over ``dp``):
+Sharding layout over up to four mesh axes:
 
-* attention qkv projections sharded on the head dimension → P(None, "tp"),
-* attention output projection P("tp", None) (psum over tp follows),
-* MLP up-projection P(None, "tp"), down-projection P("tp", None),
-* embeddings and norms replicated, batch sharded P("dp").
+* ``tp`` — Megatron tensor parallelism: qkv sharded on heads P(None, "tp"),
+  output projection P("tp", None) (psum over tp follows), MLP/expert ffn
+  dims likewise,
+* ``dp`` — batch sharded P("dp") with gradient psum,
+* ``sp`` — sequence/context parallelism: attention runs as ring attention
+  (ops.ring_attention) or Ulysses all-to-all (ops.ulysses),
+* ``ep`` — expert parallelism (``n_experts > 0``): experts sharded
+  P("ep", ...), soft-routed combine = one psum over ep,
+* embeddings and norms replicated.
 
 Everything is plain JAX (no flax): params are a pytree dict, the step is a
 pure function, and the whole thing jits into one XLA program.
@@ -48,6 +52,13 @@ class BurninConfig:
     # kernel has no CPU lowering outside interpret mode); ignored when a
     # sequence-parallel attention is active.
     use_flash_attention: bool = False
+    # >0 replaces the dense MLP with a soft mixture-of-experts: every
+    # expert computes (static shapes, no token dropping), the router's
+    # softmax weights combine them. Experts shard over the ``ep`` mesh axis
+    # — the expert-parallel pattern that keeps XLA fusion intact and turns
+    # the combine into one psum over ep, rather than the dynamic-shape
+    # gather/scatter routing a TPU program can't tile.
+    n_experts: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -65,16 +76,24 @@ def init_params(key: jax.Array, cfg: BurninConfig) -> Params:
     layers = []
     for i in range(cfg.n_layers):
         lk = jax.random.split(keys[2 + i], 6)
-        layers.append(
-            {
-                "ln1": jnp.ones((cfg.d_model,), dtype=jnp.float32),
-                "wqkv": dense(lk[0], (cfg.d_model, 3 * cfg.d_model)),
-                "wo": dense(lk[1], (cfg.d_model, cfg.d_model)),
-                "ln2": jnp.ones((cfg.d_model,), dtype=jnp.float32),
-                "w_up": dense(lk[2], (cfg.d_model, cfg.d_ff)),
-                "w_down": dense(lk[3], (cfg.d_ff, cfg.d_model)),
-            }
-        )
+        layer = {
+            "ln1": jnp.ones((cfg.d_model,), dtype=jnp.float32),
+            "wqkv": dense(lk[0], (cfg.d_model, 3 * cfg.d_model)),
+            "wo": dense(lk[1], (cfg.d_model, cfg.d_model)),
+            "ln2": jnp.ones((cfg.d_model,), dtype=jnp.float32),
+        }
+        if cfg.n_experts > 0:
+            layer["w_router"] = dense(lk[4], (cfg.d_model, cfg.n_experts))
+            layer["experts_up"] = dense(
+                lk[2], (cfg.n_experts, cfg.d_model, cfg.d_ff)
+            )
+            layer["experts_down"] = dense(
+                lk[3], (cfg.n_experts, cfg.d_ff, cfg.d_model)
+            )
+        else:
+            layer["w_up"] = dense(lk[2], (cfg.d_model, cfg.d_ff))
+            layer["w_down"] = dense(lk[3], (cfg.d_ff, cfg.d_model))
+        layers.append(layer)
     return {
         "embed": dense(keys[0], (cfg.vocab, cfg.d_model)),
         "ln_f": jnp.ones((cfg.d_model,), dtype=jnp.float32),
@@ -115,6 +134,18 @@ def _mlp(layer: Params, x: jax.Array) -> jax.Array:
     return jax.nn.gelu(x @ layer["w_up"]) @ layer["w_down"]
 
 
+def _moe(layer: Params, x: jax.Array) -> jax.Array:
+    """Soft mixture-of-experts: all experts run (sharded over ep), the
+    router's softmax mixes them. The combine einsum contracts the expert
+    dim, so with experts on ep XLA emits exactly one psum over ep here."""
+    probs = jax.nn.softmax(
+        (x @ layer["w_router"]).astype(jnp.float32), axis=-1
+    ).astype(x.dtype)  # (b, s, E)
+    up = jnp.einsum("bsd,edf->besf", x, layer["experts_up"])
+    out = jnp.einsum("besf,efd->besd", jax.nn.gelu(up), layer["experts_down"])
+    return jnp.einsum("bse,besd->bsd", probs, out)
+
+
 def forward(
     params: Params,
     tokens: jax.Array,
@@ -129,9 +160,10 @@ def forward(
     shards without code changes.
     """
     x = params["embed"][tokens]
+    mlp = _moe if cfg.n_experts > 0 else _mlp
     for layer in params["layers"]:
         x = x + _attention(layer, _rms_norm(x, layer["ln1"]), cfg, attn_core)
-        x = x + _mlp(layer, _rms_norm(x, layer["ln2"]))
+        x = x + mlp(layer, _rms_norm(x, layer["ln2"]))
     x = _rms_norm(x, params["ln_f"])
     return (x @ params["embed"].T).astype(jnp.float32)
 
@@ -148,16 +180,22 @@ def loss_fn(
     return jnp.mean(nll)
 
 
+def sgd_update(params: Params, grads: Params, lr: float) -> Params:
+    """The one SGD rule every train step shares (f32 update, param dtype
+    storage)."""
+    return jax.tree_util.tree_map(
+        lambda p, g: (p - lr * g.astype(jnp.float32)).astype(p.dtype),
+        params, grads,
+    )
+
+
 @partial(jax.jit, static_argnames=("cfg",))
 def train_step(
     params: Params, batch: dict[str, jax.Array], cfg: BurninConfig, lr: float = 1e-2
 ) -> tuple[Params, jax.Array]:
     """One SGD step; jits into a single XLA program."""
     loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg)
-    new_params = jax.tree_util.tree_map(
-        lambda p, g: (p - lr * g.astype(jnp.float32)).astype(p.dtype), params, grads
-    )
-    return new_params, loss
+    return sgd_update(params, grads, lr), loss
 
 
 def synthetic_batch(key: jax.Array, cfg: BurninConfig) -> dict[str, jax.Array]:
@@ -169,20 +207,31 @@ def synthetic_batch(key: jax.Array, cfg: BurninConfig) -> dict[str, jax.Array]:
 # ----------------------------------------------------------------------
 # Sharding
 # ----------------------------------------------------------------------
-def param_specs(cfg: BurninConfig, tp_axis: Optional[str] = "tp") -> Params:
-    """Megatron-style tensor-parallel PartitionSpecs for the param tree.
+def param_specs(
+    cfg: BurninConfig,
+    tp_axis: Optional[str] = "tp",
+    ep_axis: Optional[str] = None,
+) -> Params:
+    """PartitionSpecs for the param tree: Megatron tensor parallelism over
+    ``tp_axis``, expert parallelism over ``ep_axis`` (MoE configs).
 
-    ``tp_axis=None`` replicates the weights (data/sequence-parallel-only
-    meshes)."""
+    ``None`` for an axis replicates the corresponding weights."""
     tp = tp_axis
     layer_spec = {
         "ln1": P(),
         "wqkv": P(None, tp),
         "wo": P(tp, None),
         "ln2": P(),
-        "w_up": P(None, tp),
-        "w_down": P(tp, None),
     }
+    if cfg.n_experts > 0:
+        ep = ep_axis
+        layer_spec["w_router"] = P()
+        # Experts over ep AND each expert's ffn over tp — ep x tp compose.
+        layer_spec["experts_up"] = P(ep, None, tp)
+        layer_spec["experts_down"] = P(ep, tp, None)
+    else:
+        layer_spec["w_up"] = P(None, tp)
+        layer_spec["w_down"] = P(tp, None)
     return {
         "embed": P(),
         "ln_f": P(),
@@ -205,10 +254,11 @@ def make_sharded_train_step(
     """Jit the train step with explicit shardings over ``mesh``.
 
     Axes used if present: ``dp`` (batch), ``tp`` (Megatron tensor
-    parallelism), ``sp`` (sequence/context parallelism). ``sp_impl`` picks
-    the sequence-parallel attention: ``"ring"`` (ops.ring_attention — K/V
-    blocks rotate over neighbor ICI links) or ``"ulysses"``
-    (ops.ulysses — head/sequence all-to-all).
+    parallelism), ``sp`` (sequence/context parallelism), ``ep`` (expert
+    parallelism — requires ``cfg.n_experts`` divisible by the axis).
+    ``sp_impl`` picks the sequence-parallel attention: ``"ring"``
+    (ops.ring_attention — K/V blocks rotate over neighbor ICI links) or
+    ``"ulysses"`` (ops.ulysses — head/sequence all-to-all).
 
     Returns (step_fn, sharded_params, sharded_batch): the initial state is
     already placed according to the specs, so the first call runs the real
@@ -217,6 +267,12 @@ def make_sharded_train_step(
     """
     axes = set(mesh.axis_names)
     sp = mesh.shape["sp"] if "sp" in axes else 1
+    ep = mesh.shape["ep"] if "ep" in axes else 1
+    if ep > 1:
+        assert cfg.n_experts > 0 and cfg.n_experts % ep == 0, (
+            f"ep axis size {ep} needs n_experts divisible by it "
+            f"(got {cfg.n_experts})"
+        )
     attn_core = None
     if sp > 1:
         assert cfg.seq_len % sp == 0, (
@@ -246,7 +302,11 @@ def make_sharded_train_step(
         )
 
     param_sh = to_sharding(
-        param_specs(cfg, tp_axis="tp" if "tp" in axes else None)
+        param_specs(
+            cfg,
+            tp_axis="tp" if "tp" in axes else None,
+            ep_axis="ep" if ep > 1 else None,
+        )
     )
     batch_sh = to_sharding(
         batch_spec(
@@ -263,9 +323,6 @@ def make_sharded_train_step(
              out_shardings=(param_sh, NamedSharding(mesh, P())))
     def step(p, b):
         loss, grads = jax.value_and_grad(loss_fn)(p, b, cfg, attn_core)
-        new_p = jax.tree_util.tree_map(
-            lambda x, g: (x - lr * g.astype(jnp.float32)).astype(x.dtype), p, grads
-        )
-        return new_p, loss
+        return sgd_update(p, grads, lr), loss
 
     return step, params, batch

@@ -1,0 +1,203 @@
+"""Pipeline parallelism for the burn-in model: GPipe over a ``pp`` mesh axis.
+
+The transformer's layers are stacked into leading-``n_layers`` pytree leaves
+and sharded over ``pp`` — each device owns ``n_layers/pp`` consecutive
+layers. Microbatches flow through the stages on a static unrolled schedule
+of ``M + pp - 1`` ticks: every tick, each stage runs its local layers
+(``lax.scan``) and hands its activations to the next stage with a single
+neighbor ``ppermute`` — the same hop pattern ring attention uses, but
+carrying layer activations instead of K/V blocks. Bubble ticks compute
+garbage that provably never reaches the loss (gated by static tick/stage
+arithmetic, so XLA sees no dynamic control flow).
+
+Autodiff through the schedule gives the backward pipeline for free: the
+transpose of each forward ``ppermute`` is the reverse-direction ``ppermute``,
+so gradients flow stage-to-stage exactly as a hand-written 1F1B backward
+would, and the replicated embedding's gradient is psum'd across stages by
+the shard_map transpose rule.
+
+Composes with ``dp`` (microbatch dim sharded over data parallelism).
+No reference analog (K8s control-plane library; SURVEY.md §2.5).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map  # jax >= 0.8
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+from ..ops.ring_attention import _mark_varying
+from .burnin import (
+    BurninConfig,
+    Params,
+    _attention,
+    _mlp,
+    _moe,
+    _rms_norm,
+    init_params,
+    sgd_update,
+    synthetic_batch,
+)
+
+
+def stack_layers(layers: list[Params]) -> Params:
+    """[{leaf: (...)}, ...] → {leaf: (n_layers, ...)} for pp sharding."""
+    return jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *layers)
+
+
+def _pipeline_loss_fn(mesh: Mesh, cfg: BurninConfig, n_microbatches: int):
+    """Build loss(params, batch) running the GPipe schedule over ``mesh``.
+
+    params = {"embed", "ln_f", "stacked"}; batch tokens/targets are
+    (M, microbatch, seq)."""
+    pp = mesh.shape["pp"]
+    axes = set(mesh.axis_names)
+    dp = mesh.shape["dp"] if "dp" in axes else 1
+    M = n_microbatches
+    last = pp - 1
+    ticks = M + pp - 1
+    perm = [(i, (i + 1) % pp) for i in range(pp)]
+    mlp = _moe if cfg.n_experts > 0 else _mlp
+
+    def run_local_layers(stacked_local: Params, x: jax.Array) -> jax.Array:
+        def one_layer(y, layer):
+            y = y + _attention(layer, _rms_norm(y, layer["ln1"]), cfg)
+            y = y + mlp(layer, _rms_norm(y, layer["ln2"]))
+            return y, None
+
+        y, _ = jax.lax.scan(one_layer, x, stacked_local)
+        return y
+
+    def body(stacked_local, embed, ln_f, tokens, targets):
+        stage = jax.lax.axis_index("pp")
+        is_first = stage == 0
+        is_last = stage == last
+        carry = jnp.zeros(
+            (tokens.shape[1], tokens.shape[2], cfg.d_model), cfg.dtype
+        )
+        # Zero that carries the full varying-axes type (dp and pp): both
+        # cond branches and every addition then type-check under
+        # shard_map's varying-manual-axes tracking.
+        loss_sum = _mark_varying(
+            jnp.float32(0), tuple(mesh.axis_names)
+        ) + 0.0 * stage
+        for t in range(ticks):
+            # Stage 0 ingests microbatch t (clamped: post-drain ticks re-run
+            # the last microbatch; those outputs complete after tick
+            # M-1+last and are statically excluded from the loss below).
+            x0 = embed[tokens[min(t, M - 1)]]
+            x = jnp.where(is_first, x0, carry)
+            y = run_local_layers(stacked_local, x)
+            out_mb = t - last  # microbatch completing at the last stage
+            if 0 <= out_mb < M:
+                # Masked, not lax.cond'd: a device-varying branch would let
+                # stages reach the schedule's collectives in divergent
+                # order, which deadlocks the runtime's rendezvous (observed
+                # on the XLA CPU backend: half the devices waiting at an
+                # all-reduce, half at a collective-permute). Non-last
+                # stages waste the vocab matmul on loss ticks; on TPU the
+                # bubble overlap hides most of it.
+                logits = (
+                    _rms_norm(y, ln_f) @ embed.T
+                ).astype(jnp.float32)
+                logp = jax.nn.log_softmax(logits, axis=-1)
+                nll = -jnp.take_along_axis(
+                    logp, targets[out_mb][..., None], axis=-1
+                )
+                loss_sum = loss_sum + jnp.where(is_last, jnp.mean(nll), 0.0)
+            carry = jax.lax.ppermute(y, "pp", perm)
+        reduce_axes = ("pp", "dp") if dp > 1 else ("pp",)
+        scale = 1.0 / (M * dp)
+        return jax.lax.psum(loss_sum, reduce_axes) * scale
+
+    batch_axis = "dp" if dp > 1 else None
+
+    def loss(params, batch):
+        stacked_in = jax.tree_util.tree_map(lambda _: P("pp"), params["stacked"])
+        return shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(
+                stacked_in,
+                P(),
+                P(),
+                P(None, batch_axis, None),
+                P(None, batch_axis, None),
+            ),
+            out_specs=P(),
+        )(
+            params["stacked"],
+            params["embed"],
+            params["ln_f"],
+            batch["tokens"],
+            batch["targets"],
+        )
+
+    return loss
+
+
+def make_pipeline_train_step(
+    mesh: Mesh,
+    cfg: BurninConfig,
+    n_microbatches: int = 4,
+    lr: float = 1e-2,
+):
+    """Jit a pipeline-parallel train step over a mesh with a ``pp`` axis
+    (optionally ``dp``). Returns (step_fn, params, batch) like
+    burnin.make_sharded_train_step; params hold the layer stack sharded over
+    pp and the replicated embed/ln_f.
+    """
+    axes = set(mesh.axis_names)
+    assert "pp" in axes, "pipeline mesh needs a 'pp' axis"
+    pp = mesh.shape["pp"]
+    dp = mesh.shape["dp"] if "dp" in axes else 1
+    assert cfg.n_layers % pp == 0, (
+        f"pp axis size {pp} must divide n_layers ({cfg.n_layers})"
+    )
+    M = n_microbatches
+    assert cfg.batch % (M * dp) == 0, (
+        f"batch ({cfg.batch}) must split into {M} microbatches x dp={dp}"
+    )
+    mb = cfg.batch // M
+
+    base = init_params(jax.random.PRNGKey(0), cfg)
+    params = {
+        "embed": base["embed"],
+        "ln_f": base["ln_f"],
+        "stacked": stack_layers(base["layers"]),
+    }
+    flat = synthetic_batch(jax.random.PRNGKey(1), cfg)
+    batch = {
+        k: v.reshape(M, mb, cfg.seq_len) for k, v in flat.items()
+    }
+
+    batch_axis = "dp" if dp > 1 else None
+    param_sh = {
+        "embed": NamedSharding(mesh, P()),
+        "ln_f": NamedSharding(mesh, P()),
+        "stacked": jax.tree_util.tree_map(
+            lambda _: NamedSharding(mesh, P("pp")), params["stacked"]
+        ),
+    }
+    batch_sh = {
+        k: NamedSharding(mesh, P(None, batch_axis, None)) for k in batch
+    }
+    params = jax.device_put(params, param_sh)
+    batch = jax.device_put(batch, batch_sh)
+
+    loss_fn = _pipeline_loss_fn(mesh, cfg, M)
+
+    @partial(jax.jit, in_shardings=(param_sh, batch_sh),
+             out_shardings=(param_sh, NamedSharding(mesh, P())))
+    def step(p, b):
+        loss, grads = jax.value_and_grad(loss_fn)(p, b)
+        return sgd_update(p, grads, lr), loss
+
+    return step, params, batch
