@@ -22,42 +22,48 @@ from __future__ import annotations
 
 import json
 import os
-import subprocess
 import sys
 import time
 
 
-def _ensure_live_backend(timeout_s: float = 150.0) -> None:
+def _ensure_live_backend(deadlines_s: tuple = (150.0, 60.0)) -> None:
     """Guard against a wedged accelerator tunnel: probe backend init in a
-    subprocess; if it can't produce devices in time, re-exec this bench on
-    the CPU backend (bench must always print its JSON line — a hung
-    device-plugin handshake would otherwise stall it forever). Must run
-    BEFORE this process initializes jax backends."""
+    subprocess with a deadline, retrying once (a wedged tunnel can be
+    transient); if it still can't produce devices, re-exec this bench on a
+    hermetic CPU environment (bench must always print its JSON line — a
+    hung device-plugin handshake would otherwise stall it forever). The
+    fallback is stamped into the environment so the result JSON carries
+    ``backend: cpu-fallback`` — a CPU number must never be mistakable for
+    a TPU number. Must run BEFORE this process initializes jax backends.
+    """
     if os.environ.get("BENCH_BACKEND_CHECKED"):
         return
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout_s,
-            capture_output=True,
-        )
-        ok = probe.returncode == 0
-    except subprocess.TimeoutExpired:
-        ok = False
-    env = dict(os.environ, BENCH_BACKEND_CHECKED="1")
-    if not ok:
+    from k8s_operator_libs_tpu.utils.jaxenv import (
+        hermetic_cpu_env,
+        probe_default_backend,
+    )
+
+    # One full-deadline probe plus a short retry (a wedged tunnel can be
+    # transient) — the summed deadlines bound the worst-case time before
+    # the fallback, keeping "bench always prints its JSON line" honest.
+    detail = ""
+    for attempt, deadline_s in enumerate(deadlines_s):
+        ok, detail = probe_default_backend(deadline_s)
+        if ok:
+            print(f"bench: live backend devices: {detail}", file=sys.stderr)
+            os.environ["BENCH_BACKEND_CHECKED"] = "1"
+            return
         print(
-            f"bench: default backend unusable after {timeout_s:.0f}s; "
-            "falling back to CPU",
+            f"bench: backend probe attempt {attempt + 1} failed: {detail}",
             file=sys.stderr,
         )
-        env["JAX_PLATFORMS"] = "cpu"
-        env.pop("PYTHONPATH", None)  # drop wedged device-plugin paths
-        flags = env.get("XLA_FLAGS", "")
-        if "--xla_force_host_platform_device_count" not in flags:
-            env["XLA_FLAGS"] = (
-                flags + " --xla_force_host_platform_device_count=8"
-            ).strip()
+    print(
+        f"bench: default backend unusable ({detail}); falling back to CPU",
+        file=sys.stderr,
+    )
+    env = hermetic_cpu_env(8)
+    env["BENCH_BACKEND_CHECKED"] = "1"
+    env["BENCH_BACKEND_FALLBACK"] = detail or "backend probe failed"
     os.execve(sys.executable, [sys.executable] + sys.argv, env)
 
 
@@ -190,7 +196,48 @@ def run_roll(slice_aware: bool) -> dict:
     }
 
 
+def run_calibration() -> dict:
+    """One full-battery gate run on the real devices.
+
+    With an accelerator present the Pallas kernels run *compiled* (not
+    interpreted) — the proof they lower on the actual runtime — and the
+    measured MXU TFLOP/s / ring GB/s are the calibration inputs for the
+    gate's perf floors (``IciHealthGate`` floor defaults).
+    """
+    platform = jax.devices()[0].platform
+    accel = platform != "cpu"
+    gate = IciHealthGate(
+        payload_mb=4.0,
+        matmul_size=2048,
+        use_pallas_matmul=accel,
+        run_burnin=True,
+        run_seq_parallel_probes=len(jax.devices()) > 1,
+        run_flash_attention=accel,
+    )
+    report = gate.run()
+    ring = next(
+        (c for c in report.collectives if c.op == "ppermute_ring"), None
+    )
+    return {
+        "platform": platform,
+        "ok": report.ok,
+        "failures": report.failures,
+        "mxu_tflops": round(report.mxu.tflops, 3) if report.mxu else None,
+        "pallas_matmul_compiled": accel,
+        "ring_gbytes_per_s": round(ring.gbytes_per_s, 3) if ring else None,
+        "flash_attention_ok": report.flash.ok
+        if report.flash is not None
+        else None,
+        "elapsed_s": round(report.elapsed_s, 2),
+    }
+
+
 def main() -> None:
+    fallback_reason = os.environ.get("BENCH_BACKEND_FALLBACK")
+    backend = "cpu-fallback" if fallback_reason else jax.default_backend()
+
+    calibration = run_calibration()
+
     # Warm the JAX caches so both configurations pay compile cost equally
     # (the gate's programs are identical across runs).
     _ = run_roll(slice_aware=True)
@@ -198,6 +245,17 @@ def main() -> None:
     baseline = run_roll(slice_aware=False)
     ours = run_roll(slice_aware=True)
 
+    details = {
+        "backend": backend,
+        "ours": ours,
+        "reference_equivalent": baseline,
+        "devices": [str(d) for d in jax.devices()],
+        "calibration": calibration,
+        "vs_baseline_note": "self-relative: ours vs this framework in "
+        "reference-shaped config (the Go reference publishes no numbers)",
+    }
+    if fallback_reason:
+        details["fallback_reason"] = fallback_reason
     result = {
         "metric": "v5e-16 pool libtpu rolling-upgrade wall-clock "
         "(simulated GKE pool, real ICI/MXU health gate)",
@@ -206,11 +264,7 @@ def main() -> None:
         "vs_baseline": round(baseline["wall_s"] / ours["wall_s"], 3)
         if ours["wall_s"] > 0
         else 0.0,
-        "details": {
-            "ours": ours,
-            "reference_equivalent": baseline,
-            "devices": [str(d) for d in jax.devices()],
-        },
+        "details": details,
     }
     print(json.dumps(result))
 

@@ -166,8 +166,18 @@ class _Handler(BaseHTTPRequestHandler):
         self._send_json(200, updated.raw)
 
     def _do_patch(self, cluster, info, namespace, name, subresource, query):
+        content_type = self.headers.get("Content-Type", "")
+        patch_type = (
+            "strategic"
+            if "strategic-merge-patch" in content_type
+            else "merge"
+        )
         patched = cluster.patch(
-            info.kind, name, namespace, patch=self._read_body()
+            info.kind,
+            name,
+            namespace,
+            patch=self._read_body(),
+            patch_type=patch_type,
         )
         self._send_json(200, patched.raw)
 

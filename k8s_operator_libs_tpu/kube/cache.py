@@ -162,8 +162,11 @@ class CachedClient(Client):
         name: str,
         namespace: str = "",
         patch: Optional[Mapping[str, Any]] = None,
+        patch_type: str = "merge",
     ) -> KubeObject:
-        return self.backing.patch(kind, name, namespace, patch)
+        return self.backing.patch(
+            kind, name, namespace, patch, patch_type=patch_type
+        )
 
     def delete(
         self,

@@ -82,8 +82,13 @@ class Client(abc.ABC):
         name: str,
         namespace: str = "",
         patch: Optional[Mapping[str, Any]] = None,
+        patch_type: str = "merge",
     ) -> KubeObject:
-        """RFC 7386 merge patch (null deletes a key)."""
+        """Patch the object. ``patch_type`` selects the content type:
+        ``"merge"`` = RFC 7386 merge patch (null deletes a key),
+        ``"strategic"`` = Kubernetes strategic merge patch (the reference
+        uses strategic for the state label,
+        node_upgrade_state_provider.go:80-82)."""
 
     @abc.abstractmethod
     def delete(

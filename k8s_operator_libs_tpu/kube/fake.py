@@ -65,6 +65,97 @@ def merge_patch(target: dict[str, Any], patch: Mapping[str, Any]) -> dict[str, A
     return target
 
 
+def strategic_merge_patch(
+    target: dict[str, Any], patch: Mapping[str, Any]
+) -> dict[str, Any]:
+    """Apply a Kubernetes strategic-merge patch in place.
+
+    The reference writes the node state label with a *strategic* merge
+    patch (node_upgrade_state_provider.go:80-82) while annotations go via
+    RFC 7386 merge patch (:147-150); this fake supports both content types
+    so the distinction is honest rather than papered over. For the map
+    fields this library patches (labels/annotations), the two are
+    equivalent — ``tests/test_patch_semantics.py`` pins that equivalence.
+
+    Supported strategic semantics (the subset a driver-upgrade controller
+    exercises):
+
+    * maps merge recursively; ``null`` deletes a key (same as merge patch),
+    * a map containing ``{"$patch": "replace"}`` replaces wholesale,
+    * a map value of ``{"$patch": "delete"}`` deletes the key,
+    * lists of objects merge by the ``name`` merge key (the K8s default for
+      containers/env/etc.); an item ``{"$patch": "delete", "name": x}``
+      removes the matching element,
+    * lists of primitives are replaced (K8s replace strategy default).
+    """
+    for key, value in patch.items():
+        if key == "$patch":
+            continue
+        if value is None:
+            target.pop(key, None)
+        elif isinstance(value, Mapping):
+            directive = value.get("$patch")
+            if directive == "delete":
+                target.pop(key, None)
+                continue
+            if directive == "replace":
+                replacement = {
+                    k: copy.deepcopy(v)
+                    for k, v in value.items()
+                    if k != "$patch"
+                }
+                target[key] = replacement
+                continue
+            existing = target.get(key)
+            if not isinstance(existing, dict):
+                existing = {}
+                target[key] = existing
+            strategic_merge_patch(existing, value)
+        elif isinstance(value, list):
+            merged_list = _strategic_merge_list(target.get(key), value)
+            # Pure-directive patches ($patch:delete of absent elements)
+            # must not conjure the key into existence — a real apiserver
+            # treats them as a no-op. An explicit empty list still sets.
+            if key not in target and not merged_list and value:
+                continue
+            target[key] = merged_list
+        else:
+            target[key] = copy.deepcopy(value)
+    return target
+
+
+def _strategic_merge_list(current: Any, patch_items: list[Any]) -> list[Any]:
+    mergeable = (
+        isinstance(current, list)
+        and all(isinstance(i, Mapping) and "name" in i for i in current)
+        and all(isinstance(i, Mapping) and "name" in i for i in patch_items)
+    )
+    if not mergeable:
+        # Replace strategy — but directives are instructions, not data: a
+        # $patch:delete of an absent element is a no-op on a real
+        # apiserver, never a stored phantom object.
+        return [
+            copy.deepcopy(i)
+            for i in patch_items
+            if not (isinstance(i, Mapping) and i.get("$patch") == "delete")
+        ]
+    merged: list[Any] = [copy.deepcopy(i) for i in current]
+    index = {item["name"]: pos for pos, item in enumerate(merged)}
+    for item in patch_items:
+        name = item["name"]
+        if item.get("$patch") == "delete":
+            if name in index:
+                merged = [m for m in merged if m["name"] != name]
+                index = {m["name"]: pos for pos, m in enumerate(merged)}
+            continue
+        if name in index:
+            strategic_merge_patch(merged[index[name]], item)
+        else:
+            merged.append(copy.deepcopy(item))
+            index[name] = len(merged) - 1
+    return merged
+
+
 def _field_value(data: Mapping[str, Any], dotted: str) -> Any:
     cur: Any = data
     for part in dotted.split("."):
@@ -293,12 +384,22 @@ class FakeCluster(Client):
         name: str,
         namespace: str = "",
         patch: Optional[Mapping[str, Any]] = None,
+        patch_type: str = "merge",
     ) -> KubeObject:
         with self._lock:
             self._react("patch", kind, {"name": name, "namespace": namespace,
-                                        "patch": dict(patch or {})})
+                                        "patch": dict(patch or {}),
+                                        "patch_type": patch_type})
             current = self._get_raw(kind, name, namespace)
-            merge_patch(current, patch or {})
+            if patch_type == "strategic":
+                strategic_merge_patch(current, patch or {})
+            elif patch_type == "merge":
+                merge_patch(current, patch or {})
+            else:
+                raise InvalidError(
+                    f"unsupported patch type {patch_type!r} "
+                    "(expected 'merge' or 'strategic')"
+                )
             # A patch cannot rename or unscope the object.
             meta = current.setdefault("metadata", {})
             meta["name"] = name

@@ -421,14 +421,24 @@ class RestClient(Client):
         name: str,
         namespace: str = "",
         patch: Optional[Mapping[str, Any]] = None,
+        patch_type: str = "merge",
     ) -> KubeObject:
         info = resource_for_kind(kind)
+        content_types = {
+            "merge": "application/merge-patch+json",
+            "strategic": "application/strategic-merge-patch+json",
+        }
+        if patch_type not in content_types:
+            raise InvalidError(
+                f"unsupported patch type {patch_type!r} "
+                "(expected 'merge' or 'strategic')"
+            )
         return wrap(
             self._request(
                 "PATCH",
                 self._path(info, namespace, name),
                 body=dict(patch or {}),
-                content_type="application/merge-patch+json",
+                content_type=content_types[patch_type],
             )
         )
 

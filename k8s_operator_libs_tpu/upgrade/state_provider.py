@@ -88,10 +88,15 @@ class NodeUpgradeStateProvider:
         new_state = UpgradeState(new_state)
         value: Optional[str] = str(new_state) if new_state != UpgradeState.UNKNOWN else None
         with self._mutex.locked(node.name):
+            # Strategic merge patch, matching the reference's label write
+            # (node_upgrade_state_provider.go:80-82); annotations below use
+            # RFC 7386 merge patch (:147-150). For string-map writes the two
+            # coincide — tests/test_patch_semantics.py pins the equivalence.
             self._client.patch(
                 "Node",
                 node.name,
                 patch={"metadata": {"labels": {self._keys.state_label: value}}},
+                patch_type="strategic",
             )
             self._await_visible(
                 node.name,

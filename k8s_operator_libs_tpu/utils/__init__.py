@@ -1,4 +1,18 @@
 from .sync import KeyedMutex, StringSet
 from .intstr import IntOrString
+from .jaxenv import (
+    hermetic_cpu_env,
+    plugin_shim_on_path,
+    probe_default_backend,
+    strip_plugin_paths,
+)
 
-__all__ = ["KeyedMutex", "StringSet", "IntOrString"]
+__all__ = [
+    "KeyedMutex",
+    "StringSet",
+    "IntOrString",
+    "hermetic_cpu_env",
+    "plugin_shim_on_path",
+    "probe_default_backend",
+    "strip_plugin_paths",
+]

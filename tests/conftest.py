@@ -4,17 +4,65 @@ JAX-dependent tests run on a virtual 8-device CPU mesh: multi-chip TPU
 hardware is not available in CI, so shardings/collectives are validated on
 host devices (the same XLA partitioner runs either way). Environment must be
 set before jax initializes its backends, hence module scope here.
+
+The deployment environment additionally injects a TPU device-plugin shim
+into every Python process via ``PYTHONPATH`` (a ``sitecustomize.py`` that
+registers an experimental PJRT plugin at interpreter startup). The shim
+hooks backend lookup, so merely setting ``JAX_PLATFORMS=cpu`` here is not
+enough: a wedged plugin tunnel hangs the whole suite, and its fd-level
+side effects break pytest's default ``--capture=fd``. When the shim is
+detected, the suite re-execs itself once with a hermetic CPU environment
+(``utils/jaxenv.py``) so ``python -m pytest tests/`` works where the
+driver runs, with no manual env tweaks. The re-exec happens in
+``pytest_configure`` — not at module scope — because pytest's global
+FD capture is already active while conftest loads; the capture must be
+torn down first or the re-exec'd process inherits a temp file as stdout
+and every byte of test output is lost.
 """
 
 import os
+import sys
 
-# Hard-set, not setdefault: the ambient environment pins JAX_PLATFORMS to
-# the single-chip TPU backend, but this suite is defined to run on the
-# virtual CPU mesh (multi-device shardings need 8 devices, and test runs
-# must not contend with bench/demo processes for the one real chip).
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "--xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+from k8s_operator_libs_tpu.utils.jaxenv import (  # noqa: E402
+    hermetic_cpu_env,
+    plugin_shim_on_path,
+)
+
+_REEXEC_MARK = "K8S_OPERATOR_LIBS_TPU_TEST_REEXEC"
+
+
+def _needs_reexec() -> bool:
+    return plugin_shim_on_path() and not os.environ.get(_REEXEC_MARK)
+
+
+def pytest_configure(config):
+    if not _needs_reexec():
+        return
+    # Restore the real stdout/stderr fds before replacing the process:
+    # global FD capture is live from initial-conftest loading onwards.
+    capman = config.pluginmanager.get_plugin("capturemanager")
+    if capman is not None:
+        capman.stop_global_capturing()
+    env = hermetic_cpu_env(8)
+    env[_REEXEC_MARK] = "1"
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)
+
+
+if not _needs_reexec():
+    # Hard-set, not setdefault: the ambient environment pins JAX_PLATFORMS
+    # to the single-chip TPU backend, but this suite is defined to run on
+    # the virtual CPU mesh (multi-device shardings need 8 devices, and test
+    # runs must not contend with bench/demo processes for the one real
+    # chip).
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()

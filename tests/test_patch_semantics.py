@@ -1,0 +1,245 @@
+"""Patch-semantics conformance (VERDICT round-1 item 8).
+
+The reference writes the node state label with a *strategic* merge patch
+(node_upgrade_state_provider.go:80-82) and annotations with an RFC 7386
+merge patch (:147-150). This suite (a) exercises the fake's strategic
+merge implementation directly, (b) pins the equivalence of the two patch
+types for every label/annotation write shape the state provider emits,
+and (c) runs the same battery over the wire (RestClient + LocalApiServer
+with the strategic content type), so the conformance claims hold on the
+HTTP path too. Set ``KUBE_CONFORMANCE_KUBECONFIG`` to additionally run
+the wire battery against a real apiserver (e.g. kind).
+"""
+
+import copy
+import os
+
+import pytest
+
+from k8s_operator_libs_tpu.kube import (
+    FakeCluster,
+    LocalApiServer,
+    RestClient,
+    RestConfig,
+)
+from k8s_operator_libs_tpu.kube.fake import merge_patch, strategic_merge_patch
+from k8s_operator_libs_tpu.upgrade import (
+    DeviceClass,
+    NodeUpgradeStateProvider,
+    UpgradeKeys,
+    UpgradeState,
+)
+from builders import make_node
+
+KEYS = UpgradeKeys(DeviceClass.tpu())
+
+
+class TestStrategicMergePatch:
+    def test_recursive_map_merge(self):
+        target = {"metadata": {"labels": {"a": "1"}}}
+        strategic_merge_patch(
+            target, {"metadata": {"labels": {"b": "2"}}}
+        )
+        assert target == {"metadata": {"labels": {"a": "1", "b": "2"}}}
+
+    def test_null_deletes_key(self):
+        target = {"metadata": {"labels": {"a": "1", "b": "2"}}}
+        strategic_merge_patch(target, {"metadata": {"labels": {"a": None}}})
+        assert target == {"metadata": {"labels": {"b": "2"}}}
+
+    def test_patch_replace_directive(self):
+        target = {"spec": {"selector": {"a": "1", "b": "2"}}}
+        strategic_merge_patch(
+            target, {"spec": {"selector": {"$patch": "replace", "c": "3"}}}
+        )
+        assert target == {"spec": {"selector": {"c": "3"}}}
+
+    def test_patch_delete_directive_on_map(self):
+        target = {"spec": {"drain": {"force": True}}}
+        strategic_merge_patch(target, {"spec": {"drain": {"$patch": "delete"}}})
+        assert target == {"spec": {}}
+
+    def test_list_of_objects_merges_by_name(self):
+        target = {
+            "spec": {
+                "containers": [
+                    {"name": "a", "image": "a:1"},
+                    {"name": "b", "image": "b:1"},
+                ]
+            }
+        }
+        strategic_merge_patch(
+            target,
+            {
+                "spec": {
+                    "containers": [
+                        {"name": "b", "image": "b:2"},
+                        {"name": "c", "image": "c:1"},
+                    ]
+                }
+            },
+        )
+        assert target["spec"]["containers"] == [
+            {"name": "a", "image": "a:1"},
+            {"name": "b", "image": "b:2"},
+            {"name": "c", "image": "c:1"},
+        ]
+
+    def test_list_item_delete_directive(self):
+        target = {"spec": {"containers": [{"name": "a"}, {"name": "b"}]}}
+        strategic_merge_patch(
+            target,
+            {"spec": {"containers": [{"$patch": "delete", "name": "a"}]}},
+        )
+        assert target["spec"]["containers"] == [{"name": "b"}]
+
+    def test_primitive_list_replaced(self):
+        target = {"metadata": {"finalizers": ["x", "y"]}}
+        strategic_merge_patch(target, {"metadata": {"finalizers": ["z"]}})
+        assert target["metadata"]["finalizers"] == ["z"]
+
+    def test_delete_directive_on_absent_list_is_noop(self):
+        # A $patch:delete of an element that does not exist must not store
+        # the directive as a phantom object, nor conjure the key into
+        # existence (real apiserver: no-op).
+        target = {"spec": {}}
+        strategic_merge_patch(
+            target,
+            {"spec": {"containers": [{"$patch": "delete", "name": "a"}]}},
+        )
+        assert target == {"spec": {}}
+
+    def test_explicit_empty_list_still_sets(self):
+        target = {"spec": {}}
+        strategic_merge_patch(target, {"spec": {"containers": []}})
+        assert target == {"spec": {"containers": []}}
+
+
+# Every label/annotation write shape the state provider emits
+# (state_provider.py): set a label, clear a label (null), set an
+# annotation, delete an annotation (null), mixed with pre-existing keys.
+_WRITE_SHAPES = [
+    {"metadata": {"labels": {KEYS.state_label: "upgrade-required"}}},
+    {"metadata": {"labels": {KEYS.state_label: None}}},
+    {"metadata": {"annotations": {"tpu.example.com/start": "123"}}},
+    {"metadata": {"annotations": {"tpu.example.com/start": None}}},
+    {
+        "metadata": {
+            "labels": {KEYS.state_label: "cordon-required"},
+            "annotations": {"a": "1", "b": None},
+        }
+    },
+]
+
+
+class TestMergeVsStrategicEquivalence:
+    @pytest.mark.parametrize("patch", _WRITE_SHAPES)
+    def test_string_map_writes_identical(self, patch):
+        base = {
+            "metadata": {
+                "labels": {KEYS.state_label: "upgrade-done", "keep": "me"},
+                "annotations": {"a": "0", "b": "x"},
+            }
+        }
+        via_merge = merge_patch(copy.deepcopy(base), patch)
+        via_strategic = strategic_merge_patch(copy.deepcopy(base), patch)
+        assert via_merge == via_strategic
+
+
+def _wire_battery(client):
+    """Label/annotation writes over the wire with both patch types."""
+    node = make_node("patch-sem-node", labels={"keep": "me"})
+    client.create(node)
+    try:
+        patched = client.patch(
+            "Node",
+            node.name,
+            patch={"metadata": {"labels": {KEYS.state_label: "upgrade-required"}}},
+            patch_type="strategic",
+        )
+        assert patched.labels[KEYS.state_label] == "upgrade-required"
+        assert patched.labels["keep"] == "me"
+
+        patched = client.patch(
+            "Node",
+            node.name,
+            patch={"metadata": {"labels": {KEYS.state_label: None}}},
+            patch_type="strategic",
+        )
+        assert KEYS.state_label not in patched.labels
+
+        patched = client.patch(
+            "Node",
+            node.name,
+            patch={"metadata": {"annotations": {"tpu.example.com/s": "1"}}},
+            patch_type="merge",
+        )
+        assert patched.annotations["tpu.example.com/s"] == "1"
+
+        patched = client.patch(
+            "Node",
+            node.name,
+            patch={"metadata": {"annotations": {"tpu.example.com/s": None}}},
+            patch_type="merge",
+        )
+        assert "tpu.example.com/s" not in patched.annotations
+    finally:
+        client.delete("Node", node.name)
+
+
+class TestWireConformance:
+    def test_local_apiserver_strategic_content_type(self):
+        with LocalApiServer() as srv:
+            _wire_battery(RestClient(RestConfig(server=srv.url)))
+
+    @pytest.mark.skipif(
+        not os.environ.get("KUBE_CONFORMANCE_KUBECONFIG"),
+        reason="set KUBE_CONFORMANCE_KUBECONFIG to run against a real apiserver",
+    )
+    def test_real_apiserver(self):
+        cfg = RestConfig.from_kubeconfig(
+            os.environ["KUBE_CONFORMANCE_KUBECONFIG"]
+        )
+        _wire_battery(RestClient(cfg))
+
+
+class TestCachedClientForwardsPatchType:
+    def test_cached_client_patch_type(self):
+        from k8s_operator_libs_tpu.kube.cache import CachedClient
+
+        cluster = FakeCluster()
+        seen: list[str] = []
+        cluster.add_reactor(
+            "patch",
+            "Node",
+            lambda verb, kind, payload: seen.append(payload["patch_type"]),
+        )
+        cluster.create(make_node("cached-node"))
+        cached = CachedClient(cluster)
+        cached.patch(
+            "Node",
+            "cached-node",
+            patch={"metadata": {"labels": {"x": "1"}}},
+            patch_type="strategic",
+        )
+        assert seen == ["strategic"]
+
+
+class TestProviderUsesStrategicForLabels:
+    def test_label_write_is_strategic_annotation_is_merge(self):
+        cluster = FakeCluster()
+        seen: list[tuple[str, str]] = []
+        cluster.add_reactor(
+            "patch",
+            "Node",
+            lambda verb, kind, payload: seen.append(
+                (payload["patch_type"], str(sorted(payload["patch"])))
+            ),
+        )
+        node = cluster.create(make_node("prov-node"))
+        provider = NodeUpgradeStateProvider(cluster, KEYS)
+        provider.change_node_upgrade_state(node, UpgradeState.UPGRADE_REQUIRED)
+        provider.change_node_upgrade_annotation(
+            node, "tpu.example.com/t", "1"
+        )
+        assert [pt for pt, _ in seen] == ["strategic", "merge"]
