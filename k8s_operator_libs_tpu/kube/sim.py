@@ -11,7 +11,7 @@ apiserver.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Callable, Optional
 
 from .client import NotFoundError
 from .fake import FakeCluster
@@ -154,3 +154,85 @@ class DaemonSetSimulator:
             if not pod.is_ready():
                 return False
         return True
+
+
+class ValidationPodSimulator:
+    """Kubelet stand-in for framework-provisioned validation pods.
+
+    ``ValidationPodManager.ensure`` creates probe pods pinned to nodes
+    (``tpu/validation_pod.py``); on a real cluster the kubelet runs the
+    probe payload and its readinessProbe flips the pod Ready when the
+    battery passes. This simulator plays that role against the in-memory
+    apiserver: each ``step`` advances Pending probe pods, and after
+    ``readiness_steps`` ticks the pod becomes Ready when ``decide(pod)``
+    says the node is healthy — or Failed when it does not (the payload
+    exits non-zero; restartPolicy is Never).
+
+    ``decide`` defaults to always-healthy; tests inject per-node failures,
+    and the bench can wire an actual ``IciHealthGate.run()`` so readiness
+    is backed by real probes on real devices.
+    """
+
+    def __init__(
+        self,
+        cluster: FakeCluster,
+        namespace: str = "kube-system",
+        label_selector: str = "app=tpu-health-probe",
+        readiness_steps: int = 1,
+        decide: Optional[Callable[[Pod], bool]] = None,
+    ) -> None:
+        self.cluster = cluster
+        self.namespace = namespace
+        self.label_selector = label_selector
+        self.readiness_steps = readiness_steps
+        self.decide = decide or (lambda pod: True)
+        self._pending: dict[str, int] = {}
+
+    def step(self) -> None:
+        pods = [
+            Pod(o.raw)
+            for o in self.cluster.list(
+                "Pod",
+                namespace=self.namespace,
+                label_selector=self.label_selector,
+            )
+        ]
+        seen = set()
+        for pod in pods:
+            if pod.is_finished() or pod.is_ready():
+                continue
+            seen.add(pod.name)
+            remaining = self._pending.get(pod.name, self.readiness_steps)
+            remaining -= 1
+            if remaining > 0:
+                self._pending[pod.name] = remaining
+                continue
+            self._pending.pop(pod.name, None)
+            healthy = self.decide(pod)
+            status = (
+                {
+                    "phase": "Running",
+                    "conditions": [{"type": "Ready", "status": "True"}],
+                    "containerStatuses": [
+                        {"name": "probe", "ready": True, "restartCount": 0}
+                    ],
+                }
+                if healthy
+                else {
+                    "phase": "Failed",
+                    "conditions": [{"type": "Ready", "status": "False"}],
+                    "containerStatuses": [
+                        {"name": "probe", "ready": False, "restartCount": 0}
+                    ],
+                }
+            )
+            try:
+                self.cluster.patch(
+                    "Pod", pod.name, self.namespace, patch={"status": status}
+                )
+            except NotFoundError:
+                continue
+        # Drop counters for pods that no longer exist (cleaned up).
+        for name in list(self._pending):
+            if name not in seen:
+                del self._pending[name]

@@ -2,6 +2,7 @@ from .detector import TpuNodeDetector, TpuNodeInfo
 from .planner import SliceAwareInplaceManager, enable_slice_aware_planning
 from .libtpu import LibtpuDaemonSetManager, LibtpuSpec
 from .health import HealthReport, IciHealthGate, SliceScopedGate
+from .validation_pod import ValidationPodManager, ValidationPodSpec
 
 __all__ = [
     "HealthReport",
@@ -12,5 +13,7 @@ __all__ = [
     "SliceAwareInplaceManager",
     "TpuNodeDetector",
     "TpuNodeInfo",
+    "ValidationPodManager",
+    "ValidationPodSpec",
     "enable_slice_aware_planning",
 ]

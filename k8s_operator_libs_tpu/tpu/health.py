@@ -235,6 +235,83 @@ class IciHealthGate:
         return hook
 
 
+def main(argv: Optional[list[str]] = None) -> int:
+    """Probe-pod payload: ``python -m k8s_operator_libs_tpu.tpu.health``.
+
+    Runs the gate battery on the devices this process can see (the node's
+    TPU chips, via the pod's ``google.com/tpu`` resource), prints the
+    report as one JSON line, and on pass writes ``--ready-file`` — the
+    pod's readinessProbe watches that file, so the reference's
+    pod-Ready gate (validation_manager.go:71-116) reads probe success as
+    pod readiness. ``--park`` keeps the process (and so the Ready
+    condition) alive after a pass; on failure the process exits non-zero,
+    the pod never becomes Ready, and validation times out into
+    ``upgrade-failed``.
+    """
+    import argparse
+    import dataclasses
+    import json
+
+    parser = argparse.ArgumentParser(
+        prog="k8s_operator_libs_tpu.tpu.health",
+        description="TPU ICI/MXU health gate (validation-pod payload)",
+    )
+    parser.add_argument("--payload-mb", type=float, default=4.0)
+    parser.add_argument("--matmul-size", type=int, default=1024)
+    parser.add_argument("--min-ring-gbps", type=float, default=0.0)
+    parser.add_argument("--min-mxu-tflops", type=float, default=0.0)
+    parser.add_argument(
+        "--pallas-matmul", action="store_true",
+        help="use the Pallas MXU kernel (TPU only)",
+    )
+    parser.add_argument(
+        "--flash-attention", action="store_true",
+        help="run the Pallas flash-attention probe (TPU only)",
+    )
+    parser.add_argument(
+        "--seq-parallel", action="store_true",
+        help="run ring/ulysses attention probes (needs >1 device)",
+    )
+    parser.add_argument("--no-burnin", action="store_true")
+    parser.add_argument(
+        "--ready-file", default="",
+        help="file written on pass (readinessProbe target)",
+    )
+    parser.add_argument(
+        "--park", action="store_true",
+        help="sleep forever after a pass (keeps the pod Ready)",
+    )
+    args = parser.parse_args(argv)
+
+    # Auto-enable the TPU-only kernels when a TPU is actually present, so
+    # the default pod command proves Pallas lowering without per-platform
+    # flag plumbing — and never crashes a CPU/test run.
+    import jax
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    gate = IciHealthGate(
+        min_ring_gbytes_per_s=args.min_ring_gbps,
+        min_mxu_tflops=args.min_mxu_tflops,
+        payload_mb=args.payload_mb,
+        matmul_size=args.matmul_size,
+        use_pallas_matmul=args.pallas_matmul or on_tpu,
+        run_burnin=not args.no_burnin,
+        run_seq_parallel_probes=args.seq_parallel,
+        run_flash_attention=args.flash_attention or on_tpu,
+    )
+    report = gate.run()
+    print(json.dumps(dataclasses.asdict(report)), flush=True)
+    if not report.ok:
+        return 1
+    if args.ready_file:
+        with open(args.ready_file, "w") as f:
+            f.write(report.summary() + "\n")
+    if args.park:
+        while True:
+            time.sleep(3600)
+    return 0
+
+
 class SliceScopedGate:
     """Slice-granular memoization of the health gate.
 
@@ -279,3 +356,7 @@ class SliceScopedGate:
             return report.ok
 
         return hook
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

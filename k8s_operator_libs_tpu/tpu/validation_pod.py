@@ -1,0 +1,170 @@
+"""Validation-pod deployment shape of the ICI health gate.
+
+The reference gates uncordon on a validation pod becoming Ready on the
+upgraded node (validation_manager.go:71-116) but leaves *deploying* that
+pod to the operator's chart. In production the controller does not sit on
+the TPU host, so the in-process ``IciHealthGate`` hook cannot see the
+upgraded node's slice; the probes must run *on the node*. This module
+closes that gap: the framework itself builds and provisions the probe pod,
+whose payload is ``python -m k8s_operator_libs_tpu.tpu.health`` — it runs
+the full collective/MXU/burn-in battery on the node's TPU devices, writes
+a readiness marker on pass and parks, so **pod Ready == fabric healthy**
+under exactly the reference's pod-selector gate semantics.
+
+Scheduling shape: the pod pins ``spec.nodeName`` (no scheduler involved —
+required because the node under validation is still cordoned), tolerates
+the TPU taints, and requests the node's ``google.com/tpu`` chips — free
+during validation because the node was drained, and released again by the
+post-pass cleanup so workloads can land after uncordon.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..kube.client import AlreadyExistsError, Client, NotFoundError
+from ..kube.objects import Node, Pod
+from ..upgrade.consts import DeviceClass
+from ..utils.log import get_logger
+from .libtpu import TPU_RESOURCE
+
+log = get_logger("tpu.validation_pod")
+
+#: Label identifying probe pods; the value feeds the pod_selector gate.
+VALIDATION_APP_LABEL = "app"
+VALIDATION_APP = "tpu-health-probe"
+
+#: Marker file the probe payload writes on pass; the pod's readinessProbe
+#: watches it, turning probe success into pod readiness.
+READY_FILE = "/tmp/tpu-health-ready"
+
+
+@dataclass
+class ValidationPodSpec:
+    """Probe-pod shape + gate thresholds serialized into the pod command."""
+
+    image: str = "tpu-operator.dev/tpu-health-probe"
+    tag: str = "latest"
+    namespace: str = "kube-system"
+    device: DeviceClass = field(default_factory=DeviceClass.tpu)
+    #: ``google.com/tpu`` chips to request — the whole host's complement
+    #: (4 on a v5e host) so the probe exercises every local chip.
+    tpu_chips: int = 4
+    payload_mb: float = 4.0
+    matmul_size: int = 1024
+    min_ring_gbytes_per_s: float = 0.0
+    min_mxu_tflops: float = 0.0
+    run_flash_attention: bool = True
+    run_seq_parallel_probes: bool = False
+    #: Seconds between readinessProbe executions / before first check.
+    probe_period_seconds: int = 10
+
+    @property
+    def full_image(self) -> str:
+        return f"{self.image}:{self.tag}"
+
+    @property
+    def pod_selector(self) -> str:
+        """Selector string for ``with_validation_enabled(pod_selector=...)``."""
+        return f"{VALIDATION_APP_LABEL}={VALIDATION_APP}"
+
+    def probe_command(self) -> list[str]:
+        """The payload: the health CLI, parked after a passing battery."""
+        cmd = [
+            "python", "-m", "k8s_operator_libs_tpu.tpu.health",
+            "--ready-file", READY_FILE,
+            "--park",
+            "--payload-mb", str(self.payload_mb),
+            "--matmul-size", str(self.matmul_size),
+        ]
+        if self.min_ring_gbytes_per_s > 0:
+            cmd += ["--min-ring-gbps", str(self.min_ring_gbytes_per_s)]
+        if self.min_mxu_tflops > 0:
+            cmd += ["--min-mxu-tflops", str(self.min_mxu_tflops)]
+        if self.run_flash_attention:
+            cmd.append("--flash-attention")
+        if self.run_seq_parallel_probes:
+            cmd.append("--seq-parallel")
+        return cmd
+
+
+class ValidationPodManager:
+    """Provisions one probe pod per node under validation.
+
+    Plugs into ``ValidationManager`` as its ``pod_provisioner``: ``ensure``
+    runs before the pod-readiness check (so the gate always has a pod to
+    watch), ``cleanup`` runs after validation passes (releasing the node's
+    TPU chips before uncordon).
+    """
+
+    def __init__(self, client: Client, spec: ValidationPodSpec) -> None:
+        self.client = client
+        self.spec = spec
+
+    def pod_name(self, node_name: str) -> str:
+        return f"{VALIDATION_APP}-{node_name}"
+
+    def build_pod(self, node_name: str) -> Pod:
+        spec = self.spec
+        pod = Pod.new(self.pod_name(node_name), namespace=spec.namespace)
+        pod.labels[VALIDATION_APP_LABEL] = VALIDATION_APP
+        pod.labels["device-class"] = spec.device.name
+        # nodeName pinning bypasses the scheduler: the node is cordoned
+        # while under validation, and kubelet admits pinned pods anyway —
+        # the same mechanics that let DaemonSet pods run on cordoned nodes.
+        pod.node_name = node_name
+        pod.spec["restartPolicy"] = "Never"
+        pod.spec["tolerations"] = [
+            {"key": TPU_RESOURCE, "operator": "Exists", "effect": "NoSchedule"},
+            {"operator": "Exists", "effect": "NoExecute"},
+        ]
+        pod.spec["containers"] = [
+            {
+                "name": "probe",
+                "image": spec.full_image,
+                "command": spec.probe_command(),
+                "resources": {
+                    "requests": {TPU_RESOURCE: str(spec.tpu_chips)},
+                    "limits": {TPU_RESOURCE: str(spec.tpu_chips)},
+                },
+                "readinessProbe": {
+                    "exec": {"command": ["cat", READY_FILE]},
+                    "initialDelaySeconds": spec.probe_period_seconds,
+                    "periodSeconds": spec.probe_period_seconds,
+                },
+            }
+        ]
+        return pod
+
+    def ensure(self, node: Node) -> Pod:
+        """Create the probe pod if absent; replace a finished (crashed or
+        completed) one so every validation attempt gets a live probe."""
+        name = self.pod_name(node.name)
+        existing = self.client.get_or_none("Pod", name, self.spec.namespace)
+        if existing is not None:
+            pod = Pod(existing.raw)
+            if not pod.is_finished():
+                return pod
+            log.info(
+                "validation pod %s finished in phase %s; recreating",
+                name, pod.phase,
+            )
+            try:
+                self.client.delete("Pod", name, self.spec.namespace)
+            except NotFoundError:
+                pass
+        desired = self.build_pod(node.name)
+        log.info("creating validation pod %s on node %s", name, node.name)
+        try:
+            return Pod(self.client.create(desired).raw)
+        except AlreadyExistsError:
+            return Pod(self.client.get("Pod", name, self.spec.namespace).raw)
+
+    def cleanup(self, node: Node) -> None:
+        """Delete the node's probe pod (validation passed — release chips)."""
+        try:
+            self.client.delete(
+                "Pod", self.pod_name(node.name), self.spec.namespace
+            )
+        except NotFoundError:
+            pass

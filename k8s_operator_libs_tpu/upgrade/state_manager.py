@@ -116,9 +116,19 @@ class ClusterUpgradeStateManager:
         pod_selector: str = "",
         validation_hook: Optional[ValidationHook] = None,
         timeout_seconds: Optional[int] = None,
+        pod_provisioner=None,
     ) -> "ClusterUpgradeStateManager":
         """Enable the validation state via a pod selector (reference
-        behavior) and/or an in-process hook (TPU ICI health gate)."""
+        behavior) and/or an in-process hook (TPU ICI health gate).
+
+        ``pod_provisioner`` (e.g. ``tpu.validation_pod.ValidationPodManager``)
+        makes the framework itself deploy the probe pod onto each node under
+        validation — the production shape, where the controller cannot see
+        the upgraded node's devices. A provisioner with a ``spec.pod_selector``
+        supplies the selector automatically."""
+        if pod_provisioner is not None and not pod_selector:
+            spec = getattr(pod_provisioner, "spec", None)
+            pod_selector = getattr(spec, "pod_selector", "") if spec else ""
         if not pod_selector and validation_hook is None:
             log.warning("cannot enable validation: no selector and no hook")
             return self
@@ -132,6 +142,7 @@ class ClusterUpgradeStateManager:
             pod_selector=pod_selector,
             validation_hook=validation_hook,
             recorder=self.recorder,
+            pod_provisioner=pod_provisioner,
             **kwargs,
         )
         self.common.validation_enabled = True

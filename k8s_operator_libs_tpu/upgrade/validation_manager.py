@@ -39,6 +39,20 @@ VALIDATION_TIMEOUT_SECONDS = 600
 ValidationHook = Callable[[Node], bool]
 
 
+class PodProvisioner:
+    """Duck-typed interface for validation-pod lifecycle management
+    (implemented by ``tpu.validation_pod.ValidationPodManager``): ``ensure``
+    is called before each readiness check so the pod_selector gate always
+    has a pod to watch; ``cleanup`` after the node passes, releasing the
+    node's accelerator resources before uncordon."""
+
+    def ensure(self, node: Node):  # pragma: no cover - protocol only
+        raise NotImplementedError
+
+    def cleanup(self, node: Node) -> None:  # pragma: no cover - protocol only
+        raise NotImplementedError
+
+
 class ValidationManager:
     def __init__(
         self,
@@ -49,6 +63,7 @@ class ValidationManager:
         validation_hook: Optional[ValidationHook] = None,
         timeout_seconds: int = VALIDATION_TIMEOUT_SECONDS,
         recorder=None,
+        pod_provisioner: Optional[PodProvisioner] = None,
     ) -> None:
         self._client = client
         self._provider = state_provider
@@ -57,6 +72,7 @@ class ValidationManager:
         self._hook = validation_hook
         self._timeout = timeout_seconds
         self._recorder = recorder
+        self._provisioner = pod_provisioner
 
     @property
     def enabled(self) -> bool:
@@ -66,6 +82,19 @@ class ValidationManager:
         """True when the node passes validation (reference: :71-116)."""
         if not self.enabled:
             return True
+        if self._provisioner is not None:
+            try:
+                self._provisioner.ensure(node)
+            except Exception as e:
+                # Provision failure is a validation failure, not a crash:
+                # the durable timeout clock still runs, so a node whose
+                # probe pod can never be created fails instead of hanging.
+                log.error(
+                    "validation pod provisioning failed on node %s: %s",
+                    node.name, e,
+                )
+                self._handle_timeout(node)
+                return False
         if self._pod_selector:
             pods = [
                 Pod(o.raw)
@@ -96,7 +125,18 @@ class ValidationManager:
                 self._event(node, "Warning", "Validation hook failed for the node")
                 self._handle_timeout(node)
                 return False
-        # Validation passed — clear the start-time annotation.
+        # Validation passed — clear the start-time annotation and release
+        # the probe pod's accelerator resources before uncordon.
+        if self._provisioner is not None:
+            try:
+                self._provisioner.cleanup(node)
+            except Exception as e:
+                # Best-effort: a lingering probe pod does not invalidate a
+                # passed probe; it is replaced on the next rollout anyway.
+                log.warning(
+                    "validation pod cleanup failed on node %s: %s",
+                    node.name, e,
+                )
         self._provider.change_node_upgrade_annotation(
             node, self._keys.validation_start_annotation, "null"
         )

@@ -1,0 +1,249 @@
+"""Validation-pod deployment shape: the framework provisions the probe pod.
+
+Reference semantics under test: validation gates uncordon on a pod matching
+pod_selector becoming Ready on the node (validation_manager.go:71-116) —
+but here the framework itself creates that pod (tpu/validation_pod.py), the
+simulated kubelet (ValidationPodSimulator) runs its payload, and readiness
+follows probe success/failure.
+"""
+
+import time
+
+from k8s_operator_libs_tpu.api import DriverUpgradePolicySpec
+from k8s_operator_libs_tpu.kube import FakeCluster, Node, Pod
+from k8s_operator_libs_tpu.kube.sim import DaemonSetSimulator, ValidationPodSimulator
+from k8s_operator_libs_tpu.tpu import ValidationPodManager, ValidationPodSpec
+from k8s_operator_libs_tpu.tpu.validation_pod import READY_FILE, VALIDATION_APP
+from k8s_operator_libs_tpu.upgrade import (
+    ClusterUpgradeStateManager,
+    DeviceClass,
+    TaskRunner,
+    UpgradeKeys,
+)
+from k8s_operator_libs_tpu.utils import IntOrString
+
+DEVICE = DeviceClass.tpu()
+KEYS = UpgradeKeys(DEVICE)
+NS = "kube-system"
+DS_LABELS = {"app": "libtpu-installer"}
+
+
+def make_ready_node(cluster, name):
+    node = Node.new(name)
+    node.set_ready(True)
+    cluster.create(node)
+    return node
+
+
+class TestPodShape:
+    def test_build_pod_pins_node_and_requests_tpus(self):
+        mgr = ValidationPodManager(FakeCluster(), ValidationPodSpec(tpu_chips=4))
+        pod = mgr.build_pod("node-a")
+        assert pod.node_name == "node-a"
+        assert pod.labels["app"] == VALIDATION_APP
+        assert pod.spec["restartPolicy"] == "Never"
+        container = pod.spec["containers"][0]
+        assert container["resources"]["limits"]["google.com/tpu"] == "4"
+        # Tolerates the TPU taint so kubelet admits it on a TPU node.
+        assert any(
+            t.get("key") == "google.com/tpu" for t in pod.spec["tolerations"]
+        )
+        # Readiness = probe success: the readinessProbe watches the marker
+        # file the payload writes on pass.
+        probe = container["readinessProbe"]["exec"]["command"]
+        assert READY_FILE in probe
+        assert "--ready-file" in container["command"]
+        assert "k8s_operator_libs_tpu.tpu.health" in container["command"]
+
+    def test_command_serializes_floors(self):
+        spec = ValidationPodSpec(
+            min_ring_gbytes_per_s=12.5, min_mxu_tflops=40.0
+        )
+        cmd = spec.probe_command()
+        assert "--min-ring-gbps" in cmd and "12.5" in cmd
+        assert "--min-mxu-tflops" in cmd and "40.0" in cmd
+
+    def test_pod_selector_matches_pod_labels(self):
+        spec = ValidationPodSpec()
+        pod = ValidationPodManager(FakeCluster(), spec).build_pod("n")
+        key, value = spec.pod_selector.split("=")
+        assert pod.labels[key] == value
+
+
+class TestEnsureAndCleanup:
+    def test_ensure_creates_once(self):
+        cluster = FakeCluster()
+        node = make_ready_node(cluster, "node-a")
+        mgr = ValidationPodManager(cluster, ValidationPodSpec())
+        first = mgr.ensure(node)
+        again = mgr.ensure(node)
+        assert first.name == again.name
+        assert len(cluster.list("Pod", namespace=NS)) == 1
+
+    def test_ensure_replaces_finished_pod(self):
+        cluster = FakeCluster()
+        node = make_ready_node(cluster, "node-a")
+        mgr = ValidationPodManager(cluster, ValidationPodSpec())
+        pod = mgr.ensure(node)
+        cluster.patch("Pod", pod.name, NS, patch={"status": {"phase": "Failed"}})
+        fresh = mgr.ensure(node)
+        assert fresh.phase != "Failed"
+
+    def test_cleanup_is_idempotent(self):
+        cluster = FakeCluster()
+        node = make_ready_node(cluster, "node-a")
+        mgr = ValidationPodManager(cluster, ValidationPodSpec())
+        mgr.ensure(node)
+        mgr.cleanup(node)
+        mgr.cleanup(node)  # second delete: no NotFoundError escapes
+        assert cluster.list("Pod", namespace=NS) == []
+
+
+def build_pool(n=3):
+    cluster = FakeCluster()
+    for i in range(n):
+        make_ready_node(cluster, f"node-{i}")
+    sim = DaemonSetSimulator(
+        cluster,
+        name="libtpu-installer",
+        namespace=NS,
+        match_labels=DS_LABELS,
+        initial_hash="v1",
+    )
+    sim.settle()
+    return cluster, sim
+
+
+def make_manager(cluster, provisioner, timeout_seconds=None):
+    mgr = ClusterUpgradeStateManager(
+        cluster, DEVICE, runner=TaskRunner(inline=True)
+    )
+    kwargs = {}
+    if timeout_seconds is not None:
+        kwargs["timeout_seconds"] = timeout_seconds
+    mgr.with_validation_enabled(pod_provisioner=provisioner, **kwargs)
+    return mgr
+
+
+POLICY = DriverUpgradePolicySpec(
+    auto_upgrade=True,
+    max_parallel_upgrades=0,
+    max_unavailable=IntOrString("100%"),
+)
+
+
+class TestEndToEnd:
+    def test_roll_gated_by_framework_provisioned_pods(self):
+        cluster, sim = build_pool()
+        spec = ValidationPodSpec()
+        provisioner = ValidationPodManager(cluster, spec)
+        vps = ValidationPodSimulator(cluster, namespace=spec.namespace)
+        mgr = make_manager(cluster, provisioner)
+
+        sim.set_template_hash("v2")
+        saw_probe_pod = False
+        for _ in range(40):
+            sim.step()
+            vps.step()
+            state = mgr.build_state(NS, DS_LABELS)
+            mgr.apply_state(state, POLICY)
+            sim.step()
+            if cluster.list("Pod", namespace=NS, label_selector=spec.pod_selector):
+                saw_probe_pod = True
+            if all(
+                n.labels.get(KEYS.state_label) == "upgrade-done"
+                for n in cluster.list("Node")
+            ) and sim.all_pods_ready_and_current():
+                break
+        else:
+            raise AssertionError("roll did not converge")
+        # Validation really happened through pods the framework created...
+        assert saw_probe_pod
+        # ...and passed probes were cleaned up, releasing the TPU chips.
+        assert (
+            cluster.list("Pod", namespace=NS, label_selector=spec.pod_selector)
+            == []
+        )
+        # No node skipped the cordon/validate cycle.
+        for node in cluster.list("Node"):
+            assert not Node(node.raw).unschedulable
+
+    def test_unhealthy_node_fails_validation(self):
+        cluster, sim = build_pool(n=2)
+        spec = ValidationPodSpec()
+        provisioner = ValidationPodManager(cluster, spec)
+
+        def decide(pod: Pod) -> bool:
+            return pod.node_name != "node-0"  # node-0's fabric is broken
+
+        vps = ValidationPodSimulator(
+            cluster, namespace=spec.namespace, decide=decide
+        )
+        mgr = make_manager(cluster, provisioner, timeout_seconds=0)
+
+        sim.set_template_hash("v2")
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            sim.step()
+            vps.step()
+            state = mgr.build_state(NS, DS_LABELS)
+            mgr.apply_state(state, POLICY)
+            sim.step()
+            labels = {
+                n.name: n.labels.get(KEYS.state_label)
+                for n in cluster.list("Node")
+            }
+            if (
+                labels.get("node-0") == "upgrade-failed"
+                and labels.get("node-1") == "upgrade-done"
+            ):
+                break
+            # the zero-second validation timeout still needs the wall clock
+            # to advance one whole second between passes
+            time.sleep(0.35)
+        else:
+            raise AssertionError(
+                "expected node-0 upgrade-failed + node-1 upgrade-done"
+            )
+        # The broken node stays cordoned — never returned to service.
+        assert Node(cluster.get("Node", "node-0").raw).unschedulable
+
+
+class TestHealthCli:
+    def test_payload_writes_ready_file_on_pass(self, tmp_path):
+        from k8s_operator_libs_tpu.tpu.health import main
+
+        ready = tmp_path / "ready"
+        rc = main(
+            [
+                "--no-burnin",
+                "--payload-mb", "0.05",
+                "--matmul-size", "64",
+                "--ready-file", str(ready),
+            ]
+        )
+        assert rc == 0
+        assert "ok=True" in ready.read_text()
+
+    def test_payload_exits_nonzero_on_floor_violation(self, tmp_path, capsys):
+        import json
+
+        from k8s_operator_libs_tpu.tpu.health import main
+
+        ready = tmp_path / "ready"
+        # An impossible MXU floor: the probe runs fine but the measured
+        # TFLOP/s can never reach it, so the gate must fail closed.
+        rc = main(
+            [
+                "--no-burnin",
+                "--payload-mb", "0.05",
+                "--matmul-size", "64",
+                "--min-mxu-tflops", "1e9",
+                "--ready-file", str(ready),
+            ]
+        )
+        assert rc == 1
+        assert not ready.exists()
+        report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert report["ok"] is False
+        assert any("below floor" in f for f in report["failures"])
