@@ -196,6 +196,67 @@ def run_roll(slice_aware: bool) -> dict:
     }
 
 
+def run_requestor_roll() -> dict:
+    """BASELINE config #4: the roll delegated to an external maintenance
+    operator over NodeMaintenance CRs (full lifecycle: finalizer, cordon,
+    wait, drain, Ready, uncordon-on-delete) via
+    MaintenanceOperatorSimulator — the requestor-mode protocol end to end
+    (upgrade_requestor.go:29-66)."""
+    from k8s_operator_libs_tpu.kube.sim import MaintenanceOperatorSimulator
+    from k8s_operator_libs_tpu.upgrade import (
+        RequestorOptions,
+        enable_requestor_mode,
+    )
+
+    cluster, sim = build_pool()
+    mgr = ClusterUpgradeStateManager(
+        cluster, DEVICE, runner=TaskRunner(inline=True)
+    )
+    enable_requestor_mode(
+        mgr,
+        RequestorOptions(
+            use_maintenance_operator=True,
+            requestor_id="tpu.operator.dev",
+            namespace=NS,
+        ),
+    )
+    mgr.with_validation_enabled(validation_hook=make_gate(slice_scoped=True))
+    policy = DriverUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=1,
+        max_unavailable=IntOrString("25%"),
+    )
+    operator = MaintenanceOperatorSimulator(cluster, namespace=NS)
+
+    sim.set_template_hash("libtpu-v2")
+    start = time.perf_counter()
+    passes = 0
+    for _ in range(MAX_PASSES):
+        passes += 1
+        sim.step()
+        operator.step()
+        state = mgr.build_state(NS, DS_LABELS)
+        mgr.apply_state(state, policy)
+        sim.step()
+        done = all(
+            n.labels.get(KEYS.state_label) == "upgrade-done"
+            for n in cluster.list("Node")
+        )
+        if done and sim.all_pods_ready_and_current():
+            operator.step()  # finalize deletion-marked CRs
+            break
+    else:
+        raise RuntimeError("requestor-mode upgrade did not converge")
+    elapsed = time.perf_counter() - start
+    crs_left = len(cluster.list("NodeMaintenance", namespace=NS))
+    return {
+        "wall_s": round(elapsed, 3),
+        "passes": passes,
+        "crs_left": crs_left,
+        "converged": crs_left == 0,
+    }
+
+
 def run_calibration() -> dict:
     """One full-battery gate run on the real devices.
 
@@ -244,11 +305,13 @@ def main() -> None:
 
     baseline = run_roll(slice_aware=False)
     ours = run_roll(slice_aware=True)
+    requestor = run_requestor_roll()
 
     details = {
         "backend": backend,
         "ours": ours,
         "reference_equivalent": baseline,
+        "requestor_mode": requestor,
         "devices": [str(d) for d in jax.devices()],
         "calibration": calibration,
         "vs_baseline_note": "self-relative: ours vs this framework in "

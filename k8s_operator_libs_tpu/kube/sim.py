@@ -15,7 +15,7 @@ from typing import Callable, Optional
 
 from .client import NotFoundError
 from .fake import FakeCluster
-from .objects import ControllerRevision, DaemonSet, Pod
+from .objects import ControllerRevision, DaemonSet, NodeMaintenance, Pod
 
 
 class DaemonSetSimulator:
@@ -177,10 +177,16 @@ class ValidationPodSimulator:
         self,
         cluster: FakeCluster,
         namespace: str = "kube-system",
-        label_selector: str = "app=tpu-health-probe",
+        label_selector: Optional[str] = None,
         readiness_steps: int = 1,
         decide: Optional[Callable[[Pod], bool]] = None,
     ) -> None:
+        if label_selector is None:
+            # Default to the manager's probe-pod selector (lazy import:
+            # tpu/ imports kube/, so a module-level import would cycle).
+            from ..tpu.validation_pod import VALIDATION_APP, VALIDATION_APP_LABEL
+
+            label_selector = f"{VALIDATION_APP_LABEL}={VALIDATION_APP}"
         self.cluster = cluster
         self.namespace = namespace
         self.label_selector = label_selector
@@ -236,3 +242,133 @@ class ValidationPodSimulator:
         for name in list(self._pending):
             if name not in seen:
                 del self._pending[name]
+
+
+class MaintenanceOperatorSimulator:
+    """External maintenance-operator stand-in for requestor-mode e2e.
+
+    Plays the other party of the NodeMaintenance protocol the requestor
+    mode delegates to (upgrade_requestor.go:29-66): watches NodeMaintenance
+    CRs, performs cordon → wait-for-completion → drain against the
+    apiserver itself, then reports ``Ready`` — the reference e2e suites
+    fake this by flipping conditions directly (upgrade_suit_test.go:282-293);
+    this simulator performs the real node operations so a requestor-mode
+    roll exercises the full CR lifecycle.
+
+    One ``step`` advances each CR one stage, mirroring the real operator's
+    reconcile cadence:
+
+    ``Pending → Cordon → WaitForPodCompletion → Draining → Ready``
+
+    Progress is stored in the CR's Ready condition reason (not in-memory),
+    so the simulator is restartable mid-maintenance like the operator it
+    models. A CR with a deletionTimestamp is finalized: the node is
+    uncordoned and the finalizer removed, letting the apiserver complete
+    the delete (fake.py finalizer semantics).
+    """
+
+    FINALIZER = "maintenance.finalizers.sim"
+
+    REASON_PENDING = "Pending"
+    REASON_CORDON = "Cordon"
+    REASON_WAIT = "WaitForPodCompletion"
+    REASON_DRAIN = "Draining"
+    REASON_READY = NodeMaintenance.CONDITION_REASON_READY
+
+    def __init__(
+        self,
+        cluster: FakeCluster,
+        namespace: str = "default",
+        drain_finished_pods_only: bool = False,
+    ) -> None:
+        from .drain import DrainHelper
+
+        self.cluster = cluster
+        self.namespace = namespace
+        self.drain = DrainHelper(cluster)
+        self.drain_finished_pods_only = drain_finished_pods_only
+
+    # -- reconcile ---------------------------------------------------------
+    def step(self) -> None:
+        for obj in self.cluster.list("NodeMaintenance", namespace=self.namespace):
+            nm = NodeMaintenance(obj.raw)
+            if nm.deletion_timestamp is not None:
+                self._finalize(nm)
+                continue
+            self._advance(nm)
+
+    def _advance(self, nm: NodeMaintenance) -> None:
+        if self.FINALIZER not in nm.finalizers:
+            nm.finalizers.append(self.FINALIZER)
+            self.cluster.update(nm)
+            nm = NodeMaintenance(
+                self.cluster.get("NodeMaintenance", nm.name, nm.namespace).raw
+            )
+        reason = nm.ready_reason() or self.REASON_PENDING
+        node_name = nm.node_name
+        if reason == self.REASON_PENDING:
+            self._set_reason(nm, self.REASON_CORDON)
+        elif reason == self.REASON_CORDON:
+            self.drain.cordon(node_name)
+            self._set_reason(nm, self.REASON_WAIT)
+        elif reason == self.REASON_WAIT:
+            if self._completion_wait_done(nm):
+                self._set_reason(nm, self.REASON_DRAIN)
+        elif reason == self.REASON_DRAIN:
+            self._drain(nm)
+            self._set_reason(nm, self.REASON_READY, status="True")
+        # REASON_READY: nothing left; the requestor observes and releases.
+
+    def _finalize(self, nm: NodeMaintenance) -> None:
+        if nm.node_name:
+            self.drain.uncordon(nm.node_name)
+        if self.FINALIZER in nm.finalizers:
+            nm.finalizers.remove(self.FINALIZER)
+            self.cluster.update(nm)
+
+    # -- stages ------------------------------------------------------------
+    def _completion_wait_done(self, nm: NodeMaintenance) -> bool:
+        """waitForPodCompletion: all pods matching the selector on the node
+        have finished (no selector → nothing to wait for)."""
+        wait = nm.spec.get("waitForPodCompletion") or {}
+        selector = wait.get("podSelector", "")
+        if not selector:
+            return True
+        pods = self.cluster.list(
+            "Pod",
+            label_selector=selector,
+            field_selector=f"spec.nodeName={nm.node_name}",
+        )
+        return all(Pod(p.raw).is_finished() for p in pods)
+
+    def _drain(self, nm: NodeMaintenance) -> None:
+        from .drain import DrainConfig
+
+        drain_spec = nm.spec.get("drainSpec") or {}
+        cfg = DrainConfig(
+            force=bool(drain_spec.get("force", True)),
+            delete_empty_dir=bool(drain_spec.get("deleteEmptyDir", True)),
+            pod_selector=drain_spec.get("podSelector", ""),
+            timeout_seconds=int(drain_spec.get("timeoutSeconds", 0)),
+        )
+        self.drain.drain(nm.node_name, cfg)
+
+    def _set_reason(
+        self, nm: NodeMaintenance, reason: str, status: str = "False"
+    ) -> None:
+        self.cluster.patch(
+            "NodeMaintenance",
+            nm.name,
+            nm.namespace,
+            patch={
+                "status": {
+                    "conditions": [
+                        {
+                            "type": NodeMaintenance.CONDITION_READY,
+                            "status": status,
+                            "reason": reason,
+                        }
+                    ]
+                }
+            },
+        )

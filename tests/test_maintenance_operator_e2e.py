@@ -1,0 +1,254 @@
+"""Requestor-mode end-to-end with a full maintenance-operator lifecycle.
+
+BASELINE config #4: the requestor delegates cordon/drain to an external
+maintenance operator. The unit suite (test_requestor.py) fakes the operator
+by flipping CR conditions, as the reference e2e does
+(upgrade_suit_test.go:282-293); here MaintenanceOperatorSimulator performs
+the real node operations — finalizer, cordon, wait-for-completion, drain,
+Ready, and uncordon-on-delete — so a multi-pass roll exercises the whole CR
+protocol (upgrade_requestor.go:29-66, 320-452) against live cordon/drain
+state.
+"""
+
+from k8s_operator_libs_tpu.api import DrainSpec, DriverUpgradePolicySpec
+from k8s_operator_libs_tpu.kube import FakeCluster, Node, NodeMaintenance, Pod
+from k8s_operator_libs_tpu.kube.sim import (
+    DaemonSetSimulator,
+    MaintenanceOperatorSimulator,
+)
+from k8s_operator_libs_tpu.upgrade import (
+    ClusterUpgradeStateManager,
+    DeviceClass,
+    RequestorNodeStateManager,
+    RequestorOptions,
+    TaskRunner,
+    UpgradeKeys,
+)
+from k8s_operator_libs_tpu.utils import IntOrString
+from builders import make_node
+
+DEVICE = DeviceClass.tpu()
+KEYS = UpgradeKeys(DEVICE)
+NS = "driver-ns"
+LABELS = {"app": "libtpu-installer"}
+MAINT_NS = "maintenance-ns"
+
+POLICY = DriverUpgradePolicySpec(
+    auto_upgrade=True,
+    max_parallel_upgrades=0,
+    max_unavailable=IntOrString("100%"),
+    drain=DrainSpec(enable=True, force=True, timeout_seconds=120),
+)
+
+
+def build_harness(node_count=3, requestor_id="tpu.operator.dev"):
+    cluster = FakeCluster()
+    for i in range(node_count):
+        cluster.create(make_node(f"node-{i}"))
+    sim = DaemonSetSimulator(
+        cluster, name="libtpu-installer", namespace=NS, match_labels=LABELS
+    )
+    sim.settle()
+    opts = RequestorOptions(
+        use_maintenance_operator=True,
+        requestor_id=requestor_id,
+        namespace=MAINT_NS,
+    )
+    from k8s_operator_libs_tpu.upgrade import enable_requestor_mode
+
+    mgr = ClusterUpgradeStateManager(
+        cluster, DEVICE, runner=TaskRunner(inline=True)
+    )
+    enable_requestor_mode(mgr, opts)
+    operator = MaintenanceOperatorSimulator(cluster, namespace=MAINT_NS)
+    return cluster, sim, mgr, operator, opts
+
+
+def add_workload(cluster, node_name):
+    """A controller-owned workload pod the operator's drain must evict."""
+    pod = Pod.new(f"workload-{node_name}", namespace="default")
+    pod.node_name = node_name
+    pod.labels["app"] = "training"
+    pod.metadata["ownerReferences"] = [
+        {
+            "apiVersion": "apps/v1",
+            "kind": "ReplicaSet",
+            "name": "training",
+            "uid": "u1",
+            "controller": True,
+        }
+    ]
+    pod.phase = "Running"
+    cluster.create(pod)
+    return pod
+
+
+def drive(cluster, sim, mgr, operator, max_passes=80):
+    """One reconcile cadence: operator tick, controller pass, kubelet tick."""
+    for i in range(max_passes):
+        sim.step()
+        operator.step()
+        state = mgr.build_state(NS, LABELS)
+        mgr.apply_state(state, POLICY)
+        sim.step()
+        done = all(
+            n.labels.get(KEYS.state_label) == "upgrade-done"
+            for n in cluster.list("Node")
+        )
+        if done and sim.all_pods_ready_and_current():
+            # The operator keeps reconciling after the roll: finalize any
+            # deletion-marked CRs (uncordon + finalizer removal).
+            operator.step()
+            return i + 1
+    raise AssertionError("requestor-mode roll did not converge")
+
+
+class TestFullLifecycle:
+    def test_roll_through_real_operator(self):
+        cluster, sim, mgr, operator, opts = build_harness()
+        for i in range(3):
+            add_workload(cluster, f"node-{i}")
+
+        observed_cordons = set()
+        observed_crs = set()
+        sim.set_template_hash("v2")
+
+        # Wrap drive() so we can observe mid-roll facts.
+        passes = 0
+        for _ in range(80):
+            passes += 1
+            sim.step()
+            operator.step()
+            state = mgr.build_state(NS, LABELS)
+            mgr.apply_state(state, POLICY)
+            sim.step()
+            for nm in cluster.list("NodeMaintenance", namespace=MAINT_NS):
+                observed_crs.add(nm.name)
+            for node in cluster.list("Node"):
+                if Node(node.raw).unschedulable:
+                    observed_cordons.add(node.name)
+            done = all(
+                n.labels.get(KEYS.state_label) == "upgrade-done"
+                for n in cluster.list("Node")
+            )
+            if done and sim.all_pods_ready_and_current():
+                operator.step()  # finalize deletion-marked CRs
+                break
+        else:
+            raise AssertionError("requestor-mode roll did not converge")
+
+        # The *operator* (not the controller) cordoned every node.
+        assert observed_cordons == {"node-0", "node-1", "node-2"}
+        # One CR per node, named by the requestor prefix.
+        assert observed_crs == {
+            f"{opts.node_maintenance_name_prefix}-node-{i}" for i in range(3)
+        }
+        # Drain really happened: the workload pods are gone.
+        assert cluster.list("Pod", namespace="default") == []
+        # Owner released every CR and the operator finalized the deletes.
+        assert cluster.list("NodeMaintenance", namespace=MAINT_NS) == []
+        # Finalization uncordoned every node.
+        for node in cluster.list("Node"):
+            assert not Node(node.raw).unschedulable
+
+    def test_cr_carries_drain_spec_from_policy(self):
+        cluster, sim, mgr, operator, opts = build_harness(node_count=1)
+        sim.set_template_hash("v2")
+        # Two controller passes: upgrade-required → CR created.
+        for _ in range(3):
+            sim.step()
+            mgr.apply_state(mgr.build_state(NS, LABELS), POLICY)
+            crs = cluster.list("NodeMaintenance", namespace=MAINT_NS)
+            if crs:
+                break
+        assert crs, "CR was never created"
+        nm = NodeMaintenance(crs[0].raw)
+        assert nm.requestor_id == opts.requestor_id
+        assert nm.spec["drainSpec"]["force"] is True
+        assert nm.spec["drainSpec"]["timeoutSeconds"] == 120
+
+    def test_operator_is_restartable_mid_maintenance(self):
+        """Progress lives in the CR, not the simulator: a replacement
+        operator instance picks up where the crashed one stopped."""
+        cluster, sim, mgr, operator, opts = build_harness(node_count=1)
+        sim.set_template_hash("v2")
+        # Run until the CR is mid-lifecycle (cordon stage reached).
+        for _ in range(6):
+            sim.step()
+            operator.step()
+            mgr.apply_state(mgr.build_state(NS, LABELS), POLICY)
+            node = Node(cluster.get("Node", "node-0").raw)
+            if node.unschedulable:
+                break
+        assert Node(cluster.get("Node", "node-0").raw).unschedulable
+        # "Crash" the operator; a fresh instance resumes from CR state.
+        fresh_operator = MaintenanceOperatorSimulator(cluster, namespace=MAINT_NS)
+        for _ in range(40):
+            sim.step()
+            fresh_operator.step()
+            mgr.apply_state(mgr.build_state(NS, LABELS), POLICY)
+            sim.step()
+            if (
+                Node(cluster.get("Node", "node-0").raw).labels.get(
+                    KEYS.state_label
+                )
+                == "upgrade-done"
+            ):
+                fresh_operator.step()  # finalize the deletion-marked CR
+                break
+        else:
+            raise AssertionError("roll did not converge after operator restart")
+        assert not Node(cluster.get("Node", "node-0").raw).unschedulable
+
+
+class TestSharedRequestor:
+    def test_second_requestor_joins_and_owner_releases(self):
+        """Two operators coordinate on one CR: the second appends itself to
+        additionalRequestors (upgrade_requestor.go:320-368); when the owner
+        finishes it deletes the CR and maintenance ends for both."""
+        cluster, sim, mgr, operator, opts = build_harness(node_count=1)
+        sim.set_template_hash("v2")
+
+        # Drive until the owner's CR exists.
+        for _ in range(4):
+            sim.step()
+            operator.step()
+            mgr.apply_state(mgr.build_state(NS, LABELS), POLICY)
+            crs = cluster.list("NodeMaintenance", namespace=MAINT_NS)
+            if crs:
+                break
+        assert crs
+        nm = NodeMaintenance(crs[0].raw)
+
+        # A second operator (NIC firmware, say) joins the same CR.
+        nic_opts = RequestorOptions(
+            use_maintenance_operator=True,
+            requestor_id="nic.operator.dev",
+            namespace=MAINT_NS,
+        )
+        nic = RequestorNodeStateManager(cluster, mgr.common, nic_opts)
+
+        class FakeNodeState:
+            node = Node(cluster.get("Node", "node-0").raw)
+            node_maintenance = nm
+
+        nic.create_or_update_node_maintenance(FakeNodeState(), POLICY)
+        joined = NodeMaintenance(
+            cluster.get("NodeMaintenance", nm.name, MAINT_NS).raw
+        )
+        assert joined.additional_requestors == ["nic.operator.dev"]
+        assert joined.requestor_id == opts.requestor_id  # ownership unchanged
+
+        # Non-owner finishes first: removes itself, CR survives. (The real
+        # flow re-reads the CR each pass via build_state; refresh likewise.)
+        FakeNodeState.node_maintenance = joined
+        nic.delete_or_update_node_maintenance(FakeNodeState())
+        after_nic = NodeMaintenance(
+            cluster.get("NodeMaintenance", nm.name, MAINT_NS).raw
+        )
+        assert after_nic.additional_requestors == []
+
+        # Owner's roll completes: CR deleted, node uncordoned, upgrade done.
+        drive(cluster, sim, mgr, operator)
+        assert cluster.list("NodeMaintenance", namespace=MAINT_NS) == []
+        assert not Node(cluster.get("Node", "node-0").raw).unschedulable
