@@ -77,12 +77,41 @@ class MxuReport:
     error: str = ""
 
 
+@partial(jax.jit, static_argnames=("chain", "use_pallas", "interpret"))
+def _chained_matmul(a, b, chain: int, use_pallas: bool, interpret: bool):
+    """``chain`` back-to-back matmuls in ONE compiled program, reduced to a
+    scalar.
+
+    Throughput must be measured against device time, but a single dispatch
+    measures the host↔device round trip too — on a tunneled/remote PJRT
+    runtime that latency is ~65 ms and swamps a single matmul's ~0.1 ms of
+    MXU time (a 2048³ probe reads 0.26 "TFLOP/s" while the chip sustains
+    ~160). Chaining with a data dependency (each matmul consumes the
+    previous result, so XLA can neither elide nor overlap them) amortizes
+    one dispatch over ``chain`` matmuls; the rolled ``fori_loop`` keeps the
+    HLO small at any chain length. Returning one element keeps the
+    completion-sync transfer tiny. ``b`` should be pre-scaled by 1/sqrt(K)
+    so magnitudes stay O(1) along the chain.
+    """
+    dtype = a.dtype
+
+    def body(_, acc):
+        lhs = acc.astype(dtype)
+        if use_pallas and _HAS_PALLAS:
+            return matmul(lhs, b, interpret=interpret)
+        return jnp.dot(lhs, b, preferred_element_type=jnp.float32)
+
+    out = jax.lax.fori_loop(0, chain, body, a.astype(jnp.float32))
+    return out[0, 0]
+
+
 def mxu_probe(
     size: int = 2048,
     dtype=jnp.bfloat16,
     use_pallas: bool = True,
     interpret: bool = False,
     iters: int = 3,
+    chain: int = 0,
     device=None,
 ) -> MxuReport:
     """Numerics-checked matmul throughput measurement.
@@ -90,15 +119,32 @@ def mxu_probe(
     ``use_pallas=False`` falls back to the XLA-native dot — used on
     platforms where the Pallas TPU lowering is unavailable (the probe should
     degrade, not die, on exotic runtimes). ``device`` pins the probe to a
-    specific device (default: the platform default).
+    specific device (default: the platform default). ``chain`` sets how
+    many dependent matmuls each timed dispatch runs (0 = auto: 2048 on an
+    accelerator, where dispatch latency would otherwise dominate; 1 under
+    interpret/CPU, where the chain would only slow the suite down).
     """
     if device is not None:
         with jax.default_device(device):
             return mxu_probe(
                 size=size, dtype=dtype, use_pallas=use_pallas,
-                interpret=interpret, iters=iters, device=None,
+                interpret=interpret, iters=iters, chain=chain, device=None,
             )
     try:
+        if chain <= 0:
+            on_accel = (
+                not interpret and jax.devices()[0].platform != "cpu"
+            )
+            chain = 2048 if on_accel else 1
+        if use_pallas and size % 256:
+            # The Pallas kernel tiles (256, 256) output blocks; a probe
+            # size that cannot tile must degrade to the XLA dot, not fail
+            # a healthy node with "probe shapes must tile".
+            log.warning(
+                "matmul size %d not a multiple of 256; Pallas path "
+                "disabled for this probe", size,
+            )
+            use_pallas = False
         key_a, key_b = jax.random.split(jax.random.PRNGKey(0))
         a = jax.random.normal(key_a, (size, size), dtype=jnp.float32)
         b = jax.random.normal(key_b, (size, size), dtype=jnp.float32)
@@ -130,13 +176,25 @@ def mxu_probe(
                 error=f"numerics mismatch: max_abs_err={max_err:.4f} > {tol:.4f}",
             )
 
+        # Keep chain magnitudes O(1): each link multiplies by b/sqrt(K).
+        b_scaled = (b / np.sqrt(size)).astype(dtype)
+        # Sync via a host-scalar fetch: block_until_ready() on some remote
+        # PJRT runtimes returns before execution finishes, making timings
+        # fantasy (553 PFLOP/s observed); a device→host read cannot lie.
+        timed = lambda: float(  # noqa: E731
+            _chained_matmul(
+                a_lp, b_scaled, chain=chain,
+                use_pallas=use_pallas, interpret=interpret,
+            )
+        )
+        timed()  # compile outside the timed region
         samples = []
         for _ in range(iters):
             start = time.perf_counter()
-            run().block_until_ready()
+            timed()
             samples.append(time.perf_counter() - start)
         elapsed = float(np.median(samples))
-        flops = 2.0 * size**3
+        flops = 2.0 * size**3 * chain
         report = MxuReport(ok=True, tflops=flops / elapsed / 1e12, max_abs_err=max_err)
         log.info("MXU probe: %.2f TFLOP/s (max_abs_err %.2e)", report.tflops, max_err)
         return report

@@ -26,6 +26,10 @@ from ..kube.client import AlreadyExistsError, Client, NotFoundError
 from ..kube.objects import Node, Pod
 from ..upgrade.consts import DeviceClass
 from ..utils.log import get_logger
+from .health import (
+    TPU_DEFAULT_MIN_MXU_TFLOPS,
+    TPU_DEFAULT_MIN_RING_GBYTES_PER_S,
+)
 from .libtpu import TPU_RESOURCE
 
 log = get_logger("tpu.validation_pod")
@@ -52,8 +56,11 @@ class ValidationPodSpec:
     tpu_chips: int = 4
     payload_mb: float = 4.0
     matmul_size: int = 1024
-    min_ring_gbytes_per_s: float = 0.0
-    min_mxu_tflops: float = 0.0
+    #: Perf floors armed by default at the calibrated v5e values
+    #: (health.py TPU_DEFAULT_*): the probe pod runs on real TPU chips, so
+    #: a half-speed link or collapsed MXU fails validation out of the box.
+    min_ring_gbytes_per_s: float = TPU_DEFAULT_MIN_RING_GBYTES_PER_S
+    min_mxu_tflops: float = TPU_DEFAULT_MIN_MXU_TFLOPS
     run_flash_attention: bool = True
     run_seq_parallel_probes: bool = False
     #: Seconds between readinessProbe executions / before first check.

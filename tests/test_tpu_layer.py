@@ -12,6 +12,7 @@ from k8s_operator_libs_tpu.parallel.topology import (
     GKE_TPU_TOPOLOGY_LABEL,
 )
 from k8s_operator_libs_tpu.tpu import (
+    IciHealthGate,
     LibtpuDaemonSetManager,
     LibtpuSpec,
     TpuNodeDetector,
@@ -294,3 +295,74 @@ class TestLibtpuDaemonSet:
         mgr.apply()
         assert mgr.delete() is True
         assert mgr.delete() is False
+
+
+class TestCalibratedFloors:
+    """VERDICT item 7: the gate's perf floors are armed by default for the
+    TPU device class, calibrated from real-v5e measurements (health.py
+    TPU_DEFAULT_*), and a throttled probe fails validation."""
+
+    def test_tpu_defaults_arm_floors_and_kernels(self):
+        from k8s_operator_libs_tpu.tpu.health import (
+            TPU_DEFAULT_MIN_MXU_TFLOPS,
+            TPU_DEFAULT_MIN_RING_GBYTES_PER_S,
+        )
+
+        gate = IciHealthGate.tpu_defaults()
+        assert gate.min_mxu_tflops == TPU_DEFAULT_MIN_MXU_TFLOPS > 0
+        assert (
+            gate.min_ring_gbytes_per_s
+            == TPU_DEFAULT_MIN_RING_GBYTES_PER_S
+            > 0
+        )
+        assert gate.use_pallas_matmul and gate.run_flash_attention
+        # Overrides win (per-device-class retuning).
+        assert IciHealthGate.tpu_defaults(min_mxu_tflops=7.5).min_mxu_tflops == 7.5
+
+    def test_throttled_mxu_fails_the_gate(self):
+        import jax
+
+        gate = IciHealthGate(
+            min_mxu_tflops=1e9,  # no real device reaches this: "throttled"
+            payload_mb=0.05,
+            matmul_size=64,
+            run_burnin=False,
+        )
+        report = gate.run()
+        assert not report.ok
+        assert any("below floor" in f for f in report.failures)
+
+    def test_throttled_ring_fails_the_gate_on_multi_device(self):
+        gate = IciHealthGate(
+            min_ring_gbytes_per_s=1e9,
+            payload_mb=0.05,
+            matmul_size=64,
+            run_burnin=False,
+        )
+        report = gate.run()  # conftest: 8 virtual devices → links exist
+        assert not report.ok
+        assert any("ring bandwidth" in f and "below floor" in f for f in report.failures)
+
+    def test_ring_floor_vacuous_on_single_device(self):
+        import jax
+
+        gate = IciHealthGate(
+            min_ring_gbytes_per_s=1e9,
+            payload_mb=0.05,
+            matmul_size=64,
+            run_burnin=False,
+            devices=[jax.devices()[0]],  # no ICI links to gate
+        )
+        report = gate.run()
+        assert not any("ring bandwidth" in f for f in report.failures)
+
+    def test_validation_pod_serializes_armed_floors(self):
+        from k8s_operator_libs_tpu.tpu import ValidationPodSpec
+        from k8s_operator_libs_tpu.tpu.health import (
+            TPU_DEFAULT_MIN_MXU_TFLOPS,
+            TPU_DEFAULT_MIN_RING_GBYTES_PER_S,
+        )
+
+        cmd = ValidationPodSpec().probe_command()
+        assert str(TPU_DEFAULT_MIN_MXU_TFLOPS) in cmd
+        assert str(TPU_DEFAULT_MIN_RING_GBYTES_PER_S) in cmd
