@@ -338,9 +338,35 @@ class CommonUpgradeManager:
     def process_upgrade_failed_nodes(self, state: ClusterUpgradeState) -> None:
         """Auto-recovery: failed nodes whose driver pod is back in sync
         resume at uncordon (or done if initially cordoned)
-        (reference: :528-570)."""
+        (reference: :528-570).
+
+        Deviation from the reference: a node that failed *validation*
+        (validation_failed_annotation set) re-enters VALIDATION_REQUIRED
+        instead of skipping to uncordon. The reference's recovery signal —
+        driver pod Ready — is exactly the thing validation is stronger
+        than: on a TPU node the libtpu pod can be Ready while the ICI
+        fabric is broken, and the reference shape would uncordon the node
+        anyway, handing workloads a bad slice. Routing recovery back
+        through the gate keeps self-healing (a recovered fabric passes and
+        uncordons) while a genuinely bad node cycles
+        validation-required ↔ upgrade-failed, cordoned, until repaired or
+        an operator intervenes (docs/automatic-libtpu-upgrade.md runbook).
+        """
         for ns in state.nodes_in(UpgradeState.FAILED):
             if not self.is_driver_pod_in_sync(ns):
+                continue
+            if (
+                self.validation_enabled
+                and self.keys.validation_failed_annotation
+                in ns.node.annotations
+            ):
+                log.info(
+                    "node %s failed validation; re-validating instead of "
+                    "uncordoning", ns.node.name,
+                )
+                self.provider.change_node_upgrade_state(
+                    ns.node, UpgradeState.VALIDATION_REQUIRED
+                )
                 continue
             new_state = UpgradeState.UNCORDON_REQUIRED
             if self.keys.initial_state_annotation in ns.node.annotations:

@@ -150,6 +150,13 @@ class UpgradeKeys:
         return self._key("upgrade-validation-start-time")
 
     @property
+    def validation_failed_annotation(self) -> str:
+        """Marks a node whose FAILED state came from the validation gate
+        (no reference analog — see ValidationManager docstring: recovery
+        from a validation failure must re-validate, not skip the gate)."""
+        return self._key("upgrade-validation-failed")
+
+    @property
     def upgrade_requested_annotation(self) -> str:
         return self._key("upgrade-requested")
 

@@ -140,6 +140,10 @@ class ValidationManager:
         self._provider.change_node_upgrade_annotation(
             node, self._keys.validation_start_annotation, "null"
         )
+        if self._keys.validation_failed_annotation in node.annotations:
+            self._provider.change_node_upgrade_annotation(
+                node, self._keys.validation_failed_annotation, "null"
+            )
         return True
 
     @staticmethod
@@ -170,6 +174,12 @@ class ValidationManager:
             self._provider.change_node_upgrade_annotation(node, key, str(now))
             return
         if now > start + self._timeout:
+            # Stamp WHY the node failed: auto-recovery must route a
+            # validation failure back through validation, not around it
+            # (common_manager.process_upgrade_failed_nodes).
+            self._provider.change_node_upgrade_annotation(
+                node, self._keys.validation_failed_annotation, "true"
+            )
             self._provider.change_node_upgrade_state(node, UpgradeState.FAILED)
             log.info("validation timeout exceeded on node %s", node.name)
             self._event(

@@ -1,0 +1,272 @@
+"""Adversarial failure injection across the rolling-upgrade state machine.
+
+The reference's idempotency contract (upgrade_state.go:49-52): every
+ApplyState pass may abort at any point — API conflicts, vanished objects,
+server errors — and the *next* pass must resume from the durable state in
+labels/annotations and still converge. The happy-path suites prove the
+transitions; this suite proves the contract, injecting faults through
+FakeCluster's reactor hook (client-go fake style) at every verb the
+state machine issues, at multiple points of the roll.
+
+Clean-abort invariants checked while faults fire:
+* an aborted pass never writes an invalid state label,
+* no node is uncordoned before reaching upgrade-done,
+* the roll converges once faults stop, with every driver pod current.
+"""
+
+import copy
+import itertools
+
+import pytest
+
+from k8s_operator_libs_tpu.api import DrainSpec, DriverUpgradePolicySpec
+from k8s_operator_libs_tpu.kube import FakeCluster, Node
+from k8s_operator_libs_tpu.kube.client import (
+    ApiError,
+    ConflictError,
+    NotFoundError,
+)
+from k8s_operator_libs_tpu.kube.sim import DaemonSetSimulator
+from k8s_operator_libs_tpu.upgrade import (
+    BuildStateError,
+    ClusterUpgradeStateManager,
+    DeviceClass,
+    TaskRunner,
+    UpgradeKeys,
+    UpgradeState,
+)
+from k8s_operator_libs_tpu.utils import IntOrString
+from builders import make_node
+
+DEVICE = DeviceClass.tpu()
+KEYS = UpgradeKeys(DEVICE)
+NS = "driver-ns"
+LABELS = {"app": "driver"}
+
+POLICY = DriverUpgradePolicySpec(
+    auto_upgrade=True,
+    max_parallel_upgrades=0,
+    max_unavailable=IntOrString("100%"),
+    drain=DrainSpec(enable=True, force=True, timeout_seconds=30),
+)
+
+VALID_STATES = {s.value for s in UpgradeState}
+
+
+class ServerTimeoutError(ApiError):
+    """A 504-shaped transient apiserver failure."""
+
+
+class Flaky:
+    """Reactor failing the next ``times`` matching calls, then passing —
+    a transient fault, exactly what the contract must survive."""
+
+    def __init__(self, exc_type, times=3):
+        self.exc_type = exc_type
+        self.remaining = times
+        self.fired = 0
+
+    def __call__(self, verb, kind, payload):
+        if self.remaining > 0:
+            self.remaining -= 1
+            self.fired += 1
+            raise self.exc_type(f"injected {self.exc_type.__name__}")
+
+
+def build_harness(node_count=3):
+    cluster = FakeCluster()
+    for i in range(node_count):
+        cluster.create(make_node(f"node-{i}"))
+    sim = DaemonSetSimulator(
+        cluster, name="driver", namespace=NS, match_labels=LABELS
+    )
+    sim.settle()
+    mgr = ClusterUpgradeStateManager(
+        cluster, DEVICE, runner=TaskRunner(inline=True)
+    )
+    return cluster, sim, mgr
+
+
+def _nodes_bypassing_reactors(cluster):
+    """Harness introspection must not hit the injected faults: read the
+    backing store directly instead of going through the client API."""
+    return [
+        Node(copy.deepcopy(data))
+        for (kind, _, _), data in sorted(cluster._store.items())
+        if kind == "Node"
+    ]
+
+
+def assert_invariants(cluster):
+    for node in _nodes_bypassing_reactors(cluster):
+        state = node.labels.get(KEYS.state_label, "")
+        assert state in VALID_STATES, f"invalid state label {state!r}"
+        # A node still mid-upgrade must never be schedulable again unless
+        # it is pre-cordon or was already released.
+        if state in ("pod-restart-required", "validation-required",
+                     "uncordon-required", "drain-required"):
+            assert node.unschedulable, (
+                f"{node.name} schedulable while in {state}"
+            )
+
+
+def drive_with_fault(cluster, sim, mgr, verb, kind, exc_type,
+                     inject_at_pass=2, max_passes=60):
+    """Roll v1→v2 injecting a transient fault mid-roll; return stats."""
+    sim.set_template_hash("v2")
+    fault = Flaky(exc_type)
+    aborted = 0
+    def tick(sim):
+        # The simulated kubelet/controller shares the flaky apiserver; its
+        # tick failing is chaos too, not a harness crash.
+        try:
+            sim.step()
+        except ApiError:
+            pass
+
+    for i in range(max_passes):
+        if i == inject_at_pass:
+            cluster.add_reactor(verb, kind, fault)
+        tick(sim)
+        try:
+            state = mgr.build_state(NS, LABELS)
+            mgr.apply_state(state, POLICY)
+        except ApiError:
+            aborted += 1  # the pass aborts; durable state must carry over
+        assert_invariants(cluster)
+        tick(sim)
+        done = all(
+            n.labels.get(KEYS.state_label) == "upgrade-done"
+            for n in _nodes_bypassing_reactors(cluster)
+        )
+        try:
+            settled = done and sim.all_pods_ready_and_current()
+        except ApiError:
+            settled = False  # the done-check itself ate an injected fault
+        if settled:
+            return {"passes": i + 1, "aborted": aborted, "fired": fault.fired}
+    raise AssertionError(
+        f"roll did not converge with {exc_type.__name__} on {verb} {kind} "
+        f"(fired={fault.fired}, aborted={aborted})"
+    )
+
+
+#: Every (verb, kind) the state machine hits during an in-place roll.
+FAULT_POINTS = [
+    ("get", "Node"),
+    ("patch", "Node"),
+    ("list", "Node"),
+    ("list", "Pod"),
+    ("get", "Pod"),
+    ("delete", "Pod"),
+    ("list", "DaemonSet"),
+    ("list", "ControllerRevision"),
+]
+
+FAULT_TYPES = [ConflictError, NotFoundError, ServerTimeoutError]
+
+
+@pytest.mark.parametrize(
+    "verb,kind,exc_type",
+    [
+        (v, k, e)
+        for (v, k), e in itertools.product(FAULT_POINTS, FAULT_TYPES)
+    ],
+    ids=lambda p: getattr(p, "__name__", str(p)),
+)
+def test_transient_fault_mid_roll(verb, kind, exc_type):
+    cluster, sim, mgr = build_harness()
+    stats = drive_with_fault(cluster, sim, mgr, verb, kind, exc_type)
+    assert stats["fired"] > 0, "fault point never exercised — dead parameter"
+    # Converged clean: pods current, all nodes released.
+    for obj in cluster.list("Node"):
+        assert not Node(obj.raw).unschedulable
+
+
+@pytest.mark.parametrize("inject_at_pass", [0, 1, 2, 3, 4, 5])
+def test_conflict_storm_at_every_phase(inject_at_pass):
+    """A burst of conflicts at each successive pass of the roll — every
+    transition window gets hit in one of the parametrized runs."""
+    cluster, sim, mgr = build_harness(node_count=2)
+    stats = drive_with_fault(
+        cluster, sim, mgr, "*", "*", ConflictError,
+        inject_at_pass=inject_at_pass,
+    )
+    assert stats["fired"] > 0
+
+
+def test_hard_fault_every_pass_then_recovery():
+    """The apiserver fails every single pass for a while (wedged control
+    plane); once it heals, the roll completes from durable state."""
+    cluster, sim, mgr = build_harness(node_count=2)
+    sim.set_template_hash("v2")
+
+    class Wedge:
+        on = True
+
+        def __call__(self, verb, kind, payload):
+            if self.on:
+                raise ServerTimeoutError("control plane wedged")
+
+    wedge = Wedge()
+    cluster.add_reactor("patch", "*", wedge)
+    aborted = 0
+    for i in range(8):
+        try:
+            sim.step()  # the simulated kubelet shares the wedged apiserver
+        except ApiError:
+            pass
+        try:
+            mgr.apply_state(mgr.build_state(NS, LABELS), POLICY)
+        except ApiError:
+            aborted += 1
+        assert_invariants(cluster)
+    assert aborted > 0
+    wedge.on = False  # control plane heals
+    for i in range(60):
+        sim.step()
+        mgr.apply_state(mgr.build_state(NS, LABELS), POLICY)
+        sim.step()
+        done = all(
+            n.labels.get(KEYS.state_label) == "upgrade-done"
+            for n in cluster.list("Node")
+        )
+        if done and sim.all_pods_ready_and_current():
+            break
+    else:
+        raise AssertionError("no convergence after control plane healed")
+
+
+def test_node_vanishes_mid_roll():
+    """A node deleted mid-upgrade (pool shrink) must not wedge the roll of
+    the remaining nodes."""
+    cluster, sim, mgr = build_harness(node_count=3)
+    sim.set_template_hash("v2")
+    deleted = False
+    for i in range(60):
+        sim.step()
+        if i == 2 and not deleted:
+            # Remove the node and its driver pod, as GKE pool resize would.
+            cluster.delete("Node", "node-1")
+            try:
+                cluster.delete("Pod", sim.pod_name("node-1"), NS)
+            except NotFoundError:
+                pass
+            deleted = True
+        try:
+            mgr.apply_state(mgr.build_state(NS, LABELS), POLICY)
+        except BuildStateError:
+            # DaemonSet status still says 3 desired while only 2 nodes
+            # remain: the reference treats this as a hard requeue
+            # (upgrade_state.go:128-131); the next pass sees fresh status.
+            continue
+        sim.step()
+        nodes = cluster.list("Node")
+        done = all(
+            n.labels.get(KEYS.state_label) == "upgrade-done" for n in nodes
+        )
+        if deleted and done and sim.all_pods_ready_and_current():
+            break
+    else:
+        raise AssertionError("roll wedged after node deletion")
+    assert {n.name for n in cluster.list("Node")} == {"node-0", "node-2"}

@@ -464,6 +464,50 @@ class TestUncordonAndRecovery:
         mgr.apply_state(mgr.build_state(NS, LABELS), POLICY)
         assert state_of(cluster, "node-0") == "upgrade-failed"
 
+    def test_validation_failed_node_revalidates_instead_of_uncordoning(self):
+        """Deviation from the reference (common_manager.go:528-570): when
+        the FAILED state came from the validation gate, recovery re-enters
+        validation — a Ready driver pod must not bypass a failed fabric
+        probe."""
+        cluster, sim, mgr = make_harness(
+            node_count=1, node_states=["upgrade-failed"]
+        )
+        mgr.with_validation_enabled(validation_hook=lambda node: False)
+        cluster.patch(
+            "Node",
+            "node-0",
+            patch={
+                "metadata": {
+                    "annotations": {KEYS.validation_failed_annotation: "true"}
+                }
+            },
+        )
+        mgr.apply_state(mgr.build_state(NS, LABELS), POLICY)
+        # Driver pod in sync, but the failure was validation's: re-gate.
+        assert state_of(cluster, "node-0") == "validation-required"
+
+    def test_validation_failed_node_uncordons_after_gate_passes(self):
+        cluster, sim, mgr = make_harness(
+            node_count=1, node_states=["upgrade-failed"]
+        )
+        mgr.with_validation_enabled(validation_hook=lambda node: True)
+        cluster.patch(
+            "Node",
+            "node-0",
+            patch={
+                "metadata": {
+                    "annotations": {KEYS.validation_failed_annotation: "true"}
+                }
+            },
+        )
+        mgr.apply_state(mgr.build_state(NS, LABELS), POLICY)  # -> revalidate
+        mgr.apply_state(mgr.build_state(NS, LABELS), POLICY)  # gate passes
+        mgr.apply_state(mgr.build_state(NS, LABELS), POLICY)
+        assert state_of(cluster, "node-0") == "upgrade-done"
+        node = cluster.get("Node", "node-0")
+        # The pass cleared the failure stamp — recovery is complete.
+        assert KEYS.validation_failed_annotation not in node.annotations
+
 
 class TestEndToEndRollingUpgrade:
     def run_rolling(self, node_count, policy, max_passes=40, readiness_steps=0):
