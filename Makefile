@@ -1,19 +1,35 @@
 # Build/test harness (SURVEY.md §2 component 19; reference: Makefile:62-93).
 PYTHON ?= python
+COV_MIN ?= 85
 
-.PHONY: all lint test bench dryrun demo install
+.PHONY: all lint test coverage bench dryrun demo install
 
 all: lint test
 
 install:
 	$(PYTHON) -m pip install -e . -q --no-deps --no-build-isolation
 
+# Local lint tier (reference gates on ~60 golangci linters locally,
+# .golangci.yaml): compile check + the stdlib linter (tools/lint.py —
+# unused/undefined names, redefinitions, bare except, mutable defaults, …),
+# plus ruff when the environment has it (CI always does).
 lint:
-	$(PYTHON) -m compileall -q k8s_operator_libs_tpu tests examples bench.py __graft_entry__.py
+	$(PYTHON) -m compileall -q k8s_operator_libs_tpu tests examples tools bench.py __graft_entry__.py
+	$(PYTHON) tools/lint.py k8s_operator_libs_tpu tests examples tools bench.py __graft_entry__.py
 	$(PYTHON) -c "import k8s_operator_libs_tpu"
+	@if $(PYTHON) -c "import ruff" 2>/dev/null; then \
+	    $(PYTHON) -m ruff check k8s_operator_libs_tpu tests examples tools; \
+	else \
+	    echo "lint: ruff not installed here; stdlib linter ran (CI runs ruff+mypy)"; \
+	fi
 
 test:
 	$(PYTHON) -m pytest tests/ -x -q
+
+# Line coverage with a threshold (stdlib sys.monitoring — pytest-cov is
+# not in the image; CI uses pytest-cov with the same threshold).
+coverage:
+	$(PYTHON) tools/cover.py --min $(COV_MIN) -m pytest tests/ -q
 
 bench:
 	$(PYTHON) bench.py

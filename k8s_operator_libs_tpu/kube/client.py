@@ -11,7 +11,7 @@ REST client for real clusters reads the apiserver directly.
 from __future__ import annotations
 
 import abc
-from typing import Any, Mapping, Optional, Sequence
+from typing import Any, Mapping, Optional
 
 from .objects import KubeObject
 

@@ -13,7 +13,7 @@ import threading
 import time
 import uuid
 from collections import deque
-from typing import Deque, Optional
+from typing import Deque
 
 from .client import Client
 from .objects import Event, KubeObject

@@ -27,7 +27,6 @@ from typing import Any, Callable, Mapping, Optional
 
 from .client import (
     AlreadyExistsError,
-    ApiError,
     Client,
     ConflictError,
     InvalidError,

@@ -29,7 +29,7 @@ from .consts import (
 )
 from .cordon_manager import CordonManager
 from .drain_manager import DrainConfiguration, DrainManager
-from .pod_manager import PodManager, PodManagerConfig, RevisionHashError
+from .pod_manager import PodManager, PodManagerConfig
 from .safe_driver_load import SafeDriverLoadManager
 from .state_provider import NodeUpgradeStateProvider
 from .validation_manager import ValidationManager

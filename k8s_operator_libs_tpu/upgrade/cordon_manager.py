@@ -5,7 +5,6 @@ Parity: reference pkg/upgrade/cordon_manager.go:33-56.
 
 from __future__ import annotations
 
-from typing import Optional
 
 from ..kube.client import Client
 from ..kube.drain import DrainHelper

@@ -8,7 +8,7 @@ upgrading them costs nothing extra).
 
 from __future__ import annotations
 
-from typing import Optional, Protocol
+from typing import Protocol
 
 from ..api.upgrade_v1alpha1 import DriverUpgradePolicySpec
 from ..utils.log import get_logger

@@ -9,12 +9,12 @@ the pass and the next reconcile resumes from the node labels
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Mapping, Optional
 
 from ..api.upgrade_v1alpha1 import DriverUpgradePolicySpec
 from ..kube.client import Client
-from ..kube.objects import DaemonSet, Node, Pod
+from ..kube.objects import DaemonSet, Pod
 from ..utils.log import get_logger
 from .common_manager import (
     ClusterUpgradeState,

@@ -7,7 +7,6 @@ wait-for-established behavior and the apply-crds example CLI.
 
 import os
 import sys
-import threading
 
 import pytest
 

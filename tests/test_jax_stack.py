@@ -7,7 +7,6 @@ platform to the (single-chip) TPU backend.
 """
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 

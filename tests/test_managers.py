@@ -15,7 +15,7 @@ from k8s_operator_libs_tpu.api import (
     PodDeletionSpec,
     WaitForCompletionSpec,
 )
-from k8s_operator_libs_tpu.kube import FakeCluster, FakeRecorder
+from k8s_operator_libs_tpu.kube import FakeCluster
 from k8s_operator_libs_tpu.upgrade import (
     CordonManager,
     DeviceClass,
@@ -27,7 +27,6 @@ from k8s_operator_libs_tpu.upgrade import (
     SafeDriverLoadManager,
     TaskRunner,
     UpgradeKeys,
-    UpgradeState,
     ValidationManager,
 )
 from builders import (

@@ -33,7 +33,7 @@ from k8s_operator_libs_tpu.upgrade import (
     TaskRunner,
     UpgradeKeys,
 )
-from builders import make_daemonset, make_node, make_pod
+from builders import make_node, make_pod
 
 DEVICE = DeviceClass.tpu()
 KEYS = UpgradeKeys(DEVICE)

@@ -21,7 +21,6 @@ from k8s_operator_libs_tpu.upgrade import (
     RequestorOptions,
     TaskRunner,
     UpgradeKeys,
-    UpgradeState,
     enable_requestor_mode,
 )
 from k8s_operator_libs_tpu.utils import IntOrString

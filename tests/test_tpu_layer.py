@@ -1,7 +1,6 @@
 """TPU device-class tests: node detection, slice-aware planning, libtpu
 DaemonSet management. Pure control-plane — no JAX needed."""
 
-import pytest
 
 from k8s_operator_libs_tpu.api import DriverUpgradePolicySpec
 from k8s_operator_libs_tpu.kube import DaemonSet, FakeCluster

@@ -1,0 +1,369 @@
+"""Stdlib static linter — the local tier of the lint pipeline.
+
+The reference gates merges on ~60 golangci linters run locally via
+`make lint` (reference: .golangci.yaml, Makefile:29). The CI workflow here
+uses ruff + mypy, but the deployment image has neither and cannot pip
+install, so this module implements the highest-signal rule subset on the
+stdlib (ast + symtable) to keep `make lint` meaningful everywhere:
+
+* F401  unused import
+* F811  redefinition of an unused name (imports/defs)
+* F821  undefined name (typo detection, symtable-based)
+* F502  f-string without placeholders
+* B006  mutable default argument
+* B011  assert on a non-empty tuple (always true)
+* E722  bare except
+* F601  `is` comparison with a literal
+* W605  duplicate literal keys in a dict display
+* E501  line too long (default 100)
+* W191/W291  tabs / trailing whitespace
+
+Exit status 1 when any finding is reported; findings print as
+``path:line:col CODE message`` (ruff-compatible enough for editors).
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import builtins
+import sys
+import symtable
+from pathlib import Path
+
+MAX_LINE = 100
+
+#: Names legitimately referenced without a visible binding.
+IMPLICIT_GLOBALS = {
+    "__file__", "__name__", "__doc__", "__package__", "__spec__",
+    "__loader__", "__builtins__", "__debug__", "__path__", "__all__",
+    "__annotations__", "__dict__", "__class__",
+}
+
+BUILTIN_NAMES = set(dir(builtins)) | IMPLICIT_GLOBALS
+
+
+class Finding:
+    def __init__(self, path: Path, line: int, col: int, code: str, msg: str):
+        self.path, self.line, self.col, self.code, self.msg = (
+            path, line, col, code, msg,
+        )
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}:{self.col} {self.code} {self.msg}"
+
+    def sort_key(self):
+        return (str(self.path), self.line, self.col, self.code)
+
+
+class _ImportTracker(ast.NodeVisitor):
+    """Collect import bindings and every Name/Attribute load per scope-free
+    approximation: module-wide usage counting is enough for F401 because a
+    name used in ANY scope keeps the import."""
+
+    def __init__(self) -> None:
+        self.imports: dict[str, ast.stmt] = {}
+        self.used: set[str] = set()
+        self.string_annotations: list[str] = []
+        self.redefinitions: list[tuple[str, ast.stmt, ast.stmt]] = []
+        # F811 applies only to unconditional module-level rebinding:
+        # try/except import fallbacks, if/elif alternatives, and
+        # function-local imports are deliberate alternate bindings.
+        self._conditional_depth = 0
+        self._scope_depth = 0
+        self.imports_unconditional: dict[str, bool] = {}
+
+    def _bind(self, name: str, node: ast.stmt) -> None:
+        if name == "*":
+            return
+        unconditional = (
+            self._conditional_depth == 0 and self._scope_depth == 0
+        )
+        prior = self.imports.get(name)
+        if (
+            prior is not None
+            and name not in self.used
+            and unconditional
+            and self.imports_unconditional.get(name, False)
+        ):
+            self.redefinitions.append((name, prior, node))
+        self.imports[name] = node
+        self.imports_unconditional[name] = unconditional
+
+    def _nested(self, node, kind: str) -> None:
+        attr = "_conditional_depth" if kind == "cond" else "_scope_depth"
+        setattr(self, attr, getattr(self, attr) + 1)
+        self.generic_visit(node)
+        setattr(self, attr, getattr(self, attr) - 1)
+
+    def visit_Try(self, node) -> None:
+        self._nested(node, "cond")
+
+    def visit_If(self, node) -> None:
+        self._nested(node, "cond")
+
+    def visit_FunctionDef(self, node) -> None:
+        self._nested(node, "scope")
+
+    def visit_AsyncFunctionDef(self, node) -> None:
+        self._nested(node, "scope")
+
+    def visit_ClassDef(self, node) -> None:
+        self._nested(node, "scope")
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            bound = alias.asname or alias.name.split(".")[0]
+            self._bind(bound, node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module == "__future__":
+            return  # compiler directives, not bindings
+        for alias in node.names:
+            self._bind(alias.asname or alias.name, node)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if isinstance(node.ctx, ast.Load):
+            self.used.add(node.id)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        # a.b.c marks `a` used; the visitor recurses to the root Name.
+        self.generic_visit(node)
+
+    def visit_Constant(self, node: ast.Constant) -> None:
+        # String annotations / __all__ entries keep names alive.
+        if isinstance(node.value, str) and node.value.isidentifier():
+            self.string_annotations.append(node.value)
+
+
+def _iter_lines(source: str, path: Path):
+    findings = []
+    for i, line in enumerate(source.splitlines(), 1):
+        if len(line) > MAX_LINE and "noqa" not in line:
+            findings.append(
+                Finding(path, i, MAX_LINE + 1, "E501",
+                        f"line too long ({len(line)} > {MAX_LINE})")
+            )
+        if line.rstrip("\n") != line.rstrip():
+            findings.append(
+                Finding(path, i, len(line.rstrip()) + 1, "W291",
+                        "trailing whitespace")
+            )
+        if "\t" in line.split("#")[0]:
+            findings.append(Finding(path, i, line.index("\t") + 1, "W191",
+                                    "tab in source"))
+    return findings
+
+
+def _noqa_lines(source: str) -> set[int]:
+    return {
+        i
+        for i, line in enumerate(source.splitlines(), 1)
+        if "noqa" in line
+    }
+
+
+class _AstChecks(ast.NodeVisitor):
+    def __init__(self, path: Path, noqa: set[int]):
+        self.path = path
+        self.noqa = noqa
+        self.findings: list[Finding] = []
+
+    def _add(self, node, code: str, msg: str) -> None:
+        line = getattr(node, "lineno", 1)
+        if line in self.noqa:
+            return
+        self.findings.append(
+            Finding(self.path, line, getattr(node, "col_offset", 0) + 1,
+                    code, msg)
+        )
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if node.type is None:
+            self._add(node, "E722", "bare except")
+        self.generic_visit(node)
+
+    def _check_defaults(self, node) -> None:
+        for default in list(node.args.defaults) + [
+            d for d in node.args.kw_defaults if d is not None
+        ]:
+            if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                self._add(default, "B006", "mutable default argument")
+
+    def visit_FunctionDef(self, node) -> None:
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    def visit_AsyncFunctionDef(self, node) -> None:
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    def visit_Assert(self, node: ast.Assert) -> None:
+        if isinstance(node.test, ast.Tuple) and node.test.elts:
+            self._add(node, "B011", "assert on a tuple is always true")
+        self.generic_visit(node)
+
+    def visit_Compare(self, node: ast.Compare) -> None:
+        for op, comparator in zip(node.ops, node.comparators):
+            if isinstance(op, (ast.Is, ast.IsNot)) and isinstance(
+                comparator, ast.Constant
+            ) and comparator.value not in (None, True, False, Ellipsis):
+                self._add(node, "F601", "`is` comparison with a literal")
+        self.generic_visit(node)
+
+    def visit_Dict(self, node: ast.Dict) -> None:
+        seen: set = set()
+        for key in node.keys:
+            if isinstance(key, ast.Constant):
+                try:
+                    if key.value in seen:
+                        self._add(key, "W605",
+                                  f"duplicate dict key {key.value!r}")
+                    seen.add(key.value)
+                except TypeError:
+                    pass
+        self.generic_visit(node)
+
+    def visit_JoinedStr(self, node: ast.JoinedStr) -> None:
+        if not any(isinstance(v, ast.FormattedValue) for v in node.values):
+            self._add(node, "F502", "f-string without placeholders")
+        # Recurse into interpolated values only: a format spec ({x:.2f}) is
+        # itself a placeholder-less JoinedStr and must not be flagged.
+        for value in node.values:
+            if isinstance(value, ast.FormattedValue):
+                self.visit(value.value)
+
+
+def _undefined_names(source: str, path: Path, tree: ast.Module,
+                     noqa: set[int]) -> list[Finding]:
+    """F821 via symtable: a name referenced at module scope (or referenced
+    as a global from any nested scope) with no module-level binding, no
+    import, and no builtin fallback is a typo."""
+    findings: list[Finding] = []
+    try:
+        table = symtable.symtable(source, str(path), "exec")
+    except SyntaxError:
+        return findings
+
+    module_bindings: set[str] = set()
+
+    def collect_bindings(t: symtable.SymbolTable) -> None:
+        for sym in t.get_symbols():
+            if sym.is_assigned() or sym.is_imported():
+                module_bindings.add(sym.get_name())
+
+    collect_bindings(table)
+
+    # Names referenced as free/global anywhere in the file.
+    referenced_globals: dict[str, None] = {}
+
+    def walk(t: symtable.SymbolTable) -> None:
+        for sym in t.get_symbols():
+            if sym.is_referenced() and (sym.is_global() or (
+                t.get_type() == "module" and not sym.is_assigned()
+                and not sym.is_imported()
+            )):
+                referenced_globals.setdefault(sym.get_name())
+        for child in t.get_children():
+            walk(child)
+
+    walk(table)
+
+    unknown = {
+        name
+        for name in referenced_globals
+        if name not in module_bindings and name not in BUILTIN_NAMES
+    }
+    if not unknown:
+        return findings
+
+    class Locator(ast.NodeVisitor):
+        def visit_Name(self, node: ast.Name) -> None:
+            if (
+                isinstance(node.ctx, ast.Load)
+                and node.id in unknown
+                and node.lineno not in noqa
+            ):
+                findings.append(
+                    Finding(path, node.lineno, node.col_offset + 1, "F821",
+                            f"undefined name {node.id!r}")
+                )
+
+    Locator().visit(tree)
+    return findings
+
+
+def lint_file(path: Path) -> list[Finding]:
+    source = path.read_text()
+    noqa = _noqa_lines(source)
+    findings = [
+        f for f in _iter_lines(source, path) if f.line not in noqa
+    ]
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError as e:
+        findings.append(
+            Finding(path, e.lineno or 1, (e.offset or 0) + 1, "E999",
+                    f"syntax error: {e.msg}")
+        )
+        return findings
+
+    checks = _AstChecks(path, noqa)
+    checks.visit(tree)
+    findings.extend(checks.findings)
+
+    tracker = _ImportTracker()
+    tracker.visit(tree)
+    keep = tracker.used | set(tracker.string_annotations)
+    is_init = path.name == "__init__.py"
+    for name, node in tracker.imports.items():
+        if name in keep or name.startswith("_") or is_init:
+            continue  # __init__.py re-exports are the package's public API
+        if node.lineno in noqa:
+            continue
+        findings.append(
+            Finding(path, node.lineno, node.col_offset + 1, "F401",
+                    f"unused import {name!r}")
+        )
+    for name, prior, node in tracker.redefinitions:
+        if node.lineno in noqa:
+            continue
+        findings.append(
+            Finding(path, node.lineno, node.col_offset + 1, "F811",
+                    f"redefinition of unused {name!r} from line "
+                    f"{prior.lineno}")
+        )
+
+    findings.extend(_undefined_names(source, path, tree, noqa))
+    return findings
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("paths", nargs="+", type=Path)
+    args = parser.parse_args(argv)
+
+    files: list[Path] = []
+    for p in args.paths:
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        else:
+            files.append(p)
+
+    findings: list[Finding] = []
+    for f in files:
+        findings.extend(lint_file(f))
+    findings.sort(key=Finding.sort_key)
+    for finding in findings:
+        print(finding)
+    if findings:
+        print(f"{len(findings)} finding(s) in {len(files)} file(s)",
+              file=sys.stderr)
+        return 1
+    print(f"lint clean: {len(files)} file(s)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
