@@ -1,0 +1,255 @@
+"""Behavioral coverage for the utility tiers the big suites only graze:
+sync primitives under real threads, int-or-percent edge cases, event
+recording, the hermetic-env helpers, and the threaded TaskRunner."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from k8s_operator_libs_tpu.kube import FakeCluster, Node
+from k8s_operator_libs_tpu.kube.events import EventRecorder, FakeRecorder
+from k8s_operator_libs_tpu.upgrade import TaskRunner
+from k8s_operator_libs_tpu.utils import IntOrString, KeyedMutex, StringSet
+from k8s_operator_libs_tpu.utils.jaxenv import (
+    hermetic_cpu_env,
+    plugin_shim_on_path,
+    probe_default_backend,
+    strip_plugin_paths,
+)
+
+
+class TestStringSet:
+    def test_basic_ops(self):
+        s = StringSet()
+        s.add("a")
+        s.add("b")
+        assert s.has("a") and "b" in s and len(s) == 2
+        assert s.snapshot() == frozenset({"a", "b"})
+        s.remove("a")
+        assert not s.has("a") and len(s) == 1
+        s.clear()
+        assert len(s) == 0
+
+    def test_remove_absent_is_noop(self):
+        s = StringSet()
+        s.remove("never-added")
+        assert len(s) == 0
+
+    def test_concurrent_adds(self):
+        s = StringSet()
+        def worker(i):
+            for j in range(100):
+                s.add(f"{i}-{j}")
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(s) == 800
+
+
+class TestKeyedMutex:
+    def test_same_key_serializes(self):
+        m = KeyedMutex()
+        order = []
+        inside = threading.Event()
+        release = threading.Event()
+
+        def first():
+            with m.locked("node-1"):
+                inside.set()
+                release.wait(timeout=5)
+                order.append("first")
+
+        def second():
+            inside.wait(timeout=5)
+            with m.locked("node-1"):
+                order.append("second")
+
+        t1 = threading.Thread(target=first)
+        t2 = threading.Thread(target=second)
+        t1.start(); t2.start()
+        inside.wait(timeout=5)
+        time.sleep(0.05)  # give second a chance to (wrongly) enter
+        assert order == []  # second is blocked while first holds the key
+        release.set()
+        t1.join(timeout=5); t2.join(timeout=5)
+        assert order == ["first", "second"]
+
+    def test_distinct_keys_do_not_block(self):
+        m = KeyedMutex()
+        with m.locked("a"):
+            acquired = []
+
+            def other():
+                with m.locked("b"):
+                    acquired.append(True)
+
+            t = threading.Thread(target=other)
+            t.start()
+            t.join(timeout=5)
+            assert acquired == [True]
+
+
+class TestIntOrString:
+    def test_numeric_string_tolerated(self):
+        assert IntOrString("5").value == 5
+        assert not IntOrString("5").is_percent
+
+    def test_percent_scaling_rounds(self):
+        assert IntOrString("25%").scaled_value(10) == 3          # ceil
+        assert IntOrString("25%").scaled_value(10, round_up=False) == 2
+        assert IntOrString("100%").scaled_value(7) == 7
+        assert IntOrString("0%").scaled_value(7) == 0
+
+    def test_absolute_value_ignores_total(self):
+        assert IntOrString(4).scaled_value(100) == 4
+
+    @pytest.mark.parametrize("bad", ["abc", "-5", "-5%", "%", "5%%"])
+    def test_invalid_strings_rejected(self, bad):
+        with pytest.raises(ValueError):
+            IntOrString(bad)
+
+    def test_negative_and_bool_rejected(self):
+        with pytest.raises(ValueError):
+            IntOrString(-1)
+        with pytest.raises(ValueError):
+            IntOrString(True)
+        with pytest.raises(ValueError):
+            IntOrString(1.5)  # type: ignore[arg-type]
+
+    def test_parse_and_json_round_trip(self):
+        assert IntOrString.parse(None) is None
+        v = IntOrString("30%")
+        assert IntOrString.parse(v) is v
+        assert IntOrString.parse(3).to_json() == 3
+        assert v.to_json() == "30%"
+
+
+class TestEventRecorder:
+    def test_records_real_event_objects(self):
+        cluster = FakeCluster()
+        node = Node.new("n1")
+        cluster.create(node)
+        rec = EventRecorder(cluster, namespace="event-ns")
+        rec.eventf(node, "Warning", "UpgradeFailed", "drain failed on %s", "n1")
+        events = cluster.list("Event", namespace="event-ns")
+        assert len(events) == 1
+        ev = events[0].raw
+        assert ev["type"] == "Warning"
+        assert ev["reason"] == "UpgradeFailed"
+        assert ev["message"] == "drain failed on n1"
+        assert ev["involvedObject"]["name"] == "n1"
+        assert ev["involvedObject"]["kind"] == "Node"
+
+    def test_fake_recorder_bounded_and_drains(self):
+        rec = FakeRecorder(capacity=3)
+        node = Node.new("n1")
+        for i in range(5):
+            rec.eventf(node, "Normal", "R", "msg %d", i)
+        drained = rec.drain()
+        assert drained == ["Normal R msg 2", "Normal R msg 3", "Normal R msg 4"]
+        assert rec.drain() == []
+
+
+class TestJaxEnvHelpers:
+    def test_strip_plugin_paths(self):
+        joined = os.pathsep.join(
+            ["/a/lib", "/root/.axon_site", "/b/lib"]
+        )
+        assert strip_plugin_paths(joined) == os.pathsep.join(
+            ["/a/lib", "/b/lib"]
+        )
+        assert strip_plugin_paths("") == ""
+
+    def test_plugin_shim_detection_uses_given_env(self):
+        assert plugin_shim_on_path({"PYTHONPATH": "/root/.axon_site"})
+        assert not plugin_shim_on_path({"PYTHONPATH": "/usr/lib"})
+        assert not plugin_shim_on_path({})
+
+    def test_hermetic_env_pins_cpu_and_device_count(self):
+        base = {
+            "PYTHONPATH": "/x" + os.pathsep + "/root/.axon_site",
+            "JAX_PLATFORMS": "axon",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2 --other",
+        }
+        env = hermetic_cpu_env(8, base=base)
+        assert env["JAX_PLATFORMS"] == "cpu"
+        assert env["PYTHONPATH"] == "/x"
+        flags = env["XLA_FLAGS"].split()
+        assert "--xla_force_host_platform_device_count=8" in flags
+        assert "--other" in flags
+        assert "--xla_force_host_platform_device_count=2" not in flags
+
+    def test_hermetic_env_drops_empty_pythonpath(self):
+        env = hermetic_cpu_env(4, base={"PYTHONPATH": "/root/.axon_site"})
+        assert "PYTHONPATH" not in env
+
+    def test_probe_timeout_reports_deadline(self):
+        ok, detail = probe_default_backend(timeout_s=0.001)
+        assert not ok
+        assert "deadline" in detail
+
+    def test_probe_failure_reports_stderr_tail(self, monkeypatch):
+        import k8s_operator_libs_tpu.utils.jaxenv as jaxenv
+
+        # A python that immediately fails stands in for a broken backend.
+        monkeypatch.setattr(jaxenv.sys, "executable", "/bin/false")
+        ok, detail = probe_default_backend(timeout_s=10)
+        assert not ok
+        assert "backend init failed" in detail
+
+
+class TestThreadedTaskRunner:
+    def test_runs_and_dedups_in_flight(self):
+        runner = TaskRunner(max_workers=2)
+        try:
+            started = threading.Event()
+            release = threading.Event()
+            runs = []
+
+            def slow():
+                runs.append("slow")
+                started.set()
+                release.wait(timeout=5)
+
+            assert runner.submit("node-1", slow)
+            started.wait(timeout=5)
+            assert runner.in_progress("node-1")
+            # Same key while in flight: refused, not queued.
+            assert not runner.submit("node-1", slow)
+            # Different key proceeds.
+            other_done = threading.Event()
+            assert runner.submit("node-2", lambda: other_done.set())
+            assert other_done.wait(timeout=5)
+            release.set()
+            assert runner.wait_idle(timeout=5)
+            assert not runner.in_progress("node-1")
+            assert runs == ["slow"]  # the refused submit never ran
+        finally:
+            runner.shutdown()
+
+    def test_task_exception_never_bubbles_and_key_released(self):
+        runner = TaskRunner(max_workers=1)
+        try:
+            def boom():
+                raise RuntimeError("task error")
+
+            assert runner.submit("node-1", boom)
+            assert runner.wait_idle(timeout=5)
+            assert not runner.in_progress("node-1")
+            # Key is reusable after a crash.
+            done = threading.Event()
+            assert runner.submit("node-1", lambda: done.set())
+            assert done.wait(timeout=5)
+        finally:
+            runner.shutdown()
+
+    def test_wait_idle_empty_is_true(self):
+        runner = TaskRunner(max_workers=1)
+        try:
+            assert runner.wait_idle(timeout=1)
+        finally:
+            runner.shutdown()
