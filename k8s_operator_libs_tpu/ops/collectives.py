@@ -45,6 +45,28 @@ def _axis_size(mesh: Mesh, axis: str) -> int:
     return mesh.shape[axis]
 
 
+#: Compiled-probe cache keyed by (probe, mesh, axis, extras). The probes
+#: close over the mesh, so a fresh jit wrapper per call would miss jax's
+#: jit cache and pay a full XLA (re)compile on EVERY gate run — ~0.5 s per
+#: probe on a remote-compile runtime, which multiplied the health gate's
+#: steady-state cost several-fold. The gate re-probes the same device set
+#: every reconcile pass. The Mesh itself is the key component (hashable;
+#: equality covers devices AND topology/axis names) — flat device ids are
+#: NOT enough: a 1D and a 2D mesh over the same devices must not share a
+#: compiled probe.
+_JIT_CACHE: dict[tuple, Callable] = {}
+
+
+def _cached(kind: str, mesh: Mesh, axis: str, builder: Callable[[], Callable],
+            *extra) -> Callable:
+    key = (kind, mesh, axis, *extra)
+    fn = _JIT_CACHE.get(key)
+    if fn is None:
+        fn = builder()
+        _JIT_CACHE[key] = fn
+    return fn
+
+
 def _timed(fn: Callable[[], jax.Array], warmup: int = 1, iters: int = 3) -> float:
     """Median wall-clock of ``fn`` with compile excluded."""
     for _ in range(warmup):
@@ -62,15 +84,19 @@ def psum_check(mesh: Mesh, axis: str) -> CollectiveReport:
     must be exactly n(n-1)/2 everywhere."""
     n = _axis_size(mesh, axis)
 
-    @jax.jit
-    def run(x):
-        def body(shard):
-            return jax.lax.psum(shard, axis)
+    def build():
+        @jax.jit
+        def run(x):
+            def body(shard):
+                return jax.lax.psum(shard, axis)
 
-        return shard_map(
-            body, mesh=mesh, in_specs=P(axis), out_specs=P(axis)
-        )(x)
+            return shard_map(
+                body, mesh=mesh, in_specs=P(axis), out_specs=P(axis)
+            )(x)
 
+        return run
+
+    run = _cached("psum", mesh, axis, build)
     try:
         x = jnp.arange(n, dtype=jnp.float32)
         out = np.asarray(run(x))
@@ -88,13 +114,19 @@ def all_gather_check(mesh: Mesh, axis: str) -> CollectiveReport:
     """all_gather correctness: each device's shard must appear in order."""
     n = _axis_size(mesh, axis)
 
-    @jax.jit
-    def run(x):
-        def body(shard):
-            return jax.lax.all_gather(shard, axis, tiled=True)
+    def build():
+        @jax.jit
+        def run(x):
+            def body(shard):
+                return jax.lax.all_gather(shard, axis, tiled=True)
 
-        return shard_map(body, mesh=mesh, in_specs=P(axis), out_specs=P(axis))(x)
+            return shard_map(
+                body, mesh=mesh, in_specs=P(axis), out_specs=P(axis)
+            )(x)
 
+        return run
+
+    run = _cached("all_gather", mesh, axis, build)
     try:
         x = jnp.arange(n, dtype=jnp.float32)
         out = np.asarray(run(x))
@@ -125,13 +157,19 @@ def ppermute_ring(
     elems = max(1, int(payload_mb * 1e6 / 4))
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    @jax.jit
-    def hop(x):
-        def body(shard):
-            return jax.lax.ppermute(shard, axis, perm)
+    def build():
+        @jax.jit
+        def hop(x):
+            def body(shard):
+                return jax.lax.ppermute(shard, axis, perm)
 
-        return shard_map(body, mesh=mesh, in_specs=P(axis), out_specs=P(axis))(x)
+            return shard_map(
+                body, mesh=mesh, in_specs=P(axis), out_specs=P(axis)
+            )(x)
 
+        return hop
+
+    hop = _cached("ppermute_ring", mesh, axis, build, elems)
     try:
         x = jnp.arange(n * elems, dtype=jnp.float32).reshape(n * elems)
         # Pre-shard the payload onto the mesh: timing an unsharded input
@@ -162,13 +200,19 @@ def reduce_scatter_check(mesh: Mesh, axis: str) -> CollectiveReport:
     """psum_scatter correctness against a host-computed reduction."""
     n = _axis_size(mesh, axis)
 
-    @jax.jit
-    def run(x):
-        def body(shard):
-            return jax.lax.psum_scatter(shard, axis, tiled=True)
+    def build():
+        @jax.jit
+        def run(x):
+            def body(shard):
+                return jax.lax.psum_scatter(shard, axis, tiled=True)
 
-        return shard_map(body, mesh=mesh, in_specs=P(axis), out_specs=P(axis))(x)
+            return shard_map(
+                body, mesh=mesh, in_specs=P(axis), out_specs=P(axis)
+            )(x)
 
+        return run
+
+    run = _cached("reduce_scatter", mesh, axis, build)
     try:
         x = jnp.ones((n * n,), dtype=jnp.float32)
         out = np.asarray(run(x))

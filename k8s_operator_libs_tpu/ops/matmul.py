@@ -77,6 +77,28 @@ class MxuReport:
     error: str = ""
 
 
+#: FLOPs per timed dispatch when auto-chaining (~0.2 s on a healthy v5e):
+#: large enough that dispatch latency costs <25% of the measurement,
+#: small enough that a full gate run stays ~1 s.
+_CHAIN_FLOP_BUDGET = 2.5e13
+
+#: Auto-chain upper bound: below ~512² matrices the per-link loop overhead
+#: (µs-scale) rivals the link's MXU time, so no chain length can make the
+#: measurement throughput-faithful — the cap keeps tiny probes bounded in
+#: wall-clock instead of chasing the FLOP budget with millions of
+#: iterations. Floors are calibrated for matmul_size >= 1024.
+_CHAIN_MAX = 16384
+
+#: (size, dtype, device) → (a_lp, b_lp, b_scaled, reference). The probe's
+#: inputs are deterministic (fixed PRNG seed), so the host reference
+#: product — the expensive part of a repeat run — never changes; the
+#: health gate re-probes every reconcile pass. Keyed by target device so
+#: gating several devices from one process neither shares misplaced
+#: arrays nor pays cross-device transfers; NOT keyed by pallas/interpret,
+#: which don't affect the inputs or the reference.
+_PROBE_CACHE: dict[tuple, tuple] = {}
+
+
 @partial(jax.jit, static_argnames=("chain", "use_pallas", "interpret"))
 def _chained_matmul(a, b, chain: int, use_pallas: bool, interpret: bool):
     """``chain`` back-to-back matmuls in ONE compiled program, reduced to a
@@ -120,83 +142,108 @@ def mxu_probe(
     platforms where the Pallas TPU lowering is unavailable (the probe should
     degrade, not die, on exotic runtimes). ``device`` pins the probe to a
     specific device (default: the platform default). ``chain`` sets how
-    many dependent matmuls each timed dispatch runs (0 = auto: 2048 on an
-    accelerator, where dispatch latency would otherwise dominate; 1 under
-    interpret/CPU, where the chain would only slow the suite down).
+    many dependent matmuls each timed dispatch runs (0 = auto: on an
+    accelerator, enough matmuls that ~25 TFLOP of compute rides each
+    dispatch, so the ~65 ms tunnel round trip costs <25% of the
+    measurement at any probe size >= 1024 — a floor calibrated at one such
+    size stays valid at another; 1 under interpret/CPU, where the chain
+    would only slow the suite down).
     """
-    if device is not None:
-        with jax.default_device(device):
-            return mxu_probe(
-                size=size, dtype=dtype, use_pallas=use_pallas,
-                interpret=interpret, iters=iters, chain=chain, device=None,
-            )
+    import contextlib
+
+    ctx = (
+        jax.default_device(device)
+        if device is not None
+        else contextlib.nullcontext()
+    )
     try:
-        if chain <= 0:
-            on_accel = (
-                not interpret and jax.devices()[0].platform != "cpu"
+        with ctx:
+            return _mxu_probe_on_default_device(
+                size, dtype, use_pallas, interpret, iters, chain,
+                dev_token=str(device) if device is not None else "default",
             )
-            chain = 2048 if on_accel else 1
-        if use_pallas and size % 256:
-            # The Pallas kernel tiles (256, 256) output blocks; a probe
-            # size that cannot tile must degrade to the XLA dot, not fail
-            # a healthy node with "probe shapes must tile".
-            log.warning(
-                "matmul size %d not a multiple of 256; Pallas path "
-                "disabled for this probe", size,
-            )
-            use_pallas = False
+    except Exception as e:  # noqa: BLE001 - a dead MXU is a failed probe
+        return MxuReport(ok=False, error=str(e))
+
+
+def _mxu_probe_on_default_device(
+    size, dtype, use_pallas, interpret, iters, chain, dev_token
+) -> MxuReport:
+    on_accel = not interpret and jax.devices()[0].platform != "cpu"
+    if chain <= 0:
+        chain = (
+            max(16, min(_CHAIN_MAX,
+                        round(_CHAIN_FLOP_BUDGET / (2.0 * size**3))))
+            if on_accel
+            else 1
+        )
+    if use_pallas and size % 256:
+        # The Pallas kernel tiles (256, 256) output blocks; a probe
+        # size that cannot tile must degrade to the XLA dot, not fail
+        # a healthy node with "probe shapes must tile".
+        log.warning(
+            "matmul size %d not a multiple of 256; Pallas path "
+            "disabled for this probe", size,
+        )
+        use_pallas = False
+    cache_key = (size, str(dtype), dev_token)
+    cached = _PROBE_CACHE.get(cache_key)
+    if cached is None:
         key_a, key_b = jax.random.split(jax.random.PRNGKey(0))
         a = jax.random.normal(key_a, (size, size), dtype=jnp.float32)
         b = jax.random.normal(key_b, (size, size), dtype=jnp.float32)
         a_lp, b_lp = a.astype(dtype), b.astype(dtype)
-
-        if use_pallas and _HAS_PALLAS:
-            run = lambda: matmul(a_lp, b_lp, interpret=interpret)  # noqa: E731
-        else:
-            run = lambda: jnp.dot(  # noqa: E731
-                a_lp, b_lp, preferred_element_type=jnp.float32
-            )
-
-        out = np.asarray(run().block_until_ready())
-        # Independent reference: host numpy on the SAME quantized inputs.
-        # Computing the reference with jnp on the device under test would
-        # compare the suspect hardware against itself — a runtime that
-        # matmuls wrongly would agree with its own wrong answer and the
-        # check would always pass.
-        a_host = np.asarray(a_lp, dtype=np.float32)
-        b_host = np.asarray(b_lp, dtype=np.float32)
-        reference = a_host @ b_host
-        max_err = float(np.max(np.abs(out - reference)))
-        # bf16 products are exact in f32, so device and host differ only in
-        # f32 reduction order; the tolerance covers that ordering noise.
-        tol = 1e-2 * size ** 0.5
-        if max_err > tol:
-            return MxuReport(
-                ok=False, max_abs_err=max_err,
-                error=f"numerics mismatch: max_abs_err={max_err:.4f} > {tol:.4f}",
-            )
-
-        # Keep chain magnitudes O(1): each link multiplies by b/sqrt(K).
-        b_scaled = (b / np.sqrt(size)).astype(dtype)
-        # Sync via a host-scalar fetch: block_until_ready() on some remote
-        # PJRT runtimes returns before execution finishes, making timings
-        # fantasy (553 PFLOP/s observed); a device→host read cannot lie.
-        timed = lambda: float(  # noqa: E731
-            _chained_matmul(
-                a_lp, b_scaled, chain=chain,
-                use_pallas=use_pallas, interpret=interpret,
-            )
+        # Independent reference: host numpy on the SAME quantized
+        # inputs. Computing the reference with jnp on the device under
+        # test would compare the suspect hardware against itself — a
+        # runtime that matmuls wrongly would agree with its own wrong
+        # answer and the check would always pass. The inputs are
+        # deterministic, so the reference is computed once per config.
+        reference = np.asarray(a_lp, dtype=np.float32) @ np.asarray(
+            b_lp, dtype=np.float32
         )
-        timed()  # compile outside the timed region
-        samples = []
-        for _ in range(iters):
-            start = time.perf_counter()
-            timed()
-            samples.append(time.perf_counter() - start)
-        elapsed = float(np.median(samples))
-        flops = 2.0 * size**3 * chain
-        report = MxuReport(ok=True, tflops=flops / elapsed / 1e12, max_abs_err=max_err)
-        log.info("MXU probe: %.2f TFLOP/s (max_abs_err %.2e)", report.tflops, max_err)
-        return report
-    except Exception as e:  # noqa: BLE001 - a dead MXU is a failed probe
-        return MxuReport(ok=False, error=str(e))
+        # Keep chain magnitudes O(1): each link multiplies by b/√K.
+        b_scaled = (b / np.sqrt(size)).astype(dtype)
+        cached = (a_lp, b_lp, b_scaled, reference)
+        _PROBE_CACHE[cache_key] = cached
+    a_lp, b_lp, b_scaled, reference = cached
+
+    if use_pallas and _HAS_PALLAS:
+        run = lambda: matmul(a_lp, b_lp, interpret=interpret)  # noqa: E731
+    else:
+        run = lambda: jnp.dot(  # noqa: E731
+            a_lp, b_lp, preferred_element_type=jnp.float32
+        )
+
+    # The numerics check itself runs EVERY probe — it is the probe.
+    out = np.asarray(run().block_until_ready())
+    max_err = float(np.max(np.abs(out - reference)))
+    # bf16 products are exact in f32, so device and host differ only in
+    # f32 reduction order; the tolerance covers that ordering noise.
+    tol = 1e-2 * size ** 0.5
+    if max_err > tol:
+        return MxuReport(
+            ok=False, max_abs_err=max_err,
+            error=f"numerics mismatch: max_abs_err={max_err:.4f} > {tol:.4f}",
+        )
+
+    # Sync via a host-scalar fetch: block_until_ready() on some remote
+    # PJRT runtimes returns before execution finishes, making timings
+    # fantasy (553 PFLOP/s observed); a device→host read cannot lie.
+    timed = lambda: float(  # noqa: E731
+        _chained_matmul(
+            a_lp, b_scaled, chain=chain,
+            use_pallas=use_pallas, interpret=interpret,
+        )
+    )
+    timed()  # compile outside the timed region
+    samples = []
+    for _ in range(iters):
+        start = time.perf_counter()
+        timed()
+        samples.append(time.perf_counter() - start)
+    elapsed = float(np.median(samples))
+    flops = 2.0 * size**3 * chain
+    report = MxuReport(ok=True, tflops=flops / elapsed / 1e12, max_abs_err=max_err)
+    log.info("MXU probe: %.2f TFLOP/s (max_abs_err %.2e)", report.tflops, max_err)
+    return report

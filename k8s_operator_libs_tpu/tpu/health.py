@@ -37,7 +37,8 @@ log = get_logger("tpu.health")
 
 #: Healthy-throughput calibration, measured on a real TPU v5e chip
 #: (BENCH_r02 calibration battery): sustained chained-matmul MXU throughput
-#: 120–138 TFLOP/s (2048³ bf16, dispatch-amortized — ~60-70% of the chip's
+#: 110–138 TFLOP/s (bf16, FLOP-budgeted dispatch amortization — the
+#: measurement is probe-size-independent, ~60-70% of the chip's
 #: 197 TFLOP/s peak). Floors sit at ~25% of measured-healthy: far below
 #: normal jitter, far above the order-of-magnitude collapse a mis-installed
 #: libtpu or a degraded part shows (the failure mode the reference's

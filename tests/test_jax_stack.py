@@ -95,6 +95,19 @@ class TestCollectives:
         r = ppermute_ring(mesh, "x")
         assert r.ok and r.error == "single device"
 
+    def test_compiled_probe_cache_distinguishes_topologies(self, cpus):
+        """Regression: the probe jit-cache must key on mesh topology, not
+        flat device ids — a 1D and a 2D mesh over the SAME devices are
+        different programs, and a collision fails healthy hardware."""
+        import numpy as np
+        from jax.sharding import Mesh
+
+        devs = np.array(cpus[:4])
+        r1 = psum_check(Mesh(devs, ("x",)), "x")
+        r2 = psum_check(Mesh(devs.reshape(2, 2), ("x", "y")), "x")
+        assert r1.ok, r1.error
+        assert r2.ok, r2.error
+
 
 class TestMatmul:
     def test_xla_path_numerics(self, cpus):
@@ -106,6 +119,40 @@ class TestMatmul:
         with jax.default_device(cpus[0]):
             report = mxu_probe(size=256, use_pallas=True, interpret=True, iters=1)
         assert report.ok, report.error
+
+    def test_auto_chain_is_bounded_for_tiny_sizes(self):
+        """Regression: the FLOP-budget auto-chain must cap; a tiny probe
+        size must not explode into millions of loop iterations."""
+        from k8s_operator_libs_tpu.ops.matmul import (
+            _CHAIN_FLOP_BUDGET,
+            _CHAIN_MAX,
+        )
+
+        for size in (64, 256, 1024):
+            chain = max(
+                16,
+                min(_CHAIN_MAX, round(_CHAIN_FLOP_BUDGET / (2.0 * size**3))),
+            )
+            assert chain <= _CHAIN_MAX
+
+    def test_probe_cache_shared_across_kernel_flags(self, cpus):
+        """The input/reference cache is keyed by (size, dtype, device) —
+        switching between the XLA and Pallas paths must not duplicate the
+        host reference GEMM."""
+        from k8s_operator_libs_tpu.ops.matmul import _PROBE_CACHE
+
+        _PROBE_CACHE.clear()
+        mxu_probe(size=256, use_pallas=False, device=cpus[0], iters=1)
+        n_after_first = len(_PROBE_CACHE)
+        mxu_probe(
+            size=256, use_pallas=True, interpret=True,
+            device=cpus[0], iters=1,
+        )
+        assert n_after_first == 1
+        assert len(_PROBE_CACHE) == 1  # same entry reused
+        # A different device gets its own entry (placement correctness).
+        mxu_probe(size=256, use_pallas=False, device=cpus[1], iters=1)
+        assert len(_PROBE_CACHE) == 2
 
 
 class TestBurnin:
