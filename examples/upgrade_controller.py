@@ -123,6 +123,18 @@ def main(argv: list[str] | None = None) -> int:
         action="store_true",
         help="gate uncordon on the JAX ICI/MXU health probes",
     )
+    parser.add_argument(
+        "--validation-pod",
+        action="store_true",
+        help="validate via framework-provisioned probe pods on each node "
+        "(the production shape) instead of in-process probes",
+    )
+    parser.add_argument(
+        "--requestor",
+        action="store_true",
+        help="delegate cordon/drain to a maintenance operator over "
+        "NodeMaintenance CRs (simulated in --demo)",
+    )
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO, format="%(levelname)s %(message)s")
 
@@ -147,7 +159,25 @@ def main(argv: list[str] | None = None) -> int:
     mgr = ClusterUpgradeStateManager(
         client, device, runner=TaskRunner(inline=args.demo)
     )
-    if args.ici_gate or (args.demo and args.device == "tpu"):
+    validation_pod_sim = None
+    if args.validation_pod:
+        from k8s_operator_libs_tpu.tpu import (
+            ValidationPodManager,
+            ValidationPodSpec,
+        )
+
+        spec = ValidationPodSpec(namespace=args.namespace)
+        mgr.with_validation_enabled(
+            pod_provisioner=ValidationPodManager(client, spec)
+        )
+        if args.demo:
+            # The demo has no kubelet; simulate one running the probe pods.
+            from k8s_operator_libs_tpu.kube.sim import ValidationPodSimulator
+
+            validation_pod_sim = ValidationPodSimulator(
+                client, namespace=args.namespace
+            )
+    elif args.ici_gate or (args.demo and args.device == "tpu"):
         from k8s_operator_libs_tpu.tpu import IciHealthGate, SliceScopedGate
 
         gate = IciHealthGate(payload_mb=1.0, matmul_size=1024, run_burnin=True)
@@ -161,6 +191,28 @@ def main(argv: list[str] | None = None) -> int:
         from k8s_operator_libs_tpu.tpu import enable_slice_aware_planning
 
         enable_slice_aware_planning(mgr)
+    maintenance_sim = None
+    if args.requestor:
+        from k8s_operator_libs_tpu.upgrade import (
+            RequestorOptions,
+            enable_requestor_mode,
+        )
+
+        opts = RequestorOptions.from_env()
+        opts.use_maintenance_operator = True  # the flag IS the opt-in
+        # The env var wins over the argparse default; from_env honors it
+        # deliberately (MAINTENANCE_OPERATOR_REQUESTOR_NAMESPACE).
+        if not os.environ.get("MAINTENANCE_OPERATOR_REQUESTOR_NAMESPACE"):
+            opts.namespace = args.namespace
+        enable_requestor_mode(mgr, opts)
+        if args.demo:
+            from k8s_operator_libs_tpu.kube.sim import (
+                MaintenanceOperatorSimulator,
+            )
+
+            maintenance_sim = MaintenanceOperatorSimulator(
+                client, namespace=args.namespace
+            )
 
     passes = 0
     max_demo_passes = 100  # a 4-node roll converges in <15; 100 = stuck
@@ -174,6 +226,10 @@ def main(argv: list[str] | None = None) -> int:
             return 1
         if sim is not None:
             sim.step()
+        if maintenance_sim is not None:
+            maintenance_sim.step()
+        if validation_pod_sim is not None:
+            validation_pod_sim.step()
         state = mgr.build_state(args.namespace, selector)
         mgr.apply_state(state, policy)
         if sim is not None:

@@ -161,22 +161,33 @@ def mxu_probe(
             return _mxu_probe_on_default_device(
                 size, dtype, use_pallas, interpret, iters, chain,
                 dev_token=str(device) if device is not None else "default",
+                platform=(
+                    device.platform
+                    if device is not None
+                    else jax.devices()[0].platform
+                ),
             )
     except Exception as e:  # noqa: BLE001 - a dead MXU is a failed probe
         return MxuReport(ok=False, error=str(e))
 
 
+def _auto_chain(size: int, on_accel: bool) -> int:
+    """Links per timed dispatch: FLOP-budgeted on accelerators (capped —
+    see _CHAIN_MAX), single matmul elsewhere."""
+    if not on_accel:
+        return 1
+    return max(16, min(_CHAIN_MAX, round(_CHAIN_FLOP_BUDGET / (2.0 * size**3))))
+
+
 def _mxu_probe_on_default_device(
-    size, dtype, use_pallas, interpret, iters, chain, dev_token
+    size, dtype, use_pallas, interpret, iters, chain, dev_token, platform
 ) -> MxuReport:
-    on_accel = not interpret and jax.devices()[0].platform != "cpu"
+    # The PINNED device's platform decides the chain — jax.devices()[0] on
+    # a TPU-attached host says "tpu" even when the probe targets a CPU
+    # device, and a TPU-sized chain takes minutes of host matmuls.
+    on_accel = not interpret and platform != "cpu"
     if chain <= 0:
-        chain = (
-            max(16, min(_CHAIN_MAX,
-                        round(_CHAIN_FLOP_BUDGET / (2.0 * size**3))))
-            if on_accel
-            else 1
-        )
+        chain = _auto_chain(size, on_accel)
     if use_pallas and size % 256:
         # The Pallas kernel tiles (256, 256) output blocks; a probe
         # size that cannot tile must degrade to the XLA dot, not fail

@@ -357,32 +357,47 @@ class SliceScopedGate:
     host count for no additional signal. This wrapper runs the gate once per
     (slice, result) and serves cached passes to the slice's remaining nodes;
     failures are NOT cached, so a flapping link is re-probed every pass.
+
+    Cached passes expire after ``max_age_seconds`` so a pass earned during
+    one rollout cannot leak into the next: a long-lived controller that
+    rolled libtpu v2 must not skip validating v3 on the strength of v2's
+    probes. Within one rollout the slice's nodes reach validation within
+    minutes of each other, so the default (30 min) keeps the
+    one-run-per-slice saving; across rollouts the cache is stale by
+    construction. Call :meth:`reset` at a known rollout boundary (e.g. when
+    bumping the DaemonSet version) for an exact invalidation instead of a
+    timed one.
     """
 
     def __init__(
         self,
         gate: IciHealthGate,
         detector=None,
+        max_age_seconds: float = 1800.0,
     ) -> None:
         from .detector import TpuNodeDetector
 
         self.gate = gate
         self.detector = detector or TpuNodeDetector()
-        self._passed: set[str] = set()
+        self.max_age_seconds = max_age_seconds
+        self._passed_at: dict[str, float] = {}
 
     def reset(self) -> None:
         """Forget cached passes (call at the start of a new rollout)."""
-        self._passed.clear()
+        self._passed_at.clear()
 
     def validation_hook(self):
         def hook(node) -> bool:
             info = self.detector.detect(node)
             slice_id = info.slice_id if info is not None else node.name
-            if slice_id in self._passed:
-                return True
+            passed_at = self._passed_at.get(slice_id)
+            if passed_at is not None:
+                if time.monotonic() - passed_at < self.max_age_seconds:
+                    return True
+                del self._passed_at[slice_id]  # stale: re-probe
             report = self.gate.run()
             if report.ok:
-                self._passed.add(slice_id)
+                self._passed_at[slice_id] = time.monotonic()
             else:
                 log.warning(
                     "slice %s failed ICI health gate: %s",

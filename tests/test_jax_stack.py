@@ -123,17 +123,25 @@ class TestMatmul:
     def test_auto_chain_is_bounded_for_tiny_sizes(self):
         """Regression: the FLOP-budget auto-chain must cap; a tiny probe
         size must not explode into millions of loop iterations."""
-        from k8s_operator_libs_tpu.ops.matmul import (
-            _CHAIN_FLOP_BUDGET,
-            _CHAIN_MAX,
-        )
+        from k8s_operator_libs_tpu.ops.matmul import _CHAIN_MAX, _auto_chain
 
         for size in (64, 256, 1024):
-            chain = max(
-                16,
-                min(_CHAIN_MAX, round(_CHAIN_FLOP_BUDGET / (2.0 * size**3))),
-            )
-            assert chain <= _CHAIN_MAX
+            assert 16 <= _auto_chain(size, on_accel=True) <= _CHAIN_MAX
+        # The budget formula alone would demand ~48M links at size 64 —
+        # the production helper must cap it.
+        assert _auto_chain(64, on_accel=True) == _CHAIN_MAX
+        assert _auto_chain(64, on_accel=False) == 1
+
+    def test_cpu_pinned_probe_ignores_accelerator_presence(self, cpus):
+        """A probe pinned to a CPU device must use the CPU chain (1), not
+        the accelerator FLOP budget, even when jax.devices()[0] is an
+        accelerator — a TPU-sized chain of host matmuls takes minutes."""
+        import time as _time
+
+        start = _time.perf_counter()
+        report = mxu_probe(size=256, use_pallas=False, device=cpus[0], iters=1)
+        assert report.ok
+        assert _time.perf_counter() - start < 30
 
     def test_probe_cache_shared_across_kernel_flags(self, cpus):
         """The input/reference cache is keyed by (size, dtype, device) —

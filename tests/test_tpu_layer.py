@@ -365,3 +365,77 @@ class TestCalibratedFloors:
         cmd = ValidationPodSpec().probe_command()
         assert str(TPU_DEFAULT_MIN_MXU_TFLOPS) in cmd
         assert str(TPU_DEFAULT_MIN_RING_GBYTES_PER_S) in cmd
+
+
+class TestSliceScopedGate:
+    """Slice-granular memoization: one probe run admits the slice's other
+    nodes, failures never cached, passes expire so one rollout's probes
+    cannot vouch for the next rollout's driver."""
+
+    class StubGate:
+        def __init__(self, ok=True):
+            self.ok = ok
+            self.runs = 0
+
+        def run(self):
+            from k8s_operator_libs_tpu.tpu.health import HealthReport
+
+            self.runs += 1
+            return HealthReport(
+                ok=self.ok, failures=[] if self.ok else ["stub failure"]
+            )
+
+    @staticmethod
+    def slice_nodes(pool, n=2):
+        return [
+            make_node(f"{pool}-{i}", labels=tpu_labels(pool)) for i in range(n)
+        ]
+
+    def test_one_run_admits_whole_slice(self):
+        from k8s_operator_libs_tpu.tpu import SliceScopedGate
+
+        stub = self.StubGate(ok=True)
+        hook = SliceScopedGate(stub).validation_hook()
+        a, b = self.slice_nodes("pool-a")
+        assert hook(a) and hook(b)
+        assert stub.runs == 1  # second node served from the cached pass
+
+    def test_distinct_slices_probe_separately(self):
+        from k8s_operator_libs_tpu.tpu import SliceScopedGate
+
+        stub = self.StubGate(ok=True)
+        hook = SliceScopedGate(stub).validation_hook()
+        (a,) = self.slice_nodes("pool-a", 1)
+        (b,) = self.slice_nodes("pool-b", 1)
+        assert hook(a) and hook(b)
+        assert stub.runs == 2
+
+    def test_failures_never_cached(self):
+        from k8s_operator_libs_tpu.tpu import SliceScopedGate
+
+        stub = self.StubGate(ok=False)
+        hook = SliceScopedGate(stub).validation_hook()
+        a, b = self.slice_nodes("pool-a")
+        assert not hook(a) and not hook(b)
+        assert stub.runs == 2  # flapping link re-probed every pass
+
+    def test_pass_expires_for_next_rollout(self):
+        from k8s_operator_libs_tpu.tpu import SliceScopedGate
+
+        stub = self.StubGate(ok=True)
+        hook = SliceScopedGate(stub, max_age_seconds=0.0).validation_hook()
+        a, _ = self.slice_nodes("pool-a")
+        assert hook(a) and hook(a)
+        assert stub.runs == 2  # expired immediately: re-probed
+
+    def test_reset_clears_cached_passes(self):
+        from k8s_operator_libs_tpu.tpu import SliceScopedGate
+
+        stub = self.StubGate(ok=True)
+        gate = SliceScopedGate(stub)
+        hook = gate.validation_hook()
+        a, _ = self.slice_nodes("pool-a")
+        assert hook(a)
+        gate.reset()  # rollout boundary
+        assert hook(a)
+        assert stub.runs == 2
