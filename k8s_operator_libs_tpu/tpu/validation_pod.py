@@ -27,6 +27,7 @@ from ..kube.objects import Node, Pod
 from ..upgrade.consts import DeviceClass
 from ..utils.log import get_logger
 from .health import (
+    HEALTH_CACHE_DIR,
     TPU_DEFAULT_MIN_MXU_TFLOPS,
     TPU_DEFAULT_MIN_RING_GBYTES_PER_S,
 )
@@ -65,6 +66,10 @@ class ValidationPodSpec:
     run_seq_parallel_probes: bool = False
     #: Seconds between readinessProbe executions / before first check.
     probe_period_seconds: int = 10
+    #: Host path for the persistent XLA compilation cache (empty = no
+    #: cache mount). Keep it under a root-owned parent — see
+    #: health.HEALTH_CACHE_DIR for the threat model.
+    compile_cache_dir: str = HEALTH_CACHE_DIR
 
     @property
     def full_image(self) -> str:
@@ -125,11 +130,38 @@ class ValidationPodManager:
             {"key": TPU_RESOURCE, "operator": "Exists", "effect": "NoSchedule"},
             {"operator": "Exists", "effect": "NoExecute"},
         ]
+        # The XLA compile cache lives on the HOST: probe pods recreated
+        # within one runtime version skip the ~30 s compile-dominated cold
+        # battery (~5 s warm); a driver bump changes the cache key and
+        # recompiles once per node (health.py HEALTH_CACHE_DIR).
+        env = []
+        volume_mounts = []
+        if spec.compile_cache_dir:
+            pod.spec["volumes"] = [
+                {
+                    "name": "jax-cache",
+                    "hostPath": {
+                        "path": spec.compile_cache_dir,
+                        "type": "DirectoryOrCreate",
+                    },
+                }
+            ]
+            env.append(
+                {
+                    "name": "JAX_COMPILATION_CACHE_DIR",
+                    "value": spec.compile_cache_dir,
+                }
+            )
+            volume_mounts.append(
+                {"name": "jax-cache", "mountPath": spec.compile_cache_dir}
+            )
         pod.spec["containers"] = [
             {
                 "name": "probe",
                 "image": spec.full_image,
                 "command": spec.probe_command(),
+                "env": env,
+                "volumeMounts": volume_mounts,
                 "resources": {
                     "requests": {TPU_RESOURCE: str(spec.tpu_chips)},
                     "limits": {TPU_RESOURCE: str(spec.tpu_chips)},

@@ -227,6 +227,7 @@ class TestHealthCli:
         rc = main(
             [
                 "--no-burnin",
+                "--no-compile-cache",
                 "--payload-mb", "0.05",
                 "--matmul-size", "64",
                 "--ready-file", str(ready),
@@ -246,6 +247,7 @@ class TestHealthCli:
         rc = main(
             [
                 "--no-burnin",
+                "--no-compile-cache",
                 "--payload-mb", "0.05",
                 "--matmul-size", "64",
                 "--min-mxu-tflops", "1e9",
@@ -257,3 +259,49 @@ class TestHealthCli:
         report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
         assert report["ok"] is False
         assert any("below floor" in f for f in report["failures"])
+
+
+class TestCompileCache:
+    def test_pod_mounts_host_compile_cache(self):
+        from k8s_operator_libs_tpu.tpu.health import HEALTH_CACHE_DIR
+
+        pod = ValidationPodManager(FakeCluster(), ValidationPodSpec()).build_pod("n")
+        vol = pod.spec["volumes"][0]
+        assert vol["hostPath"]["path"] == HEALTH_CACHE_DIR
+        # Root-owned parent, never /tmp: a predictable world-writable
+        # path invites cache poisoning of the privileged probe.
+        assert HEALTH_CACHE_DIR.startswith("/var/cache/")
+        container = pod.spec["containers"][0]
+        assert {"name": "jax-cache", "mountPath": HEALTH_CACHE_DIR} in container[
+            "volumeMounts"
+        ]
+        assert {
+            "name": "JAX_COMPILATION_CACHE_DIR",
+            "value": HEALTH_CACHE_DIR,
+        } in container["env"]
+
+    def test_cache_mount_can_be_disabled(self):
+        pod = ValidationPodManager(
+            FakeCluster(), ValidationPodSpec(compile_cache_dir="")
+        ).build_pod("n")
+        assert "volumes" not in pod.spec
+        assert pod.spec["containers"][0]["env"] == []
+
+    def test_cli_enables_cache_before_probing(self, tmp_path, monkeypatch):
+        import jax
+
+        from k8s_operator_libs_tpu.tpu.health import main
+
+        cache = tmp_path / "xla-cache"
+        monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", str(cache))
+        prev = jax.config.jax_compilation_cache_dir
+        try:
+            rc = main(
+                ["--no-burnin", "--payload-mb", "0.05", "--matmul-size", "64"]
+            )
+            assert rc == 0
+            assert jax.config.jax_compilation_cache_dir == str(cache)
+        finally:
+            # jax config is process-global; leaking a pytest tmp_path as
+            # the cache dir would make later tests order-dependent.
+            jax.config.update("jax_compilation_cache_dir", prev)
