@@ -4,6 +4,7 @@ from .client import (
     Client,
     ConflictError,
     InvalidError,
+    WatchExpiredError,
     NotFoundError,
     retry_on_conflict,
 )
@@ -45,6 +46,7 @@ __all__ = [
     "FakeCluster",
     "FakeRecorder",
     "InvalidError",
+    "WatchExpiredError",
     "KubeObject",
     "LabelSelector",
     "LocalApiServer",

@@ -126,6 +126,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- verbs -------------------------------------------------------------
     def _do_get(self, cluster, info, namespace, name, subresource, query):
+        if not name and query.get("watch") in ("true", "1"):
+            self._do_watch(cluster, info, namespace, query)
+            return
         if name:
             obj = cluster.get(info.kind, name, namespace)
             self._send_json(200, obj.raw)
@@ -144,6 +147,135 @@ class _Handler(BaseHTTPRequestHandler):
                 "items": [o.raw for o in items],
             },
         )
+
+    def _do_watch(self, cluster, info, namespace, query):
+        """``?watch=true``: stream newline-delimited watch events.
+
+        Kubernetes watch semantics in the shape the library consumes:
+
+        * ``resourceVersion=N`` resumes from the event journal — the
+          list-then-watch pattern with no lost-event window (events since
+          the listed revision replay first; an expired revision returns
+          410 Gone and the client must re-list);
+        * without ``resourceVersion``, events after establishment stream;
+        * scope transitions follow the real apiserver: an object whose
+          update makes it START matching the selector arrives as ADDED,
+          one that STOPS matching arrives as DELETED;
+        * a consumer too slow to drain its event queue loses the watch
+          (stream closed) rather than silently losing events;
+        * ``timeoutSeconds`` bounds the stream server-side.
+
+        Events are ``{"type": ADDED|MODIFIED|DELETED, "object": {...}}``
+        JSON lines; the stream is EOF-delimited (``Connection: close``).
+        """
+        import queue
+        import time
+
+        from .fake import _field_value
+        from .selectors import parse_field_selector, parse_selector
+
+        selector = parse_selector(query.get("labelSelector") or None)
+        fields = parse_field_selector(query.get("fieldSelector") or None)
+        timeout_s = (
+            float(query["timeoutSeconds"])
+            if query.get("timeoutSeconds")
+            else None
+        )
+        kind = info.kind
+        events: queue.Queue = queue.Queue(maxsize=1024)
+        overflowed = threading.Event()
+
+        def in_selector_scope(data) -> bool:
+            meta = data.get("metadata") or {}
+            return selector.matches(meta.get("labels") or {}) and not any(
+                _field_value(data, f) != v for f, v in fields.items()
+            )
+
+        def scoped_event(event_type: str, data: dict, old):
+            """Classify against the selector by old-vs-new state — the
+            real watch cache's logic: entering scope is ADDED, leaving it
+            is DELETED, staying in is MODIFIED; None = out of scope
+            throughout. Stateless, so replayed and live events classify
+            identically."""
+            new_matches = event_type != "DELETED" and in_selector_scope(data)
+            old_matches = old is not None and in_selector_scope(old)
+            if new_matches and old_matches:
+                return "MODIFIED"
+            if new_matches:
+                return "ADDED"
+            if old_matches:
+                return "DELETED"
+            return None
+
+        def on_event(event_type: str, data: dict, old) -> None:
+            # Cheap static filters only; scope classification happens on
+            # the handler thread.
+            if data.get("kind") != kind:
+                return
+            meta = data.get("metadata") or {}
+            if namespace and meta.get("namespace", "") != namespace:
+                return
+            try:
+                events.put_nowait((event_type, data, old))
+            except queue.Full:
+                overflowed.set()  # close the watch; the client re-lists
+
+        try:
+            replay = cluster.subscribe_since(
+                on_event, query.get("resourceVersion")
+            )
+        except ApiError as e:
+            self._send_error(e)
+            return
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            # EOF-delimited stream: the client reads lines until close.
+            self.send_header("Connection", "close")
+            self.end_headers()
+            for event_type, data, old in replay:
+                if data.get("kind") != kind:
+                    continue
+                meta = data.get("metadata") or {}
+                if namespace and meta.get("namespace", "") != namespace:
+                    continue
+                mapped = scoped_event(event_type, data, old)
+                if mapped is None:
+                    continue
+                if not self._write_event(mapped, data):
+                    return
+            deadline = (
+                time.monotonic() + timeout_s if timeout_s is not None else None
+            )
+            while not overflowed.is_set():
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    poll = min(0.2, remaining)
+                else:
+                    poll = 0.2
+                try:
+                    event_type, data, old = events.get(timeout=poll)
+                except queue.Empty:
+                    continue
+                mapped = scoped_event(event_type, data, old)
+                if mapped is None:
+                    continue
+                if not self._write_event(mapped, data):
+                    break
+        finally:
+            cluster.unsubscribe(on_event)
+            self.close_connection = True
+
+    def _write_event(self, event_type: str, data: dict) -> bool:
+        line = json.dumps({"type": event_type, "object": data}) + "\n"
+        try:
+            self.wfile.write(line.encode())
+            self.wfile.flush()
+            return True
+        except (BrokenPipeError, ConnectionResetError):
+            return False
 
     def _do_post(self, cluster, info, namespace, name, subresource, query):
         body = self._read_body()

@@ -49,6 +49,14 @@ class InvalidError(ApiError):
     reason = "Invalid"
 
 
+class WatchExpiredError(ApiError):
+    """Watch resumption point fell out of the event journal (410 Gone):
+    the client must re-list and start a fresh watch."""
+
+    status = 410
+    reason = "Expired"
+
+
 class Client(abc.ABC):
     """Minimal typed Kubernetes client surface used by the framework."""
 

@@ -23,6 +23,7 @@ import itertools
 import threading
 import time
 import uuid
+from collections import deque
 from typing import Any, Callable, Mapping, Optional
 
 from .client import (
@@ -31,6 +32,7 @@ from .client import (
     ConflictError,
     InvalidError,
     NotFoundError,
+    WatchExpiredError,
 )
 from .objects import KINDS, KubeObject, wrap
 from .selectors import LabelSelector, parse_field_selector, parse_selector
@@ -176,7 +178,15 @@ class FakeCluster(Client):
         self._store: dict[tuple[str, str, str], dict[str, Any]] = {}
         self._rv = itertools.count(1)
         self._reactors: list[tuple[str, str, Reactor]] = []
-        self._watchers: list[Callable[[str, dict[str, Any]], None]] = []
+        self._watchers: list[
+            Callable[[str, dict[str, Any], Optional[dict[str, Any]]], None]
+        ] = []
+        # Bounded event journal for watch resumption: (rv, event, object).
+        # A watcher resuming from a resourceVersion older than the oldest
+        # entry gets a 410 Gone analog (WatchExpiredError), like etcd.
+        self._history: deque[
+            tuple[int, str, dict[str, Any], Optional[dict[str, Any]]]
+        ] = deque(maxlen=4096)
         self._changed = threading.Condition(self._lock)
         self._generation = 0
         # Emulate the apiserver's CRD controller: created CRDs gain the
@@ -197,15 +207,76 @@ class FakeCluster(Client):
                 fn(verb, kind, payload)
 
     # -- watch -------------------------------------------------------------
-    def subscribe(self, fn: Callable[[str, dict[str, Any]], None]) -> None:
-        """Register a watcher receiving (event_type, object_dict) on every write."""
+    def subscribe(
+        self, fn: Callable[[str, dict[str, Any], Optional[dict[str, Any]]], None]
+    ) -> None:
+        """Register a watcher receiving ``(event_type, object, old_object)``
+        on every write — ``old_object`` is the pre-mutation state (None for
+        ADDED), which is what lets selector-scoped watches classify
+        transitions exactly as the real watch cache does."""
         with self._lock:
             self._watchers.append(fn)
 
-    def _emit(self, event: str, data: dict[str, Any]) -> None:
+    def unsubscribe(self, fn) -> None:
+        """Remove a subscribed watcher (no-op when absent — a watch may be
+        torn down from a thread racing the subscription)."""
+        with self._lock:
+            try:
+                self._watchers.remove(fn)
+            except ValueError:
+                pass
+
+    def subscribe_since(
+        self,
+        fn: Callable[[str, dict[str, Any]], None],
+        resource_version: Optional[str] = None,
+    ) -> list[tuple[str, dict[str, Any]]]:
+        """Atomically subscribe and return the journal entries newer than
+        ``resource_version`` — the list-then-watch resumption primitive: no
+        event between the caller's list and this subscription can be lost,
+        because replay collection and watcher registration happen under the
+        same lock every mutation holds while emitting.
+
+        Raises :class:`WatchExpiredError` when ``resource_version`` is
+        older than the journal's oldest entry (the 410 Gone analog —
+        the client must re-list).
+        """
+        with self._lock:
+            replay: list[tuple[str, dict[str, Any], Optional[dict[str, Any]]]] = []
+            if resource_version is not None and resource_version != "":
+                since = int(resource_version)
+                if self._history and self._history[0][0] > since + 1:
+                    raise WatchExpiredError(
+                        f"resourceVersion {since} is too old "
+                        f"(oldest journaled: {self._history[0][0]})"
+                    )
+                replay = [
+                    (event, copy.deepcopy(data), copy.deepcopy(old))
+                    for rv, event, data, old in self._history
+                    if rv > since
+                ]
+            self._watchers.append(fn)
+            return replay
+
+    def _emit(
+        self,
+        event: str,
+        data: dict[str, Any],
+        old: Optional[dict[str, Any]] = None,
+    ) -> None:
         snapshot = copy.deepcopy(data)
+        old_snapshot = copy.deepcopy(old) if old is not None else None
+        if old_snapshot is None and event != _WATCH_ADDED:
+            # DELETED with no explicit prior: the object itself is the
+            # pre-deletion state.
+            old_snapshot = snapshot if event == _WATCH_DELETED else None
+        try:
+            rv = int((snapshot.get("metadata") or {}).get("resourceVersion"))
+        except (TypeError, ValueError):
+            rv = next(self._rv)  # defensive: journal stays ordered
+        self._history.append((rv, event, snapshot, old_snapshot))
         for fn in list(self._watchers):
-            fn(event, snapshot)
+            fn(event, snapshot, old_snapshot)
         with self._changed:
             self._generation += 1
             self._changed.notify_all()
@@ -325,9 +396,10 @@ class FakeCluster(Client):
         status = data.setdefault("status", {})
         conds = status.setdefault("conditions", [])
         if not any(c.get("type") == "Established" for c in conds):
+            old = copy.deepcopy(data)
             conds.append({"type": "Established", "status": "True"})
             self._bump(data)
-            self._emit(_WATCH_MODIFIED, data)
+            self._emit(_WATCH_MODIFIED, data, old=old)
 
     def _establish_crd(self, name: str) -> None:
         with self._lock:
@@ -347,6 +419,7 @@ class FakeCluster(Client):
                 raise ConflictError(
                     f"{kind} {obj.name}: resourceVersion {sent_rv} is stale"
                 )
+            old = copy.deepcopy(current)
             if status_only:
                 current["status"] = copy.deepcopy(obj.raw.get("status") or {})
                 data = current
@@ -367,7 +440,7 @@ class FakeCluster(Client):
                     data.pop("status", None)
                 self._store[self._key(kind, obj.namespace, obj.name)] = data
             self._bump(data)
-            self._emit(_WATCH_MODIFIED, data)
+            self._emit(_WATCH_MODIFIED, data, old=old)
             self._finalize_delete_if_due(kind, obj.name, obj.namespace)
             return wrap(copy.deepcopy(data))
 
@@ -390,6 +463,7 @@ class FakeCluster(Client):
                                         "patch": dict(patch or {}),
                                         "patch_type": patch_type})
             current = self._get_raw(kind, name, namespace)
+            old = copy.deepcopy(current)
             if patch_type == "strategic":
                 strategic_merge_patch(current, patch or {})
             elif patch_type == "merge":
@@ -403,7 +477,7 @@ class FakeCluster(Client):
             meta = current.setdefault("metadata", {})
             meta["name"] = name
             self._bump(current)
-            self._emit(_WATCH_MODIFIED, current)
+            self._emit(_WATCH_MODIFIED, current, old=old)
             self._finalize_delete_if_due(kind, name, namespace)
             return wrap(copy.deepcopy(current))
 
@@ -421,9 +495,10 @@ class FakeCluster(Client):
             meta = data.setdefault("metadata", {})
             if meta.get("finalizers"):
                 if not meta.get("deletionTimestamp"):
+                    old = copy.deepcopy(data)
                     meta["deletionTimestamp"] = time.time()
                     self._bump(data)
-                    self._emit(_WATCH_MODIFIED, data)
+                    self._emit(_WATCH_MODIFIED, data, old=old)
                 return
             del self._store[key]
             self._emit(_WATCH_DELETED, data)

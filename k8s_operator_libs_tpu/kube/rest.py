@@ -34,6 +34,7 @@ from .client import (
     ConflictError,
     InvalidError,
     NotFoundError,
+    WatchExpiredError,
 )
 from .objects import KubeObject, wrap
 from .resources import ResourceInfo, resource_for_kind
@@ -240,8 +241,14 @@ _ERRORS_BY_REASON = {
     "AlreadyExists": AlreadyExistsError,
     "Conflict": ConflictError,
     "Invalid": InvalidError,
+    "Expired": WatchExpiredError,
 }
-_ERRORS_BY_CODE = {404: NotFoundError, 409: ConflictError, 422: InvalidError}
+_ERRORS_BY_CODE = {
+    404: NotFoundError,
+    409: ConflictError,
+    410: WatchExpiredError,
+    422: InvalidError,
+}
 
 
 class RestClient(Client):
@@ -368,14 +375,11 @@ class RestClient(Client):
         info = resource_for_kind(kind)
         return wrap(self._request("GET", self._path(info, namespace, name)))
 
-    def list(
+    def _selector_query(
         self,
-        kind: str,
-        namespace: str = "",
-        label_selector: Optional[str | Mapping[str, str]] = None,
-        field_selector: Optional[str] = None,
-    ) -> list[KubeObject]:
-        info = resource_for_kind(kind)
+        label_selector: Optional[str | Mapping[str, str]],
+        field_selector: Optional[str],
+    ) -> dict[str, str]:
         query: dict[str, str] = {}
         if label_selector:
             if isinstance(label_selector, Mapping):
@@ -386,13 +390,95 @@ class RestClient(Client):
                 query["labelSelector"] = label_selector
         if field_selector:
             query["fieldSelector"] = field_selector
+        return query
+
+    def _collection_path(self, info: ResourceInfo, namespace: str) -> str:
         if info.namespaced and not namespace:
-            # All-namespaces list: /{prefix}/{plural}
-            path = f"{info.path_prefix}/{info.plural}"
-        else:
-            path = self._path(info, namespace)
+            # All-namespaces: /{prefix}/{plural}
+            return f"{info.path_prefix}/{info.plural}"
+        return self._path(info, namespace)
+
+    def list(
+        self,
+        kind: str,
+        namespace: str = "",
+        label_selector: Optional[str | Mapping[str, str]] = None,
+        field_selector: Optional[str] = None,
+    ) -> list[KubeObject]:
+        info = resource_for_kind(kind)
+        query = self._selector_query(label_selector, field_selector)
+        path = self._collection_path(info, namespace)
         out = self._request("GET", path, query=query)
         return [wrap(item) for item in out.get("items") or []]
+
+    def watch(
+        self,
+        kind: str,
+        namespace: str = "",
+        label_selector: Optional[str | Mapping[str, str]] = None,
+        field_selector: Optional[str] = None,
+        timeout_seconds: Optional[int] = None,
+        resource_version: Optional[str] = None,
+    ):
+        """Stream watch events as ``(event_type, KubeObject)`` pairs.
+
+        The list-then-watch shape the reference consumes through
+        controller-runtime (its NodeMaintenance predicates react to watch
+        deltas, upgrade_requestor.go:115-159). Pass the listed objects'
+        highest ``resource_version`` to resume with no lost-event window —
+        events since that revision replay first; a revision that fell out
+        of the server's journal raises ``WatchExpiredError`` (410) and the
+        caller must re-list. Without ``resource_version``, only events
+        after establishment arrive (there IS a races-with-list window —
+        poll-reconcile in addition, as the upgrade controller does).
+
+        ``timeout_seconds`` bounds the stream server-side, like the real
+        apiserver's int64 ``timeoutSeconds`` (the generator ends); without
+        it the stream runs until the consumer closes the generator. Uses a
+        dedicated connection — a watch parks on the socket and must not
+        hog the thread's pooled keep-alive connection.
+        """
+        info = resource_for_kind(kind)
+        query = self._selector_query(label_selector, field_selector)
+        query["watch"] = "true"
+        if timeout_seconds is not None:
+            # int64 on a real apiserver: "300.0" would be a 400.
+            query["timeoutSeconds"] = str(int(timeout_seconds))
+        if resource_version is not None:
+            query["resourceVersion"] = resource_version
+        path = self._collection_path(info, namespace)
+        url = self._base_path + path + "?" + urllib.parse.urlencode(query)
+        headers = {"Accept": "application/json"}
+        if self.config.token:
+            headers["Authorization"] = f"Bearer {self.config.token}"
+        # Socket timeout must outlive the server-side stream bound; an
+        # unbounded watch blocks in readline indefinitely (by design).
+        sock_timeout = (
+            timeout_seconds + self.timeout
+            if timeout_seconds is not None
+            else None
+        )
+        if self._https:
+            conn = http.client.HTTPSConnection(
+                self._host, self._port, timeout=sock_timeout, context=self._ssl
+            )
+        else:
+            conn = http.client.HTTPConnection(
+                self._host, self._port, timeout=sock_timeout
+            )
+        try:
+            conn.request("GET", url, headers=headers)
+            resp = conn.getresponse()
+            if resp.status >= 400:
+                raise self._api_error(resp.status, resp.read())
+            while True:
+                line = resp.readline()
+                if not line:
+                    return  # server ended the stream (timeout / shutdown)
+                event = json.loads(line)
+                yield event["type"], wrap(event["object"])
+        finally:
+            conn.close()
 
     def create(self, obj: KubeObject) -> KubeObject:
         info = resource_for_kind(obj.raw.get("kind", ""))

@@ -193,7 +193,7 @@ class TestListSelectors:
 class TestWatchAndReactors:
     def test_watch_events(self, cluster):
         events = []
-        cluster.subscribe(lambda e, o: events.append((e, o["metadata"]["name"])))
+        cluster.subscribe(lambda e, o, old: events.append((e, o["metadata"]["name"])))
         cluster.create(make_node("n1"))
         cluster.patch("Node", "n1", patch={"metadata": {"labels": {"a": "b"}}})
         cluster.delete("Node", "n1")

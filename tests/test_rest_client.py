@@ -9,6 +9,7 @@ upgrade driven over the wire.
 
 import base64
 import subprocess
+import time
 
 import pytest
 
@@ -324,3 +325,182 @@ class TestRollingUpgradeOverRest:
             assert not Node(node.raw).unschedulable
         for pod in cluster.list("Pod", namespace="driver-ns"):
             assert Pod(pod.raw).labels["controller-revision-hash"] == "rev-2"
+
+
+class TestWatch:
+    """HTTP watch streaming: the list-then-watch shape controller-runtime
+    gives the reference (upgrade_requestor.go:115-159 predicates consume
+    watch deltas). Events stream over the real wire path."""
+
+    def test_watch_streams_adds_and_modifies(self, server, client):
+        import threading
+
+        results = []
+        seen_two = threading.Event()
+
+        def consume():
+            for event_type, obj in client.watch("Node", timeout_seconds=10):
+                results.append((event_type, obj.name))
+                if len(results) >= 2:
+                    seen_two.set()
+                    return
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        time.sleep(0.3)  # let the watch establish
+        server.cluster.create(make_node("w-node"))
+        server.cluster.patch(
+            "Node", "w-node", patch={"metadata": {"labels": {"x": "1"}}}
+        )
+        assert seen_two.wait(timeout=10)
+        t.join(timeout=5)
+        assert results[0] == ("ADDED", "w-node")
+        assert results[1] == ("MODIFIED", "w-node")
+
+    def test_watch_filters_by_label_selector(self, server, client):
+        import threading
+
+        results = []
+        got_one = threading.Event()
+
+        def consume():
+            for event_type, obj in client.watch(
+                "Node", label_selector="team=tpu", timeout_seconds=10
+            ):
+                results.append(obj.name)
+                got_one.set()
+                return
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        time.sleep(0.3)
+        server.cluster.create(make_node("other-node", labels={"team": "gpu"}))
+        server.cluster.create(make_node("tpu-node", labels={"team": "tpu"}))
+        assert got_one.wait(timeout=10)
+        t.join(timeout=5)
+        assert results == ["tpu-node"]
+
+    def test_watch_timeout_ends_stream(self, client):
+        start = time.monotonic()
+        events = list(client.watch("Node", timeout_seconds=1))
+        assert events == []
+        assert time.monotonic() - start < 6
+
+    def test_watch_resume_from_resource_version_replays(self, server, client):
+        """list-then-watch with NO lost-event window: events that land
+        between the list and the watch replay from the journal."""
+        created = server.cluster.create(make_node("r-node"))
+        listed_rv = created.resource_version  # "the list's revision"
+        # These happen BEFORE the watch is established — the classic
+        # lost-event window a plain watch cannot close.
+        server.cluster.patch(
+            "Node", "r-node", patch={"metadata": {"labels": {"x": "1"}}}
+        )
+        server.cluster.patch(
+            "Node", "r-node", patch={"metadata": {"labels": {"x": "2"}}}
+        )
+        got = []
+        for event_type, obj in client.watch(
+            "Node", resource_version=listed_rv, timeout_seconds=2
+        ):
+            got.append((event_type, obj.labels.get("x")))
+            if len(got) >= 2:
+                break
+        assert got == [("MODIFIED", "1"), ("MODIFIED", "2")]
+
+    def test_watch_expired_resource_version_is_410(self, server, client):
+        from k8s_operator_libs_tpu.kube import WatchExpiredError
+
+        for i in range(40):  # roll the journal far past rv "1"
+            server.cluster.create(make_node(f"churn-{i}"))
+        server.cluster._history.popleft()  # force rv 1 out of the journal
+        while server.cluster._history and server.cluster._history[0][0] < 10:
+            server.cluster._history.popleft()
+        with pytest.raises(WatchExpiredError):
+            next(iter(client.watch("Node", resource_version="1",
+                                   timeout_seconds=2)))
+
+    def test_leaving_selector_scope_emits_deleted(self, server, client):
+        """Real-apiserver transition semantics: an object whose update
+        stops matching the selector arrives as DELETED so scoped watchers
+        prune it."""
+        import threading
+
+        got = []
+        done = threading.Event()
+
+        def consume():
+            for event_type, obj in client.watch(
+                "Node", label_selector="team=tpu", timeout_seconds=10
+            ):
+                got.append((event_type, obj.name))
+                if len(got) >= 2:
+                    done.set()
+                    return
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        time.sleep(0.3)
+        server.cluster.create(make_node("scope-node", labels={"team": "tpu"}))
+        server.cluster.patch(
+            "Node", "scope-node",
+            patch={"metadata": {"labels": {"team": "gpu"}}},
+        )
+        assert done.wait(timeout=10)
+        t.join(timeout=5)
+        assert got == [("ADDED", "scope-node"), ("DELETED", "scope-node")]
+
+    def test_watch_feeds_condition_changed_predicate(self, server, client):
+        """End-to-end: NodeMaintenance watch deltas drive the requestor's
+        reconcile predicate exactly as the reference's controller watches
+        do — only a condition flip (the operator reporting Ready) passes."""
+        import threading
+
+        from k8s_operator_libs_tpu.kube import NodeMaintenance
+        from k8s_operator_libs_tpu.upgrade import condition_changed_predicate
+
+        nm = NodeMaintenance.new("tpu-operator-node-1", namespace="default")
+        nm.requestor_id = "tpu.operator.dev"
+        nm.node_name = "node-1"
+
+        deltas = []
+        done = threading.Event()
+        previous = {}
+
+        def consume():
+            for event_type, obj in client.watch(
+                "NodeMaintenance", namespace="default", timeout_seconds=10
+            ):
+                old = previous.get(obj.name)
+                previous[obj.name] = obj.raw
+                if event_type == "MODIFIED" and old is not None:
+                    deltas.append(condition_changed_predicate(old, obj.raw))
+                    if len(deltas) >= 2:
+                        done.set()
+                        return
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        time.sleep(0.3)
+        # list-then-watch: the ADDED event seeds the consumer's baseline.
+        server.cluster.create(nm)
+        time.sleep(0.2)
+        # Spec-only change: predicate must say "ignore".
+        server.cluster.patch(
+            "NodeMaintenance", nm.name, "default",
+            patch={"spec": {"additionalRequestors": ["nic.operator.dev"]}},
+        )
+        # Condition flip: predicate must say "reconcile".
+        server.cluster.patch(
+            "NodeMaintenance", nm.name, "default",
+            patch={
+                "status": {
+                    "conditions": [
+                        {"type": "Ready", "status": "True", "reason": "Ready"}
+                    ]
+                }
+            },
+        )
+        assert done.wait(timeout=10)
+        t.join(timeout=5)
+        assert deltas == [False, True]
