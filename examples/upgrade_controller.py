@@ -106,6 +106,13 @@ def main(argv: list[str] | None = None) -> int:
     )
     parser.add_argument("--interval", type=float, default=30.0)
     parser.add_argument(
+        "--watch",
+        action="store_true",
+        help="reconcile on watch events (informers over Nodes, driver "
+        "Pods, and NodeMaintenance CRs) instead of a fixed interval; "
+        "the interval becomes the resync fallback",
+    )
+    parser.add_argument(
         "--once", action="store_true", help="one reconcile pass, then exit"
     )
     parser.add_argument(
@@ -214,6 +221,62 @@ def main(argv: list[str] | None = None) -> int:
                 client, namespace=args.namespace
             )
 
+    # Watch-driven triggering: informers mark the world dirty; the loop
+    # reconciles on deltas (filtered through the requestor predicate for
+    # NodeMaintenance) and falls back to the interval as a resync — the
+    # reference's controller-runtime shape (watches + periodic requeue).
+    dirty = None
+    informers = []
+    if args.watch and not args.demo:
+        import threading
+
+        from k8s_operator_libs_tpu.kube import Informer
+        from k8s_operator_libs_tpu.upgrade import condition_changed_predicate
+
+        dirty = threading.Event()
+
+        def mark_dirty(event_type, obj, old):
+            dirty.set()
+
+        def maintenance_dirty(event_type, obj, old):
+            # React to condition flips/deletions only, as the reference's
+            # predicate-filtered watch does (upgrade_requestor.go:115-159).
+            if event_type != "MODIFIED" or old is None:
+                dirty.set()
+                return
+            if condition_changed_predicate(old.raw, obj.raw):
+                dirty.set()
+
+        informers = [
+            Informer(client, "Node"),
+            Informer(client, "Pod", namespace=args.namespace,
+                     label_selector=selector),
+            # The rollout trigger itself: a driver image bump lands as a
+            # new ControllerRevision / DaemonSet template change — with
+            # only Node/Pod watches, nothing would wake the controller to
+            # START the roll (revision-hash sync, pod_manager.go:84-118).
+            Informer(client, "DaemonSet", namespace=args.namespace,
+                     label_selector=selector),
+            Informer(client, "ControllerRevision", namespace=args.namespace,
+                     label_selector=selector),
+        ]
+        for informer in informers:
+            informer.add_event_handler(mark_dirty)
+        if args.requestor:
+            nm_informer = Informer(client, "NodeMaintenance")
+            nm_informer.add_event_handler(maintenance_dirty)
+            informers.append(nm_informer)
+        # Start all, THEN wait: sequential start+wait would serialize the
+        # sync latency across informers.
+        for informer in informers:
+            informer.start()
+        for informer in informers:
+            if not informer.wait_for_sync(timeout=30):
+                logging.warning(
+                    "%s informer did not sync within 30s; reconciles may "
+                    "miss its triggers until it catches up", informer.kind,
+                )
+
     passes = 0
     max_demo_passes = 100  # a 4-node roll converges in <15; 100 = stuck
     while True:
@@ -249,8 +312,15 @@ def main(argv: list[str] | None = None) -> int:
                 print(f"demo: rolling upgrade complete in {passes} passes")
                 return 0
         if args.once:
+            for informer in informers:
+                informer.stop()
             return 0
-        time.sleep(args.interval if sim is None else 0.0)
+        if dirty is not None:
+            # Event-triggered with the interval as the resync fallback.
+            dirty.wait(timeout=args.interval)
+            dirty.clear()
+        else:
+            time.sleep(args.interval if sim is None else 0.0)
 
 
 if __name__ == "__main__":

@@ -27,6 +27,7 @@ from .events import EventRecorder, FakeRecorder
 from .resources import ResourceInfo, register_resource, resource_for_kind
 from .rest import RestClient, RestConfig, RestConfigError
 from .apiserver import LocalApiServer
+from .informer import Informer
 
 __all__ = [
     "AlreadyExistsError",
@@ -49,6 +50,7 @@ __all__ = [
     "WatchExpiredError",
     "KubeObject",
     "LabelSelector",
+    "Informer",
     "LocalApiServer",
     "merge_patch",
     "Node",

@@ -144,6 +144,11 @@ class _Handler(BaseHTTPRequestHandler):
             {
                 "apiVersion": info.api_version,
                 "kind": f"{info.kind}List",
+                # Collection revision: what a watch resumes from even when
+                # the list is empty (no items to take a revision from).
+                "metadata": {
+                    "resourceVersion": cluster.current_resource_version()
+                },
                 "items": [o.raw for o in items],
             },
         )
@@ -171,7 +176,7 @@ class _Handler(BaseHTTPRequestHandler):
         import queue
         import time
 
-        from .fake import _field_value
+        from .fake import classify_watch_event
         from .selectors import parse_field_selector, parse_selector
 
         selector = parse_selector(query.get("labelSelector") or None)
@@ -185,27 +190,8 @@ class _Handler(BaseHTTPRequestHandler):
         events: queue.Queue = queue.Queue(maxsize=1024)
         overflowed = threading.Event()
 
-        def in_selector_scope(data) -> bool:
-            meta = data.get("metadata") or {}
-            return selector.matches(meta.get("labels") or {}) and not any(
-                _field_value(data, f) != v for f, v in fields.items()
-            )
-
         def scoped_event(event_type: str, data: dict, old):
-            """Classify against the selector by old-vs-new state — the
-            real watch cache's logic: entering scope is ADDED, leaving it
-            is DELETED, staying in is MODIFIED; None = out of scope
-            throughout. Stateless, so replayed and live events classify
-            identically."""
-            new_matches = event_type != "DELETED" and in_selector_scope(data)
-            old_matches = old is not None and in_selector_scope(old)
-            if new_matches and old_matches:
-                return "MODIFIED"
-            if new_matches:
-                return "ADDED"
-            if old_matches:
-                return "DELETED"
-            return None
+            return classify_watch_event(event_type, data, old, selector, fields)
 
         def on_event(event_type: str, data: dict, old) -> None:
             # Cheap static filters only; scope classification happens on

@@ -72,6 +72,23 @@ class Client(abc.ABC):
         field_selector: Optional[str] = None,
     ) -> list[KubeObject]: ...
 
+    def watch(
+        self,
+        kind: str,
+        namespace: str = "",
+        label_selector=None,
+        field_selector: Optional[str] = None,
+        timeout_seconds: Optional[int] = None,
+        resource_version: Optional[str] = None,
+        handle=None,
+    ):
+        """Stream ``(event_type, KubeObject)`` watch events. Implemented by
+        RestClient (HTTP streaming) and FakeCluster (in-process); clients
+        without a watch path must fail fast, not be silently polled."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support watch"
+        )
+
     @abc.abstractmethod
     def create(self, obj: KubeObject) -> KubeObject: ...
 

@@ -166,6 +166,36 @@ def _field_value(data: Mapping[str, Any], dotted: str) -> Any:
     return cur
 
 
+def classify_watch_event(
+    event_type: str,
+    data: Mapping[str, Any],
+    old: Optional[Mapping[str, Any]],
+    selector,
+    fields: Mapping[str, str],
+) -> Optional[str]:
+    """Classify a store event against a selector scope by old-vs-new state —
+    the real watch cache's logic: entering scope is ADDED, leaving it is
+    DELETED, staying in is MODIFIED; None = out of scope throughout.
+    Stateless, so replayed and live events classify identically. Shared by
+    the HTTP apiserver's watch handler and FakeCluster.watch."""
+
+    def in_scope(obj: Mapping[str, Any]) -> bool:
+        meta = obj.get("metadata") or {}
+        return selector.matches(meta.get("labels") or {}) and not any(
+            _field_value(obj, f) != v for f, v in fields.items()
+        )
+
+    new_matches = event_type != _WATCH_DELETED and in_scope(data)
+    old_matches = old is not None and in_scope(old)
+    if new_matches and old_matches:
+        return _WATCH_MODIFIED
+    if new_matches:
+        return _WATCH_ADDED
+    if old_matches:
+        return _WATCH_DELETED
+    return None
+
+
 class FakeCluster(Client):
     """Thread-safe in-memory object store with apiserver semantics."""
 
@@ -244,7 +274,12 @@ class FakeCluster(Client):
         with self._lock:
             replay: list[tuple[str, dict[str, Any], Optional[dict[str, Any]]]] = []
             if resource_version is not None and resource_version != "":
-                since = int(resource_version)
+                try:
+                    since = int(resource_version)
+                except ValueError:
+                    raise InvalidError(
+                        f"invalid resourceVersion {resource_version!r}"
+                    ) from None
                 if self._history and self._history[0][0] > since + 1:
                     raise WatchExpiredError(
                         f"resourceVersion {since} is too old "
@@ -257,6 +292,80 @@ class FakeCluster(Client):
                 ]
             self._watchers.append(fn)
             return replay
+
+    def watch(
+        self,
+        kind: str,
+        namespace: str = "",
+        label_selector: Optional[str | Mapping[str, str]] = None,
+        field_selector: Optional[str] = None,
+        timeout_seconds: Optional[int] = None,
+        resource_version: Optional[str] = None,
+        handle=None,
+    ):
+        """In-process watch generator with the same semantics as
+        ``RestClient.watch`` against the HTTP apiserver: journal resumption
+        from ``resource_version``, selector-scope transitions via
+        old-vs-new classification, ``timeout_seconds`` ending the stream.
+        ``handle`` accepts a ``WatchHandle``-shaped object; its
+        ``cancelled`` flag ends the stream at the next poll tick."""
+        import queue
+
+        if isinstance(label_selector, Mapping):
+            selector = LabelSelector.from_match_labels(label_selector)
+        else:
+            selector = parse_selector(label_selector)
+        fields = parse_field_selector(field_selector)
+        events: queue.Queue = queue.Queue(maxsize=1024)
+
+        def on_event(event_type, data, old):
+            if data.get("kind") != kind:
+                return
+            meta = data.get("metadata") or {}
+            if namespace and meta.get("namespace", "") != namespace:
+                return
+            try:
+                events.put_nowait((event_type, data, old))
+            except queue.Full:
+                pass  # in-process consumer this slow has bigger problems
+
+        replay = self.subscribe_since(on_event, resource_version)
+        try:
+            for event_type, data, old in replay:
+                if data.get("kind") != kind:
+                    continue
+                meta = data.get("metadata") or {}
+                if namespace and meta.get("namespace", "") != namespace:
+                    continue
+                mapped = classify_watch_event(
+                    event_type, data, old, selector, fields
+                )
+                if mapped is not None:
+                    yield mapped, wrap(data)
+            deadline = (
+                time.monotonic() + timeout_seconds
+                if timeout_seconds is not None
+                else None
+            )
+            while not (handle is not None and handle.cancelled):
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return
+                    poll = min(0.2, remaining)
+                else:
+                    poll = 0.2
+                try:
+                    event_type, data, old = events.get(timeout=poll)
+                except queue.Empty:
+                    continue
+                mapped = classify_watch_event(
+                    event_type, data, old, selector, fields
+                )
+                if mapped is not None:
+                    yield mapped, wrap(data)
+        finally:
+            self.unsubscribe(on_event)
 
     def _emit(
         self,
@@ -310,7 +419,13 @@ class FakeCluster(Client):
         return (kind, namespace, name)
 
     def _bump(self, data: dict[str, Any]) -> None:
-        data.setdefault("metadata", {})["resourceVersion"] = str(next(self._rv))
+        self._last_rv = next(self._rv)
+        data.setdefault("metadata", {})["resourceVersion"] = str(self._last_rv)
+
+    def current_resource_version(self) -> str:
+        """The newest revision assigned — a list's collection
+        resourceVersion (what an empty list resumes a watch from)."""
+        return str(getattr(self, "_last_rv", 0))
 
     def _get_raw(self, kind: str, name: str, namespace: str) -> dict[str, Any]:
         key = self._key(kind, namespace, name)
@@ -328,6 +443,11 @@ class FakeCluster(Client):
         meta = data.get("metadata", {})
         if meta.get("deletionTimestamp") and not meta.get("finalizers"):
             del self._store[key]
+            # The real apiserver bumps rv on delete; without it the
+            # DELETED journal entry reuses the object's last revision and
+            # a watch resuming from exactly that revision replays PAST the
+            # deletion — a lost event.
+            self._bump(data)
             self._emit(_WATCH_DELETED, data)
 
     # -- Client API --------------------------------------------------------
@@ -501,6 +621,7 @@ class FakeCluster(Client):
                     self._emit(_WATCH_MODIFIED, data, old=old)
                 return
             del self._store[key]
+            self._bump(data)  # see _finalize_delete_if_due: rv moves on delete
             self._emit(_WATCH_DELETED, data)
 
     def evict(self, pod_name: str, namespace: str = "") -> None:

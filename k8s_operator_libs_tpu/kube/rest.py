@@ -251,6 +251,28 @@ _ERRORS_BY_CODE = {
 }
 
 
+class WatchHandle:
+    """Cancellation handle for a streaming watch.
+
+    A watch consumer blocks in a socket read; no flag check can interrupt
+    that from another thread. ``cancel()`` closes the underlying
+    connection, which unblocks the read and ends the generator cleanly —
+    the informer's stop path."""
+
+    def __init__(self) -> None:
+        self._conn: Optional[http.client.HTTPConnection] = None
+        self.cancelled = False
+
+    def cancel(self) -> None:
+        self.cancelled = True
+        conn = self._conn
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:  # noqa: BLE001 - already dead is fine
+                pass
+
+
 class RestClient(Client):
     """The ``Client`` protocol over HTTP. One instance per cluster."""
 
@@ -405,11 +427,28 @@ class RestClient(Client):
         label_selector: Optional[str | Mapping[str, str]] = None,
         field_selector: Optional[str] = None,
     ) -> list[KubeObject]:
+        items, _ = self.list_with_revision(
+            kind, namespace, label_selector, field_selector
+        )
+        return items
+
+    def list_with_revision(
+        self,
+        kind: str,
+        namespace: str = "",
+        label_selector: Optional[str | Mapping[str, str]] = None,
+        field_selector: Optional[str] = None,
+    ) -> tuple[list[KubeObject], str]:
+        """list() plus the collection resourceVersion — the revision a
+        follow-up watch resumes from (meaningful even for an empty list,
+        where there are no items to take a revision from)."""
         info = resource_for_kind(kind)
         query = self._selector_query(label_selector, field_selector)
         path = self._collection_path(info, namespace)
         out = self._request("GET", path, query=query)
-        return [wrap(item) for item in out.get("items") or []]
+        items = [wrap(item) for item in out.get("items") or []]
+        revision = str((out.get("metadata") or {}).get("resourceVersion", ""))
+        return items, revision
 
     def watch(
         self,
@@ -419,6 +458,7 @@ class RestClient(Client):
         field_selector: Optional[str] = None,
         timeout_seconds: Optional[int] = None,
         resource_version: Optional[str] = None,
+        handle: Optional[WatchHandle] = None,
     ):
         """Stream watch events as ``(event_type, KubeObject)`` pairs.
 
@@ -466,16 +506,38 @@ class RestClient(Client):
             conn = http.client.HTTPConnection(
                 self._host, self._port, timeout=sock_timeout
             )
+        if handle is not None:
+            handle._conn = conn
+            if handle.cancelled:
+                # cancel() ran between handle creation and this point; it
+                # saw no connection to close, so honor the flag here.
+                conn.close()
+                return
         try:
             conn.request("GET", url, headers=headers)
             resp = conn.getresponse()
             if resp.status >= 400:
                 raise self._api_error(resp.status, resp.read())
             while True:
-                line = resp.readline()
+                try:
+                    line = resp.readline()
+                except (OSError, ValueError):
+                    # ValueError: "I/O operation on closed file" — the
+                    # handle cancelled us mid-read.
+                    if handle is not None and handle.cancelled:
+                        return
+                    raise
                 if not line:
                     return  # server ended the stream (timeout / shutdown)
                 event = json.loads(line)
+                if event.get("type") == "ERROR":
+                    # A real apiserver reports mid-stream failure (notably
+                    # 410 Expired) INSIDE the 200 stream as an ERROR frame
+                    # carrying a Status object; surfacing it as data would
+                    # leave consumers looping on a stale resourceVersion.
+                    status = event.get("object") or {}
+                    code = int(status.get("code") or 500)
+                    raise self._api_error(code, json.dumps(status).encode())
                 yield event["type"], wrap(event["object"])
         finally:
             conn.close()
