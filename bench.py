@@ -139,6 +139,33 @@ def make_gate(slice_scoped: bool):
     return gate.validation_hook()
 
 
+def drive_to_convergence(
+    cluster, sim, mgr, policy, per_pass=None, post_pass=None
+) -> int:
+    """Reconcile until every node is upgrade-done and the driver pods are
+    current; returns the pass count. ``per_pass`` runs at the top of each
+    pass (requestor mode ticks its maintenance operator there);
+    ``post_pass`` after the kubelet settles (metric sampling). Raises when
+    MAX_PASSES is exhausted — a wedged roll must fail the bench, not
+    truncate it."""
+    for i in range(MAX_PASSES):
+        if per_pass is not None:
+            per_pass()
+        sim.step()
+        state = mgr.build_state(NS, DS_LABELS)
+        mgr.apply_state(state, policy)
+        sim.step()
+        if post_pass is not None:
+            post_pass()
+        done = all(
+            n.labels.get(KEYS.state_label) == "upgrade-done"
+            for n in cluster.list("Node")
+        )
+        if done and sim.all_pods_ready_and_current():
+            return i + 1
+    raise RuntimeError("rolling upgrade did not converge")
+
+
 def run_roll(slice_aware: bool) -> dict:
     cluster, sim = build_pool()
     mgr = ClusterUpgradeStateManager(
@@ -155,16 +182,13 @@ def run_roll(slice_aware: bool) -> dict:
 
     sim.set_template_hash("libtpu-v2")  # the update lands
     start = time.perf_counter()
-    passes = 0
-    max_unavailable_pods = 0
-    disruption_windows = 0
-    previously_disrupted = False
-    for _ in range(MAX_PASSES):
-        passes += 1
-        sim.step()
-        state = mgr.build_state(NS, DS_LABELS)
-        mgr.apply_state(state, policy)
-        sim.step()
+    metrics = {
+        "max_unavailable_pods": 0,
+        "disruption_windows": 0,
+        "previously_disrupted": False,
+    }
+
+    def sample_metrics():
         # Driver availability: a pod running the OLD revision still serves;
         # only missing/not-Ready driver pods count as unavailable.
         unavailable = 0
@@ -172,27 +196,25 @@ def run_roll(slice_aware: bool) -> dict:
             pod = cluster.get_or_none("Pod", sim.pod_name(node.name), NS)
             if pod is None or not Pod(pod.raw).is_ready():
                 unavailable += 1
-        max_unavailable_pods = max(max_unavailable_pods, unavailable)
+        metrics["max_unavailable_pods"] = max(
+            metrics["max_unavailable_pods"], unavailable
+        )
         disrupted_now = any(
             Node(n.raw).unschedulable for n in cluster.list("Node")
         )
-        if disrupted_now and not previously_disrupted:
-            disruption_windows += 1
-        previously_disrupted = disrupted_now
-        done = all(
-            n.labels.get(KEYS.state_label) == "upgrade-done"
-            for n in cluster.list("Node")
-        )
-        if done and sim.all_pods_ready_and_current():
-            break
-    else:
-        raise RuntimeError("rolling upgrade did not converge")
+        if disrupted_now and not metrics["previously_disrupted"]:
+            metrics["disruption_windows"] += 1
+        metrics["previously_disrupted"] = disrupted_now
+
+    passes = drive_to_convergence(
+        cluster, sim, mgr, policy, post_pass=sample_metrics
+    )
     elapsed = time.perf_counter() - start
     return {
         "wall_s": elapsed,
         "passes": passes,
-        "max_unavailable_pods": max_unavailable_pods,
-        "disruption_windows": disruption_windows,
+        "max_unavailable_pods": metrics["max_unavailable_pods"],
+        "disruption_windows": metrics["disruption_windows"],
     }
 
 
@@ -230,23 +252,10 @@ def run_requestor_roll() -> dict:
 
     sim.set_template_hash("libtpu-v2")
     start = time.perf_counter()
-    passes = 0
-    for _ in range(MAX_PASSES):
-        passes += 1
-        sim.step()
-        operator.step()
-        state = mgr.build_state(NS, DS_LABELS)
-        mgr.apply_state(state, policy)
-        sim.step()
-        done = all(
-            n.labels.get(KEYS.state_label) == "upgrade-done"
-            for n in cluster.list("Node")
-        )
-        if done and sim.all_pods_ready_and_current():
-            operator.step()  # finalize deletion-marked CRs
-            break
-    else:
-        raise RuntimeError("requestor-mode upgrade did not converge")
+    passes = drive_to_convergence(
+        cluster, sim, mgr, policy, per_pass=operator.step
+    )
+    operator.step()  # finalize deletion-marked CRs
     elapsed = time.perf_counter() - start
     crs_left = len(cluster.list("NodeMaintenance", namespace=NS))
     return {
@@ -254,6 +263,37 @@ def run_requestor_roll() -> dict:
         "passes": passes,
         "crs_left": crs_left,
         "converged": crs_left == 0,
+    }
+
+
+def run_state_machine_microbench() -> dict:
+    """BASELINE config #2 analog: state-machine traversal throughput on the
+    fake clientset — control-plane cost with no real cluster and zero JAX.
+    Each pass reconciles the standard 4-node pool (build_state +
+    apply_state), so ``passes_per_s`` is a per-POOL number, not per-node;
+    ``rolls_completed`` counts full 13-state rollouts finished in the one
+    measured second."""
+    cluster, sim = build_pool()
+    mgr = ClusterUpgradeStateManager(
+        cluster, DEVICE, runner=TaskRunner(inline=True)
+    )
+    policy = DriverUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=0,
+        max_unavailable=IntOrString("100%"),
+    )
+    passes = 0
+    rolls = 0
+    start = time.perf_counter()
+    while time.perf_counter() - start < 1.0:
+        sim.set_template_hash(f"libtpu-bench-{rolls}")
+        rolls += 1
+        passes += drive_to_convergence(cluster, sim, mgr, policy)
+    elapsed = time.perf_counter() - start
+    return {
+        "passes_per_s": round(passes / elapsed, 1),
+        "rolls_completed": rolls,
+        "nodes": HOSTS,
     }
 
 
@@ -312,6 +352,7 @@ def main() -> None:
         "ours": ours,
         "reference_equivalent": baseline,
         "requestor_mode": requestor,
+        "state_machine_microbench": run_state_machine_microbench(),
         "devices": [str(d) for d in jax.devices()],
         "calibration": calibration,
         "vs_baseline_note": "self-relative: ours vs this framework in "
