@@ -2,6 +2,7 @@ from .detector import TpuNodeDetector, TpuNodeInfo
 from .planner import SliceAwareInplaceManager, enable_slice_aware_planning
 from .libtpu import LibtpuDaemonSetManager, LibtpuSpec
 from .health import HealthReport, IciHealthGate, SliceScopedGate
+from .monitor import TpuHealthMonitor
 from .validation_pod import ValidationPodManager, ValidationPodSpec
 
 __all__ = [
@@ -11,6 +12,7 @@ __all__ = [
     "LibtpuDaemonSetManager",
     "LibtpuSpec",
     "SliceAwareInplaceManager",
+    "TpuHealthMonitor",
     "TpuNodeDetector",
     "TpuNodeInfo",
     "ValidationPodManager",

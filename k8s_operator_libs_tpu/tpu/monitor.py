@@ -1,0 +1,255 @@
+"""Continuous TPU health monitoring — the node-problem-detector shape.
+
+The upgrade flow probes the fabric only at validation time; a link that
+degrades BETWEEN upgrades goes unnoticed until workloads fail. This
+monitor closes that gap (SURVEY.md §5 "failure detection / recovery",
+extending the reference's validation-time-only model,
+validation_manager.go:71-116): it runs the ICI/MXU battery periodically
+and publishes the verdict where schedulers and operators already look —
+
+* a **Node condition** (``TpuIciHealthy``: True/False with reason and the
+  probe summary as message), debounced by ``failure_threshold``
+  consecutive failures so one flaky probe cannot flap the condition;
+* **Events** on every transition (healthy↔unhealthy);
+* the standard skip-label escape hatch: a node labeled with the upgrade
+  skip label is left unprobed.
+
+Deployment shapes mirror the validation pod: in-process next to the
+controller (single-host pools, tests), or as the payload of a monitoring
+DaemonSet on each TPU node (``python -m k8s_operator_libs_tpu.tpu.monitor``
+with ``NODE_NAME`` injected via the downward API), where the condition it
+writes covers exactly the node it runs on.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..kube.client import Client, retry_on_conflict
+from ..kube.objects import Node, Pod, condition_status, set_condition
+from ..upgrade.consts import TRUE_STRING, DeviceClass, UpgradeKeys
+from ..utils.log import get_logger
+from .health import HealthReport, IciHealthGate
+from .libtpu import TPU_RESOURCE
+
+log = get_logger("tpu.monitor")
+
+#: Node condition type the monitor owns.
+ICI_HEALTHY_CONDITION = "TpuIciHealthy"
+
+REASON_PASSED = "ProbePassed"
+REASON_FAILED = "ProbeFailed"
+
+
+class TpuHealthMonitor:
+    def __init__(
+        self,
+        client: Client,
+        node_name: str,
+        gate: Optional[IciHealthGate] = None,
+        interval_seconds: float = 300.0,
+        failure_threshold: int = 3,
+        success_threshold: int = 2,
+        device: Optional[DeviceClass] = None,
+        recorder=None,
+    ) -> None:
+        self.client = client
+        self.node_name = node_name
+        self.gate = gate or IciHealthGate.tpu_defaults()
+        self.interval_seconds = interval_seconds
+        #: Symmetric debounce: ``failure_threshold`` consecutive failing
+        #: batteries flip the condition False; ``success_threshold``
+        #: consecutive passes flip it back True. Asymmetric clearing would
+        #: let a marginal link that occasionally passes flap the condition
+        #: (and its Events, and the planner's wounded-slice priority) on
+        #: every lucky probe.
+        self.failure_threshold = failure_threshold
+        self.success_threshold = success_threshold
+        self.keys = UpgradeKeys(device or DeviceClass.tpu())
+        self.recorder = recorder
+        self._consecutive_failures = 0
+        self._consecutive_passes = 0
+        #: Last verdict this monitor published (None until the first).
+        self._last_published: Optional[bool] = None
+        self._stop = threading.Event()
+
+    # -- one probe cycle ---------------------------------------------------
+    def check_once(self) -> Optional[HealthReport]:
+        """Run the battery once and publish the verdict. Returns the report
+        (None when the cycle was skipped: skip label, missing node, or
+        TPU chips held by workloads)."""
+        node_obj = self.client.get_or_none("Node", self.node_name)
+        if node_obj is None:
+            log.warning("monitored node %s not found", self.node_name)
+            return None
+        node = Node(node_obj.raw)
+        if node.labels.get(self.keys.skip_label) == TRUE_STRING:
+            log.info("node %s has the skip label; not probing", self.node_name)
+            return None
+        if self._chips_busy():
+            # The battery needs the chips; a probe raced against a running
+            # workload fails on device contention, which is
+            # indistinguishable from a dead link. Skip the cycle — neither
+            # counter moves — rather than mark a busy healthy node
+            # unhealthy.
+            log.info(
+                "node %s: TPU chips in use by workloads; skipping probe",
+                self.node_name,
+            )
+            return None
+
+        report = self.gate.run()
+        if report.ok:
+            self._consecutive_failures = 0
+            self._consecutive_passes += 1
+            if (
+                self._last_published in (None, True)
+                or self._consecutive_passes >= self.success_threshold
+            ):
+                self._publish(healthy=True, report=report)
+        else:
+            self._consecutive_passes = 0
+            self._consecutive_failures += 1
+            log.warning(
+                "node %s failed probe %d/%d: %s",
+                self.node_name,
+                self._consecutive_failures,
+                self.failure_threshold,
+                "; ".join(report.failures),
+            )
+            if self._consecutive_failures >= self.failure_threshold:
+                self._publish(healthy=False, report=report)
+        return report
+
+    def _chips_busy(self) -> bool:
+        """True when any live workload pod on the node requests TPU chips
+        (our own probe shapes excluded by the upgrade drain-skip label)."""
+        pods = self.client.list(
+            "Pod", field_selector=f"spec.nodeName={self.node_name}"
+        )
+        for obj in pods:
+            pod = Pod(obj.raw)
+            if pod.is_finished() or pod.deletion_timestamp is not None:
+                continue
+            for container in pod.spec.get("containers") or []:
+                resources = container.get("resources") or {}
+                requests = resources.get("requests") or {}
+                limits = resources.get("limits") or {}
+                if TPU_RESOURCE in requests or TPU_RESOURCE in limits:
+                    return True
+        return False
+
+    def _publish(self, healthy: bool, report: HealthReport) -> None:
+        """Write the condition (read-modify-write under optimistic lock)
+        and emit an Event on transitions. Steady state writes NOTHING: a
+        per-interval status PUT per node is real apiserver load at fleet
+        scale, and rewriting the condition would stomp lastTransitionTime,
+        breaking every 'unhealthy for X minutes' consumer."""
+        self._last_published = healthy
+        transition = {"changed": False}
+
+        def attempt():
+            obj = self.client.get("Node", self.node_name)
+            node = Node(obj.raw)
+            previous = condition_status(node.status, ICI_HEALTHY_CONDITION)
+            desired = "True" if healthy else "False"
+            transition["changed"] = previous != desired
+            if not transition["changed"]:
+                return node
+            set_condition(
+                node.status,
+                ICI_HEALTHY_CONDITION,
+                desired,
+                reason=REASON_PASSED if healthy else REASON_FAILED,
+                message=report.summary(),
+            )
+            self.client.update_status(node)
+            return node
+
+        node = retry_on_conflict(attempt)
+        if transition["changed"] and self.recorder is not None:
+            self.recorder.eventf(
+                node,
+                "Normal" if healthy else "Warning",
+                self.keys.event_reason(),
+                "ICI health condition %s: %s",
+                "True" if healthy else "False",
+                report.summary(),
+            )
+
+    # -- daemon loop -------------------------------------------------------
+    def run_forever(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.check_once()
+            except Exception:  # noqa: BLE001 - the monitor must outlive blips
+                log.exception("health probe cycle failed")
+            self._stop.wait(self.interval_seconds)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    """DaemonSet payload: ``python -m k8s_operator_libs_tpu.tpu.monitor``."""
+    import argparse
+    import os
+
+    from ..kube.events import EventRecorder
+    from ..kube.rest import RestClient
+    from .health import enable_persistent_compilation_cache
+
+    parser = argparse.ArgumentParser(
+        prog="k8s_operator_libs_tpu.tpu.monitor",
+        description="continuous TPU ICI/MXU health monitor",
+    )
+    parser.add_argument(
+        "--node-name", default=os.environ.get("NODE_NAME", ""),
+        help="node whose condition to manage (default: $NODE_NAME)",
+    )
+    parser.add_argument("--interval-seconds", type=float, default=300.0)
+    parser.add_argument("--failure-threshold", type=int, default=3)
+    parser.add_argument(
+        "--once", action="store_true", help="one probe cycle, then exit"
+    )
+    import logging
+
+    logging.basicConfig(
+        level=logging.INFO, format="%(levelname)s %(name)s %(message)s"
+    )
+    args = parser.parse_args(argv)
+    if not args.node_name:
+        parser.error("--node-name or $NODE_NAME is required")
+    failure_threshold = args.failure_threshold
+    success_threshold = 2
+    if args.once and failure_threshold != 1:
+        # The consecutive-failure counter is process-local: a fresh --once
+        # process can only ever reach 1, so any higher threshold would
+        # make the condition silently un-flippable from a CronJob.
+        log.info(
+            "--once: forcing failure/success thresholds to 1 "
+            "(debounce needs a resident process)"
+        )
+        failure_threshold = 1
+        success_threshold = 1
+
+    enable_persistent_compilation_cache()
+    client = RestClient.from_environment()
+    monitor = TpuHealthMonitor(
+        client,
+        args.node_name,
+        interval_seconds=args.interval_seconds,
+        failure_threshold=failure_threshold,
+        success_threshold=success_threshold,
+        recorder=EventRecorder(client),
+    )
+    if args.once:
+        report = monitor.check_once()
+        return 0 if report is None or report.ok else 1
+    monitor.run_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -63,6 +63,23 @@ class SliceAwareInplaceManager(InplaceNodeStateManager):
     def _node_unavailable(ns: NodeUpgradeState) -> bool:
         return ns.node.unschedulable or not ns.node.is_ready()
 
+    @staticmethod
+    def _node_ici_unhealthy(ns: NodeUpgradeState) -> bool:
+        """The continuous monitor (tpu/monitor.py) reports a dead link.
+
+        A *soft* disruption signal: the slice is prioritized (rolled — and
+        so re-validated, the repair path — before healthy slices) but it
+        still CONSUMES a budget slot. Exempting it like hard-cordoned
+        slices would let a correlated monitor false positive (one
+        miscalibrated floor across the fleet) cordon every flagged slice
+        in a single pass, unbounded by maxUnavailable."""
+        from ..kube.objects import condition_status
+        from .monitor import ICI_HEALTHY_CONDITION
+
+        return (
+            condition_status(ns.node.status, ICI_HEALTHY_CONDITION) == "False"
+        )
+
     def process_upgrade_required_nodes(
         self,
         state: ClusterUpgradeState,
@@ -75,11 +92,14 @@ class SliceAwareInplaceManager(InplaceNodeStateManager):
 
         unavailable_slices = set()
         in_progress_slices = set()
+        wounded_slices = set()
         candidate_nodes: dict[str, list[NodeUpgradeState]] = {}
         for slice_id, members in slices.items():
             for bucket, ns in members:
                 if self._node_unavailable(ns):
                     unavailable_slices.add(slice_id)
+                if self._node_ici_unhealthy(ns):
+                    wounded_slices.add(slice_id)
                 if bucket not in (
                     UpgradeState.UNKNOWN,
                     UpgradeState.DONE,
@@ -121,10 +141,15 @@ class SliceAwareInplaceManager(InplaceNodeStateManager):
             max_unavailable, available,
         )
 
-        # Already-disrupted slices first: their collective is down anyway.
+        # Already-disrupted slices first (their collective is down anyway),
+        # then monitor-flagged wounded slices (repair path), then the rest.
         ordered = sorted(
             candidate_nodes.items(),
-            key=lambda item: (item[0] not in disrupted_slices, item[0]),
+            key=lambda item: (
+                item[0] not in disrupted_slices,
+                item[0] not in wounded_slices,
+                item[0],
+            ),
         )
         for slice_id, members in ordered:
             # Per-node bookkeeping shared with the base planner.

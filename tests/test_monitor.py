@@ -1,0 +1,307 @@
+"""Continuous health monitor: condition publication, debounce, transitions.
+
+The node-problem-detector analog over the ICI gate (tpu/monitor.py) —
+failure detection BETWEEN upgrades, extending the reference's
+validation-time-only probing (validation_manager.go:71-116).
+"""
+
+from k8s_operator_libs_tpu.kube import FakeCluster, Node
+from k8s_operator_libs_tpu.kube.events import FakeRecorder
+from k8s_operator_libs_tpu.kube.objects import condition_status
+from k8s_operator_libs_tpu.tpu.health import HealthReport
+from k8s_operator_libs_tpu.tpu.monitor import (
+    ICI_HEALTHY_CONDITION,
+    TpuHealthMonitor,
+)
+from k8s_operator_libs_tpu.upgrade import DeviceClass, UpgradeKeys
+from builders import make_node
+
+KEYS = UpgradeKeys(DeviceClass.tpu())
+
+
+class StubGate:
+    def __init__(self):
+        self.verdicts = []
+        self.runs = 0
+
+    def run(self):
+        self.runs += 1
+        ok = self.verdicts.pop(0) if self.verdicts else True
+        return HealthReport(ok=ok, failures=[] if ok else ["ring: dead link"])
+
+
+def make_monitor(threshold=3, success_threshold=1, recorder=None):
+    cluster = FakeCluster()
+    cluster.create(make_node("tpu-node"))
+    gate = StubGate()
+    monitor = TpuHealthMonitor(
+        cluster,
+        "tpu-node",
+        gate=gate,
+        failure_threshold=threshold,
+        success_threshold=success_threshold,
+        recorder=recorder,
+    )
+    return cluster, gate, monitor
+
+
+def node_condition(cluster):
+    node = Node(cluster.get("Node", "tpu-node").raw)
+    return condition_status(node.status, ICI_HEALTHY_CONDITION)
+
+
+class TestConditionLifecycle:
+    def test_healthy_probe_sets_condition_true(self):
+        cluster, gate, monitor = make_monitor()
+        report = monitor.check_once()
+        assert report is not None and report.ok
+        assert node_condition(cluster) == "True"
+
+    def test_failures_debounced_until_threshold(self):
+        cluster, gate, monitor = make_monitor(threshold=3)
+        monitor.check_once()  # healthy baseline
+        gate.verdicts = [False, False, False]
+        monitor.check_once()
+        assert node_condition(cluster) == "True"  # 1/3: still healthy
+        monitor.check_once()
+        assert node_condition(cluster) == "True"  # 2/3
+        monitor.check_once()
+        assert node_condition(cluster) == "False"  # 3/3: flips
+
+    def test_single_pass_resets_failure_counter(self):
+        cluster, gate, monitor = make_monitor(threshold=2)
+        gate.verdicts = [False, True, False]
+        monitor.check_once()  # 1 failure
+        monitor.check_once()  # pass: counter resets
+        monitor.check_once()  # 1 failure again — below threshold
+        assert node_condition(cluster) == "True"
+
+    def test_recovery_flips_condition_back(self):
+        cluster, gate, monitor = make_monitor(threshold=1)
+        gate.verdicts = [False]
+        monitor.check_once()
+        assert node_condition(cluster) == "False"
+        monitor.check_once()  # healthy again
+        assert node_condition(cluster) == "True"
+
+    def test_events_only_on_transitions(self):
+        recorder = FakeRecorder()
+        cluster, gate, monitor = make_monitor(threshold=1, recorder=recorder)
+        monitor.check_once()  # none -> True: transition
+        monitor.check_once()  # True -> True: no event
+        gate.verdicts = [False]
+        monitor.check_once()  # True -> False: transition
+        messages = recorder.drain()
+        assert len(messages) == 2
+        assert "True" in messages[0]
+        assert messages[1].startswith("Warning")
+
+    def test_skip_label_opts_node_out(self):
+        cluster, gate, monitor = make_monitor()
+        cluster.patch(
+            "Node", "tpu-node",
+            patch={"metadata": {"labels": {KEYS.skip_label: "true"}}},
+        )
+        assert monitor.check_once() is None
+        assert gate.runs == 0
+        assert node_condition(cluster) is None
+
+    def test_missing_node_is_tolerated(self):
+        cluster, gate, monitor = make_monitor()
+        cluster.delete("Node", "tpu-node")
+        assert monitor.check_once() is None
+        assert gate.runs == 0
+
+    def test_recovery_is_debounced_symmetrically(self):
+        """One lucky pass must not clear an unhealthy condition: a
+        marginal link that occasionally passes would otherwise flap the
+        condition (and the planner's wounded-slice priority)."""
+        cluster, gate, monitor = make_monitor(threshold=1, success_threshold=2)
+        gate.verdicts = [False, True, False, True, True]
+        monitor.check_once()
+        assert node_condition(cluster) == "False"
+        monitor.check_once()  # single pass: 1/2 — stays False
+        assert node_condition(cluster) == "False"
+        monitor.check_once()  # fail resets the pass counter
+        monitor.check_once()  # pass 1/2
+        assert node_condition(cluster) == "False"
+        monitor.check_once()  # pass 2/2: recovers
+        assert node_condition(cluster) == "True"
+
+    def test_busy_chips_skip_probe_cycle(self):
+        """A probe racing a TPU workload fails on device contention —
+        indistinguishable from a dead link — so busy nodes are skipped
+        and neither debounce counter moves."""
+        from k8s_operator_libs_tpu.kube import Pod
+
+        cluster, gate, monitor = make_monitor(threshold=1)
+        workload = Pod.new("train-0", namespace="default")
+        workload.node_name = "tpu-node"
+        workload.phase = "Running"
+        workload.spec["containers"] = [
+            {"name": "train",
+             "resources": {"requests": {"google.com/tpu": "4"}}}
+        ]
+        cluster.create(workload)
+        assert monitor.check_once() is None
+        assert gate.runs == 0
+        assert node_condition(cluster) is None
+        # Workload finishes -> probing resumes.
+        cluster.patch("Pod", "train-0", "default",
+                      patch={"status": {"phase": "Succeeded"}})
+        assert monitor.check_once() is not None
+        assert gate.runs == 1
+
+    def test_steady_state_writes_nothing(self):
+        """Unchanged verdicts must not touch the Node: per-interval
+        status PUTs are fleet-scale apiserver load and would stomp
+        lastTransitionTime."""
+        cluster, gate, monitor = make_monitor()
+        monitor.check_once()
+        rv = cluster.get("Node", "tpu-node").resource_version
+        node = Node(cluster.get("Node", "tpu-node").raw)
+        t0 = next(
+            c for c in node.status["conditions"]
+            if c["type"] == ICI_HEALTHY_CONDITION
+        )["lastTransitionTime"]
+        for _ in range(3):
+            monitor.check_once()
+        assert cluster.get("Node", "tpu-node").resource_version == rv
+        node = Node(cluster.get("Node", "tpu-node").raw)
+        t1 = next(
+            c for c in node.status["conditions"]
+            if c["type"] == ICI_HEALTHY_CONDITION
+        )["lastTransitionTime"]
+        assert t1 == t0
+
+
+class TestPlannerIntegration:
+    def test_unhealthy_condition_marks_slice_disrupted(self):
+        """A slice whose monitor reports TpuIciHealthy=False is drained
+        first: its collective is already down, so upgrading it consumes no
+        budget and routes it through validation — the repair path."""
+        from k8s_operator_libs_tpu.api import DriverUpgradePolicySpec
+        from k8s_operator_libs_tpu.kube.objects import set_condition
+        from k8s_operator_libs_tpu.kube.sim import DaemonSetSimulator
+        from k8s_operator_libs_tpu.parallel.topology import (
+            GKE_NODEPOOL_LABEL,
+            GKE_TPU_ACCELERATOR_LABEL,
+            GKE_TPU_TOPOLOGY_LABEL,
+        )
+        from k8s_operator_libs_tpu.tpu import enable_slice_aware_planning
+        from k8s_operator_libs_tpu.upgrade import (
+            ClusterUpgradeStateManager,
+            TaskRunner,
+        )
+        from k8s_operator_libs_tpu.utils import IntOrString
+
+        cluster = FakeCluster()
+        for pool in ("pool-a", "pool-b"):
+            for i in range(2):
+                node = make_node(
+                    f"{pool}-{i}",
+                    labels={
+                        GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+                        GKE_TPU_TOPOLOGY_LABEL: "2x2",
+                        GKE_NODEPOOL_LABEL: pool,
+                    },
+                )
+                cluster.create(node)
+        sim = DaemonSetSimulator(
+            cluster, name="driver", namespace="driver-ns",
+            match_labels={"app": "driver"},
+        )
+        sim.settle()
+        # pool-b's fabric is reported dead by the monitor.
+        node = Node(cluster.get("Node", "pool-b-0").raw)
+        set_condition(
+            node.status, ICI_HEALTHY_CONDITION, "False", reason="ProbeFailed"
+        )
+        cluster.update_status(node)
+
+        mgr = ClusterUpgradeStateManager(
+            cluster, DeviceClass.tpu(), runner=TaskRunner(inline=True)
+        )
+        enable_slice_aware_planning(mgr)
+        sim.set_template_hash("rev-2")
+        policy = DriverUpgradePolicySpec(
+            auto_upgrade=True, max_parallel_upgrades=1,
+            max_unavailable=IntOrString(1),
+        )
+        mgr.apply_state(mgr.build_state("driver-ns", {"app": "driver"}), policy)
+        mgr.apply_state(mgr.build_state("driver-ns", {"app": "driver"}), policy)
+        states = {
+            n.name: n.labels.get(KEYS.state_label, "")
+            for n in cluster.list("Node")
+        }
+        # The wounded slice proceeds; the healthy slice waits.
+        assert states["pool-b-0"] == "cordon-required"
+        assert states["pool-b-1"] == "cordon-required"
+        assert states["pool-a-0"] == "upgrade-required"
+        assert states["pool-a-1"] == "upgrade-required"
+
+    def test_wounded_slices_consume_budget(self):
+        """Monitor-flagged slices are prioritized but still budgeted: a
+        correlated false positive must not cordon every flagged slice in
+        one pass."""
+        from k8s_operator_libs_tpu.api import DriverUpgradePolicySpec
+        from k8s_operator_libs_tpu.kube.objects import set_condition
+        from k8s_operator_libs_tpu.kube.sim import DaemonSetSimulator
+        from k8s_operator_libs_tpu.parallel.topology import (
+            GKE_NODEPOOL_LABEL,
+            GKE_TPU_ACCELERATOR_LABEL,
+            GKE_TPU_TOPOLOGY_LABEL,
+        )
+        from k8s_operator_libs_tpu.tpu import enable_slice_aware_planning
+        from k8s_operator_libs_tpu.upgrade import (
+            ClusterUpgradeStateManager,
+            TaskRunner,
+        )
+        from k8s_operator_libs_tpu.utils import IntOrString
+
+        cluster = FakeCluster()
+        for pool in ("pool-a", "pool-b", "pool-c"):
+            for i in range(2):
+                cluster.create(make_node(
+                    f"{pool}-{i}",
+                    labels={
+                        GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+                        GKE_TPU_TOPOLOGY_LABEL: "2x2",
+                        GKE_NODEPOOL_LABEL: pool,
+                    },
+                ))
+        sim = DaemonSetSimulator(
+            cluster, name="driver", namespace="driver-ns",
+            match_labels={"app": "driver"},
+        )
+        sim.settle()
+        # The monitor flags TWO slices simultaneously (correlated signal).
+        for name in ("pool-b-0", "pool-c-0"):
+            node = Node(cluster.get("Node", name).raw)
+            set_condition(node.status, ICI_HEALTHY_CONDITION, "False",
+                          reason="ProbeFailed")
+            cluster.update_status(node)
+
+        mgr = ClusterUpgradeStateManager(
+            cluster, DeviceClass.tpu(), runner=TaskRunner(inline=True)
+        )
+        enable_slice_aware_planning(mgr)
+        sim.set_template_hash("rev-2")
+        policy = DriverUpgradePolicySpec(
+            auto_upgrade=True, max_parallel_upgrades=0,
+            max_unavailable=IntOrString(1),
+        )
+        mgr.apply_state(mgr.build_state("driver-ns", {"app": "driver"}), policy)
+        mgr.apply_state(mgr.build_state("driver-ns", {"app": "driver"}), policy)
+        states = {
+            n.name: n.labels.get(KEYS.state_label, "")
+            for n in cluster.list("Node")
+        }
+        started_pools = {
+            name.rsplit("-", 1)[0]
+            for name, s in states.items() if s == "cordon-required"
+        }
+        # Exactly ONE wounded slice started (budget=1); the other wounded
+        # slice waits its turn; the healthy slice is last in line.
+        assert len(started_pools) == 1
+        assert started_pools < {"pool-b", "pool-c"}
