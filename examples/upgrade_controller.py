@@ -142,6 +142,17 @@ def main(argv: list[str] | None = None) -> int:
         help="delegate cordon/drain to a maintenance operator over "
         "NodeMaintenance CRs (simulated in --demo)",
     )
+    parser.add_argument(
+        "--metrics-port",
+        type=int,
+        default=0,
+        help="serve Prometheus metrics on this port (0 = disabled)",
+    )
+    parser.add_argument(
+        "--metrics-host",
+        default="0.0.0.0",
+        help="metrics bind address (default 0.0.0.0: in-cluster scrape)",
+    )
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO, format="%(levelname)s %(message)s")
 
@@ -277,6 +288,17 @@ def main(argv: list[str] | None = None) -> int:
                     "miss its triggers until it catches up", informer.kind,
                 )
 
+    metrics = None
+    metrics_server = None
+    if args.metrics_port:
+        from k8s_operator_libs_tpu.upgrade import MetricsServer, UpgradeMetrics
+
+        metrics = UpgradeMetrics(mgr)
+        metrics_server = MetricsServer(
+            metrics, port=args.metrics_port, host=args.metrics_host
+        ).start()
+        print(f"metrics: {metrics_server.url}")
+
     passes = 0
     max_demo_passes = 100  # a 4-node roll converges in <15; 100 = stuck
     while True:
@@ -295,6 +317,8 @@ def main(argv: list[str] | None = None) -> int:
             validation_pod_sim.step()
         state = mgr.build_state(args.namespace, selector)
         mgr.apply_state(state, policy)
+        if metrics is not None:
+            metrics.observe(state)
         if sim is not None:
             sim.step()
         print(

@@ -10,13 +10,12 @@ upgrade_suit_test.go:69, 203-206).
 from __future__ import annotations
 
 import threading
-import time
 import uuid
 from collections import deque
 from typing import Deque
 
 from .client import Client
-from .objects import Event, KubeObject
+from .objects import Event, KubeObject, rfc3339_now
 
 EVENT_TYPE_NORMAL = "Normal"
 EVENT_TYPE_WARNING = "Warning"
@@ -50,7 +49,7 @@ class EventRecorder:
                     "namespace": obj.namespace,
                     "uid": obj.uid,
                 },
-                "firstTimestamp": time.time(),
+                "firstTimestamp": rfc3339_now(),
             }
         )
         self._client.create(ev)

@@ -153,6 +153,12 @@ def condition_status(obj_status: Mapping[str, Any], cond_type: str) -> Optional[
     return None
 
 
+def rfc3339_now() -> str:
+    """Current UTC time in the RFC3339 form metav1.Time requires — a real
+    apiserver rejects float-epoch timestamps in condition/event fields."""
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
 def set_condition(
     obj_status: MutableMapping[str, Any],
     cond_type: str,
@@ -160,17 +166,20 @@ def set_condition(
     reason: str = "",
     message: str = "",
 ) -> None:
+    """Upsert a condition; lastTransitionTime moves only when the status
+    actually changes (kube semantics — reason/message refreshes must not
+    reset a condition's age)."""
     conds = _ensure_list(obj_status, "conditions")
     for cond in conds:
         if cond.get("type") == cond_type:
-            cond.update(
-                {"status": status, "reason": reason, "message": message,
-                 "lastTransitionTime": time.time()}
-            )
+            update = {"status": status, "reason": reason, "message": message}
+            if cond.get("status") != status:
+                update["lastTransitionTime"] = rfc3339_now()
+            cond.update(update)
             return
     conds.append(
-        {"type": cond_type, "status": status, "reason": reason, "message": message,
-         "lastTransitionTime": time.time()}
+        {"type": cond_type, "status": status, "reason": reason,
+         "message": message, "lastTransitionTime": rfc3339_now()}
     )
 
 

@@ -87,6 +87,15 @@ class TpuHealthMonitor:
         if node.labels.get(self.keys.skip_label) == TRUE_STRING:
             log.info("node %s has the skip label; not probing", self.node_name)
             return None
+        if self._last_published is None:
+            # Seed the debounce baseline from the published condition: a
+            # restarted monitor (pod eviction, node reboot — exactly when
+            # links are suspect) must not let one lucky pass clear an
+            # unhealthy condition that took failure_threshold probes to
+            # earn.
+            existing = condition_status(node.status, ICI_HEALTHY_CONDITION)
+            if existing is not None:
+                self._last_published = existing == "True"
         if self._chips_busy():
             # The battery needs the chips; a probe raced against a running
             # workload fails on device contention, which is
@@ -123,14 +132,18 @@ class TpuHealthMonitor:
         return report
 
     def _chips_busy(self) -> bool:
-        """True when any live workload pod on the node requests TPU chips
-        (our own probe shapes excluded by the upgrade drain-skip label)."""
+        """True when any live workload pod on the node requests TPU chips.
+        Pods carrying the drain-skip label are excluded — the escape hatch
+        for auxiliary probe/diagnostic pods that hold chips briefly but
+        must not starve the monitor."""
         pods = self.client.list(
             "Pod", field_selector=f"spec.nodeName={self.node_name}"
         )
         for obj in pods:
             pod = Pod(obj.raw)
             if pod.is_finished() or pod.deletion_timestamp is not None:
+                continue
+            if pod.labels.get(self.keys.skip_drain_pod_label) == TRUE_STRING:
                 continue
             for container in pod.spec.get("containers") or []:
                 resources = container.get("resources") or {}

@@ -1,5 +1,6 @@
 from .consts import UpgradeState, DeviceClass, UpgradeKeys
 from .state_provider import NodeUpgradeStateProvider, StateWriteError
+from .metrics import MetricsServer, UpgradeMetrics
 from .task_runner import TaskRunner
 from .cordon_manager import CordonManager
 from .drain_manager import DrainConfiguration, DrainManager
@@ -57,7 +58,9 @@ __all__ = [
     "PodManagerConfig",
     "SafeDriverLoadManager",
     "StateWriteError",
+    "MetricsServer",
     "TaskRunner",
+    "UpgradeMetrics",
     "UpgradeKeys",
     "UpgradeState",
     "VALIDATION_TIMEOUT_SECONDS",

@@ -1,0 +1,137 @@
+"""Prometheus-format metrics for the upgrade state machine.
+
+The reference exposes its counters through controller-runtime's metrics
+server — the library side is the counter interface
+(common_manager.go:23-41: total managed, in progress, done, failed,
+pending) and consumers export it. This module is both halves on the
+stdlib: an exporter that renders a ``ClusterUpgradeState`` snapshot as
+Prometheus text exposition format, and a tiny HTTP endpoint serving it
+(``/metrics``), so an operator embedding the library gets scrapeable
+metrics with no dependency.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..utils.log import get_logger
+
+log = get_logger("upgrade.metrics")
+
+_PREFIX = "tpu_operator_upgrade"
+
+#: (metric suffix, help text, manager accessor name)
+_GAUGES = [
+    ("managed_nodes", "Nodes currently managed by the upgrade flow",
+     "get_total_managed_nodes"),
+    ("in_progress", "Nodes with an upgrade in progress",
+     "get_upgrades_in_progress"),
+    ("done", "Nodes that completed the upgrade",
+     "get_upgrades_done"),
+    ("failed", "Nodes in upgrade-failed",
+     "get_upgrades_failed"),
+    ("pending", "Nodes waiting in upgrade-required",
+     "get_upgrades_pending"),
+]
+
+
+class UpgradeMetrics:
+    """Snapshot-driven gauges + a monotonic reconcile counter.
+
+    Call :meth:`observe` with each ``build_state`` snapshot (the example
+    controller does this every pass); :meth:`render` produces the
+    Prometheus text format.
+    """
+
+    def __init__(self, manager, device_label: Optional[str] = None) -> None:
+        self._manager = manager
+        self._device = device_label or manager.keys.device.name
+        self._lock = threading.Lock()
+        self._values: dict[str, int] = {}
+        self._reconcile_passes = 0
+
+    def observe(self, state) -> None:
+        with self._lock:
+            self._reconcile_passes += 1
+            for suffix, _, accessor in _GAUGES:
+                self._values[suffix] = getattr(self._manager, accessor)(state)
+
+    def render(self) -> str:
+        lines: list[str] = []
+        label = f'{{device="{self._device}"}}'
+        with self._lock:
+            for suffix, help_text, _ in _GAUGES:
+                name = f"{_PREFIX}_{suffix}"
+                lines.append(f"# HELP {name} {help_text}")
+                lines.append(f"# TYPE {name} gauge")
+                lines.append(f"{name}{label} {self._values.get(suffix, 0)}")
+            name = f"{_PREFIX}_reconcile_passes_total"
+            lines.append(f"# HELP {name} Reconcile passes observed")
+            lines.append(f"# TYPE {name} counter")
+            lines.append(f"{name}{label} {self._reconcile_passes}")
+        return "\n".join(lines) + "\n"
+
+
+class MetricsServer(ThreadingHTTPServer):
+    """``GET /metrics`` over stdlib HTTP; use as a context manager.
+
+    ``host`` defaults to loopback for local runs; in-cluster deployments
+    must bind ``0.0.0.0`` (or the pod IP) or Prometheus cannot scrape."""
+
+    daemon_threads = True
+
+    def __init__(
+        self,
+        metrics: UpgradeMetrics,
+        port: int = 0,
+        host: str = "127.0.0.1",
+    ) -> None:
+        self.metrics = metrics
+
+        class Handler(BaseHTTPRequestHandler):
+            server: "MetricsServer"
+
+            def do_GET(self) -> None:  # noqa: N802 - http.server API
+                if self.path.rstrip("/") not in ("", "/metrics"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = self.server.metrics.render().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):  # noqa: D102
+                pass
+
+        super().__init__((host, port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        host, port = self.server_address[0], self.server_address[1]
+        return f"http://{host}:{port}/metrics"
+
+    def start(self) -> "MetricsServer":
+        self._thread = threading.Thread(target=self.serve_forever, daemon=True)
+        self._thread.start()
+        log.info("metrics served at %s", self.url)
+        return self
+
+    def stop(self) -> None:
+        self.shutdown()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self.server_close()
+
+    def __enter__(self) -> "MetricsServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -1,0 +1,152 @@
+"""Metrics exporter: Prometheus text rendering and the HTTP endpoint.
+
+The library half of the reference's metrics story is the counter interface
+(common_manager.go:23-41); this suite proves the export half — gauges track
+a live roll and the endpoint serves scrapeable text over real HTTP.
+"""
+
+import os
+import urllib.error
+import urllib.request
+
+import yaml
+
+from k8s_operator_libs_tpu.api import DriverUpgradePolicySpec
+from k8s_operator_libs_tpu.kube import FakeCluster
+from k8s_operator_libs_tpu.kube.sim import DaemonSetSimulator
+from k8s_operator_libs_tpu.upgrade import (
+    ClusterUpgradeStateManager,
+    DeviceClass,
+    MetricsServer,
+    TaskRunner,
+    UpgradeKeys,
+    UpgradeMetrics,
+)
+from k8s_operator_libs_tpu.utils import IntOrString
+from builders import make_node
+
+KEYS = UpgradeKeys(DeviceClass.tpu())
+NS = "driver-ns"
+LABELS = {"app": "driver"}
+
+
+def make_harness(nodes=3):
+    cluster = FakeCluster()
+    for i in range(nodes):
+        cluster.create(make_node(f"node-{i}"))
+    sim = DaemonSetSimulator(
+        cluster, name="driver", namespace=NS, match_labels=LABELS
+    )
+    sim.settle()
+    mgr = ClusterUpgradeStateManager(
+        cluster, DeviceClass.tpu(), runner=TaskRunner(inline=True)
+    )
+    return cluster, sim, mgr
+
+
+POLICY = DriverUpgradePolicySpec(
+    auto_upgrade=True,
+    max_parallel_upgrades=0,
+    max_unavailable=IntOrString("100%"),
+)
+
+
+class TestRender:
+    def test_gauges_track_a_roll(self):
+        cluster, sim, mgr = make_harness()
+        metrics = UpgradeMetrics(mgr)
+        sim.set_template_hash("v2")
+        for _ in range(40):
+            sim.step()
+            state = mgr.build_state(NS, LABELS)
+            mgr.apply_state(state, POLICY)
+            metrics.observe(state)
+            sim.step()
+            if all(
+                n.labels.get(KEYS.state_label) == "upgrade-done"
+                for n in cluster.list("Node")
+            ):
+                break
+        # One final observation of the converged state.
+        state = mgr.build_state(NS, LABELS)
+        metrics.observe(state)
+        text = metrics.render()
+        assert 'tpu_operator_upgrade_done{device="tpu"} 3' in text
+        assert 'tpu_operator_upgrade_in_progress{device="tpu"} 0' in text
+        assert 'tpu_operator_upgrade_failed{device="tpu"} 0' in text
+        assert "tpu_operator_upgrade_reconcile_passes_total" in text
+
+    def test_render_is_valid_exposition_format(self):
+        _, _, mgr = make_harness(nodes=1)
+        metrics = UpgradeMetrics(mgr)
+        text = metrics.render()
+        for line in text.strip().splitlines():
+            assert line.startswith("#") or " " in line
+        # Every metric has HELP and TYPE.
+        names = [
+            line.split()[2]
+            for line in text.splitlines()
+            if line.startswith("# TYPE")
+        ]
+        assert len(names) == 6
+        assert len(set(names)) == 6
+
+
+class TestEndpoint:
+    def test_metrics_served_over_http(self):
+        _, sim, mgr = make_harness(nodes=2)
+        metrics = UpgradeMetrics(mgr)
+        state = mgr.build_state(NS, LABELS)
+        metrics.observe(state)
+        with MetricsServer(metrics) as server:
+            body = urllib.request.urlopen(server.url, timeout=5).read().decode()
+            assert 'tpu_operator_upgrade_managed_nodes{device="tpu"} 2' in body
+            # Unknown paths 404.
+            try:
+                urllib.request.urlopen(
+                    server.url.replace("/metrics", "/nope"), timeout=5
+                )
+                raise AssertionError("expected 404")
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+
+
+class TestMonitorManifest:
+    def test_monitor_daemonset_manifest_shape(self):
+        path = os.path.join(
+            os.path.dirname(__file__), "..", "manifests",
+            "monitor-daemonset.yaml",
+        )
+        docs = list(yaml.safe_load_all(open(path)))
+        kinds = [d["kind"] for d in docs]
+        assert kinds == [
+            "DaemonSet", "ServiceAccount", "ClusterRole", "ClusterRoleBinding"
+        ]
+        ds = docs[0]
+        pod_spec = ds["spec"]["template"]["spec"]
+        container = pod_spec["containers"][0]
+        # NODE_NAME via downward API — the monitor's identity.
+        env = {e["name"]: e for e in container["env"]}
+        assert (
+            env["NODE_NAME"]["valueFrom"]["fieldRef"]["fieldPath"]
+            == "spec.nodeName"
+        )
+        # Deliberately does NOT request TPU chips (it skips busy nodes).
+        resources = container.get("resources", {})
+        assert "google.com/tpu" not in (resources.get("requests") or {})
+        # Tolerates the TPU taint, targets only TPU nodes.
+        assert any(
+            t.get("key") == "google.com/tpu" for t in pod_spec["tolerations"]
+        )
+        # RBAC covers exactly what the monitor does.
+        rules = docs[2]["rules"]
+        verbs = {
+            (g, r): rule["verbs"]
+            for rule in rules
+            for g in rule["apiGroups"]
+            for r in rule["resources"]
+        }
+        assert "get" in verbs[("", "nodes")]
+        assert "update" in verbs[("", "nodes/status")]
+        assert "list" in verbs[("", "pods")]
+        assert "create" in verbs[("", "events")]

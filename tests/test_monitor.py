@@ -128,6 +128,42 @@ class TestConditionLifecycle:
         monitor.check_once()  # pass 2/2: recovers
         assert node_condition(cluster) == "True"
 
+    def test_restarted_monitor_inherits_published_condition(self):
+        """A fresh monitor process (pod eviction, node reboot) seeds its
+        debounce baseline from the node's existing condition — one lucky
+        pass after a restart must not clear an unhealthy verdict."""
+        cluster, gate, monitor = make_monitor(threshold=1, success_threshold=2)
+        gate.verdicts = [False]
+        monitor.check_once()
+        assert node_condition(cluster) == "False"
+        # "Restart": a brand-new monitor against the same node.
+        fresh = TpuHealthMonitor(
+            cluster, "tpu-node", gate=gate,
+            failure_threshold=1, success_threshold=2,
+        )
+        fresh.check_once()  # one lucky pass: 1/2 — must NOT clear
+        assert node_condition(cluster) == "False"
+        fresh.check_once()  # 2/2: genuine recovery
+        assert node_condition(cluster) == "True"
+
+    def test_drain_skip_labeled_pod_does_not_block_probing(self):
+        """Auxiliary diagnostic pods holding chips can opt out of the
+        busy-chip check with the drain-skip label."""
+        from k8s_operator_libs_tpu.kube import Pod
+
+        cluster, gate, monitor = make_monitor()
+        aux = Pod.new("diag-0", namespace="default")
+        aux.node_name = "tpu-node"
+        aux.phase = "Running"
+        aux.labels[KEYS.skip_drain_pod_label] = "true"
+        aux.spec["containers"] = [
+            {"name": "diag",
+             "resources": {"requests": {"google.com/tpu": "4"}}}
+        ]
+        cluster.create(aux)
+        assert monitor.check_once() is not None
+        assert gate.runs == 1
+
     def test_busy_chips_skip_probe_cycle(self):
         """A probe racing a TPU workload fails on device contention —
         indistinguishable from a dead link — so busy nodes are skipped
@@ -177,9 +213,9 @@ class TestConditionLifecycle:
 
 class TestPlannerIntegration:
     def test_unhealthy_condition_marks_slice_disrupted(self):
-        """A slice whose monitor reports TpuIciHealthy=False is drained
-        first: its collective is already down, so upgrading it consumes no
-        budget and routes it through validation — the repair path."""
+        """A slice whose monitor reports TpuIciHealthy=False is rolled
+        first — within the budget (see test_wounded_slices_consume_budget)
+        — routing it through validation, the repair path."""
         from k8s_operator_libs_tpu.api import DriverUpgradePolicySpec
         from k8s_operator_libs_tpu.kube.objects import set_condition
         from k8s_operator_libs_tpu.kube.sim import DaemonSetSimulator

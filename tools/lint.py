@@ -113,6 +113,15 @@ class _ImportTracker(ast.NodeVisitor):
 
     def visit_Import(self, node: ast.Import) -> None:
         for alias in node.names:
+            if alias.asname is None and "." in alias.name:
+                # `import a.b` then `import a.c` both bind `a` but AUGMENT
+                # the same package — never a redefinition; and the binding
+                # counts as used if `a` is.
+                self.imports.setdefault(alias.name.split(".")[0], node)
+                self.imports_unconditional.setdefault(
+                    alias.name.split(".")[0], False
+                )
+                continue
             bound = alias.asname or alias.name.split(".")[0]
             self._bind(bound, node)
 
