@@ -22,6 +22,7 @@ directly into ``ClusterUpgradeStateManager.with_validation_enabled``.
 
 from __future__ import annotations
 
+import dataclasses
 import time
 from dataclasses import dataclass, field
 from typing import Optional
@@ -92,6 +93,33 @@ class HealthReport:
     flash: Optional[FlashAttentionReport] = None
     elapsed_s: float = 0.0
     failures: list[str] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "HealthReport":
+        """Rebuild a report from ``dataclasses.asdict`` output — the JSON
+        line the probe-pod payload prints (see :func:`main`). Unknown keys
+        are dropped so a newer payload's report still parses."""
+
+        def build(dc_cls, value):
+            if not isinstance(value, dict):
+                return value
+            names = {f.name for f in dataclasses.fields(dc_cls)}
+            return dc_cls(**{k: v for k, v in value.items() if k in names})
+
+        names = {f.name for f in dataclasses.fields(cls)}
+        kwargs = {k: v for k, v in data.items() if k in names}
+        kwargs["collectives"] = [
+            build(CollectiveReport, c) for c in kwargs.get("collectives") or []
+        ]
+        for key, dc_cls in (
+            ("mxu", MxuReport),
+            ("ring_attention", RingAttentionReport),
+            ("ulysses", UlyssesReport),
+            ("flash", FlashAttentionReport),
+        ):
+            if kwargs.get(key) is not None:
+                kwargs[key] = build(dc_cls, kwargs[key])
+        return cls(**kwargs)
 
     def summary(self) -> str:
         parts = [f"ok={self.ok}", f"elapsed={self.elapsed_s:.2f}s"]
@@ -296,6 +324,78 @@ class IciHealthGate:
             return report.ok
 
         return hook
+
+
+class SubprocessHealthGate:
+    """Run the gate battery in a short-lived child process per cycle.
+
+    A *resident* process that probes in-process keeps libtpu's exclusive
+    device lock from its first probe onward, so an idle monitor would block
+    every workload pod from initializing the TPU between cycles (contention
+    in the opposite direction from the ``_chips_busy`` check in
+    ``tpu/monitor.py``). Probing in a child bounds the lock to the probe
+    itself: the child exits, libtpu is released, workloads admitted between
+    cycles start normally. The child is the same CLI the validation pod
+    runs (:func:`main`), so one payload serves both shapes; its JSON report
+    line is parsed back into a :class:`HealthReport`.
+
+    Also applies the validation-timeout discipline of the reference's gate
+    (validation_manager.go:31-33): a wedged backend init surfaces as a
+    failed report after ``timeout_seconds``, never a hung monitor.
+    """
+
+    def __init__(
+        self,
+        cli_args: Optional[list[str]] = None,
+        timeout_seconds: float = 600.0,
+        env: Optional[dict] = None,
+    ) -> None:
+        self.cli_args = list(cli_args) if cli_args is not None else []
+        self.timeout_seconds = timeout_seconds
+        self.env = env
+
+    def run(self) -> HealthReport:
+        import json
+        import subprocess
+        import sys
+
+        cmd = [
+            sys.executable, "-m", "k8s_operator_libs_tpu.tpu.health",
+            *self.cli_args,
+        ]
+        start = time.perf_counter()
+        try:
+            proc = subprocess.run(
+                cmd,
+                capture_output=True,
+                text=True,
+                timeout=self.timeout_seconds,
+                env=self.env,
+            )
+        except subprocess.TimeoutExpired:
+            return HealthReport(
+                ok=False,
+                elapsed_s=time.perf_counter() - start,
+                failures=[
+                    f"probe subprocess exceeded {self.timeout_seconds:.0f}s"
+                ],
+            )
+        # The payload prints its report as the last JSON line even when the
+        # battery fails (rc=1) — prefer that structured verdict; fall back
+        # to stderr only when the child crashed before reporting.
+        for line in reversed((proc.stdout or "").strip().splitlines()):
+            try:
+                return HealthReport.from_dict(json.loads(line))
+            except (json.JSONDecodeError, TypeError):
+                continue
+        tail = (proc.stderr or "").strip().splitlines()[-3:]
+        return HealthReport(
+            ok=False,
+            elapsed_s=time.perf_counter() - start,
+            failures=[
+                f"probe subprocess rc={proc.returncode}: " + " | ".join(tail)
+            ],
+        )
 
 
 def main(argv: Optional[list[str]] = None) -> int:

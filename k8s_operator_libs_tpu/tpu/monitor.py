@@ -226,6 +226,16 @@ def main(argv: Optional[list[str]] = None) -> int:
     parser.add_argument(
         "--once", action="store_true", help="one probe cycle, then exit"
     )
+    parser.add_argument(
+        "--in-process", action="store_true",
+        help="run the battery inside this process instead of a per-cycle "
+        "subprocess (holds libtpu's device lock for the monitor's whole "
+        "lifetime — only safe where nothing else needs the chips)",
+    )
+    parser.add_argument(
+        "--probe-timeout-seconds", type=float, default=600.0,
+        help="deadline for one subprocess probe cycle",
+    )
     import logging
 
     logging.basicConfig(
@@ -247,11 +257,36 @@ def main(argv: Optional[list[str]] = None) -> int:
         failure_threshold = 1
         success_threshold = 1
 
-    enable_persistent_compilation_cache()
+    if args.in_process:
+        # In-process: this monitor holds libtpu's exclusive lock from the
+        # first probe onward. Reserved for hosts where the monitor owns the
+        # chips (e.g. a dedicated validation host).
+        enable_persistent_compilation_cache()
+        gate = IciHealthGate.tpu_defaults()
+    else:
+        # Default (the DaemonSet shape): probe in a short-lived child so
+        # libtpu is released between cycles and workload pods admitted
+        # meanwhile can initialize the TPU. The child is the validation-pod
+        # CLI with the same calibrated floors tpu_defaults() arms; it
+        # inherits JAX_COMPILATION_CACHE_DIR, so warm cycles stay ~5 s.
+        from .health import (
+            TPU_DEFAULT_MIN_MXU_TFLOPS,
+            TPU_DEFAULT_MIN_RING_GBYTES_PER_S,
+            SubprocessHealthGate,
+        )
+
+        gate = SubprocessHealthGate(
+            cli_args=[
+                "--min-ring-gbps", str(TPU_DEFAULT_MIN_RING_GBYTES_PER_S),
+                "--min-mxu-tflops", str(TPU_DEFAULT_MIN_MXU_TFLOPS),
+            ],
+            timeout_seconds=args.probe_timeout_seconds,
+        )
     client = RestClient.from_environment()
     monitor = TpuHealthMonitor(
         client,
         args.node_name,
+        gate=gate,
         interval_seconds=args.interval_seconds,
         failure_threshold=failure_threshold,
         success_threshold=success_threshold,
