@@ -25,7 +25,7 @@ from __future__ import annotations
 import dataclasses
 import time
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Optional, Protocol
 
 from ..ops.collectives import CollectiveReport, run_ici_probes
 from ..ops.flash_attention import FlashAttentionReport, flash_attention_probe
@@ -135,6 +135,15 @@ class HealthReport:
         return " ".join(parts)
 
 
+class HealthGate(Protocol):
+    """One probe battery → one report. Both gate shapes satisfy it:
+    :class:`IciHealthGate` (in-process) and :class:`SubprocessHealthGate`
+    (per-cycle child) — consumers like ``TpuHealthMonitor`` depend on this
+    protocol, not a concrete gate."""
+
+    def run(self) -> HealthReport: ...  # pragma: no cover - typing only
+
+
 class IciHealthGate:
     def __init__(
         self,
@@ -170,16 +179,45 @@ class IciHealthGate:
     @classmethod
     def tpu_defaults(cls, **overrides) -> "IciHealthGate":
         """The calibrated TPU gate: perf floors armed at ~25% of measured
-        v5e-healthy throughput, Pallas kernels on (they lower on TPU).
-        Keyword overrides win, so callers can retune per device class."""
+        v5e-healthy throughput, Pallas kernels on (they lower on TPU), and
+        the deep-fabric ring/ulysses probes on — ``run()`` skips them (with
+        a logged reason) on a single-device mesh, and the persistent
+        compilation cache amortizes their two extra compiles, so there is
+        no cost argument for leaving the every-link exercise off. Keyword
+        overrides win, so callers can retune per device class."""
         kwargs: dict = dict(
             min_ring_gbytes_per_s=TPU_DEFAULT_MIN_RING_GBYTES_PER_S,
             min_mxu_tflops=TPU_DEFAULT_MIN_MXU_TFLOPS,
             use_pallas_matmul=True,
             run_flash_attention=True,
+            run_seq_parallel_probes=True,
         )
         kwargs.update(overrides)
         return cls(**kwargs)
+
+    def to_cli_args(self) -> list[str]:
+        """Serialize this gate's configuration to the payload CLI flags
+        (:func:`main`) — the ONE mapping from gate knobs to child argv, so
+        subprocess/pod probe shapes cannot drift from an in-process gate
+        configured the same way (``devices`` doesn't serialize: the child
+        probes whatever devices it can see)."""
+        args = [
+            "--payload-mb", str(self.payload_mb),
+            "--matmul-size", str(self.matmul_size),
+        ]
+        if self.min_ring_gbytes_per_s > 0:
+            args += ["--min-ring-gbps", str(self.min_ring_gbytes_per_s)]
+        if self.min_mxu_tflops > 0:
+            args += ["--min-mxu-tflops", str(self.min_mxu_tflops)]
+        if self.use_pallas_matmul:
+            args.append("--pallas-matmul")
+        if self.run_flash_attention:
+            args.append("--flash-attention")
+        if self.run_seq_parallel_probes:
+            args.append("--seq-parallel")
+        if not self.run_burnin:
+            args.append("--no-burnin")
+        return args
 
     def run(self) -> HealthReport:
         start = time.perf_counter()
@@ -356,6 +394,8 @@ class SubprocessHealthGate:
 
     def run(self) -> HealthReport:
         import json
+        import os
+        import signal
         import subprocess
         import sys
 
@@ -364,15 +404,37 @@ class SubprocessHealthGate:
             *self.cli_args,
         ]
         start = time.perf_counter()
+        # The child runs in its own session (= its own process group) so a
+        # timeout can kill the WHOLE group: subprocess.run's kill-on-timeout
+        # reaps only the direct child, then blocks on pipe EOF forever if a
+        # grandchild (a probe helper) inherited stdout — exactly the hung
+        # monitor this class exists to rule out.
+        proc = subprocess.Popen(
+            cmd,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            env=self.env,
+            start_new_session=True,
+        )
         try:
-            proc = subprocess.run(
-                cmd,
-                capture_output=True,
-                text=True,
-                timeout=self.timeout_seconds,
-                env=self.env,
-            )
+            stdout, stderr = proc.communicate(timeout=self.timeout_seconds)
         except subprocess.TimeoutExpired:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                proc.kill()
+            try:
+                proc.communicate(timeout=10.0)
+            except subprocess.TimeoutExpired:
+                # A helper that setsid()'d out of the killed group can hold
+                # the inherited pipes open past our bounded drain. Close
+                # our ends and reap the (SIGKILLed) child so a wedged
+                # cycle can't leak fds/zombies monitor-lifetime.
+                for pipe in (proc.stdout, proc.stderr):
+                    if pipe is not None:
+                        pipe.close()
+                proc.poll()
             return HealthReport(
                 ok=False,
                 elapsed_s=time.perf_counter() - start,
@@ -382,13 +444,21 @@ class SubprocessHealthGate:
             )
         # The payload prints its report as the last JSON line even when the
         # battery fails (rc=1) — prefer that structured verdict; fall back
-        # to stderr only when the child crashed before reporting.
-        for line in reversed((proc.stdout or "").strip().splitlines()):
+        # to stderr only when the child crashed before reporting. A stray
+        # stdout line that parses as non-dict JSON ('null', a number, an
+        # array) is noise from a dependency, not a report — skip it.
+        for line in reversed((stdout or "").strip().splitlines()):
             try:
-                return HealthReport.from_dict(json.loads(line))
-            except (json.JSONDecodeError, TypeError):
+                parsed = json.loads(line)
+            except json.JSONDecodeError:
                 continue
-        tail = (proc.stderr or "").strip().splitlines()[-3:]
+            if not isinstance(parsed, dict):
+                continue
+            try:
+                return HealthReport.from_dict(parsed)
+            except TypeError:
+                continue
+        tail = (stderr or "").strip().splitlines()[-3:]
         return HealthReport(
             ok=False,
             elapsed_s=time.perf_counter() - start,

@@ -30,7 +30,7 @@ from ..kube.client import Client, retry_on_conflict
 from ..kube.objects import Node, Pod, condition_status, set_condition
 from ..upgrade.consts import TRUE_STRING, DeviceClass, UpgradeKeys
 from ..utils.log import get_logger
-from .health import HealthReport, IciHealthGate
+from .health import HealthGate, HealthReport, IciHealthGate
 from .libtpu import TPU_RESOURCE
 
 log = get_logger("tpu.monitor")
@@ -47,7 +47,7 @@ class TpuHealthMonitor:
         self,
         client: Client,
         node_name: str,
-        gate: Optional[IciHealthGate] = None,
+        gate: Optional[HealthGate] = None,
         interval_seconds: float = 300.0,
         failure_threshold: int = 3,
         success_threshold: int = 2,
@@ -266,20 +266,14 @@ def main(argv: Optional[list[str]] = None) -> int:
     else:
         # Default (the DaemonSet shape): probe in a short-lived child so
         # libtpu is released between cycles and workload pods admitted
-        # meanwhile can initialize the TPU. The child is the validation-pod
-        # CLI with the same calibrated floors tpu_defaults() arms; it
-        # inherits JAX_COMPILATION_CACHE_DIR, so warm cycles stay ~5 s.
-        from .health import (
-            TPU_DEFAULT_MIN_MXU_TFLOPS,
-            TPU_DEFAULT_MIN_RING_GBYTES_PER_S,
-            SubprocessHealthGate,
-        )
+        # meanwhile can initialize the TPU. The child runs the calibrated
+        # tpu_defaults() configuration, serialized through to_cli_args()
+        # so the two probe shapes cannot drift; it inherits
+        # JAX_COMPILATION_CACHE_DIR, so warm cycles stay ~5 s.
+        from .health import SubprocessHealthGate
 
         gate = SubprocessHealthGate(
-            cli_args=[
-                "--min-ring-gbps", str(TPU_DEFAULT_MIN_RING_GBYTES_PER_S),
-                "--min-mxu-tflops", str(TPU_DEFAULT_MIN_MXU_TFLOPS),
-            ],
+            cli_args=IciHealthGate.tpu_defaults().to_cli_args(),
             timeout_seconds=args.probe_timeout_seconds,
         )
     client = RestClient.from_environment()

@@ -63,7 +63,11 @@ class ValidationPodSpec:
     min_ring_gbytes_per_s: float = TPU_DEFAULT_MIN_RING_GBYTES_PER_S
     min_mxu_tflops: float = TPU_DEFAULT_MIN_MXU_TFLOPS
     run_flash_attention: bool = True
-    run_seq_parallel_probes: bool = False
+    #: Deep-fabric ring/ulysses probes on by default: the probe pod holds
+    #: the host's full chip complement (>1 device), exactly where the
+    #: every-link exercise has signal; the persistent compile cache
+    #: amortizes their extra compiles (matches IciHealthGate.tpu_defaults).
+    run_seq_parallel_probes: bool = True
     #: Seconds between readinessProbe executions / before first check.
     probe_period_seconds: int = 10
     #: Host path for the persistent XLA compilation cache (empty = no
@@ -81,23 +85,27 @@ class ValidationPodSpec:
         return f"{VALIDATION_APP_LABEL}={VALIDATION_APP}"
 
     def probe_command(self) -> list[str]:
-        """The payload: the health CLI, parked after a passing battery."""
-        cmd = [
+        """The payload: the health CLI, parked after a passing battery.
+        Gate knobs serialize through ``IciHealthGate.to_cli_args`` — the
+        one knob→argv mapping shared with the monitor's subprocess gate.
+        ``use_pallas_matmul`` stays off here: the payload auto-enables the
+        Pallas kernels when it actually lands on a TPU (health.main)."""
+        from .health import IciHealthGate
+
+        gate = IciHealthGate(
+            min_ring_gbytes_per_s=self.min_ring_gbytes_per_s,
+            min_mxu_tflops=self.min_mxu_tflops,
+            payload_mb=self.payload_mb,
+            matmul_size=self.matmul_size,
+            run_flash_attention=self.run_flash_attention,
+            run_seq_parallel_probes=self.run_seq_parallel_probes,
+        )
+        return [
             "python", "-m", "k8s_operator_libs_tpu.tpu.health",
             "--ready-file", READY_FILE,
             "--park",
-            "--payload-mb", str(self.payload_mb),
-            "--matmul-size", str(self.matmul_size),
+            *gate.to_cli_args(),
         ]
-        if self.min_ring_gbytes_per_s > 0:
-            cmd += ["--min-ring-gbps", str(self.min_ring_gbytes_per_s)]
-        if self.min_mxu_tflops > 0:
-            cmd += ["--min-mxu-tflops", str(self.min_mxu_tflops)]
-        if self.run_flash_attention:
-            cmd.append("--flash-attention")
-        if self.run_seq_parallel_probes:
-            cmd.append("--seq-parallel")
-        return cmd
 
 
 class ValidationPodManager:

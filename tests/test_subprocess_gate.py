@@ -1,0 +1,277 @@
+"""SubprocessHealthGate + HealthReport.from_dict + monitor gate selection.
+
+The subprocess gate is the monitor DaemonSet's default probe path
+(tpu/monitor.py main), so every branch of its child-handling gets a test:
+clean pass, fail-with-report, stdout noise (including non-dict JSON — the
+AttributeError regression), crashed child, and a timeout with a grandchild
+holding the pipes (the hung-monitor scenario the process-group kill
+exists for).
+
+The child command is fixed (`sys.executable -m k8s_operator_libs_tpu.tpu
+.health`), so tests shadow the real module via a stub package on
+PYTHONPATH + PYTHONSAFEPATH=1 (keeps the repo cwd out of the child's
+sys.path). The stub prints exactly the scripted stdout/stderr, so these
+tests exercise the real subprocess mechanics without paying a JAX start.
+"""
+
+import dataclasses
+import json
+import os
+import sys
+import time
+
+from k8s_operator_libs_tpu.ops.collectives import CollectiveReport
+from k8s_operator_libs_tpu.ops.matmul import MxuReport
+from k8s_operator_libs_tpu.ops.probe_harness import ProbeReport
+from k8s_operator_libs_tpu.tpu.health import (
+    HealthReport,
+    IciHealthGate,
+    SubprocessHealthGate,
+)
+
+STUB_PRELUDE = """\
+import json, os, subprocess, sys, time
+"""
+
+
+def stub_gate(tmp_path, body: str, timeout_seconds: float = 30.0,
+              cli_args=None) -> SubprocessHealthGate:
+    """Install a stub k8s_operator_libs_tpu.tpu.health whose __main__ body
+    is ``body``, and return a gate whose child will import it."""
+    pkg = tmp_path / "k8s_operator_libs_tpu"
+    (pkg / "tpu").mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "tpu" / "__init__.py").write_text("")
+    (pkg / "tpu" / "health.py").write_text(STUB_PRELUDE + body)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(tmp_path)
+    # Keep the test cwd (the repo root, holding the REAL package) out of
+    # the child's sys.path so the stub wins module resolution.
+    env["PYTHONSAFEPATH"] = "1"
+    return SubprocessHealthGate(
+        cli_args=cli_args or [], timeout_seconds=timeout_seconds, env=env
+    )
+
+
+def sample_report(ok: bool = True) -> HealthReport:
+    return HealthReport(
+        ok=ok,
+        collectives=[
+            CollectiveReport(op="psum", ok=True, elapsed_s=0.1),
+            CollectiveReport(
+                op="ppermute_ring", ok=True, gbytes_per_s=41.5
+            ),
+        ],
+        mxu=MxuReport(ok=True, tflops=118.2, max_abs_err=1e-3),
+        burnin_ok=True,
+        ring_attention=ProbeReport(ok=True, tokens_per_s=1e5),
+        ulysses=ProbeReport(ok=True, tokens_per_s=2e5),
+        flash=ProbeReport(ok=ok, error="" if ok else "pallas lowering"),
+        elapsed_s=4.2,
+        failures=[] if ok else ["flash attention: pallas lowering"],
+    )
+
+
+class TestFromDict:
+    def test_asdict_round_trip(self):
+        report = sample_report()
+        rebuilt = HealthReport.from_dict(
+            json.loads(json.dumps(dataclasses.asdict(report)))
+        )
+        assert rebuilt == report
+
+    def test_failing_report_round_trip(self):
+        report = sample_report(ok=False)
+        rebuilt = HealthReport.from_dict(dataclasses.asdict(report))
+        assert rebuilt == report
+        assert rebuilt.failures == ["flash attention: pallas lowering"]
+
+    def test_unknown_keys_dropped_top_level_and_nested(self):
+        data = dataclasses.asdict(sample_report())
+        data["from_the_future"] = {"nested": 1}
+        data["mxu"]["novel_metric"] = 9.9
+        data["collectives"][0]["novel"] = True
+        rebuilt = HealthReport.from_dict(data)
+        assert rebuilt == sample_report()
+
+    def test_minimal_dict(self):
+        rebuilt = HealthReport.from_dict({"ok": False})
+        assert rebuilt.ok is False
+        assert rebuilt.collectives == []
+        assert rebuilt.mxu is None
+
+
+class TestSubprocessHealthGate:
+    def test_pass_report_parsed(self, tmp_path):
+        payload = json.dumps(dataclasses.asdict(sample_report()))
+        gate = stub_gate(
+            tmp_path, f"print({payload!r}); sys.exit(0)\n"
+        )
+        report = gate.run()
+        assert report == sample_report()
+
+    def test_fail_with_report_prefers_structured_verdict(self, tmp_path):
+        payload = json.dumps(dataclasses.asdict(sample_report(ok=False)))
+        gate = stub_gate(
+            tmp_path,
+            f"print({payload!r})\n"
+            "print('stack trace noise', file=sys.stderr)\n"
+            "sys.exit(1)\n",
+        )
+        report = gate.run()
+        assert report.ok is False
+        assert report.failures == ["flash attention: pallas lowering"]
+
+    def test_noise_lines_skipped_last_json_dict_wins(self, tmp_path):
+        payload = json.dumps(dataclasses.asdict(sample_report()))
+        gate = stub_gate(
+            tmp_path,
+            f"print({payload!r})\n"
+            "print('INFO tpu.health: battery done')\n"  # non-JSON
+            "print('null')\nprint('42')\nprint('[1, 2]')\n",  # non-dict JSON
+        )
+        report = gate.run()
+        assert report == sample_report()
+
+    def test_only_nondict_json_falls_back_to_stderr(self, tmp_path):
+        # Regression (round-3 advisor): 'null' on stdout used to raise
+        # AttributeError inside from_dict and abort the probe cycle.
+        gate = stub_gate(
+            tmp_path,
+            "print('null')\n"
+            "print('RuntimeError: libtpu init failed', file=sys.stderr)\n"
+            "sys.exit(3)\n",
+        )
+        report = gate.run()
+        assert report.ok is False
+        assert "rc=3" in report.failures[0]
+        assert "libtpu init failed" in report.failures[0]
+
+    def test_crashed_child_reports_stderr_tail(self, tmp_path):
+        gate = stub_gate(
+            tmp_path,
+            "print('early line one', file=sys.stderr)\n"
+            "print('line two', file=sys.stderr)\n"
+            "print('line three', file=sys.stderr)\n"
+            "print('fatal: device lost', file=sys.stderr)\n"
+            "sys.exit(2)\n",
+        )
+        report = gate.run()
+        assert report.ok is False
+        assert "rc=2" in report.failures[0]
+        assert "fatal: device lost" in report.failures[0]
+        assert "early line one" not in report.failures[0]  # last-3 tail
+
+    def test_timeout_kills_process_group(self, tmp_path):
+        # Child spawns a grandchild that inherits the pipes and sleeps.
+        # Without the process-group kill, communicate() would block on
+        # pipe EOF for the grandchild's full 60 s — the hung monitor.
+        gate = stub_gate(
+            tmp_path,
+            "subprocess.Popen(['sleep', '60'])\n"
+            "time.sleep(60)\n",
+            timeout_seconds=0.5,
+        )
+        start = time.monotonic()
+        report = gate.run()
+        elapsed = time.monotonic() - start
+        assert report.ok is False
+        assert "exceeded" in report.failures[0]
+        assert elapsed < 10.0
+
+    def test_empty_output_child(self, tmp_path):
+        gate = stub_gate(tmp_path, "sys.exit(0)\n")
+        report = gate.run()
+        assert report.ok is False
+        assert "rc=0" in report.failures[0]
+
+    def test_cli_args_forwarded(self, tmp_path):
+        gate = stub_gate(
+            tmp_path,
+            "print(json.dumps({'ok': True, 'failures': [],"
+            " 'elapsed_s': float(len(sys.argv) - 1)}))\n",
+            cli_args=["--min-ring-gbps", "5.0", "--seq-parallel"],
+        )
+        report = gate.run()
+        assert report.ok
+        assert report.elapsed_s == 3.0  # three argv entries reached the child
+
+
+class TestMonitorGateSelection:
+    """monitor.main() wiring: which gate shape each flag combination builds."""
+
+    def _run_main(self, monkeypatch, argv):
+        from k8s_operator_libs_tpu.kube import FakeCluster
+        from k8s_operator_libs_tpu.kube.rest import RestClient
+        from k8s_operator_libs_tpu.tpu import health as health_mod
+        from k8s_operator_libs_tpu.tpu import monitor as monitor_mod
+
+        seen = {}
+        cluster = FakeCluster()
+        monkeypatch.setattr(
+            RestClient, "from_environment", classmethod(lambda cls: cluster)
+        )
+        # main() does `from .health import enable_persistent_compilation_
+        # cache` at call time, so patching the health module covers it.
+        monkeypatch.setattr(
+            health_mod, "enable_persistent_compilation_cache", lambda *a: None
+        )
+
+        def fake_check_once(self):
+            seen["gate"] = self.gate
+            seen["failure_threshold"] = self.failure_threshold
+            seen["success_threshold"] = self.success_threshold
+            return HealthReport(ok=True)
+
+        monkeypatch.setattr(
+            monitor_mod.TpuHealthMonitor, "check_once", fake_check_once
+        )
+        rc = monitor_mod.main(argv)
+        return rc, seen
+
+    def test_default_is_subprocess_gate_with_calibrated_floors(
+        self, monkeypatch
+    ):
+        from k8s_operator_libs_tpu.tpu.health import (
+            TPU_DEFAULT_MIN_MXU_TFLOPS,
+            TPU_DEFAULT_MIN_RING_GBYTES_PER_S,
+        )
+
+        rc, seen = self._run_main(
+            monkeypatch, ["--node-name", "n0", "--once"]
+        )
+        assert rc == 0
+        gate = seen["gate"]
+        assert isinstance(gate, SubprocessHealthGate)
+        args = gate.cli_args
+        assert args[args.index("--min-ring-gbps") + 1] == str(
+            TPU_DEFAULT_MIN_RING_GBYTES_PER_S
+        )
+        assert args[args.index("--min-mxu-tflops") + 1] == str(
+            TPU_DEFAULT_MIN_MXU_TFLOPS
+        )
+        # Deep-fabric probes ride the default DaemonSet probe cycle.
+        assert "--seq-parallel" in args
+
+    def test_in_process_builds_ici_gate(self, monkeypatch):
+        rc, seen = self._run_main(
+            monkeypatch, ["--node-name", "n0", "--once", "--in-process"]
+        )
+        assert rc == 0
+        assert isinstance(seen["gate"], IciHealthGate)
+
+    def test_once_forces_thresholds_to_one(self, monkeypatch):
+        _, seen = self._run_main(
+            monkeypatch,
+            ["--node-name", "n0", "--once", "--failure-threshold", "3"],
+        )
+        assert seen["failure_threshold"] == 1
+        assert seen["success_threshold"] == 1
+
+    def test_probe_timeout_flag_reaches_gate(self, monkeypatch):
+        _, seen = self._run_main(
+            monkeypatch,
+            ["--node-name", "n0", "--once",
+             "--probe-timeout-seconds", "42.5"],
+        )
+        assert seen["gate"].timeout_seconds == 42.5

@@ -315,8 +315,14 @@ class TestCalibratedFloors:
             > 0
         )
         assert gate.use_pallas_matmul and gate.run_flash_attention
+        # Deep-fabric ring/ulysses probes are on by default (run() skips
+        # them, logged, on single-device meshes).
+        assert gate.run_seq_parallel_probes
         # Overrides win (per-device-class retuning).
         assert IciHealthGate.tpu_defaults(min_mxu_tflops=7.5).min_mxu_tflops == 7.5
+        assert not IciHealthGate.tpu_defaults(
+            run_seq_parallel_probes=False
+        ).run_seq_parallel_probes
 
     def test_throttled_mxu_fails_the_gate(self):
         import jax
