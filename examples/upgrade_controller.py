@@ -315,8 +315,25 @@ def main(argv: list[str] | None = None) -> int:
             maintenance_sim.step()
         if validation_pod_sim is not None:
             validation_pod_sim.step()
-        state = mgr.build_state(args.namespace, selector)
-        mgr.apply_state(state, policy)
+        try:
+            state = mgr.build_state(args.namespace, selector)
+            mgr.apply_state(state, policy)
+        except Exception as e:  # noqa: BLE001 - the daemon outlives passes
+            if args.once:
+                raise
+            # Reference contract: an error aborts the PASS, never the
+            # controller — the next idempotent pass resumes from labels
+            # (upgrade_state.go:49-52). Transient snapshot incompleteness
+            # (a driver pod mid-recreate fails the unscheduled-pods guard)
+            # heals by itself; requeue shortly rather than wait for a
+            # watch event, because the event that exposed the race may
+            # have been the last one.
+            print(
+                f"pass {passes}: reconcile failed (will retry): {e}",
+                file=sys.stderr,
+            )
+            time.sleep(0.0 if sim is not None else 0.5)
+            continue
         if metrics is not None:
             metrics.observe(state)
         if sim is not None:

@@ -308,8 +308,16 @@ class FakeCluster(Client):
         from ``resource_version``, selector-scope transitions via
         old-vs-new classification, ``timeout_seconds`` ending the stream.
         ``handle`` accepts a ``WatchHandle``-shaped object; its
-        ``cancelled`` flag ends the stream at the next poll tick."""
+        ``cancelled`` flag ends the stream at the next poll tick.
+        ``timeout_seconds=None`` applies the same default window as
+        RestClient (DEFAULT_WATCH_TIMEOUT_SECONDS) — code tested against
+        the fake must see the real client's bounded-stream behavior."""
         import queue
+
+        if timeout_seconds is None:
+            from .rest import DEFAULT_WATCH_TIMEOUT_SECONDS
+
+            timeout_seconds = DEFAULT_WATCH_TIMEOUT_SECONDS
 
         if isinstance(label_selector, Mapping):
             selector = LabelSelector.from_match_labels(label_selector)
@@ -483,6 +491,24 @@ class FakeCluster(Client):
                     continue
                 out.append(wrap(copy.deepcopy(data)))
             return out
+
+    def list_with_revision(
+        self,
+        kind: str,
+        namespace: str = "",
+        label_selector: Optional[str | Mapping[str, str]] = None,
+        field_selector: Optional[str] = None,
+    ) -> tuple[list[KubeObject], str]:
+        """``list()`` plus the collection resourceVersion, RestClient
+        parity (kube/rest.py list_with_revision): the revision an informer
+        seeds its watch from, so the documented no-lost-event resumption
+        holds over the fake too — including for an empty list, where there
+        are no items to take a revision from. Items and revision are read
+        under one lock acquisition (RLock) so a concurrent write cannot
+        slip between them."""
+        with self._lock:
+            items = self.list(kind, namespace, label_selector, field_selector)
+            return items, self.current_resource_version()
 
     def create(self, obj: KubeObject) -> KubeObject:
         kind = obj.raw.get("kind", "")

@@ -41,6 +41,13 @@ from .resources import ResourceInfo, resource_for_kind
 
 _SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
+#: Server-side stream bound applied when watch() is called without
+#: timeout_seconds. A watch with NO bound needs an unbounded socket read,
+#: which parks readline() forever on a half-open connection; bounded
+#: windows resumed from the last resourceVersion are client-go's
+#: reflector shape (it picks 5-10 min per window for the same reason).
+DEFAULT_WATCH_TIMEOUT_SECONDS = 300
+
 
 class RestConfigError(Exception):
     pass
@@ -473,17 +480,23 @@ class RestClient(Client):
         poll-reconcile in addition, as the upgrade controller does).
 
         ``timeout_seconds`` bounds the stream server-side, like the real
-        apiserver's int64 ``timeoutSeconds`` (the generator ends); without
-        it the stream runs until the consumer closes the generator. Uses a
-        dedicated connection — a watch parks on the socket and must not
-        hog the thread's pooled keep-alive connection.
+        apiserver's int64 ``timeoutSeconds`` (the generator ends); when
+        None, ``DEFAULT_WATCH_TIMEOUT_SECONDS`` applies instead — an
+        UNbounded stream would also need an unbounded socket read, and a
+        half-open connection (peer gone, no FIN seen) would then park the
+        caller in readline() forever. Bounded windows + resume via
+        ``resource_version`` is the reflector shape client-go uses for the
+        same reason; callers loop and re-establish. Uses a dedicated
+        connection — a watch parks on the socket and must not hog the
+        thread's pooled keep-alive connection.
         """
+        if timeout_seconds is None:
+            timeout_seconds = DEFAULT_WATCH_TIMEOUT_SECONDS
         info = resource_for_kind(kind)
         query = self._selector_query(label_selector, field_selector)
         query["watch"] = "true"
-        if timeout_seconds is not None:
-            # int64 on a real apiserver: "300.0" would be a 400.
-            query["timeoutSeconds"] = str(int(timeout_seconds))
+        # int64 on a real apiserver: "300.0" would be a 400.
+        query["timeoutSeconds"] = str(int(timeout_seconds))
         if resource_version is not None:
             query["resourceVersion"] = resource_version
         path = self._collection_path(info, namespace)
@@ -491,13 +504,9 @@ class RestClient(Client):
         headers = {"Accept": "application/json"}
         if self.config.token:
             headers["Authorization"] = f"Bearer {self.config.token}"
-        # Socket timeout must outlive the server-side stream bound; an
-        # unbounded watch blocks in readline indefinitely (by design).
-        sock_timeout = (
-            timeout_seconds + self.timeout
-            if timeout_seconds is not None
-            else None
-        )
+        # Socket timeout must outlive the server-side stream bound
+        # (timeout_seconds is always set by this point — see above).
+        sock_timeout = timeout_seconds + self.timeout
         if self._https:
             conn = http.client.HTTPSConnection(
                 self._host, self._port, timeout=sock_timeout, context=self._ssl

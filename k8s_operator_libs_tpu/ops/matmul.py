@@ -156,16 +156,25 @@ def mxu_probe(
         if device is not None
         else contextlib.nullcontext()
     )
+    # Key the probe cache by the CONCRETE device the probe will land on —
+    # device=None resolves to the process default at call time, so a
+    # changed jax_default_device gets its own cache entry instead of
+    # reusing arrays committed to the previous default.
+    resolved = device
+    if resolved is None:
+        resolved = getattr(jax.config, "jax_default_device", None)
+    if isinstance(resolved, str):
+        # jax accepts a platform NAME as the default-device config;
+        # resolve it to that platform's first device.
+        resolved = jax.devices(resolved)[0]
+    if resolved is None:
+        resolved = jax.devices()[0]
     try:
         with ctx:
             return _mxu_probe_on_default_device(
                 size, dtype, use_pallas, interpret, iters, chain,
-                dev_token=str(device) if device is not None else "default",
-                platform=(
-                    device.platform
-                    if device is not None
-                    else jax.devices()[0].platform
-                ),
+                dev_token=str(resolved),
+                platform=resolved.platform,
             )
     except Exception as e:  # noqa: BLE001 - a dead MXU is a failed probe
         return MxuReport(ok=False, error=str(e))

@@ -189,6 +189,26 @@ class TestListSelectors:
         pods = cluster.list("Pod", field_selector="spec.nodeName=n1")
         assert [p.name for p in pods] == ["p1"]
 
+    def test_list_with_revision_rest_parity(self, cluster):
+        """RestClient parity (round-2 advisor): the fake serves the
+        collection resourceVersion an informer resumes its watch from —
+        including for an EMPTY list, the case with no items to take a
+        revision from."""
+        items, rv0 = cluster.list_with_revision("Node")
+        assert items == []
+        assert rv0 == cluster.current_resource_version()
+        cluster.create(make_node("rv-a"))
+        cluster.create(make_node("rv-b"))
+        items, rv = cluster.list_with_revision("Node")
+        assert {o.name for o in items} == {"rv-a", "rv-b"}
+        assert int(rv) > int(rv0)
+        assert rv == cluster.current_resource_version()
+        # Writes to OTHER kinds advance the collection revision too (one
+        # cluster-wide journal, like etcd).
+        cluster.create(make_pod("rv-p", node_name="rv-a"))
+        _, rv2 = cluster.list_with_revision("Node")
+        assert int(rv2) > int(rv)
+
 
 class TestWatchAndReactors:
     def test_watch_events(self, cluster):

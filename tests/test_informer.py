@@ -271,6 +271,21 @@ class TestInProcessClient:
             cluster.delete("Node", "direct-live")
             assert wait_until(lambda: inf.get("direct-live") is None)
 
+    def test_empty_store_seeds_resume_revision_over_fake(self, server):
+        """Regression (round-2 advisor): FakeCluster now serves
+        list_with_revision, so an informer syncing over an EMPTY fake
+        still seeds its resume revision — the no-lost-event guarantee
+        used to silently not hold for the in-process client."""
+        cluster = server.cluster
+        # Advance the journal so the seeded revision is visibly nonzero.
+        cluster.create(make_node("pre"))
+        cluster.delete("Node", "pre")
+        with Informer(cluster, "Node") as inf:
+            assert inf.wait_for_sync(timeout=10)
+            assert inf.list() == []
+            assert inf._resource_version is not None
+            assert inf._resource_version == cluster.current_resource_version()
+
     def test_deletion_survives_watch_window_boundary(self, server, client):
         """Regression: DELETED events journal at a bumped revision, so a
         watch resuming from the pre-delete revision still sees them."""

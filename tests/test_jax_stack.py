@@ -162,6 +162,31 @@ class TestMatmul:
         mxu_probe(size=256, use_pallas=False, device=cpus[1], iters=1)
         assert len(_PROBE_CACHE) == 2
 
+    def test_probe_cache_resolves_default_device(self, cpus):
+        """device=None keys the cache by the CONCRETE current default
+        device, not a literal sentinel — a changed process default must
+        not reuse arrays committed to the old device (round-3 advisor)."""
+        import jax
+
+        from k8s_operator_libs_tpu.ops.matmul import _PROBE_CACHE
+
+        _PROBE_CACHE.clear()
+        mxu_probe(size=256, use_pallas=False, device=None, iters=1)
+        tokens = {key[2] for key in _PROBE_CACHE}
+        assert tokens == {str(jax.devices()[0])}
+        # Same concrete device passed explicitly reuses the entry...
+        mxu_probe(size=256, use_pallas=False, device=jax.devices()[0], iters=1)
+        assert len(_PROBE_CACHE) == 1
+        # ...and a changed process default gets its own entry.
+        try:
+            jax.config.update("jax_default_device", cpus[1])
+            report = mxu_probe(size=256, use_pallas=False, device=None, iters=1)
+        finally:
+            jax.config.update("jax_default_device", None)
+        assert report.ok
+        assert len(_PROBE_CACHE) == 2
+        assert str(cpus[1]) in {key[2] for key in _PROBE_CACHE}
+
 
 class TestBurnin:
     CFG = BurninConfig(

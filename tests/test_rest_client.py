@@ -386,6 +386,21 @@ class TestWatch:
         assert events == []
         assert time.monotonic() - start < 6
 
+    def test_watch_without_timeout_gets_default_bound(
+        self, client, monkeypatch
+    ):
+        """Regression (round-2 advisor): timeout_seconds=None used to mean
+        an unbounded socket read — a half-open connection parked the
+        caller in readline() forever. None now applies the default
+        reflector window (server-side bound + socket timeout)."""
+        from k8s_operator_libs_tpu.kube import rest as rest_mod
+
+        monkeypatch.setattr(rest_mod, "DEFAULT_WATCH_TIMEOUT_SECONDS", 1)
+        start = time.monotonic()
+        events = list(client.watch("Node"))
+        assert events == []
+        assert time.monotonic() - start < 6
+
     def test_watch_resume_from_resource_version_replays(self, server, client):
         """list-then-watch with NO lost-event window: events that land
         between the list and the watch replay from the journal."""
