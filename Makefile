@@ -2,7 +2,15 @@
 PYTHON ?= python
 COV_MIN ?= 88
 
-.PHONY: all lint test coverage bench dryrun demo install
+# Container image for the framework's pod payloads (validation probe pod +
+# monitor DaemonSet). IMAGE must match ValidationPodSpec.image and the
+# image in manifests/monitor-daemonset.yaml — tests/test_manifests.py
+# enforces the consistency. (Reference analog: Makefile:114-125.)
+DOCKER ?= docker
+IMAGE ?= tpu-operator.dev/tpu-health-probe
+TAG ?= latest
+
+.PHONY: all lint test coverage bench dryrun demo install image
 
 all: lint test
 
@@ -33,6 +41,9 @@ coverage:
 
 bench:
 	$(PYTHON) bench.py
+
+image:
+	$(DOCKER) build -f docker/Dockerfile -t $(IMAGE):$(TAG) .
 
 dryrun:
 	$(PYTHON) __graft_entry__.py

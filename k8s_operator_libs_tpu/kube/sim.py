@@ -11,6 +11,13 @@ apiserver.
 
 from __future__ import annotations
 
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+from dataclasses import dataclass
 from typing import Callable, Optional
 
 from .client import NotFoundError
@@ -156,6 +163,163 @@ class DaemonSetSimulator:
         return True
 
 
+@dataclass
+class _PodExec:
+    """All kubelet-side state for one probe pod's container."""
+
+    proc: subprocess.Popen
+    ready_file: str
+    started_at: float
+    verdict: Optional[bool] = None
+
+
+class KubeletPayloadExecutor:
+    """The kubelet's container+readinessProbe mechanics, for real.
+
+    Runs a probe pod's container command as an actual subprocess (the same
+    `python -m k8s_operator_libs_tpu.tpu.health --ready-file ... --park`
+    argv the pod carries, `tpu/validation_pod.py probe_command`) and reads
+    its readiness the way the pod's exec readinessProbe does: the
+    ready-file existing. With this plugged into
+    :class:`ValidationPodSimulator`, `health.main()` writing the
+    ready-file is what flips the pod Ready — the full chain
+    payload-process → ready-file → readinessProbe → pod Ready →
+    ValidationManager pass → uncordon runs with no simulated verdict
+    anywhere in it.
+
+    Container-filesystem analog: each pod's ready-file path is rewritten
+    to a private temp dir (pods don't share a filesystem). ``env`` lets
+    tests pin the child to the hermetic CPU mesh; ``extra_args`` appends
+    payload flags (e.g. ``--no-compile-cache`` in tests).
+
+    Simplification vs a real kubelet: processes are keyed by pod NAME, so
+    a same-named replacement pod created between two ticks reuses the
+    prior verdict instead of re-running the battery (a real kubelet keys
+    by UID). The ``release``/GC path covers deletion observed at a tick.
+    """
+
+    def __init__(
+        self,
+        env: Optional[dict] = None,
+        extra_args: Optional[list[str]] = None,
+        timeout_seconds: float = 600.0,
+    ) -> None:
+        self.env = env
+        self.extra_args = list(extra_args or [])
+        self.timeout_seconds = timeout_seconds
+        #: One record per tracked pod — single pop on release, so no
+        #: partial-cleanup path can leave a stale verdict or ready-file
+        #: behind for a later same-named pod.
+        self._pods: dict[str, _PodExec] = {}
+        self._tmpdir = tempfile.TemporaryDirectory(prefix="kubelet-exec-")
+        #: Every verdict ever recorded, survives release() — the audit
+        #: trail tests assert against after pod cleanup.
+        self.history: dict[str, bool] = {}
+
+    def _start(self, pod: Pod) -> "_PodExec":
+        (container,) = pod.spec["containers"]
+        argv = list(container["command"]) + self.extra_args
+        argv[0] = sys.executable  # "python" inside the image = this python
+        ready_file = os.path.join(self._tmpdir.name, f"{pod.name}.ready")
+        if os.path.exists(ready_file):  # defensive: never trust a stale pass
+            os.unlink(ready_file)
+        if "--ready-file" in argv:
+            argv[argv.index("--ready-file") + 1] = ready_file
+        else:
+            argv += ["--ready-file", ready_file]
+        proc = subprocess.Popen(
+            argv,
+            env=self.env,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+            start_new_session=True,
+        )
+        return _PodExec(
+            proc=proc, ready_file=ready_file, started_at=time.monotonic()
+        )
+
+    def poll(self, pod: Pod) -> Optional[bool]:
+        """Advance the pod's container one kubelet tick. Returns True when
+        the readinessProbe passes (ready-file written by the payload),
+        False when the container failed (non-zero exit, or deadline), and
+        None while the battery is still running."""
+        name = pod.name
+        rec = self._pods.get(name)
+        if rec is None:
+            self._pods[name] = self._start(pod)
+            return None
+        if rec.verdict is not None:
+            return rec.verdict
+        if os.path.exists(rec.ready_file):
+            # --park keeps the process (and the Ready condition) alive;
+            # the verdict is the probe's, not the exit code's.
+            return self._record(name, True)
+        rc = rec.proc.poll()
+        if rc is not None:
+            return self._record(
+                name, rc == 0 and os.path.exists(rec.ready_file)
+            )
+        if time.monotonic() - rec.started_at > self.timeout_seconds:
+            self._kill(rec)
+            return self._record(name, False)
+        return None
+
+    def _record(self, name: str, verdict: bool) -> bool:
+        self._pods[name].verdict = verdict
+        self.history[name] = verdict
+        return verdict
+
+    def verdict(self, pod_name: str) -> Optional[bool]:
+        rec = self._pods.get(pod_name)
+        return rec.verdict if rec is not None else None
+
+    def ready_file_content(self, pod_name: str) -> Optional[str]:
+        rec = self._pods.get(pod_name)
+        if rec is None or not os.path.exists(rec.ready_file):
+            return None
+        with open(rec.ready_file) as fh:
+            return fh.read()
+
+    @staticmethod
+    def _kill(rec: "_PodExec") -> None:
+        if rec.proc.poll() is not None:
+            return
+        try:
+            os.killpg(rec.proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            rec.proc.kill()
+        try:
+            rec.proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:  # pragma: no cover - kernel lag
+            pass
+
+    def tracked_pods(self) -> set[str]:
+        """Pods with a live payload process or a recorded verdict."""
+        return set(self._pods)
+
+    def release(self, pod_name: str) -> None:
+        """Pod deleted: kill its (possibly parked) payload process and
+        drop every trace — a later same-named pod must earn a fresh
+        verdict, never inherit a stale ready-file."""
+        rec = self._pods.pop(pod_name, None)
+        if rec is None:
+            return
+        self._kill(rec)
+        if os.path.exists(rec.ready_file):
+            os.unlink(rec.ready_file)
+
+    def close(self) -> None:
+        for name in list(self._pods):
+            self.release(name)
+        self._tmpdir.cleanup()
+
+    def __enter__(self) -> "KubeletPayloadExecutor":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
 class ValidationPodSimulator:
     """Kubelet stand-in for framework-provisioned validation pods.
 
@@ -171,6 +335,11 @@ class ValidationPodSimulator:
     ``decide`` defaults to always-healthy; tests inject per-node failures,
     and the bench can wire an actual ``IciHealthGate.run()`` so readiness
     is backed by real probes on real devices.
+
+    ``executor`` replaces the simulated verdict entirely with
+    :class:`KubeletPayloadExecutor`: the pod's actual command runs as a
+    subprocess and readiness comes from the payload writing its
+    ready-file — nothing in the chain is scripted.
     """
 
     def __init__(
@@ -180,6 +349,7 @@ class ValidationPodSimulator:
         label_selector: Optional[str] = None,
         readiness_steps: int = 1,
         decide: Optional[Callable[[Pod], bool]] = None,
+        executor: Optional[KubeletPayloadExecutor] = None,
     ) -> None:
         if label_selector is None:
             # Default to the manager's probe-pod selector (lazy import:
@@ -192,6 +362,7 @@ class ValidationPodSimulator:
         self.label_selector = label_selector
         self.readiness_steps = readiness_steps
         self.decide = decide or (lambda pod: True)
+        self.executor = executor
         self._pending: dict[str, int] = {}
 
     def step(self) -> None:
@@ -208,13 +379,19 @@ class ValidationPodSimulator:
             if pod.is_finished() or pod.is_ready():
                 continue
             seen.add(pod.name)
-            remaining = self._pending.get(pod.name, self.readiness_steps)
-            remaining -= 1
-            if remaining > 0:
-                self._pending[pod.name] = remaining
-                continue
-            self._pending.pop(pod.name, None)
-            healthy = self.decide(pod)
+            if self.executor is not None:
+                verdict = self.executor.poll(pod)
+                if verdict is None:
+                    continue  # battery still running
+                healthy = verdict
+            else:
+                remaining = self._pending.get(pod.name, self.readiness_steps)
+                remaining -= 1
+                if remaining > 0:
+                    self._pending[pod.name] = remaining
+                    continue
+                self._pending.pop(pod.name, None)
+                healthy = self.decide(pod)
             status = (
                 {
                     "phase": "Running",
@@ -242,6 +419,12 @@ class ValidationPodSimulator:
         for name in list(self._pending):
             if name not in seen:
                 del self._pending[name]
+        if self.executor is not None:
+            # Kubelet GC: a deleted pod's (possibly parked or still
+            # probing) payload process is killed, releasing its devices.
+            live = {pod.name for pod in pods}
+            for name in self.executor.tracked_pods() - live:
+                self.executor.release(name)
 
 
 class MaintenanceOperatorSimulator:

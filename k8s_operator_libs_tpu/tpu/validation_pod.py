@@ -68,6 +68,8 @@ class ValidationPodSpec:
     #: every-link exercise has signal; the persistent compile cache
     #: amortizes their extra compiles (matches IciHealthGate.tpu_defaults).
     run_seq_parallel_probes: bool = True
+    #: One sharded train step as part of the battery (health._burnin).
+    run_burnin: bool = True
     #: Seconds between readinessProbe executions / before first check.
     probe_period_seconds: int = 10
     #: Host path for the persistent XLA compilation cache (empty = no
@@ -99,6 +101,7 @@ class ValidationPodSpec:
             matmul_size=self.matmul_size,
             run_flash_attention=self.run_flash_attention,
             run_seq_parallel_probes=self.run_seq_parallel_probes,
+            run_burnin=self.run_burnin,
         )
         return [
             "python", "-m", "k8s_operator_libs_tpu.tpu.health",

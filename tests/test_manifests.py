@@ -6,12 +6,19 @@ examples/apply-crds as crdutil's e2e driver (reference:
 examples/apply-crds/main.go:34-61).
 """
 
+import glob
 import os
+import re
+
+import yaml
 
 from k8s_operator_libs_tpu.crdutil import parse_crds_from_file, process_crds
 from k8s_operator_libs_tpu.kube import FakeCluster, NodeMaintenance
 
-MANIFESTS = os.path.join(os.path.dirname(__file__), "..", "manifests", "crds")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MANIFESTS_ROOT = os.path.join(REPO, "manifests")
+MANIFESTS = os.path.join(MANIFESTS_ROOT, "crds")
+DOCKERFILE = os.path.join(REPO, "docker", "Dockerfile")
 
 
 def test_manifests_apply_and_establish():
@@ -65,3 +72,189 @@ def test_nodemaintenance_fixture_delete_tolerates_absence():
     process_crds(cluster, [MANIFESTS], "apply")
     process_crds(cluster, [MANIFESTS], "delete")
     assert cluster.list("CustomResourceDefinition") == []
+
+
+# -- every shipped manifest parses and is internally consistent -----------
+
+
+def all_manifest_docs():
+    docs = []
+    for path in sorted(
+        glob.glob(os.path.join(MANIFESTS_ROOT, "**", "*.yaml"), recursive=True)
+    ):
+        with open(path) as fh:
+            for doc in yaml.safe_load_all(fh):
+                if doc is not None:
+                    docs.append((path, doc))
+    return docs
+
+
+def monitor_docs():
+    path = os.path.join(MANIFESTS_ROOT, "monitor-daemonset.yaml")
+    with open(path) as fh:
+        return {d["kind"]: d for d in yaml.safe_load_all(fh) if d}
+
+
+def test_every_manifest_yaml_parses_with_kind_and_name():
+    docs = all_manifest_docs()
+    assert len(docs) >= 6  # 2 CRDs + DaemonSet/SA/ClusterRole/Binding
+    for path, doc in docs:
+        assert doc.get("kind"), path
+        assert doc.get("apiVersion"), path
+        assert (doc.get("metadata") or {}).get("name"), path
+
+
+class TestMonitorDaemonSet:
+    """The round-3 manifest finally under test: schema shape, image
+    consistency with the code that schedules pods from this image, RBAC
+    coverage for every API call the monitor makes."""
+
+    def test_selector_matches_template_labels(self):
+        ds = monitor_docs()["DaemonSet"]
+        match = ds["spec"]["selector"]["matchLabels"]
+        labels = ds["spec"]["template"]["metadata"]["labels"]
+        assert match.items() <= labels.items()
+
+    def test_image_matches_validation_pod_spec_and_makefile(self):
+        from k8s_operator_libs_tpu.tpu.validation_pod import ValidationPodSpec
+
+        ds = monitor_docs()["DaemonSet"]
+        (container,) = ds["spec"]["template"]["spec"]["containers"]
+        spec = ValidationPodSpec()
+        assert container["image"] == spec.full_image
+        makefile = open(os.path.join(REPO, "Makefile")).read()
+        image_default = re.search(
+            r"^IMAGE \?= (\S+)$", makefile, re.MULTILINE
+        ).group(1)
+        assert image_default == spec.image
+
+    def test_command_is_the_monitor_module(self):
+        import importlib.util
+
+        ds = monitor_docs()["DaemonSet"]
+        (container,) = ds["spec"]["template"]["spec"]["containers"]
+        cmd = container["command"]
+        assert cmd[:3] == ["python", "-m", "k8s_operator_libs_tpu.tpu.monitor"]
+        assert importlib.util.find_spec(cmd[2]) is not None
+
+    def test_node_name_from_downward_api(self):
+        ds = monitor_docs()["DaemonSet"]
+        (container,) = ds["spec"]["template"]["spec"]["containers"]
+        env = {e["name"]: e for e in container["env"]}
+        assert (
+            env["NODE_NAME"]["valueFrom"]["fieldRef"]["fieldPath"]
+            == "spec.nodeName"
+        )
+
+    def test_compile_cache_env_matches_mount_and_constant(self):
+        from k8s_operator_libs_tpu.tpu.health import HEALTH_CACHE_DIR
+
+        ds = monitor_docs()["DaemonSet"]
+        pod = ds["spec"]["template"]["spec"]
+        (container,) = pod["containers"]
+        env = {e["name"]: e.get("value") for e in container["env"]}
+        assert env["JAX_COMPILATION_CACHE_DIR"] == HEALTH_CACHE_DIR
+        mounts = {m["name"]: m["mountPath"] for m in container["volumeMounts"]}
+        volumes = {v["name"]: v for v in pod["volumes"]}
+        assert mounts["jax-cache"] == HEALTH_CACHE_DIR
+        assert volumes["jax-cache"]["hostPath"]["path"] == HEALTH_CACHE_DIR
+
+    def test_targets_tpu_nodes_and_tolerates_taints(self):
+        from k8s_operator_libs_tpu.parallel.topology import (
+            GKE_TPU_ACCELERATOR_LABEL,
+        )
+        from k8s_operator_libs_tpu.tpu.libtpu import TPU_RESOURCE
+
+        ds = monitor_docs()["DaemonSet"]
+        pod = ds["spec"]["template"]["spec"]
+        terms = pod["affinity"]["nodeAffinity"][
+            "requiredDuringSchedulingIgnoredDuringExecution"
+        ]["nodeSelectorTerms"]
+        keys = {
+            expr["key"] for t in terms for expr in t["matchExpressions"]
+        }
+        assert GKE_TPU_ACCELERATOR_LABEL in keys
+        toleration_keys = {t.get("key") for t in pod["tolerations"]}
+        assert TPU_RESOURCE in toleration_keys
+
+    def test_rbac_covers_every_monitor_api_call(self):
+        """TpuHealthMonitor calls: get node, list pods (busy-chip check),
+        update node status, create events (tpu/monitor.py). The shipped
+        ClusterRole must grant each; the binding must wire the
+        DaemonSet's ServiceAccount to it."""
+        docs = monitor_docs()
+        rules = docs["ClusterRole"]["rules"]
+
+        def allows(resource, verb):
+            return any(
+                resource in r.get("resources", ())
+                and verb in r.get("verbs", ())
+                for r in rules
+            )
+
+        assert allows("nodes", "get")
+        assert allows("nodes/status", "update")
+        assert allows("pods", "list")
+        assert allows("events", "create")
+        binding = docs["ClusterRoleBinding"]
+        assert binding["roleRef"]["name"] == docs["ClusterRole"]["metadata"]["name"]
+        (subject,) = binding["subjects"]
+        sa = docs["ServiceAccount"]
+        assert subject["kind"] == "ServiceAccount"
+        assert subject["name"] == sa["metadata"]["name"]
+        assert subject["namespace"] == sa["metadata"]["namespace"]
+        ds = docs["DaemonSet"]
+        assert (
+            ds["spec"]["template"]["spec"]["serviceAccountName"]
+            == sa["metadata"]["name"]
+        )
+
+
+class TestDockerfile:
+    """`make image` produces the image the framework's pod shapes name;
+    no container runtime exists in CI, so the build file is validated
+    structurally: every COPY source exists, the payload modules resolve,
+    and the cache path matches the code constant."""
+
+    def test_copy_sources_exist(self):
+        content = open(DOCKERFILE).read()
+        copies = re.findall(r"^COPY\s+(.+)$", content, re.MULTILINE)
+        assert copies
+        for line in copies:
+            sources = line.split()[:-1]  # last token is the destination
+            for src in sources:
+                assert os.path.exists(os.path.join(REPO, src)), src
+
+    def test_cmd_module_resolves(self):
+        import importlib.util
+        import json
+
+        content = open(DOCKERFILE).read()
+        cmd = json.loads(
+            re.search(r"^CMD\s+(\[.*\])$", content, re.MULTILINE).group(1)
+        )
+        assert cmd[:2] == ["python", "-m"]
+        assert importlib.util.find_spec(cmd[2]) is not None
+
+    def test_cache_dir_matches_health_constant(self):
+        from k8s_operator_libs_tpu.tpu.health import HEALTH_CACHE_DIR
+
+        content = open(DOCKERFILE).read()
+        assert f"JAX_COMPILATION_CACHE_DIR={HEALTH_CACHE_DIR}" in content
+        assert f"mkdir -p {HEALTH_CACHE_DIR}" in content
+
+    def test_make_image_target_builds_this_dockerfile(self):
+        makefile = open(os.path.join(REPO, "Makefile")).read()
+        assert re.search(r"^image:", makefile, re.MULTILINE)
+        assert "docker/Dockerfile" in makefile
+
+    def test_pinned_jax_matches_environment(self):
+        """The image pins the jax the floors were calibrated against —
+        which is the jax this repo runs everywhere else."""
+        import jax
+
+        content = open(DOCKERFILE).read()
+        pinned = re.search(
+            r"^ARG JAX_VERSION=(\S+)$", content, re.MULTILINE
+        ).group(1)
+        assert pinned == jax.__version__

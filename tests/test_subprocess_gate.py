@@ -17,7 +17,6 @@ tests exercise the real subprocess mechanics without paying a JAX start.
 import dataclasses
 import json
 import os
-import sys
 import time
 
 from k8s_operator_libs_tpu.ops.collectives import CollectiveReport
