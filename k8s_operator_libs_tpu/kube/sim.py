@@ -33,6 +33,12 @@ class DaemonSetSimulator:
     * ``step`` models one controller+kubelet tick: every node gets a pod at
       the latest revision if missing, and fresh pods become Ready after
       ``readiness_steps`` ticks (0 = immediately).
+    * ``safe_load_annotation`` arms the SAFE-LOAD handshake (the other
+      process of docs/automatic-ofed-upgrade.md:43-66; TPU shape:
+      tpu/libtpu.py safe-load-gate): every new pod's init container first
+      SETS that annotation on its node and blocks — the pod stays NotReady
+      — until the upgrade library's ``unblock_loading`` removes the
+      annotation; only then does the driver load and the pod go Ready.
     """
 
     def __init__(
@@ -43,10 +49,14 @@ class DaemonSetSimulator:
         match_labels: Optional[dict[str, str]] = None,
         readiness_steps: int = 0,
         initial_hash: str = "rev-1",
+        safe_load_annotation: str = "",
     ) -> None:
         self.cluster = cluster
         self.namespace = namespace
         self.readiness_steps = readiness_steps
+        self.safe_load_annotation = safe_load_annotation
+        #: pod name -> node name, pods whose init container is blocked.
+        self._safe_blocked: dict[str, str] = {}
         self._pending_ready: dict[str, int] = {}
         self._revision = 0
         ds = DaemonSet.new(name, namespace=namespace)
@@ -80,7 +90,12 @@ class DaemonSetSimulator:
         for node in nodes:
             desired += 1
             self._ensure_pod(node.name)
+        # Readiness BEFORE safe-load: an unblocked init container's driver
+        # load must take its >=1 tick for real (the readiness counter it
+        # arms below is first decremented on the NEXT tick), so observers
+        # can see the init-done/driver-loading window.
         self._advance_readiness()
+        self._advance_safe_load()
         self.cluster.patch(
             "DaemonSet",
             self.ds.name,
@@ -107,7 +122,28 @@ class DaemonSetSimulator:
         pod.labels.update(self.ds.match_labels)
         pod.labels["controller-revision-hash"] = self.current_hash
         pod.add_owner_reference(self.ds)
-        if self.readiness_steps == 0:
+        if self.safe_load_annotation:
+            # Init container, step one of the handshake: annotate the node
+            # and block. The pod is Pending/NotReady until unblocked.
+            self.cluster.patch(
+                "Node",
+                node_name,
+                patch={
+                    "metadata": {
+                        "annotations": {self.safe_load_annotation: "true"}
+                    }
+                },
+            )
+            pod.phase = "Pending"
+            pod.status["conditions"] = [{"type": "Ready", "status": "False"}]
+            pod.status["initContainerStatuses"] = [
+                {"name": "safe-load-gate", "ready": False, "restartCount": 0}
+            ]
+            pod.status["containerStatuses"] = [
+                {"name": "driver", "ready": False, "restartCount": 0}
+            ]
+            self._safe_blocked[name] = node_name
+        elif self.readiness_steps == 0:
             self._make_ready(pod)
         else:
             pod.phase = "Pending"
@@ -121,6 +157,46 @@ class DaemonSetSimulator:
         pod.status["containerStatuses"] = [
             {"name": "driver", "ready": True, "restartCount": 0}
         ]
+
+    def _advance_safe_load(self) -> None:
+        """Step two of the handshake: a blocked init container polls its
+        node's annotation; once the upgrade library removed it
+        (unblock_loading), the init completes, the driver loads, and the
+        pod proceeds to readiness on the next tick(s)."""
+        for pod_name in list(self._safe_blocked):
+            node_name = self._safe_blocked[pod_name]
+            try:
+                node = self.cluster.get("Node", node_name)
+            except NotFoundError:
+                del self._safe_blocked[pod_name]
+                continue
+            annotations = (node.raw.get("metadata") or {}).get(
+                "annotations"
+            ) or {}
+            if self.safe_load_annotation in annotations:
+                continue  # still blocked
+            del self._safe_blocked[pod_name]
+            try:
+                self.cluster.patch(
+                    "Pod",
+                    pod_name,
+                    self.namespace,
+                    patch={
+                        "status": {
+                            "initContainerStatuses": [
+                                {
+                                    "name": "safe-load-gate",
+                                    "ready": True,
+                                    "restartCount": 0,
+                                }
+                            ]
+                        }
+                    },
+                )
+            except NotFoundError:
+                continue
+            # Driver load takes at least one tick (readiness_steps floor 1).
+            self._pending_ready[pod_name] = max(1, self.readiness_steps)
 
     def _advance_readiness(self) -> None:
         for name in list(self._pending_ready):

@@ -1,18 +1,26 @@
-"""Patch-semantics conformance (VERDICT round-1 item 8).
+"""Wire-protocol conformance battery (VERDICT round-1 item 8, extended
+round 4 to the FULL Client protocol).
 
-The reference writes the node state label with a *strategic* merge patch
-(node_upgrade_state_provider.go:80-82) and annotations with an RFC 7386
-merge patch (:147-150). This suite (a) exercises the fake's strategic
-merge implementation directly, (b) pins the equivalence of the two patch
-types for every label/annotation write shape the state provider emits,
-and (c) runs the same battery over the wire (RestClient + LocalApiServer
-with the strategic content type), so the conformance claims hold on the
-HTTP path too. Set ``KUBE_CONFORMANCE_KUBECONFIG`` to additionally run
-the wire battery against a real apiserver (e.g. kind).
+The reference certifies its client behavior against a genuine
+kube-apiserver (upgrade_suit_test.go:87-93). This repo's substitute is a
+single battery covering every protocol surface the upgrade library uses —
+patch semantics (strategic + merge, null-deletion), watch streaming,
+resume-from-resourceVersion with no lost events, 410 expiry, eviction,
+and finalizer-gated deletion — run three ways:
+
+(a) against the fake directly (strategic-merge unit tests),
+(b) over HTTP against LocalApiServer (the repo's own oracle), and
+(c) against a REAL apiserver the moment one is available: set
+    ``KUBE_CONFORMANCE_KUBECONFIG`` (e.g. a kind cluster) and the same
+    battery certifies the whole protocol for real. Until that has been
+    run, the kube layer is UNPROVEN against a real apiserver — see
+    README "Conformance status".
 """
 
 import copy
 import os
+import threading
+import time
 
 import pytest
 
@@ -22,6 +30,8 @@ from k8s_operator_libs_tpu.kube import (
     RestClient,
     RestConfig,
 )
+from k8s_operator_libs_tpu.kube.client import NotFoundError, WatchExpiredError
+from builders import make_pod
 from k8s_operator_libs_tpu.kube.fake import merge_patch, strategic_merge_patch
 from k8s_operator_libs_tpu.upgrade import (
     DeviceClass,
@@ -187,20 +197,245 @@ def _wire_battery(client):
         client.delete("Node", node.name)
 
 
+#: Unique label scoping battery objects: a real cluster has system noise
+#: (other Nodes, system Pods); everything the battery watches/lists is
+#: filtered to objects it created itself.
+_BATTERY_LABEL = {"tpu-operator-conformance": "battery"}
+_BATTERY_SELECTOR = "tpu-operator-conformance=battery"
+
+
+def _cleanup(client, kind, name, namespace=""):
+    try:
+        client.delete(kind, name, namespace)
+    except NotFoundError:
+        pass
+
+
+def _watch_battery(client):
+    """Streaming, ordered delivery, and resume-from-revision with no
+    lost-event window (the informer's contract, kube/informer.py)."""
+    node = make_node("conf-watch-node", labels=dict(_BATTERY_LABEL))
+    try:
+        client.create(node)
+        # Two writes; remember the revision between them.
+        client.patch(
+            "Node", node.name, patch={"metadata": {"labels": {"step": "one"}}}
+        )
+        rv_between = client.get("Node", node.name).resource_version
+        client.patch(
+            "Node", node.name, patch={"metadata": {"labels": {"step": "two"}}}
+        )
+        # Resuming from rv_between must deliver only events NEWER than it
+        # (no replay of history already reflected at that revision), and
+        # the step=two write must be among them. Third-party writes to the
+        # node (a real cluster's controllers) may interleave — assert on
+        # revision ordering, not on an exact event list.
+        steps = []
+        for etype, obj in client.watch(
+            "Node",
+            label_selector=_BATTERY_SELECTOR,
+            resource_version=rv_between,
+            timeout_seconds=10,
+        ):
+            if obj.name != node.name:
+                continue
+            steps.append((etype, obj.labels.get("step"), obj.resource_version))
+            if obj.labels.get("step") == "two":
+                break
+        assert steps and steps[-1][:2] == ("MODIFIED", "two"), steps
+        assert all(
+            int(rv) > int(rv_between) for _, _, rv in steps if str(rv).isdigit()
+        ), steps
+        # Live streaming: a concurrent delete arrives as DELETED.
+        rv_now = client.get("Node", node.name).resource_version
+        deleter = threading.Timer(
+            0.3, lambda: _cleanup(client, "Node", node.name)
+        )
+        deleter.start()
+        try:
+            got_delete = False
+            for etype, obj in client.watch(
+                "Node",
+                label_selector=_BATTERY_SELECTOR,
+                resource_version=rv_now,
+                timeout_seconds=15,
+            ):
+                if obj.name == node.name and etype == "DELETED":
+                    got_delete = True
+                    break
+            assert got_delete, "DELETED event never arrived on the stream"
+        finally:
+            deleter.join()
+    finally:
+        _cleanup(client, "Node", node.name)
+
+
+def _watch_expired_battery(client, strict, churn=None):
+    """Resuming from a revision that churned out of the server's journal
+    must be refused with 410 Gone, forcing a re-list (reference consumers
+    rely on this via controller-runtime; here: WatchExpiredError).
+
+    ``strict`` (LocalApiServer): ``churn()`` floods the server with more
+    writes than its bounded journal holds, so the revision remembered
+    before the flood is PROVABLY compacted away — the exact "client
+    listed long ago, resumes after heavy churn" scenario. A real
+    apiserver only compacts on its own ~5 min cadence, so there the
+    probe asks for rv=1 and accepts either outcome, recording which ran.
+    """
+    node = make_node("conf-expired-node", labels=dict(_BATTERY_LABEL))
+    try:
+        created = client.create(node)
+        if strict:
+            churn()
+            with pytest.raises(WatchExpiredError):
+                for _ in client.watch(
+                    "Node",
+                    label_selector=_BATTERY_SELECTOR,
+                    resource_version=created.resource_version,
+                    timeout_seconds=5,
+                ):
+                    pass
+            return "410"
+        try:
+            for _ in client.watch(
+                "Node",
+                label_selector=_BATTERY_SELECTOR,
+                resource_version="1",
+                timeout_seconds=3,
+            ):
+                break
+            return "journal-still-served-rv1"
+        except WatchExpiredError:
+            return "410"
+    finally:
+        _cleanup(client, "Node", node.name)
+
+
+def _eviction_battery(client, namespace):
+    """The drain path's primitive: POST pods/<name>/eviction either
+    removes the pod or marks it terminating (graceful deletion on a real
+    cluster whose kubelet owns the final delete)."""
+    pod = make_pod(
+        "conf-evict-pod", node_name="conf-ghost-node", namespace=namespace
+    )
+    pod.labels.update(_BATTERY_LABEL)
+    # A real apiserver requires spec.containers (the fake tolerates its
+    # absence); pause never actually runs — the node doesn't exist.
+    pod.spec["containers"] = [
+        {"name": "sleeper", "image": "registry.k8s.io/pause:3.9"}
+    ]
+    try:
+        client.create(pod)
+        client.evict("conf-evict-pod", namespace)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            obj = client.get_or_none("Pod", "conf-evict-pod", namespace)
+            if obj is None:
+                return  # evicted and reaped
+            meta = obj.raw.get("metadata") or {}
+            if meta.get("deletionTimestamp"):
+                return  # terminating: kubelet owns the rest
+            time.sleep(0.2)
+        raise AssertionError("eviction neither deleted nor marked the pod")
+    finally:
+        _cleanup(client, "Pod", "conf-evict-pod", namespace)
+
+
+def _finalizer_battery(client):
+    """Deletion is gated on finalizers exactly like the real apiserver:
+    delete marks deletionTimestamp, the object lingers, clearing the
+    finalizer completes the delete (the requestor-mode CR lifecycle
+    depends on this, kube/sim.py MaintenanceOperatorSimulator)."""
+    node = make_node("conf-fin-node", labels=dict(_BATTERY_LABEL))
+    node.raw["metadata"]["finalizers"] = ["tpu-operator.dev/conformance"]
+    try:
+        client.create(node)
+        _cleanup(client, "Node", node.name)  # delete: should linger
+        obj = client.get_or_none("Node", node.name)
+        assert obj is not None, "finalizer did not gate deletion"
+        assert (obj.raw["metadata"].get("deletionTimestamp")), (
+            "lingering object has no deletionTimestamp"
+        )
+        client.patch(
+            "Node",
+            node.name,
+            patch={"metadata": {"finalizers": None}},
+            patch_type="merge",
+        )
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if client.get_or_none("Node", node.name) is None:
+                return
+            time.sleep(0.2)
+        raise AssertionError("object survived finalizer removal")
+    finally:
+        # Clear the finalizer BEFORE the delete: a mid-battery failure
+        # must not strand a terminating Node (with our finalizer) on a
+        # real cluster, poisoning every later certification run.
+        if client.get_or_none("Node", node.name) is not None:
+            try:
+                client.patch(
+                    "Node",
+                    node.name,
+                    patch={"metadata": {"finalizers": None}},
+                    patch_type="merge",
+                )
+            except NotFoundError:
+                pass
+        _cleanup(client, "Node", node.name)
+
+
+def _full_protocol_battery(client, strict, namespace, churn=None):
+    _wire_battery(client)
+    _watch_battery(client)
+    outcome = _watch_expired_battery(client, strict=strict, churn=churn)
+    _eviction_battery(client, namespace)
+    _finalizer_battery(client)
+    return outcome
+
+
 class TestWireConformance:
-    def test_local_apiserver_strategic_content_type(self):
+    def test_local_apiserver_full_protocol(self):
         with LocalApiServer() as srv:
-            _wire_battery(RestClient(RestConfig(server=srv.url)))
+
+            def churn(n=4200):  # journal deque holds 4096 (fake.py)
+                seed = make_node("conf-churn-node")
+                srv.cluster.create(seed)
+                for i in range(n):
+                    srv.cluster.patch(
+                        "Node",
+                        seed.name,
+                        patch={"metadata": {"labels": {"i": str(i)}}},
+                    )
+                srv.cluster.delete("Node", seed.name)
+
+            outcome = _full_protocol_battery(
+                RestClient(RestConfig(server=srv.url)),
+                strict=True,
+                namespace="default",
+                churn=churn,
+            )
+            assert outcome == "410"
 
     @pytest.mark.skipif(
         not os.environ.get("KUBE_CONFORMANCE_KUBECONFIG"),
         reason="set KUBE_CONFORMANCE_KUBECONFIG to run against a real apiserver",
     )
-    def test_real_apiserver(self):
+    def test_real_apiserver_full_protocol(self):
+        """THE certification run: point KUBE_CONFORMANCE_KUBECONFIG at a
+        real cluster (kind suffices) and the entire Client protocol the
+        upgrade library uses is exercised against it in one command:
+
+            KUBE_CONFORMANCE_KUBECONFIG=~/.kube/config \\
+                python -m pytest tests/test_patch_semantics.py -k real
+        """
         cfg = RestConfig.from_kubeconfig(
             os.environ["KUBE_CONFORMANCE_KUBECONFIG"]
         )
-        _wire_battery(RestClient(cfg))
+        outcome = _full_protocol_battery(
+            RestClient(cfg), strict=False, namespace="default"
+        )
+        print(f"real-apiserver 410 probe outcome: {outcome}")
 
 
 class TestCachedClientForwardsPatchType:

@@ -1,0 +1,182 @@
+"""Safe-driver-load handshake, cross-process, end to end.
+
+The full two-party protocol of the reference's safe-load feature
+(docs/automatic-ofed-upgrade.md:43-66, safe_driver_load_manager.go:29-79),
+with BOTH parties real: the driver pod's init container is played by
+``DaemonSetSimulator(safe_load_annotation=...)`` — it sets the wait
+annotation on the node and holds the pod NotReady until the annotation is
+gone — and the upgrade library runs its normal idempotent passes against
+the same apiserver. Nothing flips any state by hand:
+
+    init container annotates node + blocks (pod NotReady)
+      → library: upgrade-required → cordon → wait-for-jobs → drain
+      → library: unblock_loading removes the annotation
+      → init container completes → driver loads → pod Ready
+      → library: uncordon-required → upgrade-done
+"""
+
+from k8s_operator_libs_tpu.api import DrainSpec, DriverUpgradePolicySpec
+from k8s_operator_libs_tpu.kube import FakeCluster, Node, Pod
+from k8s_operator_libs_tpu.kube.sim import DaemonSetSimulator
+from k8s_operator_libs_tpu.upgrade import (
+    ClusterUpgradeStateManager,
+    DeviceClass,
+    TaskRunner,
+    UpgradeKeys,
+)
+from k8s_operator_libs_tpu.utils import IntOrString
+from builders import make_pod
+
+DEVICE = DeviceClass.tpu()
+KEYS = UpgradeKeys(DEVICE)
+NS = "kube-system"
+DS_LABELS = {"app": "libtpu-installer"}
+
+POLICY = DriverUpgradePolicySpec(
+    auto_upgrade=True,
+    max_parallel_upgrades=0,
+    max_unavailable=IntOrString("100%"),
+    drain=DrainSpec(enable=True, force=True),
+)
+
+
+def make_pool(n=2):
+    cluster = FakeCluster()
+    for i in range(n):
+        node = Node.new(f"sl-{i}")
+        node.set_ready(True)
+        cluster.create(node)
+    return cluster
+
+
+def drive(cluster, sim, mgr, max_passes=30):
+    """Run library passes + kubelet ticks until convergence; record the
+    handshake observables (annotation set/cleared, cordon window) per
+    node along the way."""
+    seen = {
+        "annotated": set(),
+        "cordoned_while_annotated": set(),
+        "uncordoned_after": set(),
+    }
+    for i in range(max_passes):
+        sim.step()
+        state = mgr.build_state(NS, DS_LABELS)
+        mgr.apply_state(state, POLICY)
+        sim.step()
+        for obj in cluster.list("Node"):
+            node = Node(obj.raw)
+            waiting = bool(
+                node.annotations.get(KEYS.safe_driver_load_annotation)
+            )
+            if waiting:
+                seen["annotated"].add(node.name)
+                if node.unschedulable:
+                    seen["cordoned_while_annotated"].add(node.name)
+            if (
+                node.name in seen["annotated"]
+                and not waiting
+                and not node.unschedulable
+            ):
+                seen["uncordoned_after"].add(node.name)
+        done = all(
+            n.labels.get(KEYS.state_label) == "upgrade-done"
+            for n in cluster.list("Node")
+        )
+        if done and sim.all_pods_ready_and_current():
+            return i + 1, seen
+    raise AssertionError("safe-load flow did not converge")
+
+
+class TestStartupSafeLoad:
+    """The doc's primary scenario: first containerized-driver rollout onto
+    nodes that may be running workloads (inbox → containerized)."""
+
+    def test_full_handshake_drains_then_unblocks_then_uncordons(self):
+        cluster = make_pool(n=2)
+        # A workload riding on sl-0: safe load exists so THIS pod is
+        # rescheduled before the driver swaps out from under it.
+        cluster.create(make_pod("workload", node_name="sl-0", namespace="default"))
+        sim = DaemonSetSimulator(
+            cluster,
+            name="libtpu-installer",
+            namespace=NS,
+            match_labels=DS_LABELS,
+            initial_hash="v1",
+            safe_load_annotation=KEYS.safe_driver_load_annotation,
+        )
+        mgr = ClusterUpgradeStateManager(
+            cluster, DEVICE, runner=TaskRunner(inline=True)
+        )
+        passes, seen = drive(cluster, sim, mgr)
+        # Every node went through the whole handshake: annotated by the
+        # init container, cordoned while blocked, uncordoned after.
+        assert seen["annotated"] == {"sl-0", "sl-1"}
+        assert seen["cordoned_while_annotated"] == {"sl-0", "sl-1"}
+        assert seen["uncordoned_after"] == {"sl-0", "sl-1"}
+        # The handshake's point: the workload was drained off sl-0 before
+        # the driver loaded.
+        assert cluster.get_or_none("Pod", "workload", "default") is None
+        # Terminal state is clean: no annotation, no cordon, pods Ready.
+        for obj in cluster.list("Node"):
+            node = Node(obj.raw)
+            assert KEYS.safe_driver_load_annotation not in node.annotations
+            assert not node.unschedulable
+            assert node.labels.get(KEYS.state_label) == "upgrade-done"
+        assert sim.all_pods_ready_and_current()
+
+    def test_driver_pod_is_unblocked_not_restarted(self):
+        """Safe load must RELEASE the blocked pod, never delete it — the
+        reference replaces pod restart with annotation removal
+        (common_manager.go:476-481)."""
+        cluster = make_pool(n=1)
+        sim = DaemonSetSimulator(
+            cluster,
+            name="libtpu-installer",
+            namespace=NS,
+            match_labels=DS_LABELS,
+            initial_hash="v1",
+            safe_load_annotation=KEYS.safe_driver_load_annotation,
+        )
+        sim.step()
+        uid_before = Pod(
+            cluster.get("Pod", sim.pod_name("sl-0"), NS).raw
+        ).raw["metadata"]["uid"]
+        mgr = ClusterUpgradeStateManager(
+            cluster, DEVICE, runner=TaskRunner(inline=True)
+        )
+        drive(cluster, sim, mgr)
+        uid_after = Pod(
+            cluster.get("Pod", sim.pod_name("sl-0"), NS).raw
+        ).raw["metadata"]["uid"]
+        assert uid_before == uid_after
+
+
+class TestRolloutSafeLoad:
+    """Safe load during a NORMAL rolling upgrade: the restarted driver pod
+    at the new revision blocks on its init container; the library
+    unblocks it at pod-restart-required instead of deleting it again."""
+
+    def test_roll_with_safe_load_converges(self):
+        cluster = make_pool(n=2)
+        sim = DaemonSetSimulator(
+            cluster,
+            name="libtpu-installer",
+            namespace=NS,
+            match_labels=DS_LABELS,
+            initial_hash="v1",
+        )
+        sim.settle()
+        # Arm the handshake for pods created from now on (the v2 pods).
+        sim.safe_load_annotation = KEYS.safe_driver_load_annotation
+        mgr = ClusterUpgradeStateManager(
+            cluster, DEVICE, runner=TaskRunner(inline=True)
+        )
+        sim.set_template_hash("v2")
+        passes, seen = drive(cluster, sim, mgr)
+        assert seen["annotated"] == {"sl-0", "sl-1"}
+        assert seen["uncordoned_after"] == {"sl-0", "sl-1"}
+        assert sim.all_pods_ready_and_current()
+        for obj in cluster.list("Node"):
+            node = Node(obj.raw)
+            assert KEYS.safe_driver_load_annotation not in node.annotations
+            assert node.labels.get(KEYS.state_label) == "upgrade-done"
