@@ -28,16 +28,172 @@ the pipeline per pass.
 
 from __future__ import annotations
 
+from dataclasses import dataclass, field
 from typing import Optional
 
 from ..api.upgrade_v1alpha1 import DriverUpgradePolicySpec
 from ..utils.log import get_logger
 from ..upgrade.common_manager import ClusterUpgradeState, NodeUpgradeState
-from ..upgrade.consts import UpgradeState
+from ..upgrade.consts import TRUE_STRING, UpgradeState
 from ..upgrade.inplace import InplaceNodeStateManager
+from ..upgrade.requestor import RequestorNodeStateManager
 from .detector import TpuNodeDetector
 
 log = get_logger("tpu.planner")
+
+
+def _node_ici_unhealthy(ns: NodeUpgradeState) -> bool:
+    """The continuous monitor (tpu/monitor.py) reports a dead link.
+
+    A *soft* disruption signal: the slice is prioritized (rolled — and
+    so re-validated, the repair path — before healthy slices) but it
+    still CONSUMES a budget slot. Exempting it like hard-cordoned
+    slices would let a correlated monitor false positive (one
+    miscalibrated floor across the fleet) cordon every flagged slice
+    in a single pass, unbounded by maxUnavailable."""
+    from ..kube.objects import condition_status
+    from .monitor import ICI_HEALTHY_CONDITION
+
+    return condition_status(ns.node.status, ICI_HEALTHY_CONDITION) == "False"
+
+
+@dataclass
+class SliceAssessment:
+    """One pass's slice-level view of the cluster — the shared accounting
+    both slice-aware strategies (in-place and requestor) plan from."""
+
+    total_slices: int = 0
+    #: Hard-disrupted: any member cordoned/NotReady OR already in the
+    #: upgrade pipeline (cordon-required onward). A slice whose nodes have
+    #: entered the pipeline is disrupted even before the cordon lands —
+    #: the base manager counts CORDON_REQUIRED nodes as unavailable for
+    #: exactly this reason (common_manager.go:762-764); dropping that
+    #: would let consecutive passes start a new slice while the previous
+    #: one is still between the label write and the cordon.
+    disrupted: set[str] = field(default_factory=set)
+    in_progress: set[str] = field(default_factory=set)
+    #: Monitor-flagged (TpuIciHealthy=False on any member).
+    wounded: set[str] = field(default_factory=set)
+    #: slice -> its upgrade-required members.
+    candidates: dict[str, list[NodeUpgradeState]] = field(default_factory=dict)
+
+    def budget(self, policy: DriverUpgradePolicySpec) -> tuple[int, int]:
+        """Upgrade-start slots in SLICE units (shape parity with
+        GetUpgradesAvailable, common_manager.go:748-776). Returns
+        ``(available, resolved_max_unavailable)`` — the resolved cap is
+        runtime information (percent policies scale against the pool) the
+        planner log must carry for slots=0 debugging."""
+        max_unavailable = policy.resolved_max_unavailable(self.total_slices)
+        if policy.max_parallel_upgrades == 0:
+            available = len(self.candidates)
+        else:
+            available = policy.max_parallel_upgrades - len(self.in_progress)
+        if available > max_unavailable:
+            available = max_unavailable
+        currently_unavailable = len(self.disrupted)
+        if currently_unavailable >= max_unavailable:
+            available = 0
+        elif (
+            max_unavailable < self.total_slices
+            and currently_unavailable + available > max_unavailable
+        ):
+            available = max_unavailable - currently_unavailable
+        return available, max_unavailable
+
+    def ordered_candidates(self):
+        """Already-disrupted slices first (their collective is down
+        anyway), then monitor-flagged wounded slices (the repair path —
+        rolling re-validates them), then the rest by name."""
+        return sorted(
+            self.candidates.items(),
+            key=lambda item: (
+                item[0] not in self.disrupted,
+                item[0] not in self.wounded,
+                item[0],
+            ),
+        )
+
+
+def assess_slices(
+    detector: TpuNodeDetector, state: ClusterUpgradeState
+) -> SliceAssessment:
+    def slice_of(node) -> str:
+        info = detector.detect(node)
+        return info.slice_id if info is not None else node.name
+
+    out = SliceAssessment()
+    slices: dict[str, list[tuple[UpgradeState, NodeUpgradeState]]] = {}
+    for bucket, node_states in state.node_states.items():
+        for ns in node_states:
+            slices.setdefault(slice_of(ns.node), []).append((bucket, ns))
+    out.total_slices = len(slices)
+    for slice_id, members in slices.items():
+        for bucket, ns in members:
+            if ns.node.unschedulable or not ns.node.is_ready():
+                out.disrupted.add(slice_id)
+            if _node_ici_unhealthy(ns):
+                out.wounded.add(slice_id)
+            if bucket not in (
+                UpgradeState.UNKNOWN,
+                UpgradeState.DONE,
+                UpgradeState.UPGRADE_REQUIRED,
+            ):
+                out.in_progress.add(slice_id)
+                out.disrupted.add(slice_id)
+            if bucket == UpgradeState.UPGRADE_REQUIRED:
+                out.candidates.setdefault(slice_id, []).append(ns)
+    return out
+
+
+def start_slices_within_budget(
+    common,
+    detector: TpuNodeDetector,
+    state: ClusterUpgradeState,
+    policy: DriverUpgradePolicySpec,
+    start_slice,
+    log_label: str,
+) -> None:
+    """The ONE slice-selection walk both slice-aware strategies share:
+    assess → budget (slice units) → wounded/disrupted-first ordering →
+    per-node skip/requested bookkeeping → whole-slice starts, with
+    already-disrupted slices exempt from the budget. ``start_slice(ns)``
+    is the per-node start action (cordon-required label for in-place, CR
+    creation + maintenance-required for requestor)."""
+    assessment = assess_slices(detector, state)
+    available, max_unavailable = assessment.budget(policy)
+    log.info(
+        "%s: slices=%d in_progress=%d disrupted=%d max_unavailable=%d "
+        "slots=%d",
+        log_label, assessment.total_slices, len(assessment.in_progress),
+        len(assessment.disrupted), max_unavailable, available,
+    )
+    for slice_id, members in assessment.ordered_candidates():
+        # Per-node bookkeeping shared with the base planners.
+        startable: list[NodeUpgradeState] = []
+        for ns in members:
+            if common.is_upgrade_requested(ns.node):
+                common.provider.change_node_upgrade_annotation(
+                    ns.node, common.keys.upgrade_requested_annotation, "null"
+                )
+            if common.skip_node_upgrade(ns.node):
+                log.info("node %s is marked to skip upgrades", ns.node.name)
+                continue
+            startable.append(ns)
+        if not startable:
+            continue
+        already_disrupted = slice_id in assessment.disrupted
+        if available <= 0 and not already_disrupted:
+            continue
+        # Start the WHOLE slice: one disruption window per slice.
+        for ns in startable:
+            start_slice(ns)
+        log.info(
+            "%s: slice %s started %d node(s)%s",
+            log_label, slice_id, len(startable),
+            " (already disrupted)" if already_disrupted else "",
+        )
+        if not already_disrupted:
+            available -= 1
 
 
 class SliceAwareInplaceManager(InplaceNodeStateManager):
@@ -45,40 +201,40 @@ class SliceAwareInplaceManager(InplaceNodeStateManager):
         super().__init__(common)
         self.detector = detector or TpuNodeDetector()
 
-    # -- slice accounting --------------------------------------------------
-    def _slice_of(self, node) -> str:
-        info = self.detector.detect(node)
-        return info.slice_id if info is not None else node.name
+    def process_upgrade_required_nodes(
+        self,
+        state: ClusterUpgradeState,
+        policy: DriverUpgradePolicySpec,
+    ) -> None:
+        common = self.common
 
-    def _slice_states(
-        self, state: ClusterUpgradeState
-    ) -> dict[str, list[tuple[UpgradeState, NodeUpgradeState]]]:
-        out: dict[str, list[tuple[UpgradeState, NodeUpgradeState]]] = {}
-        for bucket, node_states in state.node_states.items():
-            for ns in node_states:
-                out.setdefault(self._slice_of(ns.node), []).append((bucket, ns))
-        return out
+        def start(ns: NodeUpgradeState) -> None:
+            common.provider.change_node_upgrade_state(
+                ns.node, UpgradeState.CORDON_REQUIRED
+            )
 
-    @staticmethod
-    def _node_unavailable(ns: NodeUpgradeState) -> bool:
-        return ns.node.unschedulable or not ns.node.is_ready()
-
-    @staticmethod
-    def _node_ici_unhealthy(ns: NodeUpgradeState) -> bool:
-        """The continuous monitor (tpu/monitor.py) reports a dead link.
-
-        A *soft* disruption signal: the slice is prioritized (rolled — and
-        so re-validated, the repair path — before healthy slices) but it
-        still CONSUMES a budget slot. Exempting it like hard-cordoned
-        slices would let a correlated monitor false positive (one
-        miscalibrated floor across the fleet) cordon every flagged slice
-        in a single pass, unbounded by maxUnavailable."""
-        from ..kube.objects import condition_status
-        from .monitor import ICI_HEALTHY_CONDITION
-
-        return (
-            condition_status(ns.node.status, ICI_HEALTHY_CONDITION) == "False"
+        start_slices_within_budget(
+            common, self.detector, state, policy, start, "slice planner"
         )
+
+
+class SliceAwareRequestorManager(RequestorNodeStateManager):
+    """Requestor mode with CR creation aligned to slice boundaries.
+
+    The base requestor creates a NodeMaintenance CR for EVERY
+    upgrade-required node at once (reference parity:
+    upgrade_requestor.go:277-319 — the external operator owns throttling
+    there). On a TPU pool that throttling is wrong-shaped twice over: the
+    maintenance operator counts nodes, not slices, and nothing makes a
+    slice's CRs land together. This planner applies the same slice budget
+    as :class:`SliceAwareInplaceManager` — wounded/disrupted slices
+    first, whole slices at a time — so the CRs the external operator sees
+    arrive in slice-aligned batches and the per-slice disruption-window
+    guarantee survives mode delegation."""
+
+    def __init__(self, client, common, opts, detector=None) -> None:
+        super().__init__(client, common, opts)
+        self.detector = detector or TpuNodeDetector()
 
     def process_upgrade_required_nodes(
         self,
@@ -86,106 +242,47 @@ class SliceAwareInplaceManager(InplaceNodeStateManager):
         policy: DriverUpgradePolicySpec,
     ) -> None:
         common = self.common
-        slices = self._slice_states(state)
-        total_slices = len(slices)
-        max_unavailable = policy.resolved_max_unavailable(total_slices)
 
-        unavailable_slices = set()
-        in_progress_slices = set()
-        wounded_slices = set()
-        candidate_nodes: dict[str, list[NodeUpgradeState]] = {}
-        for slice_id, members in slices.items():
-            for bucket, ns in members:
-                if self._node_unavailable(ns):
-                    unavailable_slices.add(slice_id)
-                if self._node_ici_unhealthy(ns):
-                    wounded_slices.add(slice_id)
-                if bucket not in (
-                    UpgradeState.UNKNOWN,
-                    UpgradeState.DONE,
-                    UpgradeState.UPGRADE_REQUIRED,
-                ):
-                    in_progress_slices.add(slice_id)
-                if bucket == UpgradeState.UPGRADE_REQUIRED:
-                    candidate_nodes.setdefault(slice_id, []).append(ns)
-
-        # A slice whose nodes have entered the pipeline (cordon-required
-        # onward) is disrupted even before the cordon lands — the base
-        # manager counts CORDON_REQUIRED nodes as unavailable for exactly
-        # this reason (common_manager.go:762-764); dropping that here would
-        # let consecutive passes start a new slice while the previous one is
-        # still between the label write and the cordon.
-        disrupted_slices = unavailable_slices | in_progress_slices
-
-        # Parallel-slice budget (shape parity with GetUpgradesAvailable,
-        # common_manager.go:748-776, in slice units).
-        if policy.max_parallel_upgrades == 0:
-            available = len(candidate_nodes)
-        else:
-            available = policy.max_parallel_upgrades - len(in_progress_slices)
-        if available > max_unavailable:
-            available = max_unavailable
-        currently_unavailable = len(disrupted_slices)
-        if currently_unavailable >= max_unavailable:
-            available = 0
-        elif (
-            max_unavailable < total_slices
-            and currently_unavailable + available > max_unavailable
-        ):
-            available = max_unavailable - currently_unavailable
-
-        log.info(
-            "slice planner: slices=%d in_progress=%d unavailable=%d "
-            "max_unavailable=%d slots=%d",
-            total_slices, len(in_progress_slices), len(unavailable_slices),
-            max_unavailable, available,
-        )
-
-        # Already-disrupted slices first (their collective is down anyway),
-        # then monitor-flagged wounded slices (repair path), then the rest.
-        ordered = sorted(
-            candidate_nodes.items(),
-            key=lambda item: (
-                item[0] not in disrupted_slices,
-                item[0] not in wounded_slices,
-                item[0],
-            ),
-        )
-        for slice_id, members in ordered:
-            # Per-node bookkeeping shared with the base planner.
-            startable: list[NodeUpgradeState] = []
-            for ns in members:
-                if common.is_upgrade_requested(ns.node):
-                    common.provider.change_node_upgrade_annotation(
-                        ns.node, common.keys.upgrade_requested_annotation, "null"
-                    )
-                if common.skip_node_upgrade(ns.node):
-                    log.info(
-                        "node %s is marked to skip upgrades", ns.node.name
-                    )
-                    continue
-                startable.append(ns)
-            if not startable:
-                continue
-            already_disrupted = slice_id in disrupted_slices
-            if available <= 0 and not already_disrupted:
-                continue
-            # Start the WHOLE slice: one disruption window per slice.
-            for ns in startable:
-                common.provider.change_node_upgrade_state(
-                    ns.node, UpgradeState.CORDON_REQUIRED
-                )
-            log.info(
-                "slice %s: started %d node(s)%s",
-                slice_id, len(startable),
-                " (already disrupted)" if already_disrupted else "",
+        def start(ns: NodeUpgradeState) -> None:
+            # The whole slice's CRs land in one batch: the external
+            # operator receives them together, so its maintenance window
+            # aligns to the slice even though IT performs cordon/drain.
+            self.create_or_update_node_maintenance(ns, policy)
+            common.provider.change_node_upgrade_annotation(
+                ns.node, common.keys.requestor_mode_annotation, TRUE_STRING
             )
-            if not already_disrupted:
-                available -= 1
+            common.provider.change_node_upgrade_state(
+                ns.node, UpgradeState.NODE_MAINTENANCE_REQUIRED
+            )
+
+        start_slices_within_budget(
+            common, self.detector, state, policy, start, "slice requestor"
+        )
 
 
 def enable_slice_aware_planning(manager, detector: Optional[TpuNodeDetector] = None):
-    """Swap the in-place strategy of a ClusterUpgradeStateManager for the
-    slice-aware planner. Returns the manager for chaining."""
+    """Swap a ClusterUpgradeStateManager's strategies for their
+    slice-aware planners. Order-independent with enable_requestor_mode:
+    an already-enabled requestor is swapped here (preserving its
+    RequestorOptions), and a requestor enabled LATER is built slice-aware
+    via the ``requestor_factory`` hook this records on the manager
+    (upgrade/requestor.py enable_requestor_mode honors it). Returns the
+    manager for chaining."""
+    detector = detector or TpuNodeDetector()
     manager.inplace = SliceAwareInplaceManager(manager.common, detector)
+    manager.requestor_factory = (
+        lambda client, common, opts: SliceAwareRequestorManager(
+            client, common, opts, detector
+        )
+    )
+    requestor = getattr(manager, "requestor", None)
+    if isinstance(requestor, RequestorNodeStateManager) and not isinstance(
+        requestor, SliceAwareRequestorManager
+    ):
+        manager.requestor = SliceAwareRequestorManager(
+            requestor.client,
+            manager.common,
+            requestor.opts,
+            detector,
+        )
     return manager

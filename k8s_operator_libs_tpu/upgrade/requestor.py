@@ -123,8 +123,15 @@ def enable_requestor_mode(manager, opts: RequestorOptions):
     upgrade_state.go:65-92). Returns the manager for chaining.
 
     Validation happens before any mutation so a rejected opts object leaves
-    the manager untouched."""
-    requestor = RequestorNodeStateManager(manager.client, manager.common, opts)
+    the manager untouched.
+
+    Honors a ``requestor_factory`` recorded on the manager (by
+    tpu/planner.py enable_slice_aware_planning) so slice-aware planning
+    composes with requestor mode regardless of which was enabled first."""
+    factory = getattr(manager, "requestor_factory", None) or (
+        RequestorNodeStateManager
+    )
+    requestor = factory(manager.client, manager.common, opts)
     manager.options = opts.to_state_options()
     manager.requestor = requestor
     return manager

@@ -1,0 +1,305 @@
+"""Multi-slice pool scenarios (VERDICT r3 item 4).
+
+Every prior e2e ran 4 hosts = ONE slice, so the planner's slice-unit
+budget never competed with batching. This suite runs 3 slices x 4 hosts
+and pins the slice-level guarantees:
+
+* budget is counted in SLICES: maxUnavailable=1 keeps at most one slice
+  disrupted at any instant across the whole roll;
+* one disruption window per slice: window count == slice count, and each
+  slice opens exactly one window;
+* wounded-first: a slice flagged by the monitor (TpuIciHealthy=False)
+  rolls before healthy slices — the repair path re-validates it first;
+* requestor composition: with requestor mode + slice-aware planning, CR
+  creation aligns to slice boundaries (a slice's CRs land in the same
+  pass; at most one slice has live CRs at a time; the wounded slice's
+  CRs land first).
+
+Reference analog for budget semantics: common_manager.go:748-776 (node
+units there; slice units here — SURVEY.md §2.5).
+"""
+
+from k8s_operator_libs_tpu.api import DriverUpgradePolicySpec
+from k8s_operator_libs_tpu.kube import FakeCluster, Node
+from k8s_operator_libs_tpu.kube.objects import set_condition
+from k8s_operator_libs_tpu.kube.sim import (
+    DaemonSetSimulator,
+    MaintenanceOperatorSimulator,
+)
+from k8s_operator_libs_tpu.parallel.topology import (
+    GKE_NODEPOOL_LABEL,
+    GKE_TPU_ACCELERATOR_LABEL,
+    GKE_TPU_TOPOLOGY_LABEL,
+)
+from k8s_operator_libs_tpu.tpu import enable_slice_aware_planning
+from k8s_operator_libs_tpu.tpu.monitor import ICI_HEALTHY_CONDITION
+from k8s_operator_libs_tpu.upgrade import (
+    ClusterUpgradeStateManager,
+    DeviceClass,
+    RequestorOptions,
+    TaskRunner,
+    UpgradeKeys,
+    enable_requestor_mode,
+)
+from k8s_operator_libs_tpu.utils import IntOrString
+
+DEVICE = DeviceClass.tpu()
+KEYS = UpgradeKeys(DEVICE)
+NS = "kube-system"
+DS_LABELS = {"app": "libtpu-installer"}
+SLICES = 3
+HOSTS_PER_SLICE = 4
+
+#: One slice at a time, in slice units.
+POLICY = DriverUpgradePolicySpec(
+    auto_upgrade=True,
+    max_parallel_upgrades=1,
+    max_unavailable=IntOrString(1),
+)
+
+
+def slice_pool_name(s: int) -> str:
+    return f"v5e-pool-{s}"
+
+
+def build_multislice_pool(cluster=None):
+    cluster = cluster or FakeCluster()
+    for s in range(SLICES):
+        for h in range(HOSTS_PER_SLICE):
+            node = Node.new(
+                f"s{s}-h{h}",
+                labels={
+                    GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+                    GKE_TPU_TOPOLOGY_LABEL: "4x4",
+                    GKE_NODEPOOL_LABEL: slice_pool_name(s),
+                },
+            )
+            node.set_ready(True)
+            cluster.create(node)
+    sim = DaemonSetSimulator(
+        cluster,
+        name="libtpu-installer",
+        namespace=NS,
+        match_labels=DS_LABELS,
+        initial_hash="libtpu-v1",
+    )
+    sim.settle()
+    return cluster, sim
+
+
+def wound_slice(cluster, s: int, host: int = 0) -> None:
+    """Publish what the continuous monitor would: TpuIciHealthy=False on
+    one member of slice ``s``."""
+    name = f"s{s}-h{host}"
+    node = Node(cluster.get("Node", name).raw)
+    set_condition(
+        node.status, ICI_HEALTHY_CONDITION, "False",
+        reason="ProbeFailed", message="ring bandwidth below floor",
+    )
+    cluster.update_status(node)
+
+
+def disrupted_slices(cluster) -> set[str]:
+    out = set()
+    for obj in cluster.list("Node"):
+        node = Node(obj.raw)
+        if node.unschedulable or not node.is_ready():
+            out.add(node.labels[GKE_NODEPOOL_LABEL])
+    return out
+
+
+def drive(cluster, sim, mgr, per_pass=None, post_pass=None, max_passes=160):
+    """Reconcile to convergence, sampling slice-level disruption after
+    every kubelet settle. Returns (passes, samples) where samples is the
+    per-pass set of disrupted slices. ``per_pass`` runs at the top of each
+    pass (requestor mode ticks its operator there), ``post_pass`` after
+    the kubelet settles (extra sampling)."""
+    samples = []
+    for i in range(max_passes):
+        if per_pass is not None:
+            per_pass()
+        sim.step()
+        state = mgr.build_state(NS, DS_LABELS)
+        mgr.apply_state(state, POLICY)
+        sim.step()
+        samples.append(disrupted_slices(cluster))
+        if post_pass is not None:
+            post_pass()
+        done = all(
+            n.labels.get(KEYS.state_label) == "upgrade-done"
+            for n in cluster.list("Node")
+        )
+        if done and sim.all_pods_ready_and_current():
+            return i + 1, samples
+    raise AssertionError("multi-slice roll did not converge")
+
+
+def window_stats(samples):
+    """(total disruption windows, first-disruption order, per-slice window
+    count) from the per-pass disrupted-slice sets."""
+    windows = 0
+    previously = set()
+    first_order = []
+    per_slice: dict[str, int] = {}
+    for current in samples:
+        for slice_id in current - previously:
+            windows += 1
+            per_slice[slice_id] = per_slice.get(slice_id, 0) + 1
+            if slice_id not in first_order:
+                first_order.append(slice_id)
+        previously = current
+    return windows, first_order, per_slice
+
+
+class TestMultiSliceInplace:
+    def test_budget_counts_slices_and_one_window_each(self):
+        cluster, sim = build_multislice_pool()
+        mgr = ClusterUpgradeStateManager(
+            cluster, DEVICE, runner=TaskRunner(inline=True)
+        )
+        enable_slice_aware_planning(mgr)
+        sim.set_template_hash("libtpu-v2")
+        passes, samples = drive(cluster, sim, mgr)
+        # maxUnavailable=1 (slice units): never more than one slice down.
+        assert max(len(s) for s in samples) <= 1
+        windows, _, per_slice = window_stats(samples)
+        # One disruption window per slice, no more no less.
+        assert windows == SLICES
+        assert per_slice == {
+            slice_pool_name(s): 1 for s in range(SLICES)
+        }
+
+    def test_wounded_slice_rolls_first(self):
+        cluster, sim = build_multislice_pool()
+        wound_slice(cluster, s=2)
+        mgr = ClusterUpgradeStateManager(
+            cluster, DEVICE, runner=TaskRunner(inline=True)
+        )
+        enable_slice_aware_planning(mgr)
+        sim.set_template_hash("libtpu-v2")
+        _, samples = drive(cluster, sim, mgr)
+        _, first_order, _ = window_stats(samples)
+        assert first_order[0] == slice_pool_name(2), first_order
+        assert set(first_order) == {slice_pool_name(s) for s in range(SLICES)}
+
+    def test_whole_slice_cordons_together(self):
+        """Within one slice's window every member is cordoned in the same
+        pass — per-node dribble would multiply windows by host count."""
+        cluster, sim = build_multislice_pool()
+        mgr = ClusterUpgradeStateManager(
+            cluster, DEVICE, runner=TaskRunner(inline=True)
+        )
+        enable_slice_aware_planning(mgr)
+        sim.set_template_hash("libtpu-v2")
+        cordon_pass: dict[str, int] = {}
+        pass_no = [0]
+
+        def record():
+            pass_no[0] += 1
+            for obj in cluster.list("Node"):
+                node = Node(obj.raw)
+                if node.unschedulable and node.name not in cordon_pass:
+                    cordon_pass[node.name] = pass_no[0]
+
+        drive(cluster, sim, mgr, per_pass=record)
+        record()
+        for s in range(SLICES):
+            passes_for_slice = {
+                cordon_pass[f"s{s}-h{h}"] for h in range(HOSTS_PER_SLICE)
+            }
+            assert len(passes_for_slice) == 1, (s, passes_for_slice)
+
+
+class TestMultiSliceRequestorComposition:
+    """Requestor mode + slice planner: the CRs the external maintenance
+    operator sees arrive in slice-aligned batches (VERDICT r3 item 4)."""
+
+    def _run(self, wound=None, slice_aware_first=False):
+        cluster, sim = build_multislice_pool()
+        if wound is not None:
+            wound_slice(cluster, s=wound)
+        mgr = ClusterUpgradeStateManager(
+            cluster, DEVICE, runner=TaskRunner(inline=True)
+        )
+        opts = RequestorOptions(
+            use_maintenance_operator=True,
+            requestor_id="tpu.operator.dev",
+            namespace=NS,
+        )
+        # The two enables compose in EITHER order (requestor_factory
+        # hook); both orders are exercised across this suite.
+        if slice_aware_first:
+            enable_slice_aware_planning(mgr)
+            enable_requestor_mode(mgr, opts)
+        else:
+            enable_requestor_mode(mgr, opts)
+            enable_slice_aware_planning(mgr)
+        operator = MaintenanceOperatorSimulator(cluster, namespace=NS)
+        sim.set_template_hash("libtpu-v2")
+
+        cr_first_pass: dict[str, int] = {}
+        cr_slices_live = []
+        pass_no = [0]
+
+        def sample():
+            pass_no[0] += 1
+            live = set()
+            for obj in cluster.list("NodeMaintenance", namespace=NS):
+                node_name = obj.raw["spec"]["nodeName"]
+                slice_id = Node(
+                    cluster.get("Node", node_name).raw
+                ).labels[GKE_NODEPOOL_LABEL]
+                live.add(slice_id)
+                if node_name not in cr_first_pass:
+                    cr_first_pass[node_name] = pass_no[0]
+            cr_slices_live.append(live)
+
+        _, samples = drive(
+            cluster, sim, mgr, per_pass=operator.step, post_pass=sample
+        )
+        operator.step()  # finalize deletion-marked CRs
+        return cluster, cr_first_pass, cr_slices_live, samples
+
+    def test_cr_creation_aligns_to_slice_boundaries(self):
+        cluster, cr_first_pass, cr_slices_live, samples = self._run()
+        # Every node got a CR, and a slice's CRs all landed the same pass.
+        assert len(cr_first_pass) == SLICES * HOSTS_PER_SLICE
+        for s in range(SLICES):
+            first_passes = {
+                cr_first_pass[f"s{s}-h{h}"] for h in range(HOSTS_PER_SLICE)
+            }
+            assert len(first_passes) == 1, (s, first_passes)
+        # At most one slice has live CRs at any instant (slice budget
+        # survives delegation), and disruption never exceeds one slice.
+        assert max(len(s) for s in cr_slices_live) <= 1
+        assert max(len(s) for s in samples) <= 1
+        # Protocol completed clean: no CRs left.
+        assert cluster.list("NodeMaintenance", namespace=NS) == []
+
+    def test_enable_order_is_irrelevant(self):
+        """Regression: enable_slice_aware_planning BEFORE
+        enable_requestor_mode (the example controller's order) must still
+        produce slice-aligned CR batches via the requestor_factory hook."""
+        cluster, cr_first_pass, cr_slices_live, _ = self._run(
+            slice_aware_first=True
+        )
+        assert len(cr_first_pass) == SLICES * HOSTS_PER_SLICE
+        for s in range(SLICES):
+            first_passes = {
+                cr_first_pass[f"s{s}-h{h}"] for h in range(HOSTS_PER_SLICE)
+            }
+            assert len(first_passes) == 1, (s, first_passes)
+        assert max(len(s) for s in cr_slices_live) <= 1
+
+    def test_wounded_slice_requests_maintenance_first(self):
+        _, cr_first_pass, _, _ = self._run(wound=1)
+        first_by_slice = {
+            s: min(
+                cr_first_pass[f"s{s}-h{h}"] for h in range(HOSTS_PER_SLICE)
+            )
+            for s in range(SLICES)
+        }
+        assert first_by_slice[1] == min(first_by_slice.values())
+        assert all(
+            first_by_slice[1] < first_by_slice[s] for s in (0, 2)
+        ), first_by_slice
