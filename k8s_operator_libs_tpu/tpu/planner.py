@@ -260,6 +260,44 @@ class SliceAwareRequestorManager(RequestorNodeStateManager):
         )
 
 
+@dataclass
+class DisruptionStats:
+    """Window accounting over a time series of disrupted-slice sets —
+    the ONE definition of "disruption window" shared by the benchmark and
+    the multi-slice test suite (a window opens when a slice enters the
+    disrupted set; a slice that flaps opens a new window each re-entry)."""
+
+    windows: int
+    #: Slices in the order their FIRST window opened.
+    first_order: list[str]
+    #: slice -> number of windows it opened.
+    per_slice: dict[str, int]
+    #: Peak number of simultaneously disrupted slices.
+    max_at_once: int
+
+
+def disruption_stats(samples) -> DisruptionStats:
+    """``samples`` is the per-pass sequence of sets of disrupted slice
+    ids (sampled after the kubelet settles)."""
+    windows = 0
+    previously: set = set()
+    first_order: list[str] = []
+    per_slice: dict[str, int] = {}
+    for current in samples:
+        for slice_id in current - previously:
+            windows += 1
+            per_slice[slice_id] = per_slice.get(slice_id, 0) + 1
+            if slice_id not in first_order:
+                first_order.append(slice_id)
+        previously = set(current)
+    return DisruptionStats(
+        windows=windows,
+        first_order=first_order,
+        per_slice=per_slice,
+        max_at_once=max((len(s) for s in samples), default=0),
+    )
+
+
 def enable_slice_aware_planning(manager, detector: Optional[TpuNodeDetector] = None):
     """Swap a ClusterUpgradeStateManager's strategies for their
     slice-aware planners. Order-independent with enable_requestor_mode:

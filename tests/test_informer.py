@@ -155,9 +155,12 @@ class TestRepair:
             server.cluster.create(make_node("missed"))
             for i in range(8):
                 server.cluster.create(make_node(f"churn-{i}"))
-            # Invalidate the informer's resume revision artificially.
-            while server.cluster._history:
-                server.cluster._history.popleft()
+            # Invalidate the informer's resume revision artificially —
+            # under the cluster lock: a concurrent subscribe() iterates
+            # the journal, and mutating a deque mid-iteration raises in
+            # the informer thread (the old load-dependent flake here).
+            with server.cluster._lock:
+                server.cluster._history.clear()
             server.cluster.create(make_node("after-expiry"))
             assert wait_until(lambda: inf.get("after-expiry") is not None)
             assert inf.get("missed") is not None

@@ -136,19 +136,12 @@ def drive(cluster, sim, mgr, per_pass=None, post_pass=None, max_passes=160):
 
 def window_stats(samples):
     """(total disruption windows, first-disruption order, per-slice window
-    count) from the per-pass disrupted-slice sets."""
-    windows = 0
-    previously = set()
-    first_order = []
-    per_slice: dict[str, int] = {}
-    for current in samples:
-        for slice_id in current - previously:
-            windows += 1
-            per_slice[slice_id] = per_slice.get(slice_id, 0) + 1
-            if slice_id not in first_order:
-                first_order.append(slice_id)
-        previously = current
-    return windows, first_order, per_slice
+    count) via the ONE shared window definition (planner.disruption_stats
+    — bench.py reports through the same helper)."""
+    from k8s_operator_libs_tpu.tpu.planner import disruption_stats
+
+    stats = disruption_stats(samples)
+    return stats.windows, stats.first_order, stats.per_slice
 
 
 class TestMultiSliceInplace:
