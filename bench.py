@@ -279,6 +279,7 @@ def run_trials(fn, trials: int = TRIALS) -> dict:
     results = [fn() for _ in range(trials)]
     walls = sorted(r["wall_s"] for r in results)
     return {
+        "trial_count": len(results),
         "median_wall_s": round(statistics.median(walls), 3),
         "min_wall_s": walls[0],
         "max_wall_s": walls[-1],
@@ -335,6 +336,7 @@ def run_requestor_roll() -> dict:
         "wall_s": round(elapsed, 3),
         "gate_s": round(hook.total_s, 3),
         "gate_runs": hook.runs,
+        "control_plane_s": round(elapsed - hook.total_s, 3),
         "passes": passes,
         "crs_left": crs_left,
         "converged": crs_left == 0,
@@ -347,8 +349,10 @@ def run_multislice_roll(slices: int = 3, hosts_per_slice: int = 4) -> dict:
     3 slices x 4 hosts, one slice wounded (TpuIciHealthy=False from the
     monitor), maxUnavailable=1 SLICE. Asserts (and reports) wounded-first
     repair ordering, disruption windows == slice count, and never more
-    than one slice down at once. Gate is real and slice-scoped: one
-    battery per slice."""
+    than one slice down at once — asserted HARD: a planner regression
+    must fail the bench (like a wedged roll does), not publish false
+    fields with exit 0. Gate is real and slice-scoped: one battery per
+    slice."""
     from k8s_operator_libs_tpu.tpu.monitor import ICI_HEALTHY_CONDITION
 
     cluster, sim = build_pool(slices=slices, hosts_per_slice=hosts_per_slice)
@@ -392,6 +396,21 @@ def run_multislice_roll(slices: int = 3, hosts_per_slice: int = 4) -> dict:
     from k8s_operator_libs_tpu.tpu.planner import disruption_stats
 
     stats = disruption_stats(samples)
+    if stats.windows != slices:
+        raise RuntimeError(
+            f"multislice: {stats.windows} disruption windows for "
+            f"{slices} slices (per_slice={stats.per_slice})"
+        )
+    if stats.max_at_once > 1:
+        raise RuntimeError(
+            f"multislice: {stats.max_at_once} slices disrupted at once "
+            "under a 1-slice budget"
+        )
+    if not stats.first_order or stats.first_order[0] != wounded_pool:
+        raise RuntimeError(
+            f"multislice: wounded slice {wounded_pool} not rolled first "
+            f"(order: {stats.first_order})"
+        )
     return {
         "wall_s": round(elapsed, 3),
         "gate_s": round(hook.total_s, 3),
@@ -550,11 +569,13 @@ def main() -> None:
 
     details = {
         "backend": backend,
+        # Trial counts derived from the actual result objects — never a
+        # parallel literal that can drift from the call sites.
         "methodology": {
             "trials": {
-                "ours": TRIALS,
-                "reference_equivalent": TRIALS,
-                "requestor_mode": 3,
+                "ours": ours["trial_count"],
+                "reference_equivalent": baseline["trial_count"],
+                "requestor_mode": requestor["trial_count"],
                 "multislice": 1,
             },
             "headline": "median wall_s; vs_baseline = ratio of medians",

@@ -144,6 +144,30 @@ def window_stats(samples):
     return stats.windows, stats.first_order, stats.per_slice
 
 
+class TestDisruptionStats:
+    """The shared window definition itself (planner.disruption_stats) —
+    bench.py and this suite both report through it."""
+
+    def test_flapping_slice_opens_a_new_window_each_reentry(self):
+        from k8s_operator_libs_tpu.tpu.planner import disruption_stats
+
+        stats = disruption_stats(
+            [{"a"}, set(), {"a"}, {"a", "b"}, {"b"}, set()]
+        )
+        assert stats.windows == 3  # a, a again, b
+        assert stats.per_slice == {"a": 2, "b": 1}
+        assert stats.first_order == ["a", "b"]
+        assert stats.max_at_once == 2
+
+    def test_empty_series(self):
+        from k8s_operator_libs_tpu.tpu.planner import disruption_stats
+
+        stats = disruption_stats([])
+        assert stats.windows == 0
+        assert stats.max_at_once == 0
+        assert stats.first_order == []
+
+
 class TestMultiSliceInplace:
     def test_budget_counts_slices_and_one_window_each(self):
         cluster, sim = build_multislice_pool()
