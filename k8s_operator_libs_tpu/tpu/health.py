@@ -209,10 +209,20 @@ class IciHealthGate:
             args += ["--min-ring-gbps", str(self.min_ring_gbytes_per_s)]
         if self.min_mxu_tflops > 0:
             args += ["--min-mxu-tflops", str(self.min_mxu_tflops)]
-        if self.use_pallas_matmul:
-            args.append("--pallas-matmul")
-        if self.run_flash_attention:
-            args.append("--flash-attention")
+        # Kernel knobs serialize BIDIRECTIONALLY: a gate instance holds a
+        # concrete bool, and the child must run exactly that battery —
+        # without the force-off flags, main()'s on-TPU auto-enable would
+        # silently re-arm Pallas kernels a portable/off-configured gate
+        # turned off, and the in-process vs subprocess shapes would run
+        # different batteries on the same hardware.
+        args.append(
+            "--pallas-matmul" if self.use_pallas_matmul
+            else "--no-pallas-matmul"
+        )
+        args.append(
+            "--flash-attention" if self.run_flash_attention
+            else "--no-flash-attention"
+        )
         if self.run_seq_parallel_probes:
             args.append("--seq-parallel")
         if not self.run_burnin:
@@ -495,11 +505,21 @@ def main(argv: Optional[list[str]] = None) -> int:
     parser.add_argument("--min-mxu-tflops", type=float, default=0.0)
     parser.add_argument(
         "--pallas-matmul", action="store_true",
-        help="use the Pallas MXU kernel (TPU only)",
+        help="force the Pallas MXU kernel on (TPU only)",
+    )
+    parser.add_argument(
+        "--no-pallas-matmul", action="store_true",
+        help="force the Pallas MXU kernel OFF, overriding on-TPU "
+        "auto-enable (e.g. to work around a kernel bug)",
     )
     parser.add_argument(
         "--flash-attention", action="store_true",
-        help="run the Pallas flash-attention probe (TPU only)",
+        help="force the Pallas flash-attention probe on (TPU only)",
+    )
+    parser.add_argument(
+        "--no-flash-attention", action="store_true",
+        help="force the flash-attention probe OFF, overriding on-TPU "
+        "auto-enable",
     )
     parser.add_argument(
         "--seq-parallel", action="store_true",
@@ -526,21 +546,27 @@ def main(argv: Optional[list[str]] = None) -> int:
     if not args.no_compile_cache:
         enable_persistent_compilation_cache()
 
-    # Auto-enable the TPU-only kernels when a TPU is actually present, so
-    # the default pod command proves Pallas lowering without per-platform
-    # flag plumbing — and never crashes a CPU/test run.
+    # Kernel resolution: explicit force-on/force-off flags win; with
+    # neither, auto-enable on TPU so a bare pod command proves Pallas
+    # lowering without per-platform flag plumbing — and never crashes a
+    # CPU/test run. (to_cli_args always emits one of the explicit flags,
+    # so gate-configured children never depend on the auto path.)
     import jax
 
     on_tpu = jax.devices()[0].platform == "tpu"
+    use_pallas = args.pallas_matmul or (on_tpu and not args.no_pallas_matmul)
+    use_flash = args.flash_attention or (
+        on_tpu and not args.no_flash_attention
+    )
     gate = IciHealthGate(
         min_ring_gbytes_per_s=args.min_ring_gbps,
         min_mxu_tflops=args.min_mxu_tflops,
         payload_mb=args.payload_mb,
         matmul_size=args.matmul_size,
-        use_pallas_matmul=args.pallas_matmul or on_tpu,
+        use_pallas_matmul=use_pallas,
         run_burnin=not args.no_burnin,
         run_seq_parallel_probes=args.seq_parallel,
-        run_flash_attention=args.flash_attention or on_tpu,
+        run_flash_attention=use_flash,
     )
     report = gate.run()
     print(json.dumps(dataclasses.asdict(report)), flush=True)

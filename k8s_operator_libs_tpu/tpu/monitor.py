@@ -236,6 +236,22 @@ def main(argv: Optional[list[str]] = None) -> int:
         "--probe-timeout-seconds", type=float, default=600.0,
         help="deadline for one subprocess probe cycle",
     )
+    parser.add_argument(
+        "--gate-preset", choices=("tpu", "portable"), default="tpu",
+        help="probe configuration: 'tpu' = calibrated v5e floors + Pallas "
+        "kernels (IciHealthGate.tpu_defaults); 'portable' = no floors, no "
+        "TPU-only kernels — runs on any backend (dev rigs, CPU smoke "
+        "environments)",
+    )
+    parser.add_argument(
+        "--min-ring-gbps", type=float, default=None,
+        help="override the preset's ring-bandwidth floor (GB/s) — the "
+        "per-device-class retuning knob, like ValidationPodSpec's",
+    )
+    parser.add_argument(
+        "--min-mxu-tflops", type=float, default=None,
+        help="override the preset's MXU throughput floor (TFLOP/s)",
+    )
     import logging
 
     logging.basicConfig(
@@ -257,23 +273,36 @@ def main(argv: Optional[list[str]] = None) -> int:
         failure_threshold = 1
         success_threshold = 1
 
+    overrides: dict = {}
+    if args.min_ring_gbps is not None:
+        overrides["min_ring_gbytes_per_s"] = args.min_ring_gbps
+    if args.min_mxu_tflops is not None:
+        overrides["min_mxu_tflops"] = args.min_mxu_tflops
+    if args.gate_preset == "tpu":
+        probe_gate = IciHealthGate.tpu_defaults(**overrides)
+    else:
+        # Portable: floorless, no TPU-only kernels — the battery itself
+        # (collectives, MXU numerics, burn-in) still runs everywhere.
+        probe_gate = IciHealthGate(
+            run_seq_parallel_probes=True, **overrides
+        )
     if args.in_process:
         # In-process: this monitor holds libtpu's exclusive lock from the
         # first probe onward. Reserved for hosts where the monitor owns the
         # chips (e.g. a dedicated validation host).
         enable_persistent_compilation_cache()
-        gate = IciHealthGate.tpu_defaults()
+        gate = probe_gate
     else:
         # Default (the DaemonSet shape): probe in a short-lived child so
         # libtpu is released between cycles and workload pods admitted
-        # meanwhile can initialize the TPU. The child runs the calibrated
-        # tpu_defaults() configuration, serialized through to_cli_args()
-        # so the two probe shapes cannot drift; it inherits
+        # meanwhile can initialize the TPU. The child runs the preset's
+        # configuration, serialized through to_cli_args() so the two
+        # probe shapes cannot drift; it inherits
         # JAX_COMPILATION_CACHE_DIR, so warm cycles stay ~5 s.
         from .health import SubprocessHealthGate
 
         gate = SubprocessHealthGate(
-            cli_args=IciHealthGate.tpu_defaults().to_cli_args(),
+            cli_args=probe_gate.to_cli_args(),
             timeout_seconds=args.probe_timeout_seconds,
         )
     client = RestClient.from_environment()

@@ -62,6 +62,10 @@ class ValidationPodSpec:
     #: a half-speed link or collapsed MXU fails validation out of the box.
     min_ring_gbytes_per_s: float = TPU_DEFAULT_MIN_RING_GBYTES_PER_S
     min_mxu_tflops: float = TPU_DEFAULT_MIN_MXU_TFLOPS
+    #: Pallas kernels on by default — the probe pod schedules onto TPU
+    #: hosts; set False to fall back to the XLA-native paths (e.g. when
+    #: working around a kernel bug).
+    use_pallas_matmul: bool = True
     run_flash_attention: bool = True
     #: Deep-fabric ring/ulysses probes on by default: the probe pod holds
     #: the host's full chip complement (>1 device), exactly where the
@@ -89,9 +93,9 @@ class ValidationPodSpec:
     def probe_command(self) -> list[str]:
         """The payload: the health CLI, parked after a passing battery.
         Gate knobs serialize through ``IciHealthGate.to_cli_args`` — the
-        one knob→argv mapping shared with the monitor's subprocess gate.
-        ``use_pallas_matmul`` stays off here: the payload auto-enables the
-        Pallas kernels when it actually lands on a TPU (health.main)."""
+        one knob→argv mapping shared with the monitor's subprocess gate,
+        emitting explicit force-on/force-off kernel flags so the pod runs
+        exactly the configured battery."""
         from .health import IciHealthGate
 
         gate = IciHealthGate(
@@ -99,6 +103,7 @@ class ValidationPodSpec:
             min_mxu_tflops=self.min_mxu_tflops,
             payload_mb=self.payload_mb,
             matmul_size=self.matmul_size,
+            use_pallas_matmul=self.use_pallas_matmul,
             run_flash_attention=self.run_flash_attention,
             run_seq_parallel_probes=self.run_seq_parallel_probes,
             run_burnin=self.run_burnin,

@@ -1,0 +1,109 @@
+"""Monitor DaemonSet payload end to end: the REAL process chain.
+
+`python -m k8s_operator_libs_tpu.tpu.monitor --once` runs as an actual
+subprocess against a LocalApiServer over real HTTP (kubeconfig +
+NODE_NAME from the environment, exactly the DaemonSet's wiring), and its
+default subprocess gate spawns the REAL probe grandchild — so one test
+covers monitor CLI → RestClient-over-kubeconfig → SubprocessHealthGate →
+health payload battery → Node condition written over the wire. Nothing
+is monkeypatched.
+
+Uses the new `--gate-preset portable` (no floors, no TPU-only kernels) so
+the battery passes on the hermetic CPU mesh; the failure path arms an
+impossible MXU floor through the monitor's own floor-override knobs.
+"""
+
+import subprocess
+import sys
+
+import pytest
+
+from k8s_operator_libs_tpu.kube import LocalApiServer, Node
+from k8s_operator_libs_tpu.kube.objects import condition_status
+from k8s_operator_libs_tpu.tpu.monitor import ICI_HEALTHY_CONDITION
+from k8s_operator_libs_tpu.upgrade import DeviceClass, UpgradeKeys
+from k8s_operator_libs_tpu.utils.jaxenv import hermetic_cpu_env
+
+KEYS = UpgradeKeys(DeviceClass.tpu())
+
+
+@pytest.fixture()
+def server():
+    with LocalApiServer() as srv:
+        yield srv
+
+
+@pytest.fixture(scope="module")
+def cache_dir(tmp_path_factory):
+    """Run-private XLA cache shared across this module's e2e runs (warm
+    second run) — NEVER a fixed /tmp path: a predictable world-writable
+    location invites cache poisoning and cross-user collisions (see the
+    HEALTH_CACHE_DIR threat model in tpu/health.py)."""
+    return str(tmp_path_factory.mktemp("monitor-e2e-jax-cache"))
+
+
+def run_monitor(server, tmp_path, cache_dir, node_name, *extra_args,
+                timeout=300):
+    kubeconfig = server.write_kubeconfig(str(tmp_path / "kubeconfig"))
+    env = hermetic_cpu_env(4)
+    env["KUBECONFIG"] = kubeconfig
+    env["NODE_NAME"] = node_name
+    # The probe grandchild inherits this too.
+    env["JAX_COMPILATION_CACHE_DIR"] = cache_dir
+    return subprocess.run(
+        [
+            sys.executable, "-m", "k8s_operator_libs_tpu.tpu.monitor",
+            "--once", *extra_args,
+        ],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+
+
+def node_condition(server, name):
+    node = Node(server.cluster.get("Node", name).raw)
+    return condition_status(node.status, ICI_HEALTHY_CONDITION)
+
+
+def make_ready_node(server, name, labels=None):
+    node = Node.new(name, labels=labels or {})
+    node.set_ready(True)
+    server.cluster.create(node)
+
+
+class TestMonitorPayloadEndToEnd:
+    def test_passing_battery_publishes_true_condition(
+        self, server, tmp_path, cache_dir
+    ):
+        make_ready_node(server, "mon-node")
+        proc = run_monitor(
+            server, tmp_path, cache_dir, "mon-node",
+            "--gate-preset", "portable",
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert node_condition(server, "mon-node") == "True"
+
+    def test_floor_violation_publishes_false_condition_and_rc1(
+        self, server, tmp_path, cache_dir
+    ):
+        make_ready_node(server, "mon-node")
+        proc = run_monitor(
+            server, tmp_path, cache_dir, "mon-node",
+            "--gate-preset", "portable",
+            "--min-mxu-tflops", "1e9",  # no device reaches this
+        )
+        assert proc.returncode == 1, proc.stderr[-2000:]
+        assert node_condition(server, "mon-node") == "False"
+
+    def test_skip_label_probes_nothing(self, server, tmp_path, cache_dir):
+        make_ready_node(
+            server, "mon-node", labels={KEYS.skip_label: "true"}
+        )
+        proc = run_monitor(
+            server, tmp_path, cache_dir, "mon-node",
+            "--gate-preset", "portable", timeout=60,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert node_condition(server, "mon-node") is None

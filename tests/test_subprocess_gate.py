@@ -267,6 +267,23 @@ class TestMonitorGateSelection:
         assert seen["failure_threshold"] == 1
         assert seen["success_threshold"] == 1
 
+    def test_portable_preset_and_floor_overrides(self, monkeypatch):
+        _, seen = self._run_main(
+            monkeypatch,
+            ["--node-name", "n0", "--once",
+             "--gate-preset", "portable",
+             "--min-mxu-tflops", "7.5"],
+        )
+        args = seen["gate"].cli_args
+        # Portable: no TPU-only kernel flags, no default floors...
+        assert "--pallas-matmul" not in args
+        assert "--flash-attention" not in args
+        assert "--min-ring-gbps" not in args
+        # ...but explicit overrides still serialize through.
+        assert args[args.index("--min-mxu-tflops") + 1] == "7.5"
+        # Deep-fabric probes ride the portable preset too.
+        assert "--seq-parallel" in args
+
     def test_probe_timeout_flag_reaches_gate(self, monkeypatch):
         _, seen = self._run_main(
             monkeypatch,

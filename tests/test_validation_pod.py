@@ -231,6 +231,7 @@ class TestRealPayloadExecution:
             matmul_size=64,
             min_ring_gbytes_per_s=0.0,
             min_mxu_tflops=0.0,
+            use_pallas_matmul=False,
             run_flash_attention=False,
             run_seq_parallel_probes=False,
             run_burnin=False,
