@@ -1,0 +1,60 @@
+"""bench.py smoke: the driver's headline artifact must never break
+silently — an import error or API drift in the bench would otherwise
+surface only in the end-of-round artifact, as an empty BENCH file.
+
+Runs the cheap sections for real (state-machine microbench, one
+slice-aware roll with the real in-process gate on the CPU mesh, the
+multi-slice roll with its hard invariants) and shape-checks their
+outputs. The full-trial methodology and TPU calibration stay bench-only.
+"""
+
+import pytest
+
+# conftest.py puts the repo root (where bench.py lives) on sys.path, and
+# bench's backend probe/re-exec runs only under __main__ — a plain import
+# is side-effect-free here.
+import bench
+
+
+def test_state_machine_microbench_shapes():
+    out = bench.run_state_machine_microbench()
+    assert out["rolls_completed"] >= 1
+    assert out["passes_per_s"] > 0
+    assert out["nodes"] == bench.HOSTS
+    multi = bench.run_state_machine_microbench(slices=3, hosts_per_slice=4)
+    assert multi["nodes"] == 12
+    assert multi["node_reconciles_per_s"] > 0
+
+
+@pytest.mark.parametrize("slice_aware", [True, False])
+def test_roll_returns_phase_breakdown(slice_aware):
+    out = bench.run_roll(slice_aware=slice_aware)
+    for key in (
+        "wall_s", "gate_s", "gate_runs", "control_plane_s",
+        "passes", "max_unavailable_pods", "disruption_windows",
+    ):
+        assert key in out, key
+    assert out["gate_runs"] == bench.HOSTS  # one hook call per node
+    assert out["wall_s"] >= out["gate_s"] >= 0
+    # The TPU-native shape's whole point: one window, not one per host.
+    assert out["disruption_windows"] == (1 if slice_aware else bench.HOSTS)
+
+
+def test_multislice_roll_invariants_hold():
+    out = bench.run_multislice_roll()
+    assert out["windows_equal_slices"] is True
+    assert out["wounded_slice_first"] is True
+    assert out["max_slices_disrupted_at_once"] == 1
+
+
+def test_trials_aggregation():
+    calls = iter([3.0, 1.0, 2.0])
+
+    def fake():
+        return {"wall_s": next(calls)}
+
+    out = bench.run_trials(fake, trials=3)
+    assert out["trial_count"] == 3
+    assert out["median_wall_s"] == 2.0
+    assert out["min_wall_s"] == 1.0
+    assert out["max_wall_s"] == 3.0

@@ -188,6 +188,27 @@ class TestConditionLifecycle:
         assert monitor.check_once() is not None
         assert gate.runs == 1
 
+    def test_condition_write_retries_through_conflicts(self):
+        """_publish is a read-modify-write under optimistic lock: a
+        concurrent status writer (kubelet heartbeats land on nodes
+        constantly) must cost a retry, never a lost condition."""
+        from k8s_operator_libs_tpu.kube.client import ConflictError
+
+        cluster, gate, monitor = make_monitor(threshold=1)
+        remaining = {"conflicts": 2}
+
+        def conflict_twice(verb, kind, payload):
+            if remaining["conflicts"] > 0:
+                remaining["conflicts"] -= 1
+                raise ConflictError("simulated concurrent status write")
+
+        cluster.add_reactor("update_status", "Node", conflict_twice)
+        gate.verdicts = [False]
+        report = monitor.check_once()
+        assert report is not None and not report.ok
+        assert remaining["conflicts"] == 0  # both conflicts were consumed
+        assert node_condition(cluster) == "False"
+
     def test_steady_state_writes_nothing(self):
         """Unchanged verdicts must not touch the Node: per-interval
         status PUTs are fleet-scale apiserver load and would stomp
