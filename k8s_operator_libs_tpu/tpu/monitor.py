@@ -42,6 +42,96 @@ REASON_PASSED = "ProbePassed"
 REASON_FAILED = "ProbeFailed"
 
 
+class MonitorMetrics:
+    """Prometheus text gauges/counters for the monitor DaemonSet —
+    per-node probe observability next to the condition it publishes
+    (served by ``upgrade.metrics.MetricsServer``, which only needs
+    ``render()``). The monitor PASSES its state into ``record``/
+    ``record_error`` (no back-reference into monitor internals), so every
+    exported value is written under this one lock and a scrape always
+    sees a consistent snapshot."""
+
+    _PREFIX = "tpu_monitor"
+
+    def __init__(self, node_name: str) -> None:
+        self._node = node_name
+        self._lock = threading.Lock()
+        self._probes_total = 0
+        self._skipped_total = 0
+        self._failures_total = 0
+        self._errors_total = 0
+        self._last_elapsed_s = 0.0
+        self._last_ok: Optional[bool] = None
+        self._consecutive_failures = 0
+        self._published: Optional[bool] = None
+
+    def record(
+        self,
+        report: Optional[HealthReport],
+        consecutive_failures: int = 0,
+        published: Optional[bool] = None,
+    ) -> None:
+        with self._lock:
+            self._consecutive_failures = consecutive_failures
+            self._published = published
+            if report is None:
+                self._skipped_total += 1
+                return
+            self._probes_total += 1
+            self._last_elapsed_s = report.elapsed_s
+            self._last_ok = report.ok
+            if not report.ok:
+                self._failures_total += 1
+
+    def record_error(self) -> None:
+        """A cycle that RAISED (apiserver auth, gate crash): without this
+        an error-looping monitor would flatline every counter while
+        last_probe_ok kept reporting the stale last good value."""
+        with self._lock:
+            self._errors_total += 1
+
+    def render(self) -> str:
+        label = f'{{node="{self._node}"}}'
+        with self._lock:
+            rows = [
+                ("probes_total", "counter",
+                 "Probe batteries run", self._probes_total),
+                ("probes_skipped_total", "counter",
+                 "Cycles skipped (skip label, busy chips, missing node)",
+                 self._skipped_total),
+                ("probe_failures_total", "counter",
+                 "Probe batteries that failed", self._failures_total),
+                ("cycle_errors_total", "counter",
+                 "Probe cycles that raised (no verdict produced)",
+                 self._errors_total),
+                ("last_probe_duration_seconds", "gauge",
+                 "Wall-clock of the most recent battery",
+                 round(self._last_elapsed_s, 3)),
+                ("consecutive_failures", "gauge",
+                 "Failing batteries since the last pass (debounce)",
+                 self._consecutive_failures),
+            ]
+            if self._last_ok is not None:
+                rows.append(
+                    ("last_probe_ok", "gauge",
+                     "1 when the most recent battery passed",
+                     int(self._last_ok))
+                )
+            if self._published is not None:
+                rows.append(
+                    ("published_healthy", "gauge",
+                     "Last TpuIciHealthy verdict published (1=True)",
+                     int(self._published))
+                )
+        out = []
+        for suffix, kind, help_text, value in rows:
+            name = f"{self._PREFIX}_{suffix}"
+            out.append(f"# HELP {name} {help_text}")
+            out.append(f"# TYPE {name} {kind}")
+            out.append(f"{name}{label} {value}")
+        return "\n".join(out) + "\n"
+
+
 class TpuHealthMonitor:
     def __init__(
         self,
@@ -53,6 +143,7 @@ class TpuHealthMonitor:
         success_threshold: int = 2,
         device: Optional[DeviceClass] = None,
         recorder=None,
+        metrics: Optional[MonitorMetrics] = None,
     ) -> None:
         self.client = client
         self.node_name = node_name
@@ -68,6 +159,7 @@ class TpuHealthMonitor:
         self.success_threshold = success_threshold
         self.keys = UpgradeKeys(device or DeviceClass.tpu())
         self.recorder = recorder
+        self.metrics = metrics
         self._consecutive_failures = 0
         self._consecutive_passes = 0
         #: Last verdict this monitor published (None until the first).
@@ -79,6 +171,21 @@ class TpuHealthMonitor:
         """Run the battery once and publish the verdict. Returns the report
         (None when the cycle was skipped: skip label, missing node, or
         TPU chips held by workloads)."""
+        try:
+            report = self._check_once()
+        except Exception:
+            if self.metrics is not None:
+                self.metrics.record_error()
+            raise
+        if self.metrics is not None:
+            self.metrics.record(
+                report,
+                consecutive_failures=self._consecutive_failures,
+                published=self._last_published,
+            )
+        return report
+
+    def _check_once(self) -> Optional[HealthReport]:
         node_obj = self.client.get_or_none("Node", self.node_name)
         if node_obj is None:
             log.warning("monitored node %s not found", self.node_name)
@@ -252,6 +359,14 @@ def main(argv: Optional[list[str]] = None) -> int:
         "--min-mxu-tflops", type=float, default=None,
         help="override the preset's MXU throughput floor (TFLOP/s)",
     )
+    parser.add_argument(
+        "--metrics-port", type=int, default=0,
+        help="serve Prometheus probe metrics on this port (0 = off)",
+    )
+    parser.add_argument(
+        "--metrics-host", default="0.0.0.0",
+        help="metrics bind address (DaemonSet pods need a scrapeable one)",
+    )
     import logging
 
     logging.basicConfig(
@@ -306,6 +421,7 @@ def main(argv: Optional[list[str]] = None) -> int:
             timeout_seconds=args.probe_timeout_seconds,
         )
     client = RestClient.from_environment()
+    metrics = MonitorMetrics(args.node_name)
     monitor = TpuHealthMonitor(
         client,
         args.node_name,
@@ -314,12 +430,25 @@ def main(argv: Optional[list[str]] = None) -> int:
         failure_threshold=failure_threshold,
         success_threshold=success_threshold,
         recorder=EventRecorder(client),
+        metrics=metrics,
     )
-    if args.once:
-        report = monitor.check_once()
-        return 0 if report is None or report.ok else 1
-    monitor.run_forever()
-    return 0
+    metrics_server = None
+    if args.metrics_port:
+        from ..upgrade.metrics import MetricsServer
+
+        metrics_server = MetricsServer(
+            metrics, port=args.metrics_port, host=args.metrics_host
+        ).start()
+        log.info("metrics: %s", metrics_server.url)
+    try:
+        if args.once:
+            report = monitor.check_once()
+            return 0 if report is None or report.ok else 1
+        monitor.run_forever()
+        return 0
+    finally:
+        if metrics_server is not None:
+            metrics_server.stop()
 
 
 if __name__ == "__main__":

@@ -14,9 +14,17 @@ from __future__ import annotations
 
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Optional, Protocol
 
 from ..utils.log import get_logger
+
+
+class Renderable(Protocol):
+    """Anything the server can expose: UpgradeMetrics here, the monitor's
+    MonitorMetrics (tpu/monitor.py), or a consumer's own collector."""
+
+    def render(self) -> str: ...  # pragma: no cover - typing only
+
 
 log = get_logger("upgrade.metrics")
 
@@ -84,7 +92,7 @@ class MetricsServer(ThreadingHTTPServer):
 
     def __init__(
         self,
-        metrics: UpgradeMetrics,
+        metrics: Renderable,
         port: int = 0,
         host: str = "127.0.0.1",
     ) -> None:

@@ -137,6 +137,14 @@ class TestMonitorDaemonSet:
         assert cmd[:3] == ["python", "-m", "k8s_operator_libs_tpu.tpu.monitor"]
         assert importlib.util.find_spec(cmd[2]) is not None
 
+    def test_metrics_port_consistent_with_command(self):
+        ds = monitor_docs()["DaemonSet"]
+        (container,) = ds["spec"]["template"]["spec"]["containers"]
+        cmd = container["command"]
+        declared = {p["containerPort"]: p["name"] for p in container["ports"]}
+        port = int(cmd[cmd.index("--metrics-port") + 1])
+        assert declared.get(port) == "metrics"
+
     def test_node_name_from_downward_api(self):
         ds = monitor_docs()["DaemonSet"]
         (container,) = ds["spec"]["template"]["spec"]["containers"]

@@ -188,6 +188,45 @@ class TestConditionLifecycle:
         assert monitor.check_once() is not None
         assert gate.runs == 1
 
+    def test_metrics_record_probes_skips_and_debounce(self):
+        from k8s_operator_libs_tpu.tpu.monitor import MonitorMetrics
+
+        cluster, gate, monitor = make_monitor(threshold=2)
+        metrics = MonitorMetrics("tpu-node")
+        monitor.metrics = metrics
+        gate.verdicts = [True, False]
+        monitor.check_once()   # pass
+        monitor.check_once()   # fail (1/2 — condition not yet flipped)
+        # Skip cycle: skip label.
+        cluster.patch(
+            "Node", "tpu-node",
+            patch={"metadata": {"labels": {KEYS.skip_label: "true"}}},
+        )
+        assert monitor.check_once() is None
+        text = metrics.render()
+        assert 'tpu_monitor_probes_total{node="tpu-node"} 2' in text
+        assert 'tpu_monitor_probes_skipped_total{node="tpu-node"} 1' in text
+        assert 'tpu_monitor_probe_failures_total{node="tpu-node"} 1' in text
+        assert 'tpu_monitor_last_probe_ok{node="tpu-node"} 0' in text
+        assert 'tpu_monitor_consecutive_failures{node="tpu-node"} 1' in text
+        assert 'tpu_monitor_published_healthy{node="tpu-node"} 1' in text
+
+    def test_metrics_served_over_http(self):
+        import urllib.request
+
+        from k8s_operator_libs_tpu.tpu.monitor import MonitorMetrics
+        from k8s_operator_libs_tpu.upgrade import MetricsServer
+
+        cluster, gate, monitor = make_monitor()
+        metrics = MonitorMetrics("tpu-node")
+        monitor.metrics = metrics
+        monitor.check_once()
+        with MetricsServer(metrics, port=0) as server:
+            body = urllib.request.urlopen(server.url, timeout=5).read()
+        text = body.decode()
+        assert "# TYPE tpu_monitor_probes_total counter" in text
+        assert 'tpu_monitor_last_probe_ok{node="tpu-node"} 1' in text
+
     def test_condition_write_retries_through_conflicts(self):
         """_publish is a read-modify-write under optimistic lock: a
         concurrent status writer (kubelet heartbeats land on nodes
