@@ -129,7 +129,8 @@ MAX_PASSES = 200
 def build_pool(
     cluster=None, slices: int = 1, hosts_per_slice: int = HOSTS, pool=POOL
 ) -> tuple[FakeCluster, DaemonSetSimulator]:
-    cluster = cluster or FakeCluster()
+    if cluster is None:  # `or` would drop an EMPTY cluster: len()==0
+        cluster = FakeCluster()
     for s in range(slices):
         pool_name = pool if slices == 1 else f"{pool}-{s}"
         for i in range(hosts_per_slice):
@@ -427,6 +428,44 @@ def run_multislice_roll(slices: int = 3, hosts_per_slice: int = 4) -> dict:
     }
 
 
+def run_http_wire_roll() -> dict:
+    """BASELINE config #3 shape over a REAL wire: the same 4-host roll
+    driven through RestClient against LocalApiServer (genuine HTTP
+    request/response per API call), gate disabled — this isolates the
+    CONTROL-PLANE cost of a roll when every get/list/patch pays
+    serialization + a socket round trip, the part the in-process fake
+    hides. (A kind/real-apiserver variant of this number is what the
+    conformance battery unlocks; see README.)"""
+    from k8s_operator_libs_tpu.kube import LocalApiServer, RestClient, RestConfig
+
+    with LocalApiServer() as srv:
+        _, sim = build_pool(cluster=srv.cluster)
+        client = RestClient(RestConfig(server=srv.url))
+        # Reference-shaped (no slice planner), matching config #3 — so
+        # subtracting reference_equivalent's control_plane_s from this
+        # wall genuinely isolates the wire cost, not planner differences.
+        mgr = ClusterUpgradeStateManager(
+            client, DEVICE, runner=TaskRunner(inline=True)
+        )
+        policy = DriverUpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=1,
+            max_unavailable=IntOrString("25%"),
+        )
+        sim.set_template_hash("libtpu-v2")
+        start = time.perf_counter()
+        passes = drive_to_convergence(srv.cluster, sim, mgr, policy)
+        elapsed = time.perf_counter() - start
+    return {
+        "wall_s": round(elapsed, 3),
+        "passes": passes,
+        "nodes": HOSTS,
+        "transport": "http (LocalApiServer)",
+        "gate": "disabled (control-plane isolation)",
+        "shape": "reference-equivalent (no slice planner)",
+    }
+
+
 def run_state_machine_microbench(
     slices: int = 1, hosts_per_slice: int = HOSTS
 ) -> dict:
@@ -577,6 +616,7 @@ def main() -> None:
                 "reference_equivalent": baseline["trial_count"],
                 "requestor_mode": requestor["trial_count"],
                 "multislice": 1,
+                "http_wire_roll": 1,
             },
             "headline": "median wall_s; vs_baseline = ratio of medians",
             "phase_breakdown": "per-trial gate_s/gate_runs vs "
@@ -587,6 +627,7 @@ def main() -> None:
         "reference_equivalent": baseline,
         "requestor_mode": requestor,
         "multislice": multislice,
+        "http_wire_roll": run_http_wire_roll(),
         "state_machine_microbench": {
             "single_slice_pool": run_state_machine_microbench(),
             "multislice_pool": run_state_machine_microbench(

@@ -123,13 +123,9 @@ class MonitorMetrics:
                      "Last TpuIciHealthy verdict published (1=True)",
                      int(self._published))
                 )
-        out = []
-        for suffix, kind, help_text, value in rows:
-            name = f"{self._PREFIX}_{suffix}"
-            out.append(f"# HELP {name} {help_text}")
-            out.append(f"# TYPE {name} {kind}")
-            out.append(f"{name}{label} {value}")
-        return "\n".join(out) + "\n"
+        from ..upgrade.metrics import render_rows
+
+        return render_rows(self._PREFIX, label, rows)
 
 
 class TpuHealthMonitor:

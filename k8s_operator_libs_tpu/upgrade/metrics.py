@@ -28,6 +28,21 @@ class Renderable(Protocol):
 
 log = get_logger("upgrade.metrics")
 
+
+def render_rows(prefix: str, label: str, rows) -> str:
+    """The ONE Prometheus text-exposition emitter (# HELP / # TYPE /
+    name{label} value) shared by every collector in the framework
+    (UpgradeMetrics here, MonitorMetrics in tpu/monitor.py). ``rows`` is
+    an iterable of (suffix, kind, help_text, value)."""
+    out: list[str] = []
+    for suffix, kind, help_text, value in rows:
+        name = f"{prefix}_{suffix}"
+        out.append(f"# HELP {name} {help_text}")
+        out.append(f"# TYPE {name} {kind}")
+        out.append(f"{name}{label} {value}")
+    return "\n".join(out) + "\n"
+
+
 _PREFIX = "tpu_operator_upgrade"
 
 #: (metric suffix, help text, manager accessor name)
@@ -67,19 +82,17 @@ class UpgradeMetrics:
                 self._values[suffix] = getattr(self._manager, accessor)(state)
 
     def render(self) -> str:
-        lines: list[str] = []
         label = f'{{device="{self._device}"}}'
         with self._lock:
-            for suffix, help_text, _ in _GAUGES:
-                name = f"{_PREFIX}_{suffix}"
-                lines.append(f"# HELP {name} {help_text}")
-                lines.append(f"# TYPE {name} gauge")
-                lines.append(f"{name}{label} {self._values.get(suffix, 0)}")
-            name = f"{_PREFIX}_reconcile_passes_total"
-            lines.append(f"# HELP {name} Reconcile passes observed")
-            lines.append(f"# TYPE {name} counter")
-            lines.append(f"{name}{label} {self._reconcile_passes}")
-        return "\n".join(lines) + "\n"
+            rows = [
+                (suffix, "gauge", help_text, self._values.get(suffix, 0))
+                for suffix, help_text, _ in _GAUGES
+            ]
+            rows.append(
+                ("reconcile_passes_total", "counter",
+                 "Reconcile passes observed", self._reconcile_passes)
+            )
+        return render_rows(_PREFIX, label, rows)
 
 
 class MetricsServer(ThreadingHTTPServer):

@@ -47,6 +47,13 @@ def test_multislice_roll_invariants_hold():
     assert out["max_slices_disrupted_at_once"] == 1
 
 
+def test_http_wire_roll_converges():
+    out = bench.run_http_wire_roll()
+    assert out["passes"] >= 1
+    assert out["wall_s"] > 0
+    assert out["transport"].startswith("http")
+
+
 def test_trials_aggregation():
     calls = iter([3.0, 1.0, 2.0])
 

@@ -63,7 +63,8 @@ def slice_pool_name(s: int) -> str:
 
 
 def build_multislice_pool(cluster=None):
-    cluster = cluster or FakeCluster()
+    if cluster is None:  # `or` would drop an EMPTY cluster: len()==0
+        cluster = FakeCluster()
     for s in range(SLICES):
         for h in range(HOSTS_PER_SLICE):
             node = Node.new(
@@ -225,6 +226,68 @@ class TestMultiSliceInplace:
                 cordon_pass[f"s{s}-h{h}"] for h in range(HOSTS_PER_SLICE)
             }
             assert len(passes_for_slice) == 1, (s, passes_for_slice)
+
+
+class TestMidSliceCrashResume:
+    def test_partial_slice_start_resumes_without_extra_budget(self):
+        """A pass that dies after starting only PART of a slice's batch
+        (state-write error mid-batch) must heal idempotently: the next
+        pass finishes that slice under its already-disrupted exemption —
+        no second budget slot, no second disruption window, and the other
+        slices still roll one at a time."""
+        from k8s_operator_libs_tpu.kube.client import ApiError
+
+        cluster, sim = build_multislice_pool()
+        mgr = ClusterUpgradeStateManager(
+            cluster, DEVICE, runner=TaskRunner(inline=True)
+        )
+        enable_slice_aware_planning(mgr)
+        sim.set_template_hash("libtpu-v2")
+
+        # Fail the SECOND cordon-required label write of the first
+        # starting pass: slice s0 ends half-started.
+        state = {"writes": 0, "armed": True}
+
+        def fail_second_state_write(verb, kind, payload):
+            patch = payload.get("patch") or {}
+            labels = (patch.get("metadata") or {}).get("labels") or {}
+            if KEYS.state_label not in labels:
+                return
+            if labels[KEYS.state_label] != "cordon-required":
+                return
+            state["writes"] += 1
+            if state["armed"] and state["writes"] == 2:
+                state["armed"] = False
+                raise ApiError("injected: apiserver blip mid-batch")
+
+        cluster.add_reactor("patch", "Node", fail_second_state_write)
+
+        # Drive passes until the batch write crashes (classification to
+        # upgrade-required happens a pass before the cordon batch, per
+        # snapshot semantics). The error aborts the PASS; labels already
+        # written persist — the reference contract.
+        for _ in range(5):
+            sim.step()
+            try:
+                snapshot = mgr.build_state(NS, DS_LABELS)
+                mgr.apply_state(snapshot, POLICY)
+            except ApiError:
+                break
+        else:
+            raise AssertionError("injected fault did not surface")
+        started = [
+            n.name
+            for n in cluster.list("Node")
+            if Node(n.raw).labels.get(KEYS.state_label) == "cordon-required"
+        ]
+        assert len(started) == 1  # genuinely half-started
+
+        # Resume: normal passes to convergence.
+        passes, samples = drive(cluster, sim, mgr)
+        windows, _, per_slice = window_stats(samples)
+        assert max(len(s) for s in samples) <= 1
+        assert windows == SLICES
+        assert all(count == 1 for count in per_slice.values()), per_slice
 
 
 class TestMultiSliceRequestorComposition:
