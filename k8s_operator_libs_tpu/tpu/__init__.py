@@ -1,21 +1,39 @@
 from .detector import TpuNodeDetector, TpuNodeInfo
-from .planner import SliceAwareInplaceManager, enable_slice_aware_planning
+from .planner import (
+    DisruptionStats,
+    SliceAwareInplaceManager,
+    SliceAwareRequestorManager,
+    disruption_stats,
+    enable_slice_aware_planning,
+)
 from .libtpu import LibtpuDaemonSetManager, LibtpuSpec
-from .health import HealthReport, IciHealthGate, SliceScopedGate
-from .monitor import TpuHealthMonitor
+from .health import (
+    HealthGate,
+    HealthReport,
+    IciHealthGate,
+    SliceScopedGate,
+    SubprocessHealthGate,
+)
+from .monitor import MonitorMetrics, TpuHealthMonitor
 from .validation_pod import ValidationPodManager, ValidationPodSpec
 
 __all__ = [
+    "DisruptionStats",
+    "HealthGate",
     "HealthReport",
     "IciHealthGate",
+    "MonitorMetrics",
     "SliceScopedGate",
+    "SubprocessHealthGate",
     "LibtpuDaemonSetManager",
     "LibtpuSpec",
     "SliceAwareInplaceManager",
+    "SliceAwareRequestorManager",
     "TpuHealthMonitor",
     "TpuNodeDetector",
     "TpuNodeInfo",
     "ValidationPodManager",
     "ValidationPodSpec",
+    "disruption_stats",
     "enable_slice_aware_planning",
 ]
