@@ -180,14 +180,24 @@ def main(argv: list[str] | None = None) -> int:
     validation_pod_sim = None
     if args.validation_pod:
         from k8s_operator_libs_tpu.tpu import (
+            SliceProbeSpec,
             ValidationPodManager,
             ValidationPodSpec,
+            make_validation_provisioner,
         )
 
-        spec = ValidationPodSpec(namespace=args.namespace)
-        mgr.with_validation_enabled(
-            pod_provisioner=ValidationPodManager(client, spec)
-        )
+        if args.slice_aware:
+            # Production default for slice-aware TPU pools: one probe GANG
+            # per multi-host slice (jax.distributed world spanning every
+            # host, cross-host ICI links in the battery, one shared
+            # verdict); single-host slices fall back to per-node pods.
+            provisioner = make_validation_provisioner(
+                client, SliceProbeSpec(namespace=args.namespace)
+            )
+        else:
+            spec = ValidationPodSpec(namespace=args.namespace)
+            provisioner = ValidationPodManager(client, spec)
+        mgr.with_validation_enabled(pod_provisioner=provisioner)
         if args.demo:
             # The demo has no kubelet; simulate one running the probe pods.
             from k8s_operator_libs_tpu.kube.sim import ValidationPodSimulator

@@ -312,6 +312,21 @@ class Event(KubeObject):
     API_VERSION = "v1"
 
 
+class Service(KubeObject):
+    """Core v1 Service — used by the slice probe gang's headless
+    rendezvous Service (tpu/slice_gate.py); only metadata/spec surface."""
+
+    KIND = "Service"
+    API_VERSION = "v1"
+
+    @property
+    def cluster_ip(self) -> str:
+        return self.spec.get("clusterIP", "")
+
+    def is_headless(self) -> bool:
+        return self.cluster_ip == "None"
+
+
 class CustomResourceDefinition(KubeObject):
     KIND = "CustomResourceDefinition"
     API_VERSION = "apiextensions.k8s.io/v1"
@@ -389,6 +404,7 @@ KINDS: dict[str, Type[KubeObject]] = {
         DaemonSet,
         ControllerRevision,
         Event,
+        Service,
         CustomResourceDefinition,
         NodeMaintenance,
     )

@@ -279,10 +279,17 @@ class KubeletPayloadExecutor:
         env: Optional[dict] = None,
         extra_args: Optional[list[str]] = None,
         timeout_seconds: float = 600.0,
+        argv_transform: Optional[Callable[[Pod, list[str]], list[str]]] = None,
     ) -> None:
         self.env = env
         self.extra_args = list(extra_args or [])
         self.timeout_seconds = timeout_seconds
+        #: Hook rewriting a pod's argv before spawn — the cluster-DNS
+        #: analog: slice-gang pods address their coordinator by headless
+        #: Service DNS (`<pod0>.<svc>:<port>`), which has no resolver
+        #: here; the e2e maps it to 127.0.0.1 the way kube-dns would map
+        #: it to the pod IP.
+        self.argv_transform = argv_transform
         #: One record per tracked pod — single pop on release, so no
         #: partial-cleanup path can leave a stale verdict or ready-file
         #: behind for a later same-named pod.
@@ -296,6 +303,8 @@ class KubeletPayloadExecutor:
         (container,) = pod.spec["containers"]
         argv = list(container["command"]) + self.extra_args
         argv[0] = sys.executable  # "python" inside the image = this python
+        if self.argv_transform is not None:
+            argv = self.argv_transform(pod, argv)
         ready_file = os.path.join(self._tmpdir.name, f"{pod.name}.ready")
         if os.path.exists(ready_file):  # defensive: never trust a stale pass
             os.unlink(ready_file)

@@ -45,6 +45,34 @@ def _axis_size(mesh: Mesh, axis: str) -> int:
     return mesh.shape[axis]
 
 
+def _put(mesh: Mesh, axis: str, x: jax.Array) -> jax.Array:
+    """Shard ``x`` over the axis before the probe runs. Two reasons: the
+    timing probes must not fold the initial scatter from the default
+    device into every sample, and on a multi-process mesh (the slice-wide
+    gang) jit only accepts inputs already laid out as global arrays —
+    device_put with host-identical data is the supported way to build
+    one."""
+    return jax.device_put(x, jax.sharding.NamedSharding(mesh, P(axis)))
+
+
+def _local_parts(arr: jax.Array) -> list[tuple[int, np.ndarray]]:
+    """(global start offset, values) per addressable shard of a 1-D array.
+
+    Verification must read only addressable shards: on a multi-process
+    mesh ``np.asarray(arr)`` raises for spans this process cannot see.
+    Each process verifies its own shards; the cross-process agreement
+    collective (:func:`slice_agreement`) is what turns H local verdicts
+    into one slice-wide one. Single-process, the parts cover the whole
+    array, so the checks are exactly as strong as a full materialize.
+    """
+    parts = []
+    for shard in arr.addressable_shards:
+        index = shard.index
+        start = (index[0].start or 0) if index else 0
+        parts.append((start, np.asarray(shard.data)))
+    return parts
+
+
 #: Compiled-probe cache keyed by (probe, mesh, axis, extras). The probes
 #: close over the mesh, so a fresh jit wrapper per call would miss jax's
 #: jit cache and pay a full XLA (re)compile on EVERY gate run — ~0.5 s per
@@ -98,13 +126,14 @@ def psum_check(mesh: Mesh, axis: str) -> CollectiveReport:
 
     run = _cached("psum", mesh, axis, build)
     try:
-        x = jnp.arange(n, dtype=jnp.float32)
-        out = np.asarray(run(x))
+        x = _put(mesh, axis, jnp.arange(n, dtype=jnp.float32))
+        out = run(x)
         expected = n * (n - 1) / 2
-        ok = bool(np.all(out == expected))
+        got = [v for _, part in _local_parts(out) for v in part.tolist()]
+        ok = all(v == expected for v in got)
         return CollectiveReport(
             op="psum", ok=ok,
-            error="" if ok else f"expected {expected}, got {out.tolist()}",
+            error="" if ok else f"expected {expected}, got {got}",
         )
     except Exception as e:  # noqa: BLE001 - a failed lowering is a failed link
         return CollectiveReport(op="psum", ok=False, error=str(e))
@@ -128,12 +157,15 @@ def all_gather_check(mesh: Mesh, axis: str) -> CollectiveReport:
 
     run = _cached("all_gather", mesh, axis, build)
     try:
-        x = jnp.arange(n, dtype=jnp.float32)
-        out = np.asarray(run(x))
+        x = _put(mesh, axis, jnp.arange(n, dtype=jnp.float32))
+        out = run(x)
         # Every device gathers the full [0..n) vector; tiled output over the
         # axis is n copies -> total length n*n with repeating pattern.
         expected = np.tile(np.arange(n, dtype=np.float32), n)
-        ok = bool(np.array_equal(out, expected))
+        ok = all(
+            np.array_equal(part, expected[start:start + len(part)])
+            for start, part in _local_parts(out)
+        )
         return CollectiveReport(
             op="all_gather", ok=ok,
             error="" if ok else "gathered order mismatch",
@@ -171,19 +203,20 @@ def ppermute_ring(
 
     hop = _cached("ppermute_ring", mesh, axis, build, elems)
     try:
-        x = jnp.arange(n * elems, dtype=jnp.float32).reshape(n * elems)
-        # Pre-shard the payload onto the mesh: timing an unsharded input
-        # would fold the initial scatter from the default device into every
-        # sample and understate ring bandwidth.
-        x = jax.device_put(
-            x, jax.sharding.NamedSharding(mesh, P(axis))
+        x = _put(
+            mesh, axis,
+            jnp.arange(n * elems, dtype=jnp.float32).reshape(n * elems),
         )
         elapsed = _timed(lambda: hop(x))
         # Correctness: n hops return every shard to its origin.
         y = x
         for _ in range(n):
             y = hop(y)
-        ok = bool(np.array_equal(np.asarray(y), np.asarray(x)))
+        expected = dict(_local_parts(x))
+        ok = all(
+            np.array_equal(part, expected.get(start))
+            for start, part in _local_parts(y)
+        )
         payload_bytes = elems * 4
         return CollectiveReport(
             op="ppermute_ring",
@@ -214,15 +247,62 @@ def reduce_scatter_check(mesh: Mesh, axis: str) -> CollectiveReport:
 
     run = _cached("reduce_scatter", mesh, axis, build)
     try:
-        x = jnp.ones((n * n,), dtype=jnp.float32)
-        out = np.asarray(run(x))
-        ok = bool(np.all(out == n))
+        x = _put(mesh, axis, jnp.ones((n * n,), dtype=jnp.float32))
+        out = run(x)
+        got = [v for _, part in _local_parts(out) for v in part.tolist()]
+        ok = all(v == n for v in got)
         return CollectiveReport(
             op="reduce_scatter", ok=ok,
-            error="" if ok else f"expected all {n}, got {out.tolist()[:8]}...",
+            error="" if ok else f"expected all {n}, got {got[:8]}...",
         )
     except Exception as e:  # noqa: BLE001
         return CollectiveReport(op="reduce_scatter", ok=False, error=str(e))
+
+
+def slice_agreement(mesh: Mesh, axis: str, local_ok: bool) -> tuple[int, int]:
+    """Cross-process agreement: ``(devices that passed, total axis size)``.
+
+    The final step of the slice-wide gang battery: every process
+    contributes its local verdict to a psum over the mesh, so every
+    process learns whether EVERY process passed — one bad host fails the
+    whole gang, and the collective itself rides the same fabric under
+    test (a dead link fails the agreement too, which is the point).
+    Counted in devices, reported as all-or-nothing: per-device flags are
+    identical within a process, so ``passed == total`` iff every process
+    said ok.
+    """
+    n = _axis_size(mesh, axis)
+
+    def build():
+        @jax.jit
+        def run(x):
+            def body(shard):
+                return jax.lax.psum(shard, axis)
+
+            return shard_map(
+                body, mesh=mesh, in_specs=P(axis), out_specs=P(axis)
+            )(x)
+
+        return run
+
+    run = _cached("psum", mesh, axis, build)  # same program as psum_check
+    local = 1.0 if local_ok else 0.0
+    # The flag vector must reflect EACH process's own verdict, so it
+    # cannot be built with host-identical device_put; placing each local
+    # device's flag shard explicitly is exactly what
+    # make_array_from_single_device_arrays exists for.
+    local_devices = {d.id for d in jax.local_devices()}
+    sharding = jax.sharding.NamedSharding(mesh, P(axis))
+    arrays = [
+        jax.device_put(jnp.asarray([local], dtype=jnp.float32), dev)
+        for dev in mesh.devices.flat
+        if dev.id in local_devices
+    ]
+    x = jax.make_array_from_single_device_arrays((n,), sharding, arrays)
+    out = run(x)
+    passed = int(round(float(_local_parts(out)[0][1][0])))
+    log.info("slice agreement: %d/%d processes passed", passed, n)
+    return passed, n
 
 
 def run_ici_probes(

@@ -15,6 +15,11 @@ from .health import (
     SubprocessHealthGate,
 )
 from .monitor import MonitorMetrics, TpuHealthMonitor
+from .slice_gate import (
+    SliceProbeGangManager,
+    SliceProbeSpec,
+    make_validation_provisioner,
+)
 from .validation_pod import ValidationPodManager, ValidationPodSpec
 
 __all__ = [
@@ -29,6 +34,8 @@ __all__ = [
     "LibtpuSpec",
     "SliceAwareInplaceManager",
     "SliceAwareRequestorManager",
+    "SliceProbeGangManager",
+    "SliceProbeSpec",
     "TpuHealthMonitor",
     "TpuNodeDetector",
     "TpuNodeInfo",
@@ -36,4 +43,5 @@ __all__ = [
     "ValidationPodSpec",
     "disruption_stats",
     "enable_slice_aware_planning",
+    "make_validation_provisioner",
 ]

@@ -40,6 +40,11 @@ class TpuNodeDetector:
     def __init__(self, slice_id_label: str = TPU_SLICE_ID_LABEL) -> None:
         self._slice_id_label = slice_id_label
 
+    @property
+    def slice_id_label(self) -> str:
+        """The explicit slice-identity label this detector honors first."""
+        return self._slice_id_label
+
     @staticmethod
     def is_tpu_node(node: Node) -> bool:
         return GKE_TPU_ACCELERATOR_LABEL in (node.metadata.get("labels") or {})

@@ -1,0 +1,403 @@
+"""Slice-wide multi-host validation gate — one probe gang per slice.
+
+The per-node probe pod (``tpu/validation_pod.py``) exercises only the
+upgraded node's own chips; on a multi-host slice the **cross-host ICI
+links** — exactly what a libtpu bump can break — are never touched by that
+shape. This module provisions a probe *gang* instead: one pod per host of
+the slice, rendezvoused via ``jax.distributed.initialize`` into a single
+JAX world, running ONE collective battery over the slice's full fabric
+(the generalization of the reference's per-node validation pod demanded by
+SURVEY §7 step 6; pod-gate semantics per validation_manager.go:71-116).
+
+How one shared run gates every member node:
+
+* every gang pod runs the same payload (``tpu.health`` CLI) with
+  ``--num-processes H --process-id i``; the collective probes span all
+  H hosts' devices, so psum/all-gather/ring traffic rides the cross-host
+  links;
+* the battery ends with a cross-process **agreement collective** (a psum
+  of per-process pass flags): each process learns whether EVERY process
+  passed, and writes its ready-file only on unanimous pass — one bad host
+  fails every pod of the gang;
+* ``ValidationManager``'s per-node pod-readiness check then reads the
+  node-local gang pod — whose Ready condition now carries the slice-wide
+  verdict. No new gate plumbing: the reference-shaped pod_selector gate
+  *is* the slice gate.
+
+Rendezvous uses an Indexed-Job-style stable DNS scheme: pods set
+``hostname``/``subdomain`` against a headless Service, so rank 0's address
+is known at pod-creation time (``<pod0>.<svc>:<port>``) with no controller
+in the loop.
+
+Single-host slices (and non-TPU nodes) fall back to the per-node
+``ValidationPodManager`` shape unchanged.
+
+Operational constraint: the gang requests every member host's full chip
+complement, so it only forms when the whole slice is drained together —
+i.e. under slice-aware planning (``enable_slice_aware_planning``), which
+cordons/drains slices as units. Under per-node planning a gang pod on a
+still-busy host would pend and validation would time out; use the
+per-node ``ValidationPodManager`` there instead.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+from dataclasses import dataclass
+from typing import Optional
+
+from ..kube.client import AlreadyExistsError, Client, NotFoundError
+from ..kube.objects import Node, Pod, Service
+from ..parallel.topology import GKE_NODEPOOL_LABEL
+from ..upgrade.consts import UpgradeKeys, UpgradeState
+from ..utils.log import get_logger
+from .detector import TpuNodeDetector
+from .validation_pod import (
+    VALIDATION_APP,
+    VALIDATION_APP_LABEL,
+    ValidationPodManager,
+    ValidationPodSpec,
+)
+
+log = get_logger("tpu.slice_gate")
+
+#: Gang bookkeeping labels (the readiness selector stays VALIDATION_APP so
+#: one pod_selector gate watches both the gang and the per-node fallback).
+GANG_SLICE_LABEL = "tpu-operator.dev/slice-gang"
+GANG_GENERATION_LABEL = "tpu-operator.dev/gang-generation"
+GANG_RANK_LABEL = "tpu-operator.dev/gang-rank"
+
+#: Port rank 0 serves the jax.distributed coordinator on.
+DEFAULT_COORDINATOR_PORT = 8476
+
+#: States in which a slice member still depends on its gang: anywhere in
+#: the upgrade pipeline before the validation verdict has been consumed.
+#: Deliberately excludes FAILED — keeping the gang alive for a failed
+#: node would leave parked pods holding every healthy member's chips
+#: after those members uncordon; a failed node's re-validation instead
+#: provisions a fresh generation (which, on a pool whose peers resumed
+#: workloads, pends until chips free up — fail-closed quarantine).
+_GANG_CONSUMER_STATES: frozenset[str] = frozenset(
+    str(s)
+    for s in (
+        UpgradeState.CORDON_REQUIRED,
+        UpgradeState.WAIT_FOR_JOBS_REQUIRED,
+        UpgradeState.POD_DELETION_REQUIRED,
+        UpgradeState.DRAIN_REQUIRED,
+        UpgradeState.NODE_MAINTENANCE_REQUIRED,
+        UpgradeState.POST_MAINTENANCE_REQUIRED,
+        UpgradeState.POD_RESTART_REQUIRED,
+        UpgradeState.VALIDATION_REQUIRED,
+    )
+)
+
+
+def slice_slug(slice_id: str) -> str:
+    """DNS-safe, collision-resistant name fragment for a slice id (slice
+    ids are label VALUES — node-pool names, free-form overrides — with no
+    pod-name character guarantees)."""
+    cleaned = re.sub(r"[^a-z0-9-]+", "-", slice_id.lower()).strip("-")[:20]
+    digest = hashlib.sha256(slice_id.encode()).hexdigest()[:6]
+    return f"{cleaned}-{digest}" if cleaned else digest
+
+
+@dataclass
+class SliceProbeSpec(ValidationPodSpec):
+    """Gang shape = per-node probe shape + the rendezvous port."""
+
+    coordinator_port: int = DEFAULT_COORDINATOR_PORT
+
+
+class SliceProbeGangManager:
+    """PodProvisioner that provisions one probe gang per multi-host slice.
+
+    Plugs into ``ValidationManager`` exactly like ``ValidationPodManager``
+    (``ensure`` before each readiness check, ``cleanup`` after the node
+    passes); single-host slices delegate to a per-node manager built from
+    the same spec, so one provisioner serves mixed pools.
+
+    Gang lifecycle: generations. A gang is *viable* for a node when the
+    node's own pod is Ready (verdict already in) or when the full current
+    generation exists with every member live. Anything else — a crashed
+    member, changed slice membership, a half-deleted set — cannot complete
+    the collective rendezvous, so ``ensure`` replaces the ENTIRE gang with
+    a fresh generation (monotonic label, never reusing pod names) rather
+    than leaving peers to hang against a dead rank.
+    """
+
+    def __init__(
+        self,
+        client: Client,
+        spec: Optional[SliceProbeSpec] = None,
+        detector: Optional[TpuNodeDetector] = None,
+    ) -> None:
+        self.client = client
+        self.spec = spec or SliceProbeSpec()
+        self.detector = detector or TpuNodeDetector()
+        self._keys = UpgradeKeys(self.spec.device)
+        self._fallback = ValidationPodManager(client, self.spec)
+
+    # -- slice membership --------------------------------------------------
+    def slice_members(self, node: Node) -> tuple[str, list[str]]:
+        """(slice_id, sorted member node names) for the node's slice.
+
+        Membership is observed (nodes currently carrying the slice id),
+        not declared: the gang must match the hosts that exist NOW — a
+        repaired pool with a replaced host still forms a full gang. The
+        node list is label-selected (slice identity IS a label), so the
+        scan is O(slice), not O(cluster).
+        """
+        info = self.detector.detect(node)
+        if info is None:
+            return node.name, [node.name]
+        labels = node.metadata.get("labels") or {}
+        selector = None
+        for label in (self.detector.slice_id_label, GKE_NODEPOOL_LABEL):
+            if labels.get(label) == info.slice_id:
+                selector = f"{label}={info.slice_id}"
+                break
+        if selector is None:
+            # slice id fell back to the node's own name: single-host slice
+            return info.slice_id, [node.name]
+        members = []
+        for obj in self.client.list("Node", label_selector=selector):
+            candidate = Node(obj.raw)
+            candidate_info = self.detector.detect(candidate)
+            # e.g. an explicit slice-id label can carve a node out of its
+            # node pool — the detector's verdict wins over the selector.
+            if candidate_info is not None and (
+                candidate_info.slice_id == info.slice_id
+            ):
+                members.append(candidate.name)
+        if node.name not in members:
+            members.append(node.name)
+        return info.slice_id, sorted(members)
+
+    # -- naming ------------------------------------------------------------
+    def service_name(self, slice_id: str) -> str:
+        return f"{VALIDATION_APP}-{slice_slug(slice_id)}"
+
+    def pod_name(self, slice_id: str, generation: int, rank: int) -> str:
+        return f"{VALIDATION_APP}-{slice_slug(slice_id)}-g{generation}-{rank}"
+
+    # -- gang construction -------------------------------------------------
+    def build_service(self, slice_id: str) -> Service:
+        svc = Service.new(self.service_name(slice_id), namespace=self.spec.namespace)
+        svc.labels[VALIDATION_APP_LABEL] = VALIDATION_APP
+        svc.labels[GANG_SLICE_LABEL] = slice_slug(slice_id)
+        svc.spec.update(
+            {
+                # Headless: DNS A records per pod, no VIP — the
+                # Indexed-Job rendezvous pattern.
+                "clusterIP": "None",
+                "selector": {GANG_SLICE_LABEL: slice_slug(slice_id)},
+                "ports": [
+                    {
+                        "name": "coordinator",
+                        "port": self.spec.coordinator_port,
+                    }
+                ],
+            }
+        )
+        return svc
+
+    def build_gang_pod(
+        self,
+        slice_id: str,
+        generation: int,
+        rank: int,
+        members: list[str],
+    ) -> Pod:
+        spec = self.spec
+        name = self.pod_name(slice_id, generation, rank)
+        svc = self.service_name(slice_id)
+        coordinator = (
+            f"{self.pod_name(slice_id, generation, 0)}.{svc}:"
+            f"{spec.coordinator_port}"
+        )
+        pod = self._fallback.build_pod(members[rank])
+        pod.metadata["name"] = name
+        pod.labels[GANG_SLICE_LABEL] = slice_slug(slice_id)
+        pod.labels[GANG_GENERATION_LABEL] = str(generation)
+        pod.labels[GANG_RANK_LABEL] = str(rank)
+        # Stable DNS: <hostname>.<subdomain> resolves in-namespace once the
+        # headless Service exists — known BEFORE any pod starts, which is
+        # what lets every rank carry the coordinator address in its argv.
+        pod.spec["hostname"] = name
+        pod.spec["subdomain"] = svc
+        (container,) = pod.spec["containers"]
+        container["command"] = container["command"] + [
+            "--coordinator", coordinator,
+            "--num-processes", str(len(members)),
+            "--process-id", str(rank),
+        ]
+        container["ports"] = [{"containerPort": spec.coordinator_port}]
+        return pod
+
+    # -- provisioner protocol ----------------------------------------------
+    def ensure(self, node: Node) -> Pod:
+        slice_id, members = self.slice_members(node)
+        if len(members) == 1:
+            # Per-node fallback for single-host pools (and non-TPU nodes):
+            # there is no cross-host fabric, so the gang degenerates to
+            # exactly the reference-shaped per-node probe.
+            return self._fallback.ensure(node)
+
+        slug = slice_slug(slice_id)
+        # Terminating pods are invisible here: on a real apiserver a
+        # deleted pod lingers with a deletionTimestamp for seconds, and
+        # counting one as "mine"/"finished" would churn a fresh healthy
+        # generation every reconcile until it finally vanishes.
+        pods = [
+            p
+            for p in (
+                Pod(o.raw)
+                for o in self.client.list(
+                    "Pod",
+                    namespace=self.spec.namespace,
+                    label_selector=f"{GANG_SLICE_LABEL}={slug}",
+                )
+            )
+            if p.deletion_timestamp is None
+        ]
+        generation = max(
+            (int(p.labels.get(GANG_GENERATION_LABEL, "0")) for p in pods),
+            default=0,
+        )
+        current = [
+            p
+            for p in pods
+            if p.labels.get(GANG_GENERATION_LABEL) == str(generation)
+        ]
+        mine = next((p for p in current if p.node_name == node.name), None)
+        if mine is not None and mine.is_ready():
+            return mine  # verdict already in — never disturb a Ready gang
+        if mine is not None and not mine.is_finished():
+            complete = (
+                len(current) == len(members)
+                and {p.node_name for p in current} == set(members)
+                and not any(p.is_finished() for p in current)
+            )
+            if complete:
+                return mine
+        # Not viable: stale membership, a finished member, or a
+        # half-deleted set. Replace the WHOLE gang — a partial gang can
+        # never complete its rendezvous.
+        for p in pods:
+            try:
+                self.client.delete("Pod", p.name, self.spec.namespace)
+            except NotFoundError:
+                pass
+        generation += 1
+        log.info(
+            "slice %s: provisioning probe gang generation %d across %d "
+            "hosts (%s)",
+            slice_id, generation, len(members), ", ".join(members),
+        )
+        self._ensure_service(slice_id)
+        result: Optional[Pod] = None
+        for rank, member in enumerate(members):
+            desired = self.build_gang_pod(slice_id, generation, rank, members)
+            try:
+                created = Pod(self.client.create(desired).raw)
+            except AlreadyExistsError:
+                created = Pod(
+                    self.client.get(
+                        "Pod", desired.name, self.spec.namespace
+                    ).raw
+                )
+            if member == node.name:
+                result = created
+        assert result is not None  # node is always a member
+        return result
+
+    def cleanup(self, node: Node) -> None:
+        """Tear the gang down — but only once the LAST consumer is done.
+
+        Deleting any single pod would collapse the shared JAX world
+        (killing rank 0 takes the coordinator; killing any rank breaks
+        the distributed runtime's heartbeats), destroying peers' parked
+        Ready pods before their own gates read them. So per-node cleanup
+        defers while any OTHER member is still in the upgrade pipeline;
+        the last node to pass deletes every gang pod plus the rendezvous
+        Service in one sweep. Under slice-aware planning the members pass
+        in the same reconcile pass (the agreement verdict lands on all
+        pods at once), so chips release promptly anyway.
+        """
+        info = self.detector.detect(node)
+        if info is None:
+            self._fallback.cleanup(node)
+            return
+        slice_id, members = self.slice_members(node)
+        if len(members) > 1:
+            waiting = []
+            for name in members:
+                if name == node.name:
+                    continue
+                obj = self.client.get_or_none("Node", name)
+                if obj is None:
+                    continue
+                state = Node(obj.raw).labels.get(self._keys.state_label, "")
+                if state in _GANG_CONSUMER_STATES:
+                    waiting.append(name)
+            if waiting:
+                log.info(
+                    "slice %s: keeping probe gang alive for %s",
+                    slice_id, ", ".join(waiting),
+                )
+                return
+        slug = slice_slug(slice_id)
+        for obj in self.client.list(
+            "Pod",
+            namespace=self.spec.namespace,
+            label_selector=f"{GANG_SLICE_LABEL}={slug}",
+        ):
+            try:
+                self.client.delete(
+                    "Pod", Pod(obj.raw).name, self.spec.namespace
+                )
+            except NotFoundError:
+                pass
+        try:
+            self.client.delete(
+                "Service", self.service_name(slice_id), self.spec.namespace
+            )
+        except NotFoundError:
+            pass
+        # Single-host fallback pods are named per-node; clear those too.
+        self._fallback.cleanup(node)
+
+    def _ensure_service(self, slice_id: str) -> None:
+        desired = self.build_service(slice_id)
+        try:
+            self.client.create(desired)
+        except AlreadyExistsError:
+            pass
+
+
+def make_validation_provisioner(
+    client: Client,
+    spec: Optional[SliceProbeSpec] = None,
+    detector: Optional[TpuNodeDetector] = None,
+) -> SliceProbeGangManager:
+    """The production validation-pod provisioner for TPU pools: slice
+    gangs on multi-host slices, per-node probe pods everywhere else. Pass
+    it as ``with_validation_enabled(pod_provisioner=...)`` — the pod
+    selector is supplied automatically from ``spec.pod_selector``. Pair
+    with ``enable_slice_aware_planning``: the gang needs every member
+    host's chips at once, which only holds when the whole slice is
+    drained together."""
+    return SliceProbeGangManager(client, spec, detector)
+
+
+__all__ = [
+    "DEFAULT_COORDINATOR_PORT",
+    "GANG_GENERATION_LABEL",
+    "GANG_RANK_LABEL",
+    "GANG_SLICE_LABEL",
+    "SliceProbeGangManager",
+    "SliceProbeSpec",
+    "make_validation_provisioner",
+    "slice_slug",
+]
