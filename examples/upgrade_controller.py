@@ -311,6 +311,7 @@ def main(argv: list[str] | None = None) -> int:
 
     passes = 0
     max_demo_passes = 100  # a 4-node roll converges in <15; 100 = stuck
+    consecutive_failures = 0
     while True:
         passes += 1
         if sim is not None and passes > max_demo_passes:
@@ -335,15 +336,22 @@ def main(argv: list[str] | None = None) -> int:
             # controller — the next idempotent pass resumes from labels
             # (upgrade_state.go:49-52). Transient snapshot incompleteness
             # (a driver pod mid-recreate fails the unscheduled-pods guard)
-            # heals by itself; requeue shortly rather than wait for a
-            # watch event, because the event that exposed the race may
-            # have been the last one.
+            # heals by itself in a requeue or two; a PERSISTENT error (bad
+            # RBAC, wrong namespace) must not spin a tight log loop, so
+            # the requeue backs off exponentially — 0.5 s doubling to
+            # 30 s — and resets on the next successful pass.
+            consecutive_failures += 1
+            # Cap the exponent BEFORE raising 2 to it: a persistent error
+            # left overnight would otherwise overflow float conversion.
+            delay = min(0.5 * 2 ** min(consecutive_failures - 1, 10), 30.0)
             print(
-                f"pass {passes}: reconcile failed (will retry): {e}",
+                f"pass {passes}: reconcile failed "
+                f"(retry #{consecutive_failures} in {delay:.1f}s): {e}",
                 file=sys.stderr,
             )
-            time.sleep(0.0 if sim is not None else 0.5)
+            time.sleep(0.0 if sim is not None else delay)
             continue
+        consecutive_failures = 0
         if metrics is not None:
             metrics.observe(state)
         if sim is not None:

@@ -129,30 +129,50 @@ def wait_for_crds(
     crds: Sequence[CustomResourceDefinition],
     timeout_seconds: float | None = None,
 ) -> None:
-    """Poll until every CRD is Established with all its served versions
-    present (reference: crdutil.go:275-319 polls discovery per version).
+    """Poll the DISCOVERY endpoint until every CRD's every served version
+    actually serves its resource (reference: crdutil.go:275-319 — one
+    discovery request per served group/version, resource plural present).
+
+    Discovery, not the CRD's status: an Established condition flips
+    before the version lands in the discovery document, and a consumer
+    that creates CRs the moment Established shows can still race a 404.
+    Polling what was just written (status) would be near-tautological;
+    polling discovery proves the apiserver can route the resource.
 
     ``timeout_seconds=None`` reads ESTABLISH_TIMEOUT_SECONDS at call time so
     it can be overridden module-wide."""
     if timeout_seconds is None:
         timeout_seconds = ESTABLISH_TIMEOUT_SECONDS
     deadline = time.monotonic() + timeout_seconds
-    pending = {crd.name: crd for crd in crds}
+    #: (crd name, group, version, plural) still awaited.
+    pending: set[tuple[str, str, str, str]] = {
+        (crd.name, crd.group, version, crd.plural)
+        for crd in crds
+        for version in crd.served_versions
+    }
     while pending:
-        for name in list(pending):
-            current = client.get_or_none(CRD_KIND, name)
-            if current is None:
-                continue
-            cur = CustomResourceDefinition(current.raw)
-            wanted = set(pending[name].served_versions)
-            if cur.is_established() and wanted.issubset(set(cur.served_versions)):
-                del pending[name]
+        # One discovery GET per distinct group/version per round — CRDs
+        # overwhelmingly share a group, and repeating the identical
+        # request per CRD would multiply apiserver load for nothing.
+        by_gv: dict[tuple[str, str], list[tuple[str, str, str, str]]] = {}
+        for entry in pending:
+            by_gv.setdefault((entry[1], entry[2]), []).append(entry)
+        for (group, version), entries in sorted(by_gv.items()):
+            try:
+                resources = client.discover(group, version)
+            except NotFoundError:
+                continue  # group/version not discoverable yet
+            served = {r.get("name") for r in resources}
+            for entry in entries:
+                if entry[3] in served:
+                    pending.discard(entry)
         if not pending:
             return
         if time.monotonic() > deadline:
+            names = sorted({f"{e[0]} ({e[2]})" for e in pending})
             raise CRDProcessingError(
-                f"timed out waiting for CRDs to become established: "
-                f"{sorted(pending)}"
+                "timed out waiting for CRD versions to become "
+                f"discoverable: {names}"
             )
         time.sleep(ESTABLISH_POLL_INTERVAL_SECONDS)
 

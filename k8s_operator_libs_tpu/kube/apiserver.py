@@ -37,6 +37,15 @@ _PATH_RE = re.compile(
     r"(?:/(?P<subresource>status|eviction))?$"
 )
 
+#: GET /api/v1 or /apis/<group>/<version> with no resource segment =
+#: API discovery (the endpoint crdutil's wait-for-established polls).
+#: Core discovery lives ONLY at /api/<version>; /apis/<version> with no
+#: group is a 404 on a real apiserver, so it must be one here too.
+_DISCOVERY_RE = re.compile(
+    r"^(?:/api/(?P<core_version>v[^/]+)"
+    r"|/apis/(?P<group>[^/]+)/(?P<version>v[^/]+))$"
+)
+
 
 def _status_body(code: int, reason: str, message: str) -> dict[str, Any]:
     return {
@@ -107,6 +116,16 @@ class _Handler(BaseHTTPRequestHandler):
                 401, _status_body(401, "Unauthorized", "invalid bearer token")
             )
             return
+        if verb == "GET":
+            parsed = urllib.parse.urlparse(self.path)
+            discovery = _DISCOVERY_RE.match(parsed.path)
+            if discovery is not None:
+                core = discovery.group("core_version")
+                self._do_discovery(
+                    "" if core else discovery.group("group"),
+                    core or discovery.group("version"),
+                )
+                return
         route = self._route()
         if route is None:
             self._send_json(
@@ -123,6 +142,27 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_error(e)
         except Exception as e:  # noqa: BLE001 - surfaced as 500 Status
             self._send_json(500, _status_body(500, "InternalError", str(e)))
+
+    def _do_discovery(self, group: str, version: str) -> None:
+        """Serve the APIResourceList discovery document (what the real
+        apiserver returns for /apis/<group>/<version>); 404 while the
+        group/version is not yet servable — the Established-but-
+        undiscoverable window crdutil polls through."""
+        try:
+            resources = self.server.cluster.discover(group, version)
+        except ApiError as e:
+            self._send_error(e)
+            return
+        gv = f"{group}/{version}" if group else version
+        self._send_json(
+            200,
+            {
+                "kind": "APIResourceList",
+                "apiVersion": "v1",
+                "groupVersion": gv,
+                "resources": resources,
+            },
+        )
 
     # -- verbs -------------------------------------------------------------
     def _do_get(self, cluster, info, namespace, name, subresource, query):

@@ -179,3 +179,8 @@ class CachedClient(Client):
 
     def evict(self, pod_name: str, namespace: str = "") -> None:
         return self.backing.evict(pod_name, namespace)
+
+    def discover(self, group: str, version: str) -> list:
+        # Discovery is never cached (the poll exists to observe the
+        # apiserver's CURRENT routing table).
+        return self.backing.discover(group, version)

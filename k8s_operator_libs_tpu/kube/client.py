@@ -89,6 +89,19 @@ class Client(abc.ABC):
             f"{type(self).__name__} does not support watch"
         )
 
+    def discover(self, group: str, version: str) -> list[dict[str, Any]]:
+        """API discovery: the resources served under ``group/version``
+        (``group=""`` = the core group), as APIResourceList entries
+        (``{"name": plural, "kind": ..., "namespaced": ...}``). Raises
+        NotFoundError while the group/version is not yet discoverable —
+        the signal crdutil's wait-for-established polls on (reference:
+        pkg/crdutil/crdutil.go:275-319 polls the discovery endpoint per
+        served version; Established alone does not guarantee the version
+        is servable)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support discovery"
+        )
+
     @abc.abstractmethod
     def create(self, obj: KubeObject) -> KubeObject: ...
 

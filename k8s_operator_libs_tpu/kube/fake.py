@@ -34,7 +34,7 @@ from .client import (
     NotFoundError,
     WatchExpiredError,
 )
-from .objects import KINDS, KubeObject, wrap
+from .objects import KINDS, CustomResourceDefinition, KubeObject, wrap
 from .selectors import LabelSelector, parse_field_selector, parse_selector
 
 #: reactor signature: (verb, kind, payload) -> None; raise to inject a failure.
@@ -203,6 +203,7 @@ class FakeCluster(Client):
         self,
         auto_establish_crds: bool = True,
         crd_establish_delay: float = 0.0,
+        crd_discovery_delay: float = 0.0,
     ) -> None:
         self._lock = threading.RLock()
         self._store: dict[tuple[str, str, str], dict[str, Any]] = {}
@@ -224,6 +225,12 @@ class FakeCluster(Client):
         # wait-for-established logic, reference: pkg/crdutil/crdutil.go:275-319).
         self._auto_establish_crds = auto_establish_crds
         self._crd_establish_delay = crd_establish_delay
+        # The real apiserver's Established-but-undiscoverable window: a
+        # CRD's condition flips before its served versions appear in the
+        # discovery document (the race pkg/crdutil/crdutil.go:275-319
+        # polls discovery to guard against). >0 reproduces that window.
+        self._crd_discovery_delay = crd_discovery_delay
+        self._discoverable: dict[str, set[str]] = {}
         self._pending_timers: list[threading.Timer] = []
 
     # -- fault injection ---------------------------------------------------
@@ -451,6 +458,8 @@ class FakeCluster(Client):
         meta = data.get("metadata", {})
         if meta.get("deletionTimestamp") and not meta.get("finalizers"):
             del self._store[key]
+            if kind == "CustomResourceDefinition":
+                self._discoverable.pop(name, None)
             # The real apiserver bumps rv on delete; without it the
             # DELETED journal entry reuses the object's last revision and
             # a watch resuming from exactly that revision replays PAST the
@@ -526,8 +535,14 @@ class FakeCluster(Client):
             self._bump(data)
             self._store[key] = data
             self._emit(_WATCH_ADDED, data)
-            if kind == "CustomResourceDefinition" and self._auto_establish_crds:
-                if self._crd_establish_delay > 0:
+            if kind == "CustomResourceDefinition":
+                # A re-created CRD must not inherit a predecessor's
+                # discoverability (its served versions may differ).
+                self._discoverable.pop(obj.name, None)
+                if not self._auto_establish_crds:
+                    # Manual-controller mode: honor a pre-set condition.
+                    self._sync_crd_discoverability_locked(data)
+                elif self._crd_establish_delay > 0:
                     timer = threading.Timer(
                         self._crd_establish_delay, self._establish_crd, (obj.name,)
                     )
@@ -546,6 +561,36 @@ class FakeCluster(Client):
             conds.append({"type": "Established", "status": "True"})
             self._bump(data)
             self._emit(_WATCH_MODIFIED, data, old=old)
+        self._sync_crd_discoverability_locked(data)
+
+    def _sync_crd_discoverability_locked(self, data: dict[str, Any]) -> None:
+        """An Established CRD becomes discoverable after the configured
+        window. Runs on every CRD write path — including manual status
+        writes with auto-establishment off, so tests that play the CRD
+        controller themselves still reach discoverability."""
+        crd = CustomResourceDefinition(data)
+        if not crd.is_established() or crd.name in self._discoverable:
+            return
+        if self._crd_discovery_delay > 0:
+            timer = threading.Timer(
+                self._crd_discovery_delay, self._make_discoverable, (crd.name,)
+            )
+            timer.daemon = True
+            self._pending_timers.append(timer)
+            timer.start()
+        else:
+            self._make_discoverable_locked(data)
+
+    def _make_discoverable_locked(self, data: dict[str, Any]) -> None:
+        crd = CustomResourceDefinition(data)
+        self._discoverable[crd.name] = set(crd.served_versions)
+
+    def _make_discoverable(self, name: str) -> None:
+        with self._lock:
+            key = self._key("CustomResourceDefinition", "", name)
+            data = self._store.get(key)
+            if data is not None:
+                self._make_discoverable_locked(data)
 
     def _establish_crd(self, name: str) -> None:
         with self._lock:
@@ -553,6 +598,46 @@ class FakeCluster(Client):
             data = self._store.get(key)
             if data is not None:
                 self._establish_crd_locked(data)
+
+    def discover(self, group: str, version: str) -> list[dict[str, Any]]:
+        """APIResourceList entries for ``group/version`` — built-in kinds
+        from the resource registry plus established CRDs whose served
+        version has become discoverable. NotFoundError while nothing
+        serves the group/version, exactly what a real apiserver's 404
+        means to a discovery poller."""
+        from .resources import _REGISTRY  # registry is the builtin catalog
+
+        gv = f"{group}/{version}" if group else version
+        resources: list[dict[str, Any]] = []
+        for info in _REGISTRY.values():
+            if info.api_version == gv:
+                resources.append(
+                    {
+                        "name": info.plural,
+                        "kind": info.kind,
+                        "namespaced": info.namespaced,
+                    }
+                )
+        with self._lock:
+            for (kind, _, _), data in list(self._store.items()):
+                if kind != "CustomResourceDefinition":
+                    continue
+                crd = CustomResourceDefinition(data)
+                if crd.group != group:
+                    continue
+                if version not in self._discoverable.get(crd.name, ()):
+                    continue
+                names = crd.spec.get("names") or {}
+                resources.append(
+                    {
+                        "name": names.get("plural", ""),
+                        "kind": names.get("kind", ""),
+                        "namespaced": crd.spec.get("scope") != "Cluster",
+                    }
+                )
+        if not resources:
+            raise NotFoundError(f"no resources discoverable for {gv}")
+        return resources
 
     def _replace(self, obj: KubeObject, status_only: bool) -> KubeObject:
         kind = obj.raw.get("kind", "")
@@ -587,6 +672,18 @@ class FakeCluster(Client):
                 self._store[self._key(kind, obj.namespace, obj.name)] = data
             self._bump(data)
             self._emit(_WATCH_MODIFIED, data, old=old)
+            if kind == "CustomResourceDefinition":
+                if not status_only:
+                    # A spec update can add served versions; the new
+                    # version becomes discoverable like a fresh CRD's
+                    # would (after the configured window).
+                    self._discoverable.pop(obj.name, None)
+                if not status_only and self._auto_establish_crds:
+                    self._establish_crd_locked(data)
+                else:
+                    # Manual-controller mode (or a status write): honor an
+                    # Established condition however it got there.
+                    self._sync_crd_discoverability_locked(data)
             self._finalize_delete_if_due(kind, obj.name, obj.namespace)
             return wrap(copy.deepcopy(data))
 
@@ -624,6 +721,8 @@ class FakeCluster(Client):
             meta["name"] = name
             self._bump(current)
             self._emit(_WATCH_MODIFIED, current, old=old)
+            if kind == "CustomResourceDefinition":
+                self._sync_crd_discoverability_locked(current)
             self._finalize_delete_if_due(kind, name, namespace)
             return wrap(copy.deepcopy(current))
 
@@ -647,6 +746,8 @@ class FakeCluster(Client):
                     self._emit(_WATCH_MODIFIED, data, old=old)
                 return
             del self._store[key]
+            if kind == "CustomResourceDefinition":
+                self._discoverable.pop(name, None)
             self._bump(data)  # see _finalize_delete_if_due: rv moves on delete
             self._emit(_WATCH_DELETED, data)
 

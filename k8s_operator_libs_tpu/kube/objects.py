@@ -344,6 +344,10 @@ class CustomResourceDefinition(KubeObject):
             if v.get("served", False)
         ]
 
+    @property
+    def plural(self) -> str:
+        return (self.spec.get("names") or {}).get("plural", "")
+
     def is_established(self) -> bool:
         return condition_status(self.status, "Established") == "True"
 

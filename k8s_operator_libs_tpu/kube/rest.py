@@ -404,6 +404,15 @@ class RestClient(Client):
         info = resource_for_kind(kind)
         return wrap(self._request("GET", self._path(info, namespace, name)))
 
+    def discover(self, group: str, version: str) -> list[dict]:
+        """GET the APIResourceList for ``group/version`` (the discovery
+        document; 404 → NotFoundError while undiscoverable). Reference:
+        pkg/crdutil/crdutil.go:275-319 polls this endpoint per served
+        version."""
+        path = f"/apis/{group}/{version}" if group else f"/api/{version}"
+        doc = self._request("GET", path)
+        return list(doc.get("resources") or [])
+
     def _selector_query(
         self,
         label_selector: Optional[str | Mapping[str, str]],

@@ -91,7 +91,9 @@ class MonitorMetrics:
             self._errors_total += 1
 
     def render(self) -> str:
-        label = f'{{node="{self._node}"}}'
+        from ..upgrade.metrics import prom_label
+
+        label = prom_label("node", self._node)
         with self._lock:
             rows = [
                 ("probes_total", "counter",

@@ -281,6 +281,23 @@ class SliceProbeGangManager:
             )
             if complete:
                 return mine
+        # Replacement would destroy PEERS' Ready pods too — verdicts their
+        # own gates may not have consumed yet (e.g. a repaired host joins
+        # a slice whose gang just passed). Defer by failing THIS node's
+        # provisioning (its validation clock keeps running); once every
+        # peer consumes its verdict the gang is swept and a fresh full
+        # generation can form.
+        ready_peers = [
+            p.node_name
+            for p in current
+            if p.node_name != node.name and p.is_ready()
+        ]
+        if ready_peers:
+            raise RuntimeError(
+                f"slice {slice_id}: probe gang is mid-consumption (Ready "
+                f"pods on {', '.join(sorted(ready_peers))}); deferring "
+                f"re-provisioning for node {node.name}"
+            )
         # Not viable: stale membership, a finished member, or a
         # half-deleted set. Replace the WHOLE gang — a partial gang can
         # never complete its rendezvous.

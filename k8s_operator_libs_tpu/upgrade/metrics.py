@@ -29,6 +29,18 @@ class Renderable(Protocol):
 log = get_logger("upgrade.metrics")
 
 
+def prom_label(name: str, value: str) -> str:
+    """One ``{name="value"}`` label set with the value escaped per the
+    Prometheus text-exposition spec (backslash, double-quote, newline).
+    Collectors must build label strings through this — interpolating a
+    raw value (a node name from the API, say) would emit an invalid
+    exposition line the moment the value carries a quote."""
+    escaped = (
+        value.replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+    )
+    return f'{{{name}="{escaped}"}}'
+
+
 def render_rows(prefix: str, label: str, rows) -> str:
     """The ONE Prometheus text-exposition emitter (# HELP / # TYPE /
     name{label} value) shared by every collector in the framework
@@ -82,7 +94,7 @@ class UpgradeMetrics:
                 self._values[suffix] = getattr(self._manager, accessor)(state)
 
     def render(self) -> str:
-        label = f'{{device="{self._device}"}}'
+        label = prom_label("device", self._device)
         with self._lock:
             rows = [
                 (suffix, "gauge", help_text, self._values.get(suffix, 0))

@@ -103,6 +103,83 @@ class TestApply:
         wait_for_crds(cluster, crds, timeout_seconds=1)
 
 
+class TestDiscoveryWait:
+    """wait_for_crds polls DISCOVERY, not the CRD's own status — the
+    Established-but-undiscoverable race (crdutil.go:275-319)."""
+
+    def test_established_but_undiscoverable_blocks_the_wait(self):
+        import time
+
+        # Established immediately; discovery catches up 0.4 s later — the
+        # real apiserver's window between the condition flip and the
+        # version appearing in the discovery document.
+        cluster = FakeCluster(crd_discovery_delay=0.4)
+        start = time.monotonic()
+        process_crds(cluster, [NESTED], "apply")
+        elapsed = time.monotonic() - start
+        crd = cluster.get("CustomResourceDefinition", "deeps.example.dev")
+        assert crd.is_established()
+        # A status-poll would have returned instantly; the discovery poll
+        # had to ride out the window.
+        assert elapsed >= 0.4, elapsed
+
+    def test_wait_times_out_when_never_discoverable(self, monkeypatch):
+        cluster = FakeCluster(crd_discovery_delay=60.0)
+        monkeypatch.setattr(
+            "k8s_operator_libs_tpu.crdutil.crdutil.ESTABLISH_TIMEOUT_SECONDS",
+            0.3,
+        )
+        with pytest.raises(CRDProcessingError, match="discoverable"):
+            process_crds(cluster, [NESTED], "apply")
+        # ...even though the CRD object itself reports Established.
+        crd = cluster.get("CustomResourceDefinition", "deeps.example.dev")
+        assert crd.is_established()
+
+    def test_discover_lists_builtin_and_crd_resources(self, cluster):
+        process_crds(cluster, [CRDS], "apply")
+        core = cluster.discover("", "v1")
+        assert any(r["name"] == "pods" for r in core)
+        custom = cluster.discover("example.dev", "v1")
+        assert any(r["name"] == "widgets" for r in custom)
+
+    def test_discover_unknown_group_is_not_found(self, cluster):
+        from k8s_operator_libs_tpu.kube.client import NotFoundError
+
+        with pytest.raises(NotFoundError):
+            cluster.discover("ghosts.example.dev", "v1")
+
+    def test_manual_establishment_reaches_discovery(self):
+        """auto_establish_crds=False is the play-the-controller mode:
+        a test that writes the Established condition itself must still
+        end up discoverable, or wait_for_crds could never pass there."""
+        from k8s_operator_libs_tpu.crdutil import parse_crds_from_file
+
+        cluster = FakeCluster(auto_establish_crds=False)
+        crds = parse_crds_from_file(os.path.join(NESTED, "subdir", "deep.yml"))
+        (crd,) = crds
+        created = cluster.create(crd.deep_copy())
+        with pytest.raises(Exception):
+            cluster.discover("example.dev", "v1")
+        patched = cluster.patch(
+            "CustomResourceDefinition", created.name, "",
+            patch={
+                "status": {
+                    "conditions": [{"type": "Established", "status": "True"}]
+                }
+            },
+        )
+        assert patched is not None
+        wait_for_crds(cluster, crds, timeout_seconds=1)
+
+    def test_deleted_crd_leaves_discovery(self, cluster):
+        from k8s_operator_libs_tpu.kube.client import NotFoundError
+
+        process_crds(cluster, [CRDS], "apply")
+        process_crds(cluster, [CRDS], "delete")
+        with pytest.raises(NotFoundError):
+            cluster.discover("example.dev", "v1")
+
+
 class TestDelete:
     def test_delete(self, cluster):
         process_crds(cluster, [CRDS], "apply")

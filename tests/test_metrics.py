@@ -91,6 +91,22 @@ class TestRender:
         assert len(names) == 6
         assert len(set(names)) == 6
 
+    def test_label_values_are_escaped(self):
+        from k8s_operator_libs_tpu.tpu.monitor import MonitorMetrics
+        from k8s_operator_libs_tpu.upgrade.metrics import prom_label
+
+        assert prom_label("node", 'a"b\\c\nd') == (
+            '{node="a\\"b\\\\c\\nd"}'
+        )
+        # The monitor renders a hostile node name without producing an
+        # invalid exposition line (ADVICE r4: raw interpolation would).
+        metrics = MonitorMetrics('evil"node\\')
+        metrics.record(None)
+        for line in metrics.render().strip().splitlines():
+            if line.startswith("#"):
+                continue
+            assert 'node="evil\\"node\\\\"' in line
+
 
 class TestEndpoint:
     def test_metrics_served_over_http(self):

@@ -127,6 +127,48 @@ class TestListSelectors:
         assert len(client.list("Pod", namespace="ns-1")) == 1
 
 
+class TestDiscovery:
+    def test_core_group_discovery_over_the_wire(self, client):
+        resources = client.discover("", "v1")
+        assert any(r["name"] == "nodes" for r in resources)
+
+    def test_crd_becomes_discoverable_over_the_wire(self, server, client):
+        import os
+
+        from k8s_operator_libs_tpu.crdutil import process_crds
+
+        fixtures = os.path.join(
+            os.path.dirname(__file__), "crd_fixtures", "crds"
+        )
+        # apply-crds over real HTTP: the establishment wait now rides the
+        # /apis/<group>/<version> discovery endpoint, end to end.
+        process_crds(client, [fixtures], "apply")
+        v1 = client.discover("example.dev", "v1")
+        assert any(r["name"] == "widgets" for r in v1)
+        # gadgets serves v1alpha1 only — discovery is per group/version
+        assert not any(r["name"] == "gadgets" for r in v1)
+        v1a1 = client.discover("example.dev", "v1alpha1")
+        assert any(r["name"] == "gadgets" for r in v1a1)
+
+    def test_unknown_group_404s(self, client):
+        with pytest.raises(NotFoundError):
+            client.discover("ghosts.example.dev", "v1")
+
+    def test_apis_without_group_404s_like_a_real_apiserver(self, server):
+        # Core discovery lives only at /api/v1; /apis/v1 must 404 so a
+        # wrong-path client bug cannot pass here and fail in production.
+        import json
+        import urllib.request
+
+        req = urllib.request.Request(f"{server.url}/apis/v1")
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(req)
+        assert err.value.code == 404
+        with urllib.request.urlopen(f"{server.url}/api/v1") as resp:
+            doc = json.load(resp)
+        assert doc["kind"] == "APIResourceList"
+
+
 class TestAuth:
     def test_bearer_token_required_and_accepted(self):
         with LocalApiServer(token="sekrit") as srv:

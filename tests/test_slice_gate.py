@@ -238,6 +238,31 @@ class TestGangLifecycle:
         assert self.gang_pods(cluster) == []
         assert cluster.get_or_none("Service", svc_name, NS) is None
 
+    def test_late_joiner_defers_while_peer_verdicts_unconsumed(self):
+        """A repaired host joining a slice whose gang just passed must NOT
+        trigger whole-gang replacement — that would destroy peers' Ready
+        pods before their gates consume the verdict. Its provisioning
+        fails (validation clock runs) until the gang is swept."""
+        import pytest
+
+        cluster, nodes, mgr = self.build(2)
+        mgr.ensure(nodes[0])
+        for pod in self.gang_pods(cluster):
+            cluster.patch(
+                "Pod", pod.name, NS,
+                patch={
+                    "status": {
+                        "phase": "Running",
+                        "conditions": [{"type": "Ready", "status": "True"}],
+                    }
+                },
+            )
+        joiner = make_tpu_node(cluster, "host-2")
+        with pytest.raises(RuntimeError, match="mid-consumption"):
+            mgr.ensure(joiner)
+        # peers' Ready pods untouched
+        assert all(p.is_ready() for p in self.gang_pods(cluster))
+
     def test_terminating_pods_do_not_trigger_generation_churn(self):
         """Real-apiserver shape: a deleted pod lingers Terminating (here:
         held by a finalizer). It must be invisible to gang accounting, or
