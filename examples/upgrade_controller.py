@@ -143,6 +143,13 @@ def main(argv: list[str] | None = None) -> int:
         "NodeMaintenance CRs (simulated in --demo)",
     )
     parser.add_argument(
+        "--post-maintenance",
+        action="store_true",
+        help="with --requestor: route Ready nodes through "
+        "post-maintenance-required (XLA cache warm-up while drained) and "
+        "count maintenance states in the upgrade budget",
+    )
+    parser.add_argument(
         "--metrics-port",
         type=int,
         default=0,
@@ -232,6 +239,19 @@ def main(argv: list[str] | None = None) -> int:
         # deliberately (MAINTENANCE_OPERATOR_REQUESTOR_NAMESPACE).
         if not os.environ.get("MAINTENANCE_OPERATOR_REQUESTOR_NAMESPACE"):
             opts.namespace = args.namespace
+        if args.post_maintenance:
+            opts.use_post_maintenance = True
+            if args.ici_gate and not args.validation_pod and not args.demo:
+                # In-process warm-up ONLY where the in-process gate shape
+                # already applies (--ici-gate: the controller owns the
+                # node's chips, e.g. single-host pools). In the
+                # --validation-pod production shape the controller is off
+                # the node — an in-process battery would warm the WRONG
+                # host's cache and stall the reconcile loop; there the
+                # probe pod's hostPath cache mount is the warm-up story.
+                from k8s_operator_libs_tpu.tpu import cache_warmup_hook
+
+                opts.post_maintenance_hook = cache_warmup_hook()
         enable_requestor_mode(mgr, opts)
         if args.demo:
             from k8s_operator_libs_tpu.kube.sim import (

@@ -231,6 +231,10 @@ class FakeCluster(Client):
         # polls discovery to guard against). >0 reproduces that window.
         self._crd_discovery_delay = crd_discovery_delay
         self._discoverable: dict[str, set[str]] = {}
+        #: CRD names with a discovery timer in flight — every CRD write
+        #: path runs the discoverability sync, and without this guard a
+        #: busy write stream would stack redundant timers per name.
+        self._discovery_pending: set[str] = set()
         self._pending_timers: list[threading.Timer] = []
 
     # -- fault injection ---------------------------------------------------
@@ -569,9 +573,14 @@ class FakeCluster(Client):
         writes with auto-establishment off, so tests that play the CRD
         controller themselves still reach discoverability."""
         crd = CustomResourceDefinition(data)
-        if not crd.is_established() or crd.name in self._discoverable:
+        if (
+            not crd.is_established()
+            or crd.name in self._discoverable
+            or crd.name in self._discovery_pending
+        ):
             return
         if self._crd_discovery_delay > 0:
+            self._discovery_pending.add(crd.name)
             timer = threading.Timer(
                 self._crd_discovery_delay, self._make_discoverable, (crd.name,)
             )
@@ -587,6 +596,7 @@ class FakeCluster(Client):
 
     def _make_discoverable(self, name: str) -> None:
         with self._lock:
+            self._discovery_pending.discard(name)
             key = self._key("CustomResourceDefinition", "", name)
             data = self._store.get(key)
             if data is not None:
@@ -722,6 +732,10 @@ class FakeCluster(Client):
             self._bump(current)
             self._emit(_WATCH_MODIFIED, current, old=old)
             if kind == "CustomResourceDefinition":
+                if "spec" in (patch or {}):
+                    # A spec patch can add served versions — they become
+                    # discoverable like a fresh CRD's (same as _replace).
+                    self._discoverable.pop(name, None)
                 self._sync_crd_discoverability_locked(current)
             self._finalize_delete_if_due(kind, name, namespace)
             return wrap(copy.deepcopy(current))

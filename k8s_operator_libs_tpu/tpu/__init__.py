@@ -13,6 +13,7 @@ from .health import (
     IciHealthGate,
     SliceScopedGate,
     SubprocessHealthGate,
+    cache_warmup_hook,
 )
 from .monitor import MonitorMetrics, TpuHealthMonitor
 from .slice_gate import (
@@ -41,6 +42,7 @@ __all__ = [
     "TpuNodeInfo",
     "ValidationPodManager",
     "ValidationPodSpec",
+    "cache_warmup_hook",
     "disruption_stats",
     "enable_slice_aware_planning",
     "make_validation_provisioner",

@@ -668,6 +668,28 @@ def main(argv: Optional[list[str]] = None) -> int:
     return 0
 
 
+def cache_warmup_hook(gate: Optional[HealthGate] = None):
+    """Post-maintenance hook (RequestorOptions.post_maintenance_hook):
+    run one probe battery while the node is still drained, purely to
+    prefill the persistent XLA compilation cache — the validation gate
+    that follows (and the first workloads) then hit warm compiles instead
+    of the ~30 s cold battery. A warm-up is not a gate: the result is
+    logged but the hook always reports done (an actually-unhealthy node
+    is the validation gate's job to catch, with its quarantine
+    semantics)."""
+    warm_gate = gate or IciHealthGate()
+
+    def hook(node) -> bool:
+        report = warm_gate.run()
+        log.info(
+            "post-maintenance cache warm-up on node %s: %s",
+            node.name, report.summary(),
+        )
+        return True
+
+    return hook
+
+
 class SliceScopedGate:
     """Slice-granular memoization of the health gate.
 

@@ -89,12 +89,26 @@ class CommonUpgradeManager:
         self.recorder = recorder
         self.pod_deletion_enabled = False
         self.validation_enabled = False
+        #: Reference parity default (common_manager.go:714-731): nodes in
+        #: the two maintenance states do NOT count as managed/in-progress
+        #: — so base requestor mode does not reserve budget for them (the
+        #: reference's own quirk). enable_requestor_mode flips this on
+        #: together with use_post_maintenance: opting into the completed
+        #: maintenance flow opts into honest accounting for it.
+        self.count_maintenance_states = False
 
     # ------------------------------------------------------------------
     # Counters / scheduling math (reference: common_manager.go:714-788)
     # ------------------------------------------------------------------
+    def _managed_states(self) -> tuple[UpgradeState, ...]:
+        from .consts import MAINTENANCE_STATES
+
+        if self.count_maintenance_states:
+            return MANAGED_STATES + MAINTENANCE_STATES
+        return MANAGED_STATES
+
     def get_total_managed_nodes(self, state: ClusterUpgradeState) -> int:
-        return sum(len(state.nodes_in(s)) for s in MANAGED_STATES)
+        return sum(len(state.nodes_in(s)) for s in self._managed_states())
 
     def get_upgrades_in_progress(self, state: ClusterUpgradeState) -> int:
         total = self.get_total_managed_nodes(state)

@@ -65,6 +65,17 @@ MANAGED_STATES: tuple[UpgradeState, ...] = (
     UpgradeState.VALIDATION_REQUIRED,
 )
 
+#: The two external-maintenance states. Faithful to the reference,
+#: MANAGED_STATES excludes them (common_manager.go:714-731) — so in base
+#: requestor mode a node under external maintenance does not count toward
+#: the budget (the reference's own quirk, kept for parity). Enabling the
+#: completed post-maintenance flow (RequestorOptions.use_post_maintenance)
+#: opts into counting them: CommonUpgradeManager.count_maintenance_states.
+MAINTENANCE_STATES: tuple[UpgradeState, ...] = (
+    UpgradeState.NODE_MAINTENANCE_REQUIRED,
+    UpgradeState.POST_MAINTENANCE_REQUIRED,
+)
+
 #: States that do NOT count as "upgrade in progress"
 #: (reference: pkg/upgrade/common_manager.go:733-739).
 IDLE_STATES: frozenset[UpgradeState] = frozenset(
@@ -148,6 +159,14 @@ class UpgradeKeys:
     @property
     def validation_start_annotation(self) -> str:
         return self._key("upgrade-validation-start-time")
+
+    @property
+    def post_maintenance_start_annotation(self) -> str:
+        """Durable clock for the post-maintenance step (no reference
+        analog — the reference declared post-maintenance-required but
+        never adopted it, upgrade_state.go:249-250; this framework
+        completes the flow)."""
+        return self._key("upgrade-post-maintenance-start-time")
 
     @property
     def validation_failed_annotation(self) -> str:

@@ -17,8 +17,9 @@ operator may take down together.
 from __future__ import annotations
 
 import os
+import time
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Callable, Optional
 
 from ..api.upgrade_v1alpha1 import DriverUpgradePolicySpec
 from ..kube.client import AlreadyExistsError, Client, retry_on_conflict
@@ -50,6 +51,21 @@ class RequestorOptions:
     #: policy enables pod deletion (maintenance-operator API field
     #: spec.drainSpec.podEvictionFilters).
     pod_eviction_filters: list[dict] = field(default_factory=list)
+    #: Complete the flow the reference declared but never adopted
+    #: (upgrade_state.go:249-250): maintenance-Ready nodes pass through
+    #: post-maintenance-required (the hook runs there — e.g. XLA
+    #: compilation-cache prefill while the node is still drained) before
+    #: pod-restart-required. Enabling this also makes the budget count
+    #: BOTH maintenance states as in-progress (see
+    #: CommonUpgradeManager.count_maintenance_states).
+    use_post_maintenance: bool = False
+    #: Node -> True when the post-maintenance work is complete; False to
+    #: retry next pass. None = pass straight through. Crashes count as
+    #: not-done and ride the durable timeout below.
+    post_maintenance_hook: Optional[Callable] = None
+    #: Durable deadline for the post-maintenance step (same discipline as
+    #: the validation gate's, validation_manager.go:31-33).
+    post_maintenance_timeout_seconds: int = 600
 
     @staticmethod
     def from_env() -> "RequestorOptions":
@@ -57,6 +73,10 @@ class RequestorOptions:
         return RequestorOptions(
             use_maintenance_operator=(
                 os.environ.get("MAINTENANCE_OPERATOR_ENABLED") == TRUE_STRING
+            ),
+            use_post_maintenance=(
+                os.environ.get("MAINTENANCE_OPERATOR_POST_MAINTENANCE")
+                == TRUE_STRING
             ),
             # Fall back to the dataclass default: an empty requestor ID would
             # make every operator look like the owner of every CR.
@@ -134,6 +154,10 @@ def enable_requestor_mode(manager, opts: RequestorOptions):
     requestor = factory(manager.client, manager.common, opts)
     manager.options = opts.to_state_options()
     manager.requestor = requestor
+    # Opting into the completed post-maintenance flow opts into honest
+    # budget accounting for nodes under external maintenance (the base
+    # mode keeps the reference's exclusion quirk for parity).
+    manager.common.count_maintenance_states = opts.use_post_maintenance
     return manager
 
 
@@ -295,8 +319,33 @@ class RequestorNodeStateManager:
         policy: DriverUpgradePolicySpec,
     ) -> None:
         """Create/join the CR, mark the node requestor-mode, move it to
-        node-maintenance-required (reference: upgrade_requestor.go:277-319)."""
+        node-maintenance-required (reference: upgrade_requestor.go:277-319).
+
+        Budget: the reference creates CRs for EVERY upgrade-required node
+        at once, delegating all throttling to the external operator — and
+        (its own quirk) maintenance states don't count as in-progress, so
+        the library-side budget could not throttle here even if it tried.
+        The base mode keeps that parity. With ``use_post_maintenance`` on
+        (the completed flow), maintenance states count as in-progress
+        (CommonUpgradeManager.count_maintenance_states) and THIS loop
+        applies the same maxParallel/maxUnavailable math as in-place
+        (upgrade_inplace.go:44-112), so the policy budget holds even
+        against a naive external operator."""
         common = self.common
+        available: Optional[int] = None
+        if self.opts.use_post_maintenance:
+            total = common.get_total_managed_nodes(state)
+            max_unavailable = policy.resolved_max_unavailable(total)
+            available = common.get_upgrades_available(
+                state, policy.max_parallel_upgrades, max_unavailable
+            )
+            log.info(
+                "requestor upgrade slots: in_progress=%d max_parallel=%d "
+                "available=%d total=%d max_unavailable=%d",
+                common.get_upgrades_in_progress(state),
+                policy.max_parallel_upgrades,
+                available, total, max_unavailable,
+            )
         for ns in state.nodes_in(UpgradeState.UPGRADE_REQUIRED):
             node = ns.node
             if common.is_upgrade_requested(node):
@@ -306,6 +355,16 @@ class RequestorNodeStateManager:
             if common.skip_node_upgrade(node):
                 log.info("node %s is marked to skip upgrades", node.name)
                 continue
+            if available is not None and available <= 0:
+                # Same manual-cordon bypass as in-place
+                # (upgrade_inplace.go:87-97): an already-unavailable node
+                # costs no new disruption.
+                if not node.unschedulable:
+                    continue
+                log.info(
+                    "node %s already cordoned, proceeding despite budget",
+                    node.name,
+                )
             self.create_or_update_node_maintenance(ns, policy)
             common.provider.change_node_upgrade_annotation(
                 node, common.keys.requestor_mode_annotation, TRUE_STRING
@@ -313,6 +372,8 @@ class RequestorNodeStateManager:
             common.provider.change_node_upgrade_state(
                 node, UpgradeState.NODE_MAINTENANCE_REQUIRED
             )
+            if available is not None:
+                available -= 1
 
     def process_node_maintenance_required_nodes(
         self, state: ClusterUpgradeState
@@ -335,8 +396,84 @@ class RequestorNodeStateManager:
                 log.info(
                     "node maintenance completed for node %s", nm.node_name
                 )
+                next_state = (
+                    UpgradeState.POST_MAINTENANCE_REQUIRED
+                    if self.opts.use_post_maintenance
+                    else UpgradeState.POD_RESTART_REQUIRED
+                )
+                common.provider.change_node_upgrade_state(ns.node, next_state)
+
+    def process_post_maintenance_required_nodes(
+        self, state: ClusterUpgradeState
+    ) -> None:
+        """The step the reference TODO'd away (upgrade_state.go:249-250),
+        completed: after external maintenance reports Ready — node still
+        cordoned and drained, its chips free — run the post-maintenance
+        hook (e.g. XLA compilation-cache prefill so the validation gate
+        and the first workloads hit a warm cache), then hand the node to
+        pod-restart-required. Hook not-done/crash retries next pass under
+        a durable start-time deadline; expiry fails the node, exactly the
+        validation gate's timeout discipline."""
+        if not self.opts.use_post_maintenance:
+            return
+        common = self.common
+        key = common.keys.post_maintenance_start_annotation
+        for ns in state.nodes_in(UpgradeState.POST_MAINTENANCE_REQUIRED):
+            node = ns.node
+            done = True
+            if self.opts.post_maintenance_hook is not None:
+                try:
+                    done = bool(self.opts.post_maintenance_hook(node))
+                except Exception as e:  # noqa: BLE001 - hook crash = retry
+                    log.error(
+                        "post-maintenance hook failed on node %s: %s",
+                        node.name, e,
+                    )
+                    done = False
+            if done:
+                if key in node.annotations:
+                    common.provider.change_node_upgrade_annotation(
+                        node, key, "null"
+                    )
                 common.provider.change_node_upgrade_state(
-                    ns.node, UpgradeState.POD_RESTART_REQUIRED
+                    node, UpgradeState.POD_RESTART_REQUIRED
+                )
+                continue
+            now = int(time.time())
+            start_raw = node.annotations.get(key)
+            if start_raw is None:
+                common.provider.change_node_upgrade_annotation(
+                    node, key, str(now)
+                )
+                continue
+            try:
+                start = int(start_raw)
+            except ValueError:
+                log.error(
+                    "node %s has invalid post-maintenance start-time %r; "
+                    "resetting", node.name, start_raw,
+                )
+                common.provider.change_node_upgrade_annotation(
+                    node, key, str(now)
+                )
+                continue
+            if now > start + self.opts.post_maintenance_timeout_seconds:
+                log.warning(
+                    "post-maintenance timed out on node %s", node.name
+                )
+                # Same routing marker as a validation timeout: FAILED
+                # auto-recovery must send this node back THROUGH the
+                # validation gate, never around it — without the marker,
+                # the DaemonSet rolling the driver pod on its own would
+                # let recovery uncordon a never-validated node.
+                common.provider.change_node_upgrade_annotation(
+                    node, common.keys.validation_failed_annotation, "true"
+                )
+                common.provider.change_node_upgrade_annotation(
+                    node, key, "null"
+                )
+                common.provider.change_node_upgrade_state(
+                    node, UpgradeState.FAILED
                 )
 
     def process_uncordon_required_nodes(self, state: ClusterUpgradeState) -> None:

@@ -265,6 +265,7 @@ class ClusterUpgradeStateManager:
         )
         common.process_drain_nodes(state, policy.drain)
         self._process_node_maintenance_required_nodes(state)
+        self._process_post_maintenance_required_nodes(state)
         common.process_pod_restart_nodes(state)
         common.process_upgrade_failed_nodes(state)
         common.process_validation_required_nodes(state)
@@ -285,6 +286,16 @@ class ClusterUpgradeStateManager:
     ) -> None:
         if self.options.use_maintenance_operator and self.requestor is not None:
             self.requestor.process_node_maintenance_required_nodes(state)
+
+    def _process_post_maintenance_required_nodes(
+        self, state: ClusterUpgradeState
+    ) -> None:
+        if self.options.use_maintenance_operator and self.requestor is not None:
+            process = getattr(
+                self.requestor, "process_post_maintenance_required_nodes", None
+            )
+            if callable(process):
+                process(state)
 
     def _process_uncordon_required_nodes(self, state: ClusterUpgradeState) -> None:
         # Both modes run so in-flight in-place upgrades can finish after

@@ -171,6 +171,25 @@ class TestDiscoveryWait:
         assert patched is not None
         wait_for_crds(cluster, crds, timeout_seconds=1)
 
+    def test_spec_patch_adding_version_becomes_discoverable(self, cluster):
+        process_crds(cluster, [NESTED], "apply")
+        crd = cluster.get("CustomResourceDefinition", "deeps.example.dev")
+        versions = list(crd.raw["spec"]["versions"])
+        versions.append(
+            {
+                "name": "v2",
+                "served": True,
+                "storage": False,
+                "schema": {"openAPIV3Schema": {"type": "object"}},
+            }
+        )
+        cluster.patch(
+            "CustomResourceDefinition", crd.name, "",
+            patch={"spec": {"versions": versions}},
+        )
+        resources = cluster.discover("example.dev", "v2")
+        assert any(r["name"] == "deeps" for r in resources)
+
     def test_deleted_crd_leaves_discovery(self, cluster):
         from k8s_operator_libs_tpu.kube.client import NotFoundError
 

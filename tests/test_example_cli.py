@@ -39,8 +39,13 @@ def run_demo(*flags, timeout=240):
         # in the example's source; must still compose via the
         # requestor_factory hook (tpu/planner.py).
         ("--requestor", "--slice-aware"),
+        ("--requestor", "--post-maintenance"),
+        ("--requestor", "--slice-aware", "--post-maintenance"),
     ],
-    ids=["plain", "slice-aware", "requestor", "requestor+slice-aware"],
+    ids=[
+        "plain", "slice-aware", "requestor", "requestor+slice-aware",
+        "requestor+post-maintenance", "requestor+slice-aware+post-maint",
+    ],
 )
 def test_demo_roll_completes(flags):
     proc = run_demo(*flags)
