@@ -150,6 +150,20 @@ def wait_for_crds(
         for crd in crds
         for version in crd.served_versions
     }
+    try:
+        # Probe once up front: a consumer-supplied Client predating the
+        # discovery surface must keep working (it did under the old
+        # status-based wait), just with the weaker evidence.
+        client.discover("", "v1")
+    except NotImplementedError:
+        log.warning(
+            "%s has no discovery support; falling back to status-based "
+            "establishment polling (weaker: cannot see the Established-"
+            "but-undiscoverable window)", type(client).__name__,
+        )
+        return _wait_for_crds_via_status(client, crds, deadline)
+    except Exception:
+        pass  # a NotFound/unreachable core group is the poll's business
     while pending:
         # One discovery GET per distinct group/version per round — CRDs
         # overwhelmingly share a group, and repeating the identical
@@ -173,6 +187,35 @@ def wait_for_crds(
             raise CRDProcessingError(
                 "timed out waiting for CRD versions to become "
                 f"discoverable: {names}"
+            )
+        time.sleep(ESTABLISH_POLL_INTERVAL_SECONDS)
+
+
+def _wait_for_crds_via_status(
+    client: Client,
+    crds: Sequence[CustomResourceDefinition],
+    deadline: float,
+) -> None:
+    """Legacy wait for Clients without a discovery surface: Established
+    condition + served versions present on the CRD object itself."""
+    pending = {crd.name: crd for crd in crds}
+    while pending:
+        for name in list(pending):
+            current = client.get_or_none(CRD_KIND, name)
+            if current is None:
+                continue
+            cur = CustomResourceDefinition(current.raw)
+            wanted = set(pending[name].served_versions)
+            if cur.is_established() and wanted.issubset(
+                set(cur.served_versions)
+            ):
+                del pending[name]
+        if not pending:
+            return
+        if time.monotonic() > deadline:
+            raise CRDProcessingError(
+                f"timed out waiting for CRDs to become established: "
+                f"{sorted(pending)}"
             )
         time.sleep(ESTABLISH_POLL_INTERVAL_SECONDS)
 

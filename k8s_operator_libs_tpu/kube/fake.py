@@ -86,11 +86,26 @@ def strategic_merge_patch(
     * a map value of ``{"$patch": "delete"}`` deletes the key,
     * lists of objects merge by the ``name`` merge key (the K8s default for
       containers/env/etc.); an item ``{"$patch": "delete", "name": x}``
-      removes the matching element,
-    * lists of primitives are replaced (K8s replace strategy default).
+      removes the matching element; a bare ``{"$patch": "replace"}``
+      element makes the remaining items replace the list wholesale,
+    * ``$deleteFromPrimitiveList/<field>: [v...]`` removes values from a
+      primitive list (apimachinery's directive for merge-strategy
+      primitive lists like finalizers),
+    * lists of primitives are otherwise replaced (K8s replace default).
+
+    Known deviations from apimachinery (documented in PARITY.md and
+    pinned by tests/test_conformance_vectors.py): no ``$setElementOrder``
+    support, no ``$retainKeys``, and — schema-less — merge keys other
+    than ``name`` and merge-strategy primitive lists are not inferred.
     """
     for key, value in patch.items():
         if key == "$patch":
+            continue
+        if key.startswith("$deleteFromPrimitiveList/"):
+            field_name = key.split("/", 1)[1]
+            current = target.get(field_name)
+            if isinstance(current, list) and isinstance(value, list):
+                target[field_name] = [v for v in current if v not in value]
             continue
         if value is None:
             target.pop(key, None)
@@ -126,6 +141,17 @@ def strategic_merge_patch(
 
 
 def _strategic_merge_list(current: Any, patch_items: list[Any]) -> list[Any]:
+    if any(
+        isinstance(i, Mapping) and i.get("$patch") == "replace" and "name" not in i
+        for i in patch_items
+    ):
+        # apimachinery: a bare {"$patch": "replace"} element means the
+        # remaining items replace the list wholesale.
+        return [
+            copy.deepcopy(i)
+            for i in patch_items
+            if not (isinstance(i, Mapping) and i.get("$patch") == "replace")
+        ]
     mergeable = (
         isinstance(current, list)
         and all(isinstance(i, Mapping) and "name" in i for i in current)
@@ -453,8 +479,26 @@ class FakeCluster(Client):
             raise NotFoundError(f"{kind} {namespace}/{name} not found")
         return data
 
-    def _finalize_delete_if_due(self, kind: str, name: str, namespace: str) -> None:
-        """Remove a deletionTimestamp-marked object once finalizers are gone."""
+    @staticmethod
+    def _write_becomes_delete(data: dict[str, Any]) -> bool:
+        """True when this write empties a terminating object's finalizer
+        list: on a real apiserver that update IS the deletion — watchers
+        observe one DELETED, never a MODIFIED for the releasing write
+        (pinned by the watch vectors in tests/conformance_vectors/)."""
+        meta = data.get("metadata", {})
+        return bool(meta.get("deletionTimestamp")) and not meta.get(
+            "finalizers"
+        )
+
+    def _finalize_delete_if_due(
+        self, kind: str, name: str, namespace: str, old=None
+    ) -> None:
+        """Remove a deletionTimestamp-marked object once finalizers are
+        gone. ``old`` is the pre-write snapshot of the releasing write:
+        its MODIFIED event was suppressed (the write IS the deletion, see
+        _write_becomes_delete), so the DELETED event must carry the
+        pre-write state or a label-selector watcher whose object left
+        scope in that same write would classify the event away."""
         key = self._key(kind, namespace, name)
         data = self._store.get(key)
         if data is None:
@@ -469,7 +513,7 @@ class FakeCluster(Client):
             # a watch resuming from exactly that revision replays PAST the
             # deletion — a lost event.
             self._bump(data)
-            self._emit(_WATCH_DELETED, data)
+            self._emit(_WATCH_DELETED, data, old=old)
 
     # -- Client API --------------------------------------------------------
     def get(self, kind: str, name: str, namespace: str = "") -> KubeObject:
@@ -573,11 +617,19 @@ class FakeCluster(Client):
         writes with auto-establishment off, so tests that play the CRD
         controller themselves still reach discoverability."""
         crd = CustomResourceDefinition(data)
-        if (
-            not crd.is_established()
-            or crd.name in self._discoverable
-            or crd.name in self._discovery_pending
-        ):
+        if crd.name in self._discoverable:
+            return
+        self._schedule_discovery_refresh_locked(data)
+
+    def _schedule_discovery_refresh_locked(self, data: dict[str, Any]) -> None:
+        """Refresh the CRD's discoverable-version set (after the window).
+        Unlike the sync above this runs even when the CRD is already
+        discoverable — the path spec UPDATES take, so already-served
+        versions stay served through the window (a real apiserver never
+        un-serves v1 while v2 establishes) and the set converges to the
+        new served list when the window elapses."""
+        crd = CustomResourceDefinition(data)
+        if not crd.is_established() or crd.name in self._discovery_pending:
             return
         if self._crd_discovery_delay > 0:
             self._discovery_pending.add(crd.name)
@@ -681,20 +733,23 @@ class FakeCluster(Client):
                     data.pop("status", None)
                 self._store[self._key(kind, obj.namespace, obj.name)] = data
             self._bump(data)
-            self._emit(_WATCH_MODIFIED, data, old=old)
+            if not self._write_becomes_delete(data):
+                self._emit(_WATCH_MODIFIED, data, old=old)
             if kind == "CustomResourceDefinition":
-                if not status_only:
-                    # A spec update can add served versions; the new
-                    # version becomes discoverable like a fresh CRD's
-                    # would (after the configured window).
-                    self._discoverable.pop(obj.name, None)
                 if not status_only and self._auto_establish_crds:
+                    # An updated CRD stays Established (the real apiserver
+                    # re-establishes in place); already-served versions
+                    # remain discoverable, and the served set refreshes
+                    # to the new spec after the window.
                     self._establish_crd_locked(data)
+                    self._schedule_discovery_refresh_locked(data)
                 else:
                     # Manual-controller mode (or a status write): honor an
                     # Established condition however it got there.
                     self._sync_crd_discoverability_locked(data)
-            self._finalize_delete_if_due(kind, obj.name, obj.namespace)
+                    if not status_only:
+                        self._schedule_discovery_refresh_locked(data)
+            self._finalize_delete_if_due(kind, obj.name, obj.namespace, old=old)
             return wrap(copy.deepcopy(data))
 
     def update(self, obj: KubeObject) -> KubeObject:
@@ -730,14 +785,16 @@ class FakeCluster(Client):
             meta = current.setdefault("metadata", {})
             meta["name"] = name
             self._bump(current)
-            self._emit(_WATCH_MODIFIED, current, old=old)
+            if not self._write_becomes_delete(current):
+                self._emit(_WATCH_MODIFIED, current, old=old)
             if kind == "CustomResourceDefinition":
-                if "spec" in (patch or {}):
-                    # A spec patch can add served versions — they become
-                    # discoverable like a fresh CRD's (same as _replace).
-                    self._discoverable.pop(name, None)
                 self._sync_crd_discoverability_locked(current)
-            self._finalize_delete_if_due(kind, name, namespace)
+                if "spec" in (patch or {}):
+                    # A spec patch can add served versions — existing ones
+                    # stay served; the set refreshes after the window
+                    # (same as _replace).
+                    self._schedule_discovery_refresh_locked(current)
+            self._finalize_delete_if_due(kind, name, namespace, old=old)
             return wrap(copy.deepcopy(current))
 
     def delete(

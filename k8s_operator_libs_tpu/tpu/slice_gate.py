@@ -284,20 +284,31 @@ class SliceProbeGangManager:
         # Replacement would destroy PEERS' Ready pods too — verdicts their
         # own gates may not have consumed yet (e.g. a repaired host joins
         # a slice whose gang just passed). Defer by failing THIS node's
-        # provisioning (its validation clock keeps running); once every
-        # peer consumes its verdict the gang is swept and a fresh full
-        # generation can form.
-        ready_peers = [
+        # provisioning (its validation clock keeps running) — but only
+        # while a Ready peer's NODE is still in the pipeline: peers that
+        # already left it (validated and moved on) will never consume
+        # again, so their parked pods are swept here rather than leaking
+        # Ready pods that hold chips forever while this node deadlocks.
+        ready_peers = {
             p.node_name
             for p in current
             if p.node_name != node.name and p.is_ready()
-        ]
+        }
         if ready_peers:
-            raise RuntimeError(
-                f"slice {slice_id}: probe gang is mid-consumption (Ready "
-                f"pods on {', '.join(sorted(ready_peers))}); deferring "
-                f"re-provisioning for node {node.name}"
-            )
+            still_consuming = []
+            for name in sorted(ready_peers):
+                obj = self.client.get_or_none("Node", name)
+                if obj is None:
+                    continue
+                state = Node(obj.raw).labels.get(self._keys.state_label, "")
+                if state in _GANG_CONSUMER_STATES:
+                    still_consuming.append(name)
+            if still_consuming:
+                raise RuntimeError(
+                    f"slice {slice_id}: probe gang is mid-consumption "
+                    f"(Ready pods on {', '.join(still_consuming)}); "
+                    f"deferring re-provisioning for node {node.name}"
+                )
         # Not viable: stale membership, a finished member, or a
         # half-deleted set. Replace the WHOLE gang — a partial gang can
         # never complete its rendezvous.

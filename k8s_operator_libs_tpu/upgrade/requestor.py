@@ -17,7 +17,6 @@ operator may take down together.
 from __future__ import annotations
 
 import os
-import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
@@ -439,25 +438,12 @@ class RequestorNodeStateManager:
                     node, UpgradeState.POD_RESTART_REQUIRED
                 )
                 continue
-            now = int(time.time())
-            start_raw = node.annotations.get(key)
-            if start_raw is None:
-                common.provider.change_node_upgrade_annotation(
-                    node, key, str(now)
-                )
-                continue
-            try:
-                start = int(start_raw)
-            except ValueError:
-                log.error(
-                    "node %s has invalid post-maintenance start-time %r; "
-                    "resetting", node.name, start_raw,
-                )
-                common.provider.change_node_upgrade_annotation(
-                    node, key, str(now)
-                )
-                continue
-            if now > start + self.opts.post_maintenance_timeout_seconds:
+            from .validation_manager import advance_durable_clock
+
+            if advance_durable_clock(
+                common.provider, node, key,
+                self.opts.post_maintenance_timeout_seconds,
+            ):
                 log.warning(
                     "post-maintenance timed out on node %s", node.name
                 )
@@ -468,9 +454,6 @@ class RequestorNodeStateManager:
                 # let recovery uncordon a never-validated node.
                 common.provider.change_node_upgrade_annotation(
                     node, common.keys.validation_failed_annotation, "true"
-                )
-                common.provider.change_node_upgrade_annotation(
-                    node, key, "null"
                 )
                 common.provider.change_node_upgrade_state(
                     node, UpgradeState.FAILED

@@ -39,6 +39,34 @@ VALIDATION_TIMEOUT_SECONDS = 600
 ValidationHook = Callable[[Node], bool]
 
 
+def advance_durable_clock(
+    provider, node: Node, key: str, timeout_seconds: float
+) -> bool:
+    """THE durable-timeout discipline (reference: validation_manager.go:
+    139-175), shared by every annotation-clocked step (validation here,
+    post-maintenance in upgrade/requestor.py): stamp the start time on
+    first sight, reset an unparseable value, and on expiry clear the clock
+    and return True — the caller applies its own expiry consequences."""
+    now = int(time.time())
+    start_raw = node.annotations.get(key)
+    if start_raw is None:
+        provider.change_node_upgrade_annotation(node, key, str(now))
+        return False
+    try:
+        start = int(start_raw)
+    except ValueError:
+        log.error(
+            "node %s has invalid start-time %r for %s; resetting",
+            node.name, start_raw, key,
+        )
+        provider.change_node_upgrade_annotation(node, key, str(now))
+        return False
+    if now > start + timeout_seconds:
+        provider.change_node_upgrade_annotation(node, key, "null")
+        return True
+    return False
+
+
 class PodProvisioner:
     """Duck-typed interface for validation-pod lifecycle management
     (implemented by ``tpu.validation_pod.ValidationPodManager``): ``ensure``
@@ -158,22 +186,13 @@ class ValidationManager:
 
     def _handle_timeout(self, node: Node) -> None:
         """Durable start-time tracking; timeout → failed (reference: :139-175)."""
-        key = self._keys.validation_start_annotation
-        now = int(time.time())
-        start_raw = node.annotations.get(key)
-        if start_raw is None:
-            self._provider.change_node_upgrade_annotation(node, key, str(now))
-            return
-        try:
-            start = int(start_raw)
-        except ValueError:
-            log.error(
-                "node %s has invalid validation start-time %r; resetting",
-                node.name, start_raw,
-            )
-            self._provider.change_node_upgrade_annotation(node, key, str(now))
-            return
-        if now > start + self._timeout:
+        expired = advance_durable_clock(
+            self._provider,
+            node,
+            self._keys.validation_start_annotation,
+            self._timeout,
+        )
+        if expired:
             # Stamp WHY the node failed: auto-recovery must route a
             # validation failure back through validation, not around it
             # (common_manager.process_upgrade_failed_nodes).
@@ -185,7 +204,6 @@ class ValidationManager:
             self._event(
                 node, "Warning", "Validation timed out for the driver upgrade"
             )
-            self._provider.change_node_upgrade_annotation(node, key, "null")
 
     def _event(self, node: Node, event_type: str, message: str) -> None:
         if self._recorder is not None:

@@ -1,0 +1,230 @@
+"""Differential wire conformance against upstream-shaped fixtures
+(VERDICT r5 item 3).
+
+A real apiserver is environment-blocked here (no docker/kind/network), so
+the substitute evidence is vector tables in the SHAPE of Kubernetes' own
+apimachinery strategic-merge-patch test tables (original/patch/expected
+triples) and client-go watch-semantics sequences, encoding the documented
+upstream behaviors. Every vector runs three ways:
+
+(a) the patch engine directly (`strategic_merge_patch`),
+(b) through ``FakeCluster.patch`` (object write path), and
+(c) over REAL HTTP against ``LocalApiServer`` with the strategic
+    content type — the full wire path.
+
+Deviations from apimachinery are declared IN the fixture file and
+asserted to actually deviate — the gap list cannot rot silently. With
+``KUBE_CONFORMANCE_KUBECONFIG`` set, the same vectors run against a real
+apiserver (the one-command certification path; README "Conformance
+status").
+"""
+
+import copy
+import os
+
+import pytest
+import yaml
+
+from k8s_operator_libs_tpu.kube import (
+    FakeCluster,
+    LocalApiServer,
+    RestClient,
+    RestConfig,
+)
+from k8s_operator_libs_tpu.kube.fake import strategic_merge_patch
+from k8s_operator_libs_tpu.kube.objects import Pod
+
+VECTOR_DIR = os.path.join(os.path.dirname(__file__), "conformance_vectors")
+
+with open(os.path.join(VECTOR_DIR, "strategic_merge.yaml")) as fh:
+    _SMP = yaml.safe_load(fh)
+with open(os.path.join(VECTOR_DIR, "watch_sequences.yaml")) as fh:
+    _WATCH = yaml.safe_load(fh)
+
+SMP_CASES = _SMP["cases"]
+SMP_DEVIATIONS = _SMP["deviations"]
+WATCH_SEQUENCES = _WATCH["sequences"]
+
+NS = "conformance"
+
+
+def _case_ids(cases):
+    return [c["name"].replace(" ", "-") for c in cases]
+
+
+def _assert_expected(expected: dict, actual: dict) -> None:
+    """Exact comparison of every subtree the vector specifies, tolerating
+    only the server-owned metadata fields (name/uid/resourceVersion/...)
+    that object write paths inject."""
+    for key, want in expected.items():
+        if key == "metadata":
+            got_meta = actual.get("metadata") or {}
+            for mkey, mwant in want.items():
+                assert got_meta.get(mkey) == mwant, (
+                    f"metadata.{mkey}: {got_meta.get(mkey)!r} != {mwant!r}"
+                )
+        else:
+            assert actual.get(key) == want, (
+                f"{key}: {actual.get(key)!r} != {want!r}"
+            )
+
+
+def _make_pod_raw(name: str, original: dict) -> dict:
+    pod = Pod.new(name, namespace=NS)
+    raw = pod.raw
+    for key, value in original.items():
+        if key == "metadata":
+            raw["metadata"].update(copy.deepcopy(value))
+        else:
+            raw[key] = copy.deepcopy(value)
+    return raw
+
+
+class TestStrategicMergeVectors:
+    @pytest.mark.parametrize("case", SMP_CASES, ids=_case_ids(SMP_CASES))
+    def test_direct_engine(self, case):
+        target = copy.deepcopy(case["original"])
+        strategic_merge_patch(target, case["patch"])
+        assert target == case["expected"]
+
+    @pytest.mark.parametrize("case", SMP_CASES, ids=_case_ids(SMP_CASES))
+    def test_fake_cluster_object_path(self, case):
+        cluster = FakeCluster()
+        cluster.create(Pod(_make_pod_raw("vector", case["original"])))
+        patched = cluster.patch(
+            "Pod", "vector", NS, patch=case["patch"], patch_type="strategic"
+        )
+        _assert_expected(case["expected"], patched.raw)
+
+    @pytest.mark.parametrize("case", SMP_CASES, ids=_case_ids(SMP_CASES))
+    def test_http_wire_path(self, case, conformance_server):
+        server, client = conformance_server
+        name = f"vector-{abs(hash(case['name'])) % 10**8}"
+        client.create(Pod(_make_pod_raw(name, case["original"])))
+        patched = client.patch(
+            "Pod", name, NS, patch=case["patch"], patch_type="strategic"
+        )
+        _assert_expected(case["expected"], patched.raw)
+
+    @pytest.mark.parametrize(
+        "case", SMP_DEVIATIONS, ids=_case_ids(SMP_DEVIATIONS)
+    )
+    def test_declared_deviations_actually_deviate(self, case):
+        """Each declared deviation must really NOT match apimachinery's
+        documented result — if the engine grows support, this fails and
+        the deviation list (and PARITY.md) must shrink."""
+        target = copy.deepcopy(case["original"])
+        try:
+            strategic_merge_patch(target, case["patch"])
+        except Exception:
+            return  # rejecting the directive outright is also a deviation
+        assert target != case["upstream_expected"], (
+            f"deviation {case['name']!r} now matches upstream — remove it "
+            "from the fixture's deviations list and from PARITY.md"
+        )
+
+
+@pytest.fixture(scope="module")
+def conformance_server():
+    with LocalApiServer() as server:
+        client = RestClient(RestConfig(server=server.url))
+        yield server, client
+        client.close()
+
+
+class TestWatchSequenceVectors:
+    @pytest.mark.parametrize(
+        "seq", WATCH_SEQUENCES, ids=_case_ids(WATCH_SEQUENCES)
+    )
+    def test_over_http(self, seq):
+        import queue
+        import threading
+
+        with LocalApiServer() as server:
+            client = RestClient(RestConfig(server=server.url))
+            watcher = RestClient(RestConfig(server=server.url))
+            events: queue.Queue = queue.Queue()
+
+            def pump():
+                try:
+                    for event_type, obj in watcher.watch(
+                        "Pod",
+                        namespace=NS,
+                        label_selector=seq["watch_selector"] or None,
+                        timeout_seconds=30,
+                    ):
+                        events.put((event_type, obj.name))
+                except Exception:
+                    pass
+
+            thread = threading.Thread(target=pump, daemon=True)
+            thread.start()
+            # Let the watch establish before generating events.
+            import time
+
+            time.sleep(0.2)
+            for op in seq["ops"]:
+                if op["op"] == "create":
+                    pod = Pod.new(op["name"], namespace=NS)
+                    pod.labels.update(op.get("labels") or {})
+                    if op.get("finalizers"):
+                        pod.metadata["finalizers"] = list(op["finalizers"])
+                    client.create(pod)
+                elif op["op"] == "patch":
+                    client.patch(
+                        "Pod", op["name"], NS, patch=op["patch"]
+                    )
+                elif op["op"] == "delete":
+                    client.delete("Pod", op["name"], NS)
+                else:  # pragma: no cover - fixture error
+                    raise AssertionError(f"unknown op {op['op']!r}")
+
+            expected = [(e["type"], e["name"]) for e in seq["events"]]
+            got = []
+            deadline = time.time() + 15
+            while len(got) < len(expected) and time.time() < deadline:
+                try:
+                    got.append(events.get(timeout=0.5))
+                except queue.Empty:
+                    continue
+            # No extra events within a grace window.
+            try:
+                extra = events.get(timeout=0.5)
+                got.append(extra)
+            except queue.Empty:
+                pass
+            assert got == expected
+            client.close()
+            watcher.close()
+
+
+@pytest.mark.skipif(
+    not os.environ.get("KUBE_CONFORMANCE_KUBECONFIG"),
+    reason="set KUBE_CONFORMANCE_KUBECONFIG to run against a real apiserver",
+)
+class TestRealApiServerVectors:
+    """One command certifies the vectors against a genuine apiserver:
+
+        KUBE_CONFORMANCE_KUBECONFIG=~/.kube/config \\
+            python -m pytest tests/test_conformance_vectors.py -k real
+    """
+
+    @pytest.mark.parametrize("case", SMP_CASES, ids=_case_ids(SMP_CASES))
+    def test_real_strategic_vectors(self, case):
+        cfg = RestConfig.from_kubeconfig(
+            os.environ["KUBE_CONFORMANCE_KUBECONFIG"]
+        )
+        client = RestClient(cfg)
+        name = f"vector-{abs(hash(case['name'])) % 10**8}"
+        raw = _make_pod_raw(name, case["original"])
+        raw["metadata"]["namespace"] = "default"
+        client.create(Pod(raw))
+        try:
+            patched = client.patch(
+                "Pod", name, "default",
+                patch=case["patch"], patch_type="strategic",
+            )
+            _assert_expected(case["expected"], patched.raw)
+        finally:
+            client.delete_if_exists("Pod", name, "default")
+            client.close()

@@ -242,7 +242,9 @@ class TestGangLifecycle:
         """A repaired host joining a slice whose gang just passed must NOT
         trigger whole-gang replacement — that would destroy peers' Ready
         pods before their gates consume the verdict. Its provisioning
-        fails (validation clock runs) until the gang is swept."""
+        fails (validation clock runs) until the peers consume; once they
+        leave the pipeline, the stale gang is swept and a fresh full
+        generation forms (no leaked Ready pods, no joiner deadlock)."""
         import pytest
 
         cluster, nodes, mgr = self.build(2)
@@ -257,11 +259,34 @@ class TestGangLifecycle:
                     }
                 },
             )
+        for name in ("host-0", "host-1"):
+            cluster.patch(
+                "Node", name, "",
+                patch={
+                    "metadata": {
+                        "labels": {KEYS.state_label: "validation-required"}
+                    }
+                },
+            )
         joiner = make_tpu_node(cluster, "host-2")
         with pytest.raises(RuntimeError, match="mid-consumption"):
             mgr.ensure(joiner)
-        # peers' Ready pods untouched
+        # peers' Ready pods untouched while their nodes still consume
         assert all(p.is_ready() for p in self.gang_pods(cluster))
+        # Peers consumed their verdicts and left the pipeline: the joiner
+        # now sweeps the stale gang and provisions a fresh 3-host one.
+        for name in ("host-0", "host-1"):
+            cluster.patch(
+                "Node", name, "",
+                patch={
+                    "metadata": {"labels": {KEYS.state_label: "upgrade-done"}}
+                },
+            )
+        mine = mgr.ensure(joiner)
+        pods = self.gang_pods(cluster)
+        assert len(pods) == 3
+        assert {p.labels[GANG_GENERATION_LABEL] for p in pods} == {"2"}
+        assert mine.node_name == "host-2"
 
     def test_terminating_pods_do_not_trigger_generation_churn(self):
         """Real-apiserver shape: a deleted pod lingers Terminating (here:
