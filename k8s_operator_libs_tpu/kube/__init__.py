@@ -4,6 +4,7 @@ from .client import (
     Client,
     ConflictError,
     InvalidError,
+    UnsupportedMediaTypeError,
     WatchExpiredError,
     NotFoundError,
     retry_on_conflict,
@@ -47,6 +48,7 @@ __all__ = [
     "FakeCluster",
     "FakeRecorder",
     "InvalidError",
+    "UnsupportedMediaTypeError",
     "WatchExpiredError",
     "KubeObject",
     "LabelSelector",

@@ -57,6 +57,15 @@ class WatchExpiredError(ApiError):
     reason = "Expired"
 
 
+class UnsupportedMediaTypeError(ApiError):
+    """Patch content type the resource cannot accept (415): a real
+    apiserver only supports strategic merge patches for built-in typed
+    resources — custom resources take JSON/merge patches only."""
+
+    status = 415
+    reason = "UnsupportedMediaType"
+
+
 class Client(abc.ABC):
     """Minimal typed Kubernetes client surface used by the framework."""
 

@@ -32,6 +32,7 @@ from .client import (
     ConflictError,
     InvalidError,
     NotFoundError,
+    UnsupportedMediaTypeError,
     WatchExpiredError,
 )
 from .objects import KINDS, CustomResourceDefinition, KubeObject, wrap
@@ -66,6 +67,45 @@ def merge_patch(target: dict[str, Any], patch: Mapping[str, Any]) -> dict[str, A
     return target
 
 
+#: Field-name-keyed mirror of the ``patchStrategy:"merge"`` /
+#: ``patchMergeKey:"..."`` struct tags in k8s.io/api. apimachinery resolves
+#: these per Go type; schema-less, this engine keys them by FIELD NAME —
+#: Kubernetes API conventions keep the names consistent across types, and
+#: the one ambiguous name (``ports``: ContainerPort keys by containerPort,
+#: ServicePort by port) carries every upstream candidate, resolved against
+#: the elements actually present. Fields without a ``patchStrategy`` tag
+#: upstream (e.g. tolerations, args) are deliberately absent: they stay
+#: atomic/replace here too. ``name`` remains the universal fallback key —
+#: it is the K8s default merge key and the convention CRDs follow.
+_LIST_MERGE_KEYS: dict[str, tuple[str, ...]] = {
+    "containers": ("name",),
+    "initContainers": ("name",),
+    "ephemeralContainers": ("name",),
+    "env": ("name",),
+    "ports": ("containerPort", "port"),
+    "volumes": ("name",),
+    "volumeMounts": ("mountPath",),
+    "volumeDevices": ("devicePath",),
+    "imagePullSecrets": ("name",),
+    "secrets": ("name",),
+    "resourceClaims": ("name",),
+    "hostAliases": ("ip",),
+    "podIPs": ("ip",),
+    "hostIPs": ("ip",),
+    "taints": ("key",),
+    "conditions": ("type",),
+    "addresses": ("type",),
+    "ownerReferences": ("uid",),
+    "topologySpreadConstraints": ("topologyKey",),
+}
+
+#: ``patchStrategy:"merge"`` on PRIMITIVE lists (``[]string``) upstream:
+#: ObjectMeta.finalizers, NodeStatus.volumesInUse. Patch values union into
+#: the live list (live order first, new values in patch order); removals
+#: go through ``$deleteFromPrimitiveList/<field>``.
+_PRIMITIVE_MERGE_FIELDS = frozenset({"finalizers", "volumesInUse"})
+
+
 def strategic_merge_patch(
     target: dict[str, Any], patch: Mapping[str, Any]
 ) -> dict[str, Any]:
@@ -78,28 +118,43 @@ def strategic_merge_patch(
     fields this library patches (labels/annotations), the two are
     equivalent — ``tests/test_patch_semantics.py`` pins that equivalence.
 
-    Supported strategic semantics (the subset a driver-upgrade controller
-    exercises):
+    Supported strategic semantics:
 
     * maps merge recursively; ``null`` deletes a key (same as merge patch),
-    * a map containing ``{"$patch": "replace"}`` replaces wholesale,
-    * a map value of ``{"$patch": "delete"}`` deletes the key,
-    * lists of objects merge by the ``name`` merge key (the K8s default for
-      containers/env/etc.); an item ``{"$patch": "delete", "name": x}``
-      removes the matching element; a bare ``{"$patch": "replace"}``
-      element makes the remaining items replace the list wholesale,
-    * ``$deleteFromPrimitiveList/<field>: [v...]`` removes values from a
-      primitive list (apimachinery's directive for merge-strategy
-      primitive lists like finalizers),
-    * lists of primitives are otherwise replaced (K8s replace default).
+    * a map containing ``{"$patch": "replace"}`` replaces wholesale; a map
+      value of ``{"$patch": "delete"}`` deletes the key,
+    * ``$retainKeys: [k...]`` on a map drops every key of the merged
+      result not in the list (apimachinery's retainKeys strategy),
+    * lists of objects merge by the upstream merge key — resolved from
+      ``_LIST_MERGE_KEYS`` by field name, ``name`` as the fallback; an
+      item ``{"$patch": "delete", <key>: x}`` removes the matching
+      element, and any ``{"$patch": "replace"}`` element makes the
+      remaining items replace the list wholesale (apimachinery's
+      mergeSliceWithSpecialElements),
+    * ``$setElementOrder/<field>: [...]`` reorders the merged list: listed
+      elements take the directive's order, server-only elements keep their
+      relative position by live index (apimachinery's normalizeElementOrder),
+    * merge-strategy primitive lists (``_PRIMITIVE_MERGE_FIELDS``) union;
+      ``$deleteFromPrimitiveList/<field>: [v...]`` removes values,
+    * other primitive lists are replaced (the K8s atomic default).
 
-    Known deviations from apimachinery (documented in PARITY.md and
-    pinned by tests/test_conformance_vectors.py): no ``$setElementOrder``
-    support, no ``$retainKeys``, and — schema-less — merge keys other
-    than ``name`` and merge-strategy primitive lists are not inferred.
+    Remaining deviations from apimachinery (PARITY.md, pinned by
+    tests/test_conformance_vectors.py): merge keys resolve by field name
+    rather than by typed schema, and invalid patches apimachinery rejects
+    (e.g. a ``$setElementOrder`` list omitting a patched element) apply
+    leniently instead of erroring.
     """
+    orders: dict[str, list[Any]] = {}
+    live_before: dict[str, Any] = {}
     for key, value in patch.items():
-        if key == "$patch":
+        if key.startswith("$setElementOrder/") and isinstance(value, list):
+            field_name = key.split("/", 1)[1]
+            orders[field_name] = value
+            live_before[field_name] = copy.deepcopy(target.get(field_name))
+    for key, value in patch.items():
+        if key in ("$patch", "$retainKeys"):
+            continue
+        if key.startswith("$setElementOrder/"):
             continue
         if key.startswith("$deleteFromPrimitiveList/"):
             field_name = key.split("/", 1)[1]
@@ -115,12 +170,7 @@ def strategic_merge_patch(
                 target.pop(key, None)
                 continue
             if directive == "replace":
-                replacement = {
-                    k: copy.deepcopy(v)
-                    for k, v in value.items()
-                    if k != "$patch"
-                }
-                target[key] = replacement
+                target[key] = _strip_directives(value)
                 continue
             existing = target.get(key)
             if not isinstance(existing, dict):
@@ -128,7 +178,7 @@ def strategic_merge_patch(
                 target[key] = existing
             strategic_merge_patch(existing, value)
         elif isinstance(value, list):
-            merged_list = _strategic_merge_list(target.get(key), value)
+            merged_list = _strategic_merge_list(key, target.get(key), value)
             # Pure-directive patches ($patch:delete of absent elements)
             # must not conjure the key into existence — a real apiserver
             # treats them as a no-op. An explicit empty list still sets.
@@ -137,50 +187,190 @@ def strategic_merge_patch(
             target[key] = merged_list
         else:
             target[key] = copy.deepcopy(value)
+    for field_name, order in orders.items():
+        current = target.get(field_name)
+        if isinstance(current, list):
+            target[field_name] = _reorder_list(
+                field_name, current, order, live_before.get(field_name)
+            )
+    retain = patch.get("$retainKeys")
+    if isinstance(retain, list):
+        for key in list(target):
+            if key not in retain:
+                target.pop(key)
     return target
 
 
-def _strategic_merge_list(current: Any, patch_items: list[Any]) -> list[Any]:
+def _is_directive_key(key: Any) -> bool:
+    return isinstance(key, str) and (
+        key in ("$patch", "$retainKeys")
+        or key.startswith(("$setElementOrder/", "$deleteFromPrimitiveList/"))
+    )
+
+
+def _strip_directives(item: Mapping[str, Any]) -> dict[str, Any]:
+    """Deep-copy a patch element minus directive keys — directives are
+    instructions to the merge, never data a real apiserver persists."""
+    return {
+        k: copy.deepcopy(v) for k, v in item.items() if not _is_directive_key(k)
+    }
+
+
+def _merge_key_for(
+    field: str, current_items: list[Any], patch_items: list[Any]
+) -> Optional[str]:
+    """Pick the upstream merge key for a list field, or None for the
+    atomic/replace strategy. Every element on both sides must carry the
+    key — mirroring apimachinery, which errors on keyless elements (we
+    fall back to replace instead of erroring)."""
+    pool = list(current_items) + list(patch_items)
+    if not pool:
+        return "name"
+    if not all(isinstance(i, Mapping) for i in pool):
+        return None
+    for key in _LIST_MERGE_KEYS.get(field, ()) + ("name",):
+        if all(key in i for i in pool):
+            return key
+    return None
+
+
+def _strategic_merge_list(
+    field: str, current: Any, patch_items: list[Any]
+) -> list[Any]:
     if any(
-        isinstance(i, Mapping) and i.get("$patch") == "replace" and "name" not in i
+        isinstance(i, Mapping) and i.get("$patch") == "replace"
         for i in patch_items
     ):
-        # apimachinery: a bare {"$patch": "replace"} element means the
-        # remaining items replace the list wholesale.
-        return [
-            copy.deepcopy(i)
-            for i in patch_items
-            if not (isinstance(i, Mapping) and i.get("$patch") == "replace")
-        ]
-    mergeable = (
-        isinstance(current, list)
-        and all(isinstance(i, Mapping) and "name" in i for i in current)
-        and all(isinstance(i, Mapping) and "name" in i for i in patch_items)
-    )
-    if not mergeable:
+        # apimachinery (mergeSliceWithSpecialElements): ANY element
+        # carrying {"$patch": "replace"} makes the remaining items replace
+        # the list wholesale; directive elements themselves are dropped.
+        result: list[Any] = []
+        for i in patch_items:
+            if isinstance(i, Mapping):
+                if i.get("$patch") == "delete":
+                    continue
+                stripped = _strip_directives(i)
+                if stripped or "$patch" not in i:
+                    result.append(stripped)
+            else:
+                result.append(copy.deepcopy(i))
+        return result
+    cur_list = current if isinstance(current, list) else []
+    if field in _PRIMITIVE_MERGE_FIELDS and all(
+        not isinstance(i, Mapping)
+        for i in itertools.chain(cur_list, patch_items)
+    ):
+        merged = [copy.deepcopy(v) for v in cur_list]
+        for v in patch_items:
+            if v not in merged:
+                merged.append(copy.deepcopy(v))
+        return merged
+    key = _merge_key_for(field, cur_list, patch_items)
+    if key is None or (current is not None and not isinstance(current, list)):
         # Replace strategy — but directives are instructions, not data: a
         # $patch:delete of an absent element is a no-op on a real
-        # apiserver, never a stored phantom object.
+        # apiserver, never a stored phantom object, and directive keys
+        # are never persisted.
         return [
-            copy.deepcopy(i)
+            _strip_directives(i) if isinstance(i, Mapping) else copy.deepcopy(i)
             for i in patch_items
             if not (isinstance(i, Mapping) and i.get("$patch") == "delete")
         ]
-    merged: list[Any] = [copy.deepcopy(i) for i in current]
-    index = {item["name"]: pos for pos, item in enumerate(merged)}
+    merged = [copy.deepcopy(i) for i in cur_list]
+    index = {item[key]: pos for pos, item in enumerate(merged)}
     for item in patch_items:
-        name = item["name"]
-        if item.get("$patch") == "delete":
-            if name in index:
-                merged = [m for m in merged if m["name"] != name]
-                index = {m["name"]: pos for pos, m in enumerate(merged)}
+        kval = item[key]
+        directive = item.get("$patch")
+        if directive == "delete":
+            if kval in index:
+                merged = [m for m in merged if m[key] != kval]
+                index = {m[key]: pos for pos, m in enumerate(merged)}
             continue
-        if name in index:
-            strategic_merge_patch(merged[index[name]], item)
+        if kval in index:
+            strategic_merge_patch(merged[index[kval]], item)
         else:
             merged.append(copy.deepcopy(item))
-            index[name] = len(merged) - 1
+            index[kval] = len(merged) - 1
     return merged
+
+
+def _reorder_list(
+    field: str, merged: list[Any], order: list[Any], live_before: Any
+) -> list[Any]:
+    """Apply a ``$setElementOrder/<field>`` directive to the merged list.
+
+    apimachinery's normalizeElementOrder: elements named by the directive
+    take the directive's order; elements the patch never mentioned
+    ("server-only") keep their relative order and slot in by comparing
+    live-list indexes against the directive elements. Elements in neither
+    the directive nor the live list (lenient here, an error upstream)
+    append at the end.
+    """
+    if not order or not merged:
+        return merged
+    if all(isinstance(o, Mapping) for o in order):
+        key = None
+        for cand in _LIST_MERGE_KEYS.get(field, ()) + ("name",):
+            if all(cand in o for o in order) and all(
+                isinstance(m, Mapping) and cand in m for m in merged
+            ):
+                key = cand
+                break
+        if key is None:
+            return merged
+
+        def keyfn(item: Any) -> Any:
+            return item.get(key) if isinstance(item, Mapping) else None
+
+    else:
+
+        def keyfn(item: Any) -> Any:
+            return None if isinstance(item, Mapping) else item
+
+    try:
+        pos_in_order: dict[Any, int] = {}
+        for i, o in enumerate(order):
+            pos_in_order.setdefault(keyfn(o), i)
+        live = live_before if isinstance(live_before, list) else []
+        live_idx: dict[Any, int] = {}
+        for i, item in enumerate(live):
+            live_idx.setdefault(keyfn(item), i)
+        ordered = sorted(
+            (m for m in merged if keyfn(m) in pos_in_order),
+            key=lambda m: pos_in_order[keyfn(m)],
+        )
+        server_only = [m for m in merged if keyfn(m) not in pos_in_order]
+    except TypeError:
+        # Unhashable keys — leave the merge result's order untouched.
+        return merged
+    inf = float("inf")
+    result: list[Any] = []
+    i = j = 0
+    while i < len(server_only) and j < len(ordered):
+        s_idx = live_idx.get(keyfn(server_only[i]), inf)
+        p_idx = live_idx.get(keyfn(ordered[j]), inf)
+        if s_idx < p_idx:
+            result.append(server_only[i])
+            i += 1
+        else:
+            result.append(ordered[j])
+            j += 1
+    result.extend(server_only[i:])
+    result.extend(ordered[j:])
+    return result
+
+
+#: API groups whose types carry strategic-merge struct tags upstream —
+#: i.e. the groups LocalApiServer/FakeCluster store as built-ins. Every
+#: other group is CRD-backed and (like a real apiserver) answers 415 to a
+#: strategic-merge-patch content type.
+_STRATEGIC_GROUPS = frozenset({"", "apps", "apiextensions.k8s.io"})
+
+
+def _supports_strategic(data: Mapping[str, Any]) -> bool:
+    api_version = data.get("apiVersion") or ""
+    group = api_version.rsplit("/", 1)[0] if "/" in api_version else ""
+    return group in _STRATEGIC_GROUPS
 
 
 def _field_value(data: Mapping[str, Any], dotted: str) -> Any:
@@ -772,6 +962,14 @@ class FakeCluster(Client):
                                         "patch_type": patch_type})
             current = self._get_raw(kind, name, namespace)
             old = copy.deepcopy(current)
+            if patch_type == "strategic" and not _supports_strategic(current):
+                # Real-apiserver semantics: strategic merge patch only
+                # exists for built-in typed resources (their Go structs
+                # carry the patch tags); custom resources answer 415.
+                raise UnsupportedMediaTypeError(
+                    "strategic merge patch is not supported for custom "
+                    f"resources ({current.get('apiVersion', '?')} {kind})"
+                )
             if patch_type == "strategic":
                 strategic_merge_patch(current, patch or {})
             elif patch_type == "merge":

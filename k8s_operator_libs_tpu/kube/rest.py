@@ -34,6 +34,7 @@ from .client import (
     ConflictError,
     InvalidError,
     NotFoundError,
+    UnsupportedMediaTypeError,
     WatchExpiredError,
 )
 from .objects import KubeObject, wrap
@@ -249,11 +250,13 @@ _ERRORS_BY_REASON = {
     "Conflict": ConflictError,
     "Invalid": InvalidError,
     "Expired": WatchExpiredError,
+    "UnsupportedMediaType": UnsupportedMediaTypeError,
 }
 _ERRORS_BY_CODE = {
     404: NotFoundError,
     409: ConflictError,
     410: WatchExpiredError,
+    415: UnsupportedMediaTypeError,
     422: InvalidError,
 }
 

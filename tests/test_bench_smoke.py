@@ -65,3 +65,47 @@ def test_trials_aggregation():
     assert out["median_wall_s"] == 2.0
     assert out["min_wall_s"] == 1.0
     assert out["max_wall_s"] == 3.0
+
+
+class TestStageWatchdog:
+    """The mid-run tunnel-wedge armor (round 5: the startup probe
+    succeeded, then calibration hung for 30 minutes — the watchdog is
+    what turns that into a CPU-fallback artifact instead of an empty
+    BENCH file)."""
+
+    def test_not_armed_on_cpu_fallback(self, monkeypatch):
+        monkeypatch.setenv("BENCH_BACKEND_FALLBACK", "probe failed")
+        assert bench._start_stage_watchdog() is None
+
+    def test_stall_triggers_cpu_reexec(self, monkeypatch):
+        monkeypatch.delenv("BENCH_BACKEND_FALLBACK", raising=False)
+        calls = []
+
+        def fake_execve(exe, argv, env):
+            calls.append(env)
+
+        monkeypatch.setattr(bench, "_last_progress", bench.time.time() - 60)
+        thread = bench._start_stage_watchdog(
+            stage_deadline_s=1.0, poll_s=0.01, _execve=fake_execve
+        )
+        thread.join(timeout=5)
+        assert not thread.is_alive()
+        assert calls, "watchdog never fired"
+        env = calls[0]
+        assert env["BENCH_BACKEND_CHECKED"] == "1"
+        assert "stalled" in env["BENCH_BACKEND_FALLBACK"]
+        # The fallback env is hermetic-CPU: the re-exec'd bench must not
+        # touch the wedged tunnel again.
+        assert env.get("JAX_PLATFORMS") == "cpu"
+
+    def test_progress_resets_the_clock(self, monkeypatch):
+        monkeypatch.delenv("BENCH_BACKEND_FALLBACK", raising=False)
+        calls = []
+        monkeypatch.setattr(bench, "_last_progress", bench.time.time() - 60)
+        bench._progress("unit-test-stage")
+        thread = bench._start_stage_watchdog(
+            stage_deadline_s=30.0, poll_s=0.01, _execve=lambda *a: calls.append(a)
+        )
+        bench.time.sleep(0.1)
+        assert thread.is_alive()  # still watching, not fired
+        assert not calls

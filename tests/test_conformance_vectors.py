@@ -111,9 +111,17 @@ class TestStrategicMergeVectors:
     )
     def test_declared_deviations_actually_deviate(self, case):
         """Each declared deviation must really NOT match apimachinery's
-        documented result — if the engine grows support, this fails and
-        the deviation list (and PARITY.md) must shrink."""
+        documented behavior — if the engine grows support, this fails and
+        the deviation list (and PARITY.md) must shrink.
+
+        Two shapes: ``upstream_expected`` = apimachinery produces that
+        result, we must produce something else; ``upstream_error: true``
+        = apimachinery rejects the patch, we must apply it leniently
+        (without raising)."""
         target = copy.deepcopy(case["original"])
+        if case.get("upstream_error"):
+            strategic_merge_patch(target, case["patch"])  # must not raise
+            return
         try:
             strategic_merge_patch(target, case["patch"])
         except Exception:
@@ -122,6 +130,55 @@ class TestStrategicMergeVectors:
             f"deviation {case['name']!r} now matches upstream — remove it "
             "from the fixture's deviations list and from PARITY.md"
         )
+
+
+class TestStrategicPatchOnCustomResources:
+    """A real apiserver only implements strategic merge patch for built-in
+    typed resources (their Go structs carry the patch tags); custom
+    resources answer 415 UnsupportedMediaType. Both write paths must
+    reproduce that, and merge patch must keep working for CRs."""
+
+    def _nm(self, name):
+        from k8s_operator_libs_tpu.kube.objects import NodeMaintenance
+
+        return NodeMaintenance.new(name, namespace=NS)
+
+    def test_fake_cluster_rejects(self):
+        from k8s_operator_libs_tpu.kube import UnsupportedMediaTypeError
+
+        cluster = FakeCluster()
+        cluster.create(self._nm("nm-reject"))
+        with pytest.raises(UnsupportedMediaTypeError):
+            cluster.patch(
+                "NodeMaintenance",
+                "nm-reject",
+                NS,
+                patch={"spec": {"requestorID": "x"}},
+                patch_type="strategic",
+            )
+        # Merge patch stays supported for CRs.
+        patched = cluster.patch(
+            "NodeMaintenance",
+            "nm-reject",
+            NS,
+            patch={"spec": {"requestorID": "x"}},
+            patch_type="merge",
+        )
+        assert patched.raw["spec"]["requestorID"] == "x"
+
+    def test_http_wire_rejects_with_415(self, conformance_server):
+        from k8s_operator_libs_tpu.kube import UnsupportedMediaTypeError
+
+        server, client = conformance_server
+        client.create(self._nm("nm-wire-reject"))
+        with pytest.raises(UnsupportedMediaTypeError):
+            client.patch(
+                "NodeMaintenance",
+                "nm-wire-reject",
+                NS,
+                patch={"spec": {"requestorID": "x"}},
+                patch_type="strategic",
+            )
 
 
 @pytest.fixture(scope="module")

@@ -104,9 +104,17 @@ class TestStrategicMergePatch:
         assert target["spec"]["containers"] == [{"name": "b"}]
 
     def test_primitive_list_replaced(self):
+        # Atomic upstream (no patchStrategy tag on args) → replace.
+        target = {"spec": {"args": ["-x", "-y"]}}
+        strategic_merge_patch(target, {"spec": {"args": ["-z"]}})
+        assert target["spec"]["args"] == ["-z"]
+
+    def test_merge_strategy_primitive_list_unions(self):
+        # ObjectMeta.finalizers carries patchStrategy:"merge" upstream —
+        # patch values union in; removal needs $deleteFromPrimitiveList.
         target = {"metadata": {"finalizers": ["x", "y"]}}
-        strategic_merge_patch(target, {"metadata": {"finalizers": ["z"]}})
-        assert target["metadata"]["finalizers"] == ["z"]
+        strategic_merge_patch(target, {"metadata": {"finalizers": ["z", "x"]}})
+        assert target["metadata"]["finalizers"] == ["x", "y", "z"]
 
     def test_delete_directive_on_absent_list_is_noop(self):
         # A $patch:delete of an element that does not exist must not store
