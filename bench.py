@@ -104,6 +104,7 @@ def _start_stage_watchdog(
     stage_deadline_s: float = 600.0,
     poll_s: float = 15.0,
     _execve=os.execve,
+    _stop=None,
 ):
     """Re-exec on CPU if no stage completes within ``stage_deadline_s``.
 
@@ -118,8 +119,14 @@ def _start_stage_watchdog(
         return None
     import threading
 
+    # Arm the clock NOW: _last_progress was stamped at import, and the
+    # backend probe (up to ~210s) ran in between — charging that against
+    # the first stage could spuriously dump a healthy live run to CPU.
+    global _last_progress
+    _last_progress = time.time()
+
     def watch() -> None:
-        while True:
+        while not (_stop is not None and _stop.is_set()):
             time.sleep(poll_s)
             stalled_s = time.time() - _last_progress
             if stalled_s > stage_deadline_s:

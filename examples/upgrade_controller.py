@@ -160,6 +160,24 @@ def main(argv: list[str] | None = None) -> int:
         default="0.0.0.0",
         help="metrics bind address (default 0.0.0.0: in-cluster scrape)",
     )
+    parser.add_argument(
+        "--leader-elect",
+        action="store_true",
+        help="campaign for a coordination.k8s.io Lease before reconciling "
+        "(the controller-runtime Manager default for the reference's "
+        "consumer operators); losing the lease is fatal",
+    )
+    parser.add_argument(
+        "--leader-elect-id",
+        default="",
+        help="holder identity for --leader-elect "
+        "(default: <hostname>_<pid>, the client-go convention)",
+    )
+    parser.add_argument(
+        "--leader-elect-lease",
+        default="",
+        help="Lease name (default: upgrade-controller-<device>)",
+    )
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO, format="%(levelname)s %(message)s")
 
@@ -329,16 +347,51 @@ def main(argv: list[str] | None = None) -> int:
         ).start()
         print(f"metrics: {metrics_server.url}")
 
+    elector = None
+    if args.leader_elect:
+        import socket
+
+        from k8s_operator_libs_tpu.kube import (
+            LeaderElectionConfig,
+            LeaderElector,
+        )
+
+        identity = args.leader_elect_id or f"{socket.gethostname()}_{os.getpid()}"
+        elector = LeaderElector(
+            client,
+            LeaderElectionConfig(
+                name=args.leader_elect_lease
+                or f"upgrade-controller-{args.device}",
+                namespace=args.namespace,
+                identity=identity,
+            ),
+        ).start()
+        print(f"leader election: campaigning as {identity!r}")
+        elector.wait_for_leadership()
+        print("leader election: leading; starting reconciles")
+
     passes = 0
     max_demo_passes = 100  # a 4-node roll converges in <15; 100 = stuck
     consecutive_failures = 0
     while True:
+        if elector is not None and not elector.is_leader():
+            # controller-runtime semantics: a deposed leader must never
+            # keep reconciling — exit and let the restart policy
+            # re-campaign from scratch.
+            print("leader election: lease lost; exiting", file=sys.stderr)
+            for informer in informers:
+                informer.stop()
+            return 3
         passes += 1
         if sim is not None and passes > max_demo_passes:
             print(
                 f"demo: did not converge within {max_demo_passes} passes",
                 file=sys.stderr,
             )
+            for informer in informers:
+                informer.stop()
+            if elector is not None:
+                elector.stop()  # release the Lease: standbys take over
             return 1
         if sim is not None:
             sim.step()
@@ -389,10 +442,14 @@ def main(argv: list[str] | None = None) -> int:
             )
             if all_done and sim.all_pods_ready_and_current():
                 print(f"demo: rolling upgrade complete in {passes} passes")
+                if elector is not None:
+                    elector.stop()  # releases: standbys take over now
                 return 0
         if args.once:
             for informer in informers:
                 informer.stop()
+            if elector is not None:
+                elector.stop()
             return 0
         if dirty is not None:
             # Event-triggered with the interval as the resync fallback.

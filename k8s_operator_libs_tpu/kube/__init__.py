@@ -15,6 +15,7 @@ from .objects import (
     DaemonSet,
     Event,
     KubeObject,
+    Lease,
     Node,
     NodeMaintenance,
     Pod,
@@ -29,6 +30,7 @@ from .resources import ResourceInfo, register_resource, resource_for_kind
 from .rest import RestClient, RestConfig, RestConfigError
 from .apiserver import LocalApiServer
 from .informer import Informer
+from .leader import LeaderElectionConfig, LeaderElector
 
 __all__ = [
     "AlreadyExistsError",
@@ -52,6 +54,9 @@ __all__ = [
     "WatchExpiredError",
     "KubeObject",
     "LabelSelector",
+    "LeaderElectionConfig",
+    "LeaderElector",
+    "Lease",
     "Informer",
     "LocalApiServer",
     "merge_patch",

@@ -289,7 +289,11 @@ def _strategic_merge_list(
         if kval in index:
             strategic_merge_patch(merged[index[kval]], item)
         else:
-            merged.append(copy.deepcopy(item))
+            # Appending still interprets the element AS a patch (against
+            # nothing) so nested directives are consumed, never stored.
+            fresh: dict[str, Any] = {}
+            strategic_merge_patch(fresh, item)
+            merged.append(fresh)
             index[kval] = len(merged) - 1
     return merged
 
@@ -364,7 +368,9 @@ def _reorder_list(
 #: i.e. the groups LocalApiServer/FakeCluster store as built-ins. Every
 #: other group is CRD-backed and (like a real apiserver) answers 415 to a
 #: strategic-merge-patch content type.
-_STRATEGIC_GROUPS = frozenset({"", "apps", "apiextensions.k8s.io"})
+_STRATEGIC_GROUPS = frozenset(
+    {"", "apps", "apiextensions.k8s.io", "coordination.k8s.io"}
+)
 
 
 def _supports_strategic(data: Mapping[str, Any]) -> bool:

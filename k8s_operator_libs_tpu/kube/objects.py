@@ -327,6 +327,33 @@ class Service(KubeObject):
         return self.cluster_ip == "None"
 
 
+class Lease(KubeObject):
+    """coordination.k8s.io/v1 Lease — the lock object behind leader
+    election. The reference library assumes controller-runtime Manager
+    hosting (SURVEY §1 L6: consumer operators' Reconcile loops); managers
+    take a LeaseLock through k8s.io/client-go/tools/leaderelection, and
+    ``kube.leader.LeaderElector`` is this framework's equivalent."""
+
+    KIND = "Lease"
+    API_VERSION = "coordination.k8s.io/v1"
+
+    @property
+    def holder_identity(self) -> str:
+        return self.spec.get("holderIdentity") or ""
+
+    @property
+    def lease_duration_seconds(self) -> int:
+        return int(self.spec.get("leaseDurationSeconds") or 0)
+
+    @property
+    def renew_time(self) -> str:
+        return self.spec.get("renewTime") or ""
+
+    @property
+    def lease_transitions(self) -> int:
+        return int(self.spec.get("leaseTransitions") or 0)
+
+
 class CustomResourceDefinition(KubeObject):
     KIND = "CustomResourceDefinition"
     API_VERSION = "apiextensions.k8s.io/v1"
@@ -409,6 +436,7 @@ KINDS: dict[str, Type[KubeObject]] = {
         ControllerRevision,
         Event,
         Service,
+        Lease,
         CustomResourceDefinition,
         NodeMaintenance,
     )

@@ -84,9 +84,8 @@ class TestStageWatchdog:
         def fake_execve(exe, argv, env):
             calls.append(env)
 
-        monkeypatch.setattr(bench, "_last_progress", bench.time.time() - 60)
         thread = bench._start_stage_watchdog(
-            stage_deadline_s=1.0, poll_s=0.01, _execve=fake_execve
+            stage_deadline_s=0.05, poll_s=0.01, _execve=fake_execve
         )
         thread.join(timeout=5)
         assert not thread.is_alive()
@@ -98,14 +97,29 @@ class TestStageWatchdog:
         # touch the wedged tunnel again.
         assert env.get("JAX_PLATFORMS") == "cpu"
 
-    def test_progress_resets_the_clock(self, monkeypatch):
+    def test_arming_resets_the_clock_and_progress_keeps_it_alive(
+        self, monkeypatch
+    ):
+        # Arming must reset the progress clock (probe time spent before
+        # main() must not count against the first stage), and _progress
+        # must keep the watchdog from firing.
+        import threading
+
         monkeypatch.delenv("BENCH_BACKEND_FALLBACK", raising=False)
         calls = []
-        monkeypatch.setattr(bench, "_last_progress", bench.time.time() - 60)
-        bench._progress("unit-test-stage")
+        stop = threading.Event()
+        bench._last_progress = bench.time.time() - 3600  # stale pre-arm
         thread = bench._start_stage_watchdog(
-            stage_deadline_s=30.0, poll_s=0.01, _execve=lambda *a: calls.append(a)
+            stage_deadline_s=30.0,
+            poll_s=0.01,
+            _execve=lambda *a: calls.append(a),
+            _stop=stop,
         )
-        bench.time.sleep(0.1)
+        bench.time.sleep(0.05)
+        bench._progress("unit-test-stage")
+        bench.time.sleep(0.05)
         assert thread.is_alive()  # still watching, not fired
         assert not calls
+        stop.set()
+        thread.join(timeout=5)
+        assert not thread.is_alive()

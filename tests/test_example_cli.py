@@ -58,3 +58,14 @@ def test_once_mode_exits_after_one_pass():
     assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-1000:]
     assert proc.stdout.count("pass 1:") == 1
     assert "pass 2:" not in proc.stdout
+
+
+def test_demo_with_leader_election():
+    """--leader-elect campaigns over the same (in-memory) cluster: the
+    single replica acquires the Lease, reconciles to completion, and
+    releases on exit."""
+    proc = run_demo("--leader-elect", "--leader-elect-id", "demo-replica")
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-1000:]
+    assert "campaigning as 'demo-replica'" in proc.stdout
+    assert "leading; starting reconciles" in proc.stdout
+    assert "rolling upgrade complete" in proc.stdout

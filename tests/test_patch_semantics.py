@@ -486,3 +486,34 @@ class TestProviderUsesStrategicForLabels:
             node, "tpu.example.com/t", "1"
         )
         assert [pt for pt, _ in seen] == ["strategic", "merge"]
+
+
+class TestAppendedElementDirectives:
+    def test_appended_merge_list_element_never_stores_directives(self):
+        # An element APPENDED to a keyed merge list is still a patch:
+        # its directive keys (top-level and nested) are consumed, never
+        # persisted — same invariant as the replace paths.
+        target = {"spec": {"containers": [{"name": "a"}]}}
+        strategic_merge_patch(
+            target,
+            {
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "b",
+                            "image": "2",
+                            "$patch": "merge",
+                            "resources": {"$retainKeys": ["limits"],
+                                          "limits": {"cpu": "1"},
+                                          "requests": {"cpu": "1"}},
+                        }
+                    ]
+                }
+            },
+        )
+        added = target["spec"]["containers"][1]
+        assert added == {
+            "name": "b",
+            "image": "2",
+            "resources": {"limits": {"cpu": "1"}},
+        }
