@@ -17,6 +17,7 @@ from __future__ import annotations
 import argparse
 import logging
 import os
+import signal
 import sys
 import time
 
@@ -181,195 +182,236 @@ def main(argv: list[str] | None = None) -> int:
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO, format="%(levelname)s %(message)s")
 
-    device = DeviceClass.tpu() if args.device == "tpu" else DeviceClass.nvidia()
-    policy = load_policy(args.policy)
-    selector = parse_selector(args.selector)
-
-    sim = None
-    if args.demo:
-        client, sim = build_demo(args)
-    else:
-        try:
-            from k8s_operator_libs_tpu.kube.rest import RestClient
-
-            client = RestClient.from_environment()
-        except Exception as e:  # RestConfigError when unconfigured
-            raise SystemExit(
-                f"no cluster access configured ({e}); use --demo for the "
-                "in-memory pool"
-            )
-
-    mgr = ClusterUpgradeStateManager(
-        client, device, runner=TaskRunner(inline=args.demo)
-    )
-    validation_pod_sim = None
-    if args.validation_pod:
-        from k8s_operator_libs_tpu.tpu import (
-            SliceProbeSpec,
-            ValidationPodManager,
-            ValidationPodSpec,
-            make_validation_provisioner,
+    # Graceful termination, installed before anything acquires resources:
+    # a terminating controller pod (kubelet sends SIGTERM) must release
+    # its Lease on the way down so a standby takes over immediately. The
+    # handler raises SystemExit; the try/finally around the campaign and
+    # reconcile loop does the one cleanup.
+    def _on_signal(signum, frame):
+        print(
+            f"received signal {signum}; shutting down gracefully",
+            file=sys.stderr,
         )
+        raise SystemExit(0)
 
-        if args.slice_aware:
-            # Production default for slice-aware TPU pools: one probe GANG
-            # per multi-host slice (jax.distributed world spanning every
-            # host, cross-host ICI links in the battery, one shared
-            # verdict); single-host slices fall back to per-node pods.
-            provisioner = make_validation_provisioner(
-                client, SliceProbeSpec(namespace=args.namespace)
-            )
-        else:
-            spec = ValidationPodSpec(namespace=args.namespace)
-            provisioner = ValidationPodManager(client, spec)
-        mgr.with_validation_enabled(pod_provisioner=provisioner)
-        if args.demo:
-            # The demo has no kubelet; simulate one running the probe pods.
-            from k8s_operator_libs_tpu.kube.sim import ValidationPodSimulator
+    signal.signal(signal.SIGTERM, _on_signal)
 
-            validation_pod_sim = ValidationPodSimulator(
-                client, namespace=args.namespace
-            )
-    elif args.ici_gate or (args.demo and args.device == "tpu"):
-        from k8s_operator_libs_tpu.tpu import IciHealthGate, SliceScopedGate
-
-        gate = IciHealthGate(payload_mb=1.0, matmul_size=1024, run_burnin=True)
-        hook = (
-            SliceScopedGate(gate).validation_hook()
-            if args.slice_aware
-            else gate.validation_hook()
-        )
-        mgr.with_validation_enabled(validation_hook=hook)
-    if args.slice_aware:
-        from k8s_operator_libs_tpu.tpu import enable_slice_aware_planning
-
-        enable_slice_aware_planning(mgr)
-    maintenance_sim = None
-    if args.requestor:
-        from k8s_operator_libs_tpu.upgrade import (
-            RequestorOptions,
-            enable_requestor_mode,
-        )
-
-        opts = RequestorOptions.from_env()
-        opts.use_maintenance_operator = True  # the flag IS the opt-in
-        # The env var wins over the argparse default; from_env honors it
-        # deliberately (MAINTENANCE_OPERATOR_REQUESTOR_NAMESPACE).
-        if not os.environ.get("MAINTENANCE_OPERATOR_REQUESTOR_NAMESPACE"):
-            opts.namespace = args.namespace
-        if args.post_maintenance:
-            opts.use_post_maintenance = True
-            if args.ici_gate and not args.validation_pod and not args.demo:
-                # In-process warm-up ONLY where the in-process gate shape
-                # already applies (--ici-gate: the controller owns the
-                # node's chips, e.g. single-host pools). In the
-                # --validation-pod production shape the controller is off
-                # the node — an in-process battery would warm the WRONG
-                # host's cache and stall the reconcile loop; there the
-                # probe pod's hostPath cache mount is the warm-up story.
-                from k8s_operator_libs_tpu.tpu import cache_warmup_hook
-
-                opts.post_maintenance_hook = cache_warmup_hook()
-        enable_requestor_mode(mgr, opts)
-        if args.demo:
-            from k8s_operator_libs_tpu.kube.sim import (
-                MaintenanceOperatorSimulator,
-            )
-
-            maintenance_sim = MaintenanceOperatorSimulator(
-                client, namespace=args.namespace
-            )
-
-    # Watch-driven triggering: informers mark the world dirty; the loop
-    # reconciles on deltas (filtered through the requestor predicate for
-    # NodeMaintenance) and falls back to the interval as a resync — the
-    # reference's controller-runtime shape (watches + periodic requeue).
-    dirty = None
+    # One try spanning ALL resource acquisition, so the SIGTERM
+    # handler's SystemExit always reaches the finally below.
     informers = []
-    if args.watch and not args.demo:
-        import threading
+    elector = None
+    metrics_server = None
+    try:
+        device = DeviceClass.tpu() if args.device == "tpu" else DeviceClass.nvidia()
+        policy = load_policy(args.policy)
+        selector = parse_selector(args.selector)
 
-        from k8s_operator_libs_tpu.kube import Informer
-        from k8s_operator_libs_tpu.upgrade import condition_changed_predicate
+        sim = None
+        if args.demo:
+            client, sim = build_demo(args)
+        else:
+            try:
+                from k8s_operator_libs_tpu.kube.rest import RestClient
 
-        dirty = threading.Event()
-
-        def mark_dirty(event_type, obj, old):
-            dirty.set()
-
-        def maintenance_dirty(event_type, obj, old):
-            # React to condition flips/deletions only, as the reference's
-            # predicate-filtered watch does (upgrade_requestor.go:115-159).
-            if event_type != "MODIFIED" or old is None:
-                dirty.set()
-                return
-            if condition_changed_predicate(old.raw, obj.raw):
-                dirty.set()
-
-        informers = [
-            Informer(client, "Node"),
-            Informer(client, "Pod", namespace=args.namespace,
-                     label_selector=selector),
-            # The rollout trigger itself: a driver image bump lands as a
-            # new ControllerRevision / DaemonSet template change — with
-            # only Node/Pod watches, nothing would wake the controller to
-            # START the roll (revision-hash sync, pod_manager.go:84-118).
-            Informer(client, "DaemonSet", namespace=args.namespace,
-                     label_selector=selector),
-            Informer(client, "ControllerRevision", namespace=args.namespace,
-                     label_selector=selector),
-        ]
-        for informer in informers:
-            informer.add_event_handler(mark_dirty)
-        if args.requestor:
-            nm_informer = Informer(client, "NodeMaintenance")
-            nm_informer.add_event_handler(maintenance_dirty)
-            informers.append(nm_informer)
-        # Start all, THEN wait: sequential start+wait would serialize the
-        # sync latency across informers.
-        for informer in informers:
-            informer.start()
-        for informer in informers:
-            if not informer.wait_for_sync(timeout=30):
-                logging.warning(
-                    "%s informer did not sync within 30s; reconciles may "
-                    "miss its triggers until it catches up", informer.kind,
+                client = RestClient.from_environment()
+            except Exception as e:  # RestConfigError when unconfigured
+                raise SystemExit(
+                    f"no cluster access configured ({e}); use --demo for the "
+                    "in-memory pool"
                 )
 
-    metrics = None
-    metrics_server = None
-    if args.metrics_port:
-        from k8s_operator_libs_tpu.upgrade import MetricsServer, UpgradeMetrics
-
-        metrics = UpgradeMetrics(mgr)
-        metrics_server = MetricsServer(
-            metrics, port=args.metrics_port, host=args.metrics_host
-        ).start()
-        print(f"metrics: {metrics_server.url}")
-
-    elector = None
-    if args.leader_elect:
-        import socket
-
-        from k8s_operator_libs_tpu.kube import (
-            LeaderElectionConfig,
-            LeaderElector,
+        mgr = ClusterUpgradeStateManager(
+            client, device, runner=TaskRunner(inline=args.demo)
         )
+        validation_pod_sim = None
+        if args.validation_pod:
+            from k8s_operator_libs_tpu.tpu import (
+                SliceProbeSpec,
+                ValidationPodManager,
+                ValidationPodSpec,
+                make_validation_provisioner,
+            )
 
-        identity = args.leader_elect_id or f"{socket.gethostname()}_{os.getpid()}"
-        elector = LeaderElector(
-            client,
-            LeaderElectionConfig(
-                name=args.leader_elect_lease
-                or f"upgrade-controller-{args.device}",
-                namespace=args.namespace,
-                identity=identity,
-            ),
-        ).start()
-        print(f"leader election: campaigning as {identity!r}")
-        elector.wait_for_leadership()
-        print("leader election: leading; starting reconciles")
+            if args.slice_aware:
+                # Production default for slice-aware TPU pools: one probe GANG
+                # per multi-host slice (jax.distributed world spanning every
+                # host, cross-host ICI links in the battery, one shared
+                # verdict); single-host slices fall back to per-node pods.
+                provisioner = make_validation_provisioner(
+                    client, SliceProbeSpec(namespace=args.namespace)
+                )
+            else:
+                spec = ValidationPodSpec(namespace=args.namespace)
+                provisioner = ValidationPodManager(client, spec)
+            mgr.with_validation_enabled(pod_provisioner=provisioner)
+            if args.demo:
+                # The demo has no kubelet; simulate one running the probe pods.
+                from k8s_operator_libs_tpu.kube.sim import ValidationPodSimulator
 
+                validation_pod_sim = ValidationPodSimulator(
+                    client, namespace=args.namespace
+                )
+        elif args.ici_gate or (args.demo and args.device == "tpu"):
+            from k8s_operator_libs_tpu.tpu import IciHealthGate, SliceScopedGate
+
+            gate = IciHealthGate(payload_mb=1.0, matmul_size=1024, run_burnin=True)
+            hook = (
+                SliceScopedGate(gate).validation_hook()
+                if args.slice_aware
+                else gate.validation_hook()
+            )
+            mgr.with_validation_enabled(validation_hook=hook)
+        if args.slice_aware:
+            from k8s_operator_libs_tpu.tpu import enable_slice_aware_planning
+
+            enable_slice_aware_planning(mgr)
+        maintenance_sim = None
+        if args.requestor:
+            from k8s_operator_libs_tpu.upgrade import (
+                RequestorOptions,
+                enable_requestor_mode,
+            )
+
+            opts = RequestorOptions.from_env()
+            opts.use_maintenance_operator = True  # the flag IS the opt-in
+            # The env var wins over the argparse default; from_env honors it
+            # deliberately (MAINTENANCE_OPERATOR_REQUESTOR_NAMESPACE).
+            if not os.environ.get("MAINTENANCE_OPERATOR_REQUESTOR_NAMESPACE"):
+                opts.namespace = args.namespace
+            if args.post_maintenance:
+                opts.use_post_maintenance = True
+                if args.ici_gate and not args.validation_pod and not args.demo:
+                    # In-process warm-up ONLY where the in-process gate shape
+                    # already applies (--ici-gate: the controller owns the
+                    # node's chips, e.g. single-host pools). In the
+                    # --validation-pod production shape the controller is off
+                    # the node — an in-process battery would warm the WRONG
+                    # host's cache and stall the reconcile loop; there the
+                    # probe pod's hostPath cache mount is the warm-up story.
+                    from k8s_operator_libs_tpu.tpu import cache_warmup_hook
+
+                    opts.post_maintenance_hook = cache_warmup_hook()
+            enable_requestor_mode(mgr, opts)
+            if args.demo:
+                from k8s_operator_libs_tpu.kube.sim import (
+                    MaintenanceOperatorSimulator,
+                )
+
+                maintenance_sim = MaintenanceOperatorSimulator(
+                    client, namespace=args.namespace
+                )
+
+        # Watch-driven triggering: informers mark the world dirty; the loop
+        # reconciles on deltas (filtered through the requestor predicate for
+        # NodeMaintenance) and falls back to the interval as a resync — the
+        # reference's controller-runtime shape (watches + periodic requeue).
+        dirty = None
+        informers = []
+        if args.watch and not args.demo:
+            import threading
+
+            from k8s_operator_libs_tpu.kube import Informer
+            from k8s_operator_libs_tpu.upgrade import condition_changed_predicate
+
+            dirty = threading.Event()
+
+            def mark_dirty(event_type, obj, old):
+                dirty.set()
+
+            def maintenance_dirty(event_type, obj, old):
+                # React to condition flips/deletions only, as the reference's
+                # predicate-filtered watch does (upgrade_requestor.go:115-159).
+                if event_type != "MODIFIED" or old is None:
+                    dirty.set()
+                    return
+                if condition_changed_predicate(old.raw, obj.raw):
+                    dirty.set()
+
+            informers = [
+                Informer(client, "Node"),
+                Informer(client, "Pod", namespace=args.namespace,
+                         label_selector=selector),
+                # The rollout trigger itself: a driver image bump lands as a
+                # new ControllerRevision / DaemonSet template change — with
+                # only Node/Pod watches, nothing would wake the controller to
+                # START the roll (revision-hash sync, pod_manager.go:84-118).
+                Informer(client, "DaemonSet", namespace=args.namespace,
+                         label_selector=selector),
+                Informer(client, "ControllerRevision", namespace=args.namespace,
+                         label_selector=selector),
+            ]
+            for informer in informers:
+                informer.add_event_handler(mark_dirty)
+            if args.requestor:
+                nm_informer = Informer(client, "NodeMaintenance")
+                nm_informer.add_event_handler(maintenance_dirty)
+                informers.append(nm_informer)
+            # Start all, THEN wait: sequential start+wait would serialize the
+            # sync latency across informers.
+            for informer in informers:
+                informer.start()
+            for informer in informers:
+                if not informer.wait_for_sync(timeout=30):
+                    logging.warning(
+                        "%s informer did not sync within 30s; reconciles may "
+                        "miss its triggers until it catches up", informer.kind,
+                    )
+
+        metrics = None
+        if args.metrics_port:
+            from k8s_operator_libs_tpu.upgrade import MetricsServer, UpgradeMetrics
+
+            metrics = UpgradeMetrics(mgr)
+            metrics_server = MetricsServer(
+                metrics, port=args.metrics_port, host=args.metrics_host
+            ).start()
+            print(f"metrics: {metrics_server.url}")
+
+        if args.leader_elect:
+            import socket
+
+            from k8s_operator_libs_tpu.kube import (
+                LeaderElectionConfig,
+                LeaderElector,
+            )
+
+            identity = (
+                args.leader_elect_id or f"{socket.gethostname()}_{os.getpid()}"
+            )
+            elector = LeaderElector(
+                client,
+                LeaderElectionConfig(
+                    name=args.leader_elect_lease
+                    or f"upgrade-controller-{args.device}",
+                    namespace=args.namespace,
+                    identity=identity,
+                ),
+            ).start()
+            print(f"leader election: campaigning as {identity!r}", flush=True)
+            elector.wait_for_leadership()
+            print("leader election: leading; starting reconciles", flush=True)
+
+        return _reconcile_loop(
+            args, mgr, policy, selector, elector, dirty,
+            metrics, sim, maintenance_sim, validation_pod_sim,
+        )
+    finally:
+        # Every exit path — convergence, --once, lease lost, SIGTERM
+        # (even mid-setup), unhandled error — stops the informers and
+        # the metrics server and releases the Lease (release is a no-op
+        # when this replica never held or no longer holds it).
+        for informer in informers:
+            informer.stop()
+        if metrics_server is not None:
+            metrics_server.stop()
+        if elector is not None:
+            elector.stop()
+
+
+def _reconcile_loop(
+    args, mgr, policy, selector, elector, dirty,
+    metrics, sim, maintenance_sim, validation_pod_sim,
+):
     passes = 0
     max_demo_passes = 100  # a 4-node roll converges in <15; 100 = stuck
     consecutive_failures = 0
@@ -379,8 +421,6 @@ def main(argv: list[str] | None = None) -> int:
             # keep reconciling — exit and let the restart policy
             # re-campaign from scratch.
             print("leader election: lease lost; exiting", file=sys.stderr)
-            for informer in informers:
-                informer.stop()
             return 3
         passes += 1
         if sim is not None and passes > max_demo_passes:
@@ -388,10 +428,6 @@ def main(argv: list[str] | None = None) -> int:
                 f"demo: did not converge within {max_demo_passes} passes",
                 file=sys.stderr,
             )
-            for informer in informers:
-                informer.stop()
-            if elector is not None:
-                elector.stop()  # release the Lease: standbys take over
             return 1
         if sim is not None:
             sim.step()
@@ -442,14 +478,8 @@ def main(argv: list[str] | None = None) -> int:
             )
             if all_done and sim.all_pods_ready_and_current():
                 print(f"demo: rolling upgrade complete in {passes} passes")
-                if elector is not None:
-                    elector.stop()  # releases: standbys take over now
                 return 0
         if args.once:
-            for informer in informers:
-                informer.stop()
-            if elector is not None:
-                elector.stop()
             return 0
         if dirty is not None:
             # Event-triggered with the interval as the resync fallback.

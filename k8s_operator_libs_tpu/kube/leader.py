@@ -240,6 +240,8 @@ class LeaderElector:
             lease.spec["holderIdentity"] = ""
             lease.spec["renewTime"] = _rfc3339_micro(self._wall())
             self._client.update(lease)
+        except NotFoundError:
+            return  # never acquired — nothing to release
         except ApiError as e:
             log.warning("leader election: release failed: %s", e)
 
@@ -267,9 +269,12 @@ class LeaderElector:
         thread = self._thread
         if thread is not None:
             thread.join(timeout=30)
-        was_leading = self._leading.is_set()
         self._leading.clear()
-        if release and was_leading:
+        if release:
+            # Unconditionally, not gated on _leading: the campaign thread
+            # can be stopped BETWEEN writing the Lease and marking itself
+            # leader — release() is identity-guarded and tolerates both a
+            # missing lease and another holder, so it is always safe.
             self.release()
         self._thread = None
 

@@ -311,3 +311,59 @@ class TestLeaseRecordFidelity:
         assert a.try_acquire_or_renew()  # transition 2
         lease = cluster.get("Lease", "upgrade-controller-tpu", NS)
         assert lease.lease_transitions == 2
+
+
+class TestControllerGracefulShutdown:
+    """kubelet sends SIGTERM to a terminating controller pod: the
+    controller must exit 0 AND release its Lease so a standby replica
+    takes over immediately (not after the lease duration)."""
+
+    def test_sigterm_releases_lease(self, tmp_path):
+        import os
+        import signal
+        import subprocess
+        import sys
+
+        with LocalApiServer() as server:
+            kubeconfig = server.write_kubeconfig(str(tmp_path / "kc"))
+            env = dict(os.environ)
+            env["KUBECONFIG"] = kubeconfig
+            repo = os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))
+            )
+            proc = subprocess.Popen(
+                [
+                    sys.executable,
+                    os.path.join(repo, "examples", "upgrade_controller.py"),
+                    "--leader-elect",
+                    "--leader-elect-id", "term-me",
+                    "--interval", "0.2",
+                ],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+            client = RestClient(RestConfig(server=server.url))
+            try:
+                def holder():
+                    lease = client.get_or_none(
+                        "Lease", "upgrade-controller-tpu", NS
+                    )
+                    return lease.holder_identity if lease else ""
+
+                _wait_until(
+                    lambda: holder() == "term-me",
+                    deadline_s=30,
+                    what="controller to acquire the lease",
+                )
+                proc.send_signal(signal.SIGTERM)
+                out, _ = proc.communicate(timeout=30)
+                assert proc.returncode == 0, out[-1500:]
+                assert "shutting down gracefully" in out
+                lease = client.get("Lease", "upgrade-controller-tpu", NS)
+                assert lease.holder_identity == ""  # released, not expired
+            finally:
+                if proc.poll() is None:
+                    proc.kill()
+                client.close()
