@@ -1,6 +1,7 @@
 from .client import (
     AlreadyExistsError,
     ApiError,
+    BadRequestError,
     Client,
     ConflictError,
     InvalidError,
@@ -35,6 +36,7 @@ from .leader import LeaderElectionConfig, LeaderElector
 __all__ = [
     "AlreadyExistsError",
     "ApiError",
+    "BadRequestError",
     "CachedClient",
     "Client",
     "ConflictError",

@@ -24,7 +24,7 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 
-from .client import ApiError, NotFoundError
+from .client import ApiError, BadRequestError, NotFoundError
 from .fake import FakeCluster
 from .objects import wrap
 from .resources import resource_for_plural
@@ -173,22 +173,33 @@ class _Handler(BaseHTTPRequestHandler):
             obj = cluster.get(info.kind, name, namespace)
             self._send_json(200, obj.raw)
             return
-        items = cluster.list(
+        try:
+            limit = int(query.get("limit", "0") or "0")
+        except ValueError:
+            raise BadRequestError(f"invalid limit {query.get('limit')!r}")
+        items, revision, next_continue, remaining = cluster.list_page(
             info.kind,
             namespace=namespace,
             label_selector=query.get("labelSelector") or None,
             field_selector=query.get("fieldSelector") or None,
+            limit=limit,
+            continue_token=query.get("continue", ""),
         )
+        # Collection revision: what a watch resumes from even when the
+        # list is empty (no items to take a revision from). On chunked
+        # lists it is the first page's snapshot revision, continue and
+        # remainingItemCount follow the real server's listMeta.
+        metadata: dict = {"resourceVersion": revision}
+        if next_continue:
+            metadata["continue"] = next_continue
+        if remaining is not None:
+            metadata["remainingItemCount"] = remaining
         self._send_json(
             200,
             {
                 "apiVersion": info.api_version,
                 "kind": f"{info.kind}List",
-                # Collection revision: what a watch resumes from even when
-                # the list is empty (no items to take a revision from).
-                "metadata": {
-                    "resourceVersion": cluster.current_resource_version()
-                },
+                "metadata": metadata,
                 "items": [o.raw for o in items],
             },
         )

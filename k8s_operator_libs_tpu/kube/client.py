@@ -44,6 +44,14 @@ class ConflictError(ApiError):
     reason = "Conflict"
 
 
+class BadRequestError(ApiError):
+    """Malformed request parameters (400) — e.g. an unparseable or
+    mismatched ``continue`` token, a negative ``limit``."""
+
+    status = 400
+    reason = "BadRequest"
+
+
 class InvalidError(ApiError):
     status = 422
     reason = "Invalid"

@@ -28,6 +28,7 @@ from typing import Any, Callable, Mapping, Optional
 
 from .client import (
     AlreadyExistsError,
+    BadRequestError,
     Client,
     ConflictError,
     InvalidError,
@@ -442,6 +443,14 @@ class FakeCluster(Client):
         ] = deque(maxlen=4096)
         self._changed = threading.Condition(self._lock)
         self._generation = 0
+        # Paginated-list continuations: token id -> (item raws, revision,
+        # expiry info). A real apiserver serves every page of one list
+        # from the SAME storage snapshot and answers a stale/compacted
+        # continue token with 410 reason=Expired; this bounded FIFO cache
+        # reproduces both behaviors (eviction = compaction).
+        self._continues: dict[str, tuple[list[dict[str, Any]], str]] = {}
+        self._continue_order: deque[str] = deque()
+        self._continue_cap = 32
         # Emulate the apiserver's CRD controller: created CRDs gain the
         # Established condition (immediately, or after a delay to exercise
         # wait-for-established logic, reference: pkg/crdutil/crdutil.go:275-319).
@@ -762,6 +771,96 @@ class FakeCluster(Client):
         with self._lock:
             items = self.list(kind, namespace, label_selector, field_selector)
             return items, self.current_resource_version()
+
+    def list_page(
+        self,
+        kind: str,
+        namespace: str = "",
+        label_selector: Optional[str | Mapping[str, str]] = None,
+        field_selector: Optional[str] = None,
+        limit: int = 0,
+        continue_token: str = "",
+    ) -> tuple[list[KubeObject], str, str, Optional[int]]:
+        """One page of a chunked list — apiserver ``limit``/``continue``
+        semantics (client-go reflectors always paginate; API machinery's
+        chunking KEP): every page of one list is served from the SAME
+        snapshot taken at the first page, the returned revision is that
+        snapshot's collection resourceVersion (what the follow-up watch
+        resumes from), and a stale/evicted continue token fails with the
+        410 reason=Expired the real apiserver emits after compaction —
+        client-go's pager then falls back to a full list, and so does
+        ``RestClient``.
+
+        Returns ``(items, revision, next_continue, remaining)`` where
+        ``next_continue`` is "" on the final page and ``remaining`` is
+        the listMeta remainingItemCount (None on single-page results,
+        like the real server omitting the field).
+        """
+        if limit < 0:
+            raise BadRequestError(f"limit must be non-negative, got {limit}")
+        # The real server never reports remainingItemCount for
+        # selector-filtered chunked lists (ListMeta contract).
+        selector_used = bool(label_selector) or bool(field_selector)
+        signature = (kind, namespace, str(label_selector or ""),
+                     str(field_selector or ""))
+        with self._lock:
+            if continue_token:
+                try:
+                    token_id, _, offset_s = continue_token.partition(":")
+                    offset = int(offset_s)
+                except ValueError:
+                    raise BadRequestError(
+                        f"malformed continue token {continue_token!r}"
+                    ) from None
+                if token_id not in self._continues:
+                    raise WatchExpiredError(
+                        "the provided continue parameter is too old: "
+                        "a consistent list is no longer possible"
+                    )
+                raws, revision, token_sig = self._continues[token_id]
+                if token_sig != signature:
+                    # Real apiserver: 400 when a continue key is replayed
+                    # against a different resource/selector query.
+                    raise BadRequestError(
+                        "continue key does not match this request's "
+                        f"query (issued for {token_sig!r})"
+                    )
+            else:
+                items, revision = self.list_with_revision(
+                    kind, namespace, label_selector, field_selector
+                )
+                raws = [o.raw for o in items]
+                offset = 0
+                if limit <= 0 or len(raws) <= limit:
+                    return items, revision, "", None
+                token_id = uuid.uuid4().hex
+                self._continues[token_id] = (raws, revision, signature)
+                self._continue_order.append(token_id)
+                while len(self._continue_order) > self._continue_cap:
+                    self._continues.pop(self._continue_order.popleft(), None)
+            if limit <= 0:
+                limit = len(raws) - offset
+            page = raws[offset : offset + limit]
+            next_offset = offset + len(page)
+            remaining = len(raws) - next_offset
+            if remaining <= 0:
+                self._continues.pop(token_id, None)
+                return (
+                    [wrap(copy.deepcopy(r)) for r in page], revision, "", None
+                )
+            return (
+                [wrap(copy.deepcopy(r)) for r in page],
+                revision,
+                f"{token_id}:{next_offset}",
+                None if selector_used else remaining,
+            )
+
+    def expire_continue_tokens(self) -> None:
+        """Test hook: the 'compaction' that invalidates every outstanding
+        continue token (subsequent pages answer 410 Expired)."""
+        with self._lock:
+            self._continues.clear()
+            self._continue_order.clear()
 
     def create(self, obj: KubeObject) -> KubeObject:
         kind = obj.raw.get("kind", "")

@@ -30,6 +30,7 @@ from typing import Any, Mapping, Optional
 from .client import (
     AlreadyExistsError,
     ApiError,
+    BadRequestError,
     Client,
     ConflictError,
     InvalidError,
@@ -66,6 +67,9 @@ class RestConfig:
     client_key_file: str = ""
     insecure_skip_tls_verify: bool = False
     namespace: str = "default"
+    #: Page size for chunked lists (client-go pager's default 500);
+    #: 0 = request everything in one response.
+    list_page_size: int = 500
     #: Paths of temp files backing *-data kubeconfig fields (private key
     #: material) — unlinked by close() and, as a backstop, at process exit.
     _temp_files: list = field(default_factory=list, repr=False)
@@ -245,6 +249,7 @@ def _unlink_quiet(path: str) -> None:
 
 
 _ERRORS_BY_REASON = {
+    "BadRequest": BadRequestError,
     "NotFound": NotFoundError,
     "AlreadyExists": AlreadyExistsError,
     "Conflict": ConflictError,
@@ -253,6 +258,7 @@ _ERRORS_BY_REASON = {
     "UnsupportedMediaType": UnsupportedMediaTypeError,
 }
 _ERRORS_BY_CODE = {
+    400: BadRequestError,
     404: NotFoundError,
     409: ConflictError,
     410: WatchExpiredError,
@@ -460,14 +466,47 @@ class RestClient(Client):
     ) -> tuple[list[KubeObject], str]:
         """list() plus the collection resourceVersion — the revision a
         follow-up watch resumes from (meaningful even for an empty list,
-        where there are no items to take a revision from)."""
+        where there are no items to take a revision from).
+
+        Lists are chunked with ``limit``/``continue`` like client-go's
+        pager (page size ``RestConfig.list_page_size``); every page comes
+        from one server-side snapshot and the returned revision is that
+        snapshot's, so watch resumption stays lossless across pages. A
+        continue token the server has expired (410 reason=Expired, e.g.
+        after compaction) triggers the pager's documented fallback: one
+        full unchunked re-list.
+        """
         info = resource_for_kind(kind)
-        query = self._selector_query(label_selector, field_selector)
+        base_query = self._selector_query(label_selector, field_selector)
         path = self._collection_path(info, namespace)
-        out = self._request("GET", path, query=query)
-        items = [wrap(item) for item in out.get("items") or []]
-        revision = str((out.get("metadata") or {}).get("resourceVersion", ""))
-        return items, revision
+        page_size = max(0, int(self.config.list_page_size or 0))
+        try:
+            return self._list_pages(path, base_query, page_size)
+        except WatchExpiredError:
+            if not page_size:
+                raise
+            return self._list_pages(path, base_query, page_size=0)
+
+    def _list_pages(
+        self, path: str, base_query: dict, page_size: int
+    ) -> tuple[list[KubeObject], str]:
+        items: list[KubeObject] = []
+        revision = ""
+        continue_token = ""
+        while True:
+            query = dict(base_query)
+            if page_size:
+                query["limit"] = str(page_size)
+            if continue_token:
+                query["continue"] = continue_token
+            out = self._request("GET", path, query=query)
+            items.extend(wrap(item) for item in out.get("items") or [])
+            meta = out.get("metadata") or {}
+            if not revision:
+                revision = str(meta.get("resourceVersion", ""))
+            continue_token = str(meta.get("continue") or "")
+            if not continue_token:
+                return items, revision
 
     def watch(
         self,

@@ -1,0 +1,228 @@
+"""List chunking — apiserver ``limit``/``continue`` semantics.
+
+client-go reflectors always paginate their initial lists (pager default
+limit 500); the API-machinery chunking contract is: every page of one
+list is served from the SAME snapshot, the collection resourceVersion is
+the snapshot's (so the follow-up watch loses nothing), and a compacted/
+stale continue token answers 410 reason=Expired, upon which the pager
+falls back to one full list. Pinned here at all three layers: the
+FakeCluster primitive, the HTTP wire (listMeta continue /
+remainingItemCount), and RestClient's transparent pager incl. the
+Expired fallback and the informer riding it.
+"""
+
+import pytest
+
+from builders import make_node
+from k8s_operator_libs_tpu.kube import (
+    BadRequestError,
+    FakeCluster,
+    Informer,
+    LocalApiServer,
+    RestClient,
+    RestConfig,
+    WatchExpiredError,
+)
+
+
+def seed(cluster, n, prefix="pg"):
+    for i in range(n):
+        cluster.create(make_node(f"{prefix}-{i:03d}"))
+
+
+class TestFakeClusterPages:
+    def test_chunks_cover_everything_in_order(self):
+        cluster = FakeCluster()
+        seed(cluster, 7)
+        names, token, pages = [], "", 0
+        while True:
+            items, revision, token, remaining = cluster.list_page(
+                "Node", limit=3, continue_token=token
+            )
+            pages += 1
+            names.extend(o.name for o in items)
+            if token:
+                assert remaining == 7 - len(names)
+            else:
+                assert remaining is None
+                break
+        assert pages == 3
+        assert names == sorted(names) and len(names) == 7
+
+    def test_pages_come_from_one_snapshot(self):
+        cluster = FakeCluster()
+        seed(cluster, 6)
+        items, revision, token, _ = cluster.list_page("Node", limit=2)
+        # Writes AFTER the first page must not leak into later pages —
+        # the real server reads every page at the snapshot revision.
+        cluster.create(make_node("aaa-before-everything"))
+        cluster.delete("Node", "pg-005")
+        rest = []
+        while token:
+            items, rev2, token, _ = cluster.list_page(
+                "Node", continue_token=token, limit=2
+            )
+            assert rev2 == revision  # same snapshot's revision throughout
+            rest.extend(o.name for o in items)
+        assert "aaa-before-everything" not in rest
+        assert "pg-005" in rest  # deleted live, still in the snapshot
+
+    def test_no_limit_returns_everything_with_no_token(self):
+        cluster = FakeCluster()
+        seed(cluster, 5)
+        items, _, token, remaining = cluster.list_page("Node")
+        assert len(items) == 5 and token == "" and remaining is None
+
+    def test_limit_covering_all_items_is_single_page(self):
+        cluster = FakeCluster()
+        seed(cluster, 3)
+        items, _, token, remaining = cluster.list_page("Node", limit=3)
+        assert len(items) == 3 and token == "" and remaining is None
+
+    def test_expired_token_is_410(self):
+        cluster = FakeCluster()
+        seed(cluster, 4)
+        _, _, token, _ = cluster.list_page("Node", limit=2)
+        cluster.expire_continue_tokens()
+        with pytest.raises(WatchExpiredError):
+            cluster.list_page("Node", limit=2, continue_token=token)
+
+    def test_eviction_acts_as_compaction(self):
+        cluster = FakeCluster()
+        seed(cluster, 4)
+        _, _, token, _ = cluster.list_page("Node", limit=2)
+        for _ in range(cluster._continue_cap + 1):
+            cluster.list_page("Node", limit=2)  # each opens a snapshot
+        with pytest.raises(WatchExpiredError):
+            cluster.list_page("Node", limit=2, continue_token=token)
+
+    def test_malformed_token_is_400(self):
+        cluster = FakeCluster()
+        seed(cluster, 2)
+        with pytest.raises(BadRequestError):
+            cluster.list_page("Node", limit=1, continue_token="no-offset")
+
+    def test_negative_limit_is_400(self):
+        cluster = FakeCluster()
+        with pytest.raises(BadRequestError):
+            cluster.list_page("Node", limit=-5)
+
+    def test_token_is_bound_to_the_original_query(self):
+        # Real apiserver: a continue key replayed against a different
+        # resource or selector answers 400, never wrong-kind items.
+        cluster = FakeCluster()
+        seed(cluster, 4)
+        _, _, token, _ = cluster.list_page("Node", limit=2)
+        with pytest.raises(BadRequestError):
+            cluster.list_page("Pod", limit=2, continue_token=token)
+        with pytest.raises(BadRequestError):
+            cluster.list_page(
+                "Node", limit=2, continue_token=token,
+                label_selector="app=x",
+            )
+
+    def test_remaining_item_count_omitted_with_selector(self):
+        # ListMeta contract: remainingItemCount is never set for
+        # selector-filtered chunked lists.
+        cluster = FakeCluster()
+        for i in range(5):
+            cluster.create(make_node(f"sel-{i}", labels={"app": "x"}))
+        items, _, token, remaining = cluster.list_page(
+            "Node", limit=2, label_selector="app=x"
+        )
+        assert len(items) == 2 and token
+        assert remaining is None
+
+    def test_finished_token_is_single_use(self):
+        cluster = FakeCluster()
+        seed(cluster, 3)
+        _, _, token, _ = cluster.list_page("Node", limit=2)
+        cluster.list_page("Node", limit=2, continue_token=token)  # final page
+        with pytest.raises(WatchExpiredError):
+            cluster.list_page("Node", limit=2, continue_token=token)
+
+
+class TestWirePagination:
+    @pytest.fixture()
+    def server(self):
+        with LocalApiServer() as server:
+            yield server
+
+    def test_listmeta_carries_continue_and_remaining(self, server):
+        client = RestClient(RestConfig(server=server.url, list_page_size=0))
+        try:
+            seed(server.cluster, 5)
+            out = client._request(
+                "GET", "/api/v1/nodes", query={"limit": "2"}
+            )
+            meta = out["metadata"]
+            assert len(out["items"]) == 2
+            assert meta["continue"]
+            assert meta["remainingItemCount"] == 3
+            out2 = client._request(
+                "GET",
+                "/api/v1/nodes",
+                query={"limit": "2", "continue": meta["continue"]},
+            )
+            assert out2["metadata"]["resourceVersion"] == meta[
+                "resourceVersion"
+            ]
+        finally:
+            client.close()
+
+    def test_rest_client_paginates_transparently(self, server):
+        seed(server.cluster, 23)
+        client = RestClient(RestConfig(server=server.url, list_page_size=5))
+        try:
+            items, revision = client.list_with_revision("Node")
+            assert len(items) == 23
+            assert revision == server.cluster.current_resource_version()
+            assert [o.name for o in items] == sorted(o.name for o in items)
+        finally:
+            client.close()
+
+    def test_expired_continue_falls_back_to_full_list(self, server):
+        seed(server.cluster, 9)
+        client = RestClient(RestConfig(server=server.url, list_page_size=4))
+        calls = []
+        original = server.cluster.list_page
+
+        def sabotaged(*args, **kwargs):
+            calls.append(kwargs.get("continue_token", ""))
+            if kwargs.get("continue_token"):
+                # First continuation hits 'compaction'.
+                server.cluster.expire_continue_tokens()
+            return original(*args, **kwargs)
+
+        server.cluster.list_page = sabotaged
+        try:
+            items, _ = client.list_with_revision("Node")
+            assert len(items) == 9  # complete despite the expiry
+            # Pager shape: first page, expired continuation (410), then
+            # the documented fallback — one FULL unchunked re-list.
+            assert calls[0] == "" and calls[1] != "" and calls[-1] == ""
+        finally:
+            server.cluster.list_page = original
+            client.close()
+
+    def test_informer_initial_sync_rides_pagination(self, server):
+        seed(server.cluster, 11)
+        client = RestClient(RestConfig(server=server.url, list_page_size=3))
+        informer = Informer(client, "Node")
+        try:
+            informer.start()
+            assert informer.wait_for_sync(timeout=30)
+            assert len(informer.list()) == 11
+            # The snapshot revision seeds the watch: a post-sync write
+            # arrives as exactly one event, nothing lost across pages.
+            import queue
+
+            events: queue.Queue = queue.Queue()
+            informer.add_event_handler(
+                lambda t, obj, old: events.put((t, obj.name))
+            )
+            server.cluster.create(make_node("pg-after-sync"))
+            assert events.get(timeout=15) == ("ADDED", "pg-after-sync")
+        finally:
+            informer.stop()
+            client.close()
