@@ -204,6 +204,16 @@ class _Handler(BaseHTTPRequestHandler):
             },
         )
 
+    @staticmethod
+    def _bookmark_object(info, resource_version: str) -> dict:
+        """The real server's bookmark payload: an object of the watched
+        kind carrying ONLY metadata.resourceVersion."""
+        return {
+            "kind": info.kind,
+            "apiVersion": info.api_version,
+            "metadata": {"resourceVersion": resource_version},
+        }
+
     def _do_watch(self, cluster, info, namespace, query):
         """``?watch=true``: stream newline-delimited watch events.
 
@@ -219,7 +229,11 @@ class _Handler(BaseHTTPRequestHandler):
           one that STOPS matching arrives as DELETED;
         * a consumer too slow to drain its event queue loses the watch
           (stream closed) rather than silently losing events;
-        * ``timeoutSeconds`` bounds the stream server-side.
+        * ``timeoutSeconds`` bounds the stream server-side;
+        * ``allowWatchBookmarks=true`` opts into periodic BOOKMARK events
+          carrying only the current collection resourceVersion, so a
+          quiet (e.g. selector-scoped) watch keeps a fresh resume point
+          and resumption does not decay into 410 + full re-list.
 
         Events are ``{"type": ADDED|MODIFIED|DELETED, "object": {...}}``
         JSON lines; the stream is EOF-delimited (``Connection: close``).
@@ -284,6 +298,9 @@ class _Handler(BaseHTTPRequestHandler):
             deadline = (
                 time.monotonic() + timeout_s if timeout_s is not None else None
             )
+            bookmarks = query.get("allowWatchBookmarks") in ("true", "1")
+            interval = self.server.bookmark_interval_s
+            next_bookmark = time.monotonic() + interval
             while not overflowed.is_set():
                 if deadline is not None:
                     remaining = deadline - time.monotonic()
@@ -292,9 +309,25 @@ class _Handler(BaseHTTPRequestHandler):
                     poll = min(0.2, remaining)
                 else:
                     poll = 0.2
+                if bookmarks:
+                    poll = min(poll, max(0.01, next_bookmark - time.monotonic()))
                 try:
                     event_type, data, old = events.get(timeout=poll)
                 except queue.Empty:
+                    # Bookmark only from a DRAINED queue — "every event up
+                    # to this rv has been delivered". rv read before the
+                    # emptiness re-check: the cluster's _emit bumps rv and
+                    # notifies watchers under one lock hold, so an rv
+                    # observed here implies its event is already enqueued.
+                    if bookmarks and time.monotonic() >= next_bookmark:
+                        rv = cluster.current_resource_version()
+                        if events.empty():
+                            next_bookmark = time.monotonic() + interval
+                            if not self._write_event(
+                                "BOOKMARK",
+                                self._bookmark_object(info, rv),
+                            ):
+                                break
                     continue
                 mapped = scoped_event(event_type, data, old)
                 if mapped is None:
@@ -396,10 +429,15 @@ class LocalApiServer(ThreadingHTTPServer):
         token: str = "",
         certfile: str = "",
         keyfile: str = "",
+        bookmark_interval_s: float = 15.0,
     ) -> None:
         super().__init__(("127.0.0.1", port), _Handler)
         self.cluster = cluster if cluster is not None else FakeCluster()
         self.token = token
+        #: Cadence of BOOKMARK events on watches that opted in via
+        #: ``allowWatchBookmarks=true`` (the real server sends them about
+        #: once a minute; tests shrink this to exercise the path).
+        self.bookmark_interval_s = bookmark_interval_s
         self.tls = bool(certfile)
         if certfile:
             ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)

@@ -98,10 +98,13 @@ class Client(abc.ABC):
         timeout_seconds: Optional[int] = None,
         resource_version: Optional[str] = None,
         handle=None,
+        allow_bookmarks: bool = False,
     ):
         """Stream ``(event_type, KubeObject)`` watch events. Implemented by
         RestClient (HTTP streaming) and FakeCluster (in-process); clients
-        without a watch path must fail fast, not be silently polled."""
+        without a watch path must fail fast, not be silently polled.
+        ``allow_bookmarks=True`` opts into periodic BOOKMARK events
+        (fresh resume resourceVersion only — reflector consumers)."""
         raise NotImplementedError(
             f"{type(self).__name__} does not support watch"
         )

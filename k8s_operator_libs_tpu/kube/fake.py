@@ -448,7 +448,10 @@ class FakeCluster(Client):
         # from the SAME storage snapshot and answers a stale/compacted
         # continue token with 410 reason=Expired; this bounded FIFO cache
         # reproduces both behaviors (eviction = compaction).
-        self._continues: dict[str, tuple[list[dict[str, Any]], str]] = {}
+        self._continues: dict[
+            str,
+            tuple[list[dict[str, Any]], str, tuple[str, str, str, str]],
+        ] = {}
         self._continue_order: deque[str] = deque()
         self._continue_cap = 32
         # Emulate the apiserver's CRD controller: created CRDs gain the
@@ -544,6 +547,8 @@ class FakeCluster(Client):
         timeout_seconds: Optional[int] = None,
         resource_version: Optional[str] = None,
         handle=None,
+        allow_bookmarks: bool = False,
+        bookmark_interval_s: float = 15.0,
     ):
         """In-process watch generator with the same semantics as
         ``RestClient.watch`` against the HTTP apiserver: journal resumption
@@ -553,7 +558,12 @@ class FakeCluster(Client):
         ``cancelled`` flag ends the stream at the next poll tick.
         ``timeout_seconds=None`` applies the same default window as
         RestClient (DEFAULT_WATCH_TIMEOUT_SECONDS) — code tested against
-        the fake must see the real client's bounded-stream behavior."""
+        the fake must see the real client's bounded-stream behavior.
+        ``allow_bookmarks`` opts into periodic BOOKMARK events carrying
+        only the current collection resourceVersion (the real server's
+        watch-bookmark contract): a quiet scoped watch keeps a fresh
+        resume point while the shared journal advances under it, instead
+        of decaying toward 410 + full re-list."""
         import queue
 
         if timeout_seconds is None:
@@ -597,6 +607,7 @@ class FakeCluster(Client):
                 if timeout_seconds is not None
                 else None
             )
+            next_bookmark = time.monotonic() + bookmark_interval_s
             while not (handle is not None and handle.cancelled):
                 if deadline is not None:
                     remaining = deadline - time.monotonic()
@@ -605,9 +616,32 @@ class FakeCluster(Client):
                     poll = min(0.2, remaining)
                 else:
                     poll = 0.2
+                if allow_bookmarks:
+                    poll = min(
+                        poll, max(0.01, next_bookmark - time.monotonic())
+                    )
                 try:
                     event_type, data, old = events.get(timeout=poll)
                 except queue.Empty:
+                    # Bookmark only from a DRAINED queue — the contract is
+                    # "every event up to this rv has been delivered". The
+                    # rv is read BEFORE re-checking emptiness: _emit bumps
+                    # the rv and enqueues under one lock hold, so an rv
+                    # observed here implies its event was already enqueued
+                    # — and an empty queue then implies it was yielded.
+                    if allow_bookmarks and time.monotonic() >= next_bookmark:
+                        rv = self.current_resource_version()
+                        if events.empty():
+                            next_bookmark = (
+                                time.monotonic() + bookmark_interval_s
+                            )
+                            yield "BOOKMARK", wrap({
+                                "kind": kind,
+                                "apiVersion": KINDS.get(
+                                    kind, KubeObject
+                                ).API_VERSION or "v1",
+                                "metadata": {"resourceVersion": rv},
+                            })
                     continue
                 mapped = classify_watch_event(
                     event_type, data, old, selector, fields
@@ -818,6 +852,13 @@ class FakeCluster(Client):
                         "a consistent list is no longer possible"
                     )
                 raws, revision, token_sig = self._continues[token_id]
+                if offset < 0 or offset > len(raws):
+                    # Tampered/corrupt token: a real server answers 400.
+                    # (A live token never carries these offsets — the
+                    # final page returns no token at all.)
+                    raise BadRequestError(
+                        f"continue token offset {offset} out of range"
+                    )
                 if token_sig != signature:
                     # Real apiserver: 400 when a continue key is replayed
                     # against a different resource/selector query.

@@ -184,6 +184,10 @@ class Informer:
                     field_selector=self.field_selector,
                     timeout_seconds=self.watch_timeout_seconds,
                     resource_version=self._resource_version,
+                    # Reflector shape: request bookmarks so a quiet
+                    # scoped watch keeps a fresh resume point while the
+                    # journal advances under it (no 410 + relist decay).
+                    allow_bookmarks=True,
                 )
                 from .rest import WatchHandle
 
@@ -195,6 +199,17 @@ class Informer:
                     if self._stop.is_set():
                         return
                     raw = obj.raw
+                    if event_type == "BOOKMARK":
+                        # Resume-point refresh only: no object payload,
+                        # nothing to store or dispatch.
+                        rv = str(
+                            (raw.get("metadata") or {}).get(
+                                "resourceVersion", ""
+                            )
+                        )
+                        if rv.isdigit():
+                            self._resource_version = rv
+                        continue
                     key = self._key(raw)
                     with self._lock:
                         old = self._store.get(key)

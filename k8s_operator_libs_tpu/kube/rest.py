@@ -517,8 +517,17 @@ class RestClient(Client):
         timeout_seconds: Optional[int] = None,
         resource_version: Optional[str] = None,
         handle: Optional[WatchHandle] = None,
+        allow_bookmarks: bool = False,
     ):
         """Stream watch events as ``(event_type, KubeObject)`` pairs.
+
+        ``allow_bookmarks=True`` requests periodic BOOKMARK events
+        (``allowWatchBookmarks``, the client-go reflector's opt-in): the
+        server interleaves objects carrying only a fresh
+        metadata.resourceVersion, which the caller uses to keep its
+        resume point current on quiet watches. They are yielded as
+        ``("BOOKMARK", obj)`` pairs — opt-in only, so plain consumers
+        never see them.
 
         The list-then-watch shape the reference consumes through
         controller-runtime (its NodeMaintenance predicates react to watch
@@ -548,6 +557,8 @@ class RestClient(Client):
         query["watch"] = "true"
         # int64 on a real apiserver: "300.0" would be a 400.
         query["timeoutSeconds"] = str(int(timeout_seconds))
+        if allow_bookmarks:
+            query["allowWatchBookmarks"] = "true"
         if resource_version is not None:
             query["resourceVersion"] = resource_version
         path = self._collection_path(info, namespace)

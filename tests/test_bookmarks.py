@@ -1,0 +1,215 @@
+"""Watch bookmarks (``allowWatchBookmarks``).
+
+client-go reflectors opt into periodic BOOKMARK events — objects
+carrying only a fresh ``metadata.resourceVersion`` — so a QUIET watch
+(e.g. selector-scoped, nothing matching for minutes) keeps a current
+resume point while the shared event journal advances under it.
+Without bookmarks, resuming such a watch from its last-seen revision
+eventually answers 410 Gone and costs a full re-list. Pinned at the
+FakeCluster generator, the HTTP wire, and the informer riding it.
+"""
+
+import threading
+import time
+
+from builders import make_node
+from k8s_operator_libs_tpu.kube import (
+    FakeCluster,
+    Informer,
+    LocalApiServer,
+    RestClient,
+    RestConfig,
+)
+
+
+def collect(watch_iter, deadline_s, want=1, types=("BOOKMARK",)):
+    got = []
+    deadline = time.monotonic() + deadline_s
+    for event_type, obj in watch_iter:
+        if event_type in types:
+            got.append((event_type, obj))
+            if len(got) >= want:
+                break
+        if time.monotonic() > deadline:
+            break
+    return got
+
+
+class TestFakeClusterBookmarks:
+    def test_quiet_watch_receives_fresh_resume_points(self):
+        cluster = FakeCluster()
+        cluster.create(make_node("bm-seed"))
+        rv_at_start = cluster.current_resource_version()
+        got = collect(
+            cluster.watch(
+                "Node",
+                timeout_seconds=5,
+                resource_version=rv_at_start,
+                allow_bookmarks=True,
+                bookmark_interval_s=0.1,
+            ),
+            deadline_s=5,
+        )
+        assert got, "no bookmark within the window"
+        event_type, obj = got[0]
+        assert event_type == "BOOKMARK"
+        assert obj.raw["kind"] == "Node"
+        assert obj.resource_version == rv_at_start  # current, no churn
+        assert set(obj.raw["metadata"]) == {"resourceVersion"}
+
+    def test_bookmarks_track_journal_advance(self):
+        cluster = FakeCluster()
+        cluster.create(make_node("bm-a"))
+        stop = threading.Event()
+
+        def churn():
+            i = 0
+            while not stop.is_set():
+                cluster.create(make_node(f"bm-churn-{i}"))
+                i += 1
+                time.sleep(0.02)
+
+        t = threading.Thread(target=churn, daemon=True)
+        t.start()
+        try:
+            got = collect(
+                cluster.watch(
+                    "Node",
+                    label_selector="app=never-matches",
+                    timeout_seconds=5,
+                    allow_bookmarks=True,
+                    bookmark_interval_s=0.15,
+                ),
+                deadline_s=5,
+                want=2,
+            )
+        finally:
+            stop.set()
+            t.join()
+        assert len(got) == 2
+        first, second = (int(o.resource_version) for _, o in got)
+        assert second > first  # resume point moved with the journal
+
+    def test_no_bookmarks_without_opt_in(self):
+        cluster = FakeCluster()
+        cluster.create(make_node("bm-quiet"))
+        got = collect(
+            cluster.watch("Node", timeout_seconds=1),
+            deadline_s=1.5,
+        )
+        assert got == []
+
+
+class TestWireBookmarks:
+    def test_http_stream_interleaves_bookmarks(self):
+        with LocalApiServer(bookmark_interval_s=0.15) as server:
+            client = RestClient(RestConfig(server=server.url))
+            try:
+                server.cluster.create(make_node("bm-wire"))
+                got = collect(
+                    client.watch(
+                        "Node", timeout_seconds=5, allow_bookmarks=True
+                    ),
+                    deadline_s=5,
+                )
+                assert got and got[0][0] == "BOOKMARK"
+                assert got[0][1].raw["kind"] == "Node"
+            finally:
+                client.close()
+
+    def test_plain_watch_never_sees_bookmarks(self):
+        with LocalApiServer(bookmark_interval_s=0.1) as server:
+            client = RestClient(RestConfig(server=server.url))
+            try:
+                server.cluster.create(make_node("bm-none"))
+                got = collect(
+                    client.watch("Node", timeout_seconds=1),
+                    deadline_s=1.5,
+                    types=("BOOKMARK",),
+                )
+                assert got == []
+            finally:
+                client.close()
+
+
+class TestInformerRidesBookmarks:
+    def test_quiet_scoped_informer_keeps_resume_point_fresh(self):
+        with LocalApiServer(bookmark_interval_s=0.15) as server:
+            client = RestClient(RestConfig(server=server.url))
+            dispatched = []
+            informer = Informer(
+                client, "Node", label_selector="app=never-matches"
+            )
+            informer.add_event_handler(
+                lambda t, obj, old: dispatched.append(t)
+            )
+            try:
+                informer.start()
+                assert informer.wait_for_sync(timeout=30)
+                rv_after_sync = int(informer._resource_version)
+                # Churn objects the selector never matches: the journal
+                # advances, the informer sees zero events.
+                for i in range(40):
+                    server.cluster.create(make_node(f"bm-other-{i}"))
+                deadline = time.monotonic() + 10
+                while time.monotonic() < deadline:
+                    rv = int(informer._resource_version or 0)
+                    if rv > rv_after_sync:
+                        break
+                    time.sleep(0.05)
+                assert int(informer._resource_version) > rv_after_sync, (
+                    "bookmark never refreshed the quiet informer's "
+                    "resume point"
+                )
+                assert dispatched == []  # fresh WITHOUT any events
+            finally:
+                informer.stop()
+                client.close()
+
+
+class TestBookmarkOrdering:
+    def test_bookmark_never_overtakes_undelivered_events(self):
+        """The contract: a bookmark's rv promises every event up to it
+        was already delivered. Stream events and bookmarks under churn
+        and assert no bookmark carries an rv >= a later-delivered
+        event's rv."""
+        cluster = FakeCluster()
+        cluster.create(make_node("bm-order-seed"))
+        stop = threading.Event()
+
+        def churn():
+            i = 0
+            while not stop.is_set():
+                cluster.create(make_node(f"bm-order-{i}"))
+                i += 1
+                time.sleep(0.01)
+
+        t = threading.Thread(target=churn, daemon=True)
+        t.start()
+        try:
+            seen = []  # (type, rv) in delivery order
+            deadline = time.monotonic() + 4
+            for event_type, obj in cluster.watch(
+                "Node",
+                timeout_seconds=5,
+                allow_bookmarks=True,
+                bookmark_interval_s=0.05,
+            ):
+                rv = int(obj.resource_version)
+                seen.append((event_type, rv))
+                if time.monotonic() > deadline:
+                    break
+        finally:
+            stop.set()
+            t.join()
+        bookmarks = [i for i, (t_, _) in enumerate(seen) if t_ == "BOOKMARK"]
+        assert bookmarks, "churn starved every bookmark out of the window"
+        for i in bookmarks:
+            _, bm_rv = seen[i]
+            later_events = [
+                rv for t_, rv in seen[i + 1:] if t_ != "BOOKMARK"
+            ]
+            assert all(rv > bm_rv for rv in later_events), (
+                f"bookmark rv={bm_rv} overtook undelivered events "
+                f"{[rv for rv in later_events if rv <= bm_rv]}"
+            )

@@ -226,3 +226,16 @@ class TestWirePagination:
         finally:
             informer.stop()
             client.close()
+
+    def test_out_of_range_offset_in_token_is_400(self):
+        # A tampered offset must 400, never loop: a negative offset used
+        # to yield an empty page WITH a next token — an unbounded hot
+        # loop for the client-side pager.
+        cluster = FakeCluster()
+        seed(cluster, 4)
+        _, _, token, _ = cluster.list_page("Node", limit=2)
+        token_id = token.partition(":")[0]
+        with pytest.raises(BadRequestError):
+            cluster.list_page("Node", limit=2, continue_token=f"{token_id}:-2")
+        with pytest.raises(BadRequestError):
+            cluster.list_page("Node", limit=2, continue_token=f"{token_id}:99")
