@@ -1,0 +1,164 @@
+"""EventRecorder correlation — the client-go EventCorrelator semantics
+the reference's recorder applies in front of every API write (dedup with
+count bumping, similar-event aggregation, per-object spam filtering), so
+a hot reconcile loop cannot flood the apiserver with Event objects."""
+
+from builders import make_node
+from k8s_operator_libs_tpu.kube import FakeCluster
+from k8s_operator_libs_tpu.kube.events import EventRecorder
+
+
+class Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def now(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def recorder(cluster, clock, **kw):
+    return EventRecorder(cluster, now_fn=clock.now, **kw)
+
+
+def events(cluster):
+    return cluster.list("Event")
+
+
+class TestDedup:
+    def test_identical_events_bump_count_not_objects(self):
+        cluster, clock = FakeCluster(), Clock()
+        rec = recorder(cluster, clock)
+        node = make_node("ev-node")
+        for _ in range(5):
+            rec.event(node, "Normal", "Cordon", "cordoned for upgrade")
+            clock.advance(1)
+        evs = events(cluster)
+        assert len(evs) == 1
+        assert evs[0].raw["count"] == 5
+        assert evs[0].raw["firstTimestamp"]  # preserved from creation
+        assert evs[0].raw["reason"] == "Cordon"
+
+    def test_distinct_messages_create_distinct_events(self):
+        cluster, clock = FakeCluster(), Clock()
+        rec = recorder(cluster, clock)
+        node = make_node("ev-node")
+        rec.event(node, "Normal", "Drain", "draining 3 pods")
+        rec.event(node, "Normal", "Drain", "draining 1 pod")
+        assert len(events(cluster)) == 2
+
+    def test_server_side_gc_recreates(self):
+        cluster, clock = FakeCluster(), Clock()
+        rec = recorder(cluster, clock)
+        node = make_node("ev-node")
+        rec.event(node, "Normal", "Cordon", "x")
+        ev = events(cluster)[0]
+        cluster.delete("Event", ev.name, ev.namespace)
+        rec.event(node, "Normal", "Cordon", "x")
+        fresh = events(cluster)
+        assert len(fresh) == 1 and fresh[0].raw["count"] == 1
+
+
+class TestAggregation:
+    def test_similar_events_collapse_after_threshold(self):
+        cluster, clock = FakeCluster(), Clock()
+        rec = recorder(cluster, clock, aggregate_threshold=3)
+        node = make_node("ev-node")
+        for i in range(8):
+            rec.event(node, "Warning", "ProbeFailed", f"attempt {i} failed")
+            clock.advance(1)
+        evs = events(cluster)
+        # 3 distinct below/at threshold, then ONE aggregate absorbing the
+        # rest via dedup.
+        combined = [
+            e for e in evs if e.raw["message"].startswith("(combined")
+        ]
+        assert len(combined) == 1
+        assert combined[0].raw["count"] == 5  # events 4..8
+        assert len(evs) == 4
+        # The aggregate message tracks the latest occurrence.
+        assert "attempt 7 failed" in combined[0].raw["message"]
+
+    def test_window_expiry_resets_aggregation(self):
+        cluster, clock = FakeCluster(), Clock()
+        rec = recorder(
+            cluster, clock, aggregate_threshold=2, aggregate_window_s=60
+        )
+        node = make_node("ev-node")
+        for i in range(3):
+            rec.event(node, "Warning", "Flaky", f"m{i}")
+        assert any(
+            e.raw["message"].startswith("(combined") for e in events(cluster)
+        )
+        clock.advance(120)  # window drains
+        rec.event(node, "Warning", "Flaky", "fresh")
+        fresh = [e for e in events(cluster) if e.raw["message"] == "fresh"]
+        assert len(fresh) == 1  # NOT aggregated anymore
+
+
+class TestSpamFilter:
+    def test_burst_exhaustion_drops_events(self):
+        cluster, clock = FakeCluster(), Clock()
+        rec = recorder(cluster, clock, spam_burst=5, spam_refill_s=10)
+        node = make_node("ev-node")
+        for i in range(20):
+            rec.event(node, "Normal", "Busy", f"m{i}")  # distinct messages
+        assert len(events(cluster)) == 5  # burst budget, rest dropped
+
+    def test_tokens_refill_over_time(self):
+        cluster, clock = FakeCluster(), Clock()
+        rec = recorder(cluster, clock, spam_burst=2, spam_refill_s=10)
+        node = make_node("ev-node")
+        for i in range(5):
+            rec.event(node, "Normal", "Busy", f"m{i}")
+        assert len(events(cluster)) == 2
+        clock.advance(25)  # 2.5 tokens back
+        rec.event(node, "Normal", "Busy", "after refill")
+        rec.event(node, "Normal", "Busy", "after refill 2")
+        rec.event(node, "Normal", "Busy", "after refill 3")
+        assert len(events(cluster)) == 4  # +2 refilled, third dropped
+
+    def test_budget_is_per_object(self):
+        cluster, clock = FakeCluster(), Clock()
+        rec = recorder(cluster, clock, spam_burst=1, spam_refill_s=1000)
+        a, b = make_node("node-a"), make_node("node-b")
+        rec.event(a, "Normal", "X", "m")
+        rec.event(a, "Normal", "X", "m2")  # dropped: a's budget spent
+        rec.event(b, "Normal", "X", "m")  # b has its own bucket
+        assert len(events(cluster)) == 2
+
+
+class TestCorrelationFidelity:
+    def test_identical_events_never_aggregate(self):
+        # client-go aggregates on DISTINCT messages; a hot identical
+        # event stays on the dedup path forever — one object, count
+        # rising, message untouched.
+        cluster, clock = FakeCluster(), Clock()
+        rec = recorder(cluster, clock, aggregate_threshold=3)
+        node = make_node("ev-node")
+        for _ in range(12):
+            rec.event(node, "Normal", "Cordon", "cordoned")
+            clock.advance(1)
+        evs = events(cluster)
+        assert len(evs) == 1
+        assert evs[0].raw["count"] == 12
+        assert evs[0].raw["message"] == "cordoned"
+
+    def test_recreated_object_gets_its_own_correlation(self):
+        # Keys include involvedObject.uid: a recreated object must not
+        # patch the dead incarnation's Event nor inherit its spam budget.
+        cluster, clock = FakeCluster(), Clock()
+        rec = recorder(cluster, clock, spam_burst=2, spam_refill_s=1000)
+        old = make_node("ev-node")
+        old.metadata["uid"] = "uid-old"
+        rec.event(old, "Normal", "Cordon", "x")
+        rec.event(old, "Normal", "Cordon", "x2")  # budget now spent
+        fresh = make_node("ev-node")
+        fresh.metadata["uid"] = "uid-new"
+        rec.event(fresh, "Normal", "Cordon", "x")
+        evs = events(cluster)
+        assert len(evs) == 3  # new uid => new Event AND new budget
+        uids = {e.raw["involvedObject"]["uid"] for e in evs}
+        assert uids == {"uid-old", "uid-new"}
