@@ -386,7 +386,12 @@ class _Handler(BaseHTTPRequestHandler):
     def _do_delete(self, cluster, info, namespace, name, subresource, query):
         if not name:
             raise NotFoundError("collection delete not supported")
-        cluster.delete(info.kind, name, namespace)
+        cluster.delete(
+            info.kind,
+            name,
+            namespace,
+            propagation_policy=query.get("propagationPolicy") or None,
+        )
         self._send_json(200, _ok_status())
 
     def do_GET(self):  # noqa: N802 - http.server API

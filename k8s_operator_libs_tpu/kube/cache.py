@@ -174,8 +174,15 @@ class CachedClient(Client):
         name: str,
         namespace: str = "",
         grace_period_seconds: Optional[int] = None,
+        propagation_policy: Optional[str] = None,
     ) -> None:
-        return self.backing.delete(kind, name, namespace, grace_period_seconds)
+        return self.backing.delete(
+            kind,
+            name,
+            namespace,
+            grace_period_seconds,
+            propagation_policy=propagation_policy,
+        )
 
     def evict(self, pod_name: str, namespace: str = "") -> None:
         return self.backing.evict(pod_name, namespace)

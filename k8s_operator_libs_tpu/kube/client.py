@@ -155,8 +155,10 @@ class Client(abc.ABC):
         name: str,
         namespace: str = "",
         grace_period_seconds: Optional[int] = None,
+        propagation_policy: Optional[str] = None,
     ) -> None:
-        """Delete; raises NotFoundError if absent."""
+        """Delete; raises NotFoundError if absent. ``propagation_policy``
+        follows DeleteOptions (Background | Foreground | Orphan)."""
 
     @abc.abstractmethod
     def evict(self, pod_name: str, namespace: str = "") -> None:

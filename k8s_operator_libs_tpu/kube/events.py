@@ -115,6 +115,11 @@ class EventRecorder:
         # budget of) its dead incarnation's events.
         spam_key = (obj.raw.get("kind", ""), namespace, obj.name, obj.uid)
         agg_key = spam_key + (event_type, reason)
+        # The whole record — correlation AND the API write — runs under
+        # one lock: client-go funnels events through a single broadcaster
+        # goroutine, which is what makes count/lastTimestamp monotonic
+        # and first-occurrence creation unique; two racing recorders must
+        # never apply counts out of order or create duplicate objects.
         with self._lock:
             if not self._spam_ok(spam_key):
                 return
@@ -134,50 +139,44 @@ class EventRecorder:
                 dedup_key = agg_key + (message,)
             seen = self._seen.get(dedup_key)
             if seen is not None:
-                # Increment under the lock — the count must never lose
-                # updates between concurrent recorders.
-                seen[2] += 1
-                count = seen[2]
-        if seen is not None:
-            try:
-                self._client.patch(
-                    "Event",
-                    seen[0],
-                    seen[1],
-                    patch={
-                        "count": count,
-                        "message": message,
-                        "lastTimestamp": rfc3339_now(),
-                    },
-                )
-                return
-            except NotFoundError:
-                # The deduped Event was garbage-collected server-side;
-                # fall through and create a fresh one.
-                with self._lock:
+                try:
+                    self._client.patch(
+                        "Event",
+                        seen[0],
+                        seen[1],
+                        patch={
+                            "count": seen[2] + 1,
+                            "message": message,
+                            "lastTimestamp": rfc3339_now(),
+                        },
+                    )
+                    seen[2] += 1
+                    return
+                except NotFoundError:
+                    # The deduped Event was garbage-collected server-side;
+                    # fall through and create a fresh one.
                     self._seen.pop(dedup_key, None)
-        ev = Event()
-        ev.name = f"{obj.name}.{uuid.uuid4().hex[:10]}"
-        ev.namespace = namespace
-        stamp = rfc3339_now()
-        ev.raw.update(
-            {
-                "type": event_type,
-                "reason": reason,
-                "message": message,
-                "count": 1,
-                "involvedObject": {
-                    "kind": obj.raw.get("kind", ""),
-                    "name": obj.name,
-                    "namespace": obj.namespace,
-                    "uid": obj.uid,
-                },
-                "firstTimestamp": stamp,
-                "lastTimestamp": stamp,
-            }
-        )
-        self._client.create(ev)
-        with self._lock:
+            ev = Event()
+            ev.name = f"{obj.name}.{uuid.uuid4().hex[:10]}"
+            ev.namespace = namespace
+            stamp = rfc3339_now()
+            ev.raw.update(
+                {
+                    "type": event_type,
+                    "reason": reason,
+                    "message": message,
+                    "count": 1,
+                    "involvedObject": {
+                        "kind": obj.raw.get("kind", ""),
+                        "name": obj.name,
+                        "namespace": obj.namespace,
+                        "uid": obj.uid,
+                    },
+                    "firstTimestamp": stamp,
+                    "lastTimestamp": stamp,
+                }
+            )
+            self._client.create(ev)
             self._seen.touch(dedup_key, [ev.name, namespace, 1])
 
     def eventf(

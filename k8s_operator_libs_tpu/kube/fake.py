@@ -427,6 +427,7 @@ class FakeCluster(Client):
         auto_establish_crds: bool = True,
         crd_establish_delay: float = 0.0,
         crd_discovery_delay: float = 0.0,
+        enable_owner_gc: bool = True,
     ) -> None:
         self._lock = threading.RLock()
         self._store: dict[tuple[str, str, str], dict[str, Any]] = {}
@@ -448,6 +449,10 @@ class FakeCluster(Client):
         # from the SAME storage snapshot and answers a stale/compacted
         # continue token with 410 reason=Expired; this bounded FIFO cache
         # reproduces both behaviors (eviction = compaction).
+        # Owner-reference garbage collection (real-cluster semantics; the
+        # reference's envtest runs NO controller-manager, so cascade
+        # deletion never happens there — pass False to emulate that).
+        self._enable_owner_gc = enable_owner_gc
         self._continues: dict[
             str,
             tuple[list[dict[str, Any]], str, tuple[str, str, str, str]],
@@ -753,6 +758,11 @@ class FakeCluster(Client):
             # deletion — a lost event.
             self._bump(data)
             self._emit(_WATCH_DELETED, data, old=old)
+            # A finalizer-released object is as gone as a direct delete:
+            # its dependents are collected, and any Foreground owner
+            # waiting on IT gets re-checked.
+            if self._enable_owner_gc and meta.get("uid"):
+                self._gc_on_owner_removed(meta["uid"])
 
     # -- Client API --------------------------------------------------------
     def get(self, kind: str, name: str, namespace: str = "") -> KubeObject:
@@ -1147,12 +1157,57 @@ class FakeCluster(Client):
         name: str,
         namespace: str = "",
         grace_period_seconds: Optional[int] = None,
+        propagation_policy: Optional[str] = None,
     ) -> None:
+        """Delete with owner-reference garbage collection.
+
+        ``propagation_policy`` follows DeleteOptions: ``Background``
+        (default — dependents are collected after the owner goes),
+        ``Foreground`` (the owner lingers with the ``foregroundDeletion``
+        finalizer until every dependent is gone), ``Orphan`` (dependents
+        survive with the owner's reference stripped). The GC controller
+        behavior is ON by default like a real cluster — note the
+        reference's envtest has NO controller-manager, so there cascade
+        deletion never happens; construct
+        ``FakeCluster(enable_owner_gc=False)`` to emulate that.
+        """
+        if propagation_policy not in (
+            None, "Background", "Foreground", "Orphan"
+        ):
+            raise BadRequestError(
+                f"invalid propagationPolicy {propagation_policy!r}"
+            )
         with self._lock:
             self._react("delete", kind, {"name": name, "namespace": namespace})
             key = self._key(kind, namespace, name)
             data = self._get_raw(kind, name, namespace)
             meta = data.setdefault("metadata", {})
+            uid = meta.get("uid", "")
+            gc = self._enable_owner_gc and bool(uid)
+            policy = propagation_policy or "Background"
+            if gc and policy == "Orphan":
+                self._gc_orphan_dependents(uid)
+                gc = False  # orphaned: nothing to collect afterwards
+            if gc and policy == "Foreground" and self._gc_dependents(uid):
+                old = copy.deepcopy(data)
+                changed = False
+                if not meta.get("deletionTimestamp"):
+                    meta["deletionTimestamp"] = time.time()
+                    changed = True
+                finalizers = meta.setdefault("finalizers", [])
+                # Appended even on an already-terminating owner — the
+                # foreground guarantee must hold regardless of which
+                # delete marked the timestamp first.
+                if "foregroundDeletion" not in finalizers:
+                    finalizers.append("foregroundDeletion")
+                    changed = True
+                if changed:
+                    self._bump(data)
+                    self._emit(_WATCH_MODIFIED, data, old=old)
+                for dkind, dns, dname in self._gc_dependents(uid):
+                    self.delete(dkind, dname, dns)
+                self._gc_foreground_sweep()
+                return
             if meta.get("finalizers"):
                 if not meta.get("deletionTimestamp"):
                     old = copy.deepcopy(data)
@@ -1165,6 +1220,106 @@ class FakeCluster(Client):
                 self._discoverable.pop(name, None)
             self._bump(data)  # see _finalize_delete_if_due: rv moves on delete
             self._emit(_WATCH_DELETED, data)
+            if gc:
+                self._gc_on_owner_removed(uid)
+
+    # -- owner-reference garbage collection (real-cluster semantics) ------
+
+    def _gc_dependents(self, uid: str) -> list[tuple[str, str, str]]:
+        """(kind, namespace, name) of every live object referencing uid."""
+        out = []
+        for (kind, ns, name), data in self._store.items():
+            refs = (data.get("metadata") or {}).get("ownerReferences") or []
+            if any(r.get("uid") == uid for r in refs):
+                out.append((kind, ns, name))
+        return out
+
+    def _gc_orphan_dependents(self, uid: str) -> None:
+        for dkind, dns, dname in self._gc_dependents(uid):
+            dep = self._store.get(self._key(dkind, dns, dname))
+            if dep is None:
+                continue
+            old = copy.deepcopy(dep)
+            meta = dep.setdefault("metadata", {})
+            refs = [
+                r for r in meta.get("ownerReferences") or []
+                if r.get("uid") != uid
+            ]
+            if refs:
+                meta["ownerReferences"] = refs
+            else:
+                meta.pop("ownerReferences", None)
+            self._bump(dep)
+            self._emit(_WATCH_MODIFIED, dep, old=old)
+
+    def _gc_blocking_dependents(self, uid: str) -> list[tuple[str, str, str]]:
+        """Dependents whose reference carries ``blockOwnerDeletion: true``
+        — the only ones a Foreground owner waits for on a real cluster."""
+        out = []
+        for (kind, ns, name), data in self._store.items():
+            refs = (data.get("metadata") or {}).get("ownerReferences") or []
+            if any(
+                r.get("uid") == uid and r.get("blockOwnerDeletion")
+                for r in refs
+            ):
+                out.append((kind, ns, name))
+        return out
+
+    def _gc_on_owner_removed(self, uid: str) -> None:
+        """The GC controller's reaction to a vanished owner: a dependent
+        with other live owners keeps the object and only drops the
+        dangling reference; a dependent owned solely by the vanished
+        owner is collected with a plain delete (recursively) — its
+        ownerReferences stay intact while it terminates, exactly as a
+        real cluster's watch stream shows."""
+        for dkind, dns, dname in self._gc_dependents(uid):
+            dep = self._store.get(self._key(dkind, dns, dname))
+            if dep is None:
+                continue
+            meta = dep.setdefault("metadata", {})
+            refs = [
+                r for r in meta.get("ownerReferences") or []
+                if r.get("uid") != uid
+            ]
+            if refs:
+                old = copy.deepcopy(dep)
+                meta["ownerReferences"] = refs
+                self._bump(dep)
+                self._emit(_WATCH_MODIFIED, dep, old=old)
+            else:
+                self.delete(dkind, dname, dns)
+        self._gc_foreground_sweep()
+
+    def _gc_foreground_sweep(self) -> None:
+        """Release ``foregroundDeletion`` finalizers whose owners have no
+        BLOCKING dependents left (``blockOwnerDeletion: true`` — other
+        dependents never hold a foreground owner on a real cluster);
+        fully-released owners finalize and cascade."""
+        for key, data in list(self._store.items()):
+            meta = data.get("metadata") or {}
+            finalizers = meta.get("finalizers") or []
+            if (
+                "foregroundDeletion" not in finalizers
+                or not meta.get("deletionTimestamp")
+                or self._gc_blocking_dependents(meta.get("uid", ""))
+            ):
+                continue
+            old = copy.deepcopy(data)
+            finalizers = [f for f in finalizers if f != "foregroundDeletion"]
+            if finalizers:
+                meta["finalizers"] = finalizers
+                self._bump(data)
+                self._emit(_WATCH_MODIFIED, data, old=old)
+                continue
+            meta.pop("finalizers", None)
+            kind, _, name = key
+            del self._store[key]
+            if kind == "CustomResourceDefinition":
+                self._discoverable.pop(name, None)
+            self._bump(data)
+            self._emit(_WATCH_DELETED, data, old=old)
+            if self._enable_owner_gc and meta.get("uid"):
+                self._gc_on_owner_removed(meta["uid"])
 
     def evict(self, pod_name: str, namespace: str = "") -> None:
         with self._lock:

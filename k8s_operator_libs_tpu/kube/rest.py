@@ -667,15 +667,18 @@ class RestClient(Client):
         name: str,
         namespace: str = "",
         grace_period_seconds: Optional[int] = None,
+        propagation_policy: Optional[str] = None,
     ) -> None:
         info = resource_for_kind(kind)
-        query = (
-            {"gracePeriodSeconds": str(grace_period_seconds)}
-            if grace_period_seconds is not None
-            else None
-        )
+        query = {}
+        if grace_period_seconds is not None:
+            query["gracePeriodSeconds"] = str(grace_period_seconds)
+        if propagation_policy is not None:
+            # DeleteOptions field, accepted as a query parameter by the
+            # real apiserver: Background | Foreground | Orphan.
+            query["propagationPolicy"] = propagation_policy
         self._request(
-            "DELETE", self._path(info, namespace, name), query=query
+            "DELETE", self._path(info, namespace, name), query=query or None
         )
 
     def evict(self, pod_name: str, namespace: str = "") -> None:

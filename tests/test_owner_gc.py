@@ -1,0 +1,203 @@
+"""Owner-reference garbage collection (DeleteOptions propagationPolicy).
+
+Real-cluster semantics the reference's envtest CANNOT provide (envtest
+runs no controller-manager, so cascade deletion never happens there):
+Background collection of dependents, Foreground's foregroundDeletion
+finalizer blocking the owner until dependents are gone, Orphan stripping
+the owner's references, dangling-reference removal with multi-owner
+survival, and recursion through ownership chains. ``enable_owner_gc=
+False`` reproduces envtest's inert behavior.
+"""
+
+import pytest
+
+from builders import make_node, make_pod
+from k8s_operator_libs_tpu.kube import (
+    BadRequestError,
+    FakeCluster,
+    LocalApiServer,
+    RestClient,
+    RestConfig,
+)
+from k8s_operator_libs_tpu.kube.objects import KubeObject
+
+
+def cm(name, namespace="default", owners=(), blocking=False):
+    """A minimal custom object carrying ownerReferences."""
+    obj = KubeObject(
+        {
+            "apiVersion": "v1",
+            "kind": "ConfigHolder",
+            "metadata": {
+                "name": name,
+                "namespace": namespace,
+                "ownerReferences": [
+                    {
+                        "apiVersion": o.raw.get("apiVersion", "v1"),
+                        "kind": o.raw.get("kind", ""),
+                        "name": o.name,
+                        "uid": o.uid,
+                        **({"blockOwnerDeletion": True} if blocking else {}),
+                    }
+                    for o in owners
+                ]
+                or None,
+            },
+        }
+    )
+    if not obj.raw["metadata"]["ownerReferences"]:
+        obj.raw["metadata"].pop("ownerReferences")
+    return obj
+
+
+@pytest.fixture()
+def cluster():
+    from k8s_operator_libs_tpu.kube.resources import register_resource
+
+    try:
+        register_resource("ConfigHolder", "v1", "configholders")
+    except Exception:
+        pass
+    return FakeCluster()
+
+
+def exists(cluster, kind, name, namespace="default"):
+    return cluster.get_or_none(kind, name, namespace) is not None
+
+
+class TestBackground:
+    def test_dependents_collected_recursively(self, cluster):
+        owner = cluster.create(make_pod("owner", namespace="default"))
+        child = cluster.create(cm("child", owners=[owner]))
+        cluster.create(cm("grandchild", owners=[child]))
+        cluster.delete("Pod", "owner", "default")
+        assert not exists(cluster, "ConfigHolder", "child")
+        assert not exists(cluster, "ConfigHolder", "grandchild")
+
+    def test_multi_owner_dependent_survives_until_last_owner(self, cluster):
+        a = cluster.create(make_pod("owner-a", namespace="default"))
+        b = cluster.create(make_pod("owner-b", namespace="default"))
+        cluster.create(cm("shared", owners=[a, b]))
+        cluster.delete("Pod", "owner-a", "default")
+        shared = cluster.get("ConfigHolder", "shared", "default")
+        refs = shared.metadata["ownerReferences"]
+        assert [r["name"] for r in refs] == ["owner-b"]  # dangling ref gone
+        cluster.delete("Pod", "owner-b", "default")
+        assert not exists(cluster, "ConfigHolder", "shared")
+
+    def test_dependent_finalizer_still_respected(self, cluster):
+        owner = cluster.create(make_pod("owner", namespace="default"))
+        child = cm("guarded", owners=[owner])
+        child.raw["metadata"]["finalizers"] = ["example.io/guard"]
+        cluster.create(child)
+        cluster.delete("Pod", "owner", "default")
+        # Collected = deletion STARTED; the finalizer keeps it lingering.
+        lingering = cluster.get("ConfigHolder", "guarded", "default")
+        assert lingering.metadata.get("deletionTimestamp")
+        lingering.metadata["finalizers"] = []
+        cluster.update(lingering)
+        assert not exists(cluster, "ConfigHolder", "guarded")
+
+    def test_unrelated_objects_untouched(self, cluster):
+        cluster.create(make_pod("owner", namespace="default"))
+        cluster.create(cm("independent"))
+        cluster.delete("Pod", "owner", "default")
+        assert exists(cluster, "ConfigHolder", "independent")
+
+
+class TestForeground:
+    def test_owner_waits_for_blocking_dependent(self, cluster):
+        owner = cluster.create(make_pod("owner", namespace="default"))
+        child = cm("guarded", owners=[owner], blocking=True)
+        child.raw["metadata"]["finalizers"] = ["example.io/guard"]
+        cluster.create(child)
+        cluster.delete(
+            "Pod", "owner", "default", propagation_policy="Foreground"
+        )
+        waiting = cluster.get("Pod", "owner", "default")
+        assert "foregroundDeletion" in waiting.metadata["finalizers"]
+        assert waiting.metadata["deletionTimestamp"]
+        # Release the dependent: the owner must finalize automatically.
+        lingering = cluster.get("ConfigHolder", "guarded", "default")
+        lingering.metadata["finalizers"] = []
+        cluster.update(lingering)
+        assert not exists(cluster, "ConfigHolder", "guarded")
+        assert not exists(cluster, "Pod", "owner", "default")
+
+    def test_non_blocking_dependent_never_holds_the_owner(self, cluster):
+        # Real-cluster rule: only ownerReferences with
+        # blockOwnerDeletion=true hold a foreground owner; a guarded
+        # dependent WITHOUT the flag terminates on its own schedule while
+        # the owner finalizes immediately.
+        owner = cluster.create(make_pod("owner", namespace="default"))
+        child = cm("slow", owners=[owner])  # no blockOwnerDeletion
+        child.raw["metadata"]["finalizers"] = ["example.io/guard"]
+        cluster.create(child)
+        cluster.delete(
+            "Pod", "owner", "default", propagation_policy="Foreground"
+        )
+        assert not exists(cluster, "Pod", "owner", "default")
+        lingering = cluster.get("ConfigHolder", "slow", "default")
+        assert lingering.metadata.get("deletionTimestamp")
+        # A real cluster keeps the (now dangling) reference on the
+        # terminating dependent — no ref-stripping MODIFIED is emitted.
+        assert lingering.metadata["ownerReferences"]
+
+    def test_foreground_with_free_dependents_completes_inline(self, cluster):
+        owner = cluster.create(make_pod("owner", namespace="default"))
+        cluster.create(cm("free", owners=[owner]))
+        cluster.delete(
+            "Pod", "owner", "default", propagation_policy="Foreground"
+        )
+        assert not exists(cluster, "ConfigHolder", "free")
+        assert not exists(cluster, "Pod", "owner", "default")
+
+
+class TestOrphan:
+    def test_dependents_survive_with_refs_stripped(self, cluster):
+        owner = cluster.create(make_pod("owner", namespace="default"))
+        cluster.create(cm("kept", owners=[owner]))
+        cluster.delete(
+            "Pod", "owner", "default", propagation_policy="Orphan"
+        )
+        kept = cluster.get("ConfigHolder", "kept", "default")
+        assert "ownerReferences" not in kept.metadata
+        assert not exists(cluster, "Pod", "owner", "default")
+
+
+class TestKnobsAndWire:
+    def test_invalid_policy_is_400(self, cluster):
+        cluster.create(make_pod("owner", namespace="default"))
+        with pytest.raises(BadRequestError):
+            cluster.delete(
+                "Pod", "owner", "default", propagation_policy="Sideways"
+            )
+
+    def test_envtest_emulation_flag_disables_gc(self):
+        cluster = FakeCluster(enable_owner_gc=False)
+        owner = cluster.create(make_pod("owner", namespace="default"))
+        cluster.create(cm("survivor", owners=[owner]))
+        cluster.delete("Pod", "owner", "default")
+        # envtest behavior: no controller-manager, nothing cascades.
+        survivor = cluster.get("ConfigHolder", "survivor", "default")
+        assert survivor.metadata["ownerReferences"]
+
+    def test_propagation_policy_over_http(self, cluster):
+        node_owner = make_node("gc-owner")  # cluster-scoped owner
+        with LocalApiServer(cluster=cluster) as server:
+            client = RestClient(RestConfig(server=server.url))
+            try:
+                owner = client.create(node_owner)
+                client.create(cm("wire-kept", owners=[owner]))
+                client.create(cm("wire-gone", owners=[owner]))
+                client.delete(
+                    "ConfigHolder", "wire-kept", "default",
+                )  # plain delete of one dependent first
+                client.delete(
+                    "Node", "gc-owner", propagation_policy="Background"
+                )
+                assert client.get_or_none(
+                    "ConfigHolder", "wire-gone", "default"
+                ) is None
+            finally:
+                client.close()
