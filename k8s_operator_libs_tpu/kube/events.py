@@ -115,11 +115,16 @@ class EventRecorder:
         # budget of) its dead incarnation's events.
         spam_key = (obj.raw.get("kind", ""), namespace, obj.name, obj.uid)
         agg_key = spam_key + (event_type, reason)
-        # The whole record — correlation AND the API write — runs under
-        # one lock: client-go funnels events through a single broadcaster
-        # goroutine, which is what makes count/lastTimestamp monotonic
-        # and first-occurrence creation unique; two racing recorders must
-        # never apply counts out of order or create duplicate objects.
+        # Two phases: correlation bookkeeping under the lock (in-memory
+        # only — the lock is NEVER held across an API write, so a slow
+        # apiserver cannot serialize every recording thread and no
+        # lock-order cycle with the client's own locks can form), then
+        # the write outside it. The dedup entry — including the chosen
+        # Event name on first occurrence — is committed under the lock,
+        # so racing recorders can never create duplicate objects; their
+        # count increments are exact in the cache, and a patch landing
+        # out of order is corrected by the next one (the same anomaly any
+        # concurrent patcher has).
         with self._lock:
             if not self._spam_ok(spam_key):
                 return
@@ -139,45 +144,63 @@ class EventRecorder:
                 dedup_key = agg_key + (message,)
             seen = self._seen.get(dedup_key)
             if seen is not None:
-                try:
-                    self._client.patch(
-                        "Event",
-                        seen[0],
-                        seen[1],
-                        patch={
-                            "count": seen[2] + 1,
-                            "message": message,
-                            "lastTimestamp": rfc3339_now(),
-                        },
-                    )
-                    seen[2] += 1
-                    return
-                except NotFoundError:
-                    # The deduped Event was garbage-collected server-side;
-                    # fall through and create a fresh one.
-                    self._seen.pop(dedup_key, None)
-            ev = Event()
-            ev.name = f"{obj.name}.{uuid.uuid4().hex[:10]}"
-            ev.namespace = namespace
-            stamp = rfc3339_now()
-            ev.raw.update(
-                {
-                    "type": event_type,
-                    "reason": reason,
-                    "message": message,
-                    "count": 1,
-                    "involvedObject": {
-                        "kind": obj.raw.get("kind", ""),
-                        "name": obj.name,
-                        "namespace": obj.namespace,
-                        "uid": obj.uid,
+                seen[2] += 1
+                count = seen[2]
+            else:
+                name = f"{obj.name}.{uuid.uuid4().hex[:10]}"
+                self._seen.touch(dedup_key, [name, namespace, 1])
+        if seen is not None:
+            try:
+                self._client.patch(
+                    "Event",
+                    seen[0],
+                    seen[1],
+                    patch={
+                        "count": count,
+                        "message": message,
+                        "lastTimestamp": rfc3339_now(),
                     },
-                    "firstTimestamp": stamp,
-                    "lastTimestamp": stamp,
-                }
-            )
+                )
+                return
+            except NotFoundError:
+                # The deduped Event was garbage-collected server-side;
+                # recreate under the same cache entry.
+                with self._lock:
+                    current = self._seen.get(dedup_key)
+                    if current is not seen:
+                        return  # someone else already recreated it
+                    name = f"{obj.name}.{uuid.uuid4().hex[:10]}"
+                    seen[0], seen[2] = name, 1
+        ev = Event()
+        ev.name = name
+        ev.namespace = namespace
+        stamp = rfc3339_now()
+        ev.raw.update(
+            {
+                "type": event_type,
+                "reason": reason,
+                "message": message,
+                "count": 1,
+                "involvedObject": {
+                    "kind": obj.raw.get("kind", ""),
+                    "name": obj.name,
+                    "namespace": obj.namespace,
+                    "uid": obj.uid,
+                },
+                "firstTimestamp": stamp,
+                "lastTimestamp": stamp,
+            }
+        )
+        try:
             self._client.create(ev)
-            self._seen.touch(dedup_key, [ev.name, namespace, 1])
+        except Exception:
+            # A failed create must not strand a phantom dedup entry that
+            # would absorb future occurrences into a nonexistent object.
+            with self._lock:
+                current = self._seen.get(dedup_key)
+                if current is not None and current[0] == name:
+                    self._seen.pop(dedup_key, None)
+            raise
 
     def eventf(
         self, obj: KubeObject, event_type: str, reason: str, fmt: str, *args

@@ -1188,7 +1188,8 @@ class FakeCluster(Client):
             if gc and policy == "Orphan":
                 self._gc_orphan_dependents(uid)
                 gc = False  # orphaned: nothing to collect afterwards
-            if gc and policy == "Foreground" and self._gc_dependents(uid):
+            dependents = self._gc_dependents(uid) if gc else []
+            if gc and policy == "Foreground" and dependents:
                 old = copy.deepcopy(data)
                 changed = False
                 if not meta.get("deletionTimestamp"):
@@ -1204,8 +1205,14 @@ class FakeCluster(Client):
                 if changed:
                     self._bump(data)
                     self._emit(_WATCH_MODIFIED, data, old=old)
-                for dkind, dns, dname in self._gc_dependents(uid):
-                    self.delete(dkind, dname, dns)
+                for dkind, dns, dname in dependents:
+                    # Foreground propagates DOWN the chain (the real GC's
+                    # rule): a child must in turn wait for ITS blocking
+                    # dependents, so an owner can never finalize while a
+                    # blocking grandchild survives.
+                    self.delete(
+                        dkind, dname, dns, propagation_policy="Foreground"
+                    )
                 self._gc_foreground_sweep()
                 return
             if meta.get("finalizers"):
@@ -1225,12 +1232,21 @@ class FakeCluster(Client):
 
     # -- owner-reference garbage collection (real-cluster semantics) ------
 
-    def _gc_dependents(self, uid: str) -> list[tuple[str, str, str]]:
-        """(kind, namespace, name) of every live object referencing uid."""
+    def _gc_dependents(
+        self, uid: str, blocking_only: bool = False
+    ) -> list[tuple[str, str, str]]:
+        """(kind, namespace, name) of every live object referencing uid;
+        ``blocking_only`` restricts to references carrying
+        ``blockOwnerDeletion: true`` — the only dependents a Foreground
+        owner waits for on a real cluster."""
         out = []
         for (kind, ns, name), data in self._store.items():
             refs = (data.get("metadata") or {}).get("ownerReferences") or []
-            if any(r.get("uid") == uid for r in refs):
+            if any(
+                r.get("uid") == uid
+                and (not blocking_only or r.get("blockOwnerDeletion"))
+                for r in refs
+            ):
                 out.append((kind, ns, name))
         return out
 
@@ -1251,19 +1267,6 @@ class FakeCluster(Client):
                 meta.pop("ownerReferences", None)
             self._bump(dep)
             self._emit(_WATCH_MODIFIED, dep, old=old)
-
-    def _gc_blocking_dependents(self, uid: str) -> list[tuple[str, str, str]]:
-        """Dependents whose reference carries ``blockOwnerDeletion: true``
-        — the only ones a Foreground owner waits for on a real cluster."""
-        out = []
-        for (kind, ns, name), data in self._store.items():
-            refs = (data.get("metadata") or {}).get("ownerReferences") or []
-            if any(
-                r.get("uid") == uid and r.get("blockOwnerDeletion")
-                for r in refs
-            ):
-                out.append((kind, ns, name))
-        return out
 
     def _gc_on_owner_removed(self, uid: str) -> None:
         """The GC controller's reaction to a vanished owner: a dependent
@@ -1301,7 +1304,9 @@ class FakeCluster(Client):
             if (
                 "foregroundDeletion" not in finalizers
                 or not meta.get("deletionTimestamp")
-                or self._gc_blocking_dependents(meta.get("uid", ""))
+                or self._gc_dependents(
+                    meta.get("uid", ""), blocking_only=True
+                )
             ):
                 continue
             old = copy.deepcopy(data)

@@ -43,6 +43,7 @@ class Informer:
         label_selector: Optional[str | Mapping[str, str]] = None,
         field_selector: Optional[str] = None,
         watch_timeout_seconds: int = 300,
+        resync_period_s: float = 0.0,
     ) -> None:
         self._client = client
         self.kind = kind
@@ -52,8 +53,20 @@ class Informer:
         #: Bounded watch windows so a dead-silent stream cannot park the
         #: informer forever; each window resumes from the last revision.
         self.watch_timeout_seconds = watch_timeout_seconds
+        #: client-go's resync: every period, every cached object is
+        #: re-delivered to handlers as MODIFIED with old == new (the
+        #: SharedInformer UpdateFunc(obj, obj) shape) — the self-heal
+        #: tick controllers lean on to requeue work lost to a handler
+        #: bug. 0 (the default) disables it, like controller-runtime
+        #: builders that pass no resync.
+        self.resync_period_s = resync_period_s
         self._store: dict[tuple[str, str], dict] = {}
         self._lock = threading.Lock()
+        # Handler deliveries are SERIALIZED across the watch and resync
+        # threads (client-go's sharedProcessor delivers through one
+        # queue; handlers are never invoked concurrently). Reentrant so
+        # the resync loop can hold it across its store re-check.
+        self._dispatch_lock = threading.RLock()
         self._handlers: list[EventHandler] = []
         self._synced = threading.Event()
         self._stop = threading.Event()
@@ -63,8 +76,11 @@ class Informer:
 
     # -- lifecycle ---------------------------------------------------------
     def add_event_handler(self, handler: EventHandler) -> None:
-        """Register a handler; called as (event_type, obj, old) on the
-        informer thread. Register before start() to see the initial ADDEDs."""
+        """Register a handler; called as (event_type, obj, old). Watch
+        deliveries run on the informer thread, resyncs on the resync
+        timer thread — but deliveries are serialized, a handler is never
+        invoked concurrently. Register before start() to see the initial
+        ADDEDs."""
         self._handlers.append(handler)
 
     def start(self) -> "Informer":
@@ -72,6 +88,13 @@ class Informer:
             target=self._run, name=f"informer-{self.kind}", daemon=True
         )
         self._thread.start()
+        if self.resync_period_s > 0:
+            self._resync_thread = threading.Thread(
+                target=self._resync_loop,
+                name=f"informer-{self.kind}-resync",
+                daemon=True,
+            )
+            self._resync_thread.start()
         return self
 
     def stop(self) -> None:
@@ -81,6 +104,30 @@ class Informer:
             handle.cancel()  # unblock the parked socket read promptly
         if self._thread is not None:
             self._thread.join(timeout=10)
+        resync_thread = getattr(self, "_resync_thread", None)
+        if resync_thread is not None:
+            resync_thread.join(timeout=10)
+
+    def _resync_loop(self) -> None:
+        while not self._stop.wait(self.resync_period_s):
+            if not self._synced.is_set():
+                continue  # nothing meaningful to re-deliver mid-relist
+            with self._lock:
+                keys = list(self._store)
+            for key in keys:
+                if self._stop.is_set():
+                    return
+                # Under the dispatch lock, re-check the object is still
+                # cached: the watch thread removes from the store BEFORE
+                # dispatching DELETED, so a gone object is skipped here
+                # and a resync MODIFIED can never follow its DELETED.
+                with self._dispatch_lock:
+                    with self._lock:
+                        raw = self._store.get(key)
+                    if raw is None:
+                        continue
+                    # client-go resync shape: UpdateFunc(obj, obj).
+                    self._dispatch("MODIFIED", raw, raw)
 
     def wait_for_sync(self, timeout: Optional[float] = None) -> bool:
         """Block until the initial list has populated the store."""
@@ -124,13 +171,14 @@ class Informer:
     def _dispatch(self, event: str, raw: dict, old: Optional[dict]) -> None:
         obj = wrap(raw)
         old_obj = wrap(old) if old is not None else None
-        for handler in self._handlers:
-            try:
-                handler(event, obj, old_obj)
-            except Exception:  # noqa: BLE001 - handlers own their errors
-                log.exception(
-                    "informer handler failed for %s %s", event, obj.name
-                )
+        with self._dispatch_lock:
+            for handler in self._handlers:
+                try:
+                    handler(event, obj, old_obj)
+                except Exception:  # noqa: BLE001 - handlers own their errors
+                    log.exception(
+                        "informer handler failed for %s %s", event, obj.name
+                    )
 
     def _relist(self) -> None:
         """Seed/repair the store from a fresh list, emitting synthetic

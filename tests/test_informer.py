@@ -11,6 +11,7 @@ import time
 import pytest
 
 from k8s_operator_libs_tpu.kube import (
+    FakeCluster,
     Informer,
     LocalApiServer,
     Node,
@@ -358,3 +359,48 @@ class TestApiserverRestart:
         finally:
             server.shutdown()
             server.server_close()
+
+
+class TestResync:
+    """client-go's resync period: every cached object re-delivered to
+    handlers as MODIFIED with old == new (UpdateFunc(obj, obj)) — the
+    self-heal tick; off by default."""
+
+    def test_resync_redelivers_cached_state(self):
+        cluster = FakeCluster()
+        cluster.create(make_node("rs-a"))
+        cluster.create(make_node("rs-b"))
+        events = []
+        informer = Informer(cluster, "Node", resync_period_s=0.2)
+        informer.add_event_handler(
+            lambda t, obj, old: events.append((t, obj.name, old))
+        )
+        with informer:
+            assert informer.wait_for_sync(10)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                resyncs = [
+                    e for e in events
+                    if e[0] == "MODIFIED" and e[2] is not None
+                    and e[2].name == e[1]
+                ]
+                if len({name for _, name, _ in resyncs}) == 2:
+                    break
+                time.sleep(0.05)
+            else:
+                raise AssertionError(f"no full resync within deadline: {events}")
+        # Resync deliveries carry old == new (the UpdateFunc(obj, obj)
+        # shape), distinguishing them from real watch MODIFIEDs.
+        resync = next(e for e in events if e[0] == "MODIFIED")
+        assert resync[2].raw == informer.get(resync[1]).raw
+
+    def test_resync_disabled_by_default(self):
+        cluster = FakeCluster()
+        cluster.create(make_node("rs-solo"))
+        events = []
+        informer = Informer(cluster, "Node")
+        informer.add_event_handler(lambda t, obj, old: events.append(t))
+        with informer:
+            assert informer.wait_for_sync(10)
+            time.sleep(0.7)
+        assert events == ["ADDED"]  # only the initial seed, no resyncs

@@ -201,3 +201,29 @@ class TestKnobsAndWire:
                 ) is None
             finally:
                 client.close()
+
+
+class TestForegroundChain:
+    def test_owner_outlives_blocking_grandchild(self, cluster):
+        # Foreground propagates DOWN: owner <- child (blocking) <-
+        # grandchild (blocking, finalizer-guarded). The child waits for
+        # its grandchild, so the owner must outlive the grandchild even
+        # though its DIRECT blocking dependent has no finalizer.
+        owner = cluster.create(make_pod("owner", namespace="default"))
+        child = cluster.create(cm("chain-child", owners=[owner],
+                                  blocking=True))
+        grand = cm("chain-grand", owners=[child], blocking=True)
+        grand.raw["metadata"]["finalizers"] = ["example.io/guard"]
+        cluster.create(grand)
+        cluster.delete(
+            "Pod", "owner", "default", propagation_policy="Foreground"
+        )
+        assert exists(cluster, "Pod", "owner", "default")
+        assert exists(cluster, "ConfigHolder", "chain-child")
+        # Release the grandchild: the whole chain unwinds bottom-up.
+        g = cluster.get("ConfigHolder", "chain-grand", "default")
+        g.metadata["finalizers"] = []
+        cluster.update(g)
+        assert not exists(cluster, "ConfigHolder", "chain-grand")
+        assert not exists(cluster, "ConfigHolder", "chain-child")
+        assert not exists(cluster, "Pod", "owner", "default")
