@@ -54,10 +54,8 @@ def cm(name, namespace="default", owners=(), blocking=False):
 def cluster():
     from k8s_operator_libs_tpu.kube.resources import register_resource
 
-    try:
-        register_resource("ConfigHolder", "v1", "configholders")
-    except Exception:
-        pass
+    # Idempotent: re-registration overwrites with identical routing.
+    register_resource("ConfigHolder", "v1", "configholders")
     return FakeCluster()
 
 
