@@ -386,11 +386,16 @@ class _Handler(BaseHTTPRequestHandler):
     def _do_delete(self, cluster, info, namespace, name, subresource, query):
         if not name:
             raise NotFoundError("collection delete not supported")
+        preconditions = (self._read_body() or {}).get("preconditions") or {}
         cluster.delete(
             info.kind,
             name,
             namespace,
             propagation_policy=query.get("propagationPolicy") or None,
+            precondition_uid=preconditions.get("uid"),
+            precondition_resource_version=preconditions.get(
+                "resourceVersion"
+            ),
         )
         self._send_json(200, _ok_status())
 

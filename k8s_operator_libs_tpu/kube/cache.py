@@ -175,6 +175,8 @@ class CachedClient(Client):
         namespace: str = "",
         grace_period_seconds: Optional[int] = None,
         propagation_policy: Optional[str] = None,
+        precondition_uid: Optional[str] = None,
+        precondition_resource_version: Optional[str] = None,
     ) -> None:
         return self.backing.delete(
             kind,
@@ -182,6 +184,8 @@ class CachedClient(Client):
             namespace,
             grace_period_seconds,
             propagation_policy=propagation_policy,
+            precondition_uid=precondition_uid,
+            precondition_resource_version=precondition_resource_version,
         )
 
     def evict(self, pod_name: str, namespace: str = "") -> None:

@@ -156,9 +156,13 @@ class Client(abc.ABC):
         namespace: str = "",
         grace_period_seconds: Optional[int] = None,
         propagation_policy: Optional[str] = None,
+        precondition_uid: Optional[str] = None,
+        precondition_resource_version: Optional[str] = None,
     ) -> None:
         """Delete; raises NotFoundError if absent. ``propagation_policy``
-        follows DeleteOptions (Background | Foreground | Orphan)."""
+        follows DeleteOptions (Background | Foreground | Orphan);
+        ``precondition_*`` follow DeleteOptions.preconditions (mismatch
+        answers 409 Conflict)."""
 
     @abc.abstractmethod
     def evict(self, pod_name: str, namespace: str = "") -> None:

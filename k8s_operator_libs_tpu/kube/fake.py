@@ -1158,6 +1158,8 @@ class FakeCluster(Client):
         namespace: str = "",
         grace_period_seconds: Optional[int] = None,
         propagation_policy: Optional[str] = None,
+        precondition_uid: Optional[str] = None,
+        precondition_resource_version: Optional[str] = None,
     ) -> None:
         """Delete with owner-reference garbage collection.
 
@@ -1170,6 +1172,11 @@ class FakeCluster(Client):
         reference's envtest has NO controller-manager, so there cascade
         deletion never happens; construct
         ``FakeCluster(enable_owner_gc=False)`` to emulate that.
+
+        ``precondition_uid`` / ``precondition_resource_version`` follow
+        DeleteOptions.preconditions: a mismatch answers 409 Conflict —
+        the guard against deleting a same-named object that was
+        deleted-and-recreated (or changed) since it was last read.
         """
         if propagation_policy not in (
             None, "Background", "Foreground", "Orphan"
@@ -1182,6 +1189,24 @@ class FakeCluster(Client):
             key = self._key(kind, namespace, name)
             data = self._get_raw(kind, name, namespace)
             meta = data.setdefault("metadata", {})
+            if (
+                precondition_uid is not None
+                and meta.get("uid") != precondition_uid
+            ):
+                raise ConflictError(
+                    f"the UID in the precondition ({precondition_uid}) does "
+                    f"not match the UID in record ({meta.get('uid')})"
+                )
+            if (
+                precondition_resource_version is not None
+                and str(meta.get("resourceVersion"))
+                != str(precondition_resource_version)
+            ):
+                raise ConflictError(
+                    "the ResourceVersion in the precondition "
+                    f"({precondition_resource_version}) does not match the "
+                    f"record ({meta.get('resourceVersion')})"
+                )
             uid = meta.get("uid", "")
             gc = self._enable_owner_gc and bool(uid)
             policy = propagation_policy or "Background"

@@ -668,6 +668,8 @@ class RestClient(Client):
         namespace: str = "",
         grace_period_seconds: Optional[int] = None,
         propagation_policy: Optional[str] = None,
+        precondition_uid: Optional[str] = None,
+        precondition_resource_version: Optional[str] = None,
     ) -> None:
         info = resource_for_kind(kind)
         query = {}
@@ -677,8 +679,32 @@ class RestClient(Client):
             # DeleteOptions field, accepted as a query parameter by the
             # real apiserver: Background | Foreground | Orphan.
             query["propagationPolicy"] = propagation_policy
+        body = None
+        if (
+            precondition_uid is not None
+            or precondition_resource_version is not None
+        ):
+            # Preconditions travel in the DeleteOptions body; mismatch
+            # answers 409 Conflict. `is not None` (never truthiness): an
+            # empty-string uid is a precondition that must FAIL, not one
+            # to silently drop.
+            preconditions: dict = {}
+            if precondition_uid is not None:
+                preconditions["uid"] = precondition_uid
+            if precondition_resource_version is not None:
+                preconditions["resourceVersion"] = (
+                    precondition_resource_version
+                )
+            body = {
+                "apiVersion": "v1",
+                "kind": "DeleteOptions",
+                "preconditions": preconditions,
+            }
         self._request(
-            "DELETE", self._path(info, namespace, name), query=query or None
+            "DELETE",
+            self._path(info, namespace, name),
+            query=query or None,
+            body=body,
         )
 
     def evict(self, pod_name: str, namespace: str = "") -> None:

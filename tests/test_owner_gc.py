@@ -225,3 +225,76 @@ class TestForegroundChain:
         assert not exists(cluster, "ConfigHolder", "chain-grand")
         assert not exists(cluster, "ConfigHolder", "chain-child")
         assert not exists(cluster, "Pod", "owner", "default")
+
+
+class TestDeletePreconditions:
+    """DeleteOptions.preconditions: uid / resourceVersion mismatch
+    answers 409 Conflict — the guard against deleting a same-named
+    object recreated (or changed) since it was last read."""
+
+    def test_uid_mismatch_is_conflict(self, cluster):
+        from k8s_operator_libs_tpu.kube import ConflictError
+
+        first = cluster.create(make_pod("pre", namespace="default"))
+        cluster.delete("Pod", "pre", "default")
+        cluster.create(make_pod("pre", namespace="default"))  # new uid
+        with pytest.raises(ConflictError):
+            cluster.delete(
+                "Pod", "pre", "default", precondition_uid=first.uid
+            )
+        assert exists(cluster, "Pod", "pre", "default")
+
+    def test_matching_preconditions_delete(self, cluster):
+        obj = cluster.create(make_pod("pre-ok", namespace="default"))
+        cluster.delete(
+            "Pod", "pre-ok", "default",
+            precondition_uid=obj.uid,
+            precondition_resource_version=obj.resource_version,
+        )
+        assert not exists(cluster, "Pod", "pre-ok", "default")
+
+    def test_resource_version_mismatch_is_conflict(self, cluster):
+        from k8s_operator_libs_tpu.kube import ConflictError
+
+        obj = cluster.create(make_pod("pre-rv", namespace="default"))
+        stale_rv = obj.resource_version
+        obj.labels["touched"] = "1"
+        cluster.update(obj)
+        with pytest.raises(ConflictError):
+            cluster.delete(
+                "Pod", "pre-rv", "default",
+                precondition_resource_version=stale_rv,
+            )
+
+    def test_preconditions_over_http(self):
+        from k8s_operator_libs_tpu.kube import ConflictError
+
+        with LocalApiServer() as server:
+            client = RestClient(RestConfig(server=server.url))
+            try:
+                node = client.create(make_node("pre-wire"))
+                with pytest.raises(ConflictError):
+                    client.delete(
+                        "Node", "pre-wire", precondition_uid="wrong-uid"
+                    )
+                client.delete(
+                    "Node", "pre-wire", precondition_uid=node.uid
+                )
+                assert client.get_or_none("Node", "pre-wire") is None
+            finally:
+                client.close()
+
+    def test_empty_string_uid_precondition_fails_not_dropped(self):
+        # Truthiness trap: an empty-string uid precondition must FAIL
+        # the delete on every backend, never be silently dropped.
+        from k8s_operator_libs_tpu.kube import ConflictError
+
+        with LocalApiServer() as server:
+            client = RestClient(RestConfig(server=server.url))
+            try:
+                client.create(make_node("pre-empty"))
+                with pytest.raises(ConflictError):
+                    client.delete("Node", "pre-empty", precondition_uid="")
+                assert client.get_or_none("Node", "pre-empty") is not None
+            finally:
+                client.close()
