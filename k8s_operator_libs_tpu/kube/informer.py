@@ -61,7 +61,16 @@ class Informer:
         #: builders that pass no resync.
         self.resync_period_s = resync_period_s
         self._store: dict[tuple[str, str], dict] = {}
-        self._lock = threading.Lock()
+        #: client-go cache.Indexer: name -> fn(KubeObject) -> [values];
+        #: indices are maintained incrementally on every store mutation
+        #: and rebuilt on relist, so by_index reads are O(bucket).
+        self._indexers: dict[str, Callable[[KubeObject], list[str]]] = {}
+        self._indices: dict[str, dict[str, set[tuple[str, str]]]] = {}
+        # Reentrant: index functions run under this lock (they must see
+        # a consistent store) and may legitimately read back through
+        # get()/list()/by_index() on the same thread — a plain Lock
+        # would self-deadlock the watch thread on the first event.
+        self._lock = threading.RLock()
         # Handler deliveries are SERIALIZED across the watch and resync
         # threads (client-go's sharedProcessor delivers through one
         # queue; handlers are never invoked concurrently). Reentrant so
@@ -140,6 +149,80 @@ class Informer:
     def __exit__(self, *exc) -> None:
         self.stop()
 
+    # -- indexers (client-go cache.Indexer) --------------------------------
+    def add_indexer(
+        self, name: str, fn: Callable[[KubeObject], list[str]]
+    ) -> None:
+        """Register a named index: ``fn(obj) -> [values]`` (client-go's
+        IndexFunc; multiple values per object are allowed, e.g. one
+        bucket per ready condition AND per node). Safe to add after
+        start — the index is built from the current store. ``fn`` runs
+        under the store lock: keep it fast, and read back through this
+        informer only (same-thread reads are safe; blocking on OTHER
+        locks from inside an index fn invites deadlock)."""
+        with self._lock:
+            self._indexers[name] = fn
+            self._indices[name] = self._build_index(fn, self._store)
+
+    def _build_index(
+        self, fn, store: dict
+    ) -> dict[str, set[tuple[str, str]]]:
+        """Full build from a store snapshot; caller holds the lock."""
+        index: dict[str, set[tuple[str, str]]] = {}
+        for key, raw in store.items():
+            for value in self._index_values(fn, raw):
+                index.setdefault(value, set()).add(key)
+        return index
+
+    def by_index(self, name: str, value: str) -> list[KubeObject]:
+        """Objects whose index function yielded ``value`` — the
+        controller-runtime ``client.MatchingFields`` read path (e.g.
+        pods by spec.nodeName) at O(bucket) instead of a store scan."""
+        with self._lock:
+            if name not in self._indexers:
+                raise KeyError(f"no indexer named {name!r}")
+            keys = self._indices.get(name, {}).get(value, set())
+            out = [wrap(self._store[k]) for k in keys if k in self._store]
+        return sorted(out, key=lambda o: (o.namespace, o.name))
+
+    @staticmethod
+    def _index_values(fn, raw: dict) -> list[str]:
+        try:
+            return [v for v in fn(wrap(raw)) if v is not None]
+        except Exception:  # noqa: BLE001 - index fns own their errors
+            log.exception("indexer function failed for %s", raw)
+            return []
+
+    def _index_remove(self, key: tuple[str, str], raw: dict) -> None:
+        for name, fn in self._indexers.items():
+            index = self._indices[name]
+            for value in self._index_values(fn, raw):
+                bucket = index.get(value)
+                if bucket is not None:
+                    bucket.discard(key)
+                    if not bucket:
+                        index.pop(value, None)
+
+    def _index_add(self, key: tuple[str, str], raw: dict) -> None:
+        for name, fn in self._indexers.items():
+            index = self._indices[name]
+            for value in self._index_values(fn, raw):
+                index.setdefault(value, set()).add(key)
+
+    def _store_set(self, key: tuple[str, str], raw: dict) -> None:
+        """Store write + incremental index maintenance; caller holds
+        the lock."""
+        old = self._store.get(key)
+        if old is not None:
+            self._index_remove(key, old)
+        self._store[key] = raw
+        self._index_add(key, raw)
+
+    def _store_pop(self, key: tuple[str, str]) -> None:
+        old = self._store.pop(key, None)
+        if old is not None:
+            self._index_remove(key, old)
+
     # -- cached reads ------------------------------------------------------
     def get(self, name: str, namespace: str = "") -> Optional[KubeObject]:
         with self._lock:
@@ -205,6 +288,9 @@ class Informer:
         with self._lock:
             previous = self._store
             self._store = fresh
+            # Rebuild every index from the fresh snapshot.
+            for name, fn in self._indexers.items():
+                self._indices[name] = self._build_index(fn, fresh)
         for key, raw in fresh.items():
             old = previous.get(key)
             if old is None:
@@ -262,9 +348,9 @@ class Informer:
                     with self._lock:
                         old = self._store.get(key)
                         if event_type == "DELETED":
-                            self._store.pop(key, None)
+                            self._store_pop(key)
                         else:
-                            self._store[key] = raw
+                            self._store_set(key, raw)
                     rv = str(
                         (raw.get("metadata") or {}).get("resourceVersion", "")
                     )

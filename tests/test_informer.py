@@ -404,3 +404,109 @@ class TestResync:
             assert informer.wait_for_sync(10)
             time.sleep(0.7)
         assert events == ["ADDED"]  # only the initial seed, no resyncs
+
+
+class TestIndexers:
+    """client-go cache.Indexer: named index functions maintained
+    incrementally on every store mutation and rebuilt on relist — the
+    controller-runtime MatchingFields read path (pods by spec.nodeName)
+    at O(bucket) cost."""
+
+    @staticmethod
+    def _by_node(obj):
+        return [obj.raw.get("spec", {}).get("nodeName", "")]
+
+    def _pod(self, cluster, name, node):
+        from builders import make_pod
+
+        return cluster.create(
+            make_pod(name, namespace="default", node_name=node)
+        )
+
+    def test_index_tracks_adds_moves_and_deletes(self):
+        cluster = FakeCluster()
+        self._pod(cluster, "p1", "host-a")
+        self._pod(cluster, "p2", "host-a")
+        self._pod(cluster, "p3", "host-b")
+        informer = Informer(cluster, "Pod", namespace="default")
+        informer.add_indexer("by-node", self._by_node)
+        with informer:
+            assert informer.wait_for_sync(10)
+            _wait_for(lambda: len(informer.by_index("by-node", "host-a")) == 2)
+            assert [o.name for o in informer.by_index("by-node", "host-a")] \
+                == ["p1", "p2"]
+            assert [o.name for o in informer.by_index("by-node", "host-b")] \
+                == ["p3"]
+            # Move p2 between buckets.
+            p2 = cluster.get("Pod", "p2", "default")
+            p2.raw["spec"]["nodeName"] = "host-b"
+            cluster.update(p2)
+            _wait_for(lambda: len(informer.by_index("by-node", "host-b")) == 2)
+            assert [o.name for o in informer.by_index("by-node", "host-a")] \
+                == ["p1"]
+            # Delete empties its bucket entry.
+            cluster.delete("Pod", "p3", "default")
+            _wait_for(lambda: len(informer.by_index("by-node", "host-b")) == 1)
+
+    def test_indexer_added_after_start_builds_from_store(self):
+        cluster = FakeCluster()
+        self._pod(cluster, "late", "host-z")
+        informer = Informer(cluster, "Pod", namespace="default")
+        with informer:
+            assert informer.wait_for_sync(10)
+            informer.add_indexer("by-node", self._by_node)
+            assert [o.name for o in informer.by_index("by-node", "host-z")] \
+                == ["late"]
+
+    def test_unknown_index_raises(self):
+        cluster = FakeCluster()
+        informer = Informer(cluster, "Pod")
+        with pytest.raises(KeyError):
+            informer.by_index("nope", "x")
+
+    def test_index_rebuilt_by_410_relist(self):
+        # Drive a REAL expiry: the shim raises WatchExpiredError while
+        # "expired_mode" is on, so r2 is created with NO live watch to
+        # index it incrementally; only the 410-recovery relist REBUILD
+        # can bring it into the index (and the relist resumes the watch
+        # from its own collection rv, so no replay re-adds it either).
+        from k8s_operator_libs_tpu.kube import WatchExpiredError
+
+        cluster = FakeCluster()
+
+        class ExpiringClient:
+            def __init__(self, backing):
+                self.backing = backing
+                self.expired_mode = False
+
+            def __getattr__(self, attr):
+                return getattr(self.backing, attr)
+
+            def watch(self, *args, **kwargs):
+                if self.expired_mode:
+                    raise WatchExpiredError("forced journal expiry")
+                return self.backing.watch(*args, **kwargs)
+
+        shim = ExpiringClient(cluster)
+        self._pod(cluster, "r1", "host-a")
+        informer = Informer(
+            shim, "Pod", namespace="default", watch_timeout_seconds=1
+        )
+        informer.add_indexer("by-node", self._by_node)
+        with informer:
+            assert informer.wait_for_sync(10)
+            _wait_for(lambda: informer.by_index("by-node", "host-a"))
+            shim.expired_mode = True
+            _wait_for(lambda: not informer._synced.is_set())
+            self._pod(cluster, "r2", "host-a")
+            shim.expired_mode = False
+            _wait_for(lambda: len(informer.by_index("by-node", "host-a")) == 2)
+
+
+def _wait_for(predicate, deadline_s=10):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.05)
+    raise AssertionError("condition not met within deadline")
