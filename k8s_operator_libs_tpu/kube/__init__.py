@@ -23,7 +23,7 @@ from .objects import (
     wrap,
 )
 from .selectors import LabelSelector, parse_selector
-from .fake import FakeCluster, merge_patch
+from .fake import FakeCluster, json_patch, merge_patch
 from .cache import CachedClient
 from .drain import DrainConfig, DrainError, DrainHelper, DrainTimeoutError
 from .events import EventRecorder, FakeRecorder
@@ -61,6 +61,7 @@ __all__ = [
     "Lease",
     "Informer",
     "LocalApiServer",
+    "json_patch",
     "merge_patch",
     "Node",
     "NodeMaintenance",

@@ -369,11 +369,12 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _do_patch(self, cluster, info, namespace, name, subresource, query):
         content_type = self.headers.get("Content-Type", "")
-        patch_type = (
-            "strategic"
-            if "strategic-merge-patch" in content_type
-            else "merge"
-        )
+        if "strategic-merge-patch" in content_type:
+            patch_type = "strategic"
+        elif "json-patch" in content_type:
+            patch_type = "json"
+        else:
+            patch_type = "merge"
         patched = cluster.patch(
             info.kind,
             name,

@@ -161,7 +161,7 @@ class CachedClient(Client):
         kind: str,
         name: str,
         namespace: str = "",
-        patch: Optional[Mapping[str, Any]] = None,
+        patch: Optional[Mapping[str, Any] | list[Any]] = None,
         patch_type: str = "merge",
     ) -> KubeObject:
         return self.backing.patch(

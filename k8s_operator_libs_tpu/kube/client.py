@@ -139,14 +139,16 @@ class Client(abc.ABC):
         kind: str,
         name: str,
         namespace: str = "",
-        patch: Optional[Mapping[str, Any]] = None,
+        patch: Optional[Mapping[str, Any] | list[Any]] = None,
         patch_type: str = "merge",
     ) -> KubeObject:
         """Patch the object. ``patch_type`` selects the content type:
         ``"merge"`` = RFC 7386 merge patch (null deletes a key),
         ``"strategic"`` = Kubernetes strategic merge patch (the reference
         uses strategic for the state label,
-        node_upgrade_state_provider.go:80-82)."""
+        node_upgrade_state_provider.go:80-82),
+        ``"json"`` = RFC 6902 JSON patch (``patch`` is the operation
+        *array*, client-go's types.JSONPatchType)."""
 
     @abc.abstractmethod
     def delete(

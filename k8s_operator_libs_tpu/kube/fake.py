@@ -380,6 +380,227 @@ def _supports_strategic(data: Mapping[str, Any]) -> bool:
     return group in _STRATEGIC_GROUPS
 
 
+def _json_pointer_tokens(pointer: str) -> list[str]:
+    """RFC 6901: split and unescape a JSON Pointer (``~1`` → ``/``, then
+    ``~0`` → ``~``; that order, or ``~01`` would wrongly become ``/``)."""
+    if pointer == "":
+        return []
+    if not pointer.startswith("/"):
+        raise BadRequestError(
+            f"json patch pointer must start with '/': {pointer!r}"
+        )
+    return [
+        t.replace("~1", "/").replace("~0", "~")
+        for t in pointer.split("/")[1:]
+    ]
+
+
+def _jp_index(tok: str, pointer: str, length: int, allow_append: bool) -> int:
+    if tok == "-" and allow_append:
+        return length
+    # RFC 6901 array index: "0" or digits with no leading zero, no sign.
+    if not (tok == "0" or (tok.isdigit() and not tok.startswith("0"))):
+        raise InvalidError(
+            f"json patch path {pointer!r}: invalid array index {tok!r}"
+        )
+    idx = int(tok)
+    limit = length + 1 if allow_append else length
+    if idx >= limit:
+        raise InvalidError(
+            f"json patch path {pointer!r}: index {idx} out of bounds "
+            f"for array of length {length}"
+        )
+    return idx
+
+
+def _jp_step(cur: Any, tok: str, pointer: str) -> Any:
+    if isinstance(cur, Mapping):
+        if tok not in cur:
+            raise InvalidError(
+                f"json patch path {pointer!r} does not exist"
+            )
+        return cur[tok]
+    if isinstance(cur, list):
+        return cur[_jp_index(tok, pointer, len(cur), allow_append=False)]
+    raise InvalidError(
+        f"json patch path {pointer!r} traverses a non-container value"
+    )
+
+
+def _jp_get(doc: Any, pointer: str) -> Any:
+    cur = doc
+    for tok in _json_pointer_tokens(pointer):
+        cur = _jp_step(cur, tok, pointer)
+    return cur
+
+
+def _jp_parent(doc: Any, tokens: list[str], pointer: str) -> tuple[Any, str]:
+    """Walk to the container holding the final token (which must exist
+    per RFC 6902 for every op — only the *final* location may be new)."""
+    cur = doc
+    for tok in tokens[:-1]:
+        cur = _jp_step(cur, tok, pointer)
+    return cur, tokens[-1]
+
+
+def _jp_root_replace(doc: dict[str, Any], value: Any) -> None:
+    if not isinstance(value, Mapping):
+        raise InvalidError(
+            "json patch cannot replace the document root with a non-object"
+        )
+    doc.clear()
+    doc.update(copy.deepcopy(value))
+
+
+def _jp_add(
+    doc: dict[str, Any], pointer: str, value: Any, copy_value: bool = True
+) -> None:
+    # copy_value=False is for values the caller already exclusively owns
+    # (a just-removed ``move`` source) — skips a redundant deepcopy.
+    tokens = _json_pointer_tokens(pointer)
+    if not tokens:
+        _jp_root_replace(doc, value)
+        return
+    parent, last = _jp_parent(doc, tokens, pointer)
+    if copy_value:
+        value = copy.deepcopy(value)
+    if isinstance(parent, Mapping):
+        parent[last] = value  # type: ignore[index]
+    elif isinstance(parent, list):
+        idx = _jp_index(last, pointer, len(parent), allow_append=True)
+        parent.insert(idx, value)
+    else:
+        raise InvalidError(
+            f"json patch path {pointer!r}: parent is not a container"
+        )
+
+
+def _jp_remove(doc: dict[str, Any], pointer: str) -> Any:
+    tokens = _json_pointer_tokens(pointer)
+    if not tokens:
+        raise InvalidError("json patch cannot remove the document root")
+    parent, last = _jp_parent(doc, tokens, pointer)
+    if isinstance(parent, Mapping):
+        if last not in parent:
+            raise InvalidError(
+                f"json patch path {pointer!r} does not exist"
+            )
+        return parent.pop(last)  # type: ignore[attr-defined]
+    if isinstance(parent, list):
+        return parent.pop(_jp_index(last, pointer, len(parent), False))
+    raise InvalidError(
+        f"json patch path {pointer!r}: parent is not a container"
+    )
+
+
+def _json_equal(a: Any, b: Any) -> bool:
+    """Deep equality with JSON semantics: bool is its own type (Python's
+    ``True == 1`` must not make a ``test`` op pass)."""
+    if isinstance(a, bool) != isinstance(b, bool):
+        return False
+    if isinstance(a, Mapping) and isinstance(b, Mapping):
+        return a.keys() == b.keys() and all(
+            _json_equal(a[k], b[k]) for k in a
+        )
+    if isinstance(a, list) and isinstance(b, list):
+        return len(a) == len(b) and all(map(_json_equal, a, b))
+    return a == b
+
+
+def _jp_op_touches_spec(op: Any) -> bool:
+    """Whether a JSON-patch op can change ``/spec``: its path (or, for
+    ``move``, its source) is the root, ``/spec`` itself, or under it.
+    (``copy`` *from* spec reads it without changing it.)"""
+    if not isinstance(op, Mapping):
+        return False
+    pointers = [str(op.get("path", ""))]
+    if op.get("op") == "move":
+        pointers.append(str(op.get("from", "")))
+    return any(
+        p == "" or p == "/spec" or p.startswith("/spec/") for p in pointers
+    )
+
+
+def json_patch(target: dict[str, Any], ops: Any) -> dict[str, Any]:
+    """Apply an RFC 6902 JSON patch in place (``application/json-patch+json``,
+    client-go's types.JSONPatchType — the third patch flavor the real
+    apiserver accepts alongside merge and strategic).
+
+    Error mapping mirrors apiserver/pkg/endpoints/handlers/patch.go: a
+    malformed patch *document* (not an array, op not an object, unknown op,
+    missing value/from, bad pointer syntax) answers 400 BadRequest; an
+    *inapplicable* operation (missing path, index out of bounds, failed
+    ``test``) answers 422 Invalid/UnprocessableEntity.
+
+    Atomic per RFC 6902: ops apply to a working copy, and ``target`` is
+    only updated (in place) once every op succeeded — a failure mid-array
+    leaves ``target`` untouched.
+    """
+    if not isinstance(ops, list):
+        raise BadRequestError("json patch must be an array of operations")
+    work = copy.deepcopy(target)
+    for i, op in enumerate(ops):
+        if not isinstance(op, Mapping) or not isinstance(op.get("op"), str):
+            raise BadRequestError(
+                f"json patch operation {i} is not an object with an 'op'"
+            )
+        name = op["op"]
+        pointer = op.get("path")
+        if not isinstance(pointer, str):
+            raise BadRequestError(
+                f"json patch operation {i} ({name}) has no 'path'"
+            )
+        if name in ("add", "replace", "test") and "value" not in op:
+            raise BadRequestError(
+                f"json patch operation {i} ({name}) has no 'value'"
+            )
+        if name in ("move", "copy") and not isinstance(op.get("from"), str):
+            raise BadRequestError(
+                f"json patch operation {i} ({name}) has no 'from'"
+            )
+        if name == "add":
+            _jp_add(work, pointer, op["value"])
+        elif name == "remove":
+            _jp_remove(work, pointer)
+        elif name == "replace":
+            _jp_get(work, pointer)  # must exist (RFC 6902 §4.3)
+            if not _json_pointer_tokens(pointer):
+                _jp_root_replace(work, op["value"])
+            else:
+                _jp_remove(work, pointer)
+                _jp_add(work, pointer, op["value"])
+        elif name == "move":
+            src = op["from"]
+            src_tokens = _json_pointer_tokens(src)
+            dst_tokens = _json_pointer_tokens(pointer)
+            if (
+                len(src_tokens) < len(dst_tokens)
+                and dst_tokens[: len(src_tokens)] == src_tokens
+            ):
+                raise InvalidError(
+                    f"json patch cannot move {src!r} into its own child "
+                    f"{pointer!r}"
+                )
+            moved = _jp_remove(work, src)
+            _jp_add(work, pointer, moved, copy_value=False)
+        elif name == "copy":
+            _jp_add(work, pointer, _jp_get(work, op["from"]))
+        elif name == "test":
+            actual = _jp_get(work, pointer)
+            if not _json_equal(actual, op["value"]):
+                raise InvalidError(
+                    f"json patch test failed at {pointer!r}: "
+                    f"expected {op['value']!r}, found {actual!r}"
+                )
+        else:
+            raise BadRequestError(
+                f"json patch operation {i}: unknown op {name!r}"
+            )
+    target.clear()
+    target.update(work)
+    return target
+
+
 def _field_value(data: Mapping[str, Any], dotted: str) -> Any:
     cur: Any = data
     for part in dotted.split("."):
@@ -1109,12 +1330,17 @@ class FakeCluster(Client):
         kind: str,
         name: str,
         namespace: str = "",
-        patch: Optional[Mapping[str, Any]] = None,
+        patch: Optional[Mapping[str, Any] | list[Any]] = None,
         patch_type: str = "merge",
     ) -> KubeObject:
         with self._lock:
+            payload = (
+                copy.deepcopy(patch)
+                if isinstance(patch, list)
+                else dict(patch or {})
+            )
             self._react("patch", kind, {"name": name, "namespace": namespace,
-                                        "patch": dict(patch or {}),
+                                        "patch": payload,
                                         "patch_type": patch_type})
             current = self._get_raw(kind, name, namespace)
             old = copy.deepcopy(current)
@@ -1127,23 +1353,41 @@ class FakeCluster(Client):
                     f"resources ({current.get('apiVersion', '?')} {kind})"
                 )
             if patch_type == "strategic":
-                strategic_merge_patch(current, patch or {})
+                strategic_merge_patch(current, patch or {})  # type: ignore[arg-type]
             elif patch_type == "merge":
-                merge_patch(current, patch or {})
+                merge_patch(current, patch or {})  # type: ignore[arg-type]
+            elif patch_type == "json":
+                # A None/dict patch is a caller bug json_patch rejects
+                # with 400 — matching RestClient's client-side guard, so
+                # the two backends never diverge on this.
+                json_patch(current, patch)
             else:
                 raise InvalidError(
                     f"unsupported patch type {patch_type!r} "
-                    "(expected 'merge' or 'strategic')"
+                    "(expected 'merge', 'strategic', or 'json')"
                 )
-            # A patch cannot rename or unscope the object.
+            # A patch cannot rename or unscope the object (a real
+            # apiserver answers 422 to attempts; restoring is our lenient
+            # equivalent, and keeps the stored key and the object's own
+            # metadata consistent).
             meta = current.setdefault("metadata", {})
             meta["name"] = name
+            old_ns = (old.get("metadata") or {}).get("namespace")
+            if old_ns:
+                meta["namespace"] = old_ns
+            else:
+                meta.pop("namespace", None)
             self._bump(current)
             if not self._write_becomes_delete(current):
                 self._emit(_WATCH_MODIFIED, current, old=old)
             if kind == "CustomResourceDefinition":
                 self._sync_crd_discoverability_locked(current)
-                if "spec" in (patch or {}):
+                touched_spec = (
+                    any(_jp_op_touches_spec(op) for op in patch)
+                    if isinstance(patch, list)
+                    else "spec" in (patch or {})
+                )
+                if touched_spec:
                     # A spec patch can add served versions — existing ones
                     # stay served; the set refreshes after the window
                     # (same as _replace).

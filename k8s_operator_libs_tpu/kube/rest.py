@@ -343,7 +343,7 @@ class RestClient(Client):
         method: str,
         path: str,
         query: Optional[Mapping[str, str]] = None,
-        body: Optional[Mapping[str, Any]] = None,
+        body: Optional[Mapping[str, Any] | list[Any]] = None,
         content_type: str = "application/json",
     ) -> dict[str, Any]:
         url = self._base_path + path
@@ -639,24 +639,37 @@ class RestClient(Client):
         kind: str,
         name: str,
         namespace: str = "",
-        patch: Optional[Mapping[str, Any]] = None,
+        patch: Optional[Mapping[str, Any] | list[Any]] = None,
         patch_type: str = "merge",
     ) -> KubeObject:
         info = resource_for_kind(kind)
         content_types = {
             "merge": "application/merge-patch+json",
             "strategic": "application/strategic-merge-patch+json",
+            "json": "application/json-patch+json",
         }
         if patch_type not in content_types:
             raise InvalidError(
                 f"unsupported patch type {patch_type!r} "
-                "(expected 'merge' or 'strategic')"
+                "(expected 'merge', 'strategic', or 'json')"
             )
+        if patch_type == "json":
+            # RFC 6902: the body is a JSON *array* of operations. A
+            # non-list here is a caller bug — fail loudly rather than
+            # sending [] and reporting a successful no-op (FakeCluster
+            # raises the same error server-side).
+            if not isinstance(patch, list):
+                raise BadRequestError(
+                    "json patch must be an array of operations"
+                )
+            body: Any = list(patch)
+        else:
+            body = dict(patch or {})
         return wrap(
             self._request(
                 "PATCH",
                 self._path(info, namespace, name),
-                body=dict(patch or {}),
+                body=body,
                 content_type=content_types[patch_type],
             )
         )

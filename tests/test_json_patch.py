@@ -1,0 +1,362 @@
+"""RFC 6902 JSON patch: the third patch content type a real apiserver
+accepts (client-go types.JSONPatchType), alongside merge and strategic.
+
+Battery shape mirrors the conformance vectors: the engine is exercised
+directly with RFC 6902 Appendix-A-shaped cases, then the same semantics
+are pinned through the FakeCluster object path and over real HTTP
+against LocalApiServer, including the apiserver error mapping
+(malformed document -> 400 BadRequest, inapplicable op -> 422 Invalid)
+and RFC atomicity (a failed op mid-array leaves the object untouched).
+"""
+
+import pytest
+
+from builders import make_node, make_node_maintenance
+from k8s_operator_libs_tpu.kube import (
+    FakeCluster,
+    LocalApiServer,
+    RestClient,
+    RestConfig,
+    json_patch,
+)
+from k8s_operator_libs_tpu.kube.client import (
+    BadRequestError,
+    InvalidError,
+    UnsupportedMediaTypeError,
+)
+
+
+class TestEngine:
+    """RFC 6902 Appendix A semantics, engine-level."""
+
+    def test_add_object_member(self):
+        doc = {"foo": "bar"}
+        json_patch(doc, [{"op": "add", "path": "/baz", "value": "qux"}])
+        assert doc == {"foo": "bar", "baz": "qux"}
+
+    def test_add_array_element(self):
+        doc = {"foo": ["bar", "baz"]}
+        json_patch(doc, [{"op": "add", "path": "/foo/1", "value": "qux"}])
+        assert doc == {"foo": ["bar", "qux", "baz"]}
+
+    def test_add_appends_with_dash(self):
+        doc = {"foo": ["bar"]}
+        json_patch(doc, [{"op": "add", "path": "/foo/-", "value": ["abc", "def"]}])
+        assert doc == {"foo": ["bar", ["abc", "def"]]}
+
+    def test_add_replaces_existing_member(self):
+        doc = {"foo": "bar"}
+        json_patch(doc, [{"op": "add", "path": "/foo", "value": "qux"}])
+        assert doc == {"foo": "qux"}
+
+    def test_add_to_nonexistent_parent_fails(self):
+        with pytest.raises(InvalidError):
+            json_patch({"foo": "bar"}, [{"op": "add", "path": "/a/b", "value": 1}])
+
+    def test_remove_object_member(self):
+        doc = {"baz": "qux", "foo": "bar"}
+        json_patch(doc, [{"op": "remove", "path": "/baz"}])
+        assert doc == {"foo": "bar"}
+
+    def test_remove_array_element(self):
+        doc = {"foo": ["bar", "qux", "baz"]}
+        json_patch(doc, [{"op": "remove", "path": "/foo/1"}])
+        assert doc == {"foo": ["bar", "baz"]}
+
+    def test_remove_missing_member_fails(self):
+        with pytest.raises(InvalidError):
+            json_patch({"foo": "bar"}, [{"op": "remove", "path": "/baz"}])
+
+    def test_replace_value(self):
+        doc = {"baz": "qux", "foo": "bar"}
+        json_patch(doc, [{"op": "replace", "path": "/baz", "value": "boo"}])
+        assert doc == {"baz": "boo", "foo": "bar"}
+
+    def test_replace_requires_existence(self):
+        with pytest.raises(InvalidError):
+            json_patch({}, [{"op": "replace", "path": "/baz", "value": 1}])
+
+    def test_replace_array_element_keeps_position(self):
+        doc = {"foo": ["a", "b", "c"]}
+        json_patch(doc, [{"op": "replace", "path": "/foo/1", "value": "X"}])
+        assert doc == {"foo": ["a", "X", "c"]}
+
+    def test_move_value(self):
+        doc = {"foo": {"bar": "baz", "waldo": "fred"}, "qux": {"corge": "grault"}}
+        json_patch(
+            doc, [{"op": "move", "from": "/foo/waldo", "path": "/qux/thud"}]
+        )
+        assert doc == {
+            "foo": {"bar": "baz"},
+            "qux": {"corge": "grault", "thud": "fred"},
+        }
+
+    def test_move_array_element(self):
+        doc = {"foo": ["all", "grass", "cows", "eat"]}
+        json_patch(doc, [{"op": "move", "from": "/foo/1", "path": "/foo/3"}])
+        assert doc == {"foo": ["all", "cows", "eat", "grass"]}
+
+    def test_move_into_own_child_fails(self):
+        with pytest.raises(InvalidError):
+            json_patch(
+                {"a": {"b": 1}},
+                [{"op": "move", "from": "/a", "path": "/a/c"}],
+            )
+
+    def test_copy_value(self):
+        doc = {"foo": {"bar": 1}}
+        json_patch(doc, [{"op": "copy", "from": "/foo", "path": "/dup"}])
+        doc["dup"]["bar"] = 2  # a deep copy, not an alias
+        assert doc["foo"]["bar"] == 1
+
+    def test_test_op_success_ignores_object_key_order(self):
+        doc = {"baz": "qux", "foo": ["a", 2, "c"]}
+        json_patch(
+            doc,
+            [
+                {"op": "test", "path": "/baz", "value": "qux"},
+                {"op": "test", "path": "/foo/1", "value": 2},
+            ],
+        )
+
+    def test_test_op_failure(self):
+        with pytest.raises(InvalidError):
+            json_patch({"baz": "qux"}, [{"op": "test", "path": "/baz", "value": "bar"}])
+
+    def test_test_op_bool_is_not_number(self):
+        # Python's True == 1 must not leak into JSON test semantics.
+        with pytest.raises(InvalidError):
+            json_patch({"a": True}, [{"op": "test", "path": "/a", "value": 1}])
+        json_patch({"a": True}, [{"op": "test", "path": "/a", "value": True}])
+
+    def test_escaped_pointer_tokens(self):
+        doc = {"a/b": 1, "m~n": 2}
+        json_patch(
+            doc,
+            [
+                {"op": "test", "path": "/a~1b", "value": 1},
+                {"op": "test", "path": "/m~0n", "value": 2},
+            ],
+        )
+
+    def test_whole_document_replace(self):
+        doc = {"foo": "bar"}
+        out = json_patch(doc, [{"op": "replace", "path": "", "value": {"baz": 1}}])
+        assert out is doc and doc == {"baz": 1}
+
+    def test_engine_is_atomic(self):
+        # RFC 6902: a failed op mid-array leaves the target untouched —
+        # at the engine level, not just through FakeCluster.
+        doc = {"a": 1}
+        with pytest.raises(InvalidError):
+            json_patch(
+                doc,
+                [
+                    {"op": "add", "path": "/b", "value": 2},
+                    {"op": "test", "path": "/a", "value": "WRONG"},
+                ],
+            )
+        assert doc == {"a": 1}
+
+    def test_spec_touch_detection(self):
+        from k8s_operator_libs_tpu.kube.fake import _jp_op_touches_spec
+
+        assert _jp_op_touches_spec({"op": "add", "path": "/spec", "value": 1})
+        assert _jp_op_touches_spec({"op": "add", "path": "/spec/v", "value": 1})
+        assert _jp_op_touches_spec({"op": "replace", "path": "", "value": {}})
+        assert _jp_op_touches_spec(
+            {"op": "move", "from": "/spec/v", "path": "/status/x"}
+        )
+        assert not _jp_op_touches_spec(
+            {"op": "add", "path": "/specFoo", "value": 1}
+        )
+        assert not _jp_op_touches_spec(
+            {"op": "copy", "from": "/spec/v", "path": "/status/x"}
+        )
+
+    def test_malformed_patches_are_bad_requests(self):
+        for ops in (
+            {"op": "add"},  # not an array
+            [{"path": "/a", "value": 1}],  # no op
+            [{"op": "frobnicate", "path": "/a"}],  # unknown op
+            [{"op": "add", "value": 1}],  # no path
+            [{"op": "add", "path": "/a"}],  # no value
+            [{"op": "move", "path": "/a"}],  # no from
+            [{"op": "add", "path": "a", "value": 1}],  # pointer without /
+        ):
+            with pytest.raises(BadRequestError):
+                json_patch({"a": 0}, ops)
+
+    def test_array_index_strictness(self):
+        # Leading zeros and out-of-bounds are inapplicable ops (422).
+        with pytest.raises(InvalidError):
+            json_patch({"a": [1, 2]}, [{"op": "remove", "path": "/a/01"}])
+        with pytest.raises(InvalidError):
+            json_patch({"a": [1, 2]}, [{"op": "remove", "path": "/a/2"}])
+        with pytest.raises(InvalidError):
+            json_patch({"a": [1]}, [{"op": "add", "path": "/a/5", "value": 9}])
+
+
+class TestFakeClusterPath:
+    def test_json_patch_applies_and_bumps_rv(self):
+        cluster = FakeCluster()
+        node = cluster.create(make_node(name="n1", labels={"zone": "a"}))
+        rv_before = node.resource_version
+        out = cluster.patch(
+            "Node",
+            "n1",
+            patch=[
+                {"op": "replace", "path": "/metadata/labels/zone", "value": "b"},
+                {"op": "add", "path": "/metadata/labels/extra", "value": "1"},
+            ],
+            patch_type="json",
+        )
+        assert out.labels == {"zone": "b", "extra": "1"}
+        assert out.resource_version != rv_before
+
+    def test_json_patch_emits_modified_watch_event(self):
+        cluster = FakeCluster()
+        cluster.create(make_node(name="n1"))
+        events = []
+
+        def on_event(event_type, data, old):
+            events.append(event_type)
+
+        cluster.subscribe(on_event)
+        try:
+            cluster.patch(
+                "Node",
+                "n1",
+                patch=[{"op": "add", "path": "/metadata/labels", "value": {"x": "1"}}],
+                patch_type="json",
+            )
+        finally:
+            cluster.unsubscribe(on_event)
+        assert "MODIFIED" in events
+
+    def test_atomicity_failed_op_leaves_object_untouched(self):
+        cluster = FakeCluster()
+        cluster.create(make_node(name="n1", labels={"zone": "a"}))
+        rv_before = cluster.get("Node", "n1").resource_version
+        with pytest.raises(InvalidError):
+            cluster.patch(
+                "Node",
+                "n1",
+                patch=[
+                    {"op": "replace", "path": "/metadata/labels/zone", "value": "b"},
+                    {"op": "test", "path": "/metadata/labels/zone", "value": "WRONG"},
+                ],
+                patch_type="json",
+            )
+        after = cluster.get("Node", "n1")
+        assert after.labels == {"zone": "a"}
+        assert after.resource_version == rv_before
+
+    def test_none_patch_is_rejected_like_rest_client(self):
+        cluster = FakeCluster()
+        cluster.create(make_node(name="n1"))
+        with pytest.raises(BadRequestError):
+            cluster.patch("Node", "n1", patch=None, patch_type="json")
+        with pytest.raises(BadRequestError):
+            cluster.patch("Node", "n1", patch={"a": 1}, patch_type="json")
+
+    def test_custom_resources_accept_json_patch(self):
+        # Unlike strategic (415 on CRs), json patch works on every kind.
+        cluster = FakeCluster()
+        nm = make_node_maintenance(node_name="n1")
+        cluster.create(nm)
+        with pytest.raises(UnsupportedMediaTypeError):
+            cluster.patch(
+                nm.raw["kind"], nm.name, nm.namespace,
+                patch={"spec": {"x": 1}}, patch_type="strategic",
+            )
+        out = cluster.patch(
+            nm.raw["kind"], nm.name, nm.namespace,
+            patch=[{"op": "add", "path": "/spec/extra", "value": True}],
+            patch_type="json",
+        )
+        assert out.spec["extra"] is True
+
+    def test_patch_cannot_rename(self):
+        cluster = FakeCluster()
+        cluster.create(make_node(name="n1"))
+        out = cluster.patch(
+            "Node",
+            "n1",
+            patch=[{"op": "replace", "path": "/metadata/name", "value": "evil"}],
+            patch_type="json",
+        )
+        assert out.name == "n1"
+
+    def test_patch_cannot_change_namespace(self):
+        cluster = FakeCluster()
+        nm = make_node_maintenance(node_name="n1")
+        cluster.create(nm)
+        out = cluster.patch(
+            nm.raw["kind"], nm.name, nm.namespace,
+            patch=[{"op": "add", "path": "/metadata/namespace",
+                    "value": "elsewhere"}],
+            patch_type="json",
+        )
+        assert out.namespace == nm.namespace
+        # Cluster-scoped objects cannot gain a namespace via patch either.
+        cluster.create(make_node(name="n1"))
+        out = cluster.patch(
+            "Node", "n1",
+            patch={"metadata": {"namespace": "sneaky"}}, patch_type="merge",
+        )
+        assert "namespace" not in out.metadata
+
+
+class TestWirePath:
+    @pytest.fixture()
+    def server(self):
+        with LocalApiServer() as server:
+            yield server
+
+    def test_round_trip_over_http(self, server):
+        server.cluster.create(make_node(name="n1", labels={"zone": "a"}))
+        client = RestClient(RestConfig(server=server.url))
+        try:
+            out = client.patch(
+                "Node",
+                "n1",
+                patch=[
+                    {"op": "test", "path": "/metadata/labels/zone", "value": "a"},
+                    {"op": "replace", "path": "/metadata/labels/zone", "value": "b"},
+                ],
+                patch_type="json",
+            )
+            assert out.labels["zone"] == "b"
+        finally:
+            client.close()
+
+    def test_error_codes_surface_over_http(self, server):
+        server.cluster.create(make_node(name="n1", labels={"zone": "a"}))
+        client = RestClient(RestConfig(server=server.url))
+        try:
+            with pytest.raises(InvalidError):  # 422: failed test op
+                client.patch(
+                    "Node",
+                    "n1",
+                    patch=[{"op": "test", "path": "/metadata/labels/zone",
+                            "value": "WRONG"}],
+                    patch_type="json",
+                )
+            with pytest.raises(BadRequestError):  # 400: malformed document
+                client.patch(
+                    "Node",
+                    "n1",
+                    patch=[{"op": "frobnicate", "path": "/x"}],
+                    patch_type="json",
+                )
+            # Atomicity holds across the wire too.
+            assert server.cluster.get("Node", "n1").labels == {"zone": "a"}
+            # A non-list patch with patch_type="json" is a caller bug:
+            # fail loudly client-side, never send [] as a silent no-op.
+            with pytest.raises(BadRequestError):
+                client.patch(
+                    "Node", "n1", patch={"metadata": {}}, patch_type="json"
+                )
+        finally:
+            client.close()
