@@ -11,6 +11,7 @@ from .client import (
     retry_on_conflict,
 )
 from .objects import (
+    ConfigMap,
     ControllerRevision,
     CustomResourceDefinition,
     DaemonSet,
@@ -24,6 +25,7 @@ from .objects import (
 )
 from .selectors import LabelSelector, parse_selector
 from .fake import FakeCluster, json_patch, merge_patch
+from .ssa import ApplyConflictError, server_side_apply
 from .cache import CachedClient
 from .drain import DrainConfig, DrainError, DrainHelper, DrainTimeoutError
 from .events import EventRecorder, FakeRecorder
@@ -38,6 +40,7 @@ __all__ = [
     "ApiError",
     "BadRequestError",
     "CachedClient",
+    "ConfigMap",
     "Client",
     "ConflictError",
     "ControllerRevision",
@@ -61,8 +64,10 @@ __all__ = [
     "Lease",
     "Informer",
     "LocalApiServer",
+    "ApplyConflictError",
     "json_patch",
     "merge_patch",
+    "server_side_apply",
     "Node",
     "NodeMaintenance",
     "NotFoundError",

@@ -356,19 +356,56 @@ class _Handler(BaseHTTPRequestHandler):
         meta = body.setdefault("metadata", {})
         if info.namespaced and not meta.get("namespace"):
             meta["namespace"] = namespace
-        created = cluster.create(wrap(body))
+        created = cluster.create(
+            wrap(body), field_manager=query.get("fieldManager", "")
+        )
         self._send_json(201, created.raw)
 
     def _do_put(self, cluster, info, namespace, name, subresource, query):
         obj = wrap(self._read_body())
+        manager = query.get("fieldManager", "")
         if subresource == "status":
-            updated = cluster.update_status(obj)
+            updated = cluster.update_status(obj, field_manager=manager)
         else:
-            updated = cluster.update(obj)
+            updated = cluster.update(obj, field_manager=manager)
         self._send_json(200, updated.raw)
 
     def _do_patch(self, cluster, info, namespace, name, subresource, query):
         content_type = self.headers.get("Content-Type", "")
+        if "apply-patch" in content_type:
+            # Server-side apply: the body is the applied config itself.
+            if subresource:
+                raise BadRequestError(
+                    "server-side apply to subresources is not supported "
+                    "(PARITY: apply targets the main resource only)"
+                )
+            body = self._read_body()
+            meta = body.setdefault("metadata", {})
+            if meta.get("name") and meta["name"] != name:
+                # Real-apiserver rule: the body may not address a
+                # different object than the URL.
+                raise BadRequestError(
+                    f"metadata.name {meta['name']!r} does not match the "
+                    f"request path name {name!r}"
+                )
+            meta["name"] = name
+            if info.namespaced:
+                if meta.get("namespace") and meta["namespace"] != namespace:
+                    raise BadRequestError(
+                        f"metadata.namespace {meta['namespace']!r} does not "
+                        f"match the request path namespace {namespace!r}"
+                    )
+                meta["namespace"] = namespace
+            created = (
+                cluster.get_or_none(info.kind, name, namespace) is None
+            )
+            applied = cluster.apply(
+                body,
+                field_manager=query.get("fieldManager", ""),
+                force=query.get("force") == "true",
+            )
+            self._send_json(201 if created else 200, applied.raw)
+            return
         if "strategic-merge-patch" in content_type:
             patch_type = "strategic"
         elif "json-patch" in content_type:
@@ -381,6 +418,7 @@ class _Handler(BaseHTTPRequestHandler):
             namespace,
             patch=self._read_body(),
             patch_type=patch_type,
+            field_manager=query.get("fieldManager", ""),
         )
         self._send_json(200, patched.raw)
 

@@ -147,14 +147,24 @@ class CachedClient(Client):
         return out
 
     # -- writes (pass through) ---------------------------------------------
-    def create(self, obj: KubeObject) -> KubeObject:
-        return self.backing.create(obj)
+    def create(self, obj: KubeObject, field_manager: str = "") -> KubeObject:
+        return self.backing.create(obj, field_manager=field_manager)
 
-    def update(self, obj: KubeObject) -> KubeObject:
-        return self.backing.update(obj)
+    def apply(
+        self,
+        obj: KubeObject | Mapping[str, Any],
+        field_manager: str,
+        force: bool = False,
+    ) -> KubeObject:
+        return self.backing.apply(obj, field_manager, force=force)
 
-    def update_status(self, obj: KubeObject) -> KubeObject:
-        return self.backing.update_status(obj)
+    def update(self, obj: KubeObject, field_manager: str = "") -> KubeObject:
+        return self.backing.update(obj, field_manager=field_manager)
+
+    def update_status(
+        self, obj: KubeObject, field_manager: str = ""
+    ) -> KubeObject:
+        return self.backing.update_status(obj, field_manager=field_manager)
 
     def patch(
         self,
@@ -163,9 +173,15 @@ class CachedClient(Client):
         namespace: str = "",
         patch: Optional[Mapping[str, Any] | list[Any]] = None,
         patch_type: str = "merge",
+        field_manager: str = "",
     ) -> KubeObject:
         return self.backing.patch(
-            kind, name, namespace, patch, patch_type=patch_type
+            kind,
+            name,
+            namespace,
+            patch,
+            patch_type=patch_type,
+            field_manager=field_manager,
         )
 
     def delete(

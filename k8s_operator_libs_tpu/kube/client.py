@@ -150,6 +150,23 @@ class Client(abc.ABC):
         ``"json"`` = RFC 6902 JSON patch (``patch`` is the operation
         *array*, client-go's types.JSONPatchType)."""
 
+    def apply(
+        self,
+        obj: "KubeObject | Mapping[str, Any]",
+        field_manager: str,
+        force: bool = False,
+    ) -> KubeObject:
+        """Server-side apply (client-go's ``client.Apply`` patch type):
+        declare the manager's intent; the server merges it, tracks field
+        ownership in ``metadata.managedFields``, removes fields the
+        manager stopped declaring, and answers 409 Conflict when another
+        manager owns a field with a different value (``force=True`` takes
+        it over). Implemented by FakeCluster, CachedClient, and
+        RestClient; clients without an apply path must fail fast."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support server-side apply"
+        )
+
     @abc.abstractmethod
     def delete(
         self,

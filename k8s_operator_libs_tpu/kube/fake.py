@@ -36,8 +36,15 @@ from .client import (
     UnsupportedMediaTypeError,
     WatchExpiredError,
 )
-from .objects import KINDS, CustomResourceDefinition, KubeObject, wrap
+from .objects import (
+    KINDS,
+    CustomResourceDefinition,
+    KubeObject,
+    rfc3339_now,
+    wrap,
+)
 from .selectors import LabelSelector, parse_field_selector, parse_selector
+from .ssa import reassign_on_write, server_side_apply
 
 #: reactor signature: (verb, kind, payload) -> None; raise to inject a failure.
 Reactor = Callable[[str, str, dict[str, Any]], None]
@@ -1134,7 +1141,7 @@ class FakeCluster(Client):
             self._continues.clear()
             self._continue_order.clear()
 
-    def create(self, obj: KubeObject) -> KubeObject:
+    def create(self, obj: KubeObject, field_manager: str = "") -> KubeObject:
         kind = obj.raw.get("kind", "")
         if not kind or not obj.name:
             raise InvalidError("object must have kind and metadata.name")
@@ -1147,6 +1154,12 @@ class FakeCluster(Client):
             meta = data.setdefault("metadata", {})
             meta.setdefault("uid", str(uuid.uuid4()))
             meta.setdefault("creationTimestamp", time.time())
+            if field_manager and not meta.get("managedFields"):
+                # An explicitly-managed create owns every field it wrote
+                # (operation Update), so a later apply by someone else
+                # sees honest conflicts. Creates that already carry
+                # managedFields (create-through-apply) keep them.
+                reassign_on_write({}, data, field_manager, rfc3339_now())
             self._bump(data)
             self._store[key] = data
             self._emit(_WATCH_ADDED, data)
@@ -1268,7 +1281,9 @@ class FakeCluster(Client):
             raise NotFoundError(f"no resources discoverable for {gv}")
         return resources
 
-    def _replace(self, obj: KubeObject, status_only: bool) -> KubeObject:
+    def _replace(
+        self, obj: KubeObject, status_only: bool, field_manager: str = ""
+    ) -> KubeObject:
         kind = obj.raw.get("kind", "")
         with self._lock:
             verb = "update_status" if status_only else "update"
@@ -1299,6 +1314,16 @@ class FakeCluster(Client):
                 else:
                     data.pop("status", None)
                 self._store[self._key(kind, obj.namespace, obj.name)] = data
+            # managedFields is server-owned: ownership moves to the writer
+            # for every field this write changed (client-sent managedFields
+            # is ignored, like a real apiserver preserving when unset).
+            reassign_on_write(
+                old,
+                data,
+                field_manager,
+                rfc3339_now(),
+                subresource="status" if status_only else "",
+            )
             self._bump(data)
             if not self._write_becomes_delete(data):
                 self._emit(_WATCH_MODIFIED, data, old=old)
@@ -1319,11 +1344,13 @@ class FakeCluster(Client):
             self._finalize_delete_if_due(kind, obj.name, obj.namespace, old=old)
             return wrap(copy.deepcopy(data))
 
-    def update(self, obj: KubeObject) -> KubeObject:
-        return self._replace(obj, status_only=False)
+    def update(self, obj: KubeObject, field_manager: str = "") -> KubeObject:
+        return self._replace(obj, status_only=False, field_manager=field_manager)
 
-    def update_status(self, obj: KubeObject) -> KubeObject:
-        return self._replace(obj, status_only=True)
+    def update_status(
+        self, obj: KubeObject, field_manager: str = ""
+    ) -> KubeObject:
+        return self._replace(obj, status_only=True, field_manager=field_manager)
 
     def patch(
         self,
@@ -1332,6 +1359,7 @@ class FakeCluster(Client):
         namespace: str = "",
         patch: Optional[Mapping[str, Any] | list[Any]] = None,
         patch_type: str = "merge",
+        field_manager: str = "",
     ) -> KubeObject:
         with self._lock:
             payload = (
@@ -1377,6 +1405,9 @@ class FakeCluster(Client):
                 meta["namespace"] = old_ns
             else:
                 meta.pop("namespace", None)
+            # Ownership follows the write (managedFields is server-owned;
+            # a patch cannot rewrite it directly).
+            reassign_on_write(old, current, field_manager, rfc3339_now())
             self._bump(current)
             if not self._write_becomes_delete(current):
                 self._emit(_WATCH_MODIFIED, current, old=old)
@@ -1391,6 +1422,96 @@ class FakeCluster(Client):
                     # A spec patch can add served versions — existing ones
                     # stay served; the set refreshes after the window
                     # (same as _replace).
+                    self._schedule_discovery_refresh_locked(current)
+            self._finalize_delete_if_due(kind, name, namespace, old=old)
+            return wrap(copy.deepcopy(current))
+
+    def apply(
+        self,
+        obj: KubeObject | Mapping[str, Any],
+        field_manager: str,
+        force: bool = False,
+    ) -> KubeObject:
+        """Server-side apply (``application/apply-patch+yaml``): merge the
+        manager's declared intent into the live object, tracking field
+        ownership in ``metadata.managedFields``. Creates the object when
+        absent. Fields the manager declared on a previous apply and omits
+        now are removed (unless co-owned); a field owned by another
+        manager with a different value raises ConflictError (409, message
+        lists the owners) unless ``force`` — the upstream co-management
+        contract (kube/ssa.py).
+        """
+        applied = copy.deepcopy(
+            obj.raw if isinstance(obj, KubeObject) else dict(obj)
+        )
+        kind = applied.get("kind", "")
+        meta = applied.setdefault("metadata", {})
+        name = meta.get("name", "")
+        namespace = meta.get("namespace", "")
+        if not kind or not name:
+            raise InvalidError("apply requires kind and metadata.name")
+        if not field_manager:
+            raise BadRequestError(
+                "fieldManager is required for apply requests"
+            )
+        # Server-owned bookkeeping a client may have round-tripped never
+        # enters the applied intent.
+        for f in (
+            "uid",
+            "resourceVersion",
+            "creationTimestamp",
+            "generation",
+            "selfLink",
+            "deletionTimestamp",
+        ):
+            meta.pop(f, None)
+        with self._lock:
+            self._react(
+                "apply",
+                kind,
+                {
+                    "name": name,
+                    "namespace": namespace,
+                    "manager": field_manager,
+                    "force": force,
+                },
+            )
+            key = self._key(kind, namespace, name)
+            now = rfc3339_now()
+            if key not in self._store:
+                # Create-through-apply: an empty shell takes the full
+                # config, then rides the normal create path (uid, rv,
+                # watch ADDED, CRD establishment).
+                live: dict[str, Any] = {
+                    "apiVersion": applied.get("apiVersion"),
+                    "kind": kind,
+                    "metadata": {"name": name},
+                }
+                if namespace:
+                    live["metadata"]["namespace"] = namespace
+                server_side_apply(live, applied, field_manager, force, now)
+                return self.create(wrap(live))
+            current = self._get_raw(kind, name, namespace)
+            old = copy.deepcopy(current)
+            if "status" in current:
+                # Main-resource writes never touch the status subresource
+                # (same rule as _replace).
+                applied.pop("status", None)
+            server_side_apply(current, applied, field_manager, force, now)
+            # Same identity pinning as patch.
+            cur_meta = current.setdefault("metadata", {})
+            cur_meta["name"] = name
+            old_ns = (old.get("metadata") or {}).get("namespace")
+            if old_ns:
+                cur_meta["namespace"] = old_ns
+            else:
+                cur_meta.pop("namespace", None)
+            self._bump(current)
+            if not self._write_becomes_delete(current):
+                self._emit(_WATCH_MODIFIED, current, old=old)
+            if kind == "CustomResourceDefinition":
+                self._sync_crd_discoverability_locked(current)
+                if "spec" in applied:
                     self._schedule_discovery_refresh_locked(current)
             self._finalize_delete_if_due(kind, name, namespace, old=old)
             return wrap(copy.deepcopy(current))

@@ -327,6 +327,19 @@ class Service(KubeObject):
         return self.cluster_ip == "None"
 
 
+class ConfigMap(KubeObject):
+    """Core v1 ConfigMap — generic key/value payload (consumer operators
+    ship upgrade configuration this way; also the canonical co-managed
+    object in server-side-apply flows, tests/test_ssa.py)."""
+
+    KIND = "ConfigMap"
+    API_VERSION = "v1"
+
+    @property
+    def data(self) -> dict[str, str]:
+        return _ensure(self.raw, "data")
+
+
 class Lease(KubeObject):
     """coordination.k8s.io/v1 Lease — the lock object behind leader
     election. The reference library assumes controller-runtime Manager
@@ -436,6 +449,7 @@ KINDS: dict[str, Type[KubeObject]] = {
         ControllerRevision,
         Event,
         Service,
+        ConfigMap,
         Lease,
         CustomResourceDefinition,
         NodeMaintenance,

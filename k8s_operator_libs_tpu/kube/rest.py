@@ -613,26 +613,63 @@ class RestClient(Client):
         finally:
             conn.close()
 
-    def create(self, obj: KubeObject) -> KubeObject:
+    def create(self, obj: KubeObject, field_manager: str = "") -> KubeObject:
         info = resource_for_kind(obj.raw.get("kind", ""))
+        query = {"fieldManager": field_manager} if field_manager else None
         return wrap(
             self._request(
-                "POST", self._path(info, obj.namespace), body=obj.raw
+                "POST",
+                self._path(info, obj.namespace),
+                query=query,
+                body=obj.raw,
             )
         )
 
-    def update(self, obj: KubeObject) -> KubeObject:
+    def update(self, obj: KubeObject, field_manager: str = "") -> KubeObject:
         info = resource_for_kind(obj.raw.get("kind", ""))
+        query = {"fieldManager": field_manager} if field_manager else None
         return wrap(
             self._request(
-                "PUT", self._path(info, obj.namespace, obj.name), body=obj.raw
+                "PUT",
+                self._path(info, obj.namespace, obj.name),
+                query=query,
+                body=obj.raw,
             )
         )
 
-    def update_status(self, obj: KubeObject) -> KubeObject:
+    def update_status(
+        self, obj: KubeObject, field_manager: str = ""
+    ) -> KubeObject:
         info = resource_for_kind(obj.raw.get("kind", ""))
         path = self._path(info, obj.namespace, obj.name) + "/status"
-        return wrap(self._request("PUT", path, body=obj.raw))
+        query = {"fieldManager": field_manager} if field_manager else None
+        return wrap(self._request("PUT", path, query=query, body=obj.raw))
+
+    def apply(
+        self,
+        obj: KubeObject | Mapping[str, Any],
+        field_manager: str,
+        force: bool = False,
+    ) -> KubeObject:
+        """Server-side apply over the wire: PATCH with the
+        ``application/apply-patch+yaml`` content type (the body is JSON,
+        which is valid YAML — what client-go sends too) and the
+        fieldManager/force query parameters."""
+        raw = dict(obj.raw if isinstance(obj, KubeObject) else obj)
+        info = resource_for_kind(raw.get("kind", ""))
+        meta = raw.get("metadata") or {}
+        query = {"fieldManager": field_manager}
+        if force:
+            query["force"] = "true"
+        return wrap(
+            self._request(
+                "PATCH",
+                self._path(info, meta.get("namespace", ""), meta.get("name", "")),
+                query=query,
+                body=raw,
+                content_type="application/apply-patch+yaml",
+            )
+        )
 
     def patch(
         self,
@@ -641,6 +678,7 @@ class RestClient(Client):
         namespace: str = "",
         patch: Optional[Mapping[str, Any] | list[Any]] = None,
         patch_type: str = "merge",
+        field_manager: str = "",
     ) -> KubeObject:
         info = resource_for_kind(kind)
         content_types = {
@@ -669,6 +707,9 @@ class RestClient(Client):
             self._request(
                 "PATCH",
                 self._path(info, namespace, name),
+                query=(
+                    {"fieldManager": field_manager} if field_manager else None
+                ),
                 body=body,
                 content_type=content_types[patch_type],
             )

@@ -1,0 +1,413 @@
+"""Server-side apply: field ownership, managedFields, conflicts.
+
+The upstream contract under test (k8s.io docs "Server-Side Apply" +
+structured-merge-diff semantics, re-implemented schema-less in
+kube/ssa.py):
+
+* apply creates the object when absent and records an Apply entry in
+  metadata.managedFields (FieldsV1 wire shape);
+* re-apply by the same manager is declarative — omitted fields are
+  removed;
+* two managers co-own disjoint fields; same-value fields are shared;
+* a different value on another manager's field is a 409 listing the
+  owner, and force=True takes the field over;
+* a plain update/patch moves ownership of the fields it changed to the
+  writer, so the displaced applier conflicts on its next apply (the
+  kubectl-scale-then-apply story);
+* objects never written with a fieldManager stay untracked (activation
+  rule — legacy behavior is byte-identical).
+
+Battery runs the object path (FakeCluster) and the HTTP wire path
+(LocalApiServer + RestClient), like the other conformance families.
+"""
+
+import pytest
+
+from builders import make_node
+from k8s_operator_libs_tpu.kube import (
+    ApplyConflictError,
+    FakeCluster,
+    LocalApiServer,
+    RestClient,
+    RestConfig,
+)
+from k8s_operator_libs_tpu.kube.client import (
+    BadRequestError,
+    ConflictError,
+    InvalidError,
+)
+from k8s_operator_libs_tpu.kube.ssa import (
+    extract_leaves,
+    fields_v1_to_leaves,
+    leaves_to_fields_v1,
+)
+
+
+def cm(name="cfg", **data):
+    """A ConfigMap-shaped custom object (generic map payload)."""
+    return {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": {"name": name, "namespace": "ns"},
+        "data": dict(data),
+    }
+
+
+def pod_manifest(name="p", containers=()):
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": "ns"},
+        "spec": {"containers": [dict(c) for c in containers]},
+    }
+
+
+def entries(obj):
+    return obj.metadata.get("managedFields") or []
+
+
+class TestFieldSets:
+    def test_round_trip_through_fields_v1(self):
+        obj = pod_manifest(
+            containers=[{"name": "a", "image": "a:1"}, {"name": "b"}]
+        )
+        obj["metadata"]["labels"] = {"app": "x"}
+        leaves = set(extract_leaves(obj))
+        wire = leaves_to_fields_v1(leaves)
+        assert fields_v1_to_leaves(wire) == leaves
+        # Wire shape uses upstream's f:/k: key prefixes.
+        assert "f:spec" in wire
+        assert any(k.startswith("k:") for k in wire["f:spec"]["f:containers"])
+
+    def test_identity_metadata_is_never_owned(self):
+        leaves = extract_leaves(
+            {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {
+                    "name": "p",
+                    "namespace": "ns",
+                    "uid": "u",
+                    "resourceVersion": "3",
+                    "labels": {"a": "1"},
+                },
+            }
+        )
+        rendered = {str(p) for p in leaves}
+        assert any("labels" in p for p in rendered)
+        assert not any("'name'" in p or "uid" in p for p in rendered)
+
+
+class TestApplyLifecycle:
+    def test_apply_creates_and_records_ownership(self):
+        cluster = FakeCluster()
+        out = cluster.apply(cm(data1="x"), field_manager="alpha")
+        assert out.raw["data"] == {"data1": "x"}
+        ents = entries(out)
+        assert len(ents) == 1
+        assert ents[0]["manager"] == "alpha"
+        assert ents[0]["operation"] == "Apply"
+        assert ents[0]["fieldsType"] == "FieldsV1"
+        assert "f:data" in ents[0]["fieldsV1"]
+
+    def test_reapply_removes_omitted_fields(self):
+        cluster = FakeCluster()
+        cluster.apply(cm(a="1", b="2"), field_manager="alpha")
+        out = cluster.apply(cm(a="1"), field_manager="alpha")
+        assert out.raw["data"] == {"a": "1"}
+
+    def test_co_management_of_disjoint_fields(self):
+        cluster = FakeCluster()
+        cluster.apply(cm(a="1"), field_manager="alpha")
+        out = cluster.apply(cm(b="2"), field_manager="beta")
+        assert out.raw["data"] == {"a": "1", "b": "2"}
+        # Beta dropping b removes it; alpha's field survives.
+        out = cluster.apply(cm(), field_manager="beta")
+        assert out.raw["data"] == {"a": "1"}
+
+    def test_conflict_names_the_owner_and_force_takes_over(self):
+        cluster = FakeCluster()
+        cluster.apply(cm(a="1"), field_manager="alpha")
+        with pytest.raises(ConflictError) as exc:
+            cluster.apply(cm(a="CHANGED"), field_manager="beta")
+        assert 'conflict with "alpha"' in str(exc.value)
+        assert ".data.a" in str(exc.value)
+        out = cluster.apply(cm(a="CHANGED"), field_manager="beta", force=True)
+        assert out.raw["data"]["a"] == "CHANGED"
+        # Alpha lost the field: its next apply of a different value
+        # now conflicts with beta.
+        with pytest.raises(ConflictError) as exc:
+            cluster.apply(cm(a="1"), field_manager="alpha")
+        assert 'conflict with "beta"' in str(exc.value)
+
+    def test_same_value_is_shared_ownership_not_conflict(self):
+        cluster = FakeCluster()
+        cluster.apply(cm(a="1"), field_manager="alpha")
+        out = cluster.apply(cm(a="1"), field_manager="beta")  # no raise
+        assert out.raw["data"]["a"] == "1"
+        # Either manager dropping the field keeps it while the other
+        # still declares it.
+        out = cluster.apply(cm(), field_manager="alpha")
+        assert out.raw["data"] == {"a": "1"}
+
+    def test_atomicity_on_conflict(self):
+        cluster = FakeCluster()
+        cluster.apply(cm(a="1"), field_manager="alpha")
+        rv = cluster.get("ConfigMap", "cfg", "ns").resource_version
+        with pytest.raises(ConflictError):
+            cluster.apply(cm(a="2", b="new"), field_manager="beta")
+        after = cluster.get("ConfigMap", "cfg", "ns")
+        assert after.raw["data"] == {"a": "1"}
+        assert after.resource_version == rv
+
+    def test_managed_fields_in_request_rejected(self):
+        cluster = FakeCluster()
+        manifest = cm(a="1")
+        manifest["metadata"]["managedFields"] = [{"manager": "evil"}]
+        with pytest.raises(InvalidError):
+            cluster.apply(manifest, field_manager="alpha")
+
+    def test_field_manager_required(self):
+        cluster = FakeCluster()
+        with pytest.raises(BadRequestError):
+            cluster.apply(cm(a="1"), field_manager="")
+
+    def test_apply_conflict_error_carries_structured_list(self):
+        cluster = FakeCluster()
+        cluster.apply(cm(a="1"), field_manager="alpha")
+        with pytest.raises(ApplyConflictError) as exc:
+            cluster.apply(cm(a="2"), field_manager="beta")
+        assert exc.value.conflicts == [("alpha", ".data.a")]
+
+
+class TestKeyedLists:
+    def test_managers_own_distinct_list_elements(self):
+        cluster = FakeCluster()
+        cluster.apply(
+            pod_manifest(containers=[{"name": "a", "image": "a:1"}]),
+            field_manager="alpha",
+        )
+        out = cluster.apply(
+            pod_manifest(containers=[{"name": "b", "image": "b:1"}]),
+            field_manager="beta",
+        )
+        names = [c["name"] for c in out.raw["spec"]["containers"]]
+        assert names == ["a", "b"]
+        # Beta dropping its element removes only b.
+        out = cluster.apply(pod_manifest(containers=[]), field_manager="beta")
+        names = [c["name"] for c in out.raw["spec"]["containers"]]
+        assert names == ["a"]
+
+    def test_key_only_element_declaration_is_shared_not_conflicting(self):
+        # {"name": "a"} declares the element exists, not its contents:
+        # later appliers naming the same element never conflict on it.
+        cluster = FakeCluster()
+        cluster.apply(
+            pod_manifest(containers=[{"name": "a"}]), field_manager="alpha"
+        )
+        cluster.apply(
+            pod_manifest(containers=[{"name": "a", "image": "x"}]),
+            field_manager="beta",
+        )
+        out = cluster.apply(
+            pod_manifest(containers=[{"name": "a"}]), field_manager="gamma"
+        )
+        assert out.raw["spec"]["containers"] == [{"name": "a", "image": "x"}]
+
+    def test_merge_key_never_removed_alone(self):
+        # Regression: ownership removal must never strip an element's
+        # merge key while the element stands (removal order used to be
+        # hash-seed-dependent; key-first left a keyless ghost that
+        # declassified the list to atomic and let an empty re-apply wipe
+        # other managers' elements).
+        from k8s_operator_libs_tpu.kube.ssa import remove_leaf
+
+        obj = {"spec": {"containers": [{"name": "b", "image": "b:1"}]}}
+        key_leaf = (
+            ("f", "spec"),
+            ("f", "containers"),
+            ("k", '{"name":"b"}'),
+            ("f", "name"),
+        )
+        image_leaf = key_leaf[:-1] + (("f", "image"),)
+        remove_leaf(obj, key_leaf)  # structural: must be a no-op
+        assert obj["spec"]["containers"] == [{"name": "b", "image": "b:1"}]
+        # Last real field: the element collapses, and the now-empty
+        # containers list (and spec) prune away with it.
+        remove_leaf(obj, image_leaf)
+        assert obj == {}
+
+    def test_element_field_conflict(self):
+        cluster = FakeCluster()
+        cluster.apply(
+            pod_manifest(containers=[{"name": "a", "image": "a:1"}]),
+            field_manager="alpha",
+        )
+        with pytest.raises(ConflictError) as exc:
+            cluster.apply(
+                pod_manifest(containers=[{"name": "a", "image": "EVIL"}]),
+                field_manager="beta",
+            )
+        assert 'name="a"' in str(exc.value)
+
+
+class TestUpdateInterplay:
+    def test_update_displaces_applier_ownership(self):
+        # The kubectl-scale-then-apply story: a plain write that changes
+        # an applied field moves ownership to the writer; the applier's
+        # next apply conflicts and force resolves it.
+        cluster = FakeCluster()
+        cluster.apply(cm(a="1"), field_manager="alpha")
+        obj = cluster.get("ConfigMap", "cfg", "ns")
+        obj.raw["data"]["a"] = "scaled"
+        cluster.update(obj, field_manager="scaler")
+        with pytest.raises(ConflictError) as exc:
+            cluster.apply(cm(a="1"), field_manager="alpha")
+        assert 'conflict with "scaler"' in str(exc.value)
+        out = cluster.apply(cm(a="1"), field_manager="alpha", force=True)
+        assert out.raw["data"]["a"] == "1"
+
+    def test_anonymous_update_on_managed_object_uses_unknown(self):
+        cluster = FakeCluster()
+        cluster.apply(cm(a="1"), field_manager="alpha")
+        obj = cluster.get("ConfigMap", "cfg", "ns")
+        obj.raw["data"]["a"] = "drifted"
+        cluster.update(obj)  # no fieldManager declared
+        with pytest.raises(ConflictError) as exc:
+            cluster.apply(cm(a="1"), field_manager="alpha")
+        assert 'conflict with "unknown"' in str(exc.value)
+
+    def test_patch_displaces_applier_ownership(self):
+        cluster = FakeCluster()
+        cluster.apply(cm(a="1"), field_manager="alpha")
+        cluster.patch(
+            "ConfigMap",
+            "cfg",
+            "ns",
+            patch={"data": {"a": "patched"}},
+            field_manager="patcher",
+        )
+        with pytest.raises(ConflictError) as exc:
+            cluster.apply(cm(a="1"), field_manager="alpha")
+        assert 'conflict with "patcher"' in str(exc.value)
+
+    def test_explicit_create_then_apply_conflicts(self):
+        cluster = FakeCluster()
+        from k8s_operator_libs_tpu.kube import wrap
+
+        cluster.create(wrap(cm(a="1")), field_manager="creator")
+        with pytest.raises(ConflictError) as exc:
+            cluster.apply(cm(a="2"), field_manager="alpha")
+        assert 'conflict with "creator"' in str(exc.value)
+        # Applying the SAME value shares ownership instead.
+        out = cluster.apply(cm(a="1"), field_manager="alpha")
+        assert out.raw["data"]["a"] == "1"
+
+
+class TestActivationRule:
+    def test_unmanaged_objects_stay_untracked(self):
+        # Legacy writes (no fieldManager anywhere) must stay byte-identical
+        # to pre-SSA behavior: no managedFields ever appears.
+        cluster = FakeCluster()
+        node = cluster.create(make_node(name="n1"))
+        assert "managedFields" not in node.metadata
+        node = cluster.get("Node", "n1")
+        node.labels["x"] = "1"
+        node = cluster.update(node)
+        assert "managedFields" not in node.metadata
+        out = cluster.patch(
+            "Node", "n1", patch={"metadata": {"labels": {"y": "2"}}}
+        )
+        assert "managedFields" not in out.metadata
+
+    def test_client_sent_managed_fields_is_ignored_on_update(self):
+        cluster = FakeCluster()
+        cluster.apply(cm(a="1"), field_manager="alpha")
+        obj = cluster.get("ConfigMap", "cfg", "ns")
+        obj.metadata["managedFields"] = [{"manager": "forged"}]
+        out = cluster.update(obj, field_manager="writer")
+        assert all(e["manager"] != "forged" for e in entries(out))
+
+
+class TestWirePath:
+    @pytest.fixture()
+    def server(self):
+        with LocalApiServer() as server:
+            yield server
+
+    def test_apply_round_trip_and_conflict_over_http(self, server):
+        client = RestClient(RestConfig(server=server.url, namespace="ns"))
+        try:
+            out = client.apply(cm(a="1"), field_manager="alpha")
+            assert out.raw["data"] == {"a": "1"}
+            assert entries(out)[0]["manager"] == "alpha"
+            with pytest.raises(ConflictError) as exc:
+                client.apply(cm(a="2"), field_manager="beta")
+            assert 'conflict with "alpha"' in str(exc.value)
+            out = client.apply(cm(a="2"), field_manager="beta", force=True)
+            assert out.raw["data"]["a"] == "2"
+            # fieldManager on a plain wire update displaces ownership.
+            obj = client.get("ConfigMap", "cfg", "ns")
+            obj.raw["data"]["a"] = "manual"
+            client.update(obj, field_manager="oncall")
+            with pytest.raises(ConflictError) as exc:
+                client.apply(cm(a="2"), field_manager="beta")
+            assert 'conflict with "oncall"' in str(exc.value)
+        finally:
+            client.close()
+
+    def test_field_manager_required_over_http(self, server):
+        client = RestClient(RestConfig(server=server.url, namespace="ns"))
+        try:
+            with pytest.raises(BadRequestError):
+                client.apply(cm(a="1"), field_manager="")
+        finally:
+            client.close()
+
+    def test_apply_status_codes_and_url_body_mismatch(self, server):
+        import http.client
+        import json as jsonlib
+        from urllib.parse import urlparse
+
+        host = urlparse(server.url)
+
+        def raw_apply(path, body, query="fieldManager=m"):
+            conn = http.client.HTTPConnection(host.hostname, host.port)
+            try:
+                conn.request(
+                    "PATCH",
+                    f"{path}?{query}",
+                    body=jsonlib.dumps(body),
+                    headers={"Content-Type": "application/apply-patch+yaml"},
+                )
+                resp = conn.getresponse()
+                return resp.status, resp.read()
+            finally:
+                conn.close()
+
+        base = "/api/v1/namespaces/ns/configmaps"
+        # Create-through-apply answers 201, a later apply 200 (the real
+        # apiserver contract; the POST path already does this).
+        status, _ = raw_apply(f"{base}/cfg", cm(a="1"))
+        assert status == 201
+        status, _ = raw_apply(f"{base}/cfg", cm(a="1"))
+        assert status == 200
+        # The body may not address a different object than the URL.
+        status, _ = raw_apply(f"{base}/cfg", cm(a="1", name="other"))
+        assert status == 400
+        # Apply to subresources is rejected, not silently misrouted.
+        status, _ = raw_apply(f"{base}/cfg/status", cm(a="1"))
+        assert status == 400
+
+    def test_cached_client_forwards_field_manager(self):
+        from k8s_operator_libs_tpu.kube import CachedClient
+
+        cluster = FakeCluster()
+        cached = CachedClient(cluster, sync_mode="passthrough")
+        cached.apply(cm(a="1"), field_manager="alpha")
+        obj = cached.get("ConfigMap", "cfg", "ns")
+        obj.raw["data"]["a"] = "changed"
+        cached.update(obj, field_manager="writer")
+        with pytest.raises(ConflictError) as exc:
+            cached.apply(cm(a="1"), field_manager="alpha")
+        assert 'conflict with "writer"' in str(exc.value)
