@@ -34,6 +34,16 @@ from .rest import RestClient, RestConfig, RestConfigError
 from .apiserver import LocalApiServer
 from .informer import Informer
 from .leader import LeaderElectionConfig, LeaderElector
+from .controller import Controller, Request, Result
+from .workqueue import (
+    BucketRateLimiter,
+    DelayingQueue,
+    ItemExponentialFailureRateLimiter,
+    MaxOfRateLimiter,
+    RateLimitingQueue,
+    WorkQueue,
+    default_controller_rate_limiter,
+)
 
 __all__ = [
     "AlreadyExistsError",
@@ -81,4 +91,14 @@ __all__ = [
     "RestConfigError",
     "retry_on_conflict",
     "wrap",
+    "BucketRateLimiter",
+    "Controller",
+    "DelayingQueue",
+    "ItemExponentialFailureRateLimiter",
+    "MaxOfRateLimiter",
+    "RateLimitingQueue",
+    "Request",
+    "Result",
+    "WorkQueue",
+    "default_controller_rate_limiter",
 ]

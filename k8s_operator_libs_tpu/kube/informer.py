@@ -88,11 +88,37 @@ class Informer:
         """Register a handler; called as (event_type, obj, old). Watch
         deliveries run on the informer thread, resyncs on the resync
         timer thread — but deliveries are serialized, a handler is never
-        invoked concurrently. Register before start() to see the initial
-        ADDEDs."""
-        self._handlers.append(handler)
+        invoked concurrently. A handler registered AFTER the initial
+        sync is caught up client-go-style: the current store is replayed
+        to it (and only it) as synthetic ADDEDs, so late registrants see
+        every existing object. Deliveries are at-least-once — an event
+        racing the replay can arrive again after it; handlers must be
+        level-driven, as controller handlers are."""
+        with self._dispatch_lock:
+            if self._synced.is_set():
+                with self._lock:
+                    snapshot = list(self._store.values())
+                for raw in snapshot:
+                    obj = wrap(raw)
+                    try:
+                        handler("ADDED", obj, None)
+                    except Exception:  # noqa: BLE001 - handlers own errors
+                        log.exception(
+                            "informer handler failed during replay for %s",
+                            obj.name,
+                        )
+            self._handlers.append(handler)
+
+    @property
+    def started(self) -> bool:
+        """True once start() has been called (whether or not the initial
+        sync has completed) — the public ownership signal for wrappers
+        like ``Controller`` deciding whose lifecycle this is."""
+        return self._thread is not None
 
     def start(self) -> "Informer":
+        if self._thread is not None:
+            raise RuntimeError(f"informer for {self.kind} already started")
         self._thread = threading.Thread(
             target=self._run, name=f"informer-{self.kind}", daemon=True
         )

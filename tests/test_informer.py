@@ -97,6 +97,33 @@ class TestHandlers:
             )
             assert wait_until(lambda: ("MODIFIED", "seed") in events)
 
+    def test_late_handler_gets_store_replayed_as_adds(self, server, client):
+        """client-go AddEventHandler semantics: a handler registered
+        after the initial sync is caught up with synthetic ADDEDs for
+        everything already cached."""
+        server.cluster.create(make_node("pre-1"))
+        server.cluster.create(make_node("pre-2"))
+        with Informer(client, "Node") as inf:
+            assert inf.wait_for_sync(timeout=10)
+            late = []
+            inf.add_event_handler(
+                lambda e, obj, old: late.append((e, obj.name))
+            )
+            assert ("ADDED", "pre-1") in late  # replayed synchronously
+            assert ("ADDED", "pre-2") in late
+            server.cluster.create(make_node("post"))
+            assert wait_until(lambda: ("ADDED", "post") in late)
+
+    def test_start_twice_rejected(self, server, client):
+        inf = Informer(client, "Node").start()
+        try:
+            import pytest
+
+            with pytest.raises(RuntimeError):
+                inf.start()
+        finally:
+            inf.stop()
+
     def test_handler_gets_old_object_for_predicates(self, server, client):
         """The informer's (obj, old) pair feeds condition_changed_predicate
         directly — the reference's watch-predicate wiring, no poll loop."""

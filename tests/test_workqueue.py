@@ -1,0 +1,261 @@
+"""Workqueue semantics — client-go util/workqueue parity.
+
+Pins the invariants controllers lean on: dedup, in-flight exclusion with
+deferred re-add, delayed maturation keeping the sooner deadline, and the
+DefaultControllerRateLimiter shape (per-item exponential + shared
+bucket). All waits are deadline-driven, never pass-counted.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from k8s_operator_libs_tpu.kube import (
+    BucketRateLimiter,
+    DelayingQueue,
+    ItemExponentialFailureRateLimiter,
+    MaxOfRateLimiter,
+    RateLimitingQueue,
+    WorkQueue,
+    default_controller_rate_limiter,
+)
+
+
+def drain_to_list(q, timeout=5.0):
+    out = []
+    deadline = time.monotonic() + timeout
+    while len(q):
+        item = q.get(timeout=max(0.0, deadline - time.monotonic()))
+        if item is None:
+            break
+        out.append(item)
+        q.done(item)
+    return out
+
+
+class TestWorkQueue:
+    def test_fifo_and_dedup(self):
+        q = WorkQueue()
+        q.add("a")
+        q.add("b")
+        q.add("a")  # dedup: already dirty
+        assert len(q) == 2
+        assert q.get() == "a"
+        assert q.get() == "b"
+        q.done("a")
+        q.done("b")
+        assert len(q) == 0
+
+    def test_in_flight_exclusion_defers_readd(self):
+        q = WorkQueue()
+        q.add("a")
+        assert q.get() == "a"
+        # Re-added while processing: NOT delivered concurrently...
+        q.add("a")
+        assert q.get(timeout=0.05) is None
+        # ...but re-queued the moment processing finishes.
+        q.done("a")
+        assert q.get(timeout=5.0) == "a"
+        q.done("a")
+        assert q.get(timeout=0.05) is None
+
+    def test_add_during_processing_coalesces(self):
+        q = WorkQueue()
+        q.add("a")
+        assert q.get() == "a"
+        q.add("a")
+        q.add("a")
+        q.add("a")
+        q.done("a")
+        assert q.get(timeout=5.0) == "a"
+        q.done("a")
+        # Three adds during one processing pass collapse into ONE re-add.
+        assert q.get(timeout=0.05) is None
+
+    def test_no_concurrent_processing_of_same_key(self):
+        q = WorkQueue()
+        in_flight: dict[str, int] = {}
+        max_seen = {"v": 0}
+        lock = threading.Lock()
+        stop = threading.Event()
+
+        def worker():
+            while not stop.is_set():
+                item = q.get(timeout=0.2)
+                if item is None:
+                    continue
+                with lock:
+                    in_flight[item] = in_flight.get(item, 0) + 1
+                    max_seen["v"] = max(max_seen["v"], in_flight[item])
+                time.sleep(0.002)
+                with lock:
+                    in_flight[item] -= 1
+                q.done(item)
+
+        workers = [threading.Thread(target=worker) for _ in range(4)]
+        for w in workers:
+            w.start()
+        for i in range(300):
+            q.add(f"key-{i % 3}")  # heavy contention on 3 keys
+            if i % 10 == 0:
+                time.sleep(0.001)
+        deadline = time.monotonic() + 10
+        while len(q) and time.monotonic() < deadline:
+            time.sleep(0.01)
+        stop.set()
+        for w in workers:
+            w.join(timeout=5)
+        assert max_seen["v"] == 1, "same key processed concurrently"
+
+    def test_shutdown_wakes_getters(self):
+        q = WorkQueue()
+        got = {}
+
+        def getter():
+            got["v"] = q.get()
+
+        t = threading.Thread(target=getter)
+        t.start()
+        time.sleep(0.05)
+        q.shutdown()
+        t.join(timeout=5)
+        assert not t.is_alive()
+        assert got["v"] is None
+        q.add("late")  # adds after shutdown are dropped
+        assert len(q) == 0
+
+    def test_shutdown_with_drain_waits_for_in_flight(self):
+        q = WorkQueue()
+        q.add("a")
+        item = q.get()
+        done_at = {}
+
+        def finish():
+            time.sleep(0.1)
+            done_at["t"] = time.monotonic()
+            q.done(item)
+
+        t = threading.Thread(target=finish)
+        t.start()
+        assert q.shutdown_with_drain(timeout=5.0) is True
+        assert time.monotonic() >= done_at["t"]
+        t.join()
+
+    def test_shutdown_with_drain_times_out(self):
+        q = WorkQueue()
+        q.add("stuck")
+        q.get()  # never call done
+        assert q.shutdown_with_drain(timeout=0.1) is False
+
+
+class TestDelayingQueue:
+    def test_add_after_matures(self):
+        q = DelayingQueue()
+        t0 = time.monotonic()
+        q.add_after("a", 0.15)
+        assert q.get(timeout=0.05) is None  # not yet
+        assert q.get(timeout=5.0) == "a"
+        assert time.monotonic() - t0 >= 0.14
+        q.done("a")
+        q.shutdown()
+
+    def test_nonpositive_delay_is_immediate(self):
+        q = DelayingQueue()
+        q.add_after("a", 0.0)
+        assert q.get(timeout=5.0) == "a"
+        q.done("a")
+        q.shutdown()
+
+    def test_sooner_deadline_wins(self):
+        q = DelayingQueue()
+        q.add_after("a", 30.0)
+        q.add_after("a", 0.05)  # supersedes with the sooner deadline
+        t0 = time.monotonic()
+        assert q.get(timeout=5.0) == "a"
+        assert time.monotonic() - t0 < 5.0
+        q.done("a")
+        # The stale 30 s entry must not re-fire the item.
+        assert q.get(timeout=0.2) is None
+        q.shutdown()
+
+    def test_later_duplicate_deadline_ignored(self):
+        q = DelayingQueue()
+        q.add_after("a", 0.05)
+        q.add_after("a", 30.0)  # ignored: an earlier timer pends
+        assert q.get(timeout=5.0) == "a"
+        q.done("a")
+        assert q.get(timeout=0.2) is None
+        q.shutdown()
+
+    def test_shutdown_drops_pending_timers(self):
+        q = DelayingQueue()
+        q.add_after("a", 0.05)
+        q.shutdown()
+        assert q.get(timeout=0.3) is None
+
+
+class TestRateLimiters:
+    def test_item_exponential_progression_and_forget(self):
+        rl = ItemExponentialFailureRateLimiter(base_delay=0.005,
+                                               max_delay=1000.0)
+        assert rl.when("a") == pytest.approx(0.005)
+        assert rl.when("a") == pytest.approx(0.010)
+        assert rl.when("a") == pytest.approx(0.020)
+        assert rl.num_requeues("a") == 3
+        # Independent per item.
+        assert rl.when("b") == pytest.approx(0.005)
+        rl.forget("a")
+        assert rl.num_requeues("a") == 0
+        assert rl.when("a") == pytest.approx(0.005)
+
+    def test_item_exponential_caps_at_max(self):
+        rl = ItemExponentialFailureRateLimiter(base_delay=1.0, max_delay=8.0)
+        delays = [rl.when("a") for _ in range(80)]
+        assert delays[:4] == [1.0, 2.0, 4.0, 8.0]
+        assert all(d == 8.0 for d in delays[4:])  # incl. huge counts
+
+    def test_bucket_burst_then_smoothing(self):
+        clock = {"t": 0.0}
+        rl = BucketRateLimiter(qps=10.0, burst=3, clock=lambda: clock["t"])
+        assert [rl.when("x") for _ in range(3)] == [0.0, 0.0, 0.0]
+        # Bucket empty: each reservation matures 100 ms after the last.
+        assert rl.when("x") == pytest.approx(0.1)
+        assert rl.when("x") == pytest.approx(0.2)
+        # Time passing refills.
+        clock["t"] = 10.0
+        assert rl.when("x") == 0.0
+
+    def test_max_of_combines(self):
+        clock = {"t": 0.0}
+        rl = MaxOfRateLimiter(
+            ItemExponentialFailureRateLimiter(0.005, 1000.0),
+            BucketRateLimiter(qps=1.0, burst=1, clock=lambda: clock["t"]),
+        )
+        assert rl.when("a") == pytest.approx(0.005)  # bucket: 0, item: 5ms
+        assert rl.when("b") == pytest.approx(1.0)  # bucket empty now
+        rl.forget("a")
+        assert rl.num_requeues("a") == 0
+
+    def test_default_controller_rate_limiter_shape(self):
+        rl = default_controller_rate_limiter()
+        assert rl.when("a") == pytest.approx(0.005)
+        assert rl.when("a") == pytest.approx(0.010)
+
+
+class TestRateLimitingQueue:
+    def test_backoff_then_forget(self):
+        q = RateLimitingQueue(
+            ItemExponentialFailureRateLimiter(base_delay=0.03,
+                                              max_delay=1.0)
+        )
+        q.add_rate_limited("a")
+        assert q.num_requeues("a") == 1
+        assert q.get(timeout=0.01) is None  # still backing off
+        assert q.get(timeout=5.0) == "a"
+        q.done("a")
+        q.forget("a")
+        assert q.num_requeues("a") == 0
+        q.shutdown()
