@@ -35,6 +35,7 @@ from .apiserver import LocalApiServer
 from .informer import Informer
 from .leader import LeaderElectionConfig, LeaderElector
 from .controller import Controller, Request, Result
+from .structural import StructuralSchema, schema_for_crd_version
 from .workqueue import (
     BucketRateLimiter,
     DelayingQueue,
@@ -99,6 +100,8 @@ __all__ = [
     "RateLimitingQueue",
     "Request",
     "Result",
+    "StructuralSchema",
     "WorkQueue",
     "default_controller_rate_limiter",
+    "schema_for_crd_version",
 ]

@@ -160,6 +160,15 @@ class Controller:
                 self._owned.append(informer)
         for informer in self._watches:
             if not informer.wait_for_sync(sync_timeout):
+                # Unwind cleanly: stop the informers THIS call started
+                # (not shared ones) and allow a retry — a half-started
+                # controller must not leak watch threads or wedge on
+                # "already started".
+                for owned in self._owned:
+                    owned.stop()
+                self._owned = []
+                with self._lock:
+                    self._started = False
                 raise TimeoutError(
                     f"{self.name}: informer for {informer.kind} did not "
                     f"sync within {sync_timeout}s"

@@ -43,8 +43,10 @@ from .objects import (
     rfc3339_now,
     wrap,
 )
+from .resources import resource_for_kind
 from .selectors import LabelSelector, parse_field_selector, parse_selector
 from .ssa import reassign_on_write, server_side_apply
+from .structural import schema_for_crd_version
 
 #: reactor signature: (verb, kind, payload) -> None; raise to inject a failure.
 Reactor = Callable[[str, str, dict[str, Any]], None]
@@ -939,6 +941,66 @@ class FakeCluster(Client):
         self._last_rv = next(self._rv)
         data.setdefault("metadata", {})["resourceVersion"] = str(self._last_rv)
 
+    # -- structural-schema admission (custom resources) --------------------
+    def _admit_custom_locked(self, data: dict[str, Any]) -> None:
+        """The apiserver's CR admission: when a stored CRD carries a
+        structural schema for this object's group/kind/version, prune
+        unknown fields, apply defaults, and validate — 422 on violation.
+        Built-in groups and kinds with no stored CRD are untouched, so a
+        schema-less cluster behaves exactly as before (the same
+        activation rule server-side apply uses)."""
+        if _supports_strategic(data):
+            return  # built-in group: typed, never CRD-backed
+        api_version = data.get("apiVersion") or ""
+        group, _, version = api_version.rpartition("/")
+        kind = data.get("kind", "")
+        crd = None
+        try:
+            plural = resource_for_kind(kind).plural
+        except KeyError:
+            pass
+        else:
+            crd = self._store.get(
+                ("CustomResourceDefinition", "", f"{plural}.{group}")
+            )
+        if crd is None:
+            # Unregistered (or irregularly-pluralized) kinds: the stored
+            # CRDs themselves are the authoritative group/kind mapping.
+            for key, stored in self._store.items():
+                if key[0] != "CustomResourceDefinition":
+                    continue
+                spec = stored.get("spec") or {}
+                if spec.get("group") == group and (
+                    (spec.get("names") or {}).get("kind") == kind
+                ):
+                    crd = stored
+                    break
+        if crd is None:
+            return
+        schema = schema_for_crd_version(crd, version)
+        if schema is None:
+            return
+        errors = schema.admit(data)
+        if errors:
+            name = (data.get("metadata") or {}).get("name", "")
+            raise InvalidError(
+                f"{kind}.{group} {name!r} is invalid: " + "; ".join(errors)
+            )
+
+    def _admit_or_restore_locked(
+        self, data: dict[str, Any], old: dict[str, Any]
+    ) -> None:
+        """Admission for write paths that mutate the STORED dict in
+        place (patch, status replace, apply): a rejected write restores
+        the pre-write content before re-raising, so 422 leaves no
+        trace — the same atomicity the json-patch engine guarantees."""
+        try:
+            self._admit_custom_locked(data)
+        except InvalidError:
+            data.clear()
+            data.update(copy.deepcopy(old))
+            raise
+
     def current_resource_version(self) -> str:
         """The newest revision assigned — a list's collection
         resourceVersion (what an empty list resumes a watch from)."""
@@ -1151,6 +1213,7 @@ class FakeCluster(Client):
             if key in self._store:
                 raise AlreadyExistsError(f"{kind} {obj.name} already exists")
             data = copy.deepcopy(obj.raw)
+            self._admit_custom_locked(data)
             meta = data.setdefault("metadata", {})
             meta.setdefault("uid", str(uuid.uuid4()))
             meta.setdefault("creationTimestamp", time.time())
@@ -1298,6 +1361,7 @@ class FakeCluster(Client):
             if status_only:
                 current["status"] = copy.deepcopy(obj.raw.get("status") or {})
                 data = current
+                self._admit_or_restore_locked(data, old)
             else:
                 data = copy.deepcopy(obj.raw)
                 # Immutable/server-owned fields survive a replace.
@@ -1310,9 +1374,15 @@ class FakeCluster(Client):
                 # The status subresource is ignored on a main-resource update,
                 # as on a real apiserver with subresources enabled.
                 if "status" in current:
-                    data["status"] = current["status"]
+                    # Deep copy: admission prunes in place, and a rejected
+                    # write must not have reached the stored status subtree
+                    # through a shared reference.
+                    data["status"] = copy.deepcopy(current["status"])
                 else:
                     data.pop("status", None)
+                # Admission before the store swap: a rejected replace
+                # must leave the stored object untouched.
+                self._admit_custom_locked(data)
                 self._store[self._key(kind, obj.namespace, obj.name)] = data
             # managedFields is server-owned: ownership moves to the writer
             # for every field this write changed (client-sent managedFields
@@ -1405,6 +1475,7 @@ class FakeCluster(Client):
                 meta["namespace"] = old_ns
             else:
                 meta.pop("namespace", None)
+            self._admit_or_restore_locked(current, old)
             # Ownership follows the write (managedFields is server-owned;
             # a patch cannot rewrite it directly).
             reassign_on_write(old, current, field_manager, rfc3339_now())
@@ -1506,6 +1577,7 @@ class FakeCluster(Client):
                 cur_meta["namespace"] = old_ns
             else:
                 cur_meta.pop("namespace", None)
+            self._admit_or_restore_locked(current, old)
             self._bump(current)
             if not self._write_becomes_delete(current):
                 self._emit(_WATCH_MODIFIED, current, old=old)

@@ -88,16 +88,20 @@ class Informer:
         """Register a handler; called as (event_type, obj, old). Watch
         deliveries run on the informer thread, resyncs on the resync
         timer thread — but deliveries are serialized, a handler is never
-        invoked concurrently. A handler registered AFTER the initial
-        sync is caught up client-go-style: the current store is replayed
-        to it (and only it) as synthetic ADDEDs, so late registrants see
-        every existing object. Deliveries are at-least-once — an event
-        racing the replay can arrive again after it; handlers must be
-        level-driven, as controller handlers are."""
+        invoked concurrently. A handler registered after objects are
+        cached is caught up client-go-style: the current store is
+        replayed to it (and only it) as synthetic ADDEDs, so late
+        registrants see every existing object — deliberately NOT gated
+        on the synced flag, which a watch expiry clears while the store
+        still holds the last-known objects (a re-list only dispatches
+        diffs, so skipping the replay there would lose the unchanged
+        ones). Deliveries are at-least-once — an event racing the replay
+        can arrive again after it; handlers must be level-driven, as
+        controller handlers are."""
         with self._dispatch_lock:
-            if self._synced.is_set():
-                with self._lock:
-                    snapshot = list(self._store.values())
+            with self._lock:
+                snapshot = list(self._store.values())
+            if snapshot:
                 for raw in snapshot:
                     obj = wrap(raw)
                     try:

@@ -1,0 +1,336 @@
+"""Structural-schema admission for custom resources — the apiserver's
+prune → default → validate pipeline.
+
+The reference's test strategy stands on envtest booting a REAL
+kube-apiserver with the NodeMaintenance CRD installed
+(`/root/reference/pkg/upgrade/upgrade_suit_test.go:87-89`), which means
+every CR its suite writes is pruned against the CRD's structural
+openAPIV3Schema, defaulted from it, and validated by it before storage.
+`FakeCluster` replicates that admission here so a stored CRD activates
+the same contract: unknown fields are pruned (unless
+``x-kubernetes-preserve-unknown-fields``), ``default``s are applied into
+existing objects, and violations answer 422 Invalid with apiserver-shaped
+field paths.
+
+Scope (the structural subset, mirroring
+apiextensions-apiserver/pkg/apiserver/schema semantics):
+
+* types ``object``/``array``/``string``/``integer``/``number``/
+  ``boolean``; ``x-kubernetes-int-or-string``; ``nullable``
+* ``properties`` / ``items`` / ``additionalProperties`` (schema or
+  ``true``)
+* ``required``, ``enum``, ``minimum``/``maximum`` (+ boolean
+  ``exclusiveMinimum``/``exclusiveMaximum``), ``minLength``/
+  ``maxLength``, ``pattern``, ``minItems``/``maxItems``,
+  ``uniqueItems``, ``allOf``/``anyOf``/``oneOf``/``not``
+* ``format`` is accepted but not enforced (upstream treats most formats
+  as annotations for CRDs; enforcing none is the closest uniform rule)
+
+At the document root, ``apiVersion``/``kind``/``metadata`` are server
+territory: never pruned, never validated by the CR schema (upstream
+coerces metadata through ObjectMeta instead of the schema).
+"""
+
+from __future__ import annotations
+
+import copy
+import re
+from typing import Any, Mapping, Optional
+
+_ROOT_SERVER_KEYS = frozenset({"apiVersion", "kind", "metadata"})
+
+
+def schema_for_crd_version(
+    crd_data: Mapping[str, Any], version: str
+) -> Optional["StructuralSchema"]:
+    """The version's openAPIV3Schema as a ``StructuralSchema``, or None
+    when that version carries no schema (schema-less CRDs admit
+    anything, like upstream with preserveUnknownFields)."""
+    for v in (crd_data.get("spec") or {}).get("versions") or []:
+        if v.get("name") != version:
+            continue
+        raw = ((v.get("schema") or {}).get("openAPIV3Schema")) or None
+        return StructuralSchema(raw) if raw else None
+    return None
+
+
+class StructuralSchema:
+    def __init__(self, root: Mapping[str, Any]) -> None:
+        self.root = root
+
+    # -- the admission pipeline -------------------------------------------
+    def admit(self, data: dict[str, Any]) -> list[str]:
+        """Prune, then default, then validate ``data`` in place —
+        upstream's write-path order. Returns validation errors
+        (empty = admitted)."""
+        self.prune(data)
+        self.apply_defaults(data)
+        return self.validate(data)
+
+    # -- pruning -----------------------------------------------------------
+    def prune(self, data: dict[str, Any]) -> None:
+        """Drop fields the schema does not specify (the apiserver's
+        field pruning). Root server-owned keys are untouched."""
+        props = self.root.get("properties") or {}
+        preserve = self.root.get("x-kubernetes-preserve-unknown-fields")
+        for key in list(data):
+            if key in _ROOT_SERVER_KEYS:
+                continue
+            if key in props:
+                _prune_value(data[key], props[key])
+            elif not preserve:
+                del data[key]
+
+    # -- defaulting --------------------------------------------------------
+    def apply_defaults(self, data: dict[str, Any]) -> None:
+        props = self.root.get("properties") or {}
+        for key, sub in props.items():
+            if key in _ROOT_SERVER_KEYS:
+                continue
+            if key not in data and "default" in sub:
+                data[key] = copy.deepcopy(sub["default"])
+            if key in data:
+                _default_value(data[key], sub)
+
+    # -- validation --------------------------------------------------------
+    def validate(self, data: Mapping[str, Any]) -> list[str]:
+        errors: list[str] = []
+        props = self.root.get("properties") or {}
+        for key in self.root.get("required") or []:
+            if key in _ROOT_SERVER_KEYS:
+                continue
+            if key not in data:
+                errors.append(f"{key}: Required value")
+        for key, value in data.items():
+            if key in _ROOT_SERVER_KEYS:
+                continue
+            if key in props:
+                _validate_value(value, props[key], key, errors)
+        return errors
+
+
+# ---------------------------------------------------------------------------
+# Node-level walkers
+# ---------------------------------------------------------------------------
+
+
+def _prune_value(value: Any, schema: Mapping[str, Any]) -> None:
+    if isinstance(value, dict):
+        if schema.get("x-kubernetes-int-or-string"):
+            return  # int-or-string holds scalars; leave malformed input
+            # for validation to report rather than silently emptying it
+        props = schema.get("properties") or {}
+        addl = schema.get("additionalProperties")
+        preserve = schema.get("x-kubernetes-preserve-unknown-fields")
+        for key in list(value):
+            if key in props:
+                _prune_value(value[key], props[key])
+            elif isinstance(addl, Mapping):
+                _prune_value(value[key], addl)
+            elif addl is True or preserve:
+                continue
+            else:
+                del value[key]
+    elif isinstance(value, list):
+        items = schema.get("items")
+        if isinstance(items, Mapping):
+            for element in value:
+                _prune_value(element, items)
+
+
+def _default_value(value: Any, schema: Mapping[str, Any]) -> None:
+    if isinstance(value, dict):
+        props = schema.get("properties") or {}
+        for key, sub in props.items():
+            if key not in value and "default" in sub:
+                value[key] = copy.deepcopy(sub["default"])
+            if key in value:
+                _default_value(value[key], sub)
+        addl = schema.get("additionalProperties")
+        if isinstance(addl, Mapping):
+            for key, element in value.items():
+                if key not in props:
+                    _default_value(element, addl)
+    elif isinstance(value, list):
+        items = schema.get("items")
+        if isinstance(items, Mapping):
+            for element in value:
+                _default_value(element, items)
+
+
+def _type_ok(value: Any, type_name: str) -> bool:
+    if type_name == "object":
+        return isinstance(value, dict)
+    if type_name == "array":
+        return isinstance(value, list)
+    if type_name == "string":
+        return isinstance(value, str)
+    if type_name == "boolean":
+        return isinstance(value, bool)
+    if type_name == "integer":
+        return isinstance(value, int) and not isinstance(value, bool)
+    if type_name == "number":
+        return (
+            isinstance(value, (int, float)) and not isinstance(value, bool)
+        )
+    return True  # unknown type names admit (upstream rejects at CRD
+    # creation; a stored schema never carries one)
+
+
+def _fmt(value: Any) -> str:
+    return repr(value) if not isinstance(value, str) else f'"{value}"'
+
+
+def _validate_value(
+    value: Any,
+    schema: Mapping[str, Any],
+    path: str,
+    errors: list[str],
+) -> None:
+    if value is None:
+        if not schema.get("nullable"):
+            errors.append(f"{path}: Invalid value: null")
+        return
+    if schema.get("x-kubernetes-int-or-string"):
+        if not (
+            isinstance(value, str)
+            or (isinstance(value, int) and not isinstance(value, bool))
+        ):
+            errors.append(
+                f"{path}: Invalid value: {_fmt(value)}: "
+                "expected integer or string"
+            )
+            return
+    else:
+        type_name = schema.get("type", "")
+        if type_name and not _type_ok(value, type_name):
+            errors.append(
+                f"{path}: Invalid value: {_fmt(value)}: "
+                f"expected {type_name}"
+            )
+            return
+
+    if "enum" in schema and value not in schema["enum"]:
+        allowed = ", ".join(_fmt(v) for v in schema["enum"])
+        errors.append(
+            f"{path}: Unsupported value: {_fmt(value)}: "
+            f"supported values: {allowed}"
+        )
+
+    if isinstance(value, (int, float)) and not isinstance(value, bool):
+        minimum = schema.get("minimum")
+        if minimum is not None:
+            if schema.get("exclusiveMinimum"):
+                if value <= minimum:
+                    errors.append(
+                        f"{path}: Invalid value: {value}: must be greater "
+                        f"than {minimum}"
+                    )
+            elif value < minimum:
+                errors.append(
+                    f"{path}: Invalid value: {value}: must be greater than "
+                    f"or equal to {minimum}"
+                )
+        maximum = schema.get("maximum")
+        if maximum is not None:
+            if schema.get("exclusiveMaximum"):
+                if value >= maximum:
+                    errors.append(
+                        f"{path}: Invalid value: {value}: must be less "
+                        f"than {maximum}"
+                    )
+            elif value > maximum:
+                errors.append(
+                    f"{path}: Invalid value: {value}: must be less than "
+                    f"or equal to {maximum}"
+                )
+
+    if isinstance(value, str):
+        min_len = schema.get("minLength")
+        if min_len is not None and len(value) < min_len:
+            errors.append(
+                f"{path}: Invalid value: {_fmt(value)}: must be at least "
+                f"{min_len} chars long"
+            )
+        max_len = schema.get("maxLength")
+        if max_len is not None and len(value) > max_len:
+            errors.append(
+                f"{path}: Invalid value: {_fmt(value)}: may not be longer "
+                f"than {max_len}"
+            )
+        pattern = schema.get("pattern")
+        if pattern is not None and re.search(pattern, value) is None:
+            errors.append(
+                f"{path}: Invalid value: {_fmt(value)}: must match "
+                f"pattern {pattern}"
+            )
+
+    if isinstance(value, list):
+        min_items = schema.get("minItems")
+        if min_items is not None and len(value) < min_items:
+            errors.append(
+                f"{path}: Invalid value: must have at least {min_items} "
+                "items"
+            )
+        max_items = schema.get("maxItems")
+        if max_items is not None and len(value) > max_items:
+            errors.append(
+                f"{path}: Invalid value: must have at most {max_items} "
+                "items"
+            )
+        if schema.get("uniqueItems"):
+            seen: list[Any] = []
+            for element in value:
+                if element in seen:
+                    errors.append(
+                        f"{path}: Duplicate value: {_fmt(element)}"
+                    )
+                    break
+                seen.append(element)
+        items = schema.get("items")
+        if isinstance(items, Mapping):
+            for i, element in enumerate(value):
+                _validate_value(element, items, f"{path}[{i}]", errors)
+
+    if isinstance(value, dict) and not schema.get("x-kubernetes-int-or-string"):
+        props = schema.get("properties") or {}
+        for key in schema.get("required") or []:
+            if key not in value:
+                errors.append(f"{path}.{key}: Required value")
+        addl = schema.get("additionalProperties")
+        for key, element in value.items():
+            if key in props:
+                _validate_value(element, props[key], f"{path}.{key}", errors)
+            elif isinstance(addl, Mapping):
+                _validate_value(element, addl, f"{path}.{key}", errors)
+
+    # Value-validation combinators (structural schemas restrict these to
+    # validation-only subtrees; we evaluate them as predicates).
+    for sub in schema.get("allOf") or []:
+        _validate_value(value, sub, path, errors)
+    any_of = schema.get("anyOf")
+    if any_of:
+        if not any(_passes(value, sub, path) for sub in any_of):
+            errors.append(
+                f"{path}: Invalid value: {_fmt(value)}: must validate "
+                "against at least one schema (anyOf)"
+            )
+    one_of = schema.get("oneOf")
+    if one_of:
+        matches = sum(1 for sub in one_of if _passes(value, sub, path))
+        if matches != 1:
+            errors.append(
+                f"{path}: Invalid value: {_fmt(value)}: must validate "
+                f"against exactly one schema (oneOf), matched {matches}"
+            )
+    if "not" in schema and _passes(value, schema["not"], path):
+        errors.append(
+            f"{path}: Invalid value: {_fmt(value)}: must not validate "
+            "against the schema (not)"
+        )
+
+
+def _passes(value: Any, schema: Mapping[str, Any], path: str) -> bool:
+    probe: list[str] = []
+    _validate_value(value, schema, path, probe)
+    return not probe

@@ -319,6 +319,49 @@ class TestConcurrency:
             ctrl.stop(drain_timeout=10.0)
         assert in_flight["max"] == 1
 
+    def test_sync_timeout_unwinds_cleanly(self):
+        """A failed start (informer never syncs) must stop the informers
+        it started and allow a retry — no leaked watch threads, no
+        'already started' wedge."""
+
+        class StuckInformer:
+            kind = "Node"
+
+            def __init__(self):
+                self.started_calls = 0
+                self.stopped = False
+
+            @property
+            def started(self):
+                return self.started_calls > 0 and not self.stopped
+
+            def add_event_handler(self, handler):
+                pass
+
+            def start(self):
+                self.started_calls += 1
+                self.stopped = False
+                return self
+
+            def wait_for_sync(self, timeout=None):
+                return False  # never syncs
+
+            def stop(self):
+                self.stopped = True
+
+        inf = StuckInformer()
+        ctrl = Controller(lambda req: None, name="stuck")
+        ctrl.watch(inf)
+        with pytest.raises(TimeoutError):
+            ctrl.start(sync_timeout=0.05)
+        assert inf.stopped, "informer the controller started was leaked"
+        # Retry is possible (state was reset)...
+        with pytest.raises(TimeoutError):
+            ctrl.start(sync_timeout=0.05)
+        # ...and a later stop() is a harmless no-op on the unwound state.
+        ctrl.stop()
+        assert inf.started_calls == 2
+
     def test_start_twice_rejected(self):
         ctrl = Controller(lambda req: None)
         ctrl.start()

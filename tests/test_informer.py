@@ -114,6 +114,22 @@ class TestHandlers:
             server.cluster.create(make_node("post"))
             assert wait_until(lambda: ("ADDED", "post") in late)
 
+    def test_replay_not_gated_on_synced_flag(self, server, client):
+        """A watch expiry clears the synced flag while the store still
+        holds the last-known objects; a handler registered in that
+        window must still be caught up from the store (the re-list that
+        follows only dispatches diffs, which would lose the unchanged
+        objects for this handler)."""
+        server.cluster.create(make_node("holdover"))
+        with Informer(client, "Node") as inf:
+            assert inf.wait_for_sync(timeout=10)
+            inf._synced.clear()  # the expiry window
+            late = []
+            inf.add_event_handler(
+                lambda e, obj, old: late.append((e, obj.name))
+            )
+            assert ("ADDED", "holdover") in late
+
     def test_start_twice_rejected(self, server, client):
         inf = Informer(client, "Node").start()
         try:

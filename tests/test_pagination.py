@@ -214,7 +214,10 @@ class TestWirePagination:
             assert informer.wait_for_sync(timeout=30)
             assert len(informer.list()) == 11
             # The snapshot revision seeds the watch: a post-sync write
-            # arrives as exactly one event, nothing lost across pages.
+            # arrives as exactly one live event, nothing lost across
+            # pages. A post-sync handler first receives the store REPLAY
+            # (client-go AddEventHandler semantics) — all 11 paginated
+            # objects — then the live event.
             import queue
 
             events: queue.Queue = queue.Queue()
@@ -222,7 +225,17 @@ class TestWirePagination:
                 lambda t, obj, old: events.put((t, obj.name))
             )
             server.cluster.create(make_node("pg-after-sync"))
-            assert events.get(timeout=15) == ("ADDED", "pg-after-sync")
+            seen = []
+            while True:
+                event = events.get(timeout=15)
+                seen.append(event)
+                if event == ("ADDED", "pg-after-sync"):
+                    break
+            replayed = {name for t, name in seen[:-1]}
+            assert replayed == {f"pg-{i:03d}" for i in range(11)}
+            assert all(t == "ADDED" for t, _ in seen)
+            # Exactly one live event: nothing else follows.
+            assert events.empty()
         finally:
             informer.stop()
             client.close()

@@ -1,0 +1,527 @@
+"""Structural-schema admission — prune / default / validate for CRs.
+
+The reference's envtest boots a real apiserver with the NodeMaintenance
+CRD installed (upgrade_suit_test.go:87-89), so every CR write in its
+suite passes CRD schema admission. These tests pin the same pipeline in
+FakeCluster: a stored CRD activates pruning, defaulting, and 422
+validation for its kind; without a CRD nothing changes (the schema-less
+round-4 behavior). The checked-in manifests are exercised as the real
+contract surface.
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+import pytest
+import yaml
+
+from k8s_operator_libs_tpu.kube import (
+    FakeCluster,
+    InvalidError,
+    KubeObject,
+    NodeMaintenance,
+    register_resource,
+    wrap,
+)
+from k8s_operator_libs_tpu.kube.structural import (
+    StructuralSchema,
+    schema_for_crd_version,
+)
+
+MANIFESTS = pathlib.Path(__file__).resolve().parent.parent / "manifests/crds"
+
+
+def load_crd(name: str) -> KubeObject:
+    return wrap(yaml.safe_load((MANIFESTS / name).read_text()))
+
+
+# ---------------------------------------------------------------------------
+# Engine unit tests
+# ---------------------------------------------------------------------------
+
+
+class TestPrune:
+    def test_unknown_fields_dropped_known_kept(self):
+        s = StructuralSchema({
+            "type": "object",
+            "properties": {
+                "spec": {
+                    "type": "object",
+                    "properties": {"keep": {"type": "string"}},
+                }
+            },
+        })
+        data = {
+            "apiVersion": "g/v1", "kind": "T",
+            "metadata": {"name": "x", "anything": "stays"},
+            "spec": {"keep": "yes", "rogue": 1},
+            "toplevel_rogue": True,
+        }
+        s.prune(data)
+        assert data["spec"] == {"keep": "yes"}
+        assert "toplevel_rogue" not in data
+        # Server territory is never pruned.
+        assert data["metadata"]["anything"] == "stays"
+
+    def test_preserve_unknown_fields(self):
+        s = StructuralSchema({
+            "type": "object",
+            "properties": {
+                "spec": {
+                    "type": "object",
+                    "x-kubernetes-preserve-unknown-fields": True,
+                    "properties": {
+                        "typed": {
+                            "type": "object",
+                            "properties": {"a": {"type": "string"}},
+                        }
+                    },
+                }
+            },
+        })
+        data = {"spec": {"free": {"form": 1}, "typed": {"a": "x", "b": "y"}}}
+        s.prune(data)
+        # Unknown siblings survive, but SPECIFIED subtrees still prune.
+        assert data["spec"]["free"] == {"form": 1}
+        assert data["spec"]["typed"] == {"a": "x"}
+
+    def test_additional_properties_schema_and_true(self):
+        s = StructuralSchema({
+            "type": "object",
+            "properties": {
+                "labels": {
+                    "type": "object",
+                    "additionalProperties": {"type": "string"},
+                },
+                "free": {
+                    "type": "object",
+                    "additionalProperties": True,
+                },
+            },
+        })
+        data = {"labels": {"a": "1", "b": "2"}, "free": {"x": [1, 2]}}
+        s.prune(data)
+        assert data == {"labels": {"a": "1", "b": "2"},
+                        "free": {"x": [1, 2]}}
+
+    def test_array_items_pruned(self):
+        s = StructuralSchema({
+            "type": "object",
+            "properties": {
+                "list": {
+                    "type": "array",
+                    "items": {
+                        "type": "object",
+                        "properties": {"name": {"type": "string"}},
+                    },
+                }
+            },
+        })
+        data = {"list": [{"name": "a", "junk": 1}, {"name": "b"}]}
+        s.prune(data)
+        assert data == {"list": [{"name": "a"}, {"name": "b"}]}
+
+
+class TestDefaults:
+    def test_nested_defaults_into_existing_objects_only(self):
+        s = StructuralSchema({
+            "type": "object",
+            "properties": {
+                "spec": {
+                    "type": "object",
+                    "properties": {
+                        "cordon": {"type": "boolean", "default": True},
+                        "drain": {
+                            "type": "object",
+                            "properties": {
+                                "force": {"type": "boolean",
+                                          "default": False},
+                            },
+                        },
+                    },
+                }
+            },
+        })
+        data = {"spec": {}}
+        s.apply_defaults(data)
+        # Scalar default lands; a default never creates the absent
+        # intermediate object (upstream semantics).
+        assert data["spec"] == {"cordon": True}
+        data2 = {"spec": {"drain": {}}}
+        s.apply_defaults(data2)
+        assert data2["spec"]["drain"] == {"force": False}
+
+    def test_array_item_defaults(self):
+        s = StructuralSchema({
+            "type": "object",
+            "properties": {
+                "conds": {
+                    "type": "array",
+                    "items": {
+                        "type": "object",
+                        "properties": {
+                            "status": {"type": "string",
+                                       "default": "Unknown"},
+                        },
+                    },
+                }
+            },
+        })
+        data = {"conds": [{}, {"status": "True"}]}
+        s.apply_defaults(data)
+        assert data["conds"] == [{"status": "Unknown"}, {"status": "True"}]
+
+    def test_default_is_deep_copied(self):
+        s = StructuralSchema({
+            "type": "object",
+            "properties": {
+                "a": {"type": "object", "default": {"k": []}},
+            },
+        })
+        one, two = {}, {}
+        s.apply_defaults(one)
+        s.apply_defaults(two)
+        one["a"]["k"].append("x")
+        assert two["a"]["k"] == []
+
+
+class TestValidate:
+    def s(self, **props):
+        return StructuralSchema({"type": "object", "properties": props})
+
+    def test_type_mismatches(self):
+        s = self.s(
+            spec={"type": "object", "properties": {
+                "i": {"type": "integer"},
+                "n": {"type": "number"},
+                "s": {"type": "string"},
+                "b": {"type": "boolean"},
+                "a": {"type": "array"},
+                "o": {"type": "object"},
+            }},
+        )
+        bad = {"spec": {"i": "1", "n": True, "s": 3, "b": "yes",
+                        "a": {}, "o": []}}
+        errors = s.validate(bad)
+        assert len(errors) == 6
+        assert any("spec.i" in e and "expected integer" in e for e in errors)
+        # booleans are NOT integers/numbers (JSON semantics, not Python's)
+        assert any("spec.n" in e for e in errors)
+        ok = {"spec": {"i": 1, "n": 1.5, "s": "x", "b": False,
+                       "a": [], "o": {}}}
+        assert s.validate(ok) == []
+
+    def test_int_or_string(self):
+        s = self.s(m={"x-kubernetes-int-or-string": True})
+        assert s.validate({"m": 3}) == []
+        assert s.validate({"m": "25%"}) == []
+        assert s.validate({"m": True}) != []
+        assert s.validate({"m": {"IntVal": 3}}) != []
+
+    def test_nullable_enum_and_bounds(self):
+        s = self.s(
+            e={"type": "string", "enum": ["node", "slice"]},
+            n={"type": "string", "nullable": True},
+            lo={"type": "integer", "minimum": 0},
+            xlo={"type": "integer", "minimum": 0, "exclusiveMinimum": True},
+            hi={"type": "integer", "maximum": 10},
+            sl={"type": "string", "minLength": 2, "maxLength": 3},
+            pat={"type": "string", "pattern": "^v[0-9]+$"},
+        )
+        assert s.validate({"e": "slice", "n": None, "lo": 0, "xlo": 1,
+                           "hi": 10, "sl": "ab", "pat": "v5"}) == []
+        errors = s.validate({"e": "rack", "lo": -1, "xlo": 0, "hi": 11,
+                             "sl": "a", "pat": "x5"})
+        assert len(errors) == 6
+        assert any("Unsupported value" in e for e in errors)
+        # null where not nullable
+        assert s.validate({"e": None}) != []
+
+    def test_required_and_array_items(self):
+        s = self.s(
+            spec={
+                "type": "object",
+                "required": ["name"],
+                "properties": {
+                    "name": {"type": "string"},
+                    "conds": {
+                        "type": "array",
+                        "minItems": 1,
+                        "maxItems": 2,
+                        "items": {
+                            "type": "object",
+                            "required": ["type"],
+                            "properties": {"type": {"type": "string"}},
+                        },
+                    },
+                },
+            },
+        )
+        errors = s.validate({"spec": {"conds": [{"huh": 1}]}})
+        assert any(e.startswith("spec.name: Required value")
+                   for e in errors)
+        assert any("spec.conds[0].type: Required value" in e
+                   for e in errors)
+        assert s.validate({"spec": {"name": "x", "conds": []}}) != []
+        assert s.validate(
+            {"spec": {"name": "x",
+                      "conds": [{"type": "a"}, {"type": "b"},
+                                {"type": "c"}]}}
+        ) != []
+
+    def test_unique_items(self):
+        s = self.s(tags={"type": "array", "uniqueItems": True,
+                         "items": {"type": "string"}})
+        assert s.validate({"tags": ["a", "b"]}) == []
+        assert s.validate({"tags": ["a", "a"]}) != []
+
+    def test_combinators(self):
+        s = self.s(
+            v={"anyOf": [{"type": "integer"}, {"type": "string"}]},
+            w={"oneOf": [{"type": "integer", "minimum": 5},
+                         {"type": "integer", "maximum": 2}]},
+            x={"type": "string", "not": {"enum": ["forbidden"]}},
+        )
+        assert s.validate({"v": 1}) == []
+        assert s.validate({"v": "ok"}) == []
+        assert s.validate({"v": []}) != []
+        assert s.validate({"w": 7}) == []
+        assert s.validate({"w": 3}) != []  # matches neither
+        assert s.validate({"x": "fine"}) == []
+        assert s.validate({"x": "forbidden"}) != []
+
+    def test_top_level_required(self):
+        s = StructuralSchema({"type": "object", "required": ["spec"]})
+        assert s.validate({}) == ["spec: Required value"]
+
+
+# ---------------------------------------------------------------------------
+# FakeCluster activation rule + the checked-in CRD contracts
+# ---------------------------------------------------------------------------
+
+
+def nm(name="nm-1", node="node-1", requestor="tpu.operator"):
+    obj = NodeMaintenance.new(name, namespace="default")
+    obj.spec["nodeName"] = node
+    obj.spec["requestorID"] = requestor
+    return obj
+
+
+class TestFakeClusterAdmission:
+    def test_no_crd_no_admission(self):
+        cluster = FakeCluster()
+        obj = nm()
+        obj.spec["rogueField"] = {"kept": True}  # schema-less: anything goes
+        created = cluster.create(obj)
+        assert created.spec["rogueField"] == {"kept": True}
+        assert "cordon" not in created.spec  # and no defaulting either
+
+    def test_crd_activates_prune_default_validate(self):
+        cluster = FakeCluster()
+        cluster.create(load_crd("nodemaintenances.yaml"))
+        obj = nm()
+        obj.spec["rogueField"] = True
+        created = cluster.create(obj)
+        assert "rogueField" not in created.spec  # pruned
+        assert created.spec["cordon"] is True  # defaulted
+        bad = NodeMaintenance.new("bad", namespace="default")
+        bad.raw["spec"] = {}  # spec present but empty: nested required fires
+        with pytest.raises(InvalidError) as exc:
+            cluster.create(bad)
+        assert "spec.nodeName: Required value" in str(exc.value)
+        assert "spec.requestorID: Required value" in str(exc.value)
+        # Like the real apiserver (and the upstream fixture, which has no
+        # root-level required), a spec-LESS CR is admitted.
+        cluster.create(NodeMaintenance.new("specless", namespace="default"))
+
+    def test_invalid_patch_is_atomic(self):
+        cluster = FakeCluster()
+        cluster.create(load_crd("nodemaintenances.yaml"))
+        cluster.create(nm())
+        before = cluster.get("NodeMaintenance", "nm-1", "default")
+        with pytest.raises(InvalidError):
+            cluster.patch(
+                "NodeMaintenance", "nm-1", "default",
+                patch={"spec": {"drainSpec": {"timeoutSeconds": -5}}},
+            )
+        after = cluster.get("NodeMaintenance", "nm-1", "default")
+        assert after.raw == before.raw  # content AND resourceVersion
+        # A valid patch then lands normally (and its unknowns prune).
+        updated = cluster.patch(
+            "NodeMaintenance", "nm-1", "default",
+            patch={"spec": {"drainSpec": {"timeoutSeconds": 30,
+                                          "bogus": "x"}}},
+        )
+        assert updated.spec["drainSpec"] == {"timeoutSeconds": 30}
+
+    def test_invalid_replace_keeps_stored_object(self):
+        cluster = FakeCluster()
+        cluster.create(load_crd("nodemaintenances.yaml"))
+        cluster.create(nm())
+        live = cluster.get("NodeMaintenance", "nm-1", "default")
+        live.spec["nodeName"] = 42  # wrong type
+        with pytest.raises(InvalidError):
+            cluster.update(live)
+        assert cluster.get(
+            "NodeMaintenance", "nm-1", "default"
+        ).spec["nodeName"] == "node-1"
+
+    def test_status_subresource_validated_and_atomic(self):
+        cluster = FakeCluster()
+        cluster.create(load_crd("nodemaintenances.yaml"))
+        cluster.create(nm())
+        live = cluster.get("NodeMaintenance", "nm-1", "default")
+        live.status["conditions"] = [{"type": "Ready"}]  # missing status
+        with pytest.raises(InvalidError) as exc:
+            cluster.update_status(live)
+        assert "status.conditions[0].status: Required value" in str(
+            exc.value
+        )
+        after = cluster.get("NodeMaintenance", "nm-1", "default")
+        assert after.status.get("conditions") is None
+        live = cluster.get("NodeMaintenance", "nm-1", "default")
+        live.status["conditions"] = [
+            {"type": "Ready", "status": "True",
+             "reason": "Ready", "message": ""}
+        ]
+        cluster.update_status(live)
+
+    def test_requestor_flow_shape_admitted(self):
+        """The exact CR the requestor strategy writes passes the
+        checked-in schema — drift between requestor.py and the CRD
+        contract now fails loudly."""
+        cluster = FakeCluster()
+        cluster.create(load_crd("nodemaintenances.yaml"))
+        obj = nm()
+        obj.spec["additionalRequestors"] = ["other.operator"]
+        obj.spec["waitForPodCompletion"] = {"podSelector": "app=x",
+                                            "timeoutSeconds": 300}
+        obj.spec["drainSpec"] = {
+            "force": True, "podSelector": "", "timeoutSeconds": 300,
+            "deleteEmptyDir": True,
+            "podEvictionFilters": [{"byResourceNameRegex": "tpu.*"}],
+        }
+        created = cluster.create(obj)
+        assert created.spec["drainSpec"]["podEvictionFilters"] == [
+            {"byResourceNameRegex": "tpu.*"}
+        ]
+
+    def test_tpu_policy_defaults_cascade(self):
+        register_resource(
+            "TPUUpgradePolicy", "tpu-operator.dev/v1alpha1",
+            "tpuupgradepolicies", namespaced=False,
+        )
+        cluster = FakeCluster()
+        cluster.create(load_crd("tpuupgradepolicies.yaml"))
+        policy = KubeObject({
+            "apiVersion": "tpu-operator.dev/v1alpha1",
+            "kind": "TPUUpgradePolicy",
+            "metadata": {"name": "default"},
+            "spec": {"drain": {}},
+        })
+        created = cluster.create(policy)
+        spec = created.spec
+        assert spec["maxParallelUpgrades"] == 1
+        assert spec["maxUnavailable"] == "25%"
+        assert spec["unavailabilityUnit"] == "slice"
+        assert spec["drain"]["timeoutSeconds"] == 300
+        with pytest.raises(InvalidError) as exc:
+            cluster.create(KubeObject({
+                "apiVersion": "tpu-operator.dev/v1alpha1",
+                "kind": "TPUUpgradePolicy",
+                "metadata": {"name": "bad"},
+                "spec": {"unavailabilityUnit": "rack"},
+            }))
+        assert "Unsupported value" in str(exc.value)
+
+    def test_int_or_string_max_unavailable(self):
+        register_resource(
+            "TPUUpgradePolicy", "tpu-operator.dev/v1alpha1",
+            "tpuupgradepolicies", namespaced=False,
+        )
+        cluster = FakeCluster()
+        cluster.create(load_crd("tpuupgradepolicies.yaml"))
+        for good in (2, "50%"):
+            cluster.create(KubeObject({
+                "apiVersion": "tpu-operator.dev/v1alpha1",
+                "kind": "TPUUpgradePolicy",
+                "metadata": {"name": f"p-{good}".replace("%", "pct")},
+                "spec": {"maxUnavailable": good},
+            }))
+        with pytest.raises(InvalidError):
+            cluster.create(KubeObject({
+                "apiVersion": "tpu-operator.dev/v1alpha1",
+                "kind": "TPUUpgradePolicy",
+                "metadata": {"name": "bad-iors"},
+                "spec": {"maxUnavailable": True},
+            }))
+
+    def test_crd_delete_deactivates_admission(self):
+        cluster = FakeCluster()
+        cluster.create(load_crd("nodemaintenances.yaml"))
+        bad = NodeMaintenance.new("bad", namespace="default")
+        bad.raw["spec"] = {}
+        with pytest.raises(InvalidError):
+            cluster.create(bad)
+        cluster.delete(
+            "CustomResourceDefinition",
+            "nodemaintenances.maintenance.nvidia.com",
+        )
+        cluster.create(bad)
+
+    def test_irregular_plural_without_registration_still_admits(self):
+        """A CRD whose plural isn't naive kind.lower()+'s' (and whose
+        kind was never register_resource'd) must still activate
+        admission — the stored CRDs are the authoritative group/kind
+        mapping."""
+        cluster = FakeCluster()
+        crd = load_crd("tpuupgradepolicies.yaml").deep_copy()
+        crd.raw["metadata"]["name"] = "libtpupolicies.irregular.example.com"
+        crd.raw["spec"]["group"] = "irregular.example.com"
+        crd.raw["spec"]["names"] = {
+            "kind": "LibtpuPolicy",  # naive plural would be libtpupolicys
+            "plural": "libtpupolicies",
+        }
+        cluster.create(crd)
+        with pytest.raises(InvalidError):
+            cluster.create(KubeObject({
+                "apiVersion": "irregular.example.com/v1alpha1",
+                "kind": "LibtpuPolicy",
+                "metadata": {"name": "bad", "namespace": "default"},
+                "spec": {"unavailabilityUnit": "rack"},
+            }))
+        good = cluster.create(KubeObject({
+            "apiVersion": "irregular.example.com/v1alpha1",
+            "kind": "LibtpuPolicy",
+            "metadata": {"name": "good", "namespace": "default"},
+            "spec": {},
+        }))
+        assert good.spec["maxUnavailable"] == "25%"  # defaults active
+
+    def test_schema_helper_unknown_version(self):
+        crd = load_crd("nodemaintenances.yaml")
+        assert schema_for_crd_version(crd.raw, "v1alpha1") is not None
+        assert schema_for_crd_version(crd.raw, "v9") is None
+
+
+class TestOverHttp:
+    def test_invalid_cr_answers_422_on_the_wire(self):
+        from k8s_operator_libs_tpu.kube import (
+            LocalApiServer,
+            RestClient,
+            RestConfig,
+        )
+
+        server = LocalApiServer().start()
+        try:
+            client = RestClient(RestConfig(server=server.url))
+            client.create(load_crd("nodemaintenances.yaml"))
+            created = client.create(nm())
+            assert created.spec["cordon"] is True  # defaulted on the wire
+            bad = NodeMaintenance.new("bad", namespace="default")
+            bad.raw["spec"] = {}
+            with pytest.raises(InvalidError):
+                client.create(bad)
+        finally:
+            server.stop()
