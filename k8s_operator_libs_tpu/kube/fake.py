@@ -693,6 +693,11 @@ class FakeCluster(Client):
         # Established condition (immediately, or after a delay to exercise
         # wait-for-established logic, reference: pkg/crdutil/crdutil.go:275-319).
         self._auto_establish_crds = auto_establish_crds
+        # Sticky flag: set on the first CRD entering the store, never
+        # cleared (deletes are rare; a stale True only costs the scan).
+        # Lets the admission fallback skip an O(store) scan per custom
+        # write on the common schema-less cluster.
+        self._crds_ever_stored = False
         self._crd_establish_delay = crd_establish_delay
         # The real apiserver's Established-but-undiscoverable window: a
         # CRD's condition flips before its served versions appear in the
@@ -941,8 +946,36 @@ class FakeCluster(Client):
         self._last_rv = next(self._rv)
         data.setdefault("metadata", {})["resourceVersion"] = str(self._last_rv)
 
+    @staticmethod
+    def _spec_view(data: Mapping[str, Any]) -> dict[str, Any]:
+        """Everything outside metadata/status — what generation tracks."""
+        return {
+            k: v for k, v in data.items() if k not in ("metadata", "status")
+        }
+
+    def _sync_generation(
+        self, data: dict[str, Any], old: Optional[Mapping[str, Any]]
+    ) -> None:
+        """metadata.generation is server-owned: 1 on create, +1 whenever
+        the desired state (anything outside metadata/status) changes,
+        never on status-only writes. One uniform rule for every kind —
+        the modern apiserver behavior (CRs with a status subresource,
+        apps types); legacy core types that skip generation entirely are
+        deliberately not special-cased (PARITY)."""
+        meta = data.setdefault("metadata", {})
+        if old is None:
+            meta["generation"] = 1
+            return
+        previous = (old.get("metadata") or {}).get("generation", 1)
+        if self._spec_view(data) != self._spec_view(old):
+            meta["generation"] = previous + 1
+        else:
+            meta["generation"] = previous
+
     # -- structural-schema admission (custom resources) --------------------
-    def _admit_custom_locked(self, data: dict[str, Any]) -> None:
+    def _admit_custom_locked(
+        self, data: dict[str, Any], status_only: bool = False
+    ) -> None:
         """The apiserver's CR admission: when a stored CRD carries a
         structural schema for this object's group/kind/version, prune
         unknown fields, apply defaults, and validate — 422 on violation.
@@ -963,6 +996,8 @@ class FakeCluster(Client):
             crd = self._store.get(
                 ("CustomResourceDefinition", "", f"{plural}.{group}")
             )
+        if crd is None and not self._crds_ever_stored:
+            return  # schema-less cluster: skip the store scan entirely
         if crd is None:
             # Unregistered (or irregularly-pluralized) kinds: the stored
             # CRDs themselves are the authoritative group/kind mapping.
@@ -981,6 +1016,11 @@ class FakeCluster(Client):
         if schema is None:
             return
         errors = schema.admit(data)
+        if status_only:
+            # ValidateStatusUpdate shape: a status write is judged on
+            # its status only — a spec that predates a tightened CRD
+            # must not wedge the status-writing controller.
+            errors = [e for e in errors if e.startswith("status")]
         if errors:
             name = (data.get("metadata") or {}).get("name", "")
             raise InvalidError(
@@ -988,14 +1028,17 @@ class FakeCluster(Client):
             )
 
     def _admit_or_restore_locked(
-        self, data: dict[str, Any], old: dict[str, Any]
+        self,
+        data: dict[str, Any],
+        old: dict[str, Any],
+        status_only: bool = False,
     ) -> None:
         """Admission for write paths that mutate the STORED dict in
         place (patch, status replace, apply): a rejected write restores
         the pre-write content before re-raising, so 422 leaves no
         trace — the same atomicity the json-patch engine guarantees."""
         try:
-            self._admit_custom_locked(data)
+            self._admit_custom_locked(data, status_only=status_only)
         except InvalidError:
             data.clear()
             data.update(copy.deepcopy(old))
@@ -1223,10 +1266,12 @@ class FakeCluster(Client):
                 # sees honest conflicts. Creates that already carry
                 # managedFields (create-through-apply) keep them.
                 reassign_on_write({}, data, field_manager, rfc3339_now())
+            self._sync_generation(data, None)
             self._bump(data)
             self._store[key] = data
             self._emit(_WATCH_ADDED, data)
             if kind == "CustomResourceDefinition":
+                self._crds_ever_stored = True
                 # A re-created CRD must not inherit a predecessor's
                 # discoverability (its served versions may differ).
                 self._discoverable.pop(obj.name, None)
@@ -1361,7 +1406,17 @@ class FakeCluster(Client):
             if status_only:
                 current["status"] = copy.deepcopy(obj.raw.get("status") or {})
                 data = current
-                self._admit_or_restore_locked(data, old)
+                self._admit_or_restore_locked(data, old, status_only=True)
+                # statusStrategy semantics: desired state cannot change
+                # through the status endpoint — whatever admission
+                # pruned/defaulted outside status is restored from the
+                # stored object, so generation never moves here.
+                for k in [k for k in data
+                          if k not in ("metadata", "status")]:
+                    del data[k]
+                for k, v in old.items():
+                    if k not in ("metadata", "status"):
+                        data[k] = copy.deepcopy(v)
             else:
                 data = copy.deepcopy(obj.raw)
                 # Immutable/server-owned fields survive a replace.
@@ -1394,6 +1449,7 @@ class FakeCluster(Client):
                 rfc3339_now(),
                 subresource="status" if status_only else "",
             )
+            self._sync_generation(data, old)
             self._bump(data)
             if not self._write_becomes_delete(data):
                 self._emit(_WATCH_MODIFIED, data, old=old)
@@ -1479,6 +1535,7 @@ class FakeCluster(Client):
             # Ownership follows the write (managedFields is server-owned;
             # a patch cannot rewrite it directly).
             reassign_on_write(old, current, field_manager, rfc3339_now())
+            self._sync_generation(current, old)
             self._bump(current)
             if not self._write_becomes_delete(current):
                 self._emit(_WATCH_MODIFIED, current, old=old)
@@ -1578,6 +1635,7 @@ class FakeCluster(Client):
             else:
                 cur_meta.pop("namespace", None)
             self._admit_or_restore_locked(current, old)
+            self._sync_generation(current, old)
             self._bump(current)
             if not self._write_becomes_delete(current):
                 self._emit(_WATCH_MODIFIED, current, old=old)

@@ -115,21 +115,35 @@ class Informer:
 
     @property
     def started(self) -> bool:
-        """True once start() has been called (whether or not the initial
-        sync has completed) — the public ownership signal for wrappers
-        like ``Controller`` deciding whose lifecycle this is."""
-        return self._thread is not None
+        """True while the informer is RUNNING — the public signal for
+        wrappers like ``Controller`` deciding whose lifecycle this is.
+        A stopped informer reads False and may be start()ed again."""
+        return self._thread is not None and self._thread.is_alive()
 
     def start(self) -> "Informer":
-        if self._thread is not None:
+        """Start (or restart after stop()). Restart takes fresh control
+        state — a previous run that failed to join within stop()'s
+        timeout keeps its own stop event and cannot be resurrected —
+        and forces a re-list, which repairs the kept store with
+        synthetic diff events."""
+        if self.started:
             raise RuntimeError(f"informer for {self.kind} already started")
+        self._stop = threading.Event()
+        self._synced = threading.Event()
+        self._resource_version = None
+        self._watch_handle = None
+        # The run loops capture THIS event as a local: a wedged previous
+        # thread (one that outlived stop()'s join timeout) still polls
+        # its own event and can never be re-armed by the fresh one.
+        stop = self._stop
         self._thread = threading.Thread(
-            target=self._run, name=f"informer-{self.kind}", daemon=True
+            target=self._run, args=(stop,),
+            name=f"informer-{self.kind}", daemon=True,
         )
         self._thread.start()
         if self.resync_period_s > 0:
             self._resync_thread = threading.Thread(
-                target=self._resync_loop,
+                target=self._resync_loop, args=(stop,),
                 name=f"informer-{self.kind}-resync",
                 daemon=True,
             )
@@ -147,14 +161,14 @@ class Informer:
         if resync_thread is not None:
             resync_thread.join(timeout=10)
 
-    def _resync_loop(self) -> None:
-        while not self._stop.wait(self.resync_period_s):
+    def _resync_loop(self, stop: threading.Event) -> None:
+        while not stop.wait(self.resync_period_s):
             if not self._synced.is_set():
                 continue  # nothing meaningful to re-deliver mid-relist
             with self._lock:
                 keys = list(self._store)
             for key in keys:
-                if self._stop.is_set():
+                if stop.is_set():
                     return
                 # Under the dispatch lock, re-check the object is still
                 # cached: the watch thread removes from the store BEFORE
@@ -337,8 +351,8 @@ class Informer:
         self._resource_version = str(max(rvs)) if rvs else None
         self._synced.set()
 
-    def _run(self) -> None:
-        while not self._stop.is_set():
+    def _run(self, stop: threading.Event) -> None:
+        while not stop.is_set():
             try:
                 if not self._synced.is_set() or self._resource_version is None:
                     self._relist()
@@ -356,11 +370,17 @@ class Informer:
                 from .rest import WatchHandle
 
                 self._watch_handle = WatchHandle()
+                # stop() may have run while we were re-listing, when
+                # there was no handle to cancel — re-check after
+                # publishing the handle so that window cannot park us
+                # in a full watch timeout.
+                if stop.is_set():
+                    return
                 watch_iter = self._client.watch(
                     self.kind, handle=self._watch_handle, **watch_kwargs
                 )
                 for event_type, obj in watch_iter:
-                    if self._stop.is_set():
+                    if stop.is_set():
                         return
                     raw = obj.raw
                     if event_type == "BOOKMARK":
@@ -401,9 +421,9 @@ class Informer:
                 # silently degraded into a re-list hot loop.
                 raise
             except Exception as e:  # noqa: BLE001 - stream died; back off
-                if self._stop.is_set():
+                if stop.is_set():
                     return
                 log.warning("%s watch failed (%s); re-listing", self.kind, e)
                 self._resource_version = None
                 self._synced.clear()
-                self._stop.wait(1.0)
+                stop.wait(1.0)

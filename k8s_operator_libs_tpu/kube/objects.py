@@ -73,6 +73,13 @@ class KubeObject:
         return self.metadata.get("resourceVersion", "")
 
     @property
+    def generation(self) -> int:
+        """Server-owned desired-state revision: 1 on create, bumped on
+        spec-changing writes, untouched by status writes — what a
+        controller compares against status.observedGeneration."""
+        return self.metadata.get("generation", 0)
+
+    @property
     def labels(self) -> dict[str, str]:
         return _ensure(self.metadata, "labels")
 

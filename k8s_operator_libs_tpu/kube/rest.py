@@ -20,6 +20,7 @@ import base64
 import http.client
 import json
 import os
+import socket
 import ssl
 import tempfile
 import threading
@@ -277,10 +278,32 @@ class WatchHandle:
 
     def __init__(self) -> None:
         self._conn: Optional[http.client.HTTPConnection] = None
+        self._sock: Optional[socket.socket] = None
         self.cancelled = False
+
+    def _attach_response(self, resp) -> None:
+        """Capture the stream's raw socket. On a Connection:-close
+        response http.client nulls conn.sock (ownership moves to the
+        response), so the socket must be dug out of resp.fp."""
+        sock = getattr(self._conn, "sock", None)
+        if sock is None:
+            fp = getattr(resp, "fp", None)
+            raw = getattr(fp, "raw", fp)
+            sock = getattr(raw, "_sock", None)
+        self._sock = sock
 
     def cancel(self) -> None:
         self.cancelled = True
+        # shutdown() BEFORE close(): closing an fd from another thread
+        # does not unblock a recv() already parked on it — a quiet watch
+        # (no events, no bookmarks) would otherwise pin the informer
+        # thread until the window times out.
+        sock = self._sock or getattr(self._conn, "sock", None)
+        if sock is not None:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
         conn = self._conn
         if conn is not None:
             try:
@@ -587,6 +610,15 @@ class RestClient(Client):
         try:
             conn.request("GET", url, headers=headers)
             resp = conn.getresponse()
+            if handle is not None:
+                # On a Connection:-close stream http.client hands the
+                # socket to the RESPONSE and nulls conn.sock — capture
+                # the live socket so cancel() can shutdown() it (the
+                # only call that unblocks a parked recv).
+                handle._attach_response(resp)
+                if handle.cancelled:
+                    resp.close()
+                    return
             if resp.status >= 400:
                 raise self._api_error(resp.status, resp.read())
             while True:

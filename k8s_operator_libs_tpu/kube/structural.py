@@ -67,46 +67,48 @@ class StructuralSchema:
         self.apply_defaults(data)
         return self.validate(data)
 
+    # The root is an ordinary object node EXCEPT that
+    # apiVersion/kind/metadata are server territory: they are set aside
+    # before each walk (so the schema can neither prune, default into,
+    # nor validate them) and restored after. Everything else — including
+    # root-level additionalProperties, enum, and combinators — goes
+    # through the same node walkers as every nested level.
+
     # -- pruning -----------------------------------------------------------
     def prune(self, data: dict[str, Any]) -> None:
         """Drop fields the schema does not specify (the apiserver's
         field pruning). Root server-owned keys are untouched."""
-        props = self.root.get("properties") or {}
-        preserve = self.root.get("x-kubernetes-preserve-unknown-fields")
-        for key in list(data):
-            if key in _ROOT_SERVER_KEYS:
-                continue
-            if key in props:
-                _prune_value(data[key], props[key])
-            elif not preserve:
-                del data[key]
+        aside = {
+            k: data.pop(k) for k in list(data) if k in _ROOT_SERVER_KEYS
+        }
+        try:
+            _prune_value(data, self.root)
+        finally:
+            data.update(aside)
 
     # -- defaulting --------------------------------------------------------
     def apply_defaults(self, data: dict[str, Any]) -> None:
-        props = self.root.get("properties") or {}
-        for key, sub in props.items():
-            if key in _ROOT_SERVER_KEYS:
-                continue
-            if key not in data and "default" in sub:
-                data[key] = copy.deepcopy(sub["default"])
-            if key in data:
-                _default_value(data[key], sub)
+        aside = {
+            k: data.pop(k) for k in list(data) if k in _ROOT_SERVER_KEYS
+        }
+        try:
+            _default_value(data, self.root)
+        finally:
+            data.update(aside)
 
     # -- validation --------------------------------------------------------
     def validate(self, data: Mapping[str, Any]) -> list[str]:
+        view = {
+            k: v for k, v in data.items() if k not in _ROOT_SERVER_KEYS
+        }
         errors: list[str] = []
-        props = self.root.get("properties") or {}
-        for key in self.root.get("required") or []:
-            if key in _ROOT_SERVER_KEYS:
-                continue
-            if key not in data:
-                errors.append(f"{key}: Required value")
-        for key, value in data.items():
-            if key in _ROOT_SERVER_KEYS:
-                continue
-            if key in props:
-                _validate_value(value, props[key], key, errors)
-        return errors
+        _validate_value(view, self.root, "", errors)
+        # A schema demanding server keys (required: [metadata]) is not
+        # the CR author's problem — those live outside the schema.
+        return [
+            e for e in errors
+            if not e.startswith(tuple(_ROOT_SERVER_KEYS))
+        ]
 
 
 # ---------------------------------------------------------------------------
@@ -181,15 +183,20 @@ def _fmt(value: Any) -> str:
     return repr(value) if not isinstance(value, str) else f'"{value}"'
 
 
+def _child(path: str, key: str) -> str:
+    return f"{path}.{key}" if path else key
+
+
 def _validate_value(
     value: Any,
     schema: Mapping[str, Any],
     path: str,
     errors: list[str],
 ) -> None:
+    label = path or "<root>"
     if value is None:
         if not schema.get("nullable"):
-            errors.append(f"{path}: Invalid value: null")
+            errors.append(f"{label}: Invalid value: null")
         return
     if schema.get("x-kubernetes-int-or-string"):
         if not (
@@ -197,7 +204,7 @@ def _validate_value(
             or (isinstance(value, int) and not isinstance(value, bool))
         ):
             errors.append(
-                f"{path}: Invalid value: {_fmt(value)}: "
+                f"{label}: Invalid value: {_fmt(value)}: "
                 "expected integer or string"
             )
             return
@@ -205,7 +212,7 @@ def _validate_value(
         type_name = schema.get("type", "")
         if type_name and not _type_ok(value, type_name):
             errors.append(
-                f"{path}: Invalid value: {_fmt(value)}: "
+                f"{label}: Invalid value: {_fmt(value)}: "
                 f"expected {type_name}"
             )
             return
@@ -213,7 +220,7 @@ def _validate_value(
     if "enum" in schema and value not in schema["enum"]:
         allowed = ", ".join(_fmt(v) for v in schema["enum"])
         errors.append(
-            f"{path}: Unsupported value: {_fmt(value)}: "
+            f"{label}: Unsupported value: {_fmt(value)}: "
             f"supported values: {allowed}"
         )
 
@@ -223,12 +230,12 @@ def _validate_value(
             if schema.get("exclusiveMinimum"):
                 if value <= minimum:
                     errors.append(
-                        f"{path}: Invalid value: {value}: must be greater "
+                        f"{label}: Invalid value: {value}: must be greater "
                         f"than {minimum}"
                     )
             elif value < minimum:
                 errors.append(
-                    f"{path}: Invalid value: {value}: must be greater than "
+                    f"{label}: Invalid value: {value}: must be greater than "
                     f"or equal to {minimum}"
                 )
         maximum = schema.get("maximum")
@@ -236,12 +243,12 @@ def _validate_value(
             if schema.get("exclusiveMaximum"):
                 if value >= maximum:
                     errors.append(
-                        f"{path}: Invalid value: {value}: must be less "
+                        f"{label}: Invalid value: {value}: must be less "
                         f"than {maximum}"
                     )
             elif value > maximum:
                 errors.append(
-                    f"{path}: Invalid value: {value}: must be less than "
+                    f"{label}: Invalid value: {value}: must be less than "
                     f"or equal to {maximum}"
                 )
 
@@ -249,19 +256,19 @@ def _validate_value(
         min_len = schema.get("minLength")
         if min_len is not None and len(value) < min_len:
             errors.append(
-                f"{path}: Invalid value: {_fmt(value)}: must be at least "
+                f"{label}: Invalid value: {_fmt(value)}: must be at least "
                 f"{min_len} chars long"
             )
         max_len = schema.get("maxLength")
         if max_len is not None and len(value) > max_len:
             errors.append(
-                f"{path}: Invalid value: {_fmt(value)}: may not be longer "
+                f"{label}: Invalid value: {_fmt(value)}: may not be longer "
                 f"than {max_len}"
             )
         pattern = schema.get("pattern")
         if pattern is not None and re.search(pattern, value) is None:
             errors.append(
-                f"{path}: Invalid value: {_fmt(value)}: must match "
+                f"{label}: Invalid value: {_fmt(value)}: must match "
                 f"pattern {pattern}"
             )
 
@@ -269,13 +276,13 @@ def _validate_value(
         min_items = schema.get("minItems")
         if min_items is not None and len(value) < min_items:
             errors.append(
-                f"{path}: Invalid value: must have at least {min_items} "
+                f"{label}: Invalid value: must have at least {min_items} "
                 "items"
             )
         max_items = schema.get("maxItems")
         if max_items is not None and len(value) > max_items:
             errors.append(
-                f"{path}: Invalid value: must have at most {max_items} "
+                f"{label}: Invalid value: must have at most {max_items} "
                 "items"
             )
         if schema.get("uniqueItems"):
@@ -283,7 +290,7 @@ def _validate_value(
             for element in value:
                 if element in seen:
                     errors.append(
-                        f"{path}: Duplicate value: {_fmt(element)}"
+                        f"{label}: Duplicate value: {_fmt(element)}"
                     )
                     break
                 seen.append(element)
@@ -296,13 +303,13 @@ def _validate_value(
         props = schema.get("properties") or {}
         for key in schema.get("required") or []:
             if key not in value:
-                errors.append(f"{path}.{key}: Required value")
+                errors.append(f"{_child(path, key)}: Required value")
         addl = schema.get("additionalProperties")
         for key, element in value.items():
             if key in props:
-                _validate_value(element, props[key], f"{path}.{key}", errors)
+                _validate_value(element, props[key], _child(path, key), errors)
             elif isinstance(addl, Mapping):
-                _validate_value(element, addl, f"{path}.{key}", errors)
+                _validate_value(element, addl, _child(path, key), errors)
 
     # Value-validation combinators (structural schemas restrict these to
     # validation-only subtrees; we evaluate them as predicates).
@@ -312,7 +319,7 @@ def _validate_value(
     if any_of:
         if not any(_passes(value, sub, path) for sub in any_of):
             errors.append(
-                f"{path}: Invalid value: {_fmt(value)}: must validate "
+                f"{label}: Invalid value: {_fmt(value)}: must validate "
                 "against at least one schema (anyOf)"
             )
     one_of = schema.get("oneOf")
@@ -320,12 +327,12 @@ def _validate_value(
         matches = sum(1 for sub in one_of if _passes(value, sub, path))
         if matches != 1:
             errors.append(
-                f"{path}: Invalid value: {_fmt(value)}: must validate "
+                f"{label}: Invalid value: {_fmt(value)}: must validate "
                 f"against exactly one schema (oneOf), matched {matches}"
             )
     if "not" in schema and _passes(value, schema["not"], path):
         errors.append(
-            f"{path}: Invalid value: {_fmt(value)}: must not validate "
+            f"{label}: Invalid value: {_fmt(value)}: must not validate "
             "against the schema (not)"
         )
 

@@ -362,6 +362,32 @@ class TestConcurrency:
         ctrl.stop()
         assert inf.started_calls == 2
 
+    def test_sync_timeout_retry_with_real_informer(self):
+        """End-to-end unwind + retry: an apiserver outage (every list
+        fails) times out Controller.start(); once the outage heals, the
+        SAME controller and informer start cleanly."""
+        cluster = FakeCluster()
+        cluster.create(make_node("survivor"))
+        outage = {"on": True}
+
+        def broken_list(verb, kind, payload):
+            if outage["on"]:
+                raise RuntimeError("apiserver down")
+
+        cluster.add_reactor("list", "Node", broken_list)
+        seen = []
+        ctrl = Controller(lambda req: seen.append(req), name="healing")
+        ctrl.watch(Informer(cluster, "Node", watch_timeout_seconds=1))
+        with pytest.raises(TimeoutError):
+            ctrl.start(sync_timeout=0.5)
+        outage["on"] = False
+        ctrl.start(sync_timeout=30)
+        try:
+            wait_until(lambda: Request("", "survivor") in seen,
+                       message="reconcile after outage healed")
+        finally:
+            ctrl.stop()
+
     def test_start_twice_rejected(self):
         ctrl = Controller(lambda req: None)
         ctrl.start()

@@ -1,0 +1,146 @@
+"""metadata.generation — server-owned desired-state revision.
+
+The apiserver sets generation to 1 on create and increments it whenever
+the desired state (anything outside metadata/status) changes; status
+writes never move it. Controllers rely on it for the
+generation/observedGeneration staleness contract. One uniform rule for
+all kinds here (the modern behavior — CRs with a status subresource,
+apps types); declared in PARITY.
+"""
+
+from __future__ import annotations
+
+from builders import make_node
+from k8s_operator_libs_tpu.kube import FakeCluster, NodeMaintenance
+
+
+def nm(name="nm-gen"):
+    obj = NodeMaintenance.new(name, namespace="default")
+    obj.spec["nodeName"] = "n1"
+    obj.spec["requestorID"] = "op"
+    return obj
+
+
+class TestGeneration:
+    def test_create_sets_one(self):
+        cluster = FakeCluster()
+        created = cluster.create(nm())
+        assert created.generation == 1
+
+    def test_spec_change_bumps(self):
+        cluster = FakeCluster()
+        cluster.create(nm())
+        live = cluster.get("NodeMaintenance", "nm-gen", "default")
+        live.spec["nodeName"] = "n2"
+        assert cluster.update(live).generation == 2
+        updated = cluster.patch(
+            "NodeMaintenance", "nm-gen", "default",
+            patch={"spec": {"cordon": False}},
+        )
+        assert updated.generation == 3
+
+    def test_metadata_and_status_writes_do_not_bump(self):
+        cluster = FakeCluster()
+        cluster.create(nm())
+        labeled = cluster.patch(
+            "NodeMaintenance", "nm-gen", "default",
+            patch={"metadata": {"labels": {"team": "tpu"}}},
+        )
+        assert labeled.generation == 1
+        live = cluster.get("NodeMaintenance", "nm-gen", "default")
+        live.status["conditions"] = [
+            {"type": "Ready", "status": "True"}
+        ]
+        status_res = cluster.update_status(live)
+        assert status_res.generation == 1
+        # resourceVersion moved even though generation did not.
+        assert status_res.resource_version != labeled.resource_version
+
+    def test_no_op_spec_patch_does_not_bump(self):
+        cluster = FakeCluster()
+        cluster.create(nm())
+        same = cluster.patch(
+            "NodeMaintenance", "nm-gen", "default",
+            patch={"spec": {"nodeName": "n1"}},  # identical value
+        )
+        assert same.generation == 1
+
+    def test_client_sent_generation_ignored(self):
+        cluster = FakeCluster()
+        cluster.create(nm())
+        live = cluster.get("NodeMaintenance", "nm-gen", "default")
+        live.metadata["generation"] = 999
+        live.spec["nodeName"] = "n3"
+        assert cluster.update(live).generation == 2
+
+    def test_apply_bumps_on_spec_change_only(self):
+        cluster = FakeCluster()
+        cluster.create(make_node("gen-node"))
+        applied = cluster.apply(
+            {
+                "apiVersion": "v1", "kind": "Node",
+                "metadata": {"name": "gen-node",
+                             "labels": {"pool": "tpu"}},
+            },
+            field_manager="m1",
+        )
+        assert applied.generation == 1  # metadata-only apply
+        applied = cluster.apply(
+            {
+                "apiVersion": "v1", "kind": "Node",
+                "metadata": {"name": "gen-node"},
+                "spec": {"unschedulable": True},
+            },
+            field_manager="m2",
+        )
+        assert applied.generation == 2
+
+    def test_status_write_never_bumps_even_when_crd_changed(self):
+        """statusStrategy semantics: a status write cannot change the
+        desired state — even when the CRD gained a new spec default
+        since the object was created, admission's defaulting must not
+        leak into spec through the status endpoint (which would bump
+        generation on a pure status write)."""
+        import pathlib
+
+        import yaml
+
+        from k8s_operator_libs_tpu.kube import wrap
+
+        manifests = (
+            pathlib.Path(__file__).resolve().parent.parent / "manifests/crds"
+        )
+        cluster = FakeCluster()
+        obj = cluster.create(nm())  # created BEFORE the CRD exists
+        assert "cordon" not in obj.spec
+        cluster.create(
+            wrap(yaml.safe_load(
+                (manifests / "nodemaintenances.yaml").read_text()
+            ))
+        )
+        live = cluster.get("NodeMaintenance", "nm-gen", "default")
+        live.status["conditions"] = [{"type": "Ready", "status": "True"}]
+        result = cluster.update_status(live)
+        assert result.generation == 1  # pure status write
+        assert "cordon" not in result.spec  # defaulting did not leak in
+        # A status write is judged on its status only: the pre-CRD spec
+        # (even if the CRD now requires more) cannot wedge it.
+        assert result.status["conditions"][0]["status"] == "True"
+
+    def test_builders_roundtrip_over_http(self):
+        from k8s_operator_libs_tpu.kube import (
+            LocalApiServer,
+            RestClient,
+            RestConfig,
+        )
+
+        server = LocalApiServer().start()
+        try:
+            client = RestClient(RestConfig(server=server.url))
+            created = client.create(nm())
+            assert created.generation == 1
+            live = client.get("NodeMaintenance", "nm-gen", "default")
+            live.spec["additionalRequestors"] = ["second"]
+            assert client.update(live).generation == 2
+        finally:
+            server.stop()

@@ -140,6 +140,50 @@ class TestHandlers:
         finally:
             inf.stop()
 
+    def test_stop_is_prompt_on_a_quiet_watch(self, server, client):
+        """Cancelling a watch parked on a QUIET stream (no events, no
+        bookmarks due) must unblock the recv immediately — shutdown(),
+        not just close(), of a socket whose ownership http.client moved
+        to the response. Without it, stop() costs a full watch window."""
+        import time as _time
+
+        server.cluster.create(make_node("quiet"))
+        inf = Informer(client, "Node").start()
+        assert inf.wait_for_sync(timeout=10)
+        # Let the thread enter the watch window and park.
+        assert wait_until(lambda: inf._watch_handle is not None
+                          and inf._watch_handle._sock is not None)
+        t0 = _time.monotonic()
+        inf.stop()
+        assert _time.monotonic() - t0 < 3.0, "stop blocked on a parked recv"
+        assert not inf.started
+
+    def test_stopped_informer_restarts(self, server, client):
+        """stop() then start() is a full restart: fresh sync, store
+        repaired by re-list, watch live again — what Controller's
+        failed-start unwind relies on for its retry story."""
+        server.cluster.create(make_node("before-stop"))
+        inf = Informer(client, "Node").start()
+        assert inf.wait_for_sync(timeout=10)
+        inf.stop()
+        assert not inf.started
+        # The world changes while the informer is down...
+        server.cluster.create(make_node("while-down"))
+        server.cluster.delete("Node", "before-stop")
+        events = []
+        inf.add_event_handler(lambda e, obj, old: events.append((e, obj.name)))
+        inf.start()
+        try:
+            assert inf.wait_for_sync(timeout=10)
+            # ...and the restart re-list repaired the store.
+            assert inf.get("while-down") is not None
+            assert inf.get("before-stop") is None
+            assert wait_until(lambda: ("DELETED", "before-stop") in events)
+            server.cluster.create(make_node("post-restart"))
+            assert wait_until(lambda: inf.get("post-restart") is not None)
+        finally:
+            inf.stop()
+
     def test_handler_gets_old_object_for_predicates(self, server, client):
         """The informer's (obj, old) pair feeds condition_changed_predicate
         directly — the reference's watch-predicate wiring, no poll loop."""

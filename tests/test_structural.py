@@ -295,6 +295,45 @@ class TestValidate:
         s = StructuralSchema({"type": "object", "required": ["spec"]})
         assert s.validate({}) == ["spec: Required value"]
 
+    def test_root_level_constructs_enforced(self):
+        """Root-level additionalProperties and combinators go through
+        the same walkers as nested levels — the root is an ordinary
+        object node apart from the server-owned keys."""
+        s = StructuralSchema({
+            "type": "object",
+            "additionalProperties": {"type": "string"},
+        })
+        data = {
+            "apiVersion": "g/v1", "kind": "T", "metadata": {"name": "x"},
+            "free": "ok",
+        }
+        assert s.validate(data) == []
+        assert s.validate({**data, "free": 42}) != []
+        # Root additionalProperties also governs pruning: the arbitrary
+        # key survives (it IS specified, via additionalProperties).
+        s.prune(data)
+        assert data["free"] == "ok"
+        # Root combinator:
+        c = StructuralSchema({
+            "type": "object",
+            "properties": {"mode": {"type": "string"}},
+            "not": {"required": ["forbidden"]},
+        })
+        assert c.validate({"mode": "a"}) == []
+        bad = c.validate({"mode": "a", "forbidden": 1})
+        assert any("must not validate" in e for e in bad)
+
+    def test_schema_requiring_server_keys_is_ignored(self):
+        s = StructuralSchema({
+            "type": "object",
+            "required": ["metadata", "spec"],
+        })
+        # metadata is server territory — only spec's absence is the CR
+        # author's problem.
+        assert s.validate({"metadata": {"name": "x"}}) == [
+            "spec: Required value"
+        ]
+
 
 # ---------------------------------------------------------------------------
 # FakeCluster activation rule + the checked-in CRD contracts
