@@ -347,27 +347,49 @@ class _Handler(BaseHTTPRequestHandler):
         except (BrokenPipeError, ConnectionResetError):
             return False
 
+    @staticmethod
+    def _dry_run(query) -> bool:
+        value = query.get("dryRun", "")
+        if value and value != "All":
+            # Real-apiserver validation: All is the only accepted value.
+            raise BadRequestError(f"invalid dryRun value {value!r}")
+        return bool(value)
+
     def _do_post(self, cluster, info, namespace, name, subresource, query):
         body = self._read_body()
         if subresource == "eviction":
-            cluster.evict(name, namespace)
+            # dryRun travels either as a query param or inside the
+            # Eviction body's deleteOptions (kubectl sends the latter).
+            opts = (body or {}).get("deleteOptions") or {}
+            body_dry = opts.get("dryRun") or []
+            if body_dry and body_dry != ["All"]:
+                raise BadRequestError(f"invalid dryRun value {body_dry!r}")
+            cluster.evict(
+                name, namespace,
+                dry_run=self._dry_run(query) or bool(body_dry),
+            )
             self._send_json(200, _ok_status())
             return
         meta = body.setdefault("metadata", {})
         if info.namespaced and not meta.get("namespace"):
             meta["namespace"] = namespace
         created = cluster.create(
-            wrap(body), field_manager=query.get("fieldManager", "")
+            wrap(body),
+            field_manager=query.get("fieldManager", ""),
+            dry_run=self._dry_run(query),
         )
         self._send_json(201, created.raw)
 
     def _do_put(self, cluster, info, namespace, name, subresource, query):
         obj = wrap(self._read_body())
         manager = query.get("fieldManager", "")
+        dry = self._dry_run(query)
         if subresource == "status":
-            updated = cluster.update_status(obj, field_manager=manager)
+            updated = cluster.update_status(
+                obj, field_manager=manager, dry_run=dry
+            )
         else:
-            updated = cluster.update(obj, field_manager=manager)
+            updated = cluster.update(obj, field_manager=manager, dry_run=dry)
         self._send_json(200, updated.raw)
 
     def _do_patch(self, cluster, info, namespace, name, subresource, query):
@@ -403,6 +425,7 @@ class _Handler(BaseHTTPRequestHandler):
                 body,
                 field_manager=query.get("fieldManager", ""),
                 force=query.get("force") == "true",
+                dry_run=self._dry_run(query),
             )
             self._send_json(201 if created else 200, applied.raw)
             return
@@ -419,6 +442,7 @@ class _Handler(BaseHTTPRequestHandler):
             patch=self._read_body(),
             patch_type=patch_type,
             field_manager=query.get("fieldManager", ""),
+            dry_run=self._dry_run(query),
         )
         self._send_json(200, patched.raw)
 
@@ -430,6 +454,7 @@ class _Handler(BaseHTTPRequestHandler):
             info.kind,
             name,
             namespace,
+            dry_run=self._dry_run(query),
             propagation_policy=query.get("propagationPolicy") or None,
             precondition_uid=preconditions.get("uid"),
             precondition_resource_version=preconditions.get(

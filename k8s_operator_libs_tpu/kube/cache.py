@@ -147,24 +147,40 @@ class CachedClient(Client):
         return out
 
     # -- writes (pass through) ---------------------------------------------
-    def create(self, obj: KubeObject, field_manager: str = "") -> KubeObject:
-        return self.backing.create(obj, field_manager=field_manager)
+    def create(
+        self, obj: KubeObject, field_manager: str = "",
+        dry_run: bool = False,
+    ) -> KubeObject:
+        return self.backing.create(
+            obj, field_manager=field_manager, dry_run=dry_run
+        )
 
     def apply(
         self,
         obj: KubeObject | Mapping[str, Any],
         field_manager: str,
         force: bool = False,
+        dry_run: bool = False,
     ) -> KubeObject:
-        return self.backing.apply(obj, field_manager, force=force)
+        return self.backing.apply(
+            obj, field_manager, force=force, dry_run=dry_run
+        )
 
-    def update(self, obj: KubeObject, field_manager: str = "") -> KubeObject:
-        return self.backing.update(obj, field_manager=field_manager)
+    def update(
+        self, obj: KubeObject, field_manager: str = "",
+        dry_run: bool = False,
+    ) -> KubeObject:
+        return self.backing.update(
+            obj, field_manager=field_manager, dry_run=dry_run
+        )
 
     def update_status(
-        self, obj: KubeObject, field_manager: str = ""
+        self, obj: KubeObject, field_manager: str = "",
+        dry_run: bool = False,
     ) -> KubeObject:
-        return self.backing.update_status(obj, field_manager=field_manager)
+        return self.backing.update_status(
+            obj, field_manager=field_manager, dry_run=dry_run
+        )
 
     def patch(
         self,
@@ -174,6 +190,7 @@ class CachedClient(Client):
         patch: Optional[Mapping[str, Any] | list[Any]] = None,
         patch_type: str = "merge",
         field_manager: str = "",
+        dry_run: bool = False,
     ) -> KubeObject:
         return self.backing.patch(
             kind,
@@ -182,6 +199,7 @@ class CachedClient(Client):
             patch,
             patch_type=patch_type,
             field_manager=field_manager,
+            dry_run=dry_run,
         )
 
     def delete(
@@ -193,6 +211,7 @@ class CachedClient(Client):
         propagation_policy: Optional[str] = None,
         precondition_uid: Optional[str] = None,
         precondition_resource_version: Optional[str] = None,
+        dry_run: bool = False,
     ) -> None:
         return self.backing.delete(
             kind,
@@ -202,10 +221,13 @@ class CachedClient(Client):
             propagation_policy=propagation_policy,
             precondition_uid=precondition_uid,
             precondition_resource_version=precondition_resource_version,
+            dry_run=dry_run,
         )
 
-    def evict(self, pod_name: str, namespace: str = "") -> None:
-        return self.backing.evict(pod_name, namespace)
+    def evict(
+        self, pod_name: str, namespace: str = "", dry_run: bool = False
+    ) -> None:
+        return self.backing.evict(pod_name, namespace, dry_run=dry_run)
 
     def discover(self, group: str, version: str) -> list:
         # Discovery is never cached (the poll exists to observe the

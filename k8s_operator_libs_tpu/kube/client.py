@@ -123,14 +123,32 @@ class Client(abc.ABC):
         )
 
     @abc.abstractmethod
-    def create(self, obj: KubeObject) -> KubeObject: ...
+    def create(
+        self,
+        obj: KubeObject,
+        field_manager: str = "",
+        dry_run: bool = False,
+    ) -> KubeObject:
+        """Create. ``field_manager`` feeds managedFields ownership;
+        ``dry_run`` runs the full write pipeline (admission, defaulting,
+        conflict checks) without persisting — ``dryRun=All``."""
 
     @abc.abstractmethod
-    def update(self, obj: KubeObject) -> KubeObject:
+    def update(
+        self,
+        obj: KubeObject,
+        field_manager: str = "",
+        dry_run: bool = False,
+    ) -> KubeObject:
         """Full replace; raises ConflictError on stale resourceVersion."""
 
     @abc.abstractmethod
-    def update_status(self, obj: KubeObject) -> KubeObject:
+    def update_status(
+        self,
+        obj: KubeObject,
+        field_manager: str = "",
+        dry_run: bool = False,
+    ) -> KubeObject:
         """Replace only the status subresource."""
 
     @abc.abstractmethod
@@ -141,6 +159,8 @@ class Client(abc.ABC):
         namespace: str = "",
         patch: Optional[Mapping[str, Any] | list[Any]] = None,
         patch_type: str = "merge",
+        field_manager: str = "",
+        dry_run: bool = False,
     ) -> KubeObject:
         """Patch the object. ``patch_type`` selects the content type:
         ``"merge"`` = RFC 7386 merge patch (null deletes a key),
@@ -155,6 +175,7 @@ class Client(abc.ABC):
         obj: "KubeObject | Mapping[str, Any]",
         field_manager: str,
         force: bool = False,
+        dry_run: bool = False,
     ) -> KubeObject:
         """Server-side apply (client-go's ``client.Apply`` patch type):
         declare the manager's intent; the server merges it, tracks field
@@ -177,6 +198,7 @@ class Client(abc.ABC):
         propagation_policy: Optional[str] = None,
         precondition_uid: Optional[str] = None,
         precondition_resource_version: Optional[str] = None,
+        dry_run: bool = False,
     ) -> None:
         """Delete; raises NotFoundError if absent. ``propagation_policy``
         follows DeleteOptions (Background | Foreground | Orphan);
@@ -184,7 +206,9 @@ class Client(abc.ABC):
         answers 409 Conflict)."""
 
     @abc.abstractmethod
-    def evict(self, pod_name: str, namespace: str = "") -> None:
+    def evict(
+        self, pod_name: str, namespace: str = "", dry_run: bool = False
+    ) -> None:
         """Evict a pod via the eviction subresource semantics."""
 
     # -- convenience -------------------------------------------------------

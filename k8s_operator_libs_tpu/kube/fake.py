@@ -46,7 +46,7 @@ from .objects import (
 from .resources import resource_for_kind
 from .selectors import LabelSelector, parse_field_selector, parse_selector
 from .ssa import reassign_on_write, server_side_apply
-from .structural import schema_for_crd_version
+from .structural import error_root_field, schema_for_crd_version
 
 #: reactor signature: (verb, kind, payload) -> None; raise to inject a failure.
 Reactor = Callable[[str, str, dict[str, Any]], None]
@@ -1019,8 +1019,12 @@ class FakeCluster(Client):
         if status_only:
             # ValidateStatusUpdate shape: a status write is judged on
             # its status only — a spec that predates a tightened CRD
-            # must not wedge the status-writing controller.
-            errors = [e for e in errors if e.startswith("status")]
+            # must not wedge the status-writing controller. Exact root
+            # field match: a spec field named "statusHistory" is not
+            # "status".
+            errors = [
+                e for e in errors if error_root_field(e) == "status"
+            ]
         if errors:
             name = (data.get("metadata") or {}).get("name", "")
             raise InvalidError(
@@ -1246,7 +1250,12 @@ class FakeCluster(Client):
             self._continues.clear()
             self._continue_order.clear()
 
-    def create(self, obj: KubeObject, field_manager: str = "") -> KubeObject:
+    def create(
+        self,
+        obj: KubeObject,
+        field_manager: str = "",
+        dry_run: bool = False,
+    ) -> KubeObject:
         kind = obj.raw.get("kind", "")
         if not kind or not obj.name:
             raise InvalidError("object must have kind and metadata.name")
@@ -1267,6 +1276,10 @@ class FakeCluster(Client):
                 # managedFields (create-through-apply) keep them.
                 reassign_on_write({}, data, field_manager, rfc3339_now())
             self._sync_generation(data, None)
+            if dry_run:
+                # dryRun=All: the full admission/defaulting pipeline ran;
+                # nothing persists, no events, no revision assigned.
+                return wrap(copy.deepcopy(data))
             self._bump(data)
             self._store[key] = data
             self._emit(_WATCH_ADDED, data)
@@ -1390,7 +1403,11 @@ class FakeCluster(Client):
         return resources
 
     def _replace(
-        self, obj: KubeObject, status_only: bool, field_manager: str = ""
+        self,
+        obj: KubeObject,
+        status_only: bool,
+        field_manager: str = "",
+        dry_run: bool = False,
     ) -> KubeObject:
         kind = obj.raw.get("kind", "")
         with self._lock:
@@ -1404,6 +1421,9 @@ class FakeCluster(Client):
                 )
             old = copy.deepcopy(current)
             if status_only:
+                if dry_run:
+                    # Work on a private copy: nothing may reach storage.
+                    current = copy.deepcopy(current)
                 current["status"] = copy.deepcopy(obj.raw.get("status") or {})
                 data = current
                 self._admit_or_restore_locked(data, old, status_only=True)
@@ -1438,7 +1458,10 @@ class FakeCluster(Client):
                 # Admission before the store swap: a rejected replace
                 # must leave the stored object untouched.
                 self._admit_custom_locked(data)
-                self._store[self._key(kind, obj.namespace, obj.name)] = data
+                if not dry_run:
+                    self._store[
+                        self._key(kind, obj.namespace, obj.name)
+                    ] = data
             # managedFields is server-owned: ownership moves to the writer
             # for every field this write changed (client-sent managedFields
             # is ignored, like a real apiserver preserving when unset).
@@ -1450,6 +1473,8 @@ class FakeCluster(Client):
                 subresource="status" if status_only else "",
             )
             self._sync_generation(data, old)
+            if dry_run:
+                return wrap(copy.deepcopy(data))
             self._bump(data)
             if not self._write_becomes_delete(data):
                 self._emit(_WATCH_MODIFIED, data, old=old)
@@ -1470,13 +1495,27 @@ class FakeCluster(Client):
             self._finalize_delete_if_due(kind, obj.name, obj.namespace, old=old)
             return wrap(copy.deepcopy(data))
 
-    def update(self, obj: KubeObject, field_manager: str = "") -> KubeObject:
-        return self._replace(obj, status_only=False, field_manager=field_manager)
+    def update(
+        self,
+        obj: KubeObject,
+        field_manager: str = "",
+        dry_run: bool = False,
+    ) -> KubeObject:
+        return self._replace(
+            obj, status_only=False, field_manager=field_manager,
+            dry_run=dry_run,
+        )
 
     def update_status(
-        self, obj: KubeObject, field_manager: str = ""
+        self,
+        obj: KubeObject,
+        field_manager: str = "",
+        dry_run: bool = False,
     ) -> KubeObject:
-        return self._replace(obj, status_only=True, field_manager=field_manager)
+        return self._replace(
+            obj, status_only=True, field_manager=field_manager,
+            dry_run=dry_run,
+        )
 
     def patch(
         self,
@@ -1486,6 +1525,7 @@ class FakeCluster(Client):
         patch: Optional[Mapping[str, Any] | list[Any]] = None,
         patch_type: str = "merge",
         field_manager: str = "",
+        dry_run: bool = False,
     ) -> KubeObject:
         with self._lock:
             payload = (
@@ -1497,6 +1537,10 @@ class FakeCluster(Client):
                                         "patch": payload,
                                         "patch_type": patch_type})
             current = self._get_raw(kind, name, namespace)
+            if dry_run:
+                # All merging/admission below mutates in place — give it
+                # a private copy so nothing reaches storage.
+                current = copy.deepcopy(current)
             old = copy.deepcopy(current)
             if patch_type == "strategic" and not _supports_strategic(current):
                 # Real-apiserver semantics: strategic merge patch only
@@ -1536,6 +1580,8 @@ class FakeCluster(Client):
             # a patch cannot rewrite it directly).
             reassign_on_write(old, current, field_manager, rfc3339_now())
             self._sync_generation(current, old)
+            if dry_run:
+                return wrap(copy.deepcopy(current))
             self._bump(current)
             if not self._write_becomes_delete(current):
                 self._emit(_WATCH_MODIFIED, current, old=old)
@@ -1559,6 +1605,7 @@ class FakeCluster(Client):
         obj: KubeObject | Mapping[str, Any],
         field_manager: str,
         force: bool = False,
+        dry_run: bool = False,
     ) -> KubeObject:
         """Server-side apply (``application/apply-patch+yaml``): merge the
         manager's declared intent into the live object, tracking field
@@ -1618,8 +1665,10 @@ class FakeCluster(Client):
                 if namespace:
                     live["metadata"]["namespace"] = namespace
                 server_side_apply(live, applied, field_manager, force, now)
-                return self.create(wrap(live))
+                return self.create(wrap(live), dry_run=dry_run)
             current = self._get_raw(kind, name, namespace)
+            if dry_run:
+                current = copy.deepcopy(current)
             old = copy.deepcopy(current)
             if "status" in current:
                 # Main-resource writes never touch the status subresource
@@ -1636,6 +1685,8 @@ class FakeCluster(Client):
                 cur_meta.pop("namespace", None)
             self._admit_or_restore_locked(current, old)
             self._sync_generation(current, old)
+            if dry_run:
+                return wrap(copy.deepcopy(current))
             self._bump(current)
             if not self._write_becomes_delete(current):
                 self._emit(_WATCH_MODIFIED, current, old=old)
@@ -1655,6 +1706,7 @@ class FakeCluster(Client):
         propagation_policy: Optional[str] = None,
         precondition_uid: Optional[str] = None,
         precondition_resource_version: Optional[str] = None,
+        dry_run: bool = False,
     ) -> None:
         """Delete with owner-reference garbage collection.
 
@@ -1702,6 +1754,9 @@ class FakeCluster(Client):
                     f"({precondition_resource_version}) does not match the "
                     f"record ({meta.get('resourceVersion')})"
                 )
+            if dry_run:
+                # Existence and preconditions verified; nothing deleted.
+                return
             uid = meta.get("uid", "")
             gc = self._enable_owner_gc and bool(uid)
             policy = propagation_policy or "Background"
@@ -1846,10 +1901,12 @@ class FakeCluster(Client):
             if self._enable_owner_gc and meta.get("uid"):
                 self._gc_on_owner_removed(meta["uid"])
 
-    def evict(self, pod_name: str, namespace: str = "") -> None:
+    def evict(
+        self, pod_name: str, namespace: str = "", dry_run: bool = False
+    ) -> None:
         with self._lock:
             self._react("evict", "Pod", {"name": pod_name, "namespace": namespace})
-            self.delete("Pod", pod_name, namespace)
+            self.delete("Pod", pod_name, namespace, dry_run=dry_run)
 
     # -- test conveniences -------------------------------------------------
     def close(self) -> None:

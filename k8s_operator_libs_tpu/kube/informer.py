@@ -307,9 +307,13 @@ class Informer:
                         "informer handler failed for %s %s", event, obj.name
                     )
 
-    def _relist(self) -> None:
+    def _relist(self, stop) -> None:
         """Seed/repair the store from a fresh list, emitting synthetic
-        events for every difference a lapsed watch may have missed."""
+        events for every difference a lapsed watch may have missed.
+        ``stop`` is THIS run's stop event: a run superseded while blocked
+        in the list call (stop() gave up joining, start() launched a new
+        run) must discard its result instead of clobbering the new run's
+        store/synced/resume state."""
         list_kwargs = dict(
             namespace=self.namespace,
             label_selector=self.label_selector,
@@ -329,6 +333,8 @@ class Informer:
         ]
         if collection_rv.isdigit():
             rvs.append(int(collection_rv))
+        if stop.is_set():
+            return  # superseded (or stopping): discard the stale list
         with self._lock:
             previous = self._store
             self._store = fresh
@@ -355,7 +361,9 @@ class Informer:
         while not stop.is_set():
             try:
                 if not self._synced.is_set() or self._resource_version is None:
-                    self._relist()
+                    self._relist(stop)
+                    if stop.is_set():
+                        return
                 watch_kwargs = dict(
                     namespace=self.namespace,
                     label_selector=self.label_selector,
@@ -369,11 +377,15 @@ class Informer:
                 )
                 from .rest import WatchHandle
 
+                if stop.is_set():
+                    # A superseded run must not clobber the live run's
+                    # handle with a stale one.
+                    return
                 self._watch_handle = WatchHandle()
-                # stop() may have run while we were re-listing, when
-                # there was no handle to cancel — re-check after
-                # publishing the handle so that window cannot park us
-                # in a full watch timeout.
+                # stop() may have run between the check above and the
+                # assignment, when there was no handle to cancel —
+                # re-check after publishing the handle so that window
+                # cannot park us in a full watch timeout.
                 if stop.is_set():
                     return
                 watch_iter = self._client.watch(

@@ -645,43 +645,61 @@ class RestClient(Client):
         finally:
             conn.close()
 
-    def create(self, obj: KubeObject, field_manager: str = "") -> KubeObject:
+    @staticmethod
+    def _write_query(field_manager: str, dry_run: bool) -> Optional[dict]:
+        query: dict[str, str] = {}
+        if field_manager:
+            query["fieldManager"] = field_manager
+        if dry_run:
+            query["dryRun"] = "All"  # the only value the apiserver takes
+        return query or None
+
+    def create(
+        self, obj: KubeObject, field_manager: str = "",
+        dry_run: bool = False,
+    ) -> KubeObject:
         info = resource_for_kind(obj.raw.get("kind", ""))
-        query = {"fieldManager": field_manager} if field_manager else None
         return wrap(
             self._request(
                 "POST",
                 self._path(info, obj.namespace),
-                query=query,
+                query=self._write_query(field_manager, dry_run),
                 body=obj.raw,
             )
         )
 
-    def update(self, obj: KubeObject, field_manager: str = "") -> KubeObject:
+    def update(
+        self, obj: KubeObject, field_manager: str = "",
+        dry_run: bool = False,
+    ) -> KubeObject:
         info = resource_for_kind(obj.raw.get("kind", ""))
-        query = {"fieldManager": field_manager} if field_manager else None
         return wrap(
             self._request(
                 "PUT",
                 self._path(info, obj.namespace, obj.name),
-                query=query,
+                query=self._write_query(field_manager, dry_run),
                 body=obj.raw,
             )
         )
 
     def update_status(
-        self, obj: KubeObject, field_manager: str = ""
+        self, obj: KubeObject, field_manager: str = "",
+        dry_run: bool = False,
     ) -> KubeObject:
         info = resource_for_kind(obj.raw.get("kind", ""))
         path = self._path(info, obj.namespace, obj.name) + "/status"
-        query = {"fieldManager": field_manager} if field_manager else None
-        return wrap(self._request("PUT", path, query=query, body=obj.raw))
+        return wrap(self._request(
+            "PUT", path,
+            query=self._write_query(field_manager, dry_run),
+            body=obj.raw,
+        ))
 
     def apply(
         self,
         obj: KubeObject | Mapping[str, Any],
         field_manager: str,
         force: bool = False,
+        dry_run: bool = False,
     ) -> KubeObject:
         """Server-side apply over the wire: PATCH with the
         ``application/apply-patch+yaml`` content type (the body is JSON,
@@ -693,6 +711,8 @@ class RestClient(Client):
         query = {"fieldManager": field_manager}
         if force:
             query["force"] = "true"
+        if dry_run:
+            query["dryRun"] = "All"
         return wrap(
             self._request(
                 "PATCH",
@@ -711,6 +731,7 @@ class RestClient(Client):
         patch: Optional[Mapping[str, Any] | list[Any]] = None,
         patch_type: str = "merge",
         field_manager: str = "",
+        dry_run: bool = False,
     ) -> KubeObject:
         info = resource_for_kind(kind)
         content_types = {
@@ -739,9 +760,7 @@ class RestClient(Client):
             self._request(
                 "PATCH",
                 self._path(info, namespace, name),
-                query=(
-                    {"fieldManager": field_manager} if field_manager else None
-                ),
+                query=self._write_query(field_manager, dry_run),
                 body=body,
                 content_type=content_types[patch_type],
             )
@@ -756,9 +775,12 @@ class RestClient(Client):
         propagation_policy: Optional[str] = None,
         precondition_uid: Optional[str] = None,
         precondition_resource_version: Optional[str] = None,
+        dry_run: bool = False,
     ) -> None:
         info = resource_for_kind(kind)
         query = {}
+        if dry_run:
+            query["dryRun"] = "All"
         if grace_period_seconds is not None:
             query["gracePeriodSeconds"] = str(grace_period_seconds)
         if propagation_policy is not None:
@@ -793,8 +815,12 @@ class RestClient(Client):
             body=body,
         )
 
-    def evict(self, pod_name: str, namespace: str = "") -> None:
-        """policy/v1 Eviction subresource (what kubectl drain uses)."""
+    def evict(
+        self, pod_name: str, namespace: str = "", dry_run: bool = False
+    ) -> None:
+        """policy/v1 Eviction subresource (what kubectl drain uses).
+        ``dry_run`` travels in the Eviction body's DeleteOptions, as
+        kubectl sends it."""
         info = resource_for_kind("Pod")
         path = self._path(info, namespace, pod_name) + "/eviction"
         body = {
@@ -805,4 +831,6 @@ class RestClient(Client):
                 "namespace": namespace or self.config.namespace,
             },
         }
-        self._request("POST", path, body=body)
+        if dry_run:
+            body["deleteOptions"] = {"dryRun": ["All"]}
+        self._request("POST", path, query={"dryRun": "All"} if dry_run else None, body=body)

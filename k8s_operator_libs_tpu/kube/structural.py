@@ -40,6 +40,16 @@ from typing import Any, Mapping, Optional
 _ROOT_SERVER_KEYS = frozenset({"apiVersion", "kind", "metadata"})
 
 
+def error_root_field(error: str) -> str:
+    """The root field segment of a validation error's path — the text
+    before the first ``.``, ``[``, or ``:``. Used for exact-field
+    filtering (a field named ``statusHistory`` is not ``status``)."""
+    head = error.split(":", 1)[0]
+    for sep in (".", "["):
+        head = head.split(sep, 1)[0]
+    return head.strip()
+
+
 def schema_for_crd_version(
     crd_data: Mapping[str, Any], version: str
 ) -> Optional["StructuralSchema"]:
@@ -104,10 +114,12 @@ class StructuralSchema:
         errors: list[str] = []
         _validate_value(view, self.root, "", errors)
         # A schema demanding server keys (required: [metadata]) is not
-        # the CR author's problem — those live outside the schema.
+        # the CR author's problem — those live outside the schema. Match
+        # the error path's ROOT SEGMENT exactly: a field merely named
+        # "kinds" or "metadataPolicy" must not be silently excused.
         return [
             e for e in errors
-            if not e.startswith(tuple(_ROOT_SERVER_KEYS))
+            if error_root_field(e) not in _ROOT_SERVER_KEYS
         ]
 
 

@@ -1,0 +1,271 @@
+"""Server-side dry-run (``dryRun=All``) — the full write pipeline with
+nothing persisted.
+
+Admission (prune/default/validate), generation preview, managedFields
+computation, conflict and precondition checks all run; storage, watch
+events, and resourceVersion assignment do not. What kubectl
+``--dry-run=server`` rides on.
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+import pytest
+import yaml
+
+from builders import make_node
+from k8s_operator_libs_tpu.kube import (
+    CachedClient,
+    ConflictError,
+    FakeCluster,
+    InvalidError,
+    LocalApiServer,
+    Node,
+    NodeMaintenance,
+    NotFoundError,
+    RestClient,
+    RestConfig,
+    wrap,
+)
+
+MANIFESTS = pathlib.Path(__file__).resolve().parent.parent / "manifests/crds"
+
+
+def nm(name="nm-dry"):
+    obj = NodeMaintenance.new(name, namespace="default")
+    obj.spec["nodeName"] = "n1"
+    obj.spec["requestorID"] = "op"
+    return obj
+
+
+def crd():
+    return wrap(
+        yaml.safe_load((MANIFESTS / "nodemaintenances.yaml").read_text())
+    )
+
+
+class TestCreate:
+    def test_preview_with_admission_but_no_persistence(self):
+        cluster = FakeCluster()
+        cluster.create(crd())
+        events = []
+        cluster.subscribe(lambda t, obj, old: events.append(t))
+        rv_before = cluster.current_resource_version()
+        preview = cluster.create(nm(), dry_run=True)
+        # The pipeline ran: defaults visible, uid generated, generation 1.
+        assert preview.spec["cordon"] is True
+        assert preview.uid and preview.generation == 1
+        # Nothing persisted: no object, no events, no revision movement.
+        with pytest.raises(NotFoundError):
+            cluster.get("NodeMaintenance", "nm-dry", "default")
+        assert cluster.current_resource_version() == rv_before
+        assert events == []
+        # And the real create still works afterwards.
+        cluster.create(nm())
+        assert cluster.get("NodeMaintenance", "nm-dry", "default")
+
+    def test_validation_still_rejects(self):
+        cluster = FakeCluster()
+        cluster.create(crd())
+        bad = NodeMaintenance.new("bad", namespace="default")
+        bad.raw["spec"] = {}
+        with pytest.raises(InvalidError):
+            cluster.create(bad, dry_run=True)
+
+    def test_duplicate_still_conflicts(self):
+        cluster = FakeCluster()
+        cluster.create(nm())
+        from k8s_operator_libs_tpu.kube import AlreadyExistsError
+
+        with pytest.raises(AlreadyExistsError):
+            cluster.create(nm(), dry_run=True)
+
+
+class TestUpdatePatchApply:
+    def test_update_previews_generation_without_persisting(self):
+        cluster = FakeCluster()
+        cluster.create(nm())
+        live = cluster.get("NodeMaintenance", "nm-dry", "default")
+        live.spec["nodeName"] = "n2"
+        preview = cluster.update(live, dry_run=True)
+        assert preview.generation == 2
+        assert preview.spec["nodeName"] == "n2"
+        stored = cluster.get("NodeMaintenance", "nm-dry", "default")
+        assert stored.spec["nodeName"] == "n1"
+        assert stored.generation == 1
+
+    def test_stale_rv_still_conflicts(self):
+        cluster = FakeCluster()
+        cluster.create(nm())
+        live = cluster.get("NodeMaintenance", "nm-dry", "default")
+        cluster.patch("NodeMaintenance", "nm-dry", "default",
+                      patch={"metadata": {"labels": {"x": "1"}}})
+        live.spec["nodeName"] = "n2"
+        with pytest.raises(ConflictError):
+            cluster.update(live, dry_run=True)
+
+    def test_status_dry_run_leaves_store(self):
+        cluster = FakeCluster()
+        cluster.create(nm())
+        live = cluster.get("NodeMaintenance", "nm-dry", "default")
+        live.status["conditions"] = [{"type": "Ready", "status": "True"}]
+        preview = cluster.update_status(live, dry_run=True)
+        assert preview.status["conditions"]
+        assert not cluster.get(
+            "NodeMaintenance", "nm-dry", "default"
+        ).status.get("conditions")
+
+    def test_patch_dry_run(self):
+        cluster = FakeCluster()
+        cluster.create(crd())
+        cluster.create(nm())
+        preview = cluster.patch(
+            "NodeMaintenance", "nm-dry", "default",
+            patch={"spec": {"drainSpec": {"timeoutSeconds": 30}}},
+            dry_run=True,
+        )
+        assert preview.spec["drainSpec"]["timeoutSeconds"] == 30
+        assert "drainSpec" not in cluster.get(
+            "NodeMaintenance", "nm-dry", "default"
+        ).spec
+        # Invalid patches still 422 (and remain atomic).
+        with pytest.raises(InvalidError):
+            cluster.patch(
+                "NodeMaintenance", "nm-dry", "default",
+                patch={"spec": {"drainSpec": {"timeoutSeconds": -1}}},
+                dry_run=True,
+            )
+
+    def test_apply_dry_run_previews_ownership(self):
+        cluster = FakeCluster()
+        preview = cluster.apply(
+            {
+                "apiVersion": "v1", "kind": "Node",
+                "metadata": {"name": "apply-dry",
+                             "labels": {"pool": "tpu"}},
+            },
+            field_manager="mgr",
+            dry_run=True,
+        )
+        assert preview.metadata.get("managedFields")
+        with pytest.raises(NotFoundError):
+            cluster.get("Node", "apply-dry")
+        # Update-path apply: object exists, dry-run preview only.
+        cluster.create(make_node("apply-live"))
+        preview = cluster.apply(
+            {
+                "apiVersion": "v1", "kind": "Node",
+                "metadata": {"name": "apply-live",
+                             "labels": {"pool": "tpu"}},
+            },
+            field_manager="mgr",
+            dry_run=True,
+        )
+        assert preview.labels.get("pool") == "tpu"
+        assert "pool" not in (
+            cluster.get("Node", "apply-live").labels or {}
+        )
+
+
+class TestEvict:
+    def test_evict_dry_run_keeps_pod(self):
+        from builders import make_pod
+
+        cluster = FakeCluster()
+        cluster.create(make_pod(name="victim", namespace="default"))
+        cluster.evict("victim", "default", dry_run=True)
+        assert cluster.get("Pod", "victim", "default")
+        cluster.evict("victim", "default")
+        with pytest.raises(NotFoundError):
+            cluster.get("Pod", "victim", "default")
+
+    def test_evict_dry_run_over_the_wire(self):
+        """kubectl drain --dry-run=server sends dryRun inside the
+        Eviction body's deleteOptions — the wire path must honor it."""
+        from builders import make_pod
+
+        server = LocalApiServer().start()
+        try:
+            client = RestClient(RestConfig(server=server.url))
+            server.cluster.create(make_pod(name="victim",
+                                           namespace="default"))
+            client.evict("victim", "default", dry_run=True)
+            assert client.get("Pod", "victim", "default")
+            client.evict("victim", "default")
+            with pytest.raises(NotFoundError):
+                client.get("Pod", "victim", "default")
+        finally:
+            server.stop()
+
+
+class TestDelete:
+    def test_delete_dry_run_checks_but_keeps(self):
+        cluster = FakeCluster()
+        cluster.create(nm())
+        cluster.delete("NodeMaintenance", "nm-dry", "default",
+                       dry_run=True)
+        assert cluster.get("NodeMaintenance", "nm-dry", "default")
+        # Missing objects still 404; bad preconditions still 409.
+        with pytest.raises(NotFoundError):
+            cluster.delete("NodeMaintenance", "ghost", "default",
+                           dry_run=True)
+        with pytest.raises(ConflictError):
+            cluster.delete("NodeMaintenance", "nm-dry", "default",
+                           precondition_uid="wrong", dry_run=True)
+
+
+class TestOverHttpAndCache:
+    def test_wire_dry_run_all_verbs(self):
+        server = LocalApiServer().start()
+        try:
+            client = RestClient(RestConfig(server=server.url))
+            client.create(crd())
+            preview = client.create(nm(), dry_run=True)
+            assert preview.spec["cordon"] is True
+            with pytest.raises(NotFoundError):
+                client.get("NodeMaintenance", "nm-dry", "default")
+            client.create(nm())
+            preview = client.patch(
+                "NodeMaintenance", "nm-dry", "default",
+                patch={"spec": {"cordon": False}}, dry_run=True,
+            )
+            assert preview.spec["cordon"] is False
+            assert client.get(
+                "NodeMaintenance", "nm-dry", "default"
+            ).spec["cordon"] is True
+            client.delete("NodeMaintenance", "nm-dry", "default",
+                          dry_run=True)
+            assert client.get("NodeMaintenance", "nm-dry", "default")
+            # CachedClient passes dry_run through to its backing client.
+            cached = CachedClient(client)
+            preview = cached.patch(
+                "NodeMaintenance", "nm-dry", "default",
+                patch={"spec": {"cordon": False}}, dry_run=True,
+            )
+            assert preview.spec["cordon"] is False
+            assert client.get(
+                "NodeMaintenance", "nm-dry", "default"
+            ).spec["cordon"] is True
+        finally:
+            server.stop()
+
+    def test_invalid_dry_run_value_is_400(self):
+        server = LocalApiServer().start()
+        try:
+            import json as _json
+            import urllib.request
+
+            body = _json.dumps(nm().raw).encode()
+            req = urllib.request.Request(
+                server.url
+                + "/apis/maintenance.nvidia.com/v1alpha1/namespaces/"
+                  "default/nodemaintenances?dryRun=Bogus",
+                data=body, method="POST",
+                headers={"Content-Type": "application/json"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req)
+            assert exc.value.code == 400
+        finally:
+            server.stop()

@@ -334,6 +334,48 @@ class TestValidate:
             "spec: Required value"
         ]
 
+    def test_fields_merely_named_like_server_keys_still_validate(self):
+        """The server-key filter matches the error path's root segment
+        EXACTLY — a field named 'kinds' or 'metadataPolicy' is not
+        excused from validation."""
+        s = StructuralSchema({
+            "type": "object",
+            "required": ["kinds"],
+            "properties": {
+                "kinds": {"type": "array"},
+                "metadataPolicy": {"type": "string"},
+                "apiVersions": {"type": "array"},
+            },
+        })
+        errors = s.validate({"metadataPolicy": 42, "apiVersions": "x"})
+        roots = sorted(e.split(":", 1)[0] for e in errors)
+        assert "kinds" in roots  # required fires
+        assert any(r.startswith("metadataPolicy") for r in roots)
+        assert any(r.startswith("apiVersions") for r in roots)
+
+    def test_status_filter_is_exact_field(self):
+        """A status-subresource write filters errors to the REAL status
+        field — spec fields named 'status*' don't survive the filter
+        and wedge the write."""
+        cluster = FakeCluster()
+        crd = load_crd("nodemaintenances.yaml").deep_copy()
+        # Tighten the schema with a root field named statusHistory that
+        # the stored object violates.
+        root = crd.raw["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+        root["properties"]["statusHistory"] = {"type": "string"}
+        obj = nm("filter-probe")
+        obj.raw["statusHistory"] = 123  # invalid under the NEW schema
+        created = None
+        cluster.create(obj)  # pre-CRD: admitted untouched
+        cluster.create(crd)
+        live = cluster.get("NodeMaintenance", "filter-probe", "default")
+        live.status["conditions"] = [
+            {"type": "Ready", "status": "True"}
+        ]
+        # statusHistory's violation must NOT block the status write.
+        created = cluster.update_status(live)
+        assert created.status["conditions"][0]["status"] == "True"
+
 
 # ---------------------------------------------------------------------------
 # FakeCluster activation rule + the checked-in CRD contracts
