@@ -24,7 +24,7 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 
-from .client import ApiError, BadRequestError, NotFoundError
+from .client import ApiError, BadRequestError
 from .fake import FakeCluster
 from .objects import wrap
 from .resources import resource_for_plural
@@ -448,7 +448,21 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _do_delete(self, cluster, info, namespace, name, subresource, query):
         if not name:
-            raise NotFoundError("collection delete not supported")
+            # DELETE on the collection: client-go's deleteCollection.
+            deleted = cluster.delete_collection(
+                info.kind,
+                namespace,
+                label_selector=query.get("labelSelector") or None,
+                field_selector=query.get("fieldSelector") or None,
+                propagation_policy=query.get("propagationPolicy") or None,
+                dry_run=self._dry_run(query),
+            )
+            self._send_json(200, {
+                "apiVersion": info.api_version,
+                "kind": f"{info.kind}List",
+                "items": [o.raw for o in deleted],
+            })
+            return
         preconditions = (self._read_body() or {}).get("preconditions") or {}
         cluster.delete(
             info.kind,

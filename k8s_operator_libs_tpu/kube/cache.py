@@ -224,6 +224,24 @@ class CachedClient(Client):
             dry_run=dry_run,
         )
 
+    def delete_collection(
+        self,
+        kind: str,
+        namespace: str = "",
+        label_selector=None,
+        field_selector=None,
+        propagation_policy=None,
+        dry_run: bool = False,
+    ):
+        return self.backing.delete_collection(
+            kind,
+            namespace,
+            label_selector=label_selector,
+            field_selector=field_selector,
+            propagation_policy=propagation_policy,
+            dry_run=dry_run,
+        )
+
     def evict(
         self, pod_name: str, namespace: str = "", dry_run: bool = False
     ) -> None:

@@ -205,6 +205,24 @@ class Client(abc.ABC):
         ``precondition_*`` follow DeleteOptions.preconditions (mismatch
         answers 409 Conflict)."""
 
+    def delete_collection(
+        self,
+        kind: str,
+        namespace: str = "",
+        label_selector=None,
+        field_selector=None,
+        propagation_policy: Optional[str] = None,
+        dry_run: bool = False,
+    ) -> list[KubeObject]:
+        """client-go's deleteCollection verb: selector-scoped bulk
+        delete through the per-object pipeline (finalizers, GC,
+        dry-run). Returns the addressed objects. Implemented by
+        FakeCluster, CachedClient, and RestClient; clients without it
+        must fail fast."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support deleteCollection"
+        )
+
     @abc.abstractmethod
     def evict(
         self, pod_name: str, namespace: str = "", dry_run: bool = False

@@ -53,6 +53,11 @@ class DrainConfig:
     extra_filters: tuple[PodFilter, ...] = field(default_factory=tuple)
     #: Poll interval while waiting for evicted pods to vanish.
     poll_interval_seconds: float = 0.05
+    #: kubectl drain --dry-run=server: cordon and evictions run as
+    #: server-side dry-run (full admission, nothing persisted) and the
+    #: wait phase is skipped — the return value reports what WOULD be
+    #: evicted.
+    dry_run: bool = False
 
 
 class DrainHelper:
@@ -60,14 +65,20 @@ class DrainHelper:
         self._client = client
 
     # -- cordon ------------------------------------------------------------
-    def cordon(self, node_name: str) -> None:
-        self._set_unschedulable(node_name, True)
+    def cordon(self, node_name: str, dry_run: bool = False) -> None:
+        self._set_unschedulable(node_name, True, dry_run=dry_run)
 
-    def uncordon(self, node_name: str) -> None:
-        self._set_unschedulable(node_name, False)
+    def uncordon(self, node_name: str, dry_run: bool = False) -> None:
+        self._set_unschedulable(node_name, False, dry_run=dry_run)
 
-    def _set_unschedulable(self, node_name: str, value: bool) -> None:
-        self._client.patch("Node", node_name, patch={"spec": {"unschedulable": value}})
+    def _set_unschedulable(
+        self, node_name: str, value: bool, dry_run: bool = False
+    ) -> None:
+        self._client.patch(
+            "Node", node_name,
+            patch={"spec": {"unschedulable": value}},
+            dry_run=dry_run,
+        )
 
     # -- drain -------------------------------------------------------------
     def pods_to_evict(self, node_name: str, cfg: DrainConfig) -> list[Pod]:
@@ -121,6 +132,20 @@ class DrainHelper:
         are still present at the deadline.
         """
         cfg = cfg or DrainConfig()
+        if cfg.dry_run:
+            # kubectl drain --dry-run=server: the SAME cordon and
+            # eviction writes as a real drain, all as server dry-runs
+            # (full pipeline, nothing persisted), and nothing to wait
+            # for — report what would be evicted.
+            self.cordon(node_name, dry_run=True)
+            pods = self.pods_to_evict(node_name, cfg)
+            for pod in pods:
+                try:
+                    self._client.evict(pod.name, pod.namespace,
+                                       dry_run=True)
+                except NotFoundError:
+                    continue
+            return len(pods)
         deadline = (
             time.monotonic() + cfg.timeout_seconds if cfg.timeout_seconds else None
         )

@@ -1135,6 +1135,48 @@ class FakeCluster(Client):
                 out.append(wrap(copy.deepcopy(data)))
             return out
 
+    def delete_collection(
+        self,
+        kind: str,
+        namespace: str = "",
+        label_selector: Optional[str | Mapping[str, str]] = None,
+        field_selector: Optional[str] = None,
+        propagation_policy: Optional[str] = None,
+        dry_run: bool = False,
+    ) -> list[KubeObject]:
+        """client-go's deleteCollection verb (``kubectl delete --all`` /
+        selector-scoped bulk delete): every matching object goes through
+        the SAME per-object delete pipeline — finalizers hold objects in
+        Terminating, owner-reference GC cascades per
+        ``propagation_policy``, dry-run previews without deleting.
+        Returns the objects the call addressed (upstream returns the
+        deleted items' list)."""
+        cls = KINDS.get(kind)
+        if cls is not None and cls.NAMESPACED and not namespace:
+            # A real apiserver serves deletecollection only on the
+            # namespaced collection of a namespaced resource — the
+            # all-namespaces path answers 405. Refusing here keeps fake
+            # -validated code from silently over-deleting cluster-wide.
+            raise BadRequestError(
+                f"deleteCollection on namespaced kind {kind} requires a "
+                "namespace (all-namespaces deletecollection is not served "
+                "by a real apiserver)"
+            )
+        matched = self.list(
+            kind, namespace,
+            label_selector=label_selector, field_selector=field_selector,
+        )
+        for obj in matched:
+            try:
+                self.delete(
+                    kind, obj.name, obj.namespace,
+                    propagation_policy=propagation_policy,
+                    dry_run=dry_run,
+                )
+            except NotFoundError:
+                continue  # raced with another deleter: already gone
+        return matched
+
     def list_with_revision(
         self,
         kind: str,

@@ -815,6 +815,35 @@ class RestClient(Client):
             body=body,
         )
 
+    def delete_collection(
+        self,
+        kind: str,
+        namespace: str = "",
+        label_selector=None,
+        field_selector=None,
+        propagation_policy: Optional[str] = None,
+        dry_run: bool = False,
+    ) -> list[KubeObject]:
+        """client-go deleteCollection: DELETE on the collection path,
+        selector-scoped. Returns the items the server addressed."""
+        info = resource_for_kind(kind)
+        query = self._selector_query(label_selector, field_selector)
+        if propagation_policy:
+            query["propagationPolicy"] = propagation_policy
+        if dry_run:
+            query["dryRun"] = "All"
+        # _path (not _collection_path): a real apiserver serves
+        # deletecollection only on the NAMESPACED collection of a
+        # namespaced resource — the all-namespaces path answers 405 —
+        # so an empty namespace falls back to config.namespace exactly
+        # like every other write verb.
+        doc = self._request(
+            "DELETE",
+            self._path(info, namespace),
+            query=query or None,
+        )
+        return [wrap(item) for item in (doc or {}).get("items", [])]
+
     def evict(
         self, pod_name: str, namespace: str = "", dry_run: bool = False
     ) -> None:

@@ -101,6 +101,20 @@ class TestDrainFilters:
         h.uncordon("n1")
         assert not cluster.get("Node", "n1").unschedulable
 
+    def test_drain_dry_run_reports_without_evicting(self, cluster):
+        """kubectl drain --dry-run=server: the count of would-be-evicted
+        pods comes back, but the node stays schedulable and every pod
+        stays put."""
+        ds = cluster.create(make_daemonset("driver"))
+        cluster.create(make_node("n1"))
+        cluster.create(make_pod("driver-pod", node_name="n1", owner=ds))
+        cluster.create(make_pod("workload", node_name="n1", controlled=True))
+        h = self.make_helper(cluster)
+        would_evict = h.drain("n1", DrainConfig(dry_run=True))
+        assert would_evict == 1
+        assert not cluster.get("Node", "n1").unschedulable
+        assert cluster.get_or_none("Pod", "workload", "driver-ns") is not None
+
     def test_daemonset_pods_skipped(self, cluster):
         ds = cluster.create(make_daemonset("driver"))
         cluster.create(make_node("n1"))

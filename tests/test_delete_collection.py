@@ -1,0 +1,133 @@
+"""deleteCollection — selector-scoped bulk delete through the
+per-object pipeline (finalizers, owner GC, dry-run), on all three
+client layers.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from builders import make_node, make_pod
+from k8s_operator_libs_tpu.kube import (
+    CachedClient,
+    FakeCluster,
+    LocalApiServer,
+    NotFoundError,
+    RestClient,
+    RestConfig,
+)
+
+
+def seed(cluster):
+    cluster.create(make_node("keep", labels={"team": "gpu"}))
+    cluster.create(make_node("drop-1", labels={"team": "tpu"}))
+    cluster.create(make_node("drop-2", labels={"team": "tpu"}))
+
+
+class TestFakeCluster:
+    def test_selector_scoped(self):
+        cluster = FakeCluster()
+        seed(cluster)
+        deleted = cluster.delete_collection(
+            "Node", label_selector="team=tpu"
+        )
+        assert sorted(o.name for o in deleted) == ["drop-1", "drop-2"]
+        assert cluster.get("Node", "keep")
+        with pytest.raises(NotFoundError):
+            cluster.get("Node", "drop-1")
+
+    def test_namespace_scoped(self):
+        cluster = FakeCluster()
+        cluster.create(make_pod("a", namespace="one"))
+        cluster.create(make_pod("b", namespace="two"))
+        deleted = cluster.delete_collection("Pod", namespace="one")
+        assert [o.name for o in deleted] == ["a"]
+        assert cluster.get("Pod", "b", "two")
+
+    def test_finalizers_hold_objects_in_terminating(self):
+        cluster = FakeCluster()
+        pod = make_pod("held", namespace="ns")
+        pod.metadata["finalizers"] = ["example.com/hold"]
+        cluster.create(pod)
+        cluster.delete_collection("Pod", namespace="ns")
+        live = cluster.get("Pod", "held", "ns")
+        assert live.deletion_timestamp is not None  # Terminating, not gone
+
+    def test_namespaced_kind_requires_namespace(self):
+        """Real-apiserver parity: deletecollection is not served on the
+        all-namespaces path — an empty namespace on a namespaced kind
+        is refused instead of silently deleting cluster-wide."""
+        from k8s_operator_libs_tpu.kube import BadRequestError
+
+        cluster = FakeCluster()
+        cluster.create(make_pod("a", namespace="one"))
+        with pytest.raises(BadRequestError):
+            cluster.delete_collection("Pod")
+        assert cluster.get("Pod", "a", "one")
+
+    def test_rest_client_defaults_namespace_like_other_verbs(self):
+        """RestClient falls back to config.namespace for namespaced
+        kinds, mirroring every other write verb."""
+        server = LocalApiServer().start()
+        try:
+            client = RestClient(
+                RestConfig(server=server.url, namespace="one")
+            )
+            server.cluster.create(make_pod("a", namespace="one"))
+            server.cluster.create(make_pod("b", namespace="two"))
+            deleted = client.delete_collection("Pod")
+            assert [o.name for o in deleted] == ["a"]
+            assert client.get("Pod", "b", "two")
+        finally:
+            server.stop()
+
+    def test_dry_run_deletes_nothing(self):
+        cluster = FakeCluster()
+        seed(cluster)
+        deleted = cluster.delete_collection(
+            "Node", label_selector="team=tpu", dry_run=True
+        )
+        assert len(deleted) == 2
+        assert cluster.get("Node", "drop-1")
+        assert cluster.get("Node", "drop-2")
+
+    def test_gc_cascades_per_object(self):
+        cluster = FakeCluster()
+        owner = cluster.create(make_node("owner", labels={"bulk": "yes"}))
+        dependent = make_pod("dep", namespace="ns")
+        dependent.add_owner_reference(owner)
+        cluster.create(dependent)
+        cluster.delete_collection("Node", label_selector="bulk=yes")
+        with pytest.raises(NotFoundError):
+            cluster.get("Pod", "dep", "ns")
+
+
+class TestOverHttp:
+    def test_wire_collection_delete(self):
+        server = LocalApiServer().start()
+        try:
+            client = RestClient(RestConfig(server=server.url))
+            seed(server.cluster)
+            deleted = client.delete_collection(
+                "Node", label_selector="team=tpu"
+            )
+            assert sorted(o.name for o in deleted) == ["drop-1", "drop-2"]
+            assert client.get("Node", "keep")
+            with pytest.raises(NotFoundError):
+                client.get("Node", "drop-1")
+            # Dry-run over the wire.
+            deleted = client.delete_collection(
+                "Node", label_selector="team=gpu", dry_run=True
+            )
+            assert [o.name for o in deleted] == ["keep"]
+            assert client.get("Node", "keep")
+            # CachedClient passes through.
+            cached = CachedClient(client)
+            assert [
+                o.name
+                for o in cached.delete_collection(
+                    "Node", label_selector="team=gpu", dry_run=True
+                )
+            ] == ["keep"]
+        finally:
+            server.stop()
