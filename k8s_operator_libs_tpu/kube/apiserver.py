@@ -28,6 +28,7 @@ from .client import ApiError, BadRequestError
 from .fake import FakeCluster
 from .objects import wrap
 from .resources import resource_for_plural
+from .table import accepts_table, render_table
 
 _PATH_RE = re.compile(
     r"^/(?:api|apis)(?:/(?P<group>[^/]+(?:\.[^/]+)*))?/(?P<version>v[^/]+)"
@@ -169,8 +170,13 @@ class _Handler(BaseHTTPRequestHandler):
         if not name and query.get("watch") in ("true", "1"):
             self._do_watch(cluster, info, namespace, query)
             return
+        as_table = accepts_table(self.headers.get("Accept", ""))
         if name:
             obj = cluster.get(info.kind, name, namespace)
+            if as_table:
+                self._send_json(200, self._table(cluster, info, [obj.raw],
+                                                 query))
+                return
             self._send_json(200, obj.raw)
             return
         try:
@@ -194,6 +200,12 @@ class _Handler(BaseHTTPRequestHandler):
             metadata["continue"] = next_continue
         if remaining is not None:
             metadata["remainingItemCount"] = remaining
+        if as_table:
+            self._send_json(200, self._table(
+                cluster, info, [o.raw for o in items], query,
+                list_metadata=metadata,
+            ))
+            return
         self._send_json(
             200,
             {
@@ -202,6 +214,22 @@ class _Handler(BaseHTTPRequestHandler):
                 "metadata": metadata,
                 "items": [o.raw for o in items],
             },
+        )
+
+    @staticmethod
+    def _table(cluster, info, raws, query, list_metadata=None):
+        include_object = query.get("includeObject", "") or "Metadata"
+        if include_object not in ("Metadata", "Object", "None"):
+            raise BadRequestError(
+                f"invalid includeObject value {include_object!r}"
+            )
+        return render_table(
+            raws,
+            crd_columns=cluster.printer_columns(
+                info.kind, info.api_version
+            ),
+            include_object=include_object,
+            list_metadata=list_metadata,
         )
 
     @staticmethod

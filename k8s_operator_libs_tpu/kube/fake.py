@@ -987,29 +987,7 @@ class FakeCluster(Client):
         api_version = data.get("apiVersion") or ""
         group, _, version = api_version.rpartition("/")
         kind = data.get("kind", "")
-        crd = None
-        try:
-            plural = resource_for_kind(kind).plural
-        except KeyError:
-            pass
-        else:
-            crd = self._store.get(
-                ("CustomResourceDefinition", "", f"{plural}.{group}")
-            )
-        if crd is None and not self._crds_ever_stored:
-            return  # schema-less cluster: skip the store scan entirely
-        if crd is None:
-            # Unregistered (or irregularly-pluralized) kinds: the stored
-            # CRDs themselves are the authoritative group/kind mapping.
-            for key, stored in self._store.items():
-                if key[0] != "CustomResourceDefinition":
-                    continue
-                spec = stored.get("spec") or {}
-                if spec.get("group") == group and (
-                    (spec.get("names") or {}).get("kind") == kind
-                ):
-                    crd = stored
-                    break
+        crd = self._crd_for_locked(group, kind)
         if crd is None:
             return
         schema = schema_for_crd_version(crd, version)
@@ -1030,6 +1008,54 @@ class FakeCluster(Client):
             raise InvalidError(
                 f"{kind}.{group} {name!r} is invalid: " + "; ".join(errors)
             )
+
+    def _crd_for_locked(self, group: str, kind: str):
+        """The stored CRD backing ``group``/``kind``, or None. Direct
+        keyed lookup via the resource registry's plural first; stored
+        CRDs themselves are the authoritative fallback mapping for
+        unregistered or irregularly-pluralized kinds."""
+        try:
+            plural = resource_for_kind(kind).plural
+        except KeyError:
+            pass
+        else:
+            crd = self._store.get(
+                ("CustomResourceDefinition", "", f"{plural}.{group}")
+            )
+            if crd is not None:
+                return crd
+        if not self._crds_ever_stored:
+            return None  # schema-less cluster: skip the store scan
+        for key, stored in self._store.items():
+            if key[0] != "CustomResourceDefinition":
+                continue
+            spec = stored.get("spec") or {}
+            if spec.get("group") == group and (
+                (spec.get("names") or {}).get("kind") == kind
+            ):
+                return stored
+        return None
+
+    def printer_columns(
+        self, kind: str, api_version: str
+    ) -> Optional[list[dict[str, Any]]]:
+        """The ``additionalPrinterColumns`` a stored CRD declares for
+        this kind's served version — what the Table transform renders
+        (reference fixture: hack/crd/bases/maintenance.nvidia.com_
+        nodemaintenances.yaml:17-31). None for built-ins or unknown
+        kinds."""
+        group, _, version = api_version.rpartition("/")
+        if not group:
+            return None
+        with self._lock:
+            crd = self._crd_for_locked(group, kind)
+            if crd is None:
+                return None
+            for v in (crd.get("spec") or {}).get("versions") or []:
+                if v.get("name") == version:
+                    cols = v.get("additionalPrinterColumns") or []
+                    return copy.deepcopy(cols)
+        return None
 
     def _admit_or_restore_locked(
         self,

@@ -1,0 +1,216 @@
+"""Table transform + printer-column JSONPath — kubectl get's wire shape.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import time
+import urllib.request
+
+import pytest
+import yaml
+
+from k8s_operator_libs_tpu.kube import (
+    FakeCluster,
+    LocalApiServer,
+    NodeMaintenance,
+    wrap,
+)
+from k8s_operator_libs_tpu.kube.jsonpath import evaluate
+from k8s_operator_libs_tpu.kube.table import (
+    accepts_table,
+    render_table,
+)
+
+MANIFESTS = pathlib.Path(__file__).resolve().parent.parent / "manifests/crds"
+
+
+class TestJsonPath:
+    OBJ = {
+        "metadata": {"name": "x"},
+        "spec": {"nodeName": "n1", "list": [{"a": 1}, {"a": 2}]},
+        "status": {
+            "conditions": [
+                {"type": "Ready", "status": "True", "reason": "Done"},
+                {"type": "Failed", "status": "False"},
+            ]
+        },
+    }
+
+    def test_dotted(self):
+        assert evaluate(".spec.nodeName", self.OBJ) == ["n1"]
+        assert evaluate(".metadata.name", self.OBJ) == ["x"]
+        assert evaluate("{.spec.nodeName}", self.OBJ) == ["n1"]
+
+    def test_missing_is_empty(self):
+        assert evaluate(".spec.ghost.deeper", self.OBJ) == []
+
+    def test_index_and_wildcard(self):
+        assert evaluate(".spec.list[0].a", self.OBJ) == [1]
+        assert evaluate(".spec.list[-1].a", self.OBJ) == [2]
+        assert evaluate(".spec.list[*].a", self.OBJ) == [1, 2]
+        assert evaluate(".spec.list[9].a", self.OBJ) == []
+
+    def test_filter_expression(self):
+        assert evaluate(
+            ".status.conditions[?(@.type=='Ready')].status", self.OBJ
+        ) == ["True"]
+        assert evaluate(
+            '.status.conditions[?(@.type=="Failed")].status', self.OBJ
+        ) == ["False"]
+        assert evaluate(
+            ".status.conditions[?(@.type=='Ghost')].status", self.OBJ
+        ) == []
+
+
+class TestAcceptNegotiation:
+    def test_kubectl_accept_header(self):
+        assert accepts_table(
+            "application/json;as=Table;v=v1;g=meta.k8s.io,application/json"
+        )
+        assert not accepts_table("application/json")
+        assert not accepts_table("")
+
+
+class TestRenderTable:
+    def test_columns_and_cells(self):
+        raw = {
+            "metadata": {"name": "nm-1",
+                         "creationTimestamp": time.time() - 90},
+            "spec": {"nodeName": "n1"},
+        }
+        table = render_table(
+            [raw],
+            crd_columns=[
+                {"jsonPath": ".spec.nodeName", "name": "Node",
+                 "type": "string", "priority": 1},
+                {"jsonPath": ".spec.ghost", "name": "Ghost",
+                 "type": "string"},
+            ],
+        )
+        assert table["kind"] == "Table"
+        assert [c["name"] for c in table["columnDefinitions"]] == [
+            "Name", "Node", "Ghost", "Age",
+        ]
+        # Served definitions: jsonPath (CRD-spec field) never leaks;
+        # priority (real TableColumnDefinition field) survives.
+        assert all("jsonPath" not in c for c in table["columnDefinitions"])
+        assert table["columnDefinitions"][1]["priority"] == 1
+        cells = table["rows"][0]["cells"]
+        assert cells[0] == "nm-1"
+        assert cells[1] == "n1"
+        assert cells[2] == "<none>"
+        assert cells[3].endswith("s")  # 90s age
+        # Default include: PartialObjectMetadata.
+        assert table["rows"][0]["object"]["kind"] == "PartialObjectMetadata"
+
+    def test_include_object_modes(self):
+        raw = {"metadata": {"name": "a"}, "spec": {"x": 1}}
+        full = render_table([raw], include_object="Object")
+        assert full["rows"][0]["object"]["spec"] == {"x": 1}
+        none = render_table([raw], include_object="None")
+        assert "object" not in none["rows"][0]
+
+
+class TestOverHttp:
+    def make_nm(self, name, ready):
+        obj = NodeMaintenance.new(name, namespace="default")
+        obj.spec["nodeName"] = f"node-for-{name}"
+        obj.spec["requestorID"] = "op"
+        obj.status["conditions"] = [
+            {"type": "Ready", "status": "True" if ready else "False",
+             "reason": "Ready" if ready else "Draining"}
+        ]
+        return obj
+
+    def test_kubectl_get_shape_with_crd_columns(self):
+        server = LocalApiServer().start()
+        try:
+            crd = yaml.safe_load(
+                (MANIFESTS / "nodemaintenances.yaml").read_text()
+            )
+            server.cluster.create(wrap(crd))
+            server.cluster.create(self.make_nm("ready-one", True))
+            server.cluster.create(self.make_nm("draining-one", False))
+            req = urllib.request.Request(
+                server.url
+                + "/apis/maintenance.nvidia.com/v1alpha1/namespaces/"
+                  "default/nodemaintenances",
+                headers={"Accept":
+                         "application/json;as=Table;v=v1;g=meta.k8s.io"},
+            )
+            with urllib.request.urlopen(req) as resp:
+                table = json.load(resp)
+            assert table["kind"] == "Table"
+            names = [c["name"] for c in table["columnDefinitions"]]
+            # Name + the CRD's four printer columns + Age.
+            assert names == [
+                "Name", "Node", "Requestor", "Ready", "Phase", "Age",
+            ]
+            by_name = {row["cells"][0]: row["cells"]
+                       for row in table["rows"]}
+            assert by_name["ready-one"][1:4] == [
+                "node-for-ready-one", "op", "True",
+            ]
+            assert by_name["ready-one"][4] == "Ready"
+            assert by_name["draining-one"][3] == "False"
+            assert by_name["draining-one"][4] == "Draining"
+        finally:
+            server.stop()
+
+    def test_single_get_as_table_and_include_object(self):
+        server = LocalApiServer().start()
+        try:
+            server.cluster.create(self.make_nm("solo", True))
+            url = (
+                server.url
+                + "/apis/maintenance.nvidia.com/v1alpha1/namespaces/"
+                  "default/nodemaintenances/solo"
+            )
+            req = urllib.request.Request(
+                url + "?includeObject=Object",
+                headers={"Accept": "application/json;as=Table"},
+            )
+            with urllib.request.urlopen(req) as resp:
+                table = json.load(resp)
+            assert len(table["rows"]) == 1
+            # No CRD stored: Name/Age fallback columns only.
+            assert [c["name"] for c in table["columnDefinitions"]] == [
+                "Name", "Age",
+            ]
+            assert table["rows"][0]["object"]["spec"]["nodeName"] == (
+                "node-for-solo"
+            )
+            # Plain Accept still gets the raw object (no accidental
+            # table for normal clients).
+            with urllib.request.urlopen(url) as resp:
+                raw = json.load(resp)
+            assert raw["kind"] == "NodeMaintenance"
+            # Invalid includeObject answers 400.
+            req = urllib.request.Request(
+                url + "?includeObject=Bogus",
+                headers={"Accept": "application/json;as=Table"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req)
+            assert exc.value.code == 400
+        finally:
+            server.stop()
+
+    def test_printer_columns_lookup(self):
+        cluster = FakeCluster()
+        assert cluster.printer_columns(
+            "NodeMaintenance", "maintenance.nvidia.com/v1alpha1"
+        ) is None
+        crd = yaml.safe_load(
+            (MANIFESTS / "nodemaintenances.yaml").read_text()
+        )
+        cluster.create(wrap(crd))
+        cols = cluster.printer_columns(
+            "NodeMaintenance", "maintenance.nvidia.com/v1alpha1"
+        )
+        assert [c["name"] for c in cols] == [
+            "Node", "Requestor", "Ready", "Phase",
+        ]
+        assert cluster.printer_columns("Node", "v1") is None  # built-in
