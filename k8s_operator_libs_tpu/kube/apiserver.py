@@ -27,7 +27,7 @@ from typing import Any, Optional
 from .client import ApiError, BadRequestError
 from .fake import FakeCluster
 from .objects import wrap
-from .resources import resource_for_plural
+from .resources import ResourceInfo, resource_for_plural
 from .table import accepts_table, render_table
 
 _PATH_RE = re.compile(
@@ -97,6 +97,26 @@ class _Handler(BaseHTTPRequestHandler):
             info = resource_for_plural(group, m.group("plural"))
         except KeyError:
             return None
+        version = m.group("version")
+        requested_gv = f"{group}/{version}" if group else version
+        if info.api_version != requested_gv:
+            # The URL names a version the registry doesn't serve this
+            # resource at. A real apiserver routes per served
+            # group/version — accept only if discovery says a stored
+            # CRD serves the plural at that version; otherwise 404.
+            try:
+                served = self.server.cluster.discover(group, version)
+            except ApiError:
+                return None
+            if not any(
+                r.get("name") == m.group("plural") for r in served
+            ):
+                return None
+            # Downstream (list apiVersion, printer columns) must speak
+            # the REQUESTED version, not the registry's default.
+            info = ResourceInfo(
+                info.kind, requested_gv, info.plural, info.namespaced
+            )
         query = dict(urllib.parse.parse_qsl(parsed.query))
         return (
             info,

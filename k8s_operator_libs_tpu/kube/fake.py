@@ -46,6 +46,7 @@ from .objects import (
 from .resources import resource_for_kind
 from .selectors import LabelSelector, parse_field_selector, parse_selector
 from .ssa import reassign_on_write, server_side_apply
+from .jsonpath import dotted_value
 from .structural import error_root_field, schema_for_crd_version
 
 #: reactor signature: (verb, kind, payload) -> None; raise to inject a failure.
@@ -611,12 +612,7 @@ def json_patch(target: dict[str, Any], ops: Any) -> dict[str, Any]:
 
 
 def _field_value(data: Mapping[str, Any], dotted: str) -> Any:
-    cur: Any = data
-    for part in dotted.split("."):
-        if not isinstance(cur, Mapping):
-            return None
-        cur = cur.get(part)
-    return cur
+    return dotted_value(data, dotted)
 
 
 def classify_watch_event(

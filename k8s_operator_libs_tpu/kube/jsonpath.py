@@ -22,7 +22,7 @@ missing paths yield an empty list, never an error — a cell renders as
 from __future__ import annotations
 
 import re
-from typing import Any
+from typing import Any, Mapping
 
 _FILTER_RE = re.compile(
     r"^\?\(@\.(?P<path>[^=!<>]+?)\s*==\s*"
@@ -67,9 +67,13 @@ def _tokenize(path: str) -> list[str]:
     return tokens
 
 
-def _dotted(obj: Any, dotted_path: str) -> Any:
+def dotted_value(obj: Any, dotted_path: str) -> Any:
+    """Walk a plain dotted path (``spec.nodeName``); None when any
+    segment is missing. Shared with the field-selector traversal in
+    ``fake.py``/``cache.py`` — one implementation for all dotted
+    walks."""
     for part in dotted_path.strip().split("."):
-        if not isinstance(obj, dict):
+        if not isinstance(obj, Mapping):
             return None
         obj = obj.get(part)
     return obj
@@ -95,7 +99,7 @@ def _apply_token(values: list[Any], token: str) -> list[Any]:
                 want = m.group("sq") if m.group("sq") is not None else m.group("dq")
                 for element in value:
                     if isinstance(element, dict) and str(
-                        _dotted(element, m.group("path"))
+                        dotted_value(element, m.group("path"))
                     ) == want:
                         out.append(element)
         return out

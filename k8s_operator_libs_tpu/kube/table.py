@@ -86,8 +86,12 @@ def render_table(
     """Render objects as a ``meta.k8s.io/v1 Table``."""
     columns = [dict(_NAME_COLUMN)]
     if crd_columns:
+        # A CRD with additionalPrinterColumns gets Name + exactly its
+        # declared columns — a real apiserver adds no implicit Age there
+        # (most controller-gen CRDs declare their own Age column).
         columns.extend(dict(c) for c in crd_columns)
-    columns.append(dict(_AGE_COLUMN))
+    else:
+        columns.append(dict(_AGE_COLUMN))
     rows = []
     for raw in items:
         if include_object == "Object":

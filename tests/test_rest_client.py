@@ -169,6 +169,46 @@ class TestDiscovery:
         assert doc["kind"] == "APIResourceList"
 
 
+class TestVersionRouting:
+    def test_unserved_version_is_404(self, server, client):
+        """A real apiserver routes per served group/version: a URL
+        naming a version nothing serves must 404, not silently resolve
+        to whatever version the resource is stored at."""
+        import json
+        import urllib.error
+        import urllib.request
+
+        import pytest
+
+        from builders import make_node
+
+        server.cluster.create(make_node("routed"))
+        # The registered version serves.
+        with urllib.request.urlopen(
+            server.url + "/api/v1/nodes/routed"
+        ) as resp:
+            assert json.load(resp)["metadata"]["name"] == "routed"
+        # A bogus core version does not.
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(server.url + "/api/v9/nodes/routed")
+        assert exc.value.code == 404
+        # Same for a CRD-backed group at an unserved version.
+        nm_path = (
+            "/apis/maintenance.nvidia.com/{v}/namespaces/default/"
+            "nodemaintenances"
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                server.url + nm_path.format(v="v9beta9")
+            )
+        assert exc.value.code == 404
+        # The served version still routes (list succeeds).
+        with urllib.request.urlopen(
+            server.url + nm_path.format(v="v1alpha1")
+        ) as resp:
+            assert json.load(resp)["kind"] == "NodeMaintenanceList"
+
+
 class TestAuth:
     def test_bearer_token_required_and_accepted(self):
         with LocalApiServer(token="sekrit") as srv:

@@ -90,18 +90,23 @@ class TestRenderTable:
             ],
         )
         assert table["kind"] == "Table"
+        # Custom columns: Name + exactly the declared set, NO implicit
+        # Age (a real apiserver adds Age only when the CRD declares it).
         assert [c["name"] for c in table["columnDefinitions"]] == [
-            "Name", "Node", "Ghost", "Age",
+            "Name", "Node", "Ghost",
         ]
         # Served definitions: jsonPath (CRD-spec field) never leaks;
         # priority (real TableColumnDefinition field) survives.
         assert all("jsonPath" not in c for c in table["columnDefinitions"])
         assert table["columnDefinitions"][1]["priority"] == 1
         cells = table["rows"][0]["cells"]
-        assert cells[0] == "nm-1"
-        assert cells[1] == "n1"
-        assert cells[2] == "<none>"
-        assert cells[3].endswith("s")  # 90s age
+        assert cells == ["nm-1", "n1", "<none>"]
+        # The no-custom-columns fallback carries Age.
+        fallback = render_table([raw])
+        assert [c["name"] for c in fallback["columnDefinitions"]] == [
+            "Name", "Age",
+        ]
+        assert fallback["rows"][0]["cells"][1].endswith("s")  # 90s age
         # Default include: PartialObjectMetadata.
         assert table["rows"][0]["object"]["kind"] == "PartialObjectMetadata"
 
@@ -144,9 +149,9 @@ class TestOverHttp:
                 table = json.load(resp)
             assert table["kind"] == "Table"
             names = [c["name"] for c in table["columnDefinitions"]]
-            # Name + the CRD's four printer columns + Age.
+            # Name + the CRD's four printer columns (no implicit Age).
             assert names == [
-                "Name", "Node", "Requestor", "Ready", "Phase", "Age",
+                "Name", "Node", "Requestor", "Ready", "Phase",
             ]
             by_name = {row["cells"][0]: row["cells"]
                        for row in table["rows"]}
