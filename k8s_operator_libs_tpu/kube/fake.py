@@ -47,7 +47,11 @@ from .resources import resource_for_kind
 from .selectors import LabelSelector, parse_field_selector, parse_selector
 from .ssa import reassign_on_write, server_side_apply
 from .jsonpath import dotted_value
-from .structural import error_root_field, schema_for_crd_version
+from .structural import (
+    error_root_field,
+    schema_for_crd_version,
+    validate_crd_structural,
+)
 
 #: reactor signature: (verb, kind, payload) -> None; raise to inject a failure.
 Reactor = Callable[[str, str, dict[str, Any]], None]
@@ -978,6 +982,19 @@ class FakeCluster(Client):
         Built-in groups and kinds with no stored CRD are untouched, so a
         schema-less cluster behaves exactly as before (the same
         activation rule server-side apply uses)."""
+        if data.get("kind") == "CustomResourceDefinition":
+            # The CRD itself is admitted too: upstream rejects v1 CRDs
+            # whose declared schemas are not structural. Runs at this
+            # one chokepoint so every write verb (and its atomicity
+            # handling) covers it.
+            crd_errors = validate_crd_structural(data)
+            if crd_errors:
+                name = (data.get("metadata") or {}).get("name", "")
+                raise InvalidError(
+                    f"CustomResourceDefinition.apiextensions.k8s.io "
+                    f"{name!r} is invalid: " + "; ".join(crd_errors)
+                )
+            return
         if _supports_strategic(data):
             return  # built-in group: typed, never CRD-backed
         api_version = data.get("apiVersion") or ""

@@ -40,6 +40,148 @@ from typing import Any, Mapping, Optional
 _ROOT_SERVER_KEYS = frozenset({"apiVersion", "kind", "metadata"})
 
 
+def validate_crd_structural(crd_data: Mapping[str, Any]) -> list[str]:
+    """apiextensions' structural-schema requirements for the CRD object
+    itself — upstream rejects a v1 CRD whose declared schemas are not
+    structural. Checked for EVERY version carrying a schema — served or
+    not, like upstream (a version with NO schema stays admitted,
+    matching this package's schema-less activation rule):
+
+    * the root must be ``type: object``;
+    * every specified field must declare a ``type`` (or be
+      int-or-string / preserve-unknown-fields); an empty field schema
+      is rejected, and a typeless node is tolerated only when its
+      constraints live entirely in junctors;
+    * ``items`` must be a single schema (upstream forbids the array
+      form);
+    * ``properties`` and ``additionalProperties`` are mutually
+      exclusive on one node; ``additionalProperties`` must be ``true``
+      or a schema (``false`` is upstream-invalid);
+    * inside junctor subtrees (allOf/anyOf/oneOf/not), ``type``,
+      ``additionalProperties``, and ``default`` are forbidden —
+      upstream exempts junctors from the type REQUIREMENT but forbids
+      those keywords there."""
+    errors: list[str] = []
+    for v in (crd_data.get("spec") or {}).get("versions") or []:
+        raw = ((v.get("schema") or {}).get("openAPIV3Schema")) or None
+        if not raw:
+            continue
+        version = v.get("name", "?")
+        if raw.get("type") != "object":
+            errors.append(
+                f"spec.versions[{version}].schema.openAPIV3Schema.type: "
+                "Required value: must be object"
+            )
+        _check_structural(raw, f"spec.versions[{version}].schema"
+                               ".openAPIV3Schema", errors, root=True)
+    return errors
+
+
+_JUNCTORS = ("allOf", "anyOf", "oneOf", "not")
+
+
+def _check_structural(
+    node: Any, path: str, errors: list[str], root: bool = False
+) -> None:
+    if not isinstance(node, Mapping):
+        return
+    props = node.get("properties")
+    addl = node.get("additionalProperties")
+    items = node.get("items")
+    if props is not None and addl is not None:
+        errors.append(
+            f"{path}: Forbidden: properties and additionalProperties "
+            "are mutually exclusive"
+        )
+    if addl is False:
+        errors.append(
+            f"{path}.additionalProperties: Forbidden: must be true or "
+            "a schema"
+        )
+    if isinstance(items, list):
+        errors.append(
+            f"{path}.items: Forbidden: must be a schema, not an array "
+            "of schemas"
+        )
+        items = None
+    typed = (
+        node.get("type")
+        or node.get("x-kubernetes-int-or-string")
+        or node.get("x-kubernetes-preserve-unknown-fields")
+    )
+    has_core = (
+        props is not None or addl is not None or items is not None
+    )
+    has_junctor = any(j in node for j in _JUNCTORS)
+    if not typed and not root:
+        if has_core:
+            errors.append(f"{path}.type: Required value")
+        elif not has_junctor:
+            errors.append(
+                f"{path}: Required value: must not be empty for "
+                "specified fields"
+            )
+    if isinstance(props, Mapping):
+        for key, sub in props.items():
+            _check_structural(sub, f"{path}.properties[{key}]", errors)
+    if isinstance(addl, Mapping):
+        _check_structural(addl, f"{path}.additionalProperties", errors)
+    if isinstance(items, Mapping):
+        _check_structural(items, f"{path}.items", errors)
+    # x-kubernetes-int-or-string carries upstream's one junctor-type
+    # exception: its canonical anyOf [{type: integer}, {type: string}]
+    # branches may name types.
+    allow_type = bool(node.get("x-kubernetes-int-or-string"))
+    for junctor in _JUNCTORS:
+        subtree = node.get(junctor)
+        branches = (
+            [subtree] if isinstance(subtree, Mapping)
+            else subtree if isinstance(subtree, list) else []
+        )
+        for i, branch in enumerate(branches):
+            _check_junctor(
+                branch, f"{path}.{junctor}[{i}]", errors,
+                allow_type=allow_type,
+            )
+
+
+def _check_junctor(
+    node: Any, path: str, errors: list[str], allow_type: bool = False
+) -> None:
+    """Inside allOf/anyOf/oneOf/not: value validations only — type,
+    additionalProperties, and default are forbidden (apiextensions'
+    junctor rules; ``allow_type`` covers the int-or-string
+    exception)."""
+    if not isinstance(node, Mapping):
+        return
+    forbidden_keys = ("additionalProperties", "default") if allow_type \
+        else ("type", "additionalProperties", "default")
+    for forbidden in forbidden_keys:
+        if forbidden in node:
+            errors.append(
+                f"{path}.{forbidden}: Forbidden: must not be set "
+                "inside allOf/anyOf/oneOf/not"
+            )
+    props = node.get("properties")
+    if isinstance(props, Mapping):
+        for key, sub in props.items():
+            _check_junctor(sub, f"{path}.properties[{key}]", errors)
+    items = node.get("items")
+    if isinstance(items, Mapping):
+        _check_junctor(items, f"{path}.items", errors)
+    for junctor in _JUNCTORS:
+        subtree = node.get(junctor)
+        branches = (
+            [subtree] if isinstance(subtree, Mapping)
+            else subtree if isinstance(subtree, list) else []
+        )
+        for i, branch in enumerate(branches):
+            # allow_type propagates through nested junctors: the other
+            # canonical int-or-string wrap is allOf -> anyOf -> types.
+            _check_junctor(branch, f"{path}.{junctor}[{i}]", errors,
+                           allow_type=allow_type)
+
+
 def error_root_field(error: str) -> str:
     """The root field segment of a validation error's path — the text
     before the first ``.``, ``[``, or ``:``. Used for exact-field

@@ -586,6 +586,181 @@ class TestFakeClusterAdmission:
         assert schema_for_crd_version(crd.raw, "v9") is None
 
 
+class TestCrdStructuralAdmission:
+    """The CRD object itself is admitted: non-structural schemas 422."""
+
+    def base_crd(self, schema):
+        return KubeObject({
+            "apiVersion": "apiextensions.k8s.io/v1",
+            "kind": "CustomResourceDefinition",
+            "metadata": {"name": "things.example.dev"},
+            "spec": {
+                "group": "example.dev",
+                "scope": "Namespaced",
+                "names": {"kind": "Thing", "plural": "things"},
+                "versions": [{
+                    "name": "v1", "served": True, "storage": True,
+                    "schema": {"openAPIV3Schema": schema},
+                }],
+            },
+        })
+
+    def test_root_must_be_object(self):
+        cluster = FakeCluster()
+        with pytest.raises(InvalidError) as exc:
+            cluster.create(self.base_crd({"type": "string"}))
+        assert "must be object" in str(exc.value)
+
+    def test_shaping_node_requires_type(self):
+        cluster = FakeCluster()
+        with pytest.raises(InvalidError) as exc:
+            cluster.create(self.base_crd({
+                "type": "object",
+                "properties": {
+                    "spec": {"properties": {"x": {"type": "string"}}},
+                },
+            }))
+        assert "properties[spec].type: Required value" in str(exc.value)
+
+    def test_properties_additional_properties_exclusive(self):
+        cluster = FakeCluster()
+        with pytest.raises(InvalidError) as exc:
+            cluster.create(self.base_crd({
+                "type": "object",
+                "properties": {"a": {"type": "string"}},
+                "additionalProperties": {"type": "string"},
+            }))
+        assert "mutually exclusive" in str(exc.value)
+        with pytest.raises(InvalidError):
+            cluster.create(self.base_crd({
+                "type": "object",
+                "properties": {
+                    "spec": {"type": "object",
+                             "additionalProperties": False},
+                },
+            }))
+
+    def test_empty_field_schema_rejected(self):
+        """Upstream rejects an empty schema for a specified field."""
+        cluster = FakeCluster()
+        with pytest.raises(InvalidError) as exc:
+            cluster.create(self.base_crd({
+                "type": "object",
+                "properties": {
+                    "spec": {"type": "object",
+                             "properties": {"replicas": {}}},
+                },
+            }))
+        assert "must not be empty" in str(exc.value)
+
+    def test_array_form_items_rejected(self):
+        cluster = FakeCluster()
+        with pytest.raises(InvalidError) as exc:
+            cluster.create(self.base_crd({
+                "type": "object",
+                "properties": {
+                    "tags": {"type": "array",
+                             "items": [{"type": "string"}]},
+                },
+            }))
+        assert "not an array of schemas" in str(exc.value)
+
+    def test_type_forbidden_inside_junctors(self):
+        cluster = FakeCluster()
+        with pytest.raises(InvalidError) as exc:
+            cluster.create(self.base_crd({
+                "type": "object",
+                "properties": {
+                    "v": {"anyOf": [{"type": "string",
+                                     "additionalProperties": False}]},
+                },
+            }))
+        message = str(exc.value)
+        assert "anyOf[0].type: Forbidden" in message
+        assert "anyOf[0].additionalProperties: Forbidden" in message
+
+    def test_int_or_string_junctor_exception(self):
+        """The canonical int-or-string pattern — anyOf naming types
+        under x-kubernetes-int-or-string — is upstream-legal."""
+        cluster = FakeCluster()
+        cluster.create(self.base_crd({
+            "type": "object",
+            "properties": {
+                "spec": {
+                    "type": "object",
+                    "properties": {
+                        "maxUnavailable": {
+                            "x-kubernetes-int-or-string": True,
+                            "anyOf": [{"type": "integer"},
+                                      {"type": "string"}],
+                        },
+                    },
+                },
+            },
+        }))
+
+    def test_junctor_only_field_admitted(self):
+        cluster = FakeCluster()
+        cluster.create(self.base_crd({
+            "type": "object",
+            "properties": {
+                "spec": {
+                    "type": "object",
+                    "properties": {
+                        # typeless but junctor-only: value validation.
+                        "v": {"not": {"enum": ["forbidden"]}},
+                    },
+                },
+            },
+        }))
+
+    def test_valid_and_schema_less_admitted(self):
+        cluster = FakeCluster()
+        cluster.create(self.base_crd({
+            "type": "object",
+            "properties": {
+                "spec": {
+                    "type": "object",
+                    "properties": {
+                        "m": {"x-kubernetes-int-or-string": True},
+                        "free": {
+                            "type": "object",
+                            "x-kubernetes-preserve-unknown-fields": True,
+                        },
+                    },
+                },
+            },
+        }))
+        # A version with NO schema is fine (schema-less activation rule).
+        no_schema = self.base_crd({"type": "object"})
+        no_schema.name = "bare.example.dev"
+        no_schema.spec["names"] = {"kind": "Bare", "plural": "bares"}
+        del no_schema.spec["versions"][0]["schema"]
+        cluster.create(no_schema)
+
+    def test_invalid_crd_update_is_atomic(self):
+        cluster = FakeCluster()
+        cluster.create(self.base_crd({"type": "object"}))
+        live = cluster.get(
+            "CustomResourceDefinition", "things.example.dev"
+        )
+        live.spec["versions"][0]["schema"]["openAPIV3Schema"] = {
+            "type": "string"
+        }
+        with pytest.raises(InvalidError):
+            cluster.update(live)
+        kept = cluster.get(
+            "CustomResourceDefinition", "things.example.dev"
+        )
+        schema = kept.spec["versions"][0]["schema"]["openAPIV3Schema"]
+        assert schema == {"type": "object"}
+
+    def test_checked_in_manifests_are_structural(self):
+        cluster = FakeCluster()
+        cluster.create(load_crd("nodemaintenances.yaml"))
+        cluster.create(load_crd("tpuupgradepolicies.yaml"))
+
+
 class TestOverHttp:
     def test_invalid_cr_answers_422_on_the_wire(self):
         from k8s_operator_libs_tpu.kube import (
