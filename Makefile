@@ -10,18 +10,25 @@ DOCKER ?= docker
 IMAGE ?= tpu-operator.dev/tpu-health-probe
 TAG ?= latest
 
-.PHONY: all lint test coverage bench dryrun demo install image
+.PHONY: all lint analyze test coverage bench dryrun demo install image
+
+# Extra flags for the domain analyzer, e.g.
+#   make analyze ANALYZE_FLAGS="--json --output analyze-report.json"
+ANALYZE_FLAGS ?=
 
 all: lint test
 
 install:
 	$(PYTHON) -m pip install -e . -q --no-deps --no-build-isolation
 
-# Local lint tier (reference gates on ~60 golangci linters locally,
+# Local lint tiers (reference gates on ~60 golangci linters locally,
 # .golangci.yaml): compile check + the stdlib linter (tools/lint.py —
-# unused/undefined names, redefinitions, bare except, mutable defaults, …),
-# plus ruff when the environment has it (CI always does).
-lint:
+# unused/undefined names, redefinitions, bare except, mutable defaults, …)
+# + the domain analyzer (tools/analyze/ — lock discipline, state-machine
+# exhaustiveness, literal keys, swallowed exceptions), plus ruff when the
+# environment has it (CI always does). docs/static-analysis.md maps the
+# tiers.
+lint: analyze
 	$(PYTHON) -m compileall -q k8s_operator_libs_tpu tests examples tools bench.py __graft_entry__.py
 	$(PYTHON) tools/lint.py k8s_operator_libs_tpu tests examples tools bench.py __graft_entry__.py
 	$(PYTHON) -c "import k8s_operator_libs_tpu"
@@ -30,6 +37,11 @@ lint:
 	else \
 	    echo "lint: ruff not installed here; stdlib linter ran (CI runs ruff+mypy)"; \
 	fi
+
+# Domain-aware static analysis over the package (exit 1 on any finding
+# not covered by tools/analyze_baseline.json).
+analyze:
+	$(PYTHON) tools/analyze.py k8s_operator_libs_tpu $(ANALYZE_FLAGS)
 
 test:
 	$(PYTHON) -m pytest tests/ -x -q

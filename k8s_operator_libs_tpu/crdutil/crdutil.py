@@ -14,7 +14,6 @@ CRD lifecycle tool — device-agnostic, driving TPU CRDs on clusters with no GPU
 
 from __future__ import annotations
 
-import enum
 import os
 import time
 from typing import Iterable, Sequence
@@ -23,6 +22,7 @@ import yaml
 
 from ..kube.client import Client, NotFoundError, retry_on_conflict
 from ..kube.objects import CustomResourceDefinition
+from ..utils.compat import StrEnum
 from ..utils.log import get_logger
 
 log = get_logger("crdutil")
@@ -35,7 +35,7 @@ CRD_KIND = "CustomResourceDefinition"
 _YAML_EXTENSIONS = (".yaml", ".yml")
 
 
-class CRDOperation(enum.StrEnum):
+class CRDOperation(StrEnum):
     """Supported operations (reference: crdutil.go:44-51)."""
 
     APPLY = "apply"
@@ -162,8 +162,10 @@ def wait_for_crds(
             "but-undiscoverable window)", type(client).__name__,
         )
         return _wait_for_crds_via_status(client, crds, deadline)
-    except Exception:
-        pass  # a NotFound/unreachable core group is the poll's business
+    except Exception as e:
+        # A NotFound/unreachable core group is the poll's business; leave
+        # a trace so a misconfigured client is diagnosable from logs.
+        log.debug("discovery probe failed (%s); proceeding to poll", e)
     while pending:
         # One discovery GET per distinct group/version per round — CRDs
         # overwhelmingly share a group, and repeating the identical

@@ -770,6 +770,18 @@ class FakeCluster(Client):
                         f"resourceVersion {since} is too old "
                         f"(oldest journaled: {self._history[0][0]})"
                     )
+                last_rv = getattr(self, "_last_rv", 0)
+                if not self._history and since < last_rv:
+                    # Journal fully compacted: nothing to replay, but the
+                    # cluster has moved past the caller's revision, so
+                    # events WERE lost. Resuming live here would silently
+                    # drop them — the real apiserver answers 410 Gone and
+                    # the client re-lists (the exact repair the informer's
+                    # relist-after-expiry path implements).
+                    raise WatchExpiredError(
+                        f"resourceVersion {since} is too old "
+                        f"(journal compacted; current: {last_rv})"
+                    )
                 replay = [
                     (event, copy.deepcopy(data), copy.deepcopy(old))
                     for rv, event, data, old in self._history
@@ -1114,11 +1126,12 @@ class FakeCluster(Client):
         self, kind: str, name: str, namespace: str, old=None
     ) -> None:
         """Remove a deletionTimestamp-marked object once finalizers are
-        gone. ``old`` is the pre-write snapshot of the releasing write:
-        its MODIFIED event was suppressed (the write IS the deletion, see
-        _write_becomes_delete), so the DELETED event must carry the
-        pre-write state or a label-selector watcher whose object left
-        scope in that same write would classify the event away."""
+        gone. Caller holds the lock. ``old`` is the pre-write snapshot of
+        the releasing write: its MODIFIED event was suppressed (the write
+        IS the deletion, see _write_becomes_delete), so the DELETED event
+        must carry the pre-write state or a label-selector watcher whose
+        object left scope in that same write would classify the event
+        away."""
         key = self._key(kind, namespace, name)
         data = self._store.get(key)
         if data is None:
@@ -1953,7 +1966,8 @@ class FakeCluster(Client):
         """Release ``foregroundDeletion`` finalizers whose owners have no
         BLOCKING dependents left (``blockOwnerDeletion: true`` — other
         dependents never hold a foreground owner on a real cluster);
-        fully-released owners finalize and cascade."""
+        fully-released owners finalize and cascade. Caller holds the
+        lock (re-entrant: the cascade re-enters ``delete``)."""
         for key, data in list(self._store.items()):
             meta = data.get("metadata") or {}
             finalizers = meta.get("finalizers") or []

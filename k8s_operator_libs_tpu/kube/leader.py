@@ -266,7 +266,8 @@ class LeaderElector:
 
     def stop(self, release: bool = True) -> None:
         self._stop.set()
-        thread = self._thread
+        with self._lock:
+            thread = self._thread
         if thread is not None:
             thread.join(timeout=30)
         self._leading.clear()
@@ -276,7 +277,8 @@ class LeaderElector:
             # leader — release() is identity-guarded and tolerates both a
             # missing lease and another holder, so it is always safe.
             self.release()
-        self._thread = None
+        with self._lock:
+            self._thread = None
 
     def _run(self) -> None:
         cfg = self.config

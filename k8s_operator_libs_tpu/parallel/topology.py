@@ -16,11 +16,12 @@ chips each.
 
 from __future__ import annotations
 
-import enum
 import math
 from dataclasses import dataclass
 from functools import reduce
 from typing import Mapping, Optional
+
+from ..utils.compat import StrEnum
 
 GKE_TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
 GKE_TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
@@ -29,7 +30,7 @@ GKE_TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
 GKE_NODEPOOL_LABEL = "cloud.google.com/gke-nodepool"
 
 
-class TpuAccelerator(enum.StrEnum):
+class TpuAccelerator(StrEnum):
     """GKE accelerator label values for TPU generations."""
 
     V4 = "tpu-v4-podslice"

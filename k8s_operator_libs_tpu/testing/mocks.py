@@ -13,7 +13,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-from ..upgrade.consts import UpgradeKeys, UpgradeState
+from ..upgrade.consts import NULL_STRING, UpgradeKeys, UpgradeState
 
 
 @dataclass
@@ -198,7 +198,7 @@ class MockNodeUpgradeStateProvider(_Recording):
 
     def change_node_upgrade_annotation(self, node, key: str, value: str) -> None:
         self._record("change_node_upgrade_annotation", node.name, key, value)
-        if value == "null":
+        if value == NULL_STRING:
             node.annotations.pop(key, None)
         else:
             node.annotations[key] = value

@@ -34,7 +34,7 @@ from typing import Optional
 from ..api.upgrade_v1alpha1 import DriverUpgradePolicySpec
 from ..utils.log import get_logger
 from ..upgrade.common_manager import ClusterUpgradeState, NodeUpgradeState
-from ..upgrade.consts import TRUE_STRING, UpgradeState
+from ..upgrade.consts import NULL_STRING, TRUE_STRING, UpgradeState
 from ..upgrade.inplace import InplaceNodeStateManager
 from ..upgrade.requestor import RequestorNodeStateManager
 from .detector import TpuNodeDetector
@@ -173,7 +173,7 @@ def start_slices_within_budget(
         for ns in members:
             if common.is_upgrade_requested(ns.node):
                 common.provider.change_node_upgrade_annotation(
-                    ns.node, common.keys.upgrade_requested_annotation, "null"
+                    ns.node, common.keys.upgrade_requested_annotation, NULL_STRING
                 )
             if common.skip_node_upgrade(ns.node):
                 log.info("node %s is marked to skip upgrades", ns.node.name)

@@ -23,6 +23,7 @@ from ..utils.log import get_logger
 from .consts import (
     IDLE_STATES,
     MANAGED_STATES,
+    NULL_STRING,
     TRUE_STRING,
     UpgradeKeys,
     UpgradeState,
@@ -388,7 +389,7 @@ class CommonUpgradeManager:
             self.provider.change_node_upgrade_state(ns.node, new_state)
             if new_state == UpgradeState.DONE:
                 self.provider.change_node_upgrade_annotation(
-                    ns.node, self.keys.initial_state_annotation, "null"
+                    ns.node, self.keys.initial_state_annotation, NULL_STRING
                 )
 
     def process_validation_required_nodes(self, state: ClusterUpgradeState) -> None:
@@ -421,7 +422,7 @@ class CommonUpgradeManager:
         self.provider.change_node_upgrade_state(node, new_state)
         if new_state == UpgradeState.DONE or in_requestor_mode:
             self.provider.change_node_upgrade_annotation(
-                node, self.keys.initial_state_annotation, "null"
+                node, self.keys.initial_state_annotation, NULL_STRING
             )
 
     def is_node_in_requestor_mode(self, node: Node) -> bool:

@@ -12,11 +12,12 @@ managed from one process, and the key domain is part of the device class.
 
 from __future__ import annotations
 
-import enum
 from dataclasses import dataclass
 
+from ..utils.compat import StrEnum
 
-class UpgradeState(enum.StrEnum):
+
+class UpgradeState(StrEnum):
     """Per-node upgrade state, stored in a node label.
 
     Value parity with reference: pkg/upgrade/consts.go:48-83.

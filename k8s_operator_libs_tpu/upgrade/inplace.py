@@ -13,7 +13,7 @@ from typing import Protocol
 from ..api.upgrade_v1alpha1 import DriverUpgradePolicySpec
 from ..utils.log import get_logger
 from .common_manager import ClusterUpgradeState, CommonUpgradeManager
-from .consts import UpgradeState
+from .consts import NULL_STRING, UpgradeState
 
 log = get_logger("upgrade.inplace")
 
@@ -66,7 +66,7 @@ class InplaceNodeStateManager:
             if common.is_upgrade_requested(node):
                 # Clear the one-shot request annotation (reference: :72-80).
                 common.provider.change_node_upgrade_annotation(
-                    node, common.keys.upgrade_requested_annotation, "null"
+                    node, common.keys.upgrade_requested_annotation, NULL_STRING
                 )
             if common.skip_node_upgrade(node):
                 log.info("node %s is marked to skip upgrades", node.name)

@@ -15,7 +15,7 @@ from ..kube.client import Client, NotFoundError
 from ..kube.drain import DrainConfig, DrainError, DrainHelper
 from ..kube.objects import ControllerRevision, DaemonSet, Node, Pod
 from ..utils.log import get_logger
-from .consts import UpgradeKeys, UpgradeState
+from .consts import NULL_STRING, UpgradeKeys, UpgradeState
 from .state_provider import NodeUpgradeStateProvider
 from .task_runner import TaskRunner
 
@@ -239,7 +239,7 @@ class PodManager:
             self._provider.change_node_upgrade_annotation(
                 node,
                 self._keys.wait_for_pod_completion_start_annotation,
-                "null",
+                NULL_STRING,
             )
             self._provider.change_node_upgrade_state(
                 node, UpgradeState.POD_DELETION_REQUIRED
@@ -269,7 +269,7 @@ class PodManager:
             self._provider.change_node_upgrade_state(
                 node, UpgradeState.POD_DELETION_REQUIRED
             )
-            self._provider.change_node_upgrade_annotation(node, key, "null")
+            self._provider.change_node_upgrade_annotation(node, key, NULL_STRING)
 
     # -- helpers -----------------------------------------------------------
     def list_pods(self, selector: str = "", node_name: str = "") -> list[Pod]:

@@ -29,7 +29,7 @@ from .common_manager import (
     CommonUpgradeManager,
     NodeUpgradeState,
 )
-from .consts import TRUE_STRING, UpgradeState
+from .consts import NULL_STRING, TRUE_STRING, UpgradeState
 from .state_manager import StateOptions
 
 log = get_logger("upgrade.requestor")
@@ -349,7 +349,7 @@ class RequestorNodeStateManager:
             node = ns.node
             if common.is_upgrade_requested(node):
                 common.provider.change_node_upgrade_annotation(
-                    node, common.keys.upgrade_requested_annotation, "null"
+                    node, common.keys.upgrade_requested_annotation, NULL_STRING
                 )
             if common.skip_node_upgrade(node):
                 log.info("node %s is marked to skip upgrades", node.name)
@@ -432,7 +432,7 @@ class RequestorNodeStateManager:
             if done:
                 if key in node.annotations:
                     common.provider.change_node_upgrade_annotation(
-                        node, key, "null"
+                        node, key, NULL_STRING
                     )
                 common.provider.change_node_upgrade_state(
                     node, UpgradeState.POD_RESTART_REQUIRED
@@ -474,6 +474,6 @@ class RequestorNodeStateManager:
                 continue
             self.delete_or_update_node_maintenance(ns)
             common.provider.change_node_upgrade_annotation(
-                ns.node, common.keys.requestor_mode_annotation, "null"
+                ns.node, common.keys.requestor_mode_annotation, NULL_STRING
             )
             common.provider.change_node_upgrade_state(ns.node, UpgradeState.DONE)

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 from ..kube.objects import Node
 from ..utils.log import get_logger
-from .consts import UpgradeKeys
+from .consts import NULL_STRING, UpgradeKeys
 from .state_provider import NodeUpgradeStateProvider
 
 log = get_logger("upgrade.safe_load")
@@ -42,5 +42,5 @@ class SafeDriverLoadManager:
             return
         log.info("unblocking safe driver load on node %s", node.name)
         self._provider.change_node_upgrade_annotation(
-            node, self._keys.safe_driver_load_annotation, "null"
+            node, self._keys.safe_driver_load_annotation, NULL_STRING
         )

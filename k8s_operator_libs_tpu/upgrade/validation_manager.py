@@ -26,7 +26,7 @@ from typing import Callable, Optional
 from ..kube.client import Client
 from ..kube.objects import Node, Pod
 from ..utils.log import get_logger
-from .consts import UpgradeKeys, UpgradeState
+from .consts import NULL_STRING, UpgradeKeys, UpgradeState
 from .state_provider import NodeUpgradeStateProvider
 
 log = get_logger("upgrade.validation")
@@ -62,7 +62,7 @@ def advance_durable_clock(
         provider.change_node_upgrade_annotation(node, key, str(now))
         return False
     if now > start + timeout_seconds:
-        provider.change_node_upgrade_annotation(node, key, "null")
+        provider.change_node_upgrade_annotation(node, key, NULL_STRING)
         return True
     return False
 
@@ -166,11 +166,11 @@ class ValidationManager:
                     node.name, e,
                 )
         self._provider.change_node_upgrade_annotation(
-            node, self._keys.validation_start_annotation, "null"
+            node, self._keys.validation_start_annotation, NULL_STRING
         )
         if self._keys.validation_failed_annotation in node.annotations:
             self._provider.change_node_upgrade_annotation(
-                node, self._keys.validation_failed_annotation, "null"
+                node, self._keys.validation_failed_annotation, NULL_STRING
             )
         return True
 

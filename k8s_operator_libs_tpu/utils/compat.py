@@ -1,0 +1,39 @@
+"""Stdlib version compatibility shims.
+
+The control-plane modules target the CI interpreter (3.12) but must
+import anywhere the operator runs — the deployment image pins an older
+Python and cannot pip install backports (the same constraint that makes
+tools/lint.py and tools/cover.py stdlib-only).
+
+``StrEnum`` is the one 3.11+ feature the package leans on: the upgrade
+state machine, CRD operations and TPU accelerator types are all
+string-valued enums whose members must compare and format as their
+values (node labels, CLI args, CRD fields). On older interpreters the
+fallback below reproduces exactly the two behaviors the codebase
+relies on:
+
+* ``UpgradeState.DONE == "upgrade-done"`` (str mixin), and
+* ``str(UpgradeState.DONE) == "upgrade-done"`` / f-string
+  interpolation yielding the value (3.11 StrEnum defines ``__str__ =
+  str.__str__``; a plain ``str``-mixin Enum would render the member
+  name).
+"""
+
+from __future__ import annotations
+
+import enum
+
+if hasattr(enum, "StrEnum"):  # Python >= 3.11
+    StrEnum = enum.StrEnum
+else:  # pragma: no cover - exercised only on older interpreters
+
+    class StrEnum(str, enum.Enum):  # type: ignore[no-redef]
+        """Minimal backport of :class:`enum.StrEnum` (3.11). All users
+        give explicit values, so the ``auto()`` lowercasing hook is
+        deliberately omitted."""
+
+        __str__ = str.__str__
+        __format__ = str.__format__
+
+
+__all__ = ["StrEnum"]

@@ -1,0 +1,10 @@
+"""Seeded literal-key violations: upgrade-flow keys spelled inline
+instead of flowing through the UpgradeKeys builders."""
+
+STATE_LABEL = "acme.dev/widget-driver-upgrade-state"  # KEY301
+
+
+def annotate(node):
+    # KEY301: inline skip-label key.
+    node.labels["acme.dev/widget-driver-upgrade.skip"] = "true"
+    return node

@@ -1,0 +1,10 @@
+"""Clean twin: keys come from a builder object; other-namespace keys
+(slice topology) and a targeted noqa suppression stay silent."""
+
+SLICE_LABEL = "acme.dev/slice-id"  # not an upgrade key: exempt
+
+
+def annotate(node, keys):
+    node.labels[keys.state_label] = "true"
+    legacy = "acme.dev/widget-driver-upgrade-state"  # noqa: KEY301
+    return node, legacy

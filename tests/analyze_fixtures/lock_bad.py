@@ -1,0 +1,30 @@
+"""Seeded lock-discipline violations (analyzer fixture — analyzed as
+text by tests/test_analyze.py, never imported)."""
+
+import threading
+import time
+
+
+class BadCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._last = None
+        self._worker = threading.Thread(target=self.bump)
+
+    def bump(self):
+        with self._lock:
+            self._count += 1
+            time.sleep(0.01)  # LCK102: blocking call under the lock
+
+    def drain(self):
+        with self._lock:
+            self._worker.join()  # LCK102: thread join under the lock
+
+    def reset(self):
+        self._count = 0  # LCK101: guarded in bump, unguarded here
+        with self._lock:
+            self._last = "reset"
+
+    def touch(self):
+        self._last = "touched"  # LCK101: guarded in reset, unguarded here

@@ -1,0 +1,38 @@
+"""Clean twin of lock_bad.py: every shared-state mutation is guarded,
+blocking work happens outside the lock, and both caller-holds-lock
+conventions (``*_locked`` name, docstring phrase) are exercised."""
+
+import threading
+import time
+
+
+class CleanCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._count = 0
+        self._last = None
+
+    def wait_for_reset(self):
+        with self._cond:
+            # Condition.wait releases the lock while blocked — the
+            # sanctioned way to block under it.
+            self._cond.wait(timeout=1.0)
+            return ", ".join(["a", "b"])  # sep.join: string building
+
+    def bump(self):
+        time.sleep(0.01)  # blocking, but before taking the lock
+        with self._lock:
+            self._count += 1
+
+    def reset(self):
+        with self._lock:
+            self._reset_locked()
+            self._touch()
+
+    def _reset_locked(self):
+        self._count = 0
+
+    def _touch(self):
+        """Caller holds the lock."""
+        self._last = "touched"

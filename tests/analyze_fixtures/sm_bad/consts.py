@@ -1,0 +1,23 @@
+"""Seeded state-machine violations: a broken partition (fixture for the
+state-machine pass; the enum/partition shapes mirror upgrade/consts.py)."""
+
+from enum import Enum
+
+
+class WidgetState(str, Enum):
+    IDLE = "widget-idle"
+    SPINNING = "widget-spinning"
+    JAMMED = "widget-jammed"
+    RETIRED = "widget-retired"  # STM201: in neither partition
+    LOST = "widget-lost"  # STM201: in neither partition
+
+
+MANAGED_STATES = (
+    WidgetState.IDLE,
+    WidgetState.SPINNING,
+    WidgetState.JAMMED,
+)
+
+MAINTENANCE_STATES = (
+    WidgetState.JAMMED,  # STM202: already in MANAGED_STATES
+)

@@ -1,0 +1,18 @@
+"""Seeded state-machine violations: incomplete apply_state coverage."""
+
+
+class WidgetMachine:
+    def apply_state(self, state):
+        # STM203: JAMMED / RETIRED / LOST have no handler here.
+        self.process_idle_nodes(state)
+        self.process_spinning_nodes(state)
+        self.process_melted_nodes(state)  # STM204: maps to no state
+
+    def process_idle_nodes(self, state):
+        return state
+
+    def process_spinning_nodes(self, state):
+        return "widget-jammed"  # STM205: state value spelled inline
+
+    def process_melted_nodes(self, state):
+        return state

@@ -1,0 +1,23 @@
+"""Clean twin: a fully partitioned, fully handled miniature machine."""
+
+from enum import Enum
+
+
+class GadgetState(str, Enum):
+    IDLE = "gadget-idle"
+    SPINNING = "gadget-spinning"
+    JAMMED = "gadget-jammed"
+    RETIRED = "gadget-retired"
+    LOST = "gadget-lost"
+
+
+MANAGED_STATES = (
+    GadgetState.IDLE,
+    GadgetState.SPINNING,
+    GadgetState.JAMMED,
+)
+
+MAINTENANCE_STATES = (
+    GadgetState.RETIRED,
+    GadgetState.LOST,
+)

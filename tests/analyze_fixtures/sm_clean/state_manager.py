@@ -1,0 +1,27 @@
+"""Clean twin: apply_state covers every GadgetState member."""
+
+from .consts import GadgetState
+
+
+class GadgetMachine:
+    def apply_state(self, state):
+        self.process_idle_nodes(state, GadgetState.IDLE)
+        self.process_spinning_nodes(state)
+        self.process_jammed_nodes(state)
+        self.process_retired_nodes(state)
+        self.process_lost_nodes(state)
+
+    def process_idle_nodes(self, state, bucket):
+        return state, bucket
+
+    def process_spinning_nodes(self, state):
+        return state
+
+    def process_jammed_nodes(self, state):
+        return state
+
+    def process_retired_nodes(self, state):
+        return state
+
+    def process_lost_nodes(self, state):
+        return state

@@ -1,0 +1,10 @@
+"""Seeded swallowed-exception violation: a broad handler in a reconcile
+path that neither logs nor re-raises."""
+
+
+def reconcile(client):
+    try:
+        client.sync()
+    except Exception:
+        pass  # EXC401: the outage becomes silence
+    return True

@@ -1,0 +1,37 @@
+"""Clean twin: every broad handler logs, re-raises, propagates the
+error as data, or is an import fallback."""
+
+import logging
+
+log = logging.getLogger("analyze-fixture")
+
+try:  # import fallback gating an optional dep: structurally exempt
+    from fixture_optional_dep import thing
+except Exception:
+    thing = None
+
+
+def has_thing():
+    return thing is not None
+
+
+def reconcile(client):
+    try:
+        client.sync()
+    except Exception:
+        log.exception("sync failed")
+
+
+def probe(client):
+    try:
+        client.sync()
+    except Exception as e:
+        return {"ok": False, "error": str(e)}  # error-as-data: exempt
+    return {"ok": True}
+
+
+def teardown(client):
+    try:
+        client.close()
+    except ValueError:
+        pass  # narrow handler: a decision about one failure mode

@@ -1,0 +1,382 @@
+"""Tests for the domain-aware static analyzer (tools/analyze/).
+
+Each pass must catch its seeded violation in tests/analyze_fixtures/ and
+stay silent on the clean twin; plus the framework behaviors the gate
+depends on: targeted noqa, the baseline lifecycle, JSON output, exit
+codes — and the acceptance criterion itself: the real package is clean
+under the checked-in baseline.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = Path(__file__).resolve().parent / "analyze_fixtures"
+
+sys.path.insert(0, str(REPO / "tools"))
+
+from analyze import cli  # noqa: E402
+from analyze.baseline import load_baseline, split_findings  # noqa: E402
+from analyze.core import parse_noqa, run_analysis, suppressed  # noqa: E402
+
+
+def codes(findings):
+    return {f.code for f in findings}
+
+
+# -- lock discipline -------------------------------------------------------
+
+def test_lock_pass_flags_seeded_violations():
+    findings = run_analysis([str(FIXTURES / "lock_bad.py")])
+    assert codes(findings) == {"LCK101", "LCK102"}
+    lck101 = [f for f in findings if f.code == "LCK101"]
+    # _count unguarded in reset(), _last unguarded in touch().
+    assert len(lck101) == 2
+    assert {"_count" in f.message or "_last" in f.message for f in lck101} == {True}
+    lck102 = [f for f in findings if f.code == "LCK102"]
+    assert len(lck102) == 2
+    reasons = " ".join(f.message for f in lck102)
+    assert "time.sleep" in reasons and "join" in reasons
+
+
+def test_lock_pass_silent_on_clean_twin():
+    assert run_analysis([str(FIXTURES / "lock_clean.py")]) == []
+
+
+# -- state machine ---------------------------------------------------------
+
+def test_state_machine_pass_flags_all_seeded_violations():
+    findings = run_analysis([str(FIXTURES / "sm_bad")])
+    got = codes(findings)
+    assert {"STM201", "STM202", "STM203", "STM204", "STM205"} <= got
+    unpartitioned = [f for f in findings if f.code == "STM201"]
+    assert len(unpartitioned) == 2  # RETIRED and LOST
+    unhandled = [f for f in findings if f.code == "STM203"]
+    assert {m for f in unhandled for m in ("JAMMED", "RETIRED", "LOST")
+            if m in f.message} == {"JAMMED", "RETIRED", "LOST"}
+    stale = [f for f in findings if f.code == "STM204"]
+    assert len(stale) == 1 and "process_melted_nodes" in stale[0].message
+    literal = [f for f in findings if f.code == "STM205"]
+    assert len(literal) == 1 and "widget-jammed" in literal[0].message
+
+
+def test_state_machine_pass_silent_on_clean_twin():
+    assert run_analysis([str(FIXTURES / "sm_clean")]) == []
+
+
+def test_real_upgrade_machine_is_exhaustive():
+    """The production state machine itself satisfies the invariants —
+    13 states partitioned and handled. Regresses loudly if a state is
+    added without a handler or partition slot."""
+    findings = run_analysis(
+        [str(REPO / "k8s_operator_libs_tpu" / "upgrade")],
+        pass_names=["state-machine"],
+    )
+    assert findings == [], [str(f) for f in findings]
+
+
+# -- literal keys ----------------------------------------------------------
+
+def test_literal_key_pass_flags_seeded_violations():
+    findings = run_analysis([str(FIXTURES / "key_bad.py")])
+    assert codes(findings) == {"KEY301"}
+    assert len(findings) == 2
+
+
+def test_literal_key_pass_silent_on_clean_twin_and_honors_noqa():
+    # key_clean.py contains an upgrade-shaped literal under # noqa: KEY301
+    # and an other-namespace key; both must stay silent.
+    assert run_analysis([str(FIXTURES / "key_clean.py")]) == []
+
+
+# -- swallowed exceptions --------------------------------------------------
+
+def test_swallowed_pass_flags_seeded_violation():
+    findings = run_analysis([str(FIXTURES / "swallow_bad.py")])
+    assert codes(findings) == {"EXC401"}
+    assert len(findings) == 1
+    assert findings[0].scope == "reconcile"
+
+
+def test_swallowed_pass_silent_on_clean_twin():
+    # Logging, error-as-data, import-fallback and narrow handlers are all
+    # legitimate shapes.
+    assert run_analysis([str(FIXTURES / "swallow_clean.py")]) == []
+
+
+# -- framework: noqa grammar ----------------------------------------------
+
+def test_parse_noqa_grammar():
+    noqa = parse_noqa(
+        "x = 1  # noqa\n"
+        "y = 2  # noqa: LCK101\n"
+        "z = 3  # noqa: LCK101, EXC401\n"
+        "w = 4\n"
+    )
+    assert suppressed(noqa, 1, "ANY999")
+    assert suppressed(noqa, 2, "LCK101") and not suppressed(noqa, 2, "EXC401")
+    assert suppressed(noqa, 3, "EXC401")
+    assert not suppressed(noqa, 4, "LCK101")
+
+
+def test_parse_noqa_stops_at_prose():
+    # Trailing prose after the code list must not widen the suppression
+    # to rule codes it merely mentions.
+    noqa = parse_noqa(
+        "x = 1  # noqa: E501 long url, see E722 docs\n"
+        "y = 2  # noqa: BLE001 - the monitor must outlive blips\n"
+    )
+    assert suppressed(noqa, 1, "E501") and not suppressed(noqa, 1, "E722")
+    assert suppressed(noqa, 2, "BLE001")
+
+
+def test_parse_noqa_malformed_codes_suppress_nothing():
+    # `# noqa: keep` (unparseable code list) must NOT degrade to a
+    # blanket suppression — the finding surfaces and the typo gets
+    # fixed.
+    noqa = parse_noqa(
+        "x = 1  # noqa: somereason\n"
+        "y = 2  # noqa: KEY-301\n"
+        "z = 3  # noqa\n"
+    )
+    assert not suppressed(noqa, 1, "LCK101")
+    assert not suppressed(noqa, 2, "KEY301")
+    assert suppressed(noqa, 3, "ANY999")  # bare blanket still works
+
+
+def test_cli_select_run_does_not_report_unselected_stale(tmp_path, capsys):
+    # Baseline an EXC401, then run ONLY the lock pass over the same file:
+    # the EXC401 entry is out of the run's scope, not "fixed".
+    baseline = tmp_path / "b.json"
+    target = str(FIXTURES / "swallow_bad.py")
+    cli.main([target, "--baseline", str(baseline), "--write-baseline"])
+    rc = cli.main([target, "--baseline", str(baseline),
+                   "--select", "lock-discipline"])
+    err = capsys.readouterr().err
+    assert rc == 0
+    assert "fixed? remove it" not in err
+
+
+def test_lock_pass_accepts_local_lock_alias(tmp_path):
+    mod = tmp_path / "alias.py"
+    mod.write_text(
+        "import threading\n\n\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._x = 0\n"
+        "\n"
+        "    def guarded(self):\n"
+        "        with self._lock:\n"
+        "            self._x = 1\n"
+        "\n"
+        "    def alias_guarded(self):\n"
+        "        lock = self._lock\n"
+        "        with lock:\n"
+        "            self._x = 2\n"
+    )
+    assert run_analysis([str(mod)]) == []
+
+
+def test_cli_rejects_nonexistent_file_argument(capsys):
+    rc = cli.main([str(FIXTURES / "no_such_file.py"), "--baseline", "-"])
+    assert rc == 2
+    capsys.readouterr()
+
+
+def test_cli_subset_run_does_not_report_out_of_scope_stale(tmp_path, capsys):
+    # Baseline an EXC401 in swallow_bad.py, then analyze ONLY the clean
+    # twin: the out-of-scope entry must not be called "fixed".
+    baseline = tmp_path / "b.json"
+    cli.main([str(FIXTURES / "swallow_bad.py"), "--baseline", str(baseline),
+              "--write-baseline"])
+    rc = cli.main([str(FIXTURES / "swallow_clean.py"),
+                   "--baseline", str(baseline)])
+    err = capsys.readouterr().err
+    assert rc == 0
+    assert "stale" not in err.split("\n")[0] or "0 stale" in err
+
+
+def test_parse_noqa_ignores_string_literals():
+    # 'noqa' inside a string (help text, a linter's own messages) is not
+    # a directive — only real comments suppress.
+    noqa = parse_noqa(
+        'msg = "add # noqa: EXC401 to silence"\n'
+        's = """\n'
+        "# noqa\n"
+        '"""\n'
+        "y = 1  # noqa: EXC401\n"
+    )
+    assert not suppressed(noqa, 1, "EXC401")
+    assert not suppressed(noqa, 3, "ANY")
+    assert suppressed(noqa, 5, "EXC401")
+
+
+# -- framework: baseline lifecycle ----------------------------------------
+
+def test_baseline_roundtrip(tmp_path):
+    baseline = tmp_path / "baseline.json"
+    target = str(FIXTURES / "swallow_bad.py")
+    assert cli.main([target, "--baseline", str(baseline)]) == 1
+    assert cli.main([target, "--baseline", str(baseline),
+                     "--write-baseline"]) == 0
+    # Baselined: the gate is green while the finding stays recorded.
+    assert cli.main([target, "--baseline", str(baseline)]) == 0
+    entries = load_baseline(baseline)
+    assert len(entries) == 1
+
+
+def test_baseline_reports_stale_entries(tmp_path):
+    baseline = tmp_path / "baseline.json"
+    bad = str(FIXTURES / "swallow_bad.py")
+    clean = str(FIXTURES / "swallow_clean.py")
+    cli.main([bad, "--baseline", str(baseline), "--write-baseline"])
+    findings = run_analysis([clean])
+    new, baselined, stale = split_findings(findings, load_baseline(baseline))
+    assert new == [] and baselined == [] and len(stale) == 1
+
+
+def test_baseline_fingerprints_distinguish_scopes():
+    findings = run_analysis([str(FIXTURES / "lock_bad.py")])
+    prints = {f.fingerprint() for f in findings}
+    assert len(prints) == len(findings)  # no two findings collapse
+
+
+def test_baseline_fingerprints_distinguish_repeats_in_one_scope(tmp_path):
+    # A SECOND identical violation in an already-baselined scope must not
+    # be absorbed by the first one's justification.
+    one = tmp_path / "one.py"
+    one.write_text(
+        "def reconcile(c):\n"
+        "    try:\n"
+        "        c.sync()\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    findings_one = run_analysis([str(one)])
+    assert len(findings_one) == 1
+    two = tmp_path / "one.py"  # same path: simulate the edit
+    two.write_text(
+        "def reconcile(c):\n"
+        "    try:\n"
+        "        c.sync()\n"
+        "    except Exception:\n"
+        "        pass\n"
+        "    try:\n"
+        "        c.flush()\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    findings_two = run_analysis([str(two)])
+    assert len(findings_two) == 2
+    prints = {f.fingerprint() for f in findings_two}
+    assert len(prints) == 2
+    # The first occurrence keeps its original fingerprint (the baseline
+    # entry stays valid); only the new one is unmatched.
+    assert findings_one[0].fingerprint() in prints
+
+
+def test_literal_key_pass_covers_modules_with_unrelated_key_helpers(tmp_path):
+    # A `_key` helper alone (FakeCluster/Informer shape) must NOT exempt
+    # a module from KEY301 — only the full builder shape does.
+    mod = tmp_path / "fakeish.py"
+    mod.write_text(
+        "class FakeCluster:\n"
+        "    def _key(self, kind, ns, name):\n"
+        "        return (kind, ns, name)\n"
+        "\n"
+        'LABEL = "acme.dev/widget-driver-upgrade-state"\n'
+    )
+    findings = run_analysis([str(mod)], pass_names=["literal-key"])
+    assert [f.code for f in findings] == ["KEY301"]
+
+
+def test_state_machine_allows_two_handlers_for_one_state(tmp_path):
+    # Splitting one state's processing across two mapped calls is not
+    # staleness.
+    pkg = tmp_path / "sm"
+    pkg.mkdir()
+    (pkg / "consts.py").write_text(
+        "from enum import Enum\n\n\n"
+        "class FooState(str, Enum):\n"
+        '    DRAIN_REQUIRED = "foo-drain-required"\n'
+        "\n\n"
+        "MANAGED_STATES = (FooState.DRAIN_REQUIRED,)\n"
+        "MAINTENANCE_STATES = ()\n"
+    )
+    (pkg / "manager.py").write_text(
+        "class M:\n"
+        "    def apply_state(self, state):\n"
+        "        self.process_drain_nodes(state)\n"
+        "        self.process_drain_timeout_nodes(state)\n"
+    )
+    findings = run_analysis([str(pkg)], pass_names=["state-machine"])
+    assert findings == [], [str(f) for f in findings]
+
+
+# -- framework: CLI behaviors ---------------------------------------------
+
+def test_cli_text_output(capsys):
+    rc = cli.main([str(FIXTURES / "swallow_bad.py"), "--baseline", "-"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "EXC401" in out and "swallow_bad.py:" in out
+
+
+def test_cli_json_report_and_output_file(tmp_path, capsys):
+    report_file = tmp_path / "report.json"
+    rc = cli.main([
+        str(FIXTURES / "swallow_bad.py"), "--json", "--baseline", "-",
+        "--output", str(report_file),
+    ])
+    assert rc == 1
+    printed = json.loads(capsys.readouterr().out)
+    on_disk = json.loads(report_file.read_text())
+    assert printed == on_disk
+    assert on_disk["counts"] == {
+        "new": 1, "baselined": 0, "stale_baseline_entries": 0,
+    }
+    finding = on_disk["findings"][0]
+    assert finding["code"] == "EXC401" and finding["scope"] == "reconcile"
+
+
+def test_cli_select_single_pass():
+    rc_all = run_analysis([str(FIXTURES / "lock_bad.py")],
+                          pass_names=["swallowed-exception"])
+    assert rc_all == []  # the lock violations are another pass's
+
+
+def test_cli_fails_loudly_when_gate_would_be_off(tmp_path, capsys):
+    # A mistyped path or pass name must not print "clean" and exit 0 —
+    # that is the gate silently turning itself off.
+    assert cli.main([str(tmp_path / "no_such_dir"), "--baseline", "-"]) == 2
+    assert cli.main([str(FIXTURES / "lock_bad.py"), "--baseline", "-",
+                     "--select", "lockdiscipline-typo"]) == 2
+    capsys.readouterr()
+
+
+def test_write_baseline_keeps_out_of_scope_entries(tmp_path):
+    # A subset --write-baseline must not drop suppressions it could not
+    # have re-observed.
+    baseline = tmp_path / "b.json"
+    cli.main([str(FIXTURES / "swallow_bad.py"), "--baseline", str(baseline),
+              "--write-baseline"])
+    cli.main([str(FIXTURES / "lock_bad.py"), "--baseline", str(baseline),
+              "--write-baseline"])
+    entries = load_baseline(baseline)
+    assert any("EXC401" in fp for fp in entries)  # survived the 2nd write
+    assert any("LCK101" in fp for fp in entries)
+
+
+# -- the acceptance criterion itself --------------------------------------
+
+def test_package_gate_is_clean_via_entrypoint():
+    """`python tools/analyze.py k8s_operator_libs_tpu` (what make lint and
+    CI run) exits 0 against the checked-in baseline."""
+    proc = subprocess.run(
+        [sys.executable, "tools/analyze.py", "k8s_operator_libs_tpu"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -45,10 +45,13 @@ def stub_gate(tmp_path, body: str, timeout_seconds: float = 30.0,
     env = dict(os.environ)
     env["PYTHONPATH"] = str(tmp_path)
     # Keep the test cwd (the repo root, holding the REAL package) out of
-    # the child's sys.path so the stub wins module resolution.
+    # the child's sys.path so the stub wins module resolution. On 3.11+
+    # PYTHONSAFEPATH does that; older interpreters ignore it and prepend
+    # the child's cwd under -m, so point cwd at the stub tree as well.
     env["PYTHONSAFEPATH"] = "1"
     return SubprocessHealthGate(
-        cli_args=cli_args or [], timeout_seconds=timeout_seconds, env=env
+        cli_args=cli_args or [], timeout_seconds=timeout_seconds, env=env,
+        cwd=str(tmp_path),
     )
 
 

@@ -1,0 +1,17 @@
+"""Entry point for the domain-aware static analyzer — see tools/analyze/.
+
+``make analyze`` (and ``make lint``, and CI) run this as
+``python tools/analyze.py k8s_operator_libs_tpu``. The implementation
+lives in the ``tools/analyze/`` package; this shim only makes the
+package importable when invoked by path from the repo root.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+from analyze.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())

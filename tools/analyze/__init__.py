@@ -1,0 +1,48 @@
+"""Domain-aware static analysis for the TPU operator framework.
+
+The reference gates every merge on ~60 golangci linters plus ``go vet``'s
+race-prone-pattern checks (reference: .golangci.yaml, Makefile:29). The
+generic tier of that gate is ``tools/lint.py``; this package is the
+domain tier — passes that understand the invariants that actually break
+operators:
+
+* ``lock_discipline`` (LCK1xx) — shared state guarded by a
+  ``threading.Lock`` must be guarded everywhere, and nothing blocking may
+  run while a lock is held.
+* ``state_machine`` (STM2xx) — the 13-state upgrade machine must stay
+  exhaustive: every ``UpgradeState`` partitioned into
+  MANAGED/MAINTENANCE, every state handled by ``apply_state``, no state
+  value spelled as a string literal outside ``consts.py``.
+* ``literal_key`` (KEY3xx) — node label/annotation keys flow through the
+  device-class key builders (``UpgradeKeys``), never inline literals.
+* ``swallowed_exception`` (EXC4xx) — broad handlers in
+  reconcile/manager paths must log or re-raise.
+
+Everything is stdlib-only (ast), shares one parse per file, prints
+``path:line:col CODE message`` (plus ``--json``), honors targeted
+``# noqa: CODE`` comments, and reads a checked-in baseline file for
+deliberate, justified exceptions (``tools/analyze_baseline.json``).
+
+Run it as ``python tools/analyze.py <paths>`` — wired into ``make lint``
+and CI so the whole suite gates merges.
+"""
+
+from .core import (  # noqa: F401
+    AnalysisPass,
+    Finding,
+    ParsedModule,
+    Project,
+    all_passes,
+    register,
+    run_analysis,
+)
+
+__all__ = [
+    "AnalysisPass",
+    "Finding",
+    "ParsedModule",
+    "Project",
+    "all_passes",
+    "register",
+    "run_analysis",
+]

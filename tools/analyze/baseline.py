@@ -1,0 +1,104 @@
+"""Checked-in suppression baseline.
+
+The gate's contract is "clean or fully baselined": a finding that is
+deliberate (best-effort teardown that must stay silent, a literal kept
+for wire compatibility) is recorded in ``tools/analyze_baseline.json``
+with a one-line justification, and the gate stays green while the
+finding stays visible in ``--json`` output (marked ``baselined``).
+
+Entries match on the line-independent fingerprint
+(``path::CODE::scope::message``, where scope is the enclosing def/class
+qualname — see :meth:`Finding.fingerprint`), so unrelated edits above a
+baselined site do not invalidate it, while any change to the finding
+itself (file moved, message changed) surfaces it again. Stale entries — baselined findings
+the code no longer produces — are reported so the file shrinks as debt
+is paid down; they warn rather than fail (a fix should not flip CI red).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Optional
+
+from .core import Finding
+
+
+class BaselineError(Exception):
+    pass
+
+
+def load_baseline(path: Path) -> dict[str, str]:
+    """fingerprint -> justification."""
+    if not path.exists():
+        return {}
+    try:
+        data = json.loads(path.read_text())
+    except (json.JSONDecodeError, OSError) as e:
+        raise BaselineError(f"unreadable baseline {path}: {e}") from e
+    entries = data.get("suppressions", [])
+    out: dict[str, str] = {}
+    for entry in entries:
+        fp = entry.get("fingerprint", "")
+        justification = entry.get("justification", "")
+        if not fp:
+            raise BaselineError(
+                f"baseline entry missing fingerprint: {entry!r}"
+            )
+        if not justification:
+            raise BaselineError(
+                f"baseline entry for {fp} has no justification — "
+                "every suppression must say why"
+            )
+        out[fp] = justification
+    return out
+
+
+def write_baseline(path: Path, findings: list[Finding],
+                   existing: Optional[dict[str, str]] = None) -> None:
+    """Add every current finding as a baseline entry, keeping existing
+    entries and their justifications (new findings get a placeholder the
+    author must replace).
+
+    Existing entries are never dropped here — a --write-baseline over a
+    subset path or a single --select pass must not delete suppressions it
+    could not have re-observed. Entries that are genuinely fixed surface
+    as *stale* on the next gate run; delete those by hand."""
+    existing = dict(existing or {})
+    entries = []
+    seen: set[str] = set()
+    for f in findings:
+        fp = f.fingerprint()
+        if fp in seen:
+            continue
+        seen.add(fp)
+        entries.append({
+            "fingerprint": fp,
+            "code": f.code,
+            "justification": existing.pop(fp, "TODO: justify or fix"),
+        })
+    for fp, justification in sorted(existing.items()):
+        entries.append({
+            "fingerprint": fp,
+            "code": fp.split("::")[1] if "::" in fp else "",
+            "justification": justification,
+        })
+    path.write_text(json.dumps({"suppressions": entries}, indent=2) + "\n")
+
+
+def split_findings(
+    findings: list[Finding], baseline: dict[str, str]
+) -> tuple[list[Finding], list[Finding], list[str]]:
+    """(new, baselined, stale-fingerprints)."""
+    new: list[Finding] = []
+    suppressed: list[Finding] = []
+    seen: set[str] = set()
+    for f in findings:
+        fp = f.fingerprint()
+        if fp in baseline:
+            suppressed.append(f)
+            seen.add(fp)
+        else:
+            new.append(f)
+    stale = sorted(fp for fp in baseline if fp not in seen)
+    return new, suppressed, stale

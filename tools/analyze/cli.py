@@ -1,0 +1,156 @@
+"""CLI: ``python tools/analyze.py <paths> [--json] [--baseline FILE]``.
+
+Exit status mirrors tools/lint.py: 1 when any non-baselined finding is
+reported, 0 otherwise. ``--json`` prints the machine-readable report
+(CI uploads it as an artifact); ``--output`` writes that JSON to a file
+while keeping the human text on stdout — one run serves both consumers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from .baseline import (
+    BaselineError,
+    load_baseline,
+    split_findings,
+    write_baseline,
+)
+from .core import all_passes, collect_files, run_analysis
+
+DEFAULT_BASELINE = Path(__file__).resolve().parent.parent / "analyze_baseline.json"
+
+
+def _report_json(new, baselined, stale, paths) -> dict:
+    return {
+        "paths": list(paths),
+        "findings": [f.to_json() for f in new],
+        "baselined": [f.to_json() for f in baselined],
+        "stale_baseline_entries": stale,
+        "counts": {
+            "new": len(new),
+            "baselined": len(baselined),
+            "stale_baseline_entries": len(stale),
+        },
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="analyze",
+        description="domain-aware static analysis (lock discipline, "
+        "state-machine exhaustiveness, literal keys, swallowed "
+        "exceptions)",
+    )
+    parser.add_argument("paths", nargs="+", help="files or directories")
+    parser.add_argument(
+        "--json", action="store_true",
+        help="print the JSON report instead of text findings",
+    )
+    parser.add_argument(
+        "--output", type=Path, default=None, metavar="FILE",
+        help="also write the JSON report to FILE (for CI artifacts)",
+    )
+    parser.add_argument(
+        "--baseline", type=Path, default=DEFAULT_BASELINE,
+        help=f"suppression baseline (default: {DEFAULT_BASELINE.name}; "
+        "'-' disables)",
+    )
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="record every current finding into the baseline file "
+        "(keeps existing justifications) and exit 0",
+    )
+    parser.add_argument(
+        "--select", action="append", default=None, metavar="PASS",
+        help="run only the named pass (repeatable); known: "
+        + ", ".join(sorted(c.name for c in all_passes())),
+    )
+    args = parser.parse_args(argv)
+
+    # A gate that silently analyzes nothing is a gate that is off: fail
+    # loudly on a mistyped path or pass name instead of printing "clean".
+    # Per argument — one typo among several must not pass unanalyzed.
+    empty = [p for p in args.paths if not collect_files([p])]
+    if empty:
+        print(f"analyze: no Python files under {empty}", file=sys.stderr)
+        return 2
+    if args.select is not None:
+        known = {c.name for c in all_passes()}
+        unknown = sorted(set(args.select) - known)
+        if unknown:
+            print(
+                f"analyze: unknown pass(es) {unknown}; known: "
+                f"{sorted(known)}", file=sys.stderr,
+            )
+            return 2
+
+    findings = run_analysis(args.paths, pass_names=args.select)
+
+    use_baseline = str(args.baseline) != "-"
+    baseline = {}
+    if use_baseline:
+        try:
+            baseline = load_baseline(args.baseline)
+        except BaselineError as e:
+            print(f"analyze: {e}", file=sys.stderr)
+            return 2
+
+    if args.write_baseline:
+        if not use_baseline:
+            print("analyze: --write-baseline needs a baseline path",
+                  file=sys.stderr)
+            return 2
+        write_baseline(args.baseline, findings, existing=baseline)
+        print(
+            f"analyze: baselined {len(findings)} finding(s) into "
+            f"{args.baseline}", file=sys.stderr,
+        )
+        return 0
+
+    new, baselined, stale = split_findings(findings, baseline)
+    # Staleness is only meaningful for entries this run could have
+    # re-observed: a subset run (one subdir, one file, one --select pass)
+    # must not call out-of-scope suppressions "fixed".
+    analyzed = {display for _, display in collect_files(args.paths)}
+    stale = [fp for fp in stale if fp.split("::", 1)[0] in analyzed]
+    if args.select is not None:
+        selected_codes = {
+            code
+            for cls in all_passes()
+            if cls.name in set(args.select)
+            for code in cls.codes
+        }
+        stale = [
+            fp for fp in stale
+            if fp.split("::")[1] in selected_codes
+        ]
+
+    report = _report_json(new, baselined, stale, args.paths)
+    if args.output is not None:
+        args.output.write_text(json.dumps(report, indent=2) + "\n")
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        for f in new:
+            print(f)
+    for fp in stale:
+        print(f"analyze: stale baseline entry (fixed? remove it): {fp}",
+              file=sys.stderr)
+
+    if new:
+        print(
+            f"{len(new)} finding(s) ({len(baselined)} baselined, "
+            f"{len(stale)} stale) in {len({f.path for f in new})} file(s)",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"analyze clean: {len(baselined)} baselined finding(s), "
+        f"{len(stale)} stale entr(y/ies)",
+        file=sys.stderr,
+    )
+    return 0

@@ -1,0 +1,305 @@
+"""Framework: one parse per file, a pass registry, findings, noqa.
+
+A pass subclasses :class:`AnalysisPass` and registers itself with
+:func:`register`. The runner parses every target file once into a
+:class:`ParsedModule` (AST + source lines + noqa map + docstring lines),
+bundles them into a :class:`Project`, and gives each pass the whole
+project — per-file passes iterate ``project.modules``; cross-file passes
+(state-machine exhaustiveness) correlate several modules.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field, replace
+from pathlib import Path
+from typing import Iterable, Optional, Type
+
+#: ``# noqa`` / ``# noqa: LCK101,STM203`` — same grammar as tools/lint.py.
+#: A code is letters+digits ENDING in a digit, and the list is
+#: comma-separated — so trailing prose ("# noqa: E501 long url") cannot
+#: widen the suppression to rule names it merely mentions.
+NOQA_RE = re.compile(
+    r"#\s*noqa"
+    r"(?P<colon>:)?"
+    r"(?:\s*(?P<codes>[A-Z][A-Z0-9]*[0-9](?:\s*,\s*[A-Z][A-Z0-9]*[0-9])*))?",
+    re.IGNORECASE,
+)
+
+
+def _comment_lines(source: str) -> Optional[dict[int, str]]:
+    """Line → comment text, via the tokenizer so a 'noqa' inside a string
+    literal (help text, a linter's own messages) is NOT a directive.
+    Returns None when tokenization fails (fall back to raw lines)."""
+    import io
+    import tokenize
+
+    out: dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                out[tok.start[0]] = tok.string
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return None
+    return out
+
+
+def parse_noqa(source: str) -> dict[int, Optional[frozenset[str]]]:
+    """Line → suppressed codes. ``None`` means blanket (all codes)."""
+    comments = _comment_lines(source)
+    if comments is None:
+        comments = dict(enumerate(source.splitlines(), 1))
+    out: dict[int, Optional[frozenset[str]]] = {}
+    for i, text in comments.items():
+        m = NOQA_RE.search(text)
+        if not m:
+            continue
+        codes = m.group("codes")
+        if codes is None:
+            if m.group("colon"):
+                # `# noqa: keep` / `# noqa: KEY-301` — a targeted
+                # suppression whose code list failed to parse. Suppress
+                # NOTHING (the finding surfaces and the author fixes the
+                # typo) rather than silently widening to a blanket.
+                continue
+            out[i] = None
+        else:
+            out[i] = frozenset(
+                c.strip().upper() for c in codes.split(",") if c.strip()
+            )
+    return out
+
+
+def suppressed(noqa: dict[int, Optional[frozenset[str]]], line: int,
+               code: str) -> bool:
+    if line not in noqa:
+        return False
+    codes = noqa[line]
+    return codes is None or code.upper() in codes
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str  # as given on the command line (relative in make/CI)
+    line: int
+    col: int
+    code: str
+    message: str
+    #: Enclosing def/class qualname ("RestClient._api_error"), so two
+    #: same-code findings in one file keep distinct fingerprints.
+    scope: str = ""
+    #: 1-based occurrence index among findings sharing path/code/scope/
+    #: message (assigned by run_analysis in line order). Without it, a
+    #: SECOND identical violation added to an already-baselined scope
+    #: would be silently absorbed by the first one's justification.
+    ordinal: int = 1
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}:{self.col} {self.code} {self.message}"
+
+    def fingerprint(self) -> str:
+        """Line-independent identity used by the baseline file, so a
+        baselined finding survives unrelated edits above it. Repeated
+        identical findings are disambiguated by ordinal (``::2``, …)."""
+        base = f"{self.path}::{self.code}::{self.scope}::{self.message}"
+        return base if self.ordinal <= 1 else f"{base}::{self.ordinal}"
+
+    def to_json(self) -> dict:
+        return {
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "code": self.code,
+            "scope": self.scope,
+            "message": self.message,
+            "fingerprint": self.fingerprint(),
+        }
+
+    def sort_key(self):
+        return (self.path, self.line, self.col, self.code)
+
+
+def _docstring_lines(tree: ast.Module) -> set[int]:
+    """Line numbers covered by module/class/function docstrings — domain
+    literals quoted in prose are documentation, not violations."""
+    lines: set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            body = node.body
+            if body and isinstance(body[0], ast.Expr) and isinstance(
+                body[0].value, ast.Constant
+            ) and isinstance(body[0].value.value, str):
+                doc = body[0].value
+                end = doc.end_lineno or doc.lineno
+                lines.update(range(doc.lineno, end + 1))
+    return lines
+
+
+def _scope_spans(tree: ast.Module) -> list[tuple[int, int, str]]:
+    """(start, end, qualname) for every def/class, innermost last."""
+    spans: list[tuple[int, int, str]] = []
+
+    def walk(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                qualname = f"{prefix}.{child.name}" if prefix else child.name
+                spans.append(
+                    (child.lineno, child.end_lineno or child.lineno, qualname)
+                )
+                walk(child, qualname)
+            else:
+                walk(child, prefix)
+
+    walk(tree, "")
+    return spans
+
+
+@dataclass
+class ParsedModule:
+    path: Path  # resolved on disk
+    display: str  # as the user spelled it (stable across machines)
+    source: str
+    tree: ast.Module
+    noqa: dict[int, Optional[frozenset[str]]]
+    docstring_lines: set[int]
+    scopes: list[tuple[int, int, str]] = field(default_factory=list)
+
+    @classmethod
+    def parse(cls, path: Path, display: str) -> Optional["ParsedModule"]:
+        try:
+            source = path.read_text()
+            tree = ast.parse(source, filename=str(path))
+        except (SyntaxError, UnicodeDecodeError, OSError):
+            # Syntax errors are lint.py's (E999) and compileall's to
+            # report; the domain passes only see parseable modules.
+            return None
+        return cls(
+            path=path,
+            display=display,
+            source=source,
+            tree=tree,
+            noqa=parse_noqa(source),
+            docstring_lines=_docstring_lines(tree),
+            scopes=_scope_spans(tree),
+        )
+
+    def scope_at(self, line: int) -> str:
+        best = ""
+        best_span = None
+        for start, end, qualname in self.scopes:
+            if start <= line <= end:
+                if best_span is None or (end - start) < best_span:
+                    best, best_span = qualname, end - start
+        return best
+
+
+@dataclass
+class Project:
+    modules: list[ParsedModule] = field(default_factory=list)
+
+    def find(self, predicate) -> list[ParsedModule]:
+        return [m for m in self.modules if predicate(m)]
+
+
+class AnalysisPass:
+    """One domain invariant. Subclasses set ``name``/``codes`` and
+    implement :meth:`run`; they report through :meth:`add`, which applies
+    the targeted-noqa filter centrally so no pass can forget it."""
+
+    name: str = ""
+    codes: tuple[str, ...] = ()
+
+    def __init__(self) -> None:
+        self.findings: list[Finding] = []
+
+    def add(self, module: ParsedModule, node: ast.AST, code: str,
+            message: str) -> None:
+        line = getattr(node, "lineno", 1)
+        if suppressed(module.noqa, line, code):
+            return
+        self.findings.append(
+            Finding(module.display, line,
+                    getattr(node, "col_offset", 0) + 1, code, message,
+                    scope=module.scope_at(line))
+        )
+
+    def run(self, project: Project) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+_REGISTRY: list[Type[AnalysisPass]] = []
+
+
+def register(cls: Type[AnalysisPass]) -> Type[AnalysisPass]:
+    _REGISTRY.append(cls)
+    return cls
+
+
+def all_passes() -> list[Type[AnalysisPass]]:
+    # Importing the pass modules is what populates the registry; keep the
+    # imports here so `import tools.analyze.core` alone stays cheap.
+    from . import lock_discipline  # noqa: F401
+    from . import state_machine  # noqa: F401
+    from . import literal_key  # noqa: F401
+    from . import swallowed_exception  # noqa: F401
+
+    return list(_REGISTRY)
+
+
+def collect_files(paths: Iterable[str]) -> list[tuple[Path, str]]:
+    """(resolved path, display path) for every .py under the targets,
+    deterministic order."""
+    out: list[tuple[Path, str]] = []
+    seen: set[Path] = set()
+    for raw in paths:
+        p = Path(raw)
+        if p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                if "__pycache__" in f.parts:
+                    continue
+                rp = f.resolve()
+                if rp not in seen:
+                    seen.add(rp)
+                    out.append((f, str(f)))
+        elif p.suffix == ".py" and p.is_file():
+            # Nonexistent/mistyped file arguments yield nothing here, so
+            # the CLI's per-argument no-files guard fails loudly instead
+            # of the gate silently skipping them.
+            rp = p.resolve()
+            if rp not in seen:
+                seen.add(rp)
+                out.append((p, str(p)))
+    return out
+
+
+def run_analysis(paths: Iterable[str],
+                 pass_names: Optional[Iterable[str]] = None) -> list[Finding]:
+    """Parse once, run every (or the named) registered pass, return
+    sorted findings."""
+    project = Project()
+    for path, display in collect_files(paths):
+        module = ParsedModule.parse(path, display)
+        if module is not None:
+            project.modules.append(module)
+
+    wanted = set(pass_names) if pass_names is not None else None
+    findings: list[Finding] = []
+    for cls in all_passes():
+        if wanted is not None and cls.name not in wanted:
+            continue
+        instance = cls()
+        instance.run(project)
+        findings.extend(instance.findings)
+    findings.sort(key=Finding.sort_key)
+    # Assign occurrence ordinals in line order so identical findings in
+    # one scope fingerprint distinctly (see Finding.ordinal).
+    counts: dict[str, int] = {}
+    for i, f in enumerate(findings):
+        key = f"{f.path}::{f.code}::{f.scope}::{f.message}"
+        counts[key] = counts.get(key, 0) + 1
+        if counts[key] > 1:
+            findings[i] = replace(f, ordinal=counts[key])
+    return findings

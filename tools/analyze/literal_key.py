@@ -1,0 +1,75 @@
+"""Literal-key pass.
+
+Node label/annotation keys for the upgrade flow are built by the
+device-class key builders (``upgrade/consts.py`` ``UpgradeKeys._key``:
+``{domain}/{driver}-driver-{suffix}``) so several device classes can
+coexist in one process. An inline ``"tpu-operator.dev/libtpu-driver-
+upgrade-state"`` hard-wires one device class and silently diverges the
+moment the builder scheme changes — the exact failure the reference's
+printf-key design suffered from (reference: pkg/upgrade/consts.go:20-47).
+
+* **KEY301** — a string literal shaped like ``<domain>/<...upgrade...>``
+  or ``<domain>/<...-driver-...>`` outside the consts module. Key shapes
+  without the upgrade/driver vocabulary (slice topology labels, image
+  refs, apiVersion strings) are someone else's namespace and exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import AnalysisPass, ParsedModule, Project, register
+
+#: <dns-domain>/<key> where the key speaks the upgrade-flow vocabulary.
+UPGRADE_KEY_RE = re.compile(
+    r"^[a-z0-9-]+(\.[a-z0-9-]+)+/"  # domain with at least one dot
+    r"[a-z0-9._-]*(upgrade|driver)[a-z0-9._-]*$",
+    re.IGNORECASE,
+)
+
+
+def is_upgrade_key_literal(value: str) -> bool:
+    return UPGRADE_KEY_RE.match(value) is not None
+
+
+def _is_consts_module(module: ParsedModule) -> bool:
+    # The module that defines the key builders is where the literal shape
+    # is allowed to exist (the single source of truth). Require the
+    # builder SHAPE — a class with both `_key` and `state_label` — not
+    # merely a method named `_key` (FakeCluster/Informer have unrelated
+    # `_key` helpers and must stay inside the pass's coverage).
+    for node in module.tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        names = {
+            item.name
+            for item in ast.walk(node)
+            if isinstance(item, ast.FunctionDef)
+        }
+        if "_key" in names and "state_label" in names:
+            return True
+    return module.path.name == "consts.py"
+
+
+@register
+class LiteralKeyPass(AnalysisPass):
+    name = "literal-key"
+    codes = ("KEY301",)
+
+    def run(self, project: Project) -> None:
+        for module in project.modules:
+            if _is_consts_module(module):
+                continue
+            for node in ast.walk(module.tree):
+                if not (isinstance(node, ast.Constant)
+                        and isinstance(node.value, str)):
+                    continue
+                if node.lineno in module.docstring_lines:
+                    continue
+                if is_upgrade_key_literal(node.value):
+                    self.add(
+                        module, node, "KEY301",
+                        f"inline upgrade label/annotation key "
+                        f"{node.value!r} — use the UpgradeKeys builders",
+                    )

@@ -1,0 +1,304 @@
+"""Lock-discipline pass — the ``go vet``-shaped race checks.
+
+Targets the threaded paths (tpu/monitor.py, upgrade/task_runner.py,
+upgrade/metrics.py, utils/sync.py, kube/cache.py, kube/workqueue.py, …)
+but runs on every class that holds a ``threading.Lock``/``RLock``/
+``Condition`` attribute:
+
+* **LCK101** — an instance attribute is mutated both inside and outside
+  ``with self._lock`` blocks. Half-guarded state is the classic silent
+  race: the guarded half documents the intent, the unguarded half
+  breaks it. ``__init__``/``__new__`` are exempt (construction
+  happens-before publication).
+* **LCK102** — a blocking call (``time.sleep``, ``subprocess.*``,
+  ``socket.*``, ``open``, HTTP client calls) made while a lock is held.
+  The reference's managers run node operations in goroutines precisely
+  to keep lock hold times bounded (reference: drain_manager.go:104-133);
+  sleeping under a lock stalls every thread behind it.
+
+A lock attribute is recognized from ``self.X = threading.Lock()`` (or
+``RLock``/``Condition``) anywhere in the class body.
+
+The codebase's caller-holds-lock conventions are honored: a method
+named ``*_locked`` (``FakeCluster._establish_crd_locked``) or whose
+docstring states the caller holds the lock (``"caller holds the
+lock"`` / ``"lock held"``, e.g. ``Informer._store_set``) is analyzed
+as a guarded region. The convention stays greppable AND checkable — an
+undocumented helper that mutates guarded state still fires LCK101.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+
+from .core import AnalysisPass, ParsedModule, Project, register
+
+LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+
+#: Dotted-call prefixes considered blocking. Matched against the
+#: reconstructed dotted name of the call target.
+BLOCKING_PREFIXES = (
+    "time.sleep",
+    "subprocess.",
+    "socket.",
+    "urllib.",
+    "http.client.",
+    "requests.",
+    "shutil.",
+    "os.system",
+    "os.popen",
+    "os.spawn",
+)
+
+#: Bare-name calls considered blocking.
+BLOCKING_NAMES = {"open", "input"}
+
+#: Blocking *methods* on any receiver: sleeping, joining a thread, or
+#: waiting on a future/event while holding a lock is a deadlock waiting
+#: for load. ``join`` only counts with zero positional args —
+#: ``sep.join(parts)`` always takes one; ``thread.join()`` /
+#: ``thread.join(timeout=30)`` take none. ``Condition.wait`` releases
+#: the lock it guards, so waiting on one of the class's own lock
+#: attributes is exempt (``_is_own_condition_wait``).
+BLOCKING_METHODS = {"sleep", "wait", "join"}
+
+
+#: Docstring phrases declaring the caller-holds-lock convention.
+CALLER_LOCKED_RE = re.compile(
+    r"caller holds the lock|lock (is )?held|called with .{0,40}lock",
+    re.IGNORECASE,
+)
+
+
+def _caller_holds_lock(func: ast.FunctionDef) -> bool:
+    # `_establish_crd_locked`-style names are the codebase's convention
+    # for "only call me with the lock held".
+    if func.name.endswith("_locked"):
+        return True
+    doc = ast.get_docstring(func)
+    if not doc:
+        return False
+    return CALLER_LOCKED_RE.search(re.sub(r"\s+", " ", doc)) is not None
+
+
+def _dotted(node: ast.expr) -> str:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    else:
+        return ""
+    return ".".join(reversed(parts))
+
+
+def _self_attr(node: ast.expr) -> str:
+    """'attr' when node is exactly ``self.attr``, else ''."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return ""
+
+
+@dataclass
+class _AttrSites:
+    inside: list[ast.AST] = field(default_factory=list)
+    outside: list[ast.AST] = field(default_factory=list)
+
+
+class _ClassAnalyzer:
+    def __init__(self, cls: ast.ClassDef) -> None:
+        self.cls = cls
+        self.lock_attrs = self._find_lock_attrs()
+        #: attr name -> mutation sites partitioned by lock context
+        self.mutations: dict[str, _AttrSites] = {}
+        self.blocking: list[tuple[ast.AST, str]] = []
+        #: Per-method local names aliasing a lock attribute.
+        self._lock_aliases: set[str] = set()
+
+    def _find_lock_attrs(self) -> set[str]:
+        found: set[str] = set()
+        for node in ast.walk(self.cls):
+            if not isinstance(node, ast.Assign):
+                continue
+            value = node.value
+            if not isinstance(value, ast.Call):
+                continue
+            callee = _dotted(value.func)
+            if not (
+                callee in LOCK_FACTORIES
+                or any(callee == f"threading.{f}" for f in LOCK_FACTORIES)
+            ):
+                continue
+            for target in node.targets:
+                attr = _self_attr(target)
+                if attr:
+                    found.add(attr)
+        return found
+
+    def analyze(self) -> None:
+        if not self.lock_attrs:
+            return
+        for item in self.cls.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                init = item.name in ("__init__", "__new__")
+                caller_locked = _caller_holds_lock(item)
+                # `lock = self._lock; with lock:` — the local-alias
+                # idiom. Collect simple aliases per method so the alias
+                # form guards like the direct form.
+                self._lock_aliases = {
+                    t.id
+                    for node in ast.walk(item)
+                    if isinstance(node, ast.Assign)
+                    and _self_attr(node.value) in self.lock_attrs
+                    for t in node.targets
+                    if isinstance(t, ast.Name)
+                }
+                self._walk(item.body, in_lock=caller_locked, in_init=init)
+
+    # -- recursive walk tracking `with self.<lock>` regions ---------------
+    def _walk(self, stmts: list[ast.stmt], in_lock: bool, in_init: bool) -> None:
+        for stmt in stmts:
+            self._visit_stmt(stmt, in_lock, in_init)
+
+    def _visit_stmt(self, stmt: ast.stmt, in_lock: bool, in_init: bool) -> None:
+        if isinstance(stmt, ast.With):
+            entered = in_lock or self._acquires_lock(stmt)
+            for item in stmt.items:
+                self._visit_expr(item.context_expr, in_lock, in_init)
+            self._walk(stmt.body, entered, in_init)
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # A nested function (callback, thread target) runs at an
+            # unknown time — treat its body as outside the lock.
+            self._walk(stmt.body, False, False)
+            return
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (
+                stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+            )
+            for target in targets:
+                self._record_mutation(target, in_lock, in_init)
+            value = getattr(stmt, "value", None)
+            if value is not None:
+                self._visit_expr(value, in_lock, in_init)
+            return
+        if isinstance(stmt, ast.Delete):
+            for target in stmt.targets:
+                self._record_mutation(target, in_lock, in_init)
+            return
+        # Generic: visit expressions, then child statement blocks with the
+        # same lock context.
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.stmt):
+                self._visit_stmt(child, in_lock, in_init)
+            elif isinstance(child, ast.expr):
+                self._visit_expr(child, in_lock, in_init)
+            elif isinstance(child, (ast.ExceptHandler, ast.match_case)):
+                self._walk(child.body, in_lock, in_init)
+
+    def _visit_expr(self, expr: ast.expr, in_lock: bool, in_init: bool) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call) and in_lock and not in_init:
+                reason = self._blocking_reason(node)
+                if reason:
+                    self.blocking.append((node, reason))
+
+    def _acquires_lock(self, stmt: ast.With) -> bool:
+        for item in stmt.items:
+            expr = item.context_expr
+            # `with self._lock:` — the plain form.
+            if _self_attr(expr) in self.lock_attrs:
+                return True
+            # `with lock:` where `lock = self._lock` earlier in the
+            # method. (contextlib.ExitStack and cross-method aliases stay
+            # out of scope — use # noqa: LCK101 there.)
+            if isinstance(expr, ast.Name) and expr.id in self._lock_aliases:
+                return True
+        return False
+
+    def _record_mutation(self, target: ast.expr, in_lock: bool,
+                         in_init: bool) -> None:
+        # Unpacking targets: descend to the attribute leaves.
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._record_mutation(elt, in_lock, in_init)
+            return
+        attr = ""
+        if isinstance(target, ast.Attribute):
+            attr = _self_attr(target)
+        elif isinstance(target, ast.Subscript):
+            attr = _self_attr(target.value)
+        if not attr or attr in self.lock_attrs or in_init:
+            return
+        sites = self.mutations.setdefault(attr, _AttrSites())
+        (sites.inside if in_lock else sites.outside).append(target)
+
+    def _is_own_condition_wait(self, call: ast.Call) -> bool:
+        """``self._cond.wait(...)`` where ``_cond`` is one of this class's
+        lock attributes: Condition.wait releases the lock while waiting,
+        so it is the sanctioned way to block under the lock."""
+        func = call.func
+        return (
+            isinstance(func, ast.Attribute)
+            and func.attr in ("wait", "wait_for")
+            and _self_attr(func.value) in self.lock_attrs
+        )
+
+    def _blocking_reason(self, call: ast.Call) -> str:
+        name = _dotted(call.func)
+        if not name:
+            return ""
+        if name in BLOCKING_NAMES:
+            return name
+        for prefix in BLOCKING_PREFIXES:
+            if name == prefix or name.startswith(prefix):
+                return name
+        last = name.rsplit(".", 1)[-1]
+        if last in BLOCKING_METHODS:
+            if self._is_own_condition_wait(call):
+                return ""
+            if last == "join" and call.args:
+                return ""  # sep.join(iterable) — string building
+            return name
+        return ""
+
+
+@register
+class LockDisciplinePass(AnalysisPass):
+    name = "lock-discipline"
+    codes = ("LCK101", "LCK102")
+
+    def run(self, project: Project) -> None:
+        for module in project.modules:
+            self._check_module(module)
+
+    def _check_module(self, module: ParsedModule) -> None:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            analyzer = _ClassAnalyzer(node)
+            analyzer.analyze()
+            if not analyzer.lock_attrs:
+                continue
+            for attr, sites in sorted(analyzer.mutations.items()):
+                if sites.inside and sites.outside:
+                    for site in sites.outside:
+                        self.add(
+                            module, site, "LCK101",
+                            f"attribute 'self.{attr}' of class "
+                            f"'{node.name}' is mutated under the lock "
+                            f"elsewhere but unguarded here",
+                        )
+            for call, reason in analyzer.blocking:
+                self.add(
+                    module, call, "LCK102",
+                    f"blocking call '{reason}' while a lock of class "
+                    f"'{node.name}' is held",
+                )

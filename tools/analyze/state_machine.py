@@ -1,0 +1,312 @@
+"""State-machine exhaustiveness pass.
+
+The upgrade machine's correctness hinges on three invariants the type
+system cannot see (Guard, PAPERS.md: node-health controllers fail via
+silent state-handling gaps):
+
+* **STM201** — every ``UpgradeState`` member belongs to exactly one of
+  the ``MANAGED_STATES`` / ``MAINTENANCE_STATES`` partitions (reference:
+  pkg/upgrade/common_manager.go:714-731 — a state outside the partition
+  silently escapes the budget math).
+* **STM202** — a member listed in both partitions (double-counted).
+* **STM203** — a member with no handler in the orchestrator's
+  ``apply_state`` pass (reference: upgrade_state.go:171-281 — a node
+  parked in an unhandled state never progresses and never alarms).
+* **STM204** — a ``process_*_nodes`` call in ``apply_state`` that maps
+  to no enum member (a stale handler for a renamed/removed state).
+* **STM205** — a state *value* string literal outside the consts module
+  (``"upgrade-done"`` inline drifts silently when the enum changes).
+
+The pass discovers the machine structurally, so the test fixtures can
+carry miniature twins: the consts module is any module defining both a
+``*State`` str-enum class and ``MANAGED_STATES``; the orchestrator is
+any module defining an ``apply_state`` function. When several machines
+are scanned at once each orchestrator is paired with the consts module
+sharing the longest path prefix.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .core import AnalysisPass, ParsedModule, Project, register
+
+PARTITION_NAMES = ("MANAGED_STATES", "MAINTENANCE_STATES")
+
+
+@dataclass
+class StateMachineModel:
+    consts_module: ParsedModule
+    enum_name: str = ""
+    enum_node: Optional[ast.ClassDef] = None
+    #: member name -> string value (only str-constant members)
+    members: dict[str, str] = field(default_factory=dict)
+    member_nodes: dict[str, ast.AST] = field(default_factory=dict)
+    #: partition name -> member names listed
+    partitions: dict[str, list[str]] = field(default_factory=dict)
+    partition_nodes: dict[str, ast.AST] = field(default_factory=dict)
+
+
+def _is_str_enum_class(node: ast.ClassDef) -> bool:
+    texts = [ast.unparse(base) for base in node.bases]
+    if any("StrEnum" in t for t in texts):
+        return True
+    # The pre-3.11 spelling: class FooState(str, Enum).
+    has_str = any(t.split(".")[-1] == "str" for t in texts)
+    has_enum = any(t.split(".")[-1] == "Enum" for t in texts)
+    return has_str and has_enum
+
+
+def extract_model(module: ParsedModule) -> Optional[StateMachineModel]:
+    """A consts module defines a ``*State`` str-enum AND MANAGED_STATES."""
+    model = StateMachineModel(consts_module=module)
+    for node in module.tree.body:
+        if isinstance(node, ast.ClassDef) and node.name.endswith("State") \
+                and _is_str_enum_class(node):
+            model.enum_name = node.name
+            model.enum_node = node
+            for item in node.body:
+                if (
+                    isinstance(item, ast.Assign)
+                    and len(item.targets) == 1
+                    and isinstance(item.targets[0], ast.Name)
+                    and isinstance(item.value, ast.Constant)
+                    and isinstance(item.value.value, str)
+                ):
+                    name = item.targets[0].id
+                    model.members[name] = item.value.value
+                    model.member_nodes[name] = item
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for target in targets:
+                if not (isinstance(target, ast.Name)
+                        and target.id in PARTITION_NAMES):
+                    continue
+                value = node.value
+                # frozenset({...}) / tuple literal / set literal all appear
+                # in consts.py history; accept any container of
+                # `Enum.MEMBER` attribute references.
+                names = [
+                    inner.attr
+                    for inner in ast.walk(value)
+                    if isinstance(inner, ast.Attribute)
+                ] if value is not None else []
+                model.partitions[target.id] = names
+                model.partition_nodes[target.id] = node
+    if model.enum_node is None or "MANAGED_STATES" not in model.partitions:
+        return None
+    return model
+
+
+def find_apply_state(module: ParsedModule) -> Optional[ast.FunctionDef]:
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == "apply_state":
+            return node
+    return None
+
+
+def _handler_tokens(member: str) -> list[str]:
+    """Name fragments that count as "a handler for this member", most
+    specific first: CORDON_REQUIRED -> ['cordon_required', 'cordon'];
+    POD_RESTART_REQUIRED -> ['pod_restart_required', 'pod_restart']."""
+    lowered = member.lower()
+    tokens = [lowered]
+    for suffix in ("_required", "_needed"):
+        if lowered.endswith(suffix):
+            tokens.append(lowered[: -len(suffix)])
+    return tokens
+
+
+def _token_in_name(token: str, name: str) -> bool:
+    """Word-boundary containment: 'cordon_required' must NOT match
+    'process_uncordon_required_nodes'."""
+    return re.search(rf"(?:^|_){re.escape(token)}(?:$|_)", name) is not None
+
+
+@dataclass
+class ApplyStateInfo:
+    func: ast.FunctionDef
+    module: ParsedModule
+    #: call node -> called name (process_cordon_required_nodes, ...)
+    handler_calls: list[tuple[ast.Call, str]] = field(default_factory=list)
+    #: Enum.MEMBER references anywhere in apply_state
+    state_refs: set[str] = field(default_factory=set)
+
+
+def extract_apply_state(module: ParsedModule, enum_name: str) -> Optional[ApplyStateInfo]:
+    func = find_apply_state(module)
+    if func is None:
+        return None
+    info = ApplyStateInfo(func=func, module=module)
+    for node in ast.walk(func):
+        if isinstance(node, ast.Call):
+            name = ""
+            if isinstance(node.func, ast.Attribute):
+                name = node.func.attr
+            elif isinstance(node.func, ast.Name):
+                name = node.func.id
+            if name.startswith(("process_", "_process_")):
+                info.handler_calls.append((node, name))
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == enum_name
+        ):
+            info.state_refs.add(node.attr)
+    return info
+
+
+def _pair_consts_with_manager(
+    models: list[StateMachineModel], managers: list[ParsedModule]
+) -> list[tuple[StateMachineModel, ParsedModule]]:
+    pairs = []
+    for manager in managers:
+        manager_parts = manager.path.parts
+        best, best_score = None, -1
+        for model in models:
+            consts_parts = model.consts_module.path.parts
+            score = 0
+            for a, b in zip(manager_parts, consts_parts):
+                if a != b:
+                    break
+                score += 1
+            if score > best_score:
+                best, best_score = model, score
+        if best is not None:
+            pairs.append((best, manager))
+    return pairs
+
+
+@register
+class StateMachinePass(AnalysisPass):
+    name = "state-machine"
+    codes = ("STM201", "STM202", "STM203", "STM204", "STM205")
+
+    def run(self, project: Project) -> None:
+        models: list[StateMachineModel] = []
+        for module in project.modules:
+            model = extract_model(module)
+            if model is not None:
+                models.append(model)
+        if not models:
+            return
+        for model in models:
+            self._check_partition(model)
+        managers = [
+            m for m in project.modules if find_apply_state(m) is not None
+        ]
+        for model, manager in _pair_consts_with_manager(models, managers):
+            self._check_handlers(model, manager)
+        self._check_literals(project, models)
+
+    # -- STM201/STM202: the MANAGED/MAINTENANCE partition ------------------
+    def _check_partition(self, model: StateMachineModel) -> None:
+        module = model.consts_module
+        listed: dict[str, list[str]] = {}
+        for part_name, names in model.partitions.items():
+            for n in names:
+                listed.setdefault(n, []).append(part_name)
+        for member in model.members:
+            parts = listed.get(member, [])
+            if not parts:
+                self.add(
+                    module, model.member_nodes[member], "STM201",
+                    f"{model.enum_name}.{member} is in neither "
+                    "MANAGED_STATES nor MAINTENANCE_STATES — it escapes "
+                    "the budget/metrics accounting",
+                )
+            elif len(parts) > 1:
+                self.add(
+                    module, model.member_nodes[member], "STM202",
+                    f"{model.enum_name}.{member} is listed in "
+                    f"{' and '.join(sorted(set(parts)))} — double-counted",
+                )
+        # Partition entries that are not members (stale after a rename).
+        for part_name, names in model.partitions.items():
+            for n in names:
+                if n not in model.members:
+                    self.add(
+                        module, model.partition_nodes[part_name], "STM201",
+                        f"{part_name} lists unknown member "
+                        f"{model.enum_name}.{n}",
+                    )
+
+    # -- STM203/STM204: apply_state handler coverage -----------------------
+    def _check_handlers(
+        self, model: StateMachineModel, manager: ParsedModule
+    ) -> None:
+        info = extract_apply_state(manager, model.enum_name)
+        if info is None:
+            return
+        called_names = [name for _, name in info.handler_calls]
+        all_tokens = {
+            token
+            for member in model.members
+            for token in _handler_tokens(member)
+        }
+
+        for member in model.members:
+            handled = member in info.state_refs or any(
+                _token_in_name(token, name)
+                for token in _handler_tokens(member)
+                for name in called_names
+            )
+            if not handled:
+                self.add(
+                    manager, info.func, "STM203",
+                    f"apply_state has no handler for "
+                    f"{model.enum_name}.{member} — nodes in that state "
+                    "never progress",
+                )
+        # Staleness is per call name against ALL member tokens — two
+        # handlers legitimately mapped to one state (e.g. a drain call
+        # split into drain + drain-timeout) must both count as mapped.
+        seen_stale: set[str] = set()
+        for node, name in info.handler_calls:
+            if name in seen_stale:
+                continue
+            if any(_token_in_name(token, name) for token in all_tokens):
+                continue
+            seen_stale.add(name)
+            self.add(
+                manager, node, "STM204",
+                f"apply_state calls '{name}' which maps to no "
+                f"{model.enum_name} member — stale handler?",
+            )
+
+    # -- STM205: state-value literals outside consts -----------------------
+    def _check_literals(
+        self, project: Project, models: list[StateMachineModel]
+    ) -> None:
+        values: dict[str, tuple[str, str]] = {}
+        consts_paths = set()
+        for model in models:
+            consts_paths.add(model.consts_module.path)
+            for member, value in model.members.items():
+                if value:
+                    values[value] = (model.enum_name, member)
+        if not values:
+            return
+        for module in project.modules:
+            if module.path in consts_paths:
+                continue
+            for node in ast.walk(module.tree):
+                if not (isinstance(node, ast.Constant)
+                        and isinstance(node.value, str)):
+                    continue
+                if node.value not in values:
+                    continue
+                if node.lineno in module.docstring_lines:
+                    continue
+                enum_name, member = values[node.value]
+                self.add(
+                    module, node, "STM205",
+                    f"state value {node.value!r} spelled inline — use "
+                    f"{enum_name}.{member}",
+                )

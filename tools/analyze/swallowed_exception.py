@@ -1,0 +1,137 @@
+"""Swallowed-exception pass.
+
+Guard (PAPERS.md) attributes node-health-controller failures to swallowed
+errors as much as to state gaps: a reconcile path that catches broadly
+and neither logs nor re-raises turns an outage into silence. The
+reference gates the analogous Go shape with errcheck + staticcheck.
+
+* **EXC401** — an ``except Exception:`` / ``except BaseException:`` /
+  bare ``except:`` handler whose body neither re-raises, nor logs
+  (``log.*``/``logger.*``/``logging.*``/``warnings.warn``), nor emits a
+  Kubernetes Event (``recorder.eventf``-shaped calls, the operator
+  world's other audit trail).
+
+Narrow handlers (``except NotFoundError: continue``) encode a decision
+about one failure mode and are exempt — only the broad catch-alls must
+leave a trace. Two structural exemptions:
+
+* error-as-data — ``except Exception as e:`` whose body *reads* ``e``
+  (the probe layer turns crashes into failed HealthReports carrying
+  ``str(e)``; the error is propagated, not swallowed);
+* import fallbacks — a ``try`` whose body is only imports (the
+  gate-missing-deps idiom for optional Pallas/TPU wheels).
+
+Deliberate silent handlers (e.g. best-effort teardown) belong in the
+baseline file with a justification, or carry a targeted
+``# noqa: EXC401``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import AnalysisPass, Project, register
+
+BROAD_NAMES = {"Exception", "BaseException"}
+
+LOGGING_METHODS = {
+    "debug", "info", "warning", "warn", "error", "exception", "critical",
+    "log",
+}
+
+EVENT_METHODS = {"eventf", "event", "_event"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:
+        return True
+    node = handler.type
+    if isinstance(node, ast.Name):
+        return node.id in BROAD_NAMES
+    if isinstance(node, ast.Attribute):
+        return node.attr in BROAD_NAMES
+    if isinstance(node, ast.Tuple):
+        return any(
+            (isinstance(e, ast.Name) and e.id in BROAD_NAMES)
+            or (isinstance(e, ast.Attribute) and e.attr in BROAD_NAMES)
+            for e in node.elts
+        )
+    return False
+
+
+def _leaves_a_trace(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            func = node.func
+            if isinstance(func, ast.Attribute) and (
+                func.attr in LOGGING_METHODS or func.attr in EVENT_METHODS
+            ):
+                return True
+            if isinstance(func, ast.Name) and func.id in ("print",):
+                # stdout is a trace in CLI tools; the operator paths all
+                # use the logger anyway.
+                return True
+        # `except Exception as e:` with `e` referenced in the body is
+        # error-as-data (the probe layer's contract: a crash becomes a
+        # failed HealthReport carrying str(e)) — the error is propagated,
+        # not swallowed.
+        if (
+            handler.name
+            and isinstance(node, ast.Name)
+            and node.id == handler.name
+            and isinstance(node.ctx, ast.Load)
+        ):
+            return True
+    return False
+
+
+def _is_import_fallback(handler: ast.ExceptHandler, tree: ast.Module) -> bool:
+    """``try: import pallas ... except Exception: <sentinel>`` — the
+    gate-missing-deps idiom. Exempt when every statement in the guarded
+    try body is an import."""
+    def import_or_flag(stmt: ast.stmt) -> bool:
+        # `from jax.experimental import pallas` + `_HAS_PALLAS = True`.
+        if isinstance(stmt, (ast.Import, ast.ImportFrom)):
+            return True
+        return isinstance(stmt, ast.Assign) and isinstance(
+            stmt.value, ast.Constant
+        )
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Try) and handler in node.handlers:
+            return (
+                bool(node.body)
+                and any(isinstance(s, (ast.Import, ast.ImportFrom))
+                        for s in node.body)
+                and all(import_or_flag(s) for s in node.body)
+            )
+    return False
+
+
+@register
+class SwallowedExceptionPass(AnalysisPass):
+    name = "swallowed-exception"
+    codes = ("EXC401",)
+
+    def run(self, project: Project) -> None:
+        for module in project.modules:
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                if not _is_broad(node):
+                    continue
+                if _leaves_a_trace(node):
+                    continue
+                if _is_import_fallback(node, module.tree):
+                    continue
+                what = (
+                    "bare except" if node.type is None
+                    else f"except {ast.unparse(node.type)}"
+                )
+                self.add(
+                    module, node, "EXC401",
+                    f"{what} swallows the error — log it, re-raise, or "
+                    "baseline with a justification",
+                )

@@ -9,17 +9,23 @@ stdlib (ast + symtable) to keep `make lint` meaningful everywhere:
 * F401  unused import
 * F811  redefinition of an unused name (imports/defs)
 * F821  undefined name (typo detection, symtable-based)
-* F502  f-string without placeholders
+* F541  f-string without placeholders (ruff's code for it)
 * B006  mutable default argument
 * B011  assert on a non-empty tuple (always true)
 * E722  bare except
 * F601  `is` comparison with a literal
-* W605  duplicate literal keys in a dict display
+* W093  duplicate literal keys in a dict display (locally assigned —
+  unclaimed by pycodestyle/ruff; upstream W605 means invalid escape
+  sequence, which this linter does not check)
 * E501  line too long (default 100)
 * W191/W291  tabs / trailing whitespace
 
 Exit status 1 when any finding is reported; findings print as
 ``path:line:col CODE message`` (ruff-compatible enough for editors).
+
+Suppression is per-code: ``# noqa: F401`` silences exactly that rule on
+that line, ``# noqa: F401,E501`` several, and a bare ``# noqa`` remains
+the blanket escape hatch. tools/analyze.py shares the same grammar.
 """
 
 from __future__ import annotations
@@ -30,6 +36,12 @@ import builtins
 import sys
 import symtable
 from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+# One suppression grammar across both lint tiers (tools/analyze/ is the
+# domain tier): `# noqa` blanket, `# noqa: CODE[,CODE]` targeted.
+from analyze.core import parse_noqa, suppressed  # noqa: E402
 
 MAX_LINE = 100
 
@@ -146,42 +158,36 @@ class _ImportTracker(ast.NodeVisitor):
             self.string_annotations.append(node.value)
 
 
-def _iter_lines(source: str, path: Path):
+def _iter_lines(source: str, path: Path, noqa):
     findings = []
     for i, line in enumerate(source.splitlines(), 1):
-        if len(line) > MAX_LINE and "noqa" not in line:
+        if len(line) > MAX_LINE and not suppressed(noqa, i, "E501"):
             findings.append(
                 Finding(path, i, MAX_LINE + 1, "E501",
                         f"line too long ({len(line)} > {MAX_LINE})")
             )
-        if line.rstrip("\n") != line.rstrip():
+        if line.rstrip("\n") != line.rstrip() and not suppressed(
+            noqa, i, "W291"
+        ):
             findings.append(
                 Finding(path, i, len(line.rstrip()) + 1, "W291",
                         "trailing whitespace")
             )
-        if "\t" in line.split("#")[0]:
+        if "\t" in line.split("#")[0] and not suppressed(noqa, i, "W191"):
             findings.append(Finding(path, i, line.index("\t") + 1, "W191",
                                     "tab in source"))
     return findings
 
 
-def _noqa_lines(source: str) -> set[int]:
-    return {
-        i
-        for i, line in enumerate(source.splitlines(), 1)
-        if "noqa" in line
-    }
-
-
 class _AstChecks(ast.NodeVisitor):
-    def __init__(self, path: Path, noqa: set[int]):
+    def __init__(self, path: Path, noqa):
         self.path = path
         self.noqa = noqa
         self.findings: list[Finding] = []
 
     def _add(self, node, code: str, msg: str) -> None:
         line = getattr(node, "lineno", 1)
-        if line in self.noqa:
+        if suppressed(self.noqa, line, code):
             return
         self.findings.append(
             Finding(self.path, line, getattr(node, "col_offset", 0) + 1,
@@ -227,7 +233,7 @@ class _AstChecks(ast.NodeVisitor):
             if isinstance(key, ast.Constant):
                 try:
                     if key.value in seen:
-                        self._add(key, "W605",
+                        self._add(key, "W093",
                                   f"duplicate dict key {key.value!r}")
                     seen.add(key.value)
                 except TypeError:
@@ -236,7 +242,7 @@ class _AstChecks(ast.NodeVisitor):
 
     def visit_JoinedStr(self, node: ast.JoinedStr) -> None:
         if not any(isinstance(v, ast.FormattedValue) for v in node.values):
-            self._add(node, "F502", "f-string without placeholders")
+            self._add(node, "F541", "f-string without placeholders")
         # Recurse into interpolated values only: a format spec ({x:.2f}) is
         # itself a placeholder-less JoinedStr and must not be flagged.
         for value in node.values:
@@ -245,7 +251,7 @@ class _AstChecks(ast.NodeVisitor):
 
 
 def _undefined_names(source: str, path: Path, tree: ast.Module,
-                     noqa: set[int]) -> list[Finding]:
+                     noqa) -> list[Finding]:
     """F821 via symtable: a name referenced at module scope (or referenced
     as a global from any nested scope) with no module-level binding, no
     import, and no builtin fallback is a typo."""
@@ -292,7 +298,7 @@ def _undefined_names(source: str, path: Path, tree: ast.Module,
             if (
                 isinstance(node.ctx, ast.Load)
                 and node.id in unknown
-                and node.lineno not in noqa
+                and not suppressed(noqa, node.lineno, "F821")
             ):
                 findings.append(
                     Finding(path, node.lineno, node.col_offset + 1, "F821",
@@ -305,10 +311,8 @@ def _undefined_names(source: str, path: Path, tree: ast.Module,
 
 def lint_file(path: Path) -> list[Finding]:
     source = path.read_text()
-    noqa = _noqa_lines(source)
-    findings = [
-        f for f in _iter_lines(source, path) if f.line not in noqa
-    ]
+    noqa = parse_noqa(source)
+    findings = _iter_lines(source, path, noqa)
     try:
         tree = ast.parse(source, filename=str(path))
     except SyntaxError as e:
@@ -329,14 +333,14 @@ def lint_file(path: Path) -> list[Finding]:
     for name, node in tracker.imports.items():
         if name in keep or name.startswith("_") or is_init:
             continue  # __init__.py re-exports are the package's public API
-        if node.lineno in noqa:
+        if suppressed(noqa, node.lineno, "F401"):
             continue
         findings.append(
             Finding(path, node.lineno, node.col_offset + 1, "F401",
                     f"unused import {name!r}")
         )
     for name, prior, node in tracker.redefinitions:
-        if node.lineno in noqa:
+        if suppressed(noqa, node.lineno, "F811"):
             continue
         findings.append(
             Finding(path, node.lineno, node.col_offset + 1, "F811",
