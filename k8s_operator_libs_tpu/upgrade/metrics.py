@@ -12,6 +12,7 @@ metrics with no dependency.
 
 from __future__ import annotations
 
+import itertools
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Protocol
@@ -86,12 +87,36 @@ class UpgradeMetrics:
         self._lock = threading.Lock()
         self._values: dict[str, int] = {}
         self._reconcile_passes = 0
+        #: Entry-order tickets for observe(): values are computed outside
+        #: the lock, so two concurrent observes can reach the commit in
+        #: either order — the ticket makes commits apply in observe-ENTRY
+        #: order (a commit that lost the race to a later-entering observe
+        #: is dropped), restoring the pre-narrowing serialization. Note
+        #: this orders observe() calls, not the build_state snapshots
+        #: they carry; callers racing whole build+observe sequences must
+        #: serialize those themselves. itertools.count.__next__ is
+        #: atomic.
+        self._ticket = itertools.count(1)
+        self._committed = 0
 
     def observe(self, state) -> None:
+        # The accessors walk the full cluster snapshot — compute them
+        # BEFORE taking the lock so a slow pass cannot stall concurrent
+        # /metrics scrapes (render() holds the same lock). The lock
+        # guards only the swap, keeping each scrape a consistent
+        # snapshot of one observe; the ticket drops a commit that lost
+        # the race to a later-entering observe (see __init__ on what
+        # that does and does not order).
+        ticket = next(self._ticket)
+        values = {
+            suffix: getattr(self._manager, accessor)(state)
+            for suffix, _, accessor in _GAUGES
+        }
         with self._lock:
             self._reconcile_passes += 1
-            for suffix, _, accessor in _GAUGES:
-                self._values[suffix] = getattr(self._manager, accessor)(state)
+            if ticket > self._committed:
+                self._committed = ticket
+                self._values.update(values)
 
     def render(self) -> str:
         label = prom_label("device", self._device)

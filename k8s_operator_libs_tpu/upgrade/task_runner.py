@@ -45,11 +45,13 @@ class TaskRunner:
 
     def submit(self, key: str, fn: Callable[[], None]) -> bool:
         """Run ``fn`` under ``key``; refuse (return False) if an operation
-        with the same key is still in flight."""
-        if self._in_progress.has(key):
+        with the same key is still in flight. The claim is an atomic
+        test-and-set: two reconcile workers racing on one node must not
+        both schedule its operation (a separate has()+add() lets both
+        observe the key absent)."""
+        if not self._in_progress.add_if_absent(key):
             log.debug("task %s already in progress, skipping", key)
             return False
-        self._in_progress.add(key)
         if self._inline:
             try:
                 fn()

@@ -29,6 +29,19 @@ class StringSet:
         with self._lock:
             self._items.add(item)
 
+    def add_if_absent(self, item: str) -> bool:
+        """Atomically add ``item`` unless present; True when added.
+
+        The in-progress guard needs test-and-set in ONE lock hold:
+        ``has()`` followed by ``add()`` lets two reconcile workers both
+        observe the key absent and both schedule the node's operation.
+        """
+        with self._lock:
+            if item in self._items:
+                return False
+            self._items.add(item)
+            return True
+
     def remove(self, item: str) -> None:
         with self._lock:
             self._items.discard(item)
@@ -47,6 +60,12 @@ class StringSet:
     def snapshot(self) -> frozenset[str]:
         with self._lock:
             return frozenset(self._items)
+
+    def __iter__(self) -> Iterator[str]:
+        """Iterate a point-in-time snapshot (sorted, deterministic):
+        concurrent add/remove during iteration neither raises nor leaks
+        into the view, matching the reference set's range-over-copy."""
+        return iter(sorted(self.snapshot()))
 
     def clear(self) -> None:
         with self._lock:

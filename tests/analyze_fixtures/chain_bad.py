@@ -1,0 +1,28 @@
+"""Seeded LCK111 violation: a blocking call three frames below a held
+lock. ``tick`` holds the lock and calls ``_refresh``; the sleep lives in
+``_backoff``, two more calls down — LCK102 (intraprocedural) cannot see
+it, the call-graph propagation can.
+"""
+
+import threading
+import time
+
+
+class Poller:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._state: dict = {}
+
+    def tick(self) -> None:
+        with self._lock:
+            self._state["latest"] = self._refresh()
+
+    def _refresh(self) -> dict:
+        return self._fetch()
+
+    def _fetch(self) -> dict:
+        self._backoff()
+        return {}
+
+    def _backoff(self) -> None:
+        time.sleep(0.05)

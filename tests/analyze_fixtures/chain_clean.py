@@ -1,0 +1,32 @@
+"""Clean twin of chain_bad.py: the same call chain, but the blocking
+backoff runs after the lock is released — the lock guards only the
+in-memory swap. The ``*_locked`` helper is called with the lock held,
+as its name requires, and does no blocking work.
+"""
+
+import threading
+import time
+
+
+class Poller:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._state: dict = {}
+
+    def tick(self) -> None:
+        fresh = self._refresh()
+        with self._lock:
+            self._swap_locked(fresh)
+        self._backoff()
+
+    def _swap_locked(self, fresh: dict) -> None:
+        self._state["latest"] = fresh
+
+    def _refresh(self) -> dict:
+        return self._fetch()
+
+    def _fetch(self) -> dict:
+        return {}
+
+    def _backoff(self) -> None:
+        time.sleep(0.05)

@@ -1,0 +1,39 @@
+"""Seeded LCK110 violation: an AB/BA lock-order inversion between two
+classes, where each half of the cycle crosses a call boundary — invisible
+to any per-function analysis.
+
+``Cache.refresh`` holds ``Cache._lock`` and calls into the queue, which
+takes ``Queue._lock``; ``Queue.drop`` holds ``Queue._lock`` and calls
+back into the cache, which takes ``Cache._lock``. Two threads running
+``refresh`` and ``drop`` concurrently deadlock.
+"""
+
+import threading
+
+
+class Cache:
+    def __init__(self, queue: "Queue") -> None:
+        self._lock = threading.Lock()
+        self.queue = queue
+
+    def refresh(self) -> None:
+        with self._lock:
+            self.queue.requeue_all()
+
+    def invalidate(self, key: str) -> None:
+        with self._lock:
+            del key
+
+
+class Queue:
+    def __init__(self, cache: Cache) -> None:
+        self._lock = threading.Lock()
+        self.cache = cache
+
+    def requeue_all(self) -> None:
+        with self._lock:
+            pass
+
+    def drop(self, key: str) -> None:
+        with self._lock:
+            self.cache.invalidate(key)

@@ -1,0 +1,37 @@
+"""Clean twin of deadlock_bad.py: the same two classes and the same
+cross-class calls, but every path acquires the locks in one global order
+(Cache before Queue) — the acquisition graph is a DAG.
+"""
+
+import threading
+
+
+class Cache:
+    def __init__(self, queue: "Queue") -> None:
+        self._lock = threading.Lock()
+        self.queue = queue
+
+    def refresh(self) -> None:
+        with self._lock:
+            self.queue.requeue_all()
+
+    def invalidate(self, key: str) -> None:
+        with self._lock:
+            del key
+
+    def drop(self, key: str) -> None:
+        # The inversion from the bad twin, restructured: take the cache
+        # lock FIRST, then call down into the queue — same order as
+        # refresh(), so no cycle.
+        with self._lock:
+            self.queue.requeue_all()
+
+
+class Queue:
+    def __init__(self, cache: Cache) -> None:
+        self._lock = threading.Lock()
+        self.cache = cache
+
+    def requeue_all(self) -> None:
+        with self._lock:
+            pass

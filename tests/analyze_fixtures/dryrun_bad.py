@@ -1,0 +1,44 @@
+"""Seeded DRY501 violations: cluster mutations reachable on dry_run
+paths without the flag forwarded.
+
+* ``cordon`` — runs on both paths (no early return) and PATCHes without
+  forwarding ``dry_run``: a dry-run cordon really mutates the node.
+* ``purge`` — evicts INSIDE the ``if dry_run:`` branch without the
+  flag: the preview path performs the real eviction.
+* ``maintenance`` — the mutation is one call below, in a helper with no
+  dry_run parameter: only transitive propagation sees it.
+"""
+
+
+class Client:
+    def patch(self, kind, name, patch=None, dry_run=False):
+        ...
+
+    def evict(self, pod, dry_run=False):
+        ...
+
+    def delete(self, kind, name, dry_run=False):
+        ...
+
+
+class NodeOps:
+    def __init__(self, client: Client) -> None:
+        self._client = client
+
+    def cordon(self, node: str, dry_run: bool = False) -> None:
+        self._client.patch(
+            "Node", node, patch={"spec": {"unschedulable": True}}
+        )
+
+    def purge(self, node: str, pod: str, dry_run: bool = False) -> int:
+        if dry_run:
+            self._client.evict(pod)
+            return 0
+        self._client.evict(pod)
+        return 1
+
+    def maintenance(self, node: str, dry_run: bool = False) -> None:
+        self._wipe(node)
+
+    def _wipe(self, node: str) -> None:
+        self._client.delete("Node", node)

@@ -1,0 +1,53 @@
+"""Clean twin of dryrun_bad.py: every mutation on a tainted path carries
+the dry-run flag — forwarded as a kwarg, hard-wired ``dry_run=True`` in
+a preview branch, killed by an early return, or smuggled through a
+taint-derived query dict (the REST-layer idiom).
+"""
+
+
+class Client:
+    def patch(self, kind, name, patch=None, dry_run=False):
+        ...
+
+    def evict(self, pod, dry_run=False):
+        ...
+
+    def delete(self, kind, name, dry_run=False):
+        ...
+
+    def _request(self, verb, path, query=None):
+        ...
+
+
+class NodeOps:
+    def __init__(self, client: Client) -> None:
+        self._client = client
+
+    def cordon(self, node: str, dry_run: bool = False) -> None:
+        self._client.patch(
+            "Node", node, patch={"spec": {"unschedulable": True}},
+            dry_run=dry_run,
+        )
+
+    def purge(self, node: str, pod: str, dry_run: bool = False) -> int:
+        if dry_run:
+            self._client.evict(pod, dry_run=True)
+            return 0
+        self._client.evict(pod)
+        return 1
+
+    def maintenance(self, node: str, dry_run: bool = False) -> None:
+        if dry_run:
+            return
+        self._wipe(node)
+
+    def _wipe(self, node: str) -> None:
+        self._client.delete("Node", node)
+
+    def raw_write(self, path: str, body, dry_run: bool = False):
+        # The REST-layer shape: the flag rides in a query dict built
+        # under the taint, not in a dry_run kwarg.
+        query: dict = {}
+        if dry_run:
+            query["dryRun"] = "All"
+        return self._client._request("POST", path, query=query)

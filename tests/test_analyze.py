@@ -380,3 +380,612 @@ def test_package_gate_is_clean_via_entrypoint():
         cwd=REPO, capture_output=True, text=True, timeout=120,
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# -- lock-order (LCK110) ---------------------------------------------------
+
+def test_lock_order_flags_seeded_cycle():
+    findings = run_analysis([str(FIXTURES / "deadlock_bad.py")])
+    assert codes(findings) == {"LCK110"}
+    assert len(findings) == 1
+    msg = findings[0].message
+    assert "Cache._lock" in msg and "Queue._lock" in msg
+    # Every edge of the cycle carries its witness call chain.
+    assert "Cache.refresh -> Queue.requeue_all" in msg
+    assert "Queue.drop -> Cache.invalidate" in msg
+
+
+def test_lock_order_silent_on_clean_twin():
+    assert run_analysis([str(FIXTURES / "deadlock_clean.py")]) == []
+
+
+def test_lock_order_self_deadlock_through_call(tmp_path):
+    # A plain Lock re-acquired via a helper is a self-deadlock; the
+    # reentrant twin (RLock) is the sanctioned idiom and stays silent.
+    bad = tmp_path / "self_deadlock.py"
+    bad.write_text(
+        "import threading\n\n\n"
+        "class Counter:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._n = 0\n"
+        "\n"
+        "    def bump(self):\n"
+        "        with self._lock:\n"
+        "            self._bump_two()\n"
+        "\n"
+        "    def _bump_two(self):\n"
+        "        with self._lock:\n"
+        "            self._n += 2\n"
+    )
+    findings = run_analysis([str(bad)])
+    assert codes(findings) == {"LCK110"}
+    assert "Counter._lock -> Counter._lock" in findings[0].message
+    good = tmp_path / "self_reentrant.py"
+    good.write_text(bad.read_text().replace("threading.Lock()",
+                                            "threading.RLock()"))
+    assert run_analysis([str(good)]) == []
+
+
+def test_lock_order_module_level_lock_identity(tmp_path):
+    # A cycle between a module-level lock and a class lock, each edge
+    # crossing a function boundary.
+    mod = tmp_path / "registry.py"
+    mod.write_text(
+        "import threading\n\n"
+        "_REGISTRY_LOCK = threading.Lock()\n\n\n"
+        "class Pool:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "\n"
+        "    def grow(self):\n"
+        "        with self._lock:\n"
+        "            register(self)\n"
+        "\n"
+        "    def audit(self):\n"
+        "        with _REGISTRY_LOCK:\n"
+        "            self.reap()\n"
+        "\n"
+        "    def reap(self):\n"
+        "        with self._lock:\n"
+        "            pass\n"
+        "\n\n"
+        'def register(pool: "Pool"):\n'
+        "    with _REGISTRY_LOCK:\n"
+        "        pass\n"
+    )
+    findings = run_analysis([str(mod)])
+    assert codes(findings) == {"LCK110"}
+    assert len(findings) == 1
+    assert "_REGISTRY_LOCK" in findings[0].message
+    assert "Pool._lock" in findings[0].message
+
+
+def test_condition_alias_shares_lock_identity(tmp_path):
+    # Condition(self._lock) IS self._lock for ordering purposes: nesting
+    # them is the fake-apiserver idiom, not an inversion.
+    mod = tmp_path / "journal.py"
+    mod.write_text(
+        "import threading\n\n\n"
+        "class Journal:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.RLock()\n"
+        "        self._changed = threading.Condition(self._lock)\n"
+        "\n"
+        "    def append(self, item):\n"
+        "        with self._lock:\n"
+        "            with self._changed:\n"
+        "                self._changed.notify_all()\n"
+    )
+    assert run_analysis([str(mod)]) == []
+
+
+def test_package_lock_graph_is_acyclic():
+    """The production lock graph (KeyedMutex -> client/cluster locks,
+    Informer dispatch -> store) must stay a DAG. Regresses loudly if a
+    cross-module inversion is introduced."""
+    findings = run_analysis(
+        [str(REPO / "k8s_operator_libs_tpu")], pass_names=["lock-order"]
+    )
+    assert findings == [], [str(f) for f in findings]
+
+
+# -- transitive blocking (LCK111) ------------------------------------------
+
+def test_blocking_chain_flags_seeded_violation():
+    findings = run_analysis([str(FIXTURES / "chain_bad.py")])
+    assert codes(findings) == {"LCK111"}
+    assert len(findings) == 1
+    msg = findings[0].message
+    assert "time.sleep" in msg
+    assert "Poller._refresh -> Poller._fetch -> Poller._backoff" in msg
+    assert "Poller._lock" in msg
+
+
+def test_blocking_chain_silent_on_clean_twin():
+    assert run_analysis([str(FIXTURES / "chain_clean.py")]) == []
+
+
+def test_keyed_mutex_direct_blocking_reported(tmp_path):
+    # Blocking under a keyed mutex is invisible to LCK102 (no lock
+    # attribute involved) — LCK111 owns it, with the keyed identity.
+    mod = tmp_path / "keyed.py"
+    mod.write_text(
+        "import threading\n"
+        "import time\n"
+        "from contextlib import contextmanager\n\n\n"
+        "class KeyedMutex:\n"
+        "    def __init__(self):\n"
+        "        self._guard = threading.Lock()\n"
+        "        self._locks = {}\n"
+        "\n"
+        "    @contextmanager\n"
+        "    def locked(self, key):\n"
+        "        with self._guard:\n"
+        "            lock = self._locks.setdefault(key, threading.Lock())\n"
+        "        lock.acquire()\n"
+        "        try:\n"
+        "            yield\n"
+        "        finally:\n"
+        "            lock.release()\n"
+        "\n\n"
+        "class Writer:\n"
+        "    def __init__(self):\n"
+        "        self._mutex = KeyedMutex()\n"
+        "\n"
+        "    def write(self, key):\n"
+        "        with self._mutex.locked(key):\n"
+        "            time.sleep(0.01)\n"
+    )
+    findings = run_analysis([str(mod)])
+    assert codes(findings) == {"LCK111"}
+    assert "KeyedMutex[Writer._mutex]" in findings[0].message
+
+
+def test_package_transitive_blocking_all_baselined():
+    """Every LCK111 the package produces today is the state provider's
+    deliberate hold-the-keyed-mutex-across-the-write contract — each is
+    baselined with a written justification, and nothing else fires."""
+    findings = run_analysis(
+        [str(REPO / "k8s_operator_libs_tpu")],
+        pass_names=["blocking-transitive"],
+    )
+    assert findings, "the deliberate state-provider holds disappeared?"
+    assert all(f.path.endswith("upgrade/state_provider.py")
+               for f in findings), [str(f) for f in findings]
+    baseline = load_baseline(REPO / "tools" / "analyze_baseline.json")
+    for f in findings:
+        # The baseline stores repo-relative fingerprints (make/CI run
+        # from the repo root); strip this run's absolute prefix.
+        fingerprint = f.fingerprint().replace(f"{REPO}/", "", 1)
+        assert fingerprint in baseline, fingerprint
+        assert len(baseline[fingerprint]) > 40  # a real justification
+
+
+# -- call-graph resolution edge cases --------------------------------------
+
+def _lck111_codes(tmp_path, source: str):
+    mod = tmp_path / "mod.py"
+    mod.write_text(source)
+    return run_analysis([str(mod)])
+
+
+def test_callgraph_resolves_inherited_methods(tmp_path):
+    findings = _lck111_codes(
+        tmp_path,
+        "import threading\n"
+        "import time\n\n\n"
+        "class Base:\n"
+        "    def slow(self):\n"
+        "        time.sleep(0.01)\n"
+        "\n\n"
+        "class Sub(Base):\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "\n"
+        "    def work(self):\n"
+        "        with self._lock:\n"
+        "            self.slow()\n",
+    )
+    assert codes(findings) == {"LCK111"}
+    assert "Base.slow" in findings[0].message
+
+
+def test_callgraph_dispatches_to_subclass_overrides(tmp_path):
+    # A call through a base-typed attribute may land on ANY override at
+    # runtime — the conservative model includes them all.
+    findings = _lck111_codes(
+        tmp_path,
+        "import threading\n"
+        "import time\n\n\n"
+        "class Transport:\n"
+        "    def send(self):\n"
+        "        pass\n"
+        "\n\n"
+        "class SlowTransport(Transport):\n"
+        "    def send(self):\n"
+        "        time.sleep(0.01)\n"
+        "\n\n"
+        "class Mgr:\n"
+        "    def __init__(self, transport: Transport):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._transport = transport\n"
+        "\n"
+        "    def flush(self):\n"
+        "        with self._lock:\n"
+        "            self._transport.send()\n",
+    )
+    assert codes(findings) == {"LCK111"}
+    assert "SlowTransport.send" in findings[0].message
+
+
+def test_callgraph_resolves_aliased_self_methods(tmp_path):
+    findings = _lck111_codes(
+        tmp_path,
+        "import threading\n"
+        "import time\n\n\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "\n"
+        "    def run(self):\n"
+        "        helper = self._helper\n"
+        "        with self._lock:\n"
+        "            helper()\n"
+        "\n"
+        "    def _helper(self):\n"
+        "        time.sleep(0.01)\n",
+    )
+    assert codes(findings) == {"LCK111"}
+    assert "C._helper" in findings[0].message
+
+
+def test_callgraph_resolves_decorated_callees(tmp_path):
+    findings = _lck111_codes(
+        tmp_path,
+        "import functools\n"
+        "import threading\n"
+        "import time\n\n\n"
+        "def logged(fn):\n"
+        "    @functools.wraps(fn)\n"
+        "    def inner(*args, **kwargs):\n"
+        "        return fn(*args, **kwargs)\n"
+        "    return inner\n"
+        "\n\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "\n"
+        "    @logged\n"
+        "    def _helper(self):\n"
+        "        time.sleep(0.01)\n"
+        "\n"
+        "    def run(self):\n"
+        "        with self._lock:\n"
+        "            self._helper()\n",
+    )
+    assert codes(findings) == {"LCK111"}
+
+
+def test_callgraph_resolves_super_calls(tmp_path):
+    findings = _lck111_codes(
+        tmp_path,
+        "import threading\n"
+        "import time\n\n\n"
+        "class Base:\n"
+        "    def close(self):\n"
+        "        time.sleep(0.01)\n"
+        "\n\n"
+        "class Sub(Base):\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "\n"
+        "    def close(self):\n"
+        "        with self._lock:\n"
+        "            super().close()\n",
+    )
+    assert codes(findings) == {"LCK111"}
+    assert "Base.close" in findings[0].message
+
+
+def test_callgraph_resolves_locked_convention_untyped(tmp_path):
+    # An untyped receiver still resolves a *_locked call when the name
+    # is defined exactly once project-wide; the helper's caller-holds
+    # contract also puts ITS calls under the class lock.
+    findings = _lck111_codes(
+        tmp_path,
+        "import threading\n"
+        "import time\n\n"
+        "_LOCK = threading.Lock()\n\n\n"
+        "class Store:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "\n"
+        "    def _flush_locked(self):\n"
+        "        self._io()\n"
+        "\n"
+        "    def _io(self):\n"
+        "        time.sleep(0.01)\n"
+        "\n\n"
+        "def helper(store):\n"
+        "    with _LOCK:\n"
+        "        store._flush_locked()\n",
+    )
+    assert codes(findings) == {"LCK111"}
+    messages = " | ".join(f.message for f in findings)
+    assert "_LOCK" in messages  # untyped receiver resolved the helper
+    assert "Store._lock" in messages  # caller-holds contract enforced
+
+
+# -- dry-run purity (DRY501) -----------------------------------------------
+
+def test_dryrun_flags_seeded_violations():
+    findings = run_analysis([str(FIXTURES / "dryrun_bad.py")])
+    assert codes(findings) == {"DRY501"}
+    assert len(findings) == 3
+    scopes = {f.scope for f in findings}
+    assert scopes == {"NodeOps.cordon", "NodeOps.purge", "NodeOps.maintenance"}
+    transitive = [f for f in findings if f.scope == "NodeOps.maintenance"]
+    assert "NodeOps._wipe" in transitive[0].message
+
+
+def test_dryrun_silent_on_clean_twin():
+    assert run_analysis([str(FIXTURES / "dryrun_clean.py")]) == []
+
+
+def test_dryrun_early_return_inside_with_cleans_tail(tmp_path):
+    # The FakeCluster shape: `if dry_run: return` INSIDE a with block
+    # makes everything after it (in and below the block) real-path-only.
+    mod = tmp_path / "store.py"
+    mod.write_text(
+        "class Client:\n"
+        "    def create(self, obj, dry_run=False):\n"
+        "        ...\n"
+        "\n\n"
+        "class Store:\n"
+        "    def __init__(self, client: Client):\n"
+        "        self._client = client\n"
+        "\n"
+        "    def _tx(self):\n"
+        "        return None\n"
+        "\n"
+        "    def write(self, obj, dry_run=False):\n"
+        "        with self._tx():\n"
+        "            if dry_run:\n"
+        "                return None\n"
+        "            self._client.create(obj)\n"
+        "        return obj\n"
+    )
+    assert run_analysis([str(mod)]) == []
+
+
+def test_dryrun_unlinked_query_dict_is_flagged(tmp_path):
+    # The clean twin's query-dict idiom only counts when the dict is
+    # actually derived from the taint.
+    mod = tmp_path / "raw.py"
+    mod.write_text(
+        "class Client:\n"
+        "    def _request(self, verb, path, query=None):\n"
+        "        ...\n"
+        "\n\n"
+        "class Ops:\n"
+        "    def __init__(self, client: Client):\n"
+        "        self._client = client\n"
+        "\n"
+        "    def raw_write(self, path, dry_run=False):\n"
+        "        query = {}\n"
+        '        return self._client._request("POST", path, query=query)\n'
+    )
+    findings = run_analysis([str(mod)])
+    assert codes(findings) == {"DRY501"}
+
+
+def test_package_dryrun_layers_are_pure():
+    """kube/{client,rest,drain,apiserver,fake,cache}.py forward the
+    dry-run flag through every mutation on every tainted path."""
+    findings = run_analysis(
+        [str(REPO / "k8s_operator_libs_tpu")], pass_names=["dryrun-purity"]
+    )
+    assert findings == [], [str(f) for f in findings]
+
+
+# -- CLI: --stats and --sarif ----------------------------------------------
+
+def test_cli_stats_line_and_json_stats(tmp_path, capsys):
+    report_file = tmp_path / "report.json"
+    rc = cli.main([
+        str(FIXTURES / "chain_bad.py"), "--baseline", "-", "--stats",
+        "--output", str(report_file),
+    ])
+    assert rc == 1
+    err = capsys.readouterr().err
+    line = next(ln for ln in err.splitlines()
+                if ln.startswith("analyze stats:"))
+    assert "files=1" in line and "functions=" in line
+    assert "call_edges=" in line and "lock_sites=1" in line
+    stats = json.loads(report_file.read_text())["stats"]
+    assert stats["files"] == 1 and stats["findings"] == 1
+
+
+def test_cli_sarif_output(tmp_path, capsys):
+    sarif_file = tmp_path / "report.sarif"
+    rc = cli.main([
+        str(FIXTURES / "deadlock_bad.py"), "--baseline", "-",
+        "--sarif", str(sarif_file),
+    ])
+    assert rc == 1
+    capsys.readouterr()
+    doc = json.loads(sarif_file.read_text())
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"LCK110", "LCK111", "DRY501", "LCK101"} <= rule_ids
+    result = run["results"][0]
+    assert result["ruleId"] == "LCK110"
+    location = result["locations"][0]["physicalLocation"]
+    assert location["artifactLocation"]["uri"].endswith("deadlock_bad.py")
+    assert location["region"]["startLine"] > 0
+    assert "analyzeFingerprint/v1" in result["partialFingerprints"]
+
+
+def test_cli_sarif_marks_baselined_as_suppressed(tmp_path, capsys):
+    baseline = tmp_path / "b.json"
+    target = str(FIXTURES / "swallow_bad.py")
+    cli.main([target, "--baseline", str(baseline), "--write-baseline"])
+    sarif_file = tmp_path / "report.sarif"
+    rc = cli.main([target, "--baseline", str(baseline),
+                   "--sarif", str(sarif_file)])
+    assert rc == 0
+    capsys.readouterr()
+    results = json.loads(sarif_file.read_text())["runs"][0]["results"]
+    assert len(results) == 1
+    suppression = results[0]["suppressions"][0]
+    assert suppression["kind"] == "external"
+    assert suppression["justification"]
+
+
+def test_dryrun_except_handler_keeps_entry_taint(tmp_path):
+    # An exception can leave the try body while dry_run is True, so an
+    # early `if dry_run: return` in the body must NOT clean the handler:
+    # a mutation there still runs on the tainted path.
+    mod = tmp_path / "handler.py"
+    mod.write_text(
+        "class Client:\n"
+        "    def delete(self, kind, name, dry_run=False):\n"
+        "        ...\n"
+        "\n\n"
+        "class Ops:\n"
+        "    def __init__(self, client: Client):\n"
+        "        self._client = client\n"
+        "\n"
+        "    def _prepare(self, name):\n"
+        "        return name\n"
+        "\n"
+        "    def write(self, name, dry_run=False):\n"
+        "        try:\n"
+        "            self._prepare(name)\n"
+        "            if dry_run:\n"
+        "                return None\n"
+        "            return name\n"
+        "        except ValueError:\n"
+        '            self._client.delete("Node", name)\n'
+        "            raise\n"
+    )
+    findings = run_analysis([str(mod)])
+    assert codes(findings) == {"DRY501"}
+    assert len(findings) == 1
+
+
+def test_lambda_bodies_do_not_inherit_lock_context(tmp_path):
+    # A lambda stored under the lock runs at an unknown time, exactly
+    # like a nested def — its body must not count as blocking-under-lock
+    # (neither directly nor through the call graph).
+    mod = tmp_path / "deferred.py"
+    mod.write_text(
+        "import threading\n"
+        "import time\n\n\n"
+        "class Registry:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._callbacks = {}\n"
+        "\n"
+        "    def _slow(self):\n"
+        "        time.sleep(0.01)\n"
+        "\n"
+        "    def install(self):\n"
+        "        with self._lock:\n"
+        "            self._callbacks.update(\n"
+        "                {'direct': lambda: time.sleep(1),\n"
+        "                 'chained': lambda: self._slow()}\n"
+        "            )\n"
+    )
+    assert run_analysis([str(mod)]) == []
+
+
+def test_lambda_default_args_still_evaluate_under_lock(tmp_path):
+    # Lambda BODIES are deferred, but default-argument expressions run
+    # at definition time — a blocking default under the lock must still
+    # be flagged (LCK102's pre-pruning behavior, kept).
+    mod = tmp_path / "defaults.py"
+    mod.write_text(
+        "import threading\n"
+        "import time\n\n\n"
+        "class Registry:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._cb = None\n"
+        "\n"
+        "    def install(self):\n"
+        "        with self._lock:\n"
+        "            self._cb = lambda t=time.sleep(1): t\n"
+    )
+    findings = run_analysis([str(mod)])
+    assert codes(findings) == {"LCK102"}
+
+
+def test_lck102_urlencode_under_lock_is_not_blocking(tmp_path):
+    # urllib.parse is pure string work — the shared classifier's
+    # carve-out must apply to LCK102 exactly as it does to LCK111.
+    mod = tmp_path / "enc.py"
+    mod.write_text(
+        "import threading\n"
+        "import urllib.parse\n\n\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._q = None\n"
+        "\n"
+        "    def encode(self, params):\n"
+        "        with self._lock:\n"
+        "            self._q = urllib.parse.urlencode(params)\n"
+    )
+    assert run_analysis([str(mod)]) == []
+
+
+def test_dryrun_continue_guard_inside_loop(tmp_path):
+    # `if dry_run: continue` makes the rest of the loop body
+    # real-path-only — the mutation after it must not be flagged.
+    mod = tmp_path / "sweep.py"
+    mod.write_text(
+        "class Client:\n"
+        "    def delete(self, kind, name, dry_run=False):\n"
+        "        ...\n"
+        "\n\n"
+        "class Ops:\n"
+        "    def __init__(self, client: Client):\n"
+        "        self._client = client\n"
+        "\n"
+        "    def sweep(self, names, dry_run=False):\n"
+        "        for name in names:\n"
+        "            if dry_run:\n"
+        "                continue\n"
+        '            self._client.delete("Node", name)\n'
+    )
+    assert run_analysis([str(mod)]) == []
+
+
+def test_dryrun_defining_a_callback_is_not_mutating(tmp_path):
+    # A function that only DEFINES a deferred callback must not be
+    # classified as transitively mutating — the callback has its own
+    # summary and only counts where it is actually called.
+    mod = tmp_path / "cb.py"
+    mod.write_text(
+        "class Client:\n"
+        "    def _request(self, verb, path):\n"
+        "        ...\n"
+        "\n\n"
+        "class Ops:\n"
+        "    def __init__(self, client: Client):\n"
+        "        self._client = client\n"
+        "        self._cb = None\n"
+        "\n"
+        "    def install_callback(self):\n"
+        "        def cb():\n"
+        '            self._client._request("POST", "/x")\n'
+        "        self._cb = cb\n"
+        "\n"
+        "    def preview(self, dry_run=False):\n"
+        "        if dry_run:\n"
+        "            self.install_callback()\n"
+    )
+    assert run_analysis([str(mod)]) == []

@@ -166,3 +166,61 @@ class TestMonitorManifest:
         assert "update" in verbs[("", "nodes/status")]
         assert "list" in verbs[("", "pods")]
         assert "create" in verbs[("", "events")]
+
+
+class TestObserveLockDiscipline:
+    def test_render_not_blocked_by_slow_observe(self):
+        """Regression for the blocking-under-lock shape LCK110/LCK111
+        police: observe() must compute the manager accessors OUTSIDE the
+        metrics lock, so a slow reconcile pass cannot stall a concurrent
+        /metrics scrape."""
+        import threading
+
+        entered = threading.Event()
+        release = threading.Event()
+
+        class SlowManager:
+            def get_total_managed_nodes(self, state):
+                entered.set()
+                release.wait(5)
+                return 3
+
+            def get_upgrades_in_progress(self, state):
+                return 0
+
+            def get_upgrades_done(self, state):
+                return 0
+
+            def get_upgrades_failed(self, state):
+                return 0
+
+            def get_upgrades_pending(self, state):
+                return 0
+
+        metrics = UpgradeMetrics(SlowManager(), device_label="tpu")
+        observer = threading.Thread(target=metrics.observe, args=(None,))
+        observer.start()
+        try:
+            assert entered.wait(5)
+            rendered = {"text": None}
+            done = threading.Event()
+
+            def scrape():
+                rendered["text"] = metrics.render()
+                done.set()
+
+            scraper = threading.Thread(target=scrape)
+            scraper.start()
+            # With the accessors computed under the lock, this scrape
+            # would hang until `release` fires and the assert fails.
+            assert done.wait(2), "render() blocked behind observe()"
+            assert "tpu_operator_upgrade_managed_nodes 0" in (
+                rendered["text"].replace('{device="tpu"}', " ").replace(
+                    "  ", " "
+                )
+            )
+        finally:
+            release.set()
+            observer.join(timeout=10)
+        # Once observe completes, the new values land atomically.
+        assert 'managed_nodes{device="tpu"} 3' in metrics.render()

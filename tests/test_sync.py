@@ -1,0 +1,274 @@
+"""Direct unit tests for utils/sync.py — StringSet and KeyedMutex.
+
+Until now these primitives were exercised only indirectly through the
+drain/pod managers; these tests pin their contracts directly:
+contention behavior, (non-)reentrancy, atomic claim semantics, and
+iterator/snapshot isolation.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from k8s_operator_libs_tpu.upgrade.task_runner import TaskRunner
+from k8s_operator_libs_tpu.utils.sync import KeyedMutex, StringSet
+
+
+# -- StringSet -------------------------------------------------------------
+
+def test_stringset_basic_ops():
+    s = StringSet()
+    assert len(s) == 0 and not s.has("a")
+    s.add("a")
+    s.add("a")  # idempotent
+    assert s.has("a") and len(s) == 1
+    assert "a" in s
+    assert 42 not in s  # non-strings are never members
+    s.remove("a")
+    s.remove("a")  # discard semantics: absent is not an error
+    assert not s.has("a")
+    s.add("x")
+    s.clear()
+    assert len(s) == 0
+
+
+def test_stringset_add_if_absent_claim_semantics():
+    s = StringSet()
+    assert s.add_if_absent("node-1") is True
+    assert s.add_if_absent("node-1") is False  # already claimed
+    s.remove("node-1")
+    assert s.add_if_absent("node-1") is True  # reclaimable after release
+
+
+def test_stringset_add_if_absent_single_winner_under_contention():
+    """N racing claimants per key -> exactly one winner. The separate
+    has()+add() sequence this API replaces let several threads observe
+    the key absent and all 'win'."""
+    s = StringSet()
+    wins: dict[str, int] = {f"node-{i}": 0 for i in range(8)}
+    tally = threading.Lock()
+    barrier = threading.Barrier(16)
+
+    def claim(key: str) -> None:
+        barrier.wait()
+        if s.add_if_absent(key):
+            with tally:
+                wins[key] += 1
+
+    threads = [
+        threading.Thread(target=claim, args=(f"node-{i % 8}",))
+        for i in range(16)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert all(count == 1 for count in wins.values()), wins
+
+
+def test_stringset_snapshot_is_isolated():
+    s = StringSet()
+    s.add("a")
+    snap = s.snapshot()
+    s.add("b")
+    s.remove("a")
+    assert snap == frozenset({"a"})
+    assert s.snapshot() == frozenset({"b"})
+
+
+def test_stringset_iteration_is_sorted_snapshot():
+    s = StringSet()
+    for name in ("c", "a", "b"):
+        s.add(name)
+    seen = []
+    for item in s:
+        seen.append(item)
+        # Mutating mid-iteration must neither raise nor leak into the
+        # already-materialized view.
+        s.add("zzz-" + item)
+        s.remove("a")
+    assert seen == ["a", "b", "c"]
+
+
+def test_stringset_concurrent_mutation_stress():
+    s = StringSet()
+    stop = threading.Event()
+    errors: list[BaseException] = []
+
+    def churn(prefix: str) -> None:
+        try:
+            i = 0
+            while not stop.is_set():
+                key = f"{prefix}-{i % 32}"
+                s.add(key)
+                s.has(key)
+                list(s)
+                s.remove(key)
+                i += 1
+        except BaseException as e:  # pragma: no cover - failure path
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=churn, args=(f"w{n}",)) for n in range(4)
+    ]
+    for t in threads:
+        t.start()
+    # A short window is enough to catch RuntimeError("set changed size
+    # during iteration")-class bugs, which surface within milliseconds.
+    stop.wait(0.2)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert not errors
+
+
+# -- KeyedMutex ------------------------------------------------------------
+
+def test_keyed_mutex_same_key_serializes():
+    m = KeyedMutex()
+    order: list[str] = []
+    inside = threading.Event()
+    release = threading.Event()
+
+    def holder() -> None:
+        with m.locked("node-a"):
+            order.append("holder-in")
+            inside.set()
+            release.wait(5)
+            order.append("holder-out")
+
+    def contender() -> None:
+        inside.wait(5)
+        with m.locked("node-a"):
+            order.append("contender-in")
+
+    t1 = threading.Thread(target=holder)
+    t2 = threading.Thread(target=contender)
+    t1.start()
+    t2.start()
+    assert inside.wait(5)
+    release.set()
+    t1.join(timeout=10)
+    t2.join(timeout=10)
+    assert order == ["holder-in", "holder-out", "contender-in"]
+
+
+def test_keyed_mutex_distinct_keys_independent():
+    m = KeyedMutex()
+    entered = threading.Event()
+    release = threading.Event()
+
+    def holder() -> None:
+        with m.locked("node-a"):
+            entered.set()
+            release.wait(5)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    assert entered.wait(5)
+    acquired = threading.Event()
+
+    def other_key() -> None:
+        with m.locked("node-b"):
+            acquired.set()
+
+    t2 = threading.Thread(target=other_key)
+    t2.start()
+    # node-b must not queue behind node-a's holder.
+    assert acquired.wait(2), "distinct key blocked behind another key"
+    release.set()
+    t.join(timeout=10)
+    t2.join(timeout=10)
+
+
+def test_keyed_mutex_lock_identity_per_key():
+    m = KeyedMutex()
+    a1 = m._lock_for("a")
+    a2 = m._lock_for("a")
+    b = m._lock_for("b")
+    assert a1 is a2  # stable per key across calls
+    assert a1 is not b
+
+
+def test_keyed_mutex_is_not_reentrant_by_design():
+    # Parity with the reference's sync.Mutex (util.go:73-89): a plain
+    # Lock per key. Probed through the non-blocking acquire so the test
+    # cannot deadlock itself.
+    m = KeyedMutex()
+    lock = m._lock_for("a")
+    assert lock.acquire(blocking=False)
+    try:
+        assert not lock.acquire(blocking=False)
+    finally:
+        lock.release()
+
+
+def test_keyed_mutex_released_on_exception():
+    m = KeyedMutex()
+    try:
+        with m.locked("a"):
+            raise RuntimeError("boom")
+    except RuntimeError:
+        pass
+    assert m._lock_for("a").acquire(blocking=False)
+    m._lock_for("a").release()
+
+
+def test_keyed_mutex_contention_counter_exact():
+    m = KeyedMutex()
+    counter = {"value": 0}
+
+    def bump() -> None:
+        for _ in range(200):
+            with m.locked("shared"):
+                counter["value"] += 1
+
+    threads = [threading.Thread(target=bump) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert counter["value"] == 800
+
+
+# -- TaskRunner claim regression ------------------------------------------
+
+def test_task_runner_submit_claim_atomic_under_contention():
+    """Regression for the has()+add() TOCTOU in TaskRunner.submit: N
+    workers submitting the same node key concurrently must schedule the
+    task exactly once (the reference's in-progress StringSet guard,
+    drain_manager.go:104)."""
+    runner = TaskRunner(max_workers=4)
+    try:
+        release = threading.Event()
+        runs = {"count": 0}
+        run_lock = threading.Lock()
+
+        def task() -> None:
+            with run_lock:
+                runs["count"] += 1
+            release.wait(5)
+
+        barrier = threading.Barrier(8)
+        results: list[bool] = []
+        results_lock = threading.Lock()
+
+        def race() -> None:
+            barrier.wait()
+            accepted = runner.submit("node-a", task)
+            with results_lock:
+                results.append(accepted)
+
+        threads = [threading.Thread(target=race) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        release.set()
+        assert runner.wait_idle(timeout=10)
+        assert sum(results) == 1, results
+        assert runs["count"] == 1
+        # The key is reusable once the task finished.
+        assert not runner.in_progress("node-a")
+    finally:
+        runner.shutdown()

@@ -1,0 +1,677 @@
+"""Project-wide call graph for the interprocedural passes (LCK110/111,
+DRY501).
+
+The graph is deliberately *name-and-annotation driven* — no execution, no
+imports of the analyzed code. Resolution sources, in order of trust:
+
+* module-level functions and classes of every analyzed module, keyed by
+  the module's dotted name (derived from its path);
+* ``import``/``from .. import`` statements, including package-relative
+  forms, mapping local names to project symbols;
+* methods via ``self.``/``cls.`` (dispatching conservatively to the
+  nearest inherited definition *and* every subclass override, since a
+  call through a base reference may land on any of them at runtime);
+* class-qualified calls (``WorkQueue.shutdown(self)``) and ``super()``
+  delegation (resolved against the first base, unqualified MRO);
+* receiver types inferred from parameter/attribute annotations,
+  ``self.x = ClassName(...)`` constructor assignments, local aliases
+  (including aliased bound methods, ``m = self.helper; m()``), ``IfExp``
+  / ``or`` defaults (first resolvable arm), and project function return
+  annotations;
+* the ``*_locked`` naming convention: an unresolved attribute call whose
+  name ends in ``_locked`` and is defined exactly once project-wide
+  resolves to that definition.
+
+Everything else is *unresolved* and dropped (an under-approximation the
+passes document): ``getattr`` dispatch, callables passed as values
+(thread targets, handlers, reactors), and properties. External receivers
+keep their dotted type (``ext:http.client.HTTPSConnection``) so the
+blocking heuristics can classify I/O on them.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Iterator, Optional, Union
+
+from .core import ParsedModule, Project
+from .lock_discipline import _dotted
+
+FuncNode = Union[ast.FunctionDef, ast.AsyncFunctionDef]
+
+#: threading factories that create a lock-like object.
+LOCK_FACTORY_NAMES = {"Lock", "RLock", "Condition"}
+
+
+@dataclass
+class LockAttr:
+    """One lock-holding attribute (``self._lock = threading.Lock()``) or
+    module-level lock. ``alias_of`` handles ``Condition(self._lock)`` —
+    the condition *is* the named lock for ordering purposes."""
+
+    attr: str
+    reentrant: bool
+    alias_of: Optional[str] = None
+
+
+@dataclass
+class ClassInfo:
+    key: str  # "<display>::<qualname>" — unique project-wide
+    name: str  # bare class name
+    module: ParsedModule
+    node: ast.ClassDef
+    bases: list[str] = field(default_factory=list)  # resolved class keys
+    methods: dict[str, "FunctionInfo"] = field(default_factory=dict)
+    #: self.<attr> -> type key ("class:<key>" or "ext:<dotted>")
+    attr_types: dict[str, str] = field(default_factory=dict)
+    lock_attrs: dict[str, LockAttr] = field(default_factory=dict)
+
+    def canonical_lock(self, attr: str) -> Optional[LockAttr]:
+        """Follow ``alias_of`` chains to the defining lock attribute."""
+        seen = set()
+        info = self.lock_attrs.get(attr)
+        while info is not None and info.alias_of and info.alias_of not in seen:
+            seen.add(info.attr)
+            nxt = self.lock_attrs.get(info.alias_of)
+            if nxt is None:
+                return info
+            info = nxt
+        return info
+
+
+@dataclass
+class FunctionInfo:
+    fid: str  # "<display>::<qualname>"
+    name: str
+    qualname: str
+    module: ParsedModule
+    node: FuncNode
+    cls: Optional[ClassInfo] = None
+
+    @property
+    def display_name(self) -> str:
+        return self.qualname
+
+
+class CallGraph:
+    """Build once per :class:`Project`; shared by every interprocedural
+    pass via :func:`get_callgraph`."""
+
+    def __init__(self, project: Project) -> None:
+        self.project = project
+        self.functions: dict[str, FunctionInfo] = {}
+        self.classes: dict[str, ClassInfo] = {}
+        self.class_by_name: dict[str, list[str]] = {}
+        #: dotted module name -> module (for import resolution)
+        self.module_by_dotted: dict[str, ParsedModule] = {}
+        self.dotted_by_display: dict[str, str] = {}
+        #: display -> local name -> ("class"|"func"|"module", payload)
+        self.symbols: dict[str, dict[str, tuple[str, str]]] = {}
+        self.children: dict[str, set[str]] = {}
+        #: module display -> module-level lock name -> LockAttr
+        self.module_locks: dict[str, dict[str, LockAttr]] = {}
+        #: fid -> list of (ast.Call, tuple of callee fids)
+        self.calls: dict[str, list[tuple[ast.Call, tuple[str, ...]]]] = {}
+        #: method name ending in _locked -> fids (for the convention)
+        self._locked_defs: dict[str, list[str]] = {}
+        self.unresolved_calls = 0
+        self.resolved_edges = 0
+        self._build()
+
+    # -- construction ------------------------------------------------------
+    def _build(self) -> None:
+        for module in self.project.modules:
+            dotted = _dotted_name(module.display)
+            self.module_by_dotted[dotted] = module
+            self.dotted_by_display[module.display] = dotted
+        for module in self.project.modules:
+            self._index_module(module)
+        for module in self.project.modules:
+            self._resolve_imports(module)
+        for info in self.classes.values():
+            self._resolve_bases(info)
+        for info in self.classes.values():
+            self._collect_attr_types(info)
+        for fi in list(self.functions.values()):
+            self.calls[fi.fid] = self._resolve_calls(fi)
+
+    def _index_module(self, module: ParsedModule) -> None:
+        table: dict[str, tuple[str, str]] = {}
+        self.symbols[module.display] = table
+        self.module_locks[module.display] = {}
+
+        def walk(node: ast.AST, prefix: str, cls: Optional[ClassInfo]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    qual = f"{prefix}.{child.name}" if prefix else child.name
+                    key = f"{module.display}::{qual}"
+                    info = ClassInfo(key=key, name=child.name, module=module,
+                                     node=child)
+                    self.classes[key] = info
+                    self.class_by_name.setdefault(child.name, []).append(key)
+                    if not prefix:
+                        table[child.name] = ("class", key)
+                    walk(child, qual, info)
+                elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = f"{prefix}.{child.name}" if prefix else child.name
+                    fid = f"{module.display}::{qual}"
+                    fi = FunctionInfo(fid=fid, name=child.name, qualname=qual,
+                                      module=module, node=child, cls=cls)
+                    self.functions[fid] = fi
+                    if cls is not None and prefix == cls.key.split("::")[1]:
+                        cls.methods[child.name] = fi
+                    if not prefix:
+                        table[child.name] = ("func", fid)
+                    if child.name.endswith("_locked"):
+                        self._locked_defs.setdefault(child.name, []).append(fid)
+                    # Nested defs are indexed (they get summaries) but the
+                    # class context does not extend through them.
+                    walk(child, qual, None)
+
+        walk(module.tree, "", None)
+        # Module-level locks: NAME = threading.Lock()/RLock()/Condition().
+        for stmt in module.tree.body:
+            if not isinstance(stmt, ast.Assign):
+                continue
+            factory = _lock_factory(stmt.value)
+            if factory is None:
+                continue
+            for target in stmt.targets:
+                if isinstance(target, ast.Name):
+                    self.module_locks[module.display][target.id] = LockAttr(
+                        attr=target.id, reentrant=factory != "Lock"
+                    )
+
+    def _resolve_imports(self, module: ParsedModule) -> None:
+        table = self.symbols[module.display]
+        dotted = self.dotted_by_display[module.display]
+        package = dotted.rsplit(".", 1)[0] if "." in dotted else ""
+        for stmt in ast.walk(module.tree):
+            if isinstance(stmt, ast.Import):
+                for alias in stmt.names:
+                    target = alias.name
+                    local = alias.asname or target.split(".")[0]
+                    if target in self.module_by_dotted:
+                        table.setdefault(
+                            local,
+                            ("module", self.module_by_dotted[target].display),
+                        )
+            elif isinstance(stmt, ast.ImportFrom):
+                base = _resolve_from(stmt, package)
+                if base is None:
+                    continue
+                for alias in stmt.names:
+                    local = alias.asname or alias.name
+                    # `from pkg import module` vs `from module import symbol`
+                    sub = f"{base}.{alias.name}"
+                    if sub in self.module_by_dotted:
+                        table.setdefault(
+                            local,
+                            ("module", self.module_by_dotted[sub].display),
+                        )
+                        continue
+                    src = self.module_by_dotted.get(base)
+                    if src is None:
+                        continue
+                    entry = self.symbols.get(src.display, {}).get(alias.name)
+                    if entry is not None and entry[0] in ("class", "func"):
+                        table.setdefault(local, entry)
+
+    def _resolve_bases(self, info: ClassInfo) -> None:
+        for base in info.node.bases:
+            key = self._class_key_for_expr(info.module, base)
+            if key is not None:
+                info.bases.append(key)
+                self.children.setdefault(key, set()).add(info.key)
+
+    def _class_key_for_expr(self, module: ParsedModule,
+                            expr: ast.expr) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            entry = self.symbols[module.display].get(expr.id)
+            if entry is not None and entry[0] == "class":
+                return entry[1]
+            return None
+        if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+            entry = self.symbols[module.display].get(expr.value.id)
+            if entry is not None and entry[0] == "module":
+                sub = self.symbols.get(entry[1], {}).get(expr.attr)
+                if sub is not None and sub[0] == "class":
+                    return sub[1]
+        if isinstance(expr, ast.Subscript):  # Generic bases: C(Base[T])
+            return self._class_key_for_expr(module, expr.value)
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            try:
+                parsed = ast.parse(expr.value, mode="eval").body
+            except SyntaxError:
+                return None
+            return self._class_key_for_expr(module, parsed)
+        return None
+
+    def _collect_attr_types(self, info: ClassInfo) -> None:
+        """Scan every method for ``self.X = ...`` / ``self.X: T`` and the
+        lock factories. ``__init__`` is scanned first so its bindings
+        win over later re-assignments elsewhere."""
+        methods = sorted(
+            info.methods.values(), key=lambda m: m.name != "__init__"
+        )
+        for method in methods:
+            env = self._param_types(method)
+            for stmt in ast.walk(method.node):
+                if isinstance(stmt, ast.AnnAssign) and _is_self_attr(stmt.target):
+                    tkey = self._annotation_type(info.module, stmt.annotation)
+                    if tkey is not None:
+                        info.attr_types.setdefault(stmt.target.attr, tkey)
+                    continue
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                factory = _lock_factory(stmt.value)
+                for target in stmt.targets:
+                    if not _is_self_attr(target):
+                        continue
+                    if factory is not None:
+                        alias = _condition_alias(stmt.value)
+                        info.lock_attrs.setdefault(
+                            target.attr,
+                            LockAttr(attr=target.attr,
+                                     reentrant=factory != "Lock",
+                                     alias_of=alias),
+                        )
+                        continue
+                    tkey = self._expr_type(info.module, stmt.value, env,
+                                           own_cls=info)
+                    if tkey is not None:
+                        info.attr_types.setdefault(target.attr, tkey)
+
+    # -- type/lookup helpers -----------------------------------------------
+    def _param_types(self, fi: FunctionInfo) -> dict[str, str]:
+        env: dict[str, str] = {}
+        args = fi.node.args
+        for arg in (list(args.posonlyargs) + list(args.args)
+                    + list(args.kwonlyargs)):
+            if arg.annotation is None:
+                continue
+            tkey = self._annotation_type(fi.module, arg.annotation)
+            if tkey is not None:
+                env[arg.arg] = tkey
+        return env
+
+    def _annotation_type(self, module: ParsedModule,
+                         ann: ast.expr) -> Optional[str]:
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            try:
+                ann = ast.parse(ann.value, mode="eval").body
+            except SyntaxError:
+                return None
+        if isinstance(ann, ast.Name):
+            entry = self.symbols[module.display].get(ann.id)
+            if entry is not None and entry[0] == "class":
+                return f"class:{entry[1]}"
+            return None
+        if isinstance(ann, ast.Attribute):
+            dotted = _dotted(ann)
+            if not dotted:
+                return None
+            head = dotted.split(".")[0]
+            entry = self.symbols[module.display].get(head)
+            if entry is not None and entry[0] == "module":
+                sub = self.symbols.get(entry[1], {}).get(dotted.split(".")[-1])
+                if sub is not None and sub[0] == "class":
+                    return f"class:{sub[1]}"
+            return f"ext:{dotted}"
+        if isinstance(ann, ast.Subscript):
+            # Optional[X] / list[X] / "X | None": take the first resolvable
+            # type argument — good enough for receiver typing.
+            inner = ann.slice
+            parts = inner.elts if isinstance(inner, ast.Tuple) else [inner]
+            for part in parts:
+                tkey = self._annotation_type(module, part)
+                if tkey is not None:
+                    return tkey
+            return None
+        if isinstance(ann, ast.BinOp) and isinstance(ann.op, ast.BitOr):
+            return (self._annotation_type(module, ann.left)
+                    or self._annotation_type(module, ann.right))
+        return None
+
+    def _expr_type(self, module: ParsedModule, expr: ast.expr,
+                   env: dict[str, str],
+                   own_cls: Optional[ClassInfo]) -> Optional[str]:
+        """Type key of an expression: "class:<key>", "ext:<dotted>", or
+        None (unknown)."""
+        if isinstance(expr, ast.Name):
+            if expr.id in ("self", "cls") and own_cls is not None:
+                return f"class:{own_cls.key}"
+            if expr.id in env:
+                return env[expr.id]
+            entry = self.symbols[module.display].get(expr.id)
+            if entry is not None and entry[0] == "class":
+                return f"classref:{entry[1]}"
+            if entry is not None and entry[0] == "module":
+                return f"module:{entry[1]}"
+            return None
+        if isinstance(expr, ast.Attribute):
+            base = self._expr_type(module, expr.value, env, own_cls)
+            if base is None:
+                return None
+            kind, _, payload = base.partition(":")
+            if kind == "class":
+                for ck in self._mro(payload):
+                    ci = self.classes[ck]
+                    if expr.attr in ci.attr_types:
+                        return ci.attr_types[expr.attr]
+                    if expr.attr in ci.methods:
+                        fids = self.resolve_method(payload, expr.attr,
+                                                   dispatch=True)
+                        return "bound:" + ",".join(fids) if fids else None
+                return None
+            if kind == "module":
+                sub = self.symbols.get(payload, {}).get(expr.attr)
+                if sub is not None and sub[0] == "class":
+                    return f"classref:{sub[1]}"
+                return None
+            if kind == "ext":
+                return f"ext:{payload}.{expr.attr}"
+            return None
+        if isinstance(expr, ast.Call):
+            return self._call_result_type(module, expr, env, own_cls)
+        if isinstance(expr, ast.IfExp):
+            return (self._expr_type(module, expr.body, env, own_cls)
+                    or self._expr_type(module, expr.orelse, env, own_cls))
+        if isinstance(expr, ast.BoolOp):
+            for value in expr.values:
+                tkey = self._expr_type(module, value, env, own_cls)
+                if tkey is not None:
+                    return tkey
+        if isinstance(expr, ast.Await):
+            return self._expr_type(module, expr.value, env, own_cls)
+        return None
+
+    def _call_result_type(self, module: ParsedModule, call: ast.Call,
+                          env: dict[str, str],
+                          own_cls: Optional[ClassInfo]) -> Optional[str]:
+        func = call.func
+        if isinstance(func, ast.Name):
+            entry = self.symbols[module.display].get(func.id)
+            if entry is not None and entry[0] == "class":
+                return f"class:{entry[1]}"
+            if entry is not None and entry[0] == "func":
+                fi = self.functions.get(entry[1])
+                if fi is not None and fi.node.returns is not None:
+                    return self._annotation_type(fi.module, fi.node.returns)
+            return None
+        if isinstance(func, ast.Attribute):
+            dotted = _dotted(func)
+            if dotted:
+                head = dotted.split(".")[0]
+                entry = self.symbols[module.display].get(head)
+                if entry is None and head not in ("self", "cls"):
+                    # External constructor-ish call: keep the dotted name.
+                    return f"ext:{dotted}"
+                if entry is not None and entry[0] == "module":
+                    sub = self.symbols.get(entry[1], {}).get(func.attr)
+                    if sub is not None and sub[0] == "class":
+                        return f"class:{sub[1]}"
+            fids = self._resolve_attribute_call(module, func, env, own_cls)
+            if fids:
+                fi = self.functions[fids[0]]
+                if fi.node.returns is not None:
+                    return self._annotation_type(fi.module, fi.node.returns)
+        return None
+
+    # -- MRO / dispatch ----------------------------------------------------
+    def _mro(self, key: str) -> Iterator[str]:
+        seen: set[str] = set()
+        stack = [key]
+        while stack:
+            current = stack.pop(0)
+            if current in seen or current not in self.classes:
+                continue
+            seen.add(current)
+            yield current
+            stack.extend(self.classes[current].bases)
+
+    def descendants(self, key: str) -> Iterator[str]:
+        seen: set[str] = set()
+        stack = list(self.children.get(key, ()))
+        while stack:
+            current = stack.pop()
+            if current in seen:
+                continue
+            seen.add(current)
+            yield current
+            stack.extend(self.children.get(current, ()))
+
+    def resolve_method(self, key: str, name: str,
+                       dispatch: bool) -> list[str]:
+        """Nearest inherited definition of ``name`` starting at ``key``
+        plus, when ``dispatch``, every subclass override — the
+        conservative model for virtual calls."""
+        out: list[str] = []
+        for ck in self._mro(key):
+            method = self.classes[ck].methods.get(name)
+            if method is not None:
+                out.append(method.fid)
+                break
+        if dispatch:
+            for ck in self.descendants(key):
+                method = self.classes[ck].methods.get(name)
+                if method is not None and method.fid not in out:
+                    out.append(method.fid)
+        return out
+
+    def lock_attr_for(self, key: str, attr: str) -> Optional[tuple[str, LockAttr]]:
+        """(defining class key, canonical LockAttr) for ``self.<attr>``
+        on class ``key``, searching the MRO."""
+        for ck in self._mro(key):
+            info = self.classes[ck]
+            if attr in info.lock_attrs:
+                canon = info.canonical_lock(attr)
+                if canon is not None:
+                    return ck, canon
+        return None
+
+    # -- call resolution ---------------------------------------------------
+    def local_env(self, fi: FunctionInfo) -> dict[str, str]:
+        """Parameter types + simple local bindings for one function.
+        Single pass in source order; later rebindings win (close enough
+        for the straight-line aliasing the codebase uses)."""
+        env = self._param_types(fi)
+        own = fi.cls
+        for stmt in ast.walk(fi.node):
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and stmt is not fi.node:
+                nested_fid = f"{fi.fid}.{stmt.name}"
+                if nested_fid in self.functions:
+                    env[stmt.name] = f"bound:{nested_fid}"
+                continue
+            if isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name):
+                tkey = self._annotation_type(fi.module, stmt.annotation)
+                if tkey is not None:
+                    env[stmt.target.id] = tkey
+            elif isinstance(stmt, ast.Assign) and stmt.targets:
+                target = stmt.targets[0]
+                if isinstance(target, ast.Name):
+                    tkey = self._expr_type(fi.module, stmt.value, env, own)
+                    if tkey is not None:
+                        env[target.id] = tkey
+        return env
+
+    def _resolve_calls(
+        self, fi: FunctionInfo
+    ) -> list[tuple[ast.Call, tuple[str, ...]]]:
+        env = self.local_env(fi)
+        out: list[tuple[ast.Call, tuple[str, ...]]] = []
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            fids = self.resolve_call(fi, node, env)
+            if fids:
+                self.resolved_edges += len(fids)
+                out.append((node, tuple(fids)))
+            else:
+                self.unresolved_calls += 1
+        return out
+
+    def resolve_call(self, fi: FunctionInfo, call: ast.Call,
+                     env: dict[str, str]) -> list[str]:
+        func = call.func
+        module = fi.module
+        if isinstance(func, ast.Name):
+            bound = env.get(func.id, "")
+            if bound.startswith("bound:"):
+                return [f for f in bound[6:].split(",") if f in self.functions]
+            entry = self.symbols[module.display].get(func.id)
+            if entry is not None and entry[0] == "func":
+                return [entry[1]]
+            if entry is not None and entry[0] == "class":
+                init = self.resolve_method(entry[1], "__init__", dispatch=False)
+                return init
+            return []
+        if isinstance(func, ast.Attribute):
+            return self._resolve_attribute_call(module, func, env, fi.cls)
+        return []
+
+    def _resolve_attribute_call(
+        self, module: ParsedModule, func: ast.Attribute,
+        env: dict[str, str], own_cls: Optional[ClassInfo],
+    ) -> list[str]:
+        value = func.value
+        # super().method() — start at the first base, no dispatch.
+        if (isinstance(value, ast.Call) and isinstance(value.func, ast.Name)
+                and value.func.id == "super" and own_cls is not None
+                and own_cls.bases):
+            return self.resolve_method(own_cls.bases[0], func.attr,
+                                       dispatch=False)
+        base = self._expr_type(module, value, env, own_cls)
+        if base is not None:
+            kind, _, payload = base.partition(":")
+            if kind == "class":
+                return self.resolve_method(payload, func.attr, dispatch=True)
+            if kind == "classref":
+                # Class-qualified call (WorkQueue.shutdown(self)): exact.
+                return self.resolve_method(payload, func.attr, dispatch=False)
+            if kind == "module":
+                entry = self.symbols.get(payload, {}).get(func.attr)
+                if entry is not None and entry[0] == "func":
+                    return [entry[1]]
+                return []
+            if kind == "bound":
+                return []
+        # The *_locked convention: callers of a caller-holds-lock helper
+        # resolve even with an untyped receiver, provided the name is
+        # unambiguous project-wide.
+        if func.attr.endswith("_locked"):
+            defs = self._locked_defs.get(func.attr, [])
+            if len(defs) == 1:
+                return list(defs)
+        return []
+
+    def ext_receiver(self, fi: FunctionInfo, call: ast.Call,
+                     env: dict[str, str]) -> str:
+        """Dotted external type of the call's receiver (``""`` when the
+        receiver is not externally typed) — feeds the blocking
+        heuristics (``http.client.HTTPSConnection`` et al)."""
+        func = call.func
+        if not isinstance(func, ast.Attribute):
+            return ""
+        tkey = self._expr_type(fi.module, func.value, env, fi.cls)
+        if tkey is not None and tkey.startswith("ext:"):
+            return tkey[4:]
+        return ""
+
+    def stats(self) -> dict[str, int]:
+        lock_sites = sum(
+            len(c.lock_attrs) for c in self.classes.values()
+        ) + sum(len(locks) for locks in self.module_locks.values())
+        return {
+            "files": len(self.project.modules),
+            "functions": len(self.functions),
+            "classes": len(self.classes),
+            "call_edges": self.resolved_edges,
+            "unresolved_calls": self.unresolved_calls,
+            "lock_sites": lock_sites,
+        }
+
+
+# -- module-level helpers --------------------------------------------------
+
+def _dotted_name(display: str) -> str:
+    """Dotted module name from a display path: strip ``.py``, split on
+    separators, drop leading non-identifier components (tmp dirs in
+    tests) so relative imports inside the analyzed tree resolve."""
+    path = display.replace("\\", "/")
+    if path.endswith(".py"):
+        path = path[:-3]
+    parts = [p for p in path.split("/") if p]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    # Keep the longest identifier-only suffix.
+    keep: list[str] = []
+    for part in reversed(parts):
+        if part.isidentifier():
+            keep.append(part)
+        else:
+            break
+    return ".".join(reversed(keep)) if keep else (parts[-1] if parts else "")
+
+
+def _resolve_from(stmt: ast.ImportFrom, package: str) -> Optional[str]:
+    if stmt.level == 0:
+        return stmt.module
+    base = package.split(".") if package else []
+    # level=1 strips nothing beyond the module itself (already handled by
+    # using the package); each extra level strips one parent.
+    strip = stmt.level - 1
+    if strip > len(base):
+        return None
+    if strip:
+        base = base[:-strip]
+    if stmt.module:
+        base = base + stmt.module.split(".")
+    return ".".join(base) if base else None
+
+
+def _is_self_attr(node: ast.expr) -> bool:
+    return (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self")
+
+
+def _lock_factory(expr: ast.expr) -> Optional[str]:
+    """'Lock'/'RLock'/'Condition' when expr constructs one, else None."""
+    if not isinstance(expr, ast.Call):
+        return None
+    dotted = _dotted(expr.func)
+    if dotted in LOCK_FACTORY_NAMES:
+        return dotted
+    if dotted.startswith("threading."):
+        tail = dotted.split(".", 1)[1]
+        if tail in LOCK_FACTORY_NAMES:
+            return tail
+    return None
+
+
+def _condition_alias(expr: ast.expr) -> Optional[str]:
+    """``Condition(self.X)`` aliases lock attribute X."""
+    if (isinstance(expr, ast.Call) and expr.args
+            and _is_self_attr(expr.args[0])):
+        factory = _lock_factory(expr)
+        if factory == "Condition":
+            return expr.args[0].attr
+    return None
+
+
+_CACHE: dict[int, CallGraph] = {}
+
+
+def get_callgraph(project: Project) -> CallGraph:
+    """One graph per Project instance, shared across the passes (the
+    runner keeps the Project alive for the whole analysis)."""
+    graph = _CACHE.get(id(project))
+    if graph is None or graph.project is not project:
+        graph = CallGraph(project)
+        _CACHE.clear()
+        _CACHE[id(project)] = graph
+    return graph

@@ -4,6 +4,9 @@ Exit status mirrors tools/lint.py: 1 when any non-baselined finding is
 reported, 0 otherwise. ``--json`` prints the machine-readable report
 (CI uploads it as an artifact); ``--output`` writes that JSON to a file
 while keeping the human text on stdout — one run serves both consumers.
+``--sarif FILE`` additionally writes a SARIF 2.1.0 report (CI uploads
+it so findings annotate PRs); ``--stats`` prints a one-line call-graph
+coverage summary so CI logs show analysis-coverage drift over time.
 """
 
 from __future__ import annotations
@@ -19,7 +22,9 @@ from .baseline import (
     split_findings,
     write_baseline,
 )
-from .core import all_passes, collect_files, run_analysis
+from .callgraph import get_callgraph
+from .core import all_passes, build_project, collect_files, run_analysis
+from .sarif import to_sarif
 
 DEFAULT_BASELINE = Path(__file__).resolve().parent.parent / "analyze_baseline.json"
 
@@ -55,6 +60,15 @@ def main(argv=None) -> int:
         help="also write the JSON report to FILE (for CI artifacts)",
     )
     parser.add_argument(
+        "--sarif", type=Path, default=None, metavar="FILE",
+        help="also write a SARIF 2.1.0 report to FILE (PR annotations)",
+    )
+    parser.add_argument(
+        "--stats", action="store_true",
+        help="print a call-graph coverage summary line to stderr "
+        "(files, functions, call edges, lock sites)",
+    )
+    parser.add_argument(
         "--baseline", type=Path, default=DEFAULT_BASELINE,
         help=f"suppression baseline (default: {DEFAULT_BASELINE.name}; "
         "'-' disables)",
@@ -88,7 +102,9 @@ def main(argv=None) -> int:
             )
             return 2
 
-    findings = run_analysis(args.paths, pass_names=args.select)
+    project = build_project(args.paths)
+    findings = run_analysis(args.paths, pass_names=args.select,
+                            project=project)
 
     use_baseline = str(args.baseline) != "-"
     baseline = {}
@@ -130,8 +146,18 @@ def main(argv=None) -> int:
         ]
 
     report = _report_json(new, baselined, stale, args.paths)
+    if args.stats:
+        stats = get_callgraph(project).stats()
+        stats["findings"] = len(new) + len(baselined)
+        report["stats"] = stats
+        line = " ".join(f"{k}={v}" for k, v in stats.items())
+        print(f"analyze stats: {line}", file=sys.stderr)
     if args.output is not None:
         args.output.write_text(json.dumps(report, indent=2) + "\n")
+    if args.sarif is not None:
+        args.sarif.write_text(
+            json.dumps(to_sarif(new, baselined, baseline), indent=2) + "\n"
+        )
     if args.json:
         print(json.dumps(report, indent=2))
     else:

@@ -245,6 +245,7 @@ def all_passes() -> list[Type[AnalysisPass]]:
     from . import state_machine  # noqa: F401
     from . import literal_key  # noqa: F401
     from . import swallowed_exception  # noqa: F401
+    from . import interproc  # noqa: F401
 
     return list(_REGISTRY)
 
@@ -275,15 +276,24 @@ def collect_files(paths: Iterable[str]) -> list[tuple[Path, str]]:
     return out
 
 
-def run_analysis(paths: Iterable[str],
-                 pass_names: Optional[Iterable[str]] = None) -> list[Finding]:
-    """Parse once, run every (or the named) registered pass, return
-    sorted findings."""
+def build_project(paths: Iterable[str]) -> Project:
+    """Parse every target file once into a shareable Project (the CLI
+    reuses it for the --stats call-graph summary)."""
     project = Project()
     for path, display in collect_files(paths):
         module = ParsedModule.parse(path, display)
         if module is not None:
             project.modules.append(module)
+    return project
+
+
+def run_analysis(paths: Iterable[str],
+                 pass_names: Optional[Iterable[str]] = None,
+                 project: Optional[Project] = None) -> list[Finding]:
+    """Parse once, run every (or the named) registered pass, return
+    sorted findings."""
+    if project is None:
+        project = build_project(paths)
 
     wanted = set(pass_names) if pass_names is not None else None
     findings: list[Finding] = []

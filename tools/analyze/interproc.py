@@ -1,0 +1,947 @@
+"""Interprocedural passes over the project call graph.
+
+* **LCK110** (``lock-order``) — lifts every lock acquisition onto the
+  call graph, builds the global lock-acquisition-order graph keyed by
+  lock identity (``Informer._lock`` resolved per class, keyed mutexes,
+  module-level locks), and reports every cycle — a potential deadlock —
+  with a witness chain for each edge.
+* **LCK111** (``blocking-transitive``) — propagates blocking-call facts
+  (REST/socket I/O, ``subprocess``, ``time.sleep``, ``Event.wait``,
+  joins) up the call graph, so a lock holder is flagged even when the
+  blocking call is N frames below the ``with`` block. Complements the
+  intraprocedural LCK102, which only sees blocking calls in the same
+  function body.
+* **DRY501** (``dryrun-purity``) — taints ``dry_run`` parameters (and
+  ``cfg.dry_run``-style reads) and reports any cluster mutation — a
+  Client write verb, an HTTP POST/PUT/PATCH/DELETE, or a call into a
+  transitively-mutating helper — reachable on a tainted path without
+  the dry-run flag forwarded.
+
+Lock identity:
+
+* ``self.X``/``self.a.b`` resolving to a ``threading.Lock``/``RLock``/
+  ``Condition`` attribute → ``<DefiningClass>.<attr>``; ``Condition(
+  self._lock)`` aliases onto the wrapped lock; RLock/Condition are
+  reentrant (self-nesting is not an error).
+* ``with <recv>.locked(...)`` (the KeyedMutex idiom) →
+  ``KeyedMutex[<Owner>.<attr>]``, non-reentrant.
+* module-level locks → ``<module>.<NAME>``.
+
+Known approximations (see docs/static-analysis.md): callables passed as
+values (thread targets, handlers, reactors, ``getattr`` dispatch) are
+not edges; lock *release* inside a callee is not modeled (over-approx);
+a ``*_locked``/docstring caller-holds helper is assumed to hold its
+class's ``_lock`` (or all of its locks when no ``_lock`` exists).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .callgraph import CallGraph, FunctionInfo, get_callgraph
+from .core import AnalysisPass, Project, register
+from .lock_discipline import (
+    BLOCKING_METHODS,
+    _caller_holds_lock,
+    _dotted,
+    calls_outside_lambdas as _calls_outside_lambdas,
+    dotted_blocking_reason,
+)
+
+#: Receiver types (from annotations/constructor inference) whose method
+#: calls are network/process I/O even though the dotted call text alone
+#: is opaque (``conn.getresponse()``).
+EXT_BLOCKING_PREFIXES = (
+    "http.client.",
+    "socket.",
+    "subprocess.",
+    "urllib.",
+)
+
+#: Client write verbs — mutation primitives for DRY501.
+MUTATION_VERBS = {
+    "create", "update", "update_status", "patch", "apply",
+    "delete", "delete_collection", "evict",
+}
+
+#: Verbs unambiguous enough to count even with an untyped receiver.
+UNAMBIGUOUS_VERBS = {"evict", "update_status", "delete_collection"}
+
+MUTATING_HTTP = {"POST", "PUT", "PATCH", "DELETE"}
+
+#: Cap on reported witness-chain length (readability, not correctness).
+MAX_CHAIN = 6
+
+
+@dataclass(frozen=True)
+class LockRef:
+    lock: str  # identity string, e.g. "Informer._lock"
+    reentrant: bool
+    kind: str  # "self" | "keyed" | "module" | "caller"
+
+
+@dataclass
+class CallFact:
+    node: ast.Call
+    callees: tuple[str, ...]
+    held: tuple[LockRef, ...]
+
+
+@dataclass
+class BlockFact:
+    node: ast.AST
+    reason: str
+    #: Lock id whose Condition this waits on (Condition.wait releases
+    #: it) — blocking is sanctioned iff it is the only lock held.
+    exempt: Optional[str]
+    held: tuple[LockRef, ...]
+
+
+@dataclass
+class Acquisition:
+    ref: LockRef
+    node: ast.AST
+    held: tuple[LockRef, ...]
+
+
+@dataclass
+class Summary:
+    fi: FunctionInfo
+    acquisitions: list[Acquisition] = field(default_factory=list)
+    calls: list[CallFact] = field(default_factory=list)
+    blocking: list[BlockFact] = field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# Per-function summaries
+# ---------------------------------------------------------------------------
+
+
+class _SummaryBuilder:
+    def __init__(self, graph: CallGraph) -> None:
+        self.graph = graph
+        self.summaries: dict[str, Summary] = {}
+        for fi in graph.functions.values():
+            self.summaries[fi.fid] = self._summarize(fi)
+
+    # -- lock identity -----------------------------------------------------
+    def _own_locks(self, fi: FunctionInfo) -> list[LockRef]:
+        """Locks a caller-holds-convention helper is assumed to hold:
+        the class's ``_lock`` when it has one, else every lock attr."""
+        if fi.cls is None:
+            return []
+        refs: dict[str, LockRef] = {}
+        attrs = (["_lock"] if "_lock" in fi.cls.lock_attrs
+                 else sorted(fi.cls.lock_attrs))
+        for attr in attrs:
+            found = self.graph.lock_attr_for(fi.cls.key, attr)
+            if found is None:
+                continue
+            ck, canon = found
+            lock_id = f"{_bare(ck)}.{canon.attr}"
+            refs.setdefault(
+                lock_id, LockRef(lock_id, canon.reentrant, "caller"))
+        return list(refs.values())
+
+    def _lock_refs_for_with(
+        self, fi: FunctionInfo, expr: ast.expr,
+        env: dict[str, str], lock_env: dict[str, LockRef],
+    ) -> Optional[LockRef]:
+        graph = self.graph
+        # `with lock:` where `lock = self._lock` earlier in the method.
+        if isinstance(expr, ast.Name):
+            if expr.id in lock_env:
+                return lock_env[expr.id]
+            info = graph.module_locks.get(fi.module.display, {}).get(expr.id)
+            if info is not None:
+                dotted = graph.dotted_by_display.get(fi.module.display, "")
+                return LockRef(f"{dotted}.{expr.id}", info.reentrant, "module")
+            return None
+        if isinstance(expr, ast.Attribute):
+            owner_key: Optional[str] = None
+            if isinstance(expr.value, ast.Name) and expr.value.id in (
+                    "self", "cls"):
+                if fi.cls is not None:
+                    owner_key = fi.cls.key
+            else:
+                tkey = graph._expr_type(fi.module, expr.value, env, fi.cls)
+                if tkey is not None and tkey.startswith("class:"):
+                    owner_key = tkey[6:]
+            if owner_key is not None:
+                found = graph.lock_attr_for(owner_key, expr.attr)
+                if found is not None:
+                    ck, canon = found
+                    return LockRef(f"{_bare(ck)}.{canon.attr}",
+                                   canon.reentrant, "self")
+            return None
+        if isinstance(expr, ast.Call):
+            func = expr.func
+            name = func.attr if isinstance(func, ast.Attribute) else ""
+            if name == "locked":
+                desc = self._receiver_desc(fi, func.value, env)
+                return LockRef(f"KeyedMutex[{desc}]", False, "keyed")
+        return None
+
+    def _receiver_desc(self, fi: FunctionInfo, expr: ast.expr,
+                       env: dict[str, str]) -> str:
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id in ("self", "cls") and fi.cls is not None):
+            return f"{fi.cls.name}.{expr.attr}"
+        if isinstance(expr, ast.Attribute):
+            tkey = self.graph._expr_type(fi.module, expr.value, env, fi.cls)
+            if tkey is not None and tkey.startswith("class:"):
+                return f"{_bare(tkey[6:])}.{expr.attr}"
+        dotted = _dotted(expr)
+        return dotted or (fi.cls.name if fi.cls else fi.name)
+
+    # -- blocking heuristics (superset of LCK102's) ------------------------
+    def _blocking_reason(
+        self, fi: FunctionInfo, call: ast.Call, env: dict[str, str],
+    ) -> tuple[str, Optional[str]]:
+        """(reason, exempt_lock_id) — empty reason means not blocking."""
+        name = _dotted(call.func)
+        if name:
+            reason = dotted_blocking_reason(name)
+            if reason:
+                return reason, None
+            last = name.rsplit(".", 1)[-1]
+            if last in BLOCKING_METHODS or last == "wait_for":
+                if last == "join" and call.args:
+                    return "", None  # sep.join(iterable)
+                exempt = self._own_condition_lock(fi, call, env)
+                return name, exempt
+        ext = self.graph.ext_receiver(fi, call, env)
+        if ext:
+            for prefix in EXT_BLOCKING_PREFIXES:
+                if ext.startswith(prefix):
+                    method = (call.func.attr
+                              if isinstance(call.func, ast.Attribute) else "")
+                    return f"{ext}.{method}", None
+        return "", None
+
+    def _own_condition_lock(
+        self, fi: FunctionInfo, call: ast.Call, env: dict[str, str],
+    ) -> Optional[str]:
+        """Lock id when this is ``<lock attr>.wait()`` — Condition.wait
+        releases its own lock, so it is sanctioned while ONLY that lock
+        is held."""
+        func = call.func
+        if not isinstance(func, ast.Attribute) or func.attr not in (
+                "wait", "wait_for"):
+            return None
+        ref = self._lock_refs_for_with(fi, func.value, env, {})
+        return ref.lock if ref is not None else None
+
+    # -- the walk ----------------------------------------------------------
+    def _summarize(self, fi: FunctionInfo) -> Summary:
+        summary = Summary(fi)
+        env = self.graph.local_env(fi)
+        lock_env: dict[str, LockRef] = {}
+        # Pre-scan local lock aliases (`lock = self._lock`).
+        for stmt in ast.walk(fi.node):
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and stmt is not fi.node:
+                continue
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                ref = self._lock_refs_for_with(fi, stmt.value, env, {})
+                if ref is not None:
+                    lock_env[stmt.targets[0].id] = ref
+        held: tuple[LockRef, ...] = ()
+        if isinstance(fi.node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and _caller_holds_lock(fi.node):
+            held = tuple(self._own_locks(fi))
+        self._walk(fi, fi.node.body, held, env, lock_env, summary)
+        return summary
+
+    def _walk(self, fi, stmts, held, env, lock_env, summary) -> None:
+        for stmt in stmts:
+            self._visit_stmt(fi, stmt, held, env, lock_env, summary)
+
+    def _visit_stmt(self, fi, stmt, held, env, lock_env, summary) -> None:
+        if isinstance(stmt, ast.With) or isinstance(stmt, ast.AsyncWith):
+            entered = held
+            for item in stmt.items:
+                self._visit_expr(fi, item.context_expr, held, env, lock_env,
+                                 summary)
+                ref = self._lock_refs_for_with(
+                    fi, item.context_expr, env, lock_env)
+                if ref is not None:
+                    summary.acquisitions.append(
+                        Acquisition(ref, item.context_expr, entered))
+                    if all(r.lock != ref.lock for r in entered):
+                        entered = entered + (ref,)
+            self._walk(fi, stmt.body, entered, env, lock_env, summary)
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # Nested def: runs at an unknown time on an unknown thread —
+            # its body is summarized separately (the call graph indexes
+            # it), never under this function's locks.
+            return
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.stmt):
+                self._visit_stmt(fi, child, held, env, lock_env, summary)
+            elif isinstance(child, ast.expr):
+                self._visit_expr(fi, child, held, env, lock_env, summary)
+            elif isinstance(child, (ast.ExceptHandler, ast.match_case)):
+                self._walk(fi, child.body, held, env, lock_env, summary)
+
+    def _visit_expr(self, fi, expr, held, env, lock_env, summary) -> None:
+        for node in _calls_outside_lambdas(expr):
+            callees = tuple(self.graph.resolve_call(fi, node, env))
+            if callees:
+                summary.calls.append(CallFact(node, callees, held))
+            reason, exempt = self._blocking_reason(fi, node, env)
+            if reason:
+                summary.blocking.append(
+                    BlockFact(node, reason, exempt, held))
+
+
+def _bare(class_key: str) -> str:
+    return class_key.split("::")[-1].split(".")[-1]
+
+
+def _own_body_calls(func_node):
+    """Call nodes in a function's own body, pruning nested ``def``s and
+    lambda bodies (deferred code; indexed and summarized separately)."""
+    stack = list(func_node.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if isinstance(node, ast.Lambda):
+            stack.extend(node.args.defaults)
+            stack.extend(d for d in node.args.kw_defaults if d is not None)
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# ---------------------------------------------------------------------------
+# Fixpoint propagation
+# ---------------------------------------------------------------------------
+
+
+class _Engine:
+    """Shared, memoized per-Project: summaries + transitive facts."""
+
+    _cache: dict[int, "_Engine"] = {}
+
+    def __init__(self, project: Project) -> None:
+        self.graph = get_callgraph(project)
+        self.builder = _SummaryBuilder(self.graph)
+        self.summaries = self.builder.summaries
+        self._callers = self._caller_map()
+        self.trans_acquires = self._fix_acquires()
+        self.trans_blocking = self._fix_blocking()
+
+    @classmethod
+    def for_project(cls, project: Project) -> "_Engine":
+        engine = cls._cache.get(id(project))
+        if engine is None or engine.graph.project is not project:
+            engine = cls(project)
+            cls._cache.clear()
+            cls._cache[id(project)] = engine
+        return engine
+
+    def _caller_map(self) -> dict[str, set[str]]:
+        callers: dict[str, set[str]] = {}
+        for fid, summary in self.summaries.items():
+            for fact in summary.calls:
+                for callee in fact.callees:
+                    callers.setdefault(callee, set()).add(fid)
+        return callers
+
+    def propagate(self, seed: dict[str, dict], prefix) -> dict[str, dict]:
+        """Generic up-the-call-graph fixpoint: per-function fact tables
+        flow from callees to callers until stable. ``prefix(fid, value)``
+        rewrites a callee's fact as seen from the caller (chain
+        extension). Monotone over finite tables, so it terminates even
+        through recursion."""
+        facts = seed
+        work = list(self.summaries)
+        pending = set(work)
+        while work:
+            fid = work.pop()
+            pending.discard(fid)
+            table = facts[fid]
+            changed = False
+            for fact in self.summaries[fid].calls:
+                for callee in fact.callees:
+                    for key, value in facts.get(callee, {}).items():
+                        if key not in table:
+                            table[key] = prefix(fid, value)
+                            changed = True
+            if changed:
+                for caller in self._callers.get(fid, ()):
+                    if caller not in pending:
+                        pending.add(caller)
+                        work.append(caller)
+        return facts
+
+    def _fix_acquires(self) -> dict[str, dict[str, tuple[bool, tuple[str, ...]]]]:
+        """fid -> lock id -> (reentrant, witness chain of fids)."""
+        seed: dict[str, dict] = {}
+        for fid, summary in self.summaries.items():
+            table: dict[str, tuple[bool, tuple[str, ...]]] = {}
+            for acq in summary.acquisitions:
+                table.setdefault(acq.ref.lock, (acq.ref.reentrant, (fid,)))
+            seed[fid] = table
+        return self.propagate(
+            seed,
+            lambda fid, v: (v[0], ((fid,) + v[1])[:MAX_CHAIN]),
+        )
+
+    def _fix_blocking(
+        self,
+    ) -> dict[str, dict[tuple[str, Optional[str]], tuple[str, ...]]]:
+        """fid -> (reason, exempt lock) -> witness chain of fids."""
+        seed: dict[str, dict] = {}
+        for fid, summary in self.summaries.items():
+            table: dict[tuple[str, Optional[str]], tuple[str, ...]] = {}
+            for block in summary.blocking:
+                table.setdefault((block.reason, block.exempt), (fid,))
+            seed[fid] = table
+        return self.propagate(
+            seed, lambda fid, chain: ((fid,) + chain)[:MAX_CHAIN]
+        )
+
+    def qualname(self, fid: str) -> str:
+        fi = self.graph.functions.get(fid)
+        return fi.qualname if fi is not None else fid.split("::")[-1]
+
+    def chain_text(self, chain: tuple[str, ...]) -> str:
+        return " -> ".join(self.qualname(fid) for fid in chain)
+
+
+# ---------------------------------------------------------------------------
+# LCK110 — lock-order cycles
+# ---------------------------------------------------------------------------
+
+
+@register
+class LockOrderPass(AnalysisPass):
+    name = "lock-order"
+    codes = ("LCK110",)
+
+    def run(self, project: Project) -> None:
+        engine = _Engine.for_project(project)
+        #: (A, B) -> (module, node, witness text) — first witness wins.
+        edges: dict[tuple[str, str], tuple] = {}
+
+        def add_edge(a: str, b: str, module, node, witness: str) -> None:
+            edges.setdefault((a, b), (module, node, witness))
+
+        for fid, summary in engine.summaries.items():
+            qual = engine.qualname(fid)
+            for acq in summary.acquisitions:
+                for prior in acq.held:
+                    if prior.lock == acq.ref.lock:
+                        if acq.ref.reentrant:
+                            continue
+                        add_edge(prior.lock, acq.ref.lock, summary.fi.module,
+                                 acq.node, f"{qual} re-acquires it")
+                        continue
+                    add_edge(prior.lock, acq.ref.lock, summary.fi.module,
+                             acq.node, f"{qual}")
+            for fact in summary.calls:
+                if not fact.held:
+                    continue
+                for callee in fact.callees:
+                    acquired = engine.trans_acquires.get(callee, {})
+                    for lock, (re, chain) in acquired.items():
+                        for prior in fact.held:
+                            if prior.lock == lock:
+                                if re:
+                                    continue
+                                witness = (f"{qual} -> "
+                                           f"{engine.chain_text(chain)}")
+                                add_edge(prior.lock, lock, summary.fi.module,
+                                         fact.node, witness)
+                                continue
+                            witness = f"{qual} -> {engine.chain_text(chain)}"
+                            add_edge(prior.lock, lock, summary.fi.module,
+                                     fact.node, witness)
+
+        for cycle in _cycles(edges):
+            first = min(cycle)
+            ordered = _rotate(cycle, first)
+            parts = []
+            for a, b in zip(ordered, ordered[1:] + ordered[:1]):
+                _, _, witness = edges[(a, b)]
+                parts.append(f"{a}->{b} via {witness}")
+            module, node, _ = edges[(ordered[0], ordered[1 % len(ordered)])]
+            path = " -> ".join(ordered + ordered[:1]) if len(ordered) > 1 \
+                else f"{ordered[0]} -> {ordered[0]}"
+            self.add(
+                module, node, "LCK110",
+                f"lock-order cycle (potential deadlock): {path} "
+                f"[{'; '.join(parts)}]",
+            )
+
+
+def _cycles(edges: dict[tuple[str, str], tuple]) -> list[list[str]]:
+    """One representative simple cycle per strongly-connected component
+    (plus self-loops), deterministic order."""
+    graph: dict[str, list[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, []).append(b)
+        graph.setdefault(b, [])
+    for outs in graph.values():
+        outs.sort()
+    sccs = _tarjan(graph)
+    out: list[list[str]] = []
+    for scc in sccs:
+        scc_set = set(scc)
+        if len(scc) == 1:
+            node = scc[0]
+            if node in graph.get(node, ()):
+                out.append([node])
+            continue
+        # Find a simple cycle inside the SCC by DFS from its least node.
+        start = min(scc)
+        stack = [(start, [start])]
+        found: Optional[list[str]] = None
+        while stack and found is None:
+            node, path = stack.pop()
+            for nxt in graph.get(node, ()):
+                if nxt == start and len(path) > 1:
+                    found = path
+                    break
+                if nxt in scc_set and nxt not in path:
+                    stack.append((nxt, path + [nxt]))
+        if found:
+            out.append(found)
+    out.sort()
+    return out
+
+
+def _tarjan(graph: dict[str, list[str]]) -> list[list[str]]:
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set[str] = set()
+    stack: list[str] = []
+    sccs: list[list[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str) -> None:
+        # Iterative Tarjan (analysis code must not recurse past the
+        # interpreter limit on large graphs).
+        work = [(v, 0)]
+        while work:
+            node, pi = work[-1]
+            if pi == 0:
+                index[node] = low[node] = counter[0]
+                counter[0] += 1
+                stack.append(node)
+                on_stack.add(node)
+            advanced = False
+            for i in range(pi, len(graph[node])):
+                w = graph[node][i]
+                if w not in index:
+                    work[-1] = (node, i + 1)
+                    work.append((w, 0))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc: list[str] = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                if len(scc) > 1:
+                    sccs.append(sorted(scc))
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+    # Self-loops are cycles too but Tarjan reports them as singletons;
+    # callers re-check membership.
+    for v in sorted(graph):
+        if v in graph.get(v, ()):
+            sccs.append([v])
+    return sccs
+
+
+def _rotate(cycle: list[str], first: str) -> list[str]:
+    i = cycle.index(first)
+    return cycle[i:] + cycle[:i]
+
+
+# ---------------------------------------------------------------------------
+# LCK111 — transitive blocking under a lock
+# ---------------------------------------------------------------------------
+
+
+@register
+class BlockingTransitivePass(AnalysisPass):
+    name = "blocking-transitive"
+    codes = ("LCK111",)
+
+    def run(self, project: Project) -> None:
+        engine = _Engine.for_project(project)
+        for fid, summary in engine.summaries.items():
+            reported: set[int] = set()
+            for fact in summary.calls:
+                if not fact.held or id(fact.node) in reported:
+                    continue
+                hit = self._blocking_hit(engine, fact)
+                if hit is None:
+                    continue
+                reason, chain, lock = hit
+                reported.add(id(fact.node))
+                callee_name = engine.qualname(chain[0]) if chain else "?"
+                self.add(
+                    summary.fi.module, fact.node, "LCK111",
+                    f"call to '{callee_name}' can block ('{reason}' via "
+                    f"{engine.chain_text(chain)}) while lock "
+                    f"'{lock}' is held",
+                )
+            # Direct blocking under locks LCK102 cannot see (keyed
+            # mutexes, module-level locks): report here instead.
+            for block in summary.blocking:
+                if not block.held or id(block.node) in reported:
+                    continue
+                if any(ref.kind in ("self", "caller") for ref in block.held):
+                    continue  # LCK102's territory
+                if block.exempt is not None and all(
+                        ref.lock == block.exempt for ref in block.held):
+                    continue
+                reported.add(id(block.node))
+                self.add(
+                    summary.fi.module, block.node, "LCK111",
+                    f"blocking call '{block.reason}' while lock "
+                    f"'{block.held[-1].lock}' is held",
+                )
+
+    @staticmethod
+    def _blocking_hit(engine: "_Engine", fact: CallFact):
+        held_ids = {ref.lock for ref in fact.held}
+        for callee in fact.callees:
+            for (reason, exempt), chain in sorted(
+                engine.trans_blocking.get(callee, {}).items(),
+                key=lambda kv: kv[1],
+            ):
+                if exempt is not None and held_ids <= {exempt}:
+                    continue
+                lock = fact.held[-1].lock
+                return reason, chain, lock
+        return None
+
+
+# ---------------------------------------------------------------------------
+# DRY501 — dry-run purity
+# ---------------------------------------------------------------------------
+
+
+BOTH, TAINTED, CLEAN, DEAD = "both", "tainted", "clean", "dead"
+
+
+@register
+class DryRunPurityPass(AnalysisPass):
+    name = "dryrun-purity"
+    codes = ("DRY501",)
+
+    def run(self, project: Project) -> None:
+        engine = _Engine.for_project(project)
+        self.engine = engine
+        self.mutates = self._fix_mutates(engine)
+        for fid, summary in engine.summaries.items():
+            if self._taint_scoped(summary.fi):
+                self._check_function(summary.fi)
+
+    # -- scope/taint helpers -----------------------------------------------
+    @staticmethod
+    def _taint_scoped(fi: FunctionInfo) -> bool:
+        args = fi.node.args
+        names = [a.arg for a in (list(args.posonlyargs) + list(args.args)
+                                 + list(args.kwonlyargs))]
+        if "dry_run" in names:
+            return True
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Attribute) and node.attr == "dry_run" \
+                    and isinstance(node.ctx, ast.Load):
+                return True
+        return False
+
+    @staticmethod
+    def _mentions_taint(expr: ast.expr) -> bool:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Name) and node.id == "dry_run":
+                return True
+            if isinstance(node, ast.Attribute) and node.attr == "dry_run":
+                return True
+        return False
+
+    def _taint_aware_locals(self, fi: FunctionInfo) -> set[str]:
+        """Locals whose value depends on the taint: assigned from a
+        taint-mentioning expression, or written under an ``if dry_run:``
+        branch (``query["dryRun"] = "All"``)."""
+        aware: set[str] = set()
+
+        def mark_target(target: ast.expr) -> None:
+            while isinstance(target, (ast.Subscript, ast.Attribute)):
+                target = target.value
+            if isinstance(target, ast.Name):
+                aware.add(target.id)
+
+        def walk(stmts: list[ast.stmt], under_taint: bool) -> None:
+            for stmt in stmts:
+                if isinstance(stmt, ast.If):
+                    branch = under_taint or self._mentions_taint(stmt.test)
+                    walk(stmt.body, branch)
+                    walk(stmt.orelse, branch)
+                    continue
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                if isinstance(stmt, (ast.Assign, ast.AugAssign)):
+                    targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                               else [stmt.target])
+                    tainted_value = under_taint or self._mentions_taint(
+                        stmt.value)
+                    if tainted_value:
+                        for target in targets:
+                            mark_target(target)
+                for child in ast.iter_child_nodes(stmt):
+                    if isinstance(child, ast.stmt):
+                        walk([child], under_taint)
+                    elif isinstance(child, (ast.ExceptHandler,
+                                            ast.match_case)):
+                        walk(child.body, under_taint)
+
+        walk(fi.node.body, False)
+        return aware
+
+    # -- mutation classification -------------------------------------------
+    def _client_family(self, engine: "_Engine") -> set[str]:
+        family: set[str] = set()
+        for key, info in engine.graph.classes.items():
+            if info.name == "Client":
+                family.add(key)
+                family.update(engine.graph.descendants(key))
+        return family
+
+    def _verb_call(self, engine: "_Engine", node: ast.Call,
+                   callees: tuple[str, ...], family: set[str]) -> bool:
+        func = node.func
+        name = func.attr if isinstance(func, ast.Attribute) else ""
+        if name in MUTATION_VERBS:
+            for fid in callees:
+                fi = engine.graph.functions.get(fid)
+                if fi is not None and fi.cls is not None \
+                        and fi.cls.key in family:
+                    return True
+            if not callees and name in UNAMBIGUOUS_VERBS:
+                return True
+        if name in ("_request", "request") and node.args:
+            first = node.args[0]
+            if isinstance(first, ast.Constant) and first.value in \
+                    MUTATING_HTTP:
+                return True
+        return False
+
+    def _fix_mutates(self, engine: "_Engine") -> dict[str, tuple[str, ...]]:
+        """fid -> witness chain when the function (transitively) performs
+        a cluster mutation that is not hard-wired to dry-run."""
+        family = self._client_family(engine)
+        seed: dict[str, dict] = {}
+        for fid, summary in engine.summaries.items():
+            table: dict[tuple, tuple[str, ...]] = {}
+            for fact in summary.calls:
+                if self._verb_call(engine, fact.node, fact.callees, family) \
+                        and not _always_dry(fact.node):
+                    table[()] = (fid,)
+                    break
+            else:
+                # Unresolved verb calls (untyped receivers) — scan the
+                # function's OWN body only: a nested def merely DEFINES
+                # deferred code (it has its own summary and its own
+                # mutation fact if anything ever calls it).
+                for node in _own_body_calls(summary.fi.node):
+                    if self._verb_call(engine, node, (), family) \
+                            and not _always_dry(node):
+                        table[()] = (fid,)
+                        break
+            seed[fid] = table
+        facts = engine.propagate(
+            seed, lambda fid, chain: ((fid,) + chain)[:MAX_CHAIN]
+        )
+        return {fid: table[()] for fid, table in facts.items() if () in table}
+
+    # -- the path-sensitive check ------------------------------------------
+    def _check_function(self, fi: FunctionInfo) -> None:
+        engine = self.engine
+        family = self._client_family(engine)
+        aware = self._taint_aware_locals(fi)
+        env = engine.graph.local_env(fi)
+        reported: set[int] = set()
+
+        def guarded(node: ast.Call) -> bool:
+            for kw in node.keywords:
+                if kw.arg == "dry_run":
+                    value = kw.value
+                    if isinstance(value, ast.Constant):
+                        return value.value is True
+                    return True  # forwarded/derived expression
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if self._mentions_taint(arg):
+                    return True
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Name) and sub.id in aware:
+                        return True
+            return False
+
+        def check_call(node: ast.Call, state: str) -> None:
+            if state not in (TAINTED, BOTH) or id(node) in reported:
+                return
+            callees = tuple(engine.graph.resolve_call(fi, node, env))
+            if self._verb_call(engine, node, callees, family):
+                if not guarded(node):
+                    reported.add(id(node))
+                    verb = (node.func.attr
+                            if isinstance(node.func, ast.Attribute)
+                            else "write")
+                    self.add(
+                        fi.module, node, "DRY501",
+                        f"cluster mutation '{verb}' reachable on a "
+                        f"dry_run path without the dry-run flag "
+                        f"forwarded",
+                    )
+                return
+            for callee in callees:
+                chain = self.mutates.get(callee)
+                if chain is not None and not guarded(node):
+                    reported.add(id(node))
+                    self.add(
+                        fi.module, node, "DRY501",
+                        f"call to '{engine.qualname(callee)}' mutates the "
+                        f"cluster (via {engine.chain_text(chain)}) on a "
+                        f"dry_run path without the dry-run flag forwarded",
+                    )
+                    return
+
+        def check_exprs(stmt: ast.stmt, state: str) -> None:
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    for node in _calls_outside_lambdas(child):
+                        check_call(node, state)
+
+        def terminates(stmts: list[ast.stmt]) -> bool:
+            return any(isinstance(s, (ast.Return, ast.Raise, ast.Continue,
+                                      ast.Break)) for s in stmts)
+
+        def walk(stmts: list[ast.stmt], state: str) -> str:
+            for stmt in stmts:
+                if state == DEAD:
+                    return state
+                if isinstance(stmt, ast.If):
+                    for node in _calls_outside_lambdas(stmt.test):
+                        check_call(node, state)
+                    polarity = _taint_polarity(stmt.test)
+                    if polarity is None:
+                        walk(stmt.body, state)
+                        walk(stmt.orelse, state)
+                        continue
+                    on_true = TAINTED if polarity else CLEAN
+                    on_false = CLEAN if polarity else TAINTED
+                    body_state = _meet(state, on_true)
+                    else_state = _meet(state, on_false)
+                    walk(stmt.body, body_state)
+                    walk(stmt.orelse, else_state)
+                    body_ends = terminates(stmt.body)
+                    else_ends = stmt.orelse and terminates(stmt.orelse)
+                    if body_ends and else_ends:
+                        state = DEAD
+                    elif body_ends:
+                        state = else_state
+                    elif else_ends:
+                        state = body_state
+                    continue
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                if isinstance(stmt, ast.Return) and stmt.value is not None:
+                    for node in _calls_outside_lambdas(stmt.value):
+                        check_call(node, state)
+                    continue
+                check_exprs(stmt, state)
+                if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                    # The body is walked as ONE block so `if dry_run:
+                    # continue` cleans the statements after it; the exit
+                    # state is discarded (a loop may run zero times, and
+                    # a `continue` only skips one iteration), so the
+                    # aftermath keeps the entry state.
+                    walk(stmt.body, state)
+                    if stmt.orelse:
+                        walk(stmt.orelse, state)
+                    continue
+                if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    # The body executes inline: thread the state through
+                    # so an early `if dry_run: return` inside it cleans
+                    # the remainder of the function too.
+                    state = walk(stmt.body, state)
+                    continue
+                if isinstance(stmt, ast.Try):
+                    entry = state
+                    state = walk(stmt.body, state)
+                    # An exception can leave the body at ANY point, so
+                    # handlers (and finally) see the TRY-ENTRY taint
+                    # state — an early `if dry_run: return` in the body
+                    # does not clean them.
+                    for handler in stmt.handlers:
+                        walk(handler.body, entry)
+                    if stmt.orelse:
+                        state = walk(stmt.orelse, state)
+                    if stmt.finalbody:
+                        walk(stmt.finalbody, entry)
+                    continue
+                for child in ast.iter_child_nodes(stmt):
+                    if isinstance(child, ast.stmt):
+                        walk([child], state)
+                    elif isinstance(child, (ast.ExceptHandler,
+                                            ast.match_case)):
+                        walk(child.body, state)
+            return state
+
+        walk(fi.node.body, BOTH)
+
+
+def _always_dry(node: ast.Call) -> bool:
+    for kw in node.keywords:
+        if kw.arg == "dry_run" and isinstance(kw.value, ast.Constant):
+            return kw.value.value is True
+    return False
+
+
+def _meet(state: str, branch: str) -> str:
+    if state == BOTH:
+        return branch
+    if state == branch or branch == BOTH:
+        return state
+    return DEAD
+
+
+def _taint_polarity(test: ast.expr) -> Optional[bool]:
+    """True for ``if dry_run:``-shaped tests, False for ``if not
+    dry_run:``; None when the taint is not the whole condition."""
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        inner = _taint_polarity(test.operand)
+        return None if inner is None else not inner
+    if isinstance(test, ast.Name) and test.id == "dry_run":
+        return True
+    if isinstance(test, ast.Attribute) and test.attr == "dry_run":
+        return True
+    return None

@@ -83,6 +83,39 @@ def _caller_holds_lock(func: ast.FunctionDef) -> bool:
     return CALLER_LOCKED_RE.search(re.sub(r"\s+", " ", doc)) is not None
 
 
+def dotted_blocking_reason(name: str) -> str:
+    """Blocking verdict for a dotted call-target name — the ONE
+    classifier shared by LCK102 and the interprocedural passes, so the
+    carve-outs cannot drift between them. ``urllib.parse`` is pure
+    string work; the I/O lives in ``urllib.request``."""
+    if name in BLOCKING_NAMES:
+        return name
+    if name.startswith("urllib.parse."):
+        return ""
+    for prefix in BLOCKING_PREFIXES:
+        if name == prefix or name.startswith(prefix):
+            return name
+    return ""
+
+
+def calls_outside_lambdas(expr: ast.AST):
+    """Call nodes in ``expr``, pruning lambda BODIES: a lambda runs at
+    an unknown time on an unknown thread — exactly like a nested
+    ``def``, its body must not inherit the enclosing lock context.
+    Default-argument expressions DO evaluate eagerly at definition time,
+    so they stay in scope."""
+    stack = [expr]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Lambda):
+            stack.extend(node.args.defaults)
+            stack.extend(d for d in node.args.kw_defaults if d is not None)
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
 def _dotted(node: ast.expr) -> str:
     parts: list[str] = []
     while isinstance(node, ast.Attribute):
@@ -204,11 +237,12 @@ class _ClassAnalyzer:
                 self._walk(child.body, in_lock, in_init)
 
     def _visit_expr(self, expr: ast.expr, in_lock: bool, in_init: bool) -> None:
-        for node in ast.walk(expr):
-            if isinstance(node, ast.Call) and in_lock and not in_init:
-                reason = self._blocking_reason(node)
-                if reason:
-                    self.blocking.append((node, reason))
+        if not (in_lock and not in_init):
+            return
+        for node in calls_outside_lambdas(expr):
+            reason = self._blocking_reason(node)
+            if reason:
+                self.blocking.append((node, reason))
 
     def _acquires_lock(self, stmt: ast.With) -> bool:
         for item in stmt.items:
@@ -255,11 +289,9 @@ class _ClassAnalyzer:
         name = _dotted(call.func)
         if not name:
             return ""
-        if name in BLOCKING_NAMES:
-            return name
-        for prefix in BLOCKING_PREFIXES:
-            if name == prefix or name.startswith(prefix):
-                return name
+        reason = dotted_blocking_reason(name)
+        if reason:
+            return reason
         last = name.rsplit(".", 1)[-1]
         if last in BLOCKING_METHODS:
             if self._is_own_condition_wait(call):

@@ -1,0 +1,119 @@
+"""SARIF 2.1.0 rendering of the analyzer's report.
+
+CI uploads the file through ``github/codeql-action/upload-sarif`` so
+findings annotate pull requests inline. Baselined findings are included
+as *suppressed* results (SARIF's first-class suppression concept, with
+the baseline justification carried in the suppression), so the PR view
+matches the gate: visible when new, hidden-but-recorded when baselined.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from .core import Finding, all_passes
+
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+#: One short description per rule code, scraped from the pass registry's
+#: docstrings at render time would be fragile — keep the canonical short
+#: texts here, next to the renderer that needs them.
+RULE_TEXT = {
+    "LCK101": "instance attribute mutated both inside and outside the lock",
+    "LCK102": "blocking call while a lock is held",
+    "LCK110": "lock-order cycle across the call graph (potential deadlock)",
+    "LCK111": "transitively-blocking call while a lock is held",
+    "STM201": "state missing from the managed/maintenance partition",
+    "STM202": "state present in both partition halves",
+    "STM203": "state with no reachable handler",
+    "STM204": "handler mapping to no state (stale)",
+    "STM205": "state value literal outside consts",
+    "KEY301": "upgrade label/annotation key literal outside the builders",
+    "EXC401": "swallowed exception in a reconcile/manager path",
+    "DRY501": "cluster mutation reachable on a dry_run path",
+}
+
+
+def _rules() -> list[dict]:
+    codes: set[str] = set()
+    for cls in all_passes():
+        codes.update(cls.codes)
+    codes.update(RULE_TEXT)
+    return [
+        {
+            "id": code,
+            "shortDescription": {
+                "text": RULE_TEXT.get(code, code),
+            },
+        }
+        for code in sorted(codes)
+    ]
+
+
+def _result(finding: Finding, justification: str = "",
+            suppressed: bool = False) -> dict:
+    result = {
+        "ruleId": finding.code,
+        "level": "warning",
+        "message": {"text": finding.message},
+        "locations": [
+            {
+                "physicalLocation": {
+                    "artifactLocation": {"uri": finding.path},
+                    "region": {
+                        "startLine": finding.line,
+                        "startColumn": finding.col,
+                    },
+                },
+                "logicalLocations": (
+                    [{"fullyQualifiedName": finding.scope}]
+                    if finding.scope else []
+                ),
+            }
+        ],
+        "partialFingerprints": {
+            # The baseline's line-independent identity, so re-uploads
+            # across unrelated edits dedupe instead of re-annotating.
+            "analyzeFingerprint/v1": finding.fingerprint(),
+        },
+    }
+    if suppressed:
+        result["suppressions"] = [
+            {
+                "kind": "external",
+                "justification": justification
+                or "baselined in tools/analyze_baseline.json",
+            }
+        ]
+    return result
+
+
+def to_sarif(new: Iterable[Finding], baselined: Iterable[Finding],
+             baseline: dict[str, str]) -> dict:
+    results = [_result(f) for f in new]
+    results.extend(
+        _result(f, justification=baseline.get(f.fingerprint(), ""),
+                suppressed=True)
+        for f in baselined
+    )
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "tpu-operator-analyze",
+                        "informationUri":
+                            "docs/static-analysis.md",
+                        "rules": _rules(),
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }
+
