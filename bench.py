@@ -150,7 +150,10 @@ def _start_stage_watchdog(
     return thread
 
 
-if __name__ == "__main__":
+if __name__ == "__main__" and "--sections" not in sys.argv:
+    # A sections-only run (CI smoke) exercises JAX-free control-plane
+    # sections on a hermetic env the caller configures; the accelerator
+    # probe/re-exec dance is for the full-artifact run.
     _ensure_live_backend()
 
 import jax
@@ -172,6 +175,7 @@ from k8s_operator_libs_tpu.tpu import (
 from k8s_operator_libs_tpu.upgrade import (
     ClusterUpgradeStateManager,
     DeviceClass,
+    StateOptions,
     TaskRunner,
     UpgradeKeys,
 )
@@ -264,6 +268,12 @@ def drive_to_convergence(
     ``post_pass`` after the kubelet settles (metric sampling). Raises when
     MAX_PASSES is exhausted — a wedged roll must fail the bench, not
     truncate it."""
+    def node_state(name):
+        raw = cluster.peek("Node", name) or {}
+        return ((raw.get("metadata") or {}).get("labels") or {}).get(
+            KEYS.state_label
+        )
+
     for i in range(MAX_PASSES):
         if per_pass is not None:
             per_pass()
@@ -273,9 +283,12 @@ def drive_to_convergence(
         sim.step()
         if post_pass is not None:
             post_pass()
+        # Convergence check via the fake's read-only peek: the harness
+        # must not deep-copy the whole pool once per pass just to read
+        # one label per node.
         done = all(
-            n.labels.get(KEYS.state_label) == "upgrade-done"
-            for n in cluster.list("Node")
+            node_state(name) == "upgrade-done"
+            for name in cluster.object_names("Node")
         )
         if done and sim.all_pods_ready_and_current():
             return i + 1
@@ -567,6 +580,150 @@ def run_state_machine_microbench(
     }
 
 
+def run_snapshot_read_bench(
+    slices: int = 64, hosts_per_slice: int = 4, passes: int = 20
+) -> dict:
+    """Client READ calls per reconcile pass at 256 nodes, uncached
+    (bulk-LIST fallback) vs cached (informer-backed) snapshot, counted
+    via the fake client's call log — call counts are load-immune where
+    wall-clock is not, and they are what actually hits an apiserver.
+
+    Steady state by design (pool settled, no roll in flight): this is
+    the read cost every idle reconcile pass pays forever. The cached
+    number includes the informers' seed LISTs, amortized over the
+    measured passes — the honest accounting for a list-once+watch
+    design."""
+    policy = DriverUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=0,
+        max_unavailable=IntOrString("100%"),
+    )
+    results: dict = {}
+    for mode in ("uncached", "cached"):
+        cluster, sim = build_pool(
+            slices=slices, hosts_per_slice=hosts_per_slice
+        )
+        mgr = ClusterUpgradeStateManager(
+            cluster, DEVICE, runner=TaskRunner(inline=True)
+        )
+        # Seed window: ONLY the snapshot source's own startup cost (the
+        # informers' list-once) is charged to the cached path — measured
+        # via the call log, never assumed.
+        seed_log = cluster.start_call_log()
+        source = None
+        if mode == "cached":
+            source = mgr.with_snapshot_from_informers(
+                NS, DS_LABELS, resync_period_s=0.0
+            )
+        seed_reads = [c for c in seed_log if c[0] in ("get", "list")]
+        cluster.stop_call_log()
+        # Settle: classify-everyone-to-done writes + simulator ticks land
+        # here, UNLOGGED — the sim's kubelet reads are not controller
+        # traffic and would drown the signal on both sides equally.
+        for _ in range(2):
+            sim.step()
+            mgr.apply_state(mgr.build_state(NS, DS_LABELS), policy)
+        steady_log = cluster.start_call_log()
+        for _ in range(passes):
+            mgr.apply_state(mgr.build_state(NS, DS_LABELS), policy)
+        steady_reads = [
+            c for c in steady_log if c[0] in ("get", "list")
+        ]
+        all_reads = len(steady_reads) + len(seed_reads)
+        cluster.stop_call_log()
+        if source is not None:
+            source.stop()
+        results[mode] = {
+            "steady_reads_per_pass": round(len(steady_reads) / passes, 3),
+            "seed_reads": len(seed_reads),
+            "reads_per_pass_amortized": round(all_reads / passes, 3),
+            "reads_total_incl_seed": all_reads,
+            "passes": passes,
+            "nodes": slices * hosts_per_slice,
+        }
+    # The headline ratio compares steady-state read cost, with the
+    # cached side charged its MEASURED pre-window reads (informer seed
+    # LISTs plus its own settle traffic) amortized over the measured
+    # passes — list-once + watch has to pay its list somewhere, and
+    # charging the whole seed is conservative against the cached path.
+    uncached = results["uncached"]["steady_reads_per_pass"]
+    cached = (
+        results["cached"]["steady_reads_per_pass"]
+        + results["cached"]["seed_reads"] / results["cached"]["passes"]
+    )
+    results["read_reduction_x"] = (
+        round(uncached / cached, 1) if cached > 0 else None
+    )
+    results["note"] = (
+        "pre-source baseline for context: the N+1 path issued "
+        f"2 LISTs + {slices * hosts_per_slice} node GETs per pass"
+    )
+    return results
+
+
+def run_apply_width_bench(
+    widths: tuple = (1, 8),
+    slices: int = 64,
+    hosts_per_slice: int = 4,
+    lag_s: float = 0.002,
+) -> dict:
+    """One full 256-node roll per apply width, with a REAL threaded
+    TaskRunner against a lagging read cache (CachedClient auto,
+    ``lag_s`` behind): every issued state write pays the reference's
+    cache-coherence wait (node_upgrade_state_provider.go:92-117), which
+    is exactly the latency concurrent apply overlaps. Width 1 is the old
+    serialize-everything write path. Terminal-sequence equivalence across
+    widths is pinned in tests/test_concurrent_apply.py; this section
+    reports the wall-clock those semantics cost at each width."""
+    from k8s_operator_libs_tpu.kube import CachedClient
+
+    policy = DriverUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=0,
+        max_unavailable=IntOrString("100%"),
+    )
+    out: dict = {
+        "nodes": slices * hosts_per_slice,
+        "cache_lag_s": lag_s,
+    }
+    walls: dict[int, float] = {}
+    for width in widths:
+        cluster, sim = build_pool(
+            slices=slices, hosts_per_slice=hosts_per_slice
+        )
+        reader = CachedClient(cluster, sync_mode="auto", lag_seconds=lag_s)
+        runner = TaskRunner(max_workers=max(int(width), 1))
+        mgr = ClusterUpgradeStateManager(
+            cluster,
+            DEVICE,
+            reader=reader,
+            runner=runner,
+            options=StateOptions(apply_width=int(width)),
+        )
+        sim.set_template_hash("libtpu-v2")
+        start = time.perf_counter()
+        passes = drive_to_convergence(cluster, sim, mgr, policy)
+        elapsed = time.perf_counter() - start
+        runner.wait_idle(timeout=30)
+        runner.shutdown()
+        reader.close()
+        walls[int(width)] = elapsed
+        out[f"width_{width}"] = {
+            "wall_s": round(elapsed, 3),
+            "passes": passes,
+            "writes_issued_last_pass": mgr.last_pass_stats.writes_issued,
+            "writes_skipped_last_pass": mgr.last_pass_stats.writes_skipped,
+        }
+    if len(walls) >= 2:
+        slowest_width = min(walls)
+        fastest_width = max(walls)
+        if walls[fastest_width] > 0:
+            out["speedup_x"] = round(
+                walls[slowest_width] / walls[fastest_width], 2
+            )
+    return out
+
+
 def run_calibration() -> dict:
     """One full-battery gate run on the real devices.
 
@@ -666,7 +823,53 @@ def run_cpu_mesh_fabric() -> dict:
     }
 
 
+#: JAX-free sections runnable standalone via ``--sections a,b`` — the CI
+#: smoke job runs the state-machine microbench (+ snapshot reads) per-PR
+#: so control-plane perf is visible without a full bench artifact.
+SECTIONS = {
+    "state_machine_microbench": lambda: {
+        "single_slice_pool": run_state_machine_microbench(),
+        "multislice_pool": run_state_machine_microbench(
+            slices=3, hosts_per_slice=4
+        ),
+        "scale_64_slices_256_nodes": run_state_machine_microbench(
+            slices=64, hosts_per_slice=4
+        ),
+    },
+    "snapshot_reads": run_snapshot_read_bench,
+    "apply_width": run_apply_width_bench,
+}
+
+
+def run_sections(names: list[str]) -> None:
+    """Run only the named sections; still exactly ONE JSON line."""
+    unknown = [n for n in names if n not in SECTIONS]
+    if unknown:
+        raise SystemExit(
+            f"unknown sections {unknown}; available: {sorted(SECTIONS)}"
+        )
+    details = {}
+    for name in names:
+        details[name] = SECTIONS[name]()
+        _progress(name)
+    result = {
+        "details": details,
+        "metric": f"bench sections: {','.join(names)}",
+        "value": None,
+        "unit": None,
+        "vs_baseline": None,
+    }
+    print(json.dumps(result))
+
+
 def main() -> None:
+    argv = sys.argv[1:]
+    if "--sections" in argv:
+        index = argv.index("--sections")
+        if index + 1 >= len(argv):
+            raise SystemExit("--sections requires a comma-separated list")
+        run_sections([n for n in argv[index + 1].split(",") if n])
+        return
     fallback_reason = os.environ.get("BENCH_BACKEND_FALLBACK")
     backend = "cpu-fallback" if fallback_reason else jax.default_backend()
     _start_stage_watchdog()
@@ -720,6 +923,14 @@ def main() -> None:
     scale_64 = run_state_machine_microbench(slices=64, hosts_per_slice=4)
     _progress("state_machine_microbench")
 
+    # Reconcile data-path sections (ISSUE 4): read calls per pass cached
+    # vs uncached, and the concurrent-apply width sweep, both at 256
+    # nodes (docs/reconcile-data-path.md).
+    snapshot_reads = run_snapshot_read_bench()
+    _progress("snapshot_reads")
+    apply_width = run_apply_width_bench()
+    _progress("apply_width")
+
     details = {
         "backend": backend,
         # Trial counts derived from the actual result objects — never a
@@ -749,6 +960,8 @@ def main() -> None:
             ),
             "scale_64_slices_256_nodes": scale_64,
         },
+        "snapshot_reads": snapshot_reads,
+        "apply_width": apply_width,
         "gate_cold_vs_warm": gate_split,
         "devices": [str(d) for d in jax.devices()],
         "calibration": calibration,
@@ -780,6 +993,11 @@ def main() -> None:
             "scale_256_node_reconciles_per_s": scale_64[
                 "node_reconciles_per_s"
             ],
+            "scale_256_passes_per_s": scale_64["passes_per_s"],
+            "snapshot_read_reduction_x": snapshot_reads[
+                "read_reduction_x"
+            ],
+            "apply_width_speedup_x": apply_width.get("speedup_x"),
         },
         "metric": "v5e-16 pool libtpu rolling-upgrade wall-clock "
         "(simulated GKE pool, real ICI/MXU health gate; median of "

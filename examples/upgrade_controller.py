@@ -327,21 +327,25 @@ def main(argv: list[str] | None = None) -> int:
                 if condition_changed_predicate(old.raw, obj.raw):
                     dirty.set()
 
-            informers = [
-                Informer(client, "Node"),
-                Informer(client, "Pod", namespace=args.namespace,
-                         label_selector=selector),
-                # The rollout trigger itself: a driver image bump lands as a
-                # new ControllerRevision / DaemonSet template change — with
-                # only Node/Pod watches, nothing would wake the controller to
-                # START the roll (revision-hash sync, pod_manager.go:84-118).
-                Informer(client, "DaemonSet", namespace=args.namespace,
-                         label_selector=selector),
-                Informer(client, "ControllerRevision", namespace=args.namespace,
-                         label_selector=selector),
-            ]
-            for informer in informers:
-                informer.add_event_handler(mark_dirty)
+            from k8s_operator_libs_tpu.upgrade import InformerSnapshotSource
+
+            # One informer set serves BOTH roles (ISSUE 4): reconcile
+            # triggering (handlers below) and build_state snapshots
+            # (snapshot-from-cache + provider write-through) — per-pass
+            # LISTs and per-node GETs disappear from the read path
+            # (docs/reconcile-data-path.md).
+            snapshot_source = InformerSnapshotSource(
+                client, args.namespace, selector
+            )
+            # ControllerRevision is the rollout trigger itself: a driver
+            # image bump lands as a new revision — with only Node/Pod
+            # watches, nothing would wake the controller to START the
+            # roll (revision-hash sync, pod_manager.go:84-118). The
+            # source watches it for the revision-sync read; the same
+            # informer triggers reconciles.
+            for kind in ("Node", "Pod", "DaemonSet", "ControllerRevision"):
+                snapshot_source.informer(kind).add_event_handler(mark_dirty)
+            informers = []
             if args.requestor:
                 nm_informer = Informer(client, "NodeMaintenance")
                 nm_informer.add_event_handler(maintenance_dirty)
@@ -350,12 +354,19 @@ def main(argv: list[str] | None = None) -> int:
             # sync latency across informers.
             for informer in informers:
                 informer.start()
+            # start() blocks until the snapshot stores are seeded — a
+            # snapshot taken before sync would be empty, not stale.
+            snapshot_source.start(sync_timeout=30)
+            mgr.snapshot_source = snapshot_source
+            mgr.provider.set_write_through(snapshot_source.record_write)
+            mgr.common.pod_manager.revision_source = snapshot_source
             for informer in informers:
                 if not informer.wait_for_sync(timeout=30):
                     logging.warning(
                         "%s informer did not sync within 30s; reconciles may "
                         "miss its triggers until it catches up", informer.kind,
                     )
+            informers.append(snapshot_source)  # stopped with the rest
 
         metrics = None
         if args.metrics_port:

@@ -25,13 +25,12 @@ for the framework (consumers embed it in their own controller runtime).
 
 from __future__ import annotations
 
-import copy
 import threading
 import time
 from typing import Any, Callable, Mapping, Optional
 
 from .client import Client, NotFoundError
-from .fake import FakeCluster
+from .fake import FakeCluster, deep_copy_json
 from .objects import KubeObject, wrap
 from .selectors import LabelSelector, parse_field_selector, parse_selector
 from .fake import _field_value  # shared field-selector traversal
@@ -62,7 +61,7 @@ class CachedClient(Client):
     def sync(self) -> None:
         """Make the cache consistent with the backing store right now."""
         with self.backing._lock:
-            fresh = copy.deepcopy(self.backing._store)
+            fresh = deep_copy_json(self.backing._store)
         with self._lock:
             self._snapshot = fresh
             self._lock.notify_all()
@@ -115,7 +114,7 @@ class CachedClient(Client):
             data = self._snapshot.get(key)
             if data is None:
                 raise NotFoundError(f"{kind} {namespace}/{name} not found (cache)")
-            return wrap(copy.deepcopy(data))
+            return wrap(deep_copy_json(data))
 
     def list(
         self,
@@ -143,7 +142,7 @@ class CachedClient(Client):
                     continue
                 if any(_field_value(data, f) != v for f, v in fields.items()):
                     continue
-                out.append(wrap(copy.deepcopy(data)))
+                out.append(wrap(deep_copy_json(data)))
         return out
 
     # -- writes (pass through) ---------------------------------------------

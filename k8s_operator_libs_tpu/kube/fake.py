@@ -18,8 +18,9 @@ implementation keeps the apiserver *semantics* the framework depends on:
 
 from __future__ import annotations
 
-import copy
 import itertools
+import os
+import random
 import threading
 import time
 import uuid
@@ -38,6 +39,7 @@ from .client import (
 )
 from .objects import (
     KINDS,
+    deep_copy_json,
     CustomResourceDefinition,
     KubeObject,
     rfc3339_now,
@@ -55,6 +57,18 @@ from .structural import (
 
 #: reactor signature: (verb, kind, payload) -> None; raise to inject a failure.
 Reactor = Callable[[str, str, dict[str, Any]], None]
+
+#: uid generation: ``uuid.uuid4`` reads ``os.urandom`` on every call, a
+#: measurable per-create cost on the pod-churn hot path (the simulated
+#: kubelet recreates one driver pod per node per roll). A process-local
+#: PRNG seeded once from urandom keeps uids RFC 4122 v4-shaped and
+#: unique-in-practice at ``getrandbits`` speed.
+_UID_RNG = random.Random(int.from_bytes(os.urandom(16), "big"))
+
+
+def _new_uid() -> str:
+    return str(uuid.UUID(int=_UID_RNG.getrandbits(128), version=4))
+
 
 _WATCH_ADDED = "ADDED"
 _WATCH_MODIFIED = "MODIFIED"
@@ -78,7 +92,7 @@ def merge_patch(target: dict[str, Any], patch: Mapping[str, Any]) -> dict[str, A
                 target[key] = existing
             merge_patch(existing, value)
         else:
-            target[key] = copy.deepcopy(value)
+            target[key] = deep_copy_json(value)
     return target
 
 
@@ -165,7 +179,7 @@ def strategic_merge_patch(
         if key.startswith("$setElementOrder/") and isinstance(value, list):
             field_name = key.split("/", 1)[1]
             orders[field_name] = value
-            live_before[field_name] = copy.deepcopy(target.get(field_name))
+            live_before[field_name] = deep_copy_json(target.get(field_name))
     for key, value in patch.items():
         if key in ("$patch", "$retainKeys"):
             continue
@@ -201,7 +215,7 @@ def strategic_merge_patch(
                 continue
             target[key] = merged_list
         else:
-            target[key] = copy.deepcopy(value)
+            target[key] = deep_copy_json(value)
     for field_name, order in orders.items():
         current = target.get(field_name)
         if isinstance(current, list):
@@ -227,7 +241,7 @@ def _strip_directives(item: Mapping[str, Any]) -> dict[str, Any]:
     """Deep-copy a patch element minus directive keys — directives are
     instructions to the merge, never data a real apiserver persists."""
     return {
-        k: copy.deepcopy(v) for k, v in item.items() if not _is_directive_key(k)
+        k: deep_copy_json(v) for k, v in item.items() if not _is_directive_key(k)
     }
 
 
@@ -268,17 +282,17 @@ def _strategic_merge_list(
                 if stripped or "$patch" not in i:
                     result.append(stripped)
             else:
-                result.append(copy.deepcopy(i))
+                result.append(deep_copy_json(i))
         return result
     cur_list = current if isinstance(current, list) else []
     if field in _PRIMITIVE_MERGE_FIELDS and all(
         not isinstance(i, Mapping)
         for i in itertools.chain(cur_list, patch_items)
     ):
-        merged = [copy.deepcopy(v) for v in cur_list]
+        merged = [deep_copy_json(v) for v in cur_list]
         for v in patch_items:
             if v not in merged:
-                merged.append(copy.deepcopy(v))
+                merged.append(deep_copy_json(v))
         return merged
     key = _merge_key_for(field, cur_list, patch_items)
     if key is None or (current is not None and not isinstance(current, list)):
@@ -287,11 +301,11 @@ def _strategic_merge_list(
         # apiserver, never a stored phantom object, and directive keys
         # are never persisted.
         return [
-            _strip_directives(i) if isinstance(i, Mapping) else copy.deepcopy(i)
+            _strip_directives(i) if isinstance(i, Mapping) else deep_copy_json(i)
             for i in patch_items
             if not (isinstance(i, Mapping) and i.get("$patch") == "delete")
         ]
-    merged = [copy.deepcopy(i) for i in cur_list]
+    merged = [deep_copy_json(i) for i in cur_list]
     index = {item[key]: pos for pos, item in enumerate(merged)}
     for item in patch_items:
         kval = item[key]
@@ -463,7 +477,7 @@ def _jp_root_replace(doc: dict[str, Any], value: Any) -> None:
             "json patch cannot replace the document root with a non-object"
         )
     doc.clear()
-    doc.update(copy.deepcopy(value))
+    doc.update(deep_copy_json(value))
 
 
 def _jp_add(
@@ -477,7 +491,7 @@ def _jp_add(
         return
     parent, last = _jp_parent(doc, tokens, pointer)
     if copy_value:
-        value = copy.deepcopy(value)
+        value = deep_copy_json(value)
     if isinstance(parent, Mapping):
         parent[last] = value  # type: ignore[index]
     elif isinstance(parent, list):
@@ -552,7 +566,7 @@ def json_patch(target: dict[str, Any], ops: Any) -> dict[str, Any]:
     """
     if not isinstance(ops, list):
         raise BadRequestError("json patch must be an array of operations")
-    work = copy.deepcopy(target)
+    work = deep_copy_json(target)
     for i, op in enumerate(ops):
         if not isinstance(op, Mapping) or not isinstance(op.get("op"), str):
             raise BadRequestError(
@@ -661,6 +675,25 @@ class FakeCluster(Client):
     ) -> None:
         self._lock = threading.RLock()
         self._store: dict[tuple[str, str, str], dict[str, Any]] = {}
+        #: Kind-bucketed mirror of ``_store`` so list/deleteCollection
+        #: scan O(kind bucket) instead of the whole store — the
+        #: difference between O(pool) and O(cluster) per list at
+        #: 256-node scale. Maintained ONLY via _store_put/_store_del;
+        #: ``_store`` stays the source of truth (tests introspect it).
+        self._by_kind: dict[str, dict[tuple[str, str, str], dict[str, Any]]] = {}
+        #: Owner-reference GC index: owner uid -> keys of (possible)
+        #: dependents, with the reverse map for cheap diffs. Synced on
+        #: every persisted write (_bump) and on delete — turns the GC's
+        #: whole-store dependent scan into an O(dependents) lookup.
+        self._owner_index: dict[str, set[tuple[str, str, str]]] = {}
+        self._owners_of: dict[tuple[str, str, str], frozenset[str]] = {}
+        #: Keys whose object is foreground-terminating (deletionTimestamp
+        #: set + ``foregroundDeletion`` finalizer) — the only objects the
+        #: GC sweep must visit. Maintained by _store_put/_store_del, the
+        #: choke points every store change flows through under the
+        #: copy-on-write write discipline; turns the per-delete sweep
+        #: from O(store) into O(pending), which is almost always O(0).
+        self._fg_pending: set[tuple[str, str, str]] = set()
         self._rv = itertools.count(1)
         self._reactors: list[tuple[str, str, Reactor]] = []
         self._watchers: list[
@@ -710,13 +743,39 @@ class FakeCluster(Client):
         #: busy write stream would stack redundant timers per name.
         self._discovery_pending: set[str] = set()
         self._pending_timers: list[threading.Timer] = []
+        #: Optional API call log (see start_call_log): (verb, kind, name)
+        #: per client call, appended under the store lock. Benches and
+        #: tests count traffic with it — load-immune, unlike wall-clock.
+        self._call_log: Optional[list[tuple[str, str, str]]] = None
 
     # -- fault injection ---------------------------------------------------
     def add_reactor(self, verb: str, kind: str, fn: Reactor) -> None:
         """Install a hook run before ``verb`` ("*" matches all) on ``kind``."""
         self._reactors.append((verb, kind, fn))
 
+    # -- call log ----------------------------------------------------------
+    def start_call_log(self) -> list[tuple[str, str, str]]:
+        """Begin recording every API call as ``(verb, kind, name)`` and
+        return the LIVE list (it keeps growing until stop_call_log).
+        Restarting truncates. The log records calls the fake *received* —
+        including ones a reactor then failed."""
+        with self._lock:
+            self._call_log = []
+            return self._call_log
+
+    def stop_call_log(self) -> list[tuple[str, str, str]]:
+        """Stop recording; returns the captured log (empty if never
+        started)."""
+        with self._lock:
+            log, self._call_log = self._call_log, None
+            return log if log is not None else []
+
     def _react(self, verb: str, kind: str, payload: dict[str, Any]) -> None:
+        if self._call_log is not None:
+            name = payload.get("name") or (
+                (payload.get("metadata") or {}).get("name", "")
+            )
+            self._call_log.append((verb, kind, str(name)))
         for v, k, fn in self._reactors:
             if v in ("*", verb) and k in ("*", kind):
                 fn(verb, kind, payload)
@@ -728,7 +787,13 @@ class FakeCluster(Client):
         """Register a watcher receiving ``(event_type, object, old_object)``
         on every write — ``old_object`` is the pre-mutation state (None for
         ADDED), which is what lets selector-scoped watches classify
-        transitions exactly as the real watch cache does."""
+        transitions exactly as the real watch cache does.
+
+        Delivered objects are FROZEN journal references (see ``_emit``):
+        read-only by contract. ``watch()`` yields these same frozen
+        references (zero copies per delivered event); any consumer that
+        hands them to code which may mutate must copy first — the
+        informer does so on its own reads, not at delivery."""
         with self._lock:
             self._watchers.append(fn)
 
@@ -782,8 +847,11 @@ class FakeCluster(Client):
                         f"resourceVersion {since} is too old "
                         f"(journal compacted; current: {last_rv})"
                     )
+                # Journal entries are frozen (copy-on-write store): replay
+                # hands out references under the same read-only contract
+                # live delivery uses — no per-entry copy on informer resume.
                 replay = [
-                    (event, copy.deepcopy(data), copy.deepcopy(old))
+                    (event, data, old)
                     for rv, event, data, old in self._history
                     if rv > since
                 ]
@@ -853,6 +921,11 @@ class FakeCluster(Client):
                     event_type, data, old, selector, fields
                 )
                 if mapped is not None:
+                    # Yielded objects are frozen journal references (see
+                    # _emit) — read-only by contract, same as the shared
+                    # snapshot every consumer of this generator always
+                    # got. The informer rides on this: zero copies per
+                    # delivered event; its own reads copy on the way out.
                     yield mapped, wrap(data)
             deadline = (
                 time.monotonic() + timeout_seconds
@@ -909,8 +982,17 @@ class FakeCluster(Client):
         data: dict[str, Any],
         old: Optional[dict[str, Any]] = None,
     ) -> None:
-        snapshot = copy.deepcopy(data)
-        old_snapshot = copy.deepcopy(old) if old is not None else None
+        # Ownership contract (the copy-on-write store discipline): a dict
+        # is FROZEN the moment it is stored or emitted — every mutating
+        # path works on a private copy and swaps it in via _store_put, so
+        # the journal and the subscribers can take both ``data`` (the
+        # just-stored object) and ``old`` (the previously-stored object,
+        # or the caller's private pre-delete copy) by reference instead
+        # of paying a whole-object copy per write. (An old shared by two
+        # journal entries — a releasing write's MODIFIED + its DELETED —
+        # stays correct for the same reason: nothing mutates it.)
+        snapshot = data
+        old_snapshot = old
         if old_snapshot is None and event != _WATCH_ADDED:
             # DELETED with no explicit prior: the object itself is the
             # pre-deletion state.
@@ -955,8 +1037,39 @@ class FakeCluster(Client):
         return (kind, namespace, name)
 
     def _bump(self, data: dict[str, Any]) -> None:
+        # Revision assignment only: index maintenance lives in
+        # _store_put, which every persisted write now reaches (the
+        # copy-on-write discipline swaps a fresh dict in per mutation).
+        # Deletes _bump a private copy after _store_del — nothing to
+        # index there.
         self._last_rv = next(self._rv)
-        data.setdefault("metadata", {})["resourceVersion"] = str(self._last_rv)
+        meta = data.setdefault("metadata", {})
+        meta["resourceVersion"] = str(self._last_rv)
+
+    def _sync_owner_index(
+        self, key: tuple[str, str, str], data: dict[str, Any]
+    ) -> None:
+        """Diff the object's ownerReferences into the GC index; caller
+        holds the lock."""
+        refs = (data.get("metadata") or {}).get("ownerReferences") or []
+        new_owners = frozenset(
+            r.get("uid") for r in refs if r.get("uid")
+        )
+        old_owners = self._owners_of.get(key, frozenset())
+        if new_owners == old_owners:
+            return
+        for uid in old_owners - new_owners:
+            bucket = self._owner_index.get(uid)
+            if bucket is not None:
+                bucket.discard(key)
+                if not bucket:
+                    del self._owner_index[uid]
+        for uid in new_owners - old_owners:
+            self._owner_index.setdefault(uid, set()).add(key)
+        if new_owners:
+            self._owners_of[key] = new_owners
+        else:
+            self._owners_of.pop(key, None)
 
     @staticmethod
     def _spec_view(data: Mapping[str, Any]) -> dict[str, Any]:
@@ -1079,30 +1192,123 @@ class FakeCluster(Client):
             for v in (crd.get("spec") or {}).get("versions") or []:
                 if v.get("name") == version:
                     cols = v.get("additionalPrinterColumns") or []
-                    return copy.deepcopy(cols)
+                    return deep_copy_json(cols)
         return None
-
-    def _admit_or_restore_locked(
-        self,
-        data: dict[str, Any],
-        old: dict[str, Any],
-        status_only: bool = False,
-    ) -> None:
-        """Admission for write paths that mutate the STORED dict in
-        place (patch, status replace, apply): a rejected write restores
-        the pre-write content before re-raising, so 422 leaves no
-        trace — the same atomicity the json-patch engine guarantees."""
-        try:
-            self._admit_custom_locked(data, status_only=status_only)
-        except InvalidError:
-            data.clear()
-            data.update(copy.deepcopy(old))
-            raise
 
     def current_resource_version(self) -> str:
         """The newest revision assigned — a list's collection
-        resourceVersion (what an empty list resumes a watch from)."""
-        return str(getattr(self, "_last_rv", 0))
+        resourceVersion (what an empty list resumes a watch from).
+
+        Taken UNDER the store lock: a writer assigns the rv (_bump) and
+        enqueues the event to watchers (_emit) in one lock hold, so a
+        locked read serializes after the whole write — an rv observed
+        here implies its event was already delivered to subscriber
+        queues. The bookmark path's drained-queue check rides on exactly
+        that ordering; a lock-free peek could see the rv of a write
+        whose event was still pending and stamp a bookmark that
+        overtakes it."""
+        with self._lock:
+            return str(getattr(self, "_last_rv", 0))
+
+    def _store_put(
+        self, key: tuple[str, str, str], data: dict[str, Any]
+    ) -> None:
+        """Store insert/replace + index maintenance; caller holds the
+        lock. Under the copy-on-write write discipline every store
+        change flows through here (mutating paths swap in a fresh dict
+        rather than editing the stored one), which makes this the single
+        place the owner-GC and foreground-pending indexes stay synced."""
+        self._store[key] = data
+        self._by_kind.setdefault(key[0], {})[key] = data
+        self._sync_owner_index(key, data)
+        meta = data.get("metadata") or {}
+        if meta.get("deletionTimestamp") and "foregroundDeletion" in (
+            meta.get("finalizers") or []
+        ):
+            self._fg_pending.add(key)
+        else:
+            self._fg_pending.discard(key)
+
+    def _store_del(self, key: tuple[str, str, str]) -> None:
+        """Store delete + kind/owner-index maintenance; caller holds
+        the lock."""
+        self._fg_pending.discard(key)
+        del self._store[key]
+        bucket = self._by_kind.get(key[0])
+        if bucket is not None:
+            bucket.pop(key, None)
+        for uid in self._owners_of.pop(key, frozenset()):
+            owner_bucket = self._owner_index.get(uid)
+            if owner_bucket is not None:
+                owner_bucket.discard(key)
+                if not owner_bucket:
+                    del self._owner_index[uid]
+
+    # -- read-only fast paths (simulators / benches) -----------------------
+    # These skip the defensive copy, NOT the API semantics: they still run
+    # reactors (fault injection sees them) and the call log records them,
+    # so a simulated kubelet on the fast path stays subject to the same
+    # injected chaos as one on get()/list().
+    def contains(self, kind: str, name: str, namespace: str = "") -> bool:
+        """Existence check without the defensive copy ``get`` makes —
+        the kubelet simulator's per-node per-tick probe."""
+        with self._lock:
+            self._react("get", kind, {"name": name, "namespace": namespace})
+            return self._key(kind, namespace, name) in self._store
+
+    def object_names(self, kind: str, namespace: str = "") -> list[str]:
+        """Sorted names of stored objects of ``kind`` (no copies)."""
+        with self._lock:
+            self._react("list", kind, {"namespace": namespace})
+            return sorted(
+                name
+                for (_, ns, name) in self._by_kind.get(kind, {})
+                if not namespace or ns == namespace
+            )
+
+    def peek(
+        self, kind: str, name: str, namespace: str = ""
+    ) -> Optional[dict[str, Any]]:
+        """The RAW stored object, no copy, or None. STRICTLY read-only:
+        mutating the return value corrupts the store — this exists for
+        simulators and benches whose per-tick reads would otherwise copy
+        the whole pool; API consumers use get()/list()."""
+        with self._lock:
+            self._react("get", kind, {"name": name, "namespace": namespace})
+            return self._store.get(self._key(kind, namespace, name))
+
+    def list_peek(
+        self,
+        kind: str,
+        namespace: str = "",
+        label_selector: Optional[str | Mapping[str, str]] = None,
+    ) -> list[dict[str, Any]]:
+        """RAW stored objects of ``kind``, filtered like ``list``, no
+        copies. STRICTLY read-only (``peek``'s contract) — with one
+        guarantee the copy-on-write store adds: the returned dicts are
+        frozen (a later write swaps in a fresh dict instead of editing
+        these), so the result is a consistent point-in-time snapshot,
+        not a live view. The snapshot source serves build_state's
+        Pod/DaemonSet/ControllerRevision reads from this — kinds the
+        upgrade managers never mutate — skipping one whole-object copy
+        per object per reconcile pass. Anything that mutates results
+        uses list()."""
+        if isinstance(label_selector, Mapping):
+            selector = LabelSelector.from_match_labels(label_selector)
+        else:
+            selector = parse_selector(label_selector)
+        with self._lock:
+            self._react("list", kind, {"namespace": namespace})
+            out = []
+            for (_, ns, _name), data in sorted(
+                self._by_kind.get(kind, {}).items()
+            ):
+                if namespace and ns != namespace:
+                    continue
+                labels = (data.get("metadata") or {}).get("labels") or {}
+                if selector.matches(labels):
+                    out.append(data)
+            return out
 
     def _get_raw(self, kind: str, name: str, namespace: str) -> dict[str, Any]:
         key = self._key(kind, namespace, name)
@@ -1138,13 +1344,15 @@ class FakeCluster(Client):
             return
         meta = data.get("metadata", {})
         if meta.get("deletionTimestamp") and not meta.get("finalizers"):
-            del self._store[key]
+            self._store_del(key)
             if kind == "CustomResourceDefinition":
                 self._discoverable.pop(name, None)
             # The real apiserver bumps rv on delete; without it the
             # DELETED journal entry reuses the object's last revision and
             # a watch resuming from exactly that revision replays PAST the
-            # deletion — a lost event.
+            # deletion — a lost event. The bump mutates, so it lands on a
+            # private copy (the released dict is already journaled).
+            data = deep_copy_json(data)
             self._bump(data)
             self._emit(_WATCH_DELETED, data, old=old)
             # A finalizer-released object is as gone as a direct delete:
@@ -1157,7 +1365,7 @@ class FakeCluster(Client):
     def get(self, kind: str, name: str, namespace: str = "") -> KubeObject:
         with self._lock:
             self._react("get", kind, {"name": name, "namespace": namespace})
-            return wrap(copy.deepcopy(self._get_raw(kind, name, namespace)))
+            return wrap(deep_copy_json(self._get_raw(kind, name, namespace)))
 
     def list(
         self,
@@ -1174,9 +1382,8 @@ class FakeCluster(Client):
         with self._lock:
             self._react("list", kind, {"namespace": namespace})
             out = []
-            for (k, ns, _), data in sorted(self._store.items()):
-                if k != kind:
-                    continue
+            bucket = self._by_kind.get(kind, {})
+            for (_, ns, _name), data in sorted(bucket.items()):
                 if namespace and ns != namespace:
                     continue
                 labels = (data.get("metadata") or {}).get("labels") or {}
@@ -1184,7 +1391,7 @@ class FakeCluster(Client):
                     continue
                 if any(_field_value(data, f) != v for f, v in fields.items()):
                     continue
-                out.append(wrap(copy.deepcopy(data)))
+                out.append(wrap(deep_copy_json(data)))
             return out
 
     def delete_collection(
@@ -1328,10 +1535,10 @@ class FakeCluster(Client):
             if remaining <= 0:
                 self._continues.pop(token_id, None)
                 return (
-                    [wrap(copy.deepcopy(r)) for r in page], revision, "", None
+                    [wrap(deep_copy_json(r)) for r in page], revision, "", None
                 )
             return (
-                [wrap(copy.deepcopy(r)) for r in page],
+                [wrap(deep_copy_json(r)) for r in page],
                 revision,
                 f"{token_id}:{next_offset}",
                 None if selector_used else remaining,
@@ -1358,10 +1565,10 @@ class FakeCluster(Client):
             key = self._key(kind, obj.namespace, obj.name)
             if key in self._store:
                 raise AlreadyExistsError(f"{kind} {obj.name} already exists")
-            data = copy.deepcopy(obj.raw)
+            data = deep_copy_json(obj.raw)
             self._admit_custom_locked(data)
             meta = data.setdefault("metadata", {})
-            meta.setdefault("uid", str(uuid.uuid4()))
+            meta.setdefault("uid", _new_uid())
             meta.setdefault("creationTimestamp", time.time())
             if field_manager and not meta.get("managedFields"):
                 # An explicitly-managed create owns every field it wrote
@@ -1373,9 +1580,9 @@ class FakeCluster(Client):
             if dry_run:
                 # dryRun=All: the full admission/defaulting pipeline ran;
                 # nothing persists, no events, no revision assigned.
-                return wrap(copy.deepcopy(data))
+                return wrap(deep_copy_json(data))
             self._bump(data)
-            self._store[key] = data
+            self._store_put(key, data)
             self._emit(_WATCH_ADDED, data)
             if kind == "CustomResourceDefinition":
                 self._crds_ever_stored = True
@@ -1393,18 +1600,30 @@ class FakeCluster(Client):
                     self._pending_timers.append(timer)
                     timer.start()
                 else:
-                    self._establish_crd_locked(data)
-            return wrap(copy.deepcopy(data))
+                    data = self._establish_crd_locked(data)
+            return wrap(deep_copy_json(data))
 
-    def _establish_crd_locked(self, data: dict[str, Any]) -> None:
-        status = data.setdefault("status", {})
-        conds = status.setdefault("conditions", [])
+    def _establish_crd_locked(self, data: dict[str, Any]) -> dict[str, Any]:
+        """Returns the (possibly replaced) stored dict — copy-on-write
+        means establishing swaps in a new object, and callers that go on
+        to build a response from ``data`` need the established one."""
+        conds = (data.get("status") or {}).get("conditions") or []
         if not any(c.get("type") == "Established" for c in conds):
-            old = copy.deepcopy(data)
-            conds.append({"type": "Established", "status": "True"})
+            old = data
+            data = deep_copy_json(old)
+            data.setdefault("status", {}).setdefault(
+                "conditions", []
+            ).append({"type": "Established", "status": "True"})
+            key = self._key(
+                "CustomResourceDefinition",
+                "",
+                (data.get("metadata") or {}).get("name", ""),
+            )
             self._bump(data)
+            self._store_put(key, data)
             self._emit(_WATCH_MODIFIED, data, old=old)
         self._sync_crd_discoverability_locked(data)
+        return data
 
     def _sync_crd_discoverability_locked(self, data: dict[str, Any]) -> None:
         """An Established CRD becomes discoverable after the configured
@@ -1513,14 +1732,14 @@ class FakeCluster(Client):
                 raise ConflictError(
                     f"{kind} {obj.name}: resourceVersion {sent_rv} is stale"
                 )
-            old = copy.deepcopy(current)
+            key = self._key(kind, obj.namespace, obj.name)
+            # Copy-on-write: ``old`` stays the frozen stored dict; both
+            # branches build the replacement privately and swap it in.
+            old = current
             if status_only:
-                if dry_run:
-                    # Work on a private copy: nothing may reach storage.
-                    current = copy.deepcopy(current)
-                current["status"] = copy.deepcopy(obj.raw.get("status") or {})
-                data = current
-                self._admit_or_restore_locked(data, old, status_only=True)
+                data = deep_copy_json(old)
+                data["status"] = deep_copy_json(obj.raw.get("status") or {})
+                self._admit_custom_locked(data, status_only=True)
                 # statusStrategy semantics: desired state cannot change
                 # through the status endpoint — whatever admission
                 # pruned/defaulted outside status is restored from the
@@ -1530,9 +1749,9 @@ class FakeCluster(Client):
                     del data[k]
                 for k, v in old.items():
                     if k not in ("metadata", "status"):
-                        data[k] = copy.deepcopy(v)
+                        data[k] = deep_copy_json(v)
             else:
-                data = copy.deepcopy(obj.raw)
+                data = deep_copy_json(obj.raw)
                 # Immutable/server-owned fields survive a replace.
                 meta = data.setdefault("metadata", {})
                 cur_meta = current.get("metadata", {})
@@ -1546,16 +1765,12 @@ class FakeCluster(Client):
                     # Deep copy: admission prunes in place, and a rejected
                     # write must not have reached the stored status subtree
                     # through a shared reference.
-                    data["status"] = copy.deepcopy(current["status"])
+                    data["status"] = deep_copy_json(current["status"])
                 else:
                     data.pop("status", None)
                 # Admission before the store swap: a rejected replace
                 # must leave the stored object untouched.
                 self._admit_custom_locked(data)
-                if not dry_run:
-                    self._store[
-                        self._key(kind, obj.namespace, obj.name)
-                    ] = data
             # managedFields is server-owned: ownership moves to the writer
             # for every field this write changed (client-sent managedFields
             # is ignored, like a real apiserver preserving when unset).
@@ -1568,8 +1783,9 @@ class FakeCluster(Client):
             )
             self._sync_generation(data, old)
             if dry_run:
-                return wrap(copy.deepcopy(data))
+                return wrap(data)
             self._bump(data)
+            self._store_put(key, data)
             if not self._write_becomes_delete(data):
                 self._emit(_WATCH_MODIFIED, data, old=old)
             if kind == "CustomResourceDefinition":
@@ -1578,7 +1794,7 @@ class FakeCluster(Client):
                     # re-establishes in place); already-served versions
                     # remain discoverable, and the served set refreshes
                     # to the new spec after the window.
-                    self._establish_crd_locked(data)
+                    data = self._establish_crd_locked(data)
                     self._schedule_discovery_refresh_locked(data)
                 else:
                     # Manual-controller mode (or a status write): honor an
@@ -1587,7 +1803,7 @@ class FakeCluster(Client):
                     if not status_only:
                         self._schedule_discovery_refresh_locked(data)
             self._finalize_delete_if_due(kind, obj.name, obj.namespace, old=old)
-            return wrap(copy.deepcopy(data))
+            return wrap(deep_copy_json(data))
 
     def update(
         self,
@@ -1622,20 +1838,23 @@ class FakeCluster(Client):
         dry_run: bool = False,
     ) -> KubeObject:
         with self._lock:
-            payload = (
-                copy.deepcopy(patch)
-                if isinstance(patch, list)
-                else dict(patch or {})
+            # Private payload copy: the merge engines may graft patch
+            # subtrees into the object wholesale, and under the frozen-
+            # store contract neither the store nor the journal may alias
+            # caller memory. Patches are small; the copy is noise next to
+            # the whole-object copies it prevents corrupting.
+            payload = deep_copy_json(
+                patch if isinstance(patch, list) else dict(patch or {})
             )
             self._react("patch", kind, {"name": name, "namespace": namespace,
                                         "patch": payload,
                                         "patch_type": patch_type})
-            current = self._get_raw(kind, name, namespace)
-            if dry_run:
-                # All merging/admission below mutates in place — give it
-                # a private copy so nothing reaches storage.
-                current = copy.deepcopy(current)
-            old = copy.deepcopy(current)
+            key = self._key(kind, namespace, name)
+            # Copy-on-write: ``old`` stays the frozen stored dict (the
+            # journal will take it by reference); all merging/admission
+            # below mutates a private copy that is swapped in on success.
+            old = self._get_raw(kind, name, namespace)
+            current = deep_copy_json(old)
             if patch_type == "strategic" and not _supports_strategic(current):
                 # Real-apiserver semantics: strategic merge patch only
                 # exists for built-in typed resources (their Go structs
@@ -1645,14 +1864,14 @@ class FakeCluster(Client):
                     f"resources ({current.get('apiVersion', '?')} {kind})"
                 )
             if patch_type == "strategic":
-                strategic_merge_patch(current, patch or {})  # type: ignore[arg-type]
+                strategic_merge_patch(current, payload)  # type: ignore[arg-type]
             elif patch_type == "merge":
-                merge_patch(current, patch or {})  # type: ignore[arg-type]
+                merge_patch(current, payload)  # type: ignore[arg-type]
             elif patch_type == "json":
-                # A None/dict patch is a caller bug json_patch rejects
+                # A non-list patch is a caller bug json_patch rejects
                 # with 400 — matching RestClient's client-side guard, so
                 # the two backends never diverge on this.
-                json_patch(current, patch)
+                json_patch(current, payload)
             else:
                 raise InvalidError(
                     f"unsupported patch type {patch_type!r} "
@@ -1669,14 +1888,18 @@ class FakeCluster(Client):
                 meta["namespace"] = old_ns
             else:
                 meta.pop("namespace", None)
-            self._admit_or_restore_locked(current, old)
+            # A rejected write leaves no trace: ``current`` is private,
+            # so admission failure just raises — the store was never
+            # touched.
+            self._admit_custom_locked(current)
             # Ownership follows the write (managedFields is server-owned;
             # a patch cannot rewrite it directly).
             reassign_on_write(old, current, field_manager, rfc3339_now())
             self._sync_generation(current, old)
             if dry_run:
-                return wrap(copy.deepcopy(current))
+                return wrap(current)
             self._bump(current)
+            self._store_put(key, current)
             if not self._write_becomes_delete(current):
                 self._emit(_WATCH_MODIFIED, current, old=old)
             if kind == "CustomResourceDefinition":
@@ -1692,7 +1915,7 @@ class FakeCluster(Client):
                     # (same as _replace).
                     self._schedule_discovery_refresh_locked(current)
             self._finalize_delete_if_due(kind, name, namespace, old=old)
-            return wrap(copy.deepcopy(current))
+            return wrap(deep_copy_json(current))
 
     def apply(
         self,
@@ -1710,7 +1933,7 @@ class FakeCluster(Client):
         lists the owners) unless ``force`` — the upstream co-management
         contract (kube/ssa.py).
         """
-        applied = copy.deepcopy(
+        applied = deep_copy_json(
             obj.raw if isinstance(obj, KubeObject) else dict(obj)
         )
         kind = applied.get("kind", "")
@@ -1760,10 +1983,10 @@ class FakeCluster(Client):
                     live["metadata"]["namespace"] = namespace
                 server_side_apply(live, applied, field_manager, force, now)
                 return self.create(wrap(live), dry_run=dry_run)
-            current = self._get_raw(kind, name, namespace)
-            if dry_run:
-                current = copy.deepcopy(current)
-            old = copy.deepcopy(current)
+            # Copy-on-write (see patch): merge into a private copy, swap
+            # it in on success; the frozen stored dict becomes ``old``.
+            old = self._get_raw(kind, name, namespace)
+            current = deep_copy_json(old)
             if "status" in current:
                 # Main-resource writes never touch the status subresource
                 # (same rule as _replace).
@@ -1777,11 +2000,12 @@ class FakeCluster(Client):
                 cur_meta["namespace"] = old_ns
             else:
                 cur_meta.pop("namespace", None)
-            self._admit_or_restore_locked(current, old)
+            self._admit_custom_locked(current)
             self._sync_generation(current, old)
             if dry_run:
-                return wrap(copy.deepcopy(current))
+                return wrap(current)
             self._bump(current)
+            self._store_put(key, current)
             if not self._write_becomes_delete(current):
                 self._emit(_WATCH_MODIFIED, current, old=old)
             if kind == "CustomResourceDefinition":
@@ -1789,7 +2013,7 @@ class FakeCluster(Client):
                 if "spec" in applied:
                     self._schedule_discovery_refresh_locked(current)
             self._finalize_delete_if_due(kind, name, namespace, old=old)
-            return wrap(copy.deepcopy(current))
+            return wrap(deep_copy_json(current))
 
     def delete(
         self,
@@ -1829,7 +2053,7 @@ class FakeCluster(Client):
             self._react("delete", kind, {"name": name, "namespace": namespace})
             key = self._key(kind, namespace, name)
             data = self._get_raw(kind, name, namespace)
-            meta = data.setdefault("metadata", {})
+            meta = data.get("metadata") or {}
             if (
                 precondition_uid is not None
                 and meta.get("uid") != precondition_uid
@@ -1859,12 +2083,15 @@ class FakeCluster(Client):
                 gc = False  # orphaned: nothing to collect afterwards
             dependents = self._gc_dependents(uid) if gc else []
             if gc and policy == "Foreground" and dependents:
-                old = copy.deepcopy(data)
+                # Copy-on-write: mark the private copy, swap it in.
+                old = data
+                data = deep_copy_json(old)
+                work_meta = data["metadata"]
                 changed = False
-                if not meta.get("deletionTimestamp"):
-                    meta["deletionTimestamp"] = time.time()
+                if not work_meta.get("deletionTimestamp"):
+                    work_meta["deletionTimestamp"] = time.time()
                     changed = True
-                finalizers = meta.setdefault("finalizers", [])
+                finalizers = work_meta.setdefault("finalizers", [])
                 # Appended even on an already-terminating owner — the
                 # foreground guarantee must hold regardless of which
                 # delete marked the timestamp first.
@@ -1873,6 +2100,7 @@ class FakeCluster(Client):
                     changed = True
                 if changed:
                     self._bump(data)
+                    self._store_put(key, data)
                     self._emit(_WATCH_MODIFIED, data, old=old)
                 for dkind, dns, dname in dependents:
                     # Foreground propagates DOWN the chain (the real GC's
@@ -1886,15 +2114,21 @@ class FakeCluster(Client):
                 return
             if meta.get("finalizers"):
                 if not meta.get("deletionTimestamp"):
-                    old = copy.deepcopy(data)
-                    meta["deletionTimestamp"] = time.time()
+                    old = data
+                    data = deep_copy_json(old)
+                    data["metadata"]["deletionTimestamp"] = time.time()
                     self._bump(data)
+                    self._store_put(key, data)
                     self._emit(_WATCH_MODIFIED, data, old=old)
                 return
-            del self._store[key]
+            self._store_del(key)
             if kind == "CustomResourceDefinition":
                 self._discoverable.pop(name, None)
-            self._bump(data)  # see _finalize_delete_if_due: rv moves on delete
+            # The DELETED event carries a bumped rv (see
+            # _finalize_delete_if_due); the bump mutates, so it lands on
+            # a private copy — the stored dict may already be journaled.
+            data = deep_copy_json(data)
+            self._bump(data)
             self._emit(_WATCH_DELETED, data)
             if gc:
                 self._gc_on_owner_removed(uid)
@@ -1909,23 +2143,28 @@ class FakeCluster(Client):
         ``blockOwnerDeletion: true`` — the only dependents a Foreground
         owner waits for on a real cluster."""
         out = []
-        for (kind, ns, name), data in self._store.items():
+        for key in list(self._owner_index.get(uid, ())):
+            data = self._store.get(key)
+            if data is None:
+                continue
             refs = (data.get("metadata") or {}).get("ownerReferences") or []
             if any(
                 r.get("uid") == uid
                 and (not blocking_only or r.get("blockOwnerDeletion"))
                 for r in refs
             ):
-                out.append((kind, ns, name))
+                out.append(key)
         return out
 
     def _gc_orphan_dependents(self, uid: str) -> None:
         for dkind, dns, dname in self._gc_dependents(uid):
-            dep = self._store.get(self._key(dkind, dns, dname))
+            dkey = self._key(dkind, dns, dname)
+            dep = self._store.get(dkey)
             if dep is None:
                 continue
-            old = copy.deepcopy(dep)
-            meta = dep.setdefault("metadata", {})
+            old = dep
+            dep = deep_copy_json(old)
+            meta = dep["metadata"]
             refs = [
                 r for r in meta.get("ownerReferences") or []
                 if r.get("uid") != uid
@@ -1935,6 +2174,7 @@ class FakeCluster(Client):
             else:
                 meta.pop("ownerReferences", None)
             self._bump(dep)
+            self._store_put(dkey, dep)
             self._emit(_WATCH_MODIFIED, dep, old=old)
 
     def _gc_on_owner_removed(self, uid: str) -> None:
@@ -1945,18 +2185,21 @@ class FakeCluster(Client):
         ownerReferences stay intact while it terminates, exactly as a
         real cluster's watch stream shows."""
         for dkind, dns, dname in self._gc_dependents(uid):
-            dep = self._store.get(self._key(dkind, dns, dname))
+            dkey = self._key(dkind, dns, dname)
+            dep = self._store.get(dkey)
             if dep is None:
                 continue
-            meta = dep.setdefault("metadata", {})
             refs = [
-                r for r in meta.get("ownerReferences") or []
+                r for r in (dep.get("metadata") or {}).get("ownerReferences")
+                or []
                 if r.get("uid") != uid
             ]
             if refs:
-                old = copy.deepcopy(dep)
-                meta["ownerReferences"] = refs
+                old = dep
+                dep = deep_copy_json(old)
+                dep["metadata"]["ownerReferences"] = refs
                 self._bump(dep)
+                self._store_put(dkey, dep)
                 self._emit(_WATCH_MODIFIED, dep, old=old)
             else:
                 self.delete(dkind, dname, dns)
@@ -1967,8 +2210,14 @@ class FakeCluster(Client):
         BLOCKING dependents left (``blockOwnerDeletion: true`` — other
         dependents never hold a foreground owner on a real cluster);
         fully-released owners finalize and cascade. Caller holds the
-        lock (re-entrant: the cascade re-enters ``delete``)."""
-        for key, data in list(self._store.items()):
+        lock (re-entrant: the cascade re-enters ``delete``). Visits only
+        ``_fg_pending`` — the keys _store_put indexed as
+        foreground-terminating — so the per-delete cost is O(pending),
+        not O(store)."""
+        for key in list(self._fg_pending):
+            data = self._store.get(key)
+            if data is None:
+                continue
             meta = data.get("metadata") or {}
             finalizers = meta.get("finalizers") or []
             if (
@@ -1979,16 +2228,19 @@ class FakeCluster(Client):
                 )
             ):
                 continue
-            old = copy.deepcopy(data)
+            old = data
+            data = deep_copy_json(old)
+            meta = data["metadata"]
             finalizers = [f for f in finalizers if f != "foregroundDeletion"]
             if finalizers:
                 meta["finalizers"] = finalizers
                 self._bump(data)
+                self._store_put(key, data)
                 self._emit(_WATCH_MODIFIED, data, old=old)
                 continue
             meta.pop("finalizers", None)
             kind, _, name = key
-            del self._store[key]
+            self._store_del(key)
             if kind == "CustomResourceDefinition":
                 self._discoverable.pop(name, None)
             self._bump(data)

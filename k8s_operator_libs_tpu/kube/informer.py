@@ -24,7 +24,7 @@ import threading
 from typing import Callable, Mapping, Optional
 
 from .client import Client, WatchExpiredError
-from .objects import KubeObject, wrap
+from .objects import KubeObject, deep_copy_json, wrap
 from .selectors import parse_selector
 from ..utils.log import get_logger
 
@@ -226,7 +226,11 @@ class Informer:
             if name not in self._indexers:
                 raise KeyError(f"no indexer named {name!r}")
             keys = self._indices.get(name, {}).get(value, set())
-            out = [wrap(self._store[k]) for k in keys if k in self._store]
+            out = [
+                wrap(deep_copy_json(self._store[k]))
+                for k in keys
+                if k in self._store
+            ]
         return sorted(out, key=lambda o: (o.namespace, o.name))
 
     @staticmethod
@@ -267,15 +271,58 @@ class Informer:
         if old is not None:
             self._index_remove(key, old)
 
+    # -- write-through -----------------------------------------------------
+    def record_write(self, obj: "KubeObject | dict") -> None:
+        """Write-through from the object's writer: store the write result
+        NOW so the writer's next cached read reflects its own write
+        (read-your-writes), instead of waiting for the watch to deliver
+        it. The watch event still arrives later; deliveries being
+        at-least-once, handlers are level-driven and tolerate the replay.
+
+        Ignored when the store already holds a strictly newer
+        resourceVersion (the watch outran the caller), and never
+        dispatched to handlers — this is a store repair, not an event.
+        """
+        raw = obj.raw if isinstance(obj, KubeObject) else obj
+        key = self._key(raw)
+        new_rv = str((raw.get("metadata") or {}).get("resourceVersion", ""))
+        with self._lock:
+            old = self._store.get(key)
+            if old is not None:
+                old_rv = str(
+                    (old.get("metadata") or {}).get("resourceVersion", "")
+                )
+                if (
+                    old_rv.isdigit()
+                    and new_rv.isdigit()
+                    and int(new_rv) <= int(old_rv)
+                ):
+                    return  # store is already as new as (or newer than) us
+            self._store_set(key, deep_copy_json(raw))
+
     # -- cached reads ------------------------------------------------------
+    # get/list/by_index return DEEP COPIES, like a controller-runtime
+    # cached client: callers mutate their results (the state provider
+    # updates node labels in place; cordon flips unschedulable), and an
+    # aliased store object would let those mutations corrupt the shared
+    # cache from outside its lock.
     def get(self, name: str, namespace: str = "") -> Optional[KubeObject]:
         with self._lock:
             raw = self._store.get((namespace, name))
-            return wrap(raw) if raw is not None else None
+            return wrap(deep_copy_json(raw)) if raw is not None else None
 
     def list(
-        self, label_selector: Optional[str | Mapping[str, str]] = None
+        self,
+        label_selector: Optional[str | Mapping[str, str]] = None,
+        copy: bool = True,
     ) -> list[KubeObject]:
+        """``copy=False`` skips the defensive copy and wraps the store's
+        own dicts — STRICTLY read-only (``FakeCluster.list_peek``'s
+        contract): store entries are never edited in place (watch/
+        record_write/relist all swap whole dicts), so the refs form a
+        consistent snapshot, but a caller mutation would corrupt the
+        cache. Reserved for consumers that provably never mutate — the
+        snapshot source's Pod/DaemonSet/ControllerRevision reads."""
         if isinstance(label_selector, Mapping):
             label_selector = ",".join(
                 f"{k}={v}" for k, v in sorted(label_selector.items())
@@ -286,7 +333,7 @@ class Informer:
             for raw in self._store.values():
                 labels = (raw.get("metadata") or {}).get("labels") or {}
                 if selector.matches(labels):
-                    out.append(wrap(raw))
+                    out.append(wrap(raw if not copy else deep_copy_json(raw)))
             return sorted(out, key=lambda o: (o.namespace, o.name))
 
     # -- internals ---------------------------------------------------------
@@ -407,15 +454,40 @@ class Informer:
                             self._resource_version = rv
                         continue
                     key = self._key(raw)
+                    rv = str(
+                        (raw.get("metadata") or {}).get("resourceVersion", "")
+                    )
                     with self._lock:
                         old = self._store.get(key)
                         if event_type == "DELETED":
                             self._store_pop(key)
                         else:
-                            self._store_set(key, raw)
-                    rv = str(
-                        (raw.get("metadata") or {}).get("resourceVersion", "")
-                    )
+                            # record_write (provider write-through) may
+                            # have landed a NEWER object than this
+                            # delivery — applying a lagging event would
+                            # regress the store below the caller's own
+                            # write, exactly the staleness write-through
+                            # exists to remove. Same forward-only rule
+                            # record_write itself follows. The event is
+                            # still DISPATCHED below: record_write never
+                            # dispatches, so dropping the watch echo too
+                            # would deliver the write zero times and
+                            # starve trigger handlers of their wake-up
+                            # (handlers are level-driven; a stale payload
+                            # is at-least-once noise, a missing one is a
+                            # lost event).
+                            old_rv = str(
+                                ((old or {}).get("metadata") or {}).get(
+                                    "resourceVersion", ""
+                                )
+                            )
+                            if not (
+                                old is not None
+                                and old_rv.isdigit()
+                                and rv.isdigit()
+                                and int(rv) <= int(old_rv)
+                            ):
+                                self._store_set(key, raw)
                     if rv.isdigit():
                         self._resource_version = rv
                     self._dispatch(event_type, raw, old)

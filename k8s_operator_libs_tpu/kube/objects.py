@@ -18,6 +18,24 @@ import time
 from typing import Any, Iterable, Mapping, MutableMapping, Optional, Type
 
 
+def deep_copy_json(obj: Any) -> Any:
+    """Deep copy for JSON-shaped API objects — ~10x faster than
+    ``copy.deepcopy`` (no memo table, no type dispatch). Containers are
+    copied recursively; every other value is returned by reference,
+    which is only safe because scalars in an API document (str/int/
+    float/bool/None) are immutable — anything else would not survive a
+    real apiserver round trip either. The in-memory apiserver (fake.py)
+    and the cached readers (cache.py, informer.py) ride on this: it is
+    the dominant per-call cost of the control plane at 256-node pool
+    sizes (ISSUE 4)."""
+    t = type(obj)
+    if t is dict:
+        return {k: deep_copy_json(v) for k, v in obj.items()}
+    if t is list:
+        return [deep_copy_json(v) for v in obj]
+    return obj
+
+
 def _ensure(d: MutableMapping[str, Any], key: str) -> dict[str, Any]:
     if key not in d or d[key] is None:
         d[key] = {}
@@ -97,13 +115,19 @@ class KubeObject:
 
     @property
     def owner_references(self) -> list[dict[str, Any]]:
-        return _ensure_list(self.metadata, "ownerReferences")
+        # Non-inserting read: a refless object must not grow an empty
+        # ``ownerReferences`` list just by being LOOKED at — zero-copy
+        # snapshot reads (FakeCluster.list_peek / Informer.list(copy=
+        # False)) hand out frozen store dicts, and a lazy insert there
+        # would mutate the store outside its lock. Mutators go through
+        # add_owner_reference, which ensures the live list explicitly.
+        return self.metadata.get("ownerReferences") or []
 
     def owned_by(self, owner: "KubeObject") -> bool:
         return any(ref.get("uid") == owner.uid for ref in self.owner_references)
 
     def add_owner_reference(self, owner: "KubeObject", controller: bool = True) -> None:
-        self.owner_references.append(
+        _ensure_list(self.metadata, "ownerReferences").append(
             {
                 "apiVersion": owner.raw.get("apiVersion", ""),
                 "kind": owner.raw.get("kind", ""),
@@ -197,7 +221,9 @@ class Node(KubeObject):
 
     @property
     def unschedulable(self) -> bool:
-        return bool(self.spec.get("unschedulable", False))
+        # Non-inserting read (see KubeObject.owner_references): safe on
+        # frozen zero-copy snapshot objects.
+        return bool((self.raw.get("spec") or {}).get("unschedulable", False))
 
     @unschedulable.setter
     def unschedulable(self, value: bool) -> None:
@@ -206,7 +232,7 @@ class Node(KubeObject):
     def is_ready(self) -> bool:
         """Node readiness; an absent Ready condition counts as ready
         (reference: pkg/upgrade/common_manager.go:656-663)."""
-        status = condition_status(self.status, "Ready")
+        status = condition_status(self.raw.get("status") or {}, "Ready")
         return status is None or status == "True"
 
     def set_ready(self, ready: bool) -> None:
@@ -222,7 +248,9 @@ class Pod(KubeObject):
 
     @property
     def node_name(self) -> str:
-        return self.spec.get("nodeName", "")
+        # Non-inserting read (see KubeObject.owner_references): safe on
+        # frozen zero-copy snapshot objects.
+        return (self.raw.get("spec") or {}).get("nodeName", "")
 
     @node_name.setter
     def node_name(self, value: str) -> None:
@@ -230,14 +258,18 @@ class Pod(KubeObject):
 
     @property
     def phase(self) -> str:
-        return self.status.get("phase", "")
+        return (self.raw.get("status") or {}).get("phase", "")
 
     @phase.setter
     def phase(self, value: str) -> None:
         self.status["phase"] = value
 
     def is_ready(self) -> bool:
-        return self.phase == "Running" and condition_status(self.status, "Ready") == "True"
+        return (
+            self.phase == "Running"
+            and condition_status(self.raw.get("status") or {}, "Ready")
+            == "True"
+        )
 
     def is_finished(self) -> bool:
         return self.phase in ("Succeeded", "Failed")
@@ -256,21 +288,26 @@ class Pod(KubeObject):
 
     def has_empty_dir(self) -> bool:
         return any(
-            "emptyDir" in (vol or {}) for vol in self.spec.get("volumes") or []
+            "emptyDir" in (vol or {})
+            for vol in (self.raw.get("spec") or {}).get("volumes") or []
         )
 
     @property
     def container_statuses(self) -> list[dict[str, Any]]:
-        return self.status.get("containerStatuses") or []
+        return (self.raw.get("status") or {}).get("containerStatuses") or []
 
     @property
     def init_container_statuses(self) -> list[dict[str, Any]]:
-        return self.status.get("initContainerStatuses") or []
+        return (self.raw.get("status") or {}).get(
+            "initContainerStatuses"
+        ) or []
 
     def controller_revision_hash(self) -> str:
         """DaemonSet rollout hash from the pod-template label
         (reference: pkg/upgrade/pod_manager.go:84-89)."""
-        return self.labels.get("controller-revision-hash", "")
+        return (self.metadata.get("labels") or {}).get(
+            "controller-revision-hash", ""
+        )
 
 
 class DaemonSet(KubeObject):
@@ -279,7 +316,11 @@ class DaemonSet(KubeObject):
 
     @property
     def match_labels(self) -> dict[str, str]:
-        return (self.spec.get("selector") or {}).get("matchLabels") or {}
+        # Non-inserting read (see KubeObject.owner_references): safe on
+        # frozen zero-copy snapshot objects.
+        return ((self.raw.get("spec") or {}).get("selector") or {}).get(
+            "matchLabels"
+        ) or {}
 
     @match_labels.setter
     def match_labels(self, value: Mapping[str, str]) -> None:
@@ -287,7 +328,12 @@ class DaemonSet(KubeObject):
 
     @property
     def desired_number_scheduled(self) -> int:
-        return int(self.status.get("desiredNumberScheduled", 0))
+        # Non-inserting read: build_state evaluates this on zero-copy
+        # snapshot DaemonSets; a status-less DS must not grow a
+        # ``status: {}`` inside the fake's frozen store.
+        return int(
+            (self.raw.get("status") or {}).get("desiredNumberScheduled", 0)
+        )
 
     @desired_number_scheduled.setter
     def desired_number_scheduled(self, value: int) -> None:
@@ -311,7 +357,11 @@ class ControllerRevision(KubeObject):
         self.raw["revision"] = int(value)
 
     def hash_label(self) -> str:
-        return self.labels.get("controller-revision-hash", "")
+        # Non-inserting read: ControllerRevisions are served zero-copy by
+        # the snapshot sources.
+        return (self.metadata.get("labels") or {}).get(
+            "controller-revision-hash", ""
+        )
 
 
 class Event(KubeObject):
@@ -328,7 +378,7 @@ class Service(KubeObject):
 
     @property
     def cluster_ip(self) -> str:
-        return self.spec.get("clusterIP", "")
+        return (self.raw.get("spec") or {}).get("clusterIP", "")
 
     def is_headless(self) -> bool:
         return self.cluster_ip == "None"

@@ -85,11 +85,13 @@ class DaemonSetSimulator:
         return f"{self.ds.name}-{node_name}"
 
     def step(self) -> None:
-        nodes = self.cluster.list("Node")
+        # The read-only fast paths: a kubelet tick at 256 nodes must not
+        # deep-copy the whole pool just to check which pods exist.
+        nodes = self.cluster.object_names("Node")
         desired = 0
-        for node in nodes:
+        for node_name in nodes:
             desired += 1
-            self._ensure_pod(node.name)
+            self._ensure_pod(node_name)
         # Readiness BEFORE safe-load: an unblocked init container's driver
         # load must take its >=1 tick for real (the readiness counter it
         # arms below is first decremented on the NEXT tick), so observers
@@ -112,11 +114,8 @@ class DaemonSetSimulator:
 
     def _ensure_pod(self, node_name: str) -> None:
         name = self.pod_name(node_name)
-        try:
-            self.cluster.get("Pod", name, self.namespace)
+        if self.cluster.contains("Pod", name, self.namespace):
             return
-        except NotFoundError:
-            pass
         pod = Pod.new(name, namespace=self.namespace)
         pod.node_name = node_name
         pod.labels.update(self.ds.match_labels)
@@ -224,14 +223,13 @@ class DaemonSetSimulator:
 
     # -- assertions helpers ------------------------------------------------
     def all_pods_ready_and_current(self) -> bool:
-        nodes = self.cluster.list("Node")
-        for node in nodes:
-            try:
-                pod = Pod(
-                    self.cluster.get("Pod", self.pod_name(node.name), self.namespace).raw
-                )
-            except NotFoundError:
+        for node_name in self.cluster.object_names("Node"):
+            raw = self.cluster.peek(
+                "Pod", self.pod_name(node_name), self.namespace
+            )
+            if raw is None:
                 return False
+            pod = Pod(raw)  # peek contract: read-only view, never mutated
             if pod.labels.get("controller-revision-hash") != self.current_hash:
                 return False
             if not pod.is_ready():

@@ -18,9 +18,15 @@ from .common_manager import (
     NodeUpgradeState,
 )
 from .inplace import InplaceNodeStateManager, ProcessNodeStateManager
+from .snapshot import (
+    ClientSnapshotSource,
+    InformerSnapshotSource,
+    SnapshotSource,
+)
 from .state_manager import (
     BuildStateError,
     ClusterUpgradeStateManager,
+    PassStats,
     StateOptions,
 )
 from .requestor import (
@@ -40,8 +46,12 @@ __all__ = [
     "enable_requestor_mode",
     "requestor_id_predicate",
     "BuildStateError",
+    "ClientSnapshotSource",
     "ClusterUpgradeState",
     "ClusterUpgradeStateManager",
+    "InformerSnapshotSource",
+    "PassStats",
+    "SnapshotSource",
     "CommonUpgradeManager",
     "InplaceNodeStateManager",
     "NodeUpgradeState",

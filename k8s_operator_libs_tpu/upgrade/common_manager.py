@@ -12,6 +12,8 @@ from collections import defaultdict
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
+from typing import Callable, TypeVar
+
 from ..api.upgrade_v1alpha1 import (
     DrainSpec,
     PodDeletionSpec,
@@ -33,9 +35,12 @@ from .drain_manager import DrainConfiguration, DrainManager
 from .pod_manager import PodManager, PodManagerConfig
 from .safe_driver_load import SafeDriverLoadManager
 from .state_provider import NodeUpgradeStateProvider
+from .task_runner import TaskRunner
 from .validation_manager import ValidationManager
 
 log = get_logger("upgrade.common")
+
+T = TypeVar("T")
 
 
 @dataclass
@@ -78,6 +83,8 @@ class CommonUpgradeManager:
         validation_manager: ValidationManager,
         safe_load_manager: SafeDriverLoadManager,
         recorder=None,
+        runner: Optional[TaskRunner] = None,
+        apply_width: Optional[int] = None,
     ) -> None:
         self.client = client
         self.provider = state_provider
@@ -88,6 +95,13 @@ class CommonUpgradeManager:
         self.validation_manager = validation_manager
         self.safe_load_manager = safe_load_manager
         self.recorder = recorder
+        #: Joined bounded fan-out for per-state buckets. Direct
+        #: constructions that pass no runner get an inline one — same
+        #: serial execution as the old per-node loops, same error
+        #: accounting as the orchestrator path (TaskRunner counts
+        #: isolated bucket failures).
+        self.runner = runner if runner is not None else TaskRunner(inline=True)
+        self.apply_width = apply_width
         self.pod_deletion_enabled = False
         self.validation_enabled = False
         #: Reference parity default (common_manager.go:714-731): nodes in
@@ -220,13 +234,47 @@ class CommonUpgradeManager:
     # ------------------------------------------------------------------
     # Per-state processors
     # ------------------------------------------------------------------
+    def _for_each(
+        self,
+        what: str,
+        items: Sequence[T],
+        key: Callable[[T], str],
+        fn: Callable[[T], None],
+    ) -> None:
+        """Run a per-state bucket with bounded fan-out and per-node error
+        isolation: every node's work runs (one failure cannot shadow the
+        rest of the bucket), the bucket JOINS, failures are counted for
+        PassStats — and then the FIRST failure is re-raised, preserving
+        the reference's error-aborts-pass contract at the pass level
+        (upgrade_state.go:166-170) while the bucket itself completed.
+        Isolated failures are counted by the runner
+        (TaskRunner.bucket_failures), which PassStats diffs per pass."""
+        tasks = [
+            (key(item), (lambda item=item: fn(item))) for item in items
+        ]
+        if not tasks:
+            return
+        errors = self.runner.run_bucket(tasks, width=self.apply_width)
+        failures = [
+            (tasks[i][0], e) for i, e in enumerate(errors) if e is not None
+        ]
+        if not failures:
+            return
+        names = ", ".join(k for k, _ in failures)
+        log.error(
+            "%s: %d/%d nodes failed (%s); aborting pass after bucket",
+            what, len(failures), len(tasks), names,
+        )
+        raise failures[0][1]
+
     def process_done_or_unknown_nodes(
         self, state: ClusterUpgradeState, bucket: UpgradeState
     ) -> None:
         """Classify unknown/done nodes: out-of-sync pod, safe-load wait or
         explicit request ⇒ upgrade-required (recording the initial cordon
         state); in-sync unknown ⇒ done (reference: :229-291)."""
-        for ns in state.nodes_in(bucket):
+
+        def classify(ns: NodeUpgradeState) -> None:
             synced, orphaned = self.pod_in_sync_with_ds(ns)
             upgrade_requested = self.is_upgrade_requested(ns.node)
             waiting_safe_load = self.safe_load_manager.is_waiting_for_safe_driver_load(
@@ -245,18 +293,48 @@ class CommonUpgradeManager:
                     ns.node, UpgradeState.UPGRADE_REQUIRED
                 )
                 log.info("node %s requires upgrade", ns.node.name)
-                continue
+                return
             if bucket == UpgradeState.UNKNOWN:
                 self.provider.change_node_upgrade_state(ns.node, UpgradeState.DONE)
                 log.info("node %s moved unknown -> done", ns.node.name)
 
+        self._for_each(
+            f"classify[{bucket or 'unknown'}]",
+            state.nodes_in(bucket),
+            lambda ns: ns.node.name,
+            classify,
+        )
+
     def process_cordon_required_nodes(self, state: ClusterUpgradeState) -> None:
         """(reference: :361-380)"""
-        for ns in state.nodes_in(UpgradeState.CORDON_REQUIRED):
+
+        def cordon(ns: NodeUpgradeState) -> None:
             self.cordon_manager.cordon(ns.node)
             self.provider.change_node_upgrade_state(
                 ns.node, UpgradeState.WAIT_FOR_JOBS_REQUIRED
             )
+
+        self._for_each(
+            "cordon",
+            state.nodes_in(UpgradeState.CORDON_REQUIRED),
+            lambda ns: ns.node.name,
+            cordon,
+        )
+
+    def _advance_all(
+        self, what: str, nodes: Sequence[Node], next_state: UpgradeState
+    ) -> None:
+        """Bulk state advance for a skipped stage (feature disabled / no
+        spec): fanned out like any bucket — each transition is a PATCH +
+        read-back, the pass's real write cost."""
+        self._for_each(
+            f"advance[{what}]",
+            nodes,
+            lambda node: node.name,
+            lambda node: self.provider.change_node_upgrade_state(
+                node, next_state
+            ),
+        )
 
     def process_wait_for_jobs_required_nodes(
         self,
@@ -271,8 +349,7 @@ class CommonUpgradeManager:
                 if self.pod_deletion_enabled
                 else UpgradeState.DRAIN_REQUIRED
             )
-            for node in nodes:
-                self.provider.change_node_upgrade_state(node, next_state)
+            self._advance_all("wait-for-jobs", nodes, next_state)
             return
         if not nodes:
             return
@@ -289,10 +366,9 @@ class CommonUpgradeManager:
         """(reference: :424-453)"""
         nodes = [ns.node for ns in state.nodes_in(UpgradeState.POD_DELETION_REQUIRED)]
         if not self.pod_deletion_enabled:
-            for node in nodes:
-                self.provider.change_node_upgrade_state(
-                    node, UpgradeState.DRAIN_REQUIRED
-                )
+            self._advance_all(
+                "pod-deletion", nodes, UpgradeState.DRAIN_REQUIRED
+            )
             return
         if not nodes:
             return
@@ -310,10 +386,9 @@ class CommonUpgradeManager:
         """(reference: :329-357)"""
         nodes = [ns.node for ns in state.nodes_in(UpgradeState.DRAIN_REQUIRED)]
         if drain_spec is None or not drain_spec.enable:
-            for node in nodes:
-                self.provider.change_node_upgrade_state(
-                    node, UpgradeState.POD_RESTART_REQUIRED
-                )
+            self._advance_all(
+                "drain", nodes, UpgradeState.POD_RESTART_REQUIRED
+            )
             return
         if not nodes:
             return
@@ -326,17 +401,20 @@ class CommonUpgradeManager:
         in-sync+Ready nodes; fail repeatedly-restarting pods
         (reference: :457-524)."""
         pods_to_restart: list[Pod] = []
-        for ns in state.nodes_in(UpgradeState.POD_RESTART_REQUIRED):
+
+        def advance(ns: NodeUpgradeState) -> None:
             synced, orphaned = self.pod_in_sync_with_ds(ns)
             if not synced or orphaned:
                 if ns.driver_pod.deletion_timestamp is None:
+                    # list.append is atomic; entries are drained only
+                    # after the bucket joins.
                     pods_to_restart.append(ns.driver_pod)
-                continue
+                return
             self.safe_load_manager.unblock_loading(ns.node)
             if self.is_driver_pod_in_sync(ns):
                 if not self.validation_enabled:
                     self.update_node_to_uncordon_or_done_state(ns)
-                    continue
+                    return
                 self.provider.change_node_upgrade_state(
                     ns.node, UpgradeState.VALIDATION_REQUIRED
                 )
@@ -348,6 +426,13 @@ class CommonUpgradeManager:
                 self.provider.change_node_upgrade_state(
                     ns.node, UpgradeState.FAILED
                 )
+
+        self._for_each(
+            "pod-restart",
+            state.nodes_in(UpgradeState.POD_RESTART_REQUIRED),
+            lambda ns: ns.node.name,
+            advance,
+        )
         self.pod_manager.schedule_pods_restart(pods_to_restart)
 
     def process_upgrade_failed_nodes(self, state: ClusterUpgradeState) -> None:
@@ -367,9 +452,9 @@ class CommonUpgradeManager:
         validation-required ↔ upgrade-failed, cordoned, until repaired or
         an operator intervenes (docs/automatic-libtpu-upgrade.md runbook).
         """
-        for ns in state.nodes_in(UpgradeState.FAILED):
+        def recover(ns: NodeUpgradeState) -> None:
             if not self.is_driver_pod_in_sync(ns):
-                continue
+                return
             if (
                 self.validation_enabled
                 and self.keys.validation_failed_annotation
@@ -382,7 +467,7 @@ class CommonUpgradeManager:
                 self.provider.change_node_upgrade_state(
                     ns.node, UpgradeState.VALIDATION_REQUIRED
                 )
-                continue
+                return
             new_state = UpgradeState.UNCORDON_REQUIRED
             if self.keys.initial_state_annotation in ns.node.annotations:
                 new_state = UpgradeState.DONE
@@ -392,8 +477,20 @@ class CommonUpgradeManager:
                     ns.node, self.keys.initial_state_annotation, NULL_STRING
                 )
 
+        self._for_each(
+            "failed-recovery",
+            state.nodes_in(UpgradeState.FAILED),
+            lambda ns: ns.node.name,
+            recover,
+        )
+
     def process_validation_required_nodes(self, state: ClusterUpgradeState) -> None:
-        """(reference: :573-604)"""
+        """(reference: :573-604)
+
+        Deliberately serial: validation hooks can be device-bound (the
+        ICI health gate runs collectives on the probe devices) and the
+        slice-scoped gate memoizes per-slice results — concurrent hook
+        invocations would race the devices for no read/write-path win."""
         for ns in state.nodes_in(UpgradeState.VALIDATION_REQUIRED):
             # The driver may have restarted after reaching this state and be
             # blocked on safe load again (reference: :578-585).
@@ -451,10 +548,14 @@ class CommonUpgradeManager:
     def get_pods_owned_by_ds(
         self, ds: DaemonSet, pods: Sequence[Pod]
     ) -> list[Pod]:
+        # The truthiness guard (not just is_orphaned_pod) keeps a refless
+        # pod from raising IndexError even when a subclass loosens the
+        # orphan classification.
         return [
             p
             for p in pods
             if not self.is_orphaned_pod(p)
+            and p.owner_references
             and p.owner_references[0].get("uid") == ds.uid
         ]
 

@@ -94,10 +94,19 @@ class InplaceNodeStateManager:
     def process_uncordon_required_nodes(self, state: ClusterUpgradeState) -> None:
         """Uncordon and finish (reference: upgrade_inplace.go:124-147).
         Nodes handled by requestor mode are skipped — their uncordon flow
-        owns completion."""
+        owns completion. Fanned out through the common bucket runner:
+        per-node uncordon+done is independent work."""
         common = self.common
-        for ns in state.nodes_in(UpgradeState.UNCORDON_REQUIRED):
+
+        def release(ns) -> None:
             if common.is_node_in_requestor_mode(ns.node):
-                continue
+                return
             common.cordon_manager.uncordon(ns.node)
             common.provider.change_node_upgrade_state(ns.node, UpgradeState.DONE)
+
+        common._for_each(
+            "uncordon",
+            state.nodes_in(UpgradeState.UNCORDON_REQUIRED),
+            lambda ns: ns.node.name,
+            release,
+        )

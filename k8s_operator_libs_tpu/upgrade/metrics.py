@@ -72,6 +72,29 @@ _GAUGES = [
      "get_upgrades_pending"),
 ]
 
+#: Per-pass phase gauges read off the orchestrator's ``last_pass_stats``
+#: (state_manager.PassStats): (metric suffix, help text, attribute).
+_PASS_GAUGES = [
+    ("pass_snapshot_seconds",
+     "Wall-clock of the last build_state snapshot phase", "snapshot_s"),
+    ("pass_apply_seconds",
+     "Wall-clock of the last apply_state phase", "apply_s"),
+    ("pass_snapshot_cached",
+     "1 when the last snapshot came from informer-backed stores",
+     "snapshot_cached"),
+    ("pass_reads_issued",
+     "Client read calls issued by the last snapshot", "reads_issued"),
+    ("pass_writes_issued",
+     "State/annotation patches issued during the last apply",
+     "writes_issued"),
+    ("pass_writes_skipped",
+     "No-op patches coalesced away during the last apply",
+     "writes_skipped"),
+    ("pass_node_errors",
+     "Per-node failures isolated inside buckets during the last apply",
+     "node_errors"),
+]
+
 
 class UpgradeMetrics:
     """Snapshot-driven gauges + a monotonic reconcile counter.
@@ -85,7 +108,7 @@ class UpgradeMetrics:
         self._manager = manager
         self._device = device_label or manager.keys.device.name
         self._lock = threading.Lock()
-        self._values: dict[str, int] = {}
+        self._values: dict[str, "int | float"] = {}
         self._reconcile_passes = 0
         #: Entry-order tickets for observe(): values are computed outside
         #: the lock, so two concurrent observes can reach the commit in
@@ -112,6 +135,18 @@ class UpgradeMetrics:
             suffix: getattr(self._manager, accessor)(state)
             for suffix, _, accessor in _GAUGES
         }
+        # Phase accounting rides along when the manager records it (the
+        # orchestrator does; bare CommonUpgradeManager doubles don't).
+        pass_stats = getattr(self._manager, "last_pass_stats", None)
+        if pass_stats is not None:
+            for suffix, _, attr in _PASS_GAUGES:
+                raw = getattr(pass_stats, attr, 0)
+                if isinstance(raw, bool):
+                    values[suffix] = int(raw)
+                elif isinstance(raw, float):
+                    values[suffix] = round(raw, 6)
+                else:
+                    values[suffix] = raw
         with self._lock:
             self._reconcile_passes += 1
             if ticket > self._committed:
@@ -125,6 +160,13 @@ class UpgradeMetrics:
                 (suffix, "gauge", help_text, self._values.get(suffix, 0))
                 for suffix, help_text, _ in _GAUGES
             ]
+            # Phase gauges only once a pass recorded them — an exporter
+            # over a bare manager double stays byte-stable.
+            rows.extend(
+                (suffix, "gauge", help_text, self._values[suffix])
+                for suffix, help_text, _ in _PASS_GAUGES
+                if suffix in self._values
+            )
             rows.append(
                 ("reconcile_passes_total", "counter",
                  "Reconcile passes observed", self._reconcile_passes)

@@ -6,6 +6,7 @@ Parity: reference pkg/upgrade/pod_manager.go:53-422.
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass
 from typing import Callable, Optional, Sequence
@@ -53,6 +54,7 @@ class PodManager:
         pod_deletion_filter: Optional[PodDeletionFilter] = None,
         runner: Optional[TaskRunner] = None,
         recorder=None,
+        apply_width: Optional[int] = None,
     ) -> None:
         self._client = client
         self._provider = state_provider
@@ -60,6 +62,41 @@ class PodManager:
         self._filter = pod_deletion_filter
         self._runner = runner if runner is not None else TaskRunner()
         self._recorder = recorder
+        self._apply_width = apply_width
+        # DaemonSet rollout-hash memo: uid -> (resourceVersion, hash).
+        # Every pod_in_sync_with_ds call used to LIST ControllerRevisions
+        # — one list PER NODE per pass, the write-path twin of the
+        # build_state N+1. The DS resourceVersion keys the entry, and the
+        # orchestrator clears the memo at each build_state
+        # (reset_pass_caches), making it strictly PASS-scoped: a rollout
+        # that lands as a new ControllerRevision without any DS write (so
+        # the DS rv alone would not invalidate) is picked up next pass.
+        self._ds_hash_lock = threading.Lock()
+        self._ds_hash_cache: dict[str, tuple[str, str]] = {}
+        #: When the orchestrator wires an informer-backed snapshot source
+        #: (state_manager.with_snapshot_from_informers), revision reads
+        #: serve from its local store instead of a client LIST — set via
+        #: plain attribute so a pod-manager swap (with_pod_deletion_enabled)
+        #: can carry it over.
+        self.revision_source = None
+
+    def reset_pass_caches(self) -> None:
+        """Drop per-pass memoization; the orchestrator calls this at the
+        top of every snapshot so no cached value outlives one pass."""
+        with self._ds_hash_lock:
+            self._ds_hash_cache.clear()
+
+    def _join_bucket(
+        self, tasks: Sequence[tuple[str, Callable[[], None]]]
+    ) -> None:
+        """Joined bounded fan-out with per-task error isolation, then the
+        first failure aborts the pass — the same bucket contract as
+        CommonUpgradeManager._for_each (the runner counts isolated
+        failures for PassStats)."""
+        errors = self._runner.run_bucket(tasks, width=self._apply_width)
+        for error in errors:
+            if error is not None:
+                raise error
 
     @property
     def pod_deletion_filter(self) -> Optional[PodDeletionFilter]:
@@ -67,7 +104,9 @@ class PodManager:
 
     # -- revision-hash sync (reference: :84-118) ---------------------------
     def get_pod_controller_revision_hash(self, pod: Pod) -> str:
-        hash_value = pod.labels.get(POD_CONTROLLER_REVISION_HASH_LABEL, "")
+        # Non-inserting label read — pods here are zero-copy snapshot
+        # references; ``pod.labels`` would lazily insert into the store.
+        hash_value = pod.controller_revision_hash()
         if not hash_value:
             raise RevisionHashError(
                 f"controller-revision-hash label not present for pod {pod.name}"
@@ -76,22 +115,41 @@ class PodManager:
 
     def get_daemonset_controller_revision_hash(self, daemonset: DaemonSet) -> str:
         """Latest rollout hash: list the DaemonSet's ControllerRevisions,
-        take the highest revision, strip the ``<ds-name>-`` prefix."""
-        revisions = [
-            ControllerRevision(o.raw)
-            for o in self._client.list(
-                "ControllerRevision",
-                namespace=daemonset.namespace,
-                label_selector=daemonset.match_labels,
+        take the highest revision, strip the ``<ds-name>-`` prefix.
+        Memoized per DS resourceVersion (see ``_ds_hash_cache``); errors
+        are never cached."""
+        uid, rv = daemonset.uid, daemonset.resource_version
+        if uid and rv:
+            with self._ds_hash_lock:
+                hit = self._ds_hash_cache.get(uid)
+            if hit is not None and hit[0] == rv:
+                return hit[1]
+        if self.revision_source is not None:
+            candidates = self.revision_source.controller_revisions(
+                daemonset.namespace, daemonset.match_labels
             )
-            if o.name.startswith(daemonset.name)
+        else:
+            candidates = [
+                ControllerRevision(o.raw)
+                for o in self._client.list(
+                    "ControllerRevision",
+                    namespace=daemonset.namespace,
+                    label_selector=daemonset.match_labels,
+                )
+            ]
+        revisions = [
+            cr for cr in candidates if cr.name.startswith(daemonset.name)
         ]
         if not revisions:
             raise RevisionHashError(
                 f"no revision found for daemonset {daemonset.name}"
             )
         latest = max(revisions, key=lambda r: r.revision)
-        return latest.name.removeprefix(f"{daemonset.name}-")
+        hash_value = latest.name.removeprefix(f"{daemonset.name}-")
+        if uid and rv:
+            with self._ds_hash_lock:
+                self._ds_hash_cache[uid] = (rv, hash_value)
+        return hash_value
 
     # -- workload eviction (reference: :122-229) ---------------------------
     def schedule_pod_eviction(self, config: PodManagerConfig) -> None:
@@ -140,7 +198,7 @@ class PodManager:
         try:
             for pod in eligible:
                 self._client.evict(pod.name, pod.namespace)
-            self._wait_pods_gone(eligible, spec.timeout_seconds)
+            waited_s = self._wait_pods_gone(eligible, spec.timeout_seconds)
         except (DrainError, TimeoutError) as e:
             log.error("failed to delete pods on node %s: %s", node.name, e)
             self._event(
@@ -149,7 +207,10 @@ class PodManager:
             )
             self._update_node_to_drain_or_failed(node, config.drain_enabled)
             return
-        log.info("deleted %d pods on node %s", len(eligible), node.name)
+        log.info(
+            "deleted %d pods on node %s (waited %.3fs for termination)",
+            len(eligible), node.name, waited_s,
+        )
         self._event(
             node, "Normal",
             "Deleted workload pods on the node for the driver upgrade",
@@ -160,9 +221,17 @@ class PodManager:
 
     def _wait_pods_gone(
         self, pods: Sequence[Pod], timeout_seconds: int, poll: float = 0.05
-    ) -> None:
-        deadline = time.monotonic() + timeout_seconds if timeout_seconds else None
+    ) -> float:
+        """Wait for evicted pods to disappear; returns total wait seconds.
+
+        Exponential backoff starting at ``poll/16`` and capped at the old
+        fixed ``poll`` interval: fast kubelets are noticed in a couple of
+        milliseconds instead of always paying the full tick, slow ones
+        converge to the previous polling cost."""
+        start = time.monotonic()
+        deadline = start + timeout_seconds if timeout_seconds else None
         remaining = {(p.namespace, p.name) for p in pods}
+        delay = poll / 16
         while remaining:
             remaining = {
                 (ns, name)
@@ -170,12 +239,14 @@ class PodManager:
                 if self._client.get_or_none("Pod", name, ns) is not None
             }
             if not remaining:
-                return
+                break
             if deadline is not None and time.monotonic() > deadline:
                 raise TimeoutError(
                     f"{len(remaining)} pods still present after {timeout_seconds}s"
                 )
-            time.sleep(poll)
+            time.sleep(delay)
+            delay = min(delay * 2, poll)
+        return time.monotonic() - start
 
     def _update_node_to_drain_or_failed(
         self, node: Node, drain_enabled: bool
@@ -198,21 +269,31 @@ class PodManager:
     # -- driver pod restart (reference: :233-251) --------------------------
     def schedule_pods_restart(self, pods: Sequence[Pod]) -> None:
         """Delete driver pods so their DaemonSet recreates them at the new
-        revision. Synchronous and fail-fast, as in the reference."""
+        revision. Synchronous (joined before return) as in the reference,
+        but fanned out with per-pod error isolation: every delete is
+        attempted, then the first failure aborts the pass."""
         if not pods:
             log.info("no pods scheduled to restart")
             return
-        for pod in pods:
+
+        def restart(pod: Pod) -> None:
             log.info("deleting pod %s/%s", pod.namespace, pod.name)
             try:
                 self._client.delete("Pod", pod.name, pod.namespace)
             except NotFoundError:
-                continue  # already gone — restart goal achieved
+                return  # already gone — restart goal achieved
             except Exception as e:
                 self._event(
                     pod, "Warning", f"Failed to restart driver pod {e}"
                 )
                 raise
+
+        self._join_bucket(
+            [
+                (f"{pod.namespace}/{pod.name}", (lambda pod=pod: restart(pod)))
+                for pod in pods
+            ]
+        )
 
     # -- completion waits (reference: :256-317) ----------------------------
     def schedule_check_on_pod_completion(self, config: PodManagerConfig) -> None:
@@ -224,7 +305,8 @@ class PodManager:
         if config.wait_for_completion_spec is None:
             raise ValueError("wait-for-completion spec should not be empty")
         spec = config.wait_for_completion_spec
-        for node in config.nodes:
+
+        def check(node: Node) -> None:
             pods = self.list_pods(
                 selector=spec.pod_selector, node_name=node.name
             )
@@ -235,7 +317,7 @@ class PodManager:
                     self.handle_timeout_on_pod_completions(
                         node, spec.timeout_seconds
                     )
-                continue
+                return
             self._provider.change_node_upgrade_annotation(
                 node,
                 self._keys.wait_for_pod_completion_start_annotation,
@@ -244,6 +326,13 @@ class PodManager:
             self._provider.change_node_upgrade_state(
                 node, UpgradeState.POD_DELETION_REQUIRED
             )
+
+        self._join_bucket(
+            [
+                (node.name, (lambda node=node: check(node)))
+                for node in config.nodes
+            ]
+        )
 
     def handle_timeout_on_pod_completions(
         self, node: Node, timeout_seconds: int

@@ -1,0 +1,331 @@
+"""SnapshotSource — where ``build_state`` gets its point-in-time cluster view.
+
+The reference controller is stateless-per-pass (upgrade_state.go:49-52),
+which historically made every reconcile pass pay the full read cost: two
+LISTs (driver DaemonSets + pods) and then **one GET per node** through the
+state provider — O(pool) apiserver round trips per pass, the N+1 pattern
+that caps large-pool reconcile throughput (see PAPERS.md on scalable
+node-health control planes). This module turns the read path into a
+pluggable source with two implementations:
+
+* :class:`ClientSnapshotSource` — the fallback when no informer runs.
+  Still stateless, but the per-node GETs collapse into ONE bulk node
+  LIST: exactly 3 client reads per pass regardless of pool size.
+* :class:`InformerSnapshotSource` — Node/Pod/DaemonSet informers
+  (list-once + watch, optional resync as the self-heal safety net) serve
+  every snapshot from local stores: O(watch-delta) apiserver traffic,
+  zero reads on the reconcile hot path. The provider's write-through
+  (``NodeUpgradeStateProvider.set_write_through``) lands every state
+  write in the store immediately, so the next pass reads its own writes
+  even before the watch echoes them.
+
+Staleness semantics: an informer snapshot is exactly as stale as a
+controller-runtime cached client — at most one watch-delivery behind,
+bounded by ``resync_period_s``. ``build_state``'s completeness invariant
+(BuildStateError on desired/scheduled mismatch) is the guard: a stale
+view aborts the pass and the next one retries, the same contract the
+reference documents for its cache. docs/reconcile-data-path.md walks the
+whole data path.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Mapping, Optional, Protocol
+
+from ..kube.client import Client
+from ..kube.informer import Informer
+from ..kube.objects import (
+    ControllerRevision,
+    DaemonSet,
+    KubeObject,
+    Node,
+    Pod,
+)
+from ..utils.log import get_logger
+
+log = get_logger("upgrade.snapshot")
+
+#: Default informer resync period — the safety net re-list cadence.
+DEFAULT_RESYNC_PERIOD_S = 300.0
+
+
+class SnapshotSource(Protocol):
+    """Read surface ``build_state`` consumes. ``cached`` tells the
+    orchestrator (and its metrics) whether reads hit a local store."""
+
+    cached: bool
+
+    def daemonsets(
+        self, namespace: str, labels: Mapping[str, str]
+    ) -> list[DaemonSet]: ...
+
+    def pods(
+        self, namespace: str, labels: Mapping[str, str]
+    ) -> list[Pod]: ...
+
+    def nodes(self) -> dict[str, Node]: ...
+
+    def controller_revisions(
+        self, namespace: str, labels: Mapping[str, str]
+    ) -> list[ControllerRevision]:
+        """The DS rollout-hash read (pod_manager revision sync)."""
+        ...
+
+    def consume_reads(self) -> int:
+        """Client read calls issued since the last call — per-pass
+        accounting for UpgradeMetrics."""
+        ...
+
+
+class ClientSnapshotSource:
+    """Fallback LIST path: 3 reads per snapshot, pool-size independent.
+
+    ``node_reader`` is the (possibly cached) reader the provider also
+    uses, preserving the pre-source read topology: DaemonSets/Pods from
+    the writing client, nodes from the reader.
+    """
+
+    cached = False
+
+    def __init__(self, client: Client, node_reader: Optional[Client] = None):
+        self._client = client
+        self._node_reader = node_reader if node_reader is not None else client
+        self._reads_lock = threading.Lock()
+        self._reads = 0
+        # Zero-copy bulk reads when the backend offers them: FakeCluster's
+        # copy-on-write store freezes stored dicts, so ``list_peek``
+        # serves consistent read-only references — one whole-object copy
+        # saved per pod/DS/revision per pass. Only for kinds the managers
+        # never mutate; nodes stay on list() (the provider writes labels
+        # back and cordon flips unschedulable on State's node objects).
+        # RestClient has no peek — decoded JSON is already private.
+        self._list_refs = getattr(client, "list_peek", None)
+
+    def _count(self, n: int = 1) -> None:
+        with self._reads_lock:
+            self._reads += n
+
+    def consume_reads(self) -> int:
+        with self._reads_lock:
+            reads, self._reads = self._reads, 0
+            return reads
+
+    def daemonsets(
+        self, namespace: str, labels: Mapping[str, str]
+    ) -> list[DaemonSet]:
+        self._count()
+        if self._list_refs is not None:
+            return [
+                DaemonSet(d)
+                for d in self._list_refs(
+                    "DaemonSet",
+                    namespace=namespace,
+                    label_selector=dict(labels),
+                )
+            ]
+        return [
+            DaemonSet(o.raw)
+            for o in self._client.list(
+                "DaemonSet", namespace=namespace, label_selector=dict(labels)
+            )
+        ]
+
+    def pods(self, namespace: str, labels: Mapping[str, str]) -> list[Pod]:
+        self._count()
+        if self._list_refs is not None:
+            return [
+                Pod(d)
+                for d in self._list_refs(
+                    "Pod", namespace=namespace, label_selector=dict(labels)
+                )
+            ]
+        return [
+            Pod(o.raw)
+            for o in self._client.list(
+                "Pod", namespace=namespace, label_selector=dict(labels)
+            )
+        ]
+
+    def nodes(self) -> dict[str, Node]:
+        self._count()
+        return {
+            o.name: Node(o.raw) for o in self._node_reader.list("Node")
+        }
+
+    def controller_revisions(
+        self, namespace: str, labels: Mapping[str, str]
+    ) -> list[ControllerRevision]:
+        self._count()
+        if self._list_refs is not None:
+            return [
+                ControllerRevision(d)
+                for d in self._list_refs(
+                    "ControllerRevision",
+                    namespace=namespace,
+                    label_selector=dict(labels),
+                )
+            ]
+        return [
+            ControllerRevision(o.raw)
+            for o in self._client.list(
+                "ControllerRevision",
+                namespace=namespace,
+                label_selector=dict(labels),
+            )
+        ]
+
+
+class InformerSnapshotSource:
+    """Informer-backed snapshots: list once, watch forever, resync as the
+    safety net; every ``build_state`` is then a local-store read.
+
+    Owns three informers (Node cluster-wide; Pod and DaemonSet scoped to
+    the driver namespace + labels). :meth:`record_write` is the provider
+    write-through target — route it via
+    ``provider.set_write_through(source.record_write)`` (the orchestrator's
+    ``with_snapshot_from_informers`` does both).
+    """
+
+    cached = True
+
+    def __init__(
+        self,
+        client: Client,
+        namespace: str,
+        driver_labels: Mapping[str, str],
+        resync_period_s: float = DEFAULT_RESYNC_PERIOD_S,
+    ) -> None:
+        self._client = client
+        self.namespace = namespace
+        self.driver_labels = dict(driver_labels)
+        self._informers: dict[str, Informer] = {
+            "Node": Informer(
+                client, "Node", resync_period_s=resync_period_s
+            ),
+            "Pod": Informer(
+                client,
+                "Pod",
+                namespace=namespace,
+                label_selector=self.driver_labels,
+                resync_period_s=resync_period_s,
+            ),
+            "DaemonSet": Informer(
+                client,
+                "DaemonSet",
+                namespace=namespace,
+                label_selector=self.driver_labels,
+                resync_period_s=resync_period_s,
+            ),
+            # The DS rollout hash is read every pass (revision sync); an
+            # uncached path here would put one LIST per pass back on the
+            # reconcile loop. Watched unselected within the namespace:
+            # ControllerRevisions carry the DS's match_labels, which may
+            # differ from the driver labels — controller_revisions()
+            # applies the caller's selector at read time.
+            "ControllerRevision": Informer(
+                client,
+                "ControllerRevision",
+                namespace=namespace,
+                resync_period_s=resync_period_s,
+            ),
+        }
+        self._started = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self, sync_timeout: float = 30.0) -> "InformerSnapshotSource":
+        """Start all informers and block until their initial lists have
+        populated the stores — a snapshot taken before sync would be
+        empty, not stale."""
+        for informer in self._informers.values():
+            if not informer.started:
+                informer.start()
+        for kind, informer in self._informers.items():
+            if not informer.wait_for_sync(timeout=sync_timeout):
+                self.stop()
+                raise TimeoutError(
+                    f"{kind} informer did not sync within {sync_timeout}s"
+                )
+        self._started = True
+        return self
+
+    def stop(self) -> None:
+        for informer in self._informers.values():
+            if informer.started:
+                informer.stop()
+        self._started = False
+
+    def __enter__(self) -> "InformerSnapshotSource":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    @property
+    def started(self) -> bool:
+        return self._started
+
+    def informer(self, kind: str) -> Informer:
+        """The underlying informer for ``kind`` ("Node" | "Pod" |
+        "DaemonSet" | "ControllerRevision") — consumers hang their
+        reconcile-trigger handlers off these instead of running
+        duplicate watches (see examples/upgrade_controller.py --watch)."""
+        return self._informers[kind]
+
+    # -- provider write-through --------------------------------------------
+    def record_write(self, obj: KubeObject) -> None:
+        """Land a write result in the matching informer store so the next
+        snapshot reads it (read-your-writes), without waiting on the
+        watch echo. Unknown kinds are ignored — the provider only writes
+        Nodes today, but the routing is kind-keyed on purpose."""
+        informer = self._informers.get(obj.raw.get("kind", ""))
+        if informer is not None:
+            informer.record_write(obj)
+
+    # -- SnapshotSource ----------------------------------------------------
+    def consume_reads(self) -> int:
+        return 0  # store reads; the informers' own lists are off-pass
+
+    def daemonsets(
+        self, namespace: str, labels: Mapping[str, str]
+    ) -> list[DaemonSet]:
+        # copy=False: read-only store references for kinds the managers
+        # never mutate (see ClientSnapshotSource._list_refs); nodes below
+        # keep the defensive copy — State's node objects get written to.
+        self._check_scope(namespace, labels)
+        return [
+            DaemonSet(o.raw)
+            for o in self._informers["DaemonSet"].list(copy=False)
+        ]
+
+    def pods(self, namespace: str, labels: Mapping[str, str]) -> list[Pod]:
+        self._check_scope(namespace, labels)
+        return [Pod(o.raw) for o in self._informers["Pod"].list(copy=False)]
+
+    def nodes(self) -> dict[str, Node]:
+        return {o.name: Node(o.raw) for o in self._informers["Node"].list()}
+
+    def controller_revisions(
+        self, namespace: str, labels: Mapping[str, str]
+    ) -> list[ControllerRevision]:
+        if namespace != self.namespace:
+            raise ValueError(
+                f"snapshot source is scoped to namespace={self.namespace!r}; "
+                f"got namespace={namespace!r}"
+            )
+        return [
+            ControllerRevision(o.raw)
+            for o in self._informers["ControllerRevision"].list(
+                label_selector=dict(labels), copy=False
+            )
+        ]
+
+    def _check_scope(self, namespace: str, labels: Mapping[str, str]) -> None:
+        """The informers were scoped at construction; serving a snapshot
+        for a DIFFERENT scope would silently return the wrong objects."""
+        if namespace != self.namespace or dict(labels) != self.driver_labels:
+            raise ValueError(
+                "snapshot source is scoped to "
+                f"namespace={self.namespace!r} labels={self.driver_labels!r}; "
+                f"got namespace={namespace!r} labels={dict(labels)!r}"
+            )

@@ -5,16 +5,31 @@ a point-in-time snapshot of driver DaemonSets/pods/nodes; ``apply_state``
 runs one stateless, idempotent pass of the state machine — any error aborts
 the pass and the next reconcile resumes from the node labels
 (reference: upgrade_state.go:49-52, 166-170).
+
+Read/write topology (this framework's deviation from the reference's
+O(pool)-per-pass cost; docs/reconcile-data-path.md):
+
+* reads go through a pluggable :class:`~.snapshot.SnapshotSource` — bulk
+  LISTs by default, informer-backed stores via
+  :meth:`ClusterUpgradeStateManager.with_snapshot_from_informers`;
+* per-state buckets in ``apply_state`` fan out through the TaskRunner with
+  bounded width (``StateOptions.apply_width``) and per-node error
+  isolation — a bucket always runs to completion, then the pass aborts
+  with the first captured error (preserving the reference's
+  error-aborts-pass contract without letting one node shadow a bucket);
+* each pass's phase timings and read/write counts land in
+  :class:`PassStats` (``last_pass_stats``), exported by UpgradeMetrics.
 """
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from typing import Mapping, Optional
 
 from ..api.upgrade_v1alpha1 import DriverUpgradePolicySpec
 from ..kube.client import Client
-from ..kube.objects import DaemonSet, Pod
+from ..kube.objects import DaemonSet, Node, Pod
 from ..utils.log import get_logger
 from .common_manager import (
     ClusterUpgradeState,
@@ -27,6 +42,11 @@ from .drain_manager import DrainManager
 from .inplace import InplaceNodeStateManager, ProcessNodeStateManager
 from .pod_manager import PodDeletionFilter, PodManager
 from .safe_driver_load import SafeDriverLoadManager
+from .snapshot import (
+    ClientSnapshotSource,
+    InformerSnapshotSource,
+    SnapshotSource,
+)
 from .state_provider import NodeUpgradeStateProvider
 from .task_runner import TaskRunner
 from .validation_manager import ValidationHook, ValidationManager
@@ -46,6 +66,32 @@ class StateOptions:
     values."""
 
     use_maintenance_operator: bool = False
+    #: Bounded fan-out width for per-state buckets in ``apply_state``
+    #: (cordon, wait-for-jobs, pod-deletion scheduling, uncordon, ...).
+    #: 1 = fully serial; the runner's inline mode is serial regardless.
+    apply_width: int = 8
+
+
+@dataclass
+class PassStats:
+    """Per-pass phase accounting: where one reconcile pass spent its time
+    and API budget. ``build_state`` opens a fresh record; ``apply_state``
+    completes it. Exported by :class:`~.metrics.UpgradeMetrics`."""
+
+    #: Wall-clock of the snapshot (build_state) / apply phases.
+    snapshot_s: float = 0.0
+    apply_s: float = 0.0
+    #: True when the snapshot came from informer-backed local stores.
+    snapshot_cached: bool = False
+    #: Client read calls the snapshot issued (0 on the cached path).
+    reads_issued: int = 0
+    #: Provider PATCHes issued vs coalesced away as no-ops during apply.
+    #: Approximate under fire-and-forget drain/eviction tasks, whose
+    #: late writes land in whichever pass is open when they finish.
+    writes_issued: int = 0
+    writes_skipped: int = 0
+    #: Per-node failures isolated inside buckets this pass.
+    node_errors: int = 0
 
 
 class ClusterUpgradeStateManager:
@@ -60,6 +106,7 @@ class ClusterUpgradeStateManager:
         options: Optional[StateOptions] = None,
         runner: Optional[TaskRunner] = None,
         requestor: Optional[ProcessNodeStateManager] = None,
+        snapshot_source: Optional[SnapshotSource] = None,
     ) -> None:
         self.keys = UpgradeKeys(device)
         self.options = options or StateOptions()
@@ -68,6 +115,7 @@ class ClusterUpgradeStateManager:
             client, self.keys, reader=reader, recorder=recorder
         )
         self.provider = provider
+        width = self.options.apply_width
         self.common = CommonUpgradeManager(
             client=client,
             state_provider=provider,
@@ -77,19 +125,51 @@ class ClusterUpgradeStateManager:
                 client, provider, self.keys, runner=runner, recorder=recorder
             ),
             pod_manager=PodManager(
-                client, provider, self.keys, runner=runner, recorder=recorder
+                client, provider, self.keys, runner=runner, recorder=recorder,
+                apply_width=width,
             ),
             validation_manager=ValidationManager(
                 client, provider, self.keys, recorder=recorder
             ),
             safe_load_manager=SafeDriverLoadManager(provider, self.keys),
             recorder=recorder,
+            runner=runner,
+            apply_width=width,
         )
         self.client = client
         self.recorder = recorder
         self.runner = runner
+        self.snapshot_source: SnapshotSource = (
+            snapshot_source
+            if snapshot_source is not None
+            else ClientSnapshotSource(client, node_reader=reader)
+        )
+        self.last_pass_stats = PassStats()
         self.inplace: ProcessNodeStateManager = InplaceNodeStateManager(self.common)
         self.requestor: Optional[ProcessNodeStateManager] = requestor
+
+    def with_snapshot_from_informers(
+        self,
+        namespace: str,
+        driver_labels: Mapping[str, str],
+        resync_period_s: Optional[float] = None,
+        sync_timeout: float = 30.0,
+    ) -> InformerSnapshotSource:
+        """Switch ``build_state`` onto informer-backed stores (list-once +
+        watch + resync) and wire the provider's write-through so each pass
+        reads its own writes. Starts the informers and blocks until their
+        initial lists sync; returns the source (caller owns ``stop()``)."""
+        kwargs = {}
+        if resync_period_s is not None:
+            kwargs["resync_period_s"] = resync_period_s
+        source = InformerSnapshotSource(
+            self.client, namespace, driver_labels, **kwargs
+        )
+        source.start(sync_timeout=sync_timeout)
+        self.snapshot_source = source
+        self.provider.set_write_through(source.record_write)
+        self.common.pod_manager.revision_source = source
+        return source
 
     # ------------------------------------------------------------------
     # Optional-state configuration (reference: upgrade_state.go:329-350)
@@ -100,6 +180,7 @@ class ClusterUpgradeStateManager:
         if pod_deletion_filter is None:
             log.warning("cannot enable pod deletion: filter is None")
             return self
+        revision_source = self.common.pod_manager.revision_source
         self.common.pod_manager = PodManager(
             self.client,
             self.provider,
@@ -107,7 +188,9 @@ class ClusterUpgradeStateManager:
             pod_deletion_filter=pod_deletion_filter,
             runner=self.runner,
             recorder=self.recorder,
+            apply_width=self.options.apply_width,
         )
+        self.common.pod_manager.revision_source = revision_source
         self.common.pod_deletion_enabled = True
         return self
 
@@ -176,16 +259,23 @@ class ClusterUpgradeStateManager:
     def build_state(
         self, namespace: str, driver_labels: Mapping[str, str]
     ) -> ClusterUpgradeState:
+        start = time.perf_counter()
+        source = self.snapshot_source
+        source.consume_reads()  # drop reads accrued outside a pass
+        # One pass = one memo lifetime (the DS revision-hash cache must
+        # not survive into a pass that may follow a rollout). Duck-typed:
+        # injected pod-manager doubles (testing/mocks.py) may not memoize.
+        reset = getattr(self.common.pod_manager, "reset_pass_caches", None)
+        if callable(reset):
+            reset()
+        stats = PassStats(snapshot_cached=source.cached)
+        self.last_pass_stats = stats
         state = ClusterUpgradeState()
-        daemonsets = self.common.get_driver_daemonsets(
-            namespace, dict(driver_labels)
-        )
-        pods = [
-            Pod(o.raw)
-            for o in self.client.list(
-                "Pod", namespace=namespace, label_selector=dict(driver_labels)
-            )
-        ]
+        daemonsets = {
+            ds.uid: ds
+            for ds in source.daemonsets(namespace, dict(driver_labels))
+        }
+        pods = source.pods(namespace, dict(driver_labels))
         selected: list[Pod] = []
         for ds in daemonsets.values():
             ds_pods = self.common.get_pods_owned_by_ds(ds, pods)
@@ -201,23 +291,38 @@ class ClusterUpgradeStateManager:
             selected.extend(ds_pods)
         selected.extend(self.common.get_orphaned_pods(pods))
 
+        # ONE bulk node read for the whole snapshot — never a GET per pod
+        # (the N+1 pattern this source layer exists to kill).
+        nodes = source.nodes()
         for pod in selected:
             if not pod.node_name and pod.phase == "Pending":
                 log.info("driver pod %s has no node yet, skipping", pod.name)
                 continue
             owner = None
             if not self.common.is_orphaned_pod(pod):
-                owner = daemonsets.get(pod.owner_references[0].get("uid"))
-            ns = self._build_node_upgrade_state(pod, owner)
+                refs = pod.owner_references
+                # Guarded: a pod that dodges the orphan classification
+                # with empty/refless metadata must degrade to ownerless,
+                # not abort the pass with an IndexError.
+                owner = daemonsets.get(refs[0].get("uid")) if refs else None
+            ns = self._build_node_upgrade_state(
+                pod, owner, node=nodes.get(pod.node_name)
+            )
             bucket = self.provider.get_upgrade_state(ns.node)
             state.node_states[bucket].append(ns)
+        stats.reads_issued = source.consume_reads()
+        stats.snapshot_s = time.perf_counter() - start
         return state
 
     def _build_node_upgrade_state(
-        self, pod: Pod, ds: Optional[DaemonSet]
+        self, pod: Pod, ds: Optional[DaemonSet], node: Optional[Node] = None
     ) -> NodeUpgradeState:
-        """(reference: upgrade_state.go:352-378)"""
-        node = self.provider.get_node(pod.node_name)
+        """(reference: upgrade_state.go:352-378). ``node`` comes from the
+        snapshot's bulk read; the per-name GET survives only as the
+        fallback for a node the bulk read raced (just created, or a
+        cached store one delivery behind)."""
+        if node is None:
+            node = self.provider.get_node(pod.node_name)
         maintenance = None
         if self.options.use_maintenance_operator and self.requestor is not None:
             get_nm = getattr(self.requestor, "get_node_maintenance_obj", None)
@@ -252,24 +357,35 @@ class ClusterUpgradeStateManager:
             },
         )
         common = self.common
-        common.process_done_or_unknown_nodes(state, UpgradeState.UNKNOWN)
-        common.process_done_or_unknown_nodes(state, UpgradeState.DONE)
-        self._process_upgrade_required_nodes(state, policy)
-        common.process_cordon_required_nodes(state)
-        common.process_wait_for_jobs_required_nodes(
-            state, policy.wait_for_completion
-        )
-        drain_enabled = policy.drain is not None and policy.drain.enable
-        common.process_pod_deletion_required_nodes(
-            state, policy.pod_deletion, drain_enabled
-        )
-        common.process_drain_nodes(state, policy.drain)
-        self._process_node_maintenance_required_nodes(state)
-        self._process_post_maintenance_required_nodes(state)
-        common.process_pod_restart_nodes(state)
-        common.process_upgrade_failed_nodes(state)
-        common.process_validation_required_nodes(state)
-        self._process_uncordon_required_nodes(state)
+        stats = self.last_pass_stats
+        start = time.perf_counter()
+        issued_before, skipped_before = self.provider.write_counts()
+        errors_before = self.runner.bucket_failures
+        try:
+            common.process_done_or_unknown_nodes(state, UpgradeState.UNKNOWN)
+            common.process_done_or_unknown_nodes(state, UpgradeState.DONE)
+            self._process_upgrade_required_nodes(state, policy)
+            common.process_cordon_required_nodes(state)
+            common.process_wait_for_jobs_required_nodes(
+                state, policy.wait_for_completion
+            )
+            drain_enabled = policy.drain is not None and policy.drain.enable
+            common.process_pod_deletion_required_nodes(
+                state, policy.pod_deletion, drain_enabled
+            )
+            common.process_drain_nodes(state, policy.drain)
+            self._process_node_maintenance_required_nodes(state)
+            self._process_post_maintenance_required_nodes(state)
+            common.process_pod_restart_nodes(state)
+            common.process_upgrade_failed_nodes(state)
+            common.process_validation_required_nodes(state)
+            self._process_uncordon_required_nodes(state)
+        finally:
+            issued_after, skipped_after = self.provider.write_counts()
+            stats.writes_issued = issued_after - issued_before
+            stats.writes_skipped = skipped_after - skipped_before
+            stats.node_errors = self.runner.bucket_failures - errors_before
+            stats.apply_s = time.perf_counter() - start
         log.info("state manager finished processing")
 
     # -- mode dispatch (reference: upgrade_state.go:287-325) ---------------

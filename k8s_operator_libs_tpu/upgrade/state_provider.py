@@ -19,14 +19,29 @@ invariants hold:
 
 Deleting an annotation is requested by writing the value ``"null"``, which
 becomes a JSON ``null`` in the merge patch (reference: :138-216).
+
+Two write-path optimizations on top of the reference shape (both pinned by
+tests/test_concurrent_apply.py):
+
+* **No-op coalescing** — when the in-memory node already holds the target
+  label/annotation value, the PATCH (and its read-back wait) is skipped
+  entirely. The provider is the single writer of these keys, so the
+  snapshot value is authoritative; re-writing it would only burn an API
+  round trip per node per pass (the safe-load unblock does exactly that
+  for every pod-restart/validation node). Skips are counted.
+* **Write-through** — an optional hook receives every patched object, so
+  an informer-backed snapshot store observes the provider's own writes
+  immediately instead of waiting on the watch (read-your-writes for the
+  next ``build_state``; see upgrade/snapshot.py).
 """
 
 from __future__ import annotations
 
-from typing import Optional, Protocol, Union
+import threading
+from typing import Callable, Optional, Protocol, Union
 
 from ..kube.client import Client
-from ..kube.objects import Node
+from ..kube.objects import KubeObject, Node
 from ..utils.log import get_logger
 from ..utils.sync import KeyedMutex
 from .consts import NULL_STRING, UpgradeKeys, UpgradeState
@@ -62,6 +77,41 @@ class NodeUpgradeStateProvider:
         self._recorder = recorder
         self._timeout = cache_sync_timeout
         self._mutex = KeyedMutex()
+        self._write_through: Optional[Callable[[KubeObject], None]] = None
+        self._counter_lock = threading.Lock()
+        self._writes_issued = 0
+        self._writes_skipped = 0
+
+    # -- write accounting / snapshot wiring --------------------------------
+    def set_write_through(
+        self, fn: Optional[Callable[[KubeObject], None]]
+    ) -> None:
+        """Install a hook called (under the node's keyed mutex) with every
+        patched object — the informer-backed snapshot store's
+        read-your-writes path."""
+        self._write_through = fn
+
+    @property
+    def writes_issued(self) -> int:
+        with self._counter_lock:
+            return self._writes_issued
+
+    @property
+    def writes_skipped(self) -> int:
+        with self._counter_lock:
+            return self._writes_skipped
+
+    def write_counts(self) -> tuple[int, int]:
+        """(issued, skipped) in one consistent read — per-pass deltas."""
+        with self._counter_lock:
+            return self._writes_issued, self._writes_skipped
+
+    def _count_write(self, skipped: bool) -> None:
+        with self._counter_lock:
+            if skipped:
+                self._writes_skipped += 1
+            else:
+                self._writes_issued += 1
 
     # -- reads -------------------------------------------------------------
     def get_node(self, name: str) -> Node:
@@ -88,21 +138,32 @@ class NodeUpgradeStateProvider:
         new_state = UpgradeState(new_state)
         value: Optional[str] = str(new_state) if new_state != UpgradeState.UNKNOWN else None
         with self._mutex.locked(node.name):
+            if node.labels.get(self._keys.state_label) == value:
+                # No-op coalescing: the label already holds the target
+                # value (None == absent). The provider is the single
+                # writer of this key, so the in-memory node is
+                # authoritative — skip the PATCH and its read-back wait.
+                self._count_write(skipped=True)
+                return
             # Strategic merge patch, matching the reference's label write
             # (node_upgrade_state_provider.go:80-82); annotations below use
             # RFC 7386 merge patch (:147-150). For string-map writes the two
             # coincide — tests/test_patch_semantics.py pins the equivalence.
-            self._client.patch(
+            patched = self._client.patch(
                 "Node",
                 node.name,
                 patch={"metadata": {"labels": {self._keys.state_label: value}}},
                 patch_type="strategic",
             )
+            self._count_write(skipped=False)
+            if self._write_through is not None and patched is not None:
+                self._write_through(patched)
             self._await_visible(
                 node.name,
                 lambda n: (n.metadata.get("labels") or {}).get(self._keys.state_label)
                 == value,
                 what=f"state={new_state or '<cleared>'}",
+                result=patched,
             )
             # Keep the caller's in-memory object coherent with what was written.
             if value is None:
@@ -125,15 +186,24 @@ class NodeUpgradeStateProvider:
         cache visibility (reference: :138-216)."""
         patch_value: Optional[str] = None if value == NULL_STRING else value
         with self._mutex.locked(node.name):
-            self._client.patch(
+            if node.annotations.get(key) == patch_value:
+                # No-op coalescing: deleting an absent key or re-writing
+                # the held value — skip the PATCH (see the label path).
+                self._count_write(skipped=True)
+                return
+            patched = self._client.patch(
                 "Node",
                 node.name,
                 patch={"metadata": {"annotations": {key: patch_value}}},
             )
+            self._count_write(skipped=False)
+            if self._write_through is not None and patched is not None:
+                self._write_through(patched)
             self._await_visible(
                 node.name,
                 lambda n: (n.metadata.get("annotations") or {}).get(key) == patch_value,
                 what=f"annotation {key}={value}",
+                result=patched,
             )
             if patch_value is None:
                 node.annotations.pop(key, None)
@@ -150,19 +220,40 @@ class NodeUpgradeStateProvider:
             )
 
     # -- internals ---------------------------------------------------------
-    def _await_visible(self, node_name: str, predicate, what: str) -> None:
-        def check(reader: Client) -> bool:
-            obj = reader.get_or_none("Node", node_name)
-            return obj is not None and predicate(obj)
-
+    def _await_visible(
+        self, node_name: str, predicate, what: str, result=None
+    ) -> None:
+        # When the reader IS the writing client there is no cache that
+        # could lag: the patch RESPONSE is the authoritative post-write
+        # object, and checking it is strictly stronger than re-reading
+        # (it verifies what the write actually produced, without paying
+        # another round trip per state transition).
+        if result is not None and self._reader is self._client:
+            if not predicate(result):
+                raise StateWriteError(
+                    f"write of {what} on node {node_name} did not produce "
+                    "the expected value (patch response disagrees)"
+                )
+            return
         # Duck-typed: any reader exposing wait_until(predicate, timeout)
         # (e.g. CachedClient, or a production watch-cache wrapper) gets a
         # bounded wait; plain clients are read-your-writes already.
         wait_until = getattr(self._reader, "wait_until", None)
         if callable(wait_until):
+            def check(reader: Client) -> bool:
+                # Absence is legitimate mid-lag state on a caching
+                # reader (our write simply hasn't synced yet) — swallow
+                # it and keep waiting for the sync.
+                obj = reader.get_or_none("Node", node_name)
+                return obj is not None and predicate(obj)
+
             ok = wait_until(check, timeout=self._timeout)
         else:
-            ok = check(self._reader)
+            # On a plain reader a failing read-back is a REAL API
+            # condition (concurrent delete, transient server error), not
+            # cache lag: let it surface and abort the pass as any other
+            # API error does.
+            ok = predicate(self._reader.get("Node", node_name))
         if not ok:
             raise StateWriteError(
                 f"write of {what} on node {node_name} not visible in cache "

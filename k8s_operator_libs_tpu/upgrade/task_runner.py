@@ -10,13 +10,20 @@ labels by the task itself, never returned.
 ``inline=True`` executes tasks synchronously on the caller's thread — used by
 deterministic tests and by the bench's simulated clusters, where real thread
 interleaving would only add noise.
+
+:meth:`run_bucket` is the second concurrency shape: a *joined* bounded
+fan-out for the reconcile pass's per-state buckets (cordon, wait-for-jobs,
+uncordon, ...). Unlike :meth:`submit` tasks, bucket work completes before
+the pass moves to the next state processor, preserving cross-bucket
+ordering; within a bucket, per-node order is unspecified and one node's
+failure never prevents the others from running.
 """
 
 from __future__ import annotations
 
 import threading
 from concurrent.futures import Future, ThreadPoolExecutor
-from typing import Callable, Optional
+from typing import Callable, Optional, Sequence
 
 from ..utils.log import get_logger
 from ..utils.sync import StringSet
@@ -27,6 +34,7 @@ log = get_logger("upgrade.task_runner")
 class TaskRunner:
     def __init__(self, max_workers: int = 16, inline: bool = False) -> None:
         self._inline = inline
+        self._max_workers = max_workers
         self._in_progress = StringSet()
         self._executor: Optional[ThreadPoolExecutor] = None
         if not inline:
@@ -35,10 +43,94 @@ class TaskRunner:
             )
         self._futures_lock = threading.Lock()
         self._futures: set[Future] = set()
+        self._bucket_stats_lock = threading.Lock()
+        self._bucket_failures = 0
+        # Lazily-created persistent pool for run_bucket (separate from
+        # the fire-and-forget executor so queued drain/eviction tasks
+        # can never starve a joined bucket): ~10 buckets run per
+        # reconcile pass, and spawning/joining OS threads per bucket
+        # would put pure churn on the hot path.
+        self._bucket_executor: Optional[ThreadPoolExecutor] = None
+        self._bucket_executor_lock = threading.Lock()
 
     @property
     def inline(self) -> bool:
         return self._inline
+
+    @property
+    def max_workers(self) -> int:
+        return self._max_workers
+
+    @property
+    def bucket_failures(self) -> int:
+        """Cumulative per-task failures isolated by run_bucket — the ONE
+        counter PassStats.node_errors diffs, wherever the bucket ran
+        (common manager processors, pod manager restarts/checks)."""
+        with self._bucket_stats_lock:
+            return self._bucket_failures
+
+    def run_bucket(
+        self,
+        tasks: Sequence[tuple[str, Callable[[], None]]],
+        width: Optional[int] = None,
+    ) -> list[Optional[Exception]]:
+        """Run keyed per-node tasks with bounded concurrency and JOIN
+        before returning.
+
+        Per-node error isolation: a task's exception is captured (and
+        logged) instead of aborting the bucket, so one bad node cannot
+        shadow the others' transitions. Returns per-task exceptions in
+        input order (None = success); the caller decides whether the
+        pass as a whole still aborts.
+
+        ``width`` bounds concurrent tasks (default: the runner's
+        ``max_workers``). Inline runners — and width 1 — run serially on
+        the caller's thread, keeping deterministic tests deterministic.
+        The in-progress dedup set is NOT consulted: bucket work is
+        joined, so a second reconcile pass cannot overlap it the way
+        fire-and-forget :meth:`submit` tasks can.
+        """
+        tasks = list(tasks)
+        results: list[Optional[Exception]] = [None] * len(tasks)
+
+        def guarded(index: int, key: str, fn: Callable[[], None]) -> None:
+            try:
+                fn()
+            except Exception as e:  # isolation: collect, never bubble here
+                results[index] = e
+                with self._bucket_stats_lock:
+                    self._bucket_failures += 1
+                log.warning("bucket task %s failed: %s", key, e)
+
+        effective = self._max_workers if width is None else width
+        if self._inline or effective <= 1 or len(tasks) <= 1:
+            for i, (key, fn) in enumerate(tasks):
+                guarded(i, key, fn)
+            return results
+        # The persistent bucket pool is sized max_workers; a narrower
+        # per-call width is enforced by a semaphore (an idle worker
+        # parked on it costs nothing — run_bucket joins before
+        # returning, so nothing else wants those workers).
+        with self._bucket_executor_lock:
+            if self._bucket_executor is None:
+                self._bucket_executor = ThreadPoolExecutor(
+                    max_workers=self._max_workers,
+                    thread_name_prefix="upgrade-bucket",
+                )
+            executor = self._bucket_executor
+        gate = threading.Semaphore(min(effective, self._max_workers))
+
+        def gated(index: int, key: str, fn: Callable[[], None]) -> None:
+            with gate:
+                guarded(index, key, fn)
+
+        futures = [
+            executor.submit(gated, i, key, fn)
+            for i, (key, fn) in enumerate(tasks)
+        ]
+        for future in futures:
+            future.result()  # guarded() never raises; this is a join
+        return results
 
     def in_progress(self, key: str) -> bool:
         return self._in_progress.has(key)
@@ -92,3 +184,7 @@ class TaskRunner:
     def shutdown(self) -> None:
         if self._executor is not None:
             self._executor.shutdown(wait=True)
+        with self._bucket_executor_lock:
+            executor, self._bucket_executor = self._bucket_executor, None
+        if executor is not None:
+            executor.shutdown(wait=True)

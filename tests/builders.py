@@ -73,7 +73,9 @@ def make_pod(
         pod.add_owner_reference(owner)
         pod.labels.update(owner.match_labels)
     elif controlled:
-        pod.owner_references.append(
+        # owner_references is a non-inserting read accessor; mutate via
+        # metadata (or add_owner_reference) so the ref actually lands.
+        pod.metadata.setdefault("ownerReferences", []).append(
             {"apiVersion": "apps/v1", "kind": "ReplicaSet",
              "name": unique("rs"), "uid": str(uuid.uuid4()), "controller": True}
         )

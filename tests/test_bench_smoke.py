@@ -40,6 +40,24 @@ def test_roll_returns_phase_breakdown(slice_aware):
     assert out["disruption_windows"] == (1 if slice_aware else bench.HOSTS)
 
 
+def test_snapshot_read_bench_shapes():
+    out = bench.run_snapshot_read_bench(slices=2, hosts_per_slice=4, passes=4)
+    assert out["uncached"]["steady_reads_per_pass"] >= 3.0
+    assert out["cached"]["steady_reads_per_pass"] == 0.0
+    assert out["cached"]["seed_reads"] >= 3  # informer list-once
+    assert out["read_reduction_x"] and out["read_reduction_x"] > 1.0
+
+
+def test_apply_width_bench_same_semantics():
+    out = bench.run_apply_width_bench(
+        widths=(1, 4), slices=2, hosts_per_slice=4, lag_s=0.001
+    )
+    # Same roll at every width: identical pass counts (the semantics the
+    # width knob must not change), wall-clock reported per width.
+    assert out["width_1"]["passes"] == out["width_4"]["passes"]
+    assert out["width_1"]["wall_s"] > 0 and out["width_4"]["wall_s"] > 0
+
+
 def test_multislice_roll_invariants_hold():
     out = bench.run_multislice_roll()
     assert out["windows_equal_slices"] is True

@@ -1,0 +1,342 @@
+"""Concurrent apply: bounded bucket fan-out, per-node error isolation,
+no-op write coalescing, and width-independent roll semantics.
+
+The contract under test (ISSUE 4 tentpole, docs/reconcile-data-path.md):
+
+* a failing node no longer aborts its bucket mid-pass — every other node
+  still transitions, THEN the pass aborts with the first error (the
+  reference's error-aborts-pass shape, preserved at pass granularity);
+* a PATCH whose target label/annotation already holds the value is
+  skipped entirely — proven against the fake client's call log, not
+  inferred from counters alone;
+* a full roll produces the same per-node state-label sequence at apply
+  width 1 and width N (order within a bucket may differ; cross-bucket
+  ordering may not).
+"""
+
+import threading
+
+import pytest
+
+from k8s_operator_libs_tpu.api import DriverUpgradePolicySpec
+from k8s_operator_libs_tpu.kube import FakeCluster, Node
+from k8s_operator_libs_tpu.kube.client import ApiError
+from k8s_operator_libs_tpu.kube.sim import DaemonSetSimulator
+from k8s_operator_libs_tpu.upgrade import (
+    ClusterUpgradeStateManager,
+    DeviceClass,
+    StateOptions,
+    TaskRunner,
+    UpgradeKeys,
+    UpgradeState,
+)
+from k8s_operator_libs_tpu.upgrade.state_provider import (
+    NodeUpgradeStateProvider,
+)
+from k8s_operator_libs_tpu.utils import IntOrString
+from builders import make_node
+
+DEVICE = DeviceClass.tpu()
+KEYS = UpgradeKeys(DEVICE)
+NS = "driver-ns"
+LABELS = {"app": "driver"}
+
+POLICY = DriverUpgradePolicySpec(
+    auto_upgrade=True,
+    max_parallel_upgrades=0,
+    max_unavailable=IntOrString("100%"),
+)
+
+
+class InjectedError(ApiError):
+    """A 500-shaped failure pinned to one node."""
+
+
+def build_harness(node_count=4, runner=None, apply_width=None):
+    cluster = FakeCluster()
+    for i in range(node_count):
+        cluster.create(make_node(f"node-{i}"))
+    sim = DaemonSetSimulator(
+        cluster, name="driver", namespace=NS, match_labels=LABELS
+    )
+    sim.settle()
+    options = StateOptions()
+    if apply_width is not None:
+        options.apply_width = apply_width
+    mgr = ClusterUpgradeStateManager(
+        cluster,
+        DEVICE,
+        runner=runner or TaskRunner(inline=True),
+        options=options,
+    )
+    return cluster, sim, mgr
+
+
+def state_of(cluster, name):
+    return Node(cluster.get("Node", name).raw).labels.get(KEYS.state_label)
+
+
+class TestErrorIsolation:
+    @pytest.mark.parametrize("threaded", [False, True])
+    def test_failing_node_does_not_shadow_its_bucket(self, threaded):
+        runner = TaskRunner(max_workers=4) if threaded else TaskRunner(
+            inline=True
+        )
+        cluster, sim, mgr = build_harness(
+            node_count=4, runner=runner, apply_width=4
+        )
+        # Put every node in cordon-required directly (durable state).
+        for i in range(4):
+            node = Node(cluster.get("Node", f"node-{i}").raw)
+            mgr.provider.change_node_upgrade_state(
+                node, UpgradeState.CORDON_REQUIRED
+            )
+
+        def poison(verb, kind, payload):
+            if payload.get("name") == "node-2":
+                raise InjectedError("injected: node-2 is poisoned")
+
+        cluster.add_reactor("patch", "Node", poison)
+        with pytest.raises(ApiError):
+            mgr.apply_state(mgr.build_state(NS, LABELS), POLICY)
+        # The bucket ran to completion: every healthy node transitioned.
+        for name in ("node-0", "node-1", "node-3"):
+            assert state_of(cluster, name) == "wait-for-jobs-required", name
+        # The poisoned node kept its durable state for the next pass.
+        assert state_of(cluster, "node-2") == "cordon-required"
+        assert mgr.last_pass_stats.node_errors == 1
+        if threaded:
+            runner.shutdown()
+
+    def test_pass_error_counts_reset_per_pass(self):
+        cluster, sim, mgr = build_harness(node_count=2)
+        node = Node(cluster.get("Node", "node-0").raw)
+        mgr.provider.change_node_upgrade_state(
+            node, UpgradeState.CORDON_REQUIRED
+        )
+
+        class Once:
+            fired = False
+
+            def __call__(self, verb, kind, payload):
+                if not self.fired and payload.get("name") == "node-0":
+                    self.fired = True
+                    raise InjectedError("one-shot")
+
+        cluster.add_reactor("patch", "Node", Once())
+        with pytest.raises(ApiError):
+            mgr.apply_state(mgr.build_state(NS, LABELS), POLICY)
+        assert mgr.last_pass_stats.node_errors == 1
+        mgr.apply_state(mgr.build_state(NS, LABELS), POLICY)
+        assert mgr.last_pass_stats.node_errors == 0
+
+
+class TestNoOpCoalescing:
+    def test_rewriting_held_state_issues_no_patch(self):
+        cluster = FakeCluster()
+        cluster.create(
+            make_node("n1", labels={KEYS.state_label: "upgrade-done"})
+        )
+        provider = NodeUpgradeStateProvider(cluster, KEYS)
+        node = provider.get_node("n1")
+        log = cluster.start_call_log()
+        provider.change_node_upgrade_state(node, UpgradeState.DONE)
+        assert [c for c in log if c[0] == "patch"] == []
+        assert provider.writes_skipped == 1
+        assert provider.writes_issued == 0
+        # A REAL transition still patches.
+        provider.change_node_upgrade_state(node, UpgradeState.UNCORDON_REQUIRED)
+        assert [c for c in log if c[0] == "patch"] == [
+            ("patch", "Node", "n1")
+        ]
+        assert provider.writes_issued == 1
+        cluster.stop_call_log()
+
+    def test_deleting_absent_annotation_issues_no_patch(self):
+        cluster = FakeCluster()
+        cluster.create(make_node("n1"))
+        provider = NodeUpgradeStateProvider(cluster, KEYS)
+        node = provider.get_node("n1")
+        log = cluster.start_call_log()
+        provider.change_node_upgrade_annotation(
+            node, KEYS.initial_state_annotation, "null"
+        )
+        assert [c for c in log if c[0] == "patch"] == []
+        assert provider.writes_skipped == 1
+        # Setting a fresh value patches; re-setting it does not.
+        provider.change_node_upgrade_annotation(
+            node, KEYS.initial_state_annotation, "true"
+        )
+        provider.change_node_upgrade_annotation(
+            node, KEYS.initial_state_annotation, "true"
+        )
+        assert len([c for c in log if c[0] == "patch"]) == 1
+        assert provider.writes_skipped == 2
+        cluster.stop_call_log()
+
+    def test_steady_state_pass_is_write_free(self):
+        """Once every node is upgrade-done and in sync, a reconcile pass
+        must issue ZERO patches — the no-op coalescing guarantee the
+        256-node idle pool rides on."""
+        cluster, sim, mgr = build_harness(node_count=3)
+        for _ in range(10):
+            sim.step()
+            mgr.apply_state(mgr.build_state(NS, LABELS), POLICY)
+            sim.step()
+            if all(
+                state_of(cluster, f"node-{i}") == "upgrade-done"
+                for i in range(3)
+            ):
+                break
+        log = cluster.start_call_log()
+        mgr.apply_state(mgr.build_state(NS, LABELS), POLICY)
+        patches = [c for c in log if c[0] in ("patch", "update", "delete")]
+        assert patches == [], patches
+        assert mgr.last_pass_stats.writes_issued == 0
+        cluster.stop_call_log()
+
+
+class TestWidthSemantics:
+    def _roll(self, width, threaded):
+        runner = (
+            TaskRunner(max_workers=max(width, 1))
+            if threaded
+            else TaskRunner(inline=True)
+        )
+        cluster, sim, mgr = build_harness(
+            node_count=4, runner=runner, apply_width=width
+        )
+        transitions = {}
+        lock = threading.Lock()
+
+        def record(event, obj, old):
+            if obj.get("kind") != "Node":
+                return
+            name = obj["metadata"]["name"]
+            label = (obj["metadata"].get("labels") or {}).get(
+                KEYS.state_label
+            )
+            old_label = (
+                ((old or {}).get("metadata") or {}).get("labels") or {}
+            ).get(KEYS.state_label)
+            if label != old_label:
+                with lock:
+                    transitions.setdefault(name, []).append(label)
+
+        cluster.subscribe(record)
+        sim.set_template_hash("v2")
+        for _ in range(60):
+            sim.step()
+            mgr.apply_state(mgr.build_state(NS, LABELS), POLICY)
+            sim.step()
+            if all(
+                state_of(cluster, f"node-{i}") == "upgrade-done"
+                for i in range(4)
+            ) and sim.all_pods_ready_and_current():
+                break
+        else:
+            raise AssertionError(f"width={width} roll did not converge")
+        if threaded:
+            runner.wait_idle(timeout=10)
+            runner.shutdown()
+        return transitions
+
+    def test_terminal_sequences_identical_across_widths(self):
+        serial = self._roll(width=1, threaded=False)
+        wide = self._roll(width=4, threaded=True)
+        assert set(serial) == set(wide)
+        for name in serial:
+            assert serial[name] == wide[name], (
+                f"{name}: {serial[name]} != {wide[name]}"
+            )
+
+
+class TestWaitPodsGoneBackoff:
+    """ISSUE 4 satellite: the fixed-interval poll became exponential
+    backoff capped at the old interval, and the total wait surfaces."""
+
+    def _manager(self, cluster):
+        from k8s_operator_libs_tpu.upgrade import PodManager
+
+        provider = NodeUpgradeStateProvider(cluster, KEYS)
+        return PodManager(
+            cluster, provider, KEYS, runner=TaskRunner(inline=True)
+        )
+
+    def test_backoff_doubles_and_caps_at_old_interval(self, monkeypatch):
+        cluster = FakeCluster()
+        pod = None
+        from builders import make_pod
+
+        pod = make_pod("p1", namespace=NS, node_name="n1")
+        cluster.create(pod)
+        manager = self._manager(cluster)
+        sleeps = []
+        checks = {"n": 0}
+
+        real_get_or_none = cluster.get_or_none
+
+        def vanishing(kind, name, namespace=""):
+            checks["n"] += 1
+            if checks["n"] > 6:
+                return None
+            return real_get_or_none(kind, name, namespace)
+
+        monkeypatch.setattr(cluster, "get_or_none", vanishing)
+        monkeypatch.setattr(
+            "k8s_operator_libs_tpu.upgrade.pod_manager.time.sleep",
+            sleeps.append,
+        )
+        waited = manager._wait_pods_gone([pod], timeout_seconds=30, poll=0.08)
+        assert waited >= 0
+        assert sleeps, "never slept despite lingering pod"
+        # Starts well under the old fixed interval...
+        assert sleeps[0] == pytest.approx(0.08 / 16)
+        # ...doubles each round...
+        for earlier, later in zip(sleeps, sleeps[1:]):
+            assert later == pytest.approx(min(earlier * 2, 0.08))
+        # ...and never exceeds the old interval.
+        assert max(sleeps) <= 0.08 + 1e-9
+
+    def test_immediate_exit_when_pods_already_gone(self):
+        cluster = FakeCluster()
+        from builders import make_pod
+
+        ghost = make_pod("ghost", namespace=NS)  # never created
+        manager = self._manager(cluster)
+        waited = manager._wait_pods_gone([ghost], timeout_seconds=5)
+        assert waited < 1.0
+
+
+class TestPassStatsExport:
+    def test_metrics_render_carries_phase_gauges(self):
+        from k8s_operator_libs_tpu.upgrade import UpgradeMetrics
+
+        cluster, sim, mgr = build_harness(node_count=2)
+        sim.set_template_hash("v2")
+        sim.step()
+        state = mgr.build_state(NS, LABELS)
+        mgr.apply_state(state, POLICY)
+        metrics = UpgradeMetrics(_StatsProxy(mgr))
+        metrics.observe(state)
+        text = metrics.render()
+        assert "pass_snapshot_seconds" in text
+        assert "pass_apply_seconds" in text
+        assert "pass_writes_issued" in text
+        assert mgr.last_pass_stats.writes_issued > 0
+        assert mgr.last_pass_stats.snapshot_s > 0
+        assert mgr.last_pass_stats.reads_issued == 3  # DS + Pod + Node LIST
+
+
+class _StatsProxy:
+    """Counter accessors from the common manager + pass stats from the
+    orchestrator — the shape a consumer's metrics wiring produces."""
+
+    def __init__(self, mgr):
+        self._mgr = mgr
+        self.keys = mgr.keys
+
+    def __getattr__(self, name):
+        if name == "last_pass_stats":
+            return self._mgr.last_pass_stats
+        return getattr(self._mgr.common, name)

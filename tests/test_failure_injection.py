@@ -152,8 +152,11 @@ def drive_with_fault(cluster, sim, mgr, verb, kind, exc_type,
 
 
 #: Every (verb, kind) the state machine hits during an in-place roll.
+#: ("get", "Node") is deliberately absent since ISSUE 4: the snapshot
+#: reads nodes via ONE bulk LIST and state writes verify against the
+#: patch response, so the roll issues no per-node GETs at all — a fault
+#: point there would be a dead parameter (the suite asserts fired > 0).
 FAULT_POINTS = [
-    ("get", "Node"),
     ("patch", "Node"),
     ("list", "Node"),
     ("list", "Pod"),

@@ -1,0 +1,343 @@
+"""SnapshotSource: bulk-LIST fallback, informer-backed snapshots,
+write-through read-your-writes, and the ownerReferences guard.
+
+Read-cost pins are counted via the fake client's call log — wall-clock
+says nothing about the N+1 pattern; call counts do.
+"""
+
+import pytest
+
+from k8s_operator_libs_tpu.api import DriverUpgradePolicySpec
+from k8s_operator_libs_tpu.kube import FakeCluster, Node, Pod
+from k8s_operator_libs_tpu.kube.informer import Informer
+from k8s_operator_libs_tpu.kube.sim import DaemonSetSimulator
+from k8s_operator_libs_tpu.upgrade import (
+    BuildStateError,
+    ClientSnapshotSource,
+    ClusterUpgradeStateManager,
+    DeviceClass,
+    InformerSnapshotSource,
+    TaskRunner,
+    UpgradeKeys,
+    UpgradeState,
+)
+from k8s_operator_libs_tpu.utils import IntOrString
+from builders import make_daemonset, make_node, make_pod
+
+DEVICE = DeviceClass.tpu()
+KEYS = UpgradeKeys(DEVICE)
+NS = "driver-ns"
+LABELS = {"app": "driver"}
+
+POLICY = DriverUpgradePolicySpec(
+    auto_upgrade=True,
+    max_parallel_upgrades=0,
+    max_unavailable=IntOrString("100%"),
+)
+
+
+def build_harness(node_count=3):
+    cluster = FakeCluster()
+    for i in range(node_count):
+        cluster.create(make_node(f"node-{i}"))
+    sim = DaemonSetSimulator(
+        cluster, name="driver", namespace=NS, match_labels=LABELS
+    )
+    sim.settle()
+    mgr = ClusterUpgradeStateManager(
+        cluster, DEVICE, runner=TaskRunner(inline=True)
+    )
+    return cluster, sim, mgr
+
+
+class TestFallbackListPath:
+    def test_snapshot_is_three_lists_no_per_node_gets(self):
+        """The fallback path must collapse the old N+1 (one GET per node)
+        into exactly DS + Pod + Node LISTs, independent of pool size."""
+        cluster, sim, mgr = build_harness(node_count=5)
+        log = cluster.start_call_log()
+        mgr.build_state(NS, LABELS)
+        reads = [c for c in log if c[0] in ("get", "list")]
+        assert reads == [
+            ("list", "DaemonSet", ""),
+            ("list", "Pod", ""),
+            ("list", "Node", ""),
+        ]
+        cluster.stop_call_log()
+        assert mgr.last_pass_stats.reads_issued == 3
+        assert mgr.last_pass_stats.snapshot_cached is False
+
+    def test_build_state_buckets_match_node_labels(self):
+        cluster, sim, mgr = build_harness(node_count=3)
+        node = Node(cluster.get("Node", "node-1").raw)
+        mgr.provider.change_node_upgrade_state(
+            node, UpgradeState.CORDON_REQUIRED
+        )
+        state = mgr.build_state(NS, LABELS)
+        assert [
+            ns.node.name
+            for ns in state.nodes_in(UpgradeState.CORDON_REQUIRED)
+        ] == ["node-1"]
+        assert len(state.nodes_in(UpgradeState.UNKNOWN)) == 2
+
+    def test_completeness_invariant_preserved(self):
+        """BuildStateError on unscheduled driver pods survives the source
+        refactor (reference: upgrade_state.go:128-131)."""
+        cluster = FakeCluster()
+        ds = make_daemonset(
+            "driver", namespace=NS, match_labels=LABELS, desired=2
+        )
+        created = cluster.create(ds)
+        pod = make_pod(
+            "driver-a", namespace=NS, node_name="n1", labels=dict(LABELS)
+        )
+        pod.raw["metadata"]["ownerReferences"] = [
+            {"uid": created.uid, "controller": True}
+        ]
+        cluster.create(make_node("n1"))
+        cluster.create(pod)
+        mgr = ClusterUpgradeStateManager(
+            cluster, DEVICE, runner=TaskRunner(inline=True)
+        )
+        with pytest.raises(BuildStateError):
+            mgr.build_state(NS, LABELS)
+
+
+class TestOwnerReferencesGuard:
+    def test_pod_with_empty_owner_refs_lands_orphaned(self):
+        """Regression (ISSUE 4 satellite): a pod carrying an explicit
+        empty ownerReferences list must flow through build_state as an
+        orphan — never an IndexError that aborts the pass."""
+        cluster, sim, mgr = build_harness(node_count=2)
+        stray = make_pod(
+            "stray", namespace=NS, node_name="node-0", labels=dict(LABELS)
+        )
+        stray.raw["metadata"]["ownerReferences"] = []
+        cluster.create(stray)
+        state = mgr.build_state(NS, LABELS)  # must not raise
+        orphans = [
+            ns
+            for bucket in state.node_states.values()
+            for ns in bucket
+            if ns.driver_pod.name == "stray"
+        ]
+        assert len(orphans) == 1
+        assert orphans[0].driver_daemonset is None
+        assert orphans[0].is_orphaned_pod()
+
+    def test_guard_holds_even_if_orphan_classification_flips_mid_pass(self):
+        """The direct guard: a classifier that selects the refless pod as
+        an orphan but re-classifies it 'owned' at the owner-lookup site
+        (the inconsistent-classification shape the satellite names) — the
+        unguarded ``owner_references[0]`` raised IndexError here."""
+        cluster, sim, mgr = build_harness(node_count=2)
+        stray = make_pod(
+            "stray", namespace=NS, node_name="node-0", labels=dict(LABELS)
+        )
+        stray.raw["metadata"]["ownerReferences"] = []
+        cluster.create(stray)
+        calls = {"stray": 0}
+
+        def flaky(pod):
+            if pod.name != "stray":
+                return len(pod.owner_references) < 1
+            calls["stray"] += 1
+            # True while build_state SELECTS pods (owned-by-ds scan +
+            # orphan scan), False at the per-pod owner lookup.
+            return calls["stray"] <= 2
+
+        mgr.common.is_orphaned_pod = flaky
+        state = mgr.build_state(NS, LABELS)  # must not raise
+        strays = [
+            ns
+            for bucket in state.node_states.values()
+            for ns in bucket
+            if ns.driver_pod.name == "stray"
+        ]
+        assert strays and strays[0].driver_daemonset is None
+
+
+class TestInformerSnapshotSource:
+    def test_zero_client_reads_per_pass_once_synced(self):
+        cluster, sim, mgr = build_harness(node_count=3)
+        source = mgr.with_snapshot_from_informers(
+            NS, LABELS, resync_period_s=0.0
+        )
+        try:
+            # Settle the classify-everyone writes first.
+            mgr.apply_state(mgr.build_state(NS, LABELS), POLICY)
+            log = cluster.start_call_log()
+            state = mgr.build_state(NS, LABELS)
+            reads = [c for c in log if c[0] in ("get", "list")]
+            assert reads == [], reads
+            assert mgr.last_pass_stats.reads_issued == 0
+            assert mgr.last_pass_stats.snapshot_cached is True
+            assert sum(len(v) for v in state.node_states.values()) == 3
+            cluster.stop_call_log()
+        finally:
+            source.stop()
+
+    def test_read_your_writes_via_write_through(self):
+        """The provider's write lands in the informer store BEFORE the
+        watch echoes it: stop the informers (dead watch), write, and the
+        next snapshot must still see the write — that is the
+        write-through, isolated from watch delivery entirely."""
+        cluster, sim, mgr = build_harness(node_count=2)
+        source = mgr.with_snapshot_from_informers(
+            NS, LABELS, resync_period_s=0.0
+        )
+        source.stop()  # watch dead; only write-through can update stores
+        node = Node(cluster.get("Node", "node-0").raw)
+        mgr.provider.change_node_upgrade_state(
+            node, UpgradeState.CORDON_REQUIRED
+        )
+        snapshot_nodes = source.nodes()
+        assert (
+            snapshot_nodes["node-0"].labels[KEYS.state_label]
+            == "cordon-required"
+        )
+        state = mgr.build_state(NS, LABELS)
+        assert [
+            ns.node.name
+            for ns in state.nodes_in(UpgradeState.CORDON_REQUIRED)
+        ] == ["node-0"]
+
+    def test_record_write_ignores_stale_revision(self):
+        cluster = FakeCluster()
+        cluster.create(make_node("n1"))
+        informer = Informer(cluster, "Node")
+        fresh = cluster.get("Node", "n1")
+        informer.record_write(fresh)
+        stale = Node(
+            {
+                "kind": "Node",
+                "metadata": {
+                    "name": "n1",
+                    "resourceVersion": "0",
+                    "labels": {"poison": "true"},
+                },
+            }
+        )
+        informer.record_write(stale)
+        cached = informer.get("n1")
+        assert cached is not None
+        assert "poison" not in cached.labels
+
+    def test_scope_mismatch_is_loud(self):
+        cluster, sim, mgr = build_harness(node_count=1)
+        source = mgr.with_snapshot_from_informers(
+            NS, LABELS, resync_period_s=0.0
+        )
+        try:
+            with pytest.raises(ValueError):
+                source.pods("other-ns", LABELS)
+            with pytest.raises(ValueError):
+                source.daemonsets(NS, {"app": "other"})
+        finally:
+            source.stop()
+
+    def test_full_roll_converges_on_informer_snapshots(self):
+        """End to end: the informer-backed read path drives a complete
+        rolling upgrade to the same terminal state as the LIST path."""
+        cluster, sim, mgr = build_harness(node_count=3)
+        source = mgr.with_snapshot_from_informers(
+            NS, LABELS, resync_period_s=0.0
+        )
+        try:
+            import time
+
+            sim.set_template_hash("v2")
+            for _ in range(60):
+                sim.step()
+                time.sleep(0.01)  # let the watch threads catch up
+                try:
+                    state = mgr.build_state(NS, LABELS)
+                except BuildStateError:
+                    continue  # informer a delivery behind; next pass
+                mgr.apply_state(state, POLICY)
+                sim.step()
+                done = all(
+                    Node(o.raw).labels.get(KEYS.state_label)
+                    == "upgrade-done"
+                    for o in cluster.list("Node")
+                )
+                if done and sim.all_pods_ready_and_current():
+                    break
+            else:
+                raise AssertionError("informer-backed roll did not converge")
+        finally:
+            source.stop()
+
+
+class TestClientSourceUnit:
+    def test_consume_reads_resets(self):
+        cluster = FakeCluster()
+        cluster.create(make_node("n1"))
+        source = ClientSnapshotSource(cluster)
+        source.nodes()
+        source.nodes()
+        assert source.consume_reads() == 2
+        assert source.consume_reads() == 0
+
+    def test_informer_source_requires_sync_before_snapshots(self):
+        cluster = FakeCluster()
+        source = InformerSnapshotSource(cluster, NS, LABELS)
+        assert source.started is False
+
+
+class TestZeroCopyReadsAreNonMutating:
+    """Zero-copy snapshot reads (FakeCluster.list_peek / Informer.list(
+    copy=False)) hand out the store's own frozen dicts: every accessor
+    build_state touches on them must be non-inserting, or a mere READ
+    mutates the fake apiserver store outside its lock."""
+
+    def test_status_less_daemonset_read_does_not_grow_store(self):
+        """Regression: ``desired_number_scheduled`` routed through the
+        inserting ``status`` accessor and grew ``status: {}`` inside the
+        frozen store entry when a DS had no status subtree yet."""
+        cluster = FakeCluster()
+        ds = make_daemonset("driver", namespace=NS, match_labels=LABELS)
+        del ds.raw["status"]  # freshly created, status never written
+        cluster.create(ds)
+        frozen = cluster.list_peek("DaemonSet", namespace=NS)[0]
+        assert "status" not in frozen
+        view = type(ds)(frozen)
+        assert view.desired_number_scheduled == 0
+        assert view.match_labels == dict(LABELS)
+        assert "status" not in frozen, "read inserted status into the store"
+
+    def test_snapshot_pass_leaves_store_keysets_untouched(self):
+        """End-to-end: a full build_state over zero-copy objects must not
+        add ANY key anywhere in the stored DS/Pod dicts."""
+        cluster, sim, mgr = build_harness(node_count=2)
+
+        def keyset(kind):
+            return {
+                (o["metadata"]["name"], frozenset(o), frozenset(o["metadata"]))
+                for o in cluster.list_peek(kind, namespace=NS)
+            }
+
+        before = {k: keyset(k) for k in ("DaemonSet", "Pod")}
+        mgr.build_state(NS, LABELS)
+        after = {k: keyset(k) for k in ("DaemonSet", "Pod")}
+        assert before == after
+
+    def test_pure_read_accessors_do_not_insert(self):
+        from k8s_operator_libs_tpu.kube.objects import (
+            ControllerRevision,
+            DaemonSet,
+        )
+
+        pod = Pod({"metadata": {"name": "p"}})
+        node = Node({"metadata": {"name": "n"}})
+        ds = DaemonSet({"metadata": {"name": "d"}})
+        cr = ControllerRevision({"metadata": {"name": "c"}})
+        assert pod.controller_revision_hash() == ""
+        assert node.unschedulable is False
+        assert node.is_ready() is True
+        assert ds.desired_number_scheduled == 0
+        assert ds.match_labels == {}
+        assert cr.hash_label() == ""
+        for obj in (pod, node, ds, cr):
+            assert "status" not in obj.raw and "spec" not in obj.raw
+            assert "labels" not in obj.raw["metadata"]
