@@ -661,6 +661,171 @@ def run_snapshot_read_bench(
     return results
 
 
+def _settle_informer_pool(cluster, sim, mgr, policy, max_passes=50):
+    """Drive passes until the pool stops producing writes (and, with an
+    incremental source, until a pass is served settled) — the steady
+    state both noop sections measure from."""
+    for _ in range(max_passes):
+        sim.step()
+        mgr.apply_state(mgr.build_state(NS, DS_LABELS), policy)
+        stats = mgr.last_pass_stats
+        settled = stats.writes_issued == 0 and (
+            not stats.snapshot_incremental or stats.snapshot_skipped
+        )
+        if settled:
+            return
+        time.sleep(0.01)  # let watch echoes land before the next pass
+    raise RuntimeError("pool did not settle")
+
+
+def run_settled_pool_noop(
+    slices: int = 64, hosts_per_slice: int = 4, seconds: float = 1.0
+) -> dict:
+    """ISSUE 5 headline: reconcile throughput on a SETTLED 256-node pool,
+    full-rebuild informer source vs incremental (delta-driven) source.
+
+    Both serve reads from informer stores — the difference is pure
+    per-pass CPU: the full path re-wraps and re-classifies every node
+    every pass; the incremental path sees an empty dirty set and serves
+    the cached state untouched. Hard-asserted (a regression must fail
+    the bench, not publish false numbers): the incremental side is
+    >=10x the full-rebuild side, with ZERO client calls per measured
+    pass (via the fake's call log) and zero writes."""
+    policy = DriverUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=0,
+        max_unavailable=IntOrString("100%"),
+    )
+    out: dict = {"nodes": slices * hosts_per_slice}
+    for mode in ("full_rebuild", "incremental"):
+        cluster, sim = build_pool(
+            slices=slices, hosts_per_slice=hosts_per_slice
+        )
+        mgr = ClusterUpgradeStateManager(
+            cluster, DEVICE, runner=TaskRunner(inline=True)
+        )
+        source = mgr.with_snapshot_from_informers(
+            NS, DS_LABELS, resync_period_s=0.0,
+            incremental=(mode == "incremental"),
+        )
+        try:
+            _settle_informer_pool(cluster, sim, mgr, policy)
+            log = cluster.start_call_log()
+            passes = 0
+            start = time.perf_counter()
+            while time.perf_counter() - start < seconds:
+                mgr.apply_state(mgr.build_state(NS, DS_LABELS), policy)
+                passes += 1
+            elapsed = time.perf_counter() - start
+            client_calls = [
+                c for c in log
+                if c[0] in ("get", "list", "create", "update", "patch",
+                            "delete")
+            ]
+        finally:
+            cluster.stop_call_log()
+            source.stop()
+        stats = mgr.last_pass_stats
+        if client_calls:
+            raise RuntimeError(
+                f"settled_pool_noop[{mode}]: {len(client_calls)} client "
+                f"calls during {passes} settled passes; expected zero "
+                f"(first: {client_calls[:3]})"
+            )
+        if stats.writes_issued != 0:
+            raise RuntimeError(
+                f"settled_pool_noop[{mode}]: settled pass issued "
+                f"{stats.writes_issued} writes"
+            )
+        out[mode] = {
+            "passes_per_s": round(passes / elapsed, 1),
+            "passes": passes,
+            "client_calls_per_pass": 0.0,
+            "writes_per_pass": 0,
+            "snapshot_skipped_last_pass": bool(
+                getattr(stats, "snapshot_skipped", False)
+            ),
+        }
+    speedup = (
+        out["incremental"]["passes_per_s"]
+        / out["full_rebuild"]["passes_per_s"]
+        if out["full_rebuild"]["passes_per_s"] > 0
+        else 0.0
+    )
+    out["speedup_x"] = round(speedup, 1)
+    if speedup < 10.0:
+        raise RuntimeError(
+            f"settled_pool_noop: incremental is only {speedup:.1f}x the "
+            "full-rebuild path; the O(dirty) contract requires >=10x"
+        )
+    return out
+
+
+def run_single_event_latency(
+    slices: int = 64, hosts_per_slice: int = 4, events: int = 20
+) -> dict:
+    """One node event against a settled 256-node incremental pool:
+    end-to-end latency from the API write to a rebuilt snapshot, and the
+    proof (PassStats, hard-asserted) that exactly ONE node was
+    reclassified per event — reconcile cost scales with the change rate,
+    not the pool size."""
+    policy = DriverUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=0,
+        max_unavailable=IntOrString("100%"),
+    )
+    cluster, sim = build_pool(slices=slices, hosts_per_slice=hosts_per_slice)
+    mgr = ClusterUpgradeStateManager(
+        cluster, DEVICE, runner=TaskRunner(inline=True)
+    )
+    source = mgr.with_snapshot_from_informers(
+        NS, DS_LABELS, resync_period_s=0.0, incremental=True
+    )
+    latencies: list[float] = []
+    try:
+        _settle_informer_pool(cluster, sim, mgr, policy)
+        names = cluster.object_names("Node")
+        deadline_s = 10.0
+        for i in range(events):
+            name = names[i % len(names)]
+            raw = cluster.get("Node", name)
+            raw.raw.setdefault("metadata", {}).setdefault(
+                "annotations", {}
+            )["bench.tpu-operator.dev/poke"] = str(i)
+            start = time.perf_counter()
+            cluster.update(raw)
+            # Spin until the watch delivery lands in the dirty set, then
+            # take the snapshot — the full event->snapshot path.
+            while name not in source.dirty().nodes:
+                if time.perf_counter() - start > deadline_s:
+                    raise RuntimeError(
+                        f"single_event_latency: delivery of event {i} "
+                        f"for {name} never arrived"
+                    )
+                time.sleep(0)
+            state = mgr.build_state(NS, DS_LABELS)
+            latencies.append(time.perf_counter() - start)
+            stats = mgr.last_pass_stats
+            if stats.nodes_reclassified != 1:
+                raise RuntimeError(
+                    "single_event_latency: one node event reclassified "
+                    f"{stats.nodes_reclassified} nodes (dirty set "
+                    f"{sorted(state.dirty_nodes or [])})"
+                )
+    finally:
+        source.stop()
+    latencies.sort()
+    return {
+        "nodes": slices * hosts_per_slice,
+        "events": events,
+        "nodes_reclassified_per_event": 1,
+        "median_event_to_snapshot_ms": round(
+            statistics.median(latencies) * 1000, 3
+        ),
+        "max_event_to_snapshot_ms": round(latencies[-1] * 1000, 3),
+    }
+
+
 def run_apply_width_bench(
     widths: tuple = (1, 8),
     slices: int = 64,
@@ -838,6 +1003,8 @@ SECTIONS = {
     },
     "snapshot_reads": run_snapshot_read_bench,
     "apply_width": run_apply_width_bench,
+    "settled_pool_noop": run_settled_pool_noop,
+    "single_event_latency": run_single_event_latency,
 }
 
 
@@ -931,6 +1098,13 @@ def main() -> None:
     apply_width = run_apply_width_bench()
     _progress("apply_width")
 
+    # Incremental reconcile sections (ISSUE 5): zero-work settled passes
+    # and single-event reclassification, both at 256 nodes.
+    settled_noop = run_settled_pool_noop()
+    _progress("settled_pool_noop")
+    single_event = run_single_event_latency()
+    _progress("single_event_latency")
+
     details = {
         "backend": backend,
         # Trial counts derived from the actual result objects — never a
@@ -962,6 +1136,8 @@ def main() -> None:
         },
         "snapshot_reads": snapshot_reads,
         "apply_width": apply_width,
+        "settled_pool_noop": settled_noop,
+        "single_event_latency": single_event,
         "gate_cold_vs_warm": gate_split,
         "devices": [str(d) for d in jax.devices()],
         "calibration": calibration,
@@ -998,6 +1174,13 @@ def main() -> None:
                 "read_reduction_x"
             ],
             "apply_width_speedup_x": apply_width.get("speedup_x"),
+            "settled_noop_speedup_x": settled_noop.get("speedup_x"),
+            "settled_incremental_passes_per_s": settled_noop[
+                "incremental"
+            ]["passes_per_s"],
+            "single_event_median_ms": single_event[
+                "median_event_to_snapshot_ms"
+            ],
         },
         "metric": "v5e-16 pool libtpu rolling-upgrade wall-clock "
         "(simulated GKE pool, real ICI/MXU health gate; median of "

@@ -32,6 +32,12 @@ from k8s_operator_libs_tpu.upgrade import (
 )
 
 
+#: Whole-world workqueue key for triggers that cannot be scoped to one
+#: node (DaemonSet/ControllerRevision rollout deltas, the periodic
+#: resync fallback, an unplaceable NodeMaintenance CR).
+RESYNC_KEY = "__resync__"
+
+
 def parse_selector(raw: str) -> dict[str, str]:
     labels = {}
     for part in filter(None, raw.split(",")):
@@ -111,7 +117,18 @@ def main(argv: list[str] | None = None) -> int:
         action="store_true",
         help="reconcile on watch events (informers over Nodes, driver "
         "Pods, and NodeMaintenance CRs) instead of a fixed interval; "
-        "the interval becomes the resync fallback",
+        "the interval becomes the resync fallback. Events feed a "
+        "client-go-style rate-limited workqueue keyed per node, and the "
+        "snapshot source maintains the cluster state incrementally "
+        "(O(dirty) reconciles)",
+    )
+    parser.add_argument(
+        "--verify-every-n",
+        type=int,
+        default=0,
+        help="with --watch: every n-th reconcile cross-checks the "
+        "incremental cluster state against a full rebuild, repairing "
+        "and counting divergences (0 = off)",
     )
     parser.add_argument(
         "--once", action="store_true", help="one reconcile pass, then exit"
@@ -201,6 +218,7 @@ def main(argv: list[str] | None = None) -> int:
     informers = []
     elector = None
     metrics_server = None
+    queue = None
     try:
         device = DeviceClass.tpu() if args.device == "tpu" else DeviceClass.nvidia()
         policy = load_policy(args.policy)
@@ -301,41 +319,73 @@ def main(argv: list[str] | None = None) -> int:
                     client, namespace=args.namespace
                 )
 
-        # Watch-driven triggering: informers mark the world dirty; the loop
-        # reconciles on deltas (filtered through the requestor predicate for
-        # NodeMaintenance) and falls back to the interval as a resync — the
-        # reference's controller-runtime shape (watches + periodic requeue).
-        dirty = None
-        informers = []
+        # Watch-driven triggering: informer deltas enqueue per-node keys
+        # on a client-go-style rate-limited workqueue; the loop drains a
+        # batch per pass and falls back to the interval as a resync — the
+        # reference's controller-runtime shape (watches + workqueue +
+        # periodic requeue), with per-key exponential backoff replacing
+        # the old hand-rolled whole-loop delay.
         if args.watch and not args.demo:
-            import threading
-
             from k8s_operator_libs_tpu.kube import Informer
-            from k8s_operator_libs_tpu.upgrade import condition_changed_predicate
+            from k8s_operator_libs_tpu.kube.workqueue import (
+                RateLimitingQueue,
+                default_controller_rate_limiter,
+            )
+            from k8s_operator_libs_tpu.upgrade import (
+                IncrementalSnapshotSource,
+                condition_changed_predicate,
+            )
 
-            dirty = threading.Event()
+            queue = RateLimitingQueue(default_controller_rate_limiter())
 
-            def mark_dirty(event_type, obj, old):
-                dirty.set()
+            def enqueue_node(event_type, obj, old):
+                queue.add(obj.name)
 
-            def maintenance_dirty(event_type, obj, old):
+            def enqueue_pod_node(event_type, obj, old):
+                # Key a pod event by the node(s) it concerns — new AND
+                # old placement; a pod with no node yet wakes the world
+                # (RESYNC_KEY) so the pass still notices the incomplete
+                # snapshot.
+                names = {obj.node_name or ""}
+                if old is not None:
+                    names.add(old.node_name or "")
+                names.discard("")
+                for name in names or {RESYNC_KEY}:
+                    queue.add(name)
+
+            def enqueue_world(event_type, obj, old):
+                # DaemonSet/ControllerRevision deltas re-hash every
+                # node's sync check — whole-world key.
+                queue.add(RESYNC_KEY)
+
+            def nm_node_names(obj):
+                # NodeMaintenance CRs carry the target node in spec.
+                name = (obj.raw.get("spec") or {}).get("nodeName", "")
+                return [name] if name else []
+
+            def maintenance_enqueue(event_type, obj, old):
                 # React to condition flips/deletions only, as the reference's
                 # predicate-filtered watch does (upgrade_requestor.go:115-159).
-                if event_type != "MODIFIED" or old is None:
-                    dirty.set()
-                    return
-                if condition_changed_predicate(old.raw, obj.raw):
-                    dirty.set()
+                if (
+                    event_type != "MODIFIED"
+                    or old is None
+                    or condition_changed_predicate(old.raw, obj.raw)
+                ):
+                    for name in nm_node_names(obj) or [RESYNC_KEY]:
+                        queue.add(name)
 
-            from k8s_operator_libs_tpu.upgrade import InformerSnapshotSource
-
-            # One informer set serves BOTH roles (ISSUE 4): reconcile
-            # triggering (handlers below) and build_state snapshots
-            # (snapshot-from-cache + provider write-through) — per-pass
-            # LISTs and per-node GETs disappear from the read path
+            # One informer set serves BOTH roles (ISSUE 4/5): reconcile
+            # triggering (workqueue handlers) and build_state snapshots —
+            # now INCREMENTAL (ISSUE 5): the source maintains the cluster
+            # state from the same deltas, so a settled pool reconciles
+            # with zero reads and zero per-node CPU and a single node
+            # event reclassifies exactly one node
             # (docs/reconcile-data-path.md).
-            snapshot_source = InformerSnapshotSource(
-                client, args.namespace, selector
+            snapshot_source = IncrementalSnapshotSource(
+                client,
+                args.namespace,
+                selector,
+                verify_every_n=args.verify_every_n,
             )
             # ControllerRevision is the rollout trigger itself: a driver
             # image bump lands as a new revision — with only Node/Pod
@@ -343,12 +393,18 @@ def main(argv: list[str] | None = None) -> int:
             # roll (revision-hash sync, pod_manager.go:84-118). The
             # source watches it for the revision-sync read; the same
             # informer triggers reconciles.
-            for kind in ("Node", "Pod", "DaemonSet", "ControllerRevision"):
-                snapshot_source.informer(kind).add_event_handler(mark_dirty)
+            snapshot_source.informer("Node").add_event_handler(enqueue_node)
+            snapshot_source.informer("Pod").add_event_handler(enqueue_pod_node)
+            for kind in ("DaemonSet", "ControllerRevision"):
+                snapshot_source.informer(kind).add_event_handler(enqueue_world)
             informers = []
             if args.requestor:
                 nm_informer = Informer(client, "NodeMaintenance")
-                nm_informer.add_event_handler(maintenance_dirty)
+                nm_informer.add_event_handler(maintenance_enqueue)
+                # The incremental state must also see the unwatched-kind
+                # deltas: map each CR to its node's dirty mark (a CR the
+                # mapping cannot place degrades to a full invalidation).
+                snapshot_source.mark_dirty_on(nm_informer, nm_node_names)
                 informers.append(nm_informer)
             # Start all, THEN wait: sequential start+wait would serialize the
             # sync latency across informers.
@@ -403,14 +459,17 @@ def main(argv: list[str] | None = None) -> int:
             print("leader election: leading; starting reconciles", flush=True)
 
         return _reconcile_loop(
-            args, mgr, policy, selector, elector, dirty,
+            args, mgr, policy, selector, elector, queue,
             metrics, sim, maintenance_sim, validation_pod_sim,
         )
     finally:
         # Every exit path — convergence, --once, lease lost, SIGTERM
-        # (even mid-setup), unhandled error — stops the informers and
-        # the metrics server and releases the Lease (release is a no-op
-        # when this replica never held or no longer holds it).
+        # (even mid-setup), unhandled error — stops the informers, the
+        # workqueue, and the metrics server and releases the Lease
+        # (release is a no-op when this replica never held or no longer
+        # holds it).
+        if queue is not None:
+            queue.shutdown()
         for informer in informers:
             informer.stop()
         if metrics_server is not None:
@@ -420,12 +479,19 @@ def main(argv: list[str] | None = None) -> int:
 
 
 def _reconcile_loop(
-    args, mgr, policy, selector, elector, dirty,
+    args, mgr, policy, selector, elector, queue,
     metrics, sim, maintenance_sim, validation_pod_sim,
 ):
     passes = 0
     max_demo_passes = 100  # a 4-node roll converges in <15; 100 = stuck
     consecutive_failures = 0
+    #: Workqueue keys the CURRENT pass is reconciling (watch mode). A
+    #: whole-world pass covers every key, so one batch drain per pass;
+    #: each key gets done() after the pass, forget() on success, and
+    #: add_rate_limited() on failure — per-key exponential backoff plus
+    #: the shared 10 qps bucket, replacing the old hand-rolled
+    #: whole-loop delay.
+    keys: list = []
     while True:
         if elector is not None and not elector.is_leader():
             # controller-runtime semantics: a deposed leader must never
@@ -457,10 +523,32 @@ def _reconcile_loop(
             # (upgrade_state.go:49-52). Transient snapshot incompleteness
             # (a driver pod mid-recreate fails the unscheduled-pods guard)
             # heals by itself in a requeue or two; a PERSISTENT error (bad
-            # RBAC, wrong namespace) must not spin a tight log loop, so
-            # the requeue backs off exponentially — 0.5 s doubling to
-            # 30 s — and resets on the next successful pass.
+            # RBAC, wrong namespace) must not spin a tight log loop.
             consecutive_failures += 1
+            if queue is not None:
+                # Watch mode: re-queue this pass's keys through the rate
+                # limiter — the failing key backs off exponentially (5 ms
+                # doubling to 1000 s) while fresh events still trigger
+                # promptly; an event-less failure (first pass, or the
+                # interval fallback) re-queues the whole-world key the
+                # same way. done() ONLY on keys get_batch handed out —
+                # done() on a never-obtained key would double-queue it
+                # if an event enqueued it concurrently.
+                for key in keys:
+                    queue.add_rate_limited(key)
+                    queue.done(key)
+                if not keys:
+                    queue.add_rate_limited(RESYNC_KEY)
+                requeues = queue.num_requeues(keys[0] if keys else RESYNC_KEY)
+                print(
+                    f"pass {passes}: reconcile failed "
+                    f"(rate-limited requeue #{requeues}): {e}",
+                    file=sys.stderr,
+                )
+                keys = queue.get_batch(timeout=args.interval)
+                continue
+            # Interval mode keeps the whole-loop exponential delay —
+            # 0.5 s doubling to 30 s, reset on the next successful pass.
             # Cap the exponent BEFORE raising 2 to it: a persistent error
             # left overnight would otherwise overflow float conversion.
             delay = min(0.5 * 2 ** min(consecutive_failures - 1, 10), 30.0)
@@ -472,6 +560,12 @@ def _reconcile_loop(
             time.sleep(0.0 if sim is not None else delay)
             continue
         consecutive_failures = 0
+        if queue is not None:
+            # Success retires this pass's keys: backoff state reset, and
+            # a key re-added mid-pass is re-delivered by done().
+            for key in keys:
+                queue.forget(key)
+                queue.done(key)
         if metrics is not None:
             metrics.observe(state)
         if sim is not None:
@@ -492,10 +586,12 @@ def _reconcile_loop(
                 return 0
         if args.once:
             return 0
-        if dirty is not None:
-            # Event-triggered with the interval as the resync fallback.
-            dirty.wait(timeout=args.interval)
-            dirty.clear()
+        if queue is not None:
+            # Event-triggered: block for the first key, then drain
+            # whatever accumulated while this pass ran — one whole-world
+            # pass covers them all. An empty batch (timeout) is the
+            # periodic resync fallback: reconcile anyway.
+            keys = queue.get_batch(timeout=args.interval)
         else:
             time.sleep(args.interval if sim is None else 0.0)
 

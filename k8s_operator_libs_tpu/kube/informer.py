@@ -16,6 +16,17 @@ that layer over ``Client.watch``:
   pair them with the requestor's plain-function predicates;
 * reads (``get``/``list``) serve from the local store: cheap, point-in-time
   consistent, and exactly as stale as a controller-runtime cached client.
+
+Deltas are **rv-ordered and resync-aware**: the informer remembers the
+resourceVersion each key last *dispatched* to handlers, and a resync
+sweep (:meth:`resync_once`) coalesces replays whose stored rv handlers
+have already seen — a resync tick over a settled store delivers ZERO
+events, instead of client-go's replay-everything storm. A resync still
+re-delivers any object whose store entry got ahead of dispatch (e.g. a
+``record_write`` store repair whose watch echo never arrived), which is
+the self-heal a resync exists for. Delta consumers building incremental
+state (``upgrade/snapshot.py:IncrementalSnapshotSource``) rely on
+exactly this contract.
 """
 
 from __future__ import annotations
@@ -77,6 +88,13 @@ class Informer:
         # the resync loop can hold it across its store re-check.
         self._dispatch_lock = threading.RLock()
         self._handlers: list[EventHandler] = []
+        #: resourceVersion last DELIVERED per key: recorded once every
+        #: registered handler returned without raising (trivially so with
+        #: zero handlers), left behind on a handler failure so the next
+        #: resync sweep re-delivers that revision. Guarded by the
+        #: dispatch lock; resync_once compares it against the store to
+        #: coalesce replays handlers have already seen.
+        self._dispatched_rv: dict[tuple[str, str], str] = {}
         self._synced = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -165,22 +183,97 @@ class Informer:
         while not stop.wait(self.resync_period_s):
             if not self._synced.is_set():
                 continue  # nothing meaningful to re-deliver mid-relist
+            self.resync_once(stop)
+
+    def resync_once(self, stop: Optional[threading.Event] = None) -> int:
+        """One coalescing resync sweep; returns how many objects were
+        re-delivered. Unlike client-go's replay-everything resync, a key
+        whose stored resourceVersion handlers were already offered is
+        SKIPPED — a sweep over a settled store delivers zero events — and
+        only entries the store holds *ahead of dispatch* (a
+        ``record_write`` store repair whose watch echo never arrived, or
+        an event delivery that died mid-flight) are re-delivered in the
+        client-go resync shape, ``UpdateFunc(obj, obj)``. This is the
+        self-heal a resync exists for, minus the O(store) replay storm
+        on every tick."""
+        delivered = 0
+        with self._lock:
+            keys = list(self._store)
+        for key in keys:
+            if stop is not None and stop.is_set():
+                return delivered
+            # Under the dispatch lock, re-check the object is still
+            # cached: the watch thread removes from the store BEFORE
+            # dispatching DELETED, so a gone object is skipped here
+            # and a resync MODIFIED can never follow its DELETED.
+            with self._dispatch_lock:
+                with self._lock:
+                    raw = self._store.get(key)
+                if raw is None:
+                    continue
+                rv = str(
+                    (raw.get("metadata") or {}).get("resourceVersion", "")
+                )
+                if self._dispatched_rv.get(key) == rv:
+                    continue  # handlers already saw this exact revision
+                # client-go resync shape: UpdateFunc(obj, obj).
+                self._dispatch("MODIFIED", raw, raw)
+                delivered += 1
+        return delivered
+
+    def _in_flight(self) -> tuple[dict, list[dict], int]:
+        """One consistent view under the dispatch lock (re-entrant for
+        callers already inside it): the store snapshot, the entries
+        whose revision has not yet been offered to handlers (the watch
+        thread writes the store BEFORE dispatching, so a reader can
+        observe the new object while its handlers are still pending),
+        and the count of dispatched keys whose store entry is already
+        gone (a DELETED mid-flight — its raw is no longer available).
+        THE settledness scan: ``pending_dispatch`` and
+        ``with_settled_store`` must agree on what "in flight" means, so
+        they both read it here."""
+        with self._dispatch_lock:
             with self._lock:
-                keys = list(self._store)
-            for key in keys:
-                if stop.is_set():
-                    return
-                # Under the dispatch lock, re-check the object is still
-                # cached: the watch thread removes from the store BEFORE
-                # dispatching DELETED, so a gone object is skipped here
-                # and a resync MODIFIED can never follow its DELETED.
-                with self._dispatch_lock:
-                    with self._lock:
-                        raw = self._store.get(key)
-                    if raw is None:
-                        continue
-                    # client-go resync shape: UpdateFunc(obj, obj).
-                    self._dispatch("MODIFIED", raw, raw)
+                store = dict(self._store)
+            pending = []
+            for key, raw in store.items():
+                rv = str(
+                    (raw.get("metadata") or {}).get("resourceVersion", "")
+                )
+                if self._dispatched_rv.get(key) != rv:
+                    pending.append(raw)
+            gone = sum(1 for k in self._dispatched_rv if k not in store)
+            return store, pending, gone
+
+    def pending_dispatch(self) -> tuple[list[dict], int]:
+        """In-flight deliveries: (pending raws, gone-key count).
+        ``resync_once`` eventually re-delivers the former; the
+        incremental audit path uses this to keep event races out of the
+        divergence count."""
+        _, pending, gone = self._in_flight()
+        return pending, gone
+
+    def with_settled_store(self, fn) -> bool:
+        """Run ``fn(raws)`` over the store contents under the dispatch
+        lock, but ONLY when no delivery is in flight — returns False
+        without calling ``fn`` otherwise. Holding the dispatch lock
+        across ``fn`` means no handler can run concurrently, so a
+        consumer maintaining an event-derived book (per-DS pod counts,
+        say) can rebase it on the store without losing an increment to
+        a racing handler: any event arriving after ``fn`` ran is NOT in
+        the raws it saw and will be applied by its handler on top of
+        the rebased book. ``fn`` sees the SAME snapshot the settledness
+        scan checked — a store write landing between the two would
+        otherwise hand ``fn`` a pod whose pending dispatch later
+        double-counts. ``fn`` must be quick and must not touch this
+        informer — it runs inside the dispatch critical section, where
+        a re-entrant informer call deadlocks."""
+        with self._dispatch_lock:
+            store, pending, gone = self._in_flight()
+            if pending or gone:
+                return False
+            fn(list(store.values()))
+            return True
 
     def wait_for_sync(self, timeout: Optional[float] = None) -> bool:
         """Block until the initial list has populated the store."""
@@ -346,13 +439,28 @@ class Informer:
         obj = wrap(raw)
         old_obj = wrap(old) if old is not None else None
         with self._dispatch_lock:
+            key = self._key(raw)
+            delivered = True
             for handler in self._handlers:
                 try:
                     handler(event, obj, old_obj)
                 except Exception:  # noqa: BLE001 - handlers own their errors
+                    delivered = False
                     log.exception(
                         "informer handler failed for %s %s", event, obj.name
                     )
+            # Record the rv only after every handler returned: a raising
+            # handler leaves the key behind dispatch, so the next resync
+            # sweep re-delivers this revision (the "delivery died
+            # mid-flight" self-heal resync_once promises). A DELETED is
+            # un-healable either way — the store entry is already gone —
+            # so its book entry is dropped regardless.
+            if event == "DELETED":
+                self._dispatched_rv.pop(key, None)
+            elif delivered:
+                self._dispatched_rv[key] = str(
+                    (raw.get("metadata") or {}).get("resourceVersion", "")
+                )
 
     def _relist(self, stop) -> None:
         """Seed/repair the store from a fresh list, emitting synthetic

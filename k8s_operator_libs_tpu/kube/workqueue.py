@@ -204,6 +204,31 @@ class WorkQueue:
             self._dirty.discard(item)
             return item
 
+    def get_batch(
+        self,
+        timeout: Optional[float] = None,
+        max_items: Optional[int] = None,
+    ) -> list[Hashable]:
+        """Block up to ``timeout`` for the first item, then greedily drain
+        whatever else is immediately available (no further waiting), up to
+        ``max_items``. Empty list means shut down or timed out.
+
+        The shape a whole-world reconciler wants: one pass covers every
+        key that accumulated while the previous pass ran, instead of one
+        pass per key. Every returned item is in-flight — the caller MUST
+        call ``done`` on each (and ``forget`` on success when rate
+        limiting), exactly as with ``get``."""
+        first = self.get(timeout)
+        if first is None:
+            return []
+        items = [first]
+        while max_items is None or len(items) < max_items:
+            item = self.get(timeout=0)
+            if item is None:
+                break
+            items.append(item)
+        return items
+
     def done(self, item: Hashable) -> None:
         with self._cond:
             self._processing.discard(item)

@@ -20,7 +20,9 @@ from .common_manager import (
 from .inplace import InplaceNodeStateManager, ProcessNodeStateManager
 from .snapshot import (
     ClientSnapshotSource,
+    IncrementalSnapshotSource,
     InformerSnapshotSource,
+    SnapshotDelta,
     SnapshotSource,
 )
 from .state_manager import (
@@ -49,8 +51,10 @@ __all__ = [
     "ClientSnapshotSource",
     "ClusterUpgradeState",
     "ClusterUpgradeStateManager",
+    "IncrementalSnapshotSource",
     "InformerSnapshotSource",
     "PassStats",
+    "SnapshotDelta",
     "SnapshotSource",
     "CommonUpgradeManager",
     "InplaceNodeStateManager",

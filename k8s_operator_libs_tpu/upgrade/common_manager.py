@@ -66,9 +66,34 @@ class ClusterUpgradeState:
     node_states: dict[UpgradeState, list[NodeUpgradeState]] = field(
         default_factory=lambda: defaultdict(list)
     )
+    #: Delta information from an incremental snapshot source
+    #: (upgrade/snapshot.py): the names of nodes whose world changed
+    #: since the last pass. ``None`` means "no delta information" — a
+    #: full rebuild or a plain per-pass source — and every bucket
+    #: processes all of its nodes, the reference behavior. A set (even
+    #: empty) lets the pure per-node *reaction* buckets iterate only the
+    #: changed nodes via :meth:`reactive_nodes_in`; a settled pass does
+    #: zero per-node work.
+    dirty_nodes: Optional[frozenset[str]] = None
 
     def nodes_in(self, state: UpgradeState) -> list[NodeUpgradeState]:
         return self.node_states.get(state, [])
+
+    def reactive_nodes_in(self, state: UpgradeState) -> list[NodeUpgradeState]:
+        """Dirty-filtered bucket view for processors that are pure
+        per-node reactions to *watched* state (classify, spec-less
+        advances, pod-restart checks, uncordon): with delta information
+        present, only nodes whose inputs changed are walked. Buckets
+        whose progress depends on objects the snapshot source does NOT
+        watch (workload-pod completion polls, eviction, validation
+        hooks) must keep using :meth:`nodes_in` — filtering them would
+        trade their polling loop for a deadlock."""
+        nodes = self.node_states.get(state, [])
+        if self.dirty_nodes is None:
+            return nodes
+        if not self.dirty_nodes or not nodes:
+            return []
+        return [ns for ns in nodes if ns.node.name in self.dirty_nodes]
 
 
 class CommonUpgradeManager:
@@ -298,9 +323,13 @@ class CommonUpgradeManager:
                 self.provider.change_node_upgrade_state(ns.node, UpgradeState.DONE)
                 log.info("node %s moved unknown -> done", ns.node.name)
 
+        # Dirty-filtered: classification is a pure function of watched
+        # state (node labels/annotations, driver-pod sync) — an unchanged
+        # done/unknown node classifies to the same answer it did last
+        # pass, so only dirty nodes are walked when delta info exists.
         self._for_each(
             f"classify[{bucket or 'unknown'}]",
-            state.nodes_in(bucket),
+            state.reactive_nodes_in(bucket),
             lambda ns: ns.node.name,
             classify,
         )
@@ -342,8 +371,17 @@ class CommonUpgradeManager:
         wait_spec: Optional[WaitForCompletionSpec],
     ) -> None:
         """(reference: :384-419)"""
-        nodes = [ns.node for ns in state.nodes_in(UpgradeState.WAIT_FOR_JOBS_REQUIRED)]
         if wait_spec is None or not wait_spec.pod_selector:
+            # Spec-less advance: a pure reaction to the node's own
+            # (watched) state — dirty-filtered. A node lands in this
+            # bucket via a state write, which dirty-marks it, so the
+            # advance always runs on the very next pass.
+            nodes = [
+                ns.node
+                for ns in state.reactive_nodes_in(
+                    UpgradeState.WAIT_FOR_JOBS_REQUIRED
+                )
+            ]
             next_state = (
                 UpgradeState.POD_DELETION_REQUIRED
                 if self.pod_deletion_enabled
@@ -351,6 +389,9 @@ class CommonUpgradeManager:
             )
             self._advance_all("wait-for-jobs", nodes, next_state)
             return
+        # With a pod selector this bucket POLLS workload pods the
+        # snapshot source does not watch — never dirty-filter a poll.
+        nodes = [ns.node for ns in state.nodes_in(UpgradeState.WAIT_FOR_JOBS_REQUIRED)]
         if not nodes:
             return
         self.pod_manager.schedule_check_on_pod_completion(
@@ -427,9 +468,14 @@ class CommonUpgradeManager:
                     ns.node, UpgradeState.FAILED
                 )
 
+        # Dirty-filtered: progress here is driven entirely by watched
+        # objects — the driver pod's revision/readiness (Pod events) and
+        # the restart deletes this bucket itself issues (each delete's
+        # watch echo dirties the node again, so the completion check
+        # re-runs until the pod is back in sync).
         self._for_each(
             "pod-restart",
-            state.nodes_in(UpgradeState.POD_RESTART_REQUIRED),
+            state.reactive_nodes_in(UpgradeState.POD_RESTART_REQUIRED),
             lambda ns: ns.node.name,
             advance,
         )
@@ -477,9 +523,11 @@ class CommonUpgradeManager:
                     ns.node, self.keys.initial_state_annotation, NULL_STRING
                 )
 
+        # Dirty-filtered: recovery is a pure reaction to the driver pod
+        # coming back in sync — a watched Pod delta dirties the node.
         self._for_each(
             "failed-recovery",
-            state.nodes_in(UpgradeState.FAILED),
+            state.reactive_nodes_in(UpgradeState.FAILED),
             lambda ns: ns.node.name,
             recover,
         )

@@ -44,8 +44,17 @@ class InplaceNodeStateManager:
         policy: DriverUpgradePolicySpec,
     ) -> None:
         """Move upgrade-required nodes to cordon-required within budget
-        (reference: upgrade_inplace.go:44-112)."""
+        (reference: upgrade_inplace.go:44-112).
+
+        The budget math is the one global decision in the pass and is
+        never dirty-filtered — a node can wait in upgrade-required with
+        no delta of its own until budget frees. But with NOTHING waiting
+        there is no admission decision to make, so the unavailability
+        walk (the only O(pool) scan left in apply) is skipped: a settled
+        pool pays zero per-node CPU here too."""
         common = self.common
+        if not state.nodes_in(UpgradeState.UPGRADE_REQUIRED):
+            return
         total = common.get_total_managed_nodes(state)
         max_unavailable = policy.resolved_max_unavailable(total)
         available = common.get_upgrades_available(
@@ -95,7 +104,12 @@ class InplaceNodeStateManager:
         """Uncordon and finish (reference: upgrade_inplace.go:124-147).
         Nodes handled by requestor mode are skipped — their uncordon flow
         owns completion. Fanned out through the common bucket runner:
-        per-node uncordon+done is independent work."""
+        per-node uncordon+done is independent work.
+
+        Dirty-filtered: a node only enters this bucket via a state write
+        (which dirty-marks it), so the release always runs on the next
+        pass; requestor-mode nodes skipped here are owned by the
+        requestor's own (unfiltered) uncordon flow."""
         common = self.common
 
         def release(ns) -> None:
@@ -106,7 +120,7 @@ class InplaceNodeStateManager:
 
         common._for_each(
             "uncordon",
-            state.nodes_in(UpgradeState.UNCORDON_REQUIRED),
+            state.reactive_nodes_in(UpgradeState.UNCORDON_REQUIRED),
             lambda ns: ns.node.name,
             release,
         )

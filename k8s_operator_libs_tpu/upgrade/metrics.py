@@ -93,6 +93,31 @@ _PASS_GAUGES = [
     ("pass_node_errors",
      "Per-node failures isolated inside buckets during the last apply",
      "node_errors"),
+    # Incremental-reconcile gauges (IncrementalSnapshotSource): all 0 on
+    # plain per-pass sources.
+    ("pass_snapshot_incremental",
+     "1 when the snapshot was served by delta-driven incremental state",
+     "snapshot_incremental"),
+    ("pass_snapshot_skipped",
+     "1 when a settled pass served the cached state with zero work",
+     "snapshot_skipped"),
+    ("pass_full_rebuild",
+     "1 when the last pass reclassified every node (first build, "
+     "rollout delta, invalidation, or verify audit)",
+     "full_rebuild"),
+    ("pass_dirty_nodes",
+     "Dirty-node set size consumed by the last snapshot",
+     "dirty_node_count"),
+    ("pass_nodes_reclassified",
+     "Nodes reclassified by the last snapshot",
+     "nodes_reclassified"),
+    ("pass_verify_divergences",
+     "Incremental-vs-full divergences repaired by the last audit pass",
+     "verify_divergences"),
+    ("pass_delta_hit_rate",
+     "Lifetime fraction of passes served from deltas without a full "
+     "rebuild",
+     "delta_hit_rate"),
 ]
 
 

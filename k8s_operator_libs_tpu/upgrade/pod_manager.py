@@ -67,10 +67,14 @@ class PodManager:
         # Every pod_in_sync_with_ds call used to LIST ControllerRevisions
         # — one list PER NODE per pass, the write-path twin of the
         # build_state N+1. The DS resourceVersion keys the entry, and the
-        # orchestrator clears the memo at each build_state
-        # (reset_pass_caches), making it strictly PASS-scoped: a rollout
-        # that lands as a new ControllerRevision without any DS write (so
-        # the DS rv alone would not invalidate) is picked up next pass.
+        # orchestrator clears the memo at each FULL rebuild
+        # (reset_pass_caches), making it rebuild-scoped: a rollout that
+        # lands as a new ControllerRevision without any DS write (so the
+        # DS rv alone would not invalidate) is picked up by the next
+        # rebuild. With an incremental source, delta passes deliberately
+        # keep the memo — any DaemonSet/ControllerRevision delta forces
+        # the next pass to BE a full rebuild (and reset), so a kept entry
+        # can only ever serve passes where no rollout happened.
         self._ds_hash_lock = threading.Lock()
         self._ds_hash_cache: dict[str, tuple[str, str]] = {}
         #: When the orchestrator wires an informer-backed snapshot source
@@ -81,8 +85,11 @@ class PodManager:
         self.revision_source = None
 
     def reset_pass_caches(self) -> None:
-        """Drop per-pass memoization; the orchestrator calls this at the
-        top of every snapshot so no cached value outlives one pass."""
+        """Drop the rebuild-scoped memoization; the orchestrator calls
+        this at the top of every FULL snapshot rebuild so no cached value
+        outlives a window in which a rollout could have landed (see the
+        ``_ds_hash_cache`` comment for why incremental delta passes are
+        safe to skip)."""
         with self._ds_hash_lock:
             self._ds_hash_cache.clear()
 

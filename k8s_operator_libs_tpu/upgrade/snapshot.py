@@ -31,7 +31,15 @@ whole data path.
 from __future__ import annotations
 
 import threading
-from typing import Mapping, Optional, Protocol
+from dataclasses import dataclass, field
+from typing import (
+    TYPE_CHECKING,
+    Callable,
+    Mapping,
+    Optional,
+    Protocol,
+    Sequence,
+)
 
 from ..kube.client import Client
 from ..kube.informer import Informer
@@ -43,6 +51,10 @@ from ..kube.objects import (
     Pod,
 )
 from ..utils.log import get_logger
+
+if TYPE_CHECKING:  # avoid a snapshot <-> common_manager import cycle
+    from .common_manager import ClusterUpgradeState, NodeUpgradeState
+    from .consts import UpgradeState
 
 log = get_logger("upgrade.snapshot")
 
@@ -329,3 +341,455 @@ class InformerSnapshotSource:
                 f"namespace={self.namespace!r} labels={self.driver_labels!r}; "
                 f"got namespace={namespace!r} labels={dict(labels)!r}"
             )
+
+
+@dataclass(frozen=True)
+class SnapshotDelta:
+    """What changed since the last successfully applied snapshot, read at
+    the top of a pass via :meth:`IncrementalSnapshotSource.dirty` and
+    retired — only after the pass consumed it — via :meth:`~.clean`.
+    Deltas that arrive mid-pass stay dirty for the next one."""
+
+    #: Per-node tracking cannot vouch for the cached state: first build,
+    #: a DaemonSet/ControllerRevision delta (rollouts re-hash every
+    #: node's sync check), or an explicit ``invalidate()``.
+    full: bool
+    #: Full-invalidation epoch at snapshot time; ``clean`` uses it so an
+    #: invalidation racing the pass is never absorbed by accident.
+    epoch: int
+    #: Names of nodes whose world changed (their own object, a driver
+    #: pod on them, or a provider write-through).
+    nodes: frozenset[str]
+    #: Per-node mark generation at snapshot time. ``clean`` retires a
+    #: node only while its generation is unchanged: an event landing
+    #: mid-pass for an ALREADY-dirty node bumps the generation, so the
+    #: mark survives even though the name was in ``nodes`` — without
+    #: this, a pass that read the node's store BEFORE the event would
+    #: absorb the newer mark and strand a stale classification (the
+    #: store write happens before the handler's re-mark, so the read
+    #: can interleave between them).
+    marks: Mapping[str, int] = field(default_factory=dict)
+
+
+class IncrementalSnapshotSource(InformerSnapshotSource):
+    """Informer-backed source that also *maintains* the cluster state.
+
+    On top of :class:`InformerSnapshotSource`'s cached reads, this source
+    subscribes to its own informers' deltas and keeps a **dirty-node
+    set**: a Node event dirties that node, a Pod event dirties the node
+    it runs on (``spec.nodeName``, old and new), and the provider's
+    write-through (:meth:`record_write`) dirties every node the reconcile
+    pass itself wrote. DaemonSet/ControllerRevision deltas — which change
+    the revision-hash every node's sync check compares against — bump a
+    **full epoch** instead: the next pass does one full reclassification.
+
+    ``build_state`` (state_manager) consumes this via :meth:`dirty` /
+    :meth:`clean`: a settled pool serves the cached
+    ``ClusterUpgradeState`` with zero reads and zero per-node CPU, and a
+    single node event reclassifies exactly one node. The cached state and
+    per-node assignment live here (:meth:`prime` / :meth:`update_node`);
+    classification itself stays in the manager. ``verify_every_n`` makes
+    every n-th pass a full rebuild that is *diffed* against the
+    incremental state — divergences are counted (PassStats /
+    ``tpu_operator_upgrade_pass_verify_divergences``) and repaired, so
+    correctness is self-auditing in production.
+
+    Threading: the dirty set, per-DS pod counts, and epochs are shared
+    with informer dispatch threads and guarded by ``_delta_lock`` (a
+    leaf lock — nothing blocks under it). The cached state/assignment are
+    touched only from the reconcile thread: one manager, sequential
+    passes, same single-consumer contract ``build_state`` always had.
+    """
+
+    incremental = True
+
+    #: Pod-informer index name: pods by the node they run on.
+    POD_NODE_INDEX = "spec.nodeName"
+
+    def __init__(
+        self,
+        client: Client,
+        namespace: str,
+        driver_labels: Mapping[str, str],
+        resync_period_s: float = DEFAULT_RESYNC_PERIOD_S,
+        verify_every_n: int = 0,
+    ) -> None:
+        super().__init__(
+            client, namespace, driver_labels, resync_period_s=resync_period_s
+        )
+        #: Every n-th build cross-checks incremental state against a full
+        #: rebuild (0 = off). The audit pass repairs and counts drift.
+        self.verify_every_n = int(verify_every_n)
+        self._delta_lock = threading.Lock()
+        #: node name -> mark generation (bumped on every re-mark); the
+        #: generation is what lets ``clean`` retire exactly the marks a
+        #: pass consumed and nothing newer (see SnapshotDelta.marks).
+        #: Generations come from a single monotonic counter — never
+        #: per-node, never reset on retirement — so a node re-marked
+        #: AFTER a clean popped it gets a generation no consumed delta
+        #: can hold, and a second clean of the same delta (the audit
+        #: path cleans once in its catch-up and once after priming) can
+        #: never absorb the fresh mark.
+        self._dirty: dict[str, int] = {}
+        self._mark_seq = 0
+        self._full_epoch = 1  # > _clean_epoch: first build must be full
+        self._clean_epoch = 0
+        self._delta_events = 0
+        self._full_invalidations = 0
+        self._verify_divergences = 0
+        #: first-ownerRef uid -> live pod count, maintained from pod
+        #: deltas — the completeness invariant's O(#DS) read on delta
+        #: passes (the full path counts by scanning the pod list).
+        self._ds_pod_counts: dict[str, int] = {}
+        # Cached classification (reconcile thread only; see class doc).
+        self._state: Optional["ClusterUpgradeState"] = None
+        self._assignment: dict[
+            str, list[tuple["UpgradeState", "NodeUpgradeState"]]
+        ] = {}
+        pod_informer = self._informers["Pod"]
+        pod_informer.add_indexer(
+            self.POD_NODE_INDEX,
+            lambda o: [(o.raw.get("spec") or {}).get("nodeName", "") or ""],
+        )
+        # Handlers registered before start(): the seed list's ADDEDs flow
+        # through them, so pod counts and the dirty set are complete from
+        # the first delivery on.
+        self._informers["Node"].add_event_handler(self._on_node_event)
+        pod_informer.add_event_handler(self._on_pod_event)
+        self._informers["DaemonSet"].add_event_handler(self._on_revision_event)
+        self._informers["ControllerRevision"].add_event_handler(
+            self._on_revision_event
+        )
+
+    # -- delta intake (informer dispatch threads) --------------------------
+    def _mark_node(self, name: str) -> None:
+        with self._delta_lock:
+            self._mark_node_locked(name)
+            self._delta_events += 1
+
+    def _mark_node_locked(self, name: str) -> None:
+        self._mark_seq += 1
+        self._dirty[name] = self._mark_seq
+
+    def invalidate(self) -> None:
+        """Force the next pass to reclassify everything. Called for
+        DaemonSet/ControllerRevision deltas, and by the orchestrator when
+        an apply pass aborts — an aborted pass may have left transitions
+        half-done on nodes no future delta would touch, and the full
+        rebuild + full apply is the level-driven retry."""
+        with self._delta_lock:
+            self._full_epoch += 1
+            self._full_invalidations += 1
+
+    def _on_node_event(self, event_type: str, obj, old) -> None:
+        self._mark_node(obj.name)
+
+    @staticmethod
+    def _first_owner_uid(pod) -> Optional[str]:
+        refs = pod.owner_references
+        return refs[0].get("uid") if refs else None
+
+    def _on_pod_event(self, event_type: str, obj, old) -> None:
+        uid = self._first_owner_uid(obj)
+        node = obj.node_name or ""
+        old_uid = old_node = None
+        if old is not None:
+            old_uid = self._first_owner_uid(old)
+            old_node = old.node_name or ""
+        with self._delta_lock:
+            self._delta_events += 1
+            self._mark_node_locked(node)
+            if old_node is not None and old_node != node:
+                self._mark_node_locked(old_node)
+            if event_type == "ADDED":
+                if uid:
+                    self._ds_pod_counts[uid] = (
+                        self._ds_pod_counts.get(uid, 0) + 1
+                    )
+            elif event_type == "DELETED":
+                if uid:
+                    self._ds_pod_counts[uid] = (
+                        self._ds_pod_counts.get(uid, 0) - 1
+                    )
+            elif uid != old_uid:  # MODIFIED with an ownerRef flip (rare)
+                if old_uid:
+                    self._ds_pod_counts[old_uid] = (
+                        self._ds_pod_counts.get(old_uid, 0) - 1
+                    )
+                if uid:
+                    self._ds_pod_counts[uid] = (
+                        self._ds_pod_counts.get(uid, 0) + 1
+                    )
+
+    def _on_revision_event(self, event_type: str, obj, old) -> None:
+        # A DS write changes desired counts and the rv keying the
+        # rollout-hash memo; a ControllerRevision changes the hash every
+        # node's sync check compares against. Either way per-node
+        # tracking cannot scope the blast radius — reclassify everything.
+        # EXCEPT when the delta is provably irrelevant: kubelet status
+        # noise (numberReady flaps every tick on a big pool) and resync
+        # re-deliveries (obj, obj) must not turn the incremental path
+        # back into reclassify-everything-always.
+        if (
+            event_type == "MODIFIED"
+            and old is not None
+            and self._revision_shape(obj.raw) == self._revision_shape(old.raw)
+        ):
+            return
+        self.invalidate()
+
+    @staticmethod
+    def _revision_shape(raw: dict) -> tuple:
+        """The fields of a DaemonSet/ControllerRevision that can affect
+        classification: selection (labels), the rollout itself (spec /
+        revision / data), and the completeness invariant's input
+        (status.desiredNumberScheduled). A MODIFIED that changes none of
+        these — numberReady churn, resourceVersion-only bumps — cannot
+        change any node's bucket."""
+        meta = raw.get("metadata") or {}
+        return (
+            meta.get("labels"),
+            raw.get("spec"),
+            raw.get("revision"),
+            raw.get("data"),
+            (raw.get("status") or {}).get("desiredNumberScheduled"),
+        )
+
+    def mark_dirty_on(
+        self,
+        informer: Informer,
+        node_names: Callable[[KubeObject], Sequence[str]],
+    ) -> None:
+        """Feed deltas from an informer this source does not own (the
+        requestor's NodeMaintenance watch, say) into the dirty set:
+        ``node_names(obj)`` maps each event to the nodes it concerns.
+        An empty/failed mapping degrades to a full invalidation — an
+        external delta must never be silently dropped."""
+
+        def handler(event_type, obj, old) -> None:
+            try:
+                names = [n for n in (node_names(obj) or []) if n]
+            except Exception:  # noqa: BLE001 - mapping owns its errors
+                log.exception("mark_dirty_on mapping failed for %s", obj.name)
+                names = []
+            if names:
+                for name in names:
+                    self._mark_node(name)
+            else:
+                self.invalidate()
+
+        informer.add_event_handler(handler)
+
+    # -- provider write-through --------------------------------------------
+    def record_write(self, obj: KubeObject) -> None:
+        """Store repair (read-your-writes) + dirty-mark: the pass's own
+        writes are exactly the deltas the next pass must reclassify —
+        record_write never dispatches informer handlers, so without this
+        mark the write would be invisible to delta tracking until its
+        watch echo lands."""
+        super().record_write(obj)
+        raw = obj.raw if isinstance(obj, KubeObject) else obj
+        if raw.get("kind") == "Node":
+            name = (raw.get("metadata") or {}).get("name", "")
+            if name:
+                self._mark_node(name)
+
+    # -- delta consumption (reconcile thread) ------------------------------
+    def dirty(self) -> SnapshotDelta:
+        with self._delta_lock:
+            return SnapshotDelta(
+                full=self._full_epoch > self._clean_epoch,
+                epoch=self._full_epoch,
+                nodes=frozenset(self._dirty),
+                marks=dict(self._dirty),
+            )
+
+    def clean(self, delta: SnapshotDelta) -> None:
+        """Retire exactly the consumed delta: nodes dirtied after
+        :meth:`dirty` — including a RE-mark of a node the delta already
+        carried (its generation moved on, so the pass may have read the
+        pre-event store) — and invalidations after its epoch stay
+        dirty."""
+        with self._delta_lock:
+            for name in delta.nodes:
+                if self._dirty.get(name) == delta.marks.get(name):
+                    self._dirty.pop(name, None)
+            if delta.epoch > self._clean_epoch:
+                self._clean_epoch = delta.epoch
+
+    @property
+    def delta_events(self) -> int:
+        with self._delta_lock:
+            return self._delta_events
+
+    @property
+    def full_invalidations(self) -> int:
+        with self._delta_lock:
+            return self._full_invalidations
+
+    @property
+    def verify_divergences_total(self) -> int:
+        """Cumulative incremental-vs-full divergences found by audit
+        passes since start. Production alert material: nonzero means
+        delta tracking dropped something (and the audit repaired it)."""
+        with self._delta_lock:
+            return self._verify_divergences
+
+    def racing_nodes(self) -> Optional[frozenset]:
+        """Nodes an in-flight event may concern, read AFTER an audit's
+        full rebuild: the dirty set, plus nodes whose Node/Pod store
+        entry is ahead of dispatch — the watch thread writes the store
+        (which the rebuild reads) BEFORE the handler dirty-marks, so a
+        mid-audit event can be visible to the rebuild while its mark is
+        still pending. Counting such a node as a divergence would fire
+        the alert-on-nonzero metric for an event race, not a tracking
+        bug. ``None`` means the in-flight work cannot be attributed to
+        nodes (a DELETED whose raw is gone, or a DaemonSet/
+        ControllerRevision delta mid-dispatch, which re-hashes every
+        node): the caller must skip counting for this audit — the next
+        cadence re-audits from the repaired baseline anyway.
+
+        Read order matters: in-flight deliveries are read BEFORE the
+        dirty set, so an event whose dispatch completes between the two
+        reads is seen by the later dirty read — reading dirty first
+        would let it vanish from both."""
+        node_pending, node_gone = self._informers["Node"].pending_dispatch()
+        pod_pending, pod_gone = self._informers["Pod"].pending_dispatch()
+        if node_gone or pod_gone:
+            return None
+        for kind in ("DaemonSet", "ControllerRevision"):
+            pending, gone = self._informers[kind].pending_dispatch()
+            if pending or gone:
+                return None
+        with self._delta_lock:
+            racing = set(self._dirty)
+        for raw in node_pending:
+            racing.add((raw.get("metadata") or {}).get("name", ""))
+        for raw in pod_pending:
+            racing.add((raw.get("spec") or {}).get("nodeName", "") or "")
+        return frozenset(n for n in racing if n)
+
+    def count_divergences(
+        self,
+        incremental_shape: Mapping[str, Sequence],
+        rebuilt_shape: Mapping[str, Sequence],
+        racing: Optional[frozenset] = None,
+    ) -> int:
+        """Audit bookkeeping: count nodes whose incremental
+        classification differs from the full rebuild's, log each, and
+        accumulate the total. The caller (state_manager's verify pass)
+        repairs by re-priming with the rebuild.
+
+        ``racing`` names nodes that took a fresh delta between the
+        pre-audit catch-up and the rebuild's store reads: a difference
+        there is attributable to the mid-audit event, not to a tracking
+        bug — it is logged but NOT counted, so the alert-on-nonzero
+        contract of ``verify_divergences_total`` stays trustworthy (the
+        surviving dirty mark makes the next pass reconcile those nodes
+        from the repaired baseline anyway)."""
+        diverged = 0
+        for name in set(incremental_shape) | set(rebuilt_shape):
+            ours = incremental_shape.get(name)
+            truth = rebuilt_shape.get(name)
+            if ours == truth:
+                continue
+            if racing is not None and name in racing:
+                log.info(
+                    "audit difference for node %s raced a mid-audit "
+                    "delta; not counted (repaired + still dirty)", name,
+                )
+                continue
+            diverged += 1
+            log.warning(
+                "incremental state diverged for node %s: "
+                "incremental=%s rebuilt=%s (repaired)",
+                name, ours, truth,
+            )
+        if diverged:
+            with self._delta_lock:
+                self._verify_divergences += diverged
+        return diverged
+
+    def ds_pod_count(self, uid: str) -> int:
+        with self._delta_lock:
+            return self._ds_pod_counts.get(uid, 0)
+
+    # -- per-node reads for reclassification -------------------------------
+    def node(self, name: str) -> Optional[Node]:
+        obj = self._informers["Node"].get(name)
+        return Node(obj.raw) if obj is not None else None
+
+    def pods_on_node(self, name: str) -> list[Pod]:
+        return [
+            Pod(o.raw)
+            for o in self._informers["Pod"].by_index(
+                self.POD_NODE_INDEX, name
+            )
+        ]
+
+    # -- cached state (reconcile thread) -----------------------------------
+    def cached_state(self) -> Optional["ClusterUpgradeState"]:
+        return self._state
+
+    def assignment(
+        self,
+    ) -> dict[str, list[tuple["UpgradeState", "NodeUpgradeState"]]]:
+        """node name -> [(bucket, entry)] — the incremental book the
+        verify pass audits."""
+        return self._assignment
+
+    def prime(
+        self,
+        state: "ClusterUpgradeState",
+        assignment: dict[
+            str, list[tuple["UpgradeState", "NodeUpgradeState"]]
+        ],
+    ) -> None:
+        """Adopt a full rebuild as the new incremental baseline — and
+        re-anchor the event-maintained per-DS pod counts to the Pod
+        store while no delivery is in flight. Without the re-anchor, a
+        count that ever drifted (a DELETED whose handler died
+        mid-delivery is the one un-healable informer case) would fail
+        ``_apply_delta``'s completeness check on every delta pass
+        forever; with it, the next quiescent full rebuild repairs the
+        book. Skipped (returns without repair) while a pod delivery is
+        mid-flight — the atomicity argument lives in
+        :meth:`Informer.with_settled_store`."""
+        self._state = state
+        self._assignment = dict(assignment)
+
+        def rebase(raws: list) -> None:
+            counts: dict[str, int] = {}
+            for raw in raws:
+                refs = (raw.get("metadata") or {}).get("ownerReferences") or []
+                uid = refs[0].get("uid") if refs else None
+                if uid:
+                    counts[uid] = counts.get(uid, 0) + 1
+            with self._delta_lock:
+                self._ds_pod_counts = counts
+
+        self._informers["Pod"].with_settled_store(rebase)
+
+    def update_node(
+        self,
+        name: str,
+        entries: Sequence[tuple["UpgradeState", "NodeUpgradeState"]],
+    ) -> None:
+        """Swap one node's classification into the cached state: its old
+        entries leave their buckets (identity-based removal — dataclass
+        equality would compare whole objects), the new ones join theirs.
+        O(dirty-node's bucket), never O(pool)."""
+        state = self._state
+        assert state is not None, "update_node before prime"
+        old = self._assignment.pop(name, None)
+        if old:
+            for bucket, entry in old:
+                entries_in_bucket = state.node_states.get(bucket)
+                if entries_in_bucket:
+                    entries_in_bucket[:] = [
+                        e for e in entries_in_bucket if e is not entry
+                    ]
+        if entries:
+            self._assignment[name] = list(entries)
+            for bucket, entry in entries:
+                state.node_states[bucket].append(entry)

@@ -44,7 +44,9 @@ from .pod_manager import PodDeletionFilter, PodManager
 from .safe_driver_load import SafeDriverLoadManager
 from .snapshot import (
     ClientSnapshotSource,
+    IncrementalSnapshotSource,
     InformerSnapshotSource,
+    SnapshotDelta,
     SnapshotSource,
 )
 from .state_provider import NodeUpgradeStateProvider
@@ -56,6 +58,26 @@ log = get_logger("upgrade.state_manager")
 
 class BuildStateError(Exception):
     pass
+
+
+def _assignment_shape(assignment: Mapping) -> dict[str, list[tuple]]:
+    """Comparable classification shape: node name -> sorted
+    (bucket, pod namespace, pod name, owning-DS uid) tuples. Entry
+    identity (the NodeUpgradeState objects) deliberately drops out —
+    the audit compares WHAT was classified where, not which pass built
+    the objects."""
+    shape: dict[str, list[tuple]] = {}
+    for name, entries in assignment.items():
+        shape[name] = sorted(
+            (
+                str(bucket),
+                ns.driver_pod.namespace,
+                ns.driver_pod.name,
+                ns.driver_daemonset.uid if ns.driver_daemonset else "",
+            )
+            for bucket, ns in entries
+        )
+    return shape
 
 
 @dataclass
@@ -92,6 +114,28 @@ class PassStats:
     writes_skipped: int = 0
     #: Per-node failures isolated inside buckets this pass.
     node_errors: int = 0
+    #: True when the snapshot came from an IncrementalSnapshotSource —
+    #: the fields below are only meaningful then.
+    snapshot_incremental: bool = False
+    #: True when this pass reclassified every node (first build, a
+    #: DaemonSet/ControllerRevision delta, an explicit invalidation, or
+    #: a verify_every_n audit).
+    full_rebuild: bool = False
+    #: True when a settled pool served the cached state untouched:
+    #: zero reads AND zero per-node CPU.
+    snapshot_skipped: bool = False
+    #: Size of the dirty-node set this snapshot consumed.
+    dirty_node_count: int = 0
+    #: Nodes actually reclassified by this snapshot (== 1 for a
+    #: single-node event; == pool size on a full rebuild).
+    nodes_reclassified: int = 0
+    #: Incremental-vs-full divergences found (and repaired) by this
+    #: pass's verify_every_n audit. Nonzero means the delta tracking
+    #: missed something — self-auditing correctness, not silent drift.
+    verify_divergences: int = 0
+    #: Lifetime fraction of incremental-source passes served from
+    #: deltas (settled or dirty-set) without a full rebuild.
+    delta_hit_rate: float = 0.0
 
 
 class ClusterUpgradeStateManager:
@@ -147,6 +191,10 @@ class ClusterUpgradeStateManager:
         self.last_pass_stats = PassStats()
         self.inplace: ProcessNodeStateManager = InplaceNodeStateManager(self.common)
         self.requestor: Optional[ProcessNodeStateManager] = requestor
+        # Incremental-source pass accounting: verify_every_n cadence and
+        # the delta hit-rate gauge (reconcile thread only).
+        self._incremental_builds = 0
+        self._incremental_hits = 0
 
     def with_snapshot_from_informers(
         self,
@@ -154,17 +202,35 @@ class ClusterUpgradeStateManager:
         driver_labels: Mapping[str, str],
         resync_period_s: Optional[float] = None,
         sync_timeout: float = 30.0,
+        incremental: bool = False,
+        verify_every_n: int = 0,
     ) -> InformerSnapshotSource:
         """Switch ``build_state`` onto informer-backed stores (list-once +
         watch + resync) and wire the provider's write-through so each pass
         reads its own writes. Starts the informers and blocks until their
-        initial lists sync; returns the source (caller owns ``stop()``)."""
+        initial lists sync; returns the source (caller owns ``stop()``).
+
+        ``incremental=True`` selects :class:`IncrementalSnapshotSource`:
+        the cluster state is *maintained* from the informers' deltas and
+        ``build_state`` becomes O(dirty) instead of O(nodes) — a settled
+        pool reconciles with zero reads and zero per-node CPU.
+        ``verify_every_n`` makes every n-th incremental build a full
+        rebuild that audits (and repairs) the incremental state."""
         kwargs = {}
         if resync_period_s is not None:
             kwargs["resync_period_s"] = resync_period_s
-        source = InformerSnapshotSource(
-            self.client, namespace, driver_labels, **kwargs
-        )
+        if incremental:
+            source: InformerSnapshotSource = IncrementalSnapshotSource(
+                self.client,
+                namespace,
+                driver_labels,
+                verify_every_n=verify_every_n,
+                **kwargs,
+            )
+        else:
+            source = InformerSnapshotSource(
+                self.client, namespace, driver_labels, **kwargs
+            )
         source.start(sync_timeout=sync_timeout)
         self.snapshot_source = source
         self.provider.set_write_through(source.record_write)
@@ -262,14 +328,45 @@ class ClusterUpgradeStateManager:
         start = time.perf_counter()
         source = self.snapshot_source
         source.consume_reads()  # drop reads accrued outside a pass
-        # One pass = one memo lifetime (the DS revision-hash cache must
-        # not survive into a pass that may follow a rollout). Duck-typed:
-        # injected pod-manager doubles (testing/mocks.py) may not memoize.
+        incremental = bool(getattr(source, "incremental", False))
+        stats = PassStats(
+            snapshot_cached=source.cached, snapshot_incremental=incremental
+        )
+        self.last_pass_stats = stats
+        if incremental:
+            state = self._build_state_incremental(
+                namespace, driver_labels, source, stats
+            )
+        else:
+            self._reset_pass_caches()
+            state = self._build_state_full(namespace, driver_labels, source)
+            state.dirty_nodes = None
+        stats.reads_issued = source.consume_reads()
+        stats.snapshot_s = time.perf_counter() - start
+        return state
+
+    def _reset_pass_caches(self) -> None:
+        # One full rebuild = one memo lifetime (the DS revision-hash
+        # cache must not survive into a rebuild that may follow a
+        # rollout). Duck-typed: injected pod-manager doubles
+        # (testing/mocks.py) may not memoize. Delta passes deliberately
+        # KEEP the memo: any rollout lands as a DaemonSet or
+        # ControllerRevision delta, which forces the next pass to be a
+        # full rebuild — and that rebuild resets the memo.
         reset = getattr(self.common.pod_manager, "reset_pass_caches", None)
         if callable(reset):
             reset()
-        stats = PassStats(snapshot_cached=source.cached)
-        self.last_pass_stats = stats
+
+    def _build_state_full(
+        self,
+        namespace: str,
+        driver_labels: Mapping[str, str],
+        source: SnapshotSource,
+        assignment: Optional[dict] = None,
+    ) -> ClusterUpgradeState:
+        """Reference-shaped full reclassification (upgrade_state.go:99-164).
+        With ``assignment`` (incremental priming), every classified entry
+        is also recorded as ``node name -> [(bucket, entry)]``."""
         state = ClusterUpgradeState()
         daemonsets = {
             ds.uid: ds
@@ -310,9 +407,190 @@ class ClusterUpgradeStateManager:
             )
             bucket = self.provider.get_upgrade_state(ns.node)
             state.node_states[bucket].append(ns)
-        stats.reads_issued = source.consume_reads()
-        stats.snapshot_s = time.perf_counter() - start
+            if assignment is not None:
+                assignment.setdefault(ns.node.name, []).append((bucket, ns))
         return state
+
+    # ------------------------------------------------------------------
+    # Incremental BuildState: O(dirty), not O(nodes)
+    # ------------------------------------------------------------------
+    def _build_state_incremental(
+        self,
+        namespace: str,
+        driver_labels: Mapping[str, str],
+        source: IncrementalSnapshotSource,
+        stats: PassStats,
+    ) -> ClusterUpgradeState:
+        """Serve ``build_state`` from the source's delta stream.
+
+        Three shapes, cheapest first:
+
+        * **settled** — no deltas since the last pass: the cached
+          ``ClusterUpgradeState`` is returned untouched with an empty
+          ``dirty_nodes`` set. Zero reads, zero per-node CPU.
+        * **delta** — reclassify exactly the dirty nodes against the
+          informer stores (per-node point reads + the pod-by-node
+          index); the completeness invariant checks event-maintained
+          per-DS pod counts, O(#DS) instead of O(pods).
+        * **full** — first build, a DaemonSet/ControllerRevision delta,
+          an explicit ``invalidate()``, or the ``verify_every_n`` audit
+          cadence: reference-shaped full reclassification, re-primed as
+          the new incremental baseline. The audit variant first consumes
+          the pending delta incrementally, then diffs the incremental
+          book against the rebuild — divergences are repaired and
+          counted (``PassStats.verify_divergences``), so a tracking bug
+          becomes a metric, not silent drift.
+
+        ``dirty_nodes`` on the returned state is what scopes the
+        dirty-set apply (``ClusterUpgradeState.reactive_nodes_in``):
+        ``None`` after a full rebuild (process everything), the consumed
+        delta set otherwise.
+        """
+        delta = source.dirty()
+        self._incremental_builds += 1
+        audit = (
+            source.verify_every_n > 0
+            and self._incremental_builds % source.verify_every_n == 0
+        )
+        cached = source.cached_state()
+        if cached is None or delta.full or audit:
+            if audit and cached is not None and not delta.full:
+                # Bring the incremental book up to date with the pending
+                # delta FIRST, so the diff below measures tracking bugs,
+                # never merely-unconsumed events.
+                self._apply_delta(namespace, driver_labels, source, delta)
+                expected = _assignment_shape(source.assignment())
+            else:
+                expected = None
+            self._reset_pass_caches()
+            assignment: dict = {}
+            state = self._build_state_full(
+                namespace, driver_labels, source, assignment=assignment
+            )
+            if expected is not None:
+                # Nodes that took a delta while the rebuild ran —
+                # including deliveries still in flight between the store
+                # write the rebuild read and the handler's dirty-mark —
+                # are excluded: their difference is the event's, not a
+                # tracking bug's (the mark survives/arrives regardless,
+                # so the next pass reconciles them anyway). An
+                # unattributable in-flight delivery (racing is None)
+                # skips counting this audit; the repair still applies
+                # and the next cadence re-audits.
+                racing = source.racing_nodes()
+                # dirty().full AFTER racing_nodes: an invalidation whose
+                # DS/CR dispatch completed between the rebuild's store
+                # reads and the pending check leaves no per-node trace —
+                # only the bumped epoch says the rebuild may have read a
+                # rollout the catch-up never saw.
+                if racing is None or source.dirty().full:
+                    log.info(
+                        "audit: in-flight deliveries or a mid-audit "
+                        "invalidation; divergence count skipped this audit"
+                    )
+                else:
+                    stats.verify_divergences = source.count_divergences(
+                        expected,
+                        _assignment_shape(assignment),
+                        racing=racing,
+                    )
+            source.prime(state, assignment)
+            source.clean(delta)
+            state.dirty_nodes = None
+            stats.full_rebuild = True
+            stats.dirty_node_count = len(delta.nodes)
+            stats.nodes_reclassified = len(assignment)
+        elif not delta.nodes:
+            self._incremental_hits += 1
+            stats.snapshot_skipped = True
+            state = cached
+            state.dirty_nodes = frozenset()
+        else:
+            self._incremental_hits += 1
+            stats.nodes_reclassified = self._apply_delta(
+                namespace, driver_labels, source, delta
+            )
+            stats.dirty_node_count = len(delta.nodes)
+            state = cached
+            state.dirty_nodes = frozenset(n for n in delta.nodes if n)
+        stats.delta_hit_rate = round(
+            self._incremental_hits / self._incremental_builds, 6
+        )
+        return state
+
+    def _apply_delta(
+        self,
+        namespace: str,
+        driver_labels: Mapping[str, str],
+        source: IncrementalSnapshotSource,
+        delta: SnapshotDelta,
+    ) -> int:
+        """Consume ``delta`` into the cached state: reclassify exactly
+        the dirty nodes. Raises BuildStateError (delta left un-consumed,
+        the pass retries) when the event-maintained per-DS pod counts
+        disagree with the DaemonSet's desired count — the same
+        completeness invariant as the full path, at O(#DS)."""
+        daemonsets = {
+            ds.uid: ds
+            for ds in source.daemonsets(namespace, dict(driver_labels))
+        }
+        for ds in daemonsets.values():
+            found = source.ds_pod_count(ds.uid)
+            if ds.desired_number_scheduled != found:
+                # Either genuinely unscheduled pods (the reference aborts
+                # the pass and retries) or a drifted event-maintained
+                # count. Invalidate so the retry is a FULL rebuild:
+                # genuine incompleteness fails the full path's real
+                # pod-scan check identically, while a drifted count is
+                # repaired by prime()'s store re-anchor — without the
+                # invalidate, drift would wedge every delta pass (and
+                # every audit, whose catch-up runs this check first)
+                # forever.
+                source.invalidate()
+                raise BuildStateError(
+                    f"driver DaemonSet {ds.name} should not have unscheduled "
+                    f"pods (desired {ds.desired_number_scheduled}, "
+                    f"found {found})"
+                )
+        reclassified = 0
+        for name in delta.nodes:
+            if not name:
+                continue  # a driver pod with no node yet (Pending)
+            self._reclassify_node(source, name, daemonsets)
+            reclassified += 1
+        source.clean(delta)
+        return reclassified
+
+    def _reclassify_node(
+        self,
+        source: IncrementalSnapshotSource,
+        name: str,
+        daemonsets: Mapping[str, DaemonSet],
+    ) -> None:
+        """One node's worth of the full path: classify every driver pod
+        on the node and swap the result into the cached state —
+        O(pods-on-node), never O(pool)."""
+        node = source.node(name)
+        entries: list = []
+        for pod in source.pods_on_node(name):
+            owner = None
+            if not self.common.is_orphaned_pod(pod):
+                refs = pod.owner_references
+                owner = daemonsets.get(refs[0].get("uid")) if refs else None
+                if owner is None:
+                    # Full-path parity: the full rebuild selects only
+                    # ds-owned + orphaned pods, so a pod owned by
+                    # something that is no (longer a) driver DaemonSet —
+                    # e.g. still terminating after its DS was deleted —
+                    # is never classified there and must not be here.
+                    continue
+            # ``node`` may be None when the Node object vanished ahead of
+            # its pods — _build_node_upgrade_state falls back to the
+            # provider GET, exactly like the full path's raced-node case.
+            ns = self._build_node_upgrade_state(pod, owner, node=node)
+            bucket = self.provider.get_upgrade_state(ns.node)
+            entries.append((bucket, ns))
+        source.update_node(name, entries)
 
     def _build_node_upgrade_state(
         self, pod: Pod, ds: Optional[DaemonSet], node: Optional[Node] = None
@@ -380,6 +658,18 @@ class ClusterUpgradeStateManager:
             common.process_upgrade_failed_nodes(state)
             common.process_validation_required_nodes(state)
             self._process_uncordon_required_nodes(state)
+        except BaseException:
+            # An aborted pass may have left transitions half-done on
+            # nodes no future delta would touch (their write landed
+            # before the abort, so nothing re-dirties them). Force the
+            # next pass to reclassify everything — the full rebuild +
+            # full apply IS the level-driven retry.
+            invalidate = getattr(self.snapshot_source, "invalidate", None)
+            if callable(invalidate) and getattr(
+                self.snapshot_source, "incremental", False
+            ):
+                invalidate()
+            raise
         finally:
             issued_after, skipped_after = self.provider.write_counts()
             stats.writes_issued = issued_after - issued_before

@@ -40,6 +40,75 @@ def test_roll_returns_phase_breakdown(slice_aware):
     assert out["disruption_windows"] == (1 if slice_aware else bench.HOSTS)
 
 
+def test_settled_pool_noop_shapes():
+    # Small pool; the >=10x contract and the zero-client-call /
+    # zero-write invariants are hard-asserted inside the section itself.
+    out = bench.run_settled_pool_noop(
+        slices=4, hosts_per_slice=4, seconds=0.3
+    )
+    assert out["nodes"] == 16
+    assert out["incremental"]["snapshot_skipped_last_pass"] is True
+    assert out["incremental"]["client_calls_per_pass"] == 0.0
+    assert out["full_rebuild"]["passes_per_s"] > 0
+    assert out["speedup_x"] >= 10.0
+
+
+def test_single_event_latency_shapes():
+    out = bench.run_single_event_latency(
+        slices=4, hosts_per_slice=4, events=5
+    )
+    assert out["nodes_reclassified_per_event"] == 1
+    assert out["events"] == 5
+    assert 0 < out["median_event_to_snapshot_ms"] <= (
+        out["max_event_to_snapshot_ms"]
+    )
+
+
+def test_bench_check_gate(tmp_path):
+    """The CI threshold gate: passes at baseline, fails on a >tolerance
+    regression, fails on a silently dropped section."""
+    import json
+    import os
+    import sys
+
+    tools_dir = os.path.join(os.path.dirname(__file__), "..", "tools")
+    sys.path.insert(0, tools_dir)
+    try:
+        import bench_check
+    finally:
+        sys.path.remove(tools_dir)
+
+    baseline = {
+        "tolerance": 0.25,
+        "metrics": {
+            "noop.passes_per_s": {"baseline": 100.0, "direction": "higher"},
+            "latency.ms": {"baseline": 2.0, "direction": "lower"},
+        },
+    }
+    def bench_doc(passes, ms):
+        details = {"noop": {"passes_per_s": passes}}
+        if ms is not None:
+            details["latency"] = {"ms": ms}
+        return {"details": details}
+
+    assert bench_check.check(bench_doc(90.0, 2.2), baseline) == []
+    slow = bench_check.check(bench_doc(70.0, 2.2), baseline)
+    assert len(slow) == 1 and "noop.passes_per_s" in slow[0]
+    laggy = bench_check.check(bench_doc(90.0, 3.0), baseline)
+    assert len(laggy) == 1 and "latency.ms" in laggy[0]
+    missing = bench_check.check(bench_doc(90.0, None), baseline)
+    assert len(missing) == 1 and "missing" in missing[0]
+
+    # End to end through the file loader, stderr noise interleaved.
+    out = tmp_path / "bench-smoke.json"
+    out.write_text(
+        "bench: stage done: noop\n"
+        + json.dumps(bench_doc(90.0, 1.0)) + "\n"
+    )
+    loaded = bench_check.load_bench_line(str(out))
+    assert bench_check.check(loaded, baseline) == []
+
+
 def test_snapshot_read_bench_shapes():
     out = bench.run_snapshot_read_bench(slices=2, hosts_per_slice=4, passes=4)
     assert out["uncached"]["steady_reads_per_pass"] >= 3.0

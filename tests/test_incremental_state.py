@@ -1,0 +1,632 @@
+"""Incremental reconcile (ISSUE 5): delta-driven cluster state.
+
+The contract under test (docs/reconcile-data-path.md "The delta path"):
+
+* **equivalence** — the incrementally maintained ``ClusterUpgradeState``
+  is indistinguishable from a full rebuild after ANY event sequence:
+  adds, updates, deletes, rollouts, watch restarts, resync sweeps — the
+  randomized fuzzer drives all of them and compares after every step;
+* **settled passes are free** — no deltas means the cached state is
+  served with zero client reads, zero writes, and zero per-node CPU;
+* **a single node event reclassifies exactly one node** (PassStats);
+* **resyncs do not dirty** — a resync tick over a settled pool produces
+  zero deltas (the ISSUE 5 resync-storm fix);
+* **verify_every_n audits repair and count divergence** — a corrupted
+  incremental book is healed by the audit pass, and the damage is a
+  metric, not silent drift;
+* **an aborted apply invalidates** — the next pass is a full rebuild,
+  so dirty-filtered buckets cannot strand a half-transitioned node;
+* **terminal sequences are identical** — a full roll produces the same
+  per-node state-label sequence with the incremental source as with the
+  stateless rebuild source, at any apply width.
+"""
+
+import random
+import threading
+
+import pytest
+
+from k8s_operator_libs_tpu.api import DriverUpgradePolicySpec
+from k8s_operator_libs_tpu.kube import FakeCluster, Node
+from k8s_operator_libs_tpu.kube.sim import DaemonSetSimulator
+from k8s_operator_libs_tpu.upgrade import (
+    BuildStateError,
+    ClusterUpgradeStateManager,
+    DeviceClass,
+    TaskRunner,
+    UpgradeKeys,
+    UpgradeState,
+)
+from k8s_operator_libs_tpu.upgrade.state_manager import StateOptions
+from k8s_operator_libs_tpu.utils import IntOrString
+from builders import make_node, make_pod
+from test_informer import wait_until
+
+DEVICE = DeviceClass.tpu()
+KEYS = UpgradeKeys(DEVICE)
+NS = "driver-ns"
+LABELS = {"app": "driver"}
+
+POLICY = DriverUpgradePolicySpec(
+    auto_upgrade=True,
+    max_parallel_upgrades=0,
+    max_unavailable=IntOrString("100%"),
+)
+
+
+def build_cluster(node_count=6):
+    cluster = FakeCluster()
+    for i in range(node_count):
+        cluster.create(make_node(f"node-{i}"))
+    sim = DaemonSetSimulator(
+        cluster, name="driver", namespace=NS, match_labels=LABELS
+    )
+    sim.settle()
+    return cluster, sim
+
+
+def incremental_manager(cluster, verify_every_n=0, width=None, runner=None):
+    options = StateOptions(apply_width=width) if width else None
+    mgr = ClusterUpgradeStateManager(
+        cluster, DEVICE,
+        runner=runner or TaskRunner(inline=True),
+        options=options,
+    )
+    source = mgr.with_snapshot_from_informers(
+        NS, LABELS, resync_period_s=0.0,
+        incremental=True, verify_every_n=verify_every_n,
+    )
+    return mgr, source
+
+
+def full_manager(cluster, width=None, runner=None):
+    options = StateOptions(apply_width=width) if width else None
+    return ClusterUpgradeStateManager(
+        cluster, DEVICE,
+        runner=runner or TaskRunner(inline=True),
+        options=options,
+    )
+
+
+def informer_truth(source, cluster, kind):
+    """(namespace, name) -> resourceVersion for the objects ``kind``'s
+    informer is scoped to."""
+    kwargs = {}
+    if kind in ("Pod", "DaemonSet"):
+        kwargs = dict(namespace=source.namespace,
+                      label_selector=dict(source.driver_labels))
+    elif kind == "ControllerRevision":
+        kwargs = dict(namespace=source.namespace)
+    return {
+        (o.namespace, o.name): str(o.resource_version)
+        for o in cluster.list(kind, **kwargs)
+    }
+
+
+def deliveries_caught_up(source, cluster):
+    """True when every informer's store matches the cluster AND every
+    stored revision has been dispatched to handlers — i.e. the source's
+    dirty set reflects everything that happened. Only valid when no
+    record_write write-throughs are in play (those are store repairs
+    that never dispatch)."""
+    for kind in ("Node", "Pod", "DaemonSet", "ControllerRevision"):
+        inf = source.informer(kind)
+        truth = informer_truth(source, cluster, kind)
+        with inf._dispatch_lock:
+            dispatched = dict(inf._dispatched_rv)
+        with inf._lock:
+            store = {
+                key: str((raw.get("metadata") or {}).get(
+                    "resourceVersion", ""))
+                for key, raw in inf._store.items()
+            }
+        if store != truth or dispatched != truth:
+            return False
+    return True
+
+
+def stores_caught_up(source, cluster):
+    """Store-level catch-up only — the right barrier once provider
+    write-throughs are in play (their watch echoes never beat the
+    record_write store repair, so rv equality is the fixpoint)."""
+    for kind in ("Node", "Pod", "DaemonSet", "ControllerRevision"):
+        inf = source.informer(kind)
+        truth = informer_truth(source, cluster, kind)
+        with inf._lock:
+            store = {
+                key: str((raw.get("metadata") or {}).get(
+                    "resourceVersion", ""))
+                for key, raw in inf._store.items()
+            }
+        if store != truth:
+            return False
+    return True
+
+
+def state_shape(state):
+    """Comparable classification: node -> sorted
+    (bucket, pod name, owning-DS uid) tuples."""
+    shape = {}
+    for bucket, entries in state.node_states.items():
+        for ns in entries:
+            shape.setdefault(ns.node.name, []).append((
+                str(bucket),
+                ns.driver_pod.name,
+                ns.driver_daemonset.uid if ns.driver_daemonset else "",
+            ))
+    return {name: sorted(rows) for name, rows in shape.items()}
+
+
+def build_shape(mgr):
+    """build_state's result as a comparable shape, with BuildStateError
+    collapsed to a sentinel so 'both paths abort' is also equivalence."""
+    try:
+        return state_shape(mgr.build_state(NS, LABELS))
+    except BuildStateError:
+        return "BUILD_STATE_ERROR"
+
+
+def settle(cluster, sim, mgr, source, passes=4):
+    """Drive build+apply until the pool stops producing deltas."""
+    for _ in range(passes):
+        sim.step()
+        assert wait_until(lambda: stores_caught_up(source, cluster))
+        try:
+            mgr.apply_state(mgr.build_state(NS, LABELS), POLICY)
+        except BuildStateError:
+            continue
+    assert wait_until(lambda: stores_caught_up(source, cluster))
+    assert wait_until(lambda: not source.dirty().nodes)
+
+
+class TestEquivalenceFuzzer:
+    """Randomized event sequences: after every step the incremental
+    state must equal a from-scratch rebuild (or both must abort with the
+    same completeness error)."""
+
+    STATES = [
+        "", "upgrade-done", "upgrade-required", "cordon-required",
+        "wait-for-jobs-required", "pod-restart-required",
+        "uncordon-required", "upgrade-failed", "validation-required",
+    ]
+
+    @pytest.mark.parametrize("seed", [7, 1234])
+    def test_incremental_matches_full_rebuild(self, seed):
+        rng = random.Random(seed)
+        cluster, sim = build_cluster(node_count=6)
+        mgr_inc, source = incremental_manager(cluster)
+        mgr_full = full_manager(cluster)
+        extra_nodes: list[str] = []
+        rollouts = 0
+        try:
+            def flip_state_label(_):
+                name = f"node-{rng.randrange(6)}"
+                node = Node(cluster.get("Node", name).raw)
+                value = rng.choice(self.STATES)
+                if value:
+                    node.labels[KEYS.state_label] = value
+                else:
+                    node.labels.pop(KEYS.state_label, None)
+                cluster.update(node)
+
+            def flip_cordon(_):
+                name = f"node-{rng.randrange(6)}"
+                node = Node(cluster.get("Node", name).raw)
+                node.unschedulable = not node.unschedulable
+                cluster.update(node)
+
+            def flip_request_annotation(_):
+                name = f"node-{rng.randrange(6)}"
+                node = Node(cluster.get("Node", name).raw)
+                key = KEYS.upgrade_requested_annotation
+                if node.annotations.get(key):
+                    node.annotations.pop(key)
+                else:
+                    node.annotations[key] = "true"
+                cluster.update(node)
+
+            def rollout(_):
+                nonlocal rollouts
+                rollouts += 1
+                sim.set_template_hash(f"v{rollouts}")
+
+            def kubelet_step(_):
+                sim.step()
+
+            def delete_driver_pod(_):
+                # Opens a completeness-invariant window (desired !=
+                # found): BOTH paths must abort until the sim's kubelet
+                # recreates the pod.
+                name = f"node-{rng.randrange(6)}"
+                pod = cluster.get_or_none("Pod", sim.pod_name(name), NS)
+                if pod is not None:
+                    cluster.delete("Pod", pod.name, NS)
+
+            def churn_node(_):
+                # The simulated DaemonSet schedules onto every node, so
+                # an added node grows the pool and a removed node takes
+                # its driver pod with it (kubelet GC analog) — keeping
+                # the world consistent enough for both paths to build.
+                if extra_nodes and rng.random() < 0.5:
+                    name = extra_nodes.pop()
+                    pod = cluster.get_or_none("Pod", sim.pod_name(name), NS)
+                    if pod is not None:
+                        cluster.delete("Pod", pod.name, NS)
+                    cluster.delete("Node", name)
+                else:
+                    name = f"extra-{len(extra_nodes)}-{seed}"
+                    cluster.create(make_node(name))
+                    extra_nodes.append(name)
+
+            def watch_restart(_):
+                source.stop()
+                source.start(sync_timeout=30)
+
+            def resync_sweep(_):
+                for kind in ("Node", "Pod", "DaemonSet",
+                             "ControllerRevision"):
+                    source.informer(kind).resync_once()
+
+            ops = [
+                flip_state_label, flip_state_label, flip_cordon,
+                flip_request_annotation, rollout, kubelet_step,
+                kubelet_step, delete_driver_pod, churn_node,
+                watch_restart, resync_sweep,
+            ]
+            for step in range(50):
+                rng.choice(ops)(step)
+                assert wait_until(
+                    lambda: deliveries_caught_up(source, cluster)
+                ), f"seed={seed} step={step}: informers never caught up"
+                expected = build_shape(mgr_full)
+                got = build_shape(mgr_inc)
+                assert got == expected, (
+                    f"seed={seed} step={step}: incremental diverged"
+                )
+        finally:
+            source.stop()
+
+    def test_resync_sweep_does_not_dirty_settled_pool(self):
+        """The ISSUE 5 resync-storm pin: a resync tick over a settled
+        pool produces ZERO deltas — no dirtied node, no invalidation."""
+        cluster, sim = build_cluster(node_count=4)
+        mgr, source = incremental_manager(cluster)
+        try:
+            settle(cluster, sim, mgr, source)
+            invalidations = source.full_invalidations
+            events = source.delta_events
+            delivered = sum(
+                source.informer(kind).resync_once()
+                for kind in ("Node", "Pod", "DaemonSet",
+                             "ControllerRevision")
+            )
+            assert delivered == 0
+            delta = source.dirty()
+            assert not delta.nodes and not delta.full
+            assert source.full_invalidations == invalidations
+            assert source.delta_events == events
+        finally:
+            source.stop()
+
+
+class TestSettledAndSingleEvent:
+    def test_settled_pass_is_zero_work(self):
+        cluster, sim = build_cluster(node_count=8)
+        mgr, source = incremental_manager(cluster)
+        try:
+            settle(cluster, sim, mgr, source)
+            log = cluster.start_call_log()
+            state = mgr.build_state(NS, LABELS)
+            mgr.apply_state(state, POLICY)
+            cluster.stop_call_log()
+            stats = mgr.last_pass_stats
+            assert stats.snapshot_incremental
+            assert stats.snapshot_skipped
+            assert not stats.full_rebuild
+            assert stats.nodes_reclassified == 0
+            assert stats.dirty_node_count == 0
+            assert stats.reads_issued == 0
+            assert stats.writes_issued == 0
+            assert state.dirty_nodes == frozenset()
+            # Zero client traffic — not one read, not one write.
+            assert [c for c in log if c[0] in
+                    ("get", "list", "patch", "update", "create")] == []
+        finally:
+            source.stop()
+
+    def test_single_node_event_reclassifies_exactly_one_node(self):
+        cluster, sim = build_cluster(node_count=8)
+        mgr, source = incremental_manager(cluster)
+        try:
+            settle(cluster, sim, mgr, source)
+            node = Node(cluster.get("Node", "node-3").raw)
+            node.annotations["example.com/poke"] = "1"
+            cluster.update(node)
+            assert wait_until(lambda: "node-3" in source.dirty().nodes)
+            state = mgr.build_state(NS, LABELS)
+            stats = mgr.last_pass_stats
+            assert stats.nodes_reclassified == 1
+            assert stats.dirty_node_count == 1
+            assert state.dirty_nodes == frozenset({"node-3"})
+            # The dirty-filtered bucket view walks exactly that node.
+            assert [
+                ns.node.name
+                for ns in state.reactive_nodes_in(UpgradeState.DONE)
+            ] == ["node-3"]
+        finally:
+            source.stop()
+
+    def test_delta_pass_skips_pods_owned_outside_driver_ds(self):
+        """Full-path parity on SELECTION: the full rebuild classifies
+        only ds-owned + orphaned pods, so a delta pass must not invent
+        an entry for a pod owned by something that is no driver
+        DaemonSet (a stray ReplicaSet pod wearing the driver labels, or
+        a pod still terminating after its DS was deleted)."""
+        cluster, sim = build_cluster(node_count=4)
+        mgr, source = incremental_manager(cluster)
+        try:
+            settle(cluster, sim, mgr, source)
+            cluster.create(make_pod(
+                "stray", namespace=NS, node_name="node-2",
+                labels=LABELS, controlled=True,
+            ))
+            assert wait_until(lambda: stores_caught_up(source, cluster))
+            assert "node-2" in source.dirty().nodes
+            incremental_shape = build_shape(mgr)
+            assert not mgr.last_pass_stats.full_rebuild
+            assert build_shape(full_manager(cluster)) == incremental_shape
+        finally:
+            source.stop()
+
+    def test_delta_hit_rate_reported(self):
+        cluster, sim = build_cluster(node_count=4)
+        mgr, source = incremental_manager(cluster)
+        try:
+            settle(cluster, sim, mgr, source)
+            for _ in range(3):
+                mgr.apply_state(mgr.build_state(NS, LABELS), POLICY)
+            stats = mgr.last_pass_stats
+            assert stats.snapshot_incremental
+            assert 0.0 < stats.delta_hit_rate <= 1.0
+        finally:
+            source.stop()
+
+
+class TestDeltaRetirement:
+    """clean() must retire exactly what the pass consumed: a node
+    re-marked AFTER dirty() — even though its name was already in the
+    consumed set — stays dirty, because the pass may have read the
+    node's store from before the re-marking event."""
+
+    def test_remark_during_pass_survives_clean(self):
+        cluster, sim = build_cluster(node_count=4)
+        mgr, source = incremental_manager(cluster)
+        try:
+            settle(cluster, sim, mgr, source)
+            source._mark_node("node-1")
+            delta = source.dirty()
+            assert "node-1" in delta.nodes
+            # The mid-pass event: same node, after the snapshot.
+            source._mark_node("node-1")
+            source.clean(delta)
+            assert "node-1" in source.dirty().nodes, (
+                "a re-marked node must survive the consumed delta's clean"
+            )
+            # And a clean of the NEW delta retires it for good.
+            source.clean(source.dirty())
+            assert not source.dirty().nodes
+        finally:
+            source.stop()
+
+    def test_double_clean_cannot_absorb_a_post_retirement_remark(self):
+        """The audit path cleans the same delta twice (once in its
+        catch-up, once after priming). A node popped by the first clean
+        and re-marked by a mid-rebuild event must survive the second —
+        mark generations are monotonic across retirement, never
+        per-node counters that restart at 1 and collide."""
+        cluster, sim = build_cluster(node_count=4)
+        mgr, source = incremental_manager(cluster)
+        try:
+            settle(cluster, sim, mgr, source)
+            source._mark_node("node-1")
+            delta = source.dirty()
+            source.clean(delta)          # the catch-up's clean
+            source._mark_node("node-1")  # mid-rebuild event
+            source.clean(delta)          # the post-prime clean
+            assert "node-1" in source.dirty().nodes, (
+                "second clean of a consumed delta absorbed a fresh mark"
+            )
+        finally:
+            source.stop()
+
+    def test_drifted_ds_pod_counts_self_heal_without_intervention(self):
+        """A drifted per-DS pod count (the un-healable lost-delivery
+        case) must not wedge the delta path: the failing completeness
+        check invalidates, so the RETRY is a full rebuild whose prime()
+        re-anchors the counts to the settled Pod store — no operator
+        intervention, no waiting for an unrelated rollout delta."""
+        cluster, sim = build_cluster(node_count=4)
+        mgr, source = incremental_manager(cluster)
+        try:
+            settle(cluster, sim, mgr, source)
+            with source._delta_lock:
+                uid = next(iter(source._ds_pod_counts))
+                source._ds_pod_counts[uid] -= 1  # simulate a lost event
+            source._mark_node("node-0")
+            with pytest.raises(BuildStateError):
+                mgr.build_state(NS, LABELS)  # delta pass sees the drift
+            # The plain level-driven retry IS the repair.
+            state = mgr.build_state(NS, LABELS)
+            assert mgr.last_pass_stats.full_rebuild
+            assert source.ds_pod_count(uid) == 4
+            mgr.apply_state(state, POLICY)
+            source._mark_node("node-0")
+            mgr.build_state(NS, LABELS)  # delta pass healthy again
+            assert not mgr.last_pass_stats.full_rebuild
+        finally:
+            source.stop()
+
+    def test_count_divergences_excludes_racing_nodes(self):
+        cluster, sim = build_cluster(node_count=4)
+        mgr, source = incremental_manager(cluster)
+        try:
+            settle(cluster, sim, mgr, source)
+            ours = {"node-1": [("a",)], "node-2": [("b",)]}
+            truth = {"node-1": [("a",)], "node-2": [("CHANGED",)]}
+            # node-2's difference raced a mid-audit delta: logged, not
+            # counted — verify_divergences_total stays alertable.
+            counted = source.count_divergences(
+                ours, truth, racing=frozenset({"node-2"})
+            )
+            assert counted == 0
+            assert source.verify_divergences_total == 0
+            # Without the racing attribution it IS a tracking bug.
+            counted = source.count_divergences(ours, truth)
+            assert counted == 1
+            assert source.verify_divergences_total == 1
+        finally:
+            source.stop()
+
+
+class TestVerifyAudit:
+    def test_audit_repairs_and_counts_corruption(self):
+        cluster, sim = build_cluster(node_count=6)
+        mgr, source = incremental_manager(cluster)
+        try:
+            settle(cluster, sim, mgr, source)
+            # Corrupt the incremental book: drop one node's entries, as
+            # a dropped delta would have.
+            source.update_node("node-2", [])
+            assert "node-2" not in state_shape(source.cached_state())
+            # Force the next build to be an audit pass.
+            source.verify_every_n = 1
+            state = mgr.build_state(NS, LABELS)
+            stats = mgr.last_pass_stats
+            assert stats.full_rebuild
+            assert stats.verify_divergences == 1
+            assert source.verify_divergences_total == 1
+            # Repaired: the node is classified again...
+            assert "node-2" in state_shape(state)
+            # ...and a clean audit right after finds nothing.
+            mgr.build_state(NS, LABELS)
+            assert mgr.last_pass_stats.verify_divergences == 0
+            assert source.verify_divergences_total == 1
+        finally:
+            source.stop()
+
+    def test_aborted_apply_invalidates_incremental_state(self):
+        cluster, sim = build_cluster(node_count=4)
+        mgr, source = incremental_manager(cluster)
+        try:
+            settle(cluster, sim, mgr, source)
+            state = mgr.build_state(NS, LABELS)
+            boom = RuntimeError("injected bucket failure")
+
+            def explode(*a, **k):
+                raise boom
+
+            mgr.common.process_done_or_unknown_nodes = explode
+            with pytest.raises(RuntimeError):
+                mgr.apply_state(state, POLICY)
+            delta = source.dirty()
+            assert delta.full, (
+                "aborted apply must force the next pass to rebuild"
+            )
+        finally:
+            source.stop()
+
+
+class TestRollEquivalence:
+    """A full rolling upgrade driven through the incremental source
+    produces the exact per-node state-label sequence of the stateless
+    full-rebuild source, at width 1 and width 8."""
+
+    NODES = 256
+
+    def _transitions(self, cluster):
+        transitions = {}
+        lock = threading.Lock()
+
+        def record(event, obj, old):
+            if obj.get("kind") != "Node":
+                return
+            name = obj["metadata"]["name"]
+            label = (obj["metadata"].get("labels") or {}).get(
+                KEYS.state_label
+            )
+            old_label = (
+                ((old or {}).get("metadata") or {}).get("labels") or {}
+            ).get(KEYS.state_label)
+            if label != old_label:
+                with lock:
+                    transitions.setdefault(name, []).append(label)
+
+        cluster.subscribe(record)
+        return transitions
+
+    def _roll(self, incremental, width=1, threaded=False):
+        cluster = FakeCluster()
+        for i in range(self.NODES):
+            cluster.create(make_node(f"node-{i}"))
+        sim = DaemonSetSimulator(
+            cluster, name="driver", namespace=NS, match_labels=LABELS
+        )
+        sim.settle()
+        runner = (
+            TaskRunner(max_workers=max(width, 1))
+            if threaded else TaskRunner(inline=True)
+        )
+        source = None
+        if incremental:
+            mgr, source = incremental_manager(
+                cluster, width=width, runner=runner
+            )
+        else:
+            mgr = full_manager(cluster, width=width, runner=runner)
+        transitions = self._transitions(cluster)
+        sim.set_template_hash("v2")
+        try:
+            for _ in range(120):
+                sim.step()
+                if source is not None:
+                    assert wait_until(
+                        lambda: stores_caught_up(source, cluster)
+                    )
+                try:
+                    mgr.apply_state(mgr.build_state(NS, LABELS), POLICY)
+                except BuildStateError:
+                    continue  # transient mid-recreate incompleteness
+                sim.step()
+                done = all(
+                    ((cluster.peek("Node", f"node-{i}") or {})
+                     .get("metadata", {}).get("labels") or {})
+                    .get(KEYS.state_label) == "upgrade-done"
+                    for i in range(self.NODES)
+                )
+                if done and sim.all_pods_ready_and_current():
+                    break
+            else:
+                raise AssertionError(
+                    f"incremental={incremental} width={width}: "
+                    "roll did not converge"
+                )
+        finally:
+            if threaded:
+                runner.wait_idle(timeout=10)
+                runner.shutdown()
+            if source is not None:
+                source.stop()
+        return transitions
+
+    def test_terminal_sequences_match_full_rebuild_at_any_width(self):
+        reference = self._roll(incremental=False, width=1)
+        inc_serial = self._roll(incremental=True, width=1)
+        inc_wide = self._roll(incremental=True, width=8, threaded=True)
+        assert set(reference) == set(inc_serial) == set(inc_wide)
+        for name in reference:
+            assert inc_serial[name] == reference[name], (
+                f"{name}: {inc_serial[name]} != {reference[name]}"
+            )
+            assert inc_wide[name] == reference[name], (
+                f"{name}: {inc_wide[name]} != {reference[name]}"
+            )

@@ -449,37 +449,94 @@ class TestApiserverRestart:
 
 
 class TestResync:
-    """client-go's resync period: every cached object re-delivered to
-    handlers as MODIFIED with old == new (UpdateFunc(obj, obj)) — the
-    self-heal tick; off by default."""
+    """client-go's resync period, minus the replay storm (ISSUE 5): a
+    sweep re-delivers ONLY store entries ahead of dispatch (a
+    record_write repair whose watch echo never arrived) as MODIFIED with
+    old == new (UpdateFunc(obj, obj)); a settled store delivers zero
+    events. Off by default."""
 
-    def test_resync_redelivers_cached_state(self):
+    def test_resync_redelivers_only_store_ahead_of_dispatch(self):
         cluster = FakeCluster()
         cluster.create(make_node("rs-a"))
         cluster.create(make_node("rs-b"))
         events = []
-        informer = Informer(cluster, "Node", resync_period_s=0.2)
+        informer = Informer(cluster, "Node")
         informer.add_event_handler(
             lambda t, obj, old: events.append((t, obj.name, old))
         )
         with informer:
             assert informer.wait_for_sync(10)
-            deadline = time.monotonic() + 10
-            while time.monotonic() < deadline:
-                resyncs = [
-                    e for e in events
-                    if e[0] == "MODIFIED" and e[2] is not None
-                    and e[2].name == e[1]
-                ]
-                if len({name for _, name, _ in resyncs}) == 2:
-                    break
-                time.sleep(0.05)
-            else:
-                raise AssertionError(f"no full resync within deadline: {events}")
-        # Resync deliveries carry old == new (the UpdateFunc(obj, obj)
-        # shape), distinguishing them from real watch MODIFIEDs.
-        resync = next(e for e in events if e[0] == "MODIFIED")
-        assert resync[2].raw == informer.get(resync[1]).raw
+            assert wait_until(lambda: len(events) == 2)  # seed ADDEDs
+            # Settled store: a sweep coalesces everything away.
+            assert informer.resync_once() == 0
+            assert len(events) == 2
+            # Push a NEWER object via record_write — a store repair that
+            # never dispatches. The next sweep must re-deliver exactly
+            # that object, in the UpdateFunc(obj, obj) shape.
+            repaired = informer.get("rs-a")
+            rv = int(repaired.raw["metadata"]["resourceVersion"])
+            repaired.raw["metadata"]["resourceVersion"] = str(rv + 1000)
+            repaired.raw["metadata"].setdefault("labels", {})["x"] = "y"
+            informer.record_write(repaired)
+            assert informer.resync_once() == 1
+            resyncs = [e for e in events if e[0] == "MODIFIED"]
+            assert [(e[1]) for e in resyncs] == ["rs-a"]
+            assert resyncs[0][2] is not None
+            assert resyncs[0][2].raw == informer.get("rs-a").raw
+            # Re-delivery marks the revision dispatched: a second sweep
+            # over the again-settled store is silent.
+            assert informer.resync_once() == 0
+
+    def test_resync_redelivers_after_handler_failure(self):
+        """The other self-heal a resync exists for: a delivery that died
+        mid-flight (a handler raised) is NOT marked dispatched, so the
+        next sweep re-delivers that revision to every handler.
+        Deterministic setup: record_write puts the store ahead of
+        dispatch without any watch-thread delivery to race, so the
+        poisoned delivery can only come from our own sweep."""
+        cluster = FakeCluster()
+        cluster.create(make_node("rs-crash"))
+        events = []
+        fail_next = [False]
+
+        def fragile(t, obj, old):
+            if fail_next[0]:
+                fail_next[0] = False
+                raise RuntimeError("handler died mid-delivery")
+            events.append((t, obj.name))
+
+        informer = Informer(cluster, "Node")
+        informer.add_event_handler(fragile)
+        with informer:
+            assert informer.wait_for_sync(10)
+            assert wait_until(lambda: len(events) == 1)  # seed ADDED
+            repaired = informer.get("rs-crash")
+            rv = int(repaired.raw["metadata"]["resourceVersion"])
+            repaired.raw["metadata"]["resourceVersion"] = str(rv + 1000)
+            informer.record_write(repaired)
+            fail_next[0] = True
+            # The sweep delivers (attempt counted) but the handler dies:
+            # nothing lands in events and the key stays behind dispatch.
+            assert informer.resync_once() == 1
+            assert events == [("ADDED", "rs-crash")]
+            # The next sweep re-delivers the lost revision.
+            assert informer.resync_once() == 1
+            assert events[-1] == ("MODIFIED", "rs-crash")
+            # Healed: the store is settled again.
+            assert informer.resync_once() == 0
+
+    def test_periodic_resync_on_settled_store_stays_silent(self):
+        cluster = FakeCluster()
+        cluster.create(make_node("rs-quiet"))
+        events = []
+        informer = Informer(cluster, "Node", resync_period_s=0.1)
+        informer.add_event_handler(
+            lambda t, obj, old: events.append(t)
+        )
+        with informer:
+            assert informer.wait_for_sync(10)
+            time.sleep(0.5)  # several resync ticks
+        assert events == ["ADDED"]  # the seed only — no replay storm
 
     def test_resync_disabled_by_default(self):
         cluster = FakeCluster()

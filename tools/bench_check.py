@@ -1,0 +1,145 @@
+"""bench_check — threshold gate for the CI ``bench-smoke`` job.
+
+Compares a bench JSON line (``python bench.py --sections ...`` output)
+against a committed baseline (``tools/bench_smoke_baseline.json``) and
+exits nonzero when any tracked metric regresses by more than the
+baseline's tolerance (default 25%) — so "the incremental path quietly
+became O(nodes) again" fails the PR instead of surfacing rounds later
+in the artifact.
+
+Baseline semantics: the committed values are deliberately CONSERVATIVE
+floors (roughly half of a dev-machine run), because CI runners vary;
+the gate exists to catch order-of-magnitude regressions (a lost fast
+path, an accidental O(n^2)), not single-digit noise. Ratio metrics
+(``speedup_x``) are machine-independent and carry most of the signal.
+
+Usage:
+    python tools/bench_check.py bench-smoke.json [baseline.json]
+    python tools/bench_check.py bench-smoke.json --update   # re-floor
+
+Baseline format::
+
+    {"tolerance": 0.25,
+     "metrics": {"settled_pool_noop.speedup_x":
+                 {"baseline": 100.0, "direction": "higher"}}}
+
+``direction: higher`` fails when value < baseline * (1 - tolerance);
+``direction: lower`` (latencies) fails when value > baseline *
+(1 + tolerance). A metric missing from the bench output fails too — a
+silently dropped section must not pass the gate.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "bench_smoke_baseline.json"
+)
+
+
+def load_bench_line(path: str) -> dict:
+    """The bench prints exactly ONE JSON line; tolerate surrounding
+    stderr noise captured into the same file by taking the last line
+    that parses as a JSON object with a ``details`` key."""
+    result = None
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                doc = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(doc, dict) and "details" in doc:
+                result = doc
+    if result is None:
+        raise SystemExit(f"bench_check: no bench JSON line found in {path}")
+    return result
+
+
+def resolve(details: dict, dotted: str):
+    cur = details
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def check(bench: dict, baseline: dict) -> list[str]:
+    tolerance = float(baseline.get("tolerance", 0.25))
+    failures = []
+    for dotted, spec in baseline.get("metrics", {}).items():
+        value = resolve(bench.get("details", {}), dotted)
+        floor = float(spec["baseline"])
+        direction = spec.get("direction", "higher")
+        if not isinstance(value, (int, float)):
+            failures.append(f"{dotted}: missing from bench output")
+            continue
+        if direction == "lower":
+            limit = floor * (1 + tolerance)
+            if value > limit:
+                failures.append(
+                    f"{dotted}: {value} exceeds {limit:.3f} "
+                    f"(baseline {floor}, tolerance {tolerance:.0%})"
+                )
+        else:
+            limit = floor * (1 - tolerance)
+            if value < limit:
+                failures.append(
+                    f"{dotted}: {value} below {limit:.3f} "
+                    f"(baseline {floor}, tolerance {tolerance:.0%})"
+                )
+    return failures
+
+
+def update_baseline(bench: dict, baseline: dict, path: str) -> None:
+    """Re-floor every tracked metric at half the measured value (double
+    for lower-is-better) — the conservative-floor convention."""
+    for dotted, spec in baseline.get("metrics", {}).items():
+        value = resolve(bench.get("details", {}), dotted)
+        if not isinstance(value, (int, float)):
+            raise SystemExit(
+                f"bench_check --update: {dotted} missing from bench output"
+            )
+        if spec.get("direction") == "lower":
+            spec["baseline"] = round(value * 2, 3)
+        else:
+            spec["baseline"] = round(value / 2, 3)
+    with open(path, "w") as f:
+        json.dump(baseline, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"bench_check: baseline re-floored at {path}")
+
+
+def main(argv: list[str]) -> int:
+    args = [a for a in argv if a != "--update"]
+    update = "--update" in argv
+    if not args:
+        print(__doc__, file=sys.stderr)
+        return 2
+    bench_path = args[0]
+    baseline_path = args[1] if len(args) > 1 else DEFAULT_BASELINE
+    bench = load_bench_line(bench_path)
+    with open(baseline_path) as f:
+        baseline = json.load(f)
+    if update:
+        update_baseline(bench, baseline, baseline_path)
+        return 0
+    failures = check(bench, baseline)
+    if failures:
+        print("bench_check: PERFORMANCE REGRESSION", file=sys.stderr)
+        for failure in failures:
+            print(f"  {failure}", file=sys.stderr)
+        return 1
+    tracked = len(baseline.get("metrics", {}))
+    print(f"bench_check: {tracked} metrics within tolerance")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
