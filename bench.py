@@ -552,7 +552,7 @@ def run_state_machine_microbench(
     fake clientset — control-plane cost with no real cluster and zero JAX.
     Each pass reconciles the whole pool (build_state + apply_state), so
     ``passes_per_s`` is a per-POOL number, not per-node;
-    ``rolls_completed`` counts full 13-state rollouts finished in the one
+    ``rolls_completed`` counts full state-machine rollouts finished in the one
     measured second."""
     cluster, sim = build_pool(slices=slices, hosts_per_slice=hosts_per_slice)
     mgr = ClusterUpgradeStateManager(
@@ -889,6 +889,219 @@ def run_apply_width_bench(
     return out
 
 
+def run_live_workload_roll(
+    slices: int = 4, hosts_per_slice: int = 4, warmup_ticks: int = 10
+) -> dict:
+    """ISSUE 6 headline — the first benchmark of the actual north-star
+    scenario: roll a 16-node pool under a continuously-training
+    (burnin-style) victim workload and report disruption in **lost
+    steps** (steps re-trained after restore; Guard, PAPERS.md), not pod
+    deaths.
+
+    Three rolls, all against one victim training pod per node
+    (kube/sim.py CheckpointingWorkloadSimulator):
+
+    * **full_restart_baseline** — evict-only (the reference shape):
+      every evicted victim restarts from step 0, so it re-trains its
+      whole history;
+    * **checkpointed** — the checkpoint-coordinated drain arc
+      (docs/checkpoint-drain.md): the drain gates on checkpoint acks and
+      uncordon is restore-verified, so each victim re-trains only the
+      steps after its checkpoint. HARD-ASSERTED: zero escalations, every
+      node restore-verified, and strictly fewer lost steps than the
+      baseline;
+    * **escalation_drill** — one deliberately non-acking (wedged) victim
+      under a 1 s deadline: HARD-ASSERTED that it escalates to a plain
+      drain and the roll still completes — graceful degradation, never a
+      stalled pool.
+    """
+    from k8s_operator_libs_tpu.api import CheckpointSpec, DrainSpec
+    from k8s_operator_libs_tpu.kube.sim import CheckpointingWorkloadSimulator
+
+    nodes = slices * hosts_per_slice
+
+    def one_roll(
+        checkpoint: bool,
+        nonacking: tuple = (),
+        deadline_s: int = 300,
+        pass_sleep: float = 0.0,
+    ) -> dict:
+        cluster, sim = build_pool(
+            slices=slices, hosts_per_slice=hosts_per_slice
+        )
+        workload = CheckpointingWorkloadSimulator(
+            cluster, KEYS, nonacking=nonacking
+        )
+        for _ in range(warmup_ticks):
+            workload.step()  # accrue training history worth losing
+        mgr = ClusterUpgradeStateManager(
+            cluster, DEVICE, runner=TaskRunner(inline=True)
+        )
+        # Trivial hook: the validation bucket must run (it carries the
+        # restore-verified uncordon step) but this section measures the
+        # control plane + workload disruption, not device health.
+        mgr.with_validation_enabled(validation_hook=lambda node: True)
+        policy = DriverUpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=4,
+            max_unavailable=IntOrString("25%"),
+            drain=DrainSpec(enable=True, force=True, timeout_seconds=30),
+            checkpoint=(
+                CheckpointSpec(
+                    enable=True,
+                    pod_selector="app=trainer",
+                    timeout_seconds=deadline_s,
+                )
+                if checkpoint
+                else None
+            ),
+        )
+        sim.set_template_hash("libtpu-v2")
+        start = time.perf_counter()
+
+        def per_pass():
+            workload.step()
+            if pass_sleep:
+                time.sleep(pass_sleep)
+
+        passes = drive_to_convergence(
+            cluster, sim, mgr, policy, per_pass=per_pass
+        )
+        elapsed = time.perf_counter() - start
+        for _ in range(3):
+            workload.step()  # evicted victims reschedule + restore
+        totals = mgr.common.checkpoint_manager.totals()
+        return {
+            "lost_steps": workload.lost_steps(),
+            "total_steps_trained": workload.total_steps(),
+            "restarts": workload.restarts(),
+            "escalations": totals["escalations"],
+            "checkpoints_completed": totals["completions"],
+            "restores_verified": totals["restores_verified"],
+            "passes": passes,
+            "wall_s": round(elapsed, 3),
+        }
+
+    baseline = one_roll(checkpoint=False)
+    checkpointed = one_roll(checkpoint=True)
+    # One wedged victim, 1s deadline; the sleep gives the deadline wall
+    # time to expire inside the pass loop. The victim is derived from
+    # the pool's actual node naming (it differs between the slices==1
+    # and slices>1 shapes of build_pool).
+    probe_cluster, _ = build_pool(
+        slices=slices, hosts_per_slice=hosts_per_slice
+    )
+    wedged = sorted(probe_cluster.object_names("Node"))[0]
+    drill = one_roll(
+        checkpoint=True,
+        nonacking=(wedged,),
+        deadline_s=1,
+        pass_sleep=0.05,
+    )
+    if checkpointed["escalations"] != 0:
+        raise RuntimeError(
+            "live_workload_roll: happy path escalated "
+            f"{checkpointed['escalations']} node(s); acking victims must "
+            "never hit the deadline"
+        )
+    if checkpointed["restores_verified"] != nodes:
+        raise RuntimeError(
+            "live_workload_roll: "
+            f"{checkpointed['restores_verified']}/{nodes} nodes "
+            "restore-verified; every uncordon must be"
+        )
+    if checkpointed["lost_steps"] >= baseline["lost_steps"]:
+        raise RuntimeError(
+            "live_workload_roll: checkpoint coordination lost "
+            f"{checkpointed['lost_steps']} steps vs full-restart baseline "
+            f"{baseline['lost_steps']} — must be strictly fewer"
+        )
+    if drill["escalations"] < 1:
+        raise RuntimeError(
+            "live_workload_roll: the non-acking victim never hit the "
+            "deadline escalation (roll should have degraded, not waited)"
+        )
+    ratio = (
+        round(checkpointed["lost_steps"] / baseline["lost_steps"], 4)
+        if baseline["lost_steps"] > 0
+        else None
+    )
+    return {
+        "nodes": nodes,
+        "victims": nodes,
+        "warmup_ticks": warmup_ticks,
+        "full_restart_baseline": baseline,
+        "checkpointed": checkpointed,
+        "escalation_drill": {
+            **drill,
+            "nonacking_nodes": [wedged],
+            "deadline_s": 1,
+            "completed": True,  # drive_to_convergence raised otherwise
+        },
+        "lost_steps_vs_baseline": ratio,
+        "lost_steps_saved": baseline["lost_steps"] - checkpointed["lost_steps"],
+    }
+
+
+def run_ring_bandwidth(payload_mb: float = 1.0, devices: int = 8) -> dict:
+    """ROADMAP item 4 / ISSUE 6 satellite: actually measure
+    ``ring_gbytes_per_s`` — every BENCH round before this one published
+    0.0, because the calibration section's ring number is gated on
+    multi-chip hardware this rig does not have. This section times the
+    ``ops/collectives.py`` ring all-reduce (``psum_bandwidth``) and ring
+    ppermute on the hermetic 8-device CPU mesh in a subprocess (the same
+    pattern as ``cpu_mesh_fabric``), reporting real measured bytes/s —
+    labeled ``platform: cpu``, so it is measurement-path evidence, never
+    mistakable for TPU ICI bandwidth."""
+    import subprocess
+
+    from k8s_operator_libs_tpu.utils.jaxenv import hermetic_cpu_env
+
+    code = (
+        "import json\n"
+        "import jax, numpy as np\n"
+        "from jax.sharding import Mesh\n"
+        "from k8s_operator_libs_tpu.ops.collectives import (\n"
+        "    ppermute_ring, psum_bandwidth)\n"
+        "mesh = Mesh(np.array(jax.devices()), ('x',))\n"
+        f"ar = psum_bandwidth(mesh, 'x', payload_mb={payload_mb})\n"
+        f"ring = ppermute_ring(mesh, 'x', payload_mb={payload_mb})\n"
+        "print(json.dumps({\n"
+        "    'ok': ar.ok and ring.ok,\n"
+        "    'ring_allreduce_gbytes_per_s': round(ar.gbytes_per_s, 3),\n"
+        "    'ring_allreduce_elapsed_s': round(ar.elapsed_s, 6),\n"
+        "    'ring_ppermute_gbytes_per_s': round(ring.gbytes_per_s, 3),\n"
+        "    'error': ar.error or ring.error,\n"
+        "}))\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        env=hermetic_cpu_env(devices),
+        capture_output=True,
+        text=True,
+        timeout=240,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"ring_bandwidth subprocess failed: {proc.stderr[-400:]}"
+        )
+    doc = json.loads(proc.stdout.strip().splitlines()[-1])
+    if not doc["ok"] or doc["ring_allreduce_gbytes_per_s"] <= 0.0:
+        raise RuntimeError(f"ring_bandwidth: no real measurement: {doc}")
+    doc.update(
+        {
+            "platform": "cpu",  # NOT fabric evidence for TPU ICI
+            "n_devices": devices,
+            "payload_mb": payload_mb,
+            "convention": "NCCL-style bus bandwidth "
+            "2(n-1)/n * payload / time (nccl-tests busbw column)",
+            "note": "CPU-interconnect numbers; proves the ring-allreduce "
+            "measurement path, not TPU ICI bandwidth",
+        }
+    )
+    return doc
+
+
 def run_calibration() -> dict:
     """One full-battery gate run on the real devices.
 
@@ -1005,6 +1218,8 @@ SECTIONS = {
     "apply_width": run_apply_width_bench,
     "settled_pool_noop": run_settled_pool_noop,
     "single_event_latency": run_single_event_latency,
+    "live_workload_roll": run_live_workload_roll,
+    "ring_bandwidth": run_ring_bandwidth,
 }
 
 
@@ -1105,6 +1320,14 @@ def main() -> None:
     single_event = run_single_event_latency()
     _progress("single_event_latency")
 
+    # Checkpoint-coordinated drain sections (ISSUE 6): the north-star
+    # live-load roll measured in lost training steps, and the first real
+    # ring-allreduce bandwidth figure (ROADMAP item 4).
+    live_roll = run_live_workload_roll()
+    _progress("live_workload_roll")
+    ring_bw = run_ring_bandwidth()
+    _progress("ring_bandwidth")
+
     details = {
         "backend": backend,
         # Trial counts derived from the actual result objects — never a
@@ -1138,6 +1361,8 @@ def main() -> None:
         "apply_width": apply_width,
         "settled_pool_noop": settled_noop,
         "single_event_latency": single_event,
+        "live_workload_roll": live_roll,
+        "ring_bandwidth": ring_bw,
         "gate_cold_vs_warm": gate_split,
         "devices": [str(d) for d in jax.devices()],
         "calibration": calibration,
@@ -1180,6 +1405,13 @@ def main() -> None:
             ]["passes_per_s"],
             "single_event_median_ms": single_event[
                 "median_event_to_snapshot_ms"
+            ],
+            "live_roll_lost_steps_vs_baseline": live_roll[
+                "lost_steps_vs_baseline"
+            ],
+            "live_roll_lost_steps_saved": live_roll["lost_steps_saved"],
+            "ring_allreduce_gbytes_per_s": ring_bw[
+                "ring_allreduce_gbytes_per_s"
             ],
         },
         "metric": "v5e-16 pool libtpu rolling-upgrade wall-clock "

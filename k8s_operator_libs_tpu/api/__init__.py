@@ -1,13 +1,25 @@
 from .upgrade_v1alpha1 import (
+    WORKLOAD_CHECKPOINT_API_VERSION,
+    WORKLOAD_CHECKPOINT_KIND,
+    CheckpointSpec,
     DrainSpec,
     DriverUpgradePolicySpec,
     PodDeletionSpec,
     WaitForCompletionSpec,
+    make_workload_checkpoint,
+    workload_checkpoint_name,
+    workload_checkpoint_step,
 )
 
 __all__ = [
+    "WORKLOAD_CHECKPOINT_API_VERSION",
+    "WORKLOAD_CHECKPOINT_KIND",
+    "CheckpointSpec",
     "DrainSpec",
     "DriverUpgradePolicySpec",
     "PodDeletionSpec",
     "WaitForCompletionSpec",
+    "make_workload_checkpoint",
+    "workload_checkpoint_name",
+    "workload_checkpoint_step",
 ]

@@ -19,6 +19,66 @@ from ..utils.intstr import IntOrString
 DEFAULT_MAX_UNAVAILABLE = IntOrString("25%")
 DEFAULT_DRAIN_TIMEOUT_SECONDS = 300
 DEFAULT_POD_DELETION_TIMEOUT_SECONDS = 300
+DEFAULT_CHECKPOINT_TIMEOUT_SECONDS = 300
+
+# ----------------------------------------------------------------------
+# WorkloadCheckpoint CR contract (docs/checkpoint-drain.md; no reference
+# analog — grounded in CRIUgpu, PAPERS.md). The workload side of the
+# checkpoint-coordinated drain: when the controller asks a pod to
+# checkpoint (checkpoint_request_annotation), the workload persists its
+# state and records it as a WorkloadCheckpoint CR named after the pod,
+# then acks on the pod. The restore-verified uncordon step later checks
+# these CRs against the node's checkpoint manifest.
+#
+# This module owns the CONTRACT (names, spec shape); the REST-registry
+# entry lives in kube/resources._bootstrap so kube surfaces know the
+# kind without importing api/ — and so importing these dataclasses never
+# pulls the kube package. A regression test pins the two in sync.
+# ----------------------------------------------------------------------
+WORKLOAD_CHECKPOINT_KIND = "WorkloadCheckpoint"
+WORKLOAD_CHECKPOINT_API_VERSION = "upgrade.tpu-operator.dev/v1alpha1"
+WORKLOAD_CHECKPOINT_PLURAL = "workloadcheckpoints"
+
+
+def workload_checkpoint_name(pod_name: str) -> str:
+    """Deterministic CR name for a pod's checkpoint — both sides of the
+    contract (controller verification, workload save/restore) derive it
+    from the pod name, so neither needs to discover the other's naming."""
+    return f"{pod_name}-checkpoint"
+
+
+def make_workload_checkpoint(
+    pod_name: str,
+    namespace: str,
+    node_name: str,
+    step: int,
+    request_id: str = "",
+) -> dict[str, Any]:
+    """Raw WorkloadCheckpoint object (create/update through any client)."""
+    return {
+        "apiVersion": WORKLOAD_CHECKPOINT_API_VERSION,
+        "kind": WORKLOAD_CHECKPOINT_KIND,
+        "metadata": {
+            "name": workload_checkpoint_name(pod_name),
+            "namespace": namespace,
+        },
+        "spec": {
+            "podName": pod_name,
+            "nodeName": node_name,
+            "step": int(step),
+            "requestId": request_id,
+        },
+    }
+
+
+def workload_checkpoint_step(raw: Mapping[str, Any]) -> int:
+    """The training step a WorkloadCheckpoint was taken at; -1 when the
+    object is malformed (a corrupt checkpoint must read as unusable, not
+    as step 0)."""
+    try:
+        return int((raw.get("spec") or {}).get("step"))
+    except (TypeError, ValueError):
+        return -1
 
 
 def _require_non_negative(name: str, value: int) -> None:
@@ -84,6 +144,68 @@ class PodDeletionSpec:
 
 
 @dataclass(frozen=True)
+class CheckpointSpec:
+    """Checkpoint-coordinated drain: before evicting workload pods, ask
+    the ones matching ``pod_selector`` to checkpoint and gate the drain
+    on their acks, escalating to a plain drain when the per-node deadline
+    expires (docs/checkpoint-drain.md). No reference analog — grounded in
+    CRIUgpu (PAPERS.md).
+
+    ``timeout_seconds`` must be positive: a zero deadline would mean
+    "wait forever", and the whole point of the escalation is that a
+    wedged workload can never stall the roll. An enabled spec must also
+    name a ``pod_selector``: an empty selector would select EVERY pod on
+    the node (driver and system pods included), none of which ack — the
+    whole roll would stall to the deadline and spuriously escalate.
+    """
+
+    enable: bool = False
+    #: Label selector naming the checkpoint-coordinated workload pods.
+    pod_selector: str = ""
+    #: Per-node checkpoint deadline; expiry escalates to a plain drain.
+    timeout_seconds: int = DEFAULT_CHECKPOINT_TIMEOUT_SECONDS
+    #: Verify the recorded WorkloadCheckpoint CRs before uncordon (the
+    #: restore-verified step); failures degrade after the deadline, they
+    #: never stall the roll. False skips the verification (the manifest
+    #: is still recorded and retired).
+    verify_restore: bool = True
+
+    def __post_init__(self) -> None:
+        if self.timeout_seconds <= 0:
+            raise ValueError(
+                "checkpoint.timeoutSeconds must be > 0, got "
+                f"{self.timeout_seconds} (a checkpoint arc without a "
+                "deadline could stall the roll forever)"
+            )
+        if self.enable and not self.pod_selector:
+            raise ValueError(
+                "checkpoint.podSelector is required when checkpoint "
+                "coordination is enabled (an empty selector would ask "
+                "every pod on the node — driver pods included — to "
+                "checkpoint, and none would ack)"
+            )
+
+    @staticmethod
+    def from_dict(d: Mapping[str, Any]) -> "CheckpointSpec":
+        return CheckpointSpec(
+            enable=bool(d.get("enable", False)),
+            pod_selector=d.get("podSelector", ""),
+            timeout_seconds=int(
+                d.get("timeoutSeconds", DEFAULT_CHECKPOINT_TIMEOUT_SECONDS)
+            ),
+            verify_restore=bool(d.get("verifyRestore", True)),
+        )
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "enable": self.enable,
+            "podSelector": self.pod_selector,
+            "timeoutSeconds": self.timeout_seconds,
+            "verifyRestore": self.verify_restore,
+        }
+
+
+@dataclass(frozen=True)
 class DrainSpec:
     """Node drain configuration during automatic upgrade.
 
@@ -138,6 +260,7 @@ class DriverUpgradePolicySpec:
     pod_deletion: Optional[PodDeletionSpec] = None
     wait_for_completion: Optional[WaitForCompletionSpec] = None
     drain: Optional[DrainSpec] = None
+    checkpoint: Optional[CheckpointSpec] = None
 
     def __post_init__(self) -> None:
         _require_non_negative("maxParallelUpgrades", self.max_parallel_upgrades)
@@ -175,6 +298,11 @@ class DriverUpgradePolicySpec:
             drain=(
                 DrainSpec.from_dict(d["drain"]) if d.get("drain") is not None else None
             ),
+            checkpoint=(
+                CheckpointSpec.from_dict(d["checkpoint"])
+                if d.get("checkpoint") is not None
+                else None
+            ),
         )
 
     def to_dict(self) -> dict[str, Any]:
@@ -195,4 +323,6 @@ class DriverUpgradePolicySpec:
             out["waitForCompletion"] = self.wait_for_completion.to_dict()
         if self.drain is not None:
             out["drain"] = self.drain.to_dict()
+        if self.checkpoint is not None:
+            out["checkpoint"] = self.checkpoint.to_dict()
         return out

@@ -497,6 +497,17 @@ class _Handler(BaseHTTPRequestHandler):
     def _do_delete(self, cluster, info, namespace, name, subresource, query):
         if not name:
             # DELETE on the collection: client-go's deleteCollection.
+            # Mirror of the fake's guard (ADVICE.md): a real apiserver
+            # does not serve deletecollection on the all-namespaces path
+            # of a namespaced resource — refuse before the cluster call
+            # so registered custom kinds get the same protection over
+            # the wire as typed kinds get in-process.
+            if info.namespaced and not namespace:
+                raise BadRequestError(
+                    f"deleteCollection on namespaced kind {info.kind} "
+                    "requires a namespace (all-namespaces "
+                    "deletecollection is not served by a real apiserver)"
+                )
             deleted = cluster.delete_collection(
                 info.kind,
                 namespace,

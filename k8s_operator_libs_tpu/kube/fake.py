@@ -1410,8 +1410,19 @@ class FakeCluster(Client):
         ``propagation_policy``, dry-run previews without deleting.
         Returns the objects the call addressed (upstream returns the
         deleted items' list)."""
-        cls = KINDS.get(kind)
-        if cls is not None and cls.NAMESPACED and not namespace:
+        # Namespacedness from the REST registry first: custom kinds
+        # registered via kube.resources.register_resource (the
+        # framework's primary CR path) are not in KINDS, and skipping
+        # them here silently deleted the kind across ALL namespaces —
+        # exactly the over-deletion this guard exists to stop
+        # (ADVICE.md). KINDS stays as the fallback for typed kinds a
+        # test may use without registering.
+        try:
+            namespaced = resource_for_kind(kind).namespaced
+        except KeyError:
+            cls = KINDS.get(kind)
+            namespaced = cls.NAMESPACED if cls is not None else False
+        if namespaced and not namespace:
             # A real apiserver serves deletecollection only on the
             # namespaced collection of a namespaced resource — the
             # all-namespaces path answers 405. Refusing here keeps fake

@@ -75,6 +75,19 @@ def _bootstrap() -> None:
             specials.get(kind, kind.lower() + "s"),
             cls.NAMESPACED,
         )
+    # Framework custom kinds with no typed wrapper in KINDS. The
+    # WorkloadCheckpoint contract (names, spec shape) is owned by
+    # api/upgrade_v1alpha1.py; the registration lives HERE so every kube
+    # surface — REST routing, the apiserver, and delete_collection's
+    # namespacedness guard — knows the kind even when api/ was never
+    # imported, and so api/ stays importable without pulling the kube
+    # package (tests/test_delete_collection.py pins the two in sync).
+    register_resource(
+        "WorkloadCheckpoint",
+        "upgrade.tpu-operator.dev/v1alpha1",
+        "workloadcheckpoints",
+        namespaced=True,
+    )
 
 
 _bootstrap()

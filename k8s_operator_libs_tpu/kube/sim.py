@@ -20,7 +20,7 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Optional
 
-from .client import NotFoundError
+from .client import AlreadyExistsError, NotFoundError
 from .fake import FakeCluster
 from .objects import ControllerRevision, DaemonSet, NodeMaintenance, Pod
 
@@ -508,6 +508,220 @@ class ValidationPodSimulator:
             live = {pod.name for pod in pods}
             for name in self.executor.tracked_pods() - live:
                 self.executor.release(name)
+
+
+@dataclass
+class _Workload:
+    """Bookkeeping for one simulated training job (pinned to one node)."""
+
+    node: str
+    pod_name: str
+    #: Global step the current incarnation resumed from.
+    base_step: int = 0
+    #: Steps trained by the current incarnation.
+    local_steps: int = 0
+    running: bool = False
+    restarts: int = 0
+    lost_steps: int = 0
+    #: Ticks remaining before the pending checkpoint request is acked.
+    ack_countdown: int = -1
+    #: The request epoch the countdown belongs to.
+    pending_epoch: str = ""
+
+    @property
+    def step(self) -> int:
+        return self.base_step + self.local_steps
+
+
+class CheckpointingWorkloadSimulator:
+    """Continuously-training workload stand-in for the checkpoint-
+    coordinated drain arc (docs/checkpoint-drain.md; the in-repo analog
+    of a ``models/burnin.py`` training job, with the train step counted
+    rather than executed so control-plane benches stay JAX-free).
+
+    One training pod per node, pinned (a TPU training job is bound to
+    its slice); each ``step()`` tick every Running pod trains
+    ``steps_per_tick`` steps. The simulator plays the WORKLOAD side of
+    the checkpoint contract:
+
+    * a pod seeing ``checkpoint_request_annotation=<epoch>`` checkpoints
+      after ``ack_delay_steps`` ticks: it persists a WorkloadCheckpoint
+      CR at its current step (api/upgrade_v1alpha1.py) and acks with
+      ``checkpoint_complete_annotation=<epoch>`` plus the step;
+    * nodes named in ``nonacking`` model a wedged workload: the request
+      is observed and ignored — the drain's deadline escalation is the
+      only way past them;
+    * an evicted/deleted pod is the disruption event: **lost steps** =
+      the step it died at minus the step its checkpoint restores to
+      (0 without a checkpoint — the full-restart baseline). The pod
+      reschedules once its node is schedulable again and resumes from
+      the checkpoint.
+
+    ``lost_steps()``/``total_steps()``/``restarts()`` aggregate the
+    fleet — the bench's disruption metric is *steps re-trained*, not pod
+    deaths (Guard, PAPERS.md; bench.py ``live_workload_roll``).
+    """
+
+    def __init__(
+        self,
+        cluster: FakeCluster,
+        keys,
+        namespace: str = "training",
+        name: str = "train",
+        pod_labels: Optional[dict[str, str]] = None,
+        ack_delay_steps: int = 1,
+        steps_per_tick: int = 1,
+        nonacking: tuple = (),
+    ) -> None:
+        from ..api.upgrade_v1alpha1 import make_workload_checkpoint
+
+        self.cluster = cluster
+        self.keys = keys
+        self.namespace = namespace
+        self.name = name
+        self.pod_labels = dict(pod_labels or {"app": "trainer"})
+        self.ack_delay_steps = ack_delay_steps
+        self.steps_per_tick = steps_per_tick
+        self.nonacking = frozenset(nonacking)
+        self._make_checkpoint = make_workload_checkpoint
+        self._workloads: dict[str, _Workload] = {}
+        for node in cluster.object_names("Node"):
+            self._workloads[node] = _Workload(
+                node=node, pod_name=f"{name}-{node}"
+            )
+
+    # -- fleet accounting --------------------------------------------------
+    def lost_steps(self) -> int:
+        return sum(w.lost_steps for w in self._workloads.values())
+
+    def total_steps(self) -> int:
+        return sum(w.step for w in self._workloads.values())
+
+    def restarts(self) -> int:
+        return sum(w.restarts for w in self._workloads.values())
+
+    def workload(self, node: str) -> _Workload:
+        return self._workloads[node]
+
+    # -- kubelet/job-controller tick ---------------------------------------
+    def step(self) -> None:
+        for w in self._workloads.values():
+            self._step_one(w)
+
+    def _checkpoint_step_of(self, w: _Workload) -> int:
+        from ..api.upgrade_v1alpha1 import (
+            WORKLOAD_CHECKPOINT_KIND,
+            workload_checkpoint_name,
+            workload_checkpoint_step,
+        )
+
+        cr = self.cluster.get_or_none(
+            WORKLOAD_CHECKPOINT_KIND,
+            workload_checkpoint_name(w.pod_name),
+            self.namespace,
+        )
+        if cr is None:
+            return 0
+        return max(0, workload_checkpoint_step(cr.raw))
+
+    def _step_one(self, w: _Workload) -> None:
+        raw = self.cluster.peek("Pod", w.pod_name, self.namespace)
+        alive = raw is not None and not (
+            (raw.get("metadata") or {}).get("deletionTimestamp")
+        )
+        if not alive:
+            if w.running:
+                # The disruption event: account the re-training bill now,
+                # while the death step is known.
+                restore_to = self._checkpoint_step_of(w)
+                w.lost_steps += max(0, w.step - restore_to)
+                w.restarts += 1
+                w.running = False
+                w.ack_countdown = -1
+                w.pending_epoch = ""
+            self._maybe_reschedule(w)
+            return
+        if not w.running:
+            w.running = True  # pod appeared (first tick after create)
+        w.local_steps += self.steps_per_tick
+        self._handle_checkpoint_request(w, raw)
+
+    def _maybe_reschedule(self, w: _Workload) -> None:
+        node_raw = self.cluster.peek("Node", w.node)
+        if node_raw is None:
+            return  # node gone: the job stays pending forever
+        if (node_raw.get("spec") or {}).get("unschedulable"):
+            return  # cordoned: the scheduler would not place the pod
+        restore_to = self._checkpoint_step_of(w)
+        pod = Pod.new(w.pod_name, namespace=self.namespace)
+        pod.node_name = w.node
+        pod.labels.update(self.pod_labels)
+        pod.phase = "Running"
+        pod.status["conditions"] = [{"type": "Ready", "status": "True"}]
+        pod.status["containerStatuses"] = [
+            {"name": "trainer", "ready": True, "restartCount": 0}
+        ]
+        try:
+            self.cluster.create(pod)
+        except AlreadyExistsError:
+            return  # raced a concurrent creator; adopt on the next tick
+        w.base_step = restore_to
+        w.local_steps = 0
+        w.running = True
+
+    def _handle_checkpoint_request(self, w: _Workload, raw: dict) -> None:
+        annotations = (raw.get("metadata") or {}).get("annotations") or {}
+        request = annotations.get(self.keys.checkpoint_request_annotation)
+        ack = annotations.get(self.keys.checkpoint_complete_annotation)
+        if not request or ack == request:
+            return
+        if w.node in self.nonacking:
+            return  # wedged workload: sees the request, never acks
+        if w.pending_epoch != request:
+            w.pending_epoch = request
+            w.ack_countdown = self.ack_delay_steps
+        w.ack_countdown -= 1
+        if w.ack_countdown > 0:
+            return
+        # Checkpoint NOW: persist the CR at the current step, then ack.
+        # CR first — an ack without a durable checkpoint would let the
+        # drain destroy unsaved state.
+        step = w.step
+        cr_raw = self._make_checkpoint(
+            w.pod_name, self.namespace, w.node, step=step, request_id=request
+        )
+        from .objects import KubeObject
+
+        existing = self.cluster.get_or_none(
+            cr_raw["kind"], cr_raw["metadata"]["name"], self.namespace
+        )
+        if existing is None:
+            self.cluster.create(KubeObject(cr_raw))
+        else:
+            self.cluster.patch(
+                cr_raw["kind"],
+                cr_raw["metadata"]["name"],
+                self.namespace,
+                patch={"spec": cr_raw["spec"]},
+            )
+        try:
+            self.cluster.patch(
+                "Pod",
+                w.pod_name,
+                self.namespace,
+                patch={
+                    "metadata": {
+                        "annotations": {
+                            self.keys.checkpoint_complete_annotation: request,
+                            self.keys.checkpoint_step_annotation: str(step),
+                        }
+                    }
+                },
+            )
+        except NotFoundError:
+            return  # evicted mid-ack; the next incarnation re-earns it
+        w.pending_epoch = ""
+        w.ack_countdown = -1
 
 
 class MaintenanceOperatorSimulator:

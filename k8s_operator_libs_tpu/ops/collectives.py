@@ -229,6 +229,75 @@ def ppermute_ring(
         return CollectiveReport(op="ppermute_ring", ok=False, error=str(e))
 
 
+def psum_bandwidth(
+    mesh: Mesh, axis: str, payload_mb: float = 4.0
+) -> CollectiveReport:
+    """Ring all-reduce with correctness AND bandwidth measurement.
+
+    ``psum_check`` proves the all-reduce is *correct*; this probe times
+    it on a real payload and reports algorithmic bandwidth — the number
+    every BENCH round before ISSUE 6 shipped as ``0.0`` because only the
+    (link-count-gated) ppermute probe ever carried a bandwidth figure
+    (ROADMAP item 4).
+
+    Convention: ``gbytes_per_s`` is the NCCL-style *bus* bandwidth
+    ``2 * (n-1)/n * payload_bytes / elapsed`` — the bytes a ring
+    all-reduce actually moves per link (reduce-scatter + all-gather
+    phases), so the figure is comparable across axis sizes and directly
+    against nccl-tests' busbw column (NOT its algbw column, which is
+    plain ``payload/elapsed``). Correctness is exact: every device
+    contributes ``arange + rank``; the reduced value is checked
+    elementwise on the host.
+    """
+    n = _axis_size(mesh, axis)
+    if n < 2:
+        return CollectiveReport(
+            op="psum_ring_allreduce", ok=True, error="single device"
+        )
+    elems = max(1, int(payload_mb * 1e6 / 4))
+
+    def build():
+        @jax.jit
+        def run(x):
+            def body(shard):
+                return jax.lax.psum(shard, axis)
+
+            return shard_map(
+                body, mesh=mesh, in_specs=P(axis), out_specs=P(axis)
+            )(x)
+
+        return run
+
+    run = _cached("psum_bw", mesh, axis, build, elems)
+    try:
+        base = jnp.tile(jnp.arange(elems, dtype=jnp.float32), n)
+        ranks = jnp.repeat(
+            jnp.arange(n, dtype=jnp.float32), elems
+        )
+        x = _put(mesh, axis, base + ranks)
+        elapsed = _timed(lambda: run(x))
+        out = run(x)
+        # sum over ranks: n * arange + n(n-1)/2, identical on every shard.
+        expected = (
+            np.arange(elems, dtype=np.float32) * n + n * (n - 1) / 2
+        )
+        ok = all(
+            np.array_equal(part, expected[: len(part)])
+            for _, part in _local_parts(out)
+        )
+        payload_bytes = elems * 4
+        bus_bytes = 2 * (n - 1) / n * payload_bytes
+        return CollectiveReport(
+            op="psum_ring_allreduce",
+            ok=ok,
+            elapsed_s=elapsed,
+            gbytes_per_s=bus_bytes / elapsed / 1e9 if elapsed > 0 else 0.0,
+            error="" if ok else "all-reduce sum mismatch",
+        )
+    except Exception as e:  # noqa: BLE001
+        return CollectiveReport(op="psum_ring_allreduce", ok=False, error=str(e))
+
+
 def reduce_scatter_check(mesh: Mesh, axis: str) -> CollectiveReport:
     """psum_scatter correctness against a host-computed reduction."""
     n = _axis_size(mesh, axis)

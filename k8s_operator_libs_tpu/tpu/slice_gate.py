@@ -83,6 +83,7 @@ _GANG_CONSUMER_STATES: frozenset[str] = frozenset(
     for s in (
         UpgradeState.CORDON_REQUIRED,
         UpgradeState.WAIT_FOR_JOBS_REQUIRED,
+        UpgradeState.CHECKPOINT_REQUIRED,
         UpgradeState.POD_DELETION_REQUIRED,
         UpgradeState.DRAIN_REQUIRED,
         UpgradeState.NODE_MAINTENANCE_REQUIRED,

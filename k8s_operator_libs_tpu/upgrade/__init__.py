@@ -1,5 +1,9 @@
 from .consts import UpgradeState, DeviceClass, UpgradeKeys
 from .state_provider import NodeUpgradeStateProvider, StateWriteError
+from .checkpoint_manager import (
+    RESTORE_VERIFY_TIMEOUT_SECONDS,
+    CheckpointManager,
+)
 from .metrics import MetricsServer, UpgradeMetrics
 from .task_runner import TaskRunner
 from .cordon_manager import CordonManager
@@ -62,6 +66,8 @@ __all__ = [
     "ProcessNodeStateManager",
     "RevisionHashError",
     "StateOptions",
+    "CheckpointManager",
+    "RESTORE_VERIFY_TIMEOUT_SECONDS",
     "CordonManager",
     "DeviceClass",
     "DrainConfiguration",

@@ -1,0 +1,379 @@
+"""CheckpointManager — the pre-drain checkpoint coordination arc.
+
+No reference analog: the reference state machine evicts workload pods
+unconditionally (pod_manager.go/drain_manager.go), so a training job on
+a drained node pays a full restart. This manager implements the
+checkpoint-before-evict contract grounded in CRIUgpu (PAPERS.md —
+transparent checkpointing of accelerated workloads), with disruption
+accounted in *training steps* rather than pod deaths (Guard, PAPERS.md).
+docs/checkpoint-drain.md documents the whole protocol.
+
+The contract, per node in ``checkpoint-required``:
+
+1. **Request** — the controller stamps the node's durable checkpoint
+   clock (``checkpoint_start_annotation``; the stamp doubles as the
+   checkpoint *epoch id*) and writes
+   ``checkpoint_request_annotation=<id>`` on every selected workload pod
+   on the node. Idempotent: re-entry after an aborted pass re-derives
+   the same id from the durable clock and re-issues only missing
+   requests.
+2. **Ack** — the workload checkpoints, persists a WorkloadCheckpoint CR
+   (api/upgrade_v1alpha1.py), and writes
+   ``checkpoint_complete_annotation=<id>`` (+ the step it checkpointed
+   at) back on its pod. A stale ack from an earlier arc carries an old
+   id and does not count.
+3. **Gate** — once every selected pod acked, the node's checkpoint
+   manifest (``{"<ns>/<pod>": step}``) is recorded on the node, the
+   clock is cleared, and the node advances into the drain path.
+4. **Escalate** — if the deadline expires first, the manifest of
+   whatever subset DID ack is recorded, the node is marked escalated,
+   and it advances anyway: a **plain drain**. Graceful degradation — a
+   wedged workload can never stall the roll. Escalations are counted
+   and exported (``tpu_operator_upgrade_checkpoint_*``).
+5. **Restore-verify** — after the driver upgrade, before uncordon, the
+   manifest entries are checked against their WorkloadCheckpoint CRs
+   (:meth:`CheckpointManager.restore_gate`, wired into the validation
+   bucket). A vanished/corrupt checkpoint defers uncordon up to its own
+   durable deadline, then degrades (the workload cold-starts) — again:
+   bounded, never a stall.
+
+Threading: ``coordinate`` runs inside apply_state's bucket fan-out (one
+task per node); counters are guarded by a leaf lock. The bucket POLLS
+workload pods the snapshot source does not watch, so it iterates
+``nodes_in`` (never the dirty-filtered view) — see
+docs/reconcile-data-path.md on polling vs reaction buckets.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Optional
+
+from ..api.upgrade_v1alpha1 import (
+    WORKLOAD_CHECKPOINT_KIND,
+    CheckpointSpec,
+    workload_checkpoint_name,
+    workload_checkpoint_step,
+)
+from ..kube.client import Client
+from ..kube.objects import Node, Pod
+from ..utils.log import get_logger
+from .consts import NULL_STRING, TRUE_STRING, UpgradeKeys, UpgradeState
+from .state_provider import NodeUpgradeStateProvider
+from .validation_manager import advance_durable_clock
+
+log = get_logger("upgrade.checkpoint")
+
+#: Default bound on the restore-verified step (the checkpoint deadline
+#: governs the pre-drain arc; this one governs the pre-uncordon check).
+RESTORE_VERIFY_TIMEOUT_SECONDS = 600
+
+
+class CheckpointManager:
+    def __init__(
+        self,
+        client: Client,
+        state_provider: NodeUpgradeStateProvider,
+        keys: UpgradeKeys,
+        recorder=None,
+        restore_timeout_seconds: int = RESTORE_VERIFY_TIMEOUT_SECONDS,
+    ) -> None:
+        self._client = client
+        self._provider = state_provider
+        self._keys = keys
+        self._recorder = recorder
+        self._restore_timeout = restore_timeout_seconds
+        #: Whether the restore-verified uncordon step actually verifies
+        #: (CheckpointSpec.verify_restore, refreshed from the policy each
+        #: apply pass by the orchestrator). With it off the gate still
+        #: retires the manifest, it just never defers on a missing CR.
+        self._verify_restore = True
+        # Leaf lock (nothing blocks under it) guarding the lifetime
+        # counters the metrics family reads.
+        self._counter_lock = threading.Lock()
+        self._totals = {
+            "requests": 0,
+            "completions": 0,
+            "escalations": 0,
+            "advanced": 0,
+            "restores_verified": 0,
+            "restore_escalations": 0,
+        }
+
+    # -- counters ----------------------------------------------------------
+    def _count(self, key: str, n: int = 1) -> None:
+        with self._counter_lock:
+            self._totals[key] += n
+
+    def totals(self) -> dict[str, int]:
+        """Consistent snapshot of the lifetime counters; apply_state diffs
+        consecutive snapshots into per-pass PassStats."""
+        with self._counter_lock:
+            return dict(self._totals)
+
+    def set_verify_restore(self, verify: bool) -> None:
+        """Refresh the restore-verification switch from the policy in
+        force (the orchestrator calls this every apply pass, so a
+        mid-roll policy flip takes effect at the next gate check)."""
+        self._verify_restore = bool(verify)
+
+    # -- pre-drain coordination (the checkpoint-required bucket) -----------
+    def eligible_pods(self, node: Node, spec: CheckpointSpec) -> list[Pod]:
+        """Live workload pods on the node the checkpoint contract selects:
+        matching the selector, not finished, not already terminating (a
+        pod on its way out cannot durably ack)."""
+        pods = [
+            Pod(o.raw)
+            for o in self._client.list(
+                "Pod",
+                label_selector=spec.pod_selector or None,
+                field_selector=f"spec.nodeName={node.name}",
+            )
+        ]
+        return [
+            p
+            for p in pods
+            if p.phase in ("Running", "Pending")
+            and p.deletion_timestamp is None
+        ]
+
+    def coordinate(
+        self, node: Node, spec: CheckpointSpec, next_state: UpgradeState
+    ) -> None:
+        """One pass of the checkpoint arc for one node: request, collect
+        acks, and either gate-complete or deadline-escalate into
+        ``next_state``. Idempotent per the epoch-id contract (a re-entered
+        pass re-derives the same id from the durable clock)."""
+        keys = self._keys
+        clock_key = keys.checkpoint_start_annotation
+        pods = self.eligible_pods(node, spec)
+        if not pods:
+            # Nothing to coordinate: trivially complete (clear a clock a
+            # previous partial pass may have started — no-op when absent).
+            self._provider.change_node_upgrade_annotation(
+                node, clock_key, NULL_STRING
+            )
+            self._advance(node, next_state)
+            self._count("completions")
+            log.info(
+                "no checkpoint-eligible pods on node %s; advancing",
+                node.name,
+            )
+            return
+        # The id BEFORE the clock tick: on expiry the helper clears the
+        # annotation, and the escalation path still needs the id to
+        # harvest the acks that did land.
+        epoch = node.annotations.get(clock_key)
+        expired = advance_durable_clock(
+            self._provider, node, clock_key, spec.timeout_seconds
+        )
+        if expired:
+            self._escalate(node, pods, epoch, next_state)
+            return
+        epoch = node.annotations.get(clock_key, epoch) or ""
+        for pod in pods:
+            if pod.annotations.get(keys.checkpoint_request_annotation) != epoch:
+                self._client.patch(
+                    "Pod",
+                    pod.name,
+                    pod.namespace,
+                    patch={
+                        "metadata": {
+                            "annotations": {
+                                keys.checkpoint_request_annotation: epoch
+                            }
+                        }
+                    },
+                )
+                self._count("requests")
+        acked = self._acked(pods, epoch)
+        if len(acked) < len(pods):
+            log.info(
+                "node %s: %d/%d checkpoint acks (epoch %s); drain gated",
+                node.name, len(acked), len(pods), epoch,
+            )
+            return
+        self._record_manifest(node, acked)
+        self._provider.change_node_upgrade_annotation(
+            node, clock_key, NULL_STRING
+        )
+        self._advance(node, next_state)
+        self._count("completions")
+        self._event(
+            node, "Normal",
+            f"All {len(acked)} workload checkpoints complete; proceeding "
+            "with a checkpoint-coordinated drain",
+        )
+
+    def _acked(self, pods: list[Pod], epoch: Optional[str]) -> list[Pod]:
+        if not epoch:
+            return []
+        key = self._keys.checkpoint_complete_annotation
+        return [p for p in pods if p.annotations.get(key) == epoch]
+
+    def _record_manifest(self, node: Node, acked: list[Pod]) -> None:
+        """Persist ``{"<ns>/<pod>": step}`` for the acked pods. Written
+        before the state advance so an abort between the two re-enters
+        with the manifest already durable (re-writing it is a no-op)."""
+        if not acked:
+            return
+        step_key = self._keys.checkpoint_step_annotation
+        manifest: dict[str, int] = {}
+        for pod in acked:
+            try:
+                step = int(pod.annotations.get(step_key, ""))
+            except ValueError:
+                step = 0
+            manifest[f"{pod.namespace}/{pod.name}"] = step
+        self._provider.change_node_upgrade_annotation(
+            node,
+            self._keys.checkpoint_manifest_annotation,
+            json.dumps(manifest, sort_keys=True),
+        )
+
+    def _escalate(
+        self,
+        node: Node,
+        pods: list[Pod],
+        epoch: Optional[str],
+        next_state: UpgradeState,
+    ) -> None:
+        acked = self._acked(pods, epoch)
+        # A partial checkpoint is still worth restoring: record what DID
+        # land; only the non-acking pods pay the full restart.
+        self._record_manifest(node, acked)
+        self._provider.change_node_upgrade_annotation(
+            node, self._keys.checkpoint_escalated_annotation, TRUE_STRING
+        )
+        self._advance(node, next_state)
+        self._count("escalations")
+        log.warning(
+            "checkpoint deadline expired on node %s (%d/%d acks); "
+            "escalating to a plain drain",
+            node.name, len(acked), len(pods),
+        )
+        self._event(
+            node, "Warning",
+            f"Checkpoint deadline expired with {len(acked)}/{len(pods)} "
+            "acks; escalating to a plain (uncoordinated) drain",
+        )
+
+    def _advance(self, node: Node, next_state: UpgradeState) -> None:
+        self._provider.change_node_upgrade_state(node, next_state)
+        self._count("advanced")
+
+    def abandon(self, node: Node, next_state: UpgradeState) -> None:
+        """Park-path exit for a node whose checkpoint policy was
+        withdrawn mid-arc: clear the durable deadline clock (a surviving
+        stamp would read as instantly-expired on the NEXT enabled roll
+        and spuriously escalate it with zero requests issued), then
+        advance into the eviction path."""
+        self._provider.change_node_upgrade_annotation(
+            node, self._keys.checkpoint_start_annotation, NULL_STRING
+        )
+        self._advance(node, next_state)
+
+    # -- restore-verified uncordon (runs in the validation bucket) ---------
+    def restore_gate(self, node: Node) -> bool:
+        """True when the node's recorded checkpoints are verified
+        restorable (or there is nothing to verify). Deferring returns
+        False — the validation bucket polls, so the check re-runs every
+        pass — up to a durable deadline, after which the gate *degrades*:
+        the loss is counted and the roll proceeds (a vanished checkpoint
+        means a cold restart for that workload, never a stalled pool)."""
+        keys = self._keys
+        manifest_raw = node.annotations.get(keys.checkpoint_manifest_annotation)
+        if manifest_raw is None:
+            return True
+        try:
+            manifest = json.loads(manifest_raw)
+            if not isinstance(manifest, dict):
+                raise ValueError("manifest is not an object")
+        except ValueError as e:
+            # A corrupt manifest cannot gate anything — clear it, log
+            # loud, and proceed (the workloads still hold their CRs).
+            log.error(
+                "node %s has corrupt checkpoint manifest %r (%s); clearing",
+                node.name, manifest_raw, e,
+            )
+            self._clear_restore_state(node)
+            return True
+        if not self._verify_restore:
+            # Verification switched off (CheckpointSpec.verify_restore):
+            # retire the manifest without checking the CRs — the operator
+            # explicitly traded the restore guarantee for an uncordon
+            # that never defers.
+            log.info(
+                "node %s: restore verification disabled by policy; "
+                "retiring the checkpoint manifest unchecked", node.name,
+            )
+            self._clear_restore_state(node)
+            return True
+        missing = []
+        for ref, recorded_step in manifest.items():
+            ns, _, pod_name = ref.partition("/")
+            cr = self._client.get_or_none(
+                WORKLOAD_CHECKPOINT_KIND, workload_checkpoint_name(pod_name), ns
+            )
+            try:
+                recorded = int(recorded_step)
+            except (TypeError, ValueError):
+                recorded = 0
+            if cr is None or workload_checkpoint_step(cr.raw) < recorded:
+                missing.append(ref)
+        if not missing:
+            self._clear_restore_state(node)
+            self._count("restores_verified")
+            log.info(
+                "node %s: %d checkpoint(s) verified restorable; uncordon "
+                "may proceed", node.name, len(manifest),
+            )
+            return True
+        expired = advance_durable_clock(
+            self._provider,
+            node,
+            keys.restore_verify_start_annotation,
+            self._restore_timeout,
+        )
+        if expired:
+            self._count("restore_escalations")
+            log.warning(
+                "restore verification deadline expired on node %s "
+                "(unverifiable: %s); degrading to cold restart",
+                node.name, ", ".join(sorted(missing)),
+            )
+            self._event(
+                node, "Warning",
+                f"Checkpoint restore verification timed out for "
+                f"{len(missing)} workload(s); they will cold-start",
+            )
+            self._clear_restore_state(node)
+            return True
+        log.info(
+            "node %s: %d checkpoint(s) not yet verifiable (%s); uncordon "
+            "deferred", node.name, len(missing), ", ".join(sorted(missing)),
+        )
+        return False
+
+    def _clear_restore_state(self, node: Node) -> None:
+        """Retire the arc's node-side bookkeeping (all no-ops when the
+        keys are absent, so this is safe to call from any exit path)."""
+        keys = self._keys
+        for key in (
+            keys.checkpoint_manifest_annotation,
+            keys.restore_verify_start_annotation,
+            keys.checkpoint_escalated_annotation,
+        ):
+            self._provider.change_node_upgrade_annotation(
+                node, key, NULL_STRING
+            )
+
+    def has_manifest(self, node: Node) -> bool:
+        return self._keys.checkpoint_manifest_annotation in node.annotations
+
+    def _event(self, node: Node, event_type: str, message: str) -> None:
+        if self._recorder is not None:
+            self._recorder.eventf(
+                node, event_type, self._keys.event_reason(), message
+            )

@@ -15,6 +15,7 @@ from typing import Optional, Sequence
 from typing import Callable, TypeVar
 
 from ..api.upgrade_v1alpha1 import (
+    CheckpointSpec,
     DrainSpec,
     PodDeletionSpec,
     WaitForCompletionSpec,
@@ -30,6 +31,7 @@ from .consts import (
     UpgradeKeys,
     UpgradeState,
 )
+from .checkpoint_manager import CheckpointManager
 from .cordon_manager import CordonManager
 from .drain_manager import DrainConfiguration, DrainManager
 from .pod_manager import PodManager, PodManagerConfig
@@ -85,7 +87,8 @@ class ClusterUpgradeState:
         advances, pod-restart checks, uncordon): with delta information
         present, only nodes whose inputs changed are walked. Buckets
         whose progress depends on objects the snapshot source does NOT
-        watch (workload-pod completion polls, eviction, validation
+        watch (workload-pod completion polls, the checkpoint arc's
+        workload acks and WorkloadCheckpoint CRs, eviction, validation
         hooks) must keep using :meth:`nodes_in` — filtering them would
         trade their polling loop for a deadlock."""
         nodes = self.node_states.get(state, [])
@@ -110,6 +113,7 @@ class CommonUpgradeManager:
         recorder=None,
         runner: Optional[TaskRunner] = None,
         apply_width: Optional[int] = None,
+        checkpoint_manager: Optional[CheckpointManager] = None,
     ) -> None:
         self.client = client
         self.provider = state_provider
@@ -119,6 +123,18 @@ class CommonUpgradeManager:
         self.pod_manager = pod_manager
         self.validation_manager = validation_manager
         self.safe_load_manager = safe_load_manager
+        self.checkpoint_manager = (
+            checkpoint_manager
+            if checkpoint_manager is not None
+            else CheckpointManager(
+                client, state_provider, keys, recorder=recorder
+            )
+        )
+        # Restore-verified uncordon: the validation bucket carries the
+        # checkpoint arc's pre-uncordon gate (docs/checkpoint-drain.md).
+        self.validation_manager.restore_gate = (
+            self.checkpoint_manager.restore_gate
+        )
         self.recorder = recorder
         #: Joined bounded fan-out for per-state buckets. Direct
         #: constructions that pass no runner get an inline one — same
@@ -365,12 +381,27 @@ class CommonUpgradeManager:
             ),
         )
 
+    def _post_checkpoint_state(self) -> UpgradeState:
+        """Where a node goes after the checkpoint arc (complete, escalated
+        or disabled): the same eviction path the reference takes after
+        wait-for-jobs."""
+        return (
+            UpgradeState.POD_DELETION_REQUIRED
+            if self.pod_deletion_enabled
+            else UpgradeState.DRAIN_REQUIRED
+        )
+
     def process_wait_for_jobs_required_nodes(
         self,
         state: ClusterUpgradeState,
         wait_spec: Optional[WaitForCompletionSpec],
+        checkpoint_enabled: bool = False,
     ) -> None:
-        """(reference: :384-419)"""
+        """(reference: :384-419). With the checkpoint arc enabled
+        (docs/checkpoint-drain.md), both completion paths route through
+        ``checkpoint-required``; otherwise each keeps its reference
+        shape (the selector path always lands in pod-deletion-required,
+        whose processor advances past a disabled feature next pass)."""
         if wait_spec is None or not wait_spec.pod_selector:
             # Spec-less advance: a pure reaction to the node's own
             # (watched) state — dirty-filtered. A node lands in this
@@ -383,9 +414,9 @@ class CommonUpgradeManager:
                 )
             ]
             next_state = (
-                UpgradeState.POD_DELETION_REQUIRED
-                if self.pod_deletion_enabled
-                else UpgradeState.DRAIN_REQUIRED
+                UpgradeState.CHECKPOINT_REQUIRED
+                if checkpoint_enabled
+                else self._post_checkpoint_state()
             )
             self._advance_all("wait-for-jobs", nodes, next_state)
             return
@@ -395,7 +426,52 @@ class CommonUpgradeManager:
         if not nodes:
             return
         self.pod_manager.schedule_check_on_pod_completion(
-            PodManagerConfig(nodes=nodes, wait_for_completion_spec=wait_spec)
+            PodManagerConfig(
+                nodes=nodes,
+                wait_for_completion_spec=wait_spec,
+                completion_next_state=(
+                    UpgradeState.CHECKPOINT_REQUIRED
+                    if checkpoint_enabled
+                    else UpgradeState.POD_DELETION_REQUIRED
+                ),
+            )
+        )
+
+    def process_checkpoint_required_nodes(
+        self,
+        state: ClusterUpgradeState,
+        checkpoint_spec: Optional[CheckpointSpec],
+    ) -> None:
+        """The pre-drain checkpoint arc (docs/checkpoint-drain.md): signal
+        selected workload pods to checkpoint, gate the drain on their
+        acks, escalate to a plain drain at the per-node deadline.
+
+        POLLS workload pods the snapshot source does not watch — never
+        dirty-filtered. With the spec absent/disabled, parked nodes (a
+        policy flipped mid-roll) advance into the eviction path so the
+        roll can never wedge on a withdrawn feature."""
+        node_states = state.nodes_in(UpgradeState.CHECKPOINT_REQUIRED)
+        next_state = self._post_checkpoint_state()
+        if checkpoint_spec is None or not checkpoint_spec.enable:
+            # Withdrawn mid-arc: exit via abandon(), which also clears
+            # the durable deadline clock — a surviving stamp would make
+            # the node's NEXT checkpoint-enabled roll escalate instantly.
+            self._for_each(
+                "advance[checkpoint]",
+                node_states,
+                lambda ns: ns.node.name,
+                lambda ns: self.checkpoint_manager.abandon(
+                    ns.node, next_state
+                ),
+            )
+            return
+        self._for_each(
+            "checkpoint",
+            node_states,
+            lambda ns: ns.node.name,
+            lambda ns: self.checkpoint_manager.coordinate(
+                ns.node, checkpoint_spec, next_state
+            ),
         )
 
     def process_pod_deletion_required_nodes(
@@ -453,7 +529,16 @@ class CommonUpgradeManager:
                 return
             self.safe_load_manager.unblock_loading(ns.node)
             if self.is_driver_pod_in_sync(ns):
-                if not self.validation_enabled:
+                # A checkpoint manifest routes through the validation
+                # bucket even with validation unconfigured: that bucket
+                # polls, and it carries the restore-verified uncordon
+                # gate (docs/checkpoint-drain.md) — skipping it would
+                # uncordon before the checkpoints were proven restorable.
+                needs_validation = (
+                    self.validation_enabled
+                    or self.checkpoint_manager.has_manifest(ns.node)
+                )
+                if not needs_validation:
                     self.update_node_to_uncordon_or_done_state(ns)
                     return
                 self.provider.change_node_upgrade_state(
@@ -501,14 +586,22 @@ class CommonUpgradeManager:
         def recover(ns: NodeUpgradeState) -> None:
             if not self.is_driver_pod_in_sync(ns):
                 return
+            # Two gates recovery must not skip: a validation failure
+            # re-validates instead of uncordoning, and a checkpoint
+            # manifest must pass the restore-verified step (which rides
+            # the validation bucket — docs/checkpoint-drain.md) before
+            # the node is released. Routing through VALIDATION_REQUIRED
+            # also retires the manifest/escalated markers, so a stale
+            # manifest cannot haunt the next roll.
             if (
                 self.validation_enabled
                 and self.keys.validation_failed_annotation
                 in ns.node.annotations
-            ):
+            ) or self.checkpoint_manager.has_manifest(ns.node):
                 log.info(
-                    "node %s failed validation; re-validating instead of "
-                    "uncordoning", ns.node.name,
+                    "node %s recovery routed through the validation gate "
+                    "(validation failure or unverified checkpoints); not "
+                    "uncordoning directly", ns.node.name,
                 )
                 self.provider.change_node_upgrade_state(
                     ns.node, UpgradeState.VALIDATION_REQUIRED
@@ -565,6 +658,14 @@ class CommonUpgradeManager:
                 )
                 new_state = UpgradeState.DONE
         self.provider.change_node_upgrade_state(node, new_state)
+        # Retire the checkpoint arc's escalation marker: the upgrade this
+        # escalation belonged to is over (a no-op skip when absent, which
+        # is every non-checkpoint roll). The manifest itself is cleared by
+        # the restore gate — this only covers the zero-ack escalation
+        # path, which never recorded one.
+        self.provider.change_node_upgrade_annotation(
+            node, self.keys.checkpoint_escalated_annotation, NULL_STRING
+        )
         if new_state == UpgradeState.DONE or in_requestor_mode:
             self.provider.change_node_upgrade_annotation(
                 node, self.keys.initial_state_annotation, NULL_STRING

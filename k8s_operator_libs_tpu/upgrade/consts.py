@@ -1,7 +1,10 @@
 """Upgrade states and label/annotation key builders.
 
 State-name parity with the reference's 13-state machine
-(reference: pkg/upgrade/consts.go:48-83). The key *scheme* is deliberately
+(reference: pkg/upgrade/consts.go:48-83), plus one state of our own:
+``checkpoint-required``, the pre-drain checkpoint-coordination arc
+(docs/checkpoint-drain.md) the reference has no analog for. The key
+*scheme* is deliberately
 re-designed: the reference keys every label/annotation off a process-global
 ``DriverName`` via printf formats like ``nvidia.com/%s-driver-upgrade-state``
 (reference: pkg/upgrade/consts.go:20-47, util.go:91-99), hard-wiring one
@@ -31,6 +34,13 @@ class UpgradeState(StrEnum):
     CORDON_REQUIRED = "cordon-required"
     # Waiting (up to a timeout) for selected workload jobs to finish.
     WAIT_FOR_JOBS_REQUIRED = "wait-for-jobs-required"
+    # Selected workload pods are being asked to checkpoint before the
+    # drain; the drain gates on their checkpoint-complete acks, with a
+    # per-node deadline that escalates to a plain drain. No reference
+    # analog (the reference evicts unconditionally); grounded in CRIUgpu
+    # (PAPERS.md) — checkpoint-before-evict turns a full workload restart
+    # into a resume, measured in training steps (docs/checkpoint-drain.md).
+    CHECKPOINT_REQUIRED = "checkpoint-required"
     # Workload pods matching the deletion filter must be evicted first.
     POD_DELETION_REQUIRED = "pod-deletion-required"
     # Node is scheduled for drain.
@@ -58,6 +68,7 @@ MANAGED_STATES: tuple[UpgradeState, ...] = (
     UpgradeState.UPGRADE_REQUIRED,
     UpgradeState.CORDON_REQUIRED,
     UpgradeState.WAIT_FOR_JOBS_REQUIRED,
+    UpgradeState.CHECKPOINT_REQUIRED,
     UpgradeState.POD_DELETION_REQUIRED,
     UpgradeState.FAILED,
     UpgradeState.DRAIN_REQUIRED,
@@ -175,6 +186,58 @@ class UpgradeKeys:
         (no reference analog — see ValidationManager docstring: recovery
         from a validation failure must re-validate, not skip the gate)."""
         return self._key("upgrade-validation-failed")
+
+    # -- checkpoint-coordinated drain contract (docs/checkpoint-drain.md;
+    # no reference analog — grounded in CRIUgpu, PAPERS.md) ---------------
+    @property
+    def checkpoint_request_annotation(self) -> str:
+        """POD annotation the controller writes to ask a selected workload
+        pod to checkpoint. The value is the per-node checkpoint epoch id
+        (the durable clock stamp), so a stale ack from an earlier arc can
+        never satisfy a new one."""
+        return self._key("upgrade-checkpoint-request")
+
+    @property
+    def checkpoint_complete_annotation(self) -> str:
+        """POD annotation the workload writes back once its checkpoint is
+        durable: the ack. Valid only when it echoes the current request
+        epoch id."""
+        return self._key("upgrade-checkpoint-complete")
+
+    @property
+    def checkpoint_step_annotation(self) -> str:
+        """POD annotation carrying the training step the checkpoint was
+        taken at — the unit disruption is accounted in (lost steps, not
+        pod deaths; Guard, PAPERS.md)."""
+        return self._key("upgrade-checkpoint-step")
+
+    @property
+    def checkpoint_start_annotation(self) -> str:
+        """NODE annotation: durable clock for the per-node checkpoint
+        deadline (advance_durable_clock discipline). Its stamp doubles as
+        the checkpoint epoch id."""
+        return self._key("upgrade-checkpoint-start-time")
+
+    @property
+    def checkpoint_manifest_annotation(self) -> str:
+        """NODE annotation: JSON map ``{"<ns>/<pod>": step}`` of the
+        checkpoints acknowledged before the drain — what the
+        restore-verified uncordon step checks against the
+        WorkloadCheckpoint CRs."""
+        return self._key("upgrade-checkpoint-manifest")
+
+    @property
+    def checkpoint_escalated_annotation(self) -> str:
+        """NODE annotation marking that the checkpoint deadline expired
+        and the drain proceeded as a plain (uncoordinated) drain."""
+        return self._key("upgrade-checkpoint-escalated")
+
+    @property
+    def restore_verify_start_annotation(self) -> str:
+        """NODE annotation: durable clock for the restore-verified
+        uncordon step (bounded — a vanished checkpoint degrades to an
+        uncoordinated restart, it never stalls the roll)."""
+        return self._key("upgrade-restore-verify-start-time")
 
     @property
     def upgrade_requested_annotation(self) -> str:

@@ -96,9 +96,27 @@ class DrainManager:
             )
 
         if self._runner.submit(node.name, task):
-            self._event(node, "Normal", "Scheduling drain of the node")
+            self._event(node, "Normal", self._drain_flavor(node))
         else:
             log.info("node %s is already being drained, skipping", node.name)
+
+    def _drain_flavor(self, node: Node) -> str:
+        """Make the drain's provenance observable (docs/checkpoint-drain.md):
+        a checkpoint-coordinated drain evicts workloads whose state is
+        already saved; an escalated one gave up on a wedged workload at
+        the deadline; a plain one never entered the checkpoint arc."""
+        annotations = node.annotations
+        if (
+            annotations.get(self._keys.checkpoint_escalated_annotation)
+            == TRUE_STRING
+        ):
+            return (
+                "Scheduling drain of the node (checkpoint deadline "
+                "escalated - plain drain)"
+            )
+        if self._keys.checkpoint_manifest_annotation in annotations:
+            return "Scheduling checkpoint-coordinated drain of the node"
+        return "Scheduling drain of the node"
 
     def _event(self, node: Node, event_type: str, message: str) -> None:
         if self._recorder is not None:

@@ -120,6 +120,44 @@ _PASS_GAUGES = [
      "delta_hit_rate"),
 ]
 
+#: Checkpoint-coordinated drain gauges (docs/checkpoint-drain.md), read
+#: off PassStats like _PASS_GAUGES — the tpu_operator_upgrade_checkpoint_*
+#: family. checkpoint_escalations_total is the alert line: nonzero means
+#: a wedged workload hit the deadline and paid a full restart.
+_CHECKPOINT_GAUGES = [
+    ("checkpoint_nodes_waiting",
+     "Nodes gated in checkpoint-required after the last pass",
+     "checkpoint_nodes_waiting"),
+    ("checkpoint_requests_issued",
+     "Checkpoint requests written to workload pods during the last pass",
+     "checkpoint_requests_issued"),
+    ("checkpoint_completions",
+     "Nodes whose checkpoint gate completed during the last pass",
+     "checkpoint_completions"),
+    ("checkpoint_escalations",
+     "Checkpoint deadline escalations to a plain drain during the last "
+     "pass",
+     "checkpoint_escalations"),
+    ("checkpoint_escalations_total",
+     "Lifetime checkpoint deadline escalations (alert on nonzero)",
+     "checkpoint_escalations_total"),
+    ("checkpoint_completed_total",
+     "Lifetime nodes that completed the checkpoint gate",
+     "checkpoints_completed_total"),
+    ("checkpoint_restores_verified_total",
+     "Lifetime nodes whose checkpoints were verified restorable before "
+     "uncordon",
+     "checkpoint_restores_verified_total"),
+    ("checkpoint_restore_escalations_total",
+     "Lifetime restore-verification deadline expiries (workloads "
+     "cold-started)",
+     "checkpoint_restore_escalations_total"),
+]
+
+#: Every PassStats-backed gauge, in one place: a new family joins here
+#: once instead of at each of observe()'s and render()'s iteration sites.
+_ALL_PASS_GAUGES = _PASS_GAUGES + _CHECKPOINT_GAUGES
+
 
 class UpgradeMetrics:
     """Snapshot-driven gauges + a monotonic reconcile counter.
@@ -164,7 +202,7 @@ class UpgradeMetrics:
         # orchestrator does; bare CommonUpgradeManager doubles don't).
         pass_stats = getattr(self._manager, "last_pass_stats", None)
         if pass_stats is not None:
-            for suffix, _, attr in _PASS_GAUGES:
+            for suffix, _, attr in _ALL_PASS_GAUGES:
                 raw = getattr(pass_stats, attr, 0)
                 if isinstance(raw, bool):
                     values[suffix] = int(raw)
@@ -189,7 +227,7 @@ class UpgradeMetrics:
             # over a bare manager double stays byte-stable.
             rows.extend(
                 (suffix, "gauge", help_text, self._values[suffix])
-                for suffix, help_text, _ in _PASS_GAUGES
+                for suffix, help_text, _ in _ALL_PASS_GAUGES
                 if suffix in self._values
             )
             rows.append(

@@ -43,6 +43,11 @@ class PodManagerConfig:
     deletion_spec: Optional[PodDeletionSpec] = None
     wait_for_completion_spec: Optional[WaitForCompletionSpec] = None
     drain_enabled: bool = False
+    #: Where a node whose awaited workload pods finished (or timed out)
+    #: goes next. The reference hard-codes pod-deletion-required; the
+    #: checkpoint arc (docs/checkpoint-drain.md) routes the completion
+    #: through checkpoint-required instead, so the caller decides.
+    completion_next_state: UpgradeState = UpgradeState.POD_DELETION_REQUIRED
 
 
 class PodManager:
@@ -322,7 +327,8 @@ class PodManager:
                 log.info("workload pods still running on node %s", node.name)
                 if spec.timeout_seconds != 0:
                     self.handle_timeout_on_pod_completions(
-                        node, spec.timeout_seconds
+                        node, spec.timeout_seconds,
+                        next_state=config.completion_next_state,
                     )
                 return
             self._provider.change_node_upgrade_annotation(
@@ -331,7 +337,7 @@ class PodManager:
                 NULL_STRING,
             )
             self._provider.change_node_upgrade_state(
-                node, UpgradeState.POD_DELETION_REQUIRED
+                node, config.completion_next_state
             )
 
         self._join_bucket(
@@ -342,7 +348,10 @@ class PodManager:
         )
 
     def handle_timeout_on_pod_completions(
-        self, node: Node, timeout_seconds: int
+        self,
+        node: Node,
+        timeout_seconds: int,
+        next_state: UpgradeState = UpgradeState.POD_DELETION_REQUIRED,
     ) -> None:
         """Start or check the durable start-time annotation
         (reference: :331-368)."""
@@ -362,9 +371,7 @@ class PodManager:
             self._provider.change_node_upgrade_annotation(node, key, str(now))
             return
         if now > start + timeout_seconds:
-            self._provider.change_node_upgrade_state(
-                node, UpgradeState.POD_DELETION_REQUIRED
-            )
+            self._provider.change_node_upgrade_state(node, next_state)
             self._provider.change_node_upgrade_annotation(node, key, NULL_STRING)
 
     # -- helpers -----------------------------------------------------------

@@ -386,7 +386,16 @@ class IncrementalSnapshotSource(InformerSnapshotSource):
     ``build_state`` (state_manager) consumes this via :meth:`dirty` /
     :meth:`clean`: a settled pool serves the cached
     ``ClusterUpgradeState`` with zero reads and zero per-node CPU, and a
-    single node event reclassifies exactly one node. The cached state and
+    single node event reclassifies exactly one node. New states join the
+    machine for free — classification keys buckets by the node's state
+    label, so ``checkpoint-required`` (ISSUE 6) flows through
+    prime/update_node like any reference state; what each arc must get
+    right is the POLLING distinction: the checkpoint gate reads workload
+    pods and WorkloadCheckpoint CRs this source does not watch, so its
+    bucket iterates unfiltered (``nodes_in``), while every transition
+    INTO/out of it is a provider node write that lands in the dirty set
+    via :meth:`record_write` — the incremental==full fuzzer covers the
+    checkpoint arc explicitly (tests/test_incremental_state.py). The cached state and
     per-node assignment live here (:meth:`prime` / :meth:`update_node`);
     classification itself stays in the manager. ``verify_every_n`` makes
     every n-th pass a full rebuild that is *diffed* against the

@@ -136,6 +136,22 @@ class PassStats:
     #: Lifetime fraction of incremental-source passes served from
     #: deltas (settled or dirty-set) without a full rebuild.
     delta_hit_rate: float = 0.0
+    # Checkpoint-coordinated drain arc (docs/checkpoint-drain.md),
+    # exported as the tpu_operator_upgrade_checkpoint_* gauge family.
+    #: Nodes still gated in checkpoint-required after this pass.
+    checkpoint_nodes_waiting: int = 0
+    #: Checkpoint requests written to workload pods this pass.
+    checkpoint_requests_issued: int = 0
+    #: Nodes whose checkpoint gate completed (all acks) this pass.
+    checkpoint_completions: int = 0
+    #: Deadline escalations to a plain drain this pass.
+    checkpoint_escalations: int = 0
+    #: Lifetime totals (CheckpointManager counters) — alert material:
+    #: nonzero escalations mean workloads paid a full restart.
+    checkpoint_escalations_total: int = 0
+    checkpoints_completed_total: int = 0
+    checkpoint_restores_verified_total: int = 0
+    checkpoint_restore_escalations_total: int = 0
 
 
 class ClusterUpgradeStateManager:
@@ -195,6 +211,12 @@ class ClusterUpgradeStateManager:
         # the delta hit-rate gauge (reconcile thread only).
         self._incremental_builds = 0
         self._incremental_hits = 0
+        #: True once any pass saw the checkpoint arc (enabled policy or a
+        #: node in the bucket). Gates the per-pass checkpoint accounting:
+        #: a settled zero-work pass on a non-checkpointing pool must not
+        #: pay counter snapshots for a feature it never used, and once
+        #: the arc WAS used the lifetime gauges keep exporting.
+        self._checkpoint_seen = False
 
     def with_snapshot_from_informers(
         self,
@@ -293,6 +315,12 @@ class ClusterUpgradeStateManager:
             recorder=self.recorder,
             pod_provisioner=pod_provisioner,
             **kwargs,
+        )
+        # The manager swap must carry the restore-verified uncordon gate
+        # (docs/checkpoint-drain.md) like pod-manager swaps carry
+        # revision_source.
+        self.common.validation_manager.restore_gate = (
+            self.common.checkpoint_manager.restore_gate
         )
         self.common.validation_enabled = True
         return self
@@ -639,14 +667,33 @@ class ClusterUpgradeStateManager:
         start = time.perf_counter()
         issued_before, skipped_before = self.provider.write_counts()
         errors_before = self.runner.bucket_failures
+        checkpoint_enabled = (
+            policy.checkpoint is not None and policy.checkpoint.enable
+        )
+        checkpoint_bucket = len(state.nodes_in(UpgradeState.CHECKPOINT_REQUIRED))
+        if checkpoint_enabled or checkpoint_bucket:
+            self._checkpoint_seen = True
+        checkpoint_active = self._checkpoint_seen
+        checkpoint_before = (
+            common.checkpoint_manager.totals() if checkpoint_active else None
+        )
+        if policy.checkpoint is not None:
+            # The restore-verified uncordon step follows the CURRENT
+            # policy, not the one in force when the node checkpointed —
+            # refreshed every pass so a mid-roll verifyRestore flip
+            # takes effect at the next gate check.
+            common.checkpoint_manager.set_verify_restore(
+                policy.checkpoint.verify_restore
+            )
         try:
             common.process_done_or_unknown_nodes(state, UpgradeState.UNKNOWN)
             common.process_done_or_unknown_nodes(state, UpgradeState.DONE)
             self._process_upgrade_required_nodes(state, policy)
             common.process_cordon_required_nodes(state)
             common.process_wait_for_jobs_required_nodes(
-                state, policy.wait_for_completion
+                state, policy.wait_for_completion, checkpoint_enabled
             )
+            common.process_checkpoint_required_nodes(state, policy.checkpoint)
             drain_enabled = policy.drain is not None and policy.drain.enable
             common.process_pod_deletion_required_nodes(
                 state, policy.pod_deletion, drain_enabled
@@ -676,6 +723,31 @@ class ClusterUpgradeStateManager:
             stats.writes_skipped = skipped_after - skipped_before
             stats.node_errors = self.runner.bucket_failures - errors_before
             stats.apply_s = time.perf_counter() - start
+            if checkpoint_before is not None:
+                ckpt = common.checkpoint_manager.totals()
+                stats.checkpoint_requests_issued = (
+                    ckpt["requests"] - checkpoint_before["requests"]
+                )
+                stats.checkpoint_completions = (
+                    ckpt["completions"] - checkpoint_before["completions"]
+                )
+                stats.checkpoint_escalations = (
+                    ckpt["escalations"] - checkpoint_before["escalations"]
+                )
+                advanced = ckpt["advanced"] - checkpoint_before["advanced"]
+                stats.checkpoint_nodes_waiting = (
+                    max(0, checkpoint_bucket - advanced)
+                    if checkpoint_enabled
+                    else 0
+                )
+                stats.checkpoint_escalations_total = ckpt["escalations"]
+                stats.checkpoints_completed_total = ckpt["completions"]
+                stats.checkpoint_restores_verified_total = ckpt[
+                    "restores_verified"
+                ]
+                stats.checkpoint_restore_escalations_total = ckpt[
+                    "restore_escalations"
+                ]
         log.info("state manager finished processing")
 
     # -- mode dispatch (reference: upgrade_state.go:287-325) ---------------

@@ -101,13 +101,50 @@ class ValidationManager:
         self._timeout = timeout_seconds
         self._recorder = recorder
         self._provisioner = pod_provisioner
+        #: Restore-verified uncordon step (docs/checkpoint-drain.md): an
+        #: optional gate run BEFORE the other validation gates — a
+        #: checkpoint-coordinated node must prove its recorded
+        #: checkpoints restorable before it is uncordoned, and a cheap
+        #: annotation/CR check deferring must not re-run the
+        #: device-bound hook every pass. Set by the orchestrator
+        #: (CheckpointManager.restore_gate); plain attribute so
+        #: with_validation_enabled's manager swap can carry it over.
+        #: The gate owns its own durable deadline and always eventually
+        #: returns True (degrading, never stalling) — it runs OUTSIDE
+        #: the validation timeout clock: a deferring restore check must
+        #: not burn the validation budget into a FAILED.
+        self.restore_gate: Optional[Callable[[Node], bool]] = None
 
     @property
     def enabled(self) -> bool:
         return bool(self._pod_selector) or self._hook is not None
 
+    def _restore_ok(self, node: Node) -> bool:
+        if self.restore_gate is None:
+            return True
+        return bool(self.restore_gate(node))
+
     def validate(self, node: Node) -> bool:
-        """True when the node passes validation (reference: :71-116)."""
+        """True when the node passes validation (reference: :71-116).
+
+        The restore-verified step runs FIRST, and even with validation
+        otherwise unconfigured: a checkpoint-coordinated node routes
+        through the validation bucket purely for this gate (the bucket
+        polls, so a deferred verification re-runs every pass). Running
+        it before the other gates keeps a deferral — up to the restore
+        deadline — from re-executing the device-bound hook and pod
+        provisioning once per pass for nothing."""
+        if not self._restore_ok(node):
+            # Deferred, not failed: the restore gate degrades on its own
+            # durable deadline. Retire any previously stamped validation
+            # clock while deferring — the gates below are not running,
+            # and a stale stamp aging through a long deferral would let
+            # a later transient pod flap read expiry off it and FAIL a
+            # node whose validation had been passing throughout.
+            self._provider.change_node_upgrade_annotation(
+                node, self._keys.validation_start_annotation, NULL_STRING
+            )
+            return False
         if not self.enabled:
             return True
         if self._provisioner is not None:

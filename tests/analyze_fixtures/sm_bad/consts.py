@@ -10,12 +10,18 @@ class WidgetState(str, Enum):
     JAMMED = "widget-jammed"
     RETIRED = "widget-retired"  # STM201: in neither partition
     LOST = "widget-lost"  # STM201: in neither partition
+    # The checkpoint-arc twin: partitioned correctly but the orchestrator
+    # below ships no handler for it -- the deliberately-missing arc the
+    # STM203 gate must catch (ISSUE 6: a state added to the machine
+    # without an apply_state processor parks nodes forever).
+    CHECKPOINTING = "widget-checkpointing"
 
 
 MANAGED_STATES = (
     WidgetState.IDLE,
     WidgetState.SPINNING,
     WidgetState.JAMMED,
+    WidgetState.CHECKPOINTING,
 )
 
 MAINTENANCE_STATES = (

@@ -3,7 +3,8 @@
 
 class WidgetMachine:
     def apply_state(self, state):
-        # STM203: JAMMED / RETIRED / LOST have no handler here.
+        # STM203: JAMMED / RETIRED / LOST / CHECKPOINTING have no
+        # handler here (CHECKPOINTING is the deliberately-missing arc).
         self.process_idle_nodes(state)
         self.process_spinning_nodes(state)
         self.process_melted_nodes(state)  # STM204: maps to no state

@@ -9,12 +9,14 @@ class GadgetState(str, Enum):
     JAMMED = "gadget-jammed"
     RETIRED = "gadget-retired"
     LOST = "gadget-lost"
+    CHECKPOINTING = "gadget-checkpointing"
 
 
 MANAGED_STATES = (
     GadgetState.IDLE,
     GadgetState.SPINNING,
     GadgetState.JAMMED,
+    GadgetState.CHECKPOINTING,
 )
 
 MAINTENANCE_STATES = (

@@ -8,6 +8,7 @@ class GadgetMachine:
         self.process_idle_nodes(state, GadgetState.IDLE)
         self.process_spinning_nodes(state)
         self.process_jammed_nodes(state)
+        self.process_checkpointing_nodes(state)
         self.process_retired_nodes(state)
         self.process_lost_nodes(state)
 
@@ -18,6 +19,9 @@ class GadgetMachine:
         return state
 
     def process_jammed_nodes(self, state):
+        return state
+
+    def process_checkpointing_nodes(self, state):
         return state
 
     def process_retired_nodes(self, state):

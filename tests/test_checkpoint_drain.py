@@ -1,0 +1,724 @@
+"""Checkpoint-coordinated zero-disruption drains (ISSUE 6).
+
+The contract under test (docs/checkpoint-drain.md):
+
+* **arc** — wait-for-jobs routes into ``checkpoint-required`` when the
+  policy enables checkpointing; the drain gates on checkpoint-complete
+  acks; uncordon is restore-verified against the WorkloadCheckpoint CRs;
+* **epoch idempotency** — re-entry after an aborted pass re-derives the
+  same epoch id from the durable clock: no duplicate requests, no stale
+  acks from an earlier arc;
+* **deadline escalation** — a wedged (non-acking) workload escalates to
+  a plain drain at the deadline, with the partial manifest of whatever
+  DID ack recorded; the roll always completes;
+* **restore degradation** — a vanished checkpoint defers uncordon up to
+  its own deadline, then degrades to a cold restart — bounded, never a
+  stalled pool;
+* **lost steps** — the sim's accounting shows a checkpointed victim
+  re-trains only post-checkpoint steps while the evict-only baseline
+  re-trains everything.
+"""
+
+import json
+
+import pytest
+
+from k8s_operator_libs_tpu.api import (
+    CheckpointSpec,
+    DrainSpec,
+    DriverUpgradePolicySpec,
+    make_workload_checkpoint,
+    workload_checkpoint_name,
+)
+from k8s_operator_libs_tpu.api.upgrade_v1alpha1 import WORKLOAD_CHECKPOINT_KIND
+from k8s_operator_libs_tpu.kube import FakeCluster, Node, Pod
+from k8s_operator_libs_tpu.kube.objects import KubeObject
+from k8s_operator_libs_tpu.kube.sim import (
+    CheckpointingWorkloadSimulator,
+    DaemonSetSimulator,
+)
+from k8s_operator_libs_tpu.upgrade import (
+    ClusterUpgradeStateManager,
+    DeviceClass,
+    TaskRunner,
+    UpgradeKeys,
+    UpgradeMetrics,
+    UpgradeState,
+)
+from k8s_operator_libs_tpu.utils import IntOrString
+from builders import make_node
+
+DEVICE = DeviceClass.tpu()
+KEYS = UpgradeKeys(DEVICE)
+NS = "driver-ns"
+LABELS = {"app": "driver"}
+TRAIN_NS = "training"
+TRAIN_SELECTOR = "app=trainer"
+
+
+def checkpoint_policy(timeout_seconds=300, enable=True, **kwargs):
+    return DriverUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=0,
+        max_unavailable=IntOrString("100%"),
+        drain=DrainSpec(enable=True, force=True, timeout_seconds=30),
+        checkpoint=(
+            CheckpointSpec(
+                enable=True,
+                pod_selector=TRAIN_SELECTOR,
+                timeout_seconds=timeout_seconds,
+                **kwargs,
+            )
+            if enable
+            else None
+        ),
+    )
+
+
+def make_harness(node_count=2, nonacking=(), ack_delay_steps=1):
+    cluster = FakeCluster()
+    for i in range(node_count):
+        cluster.create(make_node(f"node-{i}"))
+    sim = DaemonSetSimulator(
+        cluster, name="driver", namespace=NS, match_labels=LABELS
+    )
+    sim.settle()
+    workload = CheckpointingWorkloadSimulator(
+        cluster, KEYS, namespace=TRAIN_NS,
+        nonacking=nonacking, ack_delay_steps=ack_delay_steps,
+    )
+    mgr = ClusterUpgradeStateManager(
+        cluster, DEVICE, runner=TaskRunner(inline=True)
+    )
+    return cluster, sim, workload, mgr
+
+
+def state_of(cluster, name):
+    return cluster.get("Node", name).labels.get(KEYS.state_label, "")
+
+
+def drive(cluster, sim, workload, mgr, policy, max_passes=60,
+          record=None):
+    for i in range(max_passes):
+        workload.step()
+        sim.step()
+        mgr.apply_state(mgr.build_state(NS, LABELS), policy)
+        sim.step()
+        if record is not None:
+            record(i)
+        if all(
+            state_of(cluster, n.name) == str(UpgradeState.DONE)
+            for n in cluster.list("Node")
+        ) and sim.all_pods_ready_and_current():
+            for _ in range(3):
+                workload.step()  # evicted victims reschedule + restore
+            return i + 1
+    raise AssertionError("roll did not converge")
+
+
+class FakeClock:
+    """Controllable stand-in for the durable-clock module's ``time``."""
+
+    def __init__(self, start=1_000_000.0):
+        self.now = start
+
+    def time(self):
+        return self.now
+
+    def advance(self, seconds):
+        self.now += seconds
+
+
+@pytest.fixture
+def clock(monkeypatch):
+    fake = FakeClock()
+    # advance_durable_clock lives in validation_manager; checkpoint and
+    # pod managers import the helper, which resolves time via that
+    # module's globals.
+    monkeypatch.setattr(
+        "k8s_operator_libs_tpu.upgrade.validation_manager.time", fake
+    )
+    return fake
+
+
+class TestHappyArc:
+    def test_roll_passes_through_checkpoint_required(self):
+        cluster, sim, workload, mgr = make_harness(node_count=2)
+        seen = set()
+        sim.set_template_hash("v2")
+
+        def record(_):
+            for n in cluster.list("Node"):
+                seen.add(n.labels.get(KEYS.state_label, ""))
+
+        drive(cluster, sim, workload, mgr, checkpoint_policy(),
+              record=record)
+        assert str(UpgradeState.CHECKPOINT_REQUIRED) in seen
+        totals = mgr.common.checkpoint_manager.totals()
+        assert totals["completions"] == 2
+        assert totals["escalations"] == 0
+        assert totals["restores_verified"] == 2
+
+    def test_drain_gated_until_ack(self):
+        # A slow acker (3 ticks) holds its node in checkpoint-required
+        # while the workload pod is still alive — eviction must not
+        # precede the ack.
+        cluster, sim, workload, mgr = make_harness(
+            node_count=1, ack_delay_steps=3
+        )
+        policy = checkpoint_policy()
+        sim.set_template_hash("v2")
+        saw_gated = {"passes": 0}
+
+        def record(_):
+            if state_of(cluster, "node-0") == str(
+                UpgradeState.CHECKPOINT_REQUIRED
+            ):
+                # The workload pod must still exist while gated.
+                assert cluster.get_or_none(
+                    "Pod", workload.workload("node-0").pod_name, TRAIN_NS
+                ) is not None
+                saw_gated["passes"] += 1
+
+        drive(cluster, sim, workload, mgr, policy, record=record)
+        assert saw_gated["passes"] >= 2  # actually waited for the ack
+        w = workload.workload("node-0")
+        assert w.restarts == 1
+        # Only the steps after the checkpoint were re-trained.
+        assert 0 <= w.lost_steps <= 3
+
+    def test_lost_steps_strictly_fewer_than_full_restart(self):
+        results = {}
+        for mode in ("baseline", "checkpointed"):
+            cluster, sim, workload, mgr = make_harness(node_count=2)
+            for _ in range(10):
+                workload.step()  # history worth losing
+            sim.set_template_hash("v2")
+            drive(cluster, sim, workload, mgr,
+                  checkpoint_policy(enable=(mode == "checkpointed")))
+            results[mode] = workload.lost_steps()
+        assert results["checkpointed"] < results["baseline"]
+        assert results["baseline"] >= 20  # both victims lost everything
+
+    def test_arc_annotations_cleaned_up_at_done(self):
+        cluster, sim, workload, mgr = make_harness(node_count=1)
+        sim.set_template_hash("v2")
+        drive(cluster, sim, workload, mgr, checkpoint_policy())
+        annotations = Node(cluster.get("Node", "node-0").raw).annotations
+        for key in (
+            KEYS.checkpoint_start_annotation,
+            KEYS.checkpoint_manifest_annotation,
+            KEYS.checkpoint_escalated_annotation,
+            KEYS.restore_verify_start_annotation,
+        ):
+            assert key not in annotations, key
+
+    def test_restore_verified_before_uncordon(self):
+        """The WorkloadCheckpoint CR must exist (restore-verified) while
+        the node is still cordoned — the manifest gate runs in the
+        validation bucket, pre-uncordon."""
+        cluster, sim, workload, mgr = make_harness(node_count=1)
+        sim.set_template_hash("v2")
+        verified_while_cordoned = []
+        real_gate = mgr.common.checkpoint_manager.restore_gate
+
+        def spy(node):
+            ok = real_gate(node)
+            if ok:
+                raw = cluster.get("Node", node.name).raw
+                verified_while_cordoned.append(
+                    bool((raw.get("spec") or {}).get("unschedulable"))
+                )
+            return ok
+
+        mgr.common.validation_manager.restore_gate = spy
+        drive(cluster, sim, workload, mgr, checkpoint_policy())
+        assert verified_while_cordoned and all(verified_while_cordoned)
+
+
+class TestEpochIdempotency:
+    def setup_node_in_checkpoint(self, cluster, mgr):
+        node = Node(cluster.get("Node", "node-0").raw)
+        mgr.provider.change_node_upgrade_state(
+            node, UpgradeState.CHECKPOINT_REQUIRED
+        )
+
+    def test_reentry_reuses_epoch_and_issues_no_duplicate_requests(self):
+        cluster, sim, workload, mgr = make_harness(node_count=1)
+        workload.step()  # workload pod exists
+        cm = mgr.common.checkpoint_manager
+        spec = CheckpointSpec(
+            enable=True, pod_selector=TRAIN_SELECTOR, timeout_seconds=300
+        )
+        node = Node(cluster.get("Node", "node-0").raw)
+        for _ in range(3):  # three aborted/retried passes
+            cm.coordinate(node, spec, UpgradeState.DRAIN_REQUIRED)
+            node = Node(cluster.get("Node", "node-0").raw)
+        assert cm.totals()["requests"] == 1  # one request, not three
+        epoch = node.annotations[KEYS.checkpoint_start_annotation]
+        pod = Pod(
+            cluster.get(
+                "Pod", workload.workload("node-0").pod_name, TRAIN_NS
+            ).raw
+        )
+        assert pod.annotations[KEYS.checkpoint_request_annotation] == epoch
+
+    def test_stale_ack_from_previous_epoch_does_not_count(self, clock):
+        cluster, sim, workload, mgr = make_harness(node_count=1)
+        workload.step()
+        cm = mgr.common.checkpoint_manager
+        spec = CheckpointSpec(
+            enable=True, pod_selector=TRAIN_SELECTOR, timeout_seconds=300
+        )
+        pod_name = workload.workload("node-0").pod_name
+        # A leftover ack from an imaginary earlier arc.
+        cluster.patch(
+            "Pod", pod_name, TRAIN_NS,
+            patch={"metadata": {"annotations": {
+                KEYS.checkpoint_complete_annotation: "999",
+            }}},
+        )
+        node = Node(cluster.get("Node", "node-0").raw)
+        cm.coordinate(node, spec, UpgradeState.DRAIN_REQUIRED)
+        # Not advanced: the stale ack did not satisfy the fresh epoch.
+        assert state_of(cluster, "node-0") == str(
+            UpgradeState.CHECKPOINT_REQUIRED
+        ) or KEYS.state_label not in Node(
+            cluster.get("Node", "node-0").raw
+        ).labels
+        assert cm.totals()["completions"] == 0
+
+
+class TestDeadlineEscalation:
+    def test_nonacking_workload_escalates_and_roll_completes(self, clock):
+        cluster, sim, workload, mgr = make_harness(
+            node_count=2, nonacking=("node-0",)
+        )
+        sim.set_template_hash("v2")
+        policy = checkpoint_policy(timeout_seconds=5)
+
+        def record(_):
+            clock.advance(2)  # wall time passes between reconcile passes
+
+        drive(cluster, sim, workload, mgr, policy, record=record)
+        totals = mgr.common.checkpoint_manager.totals()
+        assert totals["escalations"] == 1  # node-0 only, exactly once
+        assert totals["completions"] == 1  # node-1 acked normally
+        # The wedged victim paid the full restart; the acking one didn't.
+        assert workload.workload("node-0").lost_steps > 0
+        assert (
+            workload.workload("node-1").lost_steps
+            < workload.workload("node-0").lost_steps
+        )
+
+    def test_escalation_records_partial_manifest(self, clock):
+        """Two victims on one node, one acks, one is wedged: the
+        escalated manifest still carries the acker's checkpoint."""
+        cluster = FakeCluster()
+        cluster.create(make_node("node-0"))
+        sim = DaemonSetSimulator(
+            cluster, name="driver", namespace=NS, match_labels=LABELS
+        )
+        sim.settle()
+        mgr = ClusterUpgradeStateManager(
+            cluster, DEVICE, runner=TaskRunner(inline=True)
+        )
+        cm = mgr.common.checkpoint_manager
+        spec = CheckpointSpec(
+            enable=True, pod_selector=TRAIN_SELECTOR, timeout_seconds=5
+        )
+        for pod_name in ("acker", "wedged"):
+            pod = Pod.new(pod_name, namespace=TRAIN_NS)
+            pod.node_name = "node-0"
+            pod.labels.update({"app": "trainer"})
+            pod.phase = "Running"
+            cluster.create(pod)
+        node = Node(cluster.get("Node", "node-0").raw)
+        cm.coordinate(node, spec, UpgradeState.DRAIN_REQUIRED)
+        epoch = node.annotations[KEYS.checkpoint_start_annotation]
+        # Only "acker" completes the contract.
+        cluster.create(KubeObject(make_workload_checkpoint(
+            "acker", TRAIN_NS, "node-0", step=7, request_id=epoch
+        )))
+        cluster.patch(
+            "Pod", "acker", TRAIN_NS,
+            patch={"metadata": {"annotations": {
+                KEYS.checkpoint_complete_annotation: epoch,
+                KEYS.checkpoint_step_annotation: "7",
+            }}},
+        )
+        clock.advance(6)  # past the deadline
+        node = Node(cluster.get("Node", "node-0").raw)
+        cm.coordinate(node, spec, UpgradeState.DRAIN_REQUIRED)
+        node = Node(cluster.get("Node", "node-0").raw)
+        assert node.labels[KEYS.state_label] == str(
+            UpgradeState.DRAIN_REQUIRED
+        )
+        assert (
+            node.annotations[KEYS.checkpoint_escalated_annotation] == "true"
+        )
+        manifest = json.loads(
+            node.annotations[KEYS.checkpoint_manifest_annotation]
+        )
+        assert manifest == {f"{TRAIN_NS}/acker": 7}
+        assert cm.totals()["escalations"] == 1
+
+    def test_disabled_spec_advances_parked_nodes(self):
+        """Checkpointing withdrawn mid-roll: nodes already parked in
+        checkpoint-required advance into the eviction path instead of
+        wedging on a disabled feature — and the durable deadline clock
+        is cleared with them (review finding: a surviving stamp would
+        read as instantly-expired on the NEXT enabled roll and escalate
+        it with zero requests ever issued)."""
+        cluster, sim, workload, mgr = make_harness(node_count=1)
+        workload.step()  # a victim exists, so the arc actually started
+        node = Node(cluster.get("Node", "node-0").raw)
+        mgr.provider.change_node_upgrade_state(
+            node, UpgradeState.CHECKPOINT_REQUIRED
+        )
+        sim.step()
+        # One enabled pass starts the clock (requests out, no acks yet).
+        mgr.apply_state(mgr.build_state(NS, LABELS), checkpoint_policy())
+        node = Node(cluster.get("Node", "node-0").raw)
+        assert KEYS.checkpoint_start_annotation in node.annotations
+        # Policy withdrawn: the park-path exit must clear the clock.
+        mgr.apply_state(
+            mgr.build_state(NS, LABELS), checkpoint_policy(enable=False)
+        )
+        node = Node(cluster.get("Node", "node-0").raw)
+        assert node.labels[KEYS.state_label] == str(
+            UpgradeState.DRAIN_REQUIRED
+        )
+        assert KEYS.checkpoint_start_annotation not in node.annotations
+
+    def test_verify_restore_false_skips_verification(self):
+        """verifyRestore=false must actually be consulted (review
+        finding): the gate retires the manifest without checking CRs —
+        no deferral, no restores_verified count."""
+        cluster, sim, workload, mgr = make_harness(node_count=1)
+        sim.set_template_hash("v2")
+        # Victim acks normally, but we DELETE its checkpoint CR as soon
+        # as it exists — with verification on this would defer uncordon
+        # for the whole restore deadline; with it off the roll must
+        # complete promptly and unverified.
+        from k8s_operator_libs_tpu.api.upgrade_v1alpha1 import (
+            WORKLOAD_CHECKPOINT_KIND as CKPT_KIND,
+        )
+
+        def record(_):
+            for o in cluster.list(CKPT_KIND, namespace=TRAIN_NS):
+                cluster.delete(CKPT_KIND, o.name, TRAIN_NS)
+
+        drive(cluster, sim, workload, mgr,
+              checkpoint_policy(verify_restore=False), record=record)
+        totals = mgr.common.checkpoint_manager.totals()
+        assert totals["completions"] == 1
+        assert totals["restores_verified"] == 0
+        assert totals["restore_escalations"] == 0
+        node = Node(cluster.get("Node", "node-0").raw)
+        assert KEYS.checkpoint_manifest_annotation not in node.annotations
+
+    def test_restore_deferral_does_not_burn_validation_clock(self, clock):
+        """Review finding: once every validation gate passed, the
+        validation timeout clock must be retired BEFORE the restore gate
+        defers — a stale stamp plus a later transient pod flap would
+        FAIL a node that passed everything."""
+        cluster = FakeCluster()
+        cluster.create(make_node("node-0"))
+        mgr = ClusterUpgradeStateManager(
+            cluster, DEVICE, runner=TaskRunner(inline=True)
+        )
+        hook_runs = []
+        mgr.with_validation_enabled(
+            validation_hook=lambda node: hook_runs.append(node.name) or True
+        )
+        node = Node(cluster.get("Node", "node-0").raw)
+        # Manifest pointing at a CR that never exists: the gate defers.
+        mgr.provider.change_node_upgrade_annotation(
+            node, KEYS.checkpoint_manifest_annotation,
+            json.dumps({f"{TRAIN_NS}/ghost": 5}),
+        )
+        # A previously stamped validation clock (an earlier not-ready
+        # probe pass), now ancient.
+        mgr.provider.change_node_upgrade_annotation(
+            node, KEYS.validation_start_annotation, "1"
+        )
+        vm = mgr.common.validation_manager
+        node = Node(cluster.get("Node", "node-0").raw)
+        assert vm.validate(node) is False  # restore gate defers
+        node = Node(cluster.get("Node", "node-0").raw)
+        # The validation clock is gone — the deferral cannot be turned
+        # into a validation FAILURE by a later flap reading the stamp.
+        assert KEYS.validation_start_annotation not in node.annotations
+        assert KEYS.validation_failed_annotation not in node.annotations
+        # And the device-bound hook never ran: the restore gate defers
+        # BEFORE the expensive gates, not after them.
+        assert hook_runs == []
+
+    def test_no_eligible_pods_completes_trivially(self):
+        cluster, sim, workload, mgr = make_harness(node_count=1)
+        # No workload.step(): no training pod exists on the node.
+        node = Node(cluster.get("Node", "node-0").raw)
+        mgr.provider.change_node_upgrade_state(
+            node, UpgradeState.CHECKPOINT_REQUIRED
+        )
+        sim.step()
+        mgr.apply_state(mgr.build_state(NS, LABELS), checkpoint_policy())
+        assert state_of(cluster, "node-0") == str(
+            UpgradeState.DRAIN_REQUIRED
+        )
+        assert mgr.common.checkpoint_manager.totals()["completions"] == 1
+
+
+class TestRestoreVerifiedUncordon:
+    def _node_with_manifest(self, cluster, mgr, manifest):
+        node = Node(cluster.get("Node", "node-0").raw)
+        mgr.provider.change_node_upgrade_annotation(
+            node, KEYS.checkpoint_manifest_annotation, json.dumps(manifest)
+        )
+        return Node(cluster.get("Node", "node-0").raw)
+
+    def test_missing_checkpoint_defers_then_degrades(self, clock):
+        cluster = FakeCluster()
+        cluster.create(make_node("node-0"))
+        mgr = ClusterUpgradeStateManager(
+            cluster, DEVICE, runner=TaskRunner(inline=True)
+        )
+        cm = mgr.common.checkpoint_manager
+        node = self._node_with_manifest(
+            cluster, mgr, {f"{TRAIN_NS}/ghost": 5}
+        )
+        assert cm.restore_gate(node) is False  # defers: CR missing
+        assert cm.restore_gate(node) is False
+        clock.advance(601)  # past RESTORE_VERIFY_TIMEOUT_SECONDS
+        assert cm.restore_gate(node) is True  # degrades, never stalls
+        totals = cm.totals()
+        assert totals["restore_escalations"] == 1
+        assert totals["restores_verified"] == 0
+        node = Node(cluster.get("Node", "node-0").raw)
+        assert KEYS.checkpoint_manifest_annotation not in node.annotations
+
+    def test_checkpoint_older_than_manifest_defers(self, clock):
+        """A CR that exists but holds an OLDER step than the manifest
+        recorded is not restorable to the promised point."""
+        cluster = FakeCluster()
+        cluster.create(make_node("node-0"))
+        mgr = ClusterUpgradeStateManager(
+            cluster, DEVICE, runner=TaskRunner(inline=True)
+        )
+        cm = mgr.common.checkpoint_manager
+        cluster.create(KubeObject(make_workload_checkpoint(
+            "victim", TRAIN_NS, "node-0", step=3
+        )))
+        node = self._node_with_manifest(
+            cluster, mgr, {f"{TRAIN_NS}/victim": 9}
+        )
+        assert cm.restore_gate(node) is False
+        # Workload re-checkpoints at the promised step: gate opens.
+        cluster.patch(
+            WORKLOAD_CHECKPOINT_KIND,
+            workload_checkpoint_name("victim"),
+            TRAIN_NS,
+            patch={"spec": {"step": 9}},
+        )
+        node = Node(cluster.get("Node", "node-0").raw)
+        assert cm.restore_gate(node) is True
+        assert cm.totals()["restores_verified"] == 1
+
+    def test_corrupt_manifest_clears_and_proceeds(self):
+        cluster = FakeCluster()
+        cluster.create(make_node("node-0"))
+        mgr = ClusterUpgradeStateManager(
+            cluster, DEVICE, runner=TaskRunner(inline=True)
+        )
+        cm = mgr.common.checkpoint_manager
+        node = Node(cluster.get("Node", "node-0").raw)
+        mgr.provider.change_node_upgrade_annotation(
+            node, KEYS.checkpoint_manifest_annotation, "not-json"
+        )
+        node = Node(cluster.get("Node", "node-0").raw)
+        assert cm.restore_gate(node) is True
+        node = Node(cluster.get("Node", "node-0").raw)
+        assert KEYS.checkpoint_manifest_annotation not in node.annotations
+
+    def test_failed_recovery_routes_through_restore_gate(self):
+        """Review finding: a FAILED node carrying a checkpoint manifest
+        must recover THROUGH the validation bucket (where the restore
+        gate runs and retires the manifest), never straight to uncordon
+        — otherwise the uncordon is unverified and the stale manifest
+        haunts the next roll."""
+        cluster, sim, workload, mgr = make_harness(node_count=1)
+        workload.step()
+        sim.step()
+        node = Node(cluster.get("Node", "node-0").raw)
+        # A node that checkpointed, then failed mid-upgrade: cordoned,
+        # manifest recorded, driver pod in sync again (recovery signal).
+        mgr.common.cordon_manager.cordon(node)
+        pod_name = workload.workload("node-0").pod_name
+        cluster.create(KubeObject(make_workload_checkpoint(
+            pod_name, TRAIN_NS, "node-0", step=4
+        )))
+        mgr.provider.change_node_upgrade_annotation(
+            node, KEYS.checkpoint_manifest_annotation,
+            json.dumps({f"{TRAIN_NS}/{pod_name}": 4}),
+        )
+        mgr.provider.change_node_upgrade_state(node, UpgradeState.FAILED)
+        sim.step()
+        mgr.apply_state(mgr.build_state(NS, LABELS), checkpoint_policy())
+        assert state_of(cluster, "node-0") == str(
+            UpgradeState.VALIDATION_REQUIRED
+        )
+        # Next pass: restore gate verifies, manifest retired, released.
+        sim.step()
+        mgr.apply_state(mgr.build_state(NS, LABELS), checkpoint_policy())
+        node = Node(cluster.get("Node", "node-0").raw)
+        assert KEYS.checkpoint_manifest_annotation not in node.annotations
+        assert mgr.common.checkpoint_manager.totals()["restores_verified"] == 1
+
+    def test_manifest_routes_pod_restart_through_validation_bucket(self):
+        """Even with validation unconfigured, a manifest-carrying node
+        goes pod-restart → validation-required (where the restore gate
+        polls) — never straight to uncordon."""
+        cluster, sim, workload, mgr = make_harness(node_count=1)
+        assert not mgr.is_validation_enabled()
+        seen = set()
+        sim.set_template_hash("v2")
+
+        def record(_):
+            seen.add(state_of(cluster, "node-0"))
+
+        drive(cluster, sim, workload, mgr, checkpoint_policy(),
+              record=record)
+        assert str(UpgradeState.VALIDATION_REQUIRED) in seen
+        assert (
+            mgr.common.checkpoint_manager.totals()["restores_verified"] == 1
+        )
+
+
+class TestObservability:
+    def test_checkpoint_gauge_family_exported(self):
+        cluster, sim, workload, mgr = make_harness(node_count=1)
+        metrics = UpgradeMetrics(mgr)
+        sim.set_template_hash("v2")
+        policy = checkpoint_policy()
+        for _ in range(40):
+            workload.step()
+            sim.step()
+            state = mgr.build_state(NS, LABELS)
+            mgr.apply_state(state, policy)
+            metrics.observe(state)
+            sim.step()
+            if state_of(cluster, "node-0") == str(UpgradeState.DONE):
+                break
+        text = metrics.render()
+        for line in (
+            "tpu_operator_upgrade_checkpoint_escalations_total",
+            "tpu_operator_upgrade_checkpoint_completed_total",
+            "tpu_operator_upgrade_checkpoint_restores_verified_total",
+            "tpu_operator_upgrade_checkpoint_nodes_waiting",
+        ):
+            assert line in text, line
+        assert (
+            'tpu_operator_upgrade_checkpoint_escalations_total{device="tpu"} 0'
+            in text
+        )
+
+    def test_pass_stats_count_the_arc(self):
+        cluster, sim, workload, mgr = make_harness(node_count=1)
+        workload.step()
+        node = Node(cluster.get("Node", "node-0").raw)
+        mgr.provider.change_node_upgrade_state(
+            node, UpgradeState.CHECKPOINT_REQUIRED
+        )
+        sim.step()
+        mgr.apply_state(mgr.build_state(NS, LABELS), checkpoint_policy())
+        stats = mgr.last_pass_stats
+        assert stats.checkpoint_requests_issued == 1
+        assert stats.checkpoint_nodes_waiting == 1  # gated on the ack
+        # The workload acks; the next pass completes the gate.
+        workload.step()
+        sim.step()
+        mgr.apply_state(mgr.build_state(NS, LABELS), checkpoint_policy())
+        stats = mgr.last_pass_stats
+        assert stats.checkpoint_completions == 1
+        assert stats.checkpoint_nodes_waiting == 0
+        assert stats.checkpoints_completed_total == 1
+
+    def test_drain_event_distinguishes_flavors(self):
+        events = []
+
+        class Recorder:
+            def eventf(self, obj, event_type, reason, fmt, *args):
+                events.append(fmt % args if args else fmt)
+
+        cluster = FakeCluster()
+        cluster.create(make_node("node-0"))
+        sim = DaemonSetSimulator(
+            cluster, name="driver", namespace=NS, match_labels=LABELS
+        )
+        sim.settle()
+        mgr = ClusterUpgradeStateManager(
+            cluster, DEVICE, runner=TaskRunner(inline=True),
+            recorder=Recorder(),
+        )
+        node = Node(cluster.get("Node", "node-0").raw)
+        mgr.provider.change_node_upgrade_annotation(
+            node, KEYS.checkpoint_manifest_annotation,
+            json.dumps({f"{TRAIN_NS}/victim": 3}),
+        )
+        mgr.provider.change_node_upgrade_state(
+            node, UpgradeState.DRAIN_REQUIRED
+        )
+        sim.step()
+        mgr.apply_state(mgr.build_state(NS, LABELS), checkpoint_policy())
+        assert any(
+            "checkpoint-coordinated drain" in e for e in events
+        ), events
+
+
+class TestCrossFileStateEnumerations:
+    def test_checkpoint_required_is_a_gang_consumer_state(self):
+        """Review finding: tpu/slice_gate.py enumerates the mid-pipeline
+        states POSITIVELY — a slice peer parked in checkpoint-required
+        must keep protecting its probe gang from teardown/replacement,
+        like every other state between cordon and validation."""
+        from k8s_operator_libs_tpu.tpu.slice_gate import (
+            _GANG_CONSUMER_STATES,
+        )
+
+        assert str(UpgradeState.CHECKPOINT_REQUIRED) in _GANG_CONSUMER_STATES
+
+
+class TestSpecValidation:
+    def test_round_trip(self):
+        policy = checkpoint_policy(timeout_seconds=120)
+        restored = DriverUpgradePolicySpec.from_dict(policy.to_dict())
+        assert restored.checkpoint == policy.checkpoint
+        assert restored.checkpoint.timeout_seconds == 120
+        assert restored.checkpoint.verify_restore is True
+
+    def test_zero_deadline_rejected(self):
+        with pytest.raises(ValueError):
+            CheckpointSpec(
+                enable=True, pod_selector="app=x", timeout_seconds=0
+            )
+        with pytest.raises(ValueError):
+            CheckpointSpec.from_dict(
+                {"enable": True, "podSelector": "app=x", "timeoutSeconds": -5}
+            )
+
+    def test_enabled_without_selector_rejected(self):
+        """Review finding: an empty selector would ask EVERY pod on the
+        node (driver pods included) to checkpoint; none would ack and
+        every node would stall to the deadline and spuriously escalate."""
+        with pytest.raises(ValueError):
+            CheckpointSpec(enable=True)
+        with pytest.raises(ValueError):
+            CheckpointSpec.from_dict({"enable": True})
+        # Disabled specs stay constructible with the defaults.
+        assert CheckpointSpec().pod_selector == ""
+
+    def test_absent_checkpoint_key_keeps_legacy_shape(self):
+        d = DriverUpgradePolicySpec(auto_upgrade=True).to_dict()
+        assert "checkpoint" not in d
+        assert DriverUpgradePolicySpec.from_dict(d).checkpoint is None

@@ -11,14 +11,17 @@ from k8s_operator_libs_tpu.upgrade.consts import IDLE_STATES, MANAGED_STATES
 
 
 class TestStates:
-    def test_all_thirteen_states(self):
-        assert len(list(UpgradeState)) == 13
+    def test_all_fourteen_states(self):
+        # 13 reference states (consts.go:48-83) + checkpoint-required
+        # (ISSUE 6, docs/checkpoint-drain.md — no reference analog).
+        assert len(list(UpgradeState)) == 14
 
     def test_state_values_match_reference(self):
         assert UpgradeState.UNKNOWN == ""
         assert UpgradeState.UPGRADE_REQUIRED == "upgrade-required"
         assert UpgradeState.CORDON_REQUIRED == "cordon-required"
         assert UpgradeState.WAIT_FOR_JOBS_REQUIRED == "wait-for-jobs-required"
+        assert UpgradeState.CHECKPOINT_REQUIRED == "checkpoint-required"
         assert UpgradeState.POD_DELETION_REQUIRED == "pod-deletion-required"
         assert UpgradeState.DRAIN_REQUIRED == "drain-required"
         assert UpgradeState.NODE_MAINTENANCE_REQUIRED == "node-maintenance-required"

@@ -102,6 +102,115 @@ class TestFakeCluster:
             cluster.get("Pod", "dep", "ns")
 
 
+class TestRegisteredCustomKinds:
+    """ADVICE.md fidelity gap: the namespace guard resolved
+    namespacedness via KINDS only, so namespaced custom resources
+    registered through kube.resources.register_resource bypassed it —
+    delete_collection('Widget') with no namespace silently deleted the
+    kind across ALL namespaces. The guard now consults the resource
+    registry first."""
+
+    def _seed_custom(self, cluster):
+        from k8s_operator_libs_tpu.api import make_workload_checkpoint
+        from k8s_operator_libs_tpu.kube.objects import KubeObject
+
+        # WorkloadCheckpoint is a registered custom kind (namespaced)
+        # that is NOT in objects.KINDS — exactly the bypass case.
+        for ns in ("one", "two"):
+            cluster.create(KubeObject(make_workload_checkpoint(
+                f"pod-{ns}", ns, "node-0", step=1
+            )))
+
+    def test_registry_entry_matches_api_contract(self):
+        """The CR contract lives in api/upgrade_v1alpha1.py but its
+        REST-registry entry lives in kube/resources._bootstrap (so kube
+        surfaces know the kind without importing api, and api stays
+        kube-free). Pin the two in sync."""
+        from k8s_operator_libs_tpu.api.upgrade_v1alpha1 import (
+            WORKLOAD_CHECKPOINT_API_VERSION,
+            WORKLOAD_CHECKPOINT_KIND,
+            WORKLOAD_CHECKPOINT_PLURAL,
+        )
+        from k8s_operator_libs_tpu.kube.resources import resource_for_kind
+
+        info = resource_for_kind(WORKLOAD_CHECKPOINT_KIND)
+        assert info.api_version == WORKLOAD_CHECKPOINT_API_VERSION
+        assert info.plural == WORKLOAD_CHECKPOINT_PLURAL
+        assert info.namespaced is True
+
+    def test_api_module_does_not_import_kube(self):
+        """Importing the api dataclasses alone must not pull the kube
+        package (the cost the registry placement exists to avoid)."""
+        import subprocess
+        import sys
+
+        code = (
+            "import sys\n"
+            "import k8s_operator_libs_tpu.api\n"
+            "mods = [m for m in sys.modules if m.startswith("
+            "'k8s_operator_libs_tpu.kube')]\n"
+            "assert not mods, mods\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True
+        )
+        assert proc.returncode == 0, proc.stderr
+
+    def test_all_namespaces_delete_refused(self):
+        from k8s_operator_libs_tpu.kube import BadRequestError
+
+        cluster = FakeCluster()
+        self._seed_custom(cluster)
+        with pytest.raises(BadRequestError):
+            cluster.delete_collection("WorkloadCheckpoint")
+        # Nothing was deleted anywhere.
+        assert cluster.get("WorkloadCheckpoint", "pod-one-checkpoint", "one")
+        assert cluster.get("WorkloadCheckpoint", "pod-two-checkpoint", "two")
+
+    def test_namespace_scoped_delete_works(self):
+        cluster = FakeCluster()
+        self._seed_custom(cluster)
+        deleted = cluster.delete_collection(
+            "WorkloadCheckpoint", namespace="one"
+        )
+        assert [o.name for o in deleted] == ["pod-one-checkpoint"]
+        assert cluster.get("WorkloadCheckpoint", "pod-two-checkpoint", "two")
+
+    def test_guard_mirrored_in_apiserver(self):
+        """Over the wire the same refusal must come from the apiserver's
+        deletecollection route (a raw HTTP client could otherwise hit the
+        all-namespaces path the typed client never emits)."""
+        import urllib.request
+
+        server = LocalApiServer().start()
+        try:
+            self._seed_custom(server.cluster)
+            url = (
+                f"{server.url}/apis/upgrade.tpu-operator.dev/v1alpha1/"
+                "workloadcheckpoints"
+            )
+            req = urllib.request.Request(url, method="DELETE")
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(req)
+            assert err.value.code == 400
+            assert server.cluster.get(
+                "WorkloadCheckpoint", "pod-one-checkpoint", "one"
+            )
+            # The namespaced route still serves the bulk delete.
+            ns_url = (
+                f"{server.url}/apis/upgrade.tpu-operator.dev/v1alpha1/"
+                "namespaces/one/workloadcheckpoints"
+            )
+            req = urllib.request.Request(ns_url, method="DELETE")
+            with urllib.request.urlopen(req) as resp:
+                assert resp.status == 200
+            assert server.cluster.get_or_none(
+                "WorkloadCheckpoint", "pod-one-checkpoint", "one"
+            ) is None
+        finally:
+            server.stop()
+
+
 class TestOverHttp:
     def test_wire_collection_delete(self):
         server = LocalApiServer().start()

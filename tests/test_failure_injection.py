@@ -104,7 +104,8 @@ def assert_invariants(cluster):
         # A node still mid-upgrade must never be schedulable again unless
         # it is pre-cordon or was already released.
         if state in ("pod-restart-required", "validation-required",
-                     "uncordon-required", "drain-required"):
+                     "uncordon-required", "drain-required",
+                     str(UpgradeState.CHECKPOINT_REQUIRED)):
             assert node.unschedulable, (
                 f"{node.name} schedulable while in {state}"
             )
@@ -273,3 +274,198 @@ def test_node_vanishes_mid_roll():
     else:
         raise AssertionError("roll wedged after node deletion")
     assert {n.name for n in cluster.list("Node")} == {"node-0", "node-2"}
+
+
+# ---------------------------------------------------------------------------
+# The checkpoint arc under fire (ISSUE 6): injected PATCH/eviction faults
+# mid-checkpoint must leave the node resumable (idempotent re-entry via the
+# durable epoch id), and a lost checkpoint-complete ack must hit the
+# deadline escalation — never a hang.
+# ---------------------------------------------------------------------------
+from k8s_operator_libs_tpu.api import CheckpointSpec  # noqa: E402
+from k8s_operator_libs_tpu.kube.sim import (  # noqa: E402
+    CheckpointingWorkloadSimulator,
+)
+
+CHECKPOINT_POLICY = DriverUpgradePolicySpec(
+    auto_upgrade=True,
+    max_parallel_upgrades=0,
+    max_unavailable=IntOrString("100%"),
+    drain=DrainSpec(enable=True, force=True, timeout_seconds=30),
+    checkpoint=CheckpointSpec(
+        enable=True, pod_selector="app=trainer", timeout_seconds=300
+    ),
+)
+
+
+def build_checkpoint_harness(node_count=2, nonacking=()):
+    cluster, sim, mgr = build_harness(node_count=node_count)
+    workload = CheckpointingWorkloadSimulator(
+        cluster, KEYS, namespace="training", nonacking=nonacking
+    )
+    return cluster, sim, workload, mgr
+
+
+def drive_checkpoint_roll_with_fault(
+    cluster, sim, workload, mgr, verb, kind, exc_type,
+    inject_at_pass=3, max_passes=80,
+):
+    sim.set_template_hash("v2")
+    fault = Flaky(exc_type)
+    aborted = 0
+
+    def tick(fn):
+        # Sim/workload controllers share the flaky apiserver; their tick
+        # failing is chaos too, not a harness crash.
+        try:
+            fn()
+        except ApiError:
+            pass
+
+    for i in range(max_passes):
+        if i == inject_at_pass:
+            cluster.add_reactor(verb, kind, fault)
+        tick(workload.step)
+        tick(sim.step)
+        try:
+            mgr.apply_state(mgr.build_state(NS, LABELS), CHECKPOINT_POLICY)
+        except ApiError:
+            aborted += 1
+        assert_invariants(cluster)
+        tick(sim.step)
+        done = all(
+            n.labels.get(KEYS.state_label) == "upgrade-done"
+            for n in _nodes_bypassing_reactors(cluster)
+        )
+        try:
+            settled = done and sim.all_pods_ready_and_current()
+        except ApiError:
+            settled = False
+        if settled:
+            return {"passes": i + 1, "aborted": aborted, "fired": fault.fired}
+    raise AssertionError(
+        f"checkpoint roll did not converge with {exc_type.__name__} on "
+        f"{verb} {kind} (fired={fault.fired}, aborted={aborted})"
+    )
+
+
+#: Verbs the checkpoint arc adds on top of the base roll: pod-annotation
+#: PATCHes (requests), evictions (the coordinated drain), and the
+#: restore gate's WorkloadCheckpoint reads. NotFoundError is excluded on
+#: evict: NotFound-on-evict legitimately means "already gone" (the drain
+#: helper skips the pod, real-apiserver semantics), so injecting it LIES
+#: — the pod survives while the drain believes it evicted, which is a
+#: broken fake, not a fault the contract covers.
+CHECKPOINT_FAULT_POINTS = [
+    ("patch", "Pod"),
+    ("evict", "Pod"),
+    ("get", "WorkloadCheckpoint"),
+    ("patch", "Node"),
+]
+
+
+@pytest.mark.parametrize(
+    "verb,kind,exc_type",
+    [
+        (v, k, e)
+        for (v, k), e in itertools.product(
+            CHECKPOINT_FAULT_POINTS, FAULT_TYPES
+        )
+        if not (v == "evict" and e is NotFoundError)
+    ],
+    ids=lambda p: getattr(p, "__name__", str(p)),
+)
+def test_checkpoint_arc_survives_transient_faults(verb, kind, exc_type):
+    """Mid-checkpoint faults leave the node resumable: the next pass
+    re-derives the epoch from the durable clock and the roll converges
+    with every checkpoint gate satisfied (no escalations — faults must
+    not burn the deadline path)."""
+    cluster, sim, workload, mgr = build_checkpoint_harness()
+    stats = drive_checkpoint_roll_with_fault(
+        cluster, sim, workload, mgr, verb, kind, exc_type
+    )
+    assert stats["fired"] > 0, "fault point never exercised — dead parameter"
+    totals = mgr.common.checkpoint_manager.totals()
+    assert totals["completions"] == 2
+    assert totals["escalations"] == 0
+    for obj in cluster.list("Node"):
+        assert not Node(obj.raw).unschedulable
+
+
+def test_checkpoint_requests_not_duplicated_across_aborted_passes():
+    """A conflict storm on pod patches aborts several checkpoint passes;
+    the epoch contract must keep the request count at one per victim,
+    not one per retry."""
+    cluster, sim, workload, mgr = build_checkpoint_harness(node_count=2)
+    stats = drive_checkpoint_roll_with_fault(
+        cluster, sim, workload, mgr, "patch", "Pod", ConflictError,
+    )
+    assert stats["aborted"] > 0
+    # 2 victims -> exactly 2 requests ever issued (the Flaky reactor
+    # fails the patch BEFORE it lands, so each failed attempt retries
+    # with the same epoch and the success is the only landing write).
+    assert mgr.common.checkpoint_manager.totals()["requests"] == 2
+
+
+def test_lost_ack_hits_deadline_escalation_not_a_hang(monkeypatch):
+    """The ISSUE 6 acceptance pin: a workload that never acks (lost
+    checkpoint-complete) escalates at the deadline and the roll
+    completes — under fault injection on the node patches too."""
+    class FakeTime:
+        now = 1_000_000.0
+
+        @classmethod
+        def time(cls):
+            return cls.now
+
+    monkeypatch.setattr(
+        "k8s_operator_libs_tpu.upgrade.validation_manager.time", FakeTime
+    )
+    cluster, sim, workload, mgr = build_checkpoint_harness(
+        node_count=2, nonacking=("node-0",)
+    )
+    sim.set_template_hash("v2")
+    policy = DriverUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=0,
+        max_unavailable=IntOrString("100%"),
+        drain=DrainSpec(enable=True, force=True, timeout_seconds=30),
+        checkpoint=CheckpointSpec(
+            enable=True, pod_selector="app=trainer", timeout_seconds=10
+        ),
+    )
+    fault = Flaky(ConflictError)
+    aborted = 0
+    for i in range(80):
+        if i == 3:
+            cluster.add_reactor("patch", "Node", fault)
+        FakeTime.now += 3  # wall clock marches toward the deadline
+        try:
+            workload.step()
+        except ApiError:
+            pass
+        try:
+            sim.step()
+        except ApiError:
+            pass
+        try:
+            mgr.apply_state(mgr.build_state(NS, LABELS), policy)
+        except ApiError:
+            aborted += 1
+        assert_invariants(cluster)
+        try:
+            sim.step()
+        except ApiError:
+            pass
+        done = all(
+            n.labels.get(KEYS.state_label) == "upgrade-done"
+            for n in _nodes_bypassing_reactors(cluster)
+        )
+        if done and sim.all_pods_ready_and_current():
+            break
+    else:
+        raise AssertionError("non-acking workload hung the roll")
+    totals = mgr.common.checkpoint_manager.totals()
+    assert totals["escalations"] == 1, totals
+    assert totals["completions"] == 1
+    assert fault.fired > 0
