@@ -1043,6 +1043,268 @@ def run_live_workload_roll(
     }
 
 
+def run_degraded_first_roll(slices: int = 4, hosts_per_slice: int = 4) -> dict:
+    """ISSUE 8 headline — the telemetry plane closing the loop: a
+    16-node / 4-slice pool with 3 injected stragglers (NodeHealthReport
+    CRs carrying collapsed ring bandwidth + ballooned probe latency,
+    published through the same ReportPublisher the monitor uses), rolled
+    twice under a 1-slice budget:
+
+    * **score_blind** — the pre-telemetry planner (no HealthSource):
+      candidates order by name, so healthy capacity is disrupted while
+      known stragglers keep serving degraded collectives;
+    * **degraded_first** — HealthSource wired: candidates order by
+      ascending health score, HARD-ASSERTED that every straggler node
+      enters the pipeline before any healthy-slice node and that ZERO
+      healthy-slice disruption windows open before the stragglers are
+      done (strictly fewer than score-blind).
+
+    Plus a **quarantine drill**: 6 degraded reports against a settled
+    pool under a 25% budget (4 nodes) must quarantine exactly to the
+    budget (violations hard-asserted zero, the excess counted as
+    budget-denied) and release every node once recovery reports land.
+    """
+    from k8s_operator_libs_tpu.api import QuarantineSpec
+    from k8s_operator_libs_tpu.tpu.monitor import ReportPublisher
+
+    nodes = slices * hosts_per_slice
+    straggler_nodes = tuple(f"s{s}-h0" for s in range(1, 4))
+    straggler_pools = {f"{POOL}-{s}" for s in range(1, 4)}
+
+    def node_pool(name: str) -> str:
+        return f"{POOL}-{name.split('-')[0][1:]}"
+
+    def publish(cluster, name, ring_gbps, latency_s, ok=True):
+        ReportPublisher(cluster, name, heartbeat_seconds=0.0).publish(
+            {"ring_allreduce": ok},
+            {"ring_gbytes_per_s": ring_gbps, "probe_latency_s": latency_s},
+        )
+
+    def one_roll(telemetry: bool) -> dict:
+        cluster, sim = build_pool(
+            slices=slices, hosts_per_slice=hosts_per_slice
+        )
+        # Reports exist in BOTH modes; the blind config just never
+        # consumes them — the comparison isolates the ordering policy.
+        for name in straggler_nodes:
+            publish(cluster, name, ring_gbps=2.0, latency_s=120.0)
+        mgr = ClusterUpgradeStateManager(
+            cluster, DEVICE, runner=TaskRunner(inline=True)
+        )
+        mgr.with_validation_enabled(validation_hook=lambda node: True)
+        enable_slice_aware_planning(mgr)
+        health = mgr.with_health_telemetry() if telemetry else None
+        policy = DriverUpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=1,
+            max_unavailable=IntOrString(1),  # one SLICE at a time
+        )
+        entry_order: list[str] = []
+
+        def record(event, obj, old):
+            if obj.get("kind") != "Node":
+                return
+            label = ((obj["metadata"].get("labels") or {})).get(
+                KEYS.state_label
+            )
+            old_label = (
+                ((old or {}).get("metadata") or {}).get("labels") or {}
+            ).get(KEYS.state_label)
+            if label == "cordon-required" and label != old_label:
+                entry_order.append(obj["metadata"]["name"])
+
+        cluster.subscribe(record)
+        samples: list[tuple[set, bool]] = []
+
+        def post_pass():
+            disrupted = set()
+            for obj in cluster.list("Node"):
+                from k8s_operator_libs_tpu.kube import Node as NodeObj
+
+                n = NodeObj(obj.raw)
+                if n.unschedulable or not n.is_ready():
+                    disrupted.add(n.labels[GKE_NODEPOOL_LABEL])
+            stragglers_done = all(
+                (((cluster.peek("Node", s) or {}).get("metadata") or {})
+                 .get("labels") or {}).get(KEYS.state_label)
+                == "upgrade-done"
+                for s in straggler_nodes
+            )
+            samples.append((disrupted, stragglers_done))
+
+        sim.set_template_hash("libtpu-v2")
+        start = time.perf_counter()
+        try:
+            passes = drive_to_convergence(
+                cluster, sim, mgr, policy, post_pass=post_pass
+            )
+        finally:
+            # A non-converging roll must not leak the report informer's
+            # watch thread into the rest of the bench process.
+            if health is not None:
+                health.stop()
+        elapsed = time.perf_counter() - start
+        previously: set = set()
+        windows = healthy_windows_before = 0
+        for disrupted, stragglers_done in samples:
+            for pool_id in disrupted - previously:
+                windows += 1
+                if pool_id not in straggler_pools and not stragglers_done:
+                    healthy_windows_before += 1
+            previously = set(disrupted)
+        healthy_entries = [
+            n for n in entry_order if node_pool(n) not in straggler_pools
+        ]
+        first_healthy = (
+            entry_order.index(healthy_entries[0])
+            if healthy_entries else len(entry_order)
+        )
+        last_straggler = max(
+            (entry_order.index(s) for s in straggler_nodes
+             if s in entry_order),
+            default=len(entry_order),
+        )
+        return {
+            "passes": passes,
+            "wall_s": round(elapsed, 3),
+            "disruption_windows": windows,
+            "healthy_windows_before_stragglers_done": healthy_windows_before,
+            "stragglers_before_any_healthy": last_straggler < first_healthy,
+            "entry_order": entry_order[:8],
+        }
+
+    blind = one_roll(telemetry=False)
+    degraded = one_roll(telemetry=True)
+    if not degraded["stragglers_before_any_healthy"]:
+        raise RuntimeError(
+            "degraded_first_roll: a healthy-slice node entered the "
+            f"pipeline before the stragglers (order: "
+            f"{degraded['entry_order']})"
+        )
+    if degraded["healthy_windows_before_stragglers_done"] != 0:
+        raise RuntimeError(
+            "degraded_first_roll: degraded-first ordering opened "
+            f"{degraded['healthy_windows_before_stragglers_done']} healthy "
+            "disruption windows before the stragglers were done"
+        )
+    if (
+        degraded["healthy_windows_before_stragglers_done"]
+        >= blind["healthy_windows_before_stragglers_done"]
+    ):
+        raise RuntimeError(
+            "degraded_first_roll: degraded-first must open strictly fewer "
+            "healthy-capacity windows than score-blind ordering "
+            f"({degraded['healthy_windows_before_stragglers_done']} vs "
+            f"{blind['healthy_windows_before_stragglers_done']})"
+        )
+
+    # -- quarantine drill -------------------------------------------------
+    cluster, sim = build_pool(slices=slices, hosts_per_slice=hosts_per_slice)
+    mgr = ClusterUpgradeStateManager(
+        cluster, DEVICE, runner=TaskRunner(inline=True)
+    )
+    health = mgr.with_health_telemetry()
+    budget = 4  # 25% of 16
+    policy = DriverUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=0,
+        max_unavailable=IntOrString("25%"),
+        quarantine=QuarantineSpec(
+            enable=True,
+            unhealthy_score=50.0,
+            recovery_score=70.0,
+            reprobe_backoff_seconds=1,
+        ),
+    )
+    drill: dict = {"budget": budget, "degraded_reports": 6}
+    try:
+        for _ in range(3):  # settle: classify everyone to done
+            sim.step()
+            mgr.apply_state(mgr.build_state(NS, DS_LABELS), policy)
+        degraded_names = [f"s{s}-h{h}" for s in range(3) for h in range(2)]
+        for name in degraded_names:
+            publish(cluster, name, ring_gbps=1.0, latency_s=150.0, ok=False)
+        deadline = time.time() + 10.0
+        while health.updates < len(degraded_names):
+            if time.time() > deadline:
+                raise RuntimeError(
+                    "degraded_first_roll: health reports never delivered"
+                )
+            time.sleep(0.01)
+        violations = 0
+        max_unavailable_seen = 0
+        for _ in range(4):
+            mgr.apply_state(mgr.build_state(NS, DS_LABELS), policy)
+            unavailable = sum(
+                1
+                for obj in cluster.list("Node")
+                if (obj.raw.get("spec") or {}).get("unschedulable")
+            )
+            max_unavailable_seen = max(max_unavailable_seen, unavailable)
+            if unavailable > budget:
+                violations += 1
+        totals = mgr.common.quarantine_manager.totals()
+        drill.update(
+            {
+                "quarantined": totals["entered"],
+                "budget_denied": totals["budget_denied"],
+                "max_unavailable_at_once": max_unavailable_seen,
+                "budget_violations": violations,
+            }
+        )
+        if violations or max_unavailable_seen > budget:
+            raise RuntimeError(
+                "degraded_first_roll: quarantine exceeded the disruption "
+                f"budget ({max_unavailable_seen} > {budget})"
+            )
+        if totals["entered"] != budget or totals["budget_denied"] < 1:
+            raise RuntimeError(
+                "degraded_first_roll: expected exactly budget-many "
+                f"quarantines with denials (got {totals})"
+            )
+        # Recovery: healthy reports land, the backoff clock expires, and
+        # every quarantined node must rejoin.
+        for name in degraded_names:
+            publish(cluster, name, ring_gbps=45.0, latency_s=2.0)
+        deadline = time.time() + 15.0
+        while True:
+            time.sleep(0.3)  # let the 1 s recheck backoff expire
+            mgr.apply_state(mgr.build_state(NS, DS_LABELS), policy)
+            totals = mgr.common.quarantine_manager.totals()
+            if totals["in_quarantine"] == 0:
+                break
+            if time.time() > deadline:
+                raise RuntimeError(
+                    "degraded_first_roll: quarantined nodes never released "
+                    f"after recovery ({totals})"
+                )
+        mgr.apply_state(mgr.build_state(NS, DS_LABELS), policy)
+        drill["released"] = totals["released"]
+        drill["uncordoned_after_recovery"] = all(
+            not (obj.raw.get("spec") or {}).get("unschedulable")
+            for obj in cluster.list("Node")
+        )
+        if not drill["uncordoned_after_recovery"]:
+            raise RuntimeError(
+                "degraded_first_roll: a released node stayed cordoned"
+            )
+    finally:
+        health.stop()
+
+    return {
+        "nodes": nodes,
+        "stragglers": list(straggler_nodes),
+        "score_blind": blind,
+        "degraded_first": degraded,
+        "straggler_first": 1.0,  # hard-asserted above
+        "healthy_windows_saved": (
+            blind["healthy_windows_before_stragglers_done"]
+            - degraded["healthy_windows_before_stragglers_done"]
+        ),
+        "quarantine_drill": drill,
+    }
+
+
 def run_ring_bandwidth(payload_mb: float = 1.0, devices: int = 8) -> dict:
     """ROADMAP item 4 / ISSUE 6 satellite: actually measure
     ``ring_gbytes_per_s`` — every BENCH round before this one published
@@ -1219,6 +1481,7 @@ SECTIONS = {
     "settled_pool_noop": run_settled_pool_noop,
     "single_event_latency": run_single_event_latency,
     "live_workload_roll": run_live_workload_roll,
+    "degraded_first_roll": run_degraded_first_roll,
     "ring_bandwidth": run_ring_bandwidth,
 }
 
@@ -1328,6 +1591,11 @@ def main() -> None:
     ring_bw = run_ring_bandwidth()
     _progress("ring_bandwidth")
 
+    # Fleet-health telemetry sections (ISSUE 8): degraded-node-first
+    # planning + the quarantine budget drill (docs/fleet-telemetry.md).
+    degraded_first = run_degraded_first_roll()
+    _progress("degraded_first_roll")
+
     details = {
         "backend": backend,
         # Trial counts derived from the actual result objects — never a
@@ -1363,6 +1631,7 @@ def main() -> None:
         "single_event_latency": single_event,
         "live_workload_roll": live_roll,
         "ring_bandwidth": ring_bw,
+        "degraded_first_roll": degraded_first,
         "gate_cold_vs_warm": gate_split,
         "devices": [str(d) for d in jax.devices()],
         "calibration": calibration,
@@ -1413,6 +1682,12 @@ def main() -> None:
             "ring_allreduce_gbytes_per_s": ring_bw[
                 "ring_allreduce_gbytes_per_s"
             ],
+            "degraded_first_healthy_windows_saved": degraded_first[
+                "healthy_windows_saved"
+            ],
+            "quarantine_budget_violations": degraded_first[
+                "quarantine_drill"
+            ]["budget_violations"],
         },
         "metric": "v5e-16 pool libtpu rolling-upgrade wall-clock "
         "(simulated GKE pool, real ICI/MXU health gate; median of "

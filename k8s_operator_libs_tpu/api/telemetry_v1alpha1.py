@@ -1,0 +1,263 @@
+"""NodeHealthReport CR contract (v1alpha1) — the fleet-health telemetry
+plane's data shape (docs/fleet-telemetry.md).
+
+The continuous monitor (tpu/monitor.py) reduces its whole ICI/MXU probe
+battery to one binary Node condition, throwing away every numeric signal
+the probes measure at the point of observation. Guard (PAPERS.md) argues
+straggler detection needs continuous *graded* telemetry, and the
+observable-collectives work shows the collective layer itself is the
+richest health signal. This module owns the CONTRACT for the structured
+per-node report those probes publish instead:
+
+* per-check boolean verdicts (psum, mxu, burn-in, ...);
+* numeric scores (ring all-reduce GB/s, probe latency, tokens/s);
+* a bounded rolling history window of past observations;
+* a derived 0-100 **health score** with a **trend** over the window.
+
+Like the WorkloadCheckpoint contract (upgrade_v1alpha1.py), the names
+and shapes live HERE, kube-free; the REST-registry entry lives in
+``kube/resources._bootstrap`` so every kube surface knows the kind even
+when api/ was never imported (tests/test_telemetry.py pins the two in
+sync). The report is **cluster-scoped and named after its node** — the
+informer path (upgrade/health_source.py) maps a report delta straight to
+the node it concerns with no spec read.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Mapping, Optional
+
+NODE_HEALTH_REPORT_KIND = "NodeHealthReport"
+NODE_HEALTH_REPORT_API_VERSION = "telemetry.tpu-operator.dev/v1alpha1"
+NODE_HEALTH_REPORT_PLURAL = "nodehealthreports"
+
+#: Bounded rolling history window: old entries are dropped, never an
+#: unbounded status (an apiserver object that grows per probe cycle
+#: forever is a slow-motion outage).
+DEFAULT_HISTORY_WINDOW = 12
+
+TREND_IMPROVING = "improving"
+TREND_STABLE = "stable"
+TREND_DEGRADING = "degrading"
+
+#: Score-derivation weights: check verdicts carry most of the signal (a
+#: failed probe is a failed probe), graded throughput/latency carry the
+#: rest so a *slowing* node scores below a healthy one long before any
+#: check flips (the straggler signal; Guard, PAPERS.md).
+CHECK_WEIGHT = 60.0
+BANDWIDTH_WEIGHT = 25.0
+LATENCY_WEIGHT = 15.0
+
+#: Reference points for the graded components. Full bandwidth credit at
+#: (or above) ``healthy_ring_gbytes_per_s``; full latency credit at (or
+#: under) ``latency_budget_s``. Both are derivation inputs, not gates —
+#: retune per device class like the IciHealthGate floors.
+DEFAULT_HEALTHY_RING_GBYTES_PER_S = 40.0
+DEFAULT_LATENCY_BUDGET_S = 30.0
+
+#: Trend hysteresis: the window-half means must move by more than this
+#: many score points before the trend leaves "stable" — scores jitter,
+#: and a flapping trend would flap the planner's ordering with it.
+TREND_EPSILON = 5.0
+
+#: Canonical metric keys inside ``status.metrics`` (and history rows).
+METRIC_RING_GBYTES_PER_S = "ring_gbytes_per_s"
+METRIC_PROBE_LATENCY_S = "probe_latency_s"
+METRIC_TOKENS_PER_S = "tokens_per_s"
+METRIC_MXU_TFLOPS = "mxu_tflops"
+
+
+def node_health_report_name(node_name: str) -> str:
+    """Report name == node name: both sides of the contract (publishers,
+    the informer-path consumer) derive the mapping instead of reading a
+    spec field, and one node can never accumulate two reports."""
+    return node_name
+
+
+def derive_score(
+    checks: Mapping[str, bool],
+    metrics: Mapping[str, float],
+    healthy_ring_gbytes_per_s: float = DEFAULT_HEALTHY_RING_GBYTES_PER_S,
+    latency_budget_s: float = DEFAULT_LATENCY_BUDGET_S,
+) -> float:
+    """Fold one observation into the 0-100 health score.
+
+    Three components, each scaled into its weight:
+
+    * **checks** — fraction of passing verdicts (no checks = full
+      credit; an empty battery says nothing, it must not read as dead);
+    * **bandwidth** — measured ring GB/s against the healthy reference,
+      clamped to [0, 1] (absent = full credit: single-device nodes have
+      no ring to measure and must not score as degraded);
+    * **latency** — budget over measured probe latency, clamped the
+      same way (a battery taking 3x its budget is a straggler signal
+      even when every verdict passes).
+    """
+    if checks:
+        check_component = sum(1 for ok in checks.values() if ok) / len(checks)
+    else:
+        check_component = 1.0
+    ring = metrics.get(METRIC_RING_GBYTES_PER_S)
+    if ring is None or healthy_ring_gbytes_per_s <= 0:
+        bandwidth_component = 1.0
+    else:
+        bandwidth_component = min(
+            1.0, max(0.0, float(ring) / healthy_ring_gbytes_per_s)
+        )
+    latency = metrics.get(METRIC_PROBE_LATENCY_S)
+    if latency is None or latency <= 0 or latency_budget_s <= 0:
+        latency_component = 1.0
+    else:
+        latency_component = min(1.0, latency_budget_s / float(latency))
+    score = (
+        CHECK_WEIGHT * check_component
+        + BANDWIDTH_WEIGHT * bandwidth_component
+        + LATENCY_WEIGHT * latency_component
+    )
+    return round(min(100.0, max(0.0, score)), 2)
+
+
+def derive_trend(scores: list[float], epsilon: float = TREND_EPSILON) -> str:
+    """Trend over the rolling window: compare the mean of the newer half
+    against the older half, with ``epsilon`` points of hysteresis.
+    Fewer than 2 samples is trivially stable."""
+    if len(scores) < 2:
+        return TREND_STABLE
+    half = len(scores) // 2
+    older = scores[:half] or scores[:1]
+    newer = scores[half:]
+    delta = sum(newer) / len(newer) - sum(older) / len(older)
+    if delta > epsilon:
+        return TREND_IMPROVING
+    if delta < -epsilon:
+        return TREND_DEGRADING
+    return TREND_STABLE
+
+
+def trend_value(trend: str) -> int:
+    """Numeric encoding for metrics and ordering: degrading=-1,
+    stable=0, improving=1. Degrading sorts FIRST under ascending order —
+    between two equally scored slices the one still getting worse rolls
+    first."""
+    return {TREND_DEGRADING: -1, TREND_IMPROVING: 1}.get(trend, 0)
+
+
+@dataclass(frozen=True)
+class NodeHealth:
+    """Parsed view of one report's status — what the planner and the
+    metrics family consume (upgrade/health_source.py keeps a map of
+    these per node)."""
+
+    node_name: str
+    score: float = 100.0
+    trend: str = TREND_STABLE
+    checks: Mapping[str, bool] = field(default_factory=dict)
+    metrics: Mapping[str, float] = field(default_factory=dict)
+    observed_at: float = 0.0
+    source: str = ""
+
+
+def make_node_health_report(
+    node_name: str,
+    checks: Mapping[str, bool],
+    metrics: Mapping[str, float],
+    source: str = "monitor",
+    observed_at: float = 0.0,
+    history: Optional[list[dict[str, Any]]] = None,
+    history_window: int = DEFAULT_HISTORY_WINDOW,
+    healthy_ring_gbytes_per_s: float = DEFAULT_HEALTHY_RING_GBYTES_PER_S,
+    latency_budget_s: float = DEFAULT_LATENCY_BUDGET_S,
+) -> dict[str, Any]:
+    """Raw NodeHealthReport object for this observation, appended to the
+    caller-supplied prior ``history`` (the publisher passes the live
+    CR's window so the trend sees past observations; bounded to
+    ``history_window`` entries, oldest dropped)."""
+    score = derive_score(
+        checks,
+        metrics,
+        healthy_ring_gbytes_per_s=healthy_ring_gbytes_per_s,
+        latency_budget_s=latency_budget_s,
+    )
+    entry: dict[str, Any] = {"score": score, "observedAt": float(observed_at)}
+    for key in (
+        METRIC_RING_GBYTES_PER_S,
+        METRIC_PROBE_LATENCY_S,
+        METRIC_TOKENS_PER_S,
+        METRIC_MXU_TFLOPS,
+    ):
+        if key in metrics:
+            entry[key] = round(float(metrics[key]), 4)
+    window = list(history or [])
+    window.append(entry)
+    window = window[-max(1, int(history_window)):]
+    trend = derive_trend(
+        [float(h.get("score", 0.0)) for h in window if "score" in h]
+    )
+    return {
+        "apiVersion": NODE_HEALTH_REPORT_API_VERSION,
+        "kind": NODE_HEALTH_REPORT_KIND,
+        "metadata": {"name": node_health_report_name(node_name)},
+        "spec": {"nodeName": node_name, "source": source},
+        "status": {
+            "score": score,
+            "trend": trend,
+            "checks": {k: bool(v) for k, v in checks.items()},
+            "metrics": {k: float(v) for k, v in metrics.items()},
+            "history": window,
+            "observedAt": float(observed_at),
+        },
+    }
+
+
+def report_history(raw: Mapping[str, Any]) -> list[dict[str, Any]]:
+    """The rolling window out of a raw report (empty on malformed)."""
+    history = (raw.get("status") or {}).get("history")
+    return list(history) if isinstance(history, list) else []
+
+
+def parse_node_health(raw: Mapping[str, Any]) -> Optional[NodeHealth]:
+    """Parse a raw report into :class:`NodeHealth`; ``None`` when the
+    object is malformed beyond use (no node attribution). Defensive per
+    field — a hand-edited CR must degrade, not crash the informer
+    handler that feeds the planner."""
+    meta = raw.get("metadata") or {}
+    spec = raw.get("spec") or {}
+    node_name = spec.get("nodeName") or meta.get("name") or ""
+    if not node_name:
+        return None
+    status = raw.get("status") or {}
+    try:
+        score = float(status.get("score", 100.0))
+    except (TypeError, ValueError):
+        score = 100.0
+    trend = status.get("trend")
+    if trend not in (TREND_IMPROVING, TREND_STABLE, TREND_DEGRADING):
+        trend = TREND_STABLE
+    checks_raw = status.get("checks")
+    checks = (
+        {str(k): bool(v) for k, v in checks_raw.items()}
+        if isinstance(checks_raw, Mapping)
+        else {}
+    )
+    metrics_raw = status.get("metrics")
+    metrics: dict[str, float] = {}
+    if isinstance(metrics_raw, Mapping):
+        for k, v in metrics_raw.items():
+            try:
+                metrics[str(k)] = float(v)
+            except (TypeError, ValueError):
+                continue
+    try:
+        observed_at = float(status.get("observedAt", 0.0))
+    except (TypeError, ValueError):
+        observed_at = 0.0
+    return NodeHealth(
+        node_name=str(node_name),
+        score=min(100.0, max(0.0, score)),
+        trend=trend,
+        checks=checks,
+        metrics=metrics,
+        observed_at=observed_at,
+        source=str(spec.get("source", "")),
+    )

@@ -206,6 +206,84 @@ class CheckpointSpec:
 
 
 @dataclass(frozen=True)
+class QuarantineSpec:
+    """Quarantine-on-degradation (docs/fleet-telemetry.md): a node whose
+    telemetry health score (NodeHealthReport, api/telemetry_v1alpha1.py)
+    drops below ``unhealthy_score`` *outside any roll* is cordoned into
+    the ``quarantined`` state, re-evaluated on an exponential backoff
+    clock, and either rejoins once its score recovers past
+    ``recovery_score`` (hysteresis — the two thresholds must differ or a
+    score sitting at the line would flap cordon/uncordon every backoff
+    tick) or, after ``handoff_after_seconds`` without recovery, is
+    handed to the upgrade pipeline as a repair candidate. Admission is
+    budget-aware: quarantine shares the roll's ``maxUnavailable``
+    accounting, so a correlated telemetry flap can never cordon more
+    capacity than the disruption budget allows. No reference analog —
+    grounded in Guard (PAPERS.md)."""
+
+    enable: bool = False
+    #: Entry threshold: scores strictly below this quarantine the node.
+    unhealthy_score: float = 50.0
+    #: Rejoin threshold (must be > unhealthy_score): hysteresis.
+    recovery_score: float = 70.0
+    #: Initial re-evaluation backoff; doubles per failed recheck.
+    reprobe_backoff_seconds: int = 60
+    #: Backoff cap.
+    max_backoff_seconds: int = 900
+    #: Quarantined this long without recovery ⇒ handed to the upgrade
+    #: pipeline (upgrade-required, still cordoned). 0 disables handoff.
+    handoff_after_seconds: int = 3600
+
+    def __post_init__(self) -> None:
+        if not 0.0 <= self.unhealthy_score <= 100.0:
+            raise ValueError(
+                "quarantine.unhealthyScore must be in [0, 100], got "
+                f"{self.unhealthy_score}"
+            )
+        if self.recovery_score <= self.unhealthy_score:
+            raise ValueError(
+                "quarantine.recoveryScore must be > unhealthyScore "
+                f"({self.recovery_score} <= {self.unhealthy_score}): "
+                "without hysteresis a score jittering at the line flaps "
+                "cordon/uncordon on every recheck"
+            )
+        if self.reprobe_backoff_seconds <= 0:
+            raise ValueError(
+                "quarantine.reprobeBackoffSeconds must be > 0, got "
+                f"{self.reprobe_backoff_seconds}"
+            )
+        if self.max_backoff_seconds < self.reprobe_backoff_seconds:
+            raise ValueError(
+                "quarantine.maxBackoffSeconds must be >= "
+                "reprobeBackoffSeconds"
+            )
+        _require_non_negative(
+            "quarantine.handoffAfterSeconds", self.handoff_after_seconds
+        )
+
+    @staticmethod
+    def from_dict(d: Mapping[str, Any]) -> "QuarantineSpec":
+        return QuarantineSpec(
+            enable=bool(d.get("enable", False)),
+            unhealthy_score=float(d.get("unhealthyScore", 50.0)),
+            recovery_score=float(d.get("recoveryScore", 70.0)),
+            reprobe_backoff_seconds=int(d.get("reprobeBackoffSeconds", 60)),
+            max_backoff_seconds=int(d.get("maxBackoffSeconds", 900)),
+            handoff_after_seconds=int(d.get("handoffAfterSeconds", 3600)),
+        )
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "enable": self.enable,
+            "unhealthyScore": self.unhealthy_score,
+            "recoveryScore": self.recovery_score,
+            "reprobeBackoffSeconds": self.reprobe_backoff_seconds,
+            "maxBackoffSeconds": self.max_backoff_seconds,
+            "handoffAfterSeconds": self.handoff_after_seconds,
+        }
+
+
+@dataclass(frozen=True)
 class DrainSpec:
     """Node drain configuration during automatic upgrade.
 
@@ -261,6 +339,7 @@ class DriverUpgradePolicySpec:
     wait_for_completion: Optional[WaitForCompletionSpec] = None
     drain: Optional[DrainSpec] = None
     checkpoint: Optional[CheckpointSpec] = None
+    quarantine: Optional[QuarantineSpec] = None
 
     def __post_init__(self) -> None:
         _require_non_negative("maxParallelUpgrades", self.max_parallel_upgrades)
@@ -303,6 +382,11 @@ class DriverUpgradePolicySpec:
                 if d.get("checkpoint") is not None
                 else None
             ),
+            quarantine=(
+                QuarantineSpec.from_dict(d["quarantine"])
+                if d.get("quarantine") is not None
+                else None
+            ),
         )
 
     def to_dict(self) -> dict[str, Any]:
@@ -325,4 +409,6 @@ class DriverUpgradePolicySpec:
             out["drain"] = self.drain.to_dict()
         if self.checkpoint is not None:
             out["checkpoint"] = self.checkpoint.to_dict()
+        if self.quarantine is not None:
+            out["quarantine"] = self.quarantine.to_dict()
         return out

@@ -88,6 +88,17 @@ def _bootstrap() -> None:
         "workloadcheckpoints",
         namespaced=True,
     )
+    # Fleet-health telemetry plane (docs/fleet-telemetry.md): per-node
+    # probe reports published by the monitor and the quick-battery tier.
+    # Cluster-scoped like the Node it describes, named after it — the
+    # informer path maps a report delta to its node by name alone.
+    # Contract (schema, score/trend derivation): api/telemetry_v1alpha1.py.
+    register_resource(
+        "NodeHealthReport",
+        "telemetry.tpu-operator.dev/v1alpha1",
+        "nodehealthreports",
+        namespaced=False,
+    )
 
 
 _bootstrap()

@@ -1,10 +1,15 @@
-from .collectives import CollectiveReport, run_ici_probes
+from .collectives import CollectiveReport, psum_bandwidth, run_ici_probes
 from .flash_attention import (
     FlashAttentionReport,
     flash_attention,
     flash_attention_probe,
 )
 from .matmul import matmul, mxu_probe
+from .probe_harness import (
+    QuickBatteryReport,
+    quick_battery,
+    run_quick_probe_cycle,
+)
 from .ring_attention import (
     RingAttentionReport,
     reference_attention,
@@ -15,6 +20,7 @@ from .ulysses import UlyssesReport, ulysses_attention, ulysses_probe
 
 __all__ = [
     "CollectiveReport",
+    "QuickBatteryReport",
     "FlashAttentionReport",
     "RingAttentionReport",
     "UlyssesReport",
@@ -22,10 +28,13 @@ __all__ = [
     "flash_attention_probe",
     "matmul",
     "mxu_probe",
+    "psum_bandwidth",
+    "quick_battery",
     "reference_attention",
     "ring_attention",
     "ring_attention_probe",
     "run_ici_probes",
+    "run_quick_probe_cycle",
     "ulysses_attention",
     "ulysses_probe",
 ]

@@ -1,4 +1,5 @@
-"""Shared harness for numerics-checked attention probes.
+"""Shared harness for numerics-checked attention probes — plus the
+cheap periodic probe tier (:func:`quick_battery`).
 
 All three attention probes (ring, ulysses, flash) follow the same contract:
 run the op on device, compare against the host float64-free oracle
@@ -11,8 +12,8 @@ non-addressable global array.
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass
-from typing import Callable
+from dataclasses import dataclass, field
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -89,4 +90,111 @@ def run_checked_probe(
         "%s probe: ok, %.0f tok/s, max_abs_err %.2e",
         name, report.tokens_per_s, max_err,
     )
+    return report
+
+
+# ----------------------------------------------------------------------
+# The quick battery: the low-rate telemetry probe tier (ISSUE 8).
+# ----------------------------------------------------------------------
+
+@dataclass
+class QuickBatteryReport:
+    """One quick-battery run in the telemetry plane's native shape:
+    per-check verdicts + numeric metrics (the ``(checks, metrics)``
+    arguments of ``api.telemetry_v1alpha1.make_node_health_report``)."""
+
+    ok: bool
+    checks: dict[str, bool] = field(default_factory=dict)
+    metrics: dict[str, float] = field(default_factory=dict)
+    elapsed_s: float = 0.0
+    error: str = ""
+
+
+def quick_battery(
+    mesh=None,
+    axis: str = "x",
+    payload_mb: float = 0.25,
+    matmul_size: int = 256,
+    run_matmul: bool = True,
+) -> QuickBatteryReport:
+    """The cheap periodic probe tier (docs/fleet-telemetry.md): a
+    sub-second graded measurement safe to run BESIDE live workloads,
+    feeding the NodeHealthReport stream between the full gate's
+    300 s-interval batteries.
+
+    Deliberately everything the full battery is not: a tiny-payload ring
+    all-reduce (``psum_bandwidth`` — correctness-verified AND timed, so
+    the battery yields a graded GB/s, not just a verdict) and one small
+    XLA matmul; no burn-in, no Pallas kernels, no attention probes, no
+    multi-hundred-MB payloads contending for HBM. The point is a
+    continuous numeric signal (Guard, PAPERS.md): a straggling link
+    shows up as a sliding ``ring_gbytes_per_s`` long before the full
+    gate's floors trip.
+
+    Failures degrade to verdicts, never raise — the battery runs inside
+    monitoring loops that must outlive any probe blip.
+    """
+    from ..api.telemetry_v1alpha1 import (
+        METRIC_MXU_TFLOPS,
+        METRIC_PROBE_LATENCY_S,
+        METRIC_RING_GBYTES_PER_S,
+    )
+    from .collectives import psum_bandwidth
+    from .matmul import mxu_probe
+
+    start = time.perf_counter()
+    checks: dict[str, bool] = {}
+    metrics: dict[str, float] = {}
+    error = ""
+    try:
+        if mesh is None:
+            from ..parallel.mesh import single_axis_mesh
+
+            mesh = single_axis_mesh(axis)
+        ring = psum_bandwidth(mesh, axis, payload_mb=payload_mb)
+        checks["ring_allreduce"] = ring.ok
+        if ring.gbytes_per_s:
+            metrics[METRIC_RING_GBYTES_PER_S] = round(ring.gbytes_per_s, 4)
+        if not ring.ok:
+            error = ring.error
+    except Exception as e:  # noqa: BLE001 - a failed probe is a verdict
+        checks["ring_allreduce"] = False
+        error = str(e)
+    if run_matmul:
+        try:
+            mxu = mxu_probe(size=matmul_size, use_pallas=False)
+            checks["mxu"] = mxu.ok
+            if mxu.ok and mxu.tflops:
+                metrics[METRIC_MXU_TFLOPS] = round(mxu.tflops, 4)
+            if not mxu.ok and not error:
+                error = mxu.error
+        except Exception as e:  # noqa: BLE001
+            checks["mxu"] = False
+            if not error:
+                error = str(e)
+    elapsed = time.perf_counter() - start
+    metrics[METRIC_PROBE_LATENCY_S] = round(elapsed, 4)
+    ok = all(checks.values()) if checks else False
+    log.info(
+        "quick battery: %s in %.2fs (%s)",
+        "ok" if ok else f"FAILED ({error})",
+        elapsed,
+        ", ".join(f"{k}={v}" for k, v in sorted(metrics.items())),
+    )
+    return QuickBatteryReport(
+        ok=ok, checks=checks, metrics=metrics,
+        elapsed_s=elapsed, error=error,
+    )
+
+
+def run_quick_probe_cycle(
+    publisher,
+    battery: Optional[Callable[[], QuickBatteryReport]] = None,
+) -> QuickBatteryReport:
+    """One quick-probe publish cycle: run the battery (injectable for
+    tests and for pre-built meshes) and hand its observation to a
+    ``ReportPublisher`` (tpu/monitor.py). The glue the low-rate
+    DaemonSet/sidecar tier loops over."""
+    report = battery() if battery is not None else quick_battery()
+    publisher.publish(report.checks, report.metrics)
     return report

@@ -15,7 +15,7 @@ from .health import (
     SubprocessHealthGate,
     cache_warmup_hook,
 )
-from .monitor import MonitorMetrics, TpuHealthMonitor
+from .monitor import MonitorMetrics, ReportPublisher, TpuHealthMonitor
 from .slice_gate import (
     SliceProbeGangManager,
     SliceProbeSpec,
@@ -29,6 +29,7 @@ __all__ = [
     "HealthReport",
     "IciHealthGate",
     "MonitorMetrics",
+    "ReportPublisher",
     "SliceScopedGate",
     "SubprocessHealthGate",
     "LibtpuDaemonSetManager",

@@ -131,6 +131,60 @@ class HealthReport:
                 kwargs[key] = build(dc_cls, kwargs[key])
         return cls(**kwargs)
 
+    def ring_bandwidth(self) -> Optional[float]:
+        """Measured ring bandwidth in GB/s, preferring the all-reduce
+        probe (bus-bandwidth convention) over the ppermute hop; ``None``
+        when neither carried a number (single device, probe skipped)."""
+        for op in ("psum_ring_allreduce", "ppermute_ring"):
+            for report in self.collectives:
+                if report.op == op and report.gbytes_per_s:
+                    return report.gbytes_per_s
+        return None
+
+    def observation(self) -> tuple[dict[str, bool], dict[str, float]]:
+        """``(checks, metrics)`` for the telemetry plane
+        (api/telemetry_v1alpha1.make_node_health_report): per-probe
+        boolean verdicts plus every numeric signal the battery measured
+        — exactly what the binary condition used to throw away at the
+        point of observation (ISSUE 8). Probes that did not run are
+        absent, not failed."""
+        checks: dict[str, bool] = {
+            c.op: c.ok for c in self.collectives
+        }
+        if self.mxu is not None:
+            checks["mxu"] = self.mxu.ok
+        if self.burnin_ok is not None:
+            checks["burnin"] = self.burnin_ok
+        if self.ring_attention is not None:
+            checks["ring_attention"] = self.ring_attention.ok
+        if self.ulysses is not None:
+            checks["ulysses"] = self.ulysses.ok
+        if self.flash is not None:
+            checks["flash_attention"] = self.flash.ok
+        metrics: dict[str, float] = {}
+        from ..api.telemetry_v1alpha1 import (
+            METRIC_MXU_TFLOPS,
+            METRIC_PROBE_LATENCY_S,
+            METRIC_RING_GBYTES_PER_S,
+            METRIC_TOKENS_PER_S,
+        )
+
+        if self.elapsed_s:
+            metrics[METRIC_PROBE_LATENCY_S] = self.elapsed_s
+        ring = self.ring_bandwidth()
+        if ring is not None:
+            metrics[METRIC_RING_GBYTES_PER_S] = ring
+        if self.mxu is not None and self.mxu.ok and self.mxu.tflops:
+            metrics[METRIC_MXU_TFLOPS] = self.mxu.tflops
+        tokens = 0.0
+        for probe in (self.ring_attention, self.ulysses, self.flash):
+            rate = getattr(probe, "tokens_per_s", 0.0) if probe else 0.0
+            if probe is not None and probe.ok and rate:
+                tokens = max(tokens, rate)
+        if tokens:
+            metrics[METRIC_TOKENS_PER_S] = tokens
+        return checks, metrics
+
     def summary(self) -> str:
         parts = [f"ok={self.ok}", f"elapsed={self.elapsed_s:.2f}s"]
         ring = next(

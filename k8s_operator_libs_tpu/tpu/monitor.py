@@ -24,16 +24,39 @@ writes covers exactly the node it runs on.
 from __future__ import annotations
 
 import threading
-from typing import Optional
+import time
+from collections import deque
+from typing import Mapping, Optional
 
-from ..kube.client import Client, retry_on_conflict
-from ..kube.objects import Node, Pod, condition_status, set_condition
+from ..api.telemetry_v1alpha1 import (
+    DEFAULT_HEALTHY_RING_GBYTES_PER_S,
+    DEFAULT_HISTORY_WINDOW,
+    DEFAULT_LATENCY_BUDGET_S,
+    NODE_HEALTH_REPORT_KIND,
+    make_node_health_report,
+    node_health_report_name,
+    parse_node_health,
+    report_history,
+)
+from ..kube.client import (
+    AlreadyExistsError,
+    Client,
+    ConflictError,
+    retry_on_conflict,
+)
+from ..kube.objects import Node, Pod, condition_status, set_condition, wrap
 from ..upgrade.consts import TRUE_STRING, DeviceClass, UpgradeKeys
 from ..utils.log import get_logger
 from .health import HealthGate, HealthReport, IciHealthGate
 from .libtpu import TPU_RESOURCE
 
 log = get_logger("tpu.monitor")
+
+#: Last-N retention window for the monitor's numeric signals — sized so
+#: a scrape between probe cycles still sees the degradation that
+#: triggered a condition flip (the flip needed failure_threshold
+#: consecutive batteries; the window comfortably covers them).
+METRIC_WINDOW = 8
 
 #: Node condition type the monitor owns.
 ICI_HEALTHY_CONDITION = "TpuIciHealthy"
@@ -64,6 +87,14 @@ class MonitorMetrics:
         self._last_ok: Optional[bool] = None
         self._consecutive_failures = 0
         self._published: Optional[bool] = None
+        # Last-N retention (ISSUE 8 satellite): keeping only the last
+        # probe result silently lost the signal between scrapes — a
+        # 300 s-interval monitor scraped every 60 s showed the RECOVERED
+        # bandwidth while the degraded sample that flipped the condition
+        # was already overwritten. The windows keep the recent extremes
+        # scrapeable.
+        self._ring_window: deque = deque(maxlen=METRIC_WINDOW)
+        self._elapsed_window: deque = deque(maxlen=METRIC_WINDOW)
 
     def record(
         self,
@@ -79,6 +110,10 @@ class MonitorMetrics:
                 return
             self._probes_total += 1
             self._last_elapsed_s = report.elapsed_s
+            self._elapsed_window.append(report.elapsed_s)
+            ring = report.ring_bandwidth()
+            if ring is not None:
+                self._ring_window.append(ring)
             self._last_ok = report.ok
             if not report.ok:
                 self._failures_total += 1
@@ -113,6 +148,24 @@ class MonitorMetrics:
                  "Failing batteries since the last pass (debounce)",
                  self._consecutive_failures),
             ]
+            if self._elapsed_window:
+                rows.append(
+                    ("probe_duration_window_max_seconds", "gauge",
+                     f"Slowest battery in the last {METRIC_WINDOW} probes "
+                     "(a scrape between cycles still sees a straggler)",
+                     round(max(self._elapsed_window), 3))
+                )
+            if self._ring_window:
+                rows.extend([
+                    ("ring_gbytes_per_s", "gauge",
+                     "Ring bandwidth measured by the most recent battery",
+                     round(self._ring_window[-1], 3)),
+                    ("ring_window_min_gbytes_per_s", "gauge",
+                     f"Worst ring bandwidth in the last {METRIC_WINDOW} "
+                     "probes (the degradation that flipped the condition "
+                     "stays visible between probes)",
+                     round(min(self._ring_window), 3)),
+                ])
             if self._last_ok is not None:
                 rows.append(
                     ("last_probe_ok", "gauge",
@@ -130,6 +183,135 @@ class MonitorMetrics:
         return render_rows(self._PREFIX, label, rows)
 
 
+class ReportPublisher:
+    """The telemetry half of the monitor (ISSUE 8): publish the
+    structured probe battery as a ``NodeHealthReport`` CR
+    (api/telemetry_v1alpha1.py) next to the binary condition writer.
+
+    * **rv-guarded** — read-modify-write carrying the live CR's
+      resourceVersion, retried on Conflict (a second publisher tier —
+      the quick battery — may race this one on the same report);
+    * **debounced** — an observation whose checks are unchanged and
+      whose score moved less than ``min_score_delta`` is skipped while
+      the previous one is younger than ``heartbeat_seconds``: steady
+      state costs one write per heartbeat, not one per probe cycle
+      (fleet-scale apiserver load, same argument as the condition
+      writer's write-nothing steady state);
+    * **windowed** — the CR carries a bounded rolling history, so the
+      derived trend survives publisher restarts.
+    """
+
+    def __init__(
+        self,
+        client: Client,
+        node_name: str,
+        source: str = "monitor",
+        min_score_delta: float = 1.0,
+        heartbeat_seconds: float = 900.0,
+        history_window: int = DEFAULT_HISTORY_WINDOW,
+        healthy_ring_gbytes_per_s: float = DEFAULT_HEALTHY_RING_GBYTES_PER_S,
+        latency_budget_s: float = DEFAULT_LATENCY_BUDGET_S,
+        now=time.time,
+    ) -> None:
+        self._client = client
+        self._node = node_name
+        self._source = source
+        self._min_score_delta = min_score_delta
+        self._heartbeat = heartbeat_seconds
+        self._window = history_window
+        self._healthy_ring = healthy_ring_gbytes_per_s
+        self._latency_budget = latency_budget_s
+        self._now = now
+
+    def publish(
+        self, checks: Mapping[str, bool], metrics: Mapping[str, float]
+    ) -> bool:
+        """Create-or-update the node's report from one observation;
+        returns True when a write actually happened (False = debounced)."""
+        observed_at = float(self._now())
+        name = node_health_report_name(self._node)
+
+        def attempt() -> bool:
+            existing = self._client.get_or_none(NODE_HEALTH_REPORT_KIND, name)
+            history = (
+                report_history(existing.raw) if existing is not None else []
+            )
+            desired = make_node_health_report(
+                self._node,
+                checks,
+                metrics,
+                source=self._source,
+                observed_at=observed_at,
+                history=history,
+                history_window=self._window,
+                healthy_ring_gbytes_per_s=self._healthy_ring,
+                latency_budget_s=self._latency_budget,
+            )
+            if existing is not None:
+                previous = parse_node_health(existing.raw)
+                failing = {
+                    k for k, v in desired["status"]["checks"].items() if not v
+                }
+                previously_failing = (
+                    {k for k, v in previous.checks.items() if not v}
+                    if previous is not None
+                    else None
+                )
+                # Debounce on what matters: the FAILING-check set and the
+                # score. Comparing full check identity would let the two
+                # publisher tiers (full battery vs quick battery — they
+                # run different probe sets against one CR) defeat the
+                # debounce on every alternation even while the node is
+                # perfectly healthy.
+                if (
+                    previously_failing is not None
+                    and previously_failing == failing
+                    and abs(previous.score - desired["status"]["score"])
+                    < self._min_score_delta
+                    and observed_at - previous.observed_at < self._heartbeat
+                ):
+                    return False  # debounced: nothing new worth a write
+                rv = (existing.raw.get("metadata") or {}).get(
+                    "resourceVersion"
+                )
+                if rv is not None:
+                    desired["metadata"]["resourceVersion"] = rv
+                # The observation lives under status, which the
+                # main-resource update endpoint ignores
+                # (status-subresource semantics) — the status write is
+                # the one that matters; spec is immutable by contract
+                # (nodeName == CR name).
+                self._client.update_status(wrap(desired))
+                return True
+            try:
+                created = self._client.create(wrap(desired))
+            except AlreadyExistsError as e:
+                # Lost a create race (the other publisher tier): surface
+                # as a conflict so retry_on_conflict re-reads and takes
+                # the update path.
+                raise ConflictError(str(e)) from e
+            # A status-subresource apiserver strips status on create;
+            # land the first observation through the status endpoint
+            # too, carrying the created object's rv. (Backends that kept
+            # the status on create just rewrite it — one extra write on
+            # the first publish ever, not per cycle.)
+            rv = (created.raw.get("metadata") or {}).get("resourceVersion")
+            if rv is not None:
+                desired["metadata"]["resourceVersion"] = rv
+            self._client.update_status(wrap(desired))
+            return True
+
+        wrote = retry_on_conflict(attempt)
+        if wrote:
+            log.info("published NodeHealthReport for %s", self._node)
+        return bool(wrote)
+
+    def publish_report(self, report: HealthReport) -> bool:
+        """Publish a full gate battery via its observation view."""
+        checks, metrics = report.observation()
+        return self.publish(checks, metrics)
+
+
 class TpuHealthMonitor:
     def __init__(
         self,
@@ -142,6 +324,7 @@ class TpuHealthMonitor:
         device: Optional[DeviceClass] = None,
         recorder=None,
         metrics: Optional[MonitorMetrics] = None,
+        report_publisher: Optional[ReportPublisher] = None,
     ) -> None:
         self.client = client
         self.node_name = node_name
@@ -158,6 +341,11 @@ class TpuHealthMonitor:
         self.keys = UpgradeKeys(device or DeviceClass.tpu())
         self.recorder = recorder
         self.metrics = metrics
+        #: Telemetry plane (docs/fleet-telemetry.md): when set, every
+        #: completed battery is published as a NodeHealthReport CR next
+        #: to the condition — the structured signal the planner's
+        #: degraded-first ordering and the quarantine arc consume.
+        self.report_publisher = report_publisher
         self._consecutive_failures = 0
         self._consecutive_passes = 0
         #: Last verdict this monitor published (None until the first).
@@ -234,6 +422,11 @@ class TpuHealthMonitor:
             )
             if self._consecutive_failures >= self.failure_threshold:
                 self._publish(healthy=False, report=report)
+        if self.report_publisher is not None:
+            # After the condition logic: a report-publish failure must
+            # not block the (debounced) condition flip, only fail the
+            # cycle like any other API error.
+            self.report_publisher.publish_report(report)
         return report
 
     def _chips_busy(self) -> bool:
@@ -358,6 +551,11 @@ def main(argv: Optional[list[str]] = None) -> int:
         help="override the preset's MXU throughput floor (TFLOP/s)",
     )
     parser.add_argument(
+        "--publish-reports", action="store_true",
+        help="publish each battery as a NodeHealthReport CR (the fleet "
+        "telemetry plane, docs/fleet-telemetry.md) next to the condition",
+    )
+    parser.add_argument(
         "--metrics-port", type=int, default=0,
         help="serve Prometheus probe metrics on this port (0 = off)",
     )
@@ -420,6 +618,23 @@ def main(argv: Optional[list[str]] = None) -> int:
         )
     client = RestClient.from_environment()
     metrics = MonitorMetrics(args.node_name)
+    publisher = (
+        # The latency budget scales with the probe deadline: the full
+        # battery legitimately takes minutes on a cold compile, and
+        # grading it against the quick-battery default would make the
+        # derived score oscillate between publisher tiers on a healthy
+        # node (each tier scores its own cadence).
+        ReportPublisher(
+            client,
+            args.node_name,
+            latency_budget_s=max(
+                DEFAULT_LATENCY_BUDGET_S,
+                args.probe_timeout_seconds / 4.0,
+            ),
+        )
+        if args.publish_reports
+        else None
+    )
     monitor = TpuHealthMonitor(
         client,
         args.node_name,
@@ -429,6 +644,7 @@ def main(argv: Optional[list[str]] = None) -> int:
         success_threshold=success_threshold,
         recorder=EventRecorder(client),
         metrics=metrics,
+        report_publisher=publisher,
     )
     metrics_server = None
     if args.metrics_port:

@@ -18,8 +18,13 @@ slice arithmetic:
   hosts one by one would multiply the disruption windows by the host count
   for zero safety gain. This is the big wall-clock win over naive per-node
   rolling on multi-host pools.
-* **drain-the-wounded first**: slices that are already disrupted are
-  selected before healthy ones; finishing them costs no new disruption.
+* **drain-the-wounded first, generalized degraded-first** (ISSUE 8):
+  slices that are already disrupted are selected before healthy ones
+  (finishing them costs no new disruption), then candidates order by
+  ascending telemetry health score (``ClusterUpgradeState.node_health``,
+  fed from NodeHealthReport CRs — docs/fleet-telemetry.md) with a
+  degrading trend breaking ties — stragglers roll first, and a roll
+  finishes degraded hardware before it touches healthy capacity.
 
 Everything downstream (cordon, drain, restart, validate, uncordon) is the
 unmodified common machinery — the planner only changes *which* nodes enter
@@ -31,6 +36,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Optional
 
+from ..api.telemetry_v1alpha1 import trend_value
 from ..api.upgrade_v1alpha1 import DriverUpgradePolicySpec
 from ..utils.log import get_logger
 from ..upgrade.common_manager import ClusterUpgradeState, NodeUpgradeState
@@ -76,6 +82,14 @@ class SliceAssessment:
     wounded: set[str] = field(default_factory=set)
     #: slice -> its upgrade-required members.
     candidates: dict[str, list[NodeUpgradeState]] = field(default_factory=dict)
+    #: Telemetry (docs/fleet-telemetry.md): slice -> worst member health
+    #: score (``ClusterUpgradeState.node_health``; a slice is only as
+    #: healthy as its sickest host — one straggler throttles the whole
+    #: collective). Absent slices read as fully healthy (100).
+    scores: dict[str, float] = field(default_factory=dict)
+    #: slice -> worst member trend (numeric: -1 degrading, 0 stable,
+    #: 1 improving) — the tiebreak between equally scored slices.
+    trends: dict[str, int] = field(default_factory=dict)
 
     def budget(self, policy: DriverUpgradePolicySpec) -> tuple[int, int]:
         """Upgrade-start slots in SLICE units (shape parity with
@@ -100,15 +114,30 @@ class SliceAssessment:
             available = max_unavailable - currently_unavailable
         return available, max_unavailable
 
+    def effective_score(self, slice_id: str) -> float:
+        """Ordering score: a monitor-flagged wounded slice reads 0 (a
+        dead link outranks any graded degradation), otherwise the worst
+        member telemetry score, defaulting to fully healthy. This is the
+        ONE place the binary condition and the graded telemetry merge."""
+        if slice_id in self.wounded:
+            return 0.0
+        return self.scores.get(slice_id, 100.0)
+
     def ordered_candidates(self):
-        """Already-disrupted slices first (their collective is down
-        anyway), then monitor-flagged wounded slices (the repair path —
-        rolling re-validates them), then the rest by name."""
+        """Degraded-first generalization of drain-the-wounded-first
+        (ISSUE 8; Guard, PAPERS.md): already-disrupted slices first
+        (their collective is down anyway — finishing them is free), then
+        ascending health score (wounded = 0, telemetry stragglers next,
+        fully healthy = 100 last), degrading trend breaking score ties
+        (a slice still getting worse rolls before one holding steady),
+        then name. With no telemetry plane wired every score is 100 and
+        this is exactly the old wounded-first ordering."""
         return sorted(
             self.candidates.items(),
             key=lambda item: (
                 item[0] not in self.disrupted,
-                item[0] not in self.wounded,
+                self.effective_score(item[0]),
+                self.trends.get(item[0], 0),
                 item[0],
             ),
         )
@@ -133,10 +162,26 @@ def assess_slices(
                 out.disrupted.add(slice_id)
             if _node_ici_unhealthy(ns):
                 out.wounded.add(slice_id)
+            health = state.health_of(ns.node.name)
+            if health is not None:
+                # Worst member wins on both axes: one straggler host
+                # throttles the slice's whole collective.
+                previous = out.scores.get(slice_id)
+                if previous is None or health.score < previous:
+                    out.scores[slice_id] = health.score
+                trend = trend_value(health.trend)
+                out.trends[slice_id] = min(
+                    trend, out.trends.get(slice_id, trend)
+                )
             if bucket not in (
                 UpgradeState.UNKNOWN,
                 UpgradeState.DONE,
                 UpgradeState.UPGRADE_REQUIRED,
+                # Quarantine is NOT an upgrade in flight: the slice is
+                # disrupted (its member is cordoned — the unschedulable
+                # check above already covers that), but it must not eat
+                # a maxParallelUpgrades slice slot and stall the roll.
+                UpgradeState.QUARANTINED,
             ):
                 out.in_progress.add(slice_id)
                 out.disrupted.add(slice_id)

@@ -4,7 +4,9 @@ from .checkpoint_manager import (
     RESTORE_VERIFY_TIMEOUT_SECONDS,
     CheckpointManager,
 )
-from .metrics import MetricsServer, UpgradeMetrics
+from .metrics import Histogram, MetricsServer, UpgradeMetrics
+from .health_source import HealthMetrics, HealthSource
+from .quarantine_manager import QuarantineManager
 from .task_runner import TaskRunner
 from .cordon_manager import CordonManager
 from .drain_manager import DrainConfiguration, DrainManager
@@ -78,7 +80,11 @@ __all__ = [
     "PodManagerConfig",
     "SafeDriverLoadManager",
     "StateWriteError",
+    "HealthMetrics",
+    "HealthSource",
+    "Histogram",
     "MetricsServer",
+    "QuarantineManager",
     "TaskRunner",
     "UpgradeMetrics",
     "UpgradeKeys",

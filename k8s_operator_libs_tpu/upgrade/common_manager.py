@@ -10,14 +10,14 @@ from __future__ import annotations
 
 from collections import defaultdict
 from dataclasses import dataclass, field
-from typing import Optional, Sequence
+from typing import Callable, Mapping, Optional, Sequence, TypeVar
 
-from typing import Callable, TypeVar
-
+from ..api.telemetry_v1alpha1 import NodeHealth
 from ..api.upgrade_v1alpha1 import (
     CheckpointSpec,
     DrainSpec,
     PodDeletionSpec,
+    QuarantineSpec,
     WaitForCompletionSpec,
 )
 from ..kube.client import Client
@@ -33,6 +33,7 @@ from .consts import (
 )
 from .checkpoint_manager import CheckpointManager
 from .cordon_manager import CordonManager
+from .quarantine_manager import QuarantineManager
 from .drain_manager import DrainConfiguration, DrainManager
 from .pod_manager import PodManager, PodManagerConfig
 from .safe_driver_load import SafeDriverLoadManager
@@ -77,6 +78,13 @@ class ClusterUpgradeState:
     #: changed nodes via :meth:`reactive_nodes_in`; a settled pass does
     #: zero per-node work.
     dirty_nodes: Optional[frozenset[str]] = None
+    #: Fleet-health telemetry view (docs/fleet-telemetry.md): node name
+    #: -> :class:`NodeHealth` parsed from NodeHealthReport CRs, attached
+    #: by the orchestrator when a ``HealthSource`` is wired
+    #: (upgrade/health_source.py). ``None`` means no telemetry plane is
+    #: configured — the planner orders by name and the quarantine arc is
+    #: inert, and a non-telemetry pool pays zero for the feature.
+    node_health: Optional[Mapping[str, NodeHealth]] = None
 
     def nodes_in(self, state: UpgradeState) -> list[NodeUpgradeState]:
         return self.node_states.get(state, [])
@@ -97,6 +105,12 @@ class ClusterUpgradeState:
         if not self.dirty_nodes or not nodes:
             return []
         return [ns for ns in nodes if ns.node.name in self.dirty_nodes]
+
+    def health_of(self, node_name: str) -> Optional[NodeHealth]:
+        """The node's parsed telemetry, when the health plane is wired."""
+        if self.node_health is None:
+            return None
+        return self.node_health.get(node_name)
 
 
 class CommonUpgradeManager:
@@ -134,6 +148,12 @@ class CommonUpgradeManager:
         # checkpoint arc's pre-uncordon gate (docs/checkpoint-drain.md).
         self.validation_manager.restore_gate = (
             self.checkpoint_manager.restore_gate
+        )
+        # Telemetry quarantine arc (docs/fleet-telemetry.md): inert until
+        # a policy enables it AND a HealthSource attaches node_health to
+        # the snapshots.
+        self.quarantine_manager = QuarantineManager(
+            cordon_manager, state_provider, keys, recorder=recorder
         )
         self.recorder = recorder
         #: Joined bounded fan-out for per-state buckets. Direct
@@ -473,6 +493,101 @@ class CommonUpgradeManager:
                 ns.node, checkpoint_spec, next_state
             ),
         )
+
+    def process_quarantined_nodes(
+        self,
+        state: ClusterUpgradeState,
+        policy,
+    ) -> None:
+        """The telemetry quarantine arc (docs/fleet-telemetry.md): walk
+        the ``quarantined`` bucket (handoff deadlines, backoff-clocked
+        re-evaluation, recovery releases), then ADMIT newly degraded idle
+        nodes within the disruption budget.
+
+        POLLING on both halves, never dirty-filtered: the backoff and
+        handoff clocks are time-driven (a node whose backoff expires gets
+        no event to dirty it), and admission is budget-coupled (a slot
+        freed by an unrelated node's release must be able to admit a
+        candidate that was budget-denied passes ago, which nothing
+        re-dirties). With the spec absent/disabled, parked nodes are
+        released so a withdrawn feature can never strand cordoned
+        capacity. A pool with no telemetry (``state.node_health`` is
+        None) and an empty bucket pays a few branch checks — nothing
+        else."""
+        spec: Optional[QuarantineSpec] = getattr(policy, "quarantine", None)
+        qm = self.quarantine_manager
+        node_states = state.nodes_in(UpgradeState.QUARANTINED)
+        if spec is None or not spec.enable:
+            if node_states:
+                # Withdrawn mid-arc: release (uncordon + clear clocks).
+                qm.adopt(ns.node.name for ns in node_states)
+                self._for_each(
+                    "advance[quarantine]",
+                    node_states,
+                    lambda ns: ns.node.name,
+                    lambda ns: qm.release(
+                        ns.node, "quarantine disabled by policy"
+                    ),
+                )
+            return
+        if node_states:
+            # Inherit membership first so a restarted controller's gauge
+            # covers nodes an earlier process quarantined.
+            qm.adopt(ns.node.name for ns in node_states)
+            self._for_each(
+                "quarantine",
+                node_states,
+                lambda ns: ns.node.name,
+                lambda ns: qm.evaluate(ns.node, spec, state.node_health),
+            )
+        if not state.node_health:
+            return  # no telemetry plane, or no live reports: no candidates
+        # Admission: idle (unknown/done) schedulable nodes whose score
+        # crossed the threshold, worst first, within the SAME
+        # unavailability budget the roll uses — quarantine can never
+        # cordon more than maxUnavailable allows. The health map is
+        # scanned FIRST (usually: nothing below threshold → return), so
+        # an all-healthy telemetry pool pays O(reports) per pass, never
+        # an O(idle-nodes) bucket walk — the settled path stays cheap.
+        degraded = {
+            name: health.score
+            for name, health in state.node_health.items()
+            if health.score < spec.unhealthy_score
+        }
+        if not degraded:
+            return
+        candidates: list[tuple[float, NodeUpgradeState]] = []
+        for bucket in (UpgradeState.UNKNOWN, UpgradeState.DONE):
+            for ns in state.nodes_in(bucket):
+                node = ns.node
+                score = degraded.get(node.name)
+                if score is None:
+                    continue
+                if node.unschedulable or not node.is_ready():
+                    continue  # already-disrupted capacity: nothing to save
+                if self.skip_node_upgrade(node):
+                    continue
+                if self.provider.get_upgrade_state(node) not in (
+                    UpgradeState.UNKNOWN,
+                    UpgradeState.DONE,
+                ):
+                    continue  # reclassified earlier in this very pass
+                candidates.append((score, ns))
+        if not candidates:
+            return
+        candidates.sort(key=lambda item: (item[0], item[1].node.name))
+        total = self.get_total_managed_nodes(state)
+        max_unavailable = policy.resolved_max_unavailable(total)
+        unavailable = self.get_current_unavailable_nodes(state) + len(
+            state.nodes_in(UpgradeState.CORDON_REQUIRED)
+        )
+        slots = max(0, max_unavailable - unavailable)
+        for score, ns in candidates:
+            if slots <= 0:
+                qm.deny_budget(ns.node, score)
+                continue
+            qm.enter(ns.node, spec, score)
+            slots -= 1
 
     def process_pod_deletion_required_nodes(
         self,

@@ -1,9 +1,11 @@
 """Upgrade states and label/annotation key builders.
 
 State-name parity with the reference's 13-state machine
-(reference: pkg/upgrade/consts.go:48-83), plus one state of our own:
+(reference: pkg/upgrade/consts.go:48-83), plus two states of our own:
 ``checkpoint-required``, the pre-drain checkpoint-coordination arc
-(docs/checkpoint-drain.md) the reference has no analog for. The key
+(docs/checkpoint-drain.md), and ``quarantined``, the telemetry
+quarantine arc (docs/fleet-telemetry.md) — neither has a reference
+analog. The key
 *scheme* is deliberately
 re-designed: the reference keys every label/annotation off a process-global
 ``DriverName`` via printf formats like ``nvidia.com/%s-driver-upgrade-state``
@@ -59,6 +61,12 @@ class UpgradeState(StrEnum):
     DONE = "upgrade-done"
     # Something failed; auto-recovers once the driver pod is back in sync.
     FAILED = "upgrade-failed"
+    # Telemetry quarantine (docs/fleet-telemetry.md): the node's health
+    # score (NodeHealthReport) crossed the policy threshold outside any
+    # roll — cordoned, re-evaluated on a backoff clock, and either
+    # rejoining on recovery or handed to the upgrade pipeline. No
+    # reference analog; grounded in Guard (PAPERS.md).
+    QUARANTINED = "quarantined"
 
 
 #: States counted as "managed" (reference: pkg/upgrade/common_manager.go:714-731).
@@ -75,6 +83,10 @@ MANAGED_STATES: tuple[UpgradeState, ...] = (
     UpgradeState.POD_RESTART_REQUIRED,
     UpgradeState.UNCORDON_REQUIRED,
     UpgradeState.VALIDATION_REQUIRED,
+    # Quarantined nodes are cordoned capacity: they MUST count toward
+    # the managed/unavailability math, or quarantine would sit outside
+    # the disruption budget it is explicitly bounded by.
+    UpgradeState.QUARANTINED,
 )
 
 #: The two external-maintenance states. Faithful to the reference,
@@ -89,9 +101,19 @@ MAINTENANCE_STATES: tuple[UpgradeState, ...] = (
 )
 
 #: States that do NOT count as "upgrade in progress"
-#: (reference: pkg/upgrade/common_manager.go:733-739).
+#: (reference: pkg/upgrade/common_manager.go:733-739). ``quarantined``
+#: joins them: a quarantined node is cordoned CAPACITY — it consumes the
+#: maxUnavailable budget through the unavailability count — but it is
+#: not an upgrade in flight, so it must not consume a
+#: maxParallelUpgrades slot and stall new upgrade starts for up to its
+#: whole handoff deadline (docs/fleet-telemetry.md).
 IDLE_STATES: frozenset[UpgradeState] = frozenset(
-    {UpgradeState.UNKNOWN, UpgradeState.DONE, UpgradeState.UPGRADE_REQUIRED}
+    {
+        UpgradeState.UNKNOWN,
+        UpgradeState.DONE,
+        UpgradeState.UPGRADE_REQUIRED,
+        UpgradeState.QUARANTINED,
+    }
 )
 
 TRUE_STRING = "true"
@@ -238,6 +260,29 @@ class UpgradeKeys:
         uncordon step (bounded — a vanished checkpoint degrades to an
         uncoordinated restart, it never stalls the roll)."""
         return self._key("upgrade-restore-verify-start-time")
+
+    # -- telemetry quarantine arc (docs/fleet-telemetry.md; no reference
+    # analog — grounded in Guard, PAPERS.md) ------------------------------
+    @property
+    def quarantine_start_annotation(self) -> str:
+        """NODE annotation: epoch seconds the node entered quarantine —
+        the durable clock the handoff deadline is measured against
+        (advance_durable_clock discipline is not used here: the stamp
+        must survive expiry checks, so the manager reads it raw)."""
+        return self._key("upgrade-quarantine-start-time")
+
+    @property
+    def quarantine_recheck_annotation(self) -> str:
+        """NODE annotation: epoch seconds the next health re-evaluation
+        becomes due — the backoff clock. Durable: a restarted controller
+        resumes the same schedule instead of re-probing immediately."""
+        return self._key("upgrade-quarantine-recheck-time")
+
+    @property
+    def quarantine_backoff_annotation(self) -> str:
+        """NODE annotation: current backoff interval in seconds, doubled
+        (capped) on every recheck that still finds the node unhealthy."""
+        return self._key("upgrade-quarantine-backoff-seconds")
 
     @property
     def upgrade_requested_annotation(self) -> str:

@@ -1,0 +1,254 @@
+"""HealthSource — NodeHealthReport CRs consumed through the informer path.
+
+The telemetry plane's read side (docs/fleet-telemetry.md): probes publish
+per-node ``NodeHealthReport`` CRs (api/telemetry_v1alpha1.py — the
+monitor's ReportPublisher and the quick-battery tier); this module turns
+that stream into the two things the control plane consumes:
+
+* a **per-node health map** (``snapshot()``: node name ->
+  :class:`~..api.telemetry_v1alpha1.NodeHealth`) attached to every
+  ``ClusterUpgradeState`` (``node_health``) so the planner can order
+  candidates degraded-first and the quarantine arc can judge thresholds —
+  maintained from watch deltas, list-once + watch like every other
+  informer, never a per-pass LIST;
+* **delta wiring** into the incremental snapshot path
+  (:meth:`attach` -> ``IncrementalSnapshotSource.mark_dirty_on``): a
+  report event dirties exactly the node it names (report name == node
+  name, the contract), so a health-only delta reclassifies one node and
+  never triggers a full rebuild — and a pool with no telemetry configured
+  pays literally zero (tests/test_incremental_state.py pins both).
+
+``HealthMetrics`` is the export half: the ``tpu_operator_health_*``
+family (per-node score/trend gauges, a probe-latency **histogram**, and
+the quarantine counters) served by the existing ``MetricsServer``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Mapping, Optional
+
+from ..api.telemetry_v1alpha1 import (
+    METRIC_PROBE_LATENCY_S,
+    NODE_HEALTH_REPORT_KIND,
+    NodeHealth,
+    parse_node_health,
+    trend_value,
+)
+from ..kube.client import Client
+from ..kube.informer import Informer
+from ..kube.objects import KubeObject
+from ..utils.log import get_logger
+from .metrics import (
+    DEFAULT_LATENCY_BUCKETS,
+    Histogram,
+    prom_label,
+    render_rows,
+    render_samples,
+)
+
+log = get_logger("upgrade.health")
+
+
+def report_node_name(obj: KubeObject) -> str:
+    """The node a report concerns: ``spec.nodeName``, falling back to
+    the CR name (the contract makes them equal; the fallback covers a
+    hand-made report that only set one)."""
+    raw = obj.raw if isinstance(obj, KubeObject) else obj
+    spec = raw.get("spec") or {}
+    return spec.get("nodeName") or (raw.get("metadata") or {}).get("name", "")
+
+
+class HealthSource:
+    """One informer over ``NodeHealthReport``, folded into a per-node
+    :class:`NodeHealth` map under a leaf lock.
+
+    ``snapshot()`` is memoized by an update counter: a settled pool's
+    reconcile pass re-serves the same frozen mapping with zero copying —
+    the telemetry plane must not tax the zero-work settled path it rides
+    beside. Observers (:meth:`add_observer`) see every parsed update on
+    the informer thread — the metrics histogram feeds from there.
+    """
+
+    def __init__(self, client: Client, resync_period_s: float = 0.0) -> None:
+        self._informer = Informer(
+            client, NODE_HEALTH_REPORT_KIND, resync_period_s=resync_period_s
+        )
+        self._lock = threading.Lock()
+        self._health: dict[str, NodeHealth] = {}
+        self._updates = 0
+        self._snapshot_version = -1
+        self._snapshot: Mapping[str, NodeHealth] = {}
+        self._observers: list[Callable[[NodeHealth], None]] = []
+        # Registered before start(): the seed list's ADDEDs flow through,
+        # so the map is complete from the first sync on.
+        self._informer.add_event_handler(self._on_event)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self, sync_timeout: float = 30.0) -> "HealthSource":
+        if not self._informer.started:
+            self._informer.start()
+        if not self._informer.wait_for_sync(timeout=sync_timeout):
+            self._informer.stop()
+            raise TimeoutError(
+                f"NodeHealthReport informer did not sync within "
+                f"{sync_timeout}s"
+            )
+        return self
+
+    def stop(self) -> None:
+        if self._informer.started:
+            self._informer.stop()
+
+    @property
+    def started(self) -> bool:
+        return self._informer.started
+
+    def __enter__(self) -> "HealthSource":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def informer(self) -> Informer:
+        return self._informer
+
+    # -- delta wiring ------------------------------------------------------
+    def attach(self, snapshot_source) -> None:
+        """Feed report deltas into an ``IncrementalSnapshotSource``'s
+        dirty set: each event dirties exactly the node the report names,
+        so a health-only delta is a one-node reclassification, never a
+        full rebuild (mark_dirty_on's empty-mapping degradation to a
+        full invalidation still backstops a nameless report)."""
+        snapshot_source.mark_dirty_on(
+            self._informer, lambda obj: [report_node_name(obj)]
+        )
+
+    def add_observer(self, fn: Callable[[NodeHealth], None]) -> None:
+        """Called with every parsed NodeHealth on the informer thread
+        (deliveries are serialized). Observers own their errors."""
+        self._observers.append(fn)
+
+    # -- event intake (informer dispatch thread) ---------------------------
+    def _on_event(self, event_type: str, obj, old) -> None:
+        name = report_node_name(obj)
+        if not name:
+            log.warning("NodeHealthReport with no node attribution ignored")
+            return
+        if event_type == "DELETED":
+            with self._lock:
+                self._health.pop(name, None)
+                self._updates += 1
+            return
+        health = parse_node_health(obj.raw)
+        if health is None:
+            return
+        with self._lock:
+            self._health[name] = health
+            self._updates += 1
+        for observer in self._observers:
+            try:
+                observer(health)
+            except Exception:  # noqa: BLE001 - observers own their errors
+                log.exception("health observer failed for node %s", name)
+
+    # -- reads (reconcile thread + scrapers) -------------------------------
+    def snapshot(self) -> Mapping[str, NodeHealth]:
+        """Point-in-time node -> NodeHealth mapping. Memoized: the same
+        object is returned until an event lands, so attaching it to
+        every pass costs a counter compare on a settled pool."""
+        with self._lock:
+            if self._snapshot_version != self._updates:
+                self._snapshot = dict(self._health)
+                self._snapshot_version = self._updates
+            return self._snapshot
+
+    def health_of(self, node_name: str) -> Optional[NodeHealth]:
+        with self._lock:
+            return self._health.get(node_name)
+
+    @property
+    def updates(self) -> int:
+        with self._lock:
+            return self._updates
+
+
+_PREFIX = "tpu_operator_health"
+
+
+class HealthMetrics:
+    """The ``tpu_operator_health_*`` Prometheus family, served by the
+    existing ``MetricsServer`` (it only needs ``render()``):
+
+    * ``score{node=...}`` / ``trend{node=...}`` gauges per reported node
+      (trend encoded -1 degrading / 0 stable / 1 improving);
+    * ``probe_latency_seconds`` — a real histogram
+      (bucket/sum/count lines; upgrade/metrics.py render_rows), observed
+      from every report update carrying a probe latency;
+    * quarantine counters pulled from a ``totals()`` callable
+      (``QuarantineManager.totals``) when wired.
+    """
+
+    def __init__(
+        self,
+        source: HealthSource,
+        quarantine_totals: Optional[Callable[[], Mapping[str, int]]] = None,
+        latency_buckets=DEFAULT_LATENCY_BUCKETS,
+    ) -> None:
+        self._source = source
+        self._quarantine_totals = quarantine_totals
+        self._latency = Histogram(latency_buckets)
+        source.add_observer(self._observe)
+
+    def _observe(self, health: NodeHealth) -> None:
+        latency = health.metrics.get(METRIC_PROBE_LATENCY_S)
+        if latency is not None and latency >= 0:
+            self._latency.observe(latency)
+
+    def set_quarantine_totals(
+        self, totals: Callable[[], Mapping[str, int]]
+    ) -> None:
+        self._quarantine_totals = totals
+
+    def render(self) -> str:
+        snapshot = self._source.snapshot()
+        labeled = [
+            (prom_label("node", node), snapshot[node])
+            for node in sorted(snapshot)
+        ]
+        per_node = render_samples(_PREFIX, [
+            ("score", "gauge",
+             "Derived 0-100 node health score (NodeHealthReport)",
+             [(label, h.score) for label, h in labeled]),
+            ("trend", "gauge",
+             "Health trend over the rolling window "
+             "(-1 degrading, 0 stable, 1 improving)",
+             [(label, trend_value(h.trend)) for label, h in labeled]),
+        ])
+        rows: list = [
+            ("reported_nodes", "gauge",
+             "Nodes with a live NodeHealthReport", len(snapshot)),
+            ("probe_latency_seconds", "histogram",
+             "Probe battery latency reported through NodeHealthReports",
+             self._latency.snapshot()),
+        ]
+        if self._quarantine_totals is not None:
+            totals = self._quarantine_totals()
+            rows.extend([
+                ("quarantined_nodes", "gauge",
+                 "Nodes currently in telemetry quarantine",
+                 totals.get("in_quarantine", 0)),
+                ("quarantine_entries_total", "counter",
+                 "Nodes cordoned into quarantine since start",
+                 totals.get("entered", 0)),
+                ("quarantine_releases_total", "counter",
+                 "Quarantined nodes released on score recovery",
+                 totals.get("released", 0)),
+                ("quarantine_handoffs_total", "counter",
+                 "Quarantined nodes handed to the upgrade pipeline",
+                 totals.get("handed_off", 0)),
+                ("quarantine_budget_denials_total", "counter",
+                 "Quarantine admissions deferred by the disruption budget",
+                 totals.get("budget_denied", 0)),
+            ])
+        return per_node + render_rows(_PREFIX, "", rows)

@@ -42,18 +42,112 @@ def prom_label(name: str, value: str) -> str:
     return f'{{{name}="{escaped}"}}'
 
 
-def render_rows(prefix: str, label: str, rows) -> str:
-    """The ONE Prometheus text-exposition emitter (# HELP / # TYPE /
-    name{label} value) shared by every collector in the framework
-    (UpgradeMetrics here, MonitorMetrics in tpu/monitor.py). ``rows`` is
-    an iterable of (suffix, kind, help_text, value)."""
+def merge_label(label: str, name: str, value: str) -> str:
+    """Splice one more ``name="value"`` pair into an existing label set
+    built by :func:`prom_label` (histogram bucket lines need ``le``
+    alongside the collector's own label). The value goes through the
+    same spec escaping."""
+    extra = prom_label(name, value)
+    if not label:
+        return extra
+    return label[:-1] + "," + extra[1:]
+
+
+def render_samples(prefix: str, rows) -> str:
+    """The ONE Prometheus text-exposition emitter, multi-sample form:
+    ``rows`` is an iterable of (suffix, kind, help_text, samples) where
+    ``samples`` is a list of (label, value) — one HELP/TYPE header, one
+    line per labeled sample (per-node gauge families, say).
+
+    ``kind == "histogram"`` renders the full exposition shape —
+    cumulative ``_bucket`` lines (``le`` spliced into each sample's
+    label set, spec-escaped via :func:`prom_label`), ``_sum`` and
+    ``_count`` — from :meth:`Histogram.snapshot` mappings."""
     out: list[str] = []
-    for suffix, kind, help_text, value in rows:
+    for suffix, kind, help_text, samples in rows:
         name = f"{prefix}_{suffix}"
         out.append(f"# HELP {name} {help_text}")
         out.append(f"# TYPE {name} {kind}")
-        out.append(f"{name}{label} {value}")
+        for label, value in samples:
+            if kind == "histogram":
+                for le, count in value["buckets"]:
+                    out.append(
+                        f"{name}_bucket"
+                        f"{merge_label(label, 'le', le)} {count}"
+                    )
+                out.append(f"{name}_sum{label} {value['sum']}")
+                out.append(f"{name}_count{label} {value['count']}")
+            else:
+                out.append(f"{name}{label} {value}")
     return "\n".join(out) + "\n"
+
+
+def render_rows(prefix: str, label: str, rows) -> str:
+    """Single-label convenience over :func:`render_samples` — what every
+    collector in the framework renders through (UpgradeMetrics here,
+    MonitorMetrics in tpu/monitor.py, HealthMetrics in
+    upgrade/health_source.py). ``rows`` is an iterable of
+    (suffix, kind, help_text, value); histogram values are
+    :meth:`Histogram.snapshot` mappings."""
+    return render_samples(
+        prefix,
+        [
+            (suffix, kind, help_text, [(label, value)])
+            for suffix, kind, help_text, value in rows
+        ],
+    )
+
+
+#: Default histogram buckets: probe/gate latencies — sub-second quick
+#: batteries through multi-minute cold-compile full batteries.
+DEFAULT_LATENCY_BUCKETS = (
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
+)
+
+
+class Histogram:
+    """A Prometheus histogram: fixed cumulative buckets, observed under
+    a leaf lock, snapshotted for :func:`render_rows`'s ``histogram``
+    kind. Bucket bounds are sorted and deduplicated at construction;
+    ``+Inf`` is implicit (its cumulative count is the total)."""
+
+    def __init__(self, buckets=DEFAULT_LATENCY_BUCKETS) -> None:
+        bounds = sorted({float(b) for b in buckets})
+        if not bounds:
+            raise ValueError("histogram needs at least one bucket bound")
+        self._bounds = bounds
+        self._lock = threading.Lock()
+        self._counts = [0] * len(bounds)
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        with self._lock:
+            self._count += 1
+            self._sum += value
+            for i, bound in enumerate(self._bounds):
+                if value <= bound:
+                    self._counts[i] += 1
+
+    def snapshot(self) -> dict:
+        """``{"buckets": [(le, cumulative_count), ..., ("+Inf", total)],
+        "sum": float, "count": int}`` — the shape ``render_rows``'s
+        histogram kind consumes. ``le`` values are formatted without a
+        trailing ``.0`` ambiguity (``repr`` of the float, matching
+        client_golang's shortest-form convention closely enough for
+        PromQL's numeric matching)."""
+        with self._lock:
+            buckets = [
+                (format(bound, "g"), count)
+                for bound, count in zip(self._bounds, self._counts)
+            ]
+            buckets.append(("+Inf", self._count))
+            return {
+                "buckets": buckets,
+                "sum": round(self._sum, 6),
+                "count": self._count,
+            }
 
 
 _PREFIX = "tpu_operator_upgrade"

@@ -1,0 +1,273 @@
+"""QuarantineManager — the quarantine-on-degradation arc.
+
+No reference analog: the reference state machine only ever moves nodes
+*because a roll is in flight*. This manager implements the remediation
+loop Guard (PAPERS.md) argues for, with upgrades as just one consumer:
+a node whose telemetry health score (NodeHealthReport,
+api/telemetry_v1alpha1.py, read off ``ClusterUpgradeState.node_health``)
+crosses the policy threshold OUTSIDE any roll is cordoned into the
+``quarantined`` state, re-evaluated on an exponential backoff clock, and
+either
+
+* **rejoins** — score recovers past the hysteresis threshold
+  (``QuarantineSpec.recovery_score``): uncordon, clear the arc's
+  annotations, state back to unknown (the next pass reclassifies it
+  done/upgrade-required like any other node); or
+* **hands off** — quarantined past ``handoff_after_seconds`` without
+  recovery: the node stays cordoned and enters ``upgrade-required`` —
+  the upgrade pipeline (which re-validates hardware before uncordon) is
+  the repair path, and because the node is already cordoned the slice
+  planner treats its slice as disrupted-first and budget-exempt.
+
+**Bounded and budget-aware**: admission shares the roll's
+``maxUnavailable`` accounting (CommonUpgradeManager computes the slots),
+so a correlated telemetry flap — one miscalibrated floor across the
+fleet — can never cordon more capacity than the disruption budget
+allows; denials are counted (``budget_denied``) and retried on later
+passes while the reports stay degraded.
+
+All clocks are durable node annotations (a restarted controller resumes
+the same schedule); all writes go through the state provider (no-op
+coalescing + dirty-marking). Counters live under a leaf lock, exported
+through ``HealthMetrics`` (upgrade/health_source.py). The whole arc is
+documented in docs/fleet-telemetry.md.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Mapping, Optional
+
+from ..api.telemetry_v1alpha1 import NodeHealth
+from ..api.upgrade_v1alpha1 import QuarantineSpec
+from ..kube.objects import Node
+from ..utils.log import get_logger
+from .consts import NULL_STRING, UpgradeKeys, UpgradeState
+from .cordon_manager import CordonManager
+from .state_provider import NodeUpgradeStateProvider
+
+log = get_logger("upgrade.quarantine")
+
+
+class QuarantineManager:
+    def __init__(
+        self,
+        cordon_manager: CordonManager,
+        state_provider: NodeUpgradeStateProvider,
+        keys: UpgradeKeys,
+        recorder=None,
+        now=time.time,
+    ) -> None:
+        self._cordon = cordon_manager
+        self._provider = state_provider
+        self._keys = keys
+        self._recorder = recorder
+        #: Injectable clock — deterministic backoff/handoff tests.
+        self._now = now
+        # Leaf lock (nothing blocks under it) guarding the lifetime
+        # counters and the in-quarantine membership the metrics read.
+        self._counter_lock = threading.Lock()
+        self._totals = {
+            "entered": 0,
+            "released": 0,
+            "handed_off": 0,
+            "budget_denied": 0,
+        }
+        self._members: set[str] = set()
+
+    # -- counters / metrics ------------------------------------------------
+    def _count(self, key: str, n: int = 1) -> None:
+        with self._counter_lock:
+            self._totals[key] += n
+
+    def totals(self) -> dict[str, int]:
+        """Consistent snapshot for HealthMetrics: lifetime counters plus
+        the live in-quarantine gauge."""
+        with self._counter_lock:
+            out = dict(self._totals)
+            out["in_quarantine"] = len(self._members)
+            return out
+
+    def adopt(self, node_names) -> None:
+        """Fold the pass's quarantined-bucket membership into the gauge —
+        a restarted controller inherits nodes an earlier process
+        quarantined without re-counting them as new entries."""
+        with self._counter_lock:
+            self._members.update(node_names)
+
+    def _member_add(self, name: str) -> None:
+        with self._counter_lock:
+            self._members.add(name)
+
+    def _member_drop(self, name: str) -> None:
+        with self._counter_lock:
+            self._members.discard(name)
+
+    # -- admission ---------------------------------------------------------
+    def enter(self, node: Node, spec: QuarantineSpec, score: float) -> None:
+        """Cordon the node into quarantine and arm both durable clocks:
+        the entry stamp (handoff deadline) and the first recheck."""
+        now = int(self._now())
+        keys = self._keys
+        self._cordon.cordon(node)
+        self._provider.change_node_upgrade_annotation(
+            node, keys.quarantine_start_annotation, str(now)
+        )
+        self._provider.change_node_upgrade_annotation(
+            node,
+            keys.quarantine_backoff_annotation,
+            str(int(spec.reprobe_backoff_seconds)),
+        )
+        self._provider.change_node_upgrade_annotation(
+            node,
+            keys.quarantine_recheck_annotation,
+            str(now + int(spec.reprobe_backoff_seconds)),
+        )
+        self._provider.change_node_upgrade_state(
+            node, UpgradeState.QUARANTINED
+        )
+        self._count("entered")
+        self._member_add(node.name)
+        log.warning(
+            "node %s quarantined: health score %.1f below threshold %.1f",
+            node.name, score, spec.unhealthy_score,
+        )
+        self._event(
+            node, "Warning",
+            f"Node quarantined: health score {score:.1f} crossed the "
+            f"{spec.unhealthy_score:.1f} threshold",
+        )
+
+    def deny_budget(self, node: Node, score: float) -> None:
+        """A degraded node the disruption budget refused to cordon this
+        pass: counted and retried next pass (its report stays below the
+        threshold, so it stays a candidate)."""
+        self._count("budget_denied")
+        log.info(
+            "node %s degraded (score %.1f) but quarantine deferred: "
+            "disruption budget exhausted", node.name, score,
+        )
+
+    # -- the quarantined bucket (polling: backoff clocks are time-driven) --
+    def evaluate(
+        self,
+        node: Node,
+        spec: QuarantineSpec,
+        health: Optional[Mapping[str, NodeHealth]],
+    ) -> None:
+        """One pass over one quarantined node: handoff deadline first,
+        then the backoff-clocked health re-evaluation."""
+        now = int(self._now())
+        keys = self._keys
+        start_raw = node.annotations.get(keys.quarantine_start_annotation)
+        try:
+            start = int(start_raw) if start_raw is not None else None
+        except ValueError:
+            start = None
+        if start is None:
+            # Self-heal a missing/corrupt entry stamp (hand-edited node,
+            # pre-restart partial write): re-anchor the handoff deadline
+            # rather than hand off instantly or never.
+            self._provider.change_node_upgrade_annotation(
+                node, keys.quarantine_start_annotation, str(now)
+            )
+            start = now
+        if (
+            spec.handoff_after_seconds > 0
+            and now - start > spec.handoff_after_seconds
+        ):
+            self._hand_off(node, now - start)
+            return
+        recheck_raw = node.annotations.get(keys.quarantine_recheck_annotation)
+        try:
+            recheck = int(recheck_raw) if recheck_raw is not None else 0
+        except ValueError:
+            recheck = 0  # corrupt clock: recheck now, re-arm below
+        if now < recheck:
+            return  # backing off; the bucket polls, so we re-enter later
+        entry = (health or {}).get(node.name)
+        if entry is not None and entry.score >= spec.recovery_score:
+            self.release(
+                node,
+                f"health score recovered to {entry.score:.1f} "
+                f"(>= {spec.recovery_score:.1f})",
+            )
+            return
+        # Still unhealthy (or no report at all — absence is not
+        # recovery): double the backoff, re-arm the recheck clock.
+        backoff_raw = node.annotations.get(keys.quarantine_backoff_annotation)
+        try:
+            backoff = int(backoff_raw) if backoff_raw is not None else 0
+        except ValueError:
+            backoff = 0
+        backoff = max(backoff, int(spec.reprobe_backoff_seconds))
+        next_backoff = min(backoff * 2, int(spec.max_backoff_seconds))
+        self._provider.change_node_upgrade_annotation(
+            node, keys.quarantine_backoff_annotation, str(next_backoff)
+        )
+        self._provider.change_node_upgrade_annotation(
+            node, keys.quarantine_recheck_annotation, str(now + next_backoff)
+        )
+        log.info(
+            "node %s still unhealthy (score %s); next quarantine recheck "
+            "in %ds",
+            node.name,
+            f"{entry.score:.1f}" if entry is not None else "unreported",
+            next_backoff,
+        )
+
+    def release(self, node: Node, reason: str) -> None:
+        """Rejoin path (and the policy-withdrawn exit): uncordon, clear
+        the arc's annotations, state back to unknown — the next pass
+        reclassifies the node like any other."""
+        if node.unschedulable:
+            self._cordon.uncordon(node)
+        self._clear_clocks(node)
+        self._provider.change_node_upgrade_state(node, UpgradeState.UNKNOWN)
+        self._count("released")
+        self._member_drop(node.name)
+        log.info("node %s released from quarantine: %s", node.name, reason)
+        self._event(
+            node, "Normal", f"Node released from quarantine: {reason}"
+        )
+
+    def _hand_off(self, node: Node, quarantined_s: int) -> None:
+        """Handoff path: the node stays CORDONED (it is still degraded
+        hardware) and enters upgrade-required — the roll pipeline, whose
+        validation gate must pass before it can ever uncordon, is the
+        repair path. The planner sees a cordoned node, so its slice is
+        disrupted-first and budget-exempt — finishing it costs no new
+        disruption."""
+        self._clear_clocks(node)
+        self._provider.change_node_upgrade_state(
+            node, UpgradeState.UPGRADE_REQUIRED
+        )
+        self._count("handed_off")
+        self._member_drop(node.name)
+        log.warning(
+            "node %s quarantined for %ds without recovery; handing off "
+            "to the upgrade pipeline", node.name, quarantined_s,
+        )
+        self._event(
+            node, "Warning",
+            f"Node unrecovered after {quarantined_s}s in quarantine; "
+            "handed to the upgrade pipeline for repair",
+        )
+
+    def _clear_clocks(self, node: Node) -> None:
+        keys = self._keys
+        for key in (
+            keys.quarantine_start_annotation,
+            keys.quarantine_recheck_annotation,
+            keys.quarantine_backoff_annotation,
+        ):
+            self._provider.change_node_upgrade_annotation(
+                node, key, NULL_STRING
+            )
+
+    def _event(self, node: Node, event_type: str, message: str) -> None:
+        if self._recorder is not None:
+            self._recorder.eventf(
+                node, event_type, self._keys.event_reason(), message
+            )

@@ -207,6 +207,11 @@ class ClusterUpgradeStateManager:
         self.last_pass_stats = PassStats()
         self.inplace: ProcessNodeStateManager = InplaceNodeStateManager(self.common)
         self.requestor: Optional[ProcessNodeStateManager] = requestor
+        #: Fleet-health telemetry (docs/fleet-telemetry.md): when wired
+        #: via :meth:`with_health_telemetry`, every snapshot carries the
+        #: per-node health map and the quarantine arc goes live. None =
+        #: no telemetry plane; the feature costs nothing.
+        self.health_source = None
         # Incremental-source pass accounting: verify_every_n cadence and
         # the delta hit-rate gauge (reconcile thread only).
         self._incremental_builds = 0
@@ -257,7 +262,37 @@ class ClusterUpgradeStateManager:
         self.snapshot_source = source
         self.provider.set_write_through(source.record_write)
         self.common.pod_manager.revision_source = source
+        # A health plane wired before the snapshot source still gets its
+        # deltas into the dirty set (order-independent wiring).
+        if self.health_source is not None and incremental:
+            self.health_source.attach(source)
         return source
+
+    def with_health_telemetry(
+        self,
+        health_source=None,
+        sync_timeout: float = 30.0,
+    ):
+        """Wire the fleet-health telemetry plane (docs/fleet-telemetry.md):
+        consume ``NodeHealthReport`` CRs through an informer
+        (``upgrade/health_source.py:HealthSource``; one is built over
+        this manager's client when none is given), attach the per-node
+        health map to every snapshot (``ClusterUpgradeState.node_health``
+        — the planner's degraded-first ordering and the quarantine arc
+        read it), and — when the snapshot source is incremental — feed
+        report deltas into the dirty set so a health-only delta
+        reclassifies exactly the node it names. Starts the informer;
+        returns the source (caller owns ``stop()``)."""
+        from .health_source import HealthSource
+
+        if health_source is None:
+            health_source = HealthSource(self.client)
+        if not health_source.started:
+            health_source.start(sync_timeout=sync_timeout)
+        self.health_source = health_source
+        if getattr(self.snapshot_source, "incremental", False):
+            health_source.attach(self.snapshot_source)
+        return health_source
 
     # ------------------------------------------------------------------
     # Optional-state configuration (reference: upgrade_state.go:329-350)
@@ -369,6 +404,10 @@ class ClusterUpgradeStateManager:
             self._reset_pass_caches()
             state = self._build_state_full(namespace, driver_labels, source)
             state.dirty_nodes = None
+        if self.health_source is not None:
+            # Memoized mapping: a settled pool re-attaches the same
+            # frozen dict — a counter compare, no copy, no reads.
+            state.node_health = self.health_source.snapshot()
         stats.reads_issued = source.consume_reads()
         stats.snapshot_s = time.perf_counter() - start
         return state
@@ -688,6 +727,11 @@ class ClusterUpgradeStateManager:
         try:
             common.process_done_or_unknown_nodes(state, UpgradeState.UNKNOWN)
             common.process_done_or_unknown_nodes(state, UpgradeState.DONE)
+            # Quarantine after classification (an idle node reclassified
+            # upgrade-required this pass is the roll's, not quarantine's)
+            # and before planning, so a handed-off node's slice is
+            # already cordoned-disrupted when the planner next assesses.
+            common.process_quarantined_nodes(state, policy)
             self._process_upgrade_required_nodes(state, policy)
             common.process_cordon_required_nodes(state)
             common.process_wait_for_jobs_required_nodes(

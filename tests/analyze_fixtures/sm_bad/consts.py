@@ -15,6 +15,10 @@ class WidgetState(str, Enum):
     # STM203 gate must catch (ISSUE 6: a state added to the machine
     # without an apply_state processor parks nodes forever).
     CHECKPOINTING = "widget-checkpointing"
+    # The quarantine-arc twin (ISSUE 8): same hazard, new state — a
+    # telemetry-quarantine state wired into the partition but shipped
+    # without a handler must fail STM203, not park nodes silently.
+    QUARANTINED = "widget-quarantined"
 
 
 MANAGED_STATES = (
@@ -22,6 +26,7 @@ MANAGED_STATES = (
     WidgetState.SPINNING,
     WidgetState.JAMMED,
     WidgetState.CHECKPOINTING,
+    WidgetState.QUARANTINED,
 )
 
 MAINTENANCE_STATES = (

@@ -10,6 +10,7 @@ class GadgetState(str, Enum):
     RETIRED = "gadget-retired"
     LOST = "gadget-lost"
     CHECKPOINTING = "gadget-checkpointing"
+    QUARANTINED = "gadget-quarantined"
 
 
 MANAGED_STATES = (
@@ -17,6 +18,7 @@ MANAGED_STATES = (
     GadgetState.SPINNING,
     GadgetState.JAMMED,
     GadgetState.CHECKPOINTING,
+    GadgetState.QUARANTINED,
 )
 
 MAINTENANCE_STATES = (

@@ -9,6 +9,7 @@ class GadgetMachine:
         self.process_spinning_nodes(state)
         self.process_jammed_nodes(state)
         self.process_checkpointing_nodes(state)
+        self.process_quarantined_nodes(state)
         self.process_retired_nodes(state)
         self.process_lost_nodes(state)
 
@@ -22,6 +23,9 @@ class GadgetMachine:
         return state
 
     def process_checkpointing_nodes(self, state):
+        return state
+
+    def process_quarantined_nodes(self, state):
         return state
 
     def process_retired_nodes(self, state):

@@ -54,14 +54,16 @@ def test_state_machine_pass_flags_all_seeded_violations():
     unpartitioned = [f for f in findings if f.code == "STM201"]
     assert len(unpartitioned) == 2  # RETIRED and LOST
     unhandled = [f for f in findings if f.code == "STM203"]
-    # CHECKPOINTING is the ISSUE 6 twin: correctly partitioned, but the
-    # orchestrator ships no handler — the deliberately-missing arc.
+    # CHECKPOINTING is the ISSUE 6 twin and QUARANTINED the ISSUE 8 twin:
+    # correctly partitioned, but the orchestrator ships no handler — the
+    # deliberately-missing arc for each machine-growing PR.
     assert {
         m
         for f in unhandled
-        for m in ("JAMMED", "RETIRED", "LOST", "CHECKPOINTING")
+        for m in ("JAMMED", "RETIRED", "LOST", "CHECKPOINTING",
+                  "QUARANTINED")
         if m in f.message
-    } == {"JAMMED", "RETIRED", "LOST", "CHECKPOINTING"}
+    } == {"JAMMED", "RETIRED", "LOST", "CHECKPOINTING", "QUARANTINED"}
     stale = [f for f in findings if f.code == "STM204"]
     assert len(stale) == 1 and "process_melted_nodes" in stale[0].message
     literal = [f for f in findings if f.code == "STM205"]
@@ -74,9 +76,9 @@ def test_state_machine_pass_silent_on_clean_twin():
 
 def test_real_upgrade_machine_is_exhaustive():
     """The production state machine itself satisfies the invariants —
-    14 states (13 reference states + checkpoint-required) partitioned
-    and handled. Regresses loudly if a state is added without a handler
-    or partition slot."""
+    15 states (13 reference states + checkpoint-required + quarantined)
+    partitioned and handled. Regresses loudly if a state is added
+    without a handler or partition slot."""
     findings = run_analysis(
         [str(REPO / "k8s_operator_libs_tpu" / "upgrade")],
         pass_names=["state-machine"],

@@ -93,8 +93,54 @@ class TestRoundTrip:
         # Round trip preserves everything.
         assert DriverUpgradePolicySpec.from_dict(p.to_dict()) == p
 
+    def test_quarantine_round_trip(self):
+        from k8s_operator_libs_tpu.api import QuarantineSpec
+
+        d = {
+            "autoUpgrade": True,
+            "quarantine": {
+                "enable": True,
+                "unhealthyScore": 40.0,
+                "recoveryScore": 80.0,
+                "reprobeBackoffSeconds": 30,
+                "maxBackoffSeconds": 600,
+                "handoffAfterSeconds": 7200,
+            },
+        }
+        p = DriverUpgradePolicySpec.from_dict(d)
+        assert p.quarantine == QuarantineSpec(
+            enable=True, unhealthy_score=40.0, recovery_score=80.0,
+            reprobe_backoff_seconds=30, max_backoff_seconds=600,
+            handoff_after_seconds=7200,
+        )
+        assert DriverUpgradePolicySpec.from_dict(p.to_dict()) == p
+        # Absent stays absent through the round trip.
+        bare = DriverUpgradePolicySpec.from_dict({})
+        assert bare.quarantine is None
+        assert "quarantine" not in bare.to_dict()
+
     def test_validation(self):
         with pytest.raises(ValueError):
             DriverUpgradePolicySpec(max_parallel_upgrades=-1)
         with pytest.raises(ValueError):
             DrainSpec(timeout_seconds=-5)
+
+    def test_quarantine_validation(self):
+        from k8s_operator_libs_tpu.api import QuarantineSpec
+
+        with pytest.raises(ValueError):
+            QuarantineSpec(unhealthy_score=120.0)
+        with pytest.raises(ValueError):
+            # Hysteresis: recovery below entry would flap cordon/uncordon.
+            QuarantineSpec(unhealthy_score=60.0, recovery_score=50.0)
+        with pytest.raises(ValueError):
+            # Equal thresholds are the SAME flap: a score jittering at
+            # the line enters (score < 50) and releases (score >= 50)
+            # on alternating rechecks.
+            QuarantineSpec(unhealthy_score=50.0, recovery_score=50.0)
+        with pytest.raises(ValueError):
+            QuarantineSpec(reprobe_backoff_seconds=0)
+        with pytest.raises(ValueError):
+            QuarantineSpec(reprobe_backoff_seconds=60, max_backoff_seconds=30)
+        with pytest.raises(ValueError):
+            QuarantineSpec(handoff_after_seconds=-1)

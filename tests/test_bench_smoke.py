@@ -64,6 +64,20 @@ def test_single_event_latency_shapes():
     )
 
 
+def test_degraded_first_roll_shapes():
+    # Ordering, the zero-healthy-windows contract and the quarantine
+    # budget are hard-asserted inside the section; here we pin the
+    # artifact shape the CI floors resolve against.
+    out = bench.run_degraded_first_roll()
+    assert out["straggler_first"] == 1.0
+    assert out["degraded_first"]["healthy_windows_before_stragglers_done"] == 0
+    assert out["healthy_windows_saved"] >= 1
+    drill = out["quarantine_drill"]
+    assert drill["budget_violations"] == 0
+    assert drill["quarantined"] == drill["budget"]
+    assert drill["uncordoned_after_recovery"] is True
+
+
 def test_bench_check_gate(tmp_path):
     """The CI threshold gate: passes at baseline, fails on a >tolerance
     regression, fails on a silently dropped section."""

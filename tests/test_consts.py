@@ -11,10 +11,11 @@ from k8s_operator_libs_tpu.upgrade.consts import IDLE_STATES, MANAGED_STATES
 
 
 class TestStates:
-    def test_all_fourteen_states(self):
+    def test_all_fifteen_states(self):
         # 13 reference states (consts.go:48-83) + checkpoint-required
-        # (ISSUE 6, docs/checkpoint-drain.md — no reference analog).
-        assert len(list(UpgradeState)) == 14
+        # (ISSUE 6, docs/checkpoint-drain.md) + quarantined (ISSUE 8,
+        # docs/fleet-telemetry.md) — no reference analog for either.
+        assert len(list(UpgradeState)) == 15
 
     def test_state_values_match_reference(self):
         assert UpgradeState.UNKNOWN == ""
@@ -31,6 +32,7 @@ class TestStates:
         assert UpgradeState.UNCORDON_REQUIRED == "uncordon-required"
         assert UpgradeState.DONE == "upgrade-done"
         assert UpgradeState.FAILED == "upgrade-failed"
+        assert UpgradeState.QUARANTINED == "quarantined"
 
     def test_idle_vs_managed(self):
         assert UpgradeState.POST_MAINTENANCE_REQUIRED not in MANAGED_STATES

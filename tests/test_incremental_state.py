@@ -392,6 +392,182 @@ class TestSettledAndSingleEvent:
             source.stop()
 
 
+def health_caught_up(health_source, cluster):
+    """Store AND dispatch catch-up for the NodeHealthReport informer —
+    the telemetry analog of deliveries_caught_up."""
+    inf = health_source.informer()
+    truth = {
+        (o.namespace, o.name): str(o.resource_version)
+        for o in cluster.list("NodeHealthReport")
+    }
+    with inf._dispatch_lock:
+        dispatched = dict(inf._dispatched_rv)
+    with inf._lock:
+        store = {
+            key: str((raw.get("metadata") or {}).get("resourceVersion", ""))
+            for key, raw in inf._store.items()
+        }
+    return store == truth and dispatched == truth
+
+
+class TestTelemetryDeltas:
+    """ISSUE 8: NodeHealthReport deltas through the incremental path
+    (docs/fleet-telemetry.md). A health-only delta reclassifies exactly
+    the node its report names — never a full rebuild — and a pool with
+    no telemetry configured pays zero for the feature."""
+
+    def _publish(self, cluster, node, score_bad):
+        from k8s_operator_libs_tpu.tpu.monitor import ReportPublisher
+
+        metrics = (
+            {"ring_gbytes_per_s": 1.0, "probe_latency_s": 120.0}
+            if score_bad
+            else {"ring_gbytes_per_s": 45.0, "probe_latency_s": 2.0}
+        )
+        ReportPublisher(cluster, node, heartbeat_seconds=0.0).publish(
+            {"ring_allreduce": not score_bad}, metrics
+        )
+
+    def test_health_only_delta_is_one_node_no_full_rebuild(self):
+        cluster, sim = build_cluster(node_count=8)
+        mgr, source = incremental_manager(cluster)
+        health = mgr.with_health_telemetry()
+        try:
+            settle(cluster, sim, mgr, source)
+            self._publish(cluster, "node-5", score_bad=True)
+            assert wait_until(lambda: "node-5" in source.dirty().nodes)
+            assert wait_until(lambda: health_caught_up(health, cluster))
+            state = mgr.build_state(NS, LABELS)
+            stats = mgr.last_pass_stats
+            assert not stats.full_rebuild, (
+                "a health-only delta must never trigger a full rebuild"
+            )
+            assert stats.nodes_reclassified == 1
+            assert state.dirty_nodes == frozenset({"node-5"})
+            assert state.node_health["node-5"].score < 50.0
+        finally:
+            health.stop()
+            source.stop()
+
+    def test_settled_telemetry_pool_stays_zero_client_work(self):
+        """Telemetry wired + settled: passes are still snapshot_skipped
+        with zero client traffic — the memoized health map costs a
+        counter compare, not reads."""
+        cluster, sim = build_cluster(node_count=6)
+        mgr, source = incremental_manager(cluster)
+        health = mgr.with_health_telemetry()
+        try:
+            self._publish(cluster, "node-2", score_bad=False)
+            settle(cluster, sim, mgr, source)
+            mgr.apply_state(mgr.build_state(NS, LABELS), POLICY)
+            log = cluster.start_call_log()
+            state = mgr.build_state(NS, LABELS)
+            mgr.apply_state(state, POLICY)
+            cluster.stop_call_log()
+            stats = mgr.last_pass_stats
+            assert stats.snapshot_skipped
+            assert stats.writes_issued == 0
+            assert state.node_health["node-2"].score == 100.0
+            assert [c for c in log if c[0] in
+                    ("get", "list", "patch", "update", "create")] == []
+            # Memoized: consecutive settled passes share the mapping.
+            assert (
+                mgr.build_state(NS, LABELS).node_health
+                is state.node_health
+            )
+        finally:
+            health.stop()
+            source.stop()
+
+    def test_non_telemetry_pool_pays_zero_for_the_feature(self):
+        """The PR-6 settled_pool_noop pattern, re-pinned for ISSUE 8: a
+        pool that never wires a HealthSource carries no health map, runs
+        no health informer, and its settled passes are byte-identical
+        zero work."""
+        cluster, sim = build_cluster(node_count=6)
+        mgr, source = incremental_manager(cluster)
+        try:
+            settle(cluster, sim, mgr, source)
+            log = cluster.start_call_log()
+            state = mgr.build_state(NS, LABELS)
+            mgr.apply_state(state, POLICY)
+            cluster.stop_call_log()
+            assert mgr.health_source is None
+            assert state.node_health is None
+            assert mgr.last_pass_stats.snapshot_skipped
+            assert [c for c in log if c[0] in
+                    ("get", "list", "patch", "update", "create")] == []
+            # No NodeHealthReport watch was ever opened.
+            assert all(
+                c[1] != "NodeHealthReport" for c in log
+            )
+        finally:
+            source.stop()
+
+    @pytest.mark.parametrize("seed", [21, 4242])
+    def test_fuzzer_with_health_report_steps(self, seed):
+        """The incremental==full fuzzer with NodeHealthReport create/
+        update/delete steps in the mix: classification equivalence must
+        hold after every step (health deltas dirty nodes but never
+        change any bucket), interleaved with the usual label flips,
+        rollouts and kubelet ticks."""
+        rng = random.Random(seed)
+        cluster, sim = build_cluster(node_count=6)
+        mgr_inc, source = incremental_manager(cluster)
+        health = mgr_inc.with_health_telemetry()
+        mgr_full = full_manager(cluster)
+        rollouts = 0
+        try:
+            def flip_state_label(_):
+                name = f"node-{rng.randrange(6)}"
+                node = Node(cluster.get("Node", name).raw)
+                value = rng.choice(TestEquivalenceFuzzer.STATES)
+                if value:
+                    node.labels[KEYS.state_label] = value
+                else:
+                    node.labels.pop(KEYS.state_label, None)
+                cluster.update(node)
+
+            def health_create_or_update(_):
+                self._publish(
+                    cluster, f"node-{rng.randrange(6)}",
+                    score_bad=rng.random() < 0.5,
+                )
+
+            def health_delete(_):
+                name = f"node-{rng.randrange(6)}"
+                if cluster.get_or_none("NodeHealthReport", name) is not None:
+                    cluster.delete("NodeHealthReport", name)
+
+            def rollout(_):
+                nonlocal rollouts
+                rollouts += 1
+                sim.set_template_hash(f"hv{rollouts}")
+
+            def kubelet_step(_):
+                sim.step()
+
+            ops = [
+                flip_state_label, health_create_or_update,
+                health_create_or_update, health_delete, rollout,
+                kubelet_step,
+            ]
+            for step in range(40):
+                rng.choice(ops)(step)
+                assert wait_until(
+                    lambda: deliveries_caught_up(source, cluster)
+                    and health_caught_up(health, cluster)
+                ), f"seed={seed} step={step}: informers never caught up"
+                expected = build_shape(mgr_full)
+                got = build_shape(mgr_inc)
+                assert got == expected, (
+                    f"seed={seed} step={step}: incremental diverged"
+                )
+        finally:
+            health.stop()
+            source.stop()
+
+
 class TestDeltaRetirement:
     """clean() must retire exactly what the pass consumed: a node
     re-marked AFTER dirty() — even though its name was already in the

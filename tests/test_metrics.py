@@ -108,6 +108,85 @@ class TestRender:
             assert 'node="evil\\"node\\\\"' in line
 
 
+class TestHistogram:
+    """render_rows' histogram kind (ISSUE 8 satellite): full Prometheus
+    exposition shape — cumulative buckets with le spliced into the label
+    set, sum and count — from a Histogram snapshot."""
+
+    def test_exposition_format(self):
+        from k8s_operator_libs_tpu.upgrade.metrics import (
+            Histogram,
+            prom_label,
+            render_rows,
+        )
+
+        hist = Histogram(buckets=(0.5, 1.0, 5.0))
+        for v in (0.1, 0.7, 0.7, 3.0, 99.0):
+            hist.observe(v)
+        text = render_rows(
+            "tpu_operator_health", prom_label("node", "n1"),
+            [("probe_latency_seconds", "histogram", "Probe latency",
+              hist.snapshot())],
+        )
+        lines = text.strip().splitlines()
+        assert lines[0] == (
+            "# HELP tpu_operator_health_probe_latency_seconds Probe latency"
+        )
+        assert lines[1] == (
+            "# TYPE tpu_operator_health_probe_latency_seconds histogram"
+        )
+        # Cumulative buckets; +Inf equals the total count.
+        assert lines[2] == (
+            'tpu_operator_health_probe_latency_seconds_bucket'
+            '{node="n1",le="0.5"} 1'
+        )
+        assert lines[3] == (
+            'tpu_operator_health_probe_latency_seconds_bucket'
+            '{node="n1",le="1"} 3'
+        )
+        assert lines[4] == (
+            'tpu_operator_health_probe_latency_seconds_bucket'
+            '{node="n1",le="5"} 4'
+        )
+        assert lines[5] == (
+            'tpu_operator_health_probe_latency_seconds_bucket'
+            '{node="n1",le="+Inf"} 5'
+        )
+        assert lines[6] == (
+            'tpu_operator_health_probe_latency_seconds_sum'
+            '{node="n1"} 103.5'
+        )
+        assert lines[7] == (
+            'tpu_operator_health_probe_latency_seconds_count{node="n1"} 5'
+        )
+
+    def test_empty_histogram_and_no_label(self):
+        from k8s_operator_libs_tpu.upgrade.metrics import (
+            Histogram,
+            render_rows,
+        )
+
+        text = render_rows(
+            "t", "", [("h", "histogram", "x", Histogram((1.0,)).snapshot())]
+        )
+        assert 't_h_bucket{le="1"} 0' in text
+        assert 't_h_bucket{le="+Inf"} 0' in text
+        assert "t_h_sum 0.0" in text
+        assert "t_h_count 0" in text
+
+    def test_merge_label_escapes(self):
+        from k8s_operator_libs_tpu.upgrade.metrics import (
+            merge_label,
+            prom_label,
+        )
+
+        label = prom_label("node", 'a"b')
+        assert merge_label(label, "le", "0.5") == (
+            '{node="a\\"b",le="0.5"}'
+        )
+        assert merge_label("", "le", "+Inf") == '{le="+Inf"}'
+
+
 class TestEndpoint:
     def test_metrics_served_over_http(self):
         _, sim, mgr = make_harness(nodes=2)

@@ -211,6 +211,62 @@ class TestConditionLifecycle:
         assert 'tpu_monitor_consecutive_failures{node="tpu-node"} 1' in text
         assert 'tpu_monitor_published_healthy{node="tpu-node"} 1' in text
 
+    def test_metrics_retain_degradation_between_scrapes(self):
+        """ISSUE 8 satellite regression: MonitorMetrics used to keep only
+        the LAST probe result, so a flap — degraded battery, then a
+        recovered one — between two scrapes erased the very sample that
+        flipped the condition. The last-N window keeps it visible."""
+        from k8s_operator_libs_tpu.ops.collectives import CollectiveReport
+        from k8s_operator_libs_tpu.tpu.monitor import MonitorMetrics
+
+        def battery(ok, ring, elapsed):
+            return HealthReport(
+                ok=ok,
+                collectives=[CollectiveReport(
+                    op="psum_ring_allreduce", ok=ok,
+                    gbytes_per_s=ring, elapsed_s=0.1,
+                )],
+                elapsed_s=elapsed,
+            )
+
+        metrics = MonitorMetrics("tpu-node")
+        metrics.record(battery(True, 40.0, 5.0))
+        # The degradation that flips the condition...
+        metrics.record(battery(False, 2.0, 90.0))
+        # ...followed by a recovery BEFORE the next scrape.
+        metrics.record(battery(True, 41.0, 5.0))
+        text = metrics.render()
+        # The last value alone would hide the flap; the window doesn't.
+        assert 'tpu_monitor_ring_gbytes_per_s{node="tpu-node"} 41.0' in text
+        assert (
+            'tpu_monitor_ring_window_min_gbytes_per_s{node="tpu-node"} 2.0'
+            in text
+        )
+        assert (
+            'tpu_monitor_probe_duration_window_max_seconds'
+            '{node="tpu-node"} 90.0' in text
+        )
+
+    def test_metrics_window_is_bounded(self):
+        from k8s_operator_libs_tpu.ops.collectives import CollectiveReport
+        from k8s_operator_libs_tpu.tpu.monitor import (
+            METRIC_WINDOW,
+            MonitorMetrics,
+        )
+
+        metrics = MonitorMetrics("tpu-node")
+        for i in range(METRIC_WINDOW + 4):
+            metrics.record(HealthReport(
+                ok=True,
+                collectives=[CollectiveReport(
+                    op="ppermute_ring", ok=True,
+                    gbytes_per_s=float(i + 1), elapsed_s=0.1,
+                )],
+                elapsed_s=1.0,
+            ))
+        # Old samples age out: the min reflects the window, not history.
+        assert 'tpu_monitor_ring_window_min_gbytes_per_s{node="tpu-node"} 5.0' in metrics.render()
+
     def test_metrics_served_over_http(self):
         import urllib.request
 

@@ -1,0 +1,446 @@
+"""Quarantine-on-degradation arc (ISSUE 8, docs/fleet-telemetry.md).
+
+The contract under test:
+
+* a node whose health score crosses the policy threshold OUTSIDE any
+  roll is cordoned into ``quarantined``, budget-aware (a telemetry flap
+  can never cordon past maxUnavailable);
+* quarantined nodes re-evaluate on an exponential backoff clock, rejoin
+  on recovery past the hysteresis threshold, and hand off to the
+  upgrade pipeline after the handoff deadline;
+* a withdrawn policy releases parked nodes; skip-labeled and mid-roll
+  nodes are never admitted;
+* quarantined nodes consume the roll's own availability budget.
+"""
+
+import time
+
+from k8s_operator_libs_tpu.api import DriverUpgradePolicySpec, QuarantineSpec
+from k8s_operator_libs_tpu.kube import FakeCluster, Node
+from k8s_operator_libs_tpu.kube.events import FakeRecorder
+from k8s_operator_libs_tpu.kube.sim import DaemonSetSimulator
+from k8s_operator_libs_tpu.tpu.monitor import ReportPublisher
+from k8s_operator_libs_tpu.upgrade import (
+    ClusterUpgradeStateManager,
+    DeviceClass,
+    TaskRunner,
+    UpgradeKeys,
+    UpgradeState,
+)
+from k8s_operator_libs_tpu.utils import IntOrString
+from builders import make_node
+from test_informer import wait_until
+
+KEYS = UpgradeKeys(DeviceClass.tpu())
+NS = "driver-ns"
+LABELS = {"app": "driver"}
+
+
+def policy_with_quarantine(max_unavailable="25%", **spec_kwargs):
+    spec_kwargs.setdefault("enable", True)
+    spec_kwargs.setdefault("unhealthy_score", 50.0)
+    spec_kwargs.setdefault("recovery_score", 70.0)
+    spec_kwargs.setdefault("reprobe_backoff_seconds", 1)
+    return DriverUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=0,
+        max_unavailable=IntOrString(max_unavailable),
+        quarantine=QuarantineSpec(**spec_kwargs),
+    )
+
+
+class Harness:
+    def __init__(self, nodes=4, recorder=None, now=None):
+        self.cluster = FakeCluster()
+        for i in range(nodes):
+            self.cluster.create(make_node(f"node-{i}"))
+        self.sim = DaemonSetSimulator(
+            self.cluster, name="driver", namespace=NS, match_labels=LABELS
+        )
+        self.sim.settle()
+        self.mgr = ClusterUpgradeStateManager(
+            self.cluster, DeviceClass.tpu(),
+            runner=TaskRunner(inline=True), recorder=recorder,
+        )
+        if now is not None:
+            self.mgr.common.quarantine_manager._now = now
+        self.health = self.mgr.with_health_telemetry()
+
+    def stop(self):
+        self.health.stop()
+
+    def publish(self, node, score_bad=True):
+        metrics = (
+            {"ring_gbytes_per_s": 1.0, "probe_latency_s": 120.0}
+            if score_bad
+            else {"ring_gbytes_per_s": 45.0, "probe_latency_s": 2.0}
+        )
+        ReportPublisher(
+            self.cluster, node, heartbeat_seconds=0.0
+        ).publish({"ring_allreduce": not score_bad}, metrics)
+        assert wait_until(
+            lambda: self.health.snapshot().get(node) is not None
+            and (self.health.snapshot()[node].score < 50.0) == score_bad
+        )
+
+    def reconcile(self, policy, passes=1):
+        for _ in range(passes):
+            self.mgr.apply_state(self.mgr.build_state(NS, LABELS), policy)
+
+    def node(self, name) -> Node:
+        return Node(self.cluster.get("Node", name).raw)
+
+    def state_of(self, name):
+        return self.node(name).labels.get(KEYS.state_label, "")
+
+
+class TestAdmission:
+    def test_degraded_idle_node_is_cordoned_into_quarantine(self):
+        recorder = FakeRecorder()
+        h = Harness(recorder=recorder)
+        try:
+            policy = policy_with_quarantine()
+            h.reconcile(policy)  # classify everyone done
+            h.publish("node-1", score_bad=True)
+            h.reconcile(policy)
+            node = h.node("node-1")
+            assert node.labels[KEYS.state_label] == str(
+                UpgradeState.QUARANTINED
+            )
+            assert node.unschedulable
+            assert KEYS.quarantine_start_annotation in node.annotations
+            assert KEYS.quarantine_recheck_annotation in node.annotations
+            assert any("quarantined" in m for m in recorder.drain())
+            totals = h.mgr.common.quarantine_manager.totals()
+            assert totals["entered"] == 1
+            assert totals["in_quarantine"] == 1
+        finally:
+            h.stop()
+
+    def test_admission_is_budget_bounded(self):
+        """6 degraded reports, 25% budget on 8 nodes = 2 slots: exactly
+        2 quarantined (worst scores first), the rest counted denied —
+        the correlated-flap safety property."""
+        h = Harness(nodes=8)
+        try:
+            policy = policy_with_quarantine(max_unavailable="25%")
+            h.reconcile(policy)
+            for i in range(6):
+                h.publish(f"node-{i}", score_bad=True)
+            h.reconcile(policy, passes=2)
+            quarantined = [
+                f"node-{i}" for i in range(8)
+                if h.state_of(f"node-{i}") == str(UpgradeState.QUARANTINED)
+            ]
+            assert len(quarantined) == 2
+            unavailable = sum(
+                1 for i in range(8) if h.node(f"node-{i}").unschedulable
+            )
+            assert unavailable == 2
+            totals = h.mgr.common.quarantine_manager.totals()
+            assert totals["entered"] == 2
+            assert totals["budget_denied"] >= 4
+        finally:
+            h.stop()
+
+    def test_skip_labeled_and_cordoned_nodes_are_not_admitted(self):
+        h = Harness()
+        try:
+            policy = policy_with_quarantine()
+            h.reconcile(policy)
+            node = h.node("node-1")
+            node.labels[KEYS.skip_label] = "true"
+            h.cluster.update(node)
+            node = h.node("node-2")
+            node.unschedulable = True
+            h.cluster.update(node)
+            time.sleep(0.1)
+            h.publish("node-1", score_bad=True)
+            h.publish("node-2", score_bad=True)
+            h.reconcile(policy, passes=2)
+            assert h.state_of("node-1") != str(UpgradeState.QUARANTINED)
+            assert h.state_of("node-2") != str(UpgradeState.QUARANTINED)
+            assert (
+                h.mgr.common.quarantine_manager.totals()["entered"] == 0
+            )
+        finally:
+            h.stop()
+
+    def test_mid_roll_nodes_are_not_admitted(self):
+        """'Outside any roll': a node in the pipeline keeps its arc —
+        only idle (unknown/done) nodes are quarantine candidates."""
+        h = Harness()
+        try:
+            policy = policy_with_quarantine()
+            h.reconcile(policy)
+            h.publish("node-1", score_bad=True)
+            # Put node-1 mid-roll before the quarantine pass sees it.
+            h.mgr.provider.change_node_upgrade_state(
+                h.node("node-1"), UpgradeState.WAIT_FOR_JOBS_REQUIRED
+            )
+            h.reconcile(policy)
+            assert h.state_of("node-1") != str(UpgradeState.QUARANTINED)
+        finally:
+            h.stop()
+
+    def test_no_quarantine_without_telemetry_or_spec(self):
+        # Spec enabled but no HealthSource: inert.
+        cluster = FakeCluster()
+        for i in range(2):
+            cluster.create(make_node(f"node-{i}"))
+        sim = DaemonSetSimulator(
+            cluster, name="driver", namespace=NS, match_labels=LABELS
+        )
+        sim.settle()
+        mgr = ClusterUpgradeStateManager(
+            cluster, DeviceClass.tpu(), runner=TaskRunner(inline=True)
+        )
+        mgr.apply_state(mgr.build_state(NS, LABELS), policy_with_quarantine())
+        assert mgr.common.quarantine_manager.totals()["entered"] == 0
+        # HealthSource wired but spec absent: inert too.
+        h = Harness()
+        try:
+            h.publish("node-1", score_bad=True)
+            h.reconcile(DriverUpgradePolicySpec(
+                auto_upgrade=True, max_parallel_upgrades=0,
+                max_unavailable=IntOrString("100%"),
+            ), passes=2)
+            assert h.state_of("node-1") in ("", "upgrade-done")
+        finally:
+            h.stop()
+
+
+class TestLifecycle:
+    def test_recovery_releases_and_reclassifies(self):
+        h = Harness()
+        try:
+            policy = policy_with_quarantine(reprobe_backoff_seconds=1)
+            h.reconcile(policy)
+            h.publish("node-1", score_bad=True)
+            h.reconcile(policy)
+            assert h.state_of("node-1") == str(UpgradeState.QUARANTINED)
+            h.publish("node-1", score_bad=False)
+            time.sleep(1.1)  # let the backoff clock expire
+            h.reconcile(policy)  # release
+            h.reconcile(policy)  # reclassify unknown -> done
+            node = h.node("node-1")
+            assert node.labels[KEYS.state_label] == "upgrade-done"
+            assert not node.unschedulable
+            assert KEYS.quarantine_start_annotation not in node.annotations
+            totals = h.mgr.common.quarantine_manager.totals()
+            assert totals["released"] == 1
+            assert totals["in_quarantine"] == 0
+        finally:
+            h.stop()
+
+    def test_hysteresis_keeps_borderline_node_quarantined(self):
+        """Score between unhealthy and recovery thresholds: stays in."""
+        h = Harness()
+        try:
+            policy = policy_with_quarantine(
+                unhealthy_score=50.0, recovery_score=90.0,
+                reprobe_backoff_seconds=1,
+            )
+            h.reconcile(policy)
+            h.publish("node-1", score_bad=True)
+            h.reconcile(policy)
+            # Recovers to ~85 (one failed check's worth below 100) —
+            # above entry, below the recovery threshold.
+            ReportPublisher(
+                h.cluster, "node-1", heartbeat_seconds=0.0
+            ).publish(
+                {"ring_allreduce": True, "mxu": False},
+                {"ring_gbytes_per_s": 45.0, "probe_latency_s": 2.0},
+            )
+            assert wait_until(
+                lambda: 50.0 < (h.health.snapshot()["node-1"].score) < 90.0
+            )
+            time.sleep(1.1)
+            h.reconcile(policy, passes=2)
+            assert h.state_of("node-1") == str(UpgradeState.QUARANTINED)
+        finally:
+            h.stop()
+
+    def test_backoff_doubles_and_caps(self):
+        clock = {"t": 1000.0}
+        h = Harness(now=lambda: clock["t"])
+        try:
+            policy = policy_with_quarantine(
+                reprobe_backoff_seconds=10, max_backoff_seconds=25,
+            )
+            h.reconcile(policy)
+            h.publish("node-1", score_bad=True)
+            h.reconcile(policy)  # enter: backoff 10, recheck t+10
+            node = h.node("node-1")
+            assert node.annotations[
+                KEYS.quarantine_backoff_annotation] == "10"
+            assert node.annotations[
+                KEYS.quarantine_recheck_annotation] == "1010"
+            clock["t"] = 1005.0
+            h.reconcile(policy)  # not due: nothing moves
+            assert h.node("node-1").annotations[
+                KEYS.quarantine_backoff_annotation] == "10"
+            clock["t"] = 1011.0
+            h.reconcile(policy)  # due, still bad: backoff 20
+            node = h.node("node-1")
+            assert node.annotations[
+                KEYS.quarantine_backoff_annotation] == "20"
+            assert node.annotations[
+                KEYS.quarantine_recheck_annotation] == "1031"
+            clock["t"] = 1032.0
+            h.reconcile(policy)  # due again: capped at 25
+            assert h.node("node-1").annotations[
+                KEYS.quarantine_backoff_annotation] == "25"
+        finally:
+            h.stop()
+
+    def test_missing_report_is_not_recovery(self):
+        """Absence of telemetry must not release a quarantined node —
+        a crashed publisher on a sick node is the likeliest case."""
+        h = Harness()
+        try:
+            policy = policy_with_quarantine(reprobe_backoff_seconds=1)
+            h.reconcile(policy)
+            h.publish("node-1", score_bad=True)
+            h.reconcile(policy)
+            h.cluster.delete("NodeHealthReport", "node-1")
+            assert wait_until(
+                lambda: "node-1" not in h.health.snapshot()
+            )
+            time.sleep(1.1)
+            h.reconcile(policy, passes=2)
+            assert h.state_of("node-1") == str(UpgradeState.QUARANTINED)
+        finally:
+            h.stop()
+
+    def test_handoff_to_upgrade_pipeline_after_deadline(self):
+        clock = {"t": 1000.0}
+        recorder = FakeRecorder()
+        h = Harness(recorder=recorder, now=lambda: clock["t"])
+        try:
+            policy = policy_with_quarantine(
+                reprobe_backoff_seconds=10, handoff_after_seconds=100,
+            )
+            h.reconcile(policy)
+            h.publish("node-1", score_bad=True)
+            h.reconcile(policy)
+            clock["t"] = 1101.0
+            h.reconcile(policy)
+            node = h.node("node-1")
+            # Handed to the pipeline: upgrade-required, STILL cordoned
+            # (degraded hardware must not serve), clocks cleared.
+            assert node.labels[KEYS.state_label] == str(
+                UpgradeState.UPGRADE_REQUIRED
+            )
+            assert node.unschedulable
+            assert KEYS.quarantine_start_annotation not in node.annotations
+            totals = h.mgr.common.quarantine_manager.totals()
+            assert totals["handed_off"] == 1
+            assert totals["in_quarantine"] == 0
+            assert any("handed" in m for m in recorder.drain())
+        finally:
+            h.stop()
+
+    def test_withdrawn_policy_releases_parked_nodes(self):
+        h = Harness()
+        try:
+            policy = policy_with_quarantine()
+            h.reconcile(policy)
+            h.publish("node-1", score_bad=True)
+            h.reconcile(policy)
+            assert h.state_of("node-1") == str(UpgradeState.QUARANTINED)
+            disabled = DriverUpgradePolicySpec(
+                auto_upgrade=True, max_parallel_upgrades=0,
+                max_unavailable=IntOrString("100%"),
+            )
+            h.reconcile(disabled, passes=2)
+            node = h.node("node-1")
+            assert not node.unschedulable
+            assert node.labels[KEYS.state_label] == "upgrade-done"
+            assert KEYS.quarantine_recheck_annotation not in node.annotations
+        finally:
+            h.stop()
+
+
+class TestBudgetCoupling:
+    def test_quarantined_nodes_consume_upgrade_budget(self):
+        """A quarantined (cordoned) node counts unavailable: the roll's
+        own budget math sees it, so quarantine + roll together can never
+        exceed maxUnavailable."""
+        h = Harness(nodes=4)
+        try:
+            policy = policy_with_quarantine(max_unavailable="25%")
+            h.reconcile(policy)
+            h.publish("node-0", score_bad=True)
+            h.reconcile(policy)
+            assert h.state_of("node-0") == str(UpgradeState.QUARANTINED)
+            # A rollout lands: budget (1 of 4) is already consumed by
+            # the quarantined node, so NO node starts the roll.
+            h.sim.set_template_hash("rev-2")
+            h.sim.step()
+            h.reconcile(policy, passes=2)
+            started = [
+                f"node-{i}" for i in range(4)
+                if h.state_of(f"node-{i}")
+                not in ("", "upgrade-done", "upgrade-required",
+                        str(UpgradeState.QUARANTINED))
+            ]
+            assert started == []
+            state = h.mgr.build_state(NS, LABELS)
+            # ...through the UNAVAILABILITY count, not the in-progress
+            # one: quarantine is cordoned capacity, not an upgrade in
+            # flight (see test_quarantine_does_not_eat_parallel_slots).
+            assert h.mgr.get_upgrades_in_progress(state) == 0
+            assert (
+                h.mgr.common.get_current_unavailable_nodes(state) >= 1
+            )
+        finally:
+            h.stop()
+
+    def test_quarantine_does_not_eat_parallel_slots(self):
+        """A quarantined node must not stall the roll by consuming a
+        maxParallelUpgrades slot: with a generous unavailability budget,
+        a rollout starts even while one node sits in quarantine."""
+        h = Harness(nodes=4)
+        try:
+            policy = DriverUpgradePolicySpec(
+                auto_upgrade=True,
+                max_parallel_upgrades=1,
+                max_unavailable=IntOrString("100%"),
+                quarantine=QuarantineSpec(
+                    enable=True, unhealthy_score=50.0,
+                    recovery_score=70.0, reprobe_backoff_seconds=1,
+                ),
+            )
+            h.reconcile(policy)
+            h.publish("node-0", score_bad=True)
+            h.reconcile(policy)
+            assert h.state_of("node-0") == str(UpgradeState.QUARANTINED)
+            h.sim.set_template_hash("rev-2")
+            h.sim.step()
+            h.reconcile(policy, passes=2)
+            started = [
+                f"node-{i}" for i in range(1, 4)
+                if h.state_of(f"node-{i}")
+                not in ("", "upgrade-done", "upgrade-required")
+            ]
+            # Exactly the one parallel slot is used — by a real upgrade,
+            # not by the parked quarantine.
+            assert len(started) == 1
+        finally:
+            h.stop()
+
+    def test_managed_and_partition_accounting(self):
+        """QUARANTINED is managed (it cannot escape the budget/metrics
+        math — the STM201 hazard) and IDLE (cordoned capacity, but not
+        an upgrade in flight: it consumes maxUnavailable through the
+        unavailability count, never a maxParallelUpgrades slot)."""
+        from k8s_operator_libs_tpu.upgrade.consts import (
+            IDLE_STATES,
+            MAINTENANCE_STATES,
+            MANAGED_STATES,
+        )
+
+        assert UpgradeState.QUARANTINED in MANAGED_STATES
+        assert UpgradeState.QUARANTINED not in MAINTENANCE_STATES
+        assert UpgradeState.QUARANTINED in IDLE_STATES

@@ -1,0 +1,539 @@
+"""Fleet-health telemetry plane (ISSUE 8, docs/fleet-telemetry.md).
+
+The contract under test:
+
+* **NodeHealthReport CR contract** (api/telemetry_v1alpha1.py): score
+  derivation from checks + graded metrics, trend over the bounded
+  rolling window, and the kube registry staying in sync with the
+  api-side constants without either importing the other;
+* **ReportPublisher** (tpu/monitor.py): rv-guarded create-or-update
+  through the status subresource, debounced in steady state, history
+  bounded;
+* **HealthSource** (upgrade/health_source.py): informer-fed per-node
+  map with a memoized snapshot, attached to every build_state;
+* **degraded-first planning** (tpu/planner.py): candidate slices order
+  by ascending health score with trend tiebreak;
+* **HealthMetrics**: the tpu_operator_health_* family over real HTTP,
+  including a valid Prometheus histogram.
+"""
+
+import urllib.request
+
+from k8s_operator_libs_tpu.api import (
+    DriverUpgradePolicySpec,
+    derive_score,
+    derive_trend,
+    make_node_health_report,
+    parse_node_health,
+    trend_value,
+)
+from k8s_operator_libs_tpu.api import telemetry_v1alpha1 as telemetry
+from k8s_operator_libs_tpu.kube import FakeCluster, Node
+from k8s_operator_libs_tpu.kube.resources import resource_for_kind
+from k8s_operator_libs_tpu.kube.sim import DaemonSetSimulator
+from k8s_operator_libs_tpu.tpu.health import HealthReport
+from k8s_operator_libs_tpu.tpu.monitor import ReportPublisher
+from k8s_operator_libs_tpu.upgrade import (
+    ClusterUpgradeStateManager,
+    DeviceClass,
+    HealthMetrics,
+    HealthSource,
+    MetricsServer,
+    TaskRunner,
+    UpgradeKeys,
+)
+from k8s_operator_libs_tpu.utils import IntOrString
+from builders import make_node
+from test_informer import wait_until
+
+KEYS = UpgradeKeys(DeviceClass.tpu())
+NS = "driver-ns"
+LABELS = {"app": "driver"}
+
+
+def make_harness(nodes=4):
+    cluster = FakeCluster()
+    for i in range(nodes):
+        cluster.create(make_node(f"node-{i}"))
+    sim = DaemonSetSimulator(
+        cluster, name="driver", namespace=NS, match_labels=LABELS
+    )
+    sim.settle()
+    mgr = ClusterUpgradeStateManager(
+        cluster, DeviceClass.tpu(), runner=TaskRunner(inline=True)
+    )
+    return cluster, sim, mgr
+
+
+class TestContract:
+    def test_registry_matches_api_constants(self):
+        """Same two-sided pin as WorkloadCheckpoint: the api module owns
+        the contract, kube/resources owns the REST entry, and neither
+        imports the other."""
+        info = resource_for_kind(telemetry.NODE_HEALTH_REPORT_KIND)
+        assert info.api_version == telemetry.NODE_HEALTH_REPORT_API_VERSION
+        assert info.plural == telemetry.NODE_HEALTH_REPORT_PLURAL
+        assert not info.namespaced  # cluster-scoped, like the Node
+
+    def test_score_components(self):
+        # All healthy: full credit.
+        assert derive_score(
+            {"a": True}, {"ring_gbytes_per_s": 45.0, "probe_latency_s": 5.0}
+        ) == 100.0
+        # A failed check costs its share of the check weight.
+        assert derive_score({"a": False, "b": True}, {}) == 70.0
+        # Collapsed bandwidth degrades the score even with passing checks
+        # (the straggler signal: graded, not binary).
+        slowed = derive_score({"a": True}, {"ring_gbytes_per_s": 4.0})
+        assert 70.0 < slowed < 90.0
+        # Ballooned latency degrades too.
+        late = derive_score({"a": True}, {"probe_latency_s": 300.0})
+        assert late < 100.0
+        # Absent metrics are full credit, not failures.
+        assert derive_score({"a": True}, {}) == 100.0
+        assert derive_score({}, {}) == 100.0
+
+    def test_trend_derivation_and_encoding(self):
+        assert derive_trend([]) == "stable"
+        assert derive_trend([50.0]) == "stable"
+        assert derive_trend([90.0, 88.0, 60.0, 55.0]) == "degrading"
+        assert derive_trend([40.0, 45.0, 80.0, 85.0]) == "improving"
+        assert derive_trend([80.0, 81.0, 80.0, 82.0]) == "stable"
+        assert trend_value("degrading") == -1
+        assert trend_value("stable") == 0
+        assert trend_value("improving") == 1
+        # Degrading sorts first ascending — the planner tiebreak.
+        assert trend_value("degrading") < trend_value("stable")
+
+    def test_history_window_is_bounded(self):
+        history = []
+        for i in range(40):
+            raw = make_node_health_report(
+                "n1", {"a": True}, {"probe_latency_s": float(i)},
+                observed_at=float(i), history=history, history_window=5,
+            )
+            history = telemetry.report_history(raw)
+        assert len(history) == 5
+        assert history[-1]["probe_latency_s"] == 39.0
+
+    def test_parse_tolerates_malformed_reports(self):
+        assert parse_node_health({}) is None
+        mangled = {
+            "metadata": {"name": "n1"},
+            "status": {
+                "score": "not-a-number",
+                "trend": "sideways",
+                "checks": "nope",
+                "metrics": {"ring_gbytes_per_s": "NaNsense", "ok": 3},
+            },
+        }
+        health = parse_node_health(mangled)
+        assert health is not None
+        assert health.score == 100.0
+        assert health.trend == "stable"
+        assert health.checks == {}
+        assert health.metrics == {"ok": 3.0}
+
+    def test_health_report_observation_bridge(self):
+        from k8s_operator_libs_tpu.ops.collectives import CollectiveReport
+        from k8s_operator_libs_tpu.ops.matmul import MxuReport
+
+        report = HealthReport(
+            ok=False,
+            collectives=[
+                CollectiveReport(op="psum", ok=True),
+                CollectiveReport(
+                    op="psum_ring_allreduce", ok=True,
+                    gbytes_per_s=33.0, elapsed_s=0.1,
+                ),
+            ],
+            mxu=MxuReport(ok=True, tflops=120.0),
+            burnin_ok=False,
+            elapsed_s=12.5,
+        )
+        checks, metrics = report.observation()
+        assert checks == {
+            "psum": True, "psum_ring_allreduce": True,
+            "mxu": True, "burnin": False,
+        }
+        assert metrics["ring_gbytes_per_s"] == 33.0
+        assert metrics["probe_latency_s"] == 12.5
+        assert metrics["mxu_tflops"] == 120.0
+        # Derived through the contract: a failed burn-in drags the score.
+        assert derive_score(checks, metrics) < 100.0
+
+
+class TestReportPublisher:
+    def test_create_then_status_update(self):
+        cluster = FakeCluster()
+        pub = ReportPublisher(cluster, "node-1", heartbeat_seconds=0.0)
+        assert pub.publish({"a": True}, {"ring_gbytes_per_s": 40.0})
+        raw = cluster.get("NodeHealthReport", "node-1").raw
+        assert raw["status"]["score"] == 100.0
+        assert pub.publish({"a": False}, {"ring_gbytes_per_s": 2.0})
+        raw = cluster.get("NodeHealthReport", "node-1").raw
+        # The second observation landed (through the status subresource)
+        # and the window carries both.
+        assert raw["status"]["checks"] == {"a": False}
+        assert raw["status"]["score"] < 50.0
+        assert len(raw["status"]["history"]) == 2
+
+    def test_steady_state_is_debounced(self):
+        cluster = FakeCluster()
+        pub = ReportPublisher(
+            cluster, "node-1", heartbeat_seconds=3600.0, min_score_delta=1.0
+        )
+        assert pub.publish({"a": True}, {"ring_gbytes_per_s": 40.0})
+        rv = cluster.get("NodeHealthReport", "node-1").resource_version
+        # Unchanged observation within the heartbeat: no write at all.
+        assert not pub.publish({"a": True}, {"ring_gbytes_per_s": 40.1})
+        assert (
+            cluster.get("NodeHealthReport", "node-1").resource_version == rv
+        )
+        # A check flip always writes.
+        assert pub.publish({"a": False}, {"ring_gbytes_per_s": 40.0})
+
+    def test_alternating_publisher_tiers_still_debounce(self):
+        """The two tiers run DIFFERENT probe sets against one CR: a
+        healthy node alternating full-battery and quick-battery
+        observations must still debounce — the comparison keys on the
+        failing-check set and the score, not probe-set identity."""
+        cluster = FakeCluster()
+        pub = ReportPublisher(cluster, "node-1", heartbeat_seconds=3600.0)
+        assert pub.publish(
+            {"psum": True, "psum_ring_allreduce": True, "burnin": True},
+            {"ring_gbytes_per_s": 45.0},
+        )
+        rv = cluster.get("NodeHealthReport", "node-1").resource_version
+        # The quick tier's disjoint (all-passing) check set: debounced.
+        assert not pub.publish(
+            {"ring_allreduce": True, "mxu": True},
+            {"ring_gbytes_per_s": 44.8},
+        )
+        assert (
+            cluster.get("NodeHealthReport", "node-1").resource_version == rv
+        )
+        # A NEW failure always writes, whichever tier saw it.
+        assert pub.publish(
+            {"ring_allreduce": False, "mxu": True},
+            {"ring_gbytes_per_s": 2.0},
+        )
+
+    def test_heartbeat_forces_a_write(self):
+        clock = {"t": 1000.0}
+        cluster = FakeCluster()
+        pub = ReportPublisher(
+            cluster, "node-1", heartbeat_seconds=60.0,
+            now=lambda: clock["t"],
+        )
+        assert pub.publish({"a": True}, {})
+        assert not pub.publish({"a": True}, {})
+        clock["t"] += 61.0
+        # Staleness bound: unchanged values still refresh observedAt
+        # once per heartbeat.
+        assert pub.publish({"a": True}, {})
+        raw = cluster.get("NodeHealthReport", "node-1").raw
+        assert raw["status"]["observedAt"] == 1061.0
+
+    def test_conflict_retries(self):
+        from k8s_operator_libs_tpu.kube.client import ConflictError
+
+        cluster = FakeCluster()
+        pub = ReportPublisher(cluster, "node-1", heartbeat_seconds=0.0)
+        pub.publish({"a": True}, {})
+        remaining = {"conflicts": 2}
+
+        def conflict_twice(verb, kind, payload):
+            if remaining["conflicts"] > 0:
+                remaining["conflicts"] -= 1
+                raise ConflictError("simulated concurrent publisher")
+
+        cluster.add_reactor("update_status", "NodeHealthReport",
+                            conflict_twice)
+        assert pub.publish({"a": False}, {})
+        assert remaining["conflicts"] == 0
+
+    def test_quick_battery_publish_cycle(self):
+        from k8s_operator_libs_tpu.ops.probe_harness import (
+            QuickBatteryReport,
+            run_quick_probe_cycle,
+        )
+
+        cluster = FakeCluster()
+        pub = ReportPublisher(
+            cluster, "node-1", source="quick-probe", heartbeat_seconds=0.0
+        )
+        battery = lambda: QuickBatteryReport(  # noqa: E731 - tiny stub
+            ok=True,
+            checks={"ring_allreduce": True},
+            metrics={"ring_gbytes_per_s": 12.0, "probe_latency_s": 0.4},
+            elapsed_s=0.4,
+        )
+        report = run_quick_probe_cycle(pub, battery=battery)
+        assert report.ok
+        raw = cluster.get("NodeHealthReport", "node-1").raw
+        assert raw["spec"]["source"] == "quick-probe"
+        assert raw["status"]["metrics"]["probe_latency_s"] == 0.4
+
+    def test_quick_battery_runs_on_host_devices(self):
+        """The real quick battery on whatever JAX backend the test env
+        has (single CPU device): verdicts present, latency measured,
+        sub-battery failures impossible to raise out."""
+        from k8s_operator_libs_tpu.ops.probe_harness import quick_battery
+
+        report = quick_battery(payload_mb=0.05, matmul_size=64)
+        assert report.checks.get("ring_allreduce") is True
+        assert report.checks.get("mxu") is True
+        assert report.metrics["probe_latency_s"] > 0
+        assert report.ok
+
+
+class TestHealthSource:
+    def test_snapshot_tracks_events_and_memoizes(self):
+        cluster = FakeCluster()
+        pub = ReportPublisher(cluster, "node-1", heartbeat_seconds=0.0)
+        pub.publish({"a": True}, {})
+        source = HealthSource(cluster)
+        try:
+            source.start()
+            assert wait_until(lambda: "node-1" in source.snapshot())
+            first = source.snapshot()
+            # Memoized: no event, same object.
+            assert source.snapshot() is first
+            pub.publish({"a": False}, {})
+            assert wait_until(
+                lambda: source.snapshot().get("node-1") is not None
+                and not source.snapshot()["node-1"].checks["a"]
+            )
+            assert source.snapshot() is not first
+            cluster.delete("NodeHealthReport", "node-1")
+            assert wait_until(lambda: "node-1" not in source.snapshot())
+        finally:
+            source.stop()
+
+    def test_build_state_attaches_health(self):
+        cluster, sim, mgr = make_harness()
+        ReportPublisher(cluster, "node-2", heartbeat_seconds=0.0).publish(
+            {"a": False}, {"ring_gbytes_per_s": 1.0}
+        )
+        source = mgr.with_health_telemetry()
+        try:
+            state = mgr.build_state(NS, LABELS)
+            assert state.node_health is not None
+            assert state.health_of("node-2").score < 50.0
+            assert state.health_of("node-0") is None
+        finally:
+            source.stop()
+
+    def test_no_telemetry_pool_has_no_health(self):
+        _, _, mgr = make_harness(nodes=2)
+        state = mgr.build_state(NS, LABELS)
+        assert state.node_health is None
+        assert state.health_of("node-0") is None
+
+
+class TestDegradedFirstPlanning:
+    def _mini_pool(self):
+        from k8s_operator_libs_tpu.parallel.topology import (
+            GKE_NODEPOOL_LABEL,
+            GKE_TPU_ACCELERATOR_LABEL,
+            GKE_TPU_TOPOLOGY_LABEL,
+        )
+
+        cluster = FakeCluster()
+        for pool in ("pool-a", "pool-b", "pool-c"):
+            for i in range(2):
+                cluster.create(make_node(
+                    f"{pool}-{i}",
+                    labels={
+                        GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+                        GKE_TPU_TOPOLOGY_LABEL: "2x2",
+                        GKE_NODEPOOL_LABEL: pool,
+                    },
+                ))
+        sim = DaemonSetSimulator(
+            cluster, name="driver", namespace=NS, match_labels=LABELS
+        )
+        sim.settle()
+        return cluster, sim
+
+    def test_worst_score_slice_rolls_first(self):
+        from k8s_operator_libs_tpu.tpu import enable_slice_aware_planning
+
+        cluster, sim = self._mini_pool()
+        # pool-c is the straggler (worst), pool-b mildly degraded.
+        ReportPublisher(cluster, "pool-c-0", heartbeat_seconds=0.0).publish(
+            {"ring_allreduce": False}, {"ring_gbytes_per_s": 1.0}
+        )
+        ReportPublisher(cluster, "pool-b-1", heartbeat_seconds=0.0).publish(
+            {"ring_allreduce": True}, {"ring_gbytes_per_s": 20.0}
+        )
+        mgr = ClusterUpgradeStateManager(
+            cluster, DeviceClass.tpu(), runner=TaskRunner(inline=True)
+        )
+        enable_slice_aware_planning(mgr)
+        source = mgr.with_health_telemetry()
+        try:
+            sim.set_template_hash("rev-2")
+            policy = DriverUpgradePolicySpec(
+                auto_upgrade=True, max_parallel_upgrades=1,
+                max_unavailable=IntOrString(1),
+            )
+            mgr.apply_state(mgr.build_state(NS, LABELS), policy)
+            mgr.apply_state(mgr.build_state(NS, LABELS), policy)
+            states = {
+                n.name: n.labels.get(KEYS.state_label, "")
+                for n in cluster.list("Node")
+            }
+            # The whole straggler slice started; everyone else waits.
+            assert states["pool-c-0"] == "cordon-required"
+            assert states["pool-c-1"] == "cordon-required"
+            assert states["pool-a-0"] == "upgrade-required"
+            assert states["pool-b-0"] == "upgrade-required"
+        finally:
+            source.stop()
+
+    def test_ordering_key_score_then_trend_then_name(self):
+        from k8s_operator_libs_tpu.tpu.planner import SliceAssessment
+
+        assessment = SliceAssessment(
+            candidates={"a": [], "b": [], "c": [], "d": [], "e": []},
+            disrupted={"d"},
+            wounded={"e"},
+            scores={"b": 40.0, "c": 40.0, "a": 90.0},
+            trends={"b": 0, "c": -1},
+        )
+        order = [slice_id for slice_id, _ in assessment.ordered_candidates()]
+        # disrupted first; wounded reads score 0; then 40-degrading,
+        # 40-stable, 90, and the unreported slice last by name tie.
+        assert order == ["d", "e", "c", "b", "a"]
+
+    def test_assess_slices_aggregates_worst_member(self):
+        """Per-slice aggregation takes the WORST member on both axes —
+        including an all-improving slice recording trend 1, not the
+        write-default (the review-found store/read default mismatch)."""
+        from k8s_operator_libs_tpu.tpu import (
+            TpuNodeDetector,
+            enable_slice_aware_planning,  # noqa: F401 - import check only
+        )
+        from k8s_operator_libs_tpu.tpu.planner import assess_slices
+        from k8s_operator_libs_tpu.upgrade import (
+            ClusterUpgradeState,
+            NodeUpgradeState,
+            UpgradeState,
+        )
+        from k8s_operator_libs_tpu.api import NodeHealth
+        from k8s_operator_libs_tpu.kube import Pod
+
+        state = ClusterUpgradeState()
+        for name in ("pool-a-0", "pool-a-1", "pool-b-0"):
+            node = make_node(name)
+            pod = Pod.new(f"driver-{name}", namespace=NS)
+            state.node_states[UpgradeState.DONE].append(NodeUpgradeState(
+                node=node, driver_pod=pod, driver_daemonset=None,
+            ))
+        state.node_health = {
+            "pool-a-0": NodeHealth("pool-a-0", score=90.0,
+                                   trend="improving"),
+            "pool-a-1": NodeHealth("pool-a-1", score=30.0,
+                                   trend="degrading"),
+            "pool-b-0": NodeHealth("pool-b-0", score=80.0,
+                                   trend="improving"),
+        }
+        out = assess_slices(TpuNodeDetector(), state)
+        # Non-TPU nodes form singleton slices named after the node.
+        assert out.scores["pool-a-0"] == 90.0
+        assert out.scores["pool-a-1"] == 30.0
+        # An all-improving member records improving (1), not stable (0).
+        assert out.trends["pool-b-0"] == 1
+        assert out.trends["pool-a-1"] == -1
+
+    def test_monitor_condition_still_outranks_telemetry(self):
+        from k8s_operator_libs_tpu.tpu.planner import SliceAssessment
+
+        assessment = SliceAssessment(
+            candidates={"flagged": [], "straggler": []},
+            wounded={"flagged"},
+            scores={"straggler": 15.0, "flagged": 80.0},
+        )
+        order = [s for s, _ in assessment.ordered_candidates()]
+        assert order == ["flagged", "straggler"]
+
+
+class TestHealthMetricsEndpoint:
+    def test_family_served_with_histogram(self):
+        cluster = FakeCluster()
+        for name, latency in (("node-0", 0.3), ("node-1", 45.0)):
+            ReportPublisher(cluster, name, heartbeat_seconds=0.0).publish(
+                {"a": name == "node-0"}, {"probe_latency_s": latency}
+            )
+        source = HealthSource(cluster)
+        metrics = HealthMetrics(
+            source, quarantine_totals=lambda: {
+                "in_quarantine": 1, "entered": 2, "released": 1,
+                "handed_off": 0, "budget_denied": 3,
+            },
+        )
+        try:
+            source.start()
+            assert wait_until(lambda: len(source.snapshot()) == 2)
+            with MetricsServer(metrics) as server:
+                body = urllib.request.urlopen(
+                    server.url, timeout=5
+                ).read().decode()
+        finally:
+            source.stop()
+        assert 'tpu_operator_health_score{node="node-0"} 100.0' in body
+        assert 'tpu_operator_health_trend{node="node-1"} 0' in body
+        assert "tpu_operator_health_reported_nodes 2" in body
+        # A valid histogram: TYPE line, cumulative buckets, +Inf == count.
+        assert (
+            "# TYPE tpu_operator_health_probe_latency_seconds histogram"
+            in body
+        )
+        assert (
+            'tpu_operator_health_probe_latency_seconds_bucket{le="0.5"} 1'
+            in body
+        )
+        assert (
+            'tpu_operator_health_probe_latency_seconds_bucket{le="+Inf"} 2'
+            in body
+        )
+        assert "tpu_operator_health_probe_latency_seconds_count 2" in body
+        assert "tpu_operator_health_quarantined_nodes 1" in body
+        assert "tpu_operator_health_quarantine_entries_total 2" in body
+        assert "tpu_operator_health_quarantine_budget_denials_total 3" in body
+
+
+class TestMonitorPublishes:
+    def test_monitor_cycle_publishes_report(self):
+        from k8s_operator_libs_tpu.tpu.monitor import TpuHealthMonitor
+
+        class StubGate:
+            def run(self):
+                return HealthReport(ok=True, elapsed_s=2.0)
+
+        cluster = FakeCluster()
+        cluster.create(make_node("tpu-node"))
+        monitor = TpuHealthMonitor(
+            cluster, "tpu-node", gate=StubGate(), failure_threshold=1,
+            report_publisher=ReportPublisher(
+                cluster, "tpu-node", heartbeat_seconds=0.0
+            ),
+        )
+        report = monitor.check_once()
+        assert report is not None and report.ok
+        raw = cluster.get("NodeHealthReport", "tpu-node").raw
+        assert raw["spec"]["nodeName"] == "tpu-node"
+        assert raw["status"]["metrics"]["probe_latency_s"] == 2.0
+        # A skipped cycle publishes nothing new.
+        cluster.patch(
+            "Node", "tpu-node",
+            patch={"metadata": {"labels": {KEYS.skip_label: "true"}}},
+        )
+        rv = cluster.get("NodeHealthReport", "tpu-node").resource_version
+        assert monitor.check_once() is None
+        assert (
+            cluster.get("NodeHealthReport", "tpu-node").resource_version
+            == rv
+        )

@@ -9,7 +9,7 @@ operators:
 * ``lock_discipline`` (LCK1xx) — shared state guarded by a
   ``threading.Lock`` must be guarded everywhere, and nothing blocking may
   run while a lock is held.
-* ``state_machine`` (STM2xx) — the 14-state upgrade machine must stay
+* ``state_machine`` (STM2xx) — the 15-state upgrade machine must stay
   exhaustive: every ``UpgradeState`` partitioned into
   MANAGED/MAINTENANCE, every state handled by ``apply_state``, no state
   value spelled as a string literal outside ``consts.py``.
