@@ -514,7 +514,16 @@ def run_http_wire_roll() -> dict:
     CONTROL-PLANE cost of a roll when every get/list/patch pays
     serialization + a socket round trip, the part the in-process fake
     hides. (A kind/real-apiserver variant of this number is what the
-    conformance battery unlocks; see README.)"""
+    conformance battery unlocks; see README.)
+
+    Since the asyncio wire rebuild (docs/wire-path.md) the section also
+    publishes the ATTRIBUTION for its speedup — connections opened on
+    each side, requests and bytes per pass — and hard-asserts the
+    mechanism: the whole roll must ride a handful of pooled keep-alive
+    connections (reuse ratio >= 20 requests/connection), not one TCP
+    setup per request. The absolute floor lives in the CI bench-smoke
+    gate (tools/bench_smoke_baseline.json: http_wire_roll.passes_per_s).
+    """
     from k8s_operator_libs_tpu.kube import LocalApiServer, RestClient, RestConfig
 
     with LocalApiServer() as srv:
@@ -535,13 +544,116 @@ def run_http_wire_roll() -> dict:
         start = time.perf_counter()
         passes = drive_to_convergence(srv.cluster, sim, mgr, policy)
         elapsed = time.perf_counter() - start
+        stats = client.transport_stats()
+        server_connections = srv.connections_opened
+        requests = stats["requests_sent"]
+        bytes_total = stats["bytes_sent"] + stats["bytes_received"]
+        client.close()
+    if requests < 20 * server_connections:
+        raise RuntimeError(
+            f"http_wire_roll: connection reuse collapsed — {requests} "
+            f"requests over {server_connections} connections (the "
+            "keep-alive pool is the speedup; its loss is a regression)"
+        )
     return {
         "wall_s": round(elapsed, 3),
         "passes": passes,
+        "passes_per_s": round(passes / elapsed, 1),
         "nodes": HOSTS,
-        "transport": "http (LocalApiServer)",
+        "transport": "http (LocalApiServer, asyncio wire path)",
         "gate": "disabled (control-plane isolation)",
         "shape": "reference-equivalent (no slice planner)",
+        "attribution": {
+            "server_connections_opened": server_connections,
+            "client_connections_opened": stats["connections_opened"],
+            "requests": requests,
+            "requests_per_pass": round(requests / max(1, passes), 1),
+            "reuse_ratio_requests_per_connection": round(
+                requests / max(1, server_connections), 1
+            ),
+            "bytes_per_pass": round(bytes_total / max(1, passes)),
+            "watch_frames_received": stats["watch_frames_received"],
+            "encoding": "json (loopback: CPU-bound, not byte-bound; "
+                        "see wire_encoding section)",
+        },
+    }
+
+
+def run_wire_encoding(nodes: int = 256) -> dict:
+    """JSON vs compact wire encoding on the payload that dominates the
+    informer-seed read path: a NodeList at fleet-ish scale. Reports
+    bytes per list both ways (the compact key-table's whole point:
+    Kubernetes lists repeat every key per item), codec round-trip cost,
+    and the same comparison measured OVER THE WIRE (two clients, one
+    negotiating compact, listing the same cluster). Hard-asserts the
+    codec round-trips exactly and actually compresses (< 0.7x)."""
+    import json as json_mod
+
+    from k8s_operator_libs_tpu.kube import LocalApiServer, RestClient, RestConfig
+    from k8s_operator_libs_tpu.kube.wire import decode_compact, encode_compact
+
+    cluster, _ = build_pool(slices=nodes // 4, hosts_per_slice=4)
+    doc = {
+        "apiVersion": "v1",
+        "kind": "NodeList",
+        "metadata": {"resourceVersion": "1"},
+        "items": [o.raw for o in cluster.list("Node")],
+    }
+    json_payload = json_mod.dumps(doc).encode()
+    compact_payload = encode_compact(doc)
+    if decode_compact(compact_payload) != doc:
+        raise RuntimeError("wire_encoding: compact round-trip diverged")
+    ratio = len(compact_payload) / len(json_payload)
+    if ratio >= 0.7:
+        raise RuntimeError(
+            f"wire_encoding: compact/json byte ratio {ratio:.2f} >= 0.7 "
+            "— the key-table compression regressed"
+        )
+
+    def _time(fn, reps: int = 10) -> float:
+        start = time.perf_counter()
+        for _ in range(reps):
+            fn()
+        return (time.perf_counter() - start) / reps * 1000
+
+    timings = {
+        "json_encode_ms": round(_time(lambda: json_mod.dumps(doc)), 2),
+        "compact_encode_ms": round(_time(lambda: encode_compact(doc)), 2),
+        "json_decode_ms": round(
+            _time(lambda: json_mod.loads(json_payload)), 2
+        ),
+        "compact_decode_ms": round(
+            _time(lambda: decode_compact(compact_payload)), 2
+        ),
+    }
+
+    # The same comparison over the wire: bytes actually received for one
+    # LIST, JSON client vs compact-negotiating client, same cluster.
+    with LocalApiServer(cluster=cluster) as srv:
+        wire = {}
+        for encoding in ("json", "compact"):
+            client = RestClient(
+                RestConfig(server=srv.url, wire_encoding=encoding,
+                           list_page_size=0)
+            )
+            items = client.list("Node")
+            wire[encoding] = client.transport_stats()["bytes_received"]
+            client.close()
+            if len(items) != nodes:
+                raise RuntimeError(
+                    f"wire_encoding: {encoding} list returned "
+                    f"{len(items)}/{nodes} nodes"
+                )
+    return {
+        "nodes": nodes,
+        "json_bytes_per_list": len(json_payload),
+        "compact_bytes_per_list": len(compact_payload),
+        "compact_vs_json_bytes_ratio": round(ratio, 3),
+        "wire_json_bytes_per_list": wire["json"],
+        "wire_compact_bytes_per_list": wire["compact"],
+        **timings,
+        "note": "compact trades pure-Python codec CPU for ~0.4x bytes; "
+                "negotiated opt-in (JSON stays the protocol default)",
     }
 
 
@@ -1483,6 +1595,8 @@ SECTIONS = {
     "live_workload_roll": run_live_workload_roll,
     "degraded_first_roll": run_degraded_first_roll,
     "ring_bandwidth": run_ring_bandwidth,
+    "http_wire_roll": run_http_wire_roll,
+    "wire_encoding": run_wire_encoding,
 }
 
 

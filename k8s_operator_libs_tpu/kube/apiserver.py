@@ -9,6 +9,30 @@ merge-patch, CRD establishment) exposed with Kubernetes REST conventions so
 wire path: URLs, verbs, selectors as query params, Status errors, the
 eviction subresource, and bearer-token auth.
 
+Since the asyncio rebuild (docs/wire-path.md) the server is a
+single-event-loop HTTP/1.1 server rather than a thread-per-connection
+``ThreadingHTTPServer``:
+
+* **keep-alive + pipelining** — connections persist across requests
+  (HTTP/1.1 default) and a client may write several requests before
+  reading the first response; the per-connection loop answers them in
+  order off the already-buffered bytes, so a pipelined informer seed
+  pays one round trip for N LISTs;
+* **streamed watch frames** — a watch is a chunked-transfer response on
+  the SAME held connection (no ``Connection: close``): events stream as
+  frames, periodic BOOKMARK frames carry the store rv, and the window's
+  end is the terminal chunk — the connection goes back to keep-alive and
+  the next watch window reuses it, no TCP/TLS re-setup per window;
+* **content negotiation** — object and watch-frame payloads are encoded
+  per the request's ``Accept`` header (``kube/wire.py``): JSON by
+  default, the compact binary encoding when the caller asks (the
+  protobuf posture of a real apiserver), and the ``;as=Table`` transform
+  for ``kubectl get`` — including ``kubectl get -w``: a Table-negotiated
+  watch streams Table-encoded event frames;
+* **TCP_NODELAY** — asyncio sets it on every accepted socket, which is
+  worth ~40ms per request/response turn over the old stack's
+  Nagle/delayed-ACK interaction on loopback.
+
 Also a deployment artifact, not only a fixture: ``python -m
 k8s_operator_libs_tpu.kube.apiserver --port 8001`` serves a scratch cluster
 for demos of the apply-crds CLI and the upgrade controller.
@@ -16,19 +40,25 @@ for demos of the apply-crds CLI and the upgrade controller.
 
 from __future__ import annotations
 
-import json
+import asyncio
 import re
 import ssl
 import threading
 import urllib.parse
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 
 from .client import ApiError, BadRequestError
-from .fake import FakeCluster
+from .fake import FakeCluster, WatchFrameSource
 from .objects import wrap
 from .resources import ResourceInfo, resource_for_plural
 from .table import accepts_table, render_table
+from .wire import (
+    content_type_for,
+    decode_body,
+    encode_body,
+    encode_watch_frame,
+    negotiate_encoding,
+)
 
 _PATH_RE = re.compile(
     r"^/(?:api|apis)(?:/(?P<group>[^/]+(?:\.[^/]+)*))?/(?P<version>v[^/]+)"
@@ -47,6 +77,21 @@ _DISCOVERY_RE = re.compile(
     r"|/apis/(?P<group>[^/]+)/(?P<version>v[^/]+))$"
 )
 
+_REASONS = {
+    200: "OK", 201: "Created", 400: "Bad Request", 401: "Unauthorized",
+    404: "Not Found", 405: "Method Not Allowed", 409: "Conflict",
+    410: "Gone", 415: "Unsupported Media Type", 422: "Unprocessable Entity",
+    500: "Internal Server Error",
+}
+
+#: Upper bound on queued-but-undelivered events per watch stream; a
+#: consumer this far behind loses the watch (stream ended cleanly) and
+#: resumes from its last delivered revision — the journal replays what
+#: the queue dropped. Same bound the threaded server used.
+_WATCH_QUEUE_LIMIT = 1024
+
+_MAX_HEADER_BYTES = 65536
+
 
 def _status_body(code: int, reason: str, message: str) -> dict[str, Any]:
     return {
@@ -59,36 +104,110 @@ def _status_body(code: int, reason: str, message: str) -> dict[str, Any]:
     }
 
 
-class _Handler(BaseHTTPRequestHandler):
-    protocol_version = "HTTP/1.1"
-    server: "LocalApiServer"
+def _ok_status() -> dict[str, Any]:
+    return {
+        "kind": "Status",
+        "apiVersion": "v1",
+        "status": "Success",
+        "code": 200,
+    }
+
+
+class _Request:
+    """One parsed HTTP request (the transport-neutral shape the
+    dispatcher consumes)."""
+
+    __slots__ = ("method", "target", "path", "query", "headers", "body",
+                 "keep_alive")
+
+    def __init__(self, method, target, headers, body, keep_alive):
+        self.method = method
+        self.target = target
+        parsed = urllib.parse.urlparse(target)
+        self.path = parsed.path
+        self.query = dict(urllib.parse.parse_qsl(parsed.query))
+        self.headers = headers  # lower-cased keys
+        self.body = body
+        self.keep_alive = keep_alive
+
+    def header(self, name: str, default: str = "") -> str:
+        return self.headers.get(name.lower(), default)
+
+
+class _Response:
+    """A buffered (non-streaming) response: ``body`` is the JSON-model
+    payload, encoded per negotiation at write time."""
+
+    __slots__ = ("status", "body")
+
+    def __init__(self, status: int, body: Optional[dict[str, Any]]):
+        self.status = status
+        self.body = body
+
+
+class _WatchParams:
+    """Marker result: the dispatcher routed a ``?watch=true`` GET; the
+    connection handler streams it."""
+
+    __slots__ = ("info", "namespace", "query")
+
+    def __init__(self, info, namespace, query):
+        self.info = info
+        self.namespace = namespace
+        self.query = query
+
+
+class _Dispatcher:
+    """The verb logic, transport-free: ``(method, path, query, headers,
+    body) -> _Response | _WatchParams``. Exactly the semantics the
+    threaded handler had; errors surface as ApiError and are rendered
+    into Status bodies by the caller."""
+
+    def __init__(self, server: "LocalApiServer") -> None:
+        self.server = server
+
+    def dispatch(self, req: _Request) -> "_Response | _WatchParams":
+        if not self._authorized(req):
+            return _Response(
+                401, _status_body(401, "Unauthorized", "invalid bearer token")
+            )
+        if req.method == "GET":
+            discovery = _DISCOVERY_RE.match(req.path)
+            if discovery is not None:
+                core = discovery.group("core_version")
+                return self._do_discovery(
+                    "" if core else discovery.group("group"),
+                    core or discovery.group("version"),
+                )
+        route = self._route(req)
+        if route is None:
+            return _Response(
+                404, _status_body(404, "NotFound", f"no route for {req.path}")
+            )
+        info, namespace, name, subresource, query = route
+        if req.method == "GET" and not name and query.get("watch") in (
+            "true", "1"
+        ):
+            return _WatchParams(info, namespace, query)
+        handler = getattr(self, f"_do_{req.method.lower()}", None)
+        if handler is None:
+            return _Response(
+                405,
+                _status_body(
+                    405, "MethodNotAllowed", f"method {req.method} not allowed"
+                ),
+            )
+        return handler(req, info, namespace, name, subresource, query)
 
     # -- helpers -----------------------------------------------------------
-    def _send_json(self, code: int, body: dict[str, Any]) -> None:
-        payload = json.dumps(body).encode()
-        self.send_response(code)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(payload)))
-        self.end_headers()
-        self.wfile.write(payload)
-
-    def _send_error(self, e: ApiError) -> None:
-        self._send_json(e.status, _status_body(e.status, e.reason, e.message))
-
-    def _read_body(self) -> dict[str, Any]:
-        if not self._body:
-            return {}
-        return json.loads(self._body)
-
-    def _authorized(self) -> bool:
+    def _authorized(self, req: _Request) -> bool:
         token = self.server.token
         if not token:
             return True
-        return self.headers.get("Authorization") == f"Bearer {token}"
+        return req.header("Authorization") == f"Bearer {token}"
 
-    def _route(self):
-        parsed = urllib.parse.urlparse(self.path)
-        m = _PATH_RE.match(parsed.path)
+    def _route(self, req: _Request):
+        m = _PATH_RE.match(req.path)
         if m is None:
             return None
         group = m.group("group") or ""
@@ -117,65 +236,55 @@ class _Handler(BaseHTTPRequestHandler):
             info = ResourceInfo(
                 info.kind, requested_gv, info.plural, info.namespaced
             )
-        query = dict(urllib.parse.parse_qsl(parsed.query))
         return (
             info,
             m.group("namespace") or "",
             m.group("name") or "",
             m.group("subresource") or "",
-            query,
+            req.query,
         )
 
-    def _handle(self, verb: str) -> None:
-        # Drain the body FIRST, fresh for every request: the handler
-        # instance is reused across keep-alive requests, and replying with
-        # unread body bytes on the socket corrupts the next request.
-        length = int(self.headers.get("Content-Length") or 0)
-        self._body = self.rfile.read(length) if length else b""
-        if not self._authorized():
-            self._send_json(
-                401, _status_body(401, "Unauthorized", "invalid bearer token")
-            )
-            return
-        if verb == "GET":
-            parsed = urllib.parse.urlparse(self.path)
-            discovery = _DISCOVERY_RE.match(parsed.path)
-            if discovery is not None:
-                core = discovery.group("core_version")
-                self._do_discovery(
-                    "" if core else discovery.group("group"),
-                    core or discovery.group("version"),
-                )
-                return
-        route = self._route()
-        if route is None:
-            self._send_json(
-                404, _status_body(404, "NotFound", f"no route for {self.path}")
-            )
-            return
-        info, namespace, name, subresource, query = route
-        cluster = self.server.cluster
-        try:
-            getattr(self, f"_do_{verb.lower()}")(
-                cluster, info, namespace, name, subresource, query
-            )
-        except ApiError as e:
-            self._send_error(e)
-        except Exception as e:  # noqa: BLE001 - surfaced as 500 Status
-            self._send_json(500, _status_body(500, "InternalError", str(e)))
+    def _read_body(self, req: _Request) -> dict[str, Any]:
+        """Decode a write body by its Content-Type: JSON (the default)
+        or the negotiated compact encoding — a compact-speaking client
+        sends its create/update payloads compact too."""
+        if not req.body:
+            return {}
+        return decode_body(req.body, req.header("Content-Type"))
 
-    def _do_discovery(self, group: str, version: str) -> None:
+    @staticmethod
+    def _dry_run(query) -> bool:
+        value = query.get("dryRun", "")
+        if value and value != "All":
+            # Real-apiserver validation: All is the only accepted value.
+            raise BadRequestError(f"invalid dryRun value {value!r}")
+        return bool(value)
+
+    @staticmethod
+    def _table(cluster, info, raws, query, list_metadata=None):
+        include_object = query.get("includeObject", "") or "Metadata"
+        if include_object not in ("Metadata", "Object", "None"):
+            raise BadRequestError(
+                f"invalid includeObject value {include_object!r}"
+            )
+        return render_table(
+            raws,
+            crd_columns=cluster.printer_columns(
+                info.kind, info.api_version
+            ),
+            include_object=include_object,
+            list_metadata=list_metadata,
+        )
+
+    # -- verbs -------------------------------------------------------------
+    def _do_discovery(self, group: str, version: str) -> _Response:
         """Serve the APIResourceList discovery document (what the real
         apiserver returns for /apis/<group>/<version>); 404 while the
         group/version is not yet servable — the Established-but-
         undiscoverable window crdutil polls through."""
-        try:
-            resources = self.server.cluster.discover(group, version)
-        except ApiError as e:
-            self._send_error(e)
-            return
+        resources = self.server.cluster.discover(group, version)
         gv = f"{group}/{version}" if group else version
-        self._send_json(
+        return _Response(
             200,
             {
                 "kind": "APIResourceList",
@@ -185,24 +294,22 @@ class _Handler(BaseHTTPRequestHandler):
             },
         )
 
-    # -- verbs -------------------------------------------------------------
-    def _do_get(self, cluster, info, namespace, name, subresource, query):
-        if not name and query.get("watch") in ("true", "1"):
-            self._do_watch(cluster, info, namespace, query)
-            return
-        as_table = accepts_table(self.headers.get("Accept", ""))
+    def _do_get(self, req, info, namespace, name, subresource, query):
+        cluster = self.server.cluster
+        as_table = accepts_table(req.header("Accept"))
         if name:
             obj = cluster.get(info.kind, name, namespace)
             if as_table:
-                self._send_json(200, self._table(cluster, info, [obj.raw],
-                                                 query))
-                return
-            self._send_json(200, obj.raw)
-            return
+                return _Response(
+                    200, self._table(cluster, info, [obj.raw], query)
+                )
+            return _Response(200, obj.raw)
         try:
             limit = int(query.get("limit", "0") or "0")
         except ValueError:
-            raise BadRequestError(f"invalid limit {query.get('limit')!r}")
+            raise BadRequestError(
+                f"invalid limit {query.get('limit')!r}"
+            ) from None
         items, revision, next_continue, remaining = cluster.list_page(
             info.kind,
             namespace=namespace,
@@ -221,12 +328,11 @@ class _Handler(BaseHTTPRequestHandler):
         if remaining is not None:
             metadata["remainingItemCount"] = remaining
         if as_table:
-            self._send_json(200, self._table(
+            return _Response(200, self._table(
                 cluster, info, [o.raw for o in items], query,
                 list_metadata=metadata,
             ))
-            return
-        self._send_json(
+        return _Response(
             200,
             {
                 "apiVersion": info.api_version,
@@ -236,175 +342,9 @@ class _Handler(BaseHTTPRequestHandler):
             },
         )
 
-    @staticmethod
-    def _table(cluster, info, raws, query, list_metadata=None):
-        include_object = query.get("includeObject", "") or "Metadata"
-        if include_object not in ("Metadata", "Object", "None"):
-            raise BadRequestError(
-                f"invalid includeObject value {include_object!r}"
-            )
-        return render_table(
-            raws,
-            crd_columns=cluster.printer_columns(
-                info.kind, info.api_version
-            ),
-            include_object=include_object,
-            list_metadata=list_metadata,
-        )
-
-    @staticmethod
-    def _bookmark_object(info, resource_version: str) -> dict:
-        """The real server's bookmark payload: an object of the watched
-        kind carrying ONLY metadata.resourceVersion."""
-        return {
-            "kind": info.kind,
-            "apiVersion": info.api_version,
-            "metadata": {"resourceVersion": resource_version},
-        }
-
-    def _do_watch(self, cluster, info, namespace, query):
-        """``?watch=true``: stream newline-delimited watch events.
-
-        Kubernetes watch semantics in the shape the library consumes:
-
-        * ``resourceVersion=N`` resumes from the event journal — the
-          list-then-watch pattern with no lost-event window (events since
-          the listed revision replay first; an expired revision returns
-          410 Gone and the client must re-list);
-        * without ``resourceVersion``, events after establishment stream;
-        * scope transitions follow the real apiserver: an object whose
-          update makes it START matching the selector arrives as ADDED,
-          one that STOPS matching arrives as DELETED;
-        * a consumer too slow to drain its event queue loses the watch
-          (stream closed) rather than silently losing events;
-        * ``timeoutSeconds`` bounds the stream server-side;
-        * ``allowWatchBookmarks=true`` opts into periodic BOOKMARK events
-          carrying only the current collection resourceVersion, so a
-          quiet (e.g. selector-scoped) watch keeps a fresh resume point
-          and resumption does not decay into 410 + full re-list.
-
-        Events are ``{"type": ADDED|MODIFIED|DELETED, "object": {...}}``
-        JSON lines; the stream is EOF-delimited (``Connection: close``).
-        """
-        import queue
-        import time
-
-        from .fake import classify_watch_event
-        from .selectors import parse_field_selector, parse_selector
-
-        selector = parse_selector(query.get("labelSelector") or None)
-        fields = parse_field_selector(query.get("fieldSelector") or None)
-        timeout_s = (
-            float(query["timeoutSeconds"])
-            if query.get("timeoutSeconds")
-            else None
-        )
-        kind = info.kind
-        events: queue.Queue = queue.Queue(maxsize=1024)
-        overflowed = threading.Event()
-
-        def scoped_event(event_type: str, data: dict, old):
-            return classify_watch_event(event_type, data, old, selector, fields)
-
-        def on_event(event_type: str, data: dict, old) -> None:
-            # Cheap static filters only; scope classification happens on
-            # the handler thread.
-            if data.get("kind") != kind:
-                return
-            meta = data.get("metadata") or {}
-            if namespace and meta.get("namespace", "") != namespace:
-                return
-            try:
-                events.put_nowait((event_type, data, old))
-            except queue.Full:
-                overflowed.set()  # close the watch; the client re-lists
-
-        try:
-            replay = cluster.subscribe_since(
-                on_event, query.get("resourceVersion")
-            )
-        except ApiError as e:
-            self._send_error(e)
-            return
-        try:
-            self.send_response(200)
-            self.send_header("Content-Type", "application/json")
-            # EOF-delimited stream: the client reads lines until close.
-            self.send_header("Connection", "close")
-            self.end_headers()
-            for event_type, data, old in replay:
-                if data.get("kind") != kind:
-                    continue
-                meta = data.get("metadata") or {}
-                if namespace and meta.get("namespace", "") != namespace:
-                    continue
-                mapped = scoped_event(event_type, data, old)
-                if mapped is None:
-                    continue
-                if not self._write_event(mapped, data):
-                    return
-            deadline = (
-                time.monotonic() + timeout_s if timeout_s is not None else None
-            )
-            bookmarks = query.get("allowWatchBookmarks") in ("true", "1")
-            interval = self.server.bookmark_interval_s
-            next_bookmark = time.monotonic() + interval
-            while not overflowed.is_set():
-                if deadline is not None:
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
-                        break
-                    poll = min(0.2, remaining)
-                else:
-                    poll = 0.2
-                if bookmarks:
-                    poll = min(poll, max(0.01, next_bookmark - time.monotonic()))
-                try:
-                    event_type, data, old = events.get(timeout=poll)
-                except queue.Empty:
-                    # Bookmark only from a DRAINED queue — "every event up
-                    # to this rv has been delivered". rv read before the
-                    # emptiness re-check: the cluster's _emit bumps rv and
-                    # notifies watchers under one lock hold, so an rv
-                    # observed here implies its event is already enqueued.
-                    if bookmarks and time.monotonic() >= next_bookmark:
-                        rv = cluster.current_resource_version()
-                        if events.empty():
-                            next_bookmark = time.monotonic() + interval
-                            if not self._write_event(
-                                "BOOKMARK",
-                                self._bookmark_object(info, rv),
-                            ):
-                                break
-                    continue
-                mapped = scoped_event(event_type, data, old)
-                if mapped is None:
-                    continue
-                if not self._write_event(mapped, data):
-                    break
-        finally:
-            cluster.unsubscribe(on_event)
-            self.close_connection = True
-
-    def _write_event(self, event_type: str, data: dict) -> bool:
-        line = json.dumps({"type": event_type, "object": data}) + "\n"
-        try:
-            self.wfile.write(line.encode())
-            self.wfile.flush()
-            return True
-        except (BrokenPipeError, ConnectionResetError):
-            return False
-
-    @staticmethod
-    def _dry_run(query) -> bool:
-        value = query.get("dryRun", "")
-        if value and value != "All":
-            # Real-apiserver validation: All is the only accepted value.
-            raise BadRequestError(f"invalid dryRun value {value!r}")
-        return bool(value)
-
-    def _do_post(self, cluster, info, namespace, name, subresource, query):
-        body = self._read_body()
+    def _do_post(self, req, info, namespace, name, subresource, query):
+        cluster = self.server.cluster
+        body = self._read_body(req)
         if subresource == "eviction":
             # dryRun travels either as a query param or inside the
             # Eviction body's deleteOptions (kubectl sends the latter).
@@ -416,8 +356,7 @@ class _Handler(BaseHTTPRequestHandler):
                 name, namespace,
                 dry_run=self._dry_run(query) or bool(body_dry),
             )
-            self._send_json(200, _ok_status())
-            return
+            return _Response(200, _ok_status())
         meta = body.setdefault("metadata", {})
         if info.namespaced and not meta.get("namespace"):
             meta["namespace"] = namespace
@@ -426,10 +365,11 @@ class _Handler(BaseHTTPRequestHandler):
             field_manager=query.get("fieldManager", ""),
             dry_run=self._dry_run(query),
         )
-        self._send_json(201, created.raw)
+        return _Response(201, created.raw)
 
-    def _do_put(self, cluster, info, namespace, name, subresource, query):
-        obj = wrap(self._read_body())
+    def _do_put(self, req, info, namespace, name, subresource, query):
+        cluster = self.server.cluster
+        obj = wrap(self._read_body(req))
         manager = query.get("fieldManager", "")
         dry = self._dry_run(query)
         if subresource == "status":
@@ -438,10 +378,11 @@ class _Handler(BaseHTTPRequestHandler):
             )
         else:
             updated = cluster.update(obj, field_manager=manager, dry_run=dry)
-        self._send_json(200, updated.raw)
+        return _Response(200, updated.raw)
 
-    def _do_patch(self, cluster, info, namespace, name, subresource, query):
-        content_type = self.headers.get("Content-Type", "")
+    def _do_patch(self, req, info, namespace, name, subresource, query):
+        cluster = self.server.cluster
+        content_type = req.header("Content-Type")
         if "apply-patch" in content_type:
             # Server-side apply: the body is the applied config itself.
             if subresource:
@@ -449,7 +390,7 @@ class _Handler(BaseHTTPRequestHandler):
                     "server-side apply to subresources is not supported "
                     "(PARITY: apply targets the main resource only)"
                 )
-            body = self._read_body()
+            body = self._read_body(req)
             meta = body.setdefault("metadata", {})
             if meta.get("name") and meta["name"] != name:
                 # Real-apiserver rule: the body may not address a
@@ -475,8 +416,7 @@ class _Handler(BaseHTTPRequestHandler):
                 force=query.get("force") == "true",
                 dry_run=self._dry_run(query),
             )
-            self._send_json(201 if created else 200, applied.raw)
-            return
+            return _Response(201 if created else 200, applied.raw)
         if "strategic-merge-patch" in content_type:
             patch_type = "strategic"
         elif "json-patch" in content_type:
@@ -487,14 +427,15 @@ class _Handler(BaseHTTPRequestHandler):
             info.kind,
             name,
             namespace,
-            patch=self._read_body(),
+            patch=self._read_body(req),
             patch_type=patch_type,
             field_manager=query.get("fieldManager", ""),
             dry_run=self._dry_run(query),
         )
-        self._send_json(200, patched.raw)
+        return _Response(200, patched.raw)
 
-    def _do_delete(self, cluster, info, namespace, name, subresource, query):
+    def _do_delete(self, req, info, namespace, name, subresource, query):
+        cluster = self.server.cluster
         if not name:
             # DELETE on the collection: client-go's deleteCollection.
             # Mirror of the fake's guard (ADVICE.md): a real apiserver
@@ -516,13 +457,12 @@ class _Handler(BaseHTTPRequestHandler):
                 propagation_policy=query.get("propagationPolicy") or None,
                 dry_run=self._dry_run(query),
             )
-            self._send_json(200, {
+            return _Response(200, {
                 "apiVersion": info.api_version,
                 "kind": f"{info.kind}List",
                 "items": [o.raw for o in deleted],
             })
-            return
-        preconditions = (self._read_body() or {}).get("preconditions") or {}
+        preconditions = (self._read_body(req) or {}).get("preconditions") or {}
         cluster.delete(
             info.kind,
             name,
@@ -534,40 +474,68 @@ class _Handler(BaseHTTPRequestHandler):
                 "resourceVersion"
             ),
         )
-        self._send_json(200, _ok_status())
-
-    def do_GET(self):  # noqa: N802 - http.server API
-        self._handle("GET")
-
-    def do_POST(self):  # noqa: N802
-        self._handle("POST")
-
-    def do_PUT(self):  # noqa: N802
-        self._handle("PUT")
-
-    def do_PATCH(self):  # noqa: N802
-        self._handle("PATCH")
-
-    def do_DELETE(self):  # noqa: N802
-        self._handle("DELETE")
-
-    def log_message(self, fmt, *args):  # noqa: D102 - silence default logging
-        pass
+        return _Response(200, _ok_status())
 
 
-def _ok_status() -> dict[str, Any]:
-    return {
-        "kind": "Status",
-        "apiVersion": "v1",
-        "status": "Success",
-        "code": 200,
-    }
+async def _read_request(
+    reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+) -> Optional[_Request]:
+    """Parse one HTTP/1.1 request off the connection; None on a clean
+    EOF between requests (keep-alive peer went away)."""
+    try:
+        line = await reader.readline()
+    except (ConnectionError, asyncio.IncompleteReadError):
+        return None
+    if not line or line in (b"\r\n", b"\n"):
+        return None
+    try:
+        method, target, version = line.decode("latin-1").strip().split(" ", 2)
+    except ValueError:
+        raise BadRequestError("malformed request line") from None
+    headers: dict[str, str] = {}
+    total = len(line)
+    while True:
+        line = await reader.readline()
+        total += len(line)
+        if total > _MAX_HEADER_BYTES:
+            raise BadRequestError("request headers too large")
+        if not line:
+            return None  # EOF mid-headers: peer gone
+        if line in (b"\r\n", b"\n"):
+            break
+        name, _, value = line.decode("latin-1").partition(":")
+        headers[name.strip().lower()] = value.strip()
+    if headers.get("expect", "").lower() == "100-continue":
+        # We always read the full body; a conforming client (curl with a
+        # large POST) WAITS for this interim response before sending it —
+        # without the write both sides stall until the client's fallback
+        # timer (the old BaseHTTPRequestHandler sent it automatically).
+        headers.pop("expect")
+        writer.write(b"HTTP/1.1 100 Continue\r\n\r\n")
+        await writer.drain()
+    body = b""
+    length = int(headers.get("content-length") or 0)
+    if length:
+        body = await reader.readexactly(length)
+    keep_alive = (
+        version.upper() != "HTTP/1.0"
+        and headers.get("connection", "").lower() != "close"
+    )
+    return _Request(method.upper(), target, headers, body, keep_alive)
 
 
-class LocalApiServer(ThreadingHTTPServer):
-    """Serve a FakeCluster on 127.0.0.1; use as a context manager in tests."""
+class LocalApiServer:
+    """Serve a FakeCluster on 127.0.0.1; use as a context manager in tests.
 
-    daemon_threads = True
+    Single asyncio event loop on a background thread; the public surface
+    (``cluster``, ``token``, ``url``, ``start``/``stop``, context
+    manager, ``write_kubeconfig``) is unchanged from the threaded
+    implementation. New observability for the wire path:
+    ``connections_opened`` / ``requests_served`` / ``watch_streams`` /
+    ``watch_frames_sent`` / ``bytes_sent`` counters (the counting hook
+    the connection-reuse tests and the bench's attribution read), and
+    ``kill_connections()`` force-drops every live connection — the
+    fault hook for watch-resume tests."""
 
     def __init__(
         self,
@@ -578,42 +546,405 @@ class LocalApiServer(ThreadingHTTPServer):
         keyfile: str = "",
         bookmark_interval_s: float = 15.0,
     ) -> None:
-        super().__init__(("127.0.0.1", port), _Handler)
         self.cluster = cluster if cluster is not None else FakeCluster()
         self.token = token
         #: Cadence of BOOKMARK events on watches that opted in via
         #: ``allowWatchBookmarks=true`` (the real server sends them about
         #: once a minute; tests shrink this to exercise the path).
         self.bookmark_interval_s = bookmark_interval_s
+        self._port_requested = port
         self.tls = bool(certfile)
+        self._ssl_ctx = None
         if certfile:
             ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
             ctx.load_cert_chain(certfile, keyfile or None)
-            self.socket = ctx.wrap_socket(self.socket, server_side=True)
+            self._ssl_ctx = ctx
+        self._dispatcher = _Dispatcher(self)
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._port: Optional[int] = None
+        self._started = threading.Event()
+        self._writers: set[asyncio.StreamWriter] = set()
+        # -- wire counters (loop-thread writes; cross-thread reads are
+        # single-field reads of ints, safe under the GIL) --
+        self.connections_opened = 0
+        self.requests_served = 0
+        self.watch_streams = 0
+        self.watch_frames_sent = 0
+        self.bytes_sent = 0
+        self._request_log: Optional[list] = None
 
+    def start_request_log(self) -> list:
+        """Begin recording ``(method, path, query)`` per request served
+        (the counting hook transport tests assert against — e.g. "a
+        killed watch connection resumes with a watch, not a LIST").
+        Returns the live list; ``stop_request_log()`` detaches it."""
+        log: list = []
+        self._request_log = log
+        return log
+
+    def stop_request_log(self) -> list:
+        log, self._request_log = self._request_log, None
+        return log if log is not None else []
+
+    # -- lifecycle ---------------------------------------------------------
     @property
     def url(self) -> str:
         scheme = "https" if self.tls else "http"
-        return f"{scheme}://127.0.0.1:{self.server_address[1]}"
+        return f"{scheme}://127.0.0.1:{self._port}"
+
+    @property
+    def server_address(self) -> tuple[str, int]:
+        """(host, port) — kept from the socketserver implementation for
+        callers that rebind a revived server to the same port."""
+        return ("127.0.0.1", self._port or self._port_requested)
 
     def start(self) -> "LocalApiServer":
-        self._thread = threading.Thread(target=self.serve_forever, daemon=True)
+        self._thread = threading.Thread(
+            target=self._run_loop, name="local-apiserver", daemon=True
+        )
         self._thread.start()
+        if not self._started.wait(timeout=10):
+            raise RuntimeError("LocalApiServer failed to start")
+        if self._startup_error is not None:
+            raise self._startup_error
         return self
+
+    def _run_loop(self) -> None:
+        self._startup_error: Optional[BaseException] = None
+        loop = asyncio.new_event_loop()
+        self._loop = loop
+        try:
+            try:
+                self._server = loop.run_until_complete(
+                    asyncio.start_server(
+                        self._serve_connection,
+                        "127.0.0.1",
+                        self._port_requested,
+                        ssl=self._ssl_ctx,
+                    )
+                )
+                self._port = self._server.sockets[0].getsockname()[1]
+            except BaseException as e:  # noqa: BLE001 - surfaced to start()
+                self._startup_error = e
+                return
+            finally:
+                self._started.set()
+            loop.run_forever()
+            # stop() requested: tear down the acceptor, then connections
+            # (in that order — on newer Pythons Server.wait_closed blocks
+            # until handlers finish, so handlers must be cancelled first,
+            # and the acceptor must stop before that so no new ones land).
+            self._server.close()
+            for writer in list(self._writers):
+                transport = writer.transport
+                if transport is not None:
+                    transport.abort()
+            pending = [
+                t for t in asyncio.all_tasks(loop) if not t.done()
+            ]
+            for task in pending:
+                task.cancel()
+            if pending:
+                loop.run_until_complete(
+                    asyncio.gather(*pending, return_exceptions=True)
+                )
+        finally:
+            loop.close()
+
+    def shutdown(self) -> None:
+        """Stop serving (acceptor, live connections, loop thread) but
+        leave the cluster alone — the socketserver-era split callers use
+        to revive a server over the same store."""
+        loop = self._loop
+        if loop is not None and not loop.is_closed():
+            loop.call_soon_threadsafe(loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    def server_close(self) -> None:
+        """Kept for socketserver-API compatibility; the listening socket
+        is already closed by shutdown()."""
 
     def stop(self) -> None:
         self.shutdown()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-        self.server_close()
         self.cluster.close()
+
+    def serve_forever(self) -> None:  # pragma: no cover - CLI entry path
+        """Block until interrupted (the __main__ demo path)."""
+        if self._thread is None:
+            self.start()
+        self._thread.join()
 
     def __enter__(self) -> "LocalApiServer":
         return self.start()
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+    def kill_connections(self) -> int:
+        """Force-close every live connection (test/fault hook: simulates
+        the peer's TCP state vanishing mid-watch, the failure the
+        bookmark-resume path exists for). Returns how many were hit."""
+        loop = self._loop
+        if loop is None or loop.is_closed():
+            return 0
+        writers = list(self._writers)
+
+        def _close_all():
+            for writer in writers:
+                transport = writer.transport
+                if transport is not None:
+                    transport.abort()
+
+        loop.call_soon_threadsafe(_close_all)
+        return len(writers)
+
+    # -- connection handling ----------------------------------------------
+    async def _serve_connection(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        self.connections_opened += 1
+        self._writers.add(writer)
+        try:
+            while True:
+                try:
+                    req = await _read_request(reader, writer)
+                except BadRequestError as e:
+                    await self._write_response(
+                        writer, 400,
+                        _status_body(400, "BadRequest", e.message),
+                        "json", keep_alive=False,
+                    )
+                    return
+                if req is None:
+                    return
+                self.requests_served += 1
+                request_log = self._request_log
+                if request_log is not None:
+                    request_log.append((req.method, req.path, dict(req.query)))
+                try:
+                    result = self._dispatcher.dispatch(req)
+                except ApiError as e:
+                    result = _Response(
+                        e.status, _status_body(e.status, e.reason, e.message)
+                    )
+                except Exception as e:  # noqa: BLE001 - surfaced as 500
+                    result = _Response(
+                        500, _status_body(500, "InternalError", str(e))
+                    )
+                if isinstance(result, _WatchParams):
+                    await self._stream_watch(writer, req, result)
+                else:
+                    encoding = (
+                        "json"
+                        if accepts_table(req.header("Accept"))
+                        else negotiate_encoding(req.header("Accept"))
+                    )
+                    await self._write_response(
+                        writer, result.status, result.body, encoding,
+                        keep_alive=req.keep_alive,
+                    )
+                if not req.keep_alive:
+                    return
+        except (ConnectionError, asyncio.IncompleteReadError):
+            return  # peer went away mid-exchange
+        except asyncio.CancelledError:
+            raise
+        finally:
+            self._writers.discard(writer)
+            writer.close()
+
+    async def _write_response(
+        self,
+        writer: asyncio.StreamWriter,
+        status: int,
+        body: Optional[dict[str, Any]],
+        encoding: str,
+        keep_alive: bool,
+    ) -> None:
+        payload = encode_body(body, encoding) if body is not None else b""
+        head = (
+            f"HTTP/1.1 {status} {_REASONS.get(status, 'Status')}\r\n"
+            f"Content-Type: {content_type_for(encoding)}\r\n"
+            f"Content-Length: {len(payload)}\r\n"
+        )
+        if not keep_alive:
+            head += "Connection: close\r\n"
+        data = head.encode("latin-1") + b"\r\n" + payload
+        writer.write(data)
+        self.bytes_sent += len(data)
+        await writer.drain()
+
+    # -- watch streaming ---------------------------------------------------
+    async def _stream_watch(
+        self, writer: asyncio.StreamWriter, req: _Request, params: _WatchParams
+    ) -> None:
+        """``?watch=true``: stream watch events as chunked frames on the
+        held connection.
+
+        Kubernetes watch semantics in the shape the library consumes:
+
+        * ``resourceVersion=N`` resumes from the event journal — the
+          list-then-watch pattern with no lost-event window (events since
+          the listed revision replay first; an expired revision returns
+          410 Gone and the client must re-list);
+        * without ``resourceVersion``, events after establishment stream;
+        * scope transitions follow the real apiserver: an object whose
+          update makes it START matching the selector arrives as ADDED,
+          one that STOPS matching arrives as DELETED;
+        * a consumer too slow to drain its event queue loses the watch
+          (stream ended at the last delivered revision) rather than
+          silently losing events;
+        * ``timeoutSeconds`` bounds the stream server-side — the window
+          ends with the terminal chunk and the CONNECTION STAYS OPEN:
+          the next watch window rides the same socket;
+        * ``allowWatchBookmarks=true`` opts into periodic BOOKMARK frames
+          carrying only the current collection resourceVersion, so a
+          quiet (e.g. selector-scoped) watch keeps a fresh resume point
+          and resumption does not decay into 410 + full re-list;
+        * frames are encoded per the negotiated encoding (JSON lines or
+          length-prefixed compact frames), and a Table-negotiated watch
+          (``Accept: ...;as=Table`` — kubectl get -w) streams
+          Table-transformed event frames.
+        """
+        info, namespace, query = params.info, params.namespace, params.query
+        accept = req.header("Accept")
+        as_table = accepts_table(accept)
+        encoding = "json" if as_table else negotiate_encoding(accept)
+        timeout_s = (
+            float(query["timeoutSeconds"])
+            if query.get("timeoutSeconds")
+            else None
+        )
+        loop = asyncio.get_running_loop()
+        events: asyncio.Queue = asyncio.Queue()
+        overflowed = asyncio.Event()
+
+        def emit(event_type, data, old):
+            # Runs on the WRITER's thread (any cluster mutator) — or on
+            # the loop thread itself when the mutation came through this
+            # server; call_soon_threadsafe is correct from both. It must
+            # NEVER raise into the mutator (FakeCluster._emit does not
+            # isolate watcher errors): a loop torn down mid-teardown
+            # reads as a dead stream, not a cluster write failure.
+            def _put():
+                if events.qsize() >= _WATCH_QUEUE_LIMIT:
+                    overflowed.set()  # end the stream; the client resumes
+                else:
+                    events.put_nowait((event_type, data, old))
+
+            try:
+                loop.call_soon_threadsafe(_put)
+            except RuntimeError:
+                pass  # loop closed while the subscription unwound
+
+        source = WatchFrameSource(
+            self.cluster,
+            info.kind,
+            info.api_version,
+            namespace=namespace,
+            label_selector=query.get("labelSelector") or None,
+            field_selector=query.get("fieldSelector") or None,
+        )
+        try:
+            # Everything from open() on is covered by the unsubscribe
+            # (close() is idempotent and safe pre-open): a cancellation
+            # landing anywhere in the stream cannot leak the watcher.
+            try:
+                replay = source.open(emit, query.get("resourceVersion"))
+            except ApiError as e:
+                await self._write_response(
+                    writer, e.status,
+                    _status_body(e.status, e.reason, e.message),
+                    "json" if as_table else encoding,
+                    keep_alive=req.keep_alive,
+                )
+                return
+            self.watch_streams += 1
+            head = (
+                "HTTP/1.1 200 OK\r\n"
+                f"Content-Type: {content_type_for(encoding)}\r\n"
+                "Transfer-Encoding: chunked\r\n"
+                "\r\n"
+            ).encode("latin-1")
+            writer.write(head)
+            self.bytes_sent += len(head)
+            for frame, data in replay:
+                await self._write_frame(
+                    writer, frame, data, encoding, info, query, as_table
+                )
+            deadline = (
+                loop.time() + timeout_s if timeout_s is not None else None
+            )
+            interval = self.bookmark_interval_s
+            bookmarks = query.get("allowWatchBookmarks") in ("true", "1")
+            next_bookmark = loop.time() + interval
+            while not overflowed.is_set():
+                if deadline is not None:
+                    remaining = deadline - loop.time()
+                    if remaining <= 0:
+                        break
+                    poll = min(0.2, remaining)
+                else:
+                    poll = 0.2
+                if bookmarks:
+                    poll = min(poll, max(0.01, next_bookmark - loop.time()))
+                try:
+                    event_type, data, old = await asyncio.wait_for(
+                        events.get(), poll
+                    )
+                except asyncio.TimeoutError:
+                    if bookmarks and loop.time() >= next_bookmark:
+                        # Bookmark only from a DRAINED queue — see
+                        # WatchFrameSource.bookmark for the rv-before-
+                        # emptiness-recheck ordering.
+                        frame, data = source.bookmark()
+                        if events.empty():
+                            next_bookmark = loop.time() + interval
+                            await self._write_frame(
+                                writer, frame, data, encoding, info, query,
+                                as_table,
+                            )
+                    continue
+                mapped = source.classify(event_type, data, old)
+                if mapped is None:
+                    continue
+                await self._write_frame(
+                    writer, mapped, data, encoding, info, query, as_table
+                )
+            # Terminal chunk: the window is over, the connection lives on.
+            writer.write(b"0\r\n\r\n")
+            self.bytes_sent += 5
+            await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            return  # consumer went away mid-stream
+        finally:
+            source.close()
+
+    async def _write_frame(
+        self,
+        writer: asyncio.StreamWriter,
+        event_type: str,
+        data: dict[str, Any],
+        encoding: str,
+        info,
+        query,
+        as_table: bool,
+    ) -> None:
+        if as_table:
+            # kubectl get -w: every event object — bookmarks included —
+            # is Table-transformed, one row per event.
+            data = self._dispatcher._table(self.cluster, info, [data], query)
+        frame = encode_watch_frame(
+            {"type": event_type, "object": data}, encoding
+        )
+        chunk = b"%x\r\n" % len(frame) + frame + b"\r\n"
+        writer.write(chunk)
+        self.watch_frames_sent += 1
+        self.bytes_sent += len(chunk)
+        await writer.drain()
 
     # -- kubeconfig emission ----------------------------------------------
     def write_kubeconfig(self, path: str, ca_file: str = "") -> str:
@@ -658,7 +989,7 @@ def main() -> None:  # pragma: no cover - manual demo entry point
         "--kubeconfig", default="", help="write a kubeconfig to this path"
     )
     args = parser.parse_args()
-    server = LocalApiServer(port=args.port, token=args.token)
+    server = LocalApiServer(port=args.port, token=args.token).start()
     if args.kubeconfig:
         server.write_kubeconfig(args.kubeconfig)
         print(f"kubeconfig written to {args.kubeconfig}")

@@ -663,6 +663,117 @@ def classify_watch_event(
     return None
 
 
+class WatchFrameSource:
+    """The frame source behind a watch stream — everything between the
+    cluster's raw event journal and one consumer's ordered frames:
+    static scoping (kind + namespace, applied before an event is ever
+    queued), journal replay from a resumption ``resourceVersion``,
+    selector-scope classification (``classify_watch_event``), and the
+    BOOKMARK payload contract. Shared by ``FakeCluster.watch`` (the
+    in-process sync generator) and the HTTP apiserver's streaming watch
+    (which bridges ``emit`` into its event loop) so both speak one
+    protocol — a frame the wire stream sends is byte-for-byte the frame
+    the in-process watch would have yielded.
+
+    Usage: ``open(emit, resource_version)`` subscribes and returns the
+    classified replay frames; live events arrive through ``emit(
+    event_type, data, old)`` (called from the WRITER's thread — keep it
+    to an enqueue) and are classified consumer-side via ``classify``;
+    ``bookmark()`` builds the resume-point frame; ``close()``
+    unsubscribes (idempotent)."""
+
+    def __init__(
+        self,
+        cluster: "FakeCluster",
+        kind: str,
+        api_version: str,
+        namespace: str = "",
+        label_selector: Optional[str | Mapping[str, str]] = None,
+        field_selector: Optional[str] = None,
+    ) -> None:
+        self._cluster = cluster
+        self.kind = kind
+        self.api_version = api_version
+        self.namespace = namespace
+        if isinstance(label_selector, Mapping):
+            self._selector = LabelSelector.from_match_labels(label_selector)
+        else:
+            self._selector = parse_selector(label_selector)
+        self._fields = parse_field_selector(field_selector)
+        self._on_event: Optional[Callable] = None
+
+    def in_static_scope(self, data: Mapping[str, Any]) -> bool:
+        """The cheap pre-queue filter: kind and namespace only. Selector
+        scope needs old-vs-new classification and happens consumer-side
+        (``classify``), off the writer's emit path."""
+        if data.get("kind") != self.kind:
+            return False
+        if self.namespace:
+            meta = data.get("metadata") or {}
+            if meta.get("namespace", "") != self.namespace:
+                return False
+        return True
+
+    def open(
+        self,
+        emit: Callable[[str, dict[str, Any], Optional[dict[str, Any]]], None],
+        resource_version: Optional[str] = None,
+    ) -> list[tuple[str, dict[str, Any]]]:
+        """Subscribe ``emit`` for live events (statically pre-scoped) and
+        return the classified journal replay since ``resource_version``
+        — atomically, so no event between replay and subscription can be
+        lost (``subscribe_since``'s contract). Raises
+        ``WatchExpiredError`` when the revision fell out of the journal."""
+
+        def on_event(event_type, data, old):
+            if self.in_static_scope(data):
+                emit(event_type, data, old)
+
+        replay = self._cluster.subscribe_since(on_event, resource_version)
+        self._on_event = on_event
+        mapped: list[tuple[str, dict[str, Any]]] = []
+        for event_type, data, old in replay:
+            if not self.in_static_scope(data):
+                continue
+            frame = self.classify(event_type, data, old)
+            if frame is not None:
+                mapped.append((frame, data))
+        return mapped
+
+    def classify(
+        self,
+        event_type: str,
+        data: Mapping[str, Any],
+        old: Optional[Mapping[str, Any]],
+    ) -> Optional[str]:
+        """Selector-scope classification for one queued event; None =
+        out of scope (drop the frame)."""
+        return classify_watch_event(
+            event_type, data, old, self._selector, self._fields
+        )
+
+    def bookmark(self) -> tuple[str, dict[str, Any]]:
+        """The BOOKMARK frame: an object of the watched kind carrying
+        ONLY ``metadata.resourceVersion`` (the real server's bookmark
+        payload). The rv must be read BEFORE the caller re-checks queue
+        emptiness — ``_emit`` bumps the rv and enqueues under one lock
+        hold, so an rv observed here implies its event is already
+        enqueued, and an empty queue then implies it was delivered."""
+        return "BOOKMARK", {
+            "kind": self.kind,
+            "apiVersion": self.api_version,
+            "metadata": {
+                "resourceVersion": self._cluster.current_resource_version()
+            },
+        }
+
+    def close(self) -> None:
+        on_event = self._on_event
+        if on_event is not None:
+            self._on_event = None
+            self._cluster.unsubscribe(on_event)
+
+
 class FakeCluster(Client):
     """Thread-safe in-memory object store with apiserver semantics."""
 
@@ -891,42 +1002,31 @@ class FakeCluster(Client):
 
             timeout_seconds = DEFAULT_WATCH_TIMEOUT_SECONDS
 
-        if isinstance(label_selector, Mapping):
-            selector = LabelSelector.from_match_labels(label_selector)
-        else:
-            selector = parse_selector(label_selector)
-        fields = parse_field_selector(field_selector)
+        source = WatchFrameSource(
+            self,
+            kind,
+            KINDS.get(kind, KubeObject).API_VERSION or "v1",
+            namespace=namespace,
+            label_selector=label_selector,
+            field_selector=field_selector,
+        )
         events: queue.Queue = queue.Queue(maxsize=1024)
 
-        def on_event(event_type, data, old):
-            if data.get("kind") != kind:
-                return
-            meta = data.get("metadata") or {}
-            if namespace and meta.get("namespace", "") != namespace:
-                return
+        def emit(event_type, data, old):
             try:
                 events.put_nowait((event_type, data, old))
             except queue.Full:
                 pass  # in-process consumer this slow has bigger problems
 
-        replay = self.subscribe_since(on_event, resource_version)
+        replay = source.open(emit, resource_version)
         try:
-            for event_type, data, old in replay:
-                if data.get("kind") != kind:
-                    continue
-                meta = data.get("metadata") or {}
-                if namespace and meta.get("namespace", "") != namespace:
-                    continue
-                mapped = classify_watch_event(
-                    event_type, data, old, selector, fields
-                )
-                if mapped is not None:
-                    # Yielded objects are frozen journal references (see
-                    # _emit) — read-only by contract, same as the shared
-                    # snapshot every consumer of this generator always
-                    # got. The informer rides on this: zero copies per
-                    # delivered event; its own reads copy on the way out.
-                    yield mapped, wrap(data)
+            for mapped, data in replay:
+                # Yielded objects are frozen journal references (see
+                # _emit) — read-only by contract, same as the shared
+                # snapshot every consumer of this generator always
+                # got. The informer rides on this: zero copies per
+                # delivered event; its own reads copy on the way out.
+                yield mapped, wrap(data)
             deadline = (
                 time.monotonic() + timeout_seconds
                 if timeout_seconds is not None
@@ -949,32 +1049,22 @@ class FakeCluster(Client):
                     event_type, data, old = events.get(timeout=poll)
                 except queue.Empty:
                     # Bookmark only from a DRAINED queue — the contract is
-                    # "every event up to this rv has been delivered". The
-                    # rv is read BEFORE re-checking emptiness: _emit bumps
-                    # the rv and enqueues under one lock hold, so an rv
-                    # observed here implies its event was already enqueued
-                    # — and an empty queue then implies it was yielded.
+                    # "every event up to this rv has been delivered"; see
+                    # WatchFrameSource.bookmark for the rv-before-recheck
+                    # ordering this leans on.
                     if allow_bookmarks and time.monotonic() >= next_bookmark:
-                        rv = self.current_resource_version()
+                        frame, data = source.bookmark()
                         if events.empty():
                             next_bookmark = (
                                 time.monotonic() + bookmark_interval_s
                             )
-                            yield "BOOKMARK", wrap({
-                                "kind": kind,
-                                "apiVersion": KINDS.get(
-                                    kind, KubeObject
-                                ).API_VERSION or "v1",
-                                "metadata": {"resourceVersion": rv},
-                            })
+                            yield frame, wrap(data)
                     continue
-                mapped = classify_watch_event(
-                    event_type, data, old, selector, fields
-                )
+                mapped = source.classify(event_type, data, old)
                 if mapped is not None:
                     yield mapped, wrap(data)
         finally:
-            self.unsubscribe(on_event)
+            source.close()
 
     def _emit(
         self,

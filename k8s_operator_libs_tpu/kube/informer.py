@@ -9,9 +9,14 @@ that layer over ``Client.watch``:
 * one ``Informer`` maintains a local store for one kind, seeded by a list
   and kept current by a watch resumed from the list's revision — the
   journal-backed resumption means no event is lost between the two;
-* a watch that expires (``WatchExpiredError``, the 410 Gone analog) or
-  ends re-lists and resumes, diffing the relisted state against the store
-  so handlers see synthetic ADDED/MODIFIED/DELETED for anything missed;
+* a watch window that ENDS (server-side bound) re-watches from the last
+  delivered or bookmarked revision; a watch whose CONNECTION dies does
+  the same (up to ``max_resume_attempts`` — the journal replays what the
+  dead stream swallowed, see docs/wire-path.md); only a watch that
+  EXPIRES (``WatchExpiredError``, the 410 Gone analog — the revision
+  fell out of the journal) or keeps failing re-lists, diffing the
+  relisted state against the store so handlers see synthetic
+  ADDED/MODIFIED/DELETED for anything missed;
 * handlers run on the informer thread with ``(event_type, obj, old)`` —
   pair them with the requestor's plain-function predicates;
 * reads (``get``/``list``) serve from the local store: cheap, point-in-time
@@ -64,6 +69,12 @@ class Informer:
         #: Bounded watch windows so a dead-silent stream cannot park the
         #: informer forever; each window resumes from the last revision.
         self.watch_timeout_seconds = watch_timeout_seconds
+        #: How many consecutive watch-stream failures resume from the
+        #: last delivered/bookmarked revision before degrading to a full
+        #: re-list (a killed connection costs a re-watch, not an O(pool)
+        #: LIST; see docs/wire-path.md). Reset by any delivered event or
+        #: cleanly ended window.
+        self.max_resume_attempts = 3
         #: client-go's resync: every period, every cached object is
         #: re-delivered to handlers as MODIFIED with old == new (the
         #: SharedInformer UpdateFunc(obj, obj) shape) — the self-heal
@@ -513,6 +524,7 @@ class Informer:
         self._synced.set()
 
     def _run(self, stop: threading.Event) -> None:
+        consecutive_failures = 0
         while not stop.is_set():
             try:
                 if not self._synced.is_set() or self._resource_version is None:
@@ -549,6 +561,7 @@ class Informer:
                 for event_type, obj in watch_iter:
                     if stop.is_set():
                         return
+                    consecutive_failures = 0  # the stream delivered
                     raw = obj.raw
                     if event_type == "BOOKMARK":
                         # Resume-point refresh only: no object payload,
@@ -601,6 +614,7 @@ class Informer:
                     self._dispatch(event_type, raw, old)
                 # Watch window ended (server timeout): resume from the
                 # last seen revision on the next loop iteration.
+                consecutive_failures = 0
             except WatchExpiredError:
                 log.info(
                     "%s watch expired at rv=%s; re-listing",
@@ -615,6 +629,27 @@ class Informer:
             except Exception as e:  # noqa: BLE001 - stream died; back off
                 if stop.is_set():
                     return
+                consecutive_failures += 1
+                if (
+                    self._resource_version is not None
+                    and consecutive_failures <= self.max_resume_attempts
+                ):
+                    # A dead CONNECTION is not a lost CACHE: the store is
+                    # still valid through the last delivered revision
+                    # (bookmarks keep it fresh on quiet watches), so
+                    # resume the watch from there — the journal replays
+                    # whatever the dead stream swallowed. Re-listing here
+                    # would put an O(pool) LIST on every network blip;
+                    # only a 410 (revision fell out of the journal) or
+                    # repeated resume failures earn that.
+                    log.warning(
+                        "%s watch died (%s); resuming from rv=%s "
+                        "(attempt %d/%d)",
+                        self.kind, e, self._resource_version,
+                        consecutive_failures, self.max_resume_attempts,
+                    )
+                    stop.wait(min(0.2 * consecutive_failures, 1.0))
+                    continue
                 log.warning("%s watch failed (%s); re-listing", self.kind, e)
                 self._resource_version = None
                 self._synced.clear()

@@ -5,7 +5,22 @@ This is the L0 the reference gets from controller-runtime + client-go
 (reference: pkg/upgrade/common_manager.go:108-116 creates both flavors from a
 ``rest.Config``; pkg/crdutil/crdutil.go:61 resolves it via ``ctrl.GetConfig``
 — kubeconfig or in-cluster). Implemented on the standard library only
-(urllib + ssl): no vendored SDK.
+(asyncio + ssl): no vendored SDK.
+
+The transport (docs/wire-path.md) is an asyncio HTTP/1.1 stack behind the
+unchanged **sync** ``Client`` facade — callers never see the event loop:
+
+* **keep-alive pool** — connections to the apiserver are pooled and
+  reused across requests AND watch windows (a clean watch-window end
+  returns its connection to the pool), so a reconcile pass pays zero
+  TCP/TLS setups in steady state;
+* **pipelining** — ``request_many``/``prime_list_cache`` write a batch
+  of requests before reading the first response: the informer seed's
+  LIST + paged continues cost one round trip per batch, not per page;
+* **negotiated encoding** — ``RestConfig.wire_encoding="compact"`` opts
+  into the compact binary encoding (``kube/wire.py``) next to JSON in
+  ``Accept``; JSON stays the default and either side falling back to
+  JSON keeps everything working.
 
 Error mapping mirrors apimachinery: HTTP Status ``reason`` drives the typed
 error (NotFound / AlreadyExists / Conflict / Invalid), so
@@ -15,12 +30,13 @@ real apiserver.
 
 from __future__ import annotations
 
+import asyncio
 import atexit
 import base64
-import http.client
+import concurrent.futures
 import json
 import os
-import socket
+import queue as queue_mod
 import ssl
 import tempfile
 import threading
@@ -41,6 +57,15 @@ from .client import (
 )
 from .objects import KubeObject, wrap
 from .resources import ResourceInfo, resource_for_kind
+from .wire import (
+    CLIENT_ACCEPT_COMPACT,
+    COMPACT_CONTENT_TYPE,
+    FrameDecoder,
+    JSON_CONTENT_TYPE,
+    decode_body,
+    encode_compact,
+    is_compact_content_type,
+)
 
 _SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
@@ -71,6 +96,16 @@ class RestConfig:
     #: Page size for chunked lists (client-go pager's default 500);
     #: 0 = request everything in one response.
     list_page_size: int = 500
+    #: Wire encoding to NEGOTIATE for response/watch payloads: ``"json"``
+    #: (the protocol default) or ``"compact"`` (the binary encoding in
+    #: ``kube/wire.py`` — the protobuf posture). Negotiated via
+    #: ``Accept``, so a server that only speaks JSON answers JSON and
+    #: nothing breaks; write bodies switch to compact only after the
+    #: server has proven it speaks it (a compact response arrived).
+    #: Compact trades CPU for bytes: ~0.4x the payload bytes at a pure-
+    #: Python codec cost — the right default on real networks with big
+    #: lists, not on loopback (see docs/wire-path.md).
+    wire_encoding: str = "json"
     #: Paths of temp files backing *-data kubeconfig fields (private key
     #: material) — unlinked by close() and, as a backstop, at process exit.
     _temp_files: list = field(default_factory=list, repr=False)
@@ -271,45 +306,427 @@ _ERRORS_BY_CODE = {
 class WatchHandle:
     """Cancellation handle for a streaming watch.
 
-    A watch consumer blocks in a socket read; no flag check can interrupt
-    that from another thread. ``cancel()`` closes the underlying
-    connection, which unblocks the read and ends the generator cleanly —
-    the informer's stop path."""
+    A watch consumer blocks waiting on stream frames; no flag check can
+    interrupt that from another thread. ``cancel()`` aborts the
+    underlying transport on the wire loop, which fails the pending read
+    and ends the generator cleanly — the informer's stop path.
+    ``_sock`` is the stream's raw socket once the watch is established
+    (the "stream is live" signal the informer's stop test waits on)."""
 
     def __init__(self) -> None:
-        self._conn: Optional[http.client.HTTPConnection] = None
-        self._sock: Optional[socket.socket] = None
+        self._sock = None
+        self._cancel_cb = None
         self.cancelled = False
-
-    def _attach_response(self, resp) -> None:
-        """Capture the stream's raw socket. On a Connection:-close
-        response http.client nulls conn.sock (ownership moves to the
-        response), so the socket must be dug out of resp.fp."""
-        sock = getattr(self._conn, "sock", None)
-        if sock is None:
-            fp = getattr(resp, "fp", None)
-            raw = getattr(fp, "raw", fp)
-            sock = getattr(raw, "_sock", None)
-        self._sock = sock
 
     def cancel(self) -> None:
         self.cancelled = True
-        # shutdown() BEFORE close(): closing an fd from another thread
-        # does not unblock a recv() already parked on it — a quiet watch
-        # (no events, no bookmarks) would otherwise pin the informer
-        # thread until the window times out.
-        sock = self._sock or getattr(self._conn, "sock", None)
-        if sock is not None:
+        cb = self._cancel_cb
+        if cb is not None:
             try:
-                sock.shutdown(socket.SHUT_RDWR)
-            except OSError:
+                cb()
+            except Exception:  # noqa: BLE001 - already torn down is fine
                 pass
-        conn = self._conn
-        if conn is not None:
+
+
+class _TransportError(Exception):
+    """Connection-level failure (mapped to ApiError at the facade)."""
+
+
+_wire_loop_lock = threading.Lock()
+_wire_loop: Optional[asyncio.AbstractEventLoop] = None
+
+
+def _get_wire_loop() -> asyncio.AbstractEventLoop:
+    """The shared client-side event loop: ONE daemon thread for every
+    RestClient in the process (clients are cheap; loops are not). The
+    loop only moves bytes — nothing CPU-bound runs on it."""
+    global _wire_loop
+    with _wire_loop_lock:
+        if _wire_loop is None or _wire_loop.is_closed():
+            loop = asyncio.new_event_loop()
+            thread = threading.Thread(
+                target=loop.run_forever, name="kube-wire-client", daemon=True
+            )
+            thread.start()
+            _wire_loop = loop
+        return _wire_loop
+
+
+class _Conn:
+    """One pooled connection (asyncio streams + reuse bookkeeping)."""
+
+    __slots__ = ("reader", "writer", "reused")
+
+    def __init__(self, reader, writer) -> None:
+        self.reader = reader
+        self.writer = writer
+        self.reused = False
+
+    def abort(self) -> None:
+        transport = self.writer.transport
+        if transport is not None:
+            transport.abort()
+
+
+async def _read_headers(reader) -> tuple[int, dict[str, str]]:
+    line = await reader.readline()
+    if not line:
+        raise _TransportError("connection closed before response")
+    parts = line.decode("latin-1").split(" ", 2)
+    if len(parts) < 2 or not parts[0].startswith("HTTP/"):
+        raise _TransportError(f"malformed status line {line!r}")
+    status = int(parts[1])
+    headers: dict[str, str] = {}
+    while True:
+        line = await reader.readline()
+        if not line:
+            raise _TransportError("connection closed in response headers")
+        if line in (b"\r\n", b"\n"):
+            return status, headers
+        name, _, value = line.decode("latin-1").partition(":")
+        headers[name.strip().lower()] = value.strip()
+
+
+async def _read_chunk(reader) -> bytes:
+    """One chunked-transfer chunk payload; b"" on the terminal chunk."""
+    size_line = await reader.readline()
+    if not size_line:
+        raise _TransportError("connection closed mid-stream")
+    try:
+        size = int(size_line.strip().split(b";")[0], 16)
+    except ValueError:
+        raise _TransportError(f"bad chunk size {size_line!r}") from None
+    if size == 0:
+        await reader.readline()  # the CRLF ending the terminal chunk
+        return b""
+    data = await reader.readexactly(size)
+    await reader.readexactly(2)  # chunk-terminating CRLF
+    return data
+
+
+async def _read_body(reader, headers: dict[str, str]) -> tuple[bytes, bool]:
+    """Read a buffered response body; returns (body, connection_reusable)."""
+    te = headers.get("transfer-encoding", "").lower()
+    if "chunked" in te:
+        parts = []
+        while True:
+            chunk = await _read_chunk(reader)
+            if not chunk:
+                break
+            parts.append(chunk)
+        body = b"".join(parts)
+        reusable = headers.get("connection", "").lower() != "close"
+        return body, reusable
+    if "content-length" in headers:
+        body = await reader.readexactly(int(headers["content-length"]))
+        reusable = headers.get("connection", "").lower() != "close"
+        return body, reusable
+    # EOF-delimited: the connection dies with the body.
+    return await reader.read(), False
+
+
+class _Transport:
+    """Keep-alive connection pool + request/pipeline/stream primitives,
+    all running on the shared wire loop. One per RestClient (per-host
+    reuse: a client talks to exactly one host)."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        ssl_ctx: Optional[ssl.SSLContext],
+        server_hostname: Optional[str],
+        timeout: float,
+    ) -> None:
+        self._host = host
+        self._port = port
+        self._ssl = ssl_ctx
+        self._server_hostname = server_hostname
+        self._timeout = timeout
+        self._idle: list[_Conn] = []  # loop-thread only
+        self.closed = False
+        # -- stats (loop-thread writes; int reads are GIL-atomic) --
+        self.connections_opened = 0
+        self.requests_sent = 0
+        self.pipelined_batches = 0
+        self.bytes_sent = 0
+        self.bytes_received = 0
+        self.watch_frames_received = 0
+
+    # -- pool (every method below runs on the wire loop) -------------------
+    async def _acquire(self) -> _Conn:
+        while self._idle:
+            conn = self._idle.pop()
+            if not conn.reader.at_eof():
+                conn.reused = True
+                return conn
+            conn.abort()
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(
+                self._host, self._port, ssl=self._ssl,
+                server_hostname=self._server_hostname,
+            ),
+            self._timeout,
+        )
+        self.connections_opened += 1
+        return _Conn(reader, writer)
+
+    def _release(self, conn: _Conn) -> None:
+        if self.closed:
+            conn.abort()
+        else:
+            self._idle.append(conn)
+
+    def _discard(self, conn: _Conn) -> None:
+        conn.abort()
+
+    async def close(self) -> None:
+        self.closed = True
+        while self._idle:
+            self._idle.pop().abort()
+
+    def _request_bytes(
+        self, method: str, target: str, headers: Mapping[str, str],
+        body: Optional[bytes],
+    ) -> bytes:
+        lines = [f"{method} {target} HTTP/1.1"]
+        lines.append(f"Host: {self._host}:{self._port}")
+        for name, value in headers.items():
+            lines.append(f"{name}: {value}")
+        if body is not None:
+            lines.append(f"Content-Length: {len(body)}")
+        head = ("\r\n".join(lines) + "\r\n\r\n").encode("latin-1")
+        return head + (body or b"")
+
+    async def request(
+        self, method: str, target: str, headers: Mapping[str, str],
+        body: Optional[bytes],
+    ) -> tuple[int, dict[str, str], bytes]:
+        """One request/response turn on a pooled connection, with the
+        stale-keep-alive retry: a send-phase failure retries once on a
+        fresh connection for any method (nothing reached the server); a
+        read-phase failure retries only idempotent methods (POST create
+        may have been processed)."""
+        data = self._request_bytes(method, target, headers, body)
+        for attempt in (0, 1):
             try:
-                conn.close()
-            except Exception:  # noqa: BLE001 - already dead is fine
-                pass
+                conn = await self._acquire()
+            except (OSError, asyncio.TimeoutError) as e:
+                # Connection establishment failed (refused, unreachable,
+                # TLS handshake): map into the typed-error path like any
+                # other transport failure — callers (leader election's
+                # "never raises on API errors" loop) depend on ApiError.
+                if attempt == 0:
+                    continue
+                raise _TransportError(str(e) or type(e).__name__) from None
+            try:
+                conn.writer.write(data)
+                await asyncio.wait_for(conn.writer.drain(), self._timeout)
+            except (OSError, asyncio.TimeoutError) as e:
+                self._discard(conn)
+                if attempt == 0:
+                    continue
+                raise _TransportError(str(e) or type(e).__name__) from None
+            self.requests_sent += 1
+            self.bytes_sent += len(data)
+            try:
+                status, rheaders = await asyncio.wait_for(
+                    _read_headers(conn.reader), self._timeout
+                )
+                payload, reusable = await asyncio.wait_for(
+                    _read_body(conn.reader, rheaders), self._timeout
+                )
+            except (
+                OSError, asyncio.TimeoutError,
+                asyncio.IncompleteReadError, _TransportError,
+            ) as e:
+                self._discard(conn)
+                if attempt == 0 and method != "POST":
+                    continue
+                raise _TransportError(str(e) or type(e).__name__) from None
+            self.bytes_received += len(payload)
+            if reusable:
+                self._release(conn)
+            else:
+                self._discard(conn)
+            return status, rheaders, payload
+        raise AssertionError("unreachable")  # pragma: no cover
+
+    async def request_many(
+        self, requests: list[tuple[str, str, Mapping[str, str],
+                                   Optional[bytes]]],
+    ) -> list[tuple[int, dict[str, str], bytes]]:
+        """HTTP/1.1 pipelining: write every request on ONE connection
+        before reading the first response, then read the responses in
+        order — a batch of reads costs one round trip, not N. Falls back
+        to sequential requests on any stream hiccup (pipelining is an
+        optimization, never a correctness dependency)."""
+        if not requests:
+            return []
+        conn = None
+        try:
+            conn = await self._acquire()
+            blob = b"".join(
+                self._request_bytes(m, t, h, b) for m, t, h, b in requests
+            )
+            conn.writer.write(blob)
+            await asyncio.wait_for(conn.writer.drain(), self._timeout)
+            self.bytes_sent += len(blob)
+            out = []
+            reusable = True
+            for _ in requests:
+                status, rheaders = await asyncio.wait_for(
+                    _read_headers(conn.reader), self._timeout
+                )
+                payload, this_reusable = await asyncio.wait_for(
+                    _read_body(conn.reader, rheaders), self._timeout
+                )
+                self.requests_sent += 1
+                self.bytes_received += len(payload)
+                out.append((status, rheaders, payload))
+                reusable = reusable and this_reusable
+            self.pipelined_batches += 1
+            if reusable:
+                self._release(conn)
+            else:
+                self._discard(conn)
+            return out
+        except (
+            OSError, asyncio.TimeoutError,
+            asyncio.IncompleteReadError, _TransportError,
+        ):
+            if conn is not None:
+                self._discard(conn)
+            # Sequential fallback: a mid-pipeline close (e.g. a proxy
+            # that answers Connection: close) must not fail the batch.
+            return [
+                await self.request(m, t, h, b) for m, t, h, b in requests
+            ]
+
+    async def watch_pump(
+        self,
+        target: str,
+        headers: Mapping[str, str],
+        out: "queue_mod.Queue",
+        handle: Optional[WatchHandle],
+        read_timeout: float,
+    ) -> None:
+        """Drive one watch stream: establish, then push decoded frames
+        into ``out`` as ``(kind, payload)`` tuples — ``("event", dict)``,
+        ``("httperror", (status, content_type, body))``, ``("error",
+        exc)``, ``("end", None)``. Always terminates the queue. A clean
+        window end (terminal chunk) returns the connection to the pool:
+        the next window rides the same socket."""
+        loop = asyncio.get_running_loop()
+        conn = None
+        try:
+            conn = await self._acquire()
+            if handle is not None:
+                this_conn = conn
+
+                def _abort() -> None:
+                    loop.call_soon_threadsafe(this_conn.abort)
+
+                handle._cancel_cb = _abort
+                if handle.cancelled:
+                    # cancel() ran between handle creation and this
+                    # point; it had no transport to abort — honor the
+                    # flag here.
+                    self._discard(conn)
+                    out.put(("end", None))
+                    return
+            data = self._request_bytes("GET", target, headers, None)
+            conn.writer.write(data)
+            await asyncio.wait_for(conn.writer.drain(), self._timeout)
+            self.requests_sent += 1
+            self.bytes_sent += len(data)
+            status, rheaders = await asyncio.wait_for(
+                _read_headers(conn.reader), self._timeout
+            )
+            if status >= 400:
+                payload, reusable = await asyncio.wait_for(
+                    _read_body(conn.reader, rheaders), self._timeout
+                )
+                self.bytes_received += len(payload)
+                if handle is not None:
+                    handle._cancel_cb = None  # ownership ends here
+                if reusable:
+                    self._release(conn)
+                else:
+                    self._discard(conn)
+                conn = None
+                out.put((
+                    "httperror",
+                    (status, rheaders.get("content-type"), payload),
+                ))
+                return
+            if handle is not None:
+                handle._sock = conn.writer.get_extra_info("socket")
+                if handle.cancelled:
+                    self._discard(conn)
+                    conn = None
+                    out.put(("end", None))
+                    return
+            decoder = FrameDecoder(rheaders.get("content-type"))
+            chunked = "chunked" in rheaders.get(
+                "transfer-encoding", ""
+            ).lower()
+            while True:
+                if chunked:
+                    piece = await asyncio.wait_for(
+                        _read_chunk(conn.reader), read_timeout
+                    )
+                    if piece == b"":
+                        # Clean window end: the connection goes back to
+                        # the pool for the next window. The handle's
+                        # cancel hook is DETACHED FIRST — a late
+                        # cancel() (an informer stopping between
+                        # windows) must never abort a connection this
+                        # stream no longer owns: pooled, or already
+                        # serving another consumer.
+                        if handle is not None:
+                            handle._cancel_cb = None
+                        if rheaders.get("connection", "").lower() == "close":
+                            self._discard(conn)
+                        else:
+                            self._release(conn)
+                        conn = None
+                        break
+                else:
+                    # EOF-delimited stream (a real apiserver pre-chunking,
+                    # or a proxy): the connection dies with the stream.
+                    piece = await asyncio.wait_for(
+                        conn.reader.read(65536), read_timeout
+                    )
+                    if not piece:
+                        self._discard(conn)
+                        conn = None
+                        break
+                self.bytes_received += len(piece)
+                for event in decoder.feed(piece):
+                    self.watch_frames_received += 1
+                    out.put(("event", event))
+            out.put(("end", None))
+        except asyncio.CancelledError:
+            if conn is not None:
+                self._discard(conn)
+            out.put(("end", None))
+            raise
+        except (
+            OSError, asyncio.TimeoutError,
+            asyncio.IncompleteReadError, _TransportError,
+        ) as e:
+            if conn is not None:
+                self._discard(conn)
+            if handle is not None and handle.cancelled:
+                out.put(("end", None))
+            else:
+                out.put(("error",
+                         _TransportError(str(e) or type(e).__name__)))
+        except Exception as e:  # noqa: BLE001 - surfaced to the consumer
+            if conn is not None:
+                self._discard(conn)
+            out.put(("error", e))
 
 
 class RestClient(Client):
@@ -326,40 +743,102 @@ class RestClient(Client):
         self._host = parsed.hostname
         self._port = parsed.port or (443 if self._https else 80)
         self._base_path = parsed.path.rstrip("/")
-        # One keep-alive connection per thread: the reconcile loop issues
-        # many serial calls, and async managers run on their own threads.
-        self._local = threading.local()
+        self._transport = _Transport(
+            self._host,
+            self._port,
+            self._ssl,
+            self._host if self._https else None,
+            timeout,
+        )
+        #: Accept header per the configured wire encoding; JSON unless
+        #: the caller opted into compact (see RestConfig.wire_encoding).
+        self._accept = (
+            CLIENT_ACCEPT_COMPACT
+            if config.wire_encoding == "compact"
+            else JSON_CONTENT_TYPE
+        )
+        #: Flips True the first time the server answers compact — only
+        #: then do write bodies switch to the compact encoding (a JSON-
+        #: only server must never receive a body it cannot parse).
+        self._server_speaks_compact = False
+        #: One-shot primed LIST results (see prime_list_cache).
+        self._list_cache: dict[tuple, tuple[list[KubeObject], str]] = {}
+        self._list_cache_lock = threading.Lock()
 
     @classmethod
     def from_environment(cls, context: str = "") -> "RestClient":
         return cls(RestConfig.from_environment(context=context))
 
     # -- HTTP plumbing -----------------------------------------------------
-    def _connection(self) -> http.client.HTTPConnection:
-        conn = getattr(self._local, "conn", None)
-        if conn is None:
-            if self._https:
-                conn = http.client.HTTPSConnection(
-                    self._host, self._port,
-                    timeout=self.timeout, context=self._ssl,
-                )
-            else:
-                conn = http.client.HTTPConnection(
-                    self._host, self._port, timeout=self.timeout
-                )
-            self._local.conn = conn
-        return conn
-
-    def _drop_connection(self) -> None:
-        conn = getattr(self._local, "conn", None)
-        if conn is not None:
-            conn.close()
-            self._local.conn = None
+    def _call(self, coro, timeout: Optional[float] = None):
+        """Run a transport coroutine on the shared wire loop, blocking
+        the calling thread — the sync facade over the async transport."""
+        future = asyncio.run_coroutine_threadsafe(coro, _get_wire_loop())
+        try:
+            # The transport enforces its own per-operation timeouts; the
+            # outer bound is a backstop so a lost loop cannot park the
+            # caller forever.
+            return future.result(
+                timeout if timeout is not None else self.timeout * 2 + 10
+            )
+        except _TransportError:
+            raise
+        except (asyncio.TimeoutError, concurrent.futures.TimeoutError):
+            # Both spellings: on 3.10 Future.result raises
+            # concurrent.futures.TimeoutError, a DISTINCT class from
+            # asyncio's (they only merge into builtins.TimeoutError in
+            # 3.11+) — catching one alone misses the backstop.
+            future.cancel()
+            raise _TransportError("wire-loop call timed out") from None
 
     def close(self) -> None:
-        """Close this thread's pooled connection and temp credential files."""
-        self._drop_connection()
+        """Close pooled connections and temp credential files."""
+        try:
+            self._call(self._transport.close())
+        except (_TransportError, RuntimeError):  # loop already gone
+            pass
         self.config.close()
+
+    def transport_stats(self) -> dict[str, int | bool]:
+        """Wire-path counters (the attribution the bench publishes):
+        connections opened, requests sent, pipelined batches, bytes in
+        each direction, watch frames received, and whether the server
+        negotiated the compact encoding."""
+        t = self._transport
+        return {
+            "connections_opened": t.connections_opened,
+            "requests_sent": t.requests_sent,
+            "pipelined_batches": t.pipelined_batches,
+            "bytes_sent": t.bytes_sent,
+            "bytes_received": t.bytes_received,
+            "watch_frames_received": t.watch_frames_received,
+            "server_speaks_compact": self._server_speaks_compact,
+        }
+
+    def _headers(
+        self, body: Optional[bytes], content_type: str
+    ) -> dict[str, str]:
+        headers = {"Accept": self._accept}
+        if body is not None:
+            headers["Content-Type"] = content_type
+        if self.config.token:
+            headers["Authorization"] = f"Bearer {self.config.token}"
+        return headers
+
+    def _encode_write_body(
+        self, body: "Mapping[str, Any] | list[Any]", content_type: str
+    ) -> tuple[bytes, str]:
+        """JSON unless (a) the caller opted into compact, (b) the server
+        has proven it speaks it, and (c) this is a plain object body —
+        patch bodies keep their semantic content types
+        (merge-patch+json & co) unconditionally."""
+        if (
+            self._server_speaks_compact
+            and content_type == JSON_CONTENT_TYPE
+            and self.config.wire_encoding == "compact"
+        ):
+            return encode_compact(body), COMPACT_CONTENT_TYPE
+        return json.dumps(body).encode(), content_type
 
     def _request(
         self,
@@ -372,47 +851,33 @@ class RestClient(Client):
         url = self._base_path + path
         if query:
             url += "?" + urllib.parse.urlencode(query)
-        data = json.dumps(body).encode() if body is not None else None
-        headers = {"Accept": "application/json"}
-        if data is not None:
-            headers["Content-Type"] = content_type
-        if self.config.token:
-            headers["Authorization"] = f"Bearer {self.config.token}"
-        for attempt in (0, 1):
-            conn = self._connection()
-            try:
-                conn.request(method, url, body=data, headers=headers)
-            except (http.client.HTTPException, OSError) as e:
-                # A stale keep-alive socket fails on first reuse; nothing
-                # was sent, so any method is safe to retry once fresh.
-                self._drop_connection()
-                if attempt == 0:
-                    continue
-                raise ApiError(f"{method} {url}: {e}") from None
-            try:
-                resp = conn.getresponse()
-                payload = resp.read()
-            except (http.client.HTTPException, OSError) as e:
-                self._drop_connection()
-                # The request may have been processed; only retry methods
-                # that are idempotent (POST create is not).
-                if attempt == 0 and method != "POST":
-                    continue
-                raise ApiError(f"{method} {url}: {e}") from None
-            if resp.will_close:
-                self._drop_connection()
-            break
-        if resp.status >= 400:
-            raise self._api_error(resp.status, payload)
+        data: Optional[bytes] = None
+        if body is not None:
+            data, content_type = self._encode_write_body(body, content_type)
+        try:
+            status, rheaders, payload = self._call(
+                self._transport.request(
+                    method, url, self._headers(data, content_type), data
+                )
+            )
+        except _TransportError as e:
+            raise ApiError(f"{method} {url}: {e}") from None
+        response_ct = rheaders.get("content-type")
+        if is_compact_content_type(response_ct):
+            self._server_speaks_compact = True
+        if status >= 400:
+            raise self._api_error(status, payload, response_ct)
         if not payload:
             return {}
-        return json.loads(payload)
+        return decode_body(payload, response_ct)
 
     @staticmethod
-    def _api_error(code: int, payload: bytes) -> ApiError:
+    def _api_error(
+        code: int, payload: bytes, content_type: Optional[str] = None
+    ) -> ApiError:
         reason, message = "", ""
         try:
-            status = json.loads(payload)
+            status = decode_body(payload, content_type)
             reason = status.get("reason", "")
             message = status.get("message", "")
         except Exception:
@@ -502,6 +967,9 @@ class RestClient(Client):
         info = resource_for_kind(kind)
         base_query = self._selector_query(label_selector, field_selector)
         path = self._collection_path(info, namespace)
+        primed = self._take_primed(kind, namespace, base_query)
+        if primed is not None:
+            return primed
         page_size = max(0, int(self.config.list_page_size or 0))
         try:
             return self._list_pages(path, base_query, page_size)
@@ -509,6 +977,92 @@ class RestClient(Client):
             if not page_size:
                 raise
             return self._list_pages(path, base_query, page_size=0)
+
+    # -- pipelined seed ----------------------------------------------------
+    @staticmethod
+    def _prime_key(kind: str, namespace: str, base_query: dict) -> tuple:
+        return (kind, namespace, tuple(sorted(base_query.items())))
+
+    def _take_primed(
+        self, kind: str, namespace: str, base_query: dict
+    ) -> Optional[tuple[list[KubeObject], str]]:
+        with self._list_cache_lock:
+            return self._list_cache.pop(
+                self._prime_key(kind, namespace, base_query), None
+            )
+
+    def prime_list_cache(
+        self,
+        specs: list[tuple[str, str, Optional[str | Mapping[str, str]],
+                          Optional[str]]],
+    ) -> int:
+        """Pipeline a batch of collection LISTs — ``(kind, namespace,
+        label_selector, field_selector)`` each — on ONE pooled
+        connection and cache the results; the next matching
+        ``list_with_revision`` call consumes its entry (one-shot). The
+        informer-seed fast path: N kinds' LISTs (and their paged
+        continues, batched round by round) cost one round trip per
+        batch instead of one per page. Returns how many lists were
+        primed; a spec whose request failed is simply not cached — the
+        consumer's own list surfaces the error on the normal path.
+
+        Staleness is covered by the list-then-watch contract: each
+        cached result carries its collection revision, and the
+        consumer's watch resumes from it, replaying anything that
+        happened after the prime."""
+        pending: list[dict] = []
+        for kind, namespace, label_selector, field_selector in specs:
+            info = resource_for_kind(kind)
+            base_query = self._selector_query(label_selector, field_selector)
+            query = dict(base_query)
+            page_size = max(0, int(self.config.list_page_size or 0))
+            if page_size:
+                query["limit"] = str(page_size)
+            pending.append({
+                "key": self._prime_key(kind, namespace, base_query),
+                "path": self._collection_path(info, namespace),
+                "query": query,
+                "items": [],
+                "revision": "",
+            })
+        headers = self._headers(None, JSON_CONTENT_TYPE)
+        primed = 0
+        while pending:
+            batch = []
+            for spec in pending:
+                url = self._base_path + spec["path"]
+                if spec["query"]:
+                    url += "?" + urllib.parse.urlencode(spec["query"])
+                batch.append(("GET", url, headers, None))
+            try:
+                responses = self._call(self._transport.request_many(batch))
+            except _TransportError:
+                return primed  # seed is best-effort; lists retry normally
+            next_round = []
+            for spec, (status, rheaders, payload) in zip(pending, responses):
+                if status >= 400:
+                    continue  # not cached; the consumer's list re-asks
+                if is_compact_content_type(rheaders.get("content-type")):
+                    self._server_speaks_compact = True
+                out = decode_body(payload, rheaders.get("content-type"))
+                spec["items"].extend(
+                    wrap(item) for item in out.get("items") or []
+                )
+                meta = out.get("metadata") or {}
+                if not spec["revision"]:
+                    spec["revision"] = str(meta.get("resourceVersion", ""))
+                continue_token = str(meta.get("continue") or "")
+                if continue_token:
+                    spec["query"]["continue"] = continue_token
+                    next_round.append(spec)
+                    continue
+                with self._list_cache_lock:
+                    self._list_cache[spec["key"]] = (
+                        spec["items"], spec["revision"]
+                    )
+                primed += 1
+            pending = next_round
+        return primed
 
     def _list_pages(
         self, path: str, base_query: dict, page_size: int
@@ -586,64 +1140,55 @@ class RestClient(Client):
             query["resourceVersion"] = resource_version
         path = self._collection_path(info, namespace)
         url = self._base_path + path + "?" + urllib.parse.urlencode(query)
-        headers = {"Accept": "application/json"}
-        if self.config.token:
-            headers["Authorization"] = f"Bearer {self.config.token}"
-        # Socket timeout must outlive the server-side stream bound
+        headers = self._headers(None, JSON_CONTENT_TYPE)
+        # Frame-read timeout must outlive the server-side stream bound
         # (timeout_seconds is always set by this point — see above).
-        sock_timeout = timeout_seconds + self.timeout
-        if self._https:
-            conn = http.client.HTTPSConnection(
-                self._host, self._port, timeout=sock_timeout, context=self._ssl
-            )
-        else:
-            conn = http.client.HTTPConnection(
-                self._host, self._port, timeout=sock_timeout
-            )
-        if handle is not None:
-            handle._conn = conn
-            if handle.cancelled:
-                # cancel() ran between handle creation and this point; it
-                # saw no connection to close, so honor the flag here.
-                conn.close()
-                return
+        read_timeout = timeout_seconds + self.timeout
+        frames: queue_mod.Queue = queue_mod.Queue()
+        future = asyncio.run_coroutine_threadsafe(
+            self._transport.watch_pump(
+                url, headers, frames, handle, read_timeout
+            ),
+            _get_wire_loop(),
+        )
         try:
-            conn.request("GET", url, headers=headers)
-            resp = conn.getresponse()
-            if handle is not None:
-                # On a Connection:-close stream http.client hands the
-                # socket to the RESPONSE and nulls conn.sock — capture
-                # the live socket so cancel() can shutdown() it (the
-                # only call that unblocks a parked recv).
-                handle._attach_response(resp)
-                if handle.cancelled:
-                    resp.close()
-                    return
-            if resp.status >= 400:
-                raise self._api_error(resp.status, resp.read())
             while True:
                 try:
-                    line = resp.readline()
-                except (OSError, ValueError):
-                    # ValueError: "I/O operation on closed file" — the
-                    # handle cancelled us mid-read.
+                    kind_, payload = frames.get(timeout=read_timeout + 10)
+                except queue_mod.Empty:
+                    # The pump always terminates the queue; an empty get
+                    # this long past the window means the loop is gone.
+                    raise ApiError(f"GET {url}: watch stream stalled")
+                if kind_ == "event":
+                    event = payload
+                    if event.get("type") == "ERROR":
+                        # A real apiserver reports mid-stream failure
+                        # (notably 410 Expired) INSIDE the 200 stream as
+                        # an ERROR frame carrying a Status object;
+                        # surfacing it as data would leave consumers
+                        # looping on a stale resourceVersion.
+                        status = event.get("object") or {}
+                        code = int(status.get("code") or 500)
+                        raise self._api_error(
+                            code, json.dumps(status).encode()
+                        )
+                    yield event["type"], wrap(event["object"])
+                elif kind_ == "end":
+                    return  # server ended the stream (timeout / shutdown)
+                elif kind_ == "httperror":
+                    status, content_type, body = payload
+                    raise self._api_error(status, body, content_type)
+                else:  # "error"
                     if handle is not None and handle.cancelled:
                         return
-                    raise
-                if not line:
-                    return  # server ended the stream (timeout / shutdown)
-                event = json.loads(line)
-                if event.get("type") == "ERROR":
-                    # A real apiserver reports mid-stream failure (notably
-                    # 410 Expired) INSIDE the 200 stream as an ERROR frame
-                    # carrying a Status object; surfacing it as data would
-                    # leave consumers looping on a stale resourceVersion.
-                    status = event.get("object") or {}
-                    code = int(status.get("code") or 500)
-                    raise self._api_error(code, json.dumps(status).encode())
-                yield event["type"], wrap(event["object"])
+                    raise ApiError(f"GET {url}: {payload}")
         finally:
-            conn.close()
+            if not future.done():
+                # Consumer abandoned the stream mid-window (break /
+                # GeneratorExit / error): cancel the pump, which aborts
+                # the connection — a half-read stream never re-enters
+                # the pool.
+                future.cancel()
 
     @staticmethod
     def _write_query(field_manager: str, dry_run: bool) -> Optional[dict]:

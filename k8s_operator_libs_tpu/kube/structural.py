@@ -22,7 +22,9 @@ apiextensions-apiserver/pkg/apiserver/schema semantics):
 * ``required``, ``enum``, ``minimum``/``maximum`` (+ boolean
   ``exclusiveMinimum``/``exclusiveMaximum``), ``minLength``/
   ``maxLength``, ``pattern``, ``minItems``/``maxItems``,
-  ``uniqueItems``, ``allOf``/``anyOf``/``oneOf``/``not``
+  ``allOf``/``anyOf``/``oneOf``/``not``; ``uniqueItems: true`` is
+  REJECTED at CRD admission like upstream apiextensions (the 422 a
+  real apiserver answers — a CRD never gains non-upstream validation)
 * ``format`` is accepted but not enforced (upstream treats most formats
   as annotations for CRDs; enforcing none is the closest uniform rule)
 
@@ -104,6 +106,15 @@ def _check_structural(
             "of schemas"
         )
         items = None
+    if node.get("uniqueItems"):
+        # apiextensions forbids uniqueItems: true ANYWHERE in a
+        # structural schema (deep-equality dedup is O(n^2) server work
+        # an admitted object could weaponize) — the CRD is 422'd at
+        # admission, it does not gain non-upstream validation behavior.
+        errors.append(
+            f"{path}.uniqueItems: Forbidden: uniqueItems cannot be set "
+            "to true"
+        )
     typed = (
         node.get("type")
         or node.get("x-kubernetes-int-or-string")
@@ -162,6 +173,13 @@ def _check_junctor(
                 f"{path}.{forbidden}: Forbidden: must not be set "
                 "inside allOf/anyOf/oneOf/not"
             )
+    if node.get("uniqueItems"):
+        # Forbidden in junctor subtrees too — upstream's rule is
+        # schema-wide, not structure-subtree-only.
+        errors.append(
+            f"{path}.uniqueItems: Forbidden: uniqueItems cannot be set "
+            "to true"
+        )
     props = node.get("properties")
     if isinstance(props, Mapping):
         for key, sub in props.items():

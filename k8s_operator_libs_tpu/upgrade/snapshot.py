@@ -248,7 +248,31 @@ class InformerSnapshotSource:
     def start(self, sync_timeout: float = 30.0) -> "InformerSnapshotSource":
         """Start all informers and block until their initial lists have
         populated the stores — a snapshot taken before sync would be
-        empty, not stale."""
+        empty, not stale.
+
+        When the client supports it (RestClient), the informers' seed
+        LISTs are first PIPELINED as one batch on one connection
+        (``prime_list_cache``): each informer's initial list consumes
+        its primed result, so the read-heavy seed costs one round trip
+        per page batch instead of one per kind per page. Best-effort —
+        a failed prime just leaves the normal list path to do the work
+        (and surface the error)."""
+        prime = getattr(self._client, "prime_list_cache", None)
+        if prime is not None:
+            try:
+                prime([
+                    (
+                        informer.kind,
+                        informer.namespace,
+                        informer.label_selector,
+                        informer.field_selector,
+                    )
+                    for informer in self._informers.values()
+                    if not informer.started
+                ])
+            except Exception:  # noqa: BLE001 - seed is an optimization
+                log.debug("pipelined informer seed failed; lists will re-ask",
+                          exc_info=True)
         for informer in self._informers.values():
             if not informer.started:
                 informer.start()

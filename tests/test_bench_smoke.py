@@ -153,6 +153,23 @@ def test_http_wire_roll_converges():
     assert out["passes"] >= 1
     assert out["wall_s"] > 0
     assert out["transport"].startswith("http")
+    # The asyncio wire rebuild's attribution: pooled keep-alive
+    # connections carry the whole roll (also hard-asserted in-bench).
+    attribution = out["attribution"]
+    assert attribution["reuse_ratio_requests_per_connection"] >= 20
+    assert attribution["server_connections_opened"] <= 4
+    assert out["passes_per_s"] > 0
+
+
+def test_wire_encoding_shapes():
+    # Small pool keeps it cheap; the <0.7 ratio and exact round-trip
+    # are hard-asserted inside the section itself.
+    out = bench.run_wire_encoding(nodes=16)
+    assert out["nodes"] == 16
+    assert 0 < out["compact_bytes_per_list"] < out["json_bytes_per_list"]
+    assert out["compact_vs_json_bytes_ratio"] < 0.7
+    # Over-the-wire bytes agree with the raw codec comparison.
+    assert out["wire_compact_bytes_per_list"] < out["wire_json_bytes_per_list"]
 
 
 def test_trials_aggregation():

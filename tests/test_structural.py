@@ -679,6 +679,47 @@ class TestCrdStructuralAdmission:
         assert "anyOf[0].type: Forbidden" in message
         assert "anyOf[0].additionalProperties: Forbidden" in message
 
+    def test_unique_items_rejected_at_crd_admission(self):
+        """ADVICE.md gap closed: upstream apiextensions forbids
+        ``uniqueItems: true`` anywhere in a structural schema — the CRD
+        422s at admission instead of being admitted and gaining
+        non-upstream validation behavior."""
+        cluster = FakeCluster()
+        with pytest.raises(InvalidError) as exc:
+            cluster.create(self.base_crd({
+                "type": "object",
+                "properties": {
+                    "spec": {
+                        "type": "object",
+                        "properties": {
+                            "tags": {"type": "array",
+                                     "uniqueItems": True,
+                                     "items": {"type": "string"}},
+                        },
+                    },
+                },
+            }))
+        message = str(exc.value)
+        assert "uniqueItems: Forbidden" in message
+        assert "cannot be set to true" in message
+        # ...including inside junctor subtrees — the rule is schema-wide.
+        with pytest.raises(InvalidError) as exc:
+            cluster.create(self.base_crd({
+                "type": "object",
+                "properties": {
+                    "v": {"anyOf": [{"uniqueItems": True}]},
+                },
+            }))
+        assert "anyOf[0].uniqueItems: Forbidden" in str(exc.value)
+        # uniqueItems: false (and absent) stay admitted, like upstream.
+        cluster.create(self.base_crd({
+            "type": "object",
+            "properties": {
+                "tags": {"type": "array", "uniqueItems": False,
+                         "items": {"type": "string"}},
+            },
+        }))
+
     def test_int_or_string_junctor_exception(self):
         """The canonical int-or-string pattern — anyOf naming types
         under x-kubernetes-int-or-string — is upstream-legal."""

@@ -1,0 +1,498 @@
+"""The async wire path (docs/wire-path.md): connection reuse, request
+pipelining, compact-encoding negotiation, streamed watch frames, and
+bookmark-resume across a killed connection.
+
+Everything protocol-level crosses a real HTTP boundary against
+``LocalApiServer``; codec unit tests exercise ``kube/wire.py`` directly.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time
+
+import pytest
+
+from builders import make_node, make_pod
+from k8s_operator_libs_tpu.kube import (
+    Informer,
+    LocalApiServer,
+    RestClient,
+    RestConfig,
+)
+from k8s_operator_libs_tpu.kube.wire import (
+    CLIENT_ACCEPT_COMPACT,
+    COMPACT_CONTENT_TYPE,
+    FrameDecoder,
+    WireDecodeError,
+    decode_compact,
+    encode_compact,
+    encode_watch_frame,
+    negotiate_encoding,
+)
+
+
+def wait_until(predicate, timeout=10.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class TestCompactCodec:
+    CASES = [
+        None, True, False, 0, 1, -1, 7, 2**40, -(2**40), 1.5, -0.25,
+        "", "plain", "héllo 世界", [], {}, [1, [2, [3]]],
+        {"a": 1, "b": {"a": 2}},
+        {"metadata": {"name": "n", "labels": {"app": "x"}},
+         "items": [{"metadata": {"name": f"n{i}"}} for i in range(10)]},
+    ]
+
+    def test_round_trips(self):
+        for case in self.CASES:
+            assert decode_compact(encode_compact(case)) == case
+
+    def test_key_table_compresses_repeated_keys(self):
+        items = [{"metadata": {"name": f"node-{i}", "labels": {"a": "b"}}}
+                 for i in range(64)]
+        doc = {"items": items}
+        compact = encode_compact(doc)
+        as_json = json.dumps(doc).encode()
+        # Repeated keys collapse to back-references: the compact form
+        # must be substantially smaller on list-shaped payloads.
+        assert len(compact) < 0.7 * len(as_json)
+        assert decode_compact(compact) == doc
+
+    def test_truncated_payload_raises(self):
+        data = encode_compact({"a": [1, 2, 3]})
+        with pytest.raises(WireDecodeError):
+            decode_compact(data[:-2])
+
+    def test_trailing_bytes_raise(self):
+        with pytest.raises(WireDecodeError):
+            decode_compact(encode_compact({"a": 1}) + b"\x00")
+
+    def test_bad_tag_raises(self):
+        with pytest.raises(WireDecodeError):
+            decode_compact(b"\xff")
+
+    def test_non_string_keys_rejected(self):
+        with pytest.raises(TypeError):
+            encode_compact({1: "x"})
+
+
+class TestNegotiation:
+    def test_compact_only_when_asked(self):
+        assert negotiate_encoding(CLIENT_ACCEPT_COMPACT) == "compact"
+        assert negotiate_encoding(COMPACT_CONTENT_TYPE) == "compact"
+        assert negotiate_encoding("application/json") == "json"
+        assert negotiate_encoding("") == "json"
+        assert negotiate_encoding(None) == "json"
+        # kubectl's Table accept is JSON with parameters, not compact.
+        assert negotiate_encoding(
+            "application/json;as=Table;v=v1;g=meta.k8s.io"
+        ) == "json"
+
+    def test_frame_decoder_spans_chunk_boundaries(self):
+        frames = b"".join(
+            encode_watch_frame({"type": "ADDED", "object": {"i": i}},
+                               "compact")
+            for i in range(5)
+        )
+        decoder = FrameDecoder(COMPACT_CONTENT_TYPE)
+        got = []
+        for i in range(0, len(frames), 3):  # drip-feed in 3-byte pieces
+            got.extend(e["object"]["i"] for e in decoder.feed(frames[i:i + 3]))
+        assert got == [0, 1, 2, 3, 4]
+        assert decoder.pending_bytes == 0
+
+
+class TestConnectionReuse:
+    def test_n_requests_one_connection(self):
+        """The pool-reuse contract: N sequential requests ride ONE
+        socket (the counting hook is the server's accept counter)."""
+        with LocalApiServer() as server:
+            client = RestClient(RestConfig(server=server.url))
+            try:
+                for i in range(10):
+                    client.create(make_node(f"reuse-{i}"))
+                    assert client.get("Node", f"reuse-{i}") is not None
+                assert len(client.list("Node")) == 10
+                assert server.connections_opened == 1
+                assert client.transport_stats()["connections_opened"] == 1
+                assert server.requests_served == 21
+            finally:
+                client.close()
+
+    def test_watch_windows_reuse_the_held_connection(self):
+        """A watch window ends with the terminal chunk, NOT a connection
+        close: consecutive windows (and follow-up requests) ride the
+        same socket — the no-TCP-per-window contract."""
+        with LocalApiServer() as server:
+            client = RestClient(RestConfig(server=server.url))
+            try:
+                for _ in range(3):
+                    assert list(client.watch("Node", timeout_seconds=0)) == []
+                client.list("Node")
+                assert server.connections_opened == 1
+            finally:
+                client.close()
+
+    def test_pipelined_batch_uses_one_connection_in_order(self):
+        with LocalApiServer() as server:
+            server.cluster.create(make_node("pipe-a"))
+            server.cluster.create(make_pod("pipe-p", namespace="ns-1"))
+            client = RestClient(RestConfig(server=server.url))
+            try:
+                primed = client.prime_list_cache([
+                    ("Node", "", None, None),
+                    ("Pod", "ns-1", None, None),
+                    ("DaemonSet", "ns-1", None, None),
+                ])
+                assert primed == 3
+                assert server.connections_opened == 1
+                assert client.transport_stats()["pipelined_batches"] == 1
+                # Each primed result is consumed exactly once, then the
+                # normal list path takes over.
+                nodes, rv = client.list_with_revision("Node")
+                assert [n.name for n in nodes] == ["pipe-a"] and rv
+                log = server.start_request_log()
+                nodes2, _ = client.list_with_revision("Node")
+                assert [n.name for n in nodes2] == ["pipe-a"]
+                assert len(server.stop_request_log()) == 1  # re-asked
+            finally:
+                client.close()
+
+
+class TestContentNegotiationFallback:
+    def test_compact_client_gets_compact_responses(self):
+        with LocalApiServer() as server:
+            client = RestClient(
+                RestConfig(server=server.url, wire_encoding="compact")
+            )
+            try:
+                client.create(make_node("compact-n", labels={"a": "b"}))
+                got = client.get("Node", "compact-n")
+                assert got.labels == {"a": "b"}
+                stats = client.transport_stats()
+                assert stats["server_speaks_compact"] is True
+                # Write bodies switched to compact after the first
+                # compact response proved the server speaks it.
+                updated = client.patch(
+                    "Node", "compact-n",
+                    patch={"metadata": {"labels": {"a": "c"}}},
+                )
+                assert updated.labels["a"] == "c"
+            finally:
+                client.close()
+
+    def test_json_client_untouched_by_compact_capable_server(self):
+        """Old client ↔ new server: a JSON-only caller (no compact in
+        Accept) gets JSON, byte-compatible with the previous stack."""
+        with LocalApiServer() as server:
+            server.cluster.create(make_node("json-n"))
+            conn = http.client.HTTPConnection(*server.server_address)
+            try:
+                conn.request("GET", "/api/v1/nodes",
+                             headers={"Accept": "application/json"})
+                resp = conn.getresponse()
+                assert resp.status == 200
+                assert resp.getheader("Content-Type") == "application/json"
+                doc = json.loads(resp.read())
+                assert doc["kind"] == "NodeList"
+                # No Accept header at all degrades to JSON too.
+                conn.request("GET", "/api/v1/nodes")
+                resp = conn.getresponse()
+                assert resp.getheader("Content-Type") == "application/json"
+                json.loads(resp.read())
+            finally:
+                conn.close()
+
+    def test_compact_client_against_json_only_server(self):
+        """New client ↔ old server: a server that has never heard of the
+        compact media type answers JSON; the client decodes by response
+        Content-Type and keeps working — and never sends compact write
+        bodies at a server that has not proven it speaks compact."""
+        import socketserver
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+
+        seen_content_types = []
+
+        class JsonOnly(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _send(self, doc):
+                payload = json.dumps(doc).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                self._send({"apiVersion": "v1", "kind": "NodeList",
+                            "metadata": {"resourceVersion": "1"},
+                            "items": []})
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length)
+                seen_content_types.append(
+                    self.headers.get("Content-Type", "")
+                )
+                self._send(json.loads(body))  # JSON body expected
+
+            def log_message(self, *args):
+                pass
+
+        class Server(socketserver.ThreadingMixIn, HTTPServer):
+            daemon_threads = True
+
+        httpd = Server(("127.0.0.1", 0), JsonOnly)
+        thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+        thread.start()
+        try:
+            client = RestClient(RestConfig(
+                server=f"http://127.0.0.1:{httpd.server_address[1]}",
+                wire_encoding="compact",
+            ))
+            try:
+                assert client.list("Node") == []
+                created = client.create(make_node("fallback-n"))
+                assert created.name == "fallback-n"
+                assert seen_content_types == ["application/json"]
+                assert client.transport_stats()[
+                    "server_speaks_compact"
+                ] is False
+            finally:
+                client.close()
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+    def test_compact_watch_frames_end_to_end(self):
+        with LocalApiServer() as server:
+            client = RestClient(
+                RestConfig(server=server.url, wire_encoding="compact")
+            )
+            try:
+                got = []
+                done = threading.Event()
+
+                def consume():
+                    for event_type, obj in client.watch(
+                        "Node", timeout_seconds=10
+                    ):
+                        got.append((event_type, obj.name))
+                        done.set()
+                        return
+
+                thread = threading.Thread(target=consume, daemon=True)
+                thread.start()
+                time.sleep(0.3)
+                server.cluster.create(make_node("compact-w"))
+                assert done.wait(timeout=10)
+                thread.join(timeout=5)
+                assert got == [("ADDED", "compact-w")]
+                assert client.transport_stats()[
+                    "watch_frames_received"
+                ] >= 1
+            finally:
+                client.close()
+
+
+class TestErrorMapping:
+    def test_unreachable_server_raises_api_error(self):
+        """Connection-establishment failures map into the typed-error
+        path like every other transport failure: leader election's
+        'never raises on API errors' campaign loop catches ApiError
+        only, and a raw ConnectionRefusedError would kill its thread."""
+        from k8s_operator_libs_tpu.kube import ApiError
+
+        # A port nothing listens on: bind-then-close guarantees refusal.
+        import socket
+
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+        probe.close()
+        client = RestClient(
+            RestConfig(server=f"http://127.0.0.1:{port}"), timeout=2.0
+        )
+        try:
+            with pytest.raises(ApiError):
+                client.get("Node", "ghost")
+            with pytest.raises(ApiError):
+                list(client.watch("Node", timeout_seconds=1))
+            # The pipelined seed is best-effort: an unreachable server
+            # primes nothing and raises nothing.
+            assert client.prime_list_cache([("Node", "", None, None)]) == 0
+        finally:
+            client.close()
+
+    def test_expect_100_continue_gets_interim_response(self):
+        """A conforming client sending Expect: 100-continue waits for
+        the interim response before the body — the server must send it
+        (the threaded implementation did automatically)."""
+        with LocalApiServer() as server:
+            import socket
+
+            body = json.dumps({
+                "apiVersion": "v1", "kind": "Node",
+                "metadata": {"name": "expect-n"},
+            }).encode()
+            sock = socket.create_connection(server.server_address, timeout=5)
+            try:
+                sock.sendall(
+                    b"POST /api/v1/nodes HTTP/1.1\r\n"
+                    b"Host: x\r\nContent-Type: application/json\r\n"
+                    b"Expect: 100-continue\r\n"
+                    + f"Content-Length: {len(body)}\r\n\r\n".encode()
+                )
+                # The interim response must arrive BEFORE the body is sent.
+                interim = sock.recv(64)
+                assert interim.startswith(b"HTTP/1.1 100 Continue"), interim
+                sock.sendall(body)
+                final = sock.recv(65536)
+                assert b"201" in final.split(b"\r\n", 1)[0], final[:80]
+            finally:
+                sock.close()
+            assert server.cluster.get("Node", "expect-n") is not None
+
+
+class TestBookmarkResume:
+    def test_informer_resumes_from_bookmark_after_killed_connection(self):
+        """The killed-connection arc: a watch connection dying mid-
+        stream costs ONE re-watch from the last bookmarked revision —
+        not a re-LIST. The informer store stays synced throughout."""
+        with LocalApiServer(bookmark_interval_s=0.1) as server:
+            server.cluster.create(make_node("bm-keep"))
+            client = RestClient(RestConfig(server=server.url))
+            informer = Informer(client, "Node", watch_timeout_seconds=30)
+            events = []
+            informer.add_event_handler(
+                lambda e, obj, old: events.append((e, obj.name))
+            )
+            try:
+                informer.start()
+                assert informer.wait_for_sync(timeout=10)
+                # Let bookmarks advance the resume point past the seed.
+                for i in range(3):
+                    server.cluster.create(make_node(f"bm-pre-{i}"))
+                assert wait_until(
+                    lambda: informer.get("bm-pre-2") is not None
+                )
+                log = server.start_request_log()
+                assert server.kill_connections() >= 1
+                # The informer recovers: new events flow again...
+                server.cluster.create(make_node("bm-post"))
+                assert wait_until(
+                    lambda: informer.get("bm-post") is not None, timeout=15
+                )
+                requests = server.stop_request_log()
+                # ...through a RESUMED watch — no LIST was issued.
+                lists = [
+                    (m, p, q) for m, p, q in requests
+                    if m == "GET" and q.get("watch") not in ("true", "1")
+                ]
+                watches = [
+                    (m, p, q) for m, p, q in requests
+                    if q.get("watch") in ("true", "1")
+                ]
+                assert lists == [], f"resume re-listed: {lists}"
+                assert watches, "no resumed watch observed"
+                # The resumed watch carried a resourceVersion (the
+                # bookmark-kept resume point), not a from-scratch watch.
+                assert all(
+                    q.get("resourceVersion") for _, _, q in watches
+                ), watches
+                # Nothing was lost or duplicated into oblivion: the
+                # store matches the cluster.
+                assert informer.get("bm-keep") is not None
+            finally:
+                informer.stop()
+                client.close()
+
+    def test_repeated_failures_degrade_to_relist(self):
+        """Resume is bounded: when the stream keeps dying (here: the
+        resume revision is gone from the journal → 410), the informer
+        falls back to the re-list repair path instead of spinning."""
+        with LocalApiServer() as server:
+            client = RestClient(RestConfig(server=server.url))
+            informer = Informer(client, "Node", watch_timeout_seconds=30)
+            try:
+                informer.start()
+                assert informer.wait_for_sync(timeout=10)
+                # Compact the journal far past the informer's resume
+                # point while its connection is down.
+                for i in range(60):
+                    server.cluster.create(make_node(f"churn-{i}"))
+                while len(server.cluster._history) > 3:
+                    server.cluster._history.popleft()
+                server.kill_connections()
+                # 410 on resume → re-list repairs the store.
+                assert wait_until(
+                    lambda: informer.get("churn-59") is not None, timeout=15
+                )
+            finally:
+                informer.stop()
+                client.close()
+
+
+class TestTableWatch:
+    def test_table_negotiated_watch_streams_table_frames(self):
+        """kubectl get -w: a watch with ``Accept: ...;as=Table`` gets
+        Table-transformed event frames over raw HTTP, one row per
+        event, not raw objects (ADVICE.md apiserver gap)."""
+        with LocalApiServer() as server:
+            server.cluster.create(make_node("tbl-seed"))
+            conn = http.client.HTTPConnection(*server.server_address)
+            try:
+                conn.request(
+                    "GET",
+                    "/api/v1/nodes?watch=true&timeoutSeconds=5"
+                    "&resourceVersion=0",
+                    headers={
+                        "Accept": (
+                            "application/json;as=Table;v=v1;g=meta.k8s.io"
+                        )
+                    },
+                )
+                resp = conn.getresponse()
+                assert resp.status == 200
+                event = json.loads(resp.readline())
+                assert event["type"] == "ADDED"
+                table = event["object"]
+                assert table["kind"] == "Table"
+                assert table["apiVersion"] == "meta.k8s.io/v1"
+                names = [c["name"] for c in table["columnDefinitions"]]
+                assert names[0] == "Name"
+                assert len(table["rows"]) == 1
+                assert table["rows"][0]["cells"][0] == "tbl-seed"
+                # Default includeObject: rows carry PartialObjectMetadata.
+                assert (
+                    table["rows"][0]["object"]["kind"]
+                    == "PartialObjectMetadata"
+                )
+            finally:
+                conn.close()
+
+    def test_plain_watch_still_streams_raw_objects(self):
+        with LocalApiServer() as server:
+            server.cluster.create(make_node("raw-seed"))
+            conn = http.client.HTTPConnection(*server.server_address)
+            try:
+                conn.request(
+                    "GET",
+                    "/api/v1/nodes?watch=true&timeoutSeconds=5"
+                    "&resourceVersion=0",
+                )
+                resp = conn.getresponse()
+                event = json.loads(resp.readline())
+                assert event["object"]["kind"] == "Node"
+            finally:
+                conn.close()
