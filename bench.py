@@ -1417,6 +1417,287 @@ def run_degraded_first_roll(slices: int = 4, hosts_per_slice: int = 4) -> dict:
     }
 
 
+def run_fleet_64_pools(
+    pools: int = 64,
+    hosts_per_pool: int = 4,
+    worker_counts: tuple = (1, 2, 4),
+    shards: int = 8,
+    min_scaling_x: float = 2.0,
+) -> dict:
+    """ISSUE 10 headline — the fleet tier at ROADMAP item 1's scale: 64
+    pools / 256 nodes rolled over a REAL wire (every worker a RestClient
+    against one LocalApiServer — the first code exercising the PR 9
+    asyncio wire path at fleet fan-out), from 1, 2, and 4 cooperating
+    shard workers under one global disruption budget (FleetRollout,
+    maxUnavailablePools=25% -> 16 pools).
+
+    Hard-asserted, per configuration:
+
+    * **zero global-budget violations** — no sample ever observes more
+      than 16 pools disrupted at once, regardless of worker count;
+    * **degraded pools enter the pipeline first** — 6 pools carry
+      straggler NodeHealthReports (published before the roll; folded
+      through each worker's SHARD-SCOPED HealthSource into the
+      orchestrator's global queue), and the first 6 grants are exactly
+      those pools;
+    * **scaling** — 4 workers achieve >= 2x aggregate passes/s vs 1
+      worker on the same fleet (the CI floor pins the measured ~x at
+      tools/bench_smoke_baseline.json: fleet_64_pools.scaling_4w_vs_1w).
+    """
+    import threading
+
+    from k8s_operator_libs_tpu.api import (
+        DriverUpgradePolicySpec as _Policy,
+        make_fleet_rollout,
+        pools_in_phase,
+    )
+    from k8s_operator_libs_tpu.fleet import (
+        FleetHealthAggregator,
+        FleetOrchestrator,
+        FleetWorkerConfig,
+        ShardWorker,
+        shard_id,
+    )
+    from k8s_operator_libs_tpu.kube import LocalApiServer, RestClient, RestConfig
+    from k8s_operator_libs_tpu.kube.objects import KubeObject
+    from k8s_operator_libs_tpu.tpu.monitor import ReportPublisher
+
+    pool_names = [f"s{i}" for i in range(pools)]
+    degraded_pools = [f"s{i}" for i in range(1, min(7, pools))]
+
+    def pool_of(node_name: str) -> str:
+        return node_name.split("-")[0]
+
+    def one_config(n_workers: int) -> dict:
+        with LocalApiServer() as srv:
+            _, sim = build_pool(
+                cluster=srv.cluster, slices=pools,
+                hosts_per_slice=hosts_per_pool,
+            )
+            # Straggler telemetry lands BEFORE the workers start, so the
+            # scoped health informers seed it and the first grant batch
+            # is health-ordered.
+            for pool in degraded_pools:
+                ReportPublisher(
+                    srv.cluster, f"{pool}-h0", heartbeat_seconds=0.0
+                ).publish(
+                    {"ring_allreduce": False},
+                    {"ring_gbytes_per_s": 1.5, "probe_latency_s": 180.0},
+                )
+            rollout = make_fleet_rollout("fleet-roll", pool_names, "25%")
+            srv.cluster.create(KubeObject(rollout))
+            from k8s_operator_libs_tpu.api import rollout_spec
+
+            budget = rollout_spec(rollout).resolved_budget()  # 16 at 64
+            aggregator = FleetHealthAggregator(pool_of)
+            workers, clients = [], []
+            for i in range(n_workers):
+                client = RestClient(RestConfig(server=srv.url))
+                worker = ShardWorker(
+                    client,
+                    FleetWorkerConfig(
+                        identity=f"worker-{i}",
+                        shards=shards,
+                        namespace=NS,
+                        driver_labels=DS_LABELS,
+                        pool_of=pool_of,
+                        rollout_name="fleet-roll",
+                        # Round-robin preference: deterministic balance
+                        # for the scaling comparison.
+                        preferred_shards=[
+                            shard_id(j)
+                            for j in range(shards)
+                            if j % n_workers == i
+                        ],
+                        lease_duration_s=5.0,
+                        renew_deadline_s=3.0,
+                        retry_period_s=0.5,
+                        with_health=True,
+                    ),
+                )
+                worker.start(sync_timeout=60)
+                aggregator.add_source(worker.health)
+                workers.append(worker)
+                clients.append(client)
+            orch_client = RestClient(RestConfig(server=srv.url))
+            orchestrator = FleetOrchestrator(
+                orch_client, "fleet-roll", aggregator=aggregator
+            )
+            policy = _Policy(
+                auto_upgrade=True,
+                max_parallel_upgrades=0,
+                # Permissive per-pool budget: the GRANT is the budget in
+                # the fleet shape (docs/fleet-control-plane.md).
+                max_unavailable=IntOrString("100%"),
+            )
+            stop = threading.Event()
+            try:
+                # Settle: every shard claimed and every straggler report
+                # folded before the first grant round (deadline-driven).
+                deadline = time.time() + 60
+                while True:
+                    for worker in workers:
+                        worker.tick(policy)
+                    owned = set()
+                    for worker in workers:
+                        owned |= worker.owned_shards()
+                    folded = sum(
+                        1
+                        for _, (score, _t) in aggregator.pool_health().items()
+                        if score < 60.0
+                    )
+                    if len(owned) == shards and folded >= len(degraded_pools):
+                        break
+                    if time.time() > deadline:
+                        raise RuntimeError(
+                            "fleet_64_pools: claims/health never settled "
+                            f"(owned={sorted(owned)}, folded={folded})"
+                        )
+                    time.sleep(0.02)
+                passes_before = [w.passes for w in workers]
+
+                sim.set_template_hash("libtpu-v2")
+                #: identity -> last reconcile error string: a persistent
+                #: worker-side crash must surface in the convergence
+                #: timeout, not vanish into the retry loop.
+                last_errors: dict = {}
+
+                def run_worker(worker: ShardWorker) -> None:
+                    while not stop.is_set():
+                        try:
+                            worker.tick(policy)
+                            last_errors.pop(worker.config.identity, None)
+                        except Exception as e:  # noqa: BLE001 - retried
+                            last_errors[worker.config.identity] = (
+                                f"{type(e).__name__}: {e}"
+                            )
+                            time.sleep(0.002)
+
+                threads = [
+                    threading.Thread(
+                        target=run_worker, args=(w,), daemon=True,
+                        name=f"fleet-{w.config.identity}",
+                    )
+                    for w in workers
+                ]
+                start = time.perf_counter()
+                for thread in threads:
+                    thread.start()
+                violations = 0
+                max_disrupted = 0
+                samples = 0
+                deadline = start + 300.0
+                while True:
+                    sim.step()
+                    orchestrator.tick()
+                    disrupted = set()
+                    for name in srv.cluster.object_names("Node"):
+                        raw = srv.cluster.peek("Node", name) or {}
+                        spec = raw.get("spec") or {}
+                        if spec.get("unschedulable"):
+                            disrupted.add(pool_of(name))
+                    samples += 1
+                    max_disrupted = max(max_disrupted, len(disrupted))
+                    if len(disrupted) > budget:
+                        violations += 1
+                    ledger = srv.cluster.peek("FleetRollout", "fleet-roll")
+                    if ledger and len(
+                        pools_in_phase(ledger, "done")
+                    ) == pools:
+                        break
+                    if time.perf_counter() > deadline:
+                        raise RuntimeError(
+                            "fleet_64_pools: roll did not converge "
+                            f"({len(pools_in_phase(ledger or {}, 'done'))}"
+                            f"/{pools} done; last worker errors: "
+                            f"{last_errors or 'none'})"
+                        )
+                    time.sleep(0.005)
+                wall = time.perf_counter() - start
+                stop.set()
+                for thread in threads:
+                    thread.join(timeout=10)
+                total_passes = sum(
+                    w.passes - before
+                    for w, before in zip(workers, passes_before)
+                )
+                if violations:
+                    raise RuntimeError(
+                        f"fleet_64_pools: {violations} samples exceeded the "
+                        f"global budget ({max_disrupted} > {budget} pools)"
+                    )
+                first_grants = orchestrator.grant_order[: len(degraded_pools)]
+                if set(first_grants) != set(degraded_pools):
+                    raise RuntimeError(
+                        "fleet_64_pools: degraded pools were not granted "
+                        f"first (got {first_grants})"
+                    )
+                if not sim.all_pods_ready_and_current():
+                    raise RuntimeError(
+                        "fleet_64_pools: ledger says done but driver pods "
+                        "are not current"
+                    )
+                return {
+                    "workers": n_workers,
+                    "wall_s": round(wall, 3),
+                    "aggregate_passes": total_passes,
+                    "aggregate_passes_per_s": round(total_passes / wall, 1),
+                    "pools_done": pools,
+                    "budget_pools": budget,
+                    "max_disrupted_pools_at_once": max_disrupted,
+                    "budget_violations": violations,
+                    "budget_samples": samples,
+                    "grants": orchestrator.grants_issued,
+                    "first_grants": first_grants,
+                    "per_worker_passes": [
+                        w.passes - before
+                        for w, before in zip(workers, passes_before)
+                    ],
+                    "shard_balance": [
+                        sorted(w.owned_shards()) for w in workers
+                    ],
+                }
+            finally:
+                stop.set()
+                for worker in workers:
+                    worker.stop()
+                for client in clients:
+                    client.close()
+                orch_client.close()
+
+    configs = {f"workers_{n}": one_config(n) for n in worker_counts}
+    base = configs[f"workers_{worker_counts[0]}"]
+    peak = configs[f"workers_{worker_counts[-1]}"]
+    scaling = round(
+        peak["aggregate_passes_per_s"] / base["aggregate_passes_per_s"], 2
+    ) if base["aggregate_passes_per_s"] else 0.0
+    if scaling < min_scaling_x:
+        raise RuntimeError(
+            f"fleet_64_pools: {worker_counts[-1]} workers scaled only "
+            f"{scaling}x over 1 worker (aggregate passes/s) — the shard "
+            "partition stopped paying for itself"
+        )
+    return {
+        "pools": pools,
+        "nodes": pools * hosts_per_pool,
+        "shards": shards,
+        "transport": "http (LocalApiServer, asyncio wire path; one "
+                     "RestClient per worker)",
+        "degraded_pools": degraded_pools,
+        "degraded_pools_first": 1.0,  # hard-asserted per config above
+        "budget_violations": max(
+            c["budget_violations"] for c in configs.values()
+        ),
+        "scaling_4w_vs_1w": scaling,
+        "note": "aggregate passes/s counts each worker's reconcile over "
+                "ITS OWN shards — at N workers a pass covers ~1/N of the "
+                "fleet, so scaling can exceed N (smaller scope per pass + "
+                "overlapped wire I/O); per-config wall_s is the "
+                "equal-units comparison",
+        **configs,
+    }
+
+
 def run_ring_bandwidth(payload_mb: float = 1.0, devices: int = 8) -> dict:
     """ROADMAP item 4 / ISSUE 6 satellite: actually measure
     ``ring_gbytes_per_s`` — every BENCH round before this one published
@@ -1594,6 +1875,7 @@ SECTIONS = {
     "single_event_latency": run_single_event_latency,
     "live_workload_roll": run_live_workload_roll,
     "degraded_first_roll": run_degraded_first_roll,
+    "fleet_64_pools": run_fleet_64_pools,
     "ring_bandwidth": run_ring_bandwidth,
     "http_wire_roll": run_http_wire_roll,
     "wire_encoding": run_wire_encoding,
@@ -1710,6 +1992,12 @@ def main() -> None:
     degraded_first = run_degraded_first_roll()
     _progress("degraded_first_roll")
 
+    # Fleet tier (ISSUE 10): 64 pools / 256 nodes rolled over the wire
+    # from 1/2/4 shard workers under one global disruption budget
+    # (docs/fleet-control-plane.md).
+    fleet = run_fleet_64_pools()
+    _progress("fleet_64_pools")
+
     details = {
         "backend": backend,
         # Trial counts derived from the actual result objects — never a
@@ -1746,6 +2034,7 @@ def main() -> None:
         "live_workload_roll": live_roll,
         "ring_bandwidth": ring_bw,
         "degraded_first_roll": degraded_first,
+        "fleet_64_pools": fleet,
         "gate_cold_vs_warm": gate_split,
         "devices": [str(d) for d in jax.devices()],
         "calibration": calibration,
@@ -1802,6 +2091,11 @@ def main() -> None:
             "quarantine_budget_violations": degraded_first[
                 "quarantine_drill"
             ]["budget_violations"],
+            "fleet_64_pools_budget_violations": fleet["budget_violations"],
+            "fleet_scaling_4w_vs_1w": fleet["scaling_4w_vs_1w"],
+            "fleet_4w_passes_per_s": fleet["workers_4"][
+                "aggregate_passes_per_s"
+            ],
         },
         "metric": "v5e-16 pool libtpu rolling-upgrade wall-clock "
         "(simulated GKE pool, real ICI/MXU health gate; median of "

@@ -179,6 +179,33 @@ def main(argv: list[str] | None = None) -> int:
         help="metrics bind address (default 0.0.0.0: in-cluster scrape)",
     )
     parser.add_argument(
+        "--shards",
+        type=int,
+        default=0,
+        help="fleet mode (docs/fleet-control-plane.md): total shard count "
+        "for the fleet; this process campaigns for per-shard Leases and "
+        "reconciles only the node keys hashing to its shards. Run N "
+        "processes against one apiserver (e.g. kube.apiserver) with the "
+        "same --shards and distinct --shard-index to roll a fleet from N "
+        "cooperating workers; a killed worker's shards fail over via "
+        "lease expiry. 0 = classic single-owner mode",
+    )
+    parser.add_argument(
+        "--shard-index",
+        type=int,
+        default=0,
+        help="with --shards: this worker's index (its PREFERRED shard); "
+        "other shards are probed at the failover cadence only",
+    )
+    parser.add_argument(
+        "--fleet-rollout",
+        default="",
+        help="with --shards: FleetRollout CR name to consume pool-roll "
+        "grants from (the fleet orchestrator's global disruption "
+        "budget); empty = standalone sharding under this worker's own "
+        "policy budget",
+    )
+    parser.add_argument(
         "--leader-elect",
         action="store_true",
         help="campaign for a coordination.k8s.io Lease before reconciling "
@@ -219,6 +246,7 @@ def main(argv: list[str] | None = None) -> int:
     elector = None
     metrics_server = None
     queue = None
+    worker = None
     try:
         device = DeviceClass.tpu() if args.device == "tpu" else DeviceClass.nvidia()
         policy = load_policy(args.policy)
@@ -319,6 +347,40 @@ def main(argv: list[str] | None = None) -> int:
                     client, namespace=args.namespace
                 )
 
+        # Fleet mode (docs/fleet-control-plane.md): wrap the configured
+        # manager in a ShardWorker — per-shard Lease campaigns, a
+        # shard-scoped snapshot source in place of the plain one, and
+        # (with --fleet-rollout) grant-gated planning under the global
+        # disruption budget. Constructed BEFORE the watch wiring so the
+        # workqueue handlers ride the worker's own informers (the PR 5
+        # one-informer-set-serves-both-roles shape).
+        if args.shards:
+            import socket
+
+            from k8s_operator_libs_tpu.fleet import (
+                FleetWorkerConfig,
+                ShardWorker,
+                shard_id,
+            )
+
+            identity = (
+                args.leader_elect_id or f"{socket.gethostname()}_{os.getpid()}"
+            )
+            worker = ShardWorker(
+                client,
+                FleetWorkerConfig(
+                    identity=identity,
+                    shards=args.shards,
+                    namespace=args.namespace,
+                    driver_labels=selector,
+                    rollout_name=args.fleet_rollout,
+                    preferred_shards=[shard_id(args.shard_index % args.shards)],
+                    lease_namespace=args.namespace,
+                    verify_every_n=args.verify_every_n,
+                ),
+                manager=mgr,
+            )
+
         # Watch-driven triggering: informer deltas enqueue per-node keys
         # on a client-go-style rate-limited workqueue; the loop drains a
         # batch per pass and falls back to the interval as a resync — the
@@ -381,12 +443,18 @@ def main(argv: list[str] | None = None) -> int:
             # with zero reads and zero per-node CPU and a single node
             # event reclassifies exactly one node
             # (docs/reconcile-data-path.md).
-            snapshot_source = IncrementalSnapshotSource(
-                client,
-                args.namespace,
-                selector,
-                verify_every_n=args.verify_every_n,
-            )
+            if worker is not None:
+                # Fleet mode: the worker already built (and wired into
+                # the manager) a shard-scoped incremental source — the
+                # same informers serve the workqueue triggers.
+                snapshot_source = worker.source
+            else:
+                snapshot_source = IncrementalSnapshotSource(
+                    client,
+                    args.namespace,
+                    selector,
+                    verify_every_n=args.verify_every_n,
+                )
             # ControllerRevision is the rollout trigger itself: a driver
             # image bump lands as a new revision — with only Node/Pod
             # watches, nothing would wake the controller to START the
@@ -412,17 +480,27 @@ def main(argv: list[str] | None = None) -> int:
                 informer.start()
             # start() blocks until the snapshot stores are seeded — a
             # snapshot taken before sync would be empty, not stale.
-            snapshot_source.start(sync_timeout=30)
-            mgr.snapshot_source = snapshot_source
-            mgr.provider.set_write_through(snapshot_source.record_write)
-            mgr.common.pod_manager.revision_source = snapshot_source
+            if worker is not None:
+                worker.start(sync_timeout=30)  # owns its source's stop
+            else:
+                snapshot_source.start(sync_timeout=30)
+                mgr.snapshot_source = snapshot_source
+                mgr.provider.set_write_through(snapshot_source.record_write)
+                mgr.common.pod_manager.revision_source = snapshot_source
+                informers.append(snapshot_source)  # stopped with the rest
             for informer in informers:
+                if informer is snapshot_source:
+                    continue
                 if not informer.wait_for_sync(timeout=30):
                     logging.warning(
                         "%s informer did not sync within 30s; reconciles may "
                         "miss its triggers until it catches up", informer.kind,
                     )
-            informers.append(snapshot_source)  # stopped with the rest
+
+        if worker is not None and not worker.source.started:
+            # Fleet mode without --watch: the scoped source still needs
+            # its informers up before the first tick snapshots.
+            worker.start(sync_timeout=30)
 
         metrics = None
         if args.metrics_port:
@@ -461,6 +539,7 @@ def main(argv: list[str] | None = None) -> int:
         return _reconcile_loop(
             args, mgr, policy, selector, elector, queue,
             metrics, sim, maintenance_sim, validation_pod_sim,
+            worker=worker,
         )
     finally:
         # Every exit path — convergence, --once, lease lost, SIGTERM
@@ -472,6 +551,10 @@ def main(argv: list[str] | None = None) -> int:
             queue.shutdown()
         for informer in informers:
             informer.stop()
+        if worker is not None:
+            # Releases the per-shard Leases (standbys take over
+            # immediately) and stops the scoped source + health informer.
+            worker.stop()
         if metrics_server is not None:
             metrics_server.stop()
         if elector is not None:
@@ -481,6 +564,7 @@ def main(argv: list[str] | None = None) -> int:
 def _reconcile_loop(
     args, mgr, policy, selector, elector, queue,
     metrics, sim, maintenance_sim, validation_pod_sim,
+    worker=None,
 ):
     passes = 0
     max_demo_passes = 100  # a 4-node roll converges in <15; 100 = stuck
@@ -513,8 +597,22 @@ def _reconcile_loop(
         if validation_pod_sim is not None:
             validation_pod_sim.step()
         try:
-            state = mgr.build_state(args.namespace, selector)
-            mgr.apply_state(state, policy)
+            if worker is not None:
+                # Fleet mode: one tick = lease campaigns + a reconcile
+                # over the owned shards + grant/completion I/O. state is
+                # None while this worker owns no shards (standby).
+                ticked = worker.tick(policy)
+                state = ticked.state
+                if state is None:
+                    print(
+                        f"pass {passes}: no shards owned "
+                        f"(campaigning for {sorted(worker.shards)})"
+                    )
+                    time.sleep(args.interval if sim is None else 0.0)
+                    continue
+            else:
+                state = mgr.build_state(args.namespace, selector)
+                mgr.apply_state(state, policy)
         except Exception as e:  # noqa: BLE001 - the daemon outlives passes
             if args.once:
                 raise
@@ -570,16 +668,31 @@ def _reconcile_loop(
             metrics.observe(state)
         if sim is not None:
             sim.step()
+        shard_note = (
+            f" | shards={','.join(sorted(worker.owned_shards()))}"
+            if worker is not None
+            else ""
+        )
         print(
             f"pass {passes}: {state_counts(state)} | "
             f"in-progress={mgr.get_upgrades_in_progress(state)} "
             f"done={mgr.get_upgrades_done(state)} "
             f"failed={mgr.get_upgrades_failed(state)}"
+            f"{shard_note}"
         )
         if sim is not None:
-            fresh = mgr.build_state(args.namespace, selector)
-            all_done = fresh.node_states and all(
-                s == "upgrade-done" for s in fresh.node_states
+            # Convergence check via plain label reads — NEVER an
+            # out-of-band mgr.build_state: an incremental snapshot
+            # source is single-consumer, and a side-channel build would
+            # consume the dirty set without applying it, wedging the
+            # dirty-filtered buckets (spec-less wait-for-jobs advance)
+            # forever.
+            objs = mgr.client.list("Node")
+            all_done = bool(objs) and all(
+                (o.raw.get("metadata", {}).get("labels") or {}).get(
+                    mgr.keys.state_label
+                ) == "upgrade-done"
+                for o in objs
             )
             if all_done and sim.all_pods_ready_and_current():
                 print(f"demo: rolling upgrade complete in {passes} passes")

@@ -1,0 +1,181 @@
+"""FleetRollout CR contract (v1alpha1) — the fleet tier's grant ledger
+(docs/fleet-control-plane.md).
+
+One process owning one pool was the pre-fleet shape; the fleet tier
+(``k8s_operator_libs_tpu/fleet/``) rolls MANY pools from N cooperating
+shard workers under one *global* disruption budget. Like every other
+piece of durable coordination in this library, the shared state is a
+Kubernetes object, not worker memory — the same labels-as-state
+philosophy that makes a reconcile pass stateless and restart-resumable
+(reference: upgrade_state.go:49-52), lifted one tier up:
+
+* the **spec** names the pools to roll and the global budget
+  (``maxUnavailablePools``, int-or-percent of the pool count — the
+  pool-grain analog of ``DriverUpgradePolicySpec.maxUnavailable``);
+* the **status** is the grant ledger: per-pool phase
+  (``pending`` → ``granted`` → ``done``), written by the fleet
+  orchestrator (grants, degraded-first) and by shard workers
+  (completions), both under optimistic concurrency. A worker that
+  crashes mid-roll loses nothing: its successor reads the same grants
+  and the node labels carry the per-node progress.
+
+Like the WorkloadCheckpoint and NodeHealthReport contracts, the names
+and shapes live HERE, kube-free; the REST-registry entry lives in
+``kube/resources._bootstrap`` so every kube surface knows the kind even
+when api/ was never imported (tests/test_api_types.py pins the two in
+sync). The CR is **cluster-scoped**: a rollout spans pools, pools span
+namespaces' worth of nodes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Mapping, Optional
+
+from ..utils.intstr import IntOrString
+
+FLEET_ROLLOUT_KIND = "FleetRollout"
+FLEET_ROLLOUT_API_VERSION = "fleet.tpu-operator.dev/v1alpha1"
+FLEET_ROLLOUT_PLURAL = "fleetrollouts"
+
+#: Pool phases in the status ledger. ``pending`` is the implicit phase
+#: of a pool with no status entry — a fresh CR is all-pending.
+POOL_PENDING = "pending"
+POOL_GRANTED = "granted"
+POOL_DONE = "done"
+
+POOL_PHASES = (POOL_PENDING, POOL_GRANTED, POOL_DONE)
+
+#: Default global budget: a quarter of the fleet's pools may be
+#: disrupted at once (the kubebuilder-default shape of the per-pool
+#: policy's maxUnavailable, applied at pool grain).
+DEFAULT_MAX_UNAVAILABLE_POOLS = "25%"
+
+
+@dataclass
+class FleetRolloutSpec:
+    """Parsed + validated spec. ``pools`` is the explicit roll set —
+    the orchestrator never discovers pools on its own (an operator must
+    not silently widen a rollout because a node grew a label)."""
+
+    pools: list[str] = field(default_factory=list)
+    #: None = unlimited (every pool may be in flight at once — the
+    #: explicit opt-out, mirroring maxUnavailable: null on the policy).
+    max_unavailable_pools: Optional[IntOrString] = field(
+        default_factory=lambda: IntOrString(DEFAULT_MAX_UNAVAILABLE_POOLS)
+    )
+
+    def __post_init__(self) -> None:
+        if not self.pools:
+            raise ValueError("FleetRollout spec.pools must be non-empty")
+        if any(not p or not isinstance(p, str) for p in self.pools):
+            raise ValueError("FleetRollout spec.pools entries must be "
+                             "non-empty strings")
+        if len(set(self.pools)) != len(self.pools):
+            raise ValueError("FleetRollout spec.pools must not repeat a pool")
+
+    def resolved_budget(self) -> int:
+        """The global budget in POOL units, scaled against the roll set
+        (percent policies, round up — the per-pool policy's resolution
+        rule, upgrade_inplace.go:54-69) and clamped to [1, len(pools)].
+        The floor of 1 is deliberate: a rollout whose budget resolves to
+        zero pools could never start — a grant ledger that can only
+        deny is a deadlock, not a safety feature."""
+        total = len(self.pools)
+        if self.max_unavailable_pools is None:
+            return total
+        scaled = self.max_unavailable_pools.scaled_value(total, round_up=True)
+        return max(1, min(scaled, total))
+
+    def to_dict(self) -> dict[str, Any]:
+        out: dict[str, Any] = {"pools": list(self.pools)}
+        out["maxUnavailablePools"] = (
+            self.max_unavailable_pools.value
+            if self.max_unavailable_pools is not None
+            else None
+        )
+        return out
+
+    @staticmethod
+    def from_dict(d: Mapping[str, Any]) -> "FleetRolloutSpec":
+        # Mirror DriverUpgradePolicySpec.from_dict: an explicit null is
+        # "no limit" and survives round-trips; a MISSING key takes the
+        # default.
+        if "maxUnavailablePools" in d:
+            raw = d["maxUnavailablePools"]
+            max_unavailable = IntOrString.parse(raw) if raw is not None else None
+        else:
+            max_unavailable = IntOrString(DEFAULT_MAX_UNAVAILABLE_POOLS)
+        return FleetRolloutSpec(
+            pools=list(d.get("pools") or []),
+            max_unavailable_pools=max_unavailable,
+        )
+
+
+def make_fleet_rollout(
+    name: str,
+    pools: list[str],
+    max_unavailable_pools: Any = DEFAULT_MAX_UNAVAILABLE_POOLS,
+) -> dict[str, Any]:
+    """Raw FleetRollout object (validated through the spec dataclass)."""
+    spec = FleetRolloutSpec(
+        pools=list(pools),
+        max_unavailable_pools=(
+            IntOrString.parse(max_unavailable_pools)
+            if max_unavailable_pools is not None
+            else None
+        ),
+    )
+    return {
+        "apiVersion": FLEET_ROLLOUT_API_VERSION,
+        "kind": FLEET_ROLLOUT_KIND,
+        "metadata": {"name": name},
+        "spec": spec.to_dict(),
+        "status": {"pools": {}, "grantsIssued": 0},
+    }
+
+
+def rollout_spec(raw: Mapping[str, Any]) -> FleetRolloutSpec:
+    return FleetRolloutSpec.from_dict(raw.get("spec") or {})
+
+
+def _status_pools(raw: Mapping[str, Any]) -> Mapping[str, Any]:
+    status = raw.get("status") or {}
+    pools = status.get("pools")
+    return pools if isinstance(pools, Mapping) else {}
+
+
+def pool_phase(raw: Mapping[str, Any], pool: str) -> str:
+    """A pool's ledger phase; no entry (or a mangled one) reads as
+    ``pending`` — the safe default: an unknown pool is never considered
+    granted, so a hand-edited CR can only withhold disruption."""
+    entry = _status_pools(raw).get(pool)
+    phase = entry.get("phase") if isinstance(entry, Mapping) else None
+    return phase if phase in POOL_PHASES else POOL_PENDING
+
+
+def pools_in_phase(raw: Mapping[str, Any], phase: str) -> list[str]:
+    """Spec pools currently in ``phase``, in spec order. Keyed off the
+    SPEC (not the status map) so a stale status entry for a pool no
+    longer in the roll set can never count against the budget."""
+    spec_pools = (raw.get("spec") or {}).get("pools") or []
+    return [p for p in spec_pools if pool_phase(raw, p) == phase]
+
+
+def set_pool_phase(
+    raw: dict[str, Any], pool: str, phase: str, **extra: Any
+) -> bool:
+    """Move one pool's ledger entry to ``phase`` (merging ``extra``
+    fields, e.g. grantedSeq / completedBy); returns False without
+    touching the object when the pool is already there — callers skip
+    the write entirely on a no-op pass."""
+    if phase not in POOL_PHASES:
+        raise ValueError(f"unknown pool phase {phase!r}")
+    status = raw.setdefault("status", {})
+    pools = status.setdefault("pools", {})
+    entry = pools.setdefault(pool, {})
+    if entry.get("phase") == phase:
+        return False
+    entry["phase"] = phase
+    entry.update(extra)
+    return True

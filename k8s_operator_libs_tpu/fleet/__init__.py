@@ -1,0 +1,36 @@
+"""Fleet tier: sharded multi-pool control plane
+(docs/fleet-control-plane.md; ROADMAP item 1).
+
+Everything below this package reconciles ONE pool from one process; the
+fleet tier composes those single-pool units — consistent-hash key
+partitioning (hashring), per-shard Lease ownership with automatic
+failover and shard-scoped snapshots (worker/scope), and a global
+disruption budget coordinated through the FleetRollout grant ledger
+(orchestrator; contract in api/fleet_v1alpha1.py) — into N cooperating
+workers rolling many pools, degraded-first, without any worker holding
+fleet state in memory.
+"""
+
+from .hashring import HashRing, stable_hash
+from .orchestrator import FleetHealthAggregator, FleetOrchestrator
+from .scope import ShardScopedSnapshotSource
+from .worker import (
+    FleetWorkerConfig,
+    GrantGatedInplaceManager,
+    ShardWorker,
+    TickStats,
+    shard_id,
+)
+
+__all__ = [
+    "FleetHealthAggregator",
+    "FleetOrchestrator",
+    "FleetWorkerConfig",
+    "GrantGatedInplaceManager",
+    "HashRing",
+    "ShardScopedSnapshotSource",
+    "ShardWorker",
+    "TickStats",
+    "shard_id",
+    "stable_hash",
+]

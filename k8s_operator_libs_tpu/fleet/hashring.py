@@ -1,0 +1,112 @@
+"""Consistent-hash ring — the fleet tier's key partitioner.
+
+The fleet control plane (docs/fleet-control-plane.md) splits pool/node
+keys across shards, and shards across workers, with TWO requirements a
+plain ``hash(key) % n`` cannot meet:
+
+* **stability across processes** — every worker must compute the same
+  owner for the same key with no coordination. Python's builtin ``hash``
+  is randomized per process (PYTHONHASHSEED), so the ring hashes with
+  BLAKE2b instead: byte-stable everywhere, forever.
+* **bounded churn on membership change** — scaling the shard count (or
+  losing a worker from a worker-preference ring) must move only the
+  keys adjacent to the changed member, never reshuffle the world: a
+  reshuffle would invalidate every shard worker's incremental snapshot
+  baseline at once (the O(dirty) reconcile economics of PR 5 are the
+  whole point of sharding). Classic consistent hashing with virtual
+  nodes (``replicas`` points per member) gives ~K/N expected moved keys
+  per membership change; ``tests/test_fleet.py`` pins the bound.
+
+The ring is deliberately tiny and dependency-free — the same altitude
+as ``kube/workqueue.py``: a primitive the fleet modules compose, not a
+framework.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import threading
+from typing import Iterable, Mapping
+
+
+def stable_hash(key: str) -> int:
+    """Process-stable 64-bit hash (BLAKE2b). NEVER the builtin ``hash``:
+    two workers disagreeing on a key's owner would double-manage its
+    pool (both roll it — the budget can't see the overlap) or orphan it
+    (neither rolls it)."""
+    digest = hashlib.blake2b(key.encode("utf-8"), digest_size=8).digest()
+    return int.from_bytes(digest, "big")
+
+
+class HashRing:
+    """Consistent-hash ring over string members with virtual nodes.
+
+    Thread-safety: membership mutation and ownership lookup take a leaf
+    lock (nothing blocks under it); lookups on a settled ring are a
+    binary search over a tuple snapshot.
+    """
+
+    def __init__(self, members: Iterable[str] = (), replicas: int = 128) -> None:
+        if replicas < 1:
+            raise ValueError("replicas must be >= 1")
+        self.replicas = int(replicas)
+        self._lock = threading.Lock()
+        self._members: set[str] = set()
+        #: Sorted virtual-node points: (point_hash, member).
+        self._points: list[tuple[int, str]] = []
+        for member in members:
+            self.add(member)
+
+    # -- membership --------------------------------------------------------
+    def add(self, member: str) -> None:
+        if not member:
+            raise ValueError("ring member must be a non-empty string")
+        with self._lock:
+            if member in self._members:
+                return
+            self._members.add(member)
+            for replica in range(self.replicas):
+                point = stable_hash(f"{member}#{replica}")
+                bisect.insort(self._points, (point, member))
+
+    def remove(self, member: str) -> None:
+        with self._lock:
+            if member not in self._members:
+                return
+            self._members.discard(member)
+            self._points = [
+                (point, m) for point, m in self._points if m != member
+            ]
+
+    def members(self) -> frozenset[str]:
+        with self._lock:
+            return frozenset(self._members)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._members)
+
+    # -- ownership ---------------------------------------------------------
+    def owner(self, key: str) -> str:
+        """The member owning ``key``: the first virtual node clockwise
+        from the key's hash (wrapping at the top). Raises on an empty
+        ring — silently returning a default owner would let an
+        unconfigured worker claim the whole fleet."""
+        with self._lock:
+            if not self._points:
+                raise ValueError("hash ring has no members")
+            index = bisect.bisect_right(self._points, (stable_hash(key), ""))
+            if index == len(self._points):
+                index = 0
+            return self._points[index][1]
+
+    def assignment(self, keys: Iterable[str]) -> Mapping[str, list[str]]:
+        """member -> sorted owned keys, every member present (possibly
+        empty) — the fleet bench's balance report."""
+        out: dict[str, list[str]] = {m: [] for m in self.members()}
+        for key in keys:
+            out[self.owner(key)].append(key)
+        for owned in out.values():
+            owned.sort()
+        return out

@@ -1,0 +1,216 @@
+"""Fleet orchestrator — per-pool roll grants under one global budget.
+
+The per-pool planner (tpu/planner.py) orders slices degraded-first
+WITHIN a pool; this module generalizes that one tier up (ROADMAP item 1,
+Guard in PAPERS.md): many pools, one *global* disruption budget, the
+most degraded pool rolls first. Coordination is the FleetRollout CR
+(api/fleet_v1alpha1.py) — the orchestrator writes grants into its
+status ledger, shard workers (fleet/worker.py) consume grants and
+report completions, and every participant is stateless between ticks:
+kill any of them mid-roll and its successor resumes from the CR plus
+node labels, nothing else.
+
+Budget semantics (the safety property the fleet bench hard-asserts):
+a grant is permission to disrupt ONE pool; it stays charged against
+``maxUnavailablePools`` from the moment it is issued until the worker
+reports the pool ``done`` (all nodes upgrade-done and schedulable
+again). A worker dying mid-roll leaves the grant charged — the budget
+holds across the lease failover, because the ledger, not the worker,
+carries it.
+
+:class:`FleetHealthAggregator` is the fold (ROADMAP item 4d): per-shard
+``HealthSource`` maps collapse into per-pool worst-member scores — one
+straggler host throttles its pool's collectives, so the pool is only as
+healthy as its sickest member, exactly the slice-level rule
+``SliceAssessment.effective_score`` applies one tier down — and the
+orchestrator consumes the resulting degraded-first queue when granting.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Iterable, Optional
+
+from ..api.fleet_v1alpha1 import (
+    FLEET_ROLLOUT_KIND,
+    POOL_DONE,
+    POOL_GRANTED,
+    POOL_PENDING,
+    pools_in_phase,
+    rollout_spec,
+    set_pool_phase,
+)
+from ..api.telemetry_v1alpha1 import trend_value
+from ..kube.client import ApiError, Client, ConflictError
+from ..utils.log import get_logger
+
+log = get_logger("fleet.orchestrator")
+
+
+class FleetHealthAggregator:
+    """Fold N (shard-scoped) HealthSource maps into per-pool scores.
+
+    Sources register via :meth:`add_source`; each exposes the memoized
+    ``snapshot()`` mapping (node -> NodeHealth) the telemetry plane
+    already maintains, so a fold over a settled fleet costs N dict
+    walks of already-built maps — no reads, no parsing. ``pool_of``
+    maps a node name to its pool key, the SAME pure mapping the shard
+    workers partition by (fleet/worker.py), so the aggregate and the
+    partition can never disagree about which pool a node belongs to.
+    """
+
+    def __init__(self, pool_of: Callable[[str], str]) -> None:
+        self._pool_of = pool_of
+        self._lock = threading.Lock()
+        self._sources: list[Any] = []
+
+    def add_source(self, source: Any) -> None:
+        """Register a HealthSource-shaped object (``snapshot()``)."""
+        with self._lock:
+            if source not in self._sources:
+                self._sources.append(source)
+
+    def pool_health(self) -> dict[str, tuple[float, int]]:
+        """pool -> (worst member score, worst member trend). A node
+        reported by several sources (a shard mid-failover can appear in
+        the old and new owner's scope) folds by worst — duplication can
+        only make a pool look sicker, never healthier."""
+        with self._lock:
+            sources = list(self._sources)
+        out: dict[str, tuple[float, int]] = {}
+        for source in sources:
+            for node_name, health in source.snapshot().items():
+                pool = self._pool_of(node_name)
+                if not pool:
+                    continue
+                score = health.score
+                trend = trend_value(health.trend)
+                previous = out.get(pool)
+                if previous is not None:
+                    score = min(score, previous[0])
+                    trend = min(trend, previous[1])
+                out[pool] = (score, trend)
+        return out
+
+    def ordered(self, pools: Iterable[str]) -> list[str]:
+        """``pools`` in degraded-first order: ascending worst-member
+        score (no telemetry = fully healthy 100), degrading trend
+        breaking score ties, then name — the planner's
+        ``ordered_candidates`` key (tpu/planner.py), applied at pool
+        grain."""
+        health = self.pool_health()
+
+        def key(pool: str):
+            score, trend = health.get(pool, (100.0, 0))
+            return (score, trend, pool)
+
+        return sorted(pools, key=key)
+
+
+class FleetOrchestrator:
+    """Grant pool rolls from the FleetRollout CR's pending set.
+
+    Drive it with :meth:`tick` from any reconcile cadence. A tick never
+    raises on API errors (the daemon convention ``LeaderElector``
+    follows: a flaky apiserver surfaces as a skipped round, not a
+    crashed control plane) and is stateless — every decision re-derives
+    from the CR, so orchestrator restarts (or replicas behind their own
+    leader election) are free.
+    """
+
+    def __init__(
+        self,
+        client: Client,
+        rollout_name: str,
+        aggregator: Optional[FleetHealthAggregator] = None,
+    ) -> None:
+        self.client = client
+        self.rollout_name = rollout_name
+        self.aggregator = aggregator
+        #: Pools granted by THIS instance, in grant order — bench/debug
+        #: introspection (the durable record is the CR's grantedSeq).
+        self.grant_order: list[str] = []
+        self.grants_issued = 0
+        self.budget_denials = 0
+        self.ticks = 0
+        self.api_errors = 0
+
+    def tick(self) -> dict[str, Any]:
+        """One grant round; returns a summary of the ledger after it."""
+        self.ticks += 1
+        try:
+            return self._grant_round()
+        except ConflictError:
+            # retry_on_conflict exhausted: heavy status contention this
+            # round (workers reporting completions). Next tick re-reads.
+            self.api_errors += 1
+            log.info("fleet orchestrator: grant round lost its conflicts")
+            return {"error": "conflict"}
+        except ApiError as e:
+            self.api_errors += 1
+            log.warning("fleet orchestrator: tick failed: %s", e)
+            return {"error": str(e)}
+
+    def _grant_round(self) -> dict[str, Any]:
+        from ..kube.client import retry_on_conflict
+
+        summary: dict[str, Any] = {}
+
+        def attempt() -> None:
+            obj = self.client.get_or_none(FLEET_ROLLOUT_KIND, self.rollout_name)
+            if obj is None:
+                summary.clear()
+                summary["missing"] = True
+                return
+            raw = obj.raw
+            spec = rollout_spec(raw)
+            granted = pools_in_phase(raw, POOL_GRANTED)
+            done = pools_in_phase(raw, POOL_DONE)
+            pending = pools_in_phase(raw, POOL_PENDING)
+            budget = spec.resolved_budget()
+            slots = budget - len(granted)
+            order = (
+                self.aggregator.ordered(pending)
+                if self.aggregator is not None
+                else sorted(pending)
+            )
+            grants = order[: max(0, slots)] if pending else []
+            denied = len(pending) - len(grants)
+            summary.clear()
+            summary.update(
+                {
+                    "budget": budget,
+                    "granted": len(granted) + len(grants),
+                    "done": len(done),
+                    "pending": denied,
+                    "new_grants": list(grants),
+                }
+            )
+            if not grants:
+                # Nothing to write: a settled ledger costs one GET.
+                self.budget_denials += denied
+                return
+            status = raw.setdefault("status", {})
+            seq = int(status.get("grantsIssued", 0) or 0)
+            for pool in grants:
+                seq += 1
+                set_pool_phase(raw, pool, POOL_GRANTED, grantedSeq=seq)
+            status["grantsIssued"] = seq
+            # Optimistic STATUS write (the ledger lives in the status
+            # subresource — a plain update would have it stripped, the
+            # real-apiserver behavior kube/fake.py mirrors): the read's
+            # resourceVersion rides along, so a worker's concurrent
+            # completion report conflicts this attempt and the retry
+            # re-derives from the fresh ledger.
+            self.client.update_status(obj)
+            self.grants_issued += len(grants)
+            self.grant_order.extend(grants)
+            self.budget_denials += denied
+            log.info(
+                "fleet orchestrator: granted %s (budget=%d granted=%d "
+                "done=%d pending=%d)",
+                grants, budget, summary["granted"], len(done), denied,
+            )
+
+        retry_on_conflict(attempt)
+        return summary

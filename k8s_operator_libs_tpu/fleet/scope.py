@@ -1,0 +1,231 @@
+"""Shard-scoped snapshots — one worker's window onto the fleet.
+
+A shard worker (fleet/worker.py) reconciles only the pools whose keys
+hash to its shards, but its informers watch the FLEET (the shard set a
+worker owns changes on failover — a watch-level selector cannot follow
+a lease). This module scopes the READ side instead:
+:class:`ShardScopedSnapshotSource` extends the incremental source
+(upgrade/snapshot.py) so that ``build_state`` sees exactly the owned
+shards' world:
+
+* ``nodes()`` / ``pods()`` / ``pods_on_node()`` filter by the node's
+  shard (``shard_of_node`` — a pure, name-based mapping through the
+  pool ring, so every surface agrees with zero lookups);
+* the **completeness invariant** is re-scoped: the DaemonSet's
+  ``desiredNumberScheduled`` is rewritten to the in-scope node count
+  (event-maintained per shard, re-anchored by ``prime()`` exactly like
+  the per-DS pod book), and ``ds_pod_count`` serves the owned-shard
+  slice of a per-(uid, shard) twin of the pod book — a missing driver
+  pod on an OWNED node still aborts the pass, while another shard's
+  drain can never wedge this worker's delta passes;
+* **ownership changes invalidate**: acquiring or losing a shard forces
+  a full rebuild, because the cached classification was built for a
+  different scope (newly owned pools must enter the state, lost ones
+  must leave).
+
+Scope limitation, stated plainly: the desired-count rewrite assumes the
+driver DaemonSet targets every fleet node (the device-driver deployment
+shape on dedicated accelerator pools — and the only shape the upgrade
+machinery itself models). A DS whose nodeSelector splits the fleet
+would need per-scope eligibility counting here.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Mapping
+
+from ..kube.client import Client
+from ..kube.objects import DaemonSet, Node, Pod
+from ..upgrade.snapshot import (
+    DEFAULT_RESYNC_PERIOD_S,
+    IncrementalSnapshotSource,
+)
+from ..utils.log import get_logger
+
+log = get_logger("fleet.scope")
+
+#: Reserved shard for keys the mapping cannot place (an empty node name,
+#: a crashing mapper). Owned by NO worker: an unmappable node escapes
+#: every scope — loudly logged, never silently adopted by all workers
+#: at once (double management is the worse failure).
+UNMAPPED_SHARD = ""
+
+
+class ShardScopedSnapshotSource(IncrementalSnapshotSource):
+    """Incremental snapshot source filtered to a dynamic shard set."""
+
+    def __init__(
+        self,
+        client: Client,
+        namespace: str,
+        driver_labels: Mapping[str, str],
+        shard_of_node: Callable[[str], str],
+        resync_period_s: float = DEFAULT_RESYNC_PERIOD_S,
+        verify_every_n: int = 0,
+    ) -> None:
+        # Scope state first: super().__init__ registers the event
+        # handlers this subclass overrides, and they read these fields.
+        self._shard_of_node = shard_of_node
+        #: node name -> shard memo. The mapping is pure and the pool
+        #: ring is fixed for the source's lifetime, so every surface —
+        #: including the `_delta_lock` critical sections pod events run
+        #: in — pays a dict hit instead of a ring-lock + bisect per
+        #: call; entries are bounded by node names seen. Benign under
+        #: concurrent writers (both compute the same value).
+        self._shard_memo: dict[str, str] = {}
+        self._owned_shards: frozenset[str] = frozenset()
+        #: shard -> live node count (event-maintained; prime re-anchors).
+        self._node_count_by_shard: dict[str, int] = {}
+        #: (owner uid, shard) -> live pod count — the location-keyed twin
+        #: of the base per-DS pod book (see _bump_ds_pod_count_locked).
+        self._ds_pod_counts_by_shard: dict[tuple[str, str], int] = {}
+        super().__init__(
+            client,
+            namespace,
+            driver_labels,
+            resync_period_s=resync_period_s,
+            verify_every_n=verify_every_n,
+        )
+
+    # -- shard mapping -----------------------------------------------------
+    def shard_of(self, node_name: str) -> str:
+        if not node_name:
+            return UNMAPPED_SHARD
+        shard = self._shard_memo.get(node_name)
+        if shard is not None:
+            return shard
+        try:
+            shard = self._shard_of_node(node_name) or UNMAPPED_SHARD
+        except Exception:  # noqa: BLE001 - mapper owns its errors
+            log.exception("shard mapping failed for node %s", node_name)
+            return UNMAPPED_SHARD  # not memoized: a transient error heals
+        self._shard_memo[node_name] = shard
+        return shard
+
+    def in_scope(self, node_name: str) -> bool:
+        return self.shard_of(node_name) in self._owned_shards
+
+    def owned_shards(self) -> frozenset[str]:
+        return self._owned_shards
+
+    def set_owned_shards(self, shards: frozenset[str]) -> bool:
+        """Adopt a new claim set; returns True (and invalidates the
+        incremental baseline) when it changed — the cached state was
+        classified for a different scope. Reconcile-thread only, like
+        every other cached-state surface of the base class."""
+        shards = frozenset(shards)
+        if shards == self._owned_shards:
+            return False
+        self._owned_shards = shards
+        self.invalidate()
+        return True
+
+    # -- event-maintained scoped books -------------------------------------
+    def _on_node_event(self, event_type: str, obj, old) -> None:
+        super()._on_node_event(event_type, obj, old)
+        if event_type not in ("ADDED", "DELETED"):
+            return
+        delta = 1 if event_type == "ADDED" else -1
+        shard = self.shard_of(obj.name)
+        with self._delta_lock:
+            self._node_count_by_shard[shard] = (
+                self._node_count_by_shard.get(shard, 0) + delta
+            )
+
+    def _bump_ds_pod_count_locked(
+        self, uid: str, node_name: str, delta: int
+    ) -> None:
+        super()._bump_ds_pod_count_locked(uid, node_name, delta)
+        key = (uid, self.shard_of(node_name))
+        self._ds_pod_counts_by_shard[key] = (
+            self._ds_pod_counts_by_shard.get(key, 0) + delta
+        )
+
+    def _rebase_pod_counts(self, raws: list) -> None:
+        """prime()'s settled-store re-anchor, extended to the shard twin
+        (both books rebuilt from ONE settled snapshot — re-anchoring
+        them from different reads could disagree with each other)."""
+        counts: dict[str, int] = {}
+        by_shard: dict[tuple[str, str], int] = {}
+        for raw in raws:
+            refs = (raw.get("metadata") or {}).get("ownerReferences") or []
+            uid = refs[0].get("uid") if refs else None
+            if not uid:
+                continue
+            counts[uid] = counts.get(uid, 0) + 1
+            node = (raw.get("spec") or {}).get("nodeName") or ""
+            key = (uid, self.shard_of(node))
+            by_shard[key] = by_shard.get(key, 0) + 1
+        with self._delta_lock:
+            self._ds_pod_counts = counts
+            self._ds_pod_counts_by_shard = by_shard
+
+    def _rebase_node_counts(self, raws: list) -> None:
+        counts: dict[str, int] = {}
+        for raw in raws:
+            name = (raw.get("metadata") or {}).get("name", "")
+            shard = self.shard_of(name)
+            counts[shard] = counts.get(shard, 0) + 1
+        with self._delta_lock:
+            self._node_count_by_shard = counts
+
+    def prime(self, state, assignment) -> None:
+        super().prime(state, assignment)
+        self._informers["Node"].with_settled_store(self._rebase_node_counts)
+
+    # -- scoped reads ------------------------------------------------------
+    def scoped_node_count(self) -> int:
+        with self._delta_lock:
+            return sum(
+                self._node_count_by_shard.get(s, 0)
+                for s in self._owned_shards
+            )
+
+    def nodes(self) -> dict[str, Node]:
+        return {
+            name: node
+            for name, node in super().nodes().items()
+            if self.in_scope(name)
+        }
+
+    def pods(self, namespace: str, labels: Mapping[str, str]) -> list[Pod]:
+        # A pod with no node yet (Pending) belongs to no shard and is
+        # dropped: the scoped completeness check counts NODES, and the
+        # placement event dirty-marks the node the moment it lands.
+        return [
+            p
+            for p in super().pods(namespace, labels)
+            if p.node_name and self.in_scope(p.node_name)
+        ]
+
+    def daemonsets(
+        self, namespace: str, labels: Mapping[str, str]
+    ) -> list[DaemonSet]:
+        """Fleet DaemonSets with ``desiredNumberScheduled`` rewritten to
+        the in-scope node count — the completeness invariant at shard
+        grain (module docstring states the every-node assumption). The
+        store's frozen raws are never touched: the rewrite lands on a
+        fresh top-level + status dict."""
+        scoped_desired = self.scoped_node_count()
+        out: list[DaemonSet] = []
+        for ds in super().daemonsets(namespace, labels):
+            raw = dict(ds.raw)
+            raw["status"] = dict(raw.get("status") or {})
+            raw["status"]["desiredNumberScheduled"] = scoped_desired
+            out.append(DaemonSet(raw))
+        return out
+
+    def ds_pod_count(self, uid: str) -> int:
+        with self._delta_lock:
+            return sum(
+                self._ds_pod_counts_by_shard.get((uid, s), 0)
+                for s in self._owned_shards
+            )
+
+    def pods_on_node(self, name: str) -> list[Pod]:
+        # An out-of-scope dirty node (fleet-wide informers mark every
+        # node) reclassifies to ZERO entries — update_node drops it from
+        # the cached state, which for a never-present node is a no-op.
+        if not self.in_scope(name):
+            return []
+        return super().pods_on_node(name)

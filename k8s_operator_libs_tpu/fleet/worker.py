@@ -1,0 +1,589 @@
+"""Shard workers — N cooperating processes rolling one fleet.
+
+ROADMAP item 1's runtime shape (docs/fleet-control-plane.md): the fleet
+is partitioned into a fixed set of **shards**; pool keys hash onto
+shards through the consistent ring (fleet/hashring.py), and each shard
+is owned by exactly one worker at a time through a per-shard
+``coordination.k8s.io`` Lease (``kube/leader.py`` — the same elector the
+controller daemon already campaigns with, one instance per shard). A
+worker that dies simply stops renewing: its shards go stale, surviving
+workers' failover probes claim them, and the new owner resumes from
+node labels + the FleetRollout grant ledger — no state lived in the
+dead process.
+
+Per tick a worker does four things, all idempotent:
+
+1. **campaign** — renew held shard leases (every ``retry_period_s``),
+   acquire preferred shards eagerly, probe non-preferred shards at the
+   slower ``failover_probe_s`` cadence (so a healthy fleet converges to
+   the ring-preferred balance instead of thundering-herd claiming);
+2. **scope** — adopt the claim set into the shard-scoped snapshot
+   source (fleet/scope.py); a change invalidates the incremental
+   baseline and re-folds the scoped HealthSource;
+3. **reconcile** — one ``build_state``/``apply_state`` pass over the
+   owned scope, the unmodified upgrade machinery, with the planner
+   swapped for :class:`GrantGatedInplaceManager` when a FleetRollout
+   ledger is configured: the POOL is the disruption unit and the grant
+   is the budget (the slice planner's whole-slice batching, one tier
+   up);
+4. **report** — granted pools whose every in-scope node is
+   upgrade-done, schedulable, and running a current driver pod are
+   marked ``done`` in the ledger (optimistic write), freeing global
+   budget.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Mapping, Optional, Sequence
+
+from ..api.fleet_v1alpha1 import (
+    FLEET_ROLLOUT_KIND,
+    POOL_DONE,
+    POOL_GRANTED,
+    pool_phase,
+    pools_in_phase,
+    set_pool_phase,
+)
+from ..kube.client import ApiError, Client, retry_on_conflict
+from ..kube.leader import LeaderElectionConfig, LeaderElector
+from ..upgrade.consts import NULL_STRING, DeviceClass, UpgradeState
+from ..upgrade.inplace import InplaceNodeStateManager
+from ..upgrade.snapshot import DEFAULT_RESYNC_PERIOD_S
+from ..upgrade.state_manager import ClusterUpgradeStateManager
+from ..upgrade.task_runner import TaskRunner
+from ..utils.log import get_logger
+from .hashring import HashRing
+from .scope import ShardScopedSnapshotSource
+
+log = get_logger("fleet.worker")
+
+
+def shard_id(index: int) -> str:
+    """Canonical shard name: stable, sortable, ring-hashable."""
+    return f"shard-{index:02d}"
+
+
+@dataclass
+class FleetWorkerConfig:
+    """One shard worker's identity and fleet wiring.
+
+    ``pool_of`` maps a node NAME to its pool key — a pure string
+    function (never a store lookup), so every worker, the orchestrator's
+    aggregator, and the scoped source compute identical partitions with
+    zero coordination. The default (node name = pool key) shards by
+    node, the finest grain; fleet deployments pass the
+    name-to-nodepool mapping their naming scheme encodes.
+    """
+
+    identity: str
+    #: Total FIXED shard count for the fleet — every worker must agree
+    #: (it defines the ring). More shards than workers = finer failover
+    #: grain.
+    shards: int
+    namespace: str
+    driver_labels: Mapping[str, str] = field(default_factory=dict)
+    pool_of: Callable[[str], str] = staticmethod(lambda name: name)
+    #: FleetRollout CR to consume grants from / report completions to;
+    #: "" = standalone sharding (no orchestrator: the worker's own
+    #: policy budget governs, scoped to its shards).
+    rollout_name: str = ""
+    #: Known peer identities: shard preference = consistent-ring
+    #: assignment of shards across workers. None (and no explicit
+    #: preferred_shards) = prefer everything — the single-worker shape.
+    workers: Optional[Sequence[str]] = None
+    #: Explicit preference override (e.g. round-robin by index from the
+    #: example CLI); wins over ``workers``.
+    preferred_shards: Optional[Sequence[str]] = None
+    lease_namespace: str = "kube-system"
+    lease_name_prefix: str = "fleet"
+    lease_duration_s: float = 15.0
+    renew_deadline_s: float = 10.0
+    retry_period_s: float = 2.0
+    #: Cadence for probing NON-preferred shards (failover path); default
+    #: one lease duration — a dead peer's shard is reclaimed about one
+    #: lease after it went stale, without hammering healthy leases.
+    failover_probe_s: Optional[float] = None
+    resync_period_s: float = DEFAULT_RESYNC_PERIOD_S
+    verify_every_n: int = 0
+    #: Run a shard-scoped HealthSource (NodeHealthReport informer
+    #: filtered to owned shards) and attach it to every snapshot —
+    #: register it with the orchestrator's FleetHealthAggregator for
+    #: the global degraded-first fold.
+    with_health: bool = False
+    device: Optional[DeviceClass] = None
+
+    def resolved_failover_probe_s(self) -> float:
+        return (
+            self.failover_probe_s
+            if self.failover_probe_s is not None
+            else self.lease_duration_s
+        )
+
+
+class _ShardClaim:
+    """Synchronous campaign cadence around one shard's LeaderElector.
+
+    The elector's protocol round (``try_acquire_or_renew``) is already
+    sync-drivable with injected clocks; this wrapper adds the worker's
+    pacing: held/preferred shards renew every retry period, non-preferred
+    shards probe at the failover cadence (first probe deferred by one
+    full period, so at a clean start the preferred owner wins its shard
+    uncontested), and a held claim is surrendered when renewals have
+    failed past the renew deadline — the same deadline the threaded
+    elector applies.
+    """
+
+    def __init__(
+        self,
+        shard: str,
+        elector: LeaderElector,
+        preferred: bool,
+        retry_period_s: float,
+        renew_deadline_s: float,
+        failover_probe_s: float,
+    ) -> None:
+        self.shard = shard
+        self.elector = elector
+        self.preferred = preferred
+        self.held = False
+        self._retry = retry_period_s
+        self._deadline = renew_deadline_s
+        self._probe = failover_probe_s
+        self._last_attempt: Optional[float] = None
+        self._last_success: Optional[float] = None
+
+    def tick(self, now: float) -> bool:
+        if self._last_attempt is None and not self.preferred:
+            self._last_attempt = now  # defer the first failover probe
+            return False
+        if self._last_attempt is not None:
+            cadence = (
+                self._retry if (self.preferred or self.held) else self._probe
+            )
+            if now - self._last_attempt < cadence:
+                return self.held
+        self._last_attempt = now
+        if self.elector.try_acquire_or_renew():
+            if not self.held:
+                log.info(
+                    "worker %r claimed %s",
+                    self.elector.config.identity, self.shard,
+                )
+            self.held = True
+            self._last_success = now
+        elif self.held and (
+            self._last_success is None
+            or now - self._last_success > self._deadline
+        ):
+            log.warning(
+                "worker %r lost %s (no renewal within %.1fs)",
+                self.elector.config.identity, self.shard, self._deadline,
+            )
+            self.held = False
+        return self.held
+
+    def release(self) -> None:
+        if self.held:
+            self.held = False
+            self.elector.release()
+
+
+class GrantGatedInplaceManager(InplaceNodeStateManager):
+    """The fleet planner: start upgrade-required nodes only in pools the
+    FleetRollout ledger currently grants — the whole pool at once.
+
+    This is the slice planner's batching rule one tier up: a granted
+    pool's disruption window is already charged to the GLOBAL budget, so
+    starting its nodes one by one would multiply the windows for zero
+    safety gain (tpu/planner.py makes the same argument for hosts in a
+    slice). The per-node budget math of the base class deliberately does
+    not run here — the grant IS the budget; pass a permissive per-pool
+    policy (docs/fleet-control-plane.md, budget math).
+    """
+
+    def __init__(
+        self,
+        common,
+        pool_of: Callable[[str], str],
+        granted: Callable[[], frozenset],
+    ) -> None:
+        super().__init__(common)
+        self.pool_of = pool_of
+        self.granted = granted
+
+    def process_upgrade_required_nodes(self, state, policy) -> None:
+        common = self.common
+        candidates = state.nodes_in(UpgradeState.UPGRADE_REQUIRED)
+        if not candidates:
+            return
+        granted = self.granted()
+        started: dict[str, int] = {}
+        for ns in candidates:
+            node = ns.node
+            if common.is_upgrade_requested(node):
+                common.provider.change_node_upgrade_annotation(
+                    node, common.keys.upgrade_requested_annotation, NULL_STRING
+                )
+            if self.pool_of(node.name) not in granted:
+                continue  # waits for its grant; no delta needed (polling)
+            if common.skip_node_upgrade(node):
+                log.info("node %s is marked to skip upgrades", node.name)
+                continue
+            common.provider.change_node_upgrade_state(
+                node, UpgradeState.CORDON_REQUIRED
+            )
+            started[self.pool_of(node.name)] = (
+                started.get(self.pool_of(node.name), 0) + 1
+            )
+        if started:
+            log.info(
+                "fleet planner: started %s (granted=%d pools)",
+                started, len(granted),
+            )
+
+
+@dataclass
+class TickStats:
+    """What one :meth:`ShardWorker.tick` did — the example CLI's print
+    line and the bench's accounting."""
+
+    owned: frozenset
+    reconciled: bool = False
+    scope_changed: bool = False
+    pools_completed: list[str] = field(default_factory=list)
+    state: Any = None
+
+
+class ShardWorker:
+    """One fleet worker: shard leases + scoped reconciles + grant I/O.
+
+    Pass an existing (already configured) ``manager`` to keep its
+    validation hooks / planners; the worker swaps in the scoped
+    snapshot source and, when a rollout ledger is configured, the
+    grant-gated planner. Clocks are injectable for deterministic
+    failover tests (the LeaderElector convention).
+    """
+
+    def __init__(
+        self,
+        client: Client,
+        config: FleetWorkerConfig,
+        manager: Optional[ClusterUpgradeStateManager] = None,
+        now_fn: Callable[[], float] = time.monotonic,
+        wall_fn: Callable[[], float] = time.time,
+    ) -> None:
+        if config.shards < 1:
+            raise ValueError("fleet needs at least one shard")
+        self.client = client
+        self.config = config
+        self._now = now_fn
+        self.shards = [shard_id(i) for i in range(config.shards)]
+        self.pool_ring = HashRing(self.shards)
+        self._pool_of = config.pool_of
+        self.source = ShardScopedSnapshotSource(
+            client,
+            config.namespace,
+            dict(config.driver_labels),
+            shard_of_node=self._shard_of_node,
+            resync_period_s=config.resync_period_s,
+            verify_every_n=config.verify_every_n,
+        )
+        if manager is None:
+            manager = ClusterUpgradeStateManager(
+                client,
+                config.device or DeviceClass.tpu(),
+                runner=TaskRunner(inline=True),
+            )
+        self.mgr = manager
+        self.mgr.snapshot_source = self.source
+        self.mgr.provider.set_write_through(self.source.record_write)
+        self.mgr.common.pod_manager.revision_source = self.source
+        if config.rollout_name:
+            if self.mgr.options.use_maintenance_operator:
+                # The orchestrator dispatches upgrade-required processing
+                # to the REQUESTOR strategy in maintenance-operator mode,
+                # which would silently bypass grant gating — every pool
+                # would start at once and the global budget would hold
+                # nothing. Refuse loudly instead of disrupting a fleet.
+                raise ValueError(
+                    "fleet grant gating (rollout_name) does not compose "
+                    "with requestor/maintenance-operator mode yet; run "
+                    "fleet workers in in-place mode"
+                )
+            self.mgr.inplace = GrantGatedInplaceManager(
+                self.mgr.common, self._pool_of, self.granted_pools
+            )
+        self.health = None
+        preferred = self._preferred_shards()
+        probe = config.resolved_failover_probe_s()
+        self._claims: dict[str, _ShardClaim] = {}
+        for shard in self.shards:
+            elector = LeaderElector(
+                client,
+                LeaderElectionConfig(
+                    name=f"{config.lease_name_prefix}-{shard}",
+                    namespace=config.lease_namespace,
+                    identity=config.identity,
+                    lease_duration_s=config.lease_duration_s,
+                    renew_deadline_s=config.renew_deadline_s,
+                    retry_period_s=config.retry_period_s,
+                ),
+                now_fn=now_fn,
+                wall_fn=wall_fn,
+            )
+            self._claims[shard] = _ShardClaim(
+                shard,
+                elector,
+                preferred=shard in preferred,
+                retry_period_s=config.retry_period_s,
+                renew_deadline_s=config.renew_deadline_s,
+                failover_probe_s=probe,
+            )
+        self._rollout_raw: Optional[dict] = None
+        self.passes = 0
+        self.pools_reported_done = 0
+
+    def _preferred_shards(self) -> frozenset:
+        cfg = self.config
+        if cfg.preferred_shards is not None:
+            unknown = set(cfg.preferred_shards) - set(self.shards)
+            if unknown:
+                raise ValueError(f"unknown preferred shards {sorted(unknown)}")
+            return frozenset(cfg.preferred_shards)
+        if cfg.workers:
+            if cfg.identity not in cfg.workers:
+                raise ValueError(
+                    "config.workers must include this worker's identity"
+                )
+            worker_ring = HashRing(cfg.workers)
+            return frozenset(
+                s for s in self.shards if worker_ring.owner(s) == cfg.identity
+            )
+        return frozenset(self.shards)
+
+    def _shard_of_node(self, node_name: str) -> str:
+        return self.pool_ring.owner(self._pool_of(node_name))
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self, sync_timeout: float = 30.0) -> "ShardWorker":
+        self.source.start(sync_timeout=sync_timeout)
+        if self.config.with_health:
+            from ..upgrade.health_source import HealthSource
+
+            self.health = HealthSource(
+                self.client, node_filter=self.source.in_scope
+            )
+            self.mgr.with_health_telemetry(
+                self.health, sync_timeout=sync_timeout
+            )
+        return self
+
+    def stop(self, release: bool = True) -> None:
+        if release:
+            for claim in self._claims.values():
+                claim.release()
+        if self.health is not None:
+            self.health.stop()
+        self.source.stop()
+
+    def __enter__(self) -> "ShardWorker":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- introspection -----------------------------------------------------
+    def owned_shards(self) -> frozenset:
+        return frozenset(s for s, c in self._claims.items() if c.held)
+
+    def granted_pools(self) -> frozenset:
+        raw = self._rollout_raw
+        if raw is None:
+            return frozenset()
+        return frozenset(pools_in_phase(raw, POOL_GRANTED))
+
+    # -- the tick ----------------------------------------------------------
+    def tick(self, policy) -> TickStats:
+        """Campaign, scope, reconcile, report — one idempotent round.
+        Reconcile errors propagate (the caller's loop owns retry policy,
+        the build/apply contract); lease and ledger I/O degrade to a
+        skipped sub-step, never a crashed worker."""
+        now = self._now()
+        held = frozenset(
+            shard
+            for shard, claim in self._claims.items()
+            if claim.tick(now)
+        )
+        stats = TickStats(owned=held)
+        stats.scope_changed = self.source.set_owned_shards(held)
+        if stats.scope_changed and self.health is not None:
+            # The scoped fold must follow the scope: newly owned shards'
+            # reports enter the map from the informer store, lost ones
+            # leave.
+            self.health.refold()
+        if self.config.rollout_name:
+            try:
+                obj = self.client.get_or_none(
+                    FLEET_ROLLOUT_KIND, self.config.rollout_name
+                )
+                self._rollout_raw = obj.raw if obj is not None else None
+            except ApiError as e:
+                # Keep acting on the last-observed ledger: grants only
+                # ever move forward (granted pools stay granted until
+                # done), so a stale view can under-roll, never
+                # over-disrupt.
+                log.warning("fleet ledger read failed: %s", e)
+        if not held:
+            return stats
+        state = self.mgr.build_state(
+            self.config.namespace, dict(self.config.driver_labels)
+        )
+        self.mgr.apply_state(state, policy)
+        self.passes += 1
+        stats.reconciled = True
+        stats.state = state
+        if self.config.rollout_name and self._rollout_raw is not None:
+            stats.pools_completed = self._report_done_pools(state)
+        return stats
+
+    # -- completion reporting ----------------------------------------------
+    def _live_revision_hash(self, ds, cache: dict) -> str:
+        """The driver DaemonSet's latest rollout hash from a LIVE
+        apiserver read (cached per uid within one report round).
+
+        Deliberately NOT the informer-backed revision source: marking a
+        pool ``done`` is the one IRREVERSIBLE write in the fleet
+        protocol, and a worker whose ControllerRevision watch is a
+        delivery behind the rollout's new revision would otherwise
+        conclude "nothing to roll" and retire the grant without rolling
+        — the level-driven machinery heals every other stale read, but
+        a retired grant never comes back. One real LIST per pool
+        completion is the price of making the irreversible step read
+        the source of truth."""
+        uid = ds.uid
+        if uid in cache:
+            return cache[uid]
+        from ..kube.objects import ControllerRevision
+
+        revisions = self.client.list(
+            "ControllerRevision",
+            namespace=self.config.namespace,
+            label_selector=dict(ds.match_labels),
+        )
+        latest = None
+        for obj in revisions:
+            cr = ControllerRevision(obj.raw)
+            if latest is None or cr.revision > latest.revision:
+                latest = cr
+        hash_value = ""
+        if latest is not None:
+            hash_value = latest.hash_label() or latest.name.removeprefix(
+                f"{ds.name}-"
+            )
+        cache[uid] = hash_value
+        return hash_value
+
+    def _pool_converged(self, entries, hash_cache: dict) -> bool:
+        """Every entry: upgrade-done, schedulable, and a ready driver
+        pod CURRENT against the live revision hash. The pod-currency
+        check is what makes done-reporting safe on a worker's very
+        first pass after a grant: a node whose label still says done
+        from BEFORE the driver bump must not let the pool report done
+        without rolling (see _live_revision_hash for why the hash comes
+        from a live read)."""
+        common = self.mgr.common
+        for bucket, ns in entries:
+            if bucket != UpgradeState.DONE or ns.node.unschedulable:
+                return False
+            try:
+                if not common.is_driver_pod_in_sync(ns):
+                    return False
+                if ns.driver_daemonset is None:
+                    return False
+                live_hash = self._live_revision_hash(
+                    ns.driver_daemonset, hash_cache
+                )
+                if not live_hash or (
+                    ns.driver_pod.controller_revision_hash() != live_hash
+                ):
+                    return False
+            except Exception as e:  # noqa: BLE001 - treat as not-done
+                # A missing hash label / transient revision-read error
+                # reads as NOT converged: the report retries next tick,
+                # and an irreversible done must never ride an error.
+                log.debug(
+                    "pool convergence check failed for node %s: %s",
+                    ns.node.name, e,
+                )
+                return False
+        return True
+
+    def _report_done_pools(self, state) -> list[str]:
+        raw = self._rollout_raw
+        assert raw is not None
+        granted = set(pools_in_phase(raw, POOL_GRANTED))
+        if not granted:
+            return []
+        by_pool: dict[str, list] = {}
+        for bucket, node_states in state.node_states.items():
+            for ns in node_states:
+                pool = self._pool_of(ns.node.name)
+                if pool in granted:
+                    by_pool.setdefault(pool, []).append((bucket, ns))
+        hash_cache: dict = {}
+        done = [
+            pool
+            for pool, entries in by_pool.items()
+            if self._pool_converged(entries, hash_cache)
+        ]
+        # A granted pool with ZERO nodes in its shard's scope is
+        # vacuously converged — and only its shard's owner may say so
+        # (for every other worker "no nodes" just means "not my shard").
+        # Without this, a ghost pool (an operator typo in spec.pools, or
+        # a pool whose nodes were deleted after its grant) would hold a
+        # global budget slot forever; enough ghosts would deadlock the
+        # whole rollout. The worker's informers are synced (start()
+        # blocks on it), so the scoped store is authoritative for owned
+        # shards.
+        owned = self.source.owned_shards()
+        for pool in granted:
+            if pool not in by_pool and self.pool_ring.owner(pool) in owned:
+                log.warning(
+                    "granted pool %r has no nodes in its shard; retiring "
+                    "the grant as vacuously done", pool,
+                )
+                done.append(pool)
+        if not done:
+            return []
+
+        def report() -> None:
+            obj = self.client.get(
+                FLEET_ROLLOUT_KIND, self.config.rollout_name
+            )
+            changed = False
+            for pool in done:
+                if pool_phase(obj.raw, pool) == POOL_GRANTED:
+                    changed = set_pool_phase(
+                        obj.raw, pool, POOL_DONE,
+                        completedBy=self.config.identity,
+                    ) or changed
+            if changed:
+                # Status subresource: the ledger lives in status; a
+                # plain update would strip it (real-apiserver + fake
+                # behavior alike).
+                self.client.update_status(obj)
+
+        try:
+            retry_on_conflict(report)
+        except ApiError as e:
+            # Reported again next tick — completion is level-derived
+            # from node labels + pod currency, not from this write.
+            log.warning("fleet completion report failed: %s", e)
+            return []
+        self.pools_reported_done += len(done)
+        log.info(
+            "worker %r reported pools done: %s", self.config.identity, done
+        )
+        return done

@@ -486,6 +486,26 @@ class NodeMaintenance(KubeObject):
     def node_name(self, value: str) -> None:
         self.spec["nodeName"] = value
 
+    @property
+    def node_health(self) -> Optional[dict[str, Any]]:
+        """Telemetry surfaced for the external maintenance operator
+        (ROADMAP 4c; docs/fleet-telemetry.md): ``{"score": 0-100,
+        "trend": improving|stable|degrading}`` stamped by the requestor
+        from the node's NodeHealthReport at CR-creation time, so an
+        operator that orders its own maintenance queue can go
+        degraded-first without consuming the telemetry plane itself.
+        None = no telemetry was wired (absence, never a default score:
+        an operator must be able to tell "healthy" from "unmeasured")."""
+        value = self.spec.get("nodeHealth")
+        return value if isinstance(value, dict) else None
+
+    @node_health.setter
+    def node_health(self, value: Optional[dict[str, Any]]) -> None:
+        if value is None:
+            self.spec.pop("nodeHealth", None)
+        else:
+            self.spec["nodeHealth"] = dict(value)
+
     def is_ready(self) -> bool:
         return condition_status(self.status, self.CONDITION_READY) == "True"
 

@@ -99,6 +99,17 @@ def _bootstrap() -> None:
         "nodehealthreports",
         namespaced=False,
     )
+    # Fleet tier (docs/fleet-control-plane.md): the grant ledger the
+    # fleet orchestrator and shard workers coordinate through — per-pool
+    # roll phases under one global disruption budget. Cluster-scoped: a
+    # rollout spans pools. Contract (spec/status shape, phase semantics):
+    # api/fleet_v1alpha1.py.
+    register_resource(
+        "FleetRollout",
+        "fleet.tpu-operator.dev/v1alpha1",
+        "fleetrollouts",
+        namespaced=False,
+    )
 
 
 _bootstrap()

@@ -292,7 +292,12 @@ class SliceAwareRequestorManager(RequestorNodeStateManager):
             # The whole slice's CRs land in one batch: the external
             # operator receives them together, so its maintenance window
             # aligns to the slice even though IT performs cordon/drain.
-            self.create_or_update_node_maintenance(ns, policy)
+            # Telemetry rides along (ROADMAP 4c): the CR carries the
+            # node's health score so the external operator can order
+            # degraded-first too.
+            self.create_or_update_node_maintenance(
+                ns, policy, health=state.health_of(ns.node.name)
+            )
             common.provider.change_node_upgrade_annotation(
                 ns.node, common.keys.requestor_mode_annotation, TRUE_STRING
             )

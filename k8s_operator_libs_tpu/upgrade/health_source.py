@@ -70,10 +70,22 @@ class HealthSource:
     the informer thread — the metrics histogram feeds from there.
     """
 
-    def __init__(self, client: Client, resync_period_s: float = 0.0) -> None:
+    def __init__(
+        self,
+        client: Client,
+        resync_period_s: float = 0.0,
+        node_filter: Optional[Callable[[str], bool]] = None,
+    ) -> None:
         self._informer = Informer(
             client, NODE_HEALTH_REPORT_KIND, resync_period_s=resync_period_s
         )
+        #: Shard selector (fleet tier, docs/fleet-control-plane.md):
+        #: only reports for nodes the filter accepts enter the map. The
+        #: filter may be DYNAMIC (a shard worker's owned-scope check) —
+        #: after a scope change the owner calls :meth:`refold` to
+        #: rebuild the map from the informer store; an event filtered
+        #: under a momentarily stale scope is repaired by that refold.
+        self._node_filter = node_filter
         self._lock = threading.Lock()
         self._health: dict[str, NodeHealth] = {}
         self._updates = 0
@@ -135,6 +147,15 @@ class HealthSource:
         if not name:
             log.warning("NodeHealthReport with no node attribution ignored")
             return
+        if self._node_filter is not None and not self._node_filter(name):
+            # Out of scope. Drop — and evict a leftover entry from a
+            # scope that since shrank, so a lost shard's nodes cannot
+            # linger in this worker's fold.
+            with self._lock:
+                if name in self._health:
+                    self._health.pop(name, None)
+                    self._updates += 1
+            return
         if event_type == "DELETED":
             with self._lock:
                 self._health.pop(name, None)
@@ -151,6 +172,27 @@ class HealthSource:
                 observer(health)
             except Exception:  # noqa: BLE001 - observers own their errors
                 log.exception("health observer failed for node %s", name)
+
+    def refold(self) -> None:
+        """Rebuild the map from the informer store against the CURRENT
+        filter — the scope-change repair (fleet shard failover: newly
+        owned nodes' reports are already in the store but were filtered
+        at delivery time; lost shards' entries must leave). The store
+        list completes before the map lock is taken, so no lock nests
+        under another."""
+        rebuilt: dict[str, NodeHealth] = {}
+        for obj in self._informer.list():
+            name = report_node_name(obj)
+            if not name:
+                continue
+            if self._node_filter is not None and not self._node_filter(name):
+                continue
+            health = parse_node_health(obj.raw)
+            if health is not None:
+                rebuilt[name] = health
+        with self._lock:
+            self._health = rebuilt
+            self._updates += 1
 
     # -- reads (reconcile thread + scrapers) -------------------------------
     def snapshot(self) -> Mapping[str, NodeHealth]:

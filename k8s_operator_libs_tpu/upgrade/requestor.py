@@ -180,15 +180,26 @@ class RequestorNodeStateManager:
         return f"{self.opts.node_maintenance_name_prefix}-{node_name}"
 
     def new_node_maintenance(
-        self, node_name: str, policy: Optional[DriverUpgradePolicySpec]
+        self,
+        node_name: str,
+        policy: Optional[DriverUpgradePolicySpec],
+        health=None,
     ) -> NodeMaintenance:
         """Build the CR from the upgrade policy
-        (reference: upgrade_requestor.go:161-180, 497-524)."""
+        (reference: upgrade_requestor.go:161-180, 497-524).
+
+        ``health`` (a telemetry ``NodeHealth``, when the health plane is
+        wired — ROADMAP 4c) is surfaced as ``spec.nodeHealth`` so the
+        external maintenance operator can order its own queue
+        degraded-first; absent telemetry leaves the field off entirely —
+        an operator must distinguish "healthy" from "unmeasured"."""
         nm = NodeMaintenance.new(
             self.node_maintenance_name(node_name), namespace=self.opts.namespace
         )
         nm.requestor_id = self.opts.requestor_id
         nm.node_name = node_name
+        if health is not None:
+            nm.node_health = {"score": health.score, "trend": health.trend}
         if policy is not None:
             drain: dict = {}
             if policy.drain is not None:
@@ -219,10 +230,13 @@ class RequestorNodeStateManager:
         return NodeMaintenance(obj.raw) if obj is not None else None
 
     def _create_node_maintenance(
-        self, node_state: NodeUpgradeState, policy: Optional[DriverUpgradePolicySpec]
+        self,
+        node_state: NodeUpgradeState,
+        policy: Optional[DriverUpgradePolicySpec],
+        health=None,
     ) -> None:
         """(reference: upgrade_requestor.go:185-201)"""
-        nm = self.new_node_maintenance(node_state.node.name, policy)
+        nm = self.new_node_maintenance(node_state.node.name, policy, health)
         node_state.node_maintenance = nm
         try:
             self.client.create(nm)
@@ -244,7 +258,10 @@ class RequestorNodeStateManager:
             self.client.delete("NodeMaintenance", name, self.opts.namespace)
 
     def create_or_update_node_maintenance(
-        self, node_state: NodeUpgradeState, policy: Optional[DriverUpgradePolicySpec]
+        self,
+        node_state: NodeUpgradeState,
+        policy: Optional[DriverUpgradePolicySpec],
+        health=None,
     ) -> None:
         """Shared-requestor append protocol
         (reference: upgrade_requestor.go:320-368): with the default name
@@ -256,7 +273,7 @@ class RequestorNodeStateManager:
             == DEFAULT_NODE_MAINTENANCE_NAME_PREFIX
         )
         if existing is None or not shared_naming:
-            self._create_node_maintenance(node_state, policy)
+            self._create_node_maintenance(node_state, policy, health)
             return
         nm = NodeMaintenance(existing.raw)
         if nm.requestor_id == self.opts.requestor_id:
@@ -364,7 +381,9 @@ class RequestorNodeStateManager:
                     "node %s already cordoned, proceeding despite budget",
                     node.name,
                 )
-            self.create_or_update_node_maintenance(ns, policy)
+            self.create_or_update_node_maintenance(
+                ns, policy, health=state.health_of(node.name)
+            )
             common.provider.change_node_upgrade_annotation(
                 node, common.keys.requestor_mode_annotation, TRUE_STRING
             )

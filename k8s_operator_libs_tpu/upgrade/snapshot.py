@@ -536,23 +536,33 @@ class IncrementalSnapshotSource(InformerSnapshotSource):
                 self._mark_node_locked(old_node)
             if event_type == "ADDED":
                 if uid:
-                    self._ds_pod_counts[uid] = (
-                        self._ds_pod_counts.get(uid, 0) + 1
-                    )
+                    self._bump_ds_pod_count_locked(uid, node, +1)
             elif event_type == "DELETED":
                 if uid:
-                    self._ds_pod_counts[uid] = (
-                        self._ds_pod_counts.get(uid, 0) - 1
-                    )
+                    self._bump_ds_pod_count_locked(uid, node, -1)
             elif uid != old_uid:  # MODIFIED with an ownerRef flip (rare)
                 if old_uid:
-                    self._ds_pod_counts[old_uid] = (
-                        self._ds_pod_counts.get(old_uid, 0) - 1
+                    self._bump_ds_pod_count_locked(
+                        old_uid, old_node if old_node is not None else node, -1
                     )
                 if uid:
-                    self._ds_pod_counts[uid] = (
-                        self._ds_pod_counts.get(uid, 0) + 1
-                    )
+                    self._bump_ds_pod_count_locked(uid, node, +1)
+            elif uid and old_node is not None and old_node != node:
+                # Same owner, pod re-placed onto another node: the per-uid
+                # total is unchanged (net zero here), but subclasses that
+                # attribute counts by node location (the fleet tier's
+                # shard-scoped source) must see the move.
+                self._bump_ds_pod_count_locked(uid, old_node, -1)
+                self._bump_ds_pod_count_locked(uid, node, +1)
+
+    def _bump_ds_pod_count_locked(
+        self, uid: str, node_name: str, delta: int
+    ) -> None:
+        """One owner-uid pod-count adjustment (caller holds _delta_lock).
+        ``node_name`` is where the counted pod lives — unused here, but
+        the override point for location-scoped accounting
+        (fleet/scope.py keeps a per-shard twin of this book)."""
+        self._ds_pod_counts[uid] = self._ds_pod_counts.get(uid, 0) + delta
 
     def _on_revision_event(self, event_type: str, obj, old) -> None:
         # A DS write changes desired counts and the rv keying the
@@ -790,18 +800,21 @@ class IncrementalSnapshotSource(InformerSnapshotSource):
         :meth:`Informer.with_settled_store`."""
         self._state = state
         self._assignment = dict(assignment)
+        self._informers["Pod"].with_settled_store(self._rebase_pod_counts)
 
-        def rebase(raws: list) -> None:
-            counts: dict[str, int] = {}
-            for raw in raws:
-                refs = (raw.get("metadata") or {}).get("ownerReferences") or []
-                uid = refs[0].get("uid") if refs else None
-                if uid:
-                    counts[uid] = counts.get(uid, 0) + 1
-            with self._delta_lock:
-                self._ds_pod_counts = counts
-
-        self._informers["Pod"].with_settled_store(rebase)
+    def _rebase_pod_counts(self, raws: list) -> None:
+        """Rebuild the per-DS pod book from the settled Pod store (the
+        prime() re-anchor; see :meth:`prime`). Overridable so scoped
+        sources re-anchor their location-keyed twin from the same
+        settled snapshot."""
+        counts: dict[str, int] = {}
+        for raw in raws:
+            refs = (raw.get("metadata") or {}).get("ownerReferences") or []
+            uid = refs[0].get("uid") if refs else None
+            if uid:
+                counts[uid] = counts.get(uid, 0) + 1
+        with self._delta_lock:
+            self._ds_pod_counts = counts
 
     def update_node(
         self,

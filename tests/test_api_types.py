@@ -144,3 +144,139 @@ class TestRoundTrip:
             QuarantineSpec(reprobe_backoff_seconds=60, max_backoff_seconds=30)
         with pytest.raises(ValueError):
             QuarantineSpec(handoff_after_seconds=-1)
+
+
+class TestFleetRollout:
+    """FleetRollout contract (api/fleet_v1alpha1.py) — the fleet tier's
+    grant ledger (docs/fleet-control-plane.md)."""
+
+    def test_round_trip(self):
+        from k8s_operator_libs_tpu.api import FleetRolloutSpec
+
+        spec = FleetRolloutSpec(
+            pools=["p0", "p1", "p2"],
+            max_unavailable_pools=IntOrString("50%"),
+        )
+        assert FleetRolloutSpec.from_dict(spec.to_dict()) == spec
+        # Explicit null = unlimited, surviving the round trip (the
+        # DriverUpgradePolicySpec.maxUnavailable convention).
+        unlimited = FleetRolloutSpec.from_dict(
+            {"pools": ["a", "b"], "maxUnavailablePools": None}
+        )
+        assert unlimited.max_unavailable_pools is None
+        assert unlimited.resolved_budget() == 2
+        assert FleetRolloutSpec.from_dict(unlimited.to_dict()) == unlimited
+        # A MISSING key takes the 25% default.
+        defaulted = FleetRolloutSpec.from_dict({"pools": ["a"]})
+        assert defaulted.max_unavailable_pools == IntOrString("25%")
+
+    def test_resolved_budget(self):
+        from k8s_operator_libs_tpu.api import FleetRolloutSpec
+
+        spec = FleetRolloutSpec.from_dict(
+            {"pools": [f"p{i}" for i in range(64)]}
+        )
+        assert spec.resolved_budget() == 16  # 25% of 64
+        # Percent rounding up, floored at 1: a budget of zero pools is a
+        # deadlock, not a safety feature.
+        tiny = FleetRolloutSpec.from_dict(
+            {"pools": ["a", "b"], "maxUnavailablePools": "10%"}
+        )
+        assert tiny.resolved_budget() == 1
+        absolute = FleetRolloutSpec.from_dict(
+            {"pools": ["a", "b"], "maxUnavailablePools": 50}
+        )
+        assert absolute.resolved_budget() == 2  # clamped to the roll set
+
+    def test_validation(self):
+        from k8s_operator_libs_tpu.api import FleetRolloutSpec
+
+        with pytest.raises(ValueError):
+            FleetRolloutSpec(pools=[])
+        with pytest.raises(ValueError):
+            FleetRolloutSpec(pools=["a", "a"])
+        with pytest.raises(ValueError):
+            FleetRolloutSpec(pools=["a", ""])
+
+    def test_ledger_phases(self):
+        from k8s_operator_libs_tpu.api import (
+            make_fleet_rollout,
+            pool_phase,
+            pools_in_phase,
+            set_pool_phase,
+        )
+
+        raw = make_fleet_rollout("roll", ["a", "b"], "25%")
+        assert pool_phase(raw, "a") == "pending"
+        assert set_pool_phase(raw, "a", "granted", grantedSeq=1)
+        assert not set_pool_phase(raw, "a", "granted"), "no-op re-set"
+        assert pools_in_phase(raw, "granted") == ["a"]
+        # A stale status entry for a pool no longer in spec.pools never
+        # counts (the budget is computed over the SPEC's pools).
+        set_pool_phase(raw, "ghost", "granted")
+        assert pools_in_phase(raw, "granted") == ["a"]
+        with pytest.raises(ValueError):
+            set_pool_phase(raw, "a", "nonsense")
+
+    def test_registry_matches_contract(self):
+        """The kube REST registry (kube/resources._bootstrap) and the
+        api contract must agree — the WorkloadCheckpoint two-sided pin."""
+        from k8s_operator_libs_tpu.api.fleet_v1alpha1 import (
+            FLEET_ROLLOUT_API_VERSION,
+            FLEET_ROLLOUT_KIND,
+            FLEET_ROLLOUT_PLURAL,
+        )
+        from k8s_operator_libs_tpu.kube.resources import resource_for_kind
+
+        info = resource_for_kind(FLEET_ROLLOUT_KIND)
+        assert info.api_version == FLEET_ROLLOUT_API_VERSION
+        assert info.plural == FLEET_ROLLOUT_PLURAL
+        assert info.namespaced is False
+
+
+class TestNodeMaintenanceHealth:
+    """ROADMAP 4c: the requestor surfaces the node health score on the
+    NodeMaintenance CR so an external maintenance operator can order
+    degraded-first too."""
+
+    def _requestor(self):
+        from k8s_operator_libs_tpu.kube import FakeCluster
+        from k8s_operator_libs_tpu.upgrade.requestor import (
+            RequestorNodeStateManager,
+            RequestorOptions,
+        )
+
+        return RequestorNodeStateManager(
+            FakeCluster(),
+            common=None,  # CR construction never touches the common layer
+            opts=RequestorOptions(use_maintenance_operator=True),
+        )
+
+    def test_health_round_trips_on_the_cr(self):
+        from k8s_operator_libs_tpu.api import parse_node_health
+        from k8s_operator_libs_tpu.api.telemetry_v1alpha1 import (
+            make_node_health_report,
+        )
+        from k8s_operator_libs_tpu.kube import NodeMaintenance
+
+        report = make_node_health_report(
+            "node-1", {"ring_allreduce": False},
+            {"ring_gbytes_per_s": 2.0, "probe_latency_s": 120.0},
+        )
+        health = parse_node_health(report)
+        nm = self._requestor().new_node_maintenance(
+            "node-1", policy=None, health=health
+        )
+        assert nm.node_health == {"score": health.score, "trend": health.trend}
+        assert nm.node_health["score"] < 100.0
+        # Round trip through the raw dict (what the apiserver stores).
+        again = NodeMaintenance(dict(nm.raw))
+        assert again.node_health == nm.node_health
+        # Clearing removes the field entirely.
+        again.node_health = None
+        assert "nodeHealth" not in again.spec
+
+    def test_no_telemetry_leaves_the_field_absent(self):
+        nm = self._requestor().new_node_maintenance("node-1", policy=None)
+        assert nm.node_health is None
+        assert "nodeHealth" not in nm.spec

@@ -241,3 +241,25 @@ class TestStageWatchdog:
         stop.set()
         thread.join(timeout=5)
         assert not thread.is_alive()
+
+
+def test_fleet_64_pools_shapes():
+    """Small-fleet twin of the fleet_64_pools section (8 pools, 2
+    hosts, 1 vs 2 workers over a real LocalApiServer): the budget and
+    degraded-first asserts run for real inside the section; here we pin
+    the artifact shape the CI floors resolve against. Scaling is not
+    asserted at this size (min_scaling_x=0) — a 2-worker split of 8
+    pools is noise-dominated; the 64-pool CI run owns that gate."""
+    out = bench.run_fleet_64_pools(
+        pools=8, hosts_per_pool=2, worker_counts=(1, 2), shards=4,
+        min_scaling_x=0.0,
+    )
+    assert out["budget_violations"] == 0
+    assert out["degraded_pools_first"] == 1.0
+    assert out["pools"] == 8 and out["nodes"] == 16
+    for key in ("workers_1", "workers_2"):
+        cfg = out[key]
+        assert cfg["pools_done"] == 8
+        assert cfg["aggregate_passes_per_s"] > 0
+        assert cfg["max_disrupted_pools_at_once"] <= cfg["budget_pools"]
+    assert "scaling_4w_vs_1w" in out

@@ -69,3 +69,14 @@ def test_demo_with_leader_election():
     assert "campaigning as 'demo-replica'" in proc.stdout
     assert "leading; starting reconciles" in proc.stdout
     assert "rolling upgrade complete" in proc.stdout
+
+
+def test_demo_fleet_mode_single_shard():
+    """--shards wires the fleet tier (docs/fleet-control-plane.md) into
+    the example: the worker claims its per-shard Lease, reconciles
+    through the shard-scoped incremental source, and the demo roll
+    still completes. One shard = the single-worker fleet shape."""
+    proc = run_demo("--shards", "1", "--shard-index", "0")
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-1000:]
+    assert "rolling upgrade complete" in proc.stdout
+    assert "shards=shard-00" in proc.stdout
