@@ -1468,8 +1468,11 @@ def run_fleet_64_pools(
     def pool_of(node_name: str) -> str:
         return node_name.split("-")[0]
 
-    def one_config(n_workers: int) -> dict:
+    def one_config(n_workers: int, use_hub: bool = False) -> dict:
+        from k8s_operator_libs_tpu.kube import WatchHub
+
         with LocalApiServer() as srv:
+            request_log = srv.start_request_log()
             _, sim = build_pool(
                 cluster=srv.cluster, slices=pools,
                 hosts_per_slice=hosts_per_pool,
@@ -1490,6 +1493,13 @@ def run_fleet_64_pools(
 
             budget = rollout_spec(rollout).resolved_budget()  # 16 at 64
             aggregator = FleetHealthAggregator(pool_of)
+            hub = hub_client = None
+            if use_hub:
+                # ONE hub (own client) multiplexing every co-hosted
+                # worker's watches: upstream streams stop scaling with
+                # worker count (docs/wire-path.md "Watch hub").
+                hub_client = RestClient(RestConfig(server=srv.url))
+                hub = WatchHub(hub_client)
             workers, clients = [], []
             for i in range(n_workers):
                 client = RestClient(RestConfig(server=srv.url))
@@ -1513,6 +1523,7 @@ def run_fleet_64_pools(
                         renew_deadline_s=3.0,
                         retry_period_s=0.5,
                         with_health=True,
+                        watch_hub=hub,
                     ),
                 )
                 worker.start(sync_timeout=60)
@@ -1637,8 +1648,27 @@ def run_fleet_64_pools(
                         "fleet_64_pools: ledger says done but driver pods "
                         "are not current"
                     )
+                srv.stop_request_log()
+                watch_opens: dict = {}
+                for method, req_path, query in request_log:
+                    if method == "GET" and query.get("watch") in (
+                        "true", "1"
+                    ):
+                        plural = req_path.rstrip("/").rsplit("/", 1)[-1]
+                        watch_opens[plural] = watch_opens.get(plural, 0) + 1
+                streams_per_kind = (
+                    max(watch_opens.values()) if watch_opens else 0
+                )
+                if use_hub and streams_per_kind != 1:
+                    raise RuntimeError(
+                        "fleet_64_pools: hub config opened "
+                        f"{watch_opens} upstream watch streams — expected "
+                        "exactly 1 per kind (attribution via the server "
+                        "request log)"
+                    )
                 return {
                     "workers": n_workers,
+                    "watch_hub": use_hub,
                     "wall_s": round(wall, 3),
                     "aggregate_passes": total_passes,
                     "aggregate_passes_per_s": round(total_passes / wall, 1),
@@ -1656,18 +1686,33 @@ def run_fleet_64_pools(
                     "shard_balance": [
                         sorted(w.owned_shards()) for w in workers
                     ],
+                    # Wire attribution (the fan-out numbers this PR's
+                    # hub exists to change): watch streams opened per
+                    # kind over the whole run, and the server-side bytes
+                    # spent on watch streams.
+                    "watch_streams_opened_per_kind": watch_opens,
+                    "upstream_watch_streams_per_kind": streams_per_kind,
+                    "watch_bytes_sent": srv.watch_bytes_sent,
                 }
             finally:
                 stop.set()
                 for worker in workers:
                     worker.stop()
+                if hub is not None:
+                    hub.stop()
                 for client in clients:
                     client.close()
+                if hub_client is not None:
+                    hub_client.close()
                 orch_client.close()
 
     configs = {f"workers_{n}": one_config(n) for n in worker_counts}
+    configs[f"workers_{worker_counts[-1]}_hub"] = one_config(
+        worker_counts[-1], use_hub=True
+    )
     base = configs[f"workers_{worker_counts[0]}"]
     peak = configs[f"workers_{worker_counts[-1]}"]
+    hub_cfg = configs[f"workers_{worker_counts[-1]}_hub"]
     scaling = round(
         peak["aggregate_passes_per_s"] / base["aggregate_passes_per_s"], 2
     ) if base["aggregate_passes_per_s"] else 0.0
@@ -1676,6 +1721,18 @@ def run_fleet_64_pools(
             f"fleet_64_pools: {worker_counts[-1]} workers scaled only "
             f"{scaling}x over 1 worker (aggregate passes/s) — the shard "
             "partition stopped paying for itself"
+        )
+    # The hub acceptance line (ISSUE 11): N co-hosted workers' aggregate
+    # watch bytes must stay within 1.3x of the ONE-worker figure —
+    # upstream load stops multiplying with worker count.
+    hub_watch_bytes_ratio = round(
+        hub_cfg["watch_bytes_sent"] / base["watch_bytes_sent"], 3
+    ) if base["watch_bytes_sent"] else 0.0
+    if hub_watch_bytes_ratio > 1.3:
+        raise RuntimeError(
+            f"fleet_64_pools: hub config at {worker_counts[-1]} workers "
+            f"paid {hub_watch_bytes_ratio}x the 1-worker watch bytes "
+            "(<= 1.3x required: the hub stopped multiplexing)"
         )
     return {
         "pools": pools,
@@ -1689,12 +1746,233 @@ def run_fleet_64_pools(
             c["budget_violations"] for c in configs.values()
         ),
         "scaling_4w_vs_1w": scaling,
+        # Hub attribution, CI-floor-gated (tools/bench_smoke_baseline):
+        # exactly 1 upstream watch stream per kind at 4 workers, and
+        # aggregate watch bytes within 1.3x of the 1-worker figure.
+        "hub_upstream_watch_streams_per_kind": hub_cfg[
+            "upstream_watch_streams_per_kind"
+        ],
+        "hub_watch_bytes_ratio_vs_1w": hub_watch_bytes_ratio,
+        "no_hub_watch_bytes_ratio_vs_1w": round(
+            peak["watch_bytes_sent"] / base["watch_bytes_sent"], 3
+        ) if base["watch_bytes_sent"] else 0.0,
         "note": "aggregate passes/s counts each worker's reconcile over "
                 "ITS OWN shards — at N workers a pass covers ~1/N of the "
                 "fleet, so scaling can exceed N (smaller scope per pass + "
                 "overlapped wire I/O); per-config wall_s is the "
                 "equal-units comparison",
         **configs,
+    }
+
+
+def run_report_storm(
+    monitor_nodes: int = 1000,
+    writer_threads: int = 64,
+    storm_seconds: float = 6.0,
+    lease_deadline_s: float = 2.0,
+) -> dict:
+    """ISSUE 11 — priority-and-fairness under a telemetry storm: a
+    simulated thousand-node monitor fleet saturates the apiserver with
+    NodeHealthReport status writes (the millions-of-users shape of this
+    control plane) while a lease renews on a deadline and a reconcile
+    writer patches nodes.
+
+    Hard-asserted:
+
+    * **zero missed lease renewals** — no gap between successful lease
+      renewals ever exceeds the lease deadline, storm or not (the whole
+      point of the per-flow queues: telemetry cannot starve the
+      heartbeats that keep shard ownership alive);
+    * **the storm actually saturates** — the telemetry flow SHED
+      requests as 429 + Retry-After (otherwise the drill proves
+      nothing) while the lease flow shed zero;
+    * **bounded reconcile latency** — the node-patch p99 stays under
+      1s under full telemetry saturation (CI floor pins the measured
+      figure at tools/bench_smoke_baseline.json: report_storm.*).
+    """
+    import threading
+
+    from k8s_operator_libs_tpu.kube import (
+        LocalApiServer,
+        RestClient,
+        RestConfig,
+        TooManyRequestsError,
+        wrap,
+    )
+    from k8s_operator_libs_tpu.kube.apiserver import ApfConfig, FlowConfig
+
+    # Every writer must own at least one report name (a thread with an
+    # empty round-robin slice would divide by zero).
+    writer_threads = max(1, min(int(writer_threads), int(monitor_nodes)))
+    from k8s_operator_libs_tpu.api.telemetry_v1alpha1 import (
+        NODE_HEALTH_REPORT_API_VERSION,
+        NODE_HEALTH_REPORT_KIND,
+    )
+
+    apf = ApfConfig(retry_after_s=0.05)
+    # Production-shaped telemetry bound: small enough that a storm from
+    # a thousand-node monitor fleet (64 concurrent connections here —
+    # the concurrency unit a storm actually multiplies) sheds instead
+    # of queueing without limit.
+    apf.flows["telemetry"] = FlowConfig(queue_depth=8, concurrency=1)
+    with LocalApiServer(apf=apf) as srv:
+        srv.cluster.create(wrap({
+            "kind": "Lease",
+            "apiVersion": "coordination.k8s.io/v1",
+            "metadata": {"name": "storm-lease", "namespace": "kube-system"},
+            "spec": {"holderIdentity": "worker-0"},
+        }))
+        srv.cluster.create(wrap({
+            "kind": "Node", "apiVersion": "v1",
+            "metadata": {"name": "recon-node"},
+        }))
+        stop = threading.Event()
+        errors: list = []
+        telemetry_attempts = [0] * writer_threads
+        telemetry_429s = [0] * writer_threads
+
+        def monitor_fleet(index: int) -> None:
+            """One writer thread standing in for a slice of the monitor
+            fleet: cycles its nodes' reports as fast as the server
+            admits them; a shed (429 after the client's bounded
+            Retry-After retries) is dropped telemetry freshness, by
+            design."""
+            cfg = RestConfig(server=srv.url)
+            cfg.too_many_requests_retries = 0  # the loop IS the retry
+            client = RestClient(cfg)
+            names = [
+                f"storm-{n}" for n in range(monitor_nodes)
+                if n % writer_threads == index
+            ]
+            beat = 0
+            try:
+                while not stop.is_set():
+                    name = names[beat % len(names)]
+                    beat += 1
+                    report = wrap({
+                        "kind": NODE_HEALTH_REPORT_KIND,
+                        "apiVersion": NODE_HEALTH_REPORT_API_VERSION,
+                        "metadata": {"name": name},
+                        # beat varies per write so server-side apply
+                        # never no-ops the storm into free requests.
+                        "spec": {"nodeName": name, "beat": beat},
+                    })
+                    telemetry_attempts[index] += 1
+                    try:
+                        client.apply(report, field_manager=f"mon-{index}")
+                    except TooManyRequestsError:
+                        telemetry_429s[index] += 1
+                    except Exception as e:  # noqa: BLE001 - surfaced below
+                        errors.append(f"writer-{index}: {e!r}")
+                        return
+            finally:
+                client.close()
+
+        renew_gaps: list = []
+        renew_latencies: list = []
+
+        def lease_renewer() -> None:
+            client = RestClient(RestConfig(server=srv.url))
+            last_success = time.monotonic()
+            try:
+                while not stop.is_set():
+                    started = time.perf_counter()
+                    obj = client.get("Lease", "storm-lease", "kube-system")
+                    obj.raw["spec"]["renewTime"] = time.time()
+                    client.update(obj)
+                    renew_latencies.append(time.perf_counter() - started)
+                    now = time.monotonic()
+                    renew_gaps.append(now - last_success)
+                    last_success = now
+                    stop.wait(0.2)
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                errors.append(f"lease: {e!r}")
+            finally:
+                client.close()
+
+        reconcile_latencies: list = []
+
+        def reconciler() -> None:
+            client = RestClient(RestConfig(server=srv.url))
+            i = 0
+            try:
+                while not stop.is_set():
+                    i += 1
+                    started = time.perf_counter()
+                    client.patch("Node", "recon-node", patch={
+                        "metadata": {"labels": {"pass": str(i)}}
+                    })
+                    reconcile_latencies.append(
+                        time.perf_counter() - started
+                    )
+                    stop.wait(0.01)
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                errors.append(f"reconcile: {e!r}")
+            finally:
+                client.close()
+
+        threads = [
+            threading.Thread(target=monitor_fleet, args=(i,), daemon=True)
+            for i in range(writer_threads)
+        ]
+        threads.append(threading.Thread(target=lease_renewer, daemon=True))
+        threads.append(threading.Thread(target=reconciler, daemon=True))
+        for thread in threads:
+            thread.start()
+        time.sleep(storm_seconds)
+        stop.set()
+        for thread in threads:
+            thread.join(timeout=10)
+        stats = srv.apf_stats()
+
+    if errors:
+        raise RuntimeError(f"report_storm: unexpected errors: {errors[:5]}")
+    missed = sum(1 for gap in renew_gaps if gap > lease_deadline_s)
+    sheds = stats["telemetry"]["shed_429_total"]
+    attempts = sum(telemetry_attempts)
+    if missed:
+        raise RuntimeError(
+            f"report_storm: {missed} lease renewal gaps exceeded the "
+            f"{lease_deadline_s}s deadline (max {max(renew_gaps):.3f}s) — "
+            "telemetry starved the lease flow"
+        )
+    if stats["lease"]["shed_429_total"]:
+        raise RuntimeError("report_storm: the lease flow was shed")
+    if not sheds:
+        raise RuntimeError(
+            "report_storm: the telemetry flood never shed — the drill "
+            f"proved nothing (attempts={attempts})"
+        )
+    if not reconcile_latencies or not renew_gaps:
+        raise RuntimeError("report_storm: a measured loop never ran")
+    reconcile_sorted = sorted(reconcile_latencies)
+
+    def percentile(values: list, q: float) -> float:
+        return values[min(len(values) - 1, int(q * len(values)))]
+
+    p99 = percentile(reconcile_sorted, 0.99)
+    if p99 > 1.0:
+        raise RuntimeError(
+            f"report_storm: reconcile p99 {p99:.3f}s under saturation "
+            "(>1s hard bound)"
+        )
+    return {
+        "monitor_nodes": monitor_nodes,
+        "writer_threads": writer_threads,
+        "storm_seconds": storm_seconds,
+        "telemetry_writes_attempted": attempts,
+        "telemetry_writes_admitted": stats["telemetry"]["admitted_total"],
+        "telemetry_sheds_429": sheds,
+        "telemetry_queue_high_water": stats["telemetry"]["max_queued"],
+        "lease_renewals": len(renew_gaps),
+        "missed_lease_renewals": missed,
+        "max_renewal_gap_s": round(max(renew_gaps), 4),
+        "renew_p99_s": round(percentile(sorted(renew_latencies), 0.99), 4),
+        "reconcile_writes": len(reconcile_latencies),
+        "reconcile_p50_s": round(percentile(reconcile_sorted, 0.50), 4),
+        "reconcile_p99_s": round(p99, 4),
+        "lease_sheds_429": stats["lease"]["shed_429_total"],
+        "apf_flows": stats,
     }
 
 
@@ -1876,6 +2154,7 @@ SECTIONS = {
     "live_workload_roll": run_live_workload_roll,
     "degraded_first_roll": run_degraded_first_roll,
     "fleet_64_pools": run_fleet_64_pools,
+    "report_storm": run_report_storm,
     "ring_bandwidth": run_ring_bandwidth,
     "http_wire_roll": run_http_wire_roll,
     "wire_encoding": run_wire_encoding,
@@ -1998,6 +2277,11 @@ def main() -> None:
     fleet = run_fleet_64_pools()
     _progress("fleet_64_pools")
 
+    # Wire path at fleet fan-out (ISSUE 11): priority-and-fairness under
+    # a thousand-node telemetry storm (docs/wire-path.md).
+    storm = run_report_storm()
+    _progress("report_storm")
+
     details = {
         "backend": backend,
         # Trial counts derived from the actual result objects — never a
@@ -2035,6 +2319,7 @@ def main() -> None:
         "ring_bandwidth": ring_bw,
         "degraded_first_roll": degraded_first,
         "fleet_64_pools": fleet,
+        "report_storm": storm,
         "gate_cold_vs_warm": gate_split,
         "devices": [str(d) for d in jax.devices()],
         "calibration": calibration,
@@ -2096,6 +2381,19 @@ def main() -> None:
             "fleet_4w_passes_per_s": fleet["workers_4"][
                 "aggregate_passes_per_s"
             ],
+            "hub_upstream_watch_streams_per_kind": fleet[
+                "hub_upstream_watch_streams_per_kind"
+            ],
+            "hub_watch_bytes_ratio_vs_1w": fleet[
+                "hub_watch_bytes_ratio_vs_1w"
+            ],
+            "report_storm_missed_lease_renewals": storm[
+                "missed_lease_renewals"
+            ],
+            "report_storm_telemetry_sheds_429": storm[
+                "telemetry_sheds_429"
+            ],
+            "report_storm_reconcile_p99_s": storm["reconcile_p99_s"],
         },
         "metric": "v5e-16 pool libtpu rolling-upgrade wall-clock "
         "(simulated GKE pool, real ICI/MXU health gate; median of "

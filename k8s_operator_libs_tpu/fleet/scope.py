@@ -62,6 +62,7 @@ class ShardScopedSnapshotSource(IncrementalSnapshotSource):
         shard_of_node: Callable[[str], str],
         resync_period_s: float = DEFAULT_RESYNC_PERIOD_S,
         verify_every_n: int = 0,
+        watch_hub=None,
     ) -> None:
         # Scope state first: super().__init__ registers the event
         # handlers this subclass overrides, and they read these fields.
@@ -85,6 +86,7 @@ class ShardScopedSnapshotSource(IncrementalSnapshotSource):
             driver_labels,
             resync_period_s=resync_period_s,
             verify_every_n=verify_every_n,
+            watch_hub=watch_hub,
         )
 
     # -- shard mapping -----------------------------------------------------

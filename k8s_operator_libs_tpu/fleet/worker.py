@@ -112,6 +112,14 @@ class FleetWorkerConfig:
     #: register it with the orchestrator's FleetHealthAggregator for
     #: the global degraded-first fold.
     with_health: bool = False
+    #: Shared :class:`~..kube.watchhub.WatchHub` for CO-HOSTED workers:
+    #: every informer this worker runs (snapshot source + HealthSource)
+    #: subscribes to the hub's multiplexed upstream streams instead of
+    #: opening its own — N workers in one process then cost 1 upstream
+    #: watch per kind, not N (docs/wire-path.md "Watch hub"). The hub
+    #: rides its OWN client; this worker's client keeps carrying lists,
+    #: writes, and lease traffic.
+    watch_hub: Optional[Any] = None
     device: Optional[DeviceClass] = None
 
     def resolved_failover_probe_s(self) -> float:
@@ -289,6 +297,7 @@ class ShardWorker:
             shard_of_node=self._shard_of_node,
             resync_period_s=config.resync_period_s,
             verify_every_n=config.verify_every_n,
+            watch_hub=config.watch_hub,
         )
         if manager is None:
             manager = ClusterUpgradeStateManager(
@@ -373,7 +382,9 @@ class ShardWorker:
             from ..upgrade.health_source import HealthSource
 
             self.health = HealthSource(
-                self.client, node_filter=self.source.in_scope
+                self.client,
+                node_filter=self.source.in_scope,
+                watch_hub=self.config.watch_hub,
             )
             self.mgr.with_health_telemetry(
                 self.health, sync_timeout=sync_timeout

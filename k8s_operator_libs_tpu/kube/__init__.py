@@ -5,6 +5,8 @@ from .client import (
     Client,
     ConflictError,
     InvalidError,
+    ListDelta,
+    TooManyRequestsError,
     UnsupportedMediaTypeError,
     WatchExpiredError,
     NotFoundError,
@@ -33,6 +35,7 @@ from .resources import ResourceInfo, register_resource, resource_for_kind
 from .rest import RestClient, RestConfig, RestConfigError
 from .apiserver import LocalApiServer
 from .informer import Informer
+from .watchhub import WatchHub
 from .leader import LeaderElectionConfig, LeaderElector
 from .controller import Controller, Request, Result
 from .structural import StructuralSchema, schema_for_crd_version
@@ -66,6 +69,8 @@ __all__ = [
     "FakeCluster",
     "FakeRecorder",
     "InvalidError",
+    "ListDelta",
+    "TooManyRequestsError",
     "UnsupportedMediaTypeError",
     "WatchExpiredError",
     "KubeObject",
@@ -75,6 +80,7 @@ __all__ = [
     "Lease",
     "Informer",
     "LocalApiServer",
+    "WatchHub",
     "ApplyConflictError",
     "json_patch",
     "merge_patch",

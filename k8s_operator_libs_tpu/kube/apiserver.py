@@ -45,9 +45,11 @@ import re
 import ssl
 import threading
 import urllib.parse
+from collections import deque
+from dataclasses import dataclass, field
 from typing import Any, Optional
 
-from .client import ApiError, BadRequestError
+from .client import ApiError, BadRequestError, WatchExpiredError
 from .fake import FakeCluster, WatchFrameSource
 from .objects import wrap
 from .resources import ResourceInfo, resource_for_plural
@@ -81,7 +83,7 @@ _REASONS = {
     200: "OK", 201: "Created", 400: "Bad Request", 401: "Unauthorized",
     404: "Not Found", 405: "Method Not Allowed", 409: "Conflict",
     410: "Gone", 415: "Unsupported Media Type", 422: "Unprocessable Entity",
-    500: "Internal Server Error",
+    429: "Too Many Requests", 500: "Internal Server Error",
 }
 
 #: Upper bound on queued-but-undelivered events per watch stream; a
@@ -155,6 +157,150 @@ class _WatchParams:
         self.info = info
         self.namespace = namespace
         self.query = query
+
+
+@dataclass(frozen=True)
+class FlowConfig:
+    """One APF flow's bounds: ``queue_depth`` pending requests (overflow
+    sheds 429 + Retry-After) and ``concurrency`` — the dispatch batch the
+    scheduler drains from this flow before re-checking higher-priority
+    queues (handlers are synchronous on the loop, so this is the unit of
+    head-of-line blocking a flow may impose on flows above it)."""
+
+    queue_depth: int
+    concurrency: int = 1
+
+
+#: The flow a request belongs to, in strict priority order. Lease traffic
+#: (the heartbeats that keep shard ownership alive) outranks reconcile
+#: writes, which outrank informer reads (seed LISTs + watch
+#: establishment), which outrank telemetry status reports — so a
+#: monitor-report storm from thousands of nodes degrades telemetry
+#: freshness, never lease renewal (docs/wire-path.md "Priority and
+#: fairness").
+APF_PRIORITY = ("lease", "reconcile", "informer", "telemetry")
+
+
+def _default_flows() -> dict:
+    return {
+        "lease": FlowConfig(queue_depth=1024, concurrency=4),
+        "reconcile": FlowConfig(queue_depth=1024, concurrency=4),
+        "informer": FlowConfig(queue_depth=1024, concurrency=2),
+        "telemetry": FlowConfig(queue_depth=256, concurrency=2),
+    }
+
+
+@dataclass
+class ApfConfig:
+    """API priority-and-fairness at the LocalApiServer: per-flow FIFO
+    queues with bounded depth, drained in strict priority order; a full
+    queue sheds the request as 429 with ``Retry-After`` (honored by
+    RestClient's typed-error retry path). Defaults are generous enough
+    that only a genuine storm sheds; production tunings shrink the
+    telemetry queue. A partial ``flows`` dict is MERGED over the
+    defaults — ``ApfConfig(flows={"telemetry": FlowConfig(8)})`` tunes
+    one flow without un-configuring the other three."""
+
+    enabled: bool = True
+    #: Retry-After hint sent with every 429 (seconds; fractional OK for
+    #: the in-process client, rendered as-is).
+    retry_after_s: float = 1.0
+    flows: dict = field(default_factory=_default_flows)
+
+    def __post_init__(self) -> None:
+        for flow, cfg in _default_flows().items():
+            self.flows.setdefault(flow, cfg)
+
+
+def classify_flow(method: str, path: str) -> str:
+    """Request → APF flow, from the RESOURCE segment of the parsed path
+    (the same route grammar the dispatcher uses — a pod named
+    ``leases-cache-0`` or a namespace named ``leases`` must not ride the
+    lease flow): Lease objects (any verb) are ``lease``;
+    NodeHealthReport writes are ``telemetry``; remaining GETs
+    (list/watch) are ``informer``; every other write is ``reconcile``."""
+    m = _PATH_RE.match(path)
+    plural = m.group("plural") if m is not None else ""
+    if plural == "leases":
+        return "lease"
+    if method != "GET" and plural == "nodehealthreports":
+        return "telemetry"
+    if method == "GET":
+        return "informer"
+    return "reconcile"
+
+
+class _ApfShed(Exception):
+    """Internal marker: the flow queue was full; answer 429."""
+
+
+class _ApfScheduler:
+    """Per-flow FIFO queues drained in strict priority order by ONE
+    task on the server loop. Handlers are synchronous, so the scheduler
+    IS the concurrency bound; its job is ordering and shedding: a lease
+    renewal enqueued behind a thousand pending telemetry writes is
+    served next, and telemetry past its queue depth is shed instead of
+    ever entering the loop's work."""
+
+    def __init__(self, config: ApfConfig, loop) -> None:
+        self._config = config
+        self._loop = loop
+        self._queues: dict[str, deque] = {f: deque() for f in APF_PRIORITY}
+        self._wake = asyncio.Event()
+        self.stats: dict[str, dict[str, int]] = {
+            f: {"admitted": 0, "shed": 0, "max_queued": 0}
+            for f in APF_PRIORITY
+        }
+        self._task = loop.create_task(self._drain())
+
+    def close(self) -> None:
+        self._task.cancel()
+
+    def queue_depths(self) -> dict[str, int]:
+        return {f: len(q) for f, q in self._queues.items()}
+
+    async def submit(self, flow: str, thunk):
+        """Enqueue ``thunk`` on ``flow``'s FIFO and await its result;
+        raises ``_ApfShed`` immediately when the queue is full."""
+        q = self._queues[flow]
+        cfg = self._config.flows[flow]
+        stats = self.stats[flow]
+        if len(q) >= cfg.queue_depth:
+            stats["shed"] += 1
+            raise _ApfShed()
+        future = self._loop.create_future()
+        q.append((future, thunk))
+        if len(q) > stats["max_queued"]:
+            stats["max_queued"] = len(q)
+        self._wake.set()
+        return await future
+
+    async def _drain(self) -> None:
+        while True:
+            flow = next(
+                (f for f in APF_PRIORITY if self._queues[f]), None
+            )
+            if flow is None:
+                self._wake.clear()
+                await self._wake.wait()
+                continue
+            q = self._queues[flow]
+            batch = max(1, self._config.flows[flow].concurrency)
+            for _ in range(batch):
+                if not q:
+                    break
+                future, thunk = q.popleft()
+                if future.done():
+                    continue  # submitter went away (connection killed)
+                self.stats[flow]["admitted"] += 1
+                try:
+                    future.set_result(thunk())
+                except BaseException as e:  # noqa: BLE001 - to submitter
+                    future.set_exception(e)
+            # Yield between batches: connection tasks get to read newly
+            # arrived requests, so a lease renewal landing mid-storm is
+            # seen before the next telemetry batch.
+            await asyncio.sleep(0)
 
 
 class _Dispatcher:
@@ -304,6 +450,39 @@ class _Dispatcher:
                     200, self._table(cluster, info, [obj.raw], query)
                 )
             return _Response(200, obj.raw)
+        since = query.get("sinceResourceVersion", "")
+        if since:
+            # Delta-aware LIST (docs/wire-path.md): the client presents
+            # the revision it is current through; inside the journal
+            # window the response is deltas-since-rv (changed items +
+            # departed keys) instead of a full snapshot. Outside the
+            # window: 410 Gone, and the client takes the full path —
+            # the same decay contract as watch resumption.
+            delta = cluster.list_delta(
+                info.kind,
+                since,
+                namespace=namespace,
+                label_selector=query.get("labelSelector") or None,
+                field_selector=query.get("fieldSelector") or None,
+            )
+            if delta is None:
+                raise WatchExpiredError(
+                    f"resourceVersion {since} fell out of the journal; "
+                    "a full list is required"
+                )
+            return _Response(200, {
+                "apiVersion": info.api_version,
+                "kind": f"{info.kind}List",
+                "metadata": {
+                    "resourceVersion": delta.revision,
+                    "deltaSince": since,
+                },
+                "items": [o.raw for o in delta.items],
+                "deletedItems": [
+                    {"namespace": ns, "name": n}
+                    for ns, n in delta.deleted
+                ],
+            })
         try:
             limit = int(query.get("limit", "0") or "0")
         except ValueError:
@@ -545,9 +724,15 @@ class LocalApiServer:
         certfile: str = "",
         keyfile: str = "",
         bookmark_interval_s: float = 15.0,
+        apf: Optional[ApfConfig] = None,
     ) -> None:
         self.cluster = cluster if cluster is not None else FakeCluster()
         self.token = token
+        #: Priority-and-fairness: per-flow FIFO queues + shedding. On by
+        #: default with storm-sized bounds (see ApfConfig); pass
+        #: ``ApfConfig(enabled=False)`` for the raw dispatch path.
+        self.apf = apf if apf is not None else ApfConfig()
+        self._apf_scheduler: Optional[_ApfScheduler] = None
         #: Cadence of BOOKMARK events on watches that opted in via
         #: ``allowWatchBookmarks=true`` (the real server sends them about
         #: once a minute; tests shrink this to exercise the path).
@@ -573,7 +758,29 @@ class LocalApiServer:
         self.watch_streams = 0
         self.watch_frames_sent = 0
         self.bytes_sent = 0
+        #: Bytes written on watch STREAMS only (head + frames + terminal
+        #: chunk) — the attribution the hub bench compares across worker
+        #: counts (aggregate watch bytes must not multiply with workers).
+        self.watch_bytes_sent = 0
         self._request_log: Optional[list] = None
+
+    def apf_stats(self) -> dict[str, dict[str, int]]:
+        """Per-flow priority-and-fairness counters: current queue depth,
+        admitted/shed totals (a shed IS a 429), high-water queue depth.
+        Empty when APF is disabled. Feeds ``tpu_operator_wire_apf_*``."""
+        scheduler = self._apf_scheduler
+        if scheduler is None:
+            return {}
+        depths = scheduler.queue_depths()
+        return {
+            flow: {
+                "queued": depths.get(flow, 0),
+                "admitted_total": stats["admitted"],
+                "shed_429_total": stats["shed"],
+                "max_queued": stats["max_queued"],
+            }
+            for flow, stats in scheduler.stats.items()
+        }
 
     def start_request_log(self) -> list:
         """Begin recording ``(method, path, query)`` per request served
@@ -626,6 +833,8 @@ class LocalApiServer:
                     )
                 )
                 self._port = self._server.sockets[0].getsockname()[1]
+                if self.apf.enabled:
+                    self._apf_scheduler = _ApfScheduler(self.apf, loop)
             except BaseException as e:  # noqa: BLE001 - surfaced to start()
                 self._startup_error = e
                 return
@@ -724,8 +933,34 @@ class LocalApiServer:
                 request_log = self._request_log
                 if request_log is not None:
                     request_log.append((req.method, req.path, dict(req.query)))
+                scheduler = self._apf_scheduler
                 try:
-                    result = self._dispatcher.dispatch(req)
+                    if scheduler is not None:
+                        flow = classify_flow(req.method, req.path)
+                        result = await scheduler.submit(
+                            flow, lambda: self._dispatcher.dispatch(req)
+                        )
+                    else:
+                        result = self._dispatcher.dispatch(req)
+                except _ApfShed:
+                    # Shed, not queued: the flow is over its depth. The
+                    # client backs off per Retry-After and retries; the
+                    # connection stays healthy (keep-alive preserved).
+                    await self._write_response(
+                        writer, 429,
+                        _status_body(
+                            429, "TooManyRequests",
+                            "request shed by priority-and-fairness; "
+                            "retry after backoff",
+                        ),
+                        "json", keep_alive=req.keep_alive,
+                        extra_headers={
+                            "Retry-After": f"{self.apf.retry_after_s:g}"
+                        },
+                    )
+                    if not req.keep_alive:
+                        return
+                    continue
                 except ApiError as e:
                     result = _Response(
                         e.status, _status_body(e.status, e.reason, e.message)
@@ -763,6 +998,7 @@ class LocalApiServer:
         body: Optional[dict[str, Any]],
         encoding: str,
         keep_alive: bool,
+        extra_headers: Optional[dict[str, str]] = None,
     ) -> None:
         payload = encode_body(body, encoding) if body is not None else b""
         head = (
@@ -770,6 +1006,8 @@ class LocalApiServer:
             f"Content-Type: {content_type_for(encoding)}\r\n"
             f"Content-Length: {len(payload)}\r\n"
         )
+        for header_name, header_value in (extra_headers or {}).items():
+            head += f"{header_name}: {header_value}\r\n"
         if not keep_alive:
             head += "Connection: close\r\n"
         data = head.encode("latin-1") + b"\r\n" + payload
@@ -871,6 +1109,7 @@ class LocalApiServer:
             ).encode("latin-1")
             writer.write(head)
             self.bytes_sent += len(head)
+            self.watch_bytes_sent += len(head)
             for frame, data in replay:
                 await self._write_frame(
                     writer, frame, data, encoding, info, query, as_table
@@ -917,6 +1156,7 @@ class LocalApiServer:
             # Terminal chunk: the window is over, the connection lives on.
             writer.write(b"0\r\n\r\n")
             self.bytes_sent += 5
+            self.watch_bytes_sent += 5
             await writer.drain()
         except (ConnectionError, asyncio.IncompleteReadError):
             return  # consumer went away mid-stream
@@ -944,6 +1184,7 @@ class LocalApiServer:
         writer.write(chunk)
         self.watch_frames_sent += 1
         self.bytes_sent += len(chunk)
+        self.watch_bytes_sent += len(chunk)
         await writer.drain()
 
     # -- kubeconfig emission ----------------------------------------------

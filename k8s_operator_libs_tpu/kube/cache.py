@@ -33,7 +33,6 @@ from .client import Client, NotFoundError
 from .fake import FakeCluster, deep_copy_json
 from .objects import KubeObject, wrap
 from .selectors import LabelSelector, parse_field_selector, parse_selector
-from .fake import _field_value  # shared field-selector traversal
 
 
 class CachedClient(Client):
@@ -140,7 +139,7 @@ class CachedClient(Client):
                 labels = (data.get("metadata") or {}).get("labels") or {}
                 if not selector.matches(labels):
                     continue
-                if any(_field_value(data, f) != v for f, v in fields.items()):
+                if not fields.matches(data):
                     continue
                 out.append(wrap(deep_copy_json(data)))
         return out

@@ -65,6 +65,20 @@ class WatchExpiredError(ApiError):
     reason = "Expired"
 
 
+class TooManyRequestsError(ApiError):
+    """Shed by the server's priority-and-fairness layer (429): the
+    request's flow queue is full. ``retry_after_s`` carries the server's
+    Retry-After hint; RestClient honors it with a bounded transparent
+    retry before surfacing this error (docs/wire-path.md)."""
+
+    status = 429
+    reason = "TooManyRequests"
+
+    def __init__(self, message: str = "", retry_after_s: float = 1.0) -> None:
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
 class UnsupportedMediaTypeError(ApiError):
     """Patch content type the resource cannot accept (415): a real
     apiserver only supports strategic merge patches for built-in typed
@@ -72,6 +86,36 @@ class UnsupportedMediaTypeError(ApiError):
 
     status = 415
     reason = "UnsupportedMediaType"
+
+
+class ListDelta:
+    """A deltas-since-rv LIST result (the journal-backed fast re-list,
+    docs/wire-path.md): ``items`` is the CURRENT state of every in-scope
+    object that changed after the presented revision, ``deleted`` the
+    ``(namespace, name)`` keys that left the collection or the selector
+    scope, ``revision`` the collection revision a follow-up watch
+    resumes from. Servers answer it only while the presented revision is
+    inside their event journal; outside the window the client falls back
+    to a full snapshot.
+
+    ``full=True`` means the server answered a FULL list instead (it
+    predates delta lists): ``items`` is then the complete collection and
+    ``deleted`` is empty — the caller diffs against its own store rather
+    than refetching the bytes already in hand."""
+
+    __slots__ = ("items", "deleted", "revision", "full")
+
+    def __init__(
+        self,
+        items: list[KubeObject],
+        deleted: list[tuple[str, str]],
+        revision: str,
+        full: bool = False,
+    ) -> None:
+        self.items = items
+        self.deleted = deleted
+        self.revision = revision
+        self.full = full
 
 
 class Client(abc.ABC):

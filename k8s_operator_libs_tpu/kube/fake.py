@@ -33,6 +33,7 @@ from .client import (
     Client,
     ConflictError,
     InvalidError,
+    ListDelta,
     NotFoundError,
     UnsupportedMediaTypeError,
     WatchExpiredError,
@@ -48,7 +49,6 @@ from .objects import (
 from .resources import resource_for_kind
 from .selectors import LabelSelector, parse_field_selector, parse_selector
 from .ssa import reassign_on_write, server_side_apply
-from .jsonpath import dotted_value
 from .structural import (
     error_root_field,
     schema_for_crd_version,
@@ -629,27 +629,26 @@ def json_patch(target: dict[str, Any], ops: Any) -> dict[str, Any]:
     return target
 
 
-def _field_value(data: Mapping[str, Any], dotted: str) -> Any:
-    return dotted_value(data, dotted)
-
-
 def classify_watch_event(
     event_type: str,
     data: Mapping[str, Any],
     old: Optional[Mapping[str, Any]],
     selector,
-    fields: Mapping[str, str],
+    fields,
 ) -> Optional[str]:
     """Classify a store event against a selector scope by old-vs-new state —
     the real watch cache's logic: entering scope is ADDED, leaving it is
     DELETED, staying in is MODIFIED; None = out of scope throughout.
     Stateless, so replayed and live events classify identically. Shared by
-    the HTTP apiserver's watch handler and FakeCluster.watch."""
+    the HTTP apiserver's watch handler and FakeCluster.watch — the
+    server-side selector evaluation that keeps scoped watch streams (and
+    hub scopes riding them) carrying only in-scope bytes.
+    ``fields`` is a :class:`~.selectors.FieldSelector`."""
 
     def in_scope(obj: Mapping[str, Any]) -> bool:
         meta = obj.get("metadata") or {}
-        return selector.matches(meta.get("labels") or {}) and not any(
-            _field_value(obj, f) != v for f, v in fields.items()
+        return selector.matches(meta.get("labels") or {}) and fields.matches(
+            obj
         )
 
     new_matches = event_type != _WATCH_DELETED and in_scope(data)
@@ -1479,7 +1478,7 @@ class FakeCluster(Client):
                 labels = (data.get("metadata") or {}).get("labels") or {}
                 if not selector.matches(labels):
                     continue
-                if any(_field_value(data, f) != v for f, v in fields.items()):
+                if not fields.matches(data):
                     continue
                 out.append(wrap(deep_copy_json(data)))
             return out
@@ -1554,6 +1553,69 @@ class FakeCluster(Client):
         with self._lock:
             items = self.list(kind, namespace, label_selector, field_selector)
             return items, self.current_resource_version()
+
+    def list_delta(
+        self,
+        kind: str,
+        since_resource_version: str,
+        namespace: str = "",
+        label_selector: Optional[str | Mapping[str, str]] = None,
+        field_selector: Optional[str] = None,
+    ) -> Optional[ListDelta]:
+        """Deltas-since-rv LIST (the journal-backed fast re-list,
+        docs/wire-path.md): when ``since_resource_version`` is inside
+        the event journal, answer the CURRENT state of every in-scope
+        object touched after it plus the keys that left the collection
+        (or the selector scope) — O(what changed), not O(collection).
+        Returns ``None`` when the revision fell out of the journal (the
+        410 analog — the HTTP layer answers Gone and the client falls
+        back to a full snapshot)."""
+        try:
+            since = int(since_resource_version)
+        except (TypeError, ValueError):
+            raise InvalidError(
+                f"invalid resourceVersion {since_resource_version!r}"
+            ) from None
+        if isinstance(label_selector, Mapping):
+            selector = LabelSelector.from_match_labels(label_selector)
+        else:
+            selector = parse_selector(label_selector)
+        fields = parse_field_selector(field_selector)
+        with self._lock:
+            self._react("list", kind, {"namespace": namespace})
+            last_rv = getattr(self, "_last_rv", 0)
+            # Same coverage rules as watch resumption (subscribe_since):
+            # a gap between `since` and the oldest journal entry means
+            # events were lost to compaction — only a full list repairs.
+            if self._history and self._history[0][0] > since + 1:
+                return None
+            if not self._history and since < last_rv:
+                return None
+            touched: dict[tuple[str, str], None] = {}
+            for rv, _event, data, _old in self._history:
+                if rv <= since or data.get("kind") != kind:
+                    continue
+                meta = data.get("metadata") or {}
+                ns = meta.get("namespace", "")
+                if namespace and ns != namespace:
+                    continue
+                touched[(ns, meta.get("name", ""))] = None
+            items: list[KubeObject] = []
+            deleted: list[tuple[str, str]] = []
+            for ns, name in touched:
+                data = self._store.get(self._key(kind, ns, name))
+                if data is None:
+                    deleted.append((ns, name))
+                    continue
+                labels = (data.get("metadata") or {}).get("labels") or {}
+                if not selector.matches(labels) or not fields.matches(data):
+                    # Left the selector scope: for this consumer the
+                    # object is gone (a never-matching key deletes a
+                    # store entry the consumer never had — a no-op).
+                    deleted.append((ns, name))
+                    continue
+                items.append(wrap(deep_copy_json(data)))
+            return ListDelta(items, deleted, str(last_rv))
 
     def list_page(
         self,

@@ -60,8 +60,16 @@ class Informer:
         field_selector: Optional[str] = None,
         watch_timeout_seconds: int = 300,
         resync_period_s: float = 0.0,
+        stream_source=None,
     ) -> None:
         self._client = client
+        #: Where the WATCH stream comes from: any object with the
+        #: ``Client.watch`` signature. None = the client itself; a
+        #: :class:`~.watchhub.WatchHub` here multiplexes this informer
+        #: onto the hub's shared upstream stream (N co-hosted informers
+        #: of one scope ⇒ 1 upstream watch). Lists (seed + relist) stay
+        #: on the client — the hub only owns watches.
+        self._stream_source = stream_source
         self.kind = kind
         self.namespace = namespace
         self.label_selector = label_selector
@@ -110,6 +118,17 @@ class Informer:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._resource_version: Optional[str] = None
+        #: Last revision this informer is CURRENT through — survives the
+        #: resume bookkeeping resets so a degraded re-list can ask the
+        #: server for deltas-since-rv (``Client.list_delta``) instead of
+        #: a full O(collection) snapshot. Cleared only on 410 (the
+        #: revision fell out of the server journal, so the delta ask
+        #: would fail the same way).
+        self._delta_base_rv: Optional[str] = None
+        #: Relist accounting: (full relists, delta relists) — the bench/
+        #: test hook proving the delta path carried a repair.
+        self.full_relists = 0
+        self.delta_relists = 0
         self._watch_handle = None
 
     # -- lifecycle ---------------------------------------------------------
@@ -473,6 +492,97 @@ class Informer:
                     (raw.get("metadata") or {}).get("resourceVersion", "")
                 )
 
+    def _try_delta_relist(self, stop) -> bool:
+        """Repair the store from a deltas-since-rv LIST when the client
+        and server support it (``list_delta`` + the journal window):
+        O(what changed) instead of O(collection), which is what keeps a
+        degraded re-list (``max_resume_attempts`` exhausted) from
+        costing O(fleet) at fan-out. Returns False to fall back to the
+        full snapshot path — outside the window, unsupported, first
+        seed, or any error."""
+        since = self._delta_base_rv
+        lister = getattr(self._client, "list_delta", None)
+        with self._lock:
+            have_store = bool(self._store)
+        if lister is None or not since or not have_store:
+            return False
+        try:
+            delta = lister(
+                self.kind,
+                since,
+                namespace=self.namespace,
+                label_selector=self.label_selector,
+                field_selector=self.field_selector,
+            )
+        except Exception:  # noqa: BLE001 - delta is an optimization
+            log.debug("delta list failed for %s; full re-list", self.kind,
+                      exc_info=True)
+            return False
+        if delta is None:
+            return False  # outside the journal window: full snapshot
+        if stop.is_set():
+            return True  # superseded: discard; _run exits on stop
+        rvs = [int(since)] if since.isdigit() else []
+        if str(delta.revision or "").isdigit():
+            rvs.append(int(delta.revision))
+        changed: list[tuple[tuple[str, str], dict, Optional[dict]]] = []
+        dropped: list[tuple[tuple[str, str], dict]] = []
+        deleted_keys = list(delta.deleted)
+        if delta.full:
+            # The server answered a FULL list (it predates delta
+            # lists): items is the whole collection, already in hand —
+            # diff it against the store instead of refetching the same
+            # bytes through the plain-list path. Anything we hold that
+            # the list lacks is gone.
+            fresh_keys = {self._key(obj.raw) for obj in delta.items}
+            with self._lock:
+                deleted_keys.extend(
+                    key for key in self._store if key not in fresh_keys
+                )
+        with self._lock:
+            for obj in delta.items:
+                raw = obj.raw
+                key = self._key(raw)
+                old = self._store.get(key)
+                old_rv = str(
+                    ((old or {}).get("metadata") or {}).get(
+                        "resourceVersion", ""
+                    )
+                )
+                new_rv = str(
+                    (raw.get("metadata") or {}).get("resourceVersion", "")
+                )
+                if new_rv.isdigit():
+                    rvs.append(int(new_rv))
+                if (
+                    old is not None
+                    and old_rv.isdigit()
+                    and new_rv.isdigit()
+                    and int(new_rv) <= int(old_rv)
+                ):
+                    continue  # record_write already holds something newer
+                self._store_set(key, raw)
+                changed.append((key, raw, old))
+            for namespace, name in deleted_keys:
+                key = (namespace, name)
+                old = self._store.get(key)
+                if old is not None:
+                    self._store_pop(key)
+                    dropped.append((key, old))
+        for _key, raw, old in changed:
+            self._dispatch("MODIFIED" if old is not None else "ADDED",
+                           raw, old)
+        for _key, old in dropped:
+            self._dispatch("DELETED", old, old)
+        self._resource_version = str(max(rvs)) if rvs else None
+        self._delta_base_rv = self._resource_version
+        if delta.full:
+            self.full_relists += 1  # a whole collection crossed the wire
+        else:
+            self.delta_relists += 1
+        self._synced.set()
+        return True
+
     def _relist(self, stop) -> None:
         """Seed/repair the store from a fresh list, emitting synthetic
         events for every difference a lapsed watch may have missed.
@@ -480,6 +590,8 @@ class Informer:
         in the list call (stop() gave up joining, start() launched a new
         run) must discard its result instead of clobbering the new run's
         store/synced/resume state."""
+        if self._try_delta_relist(stop):
+            return
         list_kwargs = dict(
             namespace=self.namespace,
             label_selector=self.label_selector,
@@ -521,6 +633,8 @@ class Informer:
         # Resume from the newest revision the list showed; watching from
         # an older one would replay events already reflected in the store.
         self._resource_version = str(max(rvs)) if rvs else None
+        self._delta_base_rv = self._resource_version
+        self.full_relists += 1
         self._synced.set()
 
     def _run(self, stop: threading.Event) -> None:
@@ -555,7 +669,8 @@ class Informer:
                 # cannot park us in a full watch timeout.
                 if stop.is_set():
                     return
-                watch_iter = self._client.watch(
+                watch_source = self._stream_source or self._client
+                watch_iter = watch_source.watch(
                     self.kind, handle=self._watch_handle, **watch_kwargs
                 )
                 for event_type, obj in watch_iter:
@@ -573,6 +688,7 @@ class Informer:
                         )
                         if rv.isdigit():
                             self._resource_version = rv
+                            self._delta_base_rv = rv
                         continue
                     key = self._key(raw)
                     rv = str(
@@ -611,6 +727,7 @@ class Informer:
                                 self._store_set(key, raw)
                     if rv.isdigit():
                         self._resource_version = rv
+                        self._delta_base_rv = rv
                     self._dispatch(event_type, raw, old)
                 # Watch window ended (server timeout): resume from the
                 # last seen revision on the next loop iteration.
@@ -621,6 +738,18 @@ class Informer:
                     self.kind, self._resource_version,
                 )
                 self._resource_version = None
+                if self._stream_source is None:
+                    # The revision fell out of the SERVER journal; a
+                    # delta LIST from it would be outside the window
+                    # too — take the full snapshot directly, not after
+                    # a failed ask.
+                    self._delta_base_rv = None
+                # With a hub stream source the 410 usually means only
+                # the HUB's replay window lapsed (slow subscriber); the
+                # server-side journal is typically deeper, so KEEP the
+                # base rv and let the delta LIST repair in O(changed) —
+                # if the server journal lapsed too, list_delta answers
+                # None (its own 410) and the full path runs.
                 self._synced.clear()
             except NotImplementedError:
                 # A client with no watch path must fail fast, not be

@@ -40,6 +40,7 @@ import queue as queue_mod
 import ssl
 import tempfile
 import threading
+import time
 import urllib.parse
 from dataclasses import dataclass, field
 from typing import Any, Mapping, Optional
@@ -51,7 +52,9 @@ from .client import (
     Client,
     ConflictError,
     InvalidError,
+    ListDelta,
     NotFoundError,
+    TooManyRequestsError,
     UnsupportedMediaTypeError,
     WatchExpiredError,
 )
@@ -106,6 +109,16 @@ class RestConfig:
     #: Python codec cost — the right default on real networks with big
     #: lists, not on loopback (see docs/wire-path.md).
     wire_encoding: str = "json"
+    #: How many times a request shed by the server's priority-and-
+    #: fairness layer (429 + Retry-After) is transparently retried after
+    #: sleeping the advertised backoff, before TooManyRequestsError
+    #: surfaces to the caller. The shed flow is by construction the one
+    #: the server wants throttled (telemetry, in the default flow map),
+    #: so honoring the hint IS the client's part of the protocol.
+    too_many_requests_retries: int = 2
+    #: Cap on a single Retry-After sleep (a misconfigured server must
+    #: not park a caller for minutes).
+    retry_after_cap_s: float = 5.0
     #: Paths of temp files backing *-data kubeconfig fields (private key
     #: material) — unlinked by close() and, as a backstop, at process exit.
     _temp_files: list = field(default_factory=list, repr=False)
@@ -284,6 +297,18 @@ def _unlink_quiet(path: str) -> None:
         pass
 
 
+def _retry_after_seconds(headers: Mapping[str, str], cap_s: float) -> float:
+    """The server's Retry-After hint in seconds, clamped to [0, cap]
+    (delta-seconds form only — the HTTP-date form is not worth parsing
+    for an in-process control plane)."""
+    raw = headers.get("retry-after", "")
+    try:
+        value = float(raw)
+    except (TypeError, ValueError):
+        value = 1.0
+    return max(0.0, min(value, cap_s))
+
+
 _ERRORS_BY_REASON = {
     "BadRequest": BadRequestError,
     "NotFound": NotFoundError,
@@ -291,6 +316,7 @@ _ERRORS_BY_REASON = {
     "Conflict": ConflictError,
     "Invalid": InvalidError,
     "Expired": WatchExpiredError,
+    "TooManyRequests": TooManyRequestsError,
     "UnsupportedMediaType": UnsupportedMediaTypeError,
 }
 _ERRORS_BY_CODE = {
@@ -300,6 +326,7 @@ _ERRORS_BY_CODE = {
     410: WatchExpiredError,
     415: UnsupportedMediaTypeError,
     422: InvalidError,
+    429: TooManyRequestsError,
 }
 
 
@@ -854,22 +881,41 @@ class RestClient(Client):
         data: Optional[bytes] = None
         if body is not None:
             data, content_type = self._encode_write_body(body, content_type)
-        try:
-            status, rheaders, payload = self._call(
-                self._transport.request(
-                    method, url, self._headers(data, content_type), data
+        shed_retries = max(0, int(self.config.too_many_requests_retries))
+        for attempt in range(shed_retries + 1):
+            try:
+                status, rheaders, payload = self._call(
+                    self._transport.request(
+                        method, url, self._headers(data, content_type), data
+                    )
                 )
-            )
-        except _TransportError as e:
-            raise ApiError(f"{method} {url}: {e}") from None
-        response_ct = rheaders.get("content-type")
-        if is_compact_content_type(response_ct):
-            self._server_speaks_compact = True
-        if status >= 400:
-            raise self._api_error(status, payload, response_ct)
-        if not payload:
-            return {}
-        return decode_body(payload, response_ct)
+            except _TransportError as e:
+                raise ApiError(f"{method} {url}: {e}") from None
+            response_ct = rheaders.get("content-type")
+            if is_compact_content_type(response_ct):
+                self._server_speaks_compact = True
+            if status == 429:
+                # Shed by the server's priority-and-fairness layer:
+                # honor Retry-After with a bounded transparent retry —
+                # the typed-error retry path the APF contract names
+                # (docs/wire-path.md). Safe for any verb: a shed request
+                # never entered the server's dispatch.
+                retry_after = _retry_after_seconds(
+                    rheaders, self.config.retry_after_cap_s
+                )
+                if attempt < shed_retries:
+                    time.sleep(retry_after)
+                    continue
+                error = self._api_error(status, payload, response_ct)
+                if isinstance(error, TooManyRequestsError):
+                    error.retry_after_s = retry_after
+                raise error
+            if status >= 400:
+                raise self._api_error(status, payload, response_ct)
+            if not payload:
+                return {}
+            return decode_body(payload, response_ct)
+        raise AssertionError("unreachable")  # pragma: no cover
 
     @staticmethod
     def _api_error(
@@ -977,6 +1023,45 @@ class RestClient(Client):
             if not page_size:
                 raise
             return self._list_pages(path, base_query, page_size=0)
+
+    def list_delta(
+        self,
+        kind: str,
+        since_resource_version: str,
+        namespace: str = "",
+        label_selector: Optional[str | Mapping[str, str]] = None,
+        field_selector: Optional[str] = None,
+    ) -> Optional[ListDelta]:
+        """Deltas-since-rv LIST (``sinceResourceVersion`` query; the
+        journal-backed fast re-list, docs/wire-path.md): O(what changed)
+        items + departed keys + the new collection revision. ``None``
+        when a full list is required instead — the presented revision
+        fell out of the server's journal (410). A server that predates
+        delta lists answers a plain full list (no ``metadata.deltaSince``
+        marker); rather than discard the bytes already in hand and make
+        the caller refetch them, that response is returned as a
+        ``full=True`` ListDelta carrying the whole collection."""
+        info = resource_for_kind(kind)
+        query = self._selector_query(label_selector, field_selector)
+        query["sinceResourceVersion"] = str(since_resource_version)
+        path = self._collection_path(info, namespace)
+        try:
+            out = self._request("GET", path, query=query)
+        except WatchExpiredError:
+            return None  # outside the journal window: full list, please
+        meta = out.get("metadata") or {}
+        items = [wrap(item) for item in out.get("items") or []]
+        revision = str(meta.get("resourceVersion", ""))
+        if "deltaSince" not in meta:
+            return ListDelta(items, [], revision, full=True)
+        return ListDelta(
+            items,
+            [
+                (d.get("namespace", ""), d.get("name", ""))
+                for d in out.get("deletedItems") or []
+            ],
+            revision,
+        )
 
     # -- pipelined seed ----------------------------------------------------
     @staticmethod

@@ -14,6 +14,8 @@ import re
 from dataclasses import dataclass, field
 from typing import Mapping
 
+from .jsonpath import dotted_value
+
 
 class SelectorError(ValueError):
     pass
@@ -136,21 +138,67 @@ def parse_selector(selector: str | None) -> LabelSelector:
     return LabelSelector(requirements=reqs)
 
 
-def parse_field_selector(selector: str | None) -> dict[str, str]:
-    """Parse a field selector like ``spec.nodeName=node-1`` into a dict.
+@dataclass(frozen=True)
+class FieldRequirement:
+    """One field-selector term. apimachinery's fields.Selector grammar:
+    ``=``/``==`` (equality) and ``!=`` (inequality) — comparison is on
+    the field's STRING form, with an absent field reading as ``""``
+    (the real apiserver's behavior for e.g. an unscheduled pod's
+    ``spec.nodeName``)."""
 
-    Only equality terms are supported — the single shape the reference uses
-    (reference: pkg/upgrade/consts.go:85-87).
-    """
+    key: str
+    op: str  # "=" or "!="
+    value: str
+
+    def matches(self, data: Mapping) -> bool:
+        actual = dotted_value(data, self.key)
+        actual_s = "" if actual is None else str(actual)
+        if self.op == "=":
+            return actual_s == self.value
+        return actual_s != self.value
+
+
+@dataclass(frozen=True)
+class FieldSelector:
+    """A conjunction of field requirements, evaluated server-side on
+    list/watch scopes (kube/fake.py, the HTTP apiserver's watch
+    streams) and client-side by the cached client — one matcher, so the
+    two can never disagree."""
+
+    requirements: tuple[FieldRequirement, ...] = field(default_factory=tuple)
+
+    @property
+    def empty(self) -> bool:
+        return not self.requirements
+
+    def matches(self, data: Mapping | None) -> bool:
+        data = data or {}
+        return all(r.matches(data) for r in self.requirements)
+
+
+def parse_field_selector(selector: str | None) -> FieldSelector:
+    """Parse a field selector like ``spec.nodeName=node-1`` (comma-joined
+    conjunction; ``=``, ``==`` and ``!=`` terms — the apimachinery
+    fields.Selector grammar subset). Empty/None selects everything."""
     if not selector or not selector.strip():
-        return {}
-    out: dict[str, str] = {}
+        return FieldSelector()
+    reqs: list[FieldRequirement] = []
     for term in selector.split(","):
         term = term.strip()
         if not term:
             continue
-        if "=" not in term or "!=" in term:
+        if "!=" in term:
+            key, _, val = term.partition("!=")
+            op = "!="
+        elif "=" in term:
+            key, _, val = (
+                term.partition("==") if "==" in term else term.partition("=")
+            )
+            op = "="
+        else:
             raise SelectorError(f"unsupported field selector term {term!r}")
-        key, _, val = term.partition("==") if "==" in term else term.partition("=")
-        out[key.strip()] = val.strip()
-    return out
+        key = key.strip()
+        if not key:
+            raise SelectorError(f"empty key in field selector term {term!r}")
+        reqs.append(FieldRequirement(key=key, op=op, value=val.strip()))
+    return FieldSelector(requirements=tuple(reqs))

@@ -4,7 +4,7 @@ from .checkpoint_manager import (
     RESTORE_VERIFY_TIMEOUT_SECONDS,
     CheckpointManager,
 )
-from .metrics import Histogram, MetricsServer, UpgradeMetrics
+from .metrics import Histogram, MetricsServer, UpgradeMetrics, WireMetrics
 from .health_source import HealthMetrics, HealthSource
 from .quarantine_manager import QuarantineManager
 from .task_runner import TaskRunner
@@ -87,6 +87,7 @@ __all__ = [
     "QuarantineManager",
     "TaskRunner",
     "UpgradeMetrics",
+    "WireMetrics",
     "UpgradeKeys",
     "UpgradeState",
     "VALIDATION_TIMEOUT_SECONDS",

@@ -75,9 +75,11 @@ class HealthSource:
         client: Client,
         resync_period_s: float = 0.0,
         node_filter: Optional[Callable[[str], bool]] = None,
+        watch_hub=None,
     ) -> None:
         self._informer = Informer(
-            client, NODE_HEALTH_REPORT_KIND, resync_period_s=resync_period_s
+            client, NODE_HEALTH_REPORT_KIND, resync_period_s=resync_period_s,
+            stream_source=watch_hub,
         )
         #: Shard selector (fleet tier, docs/fleet-control-plane.md):
         #: only reports for nodes the filter accepts enter the map. The

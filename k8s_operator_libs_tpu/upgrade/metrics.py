@@ -331,6 +331,97 @@ class UpgradeMetrics:
         return render_rows(_PREFIX, label, rows)
 
 
+_WIRE_PREFIX = "tpu_operator_wire"
+
+
+class WireMetrics:
+    """The ``tpu_operator_wire_*`` family — the fleet-fan-out wire path's
+    observability (docs/wire-path.md gauge table), served by the existing
+    :class:`MetricsServer` like every other collector:
+
+    * **watch hub** (from ``WatchHub.stats()``): upstream streams,
+      subscribers, frames upstream vs delivered and their ratio (the
+      fan-out multiplier the hub exists to buy), per-subscriber buffer
+      depths (max exported), stale self-resumes, per-scope subscriber
+      gauges;
+    * **APF** (from ``LocalApiServer.apf_stats()``): per-flow queue
+      depth, admitted/shed totals (a shed IS a 429), high-water depth.
+
+    Both halves are optional and duck-typed (any object with the same
+    ``stats()``/``apf_stats()`` shape works), so the collector can sit
+    beside a client-only process (hub, no server) or a server-only one.
+    """
+
+    def __init__(self, hub=None, apiserver=None) -> None:
+        self._hub = hub
+        self._apiserver = apiserver
+
+    def render(self) -> str:
+        out: list[str] = []
+        if self._hub is not None:
+            stats = self._hub.stats()
+            depths = [
+                depth
+                for scope in stats["scopes"].values()
+                for depth in scope["buffer_depths"]
+            ]
+            out.append(render_rows(_WIRE_PREFIX, "", [
+                ("hub_upstream_streams", "gauge",
+                 "Live upstream watch streams the hub multiplexes",
+                 stats["upstream_streams"]),
+                ("hub_subscribers", "gauge",
+                 "Subscribers across all hub scopes",
+                 stats["subscribers"]),
+                ("hub_frames_upstream_total", "counter",
+                 "Watch frames received on upstream streams",
+                 stats["frames_upstream"]),
+                ("hub_frames_delivered_total", "counter",
+                 "Watch frames delivered to subscribers (fan-out)",
+                 stats["frames_delivered"]),
+                ("hub_fanout_ratio", "gauge",
+                 "Frames delivered / frames received upstream",
+                 stats["fanout_ratio"]),
+                ("hub_subscriber_buffer_depth_max", "gauge",
+                 "Deepest per-subscriber buffer right now",
+                 max(depths) if depths else 0),
+                ("hub_stale_resumes_total", "counter",
+                 "Slow-subscriber buffer overflows healed by a journal "
+                 "self-resume (no upstream re-LIST)",
+                 stats["stale_resumes"]),
+            ]))
+            out.append(render_samples(_WIRE_PREFIX, [
+                ("hub_scope_subscribers", "gauge",
+                 "Subscribers per hub scope",
+                 [
+                     (prom_label("scope", scope_name), scope["subscribers"])
+                     for scope_name, scope in sorted(
+                         stats["scopes"].items()
+                     )
+                 ]),
+            ]))
+        if self._apiserver is not None:
+            flows = self._apiserver.apf_stats()
+            labeled = [
+                (prom_label("flow", flow), stats)
+                for flow, stats in sorted(flows.items())
+            ]
+            out.append(render_samples(_WIRE_PREFIX, [
+                ("apf_queue_depth", "gauge",
+                 "Requests queued per priority-and-fairness flow",
+                 [(label, s["queued"]) for label, s in labeled]),
+                ("apf_queue_depth_max", "gauge",
+                 "High-water queue depth per flow",
+                 [(label, s["max_queued"]) for label, s in labeled]),
+                ("apf_admitted_total", "counter",
+                 "Requests dispatched per flow",
+                 [(label, s["admitted_total"]) for label, s in labeled]),
+                ("apf_shed_429_total", "counter",
+                 "Requests shed as 429 + Retry-After per flow",
+                 [(label, s["shed_429_total"]) for label, s in labeled]),
+            ]))
+        return "".join(out)
+
+
 class MetricsServer(ThreadingHTTPServer):
     """``GET /metrics`` over stdlib HTTP; use as a context manager.
 

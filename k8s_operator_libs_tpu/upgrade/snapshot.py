@@ -207,13 +207,20 @@ class InformerSnapshotSource:
         namespace: str,
         driver_labels: Mapping[str, str],
         resync_period_s: float = DEFAULT_RESYNC_PERIOD_S,
+        watch_hub=None,
     ) -> None:
         self._client = client
         self.namespace = namespace
         self.driver_labels = dict(driver_labels)
+        #: Optional :class:`~..kube.watchhub.WatchHub`: every informer's
+        #: WATCH rides the hub's shared upstream stream instead of this
+        #: client, so N co-hosted sources cost 1 upstream stream per
+        #: kind, not N (docs/wire-path.md). Lists stay on the client.
+        self.watch_hub = watch_hub
         self._informers: dict[str, Informer] = {
             "Node": Informer(
-                client, "Node", resync_period_s=resync_period_s
+                client, "Node", resync_period_s=resync_period_s,
+                stream_source=watch_hub,
             ),
             "Pod": Informer(
                 client,
@@ -221,6 +228,7 @@ class InformerSnapshotSource:
                 namespace=namespace,
                 label_selector=self.driver_labels,
                 resync_period_s=resync_period_s,
+                stream_source=watch_hub,
             ),
             "DaemonSet": Informer(
                 client,
@@ -228,6 +236,7 @@ class InformerSnapshotSource:
                 namespace=namespace,
                 label_selector=self.driver_labels,
                 resync_period_s=resync_period_s,
+                stream_source=watch_hub,
             ),
             # The DS rollout hash is read every pass (revision sync); an
             # uncached path here would put one LIST per pass back on the
@@ -240,6 +249,7 @@ class InformerSnapshotSource:
                 "ControllerRevision",
                 namespace=namespace,
                 resync_period_s=resync_period_s,
+                stream_source=watch_hub,
             ),
         }
         self._started = False
@@ -446,9 +456,11 @@ class IncrementalSnapshotSource(InformerSnapshotSource):
         driver_labels: Mapping[str, str],
         resync_period_s: float = DEFAULT_RESYNC_PERIOD_S,
         verify_every_n: int = 0,
+        watch_hub=None,
     ) -> None:
         super().__init__(
-            client, namespace, driver_labels, resync_period_s=resync_period_s
+            client, namespace, driver_labels,
+            resync_period_s=resync_period_s, watch_hub=watch_hub,
         )
         #: Every n-th build cross-checks incremental state against a full
         #: rebuild (0 = off). The audit pass repairs and counts drift.

@@ -231,6 +231,7 @@ class ClusterUpgradeStateManager:
         sync_timeout: float = 30.0,
         incremental: bool = False,
         verify_every_n: int = 0,
+        watch_hub=None,
     ) -> InformerSnapshotSource:
         """Switch ``build_state`` onto informer-backed stores (list-once +
         watch + resync) and wire the provider's write-through so each pass
@@ -246,6 +247,11 @@ class ClusterUpgradeStateManager:
         kwargs = {}
         if resync_period_s is not None:
             kwargs["resync_period_s"] = resync_period_s
+        if watch_hub is not None:
+            # The informers' watches ride the shared hub (one upstream
+            # stream per kind across every co-hosted source); their
+            # lists stay on this manager's client.
+            kwargs["watch_hub"] = watch_hub
         if incremental:
             source: InformerSnapshotSource = IncrementalSnapshotSource(
                 self.client,

@@ -65,7 +65,9 @@ def build_cluster(node_count=6):
     return cluster, sim
 
 
-def incremental_manager(cluster, verify_every_n=0, width=None, runner=None):
+def incremental_manager(
+    cluster, verify_every_n=0, width=None, runner=None, watch_hub=None
+):
     options = StateOptions(apply_width=width) if width else None
     mgr = ClusterUpgradeStateManager(
         cluster, DEVICE,
@@ -75,6 +77,7 @@ def incremental_manager(cluster, verify_every_n=0, width=None, runner=None):
     source = mgr.with_snapshot_from_informers(
         NS, LABELS, resync_period_s=0.0,
         incremental=True, verify_every_n=verify_every_n,
+        watch_hub=watch_hub,
     )
     return mgr, source
 
@@ -192,9 +195,23 @@ class TestEquivalenceFuzzer:
 
     @pytest.mark.parametrize("seed", [7, 1234])
     def test_incremental_matches_full_rebuild(self, seed):
+        self._fuzz(seed)
+
+    @pytest.mark.parametrize("seed", [11, 2026])
+    def test_incremental_matches_full_rebuild_hub_fed(self, seed):
+        """The same equivalence fuzz with the informers' watches riding
+        a shared WatchHub (the fleet fan-out shape, docs/wire-path.md):
+        one multiplexed upstream per kind must be delta-for-delta
+        indistinguishable from direct watches."""
+        from k8s_operator_libs_tpu.kube import WatchHub
+
+        self._fuzz(seed, hub_factory=WatchHub)
+
+    def _fuzz(self, seed, hub_factory=None):
         rng = random.Random(seed)
         cluster, sim = build_cluster(node_count=6)
-        mgr_inc, source = incremental_manager(cluster)
+        hub = hub_factory(cluster) if hub_factory is not None else None
+        mgr_inc, source = incremental_manager(cluster, watch_hub=hub)
         mgr_full = full_manager(cluster)
         extra_nodes: list[str] = []
         rollouts = 0
@@ -285,6 +302,8 @@ class TestEquivalenceFuzzer:
                 )
         finally:
             source.stop()
+            if hub is not None:
+                hub.stop()
 
     def test_resync_sweep_does_not_dirty_settled_pool(self):
         """The ISSUE 5 resync-storm pin: a resync tick over a settled

@@ -73,14 +73,39 @@ class TestParseAndMatch:
 
 class TestFieldSelector:
     def test_node_name(self):
-        assert parse_field_selector("spec.nodeName=node-1") == {
-            "spec.nodeName": "node-1"
-        }
+        sel = parse_field_selector("spec.nodeName=node-1")
+        assert sel.matches({"spec": {"nodeName": "node-1"}})
+        assert not sel.matches({"spec": {"nodeName": "node-2"}})
+        # Absent field reads as "" (real-apiserver comparison form).
+        assert not sel.matches({"spec": {}})
+        assert not sel.matches({})
 
     def test_empty(self):
-        assert parse_field_selector(None) == {}
-        assert parse_field_selector("") == {}
+        assert parse_field_selector(None).empty
+        assert parse_field_selector("").empty
+        assert parse_field_selector("").matches({"anything": "goes"})
+
+    def test_not_equals(self):
+        # apimachinery fields.Selector supports != too; an absent field
+        # compares as "" and so MATCHES a != term.
+        sel = parse_field_selector("spec.nodeName!=node-1")
+        assert not sel.matches({"spec": {"nodeName": "node-1"}})
+        assert sel.matches({"spec": {"nodeName": "node-2"}})
+        assert sel.matches({})
+
+    def test_conjunction(self):
+        sel = parse_field_selector(
+            "spec.nodeName=node-1,metadata.name!=skip"
+        )
+        assert sel.matches(
+            {"spec": {"nodeName": "node-1"}, "metadata": {"name": "keep"}}
+        )
+        assert not sel.matches(
+            {"spec": {"nodeName": "node-1"}, "metadata": {"name": "skip"}}
+        )
 
     def test_unsupported(self):
         with pytest.raises(SelectorError):
-            parse_field_selector("metadata.name!=x")
+            parse_field_selector("metadata.name")
+        with pytest.raises(SelectorError):
+            parse_field_selector("!=x")

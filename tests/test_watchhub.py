@@ -1,0 +1,844 @@
+"""Watch hub + API priority-and-fairness + delta-aware LIST suite.
+
+The fleet-fan-out wire path (docs/wire-path.md "Watch hub" / "Priority
+and fairness"): one upstream watch stream per (kind, scope) multiplexed
+to N subscribers with per-subscriber cursors and bounded buffers; the
+LocalApiServer's per-flow FIFO queues shedding telemetry storms as 429 +
+Retry-After while lease/reconcile traffic keeps flowing; and the
+journal-backed deltas-since-rv LIST that keeps a degraded re-list from
+costing O(fleet).
+"""
+
+import threading
+import time
+
+import pytest
+
+from k8s_operator_libs_tpu.kube import (
+    ConflictError,
+    FakeCluster,
+    Informer,
+    LocalApiServer,
+    RestClient,
+    RestConfig,
+    TooManyRequestsError,
+    WatchExpiredError,
+    WatchHub,
+    wrap,
+)
+from k8s_operator_libs_tpu.kube.apiserver import ApfConfig, FlowConfig, classify_flow
+from k8s_operator_libs_tpu.kube.rest import WatchHandle
+from k8s_operator_libs_tpu.upgrade.metrics import WireMetrics
+from builders import make_node
+from test_informer import wait_until
+
+
+@pytest.fixture()
+def server():
+    with LocalApiServer() as srv:
+        yield srv
+
+
+@pytest.fixture()
+def client(server):
+    c = RestClient(RestConfig(server=server.url))
+    yield c
+    c.close()
+
+
+def node_raw(name, labels=None):
+    raw = {"kind": "Node", "apiVersion": "v1", "metadata": {"name": name}}
+    if labels:
+        raw["metadata"]["labels"] = dict(labels)
+    return raw
+
+
+def watch_requests(log, plural="nodes"):
+    return [
+        entry for entry in log
+        if entry[0] == "GET" and plural in entry[1]
+        and entry[2].get("watch") in ("true", "1")
+    ]
+
+
+def full_list_requests(log, plural="nodes"):
+    return [
+        entry for entry in log
+        if entry[0] == "GET" and entry[1].endswith(f"/{plural}")
+        and entry[2].get("watch") is None
+        and "sinceResourceVersion" not in entry[2]
+    ]
+
+
+class TestHubMultiplexing:
+    def test_two_informers_one_upstream_stream(self, server, client):
+        """N hub-fed informers of one scope open exactly ONE upstream
+        watch — the whole point of the hub."""
+        for i in range(4):
+            server.cluster.create(wrap(node_raw(f"n{i}")))
+        log = server.start_request_log()
+        with WatchHub(client) as hub:
+            informers = [
+                Informer(client, "Node", stream_source=hub).start()
+                for _ in range(3)
+            ]
+            try:
+                for inf in informers:
+                    assert inf.wait_for_sync(10)
+                server.cluster.create(wrap(node_raw("n4")))
+                assert wait_until(
+                    lambda: all(inf.get("n4") for inf in informers)
+                )
+                assert len(watch_requests(log)) == 1
+                stats = hub.stats()
+                assert stats["upstream_streams"] == 1
+                assert stats["subscribers"] == 3
+                # Fan-out ratio: every upstream frame delivered 3x.
+                assert stats["frames_delivered"] >= 3 * stats[
+                    "frames_upstream"
+                ] - 3  # joins replay independently; allow edge slack
+            finally:
+                for inf in informers:
+                    inf.stop()
+
+    def test_distinct_scopes_get_distinct_upstreams(self, server, client):
+        with WatchHub(client) as hub:
+            a = Informer(client, "Node", stream_source=hub).start()
+            b = Informer(
+                client, "Node", label_selector="tier=x", stream_source=hub
+            ).start()
+            try:
+                assert a.wait_for_sync(10) and b.wait_for_sync(10)
+                assert wait_until(
+                    lambda: hub.stats()["upstream_streams"] == 2
+                )
+            finally:
+                a.stop()
+                b.stop()
+
+    def test_join_mid_stream_seeds_from_cursor(self, server, client):
+        """A subscriber joining with a cursor replays exactly the frames
+        after it from the hub journal — no gap, no duplicates."""
+        server.cluster.create(wrap(node_raw("seed")))
+        with WatchHub(client) as hub:
+            first = Informer(client, "Node", stream_source=hub).start()
+            try:
+                assert first.wait_for_sync(10)
+                # Events land while only the first subscriber is attached.
+                _, rv_before = client.list_with_revision("Node")
+                server.cluster.create(wrap(node_raw("mid-1")))
+                server.cluster.create(wrap(node_raw("mid-2")))
+                assert wait_until(lambda: first.get("mid-2") is not None)
+                # Direct hub subscription with the pre-event cursor: the
+                # journal must replay both events.
+                handle = WatchHandle()
+                seen = []
+                for event_type, obj in hub.watch(
+                    "Node", resource_version=rv_before,
+                    timeout_seconds=2, handle=handle,
+                ):
+                    seen.append((event_type, obj.name))
+                    if len(seen) == 2:
+                        handle.cancel()
+                assert seen == [("ADDED", "mid-1"), ("ADDED", "mid-2")]
+            finally:
+                first.stop()
+
+    def test_cursor_behind_replay_window_expires(self, server, client):
+        for i in range(8):
+            server.cluster.create(wrap(node_raw(f"w{i}")))
+        with WatchHub(client, journal_window=2) as hub:
+            inf = Informer(client, "Node", stream_source=hub).start()
+            try:
+                assert inf.wait_for_sync(10)
+                for i in range(8, 14):
+                    server.cluster.create(wrap(node_raw(f"w{i}")))
+                assert wait_until(lambda: inf.get("w13") is not None)
+                with pytest.raises(WatchExpiredError):
+                    # Ancient cursor: the 2-entry journal cannot vouch.
+                    for _ in hub.watch(
+                        "Node", resource_version="1", timeout_seconds=1
+                    ):
+                        pass
+            finally:
+                inf.stop()
+
+    def test_upstream_dead_connection_resume_is_shared(self, server, client):
+        """kill_connections() drill: ONE upstream resume heals every
+        subscriber — no subscriber sees a gap, and nobody re-LISTs."""
+        server.cluster.create(wrap(node_raw("r0")))
+        with WatchHub(client) as hub:
+            informers = [
+                Informer(client, "Node", stream_source=hub).start()
+                for _ in range(2)
+            ]
+            try:
+                for inf in informers:
+                    assert inf.wait_for_sync(10)
+                # The upstream stream must be LIVE before the drill —
+                # killing earlier would only hit idle list connections.
+                assert wait_until(lambda: server.watch_streams >= 1)
+                log = server.start_request_log()
+                server.kill_connections()
+                server.cluster.create(wrap(node_raw("r1")))
+                assert wait_until(
+                    lambda: all(inf.get("r1") for inf in informers)
+                )
+                # The resume was upstream-shared: one new watch request,
+                # zero LISTs (the informers never even noticed).
+                assert len(watch_requests(log)) == 1
+                assert len(full_list_requests(log)) == 0
+                assert hub.stats()["scopes"]["Node"][
+                    "upstream_resumes"
+                ] >= 1
+            finally:
+                for inf in informers:
+                    inf.stop()
+
+    def test_slow_subscriber_goes_stale_and_self_resumes(
+        self, server, client
+    ):
+        """A subscriber whose buffer overflows loses its BUFFER, not the
+        stream: it self-resumes from its own cursor over the hub journal
+        — no upstream re-LIST, no effect on the fast subscriber."""
+        server.cluster.create(wrap(node_raw("s0")))
+        with WatchHub(client, buffer_limit=4) as hub:
+            fast = Informer(client, "Node", stream_source=hub).start()
+            try:
+                assert fast.wait_for_sync(10)
+                _, rv = client.list_with_revision("Node")
+                # A raw hub subscription that does NOT consume while a
+                # burst lands: its 4-slot buffer must overflow.
+                handle = WatchHandle()
+                stream = hub.watch(
+                    "Node", resource_version=rv,
+                    timeout_seconds=30, handle=handle,
+                )
+                # Prime the generator so the subscriber is registered.
+                server.cluster.create(wrap(node_raw("burst-0")))
+                first = next(stream)
+                assert first[1].name == "burst-0"
+                log = server.start_request_log()
+                for i in range(1, 12):
+                    server.cluster.create(wrap(node_raw(f"burst-{i}")))
+                assert wait_until(
+                    lambda: fast.get("burst-11") is not None
+                )
+                # Now drain: the stale subscriber must still see EVERY
+                # burst event (journal replay), in order.
+                names = []
+                for _event, obj in stream:
+                    names.append(obj.name)
+                    if obj.name == "burst-11":
+                        handle.cancel()
+                assert names == [f"burst-{i}" for i in range(1, 12)]
+                stats = hub.stats()
+                assert stats["stale_resumes"] >= 1
+                # The self-resume generated zero upstream traffic.
+                assert len(watch_requests(log)) == 0
+                assert len(full_list_requests(log)) == 0
+            finally:
+                fast.stop()
+
+    def test_live_only_upstream_rewinds_for_cursor_joiner(
+        self, server, client
+    ):
+        """A cursor-bearing subscriber joining a LIVE-ONLY upstream
+        (first subscriber had no cursor) rewinds the stream to its
+        cursor: the gap replays from the server journal, and frames
+        still in flight from the cancelled window cannot clobber the
+        rewound resume point (the stream-epoch guard)."""
+        server.cluster.create(wrap(node_raw("base")))
+        _, rv0 = client.list_with_revision("Node")
+        server.cluster.create(wrap(node_raw("gap-1")))
+        server.cluster.create(wrap(node_raw("gap-2")))
+        with WatchHub(client) as hub:
+            live_handle = WatchHandle()
+            live_seen: list = []
+
+            def consume_live():
+                for event_type, obj in hub.watch(
+                    "Node", timeout_seconds=20, handle=live_handle
+                ):
+                    live_seen.append(obj.name)
+
+            live = threading.Thread(target=consume_live, daemon=True)
+            live.start()
+            assert wait_until(lambda: server.watch_streams >= 1)
+            # Joiner presents the pre-gap cursor against the live-only
+            # upstream: the hub must restart from rv0 and deliver the
+            # gap.
+            handle = WatchHandle()
+            seen = []
+            for _event, obj in hub.watch(
+                "Node", resource_version=rv0,
+                timeout_seconds=10, handle=handle,
+            ):
+                seen.append(obj.name)
+                if obj.name == "gap-2":
+                    handle.cancel()
+            assert seen == ["gap-1", "gap-2"]
+            live_handle.cancel()
+            live.join(timeout=10)
+
+    def test_hub_works_over_fake_cluster_in_process(self):
+        """The hub multiplexes any Client.watch — including the
+        in-process FakeCluster (no HTTP involved)."""
+        cluster = FakeCluster()
+        cluster.create(make_node("a"))
+        with WatchHub(cluster) as hub:
+            informers = [
+                Informer(cluster, "Node", stream_source=hub).start()
+                for _ in range(2)
+            ]
+            try:
+                for inf in informers:
+                    assert inf.wait_for_sync(10)
+                cluster.create(make_node("b"))
+                assert wait_until(
+                    lambda: all(inf.get("b") for inf in informers)
+                )
+                assert hub.stats()["upstream_streams"] == 1
+            finally:
+                for inf in informers:
+                    inf.stop()
+
+    def test_last_unsubscriber_retires_the_upstream(self, server, client):
+        # linger 0: retirement is immediate (the default linger keeps
+        # the upstream warm across subscriber window ends — next test).
+        with WatchHub(client, idle_linger_s=0) as hub:
+            inf = Informer(client, "Node", stream_source=hub).start()
+            assert inf.wait_for_sync(10)
+            assert wait_until(lambda: hub.stats()["upstream_streams"] == 1)
+            inf.stop()
+            assert wait_until(lambda: hub.stats()["upstream_streams"] == 0)
+
+    def test_subscriber_window_end_reuses_upstream_and_journal(
+        self, server, client
+    ):
+        """A subscriber whose WINDOW ends (the informer re-subscribing
+        on its watch_timeout cadence) must find the SAME upstream
+        stream and journal — no teardown, no new upstream watch
+        request, no journal loss across the momentary zero."""
+        server.cluster.create(wrap(node_raw("w0")))
+        with WatchHub(client) as hub:
+            inf = Informer(
+                client, "Node", watch_timeout_seconds=1, stream_source=hub
+            ).start()
+            try:
+                assert inf.wait_for_sync(10)
+                assert wait_until(lambda: server.watch_streams >= 1)
+                log = server.start_request_log()
+                time.sleep(2.5)  # several subscriber windows roll over
+                server.cluster.create(wrap(node_raw("w1")))
+                assert wait_until(lambda: inf.get("w1") is not None)
+                # The hub's 300s upstream window outlives every 1s
+                # subscriber window: zero new upstream watch requests.
+                assert len(watch_requests(log)) == 0
+                scope = hub.stats()["scopes"]["Node"]
+                assert scope["upstream_watches_opened"] == 1
+            finally:
+                inf.stop()
+
+
+class TestApf:
+    def test_flow_classification(self):
+        assert classify_flow(
+            "PUT", "/apis/coordination.k8s.io/v1/namespaces/kube-system"
+            "/leases/fleet-shard-00"
+        ) == "lease"
+        assert classify_flow(
+            "GET", "/apis/coordination.k8s.io/v1/namespaces/kube-system"
+            "/leases/fleet-shard-00"
+        ) == "lease"
+        assert classify_flow(
+            "PUT", "/apis/tpu.example.com/v1alpha1/nodehealthreports/n1"
+            "/status"
+        ) == "telemetry"
+        assert classify_flow("GET", "/api/v1/nodes") == "informer"
+        assert classify_flow("PATCH", "/api/v1/nodes/n1") == "reconcile"
+        # Classification keys on the parsed RESOURCE segment: a pod
+        # named after the lease plural, or a namespace literally called
+        # "leases", must not ride the lease flow.
+        assert classify_flow(
+            "PATCH", "/api/v1/namespaces/d/pods/leases-cache-0"
+        ) == "reconcile"
+        assert classify_flow(
+            "GET", "/api/v1/namespaces/leases/pods"
+        ) == "informer"
+
+    def test_partial_flows_dict_merges_over_defaults(self):
+        """The natural production spelling — tuning ONE flow — must not
+        un-configure the others (a KeyError here answered 500 for every
+        lease renewal)."""
+        apf = ApfConfig(flows={"telemetry": FlowConfig(queue_depth=8)})
+        assert apf.flows["telemetry"].queue_depth == 8
+        assert set(apf.flows) >= {"lease", "reconcile", "informer"}
+        with LocalApiServer(apf=apf) as srv:
+            c = RestClient(RestConfig(server=srv.url))
+            try:
+                c.create(wrap({
+                    "kind": "Lease",
+                    "apiVersion": "coordination.k8s.io/v1",
+                    "metadata": {"name": "l1", "namespace": "default"},
+                    "spec": {"holderIdentity": "w0"},
+                }))
+                assert srv.apf_stats()["lease"]["admitted_total"] >= 1
+            finally:
+                c.close()
+
+    def test_shed_surfaces_as_429_with_retry_after_honored(self):
+        """queue_depth=0 sheds every telemetry write: the client honors
+        Retry-After with bounded retries, then surfaces the typed
+        error; lease and reconcile flows on the SAME server keep
+        working untouched."""
+        apf = ApfConfig(retry_after_s=0.05)
+        apf.flows["telemetry"] = FlowConfig(queue_depth=0)
+        with LocalApiServer(apf=apf) as srv:
+            cfg = RestConfig(server=srv.url)
+            cfg.too_many_requests_retries = 2
+            c = RestClient(cfg)
+            try:
+                srv.cluster.create(wrap(node_raw("n1")))
+                report = wrap({
+                    "kind": "NodeHealthReport",
+                    "apiVersion": "telemetry.tpu-operator.dev/v1alpha1",
+                    "metadata": {"name": "n1"},
+                    "spec": {"nodeName": "n1"},
+                })
+                started = time.monotonic()
+                with pytest.raises(TooManyRequestsError) as exc_info:
+                    c.create(report)
+                elapsed = time.monotonic() - started
+                # Two transparent Retry-After sleeps happened first.
+                assert elapsed >= 0.08
+                assert exc_info.value.retry_after_s == pytest.approx(0.05)
+                assert srv.apf_stats()["telemetry"]["shed_429_total"] == 3
+                # Other flows are untouched by the telemetry shed.
+                c.patch("Node", "n1", patch={"metadata": {
+                    "labels": {"x": "1"}}})
+                lease = wrap({
+                    "kind": "Lease",
+                    "apiVersion": "coordination.k8s.io/v1",
+                    "metadata": {"name": "l1", "namespace": "default"},
+                    "spec": {"holderIdentity": "w0"},
+                })
+                c.create(lease)
+                stats = srv.apf_stats()
+                assert stats["reconcile"]["shed_429_total"] == 0
+                assert stats["lease"]["shed_429_total"] == 0
+                assert stats["lease"]["admitted_total"] >= 1
+            finally:
+                c.close()
+
+    def test_retry_after_transparent_recovery(self):
+        """A 429 whose retry lands after the queue drained succeeds
+        without the caller ever seeing an error."""
+        apf = ApfConfig(retry_after_s=0.05)
+        apf.flows["telemetry"] = FlowConfig(queue_depth=0)
+        with LocalApiServer(apf=apf) as srv:
+            cfg = RestConfig(server=srv.url)
+            cfg.too_many_requests_retries = 3
+            c = RestClient(cfg)
+            try:
+                report = wrap({
+                    "kind": "NodeHealthReport",
+                    "apiVersion": "telemetry.tpu-operator.dev/v1alpha1",
+                    "metadata": {"name": "n9"},
+                    "spec": {"nodeName": "n9"},
+                })
+
+                def relax():
+                    time.sleep(0.07)
+                    srv.apf.flows["telemetry"] = FlowConfig(queue_depth=64)
+
+                relaxer = threading.Thread(target=relax)
+                relaxer.start()
+                try:
+                    created = c.create(report)  # retried past the shed
+                finally:
+                    relaxer.join()
+                assert created.name == "n9"
+                assert srv.apf_stats()["telemetry"]["admitted_total"] >= 1
+            finally:
+                c.close()
+
+    def test_conflict_retry_interaction(self):
+        """429 and 409 stay DISTINCT typed errors: a conflicting write
+        through a healthy flow surfaces ConflictError (never retried as
+        a shed), and retry_on_conflict does not absorb a 429."""
+        with LocalApiServer() as srv:
+            c = RestClient(RestConfig(server=srv.url))
+            try:
+                srv.cluster.create(wrap(node_raw("n1")))
+                stale = c.get("Node", "n1")
+                c.patch("Node", "n1", patch={"metadata": {
+                    "labels": {"bump": "1"}}})
+                stale.raw["metadata"]["labels"] = {"stale": "1"}
+                with pytest.raises(ConflictError):
+                    c.update(stale)
+            finally:
+                c.close()
+
+    def test_apf_disabled_is_raw_dispatch(self):
+        with LocalApiServer(apf=ApfConfig(enabled=False)) as srv:
+            c = RestClient(RestConfig(server=srv.url))
+            try:
+                srv.cluster.create(wrap(node_raw("n1")))
+                assert c.get("Node", "n1").name == "n1"
+                assert srv.apf_stats() == {}
+            finally:
+                c.close()
+
+    def test_telemetry_flood_never_starves_lease_renewals(self):
+        """The starvation drill: writer threads flood NodeHealthReport
+        status writes against a tight telemetry queue while a lease
+        renews on a deadline; every renewal must land in time. The
+        flood itself must actually shed (otherwise the drill proved
+        nothing)."""
+        apf = ApfConfig(retry_after_s=0.02)
+        apf.flows["telemetry"] = FlowConfig(queue_depth=1)
+        with LocalApiServer(apf=apf) as srv:
+            srv.cluster.create(wrap({
+                "kind": "Lease",
+                "apiVersion": "coordination.k8s.io/v1",
+                "metadata": {"name": "renew-me", "namespace": "default"},
+                "spec": {"holderIdentity": "w0"},
+            }))
+            stop = threading.Event()
+            writer_errors: list = []
+
+            def flood(i):
+                cfg = RestConfig(server=srv.url)
+                cfg.too_many_requests_retries = 0
+                wc = RestClient(cfg)
+                try:
+                    while not stop.is_set():
+                        report = wrap({
+                            "kind": "NodeHealthReport",
+                            "apiVersion":
+                                "telemetry.tpu-operator.dev/v1alpha1",
+                            "metadata": {"name": f"flood-{i}"},
+                            "spec": {"nodeName": f"flood-{i}"},
+                        })
+                        try:
+                            wc.apply(report, field_manager=f"w{i}")
+                        except TooManyRequestsError:
+                            pass  # shed: exactly the design
+                        except Exception as e:  # noqa: BLE001
+                            writer_errors.append(repr(e))
+                            return
+                finally:
+                    wc.close()
+
+            writers = [
+                threading.Thread(target=flood, args=(i,), daemon=True)
+                for i in range(8)
+            ]
+            for w in writers:
+                w.start()
+            lease_client = RestClient(RestConfig(server=srv.url))
+            renew_gaps = []
+            try:
+                last = time.monotonic()
+                deadline = time.monotonic() + 2.0
+                while time.monotonic() < deadline:
+                    obj = lease_client.get("Lease", "renew-me", "default")
+                    obj.raw["spec"]["renewTime"] = time.time()
+                    lease_client.update(obj)
+                    now = time.monotonic()
+                    renew_gaps.append(now - last)
+                    last = now
+                    time.sleep(0.05)
+            finally:
+                stop.set()
+                for w in writers:
+                    w.join(timeout=5)
+                lease_client.close()
+            assert not writer_errors, writer_errors
+            assert len(renew_gaps) >= 10
+            # Every renewal round-trip stayed far inside a 2s lease
+            # deadline even under the flood.
+            assert max(renew_gaps) < 1.0, renew_gaps
+            stats = srv.apf_stats()
+            assert stats["telemetry"]["shed_429_total"] > 0, (
+                "flood never saturated; the drill is vacuous"
+            )
+            assert stats["lease"]["shed_429_total"] == 0
+            # The wire metrics family renders all of it.
+            rendered = WireMetrics(apiserver=srv).render()
+            assert 'tpu_operator_wire_apf_shed_429_total{flow="telemetry"}' \
+                in rendered
+
+
+class TestDeltaList:
+    def test_fake_delta_semantics(self):
+        cluster = FakeCluster()
+        cluster.create(make_node("a", labels={"keep": "1"}))
+        cluster.create(make_node("b", labels={"keep": "1"}))
+        _, rv = cluster.list_with_revision("Node")
+        cluster.create(make_node("c", labels={"keep": "1"}))
+        cluster.patch("Node", "a", patch={"metadata": {
+            "labels": {"keep": "0"}}})
+        cluster.delete("Node", "b")
+        delta = cluster.list_delta("Node", rv, label_selector="keep=1")
+        assert [o.name for o in delta.items] == ["c"]
+        # b left the collection; a left the selector scope.
+        assert sorted(delta.deleted) == [("", "a"), ("", "b")]
+        assert int(delta.revision) >= int(rv)
+
+    def test_fake_outside_journal_window_returns_none(self):
+        cluster = FakeCluster()
+        cluster._history = type(cluster._history)(maxlen=4)
+        for i in range(8):
+            cluster.create(make_node(f"n{i}"))
+        assert cluster.list_delta("Node", "1") is None
+
+    def test_http_delta_and_410_fallback(self, server, client):
+        server.cluster.create(wrap(node_raw("x0")))
+        _, rv = client.list_with_revision("Node")
+        server.cluster.create(wrap(node_raw("x1")))
+        delta = client.list_delta("Node", rv)
+        assert delta is not None
+        assert [o.name for o in delta.items] == ["x1"]
+        assert delta.deleted == []
+        # Outside the window: the server answers 410 and the client
+        # reports "full list required" as None.
+        server.cluster._history.clear()
+        server.cluster.create(wrap(node_raw("x2")))
+        server.cluster._history.clear()
+        assert client.list_delta("Node", rv) is None
+
+    def test_informer_delta_relist_matches_full(self, server, client):
+        """Parity pin: a delta re-list repairs the store to exactly the
+        state a full re-list produces — including deletes and selector
+        departures — and dispatches the same effective deltas."""
+        for i in range(4):
+            server.cluster.create(
+                wrap(node_raw(f"p{i}", labels={"keep": "1"}))
+            )
+        delta_inf = Informer(client, "Node", label_selector="keep=1")
+        full_inf = Informer(client, "Node", label_selector="keep=1")
+        delta_inf.start()
+        full_inf.start()
+        try:
+            assert delta_inf.wait_for_sync(10)
+            assert full_inf.wait_for_sync(10)
+            baseline_full = full_inf.full_relists
+            # Mutate while watches are live so both stores track; then
+            # force a re-list on both paths and compare.
+            server.cluster.create(
+                wrap(node_raw("p4", labels={"keep": "1"}))
+            )
+            server.cluster.delete("Node", "p0")
+            server.cluster.patch("Node", "p1", patch={"metadata": {
+                "labels": {"keep": "0"}}})
+            assert wait_until(
+                lambda: delta_inf.get("p4") is not None
+                and full_inf.get("p4") is not None
+                and delta_inf.get("p1") is None
+            )
+            # More changes the (about-to-die) watches may not deliver:
+            # stop both informers first so the re-list does the repair.
+            delta_inf.stop()
+            full_inf.stop()
+            server.cluster.create(
+                wrap(node_raw("p5", labels={"keep": "1"}))
+            )
+            server.cluster.delete("Node", "p2")
+            log = server.start_request_log()
+            stop = threading.Event()
+            delta_inf._synced.clear()
+            delta_inf._relist(stop)
+            full_inf._delta_base_rv = None  # force the full path
+            full_inf._synced.clear()
+            full_inf._relist(stop)
+            assert delta_inf.delta_relists == 1
+            assert full_inf.full_relists == baseline_full + 1
+            delta_names = sorted(o.name for o in delta_inf.list())
+            full_names = sorted(o.name for o in full_inf.list())
+            assert delta_names == full_names == ["p3", "p4", "p5"]
+            # The delta ask carried the cursor; the full one did not.
+            delta_lists = [
+                e for e in log if "sinceResourceVersion" in e[2]
+            ]
+            assert len(delta_lists) == 1
+        finally:
+            delta_inf.stop()
+            full_inf.stop()
+
+    def test_hub_expiry_keeps_the_delta_cursor(self, server, client):
+        """A 410 surfaced by the hub (its replay window lapsed, the
+        SERVER journal usually has not) must not discard the informer's
+        delta cursor: the repair re-list goes down the O(changed) delta
+        path, not the full snapshot."""
+
+        class ExpiringSource:
+            """Stream source whose first watch expires (the hub-window-
+            lapsed shape); later watches pass through."""
+
+            def __init__(self, inner):
+                self._inner = inner
+                self.calls = 0
+
+            def watch(self, *args, **kwargs):
+                self.calls += 1
+                if self.calls == 1:
+                    raise WatchExpiredError("hub replay window lapsed")
+                return self._inner.watch(*args, **kwargs)
+
+        server.cluster.create(wrap(node_raw("k0")))
+        inf = Informer(client, "Node",
+                       stream_source=ExpiringSource(client)).start()
+        try:
+            assert wait_until(
+                lambda: inf.delta_relists + inf.full_relists >= 2
+            )
+            # Seed list was full; the expiry repair was a DELTA list.
+            assert inf.full_relists == 1
+            assert inf.delta_relists == 1
+            assert inf.get("k0") is not None
+        finally:
+            inf.stop()
+
+    def test_old_server_full_list_is_salvaged_not_refetched(self, server):
+        """Against a server that predates delta lists, list_delta's
+        full-list answer is APPLIED (diffed against the store), not
+        discarded and refetched."""
+        from k8s_operator_libs_tpu.kube import ListDelta
+
+        cluster = FakeCluster()
+
+        class OldServer:
+            """Client whose list_delta answers the full collection
+            (what RestClient returns when metadata.deltaSince is
+            missing)."""
+
+            def __init__(self, inner):
+                self._inner = inner
+                self.delta_calls = 0
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+            def list_delta(self, kind, since, namespace="",
+                           label_selector=None, field_selector=None):
+                self.delta_calls += 1
+                items, rv = self._inner.list_with_revision(
+                    kind, namespace, label_selector, field_selector
+                )
+                return ListDelta(items, [], rv, full=True)
+
+        old = OldServer(cluster)
+        cluster.create(make_node("s0"))
+        cluster.create(make_node("s1"))
+        inf = Informer(old, "Node").start()
+        try:
+            assert inf.wait_for_sync(10)
+            inf.stop()
+            cluster.create(make_node("s2"))
+            cluster.delete("Node", "s0")
+            list_log = cluster.start_call_log()
+            stop = threading.Event()
+            inf._synced.clear()
+            inf._relist(stop)
+            # ONE list crossed the wire (inside list_delta); the
+            # salvage applied it — adds, deletes, revision — with no
+            # second fetch, and it is accounted as a full relist.
+            assert old.delta_calls == 1
+            assert [v for v, k, _ in list_log if v == "list"] == ["list"]
+            assert inf.delta_relists == 0
+            assert inf.full_relists == 2
+            assert sorted(o.name for o in inf.list()) == ["s1", "s2"]
+        finally:
+            inf.stop()
+
+    def test_informer_falls_back_outside_window(self, server, client):
+        server.cluster.create(wrap(node_raw("f0")))
+        inf = Informer(client, "Node").start()
+        try:
+            assert inf.wait_for_sync(10)
+            inf.stop()
+            server.cluster.create(wrap(node_raw("f1")))
+            server.cluster._history.clear()
+            stop = threading.Event()
+            inf._synced.clear()
+            inf._relist(stop)
+            assert inf.delta_relists == 0
+            assert inf.full_relists == 2
+            assert inf.get("f1") is not None
+        finally:
+            inf.stop()
+
+
+class TestServerSideFieldSelectors:
+    def test_watch_filters_fields_server_side_with_parity(
+        self, server, client
+    ):
+        """A fieldSelector-scoped watch carries only in-scope frames —
+        and classifies identically to client-side filtering of the
+        unscoped stream (parity pin for the hub's scoped upstreams)."""
+        pod = {
+            "kind": "Pod", "apiVersion": "v1",
+            "metadata": {"name": "pod-a", "namespace": "d"},
+            "spec": {"nodeName": "n1"},
+        }
+        server.cluster.create(wrap(pod))
+        _, rv = client.list_with_revision("Pod", namespace="d")
+        handle = WatchHandle()
+        scoped = client.watch(
+            "Pod", namespace="d", field_selector="spec.nodeName=n1",
+            resource_version=rv, timeout_seconds=5, handle=handle,
+        )
+        other = dict(pod, metadata={"name": "pod-b", "namespace": "d"},
+                     spec={"nodeName": "n2"})
+        server.cluster.create(wrap(other))
+        mine = dict(pod, metadata={"name": "pod-c", "namespace": "d"},
+                    spec={"nodeName": "n1"})
+        server.cluster.create(wrap(mine))
+        seen = []
+        for event_type, obj in scoped:
+            seen.append((event_type, obj.name))
+            if obj.name == "pod-c":
+                handle.cancel()
+        # pod-b (other node) never crossed the wire.
+        assert seen == [("ADDED", "pod-c")]
+        # Parity: client-side filtering of the unscoped stream agrees.
+        from k8s_operator_libs_tpu.kube.selectors import (
+            parse_field_selector,
+        )
+        matcher = parse_field_selector("spec.nodeName=n1")
+        unscoped = [
+            o for o in server.cluster.list("Pod", namespace="d")
+            if matcher.matches(o.raw)
+        ]
+        assert sorted(o.name for o in unscoped) == ["pod-a", "pod-c"]
+
+    def test_not_equals_field_selector_over_the_wire(self, server, client):
+        for name, node in (("pod-a", "n1"), ("pod-b", "n2")):
+            server.cluster.create(wrap({
+                "kind": "Pod", "apiVersion": "v1",
+                "metadata": {"name": name, "namespace": "d"},
+                "spec": {"nodeName": node},
+            }))
+        out = client.list(
+            "Pod", namespace="d", field_selector="spec.nodeName!=n1"
+        )
+        assert [o.name for o in out] == ["pod-b"]
+
+
+class TestHubWireMetrics:
+    def test_hub_metrics_render(self, server, client):
+        with WatchHub(client) as hub:
+            inf = Informer(client, "Node", stream_source=hub).start()
+            try:
+                assert inf.wait_for_sync(10)
+                server.cluster.create(wrap(node_raw("m0")))
+                assert wait_until(lambda: inf.get("m0") is not None)
+                rendered = WireMetrics(hub=hub, apiserver=server).render()
+                assert "tpu_operator_wire_hub_upstream_streams 1" in rendered
+                assert "tpu_operator_wire_hub_subscribers 1" in rendered
+                assert 'tpu_operator_wire_hub_scope_subscribers{scope="Node"} 1' in rendered
+                assert "tpu_operator_wire_apf_admitted_total" in rendered
+            finally:
+                inf.stop()
