@@ -1417,6 +1417,241 @@ def run_degraded_first_roll(slices: int = 4, hosts_per_slice: int = 4) -> dict:
     }
 
 
+def run_bad_link_roll(slices: int = 4, hosts_per_slice: int = 4) -> dict:
+    """ISSUE 12 headline — per-link fault localization: a 16-node /
+    4-slice pool where ONE asymmetric slow link sickens slice 1
+    (``s1-h0`` publishes a degraded per-link entry against ``s1-h1``;
+    the reverse direction was never observed — the asymmetric case the
+    symmetric topology fold exists for) while EVERY per-node aggregate
+    score reads identically healthy (all checks pass, ring bandwidth
+    and latency nominal — the ring aggregate hides one sick hop among
+    healthy ones). Rolled twice under a 1-slice budget:
+
+    * **aggregate_only** (the in-bench CONTROL): identical reports
+      minus the link map. All 16 aggregate scores are byte-equal —
+      hard-asserted — so NO ordering derived from per-node aggregate
+      scores can localize the sick link's slice; the planner falls back
+      to name order and disrupts healthy slice 0 first (hard-asserted:
+      the sick slice does NOT enter first). This is the "per-node
+      scores alone provably cannot" comparison.
+    * **link_aware**: the same pool with the link map published.
+      HARD-ASSERTED: every node of the sick link's slice enters the
+      pipeline before ANY other slice's node (the planner fingers the
+      LINK's slice first), and zero healthy-slice disruption windows
+      open before the sick slice is done (``false_localization`` — CI
+      hard-0).
+
+    Plus the endpoint-degradation pin: from the SAME published reports,
+    ``effective_scores`` must degrade BOTH endpoints (s1-h0 and s1-h1)
+    below the healthy 100 their own aggregates read — one sick link,
+    two degraded nodes, zero false positives elsewhere.
+    """
+    from k8s_operator_libs_tpu.api.telemetry_v1alpha1 import (
+        effective_scores,
+        parse_node_health,
+    )
+    from k8s_operator_libs_tpu.tpu.monitor import ReportPublisher
+
+    nodes = slices * hosts_per_slice
+    sick_pool = f"{POOL}-1"
+    sick_nodes = ("s1-h0", "s1-h1")
+
+    def node_pool(name: str) -> str:
+        return f"{POOL}-{name.split('-')[0][1:]}"
+
+    def publish_all(cluster, with_link_map: bool) -> None:
+        for s in range(slices):
+            for h in range(hosts_per_slice):
+                name = f"s{s}-h{h}"
+                links = None
+                if with_link_map:
+                    # Every node carries a healthy link map (the quick
+                    # battery publishes one everywhere); ONLY s1-h0's
+                    # entry against s1-h1 is sick — and only in that
+                    # direction.
+                    peer = f"s{s}-h{(h + 1) % hosts_per_slice}"
+                    sick = name == "s1-h0"
+                    links = {
+                        peer: {
+                            "ok": True,
+                            "latency_s": 5.0 if sick else 0.001,
+                            "gbytes_per_s": 1.0 if sick else 42.0,
+                        }
+                    }
+                ReportPublisher(
+                    cluster, name, heartbeat_seconds=0.0
+                ).publish(
+                    {"ring_allreduce": True},
+                    {"ring_gbytes_per_s": 45.0, "probe_latency_s": 2.0},
+                    links=links,
+                )
+
+    def one_roll(with_link_map: bool) -> dict:
+        cluster, sim = build_pool(
+            slices=slices, hosts_per_slice=hosts_per_slice
+        )
+        publish_all(cluster, with_link_map)
+        mgr = ClusterUpgradeStateManager(
+            cluster, DEVICE, runner=TaskRunner(inline=True)
+        )
+        mgr.with_validation_enabled(validation_hook=lambda node: True)
+        enable_slice_aware_planning(mgr)
+        health = mgr.with_health_telemetry()
+        policy = DriverUpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=1,
+            max_unavailable=IntOrString(1),  # one SLICE at a time
+        )
+        entry_order: list[str] = []
+
+        def record(event, obj, old):
+            if obj.get("kind") != "Node":
+                return
+            label = ((obj["metadata"].get("labels") or {})).get(
+                KEYS.state_label
+            )
+            old_label = (
+                ((old or {}).get("metadata") or {}).get("labels") or {}
+            ).get(KEYS.state_label)
+            if label == "cordon-required" and label != old_label:
+                entry_order.append(obj["metadata"]["name"])
+
+        cluster.subscribe(record)
+        samples: list[tuple[set, bool]] = []
+
+        def post_pass():
+            disrupted = set()
+            for obj in cluster.list("Node"):
+                from k8s_operator_libs_tpu.kube import Node as NodeObj
+
+                n = NodeObj(obj.raw)
+                if n.unschedulable or not n.is_ready():
+                    disrupted.add(n.labels[GKE_NODEPOOL_LABEL])
+            sick_done = all(
+                (((cluster.peek("Node", f"s1-h{h}") or {}).get("metadata")
+                  or {}).get("labels") or {}).get(KEYS.state_label)
+                == "upgrade-done"
+                for h in range(hosts_per_slice)
+            )
+            samples.append((disrupted, sick_done))
+
+        # The aggregate-score control: every score must be byte-equal,
+        # or the "aggregates provably cannot localize" claim is hollow.
+        raw_scores = {}
+        for obj in cluster.list("NodeHealthReport"):
+            parsed = parse_node_health(obj.raw)
+            raw_scores[parsed.node_name] = parsed.score
+        eff = effective_scores(
+            {
+                parse_node_health(o.raw).node_name: parse_node_health(o.raw)
+                for o in cluster.list("NodeHealthReport")
+            }
+        )
+
+        sim.set_template_hash("libtpu-v2")
+        start = time.perf_counter()
+        try:
+            passes = drive_to_convergence(
+                cluster, sim, mgr, policy, post_pass=post_pass
+            )
+        finally:
+            health.stop()
+        elapsed = time.perf_counter() - start
+        healthy_windows_before = 0
+        previously: set = set()
+        for disrupted, sick_done in samples:
+            for pool_id in disrupted - previously:
+                if pool_id != sick_pool and not sick_done:
+                    healthy_windows_before += 1
+            previously = set(disrupted)
+        sick_entries = [n for n in entry_order if node_pool(n) == sick_pool]
+        other_entries = [n for n in entry_order if node_pool(n) != sick_pool]
+        first_other = (
+            entry_order.index(other_entries[0])
+            if other_entries else len(entry_order)
+        )
+        last_sick = max(
+            (entry_order.index(n) for n in sick_entries),
+            default=len(entry_order),
+        )
+        return {
+            "passes": passes,
+            "wall_s": round(elapsed, 3),
+            "entry_order": entry_order[:8],
+            "sick_slice_first": bool(sick_entries) and last_sick < first_other,
+            "healthy_windows_before_sick_done": healthy_windows_before,
+            "aggregate_scores": raw_scores,
+            "effective_scores": {
+                n: eff.get(n) for n in (*sick_nodes, "s0-h0", "s2-h0")
+            },
+        }
+
+    control = one_roll(with_link_map=False)
+    spread = max(control["aggregate_scores"].values()) - min(
+        control["aggregate_scores"].values()
+    )
+    if spread != 0.0:
+        raise RuntimeError(
+            "bad_link_roll: control aggregate scores are not byte-equal "
+            f"(spread {spread}) — the cannot-localize claim needs "
+            "indistinguishable aggregates"
+        )
+    if control["sick_slice_first"]:
+        raise RuntimeError(
+            "bad_link_roll: the aggregate-only control localized the sick "
+            "slice — the link map carried no exclusive signal "
+            f"(order: {control['entry_order']})"
+        )
+
+    link_aware = one_roll(with_link_map=True)
+    if not link_aware["sick_slice_first"]:
+        raise RuntimeError(
+            "bad_link_roll: the planner did not finger the sick link's "
+            f"slice first (order: {link_aware['entry_order']})"
+        )
+    if link_aware["healthy_windows_before_sick_done"] != 0:
+        raise RuntimeError(
+            "bad_link_roll: "
+            f"{link_aware['healthy_windows_before_sick_done']} healthy "
+            "disruption windows opened before the sick slice was done"
+        )
+    eff = link_aware["effective_scores"]
+    for endpoint in sick_nodes:
+        if not (eff.get(endpoint) is not None and eff[endpoint] < 100.0):
+            raise RuntimeError(
+                f"bad_link_roll: endpoint {endpoint} did not degrade from "
+                f"the sick link (effective {eff.get(endpoint)}) — the "
+                "symmetric fold must sicken BOTH ends of an asymmetric "
+                "observation"
+            )
+    for healthy in ("s0-h0", "s2-h0"):
+        if eff.get(healthy) != 100.0:
+            raise RuntimeError(
+                f"bad_link_roll: healthy node {healthy} degraded "
+                f"(effective {eff.get(healthy)}) — false positive"
+            )
+
+    return {
+        "nodes": nodes,
+        "sick_link": list(sick_nodes),
+        "aggregate_only": control,
+        "link_aware": link_aware,
+        # CI-gated flags (tools/bench_smoke_baseline.json): both are
+        # hard-asserted above; the floors keep the gate honest if the
+        # asserts are ever weakened — so they are DERIVED from the
+        # measurement, never hardcoded (a literal would make the floor
+        # tautological).
+        "link_slice_first": 1.0 if link_aware["sick_slice_first"] else 0.0,
+        "false_localization": link_aware[
+            "healthy_windows_before_sick_done"
+        ],
+        "aggregate_localizes": 1.0 if control["sick_slice_first"] else 0.0,
+        "both_endpoints_degraded": all(
+            eff[n] < 100.0 for n in sick_nodes
+        ),
+    }
+
+
 def run_fleet_64_pools(
     pools: int = 64,
     hosts_per_pool: int = 4,
@@ -2153,6 +2388,7 @@ SECTIONS = {
     "single_event_latency": run_single_event_latency,
     "live_workload_roll": run_live_workload_roll,
     "degraded_first_roll": run_degraded_first_roll,
+    "bad_link_roll": run_bad_link_roll,
     "fleet_64_pools": run_fleet_64_pools,
     "report_storm": run_report_storm,
     "ring_bandwidth": run_ring_bandwidth,
@@ -2271,6 +2507,12 @@ def main() -> None:
     degraded_first = run_degraded_first_roll()
     _progress("degraded_first_roll")
 
+    # Per-link health plane (ISSUE 12): link-level fault localization —
+    # the planner fingers a sick LINK's slice while per-node aggregates
+    # provably cannot (docs/ici-health-gate.md "Link localization").
+    bad_link = run_bad_link_roll()
+    _progress("bad_link_roll")
+
     # Fleet tier (ISSUE 10): 64 pools / 256 nodes rolled over the wire
     # from 1/2/4 shard workers under one global disruption budget
     # (docs/fleet-control-plane.md).
@@ -2318,6 +2560,7 @@ def main() -> None:
         "live_workload_roll": live_roll,
         "ring_bandwidth": ring_bw,
         "degraded_first_roll": degraded_first,
+        "bad_link_roll": bad_link,
         "fleet_64_pools": fleet,
         "report_storm": storm,
         "gate_cold_vs_warm": gate_split,
@@ -2376,6 +2619,8 @@ def main() -> None:
             "quarantine_budget_violations": degraded_first[
                 "quarantine_drill"
             ]["budget_violations"],
+            "bad_link_slice_first": bad_link["link_slice_first"],
+            "bad_link_false_localization": bad_link["false_localization"],
             "fleet_64_pools_budget_violations": fleet["budget_violations"],
             "fleet_scaling_4w_vs_1w": fleet["scaling_4w_vs_1w"],
             "fleet_4w_passes_per_s": fleet["workers_4"][

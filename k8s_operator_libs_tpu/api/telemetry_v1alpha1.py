@@ -12,7 +12,18 @@ per-node report those probes publish instead:
 * per-check boolean verdicts (psum, mxu, burn-in, ...);
 * numeric scores (ring all-reduce GB/s, probe latency, tokens/s);
 * a bounded rolling history window of past observations;
-* a derived 0-100 **health score** with a **trend** over the window.
+* a derived 0-100 **health score** with a **trend** over the window;
+* a per-neighbor **link map** (ISSUE 12): one graded entry per ICI
+  neighbor the per-hop ppermute probe timed individually — latency,
+  bandwidth, a graded verdict, and a bounded per-link rolling window.
+
+The link map deliberately does NOT fold into the scalar score: the
+0-100 aggregate reduces a whole ring to one number, which is exactly
+the information loss that makes a sick link between two healthy hosts
+invisible (the observable-collectives argument, PAPERS.md). Consumers
+localize through :func:`fold_link_topology` /
+:func:`node_link_scores` instead — both ENDPOINTS of a sick link
+degrade, even when only one of them observed it.
 
 Like the WorkloadCheckpoint contract (upgrade_v1alpha1.py), the names
 and shapes live HERE, kube-free; the REST-registry entry lives in
@@ -66,6 +77,114 @@ METRIC_RING_GBYTES_PER_S = "ring_gbytes_per_s"
 METRIC_PROBE_LATENCY_S = "probe_latency_s"
 METRIC_TOKENS_PER_S = "tokens_per_s"
 METRIC_MXU_TFLOPS = "mxu_tflops"
+#: Worst incident-link summary metrics the probe tiers surface beside
+#: the link map (a scrape-friendly scalar; the map carries the detail).
+METRIC_WORST_LINK_GBYTES_PER_S = "worst_link_gbytes_per_s"
+METRIC_WORST_LINK_LATENCY_S = "worst_link_latency_s"
+
+# ---------------------------------------------------------------------------
+# Per-link contract (ISSUE 12, docs/fleet-telemetry.md "Per-link schema")
+# ---------------------------------------------------------------------------
+
+#: Graded per-link verdicts: ``failed`` (the hop's numerics/transport
+#: broke), ``degraded`` (carried traffic, but below the references), or
+#: ``ok``. Ordered worst-first by :func:`_link_rank` for folds.
+LINK_OK = "ok"
+LINK_DEGRADED = "degraded"
+LINK_FAILED = "failed"
+
+#: Reference points for grading one hop. A single neighbor exchange is
+#: graded against the same healthy-bandwidth reference as the ring (the
+#: per-hop payload rides one link, so the per-link figure is directly
+#: comparable) and a per-hop latency budget far below the whole-battery
+#: budget — one hop taking a second is a straggling link, not a slow
+#: battery.
+DEFAULT_HEALTHY_LINK_GBYTES_PER_S = DEFAULT_HEALTHY_RING_GBYTES_PER_S
+DEFAULT_LINK_LATENCY_BUDGET_S = 1.0
+#: Degradation thresholds: below this fraction of healthy bandwidth, or
+#: above this multiple of the latency budget, a passing hop still grades
+#: ``degraded``.
+LINK_DEGRADED_BANDWIDTH_FRACTION = 0.5
+LINK_DEGRADED_LATENCY_FACTOR = 2.0
+
+#: Bounded per-link rolling window of bandwidth samples (same argument
+#: as the report history window: a CR must never grow per probe cycle).
+DEFAULT_LINK_WINDOW = 8
+
+#: Effective-score contribution of a link verdict — the ONE mapping
+#: from graded link state to the planner's 0-100 ordering space. A
+#: failed link reads 0 (a dead hop outranks any graded degradation,
+#: mirroring the monitor condition's rank in effective_score); a
+#: degraded link reads below every quarantine default threshold so a
+#: sick link can quarantine its endpoints.
+LINK_VERDICT_SCORES = {LINK_OK: 100.0, LINK_DEGRADED: 40.0, LINK_FAILED: 0.0}
+
+
+def _link_rank(verdict: str) -> int:
+    """Worst-first ordering for folds: failed < degraded < ok."""
+    return {LINK_FAILED: 0, LINK_DEGRADED: 1}.get(verdict, 2)
+
+
+def link_verdict_value(verdict: str) -> int:
+    """Numeric encoding for metrics: failed=-1, degraded=0, ok=1."""
+    return {LINK_FAILED: -1, LINK_OK: 1}.get(verdict, 0)
+
+
+def sicker_link(a: "LinkHealth", b: "LinkHealth") -> "LinkHealth":
+    """The sicker of two observations of one directed link (worst
+    verdict, lowest bandwidth breaking ties) — the merge rule for
+    duplicate reports of the same node (fleet aggregation: a shard
+    mid-failover can surface two copies, and duplication must only
+    ever make things look sicker)."""
+    if _link_rank(a.verdict) != _link_rank(b.verdict):
+        return a if _link_rank(a.verdict) < _link_rank(b.verdict) else b
+    return a if a.gbytes_per_s <= b.gbytes_per_s else b
+
+
+def raw_link_entries(links: Mapping[str, "LinkHealth"]) -> dict:
+    """Parsed :class:`LinkHealth` entries back to the raw
+    ``status.links`` shape — the carry-forward path: a publisher tier
+    that ran NO link probes must preserve the live CR's map verbatim
+    instead of erasing the other tier's signal."""
+    return {
+        peer: {
+            "latencyS": link.latency_s,
+            "gbytesPerS": link.gbytes_per_s,
+            "verdict": link.verdict,
+            "window": list(link.window),
+        }
+        for peer, link in links.items()
+    }
+
+
+def grade_link(
+    ok: bool,
+    latency_s: float,
+    gbytes_per_s: float,
+    healthy_link_gbytes_per_s: float = DEFAULT_HEALTHY_LINK_GBYTES_PER_S,
+    link_latency_budget_s: float = DEFAULT_LINK_LATENCY_BUDGET_S,
+) -> str:
+    """Grade one timed neighbor exchange. A hop that failed its
+    correctness check is ``failed`` regardless of timing; a passing hop
+    degrades on collapsed bandwidth or ballooned latency; absent
+    numbers (0.0 — the probe carried no timing) grade ``ok``: a missing
+    measurement must not read as a sick link."""
+    if not ok:
+        return LINK_FAILED
+    if (
+        gbytes_per_s > 0
+        and healthy_link_gbytes_per_s > 0
+        and gbytes_per_s
+        < LINK_DEGRADED_BANDWIDTH_FRACTION * healthy_link_gbytes_per_s
+    ):
+        return LINK_DEGRADED
+    if (
+        latency_s > 0
+        and link_latency_budget_s > 0
+        and latency_s > LINK_DEGRADED_LATENCY_FACTOR * link_latency_budget_s
+    ):
+        return LINK_DEGRADED
+    return LINK_OK
 
 
 def node_health_report_name(node_name: str) -> str:
@@ -144,6 +263,21 @@ def trend_value(trend: str) -> int:
 
 
 @dataclass(frozen=True)
+class LinkHealth:
+    """Parsed view of one per-neighbor link entry: the peer identifier
+    (a NODE name for cross-host links — those participate in the fleet
+    topology fold — or a local device tag like ``device-3`` for
+    intra-node hops), the timed numbers, the graded verdict, and the
+    bounded rolling bandwidth window."""
+
+    peer: str
+    latency_s: float = 0.0
+    gbytes_per_s: float = 0.0
+    verdict: str = LINK_OK
+    window: tuple = ()
+
+
+@dataclass(frozen=True)
 class NodeHealth:
     """Parsed view of one report's status — what the planner and the
     metrics family consume (upgrade/health_source.py keeps a map of
@@ -156,6 +290,59 @@ class NodeHealth:
     metrics: Mapping[str, float] = field(default_factory=dict)
     observed_at: float = 0.0
     source: str = ""
+    #: Per-neighbor link map (peer id -> LinkHealth); empty when the
+    #: publisher's battery carried no per-hop probe.
+    links: Mapping[str, LinkHealth] = field(default_factory=dict)
+
+    def worst_link(self) -> Optional[LinkHealth]:
+        """The sickest link this node itself observed (``None`` with no
+        link map). Fleet consumers should prefer the symmetric
+        :func:`fold_link_topology` view, which also sees links the PEER
+        reported against this node."""
+        if not self.links:
+            return None
+        return min(
+            self.links.values(),
+            key=lambda l: (_link_rank(l.verdict), l.gbytes_per_s),
+        )
+
+
+def make_link_entries(
+    links: Mapping[str, Mapping[str, Any]],
+    prior_links: Optional[Mapping[str, LinkHealth]] = None,
+    link_window: int = DEFAULT_LINK_WINDOW,
+    healthy_link_gbytes_per_s: float = DEFAULT_HEALTHY_LINK_GBYTES_PER_S,
+    link_latency_budget_s: float = DEFAULT_LINK_LATENCY_BUDGET_S,
+) -> dict[str, dict[str, Any]]:
+    """Raw ``status.links`` entries from per-hop observations
+    (``peer -> {ok, latency_s, gbytes_per_s}`` — the shape the probe
+    tiers emit), graded via :func:`grade_link`, each carrying a bounded
+    rolling bandwidth window appended to the live CR's prior window (a
+    peer absent from this observation drops out: link membership is
+    observed, not accumulated — a re-cabled slice must not haunt the
+    map)."""
+    out: dict[str, dict[str, Any]] = {}
+    for peer, obs in links.items():
+        ok = bool(obs.get("ok", True))
+        latency = float(obs.get("latency_s", 0.0) or 0.0)
+        gbps = float(obs.get("gbytes_per_s", 0.0) or 0.0)
+        prior = (prior_links or {}).get(str(peer))
+        window = list(prior.window) if prior is not None else []
+        window.append(round(gbps, 4))
+        window = window[-max(1, int(link_window)):]
+        out[str(peer)] = {
+            "latencyS": round(latency, 6),
+            "gbytesPerS": round(gbps, 4),
+            "verdict": grade_link(
+                ok,
+                latency,
+                gbps,
+                healthy_link_gbytes_per_s=healthy_link_gbytes_per_s,
+                link_latency_budget_s=link_latency_budget_s,
+            ),
+            "window": window,
+        }
+    return out
 
 
 def make_node_health_report(
@@ -168,11 +355,19 @@ def make_node_health_report(
     history_window: int = DEFAULT_HISTORY_WINDOW,
     healthy_ring_gbytes_per_s: float = DEFAULT_HEALTHY_RING_GBYTES_PER_S,
     latency_budget_s: float = DEFAULT_LATENCY_BUDGET_S,
+    links: Optional[Mapping[str, Mapping[str, Any]]] = None,
+    prior_links: Optional[Mapping[str, LinkHealth]] = None,
+    link_window: int = DEFAULT_LINK_WINDOW,
+    healthy_link_gbytes_per_s: float = DEFAULT_HEALTHY_LINK_GBYTES_PER_S,
+    link_latency_budget_s: float = DEFAULT_LINK_LATENCY_BUDGET_S,
 ) -> dict[str, Any]:
     """Raw NodeHealthReport object for this observation, appended to the
     caller-supplied prior ``history`` (the publisher passes the live
     CR's window so the trend sees past observations; bounded to
-    ``history_window`` entries, oldest dropped)."""
+    ``history_window`` entries, oldest dropped). ``links`` is the
+    per-hop observation map (see :func:`make_link_entries`); note the
+    derived score stays link-BLIND by design — the link signal travels
+    in the map, where consumers can localize it."""
     score = derive_score(
         checks,
         metrics,
@@ -194,19 +389,28 @@ def make_node_health_report(
     trend = derive_trend(
         [float(h.get("score", 0.0)) for h in window if "score" in h]
     )
+    status: dict[str, Any] = {
+        "score": score,
+        "trend": trend,
+        "checks": {k: bool(v) for k, v in checks.items()},
+        "metrics": {k: float(v) for k, v in metrics.items()},
+        "history": window,
+        "observedAt": float(observed_at),
+    }
+    if links is not None:
+        status["links"] = make_link_entries(
+            links,
+            prior_links=prior_links,
+            link_window=link_window,
+            healthy_link_gbytes_per_s=healthy_link_gbytes_per_s,
+            link_latency_budget_s=link_latency_budget_s,
+        )
     return {
         "apiVersion": NODE_HEALTH_REPORT_API_VERSION,
         "kind": NODE_HEALTH_REPORT_KIND,
         "metadata": {"name": node_health_report_name(node_name)},
         "spec": {"nodeName": node_name, "source": source},
-        "status": {
-            "score": score,
-            "trend": trend,
-            "checks": {k: bool(v) for k, v in checks.items()},
-            "metrics": {k: float(v) for k, v in metrics.items()},
-            "history": window,
-            "observedAt": float(observed_at),
-        },
+        "status": status,
     }
 
 
@@ -252,6 +456,37 @@ def parse_node_health(raw: Mapping[str, Any]) -> Optional[NodeHealth]:
         observed_at = float(status.get("observedAt", 0.0))
     except (TypeError, ValueError):
         observed_at = 0.0
+    links_raw = status.get("links")
+    links: dict[str, LinkHealth] = {}
+    if isinstance(links_raw, Mapping):
+        for peer, entry in links_raw.items():
+            if not isinstance(entry, Mapping):
+                continue
+            verdict = entry.get("verdict")
+            if verdict not in (LINK_OK, LINK_DEGRADED, LINK_FAILED):
+                verdict = LINK_OK
+            try:
+                latency = float(entry.get("latencyS", 0.0) or 0.0)
+                gbps = float(entry.get("gbytesPerS", 0.0) or 0.0)
+            except (TypeError, ValueError):
+                continue
+            window_raw = entry.get("window")
+            window: tuple = ()
+            if isinstance(window_raw, list):
+                samples = []
+                for v in window_raw:
+                    try:
+                        samples.append(float(v))
+                    except (TypeError, ValueError):
+                        continue
+                window = tuple(samples)
+            links[str(peer)] = LinkHealth(
+                peer=str(peer),
+                latency_s=latency,
+                gbytes_per_s=gbps,
+                verdict=verdict,
+                window=window,
+            )
     return NodeHealth(
         node_name=str(node_name),
         score=min(100.0, max(0.0, score)),
@@ -260,4 +495,123 @@ def parse_node_health(raw: Mapping[str, Any]) -> Optional[NodeHealth]:
         metrics=metrics,
         observed_at=observed_at,
         source=str(spec.get("source", "")),
+        links=links,
     )
+
+
+# ---------------------------------------------------------------------------
+# Fleet link-topology fold (ISSUE 12): the symmetric consumer view.
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class LinkObservation:
+    """One fleet link after the symmetric fold: the two endpoints
+    (sorted; ``b`` may be a local device tag for intra-node hops), the
+    WORST observation either endpoint made, and which endpoints
+    reported it (one name = an asymmetric observation — the fold still
+    degrades both sides)."""
+
+    a: str
+    b: str
+    latency_s: float
+    gbytes_per_s: float
+    verdict: str
+    reporters: tuple
+
+    @property
+    def key(self) -> tuple:
+        return (self.a, self.b)
+
+
+def link_key(node_a: str, node_b: str) -> tuple:
+    """Canonical undirected link identity: sorted endpoint pair — A's
+    report about B and B's report about A land on ONE key."""
+    return (node_a, node_b) if node_a <= node_b else (node_b, node_a)
+
+
+def fold_link_topology(
+    health: Mapping[str, NodeHealth],
+) -> dict[tuple, LinkObservation]:
+    """Fold every node's per-neighbor link map into a symmetric fleet
+    topology view keyed by undirected link. Disagreeing endpoints take
+    the WORST observation on every axis (worst verdict, lowest
+    bandwidth, highest latency): an asymmetric sick link — one side
+    times the collapse, the other side's probe happened to ride the
+    healthy direction — must still read sick, and duplication can only
+    make a link look sicker, never healthier (the fleet aggregator's
+    fold rule, one tier down)."""
+    out: dict[tuple, LinkObservation] = {}
+    for node_name, entry in (health or {}).items():
+        for peer, link in entry.links.items():
+            key = link_key(node_name, peer)
+            prev = out.get(key)
+            if prev is None:
+                out[key] = LinkObservation(
+                    a=key[0],
+                    b=key[1],
+                    latency_s=link.latency_s,
+                    gbytes_per_s=link.gbytes_per_s,
+                    verdict=link.verdict,
+                    reporters=(node_name,),
+                )
+                continue
+            verdict = min(prev.verdict, link.verdict, key=_link_rank)
+            gbps = (
+                min(prev.gbytes_per_s, link.gbytes_per_s)
+                if prev.gbytes_per_s > 0 and link.gbytes_per_s > 0
+                else max(prev.gbytes_per_s, link.gbytes_per_s)
+            )
+            reporters = prev.reporters
+            if node_name not in reporters:
+                reporters = tuple(sorted((*reporters, node_name)))
+            out[key] = LinkObservation(
+                a=key[0],
+                b=key[1],
+                latency_s=max(prev.latency_s, link.latency_s),
+                gbytes_per_s=gbps,
+                verdict=verdict,
+                reporters=reporters,
+            )
+    return out
+
+
+def node_link_scores(
+    topology: Mapping[tuple, LinkObservation],
+) -> dict[str, float]:
+    """node -> worst incident-link score (``LINK_VERDICT_SCORES``) over
+    the folded topology. BOTH endpoints of every link get an entry —
+    two healthy nodes sharing a sick link both degrade, including an
+    endpoint that never published a report of its own (it appears only
+    as a peer). Nodes whose every incident link is ok read 100."""
+    out: dict[str, float] = {}
+    for obs in topology.values():
+        score = LINK_VERDICT_SCORES.get(obs.verdict, 100.0)
+        for endpoint in (obs.a, obs.b):
+            prev = out.get(endpoint)
+            if prev is None or score < prev:
+                out[endpoint] = score
+    return out
+
+
+def effective_scores(health: Mapping[str, NodeHealth]) -> dict[str, float]:
+    """node -> min(own aggregate score, worst incident-link score) over
+    one health map — the link-aware ordering/quarantine input. Includes
+    peer-only nodes (no report of their own, but an incident link names
+    them); intra-node peers (device tags) pick up entries too, which
+    consumers keyed by node name simply never look up."""
+    topology = fold_link_topology(health)
+    out = node_link_scores(topology)
+    for name, entry in (health or {}).items():
+        prev = out.get(name)
+        if prev is None or entry.score < prev:
+            out[name] = entry.score
+    return out
+
+
+def effective_node_score(
+    node_name: str, health: Mapping[str, NodeHealth]
+) -> Optional[float]:
+    """Link-aware score for ONE node (``None`` when neither an own
+    report nor any incident link mentions it — absence of telemetry is
+    not a verdict)."""
+    return effective_scores(health).get(node_name)

@@ -12,6 +12,7 @@ fleet state in memory.
 """
 
 from .hashring import HashRing, stable_hash
+from .metrics import FleetMetrics
 from .orchestrator import FleetHealthAggregator, FleetOrchestrator
 from .scope import ShardScopedSnapshotSource
 from .worker import (
@@ -24,6 +25,7 @@ from .worker import (
 
 __all__ = [
     "FleetHealthAggregator",
+    "FleetMetrics",
     "FleetOrchestrator",
     "FleetWorkerConfig",
     "GrantGatedInplaceManager",

@@ -74,22 +74,73 @@ class FleetHealthAggregator:
         """pool -> (worst member score, worst member trend). A node
         reported by several sources (a shard mid-failover can appear in
         the old and new owner's scope) folds by worst — duplication can
-        only make a pool look sicker, never healthier."""
+        only make a pool look sicker, never healthier.
+
+        Scores are LINK-AWARE (ISSUE 12): the per-shard maps merge into
+        one fleet view first, the symmetric link-topology fold runs
+        over the MERGED map (a cross-shard link's two endpoint reports
+        live in different sources — folding per source would miss the
+        pair), and every node's score is the worst of its own aggregate
+        and its worst incident link. A node named only as a link PEER
+        still degrades its pool — link-degraded pools propagate
+        degraded-first with no report of their own. Duplicate copies
+        of one node merge PER AXIS (worst aggregate score AND, per
+        peer, the sicker link observation) — picking one whole report
+        could discard a sicker link map riding the higher-score copy."""
+        from ..api.telemetry_v1alpha1 import (
+            NodeHealth,
+            effective_scores,
+            sicker_link,
+        )
+
         with self._lock:
             sources = list(self._sources)
-        out: dict[str, tuple[float, int]] = {}
+        scores: dict[str, float] = {}
+        links: dict[str, dict[str, Any]] = {}
+        trends: dict[str, int] = {}
         for source in sources:
             for node_name, health in source.snapshot().items():
-                pool = self._pool_of(node_name)
-                if not pool:
-                    continue
-                score = health.score
+                previous = scores.get(node_name)
+                if previous is None or health.score < previous:
+                    scores[node_name] = health.score
+                for peer, link in health.links.items():
+                    per_node = links.setdefault(node_name, {})
+                    current = per_node.get(peer)
+                    per_node[peer] = (
+                        link if current is None else sicker_link(link, current)
+                    )
                 trend = trend_value(health.trend)
-                previous = out.get(pool)
-                if previous is not None:
-                    score = min(score, previous[0])
-                    trend = min(trend, previous[1])
-                out[pool] = (score, trend)
+                trends[node_name] = min(trend, trends.get(node_name, trend))
+        merged = {
+            name: NodeHealth(name, score=score, links=links.get(name, {}))
+            for name, score in scores.items()
+        }
+        out: dict[str, tuple[float, int]] = {}
+        for node_name, score in effective_scores(merged).items():
+            try:
+                pool = self._pool_of(node_name)
+            except Exception:
+                # Suppressed ONLY for peer-only ids (a link peer that
+                # never published — intra-node device tags, say): they
+                # carry no pool signal a strict mapper must resolve.
+                # A mapper failure for a node with its OWN report is
+                # the pre-PR-12 loud path — swallowing it would
+                # silently drop a degraded pool from the fold.
+                if node_name in scores:
+                    raise
+                log.debug(
+                    "pool mapping rejected link peer %r; skipped",
+                    node_name,
+                )
+                pool = ""
+            if not pool:
+                continue
+            trend = trends.get(node_name, 0)
+            previous = out.get(pool)
+            if previous is not None:
+                score = min(score, previous[0])
+                trend = min(trend, previous[1])
+            out[pool] = (score, trend)
         return out
 
     def ordered(self, pools: Iterable[str]) -> list[str]:
@@ -134,12 +185,20 @@ class FleetOrchestrator:
         self.budget_denials = 0
         self.ticks = 0
         self.api_errors = 0
+        #: Ledger shape after the most recent successful grant round —
+        #: what the ``tpu_operator_fleet_*`` exporter reads (budget
+        #: headroom, pools per phase) without its own apiserver GET per
+        #: scrape (fleet/metrics.py).
+        self.last_summary: dict[str, Any] = {}
 
     def tick(self) -> dict[str, Any]:
         """One grant round; returns a summary of the ledger after it."""
         self.ticks += 1
         try:
-            return self._grant_round()
+            summary = self._grant_round()
+            if "error" not in summary and "missing" not in summary:
+                self.last_summary = dict(summary)
+            return summary
         except ConflictError:
             # retry_on_conflict exhausted: heavy status contention this
             # round (workers reporting completions). Next tick re-reads.

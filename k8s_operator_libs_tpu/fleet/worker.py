@@ -156,6 +156,13 @@ class _ShardClaim:
         self.elector = elector
         self.preferred = preferred
         self.held = False
+        #: Observability (tpu_operator_fleet_*): lifetime transitions
+        #: into held, and the subset that were FAILOVER claims (a
+        #: non-preferred shard acquired — i.e. its preferred owner's
+        #: lease went stale and this worker stole it).
+        self.acquisitions = 0
+        self.failover_acquisitions = 0
+        self.losses = 0
         self._retry = retry_period_s
         self._deadline = renew_deadline_s
         self._probe = failover_probe_s
@@ -179,6 +186,9 @@ class _ShardClaim:
                     "worker %r claimed %s",
                     self.elector.config.identity, self.shard,
                 )
+                self.acquisitions += 1
+                if not self.preferred:
+                    self.failover_acquisitions += 1
             self.held = True
             self._last_success = now
         elif self.held and (
@@ -190,6 +200,7 @@ class _ShardClaim:
                 self.elector.config.identity, self.shard, self._deadline,
             )
             self.held = False
+            self.losses += 1
         return self.held
 
     def release(self) -> None:
@@ -353,6 +364,10 @@ class ShardWorker:
         self._rollout_raw: Optional[dict] = None
         self.passes = 0
         self.pools_reported_done = 0
+        #: Per-shard reconcile coverage (tpu_operator_fleet_*): how many
+        #: ticks each shard was reconciled under this worker's lease —
+        #: the per-shard pass-rate series the fleet exporter renders.
+        self.shard_passes: dict[str, int] = {s: 0 for s in self.shards}
 
     def _preferred_shards(self) -> frozenset:
         cfg = self.config
@@ -409,6 +424,20 @@ class ShardWorker:
     def owned_shards(self) -> frozenset:
         return frozenset(s for s, c in self._claims.items() if c.held)
 
+    def lease_stats(self) -> dict[str, int]:
+        """Lifetime lease-transition counters summed over this worker's
+        claims — the ``tpu_operator_fleet_*`` exporter's failover
+        signal (fleet/metrics.py)."""
+        return {
+            "acquisitions": sum(
+                c.acquisitions for c in self._claims.values()
+            ),
+            "failover_acquisitions": sum(
+                c.failover_acquisitions for c in self._claims.values()
+            ),
+            "losses": sum(c.losses for c in self._claims.values()),
+        }
+
     def granted_pools(self) -> frozenset:
         raw = self._rollout_raw
         if raw is None:
@@ -453,6 +482,8 @@ class ShardWorker:
         )
         self.mgr.apply_state(state, policy)
         self.passes += 1
+        for shard in held:
+            self.shard_passes[shard] = self.shard_passes.get(shard, 0) + 1
         stats.reconciled = True
         stats.state = state
         if self.config.rollout_name and self._rollout_raw is not None:

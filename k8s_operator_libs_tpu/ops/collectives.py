@@ -41,6 +41,33 @@ class CollectiveReport:
     error: str = ""
 
 
+@dataclass
+class LinkProbeReport:
+    """One timed neighbor exchange (ISSUE 12): a single ring hop,
+    ``src`` device -> ``dst`` device, exercised and timed ALONE so the
+    number attributes to ONE link instead of folding into the ring
+    aggregate. ``peer`` is the contract-side identifier the link map is
+    keyed by — the peer's node name on a multi-host gang (it then joins
+    the fleet topology fold), a local ``device-<id>`` tag otherwise."""
+
+    src: int
+    dst: int
+    peer: str
+    ok: bool
+    latency_s: float = 0.0
+    gbytes_per_s: float = 0.0
+    error: str = ""
+
+    def observation(self) -> dict:
+        """The per-hop observation shape
+        ``api.telemetry_v1alpha1.make_link_entries`` consumes."""
+        return {
+            "ok": self.ok,
+            "latency_s": self.latency_s,
+            "gbytes_per_s": self.gbytes_per_s,
+        }
+
+
 def _axis_size(mesh: Mesh, axis: str) -> int:
     return mesh.shape[axis]
 
@@ -227,6 +254,140 @@ def ppermute_ring(
         )
     except Exception as e:  # noqa: BLE001
         return CollectiveReport(op="ppermute_ring", ok=False, error=str(e))
+
+
+def default_peer_name(device) -> str:
+    """Contract-side peer id for a device with no caller-supplied
+    mapping: a stable local tag. Deliberately NOT a node name, so these
+    hops stay out of the fleet topology fold (they are intra-node
+    links; gang callers pass ``peer_of`` to resolve real node names)."""
+    return f"device-{device.id}"
+
+
+def make_peer_resolver(
+    member_names: Optional[list] = None,
+) -> tuple[Callable, Callable]:
+    """The ONE gang-side peer-id policy, shared by every battery shape
+    (the full gate and the quick battery must emit identical peer ids
+    or their maps stop joining on ``fold_link_topology``'s keys).
+    Returns ``(peer_of, owns_hop)``:
+
+    * ``peer_of(device)``: a cross-process destination resolves to
+      ``member_names[device.process_index]`` (gang rank -> node name,
+      the fleet fold's join key) when the rank is covered; local
+      devices — and uncovered ranks — keep the local
+      :func:`default_peer_name` tag (a wrong node name would poison
+      the fold; a device tag merely stays out of it);
+    * ``owns_hop(hop)``: True for hops whose SOURCE device this
+      process owns — each gang member publishes its own outgoing
+      links, so the fleet view assembles without double-publishing.
+    """
+    my_process = jax.process_index()
+    local_ids = {d.id for d in jax.local_devices()}
+
+    def peer_of(device) -> str:
+        if (
+            member_names is not None
+            and device.process_index != my_process
+            and 0 <= device.process_index < len(member_names)
+        ):
+            return str(member_names[device.process_index])
+        return default_peer_name(device)
+
+    def owns_hop(hop: "LinkProbeReport") -> bool:
+        return hop.src in local_ids
+
+    return peer_of, owns_hop
+
+
+def ppermute_per_link(
+    mesh: Mesh,
+    axis: str,
+    payload_mb: float = 1.0,
+    peer_of: Optional[Callable] = None,
+) -> list[LinkProbeReport]:
+    """Time each ring hop INDIVIDUALLY: one single-pair ppermute per
+    neighbor exchange (ISSUE 12; the observable-collectives shape,
+    PAPERS.md).
+
+    The whole-ring probe (:func:`ppermute_ring`) moves every link at
+    once, so one sick hop hides inside the aggregate — 15 healthy links
+    average it away. Here hop ``i -> (i+1) % n`` runs alone: only
+    device ``i`` sends, only its successor receives (ppermute zeroes
+    every shard the permutation does not target, which is also the
+    correctness oracle — exactly one shard must carry the payload,
+    everywhere else must be zero), and the timed wall-clock attributes
+    to that ONE link. Bandwidth = payload_bytes / median hop time, the
+    same convention as the ring probe's per-hop figure.
+
+    ``peer_of(device) -> str`` maps the hop's DESTINATION device to the
+    link-map peer id (a node name on a multi-host gang); default is the
+    local :func:`default_peer_name` tag. Per-hop failures degrade to a
+    failed report for that link, never raise — one dead hop must not
+    hide the health of the other n-1.
+    """
+    n = _axis_size(mesh, axis)
+    if n < 2:
+        return []
+    elems = max(1, int(payload_mb * 1e6 / 4))
+    payload_bytes = elems * 4
+    devices = list(mesh.devices.flat)
+    reports: list[LinkProbeReport] = []
+    base = np.arange(n * elems, dtype=np.float32)
+    for i in range(n):
+        j = (i + 1) % n
+        perm = [(i, j)]
+
+        def build(perm=perm):
+            @jax.jit
+            def hop(x):
+                def body(shard):
+                    return jax.lax.ppermute(shard, axis, perm)
+
+                return shard_map(
+                    body, mesh=mesh, in_specs=P(axis), out_specs=P(axis)
+                )(x)
+
+            return hop
+
+        src, dst = devices[i], devices[j]
+        peer = peer_of(dst) if peer_of is not None else default_peer_name(dst)
+        try:
+            hop = _cached("ppermute_link", mesh, axis, build, elems, i, j)
+            x = _put(mesh, axis, jnp.asarray(base))
+            elapsed = _timed(lambda: hop(x))
+            out = hop(x)
+            sent = base[i * elems:(i + 1) * elems]
+            ok = True
+            error = ""
+            for start, part in _local_parts(out):
+                if start == j * elems and len(part) == elems:
+                    if not np.array_equal(part, sent):
+                        ok = False
+                        error = f"hop {i}->{j}: payload corrupted"
+                elif np.any(part):
+                    ok = False
+                    error = f"hop {i}->{j}: leak into untargeted shard"
+            reports.append(
+                LinkProbeReport(
+                    src=src.id,
+                    dst=dst.id,
+                    peer=peer,
+                    ok=ok,
+                    latency_s=elapsed,
+                    gbytes_per_s=(
+                        payload_bytes / elapsed / 1e9 if elapsed > 0 else 0.0
+                    ),
+                    error=error,
+                )
+            )
+        except Exception as e:  # noqa: BLE001 - a dead hop is a verdict
+            reports.append(
+                LinkProbeReport(
+                    src=src.id, dst=dst.id, peer=peer, ok=False, error=str(e)
+                )
+            )
+    return reports
 
 
 def psum_bandwidth(

@@ -100,12 +100,23 @@ def run_checked_probe(
 @dataclass
 class QuickBatteryReport:
     """One quick-battery run in the telemetry plane's native shape:
-    per-check verdicts + numeric metrics (the ``(checks, metrics)``
-    arguments of ``api.telemetry_v1alpha1.make_node_health_report``)."""
+    per-check verdicts + numeric metrics + the per-neighbor link map
+    (the ``(checks, metrics, links)`` arguments of
+    ``api.telemetry_v1alpha1.make_node_health_report``)."""
 
     ok: bool
     checks: dict[str, bool] = field(default_factory=dict)
     metrics: dict[str, float] = field(default_factory=dict)
+    #: peer id -> {ok, latency_s, gbytes_per_s} (ops.collectives
+    #: LinkProbeReport.observation), ready for ReportPublisher.publish.
+    #: ``None`` = the link tier produced NO measurement this run
+    #: (disabled, single-device mesh, or the tier itself raised) —
+    #: distinct from a measured map, because the publisher's ``None``
+    #: carries the CR's existing link map forward while a Mapping
+    #: (empty included) REPLACES it; conflating "did not measure" with
+    #: "measured nothing" would erase the other tier's signal on every
+    #: blip.
+    links: Optional[dict[str, dict]] = None
     elapsed_s: float = 0.0
     error: str = ""
 
@@ -116,6 +127,9 @@ def quick_battery(
     payload_mb: float = 0.25,
     matmul_size: int = 256,
     run_matmul: bool = True,
+    probe_links: bool = True,
+    peer_of=None,
+    link_src_filter=None,
 ) -> QuickBatteryReport:
     """The cheap periodic probe tier (docs/fleet-telemetry.md): a
     sub-second graded measurement safe to run BESIDE live workloads,
@@ -131,6 +145,15 @@ def quick_battery(
     shows up as a sliding ``ring_gbytes_per_s`` long before the full
     gate's floors trip.
 
+    The per-hop link tier (ISSUE 12, ``probe_links``): every ring hop
+    is additionally exercised and timed ALONE (``ppermute_per_link``),
+    so the battery yields a per-neighbor link map — the signal the ring
+    aggregate provably averages away (one sick hop inside n-1 healthy
+    ones). ``peer_of`` maps destination devices to link-map peer ids
+    (node names on a gang); ``link_src_filter`` keeps only hops this
+    caller owns (a gang process publishes its own outgoing links, not
+    its peers').
+
     Failures degrade to verdicts, never raise — the battery runs inside
     monitoring loops that must outlive any probe blip.
     """
@@ -138,13 +161,16 @@ def quick_battery(
         METRIC_MXU_TFLOPS,
         METRIC_PROBE_LATENCY_S,
         METRIC_RING_GBYTES_PER_S,
+        METRIC_WORST_LINK_GBYTES_PER_S,
+        METRIC_WORST_LINK_LATENCY_S,
     )
-    from .collectives import psum_bandwidth
+    from .collectives import ppermute_per_link, psum_bandwidth
     from .matmul import mxu_probe
 
     start = time.perf_counter()
     checks: dict[str, bool] = {}
     metrics: dict[str, float] = {}
+    links: Optional[dict[str, dict]] = None
     error = ""
     try:
         if mesh is None:
@@ -160,6 +186,33 @@ def quick_battery(
     except Exception as e:  # noqa: BLE001 - a failed probe is a verdict
         checks["ring_allreduce"] = False
         error = str(e)
+    if probe_links and mesh is not None:
+        try:
+            hops = ppermute_per_link(
+                mesh, axis, payload_mb=payload_mb, peer_of=peer_of
+            )
+            if link_src_filter is not None:
+                hops = [h for h in hops if link_src_filter(h)]
+            if hops:
+                checks["links"] = all(h.ok for h in hops)
+                links = {h.peer: h.observation() for h in hops}
+                timed = [h for h in hops if h.ok and h.gbytes_per_s]
+                if timed:
+                    worst = min(timed, key=lambda h: h.gbytes_per_s)
+                    metrics[METRIC_WORST_LINK_GBYTES_PER_S] = round(
+                        worst.gbytes_per_s, 4
+                    )
+                    metrics[METRIC_WORST_LINK_LATENCY_S] = round(
+                        max(h.latency_s for h in timed), 6
+                    )
+                if not checks["links"] and not error:
+                    error = next(
+                        (h.error for h in hops if not h.ok), "link probe failed"
+                    )
+        except Exception as e:  # noqa: BLE001
+            checks["links"] = False
+            if not error:
+                error = str(e)
     if run_matmul:
         try:
             mxu = mxu_probe(size=matmul_size, use_pallas=False)
@@ -182,8 +235,47 @@ def quick_battery(
         ", ".join(f"{k}={v}" for k, v in sorted(metrics.items())),
     )
     return QuickBatteryReport(
-        ok=ok, checks=checks, metrics=metrics,
+        ok=ok, checks=checks, metrics=metrics, links=links,
         elapsed_s=elapsed, error=error,
+    )
+
+
+def slice_gang_quick_battery(
+    mesh=None,
+    axis: str = "x",
+    member_names: Optional[list] = None,
+    payload_mb: float = 0.25,
+    matmul_size: int = 256,
+) -> QuickBatteryReport:
+    """The quick battery in slice-gang shape (ISSUE 12): run over the
+    FULL multi-process mesh so the per-hop link tier times the
+    cross-host ICI links — the links a per-node quick battery never
+    touches — between the full gate's slice-gang batteries.
+
+    ``member_names`` maps gang rank -> node name (the slice gate's
+    sorted member list, the same ordering both sides derive); with it,
+    a cross-host hop's peer id is the peer HOST's node name, so the
+    published link map joins the fleet topology fold and both endpoints
+    of a sick cross-host link degrade. Hops to this process's own
+    devices keep local ``device-<id>`` tags. Only hops whose SOURCE
+    device is addressable here are reported — each gang member
+    publishes its own outgoing links, so the fleet view assembles from
+    per-node reports without double-publishing."""
+    from .collectives import make_peer_resolver
+
+    if mesh is None:
+        from ..parallel.mesh import single_axis_mesh
+
+        mesh = single_axis_mesh(axis)
+    peer_of, owns_hop = make_peer_resolver(member_names)
+    return quick_battery(
+        mesh=mesh,
+        axis=axis,
+        payload_mb=payload_mb,
+        matmul_size=matmul_size,
+        probe_links=True,
+        peer_of=peer_of,
+        link_src_filter=owns_hop,
     )
 
 
@@ -192,9 +284,9 @@ def run_quick_probe_cycle(
     battery: Optional[Callable[[], QuickBatteryReport]] = None,
 ) -> QuickBatteryReport:
     """One quick-probe publish cycle: run the battery (injectable for
-    tests and for pre-built meshes) and hand its observation to a
-    ``ReportPublisher`` (tpu/monitor.py). The glue the low-rate
-    DaemonSet/sidecar tier loops over."""
+    tests and for pre-built meshes) and hand its observation — link map
+    included — to a ``ReportPublisher`` (tpu/monitor.py). The glue the
+    low-rate DaemonSet/sidecar tier loops over."""
     report = battery() if battery is not None else quick_battery()
-    publisher.publish(report.checks, report.metrics)
+    publisher.publish(report.checks, report.metrics, links=report.links)
     return report

@@ -29,9 +29,12 @@ from collections import deque
 from typing import Mapping, Optional
 
 from ..api.telemetry_v1alpha1 import (
+    DEFAULT_HEALTHY_LINK_GBYTES_PER_S,
     DEFAULT_HEALTHY_RING_GBYTES_PER_S,
     DEFAULT_HISTORY_WINDOW,
     DEFAULT_LATENCY_BUDGET_S,
+    DEFAULT_LINK_LATENCY_BUDGET_S,
+    LINK_OK,
     NODE_HEALTH_REPORT_KIND,
     make_node_health_report,
     node_health_report_name,
@@ -183,6 +186,57 @@ class MonitorMetrics:
         return render_rows(self._PREFIX, label, rows)
 
 
+def tpu_chips_busy(client: Client, node_name: str, keys: UpgradeKeys) -> bool:
+    """True when any live workload pod on the node requests TPU chips.
+    Pods carrying the drain-skip label are excluded — the escape hatch
+    for auxiliary probe/diagnostic pods that hold chips briefly but
+    must not starve the monitor. Shared by both probe tiers: device
+    contention is indistinguishable from a dead link, so NO tier may
+    probe a busy node (the quick tier's tiny payloads still need
+    libtpu's exclusive device lock)."""
+    pods = client.list(
+        "Pod", field_selector=f"spec.nodeName={node_name}"
+    )
+    for obj in pods:
+        pod = Pod(obj.raw)
+        if pod.is_finished() or pod.deletion_timestamp is not None:
+            continue
+        if pod.labels.get(keys.skip_drain_pod_label) == TRUE_STRING:
+            continue
+        for container in pod.spec.get("containers") or []:
+            resources = container.get("resources") or {}
+            requests = resources.get("requests") or {}
+            limits = resources.get("limits") or {}
+            if TPU_RESOURCE in requests or TPU_RESOURCE in limits:
+                return True
+    return False
+
+
+def make_quick_probe_guard(
+    client: Client, node_name: str, keys: Optional[UpgradeKeys] = None
+):
+    """Skip-cycle predicate for the quick tier (``--quick-only``):
+    the SAME probe discipline as the full monitor — a skip-labeled node
+    is never probed, and chips held by live workloads skip the cycle
+    (a probe raced against a workload fails on device contention,
+    which would publish a falsely failing report and could quarantine
+    a healthy in-use node). Returns ``None`` (probe) or a skip
+    reason."""
+    keys = keys or UpgradeKeys(DeviceClass.tpu())
+
+    def guard() -> Optional[str]:
+        node_obj = client.get_or_none("Node", node_name)
+        if node_obj is not None:
+            node = Node(node_obj.raw)
+            if node.labels.get(keys.skip_label) == TRUE_STRING:
+                return "skip label set"
+        if tpu_chips_busy(client, node_name, keys):
+            return "TPU chips in use by workloads"
+        return None
+
+    return guard
+
+
 class ReportPublisher:
     """The telemetry half of the monitor (ISSUE 8): publish the
     structured probe battery as a ``NodeHealthReport`` CR
@@ -191,14 +245,17 @@ class ReportPublisher:
     * **rv-guarded** — read-modify-write carrying the live CR's
       resourceVersion, retried on Conflict (a second publisher tier —
       the quick battery — may race this one on the same report);
-    * **debounced** — an observation whose checks are unchanged and
-      whose score moved less than ``min_score_delta`` is skipped while
-      the previous one is younger than ``heartbeat_seconds``: steady
-      state costs one write per heartbeat, not one per probe cycle
-      (fleet-scale apiserver load, same argument as the condition
-      writer's write-nothing steady state);
-    * **windowed** — the CR carries a bounded rolling history, so the
-      derived trend survives publisher restarts.
+    * **debounced** — an observation whose checks are unchanged, whose
+      score moved less than ``min_score_delta`` AND whose graded
+      non-ok LINK set is unchanged is skipped while the previous one is
+      younger than ``heartbeat_seconds``: steady state costs one write
+      per heartbeat, not one per probe cycle (fleet-scale apiserver
+      load, same argument as the condition writer's write-nothing
+      steady state) — but a link newly grading degraded/failed, or one
+      recovering, always lands immediately;
+    * **windowed** — the CR carries a bounded rolling history (and
+      bounded per-link windows), so derived trends survive publisher
+      restarts.
     """
 
     def __init__(
@@ -211,6 +268,8 @@ class ReportPublisher:
         history_window: int = DEFAULT_HISTORY_WINDOW,
         healthy_ring_gbytes_per_s: float = DEFAULT_HEALTHY_RING_GBYTES_PER_S,
         latency_budget_s: float = DEFAULT_LATENCY_BUDGET_S,
+        healthy_link_gbytes_per_s: float = DEFAULT_HEALTHY_LINK_GBYTES_PER_S,
+        link_latency_budget_s: float = DEFAULT_LINK_LATENCY_BUDGET_S,
         now=time.time,
     ) -> None:
         self._client = client
@@ -221,13 +280,51 @@ class ReportPublisher:
         self._window = history_window
         self._healthy_ring = healthy_ring_gbytes_per_s
         self._latency_budget = latency_budget_s
+        self._healthy_link = healthy_link_gbytes_per_s
+        self._link_latency_budget = link_latency_budget_s
         self._now = now
 
+    @staticmethod
+    def _sick_links(entries: Optional[Mapping]) -> frozenset:
+        """The debounce key's link half: the set of (peer, verdict)
+        pairs grading non-ok. Keying on the FULL link map would defeat
+        the debounce on every healthy probe cycle (timings jitter);
+        keying on nothing would delay a sick-link transition behind the
+        heartbeat — the exact signal the per-link plane exists to
+        deliver promptly."""
+        if not entries:
+            return frozenset()
+        out = set()
+        for peer, entry in entries.items():
+            verdict = (
+                entry.get("verdict")
+                if isinstance(entry, Mapping)
+                else getattr(entry, "verdict", LINK_OK)
+            )
+            if verdict != LINK_OK:
+                out.add((str(peer), str(verdict)))
+        return frozenset(out)
+
     def publish(
-        self, checks: Mapping[str, bool], metrics: Mapping[str, float]
+        self,
+        checks: Mapping[str, bool],
+        metrics: Mapping[str, float],
+        links: Optional[Mapping[str, Mapping]] = None,
     ) -> bool:
-        """Create-or-update the node's report from one observation;
-        returns True when a write actually happened (False = debounced)."""
+        """Create-or-update the node's report from one observation
+        (``links`` is the per-hop map the probe tiers emit — peer ->
+        {ok, latency_s, gbytes_per_s}); returns True when a write
+        actually happened (False = debounced).
+
+        ``links`` semantics: a Mapping (empty included) means the link
+        tier RAN and measured exactly this neighbor set — it replaces
+        the CR's map. ``None`` means the tier did not run (a full gate
+        with ``--no-link-probes``, a checks-only publisher) — the live
+        CR's link map is carried forward VERBATIM, because this
+        publisher learned nothing about the links: erasing the other
+        tier's map would flip effective scores healthy every full-gate
+        cycle (premature quarantine release + a debounce-defeating
+        sick-set flap)."""
         observed_at = float(self._now())
         name = node_health_report_name(self._node)
 
@@ -235,6 +332,11 @@ class ReportPublisher:
             existing = self._client.get_or_none(NODE_HEALTH_REPORT_KIND, name)
             history = (
                 report_history(existing.raw) if existing is not None else []
+            )
+            previous = (
+                parse_node_health(existing.raw)
+                if existing is not None
+                else None
             )
             desired = make_node_health_report(
                 self._node,
@@ -246,9 +348,20 @@ class ReportPublisher:
                 history_window=self._window,
                 healthy_ring_gbytes_per_s=self._healthy_ring,
                 latency_budget_s=self._latency_budget,
+                links=links,
+                prior_links=previous.links if previous is not None else None,
+                healthy_link_gbytes_per_s=self._healthy_link,
+                link_latency_budget_s=self._link_latency_budget,
             )
+            if links is None and previous is not None and previous.links:
+                # Link tier absent this cycle: carry the live map
+                # forward (see publish docstring).
+                from ..api.telemetry_v1alpha1 import raw_link_entries
+
+                desired["status"]["links"] = raw_link_entries(
+                    previous.links
+                )
             if existing is not None:
-                previous = parse_node_health(existing.raw)
                 failing = {
                     k for k, v in desired["status"]["checks"].items() if not v
                 }
@@ -257,17 +370,20 @@ class ReportPublisher:
                     if previous is not None
                     else None
                 )
-                # Debounce on what matters: the FAILING-check set and the
-                # score. Comparing full check identity would let the two
-                # publisher tiers (full battery vs quick battery — they
-                # run different probe sets against one CR) defeat the
-                # debounce on every alternation even while the node is
-                # perfectly healthy.
+                # Debounce on what matters: the FAILING-check set, the
+                # score, and the graded non-ok LINK set. Comparing full
+                # check/link identity would let the two publisher tiers
+                # (full battery vs quick battery — they run different
+                # probe sets against one CR) defeat the debounce on
+                # every alternation even while the node is perfectly
+                # healthy.
                 if (
                     previously_failing is not None
                     and previously_failing == failing
                     and abs(previous.score - desired["status"]["score"])
                     < self._min_score_delta
+                    and self._sick_links(previous.links)
+                    == self._sick_links(desired["status"].get("links"))
                     and observed_at - previous.observed_at < self._heartbeat
                 ):
                     return False  # debounced: nothing new worth a write
@@ -307,9 +423,11 @@ class ReportPublisher:
         return bool(wrote)
 
     def publish_report(self, report: HealthReport) -> bool:
-        """Publish a full gate battery via its observation view."""
+        """Publish a full gate battery via its observation view — the
+        per-hop link map rides along when the battery carried one."""
         checks, metrics = report.observation()
-        return self.publish(checks, metrics)
+        links = report.links_observation()
+        return self.publish(checks, metrics, links=links or None)
 
 
 class TpuHealthMonitor:
@@ -430,26 +548,7 @@ class TpuHealthMonitor:
         return report
 
     def _chips_busy(self) -> bool:
-        """True when any live workload pod on the node requests TPU chips.
-        Pods carrying the drain-skip label are excluded — the escape hatch
-        for auxiliary probe/diagnostic pods that hold chips briefly but
-        must not starve the monitor."""
-        pods = self.client.list(
-            "Pod", field_selector=f"spec.nodeName={self.node_name}"
-        )
-        for obj in pods:
-            pod = Pod(obj.raw)
-            if pod.is_finished() or pod.deletion_timestamp is not None:
-                continue
-            if pod.labels.get(self.keys.skip_drain_pod_label) == TRUE_STRING:
-                continue
-            for container in pod.spec.get("containers") or []:
-                resources = container.get("resources") or {}
-                requests = resources.get("requests") or {}
-                limits = resources.get("limits") or {}
-                if TPU_RESOURCE in requests or TPU_RESOURCE in limits:
-                    return True
-        return False
+        return tpu_chips_busy(self.client, self.node_name, self.keys)
 
     def _publish(self, healthy: bool, report: HealthReport) -> None:
         """Write the condition (read-modify-write under optimistic lock)
@@ -500,6 +599,44 @@ class TpuHealthMonitor:
 
     def stop(self) -> None:
         self._stop.set()
+
+
+def run_quick_probe_loop(
+    publisher,
+    interval_seconds: float = 60.0,
+    once: bool = False,
+    battery=None,
+    stop_event: Optional[threading.Event] = None,
+    skip_cycle=None,
+) -> int:
+    """The quick-probe tier's daemon loop (``--quick-only``,
+    manifests/monitor-quickprobe-daemonset.yaml): one
+    ``run_quick_probe_cycle`` per cadence tick, outliving any probe
+    blip (a raising cycle is logged and the loop keeps its cadence —
+    the monitor convention). ``skip_cycle`` (``make_quick_probe_guard``)
+    is consulted first: a returned reason skips the tick entirely —
+    skip-labeled nodes and busy chips must not be probed, exactly like
+    the full monitor (a skipped cycle is not a failure). ``once`` runs
+    a single cycle and exits with the battery verdict (CronJob shape);
+    ``battery`` and ``stop_event`` are injectable for tests."""
+    from ..ops.probe_harness import run_quick_probe_cycle
+
+    stop = stop_event if stop_event is not None else threading.Event()
+    while True:
+        ok = False
+        try:
+            reason = skip_cycle() if skip_cycle is not None else None
+            if reason is not None:
+                log.info("quick-probe cycle skipped: %s", reason)
+                ok = True  # a skipped cycle is not a failed battery
+            else:
+                ok = run_quick_probe_cycle(publisher, battery=battery).ok
+        except Exception:  # noqa: BLE001 - the loop must outlive blips
+            log.exception("quick-probe cycle failed")
+        if once:
+            return 0 if ok else 1
+        if stop.wait(interval_seconds):
+            return 0
 
 
 def main(argv: Optional[list[str]] = None) -> int:
@@ -556,6 +693,20 @@ def main(argv: Optional[list[str]] = None) -> int:
         "telemetry plane, docs/fleet-telemetry.md) next to the condition",
     )
     parser.add_argument(
+        "--quick-only", action="store_true",
+        help="the low-rate quick-probe tier (ISSUE 12, "
+        "manifests/monitor-quickprobe-daemonset.yaml): run ONLY the "
+        "cheap quick battery (tiny-payload ring + per-hop link probes "
+        "+ small matmul — safe beside live workloads) on its own "
+        "cadence and publish NodeHealthReports; no full gate, no Node "
+        "condition writes. Implies --publish-reports.",
+    )
+    parser.add_argument(
+        "--quick-interval-seconds", type=float, default=60.0,
+        help="quick-probe cadence under --quick-only (the full "
+        "battery's --interval-seconds stays untouched)",
+    )
+    parser.add_argument(
         "--metrics-port", type=int, default=0,
         help="serve Prometheus probe metrics on this port (0 = off)",
     )
@@ -571,6 +722,29 @@ def main(argv: Optional[list[str]] = None) -> int:
     args = parser.parse_args(argv)
     if not args.node_name:
         parser.error("--node-name or $NODE_NAME is required")
+    if args.quick_only:
+        if args.metrics_port:
+            # Rejected loudly: the quick tier records no MonitorMetrics,
+            # so a silently dropped flag would read as a broken scrape.
+            parser.error(
+                "--metrics-port is not supported with --quick-only "
+                "(the quick tier's telemetry IS the NodeHealthReport)"
+            )
+        # The quick tier IS report publishing: without a report there
+        # is no output at all (it writes no condition).
+        client = RestClient.from_environment()
+        publisher = ReportPublisher(
+            client, args.node_name, source="quick-probe"
+        )
+        return run_quick_probe_loop(
+            publisher,
+            interval_seconds=args.quick_interval_seconds,
+            once=args.once,
+            # Full-monitor probe discipline: skip-labeled or busy-chip
+            # nodes are not probed (manifest RBAC grants nodes get +
+            # pods list for exactly this).
+            skip_cycle=make_quick_probe_guard(client, args.node_name),
+        )
     failure_threshold = args.failure_threshold
     success_threshold = 2
     if args.once and failure_threshold != 1:

@@ -36,7 +36,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Optional
 
-from ..api.telemetry_v1alpha1 import trend_value
+from ..api.telemetry_v1alpha1 import fold_link_topology, trend_value
 from ..api.upgrade_v1alpha1 import DriverUpgradePolicySpec
 from ..utils.log import get_logger
 from ..upgrade.common_manager import ClusterUpgradeState, NodeUpgradeState
@@ -90,6 +90,18 @@ class SliceAssessment:
     #: slice -> worst member trend (numeric: -1 degrading, 0 stable,
     #: 1 improving) — the tiebreak between equally scored slices.
     trends: dict[str, int] = field(default_factory=dict)
+    #: Per-link localization (ISSUE 12): slice -> worst INCIDENT-link
+    #: score over the symmetric topology fold
+    #: (``api.telemetry_v1alpha1.node_link_scores``). Distinct from
+    #: ``scores`` (per-node aggregates) because the aggregate provably
+    #: cannot localize a link: a sick hop between two hosts whose own
+    #: scalars read healthy lives ONLY here. Both endpoints' slices
+    #: degrade — a cross-slice link sickens both.
+    link_scores: dict[str, float] = field(default_factory=dict)
+    #: slice -> the worst incident link's (a, b) key — the planner
+    #: log's localization line ("which link made this slice roll
+    #: first").
+    worst_links: dict[str, tuple] = field(default_factory=dict)
 
     def budget(self, policy: DriverUpgradePolicySpec) -> tuple[int, int]:
         """Upgrade-start slots in SLICE units (shape parity with
@@ -117,11 +129,18 @@ class SliceAssessment:
     def effective_score(self, slice_id: str) -> float:
         """Ordering score: a monitor-flagged wounded slice reads 0 (a
         dead link outranks any graded degradation), otherwise the worst
-        member telemetry score, defaulting to fully healthy. This is the
-        ONE place the binary condition and the graded telemetry merge."""
+        of the member telemetry scores AND the worst incident LINK
+        score (ISSUE 12 — a sick link between two healthy hosts must
+        sicken the slice even though every per-node aggregate reads
+        100), defaulting to fully healthy. This is the ONE place the
+        binary condition, the graded telemetry, and the link topology
+        merge."""
         if slice_id in self.wounded:
             return 0.0
-        return self.scores.get(slice_id, 100.0)
+        return min(
+            self.scores.get(slice_id, 100.0),
+            self.link_scores.get(slice_id, 100.0),
+        )
 
     def ordered_candidates(self):
         """Degraded-first generalization of drain-the-wounded-first
@@ -156,6 +175,24 @@ def assess_slices(
         for ns in node_states:
             slices.setdefault(slice_of(ns.node), []).append((bucket, ns))
     out.total_slices = len(slices)
+    # Per-link localization (ISSUE 12): fold the fleet link topology
+    # once per assessment and pre-compute each node's worst incident
+    # link. The fold is symmetric — a link reported by only ONE
+    # endpoint (the asymmetric sick-link case) still lands on both —
+    # and O(total link entries), zero on a pool publishing no link
+    # maps.
+    node_links: dict[str, tuple[float, tuple]] = {}
+    if state.node_health:
+        from ..api.telemetry_v1alpha1 import LINK_VERDICT_SCORES
+
+        for key, obs in fold_link_topology(state.node_health).items():
+            link_score = LINK_VERDICT_SCORES.get(obs.verdict, 100.0)
+            if link_score >= 100.0:
+                continue  # healthy links never perturb the ordering
+            for endpoint in (obs.a, obs.b):
+                previous = node_links.get(endpoint)
+                if previous is None or link_score < previous[0]:
+                    node_links[endpoint] = (link_score, key)
     for slice_id, members in slices.items():
         for bucket, ns in members:
             if ns.node.unschedulable or not ns.node.is_ready():
@@ -173,6 +210,16 @@ def assess_slices(
                 out.trends[slice_id] = min(
                     trend, out.trends.get(slice_id, trend)
                 )
+            incident = node_links.get(ns.node.name)
+            if incident is not None:
+                # Worst incident link wins per slice; the whole slice
+                # carries it — the link's collective traffic is slice
+                # traffic, so the repair unit IS the slice.
+                link_score, link = incident
+                previous = out.link_scores.get(slice_id)
+                if previous is None or link_score < previous:
+                    out.link_scores[slice_id] = link_score
+                    out.worst_links[slice_id] = link
             if bucket not in (
                 UpgradeState.UNKNOWN,
                 UpgradeState.DONE,
@@ -232,10 +279,15 @@ def start_slices_within_budget(
         # Start the WHOLE slice: one disruption window per slice.
         for ns in startable:
             start_slice(ns)
+        sick_link = assessment.worst_links.get(slice_id)
         log.info(
-            "%s: slice %s started %d node(s)%s",
+            "%s: slice %s started %d node(s)%s%s",
             log_label, slice_id, len(startable),
             " (already disrupted)" if already_disrupted else "",
+            # The localization line: WHICH link made this slice order
+            # first (docs/ici-health-gate.md "Link localization").
+            f" (sick link {sick_link[0]}<->{sick_link[1]})"
+            if sick_link is not None else "",
         )
         if not already_disrupted:
             available -= 1

@@ -232,6 +232,11 @@ class SliceProbeGangManager:
             "--coordinator", coordinator,
             "--num-processes", str(len(members)),
             "--process-id", str(rank),
+            # Rank -> node-name mapping for the per-link tier (ISSUE
+            # 12): cross-host hops then publish NODE-name peers, the
+            # fleet topology fold's join key. Members are already the
+            # rank ordering (sorted by slice_members).
+            "--link-peers", ",".join(members),
         ]
         container["ports"] = [{"containerPort": spec.coordinator_port}]
         return pod

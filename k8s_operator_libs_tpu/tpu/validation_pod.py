@@ -80,6 +80,16 @@ class ValidationPodSpec:
     #: cache mount). Keep it under a root-owned parent — see
     #: health.HEALTH_CACHE_DIR for the threat model.
     compile_cache_dir: str = HEALTH_CACHE_DIR
+    #: Publish the battery as a NodeHealthReport CR from the probe pod
+    #: itself (ISSUE 12): the pod gets NODE_NAME via the downward API
+    #: and the health payload's ``--publish-report`` flag. This is the
+    #: production emitter for slice-gang CROSS-HOST link maps — gang
+    #: pods carry ``--link-peers``, so each rank's published report
+    #: holds its node's outgoing cross-host links with node-name peers
+    #: (the fleet topology fold's join key). Requires the pod's
+    #: ServiceAccount to grant the nodehealthreports surface (see
+    #: manifests/monitor-quickprobe-daemonset.yaml's ClusterRole).
+    publish_reports: bool = False
 
     @property
     def full_image(self) -> str:
@@ -108,12 +118,15 @@ class ValidationPodSpec:
             run_seq_parallel_probes=self.run_seq_parallel_probes,
             run_burnin=self.run_burnin,
         )
-        return [
+        command = [
             "python", "-m", "k8s_operator_libs_tpu.tpu.health",
             "--ready-file", READY_FILE,
             "--park",
             *gate.to_cli_args(),
         ]
+        if self.publish_reports:
+            command.append("--publish-report")
+        return command
 
 
 class ValidationPodManager:
@@ -151,6 +164,17 @@ class ValidationPodManager:
         # battery (~5 s warm); a driver bump changes the cache key and
         # recompiles once per node (health.py HEALTH_CACHE_DIR).
         env = []
+        if spec.publish_reports:
+            # --publish-report names the node via $NODE_NAME (downward
+            # API) — same contract as the monitor DaemonSet.
+            env.append(
+                {
+                    "name": "NODE_NAME",
+                    "valueFrom": {
+                        "fieldRef": {"fieldPath": "spec.nodeName"}
+                    },
+                }
+            )
         volume_mounts = []
         if spec.compile_cache_dir:
             pod.spec["volumes"] = [

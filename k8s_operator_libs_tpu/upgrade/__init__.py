@@ -5,7 +5,7 @@ from .checkpoint_manager import (
     CheckpointManager,
 )
 from .metrics import Histogram, MetricsServer, UpgradeMetrics, WireMetrics
-from .health_source import HealthMetrics, HealthSource
+from .health_source import HealthMetrics, HealthSource, LinkMetrics
 from .quarantine_manager import QuarantineManager
 from .task_runner import TaskRunner
 from .cordon_manager import CordonManager
@@ -82,6 +82,7 @@ __all__ = [
     "StateWriteError",
     "HealthMetrics",
     "HealthSource",
+    "LinkMetrics",
     "Histogram",
     "MetricsServer",
     "QuarantineManager",

@@ -12,7 +12,7 @@ from collections import defaultdict
 from dataclasses import dataclass, field
 from typing import Callable, Mapping, Optional, Sequence, TypeVar
 
-from ..api.telemetry_v1alpha1 import NodeHealth
+from ..api.telemetry_v1alpha1 import NodeHealth, effective_scores
 from ..api.upgrade_v1alpha1 import (
     CheckpointSpec,
     DrainSpec,
@@ -530,6 +530,13 @@ class CommonUpgradeManager:
                     ),
                 )
             return
+        # The link-topology fold runs ONCE per pass and is shared by
+        # the bucket walk's recovery checks and the admission scan —
+        # folding per quarantined node would put an O(reports + links)
+        # walk on the hot path Q times over.
+        eff_scores = (
+            effective_scores(state.node_health) if state.node_health else {}
+        )
         if node_states:
             # Inherit membership first so a restarted controller's gauge
             # covers nodes an earlier process quarantined.
@@ -538,21 +545,29 @@ class CommonUpgradeManager:
                 "quarantine",
                 node_states,
                 lambda ns: ns.node.name,
-                lambda ns: qm.evaluate(ns.node, spec, state.node_health),
+                lambda ns: qm.evaluate(
+                    ns.node, spec, state.node_health, scores=eff_scores
+                ),
             )
         if not state.node_health:
             return  # no telemetry plane, or no live reports: no candidates
         # Admission: idle (unknown/done) schedulable nodes whose score
         # crossed the threshold, worst first, within the SAME
         # unavailability budget the roll uses — quarantine can never
-        # cordon more than maxUnavailable allows. The health map is
-        # scanned FIRST (usually: nothing below threshold → return), so
-        # an all-healthy telemetry pool pays O(reports) per pass, never
-        # an O(idle-nodes) bucket walk — the settled path stays cheap.
+        # cordon more than maxUnavailable allows. Scores are LINK-AWARE
+        # (ISSUE 12, api.telemetry_v1alpha1.effective_scores): a node's
+        # effective score is the worst of its own aggregate and its
+        # worst incident link from the symmetric topology fold, so BOTH
+        # endpoints of a sick link become candidates — including one
+        # that never published a report (it appears only as a peer).
+        # The health map is scanned FIRST (usually: nothing below
+        # threshold → return), so an all-healthy telemetry pool pays
+        # O(reports + link entries) per pass, never an O(idle-nodes)
+        # bucket walk — the settled path stays cheap.
         degraded = {
-            name: health.score
-            for name, health in state.node_health.items()
-            if health.score < spec.unhealthy_score
+            name: score
+            for name, score in eff_scores.items()
+            if score < spec.unhealthy_score
         }
         if not degraded:
             return

@@ -29,9 +29,13 @@ import threading
 from typing import Callable, Mapping, Optional
 
 from ..api.telemetry_v1alpha1 import (
+    LINK_OK,
     METRIC_PROBE_LATENCY_S,
     NODE_HEALTH_REPORT_KIND,
+    LinkObservation,
     NodeHealth,
+    fold_link_topology,
+    link_verdict_value,
     parse_node_health,
     trend_value,
 )
@@ -41,7 +45,9 @@ from ..kube.objects import KubeObject
 from ..utils.log import get_logger
 from .metrics import (
     DEFAULT_LATENCY_BUCKETS,
+    DEFAULT_LINK_LATENCY_BUCKETS,
     Histogram,
+    merge_label,
     prom_label,
     render_rows,
     render_samples,
@@ -57,6 +63,23 @@ def report_node_name(obj: KubeObject) -> str:
     raw = obj.raw if isinstance(obj, KubeObject) else obj
     spec = raw.get("spec") or {}
     return spec.get("nodeName") or (raw.get("metadata") or {}).get("name", "")
+
+
+def report_concerned_nodes(obj) -> list:
+    """Every node one report concerns for DELTA purposes (ISSUE 12):
+    the reporting node itself plus every peer its link map names. A
+    link's health degrades BOTH endpoints (the symmetric topology
+    fold), so a link-map delta must dirty the peer too — a peer id
+    that is a local device tag rather than a node name dirty-marks a
+    nonexistent node, which reclassifies to zero entries (harmless by
+    design, and far cheaper than resolving peers against the store on
+    the informer thread)."""
+    raw = obj.raw if isinstance(obj, KubeObject) else obj
+    names = [report_node_name(obj)]
+    links = (raw.get("status") or {}).get("links")
+    if isinstance(links, Mapping):
+        names += [str(peer) for peer in links]
+    return names
 
 
 class HealthSource:
@@ -93,6 +116,8 @@ class HealthSource:
         self._updates = 0
         self._snapshot_version = -1
         self._snapshot: Mapping[str, NodeHealth] = {}
+        self._topology_version = -1
+        self._topology: Mapping[tuple, LinkObservation] = {}
         self._observers: list[Callable[[NodeHealth], None]] = []
         # Registered before start(): the seed list's ADDEDs flow through,
         # so the map is complete from the first sync on.
@@ -130,12 +155,17 @@ class HealthSource:
     # -- delta wiring ------------------------------------------------------
     def attach(self, snapshot_source) -> None:
         """Feed report deltas into an ``IncrementalSnapshotSource``'s
-        dirty set: each event dirties exactly the node the report names,
-        so a health-only delta is a one-node reclassification, never a
-        full rebuild (mark_dirty_on's empty-mapping degradation to a
-        full invalidation still backstops a nameless report)."""
+        dirty set: each event dirties the node the report names PLUS
+        every link-map peer (both endpoints of a link share its health
+        — the symmetric fold), so a health-only delta is a one-node
+        reclassification and a link-map delta reclassifies exactly the
+        link's endpoints — never a full rebuild. ``include_old`` covers
+        a peer DROPPED from the map: only the old object remembers the
+        node whose incident-link view just changed (mark_dirty_on's
+        empty-mapping degradation to a full invalidation still
+        backstops a nameless report)."""
         snapshot_source.mark_dirty_on(
-            self._informer, lambda obj: [report_node_name(obj)]
+            self._informer, report_concerned_nodes, include_old=True
         )
 
     def add_observer(self, fn: Callable[[NodeHealth], None]) -> None:
@@ -197,15 +227,50 @@ class HealthSource:
             self._updates += 1
 
     # -- reads (reconcile thread + scrapers) -------------------------------
+    def _snapshot_locked(self) -> tuple[Mapping[str, NodeHealth], int]:
+        """(memoized snapshot, its version) — caller holds the lock.
+        The pair is read atomically: topology memoization keys a fold
+        to the EXACT snapshot it folded, so snapshot and version must
+        never come from two lock regions (a concurrent advance between
+        them would install a stale fold under a newer version)."""
+        if self._snapshot_version != self._updates:
+            self._snapshot = dict(self._health)
+            self._snapshot_version = self._updates
+        return self._snapshot, self._snapshot_version
+
     def snapshot(self) -> Mapping[str, NodeHealth]:
         """Point-in-time node -> NodeHealth mapping. Memoized: the same
         object is returned until an event lands, so attaching it to
         every pass costs a counter compare on a settled pool."""
         with self._lock:
-            if self._snapshot_version != self._updates:
-                self._snapshot = dict(self._health)
-                self._snapshot_version = self._updates
-            return self._snapshot
+            return self._snapshot_locked()[0]
+
+    def link_topology(self) -> Mapping[tuple, LinkObservation]:
+        """The symmetric fleet link view over the current map
+        (``api.telemetry_v1alpha1.fold_link_topology``), memoized by the
+        same update counter as :meth:`snapshot` — a settled pool's
+        scrape re-serves the same fold with zero work. The fold itself
+        runs OUTSIDE the lock (pure function over the immutable
+        snapshot mapping), so a large fleet's fold never stalls the
+        informer thread's event intake."""
+        with self._lock:
+            # Snapshot and version read in ONE lock region: a fold must
+            # be installed under the version of the snapshot it ACTUALLY
+            # folded, or a concurrent advance between the two reads
+            # would cache a stale topology under the new version.
+            snapshot, version = self._snapshot_locked()
+            if self._topology_version == version:
+                return self._topology
+        topology = fold_link_topology(snapshot)
+        with self._lock:
+            # Ordered install: a slower fold of an OLDER snapshot must
+            # never overwrite a newer cached one (versions only grow).
+            # The stale folder still returns its own consistent fold.
+            if version > self._topology_version:
+                self._topology = topology
+                self._topology_version = version
+                return self._topology
+            return topology
 
     def health_of(self, node_name: str) -> Optional[NodeHealth]:
         with self._lock:
@@ -296,3 +361,86 @@ class HealthMetrics:
                  totals.get("budget_denied", 0)),
             ])
         return per_node + render_rows(_PREFIX, "", rows)
+
+
+_LINK_PREFIX = "tpu_operator_link"
+
+
+def link_label(obs: LinkObservation) -> str:
+    """One link's label set: both endpoints (canonical sorted order, so
+    A's and B's observations land on one series) through the shared
+    spec escaping."""
+    return merge_label(prom_label("a", obs.a), "b", obs.b)
+
+
+class LinkMetrics:
+    """The ``tpu_operator_link_*`` Prometheus family (ISSUE 12), served
+    by the existing ``MetricsServer`` beside :class:`HealthMetrics`:
+
+    * per-link gauges over the SYMMETRIC topology fold
+      (``HealthSource.link_topology``): ``gbytes_per_s{a=,b=}``,
+      ``latency_seconds{a=,b=}``, ``verdict{a=,b=}`` (-1 failed /
+      0 degraded / 1 ok) — one series per undirected link, worst
+      observation from either endpoint;
+    * fleet rollups: total links, non-ok links;
+    * ``hop_latency_seconds`` — a real histogram observed from every
+      link entry flowing through report updates (per-hop buckets:
+      healthy hops are micro-to-milliseconds, sick ones seconds).
+    """
+
+    def __init__(
+        self,
+        source: HealthSource,
+        latency_buckets=DEFAULT_LINK_LATENCY_BUCKETS,
+    ) -> None:
+        self._source = source
+        self._latency = Histogram(latency_buckets)
+        #: node -> last observed link map. Observer deliveries are
+        #: serialized on the informer thread, so no lock. A report
+        #: whose link entry is IDENTICAL to the last one seen (frozen
+        #: dataclass equality, windows included) is a carried-forward
+        #: map (links=None publishes, heartbeat refreshes) — not a new
+        #: measurement, and re-observing it would skew the histogram
+        #: toward whatever value happened to be frozen in the map.
+        self._last: dict[str, Mapping] = {}
+        source.add_observer(self._observe)
+
+    def _observe(self, health: NodeHealth) -> None:
+        previous = self._last.get(health.node_name)
+        self._last[health.node_name] = health.links
+        for peer, link in health.links.items():
+            if previous is not None and previous.get(peer) == link:
+                continue  # carried forward, not re-measured
+            if link.latency_s > 0:
+                self._latency.observe(link.latency_s)
+
+    def render(self) -> str:
+        topology = self._source.link_topology()
+        labeled = [
+            (link_label(obs), obs)
+            for key, obs in sorted(topology.items())
+        ]
+        per_link = render_samples(_LINK_PREFIX, [
+            ("gbytes_per_s", "gauge",
+             "Per-link bandwidth (worst observation from either "
+             "endpoint of the folded topology)",
+             [(label, round(obs.gbytes_per_s, 4)) for label, obs in labeled]),
+            ("latency_seconds", "gauge",
+             "Per-link hop latency (worst observation from either "
+             "endpoint)",
+             [(label, round(obs.latency_s, 6)) for label, obs in labeled]),
+            ("verdict", "gauge",
+             "Graded link verdict (-1 failed, 0 degraded, 1 ok)",
+             [(label, link_verdict_value(obs.verdict))
+              for label, obs in labeled]),
+        ])
+        return per_link + render_rows(_LINK_PREFIX, "", [
+            ("links", "gauge",
+             "Links in the folded fleet topology", len(topology)),
+            ("sick_links", "gauge",
+             "Links grading degraded or failed",
+             sum(1 for obs in topology.values() if obs.verdict != LINK_OK)),
+            ("hop_latency_seconds", "histogram",
+             "Per-hop link latencies reported through NodeHealthReports",
+             self._latency.snapshot()),
+        ])

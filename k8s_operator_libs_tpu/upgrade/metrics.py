@@ -104,6 +104,15 @@ DEFAULT_LATENCY_BUCKETS = (
     0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
 )
 
+#: Per-LINK latency buckets (tpu_operator_link_*): a single neighbor
+#: exchange is micro-to-milliseconds healthy and seconds when sick —
+#: the whole-battery buckets above would put every healthy hop in the
+#: first bucket and lose the distribution.
+DEFAULT_LINK_LATENCY_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.05, 0.1,
+    0.5, 1.0, 5.0,
+)
+
 
 class Histogram:
     """A Prometheus histogram: fixed cumulative buckets, observed under

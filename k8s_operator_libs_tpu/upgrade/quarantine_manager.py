@@ -39,7 +39,7 @@ import threading
 import time
 from typing import Mapping, Optional
 
-from ..api.telemetry_v1alpha1 import NodeHealth
+from ..api.telemetry_v1alpha1 import NodeHealth, effective_node_score
 from ..api.upgrade_v1alpha1 import QuarantineSpec
 from ..kube.objects import Node
 from ..utils.log import get_logger
@@ -155,9 +155,14 @@ class QuarantineManager:
         node: Node,
         spec: QuarantineSpec,
         health: Optional[Mapping[str, NodeHealth]],
+        scores: Optional[Mapping[str, float]] = None,
     ) -> None:
         """One pass over one quarantined node: handoff deadline first,
-        then the backoff-clocked health re-evaluation."""
+        then the backoff-clocked health re-evaluation. ``scores`` is
+        the pass-level ``effective_scores(health)`` map — the caller
+        computes the link-topology fold ONCE per pass and shares it
+        across the bucket walk and admission; without it this method
+        folds on demand (single-node callers, tests)."""
         now = int(self._now())
         keys = self._keys
         start_raw = node.annotations.get(keys.quarantine_start_annotation)
@@ -186,11 +191,20 @@ class QuarantineManager:
             recheck = 0  # corrupt clock: recheck now, re-arm below
         if now < recheck:
             return  # backing off; the bucket polls, so we re-enter later
-        entry = (health or {}).get(node.name)
-        if entry is not None and entry.score >= spec.recovery_score:
+        # Recovery reads the LINK-AWARE effective score (ISSUE 12): a
+        # node quarantined for a sick incident link must not rejoin on
+        # the strength of its own healthy aggregate while the link
+        # still grades sick — the peer's report holds it down exactly
+        # like its own would. Absence (None) is still not recovery.
+        entry = (
+            scores.get(node.name)
+            if scores is not None
+            else effective_node_score(node.name, health or {})
+        )
+        if entry is not None and entry >= spec.recovery_score:
             self.release(
                 node,
-                f"health score recovered to {entry.score:.1f} "
+                f"health score recovered to {entry:.1f} "
                 f"(>= {spec.recovery_score:.1f})",
             )
             return
@@ -213,7 +227,7 @@ class QuarantineManager:
             "node %s still unhealthy (score %s); next quarantine recheck "
             "in %ds",
             node.name,
-            f"{entry.score:.1f}" if entry is not None else "unreported",
+            f"{entry:.1f}" if entry is not None else "unreported",
             next_backoff,
         )
 

@@ -614,21 +614,33 @@ class IncrementalSnapshotSource(InformerSnapshotSource):
         self,
         informer: Informer,
         node_names: Callable[[KubeObject], Sequence[str]],
+        include_old: bool = False,
     ) -> None:
         """Feed deltas from an informer this source does not own (the
         requestor's NodeMaintenance watch, say) into the dirty set:
         ``node_names(obj)`` maps each event to the nodes it concerns.
+        ``include_old=True`` additionally maps the event's OLD object —
+        for watches whose objects NAME other nodes (a NodeHealthReport's
+        link-map peers): an entry dropped by the update still concerns
+        the node it used to name, and only the old object remembers it.
         An empty/failed mapping degrades to a full invalidation — an
         external delta must never be silently dropped."""
 
         def handler(event_type, obj, old) -> None:
-            try:
-                names = [n for n in (node_names(obj) or []) if n]
-            except Exception:  # noqa: BLE001 - mapping owns its errors
-                log.exception("mark_dirty_on mapping failed for %s", obj.name)
-                names = []
-            if names:
-                for name in names:
+            names = []
+            failed = False
+            for target in (obj, old if include_old else None):
+                if target is None:
+                    continue
+                try:
+                    names += [n for n in (node_names(target) or []) if n]
+                except Exception:  # noqa: BLE001 - mapping owns its errors
+                    log.exception(
+                        "mark_dirty_on mapping failed for %s", obj.name
+                    )
+                    failed = True
+            if names and not failed:
+                for name in dict.fromkeys(names):
                     self._mark_node(name)
             else:
                 self.invalidate()

@@ -317,7 +317,17 @@ class TestShardScope:
             sim.set_template_hash("v2")
             for _ in range(120):
                 sim.step()
-                worker.tick(POLICY)
+                try:
+                    worker.tick(POLICY)
+                except BuildStateError:
+                    # The documented tick contract: reconcile errors
+                    # propagate and "the caller's loop owns retry
+                    # policy". A completeness check racing an in-flight
+                    # kubelet pod delivery aborts THIS pass; the next
+                    # iteration's full rebuild resumes (same tolerance
+                    # as drive_fleet above and incremental-state
+                    # settle()).
+                    pass
                 sim.step()
                 clock.advance(0.6)
                 if all(

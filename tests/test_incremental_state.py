@@ -435,7 +435,7 @@ class TestTelemetryDeltas:
     the node its report names — never a full rebuild — and a pool with
     no telemetry configured pays zero for the feature."""
 
-    def _publish(self, cluster, node, score_bad):
+    def _publish(self, cluster, node, score_bad, links=None):
         from k8s_operator_libs_tpu.tpu.monitor import ReportPublisher
 
         metrics = (
@@ -444,7 +444,7 @@ class TestTelemetryDeltas:
             else {"ring_gbytes_per_s": 45.0, "probe_latency_s": 2.0}
         )
         ReportPublisher(cluster, node, heartbeat_seconds=0.0).publish(
-            {"ring_allreduce": not score_bad}, metrics
+            {"ring_allreduce": not score_bad}, metrics, links=links
         )
 
     def test_health_only_delta_is_one_node_no_full_rebuild(self):
@@ -464,6 +464,92 @@ class TestTelemetryDeltas:
             assert stats.nodes_reclassified == 1
             assert state.dirty_nodes == frozenset({"node-5"})
             assert state.node_health["node-5"].score < 50.0
+        finally:
+            health.stop()
+            source.stop()
+
+    def test_link_only_delta_reclassifies_exactly_both_endpoints(self):
+        """ISSUE 12: a report delta whose only change is the LINK MAP
+        dirties the reporting node AND the named peer — a link's health
+        belongs to both endpoints (the symmetric topology fold), so the
+        peer's effective classification context changed too — and
+        nothing else: two reclassifications, never a full rebuild."""
+        cluster, sim = build_cluster(node_count=8)
+        mgr, source = incremental_manager(cluster)
+        health = mgr.with_health_telemetry()
+        try:
+            # Baseline report WITHOUT a link map, fully consumed.
+            self._publish(cluster, "node-5", score_bad=False)
+            settle(cluster, sim, mgr, source)
+            assert wait_until(lambda: health_caught_up(health, cluster))
+            mgr.apply_state(mgr.build_state(NS, LABELS), POLICY)
+            assert wait_until(lambda: not source.dirty().nodes)
+            # The link-only delta: same checks, same score, one
+            # degraded link entry naming node-2.
+            self._publish(
+                cluster, "node-5", score_bad=False,
+                links={"node-2": {"ok": True, "latency_s": 5.0,
+                                  "gbytes_per_s": 1.0}},
+            )
+            assert wait_until(
+                lambda: source.dirty().nodes >= {"node-5", "node-2"}
+            )
+            assert wait_until(lambda: health_caught_up(health, cluster))
+            state = mgr.build_state(NS, LABELS)
+            stats = mgr.last_pass_stats
+            assert not stats.full_rebuild, (
+                "a link-map delta must flow the incremental path"
+            )
+            assert state.dirty_nodes == frozenset({"node-5", "node-2"})
+            assert stats.nodes_reclassified == 2
+            # The consumer-side fold sees both endpoints degraded.
+            from k8s_operator_libs_tpu.api import effective_scores
+
+            eff = effective_scores(state.node_health)
+            assert eff["node-5"] == eff["node-2"] == 40.0
+        finally:
+            health.stop()
+            source.stop()
+
+    def test_link_peer_dropout_redirties_the_old_peer(self):
+        """A peer REMOVED from the link map is still a delta for that
+        peer (its incident-link view changed — only the old object
+        names it): mark_dirty_on's include_old path."""
+        cluster, sim = build_cluster(node_count=8)
+        mgr, source = incremental_manager(cluster)
+        health = mgr.with_health_telemetry()
+        try:
+            self._publish(
+                cluster, "node-5", score_bad=False,
+                links={"node-2": {"ok": True, "latency_s": 5.0,
+                                  "gbytes_per_s": 1.0}},
+            )
+            settle(cluster, sim, mgr, source)
+            assert wait_until(lambda: health_caught_up(health, cluster))
+            mgr.apply_state(mgr.build_state(NS, LABELS), POLICY)
+            assert wait_until(lambda: not source.dirty().nodes)
+            # The link heals by VANISHING (re-cabled, next battery maps
+            # a different neighbor set): node-2 must be re-dirtied so
+            # its effective recovery is observed.
+            self._publish(
+                cluster, "node-5", score_bad=False,
+                links={"node-3": {"ok": True, "latency_s": 0.001,
+                                  "gbytes_per_s": 42.0}},
+            )
+            assert wait_until(
+                lambda: source.dirty().nodes
+                >= {"node-5", "node-2", "node-3"}
+            )
+            assert wait_until(lambda: health_caught_up(health, cluster))
+            state = mgr.build_state(NS, LABELS)
+            assert not mgr.last_pass_stats.full_rebuild
+            from k8s_operator_libs_tpu.api import effective_scores
+
+            # Recovered via dropout: no incident link names node-2 any
+            # more, so its effective score defaults back to healthy
+            # (absence of telemetry is not a verdict).
+            eff = effective_scores(state.node_health)
+            assert eff.get("node-2", 100.0) == 100.0
         finally:
             health.stop()
             source.stop()
@@ -548,9 +634,24 @@ class TestTelemetryDeltas:
                 cluster.update(node)
 
             def health_create_or_update(_):
+                # Half the reports carry a link map (ISSUE 12) — sick
+                # or healthy, against a random peer — so link-map
+                # deltas (peer dirty-marks included) interleave with
+                # every other event class under the equivalence check.
+                links = None
+                if rng.random() < 0.5:
+                    sick = rng.random() < 0.5
+                    links = {
+                        f"node-{rng.randrange(6)}": {
+                            "ok": True,
+                            "latency_s": 5.0 if sick else 0.001,
+                            "gbytes_per_s": 1.0 if sick else 42.0,
+                        }
+                    }
                 self._publish(
                     cluster, f"node-{rng.randrange(6)}",
                     score_bad=rng.random() < 0.5,
+                    links=links,
                 )
 
             def health_delete(_):

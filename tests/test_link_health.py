@@ -1,0 +1,917 @@
+"""Per-link health plane (ISSUE 12, docs/fleet-telemetry.md "Per-link
+schema" + docs/ici-health-gate.md "Link localization").
+
+The contract under test:
+
+* **grading** (api/telemetry_v1alpha1.grade_link): failed transport =
+  failed; collapsed bandwidth / ballooned latency = degraded; missing
+  numbers never read sick;
+* **CR round trip**: link maps serialize with graded verdicts and
+  bounded per-link rolling windows, parse defensively, and peers drop
+  out when no longer observed;
+* **symmetric topology fold** (fold_link_topology / node_link_scores /
+  effective_scores): one ASYMMETRIC observation degrades BOTH
+  endpoints — including an endpoint that never published a report;
+  disagreeing endpoints take the worst observation;
+* **publisher debounce** extends to the graded non-ok link SET: a link
+  transition (sick or recovered) always writes; healthy link jitter
+  stays debounced;
+* **probes**: ppermute_per_link times each hop alone and the quick
+  battery surfaces the map; the full gate carries it into
+  HealthReport.observation()/links_observation();
+* **planner**: a sick link orders its slice first while every per-node
+  aggregate reads identically healthy (the localization the scalar
+  score provably cannot do); a cross-slice link degrades both slices;
+* **quarantine**: both endpoints of a sick link are admission
+  candidates and recovery requires the LINK healthy, not just the
+  node's own aggregate;
+* **fleet**: the aggregator's pool fold pairs cross-shard endpoint
+  reports and propagates link-degraded pools degraded-first; the
+  tpu_operator_fleet_* family renders worker/orchestrator counters.
+"""
+
+import threading
+
+from k8s_operator_libs_tpu.api import (
+    DriverUpgradePolicySpec,
+    LINK_DEGRADED,
+    LINK_FAILED,
+    LINK_OK,
+    NodeHealth,
+    QuarantineSpec,
+    effective_node_score,
+    effective_scores,
+    fold_link_topology,
+    grade_link,
+    link_key,
+    make_node_health_report,
+    node_link_scores,
+    parse_node_health,
+)
+from k8s_operator_libs_tpu.api import telemetry_v1alpha1 as telemetry
+from k8s_operator_libs_tpu.kube import FakeCluster
+from k8s_operator_libs_tpu.tpu.monitor import ReportPublisher
+from k8s_operator_libs_tpu.upgrade import (
+    ClusterUpgradeStateManager,
+    DeviceClass,
+    TaskRunner,
+    UpgradeKeys,
+)
+from k8s_operator_libs_tpu.utils import IntOrString
+from builders import make_node
+from test_telemetry import LABELS, NS, make_harness
+
+KEYS = UpgradeKeys(DeviceClass.tpu())
+
+SICK = {"ok": True, "latency_s": 5.0, "gbytes_per_s": 1.0}
+HEALTHY = {"ok": True, "latency_s": 0.001, "gbytes_per_s": 42.0}
+
+
+def publish(cluster, node, links=None, score_bad=False, **kwargs):
+    metrics = (
+        {"ring_gbytes_per_s": 1.0, "probe_latency_s": 120.0}
+        if score_bad
+        else {"ring_gbytes_per_s": 45.0, "probe_latency_s": 2.0}
+    )
+    return ReportPublisher(
+        cluster, node, heartbeat_seconds=0.0, **kwargs
+    ).publish({"ring_allreduce": not score_bad}, metrics, links=links)
+
+
+class TestGrading:
+    def test_verdict_thresholds(self):
+        assert grade_link(False, 0.001, 42.0) == LINK_FAILED
+        assert grade_link(True, 0.001, 42.0) == LINK_OK
+        # Below half the healthy bandwidth reference: degraded.
+        assert grade_link(True, 0.001, 10.0) == LINK_DEGRADED
+        # Past twice the per-hop latency budget: degraded.
+        assert grade_link(True, 3.0, 42.0) == LINK_DEGRADED
+        # Missing numbers are missing measurements, never sickness.
+        assert grade_link(True, 0.0, 0.0) == LINK_OK
+
+    def test_verdict_scores_cover_quarantine_thresholds(self):
+        """A degraded link must be able to quarantine its endpoints:
+        its score sits below the default admission threshold, and a
+        failed link below everything."""
+        assert telemetry.LINK_VERDICT_SCORES[LINK_FAILED] == 0.0
+        assert telemetry.LINK_VERDICT_SCORES[LINK_DEGRADED] < 50.0
+        assert telemetry.LINK_VERDICT_SCORES[LINK_OK] == 100.0
+
+
+class TestContractRoundTrip:
+    def test_links_serialize_graded_and_parse(self):
+        raw = make_node_health_report(
+            "a", {"ring_allreduce": True}, {},
+            links={"b": dict(SICK), "device-2": dict(HEALTHY)},
+        )
+        parsed = parse_node_health(raw)
+        assert parsed.links["b"].verdict == LINK_DEGRADED
+        assert parsed.links["b"].gbytes_per_s == 1.0
+        assert parsed.links["device-2"].verdict == LINK_OK
+        # The aggregate score stays link-BLIND by design: localization
+        # lives in the map, not the scalar.
+        assert parsed.score == 100.0
+        worst = parsed.worst_link()
+        assert worst is not None and worst.peer == "b"
+
+    def test_link_window_is_bounded_and_peers_drop_out(self):
+        prior = None
+        for i in range(telemetry.DEFAULT_LINK_WINDOW + 4):
+            raw = make_node_health_report(
+                "a", {}, {},
+                links={"b": {"ok": True, "latency_s": 0.001,
+                             "gbytes_per_s": 40.0 + i}},
+                prior_links=prior,
+            )
+            prior = parse_node_health(raw).links
+        window = prior["b"].window
+        assert len(window) == telemetry.DEFAULT_LINK_WINDOW
+        assert window[-1] == 40.0 + telemetry.DEFAULT_LINK_WINDOW + 3
+        # A peer absent from the new observation leaves the map —
+        # membership is observed, not accumulated.
+        raw = make_node_health_report(
+            "a", {}, {}, links={"c": dict(HEALTHY)}, prior_links=prior
+        )
+        assert set(parse_node_health(raw).links) == {"c"}
+
+    def test_parse_tolerates_malformed_links(self):
+        raw = make_node_health_report("a", {}, {})
+        raw["status"]["links"] = {
+            "b": {"latencyS": "nope", "gbytesPerS": []},
+            "c": "not-a-mapping",
+            "d": {"latencyS": 0.1, "gbytesPerS": 5.0,
+                  "verdict": "gibberish", "window": ["x", 1.5]},
+        }
+        parsed = parse_node_health(raw)
+        assert "b" not in parsed.links and "c" not in parsed.links
+        # Unknown verdict degrades to ok (absence of a grade is not
+        # sickness); unparseable window samples are dropped.
+        assert parsed.links["d"].verdict == LINK_OK
+        assert parsed.links["d"].window == (1.5,)
+
+
+class TestTopologyFold:
+    def test_asymmetric_observation_degrades_both_endpoints(self):
+        health = {
+            "a": parse_node_health(make_node_health_report(
+                "a", {}, {}, links={"b": dict(SICK)}
+            )),
+            "b": parse_node_health(make_node_health_report("b", {}, {})),
+        }
+        topology = fold_link_topology(health)
+        obs = topology[link_key("a", "b")]
+        assert obs.verdict == LINK_DEGRADED
+        assert obs.reporters == ("a",)
+        scores = node_link_scores(topology)
+        assert scores["a"] == scores["b"] == 40.0
+        eff = effective_scores(health)
+        assert eff["a"] == eff["b"] == 40.0
+
+    def test_disagreeing_endpoints_take_the_worst(self):
+        health = {
+            "a": parse_node_health(make_node_health_report(
+                "a", {}, {}, links={"b": dict(HEALTHY)}
+            )),
+            "b": parse_node_health(make_node_health_report(
+                "b", {}, {},
+                links={"a": {"ok": False, "latency_s": 0.0,
+                             "gbytes_per_s": 0.0}},
+            )),
+        }
+        obs = fold_link_topology(health)[link_key("a", "b")]
+        assert obs.verdict == LINK_FAILED
+        assert obs.reporters == ("a", "b")
+        # Worst on every axis: the healthy direction's bandwidth does
+        # not launder the failed one.
+        assert node_link_scores({obs.key: obs})["a"] == 0.0
+
+    def test_peer_only_node_gets_an_effective_score(self):
+        """An endpoint that never published a report still degrades —
+        only the peer's report names it."""
+        health = {
+            "a": parse_node_health(make_node_health_report(
+                "a", {}, {}, links={"ghost": dict(SICK)}
+            )),
+        }
+        assert effective_node_score("ghost", health) == 40.0
+        assert effective_node_score("unrelated", health) is None
+
+    def test_own_aggregate_and_link_fold_by_min(self):
+        health = {
+            "a": NodeHealth("a", score=20.0),
+            "b": parse_node_health(make_node_health_report(
+                "b", {}, {}, links={"a": dict(SICK)}
+            )),
+        }
+        eff = effective_scores(health)
+        assert eff["a"] == 20.0  # own aggregate is worse than the link
+        assert eff["b"] == 40.0  # link is worse than own aggregate
+
+
+class TestPublisherLinkDebounce:
+    def test_sick_link_transition_always_writes(self):
+        cluster = FakeCluster()
+        pub = ReportPublisher(cluster, "a", heartbeat_seconds=3600.0)
+        assert pub.publish({"x": True}, {}, links={"b": dict(HEALTHY)})
+        rv = cluster.get("NodeHealthReport", "a").resource_version
+        # Healthy link jitter: same ok verdict, different timings —
+        # debounced like any other steady-state observation.
+        assert not pub.publish(
+            {"x": True}, {},
+            links={"b": {"ok": True, "latency_s": 0.002,
+                         "gbytes_per_s": 41.0}},
+        )
+        assert cluster.get("NodeHealthReport", "a").resource_version == rv
+        # The link grades degraded: writes immediately.
+        assert pub.publish({"x": True}, {}, links={"b": dict(SICK)})
+        # Unchanged sick set: debounced again.
+        assert not pub.publish({"x": True}, {}, links={"b": dict(SICK)})
+        # Recovery is a transition too: writes immediately.
+        assert pub.publish({"x": True}, {}, links={"b": dict(HEALTHY)})
+
+    def test_linkless_publish_carries_the_map_forward(self):
+        """A publisher tier that ran NO link probes (links=None — the
+        full gate under --no-link-probes, a checks-only publisher) must
+        not erase the quick tier's link map: it learned nothing about
+        the links. Erasure would flip effective scores healthy every
+        full-gate cycle — premature quarantine release plus a
+        debounce-defeating sick-set flap."""
+        cluster = FakeCluster()
+        pub = ReportPublisher(cluster, "a", heartbeat_seconds=3600.0)
+        assert pub.publish({"x": True}, {}, links={"b": dict(SICK)})
+        # Checks-only steady state: the carried-forward map makes the
+        # sick set UNCHANGED, so this debounces entirely.
+        assert not pub.publish({"x": True}, {}, links=None)
+        parsed = parse_node_health(cluster.get("NodeHealthReport", "a").raw)
+        assert parsed.links["b"].verdict == LINK_DEGRADED
+        # A forced write (check flip) still preserves the map verbatim.
+        assert pub.publish({"x": False}, {}, links=None)
+        parsed = parse_node_health(cluster.get("NodeHealthReport", "a").raw)
+        assert parsed.links["b"].verdict == LINK_DEGRADED
+        assert parsed.links["b"].window == (1.0,)
+        # An EMPTY mapping is a measurement ("no neighbors"): replaces.
+        assert pub.publish({"x": False}, {}, links={})
+        parsed = parse_node_health(cluster.get("NodeHealthReport", "a").raw)
+        assert parsed.links == {}
+
+    def test_link_windows_survive_publisher_restarts(self):
+        cluster = FakeCluster()
+        assert publish(cluster, "a", links={"b": dict(SICK)})
+        # A NEW publisher (restart) appends to the CR's window.
+        assert publish(
+            cluster, "a",
+            links={"b": {"ok": True, "latency_s": 4.0,
+                         "gbytes_per_s": 1.5}},
+        )
+        parsed = parse_node_health(
+            cluster.get("NodeHealthReport", "a").raw
+        )
+        assert parsed.links["b"].window == (1.0, 1.5)
+
+
+class TestProbes:
+    def test_ppermute_per_link_times_each_hop(self):
+        import jax
+
+        from k8s_operator_libs_tpu.ops.collectives import ppermute_per_link
+        from k8s_operator_libs_tpu.parallel.mesh import single_axis_mesh
+
+        mesh = single_axis_mesh("x")
+        n = len(jax.devices())
+        hops = ppermute_per_link(mesh, "x", payload_mb=0.05)
+        assert len(hops) == n
+        assert all(h.ok for h in hops), [h.error for h in hops]
+        assert all(h.latency_s > 0 and h.gbytes_per_s > 0 for h in hops)
+        # One report per ring hop, each attributing to a distinct link.
+        assert len({(h.src, h.dst) for h in hops}) == n
+
+    def test_quick_battery_surfaces_link_map(self):
+        from k8s_operator_libs_tpu.ops.probe_harness import quick_battery
+
+        report = quick_battery(payload_mb=0.05, matmul_size=64)
+        assert report.checks.get("links") is True
+        assert report.links and all(
+            set(obs) == {"ok", "latency_s", "gbytes_per_s"}
+            for obs in report.links.values()
+        )
+        assert report.metrics["worst_link_gbytes_per_s"] > 0
+
+    def test_slice_gang_quick_battery_maps_peer_names(self):
+        """Single-process shape: every device is local, so peers keep
+        device tags (member_names only applies to OTHER processes) and
+        every hop is reported (all srcs local)."""
+        import jax
+
+        from k8s_operator_libs_tpu.ops.probe_harness import (
+            slice_gang_quick_battery,
+        )
+
+        report = slice_gang_quick_battery(
+            member_names=["this-host"], payload_mb=0.05, matmul_size=64
+        )
+        assert report.checks.get("links") is True
+        assert len(report.links) == len(jax.devices())
+        assert all(peer.startswith("device-") for peer in report.links)
+
+    def test_full_gate_report_carries_links(self):
+        from k8s_operator_libs_tpu.tpu.health import HealthReport, IciHealthGate
+
+        gate = IciHealthGate(
+            payload_mb=0.05, matmul_size=64, run_burnin=False,
+        )
+        report = gate.run()
+        assert report.links and all(h.ok for h in report.links)
+        checks, metrics = report.observation()
+        assert checks["links"] is True
+        assert metrics["worst_link_gbytes_per_s"] > 0
+        links = report.links_observation()
+        assert set(links) == {h.peer for h in report.links}
+        # The JSON round trip the subprocess gate rides.
+        import dataclasses
+
+        rebuilt = HealthReport.from_dict(dataclasses.asdict(report))
+        assert rebuilt.links_observation() == links
+
+    def test_gate_cli_args_round_trip_link_knobs(self):
+        from k8s_operator_libs_tpu.tpu.health import IciHealthGate
+
+        gate = IciHealthGate(
+            run_link_probes=False, link_peer_names=["h0", "h1"]
+        )
+        args = gate.to_cli_args()
+        assert "--no-link-probes" in args
+        assert args[args.index("--link-peers") + 1] == "h0,h1"
+
+    def test_quick_probe_loop_once_publishes(self):
+        from k8s_operator_libs_tpu.ops.probe_harness import QuickBatteryReport
+        from k8s_operator_libs_tpu.tpu.monitor import run_quick_probe_loop
+
+        cluster = FakeCluster()
+        pub = ReportPublisher(
+            cluster, "node-1", source="quick-probe", heartbeat_seconds=0.0
+        )
+        battery = lambda: QuickBatteryReport(  # noqa: E731 - tiny stub
+            ok=True,
+            checks={"ring_allreduce": True, "links": True},
+            metrics={"probe_latency_s": 0.1},
+            links={"peer-1": dict(SICK)},
+        )
+        rc = run_quick_probe_loop(pub, once=True, battery=battery)
+        assert rc == 0
+        parsed = parse_node_health(cluster.get("NodeHealthReport",
+                                               "node-1").raw)
+        assert parsed.links["peer-1"].verdict == LINK_DEGRADED
+
+    def test_failed_link_tier_does_not_erase_the_published_map(self):
+        """A quick cycle whose link tier produced NO measurement
+        (disabled, raised, single-device mesh — QuickBatteryReport.links
+        is None) must not erase the CR's existing link map: only a
+        MEASURED map (empty included) replaces it."""
+        from k8s_operator_libs_tpu.ops.probe_harness import (
+            QuickBatteryReport,
+            quick_battery,
+            run_quick_probe_cycle,
+        )
+
+        cluster = FakeCluster()
+        pub = ReportPublisher(cluster, "node-1", heartbeat_seconds=0.0)
+        run_quick_probe_cycle(pub, battery=lambda: QuickBatteryReport(
+            ok=True, checks={"ring_allreduce": True},
+            links={"peer-1": dict(SICK)},
+        ))
+        # Tier absent: links defaults to None — the map survives.
+        run_quick_probe_cycle(pub, battery=lambda: QuickBatteryReport(
+            ok=True, checks={"ring_allreduce": True},
+        ))
+        parsed = parse_node_health(cluster.get("NodeHealthReport",
+                                               "node-1").raw)
+        assert parsed.links["peer-1"].verdict == LINK_DEGRADED
+        # The real battery with the tier disabled reports None too.
+        report = quick_battery(
+            payload_mb=0.05, matmul_size=64, probe_links=False
+        )
+        assert report.links is None
+
+    def test_quick_probe_loop_outlives_blips_and_stops(self):
+        from k8s_operator_libs_tpu.tpu.monitor import run_quick_probe_loop
+
+        calls = {"n": 0}
+        stop = threading.Event()
+
+        def battery():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("transient probe blip")
+            stop.set()
+            from k8s_operator_libs_tpu.ops.probe_harness import (
+                QuickBatteryReport,
+            )
+
+            return QuickBatteryReport(ok=True, checks={"x": True})
+
+        cluster = FakeCluster()
+        pub = ReportPublisher(cluster, "node-1", heartbeat_seconds=0.0)
+        rc = run_quick_probe_loop(
+            pub, interval_seconds=0.01, battery=battery, stop_event=stop
+        )
+        assert rc == 0
+        assert calls["n"] == 2  # the raising cycle did not kill the loop
+
+
+class TestQuickProbeGuard:
+    def test_busy_or_skip_labeled_node_is_not_probed(self):
+        from k8s_operator_libs_tpu.kube import Pod
+        from k8s_operator_libs_tpu.tpu.libtpu import TPU_RESOURCE
+        from k8s_operator_libs_tpu.tpu.monitor import make_quick_probe_guard
+
+        cluster = FakeCluster()
+        cluster.create(make_node("node-1"))
+        guard = make_quick_probe_guard(cluster, "node-1")
+        assert guard() is None  # idle node: probe
+        # A live TPU workload on the node: device contention would make
+        # the battery publish a falsely failing report.
+        pod = Pod.new("workload", namespace="default")
+        pod.node_name = "node-1"
+        pod.spec["containers"] = [{
+            "name": "w",
+            "resources": {"requests": {TPU_RESOURCE: "4"}},
+        }]
+        cluster.create(pod)
+        assert guard() == "TPU chips in use by workloads"
+        cluster.delete("Pod", "workload", "default")
+        node = cluster.get("Node", "node-1")
+        from k8s_operator_libs_tpu.kube import Node as NodeObj
+
+        n = NodeObj(node.raw)
+        n.labels[KEYS.skip_label] = "true"
+        cluster.update(n)
+        assert guard() == "skip label set"
+
+    def test_skipped_cycle_publishes_nothing_and_is_not_a_failure(self):
+        from k8s_operator_libs_tpu.tpu.monitor import run_quick_probe_loop
+
+        cluster = FakeCluster()
+        pub = ReportPublisher(cluster, "node-1", heartbeat_seconds=0.0)
+
+        def battery():
+            raise AssertionError("battery must not run on a skipped cycle")
+
+        rc = run_quick_probe_loop(
+            pub, once=True, battery=battery, skip_cycle=lambda: "busy"
+        )
+        assert rc == 0
+        assert cluster.get_or_none("NodeHealthReport", "node-1") is None
+
+
+class TestGatePublishEntrypoint:
+    def test_validation_pod_spec_wires_publish_report(self):
+        from k8s_operator_libs_tpu.tpu.validation_pod import (
+            ValidationPodManager,
+            ValidationPodSpec,
+        )
+
+        spec = ValidationPodSpec(publish_reports=True)
+        assert "--publish-report" in spec.probe_command()
+        pod = ValidationPodManager(FakeCluster(), spec).build_pod("node-1")
+        (container,) = pod.spec["containers"]
+        env = {e["name"]: e for e in container["env"]}
+        assert (
+            env["NODE_NAME"]["valueFrom"]["fieldRef"]["fieldPath"]
+            == "spec.nodeName"
+        )
+        # Default shape unchanged: no flag, no NODE_NAME env.
+        default_pod = ValidationPodManager(
+            FakeCluster(), ValidationPodSpec()
+        ).build_pod("node-1")
+        (default_container,) = default_pod.spec["containers"]
+        assert "--publish-report" not in default_container["command"]
+        assert all(
+            e["name"] != "NODE_NAME" for e in default_container["env"]
+        )
+
+    def test_gang_pod_carries_publish_and_link_peers(self):
+        """The production cross-host emitter: a gang pod built from a
+        publish_reports spec carries BOTH --link-peers (node-name peer
+        ids) and --publish-report — each rank publishes its own
+        outgoing cross-host links."""
+        from k8s_operator_libs_tpu.tpu.slice_gate import (
+            SliceProbeGangManager,
+            SliceProbeSpec,
+        )
+
+        mgr = SliceProbeGangManager(
+            FakeCluster(), SliceProbeSpec(publish_reports=True)
+        )
+        pod = mgr.build_gang_pod("slice-1", 1, 0, ["host-a", "host-b"])
+        (container,) = pod.spec["containers"]
+        cmd = container["command"]
+        assert "--publish-report" in cmd
+        assert cmd[cmd.index("--link-peers") + 1] == "host-a,host-b"
+
+    def test_cli_requires_node_name(self):
+        import subprocess, sys, os
+
+        env = {k: v for k, v in os.environ.items() if k != "NODE_NAME"}
+        proc = subprocess.run(
+            [sys.executable, "-m", "k8s_operator_libs_tpu.tpu.health",
+             "--publish-report"],
+            capture_output=True, text=True, env=env, timeout=60,
+        )
+        assert proc.returncode == 2
+        assert "NODE_NAME" in proc.stderr
+
+
+class TestLinkMetricsDedup:
+    def test_carried_forward_map_is_not_reobserved(self):
+        """A checks-only publish carries the link map forward verbatim;
+        the informer re-delivers it, but the histogram must count each
+        MEASUREMENT once — not once per write."""
+        from k8s_operator_libs_tpu.upgrade import HealthSource, LinkMetrics
+        from test_informer import wait_until
+
+        cluster = FakeCluster()
+        pub = ReportPublisher(cluster, "a", heartbeat_seconds=0.0)
+        source = HealthSource(cluster)
+        metrics = LinkMetrics(source)
+        with source:
+            assert pub.publish({"x": True}, {}, links={"b": dict(SICK)})
+            assert pub.publish({"x": False}, {}, links=None)  # carry
+            assert pub.publish({"x": True}, {}, links=None)  # carry
+            assert wait_until(lambda: source.updates >= 3)
+            snap = metrics._latency.snapshot()
+            assert snap["count"] == 1  # one measurement, three writes
+            # A re-MEASURED link observes again.
+            assert pub.publish(
+                {"x": True}, {},
+                links={"b": {"ok": True, "latency_s": 4.0,
+                             "gbytes_per_s": 1.5}},
+            )
+            assert wait_until(
+                lambda: metrics._latency.snapshot()["count"] == 2
+            )
+
+
+class TestPlannerLinkLocalization:
+    def _link_pool(self):
+        from test_telemetry import TestDegradedFirstPlanning
+
+        return TestDegradedFirstPlanning()._mini_pool()
+
+    def test_sick_link_slice_rolls_first_despite_equal_aggregates(self):
+        from k8s_operator_libs_tpu.tpu import enable_slice_aware_planning
+
+        cluster, sim = self._link_pool()
+        # EVERY node publishes an identically healthy aggregate; only
+        # pool-c-0 carries a degraded link entry against pool-c-1.
+        for pool in ("pool-a", "pool-b", "pool-c"):
+            for i in range(2):
+                name = f"{pool}-{i}"
+                links = (
+                    {"pool-c-1": dict(SICK)}
+                    if name == "pool-c-0"
+                    else {f"{pool}-{1 - i}": dict(HEALTHY)}
+                )
+                publish(cluster, name, links=links)
+        mgr = ClusterUpgradeStateManager(
+            cluster, DeviceClass.tpu(), runner=TaskRunner(inline=True)
+        )
+        enable_slice_aware_planning(mgr)
+        source = mgr.with_health_telemetry()
+        try:
+            sim.set_template_hash("rev-2")
+            policy = DriverUpgradePolicySpec(
+                auto_upgrade=True, max_parallel_upgrades=1,
+                max_unavailable=IntOrString(1),
+            )
+            mgr.apply_state(mgr.build_state(NS, LABELS), policy)
+            mgr.apply_state(mgr.build_state(NS, LABELS), policy)
+            states = {
+                n.name: n.labels.get(KEYS.state_label, "")
+                for n in cluster.list("Node")
+            }
+            assert states["pool-c-0"] == "cordon-required"
+            assert states["pool-c-1"] == "cordon-required"
+            assert states["pool-a-0"] == "upgrade-required"
+            assert states["pool-b-0"] == "upgrade-required"
+        finally:
+            source.stop()
+
+    def test_cross_slice_link_degrades_both_slices(self):
+        from k8s_operator_libs_tpu.kube import Pod
+        from k8s_operator_libs_tpu.tpu import TpuNodeDetector
+        from k8s_operator_libs_tpu.tpu.planner import assess_slices
+        from k8s_operator_libs_tpu.upgrade import (
+            ClusterUpgradeState,
+            NodeUpgradeState,
+            UpgradeState,
+        )
+
+        state = ClusterUpgradeState()
+        for name in ("pool-a-0", "pool-b-0", "pool-c-0"):
+            state.node_states[UpgradeState.DONE].append(NodeUpgradeState(
+                node=make_node(name),
+                driver_pod=Pod.new(f"driver-{name}", namespace=NS),
+                driver_daemonset=None,
+            ))
+        state.node_health = {
+            "pool-a-0": parse_node_health(make_node_health_report(
+                "pool-a-0", {}, {}, links={"pool-b-0": dict(SICK)}
+            )),
+        }
+        out = assess_slices(TpuNodeDetector(), state)
+        # Both endpoint slices consult the worst incident link; the
+        # third slice stays fully healthy.
+        assert out.effective_score("pool-a-0") == 40.0
+        assert out.effective_score("pool-b-0") == 40.0
+        assert out.effective_score("pool-c-0") == 100.0
+        assert out.worst_links["pool-a-0"] == link_key(
+            "pool-a-0", "pool-b-0"
+        )
+        assert out.worst_links["pool-b-0"] == link_key(
+            "pool-a-0", "pool-b-0"
+        )
+
+    def test_no_link_maps_is_byte_identical_old_ordering(self):
+        from k8s_operator_libs_tpu.tpu.planner import SliceAssessment
+
+        assessment = SliceAssessment(
+            candidates={"a": [], "b": []},
+            scores={"a": 50.0},
+        )
+        assert assessment.effective_score("a") == 50.0
+        assert assessment.effective_score("b") == 100.0
+        assert assessment.link_scores == {}
+
+
+class TestQuarantineLinkAware:
+    POLICY = DriverUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=0,
+        max_unavailable=IntOrString("100%"),
+        quarantine=QuarantineSpec(
+            enable=True,
+            unhealthy_score=50.0,
+            recovery_score=70.0,
+            reprobe_backoff_seconds=1,
+        ),
+    )
+
+    def test_both_endpoints_quarantine_and_release_on_link_recovery(self):
+        import time as _time
+
+        cluster, sim, mgr = make_harness(nodes=4)
+        source = mgr.with_health_telemetry()
+        try:
+            for _ in range(3):  # settle: classify everyone to done
+                sim.step()
+                mgr.apply_state(mgr.build_state(NS, LABELS), self.POLICY)
+            # ONE asymmetric sick-link report; node-2's own report is
+            # fully healthy, node-1 never reports at all.
+            publish(cluster, "node-0", links={"node-1": dict(SICK)})
+            publish(cluster, "node-2", links={"node-3": dict(HEALTHY)})
+            from test_informer import wait_until
+
+            assert wait_until(lambda: source.updates >= 2)
+            mgr.apply_state(mgr.build_state(NS, LABELS), self.POLICY)
+            states = {
+                n.name: n.labels.get(KEYS.state_label, "")
+                for n in cluster.list("Node")
+            }
+            # Both endpoints of the sick link — including never-reported
+            # node-1 — quarantined; the healthy-link pair untouched.
+            assert states["node-0"] == "quarantined"
+            assert states["node-1"] == "quarantined"
+            assert states["node-2"] == "upgrade-done"
+            assert states["node-3"] == "upgrade-done"
+            # Recovery requires the LINK healthy: the reporter's own
+            # aggregate was always 100, so only the link transition can
+            # release.
+            publish(cluster, "node-0", links={"node-1": dict(HEALTHY)})
+            assert wait_until(lambda: source.updates >= 3)
+            deadline = _time.time() + 10.0
+            while True:
+                _time.sleep(0.3)  # let the 1 s recheck backoff expire
+                mgr.apply_state(mgr.build_state(NS, LABELS), self.POLICY)
+                totals = mgr.common.quarantine_manager.totals()
+                if totals["in_quarantine"] == 0:
+                    break
+                assert _time.time() < deadline, totals
+            assert all(
+                not (o.raw.get("spec") or {}).get("unschedulable")
+                for o in cluster.list("Node")
+            )
+        finally:
+            source.stop()
+
+
+class TestFleetLinkFold:
+    def test_cross_shard_link_pairs_in_the_merged_fold(self):
+        """The two endpoints of a cross-shard link live in DIFFERENT
+        sources; the pool fold must merge maps before folding topology
+        or the pair never meets."""
+        from k8s_operator_libs_tpu.fleet import FleetHealthAggregator
+
+        class StubSource:
+            def __init__(self, snap):
+                self._snap = snap
+
+            def snapshot(self):
+                return self._snap
+
+        a_report = parse_node_health(make_node_health_report(
+            "pool-1-n0", {}, {}, links={"pool-2-n0": dict(SICK)}
+        ))
+        b_report = parse_node_health(make_node_health_report(
+            "pool-2-n0", {}, {}
+        ))
+        agg = FleetHealthAggregator(
+            pool_of=lambda name: name.rsplit("-n", 1)[0]
+        )
+        agg.add_source(StubSource({"pool-1-n0": a_report}))
+        agg.add_source(StubSource({"pool-2-n0": b_report}))
+        health = agg.pool_health()
+        # BOTH pools degrade from the one asymmetric link observation.
+        assert health["pool-1"][0] == 40.0
+        assert health["pool-2"][0] == 40.0
+        assert agg.ordered(["pool-3", "pool-2", "pool-1"])[-1] == "pool-3"
+
+    def test_duplicate_node_merges_sicker_links_across_copies(self):
+        """Mid-failover a node appears in two sources. The merge is
+        PER AXIS: the lower aggregate score from one copy AND the
+        sicker link map from the other — picking one whole report
+        would discard whichever signal rode the losing copy."""
+        from k8s_operator_libs_tpu.fleet import FleetHealthAggregator
+
+        class StubSource:
+            def __init__(self, snap):
+                self._snap = snap
+
+            def snapshot(self):
+                return self._snap
+
+        stale = NodeHealth("p1-n0", score=95.0)  # lower score, no links
+        fresh = parse_node_health(make_node_health_report(
+            "p1-n0", {}, {},
+            links={"p2-n0": {"ok": False, "latency_s": 0.0,
+                             "gbytes_per_s": 0.0}},
+        ))  # score 100, FAILED link
+        agg = FleetHealthAggregator(
+            pool_of=lambda name: name.rsplit("-n", 1)[0]
+        )
+        agg.add_source(StubSource({"p1-n0": stale}))
+        agg.add_source(StubSource({"p1-n0": fresh}))
+        health = agg.pool_health()
+        # The failed link (score 0) survives the merge despite riding
+        # the higher-aggregate copy; the peer's pool degrades too.
+        assert health["p1"][0] == 0.0
+        assert health["p2"][0] == 0.0
+
+    def test_strict_pool_mapper_tolerates_device_tag_peers(self):
+        from k8s_operator_libs_tpu.fleet import FleetHealthAggregator
+
+        class StubSource:
+            def snapshot(self):
+                return {
+                    "n0": parse_node_health(make_node_health_report(
+                        "n0", {}, {}, links={"device-3": dict(SICK)}
+                    )),
+                }
+
+        def strict_pool_of(name):
+            if name.startswith("device-"):
+                raise KeyError(name)
+            return "pool-1"
+
+        agg = FleetHealthAggregator(pool_of=strict_pool_of)
+        agg.add_source(StubSource())
+        # The device-tag peer is skipped; its NODE endpoint still
+        # carries the degradation into the pool.
+        assert agg.pool_health() == {"pool-1": (40.0, 0)}
+
+    def test_mapper_failure_for_a_reported_node_stays_loud(self):
+        """The peer-only suppression must not swallow a mapper failure
+        for a node that PUBLISHED a report — silently dropping it would
+        hide a degraded pool from the fleet fold."""
+        import pytest
+
+        from k8s_operator_libs_tpu.fleet import FleetHealthAggregator
+
+        class StubSource:
+            def snapshot(self):
+                return {"n0": NodeHealth("n0", score=10.0)}
+
+        def broken_pool_of(name):
+            raise KeyError(name)
+
+        agg = FleetHealthAggregator(pool_of=broken_pool_of)
+        agg.add_source(StubSource())
+        with pytest.raises(KeyError):
+            agg.pool_health()
+
+
+class TestFleetMetricsFamily:
+    def test_renders_orchestrator_and_worker_counters(self):
+        from k8s_operator_libs_tpu.fleet import FleetMetrics
+
+        class StubOrchestrator:
+            grants_issued = 7
+            budget_denials = 3
+            ticks = 11
+            api_errors = 1
+            last_summary = {
+                "budget": 4, "granted": 3, "done": 2, "pending": 5,
+            }
+
+        class StubConfig:
+            identity = "worker-a"
+
+        class StubWorker:
+            config = StubConfig()
+            passes = 42
+            shard_passes = {"shard-00": 40, "shard-01": 2}
+
+            def owned_shards(self):
+                return frozenset({"shard-00", "shard-01"})
+
+            def lease_stats(self):
+                return {
+                    "acquisitions": 5,
+                    "failover_acquisitions": 2,
+                    "losses": 1,
+                }
+
+        metrics = FleetMetrics(orchestrator=StubOrchestrator())
+        metrics.add_worker(StubWorker())
+        text = metrics.render()
+        assert "tpu_operator_fleet_grants_total 7" in text
+        assert "tpu_operator_fleet_budget_denials_total 3" in text
+        # headroom = budget - (granted - done) = 4 - 1 = 3
+        assert "tpu_operator_fleet_budget_headroom 3" in text
+        assert (
+            'tpu_operator_fleet_worker_owned_shards{worker="worker-a"} 2'
+            in text
+        )
+        assert (
+            'tpu_operator_fleet_lease_failovers_total{worker="worker-a"} 2'
+            in text
+        )
+        assert (
+            'tpu_operator_fleet_shard_passes_total'
+            '{worker="worker-a",shard="shard-00"} 40' in text
+        )
+
+    def test_served_by_the_shared_metrics_server(self):
+        import urllib.request
+
+        from k8s_operator_libs_tpu.fleet import FleetMetrics
+        from k8s_operator_libs_tpu.upgrade import MetricsServer
+
+        with MetricsServer(FleetMetrics()) as server:
+            body = urllib.request.urlopen(server.url).read().decode()
+        assert body == ""  # no halves wired: an empty, valid exposition
+
+    def test_worker_lease_and_pass_counters_move(self):
+        """Drive a real 1-worker fleet tick loop far enough to see the
+        counters the exporter reads: acquisitions on claim, per-shard
+        pass counts on reconcile."""
+        from k8s_operator_libs_tpu.fleet import FleetWorkerConfig, ShardWorker
+
+        cluster = FakeCluster()
+        for i in range(4):
+            cluster.create(make_node(f"n{i}"))
+        from k8s_operator_libs_tpu.kube.sim import DaemonSetSimulator
+
+        sim = DaemonSetSimulator(
+            cluster, name="driver", namespace=NS, match_labels=LABELS
+        )
+        sim.settle()
+        clock = {"t": 1000.0}
+        worker = ShardWorker(
+            cluster,
+            FleetWorkerConfig(
+                identity="w1",
+                shards=2,
+                namespace=NS,
+                driver_labels=LABELS,
+                pool_of=lambda name: "pool-0",
+            ),
+            now_fn=lambda: clock["t"],
+            wall_fn=lambda: clock["t"],
+        )
+        policy = DriverUpgradePolicySpec(
+            auto_upgrade=True, max_parallel_upgrades=0,
+            max_unavailable=IntOrString("100%"),
+        )
+        with worker:
+            for _ in range(3):
+                worker.tick(policy)
+                clock["t"] += 3.0
+            stats = worker.lease_stats()
+            assert stats["acquisitions"] == 2  # both shards claimed once
+            assert stats["failover_acquisitions"] == 0  # all preferred
+            assert stats["losses"] == 0
+            assert worker.passes >= 2
+            owned_shard_passes = {
+                s: c for s, c in worker.shard_passes.items() if c
+            }
+            assert owned_shard_passes  # coverage series populated

@@ -218,6 +218,112 @@ class TestMonitorDaemonSet:
         )
 
 
+def quickprobe_docs():
+    path = os.path.join(MANIFESTS_ROOT, "monitor-quickprobe-daemonset.yaml")
+    with open(path) as fh:
+        return {d["kind"]: d for d in yaml.safe_load_all(fh) if d}
+
+
+class TestQuickProbeDaemonSet:
+    """The quick-probe tier as a real deployment shape (ISSUE 12,
+    ROADMAP 5b): the low-rate monitor DaemonSet running --quick-only,
+    publishing NodeHealthReports and nothing else — so its RBAC is
+    exactly the report surface, with no nodes/status write at all."""
+
+    def test_selector_matches_template_labels(self):
+        ds = quickprobe_docs()["DaemonSet"]
+        match = ds["spec"]["selector"]["matchLabels"]
+        labels = ds["spec"]["template"]["metadata"]["labels"]
+        assert match.items() <= labels.items()
+
+    def test_command_is_quick_only_monitor(self):
+        import importlib.util
+
+        ds = quickprobe_docs()["DaemonSet"]
+        (container,) = ds["spec"]["template"]["spec"]["containers"]
+        cmd = container["command"]
+        assert cmd[:3] == [
+            "python", "-m", "k8s_operator_libs_tpu.tpu.monitor"
+        ]
+        assert importlib.util.find_spec(cmd[2]) is not None
+        assert "--quick-only" in cmd
+        interval = float(cmd[cmd.index("--quick-interval-seconds") + 1])
+        # The tier's whole point is a cadence well below the full
+        # gate's 300 s interval.
+        assert 0 < interval < 300
+
+    def test_image_and_cache_match_full_monitor(self):
+        full = monitor_docs()["DaemonSet"]
+        quick = quickprobe_docs()["DaemonSet"]
+        (fc,) = full["spec"]["template"]["spec"]["containers"]
+        (qc,) = quick["spec"]["template"]["spec"]["containers"]
+        assert qc["image"] == fc["image"]  # one probe payload image
+        fenv = {e["name"]: e.get("value") for e in fc["env"]}
+        qenv = {e["name"]: e.get("value") for e in qc["env"]}
+        assert (
+            qenv["JAX_COMPILATION_CACHE_DIR"]
+            == fenv["JAX_COMPILATION_CACHE_DIR"]
+        )
+        assert (
+            qenv.get("NODE_NAME") is None  # downward API, not a literal
+        )
+
+    def test_rbac_is_exactly_the_report_surface(self):
+        """--quick-only publishes NodeHealthReports (get + create +
+        update/patch incl. status) plus the READ-ONLY probe-discipline
+        guard (get nodes for the skip label, list pods for the
+        busy-chip check) and touches nothing else — in particular no
+        nodes/status: the quick tier writes no conditions, and its
+        ClusterRole must not be able to."""
+        docs = quickprobe_docs()
+        rules = docs["ClusterRole"]["rules"]
+
+        def allows(resource, verb):
+            return any(
+                resource in r.get("resources", ())
+                and verb in r.get("verbs", ())
+                for r in rules
+            )
+
+        for verb in ("get", "create", "update", "patch"):
+            assert allows("nodehealthreports", verb)
+        assert allows("nodehealthreports/status", "update")
+        assert allows("nodes", "get")  # skip-label guard
+        assert allows("pods", "list")  # busy-chip guard
+        assert not allows("nodes/status", "update")
+        assert not allows("nodes", "update")
+        assert not allows("nodes", "patch")
+        binding = docs["ClusterRoleBinding"]
+        assert (
+            binding["roleRef"]["name"]
+            == docs["ClusterRole"]["metadata"]["name"]
+        )
+        (subject,) = binding["subjects"]
+        sa = docs["ServiceAccount"]
+        assert subject["name"] == sa["metadata"]["name"]
+        assert (
+            docs["DaemonSet"]["spec"]["template"]["spec"][
+                "serviceAccountName"
+            ]
+            == sa["metadata"]["name"]
+        )
+
+    def test_targets_tpu_nodes(self):
+        from k8s_operator_libs_tpu.parallel.topology import (
+            GKE_TPU_ACCELERATOR_LABEL,
+        )
+
+        ds = quickprobe_docs()["DaemonSet"]
+        pod = ds["spec"]["template"]["spec"]
+        terms = pod["affinity"]["nodeAffinity"][
+            "requiredDuringSchedulingIgnoredDuringExecution"
+        ]["nodeSelectorTerms"]
+        keys = {
+            expr["key"] for t in terms for expr in t["matchExpressions"]
+        }
+        assert GKE_TPU_ACCELERATOR_LABEL in keys
+
+
 class TestDockerfile:
     """`make image` produces the image the framework's pod shapes name;
     no container runtime exists in CI, so the build file is validated

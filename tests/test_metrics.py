@@ -187,6 +187,96 @@ class TestHistogram:
         assert merge_label("", "le", "+Inf") == '{le="+Inf"}'
 
 
+class TestLinkFamily:
+    """tpu_operator_link_* (ISSUE 12): the per-link family renders on
+    the shared exposition emitter, pinned BYTE-EXACT — the acceptance
+    contract for the link plane's scrape surface."""
+
+    def test_exposition_pinned_byte_exact(self):
+        from k8s_operator_libs_tpu.tpu.monitor import ReportPublisher
+        from k8s_operator_libs_tpu.upgrade import HealthSource, LinkMetrics
+
+        cluster = FakeCluster()
+        # One report, two links: a degraded cross-node hop and a
+        # healthy intra-node hop. Published BEFORE the source starts so
+        # the seed list delivers exactly one observation per link.
+        ReportPublisher(cluster, "a", heartbeat_seconds=0.0).publish(
+            {"ring_allreduce": True}, {},
+            links={
+                "b": {"ok": True, "latency_s": 5.0, "gbytes_per_s": 1.0},
+                "device-2": {"ok": True, "latency_s": 0.001,
+                             "gbytes_per_s": 42.0},
+            },
+        )
+        source = HealthSource(cluster)
+        metrics = LinkMetrics(source)
+        with source:
+            text = metrics.render()
+        assert text == (
+            "# HELP tpu_operator_link_gbytes_per_s Per-link bandwidth "
+            "(worst observation from either endpoint of the folded "
+            "topology)\n"
+            "# TYPE tpu_operator_link_gbytes_per_s gauge\n"
+            'tpu_operator_link_gbytes_per_s{a="a",b="b"} 1.0\n'
+            'tpu_operator_link_gbytes_per_s{a="a",b="device-2"} 42.0\n'
+            "# HELP tpu_operator_link_latency_seconds Per-link hop "
+            "latency (worst observation from either endpoint)\n"
+            "# TYPE tpu_operator_link_latency_seconds gauge\n"
+            'tpu_operator_link_latency_seconds{a="a",b="b"} 5.0\n'
+            'tpu_operator_link_latency_seconds{a="a",b="device-2"} 0.001\n'
+            "# HELP tpu_operator_link_verdict Graded link verdict "
+            "(-1 failed, 0 degraded, 1 ok)\n"
+            "# TYPE tpu_operator_link_verdict gauge\n"
+            'tpu_operator_link_verdict{a="a",b="b"} 0\n'
+            'tpu_operator_link_verdict{a="a",b="device-2"} 1\n'
+            "# HELP tpu_operator_link_links Links in the folded fleet "
+            "topology\n"
+            "# TYPE tpu_operator_link_links gauge\n"
+            "tpu_operator_link_links 2\n"
+            "# HELP tpu_operator_link_sick_links Links grading degraded "
+            "or failed\n"
+            "# TYPE tpu_operator_link_sick_links gauge\n"
+            "tpu_operator_link_sick_links 1\n"
+            "# HELP tpu_operator_link_hop_latency_seconds Per-hop link "
+            "latencies reported through NodeHealthReports\n"
+            "# TYPE tpu_operator_link_hop_latency_seconds histogram\n"
+            'tpu_operator_link_hop_latency_seconds_bucket{le="0.0001"} 0\n'
+            'tpu_operator_link_hop_latency_seconds_bucket{le="0.00025"} 0\n'
+            'tpu_operator_link_hop_latency_seconds_bucket{le="0.0005"} 0\n'
+            'tpu_operator_link_hop_latency_seconds_bucket{le="0.001"} 1\n'
+            'tpu_operator_link_hop_latency_seconds_bucket{le="0.0025"} 1\n'
+            'tpu_operator_link_hop_latency_seconds_bucket{le="0.005"} 1\n'
+            'tpu_operator_link_hop_latency_seconds_bucket{le="0.01"} 1\n'
+            'tpu_operator_link_hop_latency_seconds_bucket{le="0.05"} 1\n'
+            'tpu_operator_link_hop_latency_seconds_bucket{le="0.1"} 1\n'
+            'tpu_operator_link_hop_latency_seconds_bucket{le="0.5"} 1\n'
+            'tpu_operator_link_hop_latency_seconds_bucket{le="1"} 1\n'
+            'tpu_operator_link_hop_latency_seconds_bucket{le="5"} 2\n'
+            'tpu_operator_link_hop_latency_seconds_bucket{le="+Inf"} 2\n'
+            "tpu_operator_link_hop_latency_seconds_sum 5.001\n"
+            "tpu_operator_link_hop_latency_seconds_count 2\n"
+        )
+
+    def test_served_beside_health_family_over_http(self):
+        from k8s_operator_libs_tpu.tpu.monitor import ReportPublisher
+        from k8s_operator_libs_tpu.upgrade import HealthSource, LinkMetrics
+
+        cluster = FakeCluster()
+        ReportPublisher(cluster, "a", heartbeat_seconds=0.0).publish(
+            {"x": True}, {},
+            links={"b": {"ok": False, "latency_s": 0.0,
+                         "gbytes_per_s": 0.0}},
+        )
+        source = HealthSource(cluster)
+        metrics = LinkMetrics(source)
+        with source, MetricsServer(metrics) as server:
+            body = urllib.request.urlopen(
+                server.url, timeout=5
+            ).read().decode()
+        assert 'tpu_operator_link_verdict{a="a",b="b"} -1' in body
+        assert "tpu_operator_link_sick_links 1" in body
+
+
 class TestEndpoint:
     def test_metrics_served_over_http(self):
         _, sim, mgr = make_harness(nodes=2)
