@@ -615,3 +615,45 @@ def effective_node_score(
     report nor any incident link mentions it — absence of telemetry is
     not a verdict)."""
     return effective_scores(health).get(node_name)
+
+
+def sick_links_from_topology(
+    node_name: str, topology: Mapping[tuple, LinkObservation]
+) -> list[dict[str, Any]]:
+    """JSON-ready sick incident links of one node over an ALREADY
+    folded topology — per-node extraction is O(links), so callers
+    walking many nodes fold ONCE and extract per node
+    (``ClusterUpgradeState.sick_links_of`` memoizes the fold per
+    snapshot; the quarantine plane learned the same
+    one-fold-per-pass lesson in PR 12)."""
+    out: list[dict[str, Any]] = []
+    for obs in topology.values():
+        if node_name not in (obs.a, obs.b) or obs.verdict == LINK_OK:
+            continue
+        entry: dict[str, Any] = {
+            "peer": obs.b if obs.a == node_name else obs.a,
+            "verdict": obs.verdict,
+        }
+        if obs.gbytes_per_s > 0:
+            entry["gbytesPerS"] = round(obs.gbytes_per_s, 3)
+        if obs.latency_s > 0:
+            entry["latencyS"] = round(obs.latency_s, 6)
+        out.append(entry)
+    return sorted(out, key=lambda e: e["peer"])
+
+
+def sick_links_for(
+    node_name: str, health: Mapping[str, NodeHealth]
+) -> list[dict[str, Any]]:
+    """Sick incident links of one node over the FOLDED topology
+    (ROADMAP item 5 follow-on): the ``worstLinks`` payload the
+    requestor stamps into ``NodeMaintenance.spec.nodeHealth`` so an
+    external maintenance operator sees the same localization the
+    planner acts on — including a link only the PEER reported (the
+    asymmetric-observation rule of :func:`fold_link_topology`). Sorted
+    by peer name; empty when every incident link grades ok (absence of
+    link telemetry and all-healthy links are indistinguishable here —
+    the scalar score already carries "unmeasured" as its own absence).
+    One-shot convenience; loops over nodes should fold once and use
+    :func:`sick_links_from_topology`."""
+    return sick_links_from_topology(node_name, fold_link_topology(health))

@@ -42,6 +42,7 @@ from ..api.fleet_v1alpha1 import (
 )
 from ..api.telemetry_v1alpha1 import trend_value
 from ..kube.client import ApiError, Client, ConflictError
+from ..utils.faultpoints import fault_point
 from ..utils.log import get_logger
 
 log = get_logger("fleet.orchestrator")
@@ -255,6 +256,15 @@ class FleetOrchestrator:
                 seq += 1
                 set_pool_phase(raw, pool, POOL_GRANTED, grantedSeq=seq)
             status["grantsIssued"] = seq
+            act = fault_point(
+                "fleet.grant_write", rollout=self.rollout_name
+            )
+            if act is not None and act.exc is not None:
+                # Chaos fault point (docs/chaos-harness.md): the grant
+                # write fails at the one place a real apiserver would
+                # fail it — after the decision, before the ledger moved
+                # — so the retry path re-derives from a fresh read.
+                raise act.exc
             # Optimistic STATUS write (the ledger lives in the status
             # subresource — a plain update would have it stripped, the
             # real-apiserver behavior kube/fake.py mirrors): the read's

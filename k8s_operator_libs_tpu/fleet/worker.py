@@ -53,6 +53,7 @@ from ..upgrade.inplace import InplaceNodeStateManager
 from ..upgrade.snapshot import DEFAULT_RESYNC_PERIOD_S
 from ..upgrade.state_manager import ClusterUpgradeStateManager
 from ..upgrade.task_runner import TaskRunner
+from ..utils.faultpoints import fault_point
 from ..utils.log import get_logger
 from .hashring import HashRing
 from .scope import ShardScopedSnapshotSource
@@ -601,6 +602,17 @@ class ShardWorker:
             return []
 
         def report() -> None:
+            act = fault_point(
+                "fleet.status_write",
+                rollout=self.config.rollout_name,
+                identity=self.config.identity,
+            )
+            if act is not None and act.exc is not None:
+                # Chaos fault point (docs/chaos-harness.md): the
+                # pool-done report fails mid-protocol — completion must
+                # stay level-derived (re-reported next tick), never
+                # lost with the failed write.
+                raise act.exc
             obj = self.client.get(
                 FLEET_ROLLOUT_KIND, self.config.rollout_name
             )

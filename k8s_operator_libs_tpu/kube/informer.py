@@ -42,6 +42,7 @@ from typing import Callable, Mapping, Optional
 from .client import Client, WatchExpiredError
 from .objects import KubeObject, deep_copy_json, wrap
 from .selectors import parse_selector
+from ..utils.faultpoints import chaos_hold
 from ..utils.log import get_logger
 
 log = get_logger("kube.informer")
@@ -129,6 +130,11 @@ class Informer:
         #: test hook proving the delta path carried a repair.
         self.full_relists = 0
         self.delta_relists = 0
+        #: Chaos identity (docs/chaos-harness.md): the schedule driver
+        #: tags each worker's informers so a ``watch.deliver`` fault can
+        #: lag ONE consumer's stream while its peers stay current — the
+        #: watch-behind-the-ledger scenario. "" = untargetable.
+        self.chaos_tag = ""
         self._watch_handle = None
 
     # -- lifecycle ---------------------------------------------------------
@@ -674,6 +680,17 @@ class Informer:
                     self.kind, handle=self._watch_handle, **watch_kwargs
                 )
                 for event_type, obj in watch_iter:
+                    if stop.is_set():
+                        return
+                    # Chaos fault point: while a schedule holds this
+                    # informer's delivery, events queue UPSTREAM (the
+                    # watch generator is not pulled) and the store goes
+                    # stale — the lagging-stream scenario. Heal releases
+                    # them here in arrival order. No plan = no-op.
+                    chaos_hold(
+                        "watch.deliver", stop.is_set,
+                        kind=self.kind, tag=self.chaos_tag,
+                    )
                     if stop.is_set():
                         return
                     consecutive_failures = 0  # the stream delivered

@@ -43,6 +43,7 @@ from typing import Any, Callable, Optional
 
 from .client import ApiError, Client, ConflictError, NotFoundError
 from .objects import Lease
+from ..utils.faultpoints import fault_point
 
 log = logging.getLogger(__name__)
 
@@ -178,6 +179,13 @@ class LeaderElector:
         lease afterwards. Never raises on API errors (a flaky apiserver
         must surface as lost renewals, not a crashed elector)."""
         cfg = self.config
+        if fault_point("lease.round", name=cfg.name,
+                       identity=cfg.identity) is not None:
+            # Chaos fault point (docs/chaos-harness.md): the schedule
+            # fails this protocol round exactly as a lost update race
+            # would — the campaign's own retry/deadline machinery is
+            # what's under test, so the fault must enter through it.
+            return False
         try:
             lease = self._client.get("Lease", cfg.name, cfg.namespace)
         except NotFoundError:

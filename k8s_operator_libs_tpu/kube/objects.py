@@ -506,6 +506,21 @@ class NodeMaintenance(KubeObject):
         else:
             self.spec["nodeHealth"] = dict(value)
 
+    @property
+    def worst_links(self) -> list[dict[str, Any]]:
+        """Sick incident links riding ``spec.nodeHealth.worstLinks``
+        (ROADMAP item 5 follow-on; docs/fleet-telemetry.md): each entry
+        ``{"peer", "verdict", "gbytesPerS"?, "latencyS"?}`` from the
+        requestor's folded-topology localization — so the external
+        maintenance operator knows WHICH fabric link degraded the
+        score. Empty when the field is absent (no link telemetry, or
+        every incident link graded ok)."""
+        health = self.node_health or {}
+        links = health.get("worstLinks")
+        if not isinstance(links, list):
+            return []
+        return [dict(entry) for entry in links]
+
     def is_ready(self) -> bool:
         return condition_status(self.status, self.CONDITION_READY) == "True"
 

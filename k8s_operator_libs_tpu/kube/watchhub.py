@@ -48,6 +48,7 @@ from typing import Any, Mapping, Optional
 
 from .client import Client, WatchExpiredError
 from .objects import wrap
+from ..utils.faultpoints import OVERFLOW, fault_point, plan_active
 from ..utils.log import get_logger
 
 log = get_logger("kube.watchhub")
@@ -146,10 +147,33 @@ class _Upstream:
         A full buffer marks the subscriber stale and DROPS its buffer —
         the journal already holds everything past its cursor, so the
         self-resume replays exactly what the drop lost."""
+        # Consulted only for real frames with an ELIGIBLE (non-stale,
+        # non-expired) subscriber: a bookmark (raw None) can never
+        # overflow a buffer, an already-stale subscriber cannot
+        # overflow again before its self-resume, and a count-bounded
+        # fault must not have its fires eaten by frames the overflow
+        # cannot apply to. plan_active() first: the eligibility scan
+        # must cost production fan-out (no plan ever installed) one
+        # global read per frame, nothing more.
+        act = None
+        if plan_active() and raw is not None and any(
+            not s.stale and not s.expired for s in self.subscribers
+        ):
+            act = fault_point("watchhub.deliver", kind=self.key[0])
+        forced_overflow = act is not None and act.kind == OVERFLOW
         for sub in self.subscribers:
             if sub.stale or sub.expired:
                 continue
             if raw is None and not sub.allow_bookmarks:
+                continue
+            if forced_overflow:
+                # Chaos fault point (docs/chaos-harness.md): treat this
+                # frame as the one that overflowed every live buffer —
+                # the subscriber takes the SAME stale -> journal
+                # self-resume path a genuinely slow consumer takes, at
+                # a schedule-chosen moment (e.g. mid-grant-write).
+                sub.stale = True
+                sub.buffer.clear()
                 continue
             if len(sub.buffer) >= self.hub.buffer_limit:
                 sub.stale = True

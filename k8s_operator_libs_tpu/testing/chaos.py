@@ -1,0 +1,1131 @@
+"""Deterministic chaos harness — seeded fleet-scale fault SCHEDULES
+(ROADMAP item 6; docs/chaos-harness.md).
+
+The per-call failure-injection matrix (tests/test_failure_injection.py,
+test_fleet.py) proves each API surface absorbs one transient fault. The
+failures that break control planes at production scale are *schedules*:
+a worker dying between grant and pool-done, a lease stolen mid-apply, a
+watch stream lagging the grant ledger, a partition splitting the
+orchestrator from half its workers. This module drives the fleet e2e
+(fleet/worker.py + fleet/orchestrator.py over a FakeCluster or a
+LocalApiServer) under a **seeded, deterministic fault schedule** and
+asserts the global invariants under every interleaving explored:
+
+* **budget** — never more than ``maxUnavailablePools`` pools disrupted,
+  sampled every step;
+* **no grant retired unrolled** — every pool the ledger marks ``done``
+  is verifiably rolled (state label, schedulability, pod currency) at
+  the moment of the transition;
+* **no node lost** — the run ends with every node schedulable, ready,
+  and upgrade-done;
+* **completeness / incremental==full** — each surviving worker's
+  incremental book byte-agrees with a fresh full classification
+  (``ClusterUpgradeStateManager.audit_incremental``), and completeness
+  aborts stay a bounded counted signal
+  (``PassStats.aborted_completeness_races``), never a wedge.
+
+Determinism is an architecture, not a hope:
+
+* **virtual time** — one :class:`~..utils.faultpoints.ChaosClock` feeds
+  every elector/claim (``now_fn``/``wall_fn``) and the durable-clock
+  helpers (``faultpoints.wall_now``), advanced only by the driver:
+  lease expiry and deadline escalation happen when the schedule says,
+  not when the test host is slow;
+* **step-armed faults** — every fault is armed/disarmed at a schedule
+  step, never decided by a racing visit counter, so the decision
+  stream is a pure function of (seed, config);
+* **settle barriers** — after each step the driver waits until every
+  live informer's store byte-matches the cluster truth for its scope
+  and nothing is pending dispatch (held/lagged informers exempted
+  while their fault is armed), so the next step always starts from one
+  well-defined world.
+
+Same seed ⇒ same schedule JSON ⇒ same step trace ⇒ same final cluster
+state — pinned by a run-twice test (tests/test_chaos.py) and
+reproducible with one command::
+
+    python -m tools.chaos_run --seed S --schedule-json out.json
+
+This is the property-based *runtime* analogue of what ``tools/analyze``
+verifies statically (docs/static-analysis.md): the analyzer proves a
+policy cannot mutate the cluster; this harness proves the protocols
+converge when the cluster mutates under them.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import random
+import time as _time
+from dataclasses import asdict, dataclass, field
+from typing import Any, Callable, Mapping, Optional
+
+from ..api.fleet_v1alpha1 import (
+    FLEET_ROLLOUT_KIND,
+    POOL_DONE,
+    POOL_GRANTED,
+    make_fleet_rollout,
+    pools_in_phase,
+    rollout_spec,
+)
+from ..api.upgrade_v1alpha1 import (
+    CheckpointSpec,
+    DrainSpec,
+    DriverUpgradePolicySpec,
+)
+from ..kube.client import ApiError, Client, ConflictError
+from ..kube.fake import FakeCluster
+from ..kube.objects import KubeObject, Node
+from ..kube.sim import CheckpointingWorkloadSimulator, DaemonSetSimulator
+from ..upgrade.consts import DeviceClass, UpgradeKeys, UpgradeState
+from ..upgrade.state_manager import BuildStateError
+from ..utils import faultpoints
+from ..utils.faultpoints import (
+    DENY,
+    HOLD,
+    OVERFLOW,
+    RAISE,
+    ChaosClock,
+    FaultAction,
+)
+from ..utils.intstr import IntOrString
+from ..utils.log import get_logger
+
+log = get_logger("testing.chaos")
+
+#: The schedule-drivable fault points (ISSUE 13 acceptance): consulted
+#: in production code via ``utils.faultpoints.fault_point`` (the first
+#: five) or applied by the driver itself (the last two — process death
+#: and TCP teardown have no in-process consult site).
+POINT_LEASE = "lease"                # kube/leader.py protocol round
+POINT_GRANT_WRITE = "grant_write"    # fleet/orchestrator.py ledger write
+POINT_STATUS_WRITE = "status_write"  # fleet/worker.py pool-done report
+POINT_WATCH = "watch"                # kube/informer.py delivery hold
+POINT_HUB_REPLAY = "hub_replay"      # kube/watchhub.py forced overflow
+POINT_PARTITION = "partition"        # per-client request blackholing
+POINT_WORKER_KILL = "worker_kill"    # driver: stop + optional restart
+POINT_WIRE_KILL = "wire_kill"        # driver: LocalApiServer.kill_connections
+
+ALL_POINTS = (
+    POINT_LEASE, POINT_GRANT_WRITE, POINT_STATUS_WRITE, POINT_WATCH,
+    POINT_HUB_REPLAY, POINT_PARTITION, POINT_WORKER_KILL, POINT_WIRE_KILL,
+)
+
+SCHEDULE_VERSION = 1
+
+NS = "driver-ns"
+LABELS = {"app": "driver"}
+ROLLOUT = "chaos-roll"
+DEVICE = DeviceClass.tpu()
+KEYS = UpgradeKeys(DEVICE)
+ORCH_IDENTITY = "orchestrator"
+
+
+class ChaosServerTimeoutError(ApiError):
+    """The injected 504-shaped transient (the failure-injection matrix's
+    ServerTimeout, reproduced under schedule control)."""
+
+
+def pool_of(node_name: str) -> str:
+    return node_name.split("-")[0]
+
+
+# ---------------------------------------------------------------------------
+# Schedule: seeded fault specs, byte-stable JSON
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class FaultSpec:
+    """One scheduled fault: ``point`` armed for ``duration`` steps from
+    ``step``. ``target`` picks the participant (lease name, worker
+    identity); ``param`` narrows further (informer kind); ``error``
+    picks the injected exception for raise-points; ``count`` bounds how
+    many consults fire within the window (0 = every consult)."""
+
+    step: int
+    point: str
+    duration: int = 1
+    target: str = ""
+    param: str = ""
+    error: str = ""
+    count: int = 0
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, raw: Mapping[str, Any]) -> "FaultSpec":
+        return cls(**{k: raw[k] for k in (
+            "step", "point", "duration", "target", "param", "error", "count"
+        )})
+
+
+@dataclass
+class ChaosConfig:
+    """Fleet shape + schedule envelope. Everything that shapes the run
+    is HERE (echoed into the schedule JSON) so a schedule file is a
+    complete reproduction recipe."""
+
+    pools: int = 16
+    hosts: int = 1
+    workers: int = 2
+    shards: int = 4
+    budget: str = "25%"
+    max_steps: int = 0          # 0 = derived from pools
+    step_dt: float = 0.6
+    fault_window: int = 80      # faults arm within the first N steps
+    faults_min: int = 2
+    faults_max: int = 5
+    hub: bool = False           # co-hosted workers behind one WatchHub
+    checkpoint: bool = False    # checkpoint-coordinated drains + victims
+    checkpoint_timeout_s: int = 120
+    wire: bool = False          # run over a LocalApiServer (wire mode)
+
+    def resolved_max_steps(self) -> int:
+        return self.max_steps or (240 + 5 * self.pools)
+
+    def identities(self) -> list[str]:
+        return [f"w{i}" for i in range(self.workers)]
+
+    def pool_names(self) -> list[str]:
+        return [f"p{i}" for i in range(self.pools)]
+
+    def node_names(self) -> list[str]:
+        return [
+            f"{pool}-h{h}"
+            for pool in self.pool_names()
+            for h in range(self.hosts)
+        ]
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, raw: Mapping[str, Any]) -> "ChaosConfig":
+        return cls(**dict(raw))
+
+
+@dataclass
+class FaultSchedule:
+    seed: int
+    config: ChaosConfig
+    faults: list[FaultSpec] = field(default_factory=list)
+
+    def to_json(self) -> str:
+        """Byte-stable serialization: same schedule ⇒ same bytes (the
+        repro artifact ``tools/chaos_run.py --schedule-json`` writes)."""
+        return json.dumps(
+            {
+                "version": SCHEDULE_VERSION,
+                "seed": self.seed,
+                "config": self.config.to_dict(),
+                "faults": [f.to_dict() for f in self.faults],
+            },
+            sort_keys=True,
+        )
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultSchedule":
+        raw = json.loads(text)
+        if raw.get("version") != SCHEDULE_VERSION:
+            raise ValueError(
+                f"unsupported schedule version {raw.get('version')!r}"
+            )
+        return cls(
+            seed=int(raw["seed"]),
+            config=ChaosConfig.from_dict(raw["config"]),
+            faults=[FaultSpec.from_dict(f) for f in raw["faults"]],
+        )
+
+    def last_armed_step(self) -> int:
+        return max(
+            (f.step + max(1, f.duration) for f in self.faults), default=0
+        )
+
+
+def generate_schedule(seed: int, config: ChaosConfig) -> FaultSchedule:
+    """Draw a fault schedule from the seed — the ONLY source of
+    randomness in a chaos run (``random.Random(seed)``; the run itself
+    is deterministic). Drawn within convergence-safe envelopes: fault
+    windows close well before ``max_steps``, at most ``workers - 1``
+    workers are ever down at once, watch holds are short enough that
+    the fake's bounded per-watch queue (1024 events) cannot overflow at
+    the configured fleet size, and a restart never lands inside its own
+    worker's partition window (the restarted informers must sync)."""
+    rng = random.Random(seed)
+    cfg = config
+    points = [
+        POINT_LEASE, POINT_GRANT_WRITE, POINT_STATUS_WRITE,
+        POINT_WATCH, POINT_PARTITION, POINT_WORKER_KILL,
+    ]
+    if cfg.hub:
+        points.append(POINT_HUB_REPLAY)
+    if cfg.wire:
+        points.append(POINT_WIRE_KILL)
+    identities = cfg.identities()
+    faults: list[FaultSpec] = []
+    perma_killed: set[str] = set()
+    partition_windows: dict[str, list[tuple[int, int]]] = {}
+    kill_windows: dict[str, list[tuple[int, int]]] = {}
+
+    def overlaps(windows, step, duration):
+        return any(
+            step < end and start < step + duration
+            for start, end in windows
+        )
+
+    n_faults = rng.randint(cfg.faults_min, cfg.faults_max)
+    for _ in range(n_faults):
+        point = rng.choice(points)
+        step = rng.randint(2, max(3, cfg.fault_window))
+        if point == POINT_LEASE:
+            shard = rng.randrange(cfg.shards)
+            faults.append(FaultSpec(
+                step=step, point=point, duration=rng.randint(3, 12),
+                target=f"fleet-shard-{shard:02d}",
+            ))
+        elif point == POINT_GRANT_WRITE:
+            faults.append(FaultSpec(
+                step=step, point=point, duration=rng.randint(1, 6),
+                error=rng.choice(("conflict", "server_timeout")),
+                count=rng.randint(1, 4),
+            ))
+        elif point == POINT_STATUS_WRITE:
+            faults.append(FaultSpec(
+                step=step, point=point, duration=rng.randint(1, 6),
+                target=rng.choice(["", *identities]),
+                error=rng.choice(("conflict", "server_timeout")),
+                count=rng.randint(1, 4),
+            ))
+        elif point == POINT_WATCH:
+            # Short holds only: events queue upstream while held, and
+            # the fake's per-watch queue drops past 1024 — bound the
+            # window so a held informer can never silently lose events.
+            faults.append(FaultSpec(
+                step=step, point=point, duration=rng.randint(2, 6),
+                target=rng.choice(identities),
+                param=rng.choice(("", "Node", "Pod")),
+            ))
+        elif point == POINT_HUB_REPLAY:
+            faults.append(FaultSpec(
+                step=step, point=point, duration=rng.randint(1, 3),
+                param=rng.choice(("", "Node", "Pod")),
+                count=rng.randint(1, 2),
+            ))
+        elif point == POINT_PARTITION:
+            target = rng.choice([ORCH_IDENTITY, *identities])
+            duration = rng.randint(3, 12)
+            if overlaps(kill_windows.get(target, []), step, duration):
+                continue  # the restart inside would fail its sync
+            partition_windows.setdefault(target, []).append(
+                (step, step + duration)
+            )
+            faults.append(FaultSpec(
+                step=step, point=point, duration=duration, target=target,
+            ))
+        elif point == POINT_WORKER_KILL:
+            alive = [
+                i for i in identities
+                if i not in perma_killed
+            ]
+            if len(alive) <= 1:
+                continue  # someone must survive to finish the roll
+            target = rng.choice(alive)
+            permanent = rng.random() < 0.3
+            duration = rng.randint(6, 30)
+            if overlaps(
+                partition_windows.get(target, []), step + duration, 1
+            ):
+                continue  # restart would sync through its own partition
+            if permanent:
+                perma_killed.add(target)
+            else:
+                # Record the restart instant so a LATER partition draw
+                # for this worker cannot bracket it (the other half of
+                # the exclusion; the overlaps() check above covers a
+                # kill drawn after the partition).
+                kill_windows.setdefault(target, []).append(
+                    (step + duration, step + duration + 1)
+                )
+            faults.append(FaultSpec(
+                step=step, point=point, duration=duration, target=target,
+                param="perma" if permanent else "restart",
+            ))
+        elif point == POINT_WIRE_KILL:
+            faults.append(FaultSpec(
+                step=step, point=point, duration=rng.randint(1, 2),
+            ))
+    faults.sort(key=lambda f: (f.step, f.point, f.target, f.param))
+    return FaultSchedule(seed=seed, config=cfg, faults=faults)
+
+
+# ---------------------------------------------------------------------------
+# Plan: the runtime registry fault_point() consults
+# ---------------------------------------------------------------------------
+
+
+class FaultPlan:
+    """Armed-window matcher behind ``faultpoints.fault_point``. The
+    driver moves :attr:`step`; consults (from any thread) match the
+    armed specs — a pure function of (schedule, step, ctx), which is
+    what keeps the decision stream replayable."""
+
+    def __init__(self, schedule: FaultSchedule) -> None:
+        import threading
+
+        self.schedule = schedule
+        self._lock = threading.Lock()
+        self.step = -1
+        #: spec index -> fires inside its window (count-bounded points).
+        self.fires: dict[int, int] = {}
+        #: point name -> lifetime fires (sync points land in the trace).
+        self.fired: dict[str, int] = {}
+
+    def begin_step(self, step: int) -> None:
+        with self._lock:
+            self.step = step
+
+    def _armed(self, spec: FaultSpec) -> bool:
+        return spec.step <= self.step < spec.step + max(1, spec.duration)
+
+    @staticmethod
+    def _error_for(spec: FaultSpec) -> BaseException:
+        if spec.error == "conflict":
+            return ConflictError("chaos: injected conflict")
+        if spec.error == "server_timeout":
+            return ChaosServerTimeoutError("chaos: injected server timeout")
+        return ApiError("chaos: injected api error")
+
+    def consult(self, point: str, ctx: Mapping[str, Any]):
+        with self._lock:
+            for idx, spec in enumerate(self.schedule.faults):
+                if not self._armed(spec):
+                    continue
+                action = self._match(spec, point, ctx)
+                if action is None:
+                    continue
+                if spec.count and self.fires.get(idx, 0) >= spec.count:
+                    continue
+                self.fires[idx] = self.fires.get(idx, 0) + 1
+                self.fired[spec.point] = self.fired.get(spec.point, 0) + 1
+                return action
+        return None
+
+    def _match(
+        self, spec: FaultSpec, point: str, ctx: Mapping[str, Any]
+    ) -> Optional[FaultAction]:
+        if spec.point == POINT_LEASE and point == "lease.round":
+            if spec.target in ("", ctx.get("name")):
+                return FaultAction(DENY)
+        elif spec.point == POINT_GRANT_WRITE and point == "fleet.grant_write":
+            return FaultAction(RAISE, self._error_for(spec))
+        elif (
+            spec.point == POINT_STATUS_WRITE
+            and point == "fleet.status_write"
+        ):
+            if spec.target in ("", ctx.get("identity")):
+                return FaultAction(RAISE, self._error_for(spec))
+        elif spec.point == POINT_WATCH and point == "watch.deliver":
+            # A watch hold REQUIRES a target: informers with the
+            # default empty chaos_tag are untargetable by contract
+            # (kube/informer.py) — an empty-target spec matching them
+            # would silently hold every untagged informer in the
+            # process (health sources, unrelated tests).
+            if spec.target and spec.target == ctx.get("tag") and (
+                spec.param in ("", ctx.get("kind"))
+            ):
+                return FaultAction(HOLD)
+        elif spec.point == POINT_HUB_REPLAY and point == "watchhub.deliver":
+            if spec.param in ("", ctx.get("kind")):
+                return FaultAction(OVERFLOW)
+        elif spec.point == POINT_PARTITION and point == "wire.partition":
+            if spec.target == ctx.get("identity"):
+                return FaultAction(
+                    RAISE,
+                    ApiError(
+                        f"chaos: {spec.target} partitioned from the "
+                        "apiserver"
+                    ),
+                )
+        return None
+
+    def record_driver_fire(self, point: str) -> None:
+        """Driver-applied points (worker_kill, wire_kill) have no
+        in-code consult — the driver records their firing here so the
+        trace and the pinning tests see them like any other point.
+        Driver thread only, hence step-deterministic."""
+        with self._lock:
+            self.fired[point] = self.fired.get(point, 0) + 1
+
+    # -- driver-side queries ------------------------------------------------
+    def held_watch(self, tag: str, kind: str) -> bool:
+        """True while a watch-hold fault is armed for this informer —
+        the settle barrier exempts it (its store is SUPPOSED to lag)."""
+        with self._lock:
+            return any(
+                self._armed(s)
+                and s.point == POINT_WATCH
+                and s.target == tag
+                and s.param in ("", kind)
+                for s in self.schedule.faults
+            )
+
+    def partitioned(self, identity: str) -> bool:
+        with self._lock:
+            return any(
+                self._armed(s)
+                and s.point == POINT_PARTITION
+                and s.target == identity
+                for s in self.schedule.faults
+            )
+
+    def sync_fire_counts(self) -> dict[str, int]:
+        """Cumulative fires of the step-synchronous points (consulted
+        only from the driver thread) — safe to embed in the trace. The
+        async points (watch hold, hub overflow: consulted from watch/
+        pump threads) are reported once per run instead."""
+        with self._lock:
+            return {
+                p: n
+                for p, n in sorted(self.fired.items())
+                if p not in (POINT_WATCH, POINT_HUB_REPLAY)
+            }
+
+    def async_points_engaged(self) -> dict[str, bool]:
+        with self._lock:
+            return {
+                POINT_WATCH: self.fired.get(POINT_WATCH, 0) > 0,
+                POINT_HUB_REPLAY: self.fired.get(POINT_HUB_REPLAY, 0) > 0,
+            }
+
+
+class PartitionedClient:
+    """Per-participant request blackholing: every API call this client
+    carries consults the ``wire.partition`` fault point first, so a
+    schedule can split the orchestrator from a subset of workers while
+    the cluster itself stays healthy. Established watch streams are
+    deliberately NOT cut (the half-open partition: the kernel keeps a
+    TCP stream alive while new connections fail) — cutting streams is
+    the ``watch``/``wire_kill`` points' job."""
+
+    _INTERCEPTED = frozenset({
+        "get", "get_or_none", "list", "list_with_revision", "list_delta",
+        "watch", "create", "update", "update_status", "patch", "apply",
+        "delete", "delete_collection", "delete_if_exists", "evict",
+        "discover",
+    })
+
+    def __init__(self, inner: Client, identity: str) -> None:
+        self._inner = inner
+        self.identity = identity
+
+    def _check(self) -> None:
+        act = faultpoints.fault_point(
+            "wire.partition", identity=self.identity
+        )
+        if act is not None:
+            raise act.exc if act.exc is not None else ApiError(
+                f"chaos: {self.identity} partitioned"
+            )
+
+    def __getattr__(self, name: str):
+        attr = getattr(self._inner, name)
+        if name in self._INTERCEPTED and callable(attr):
+            def guarded(*args, **kwargs):
+                self._check()
+                return attr(*args, **kwargs)
+
+            return guarded
+        return attr
+
+
+# ---------------------------------------------------------------------------
+# The harness
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ChaosResult:
+    seed: int
+    converged: bool
+    steps: int
+    #: invariant name -> violation count; ALL must be zero.
+    violations: dict[str, int]
+    #: per-step observable record (cluster truth only) — byte-compared
+    #: by the run-twice determinism pin.
+    trace: list[dict]
+    fired: dict[str, int]
+    async_engaged: dict[str, bool]
+    completeness_aborts: int
+    final_digest: str
+    schedule_json: str
+    wall_s: float
+
+    @property
+    def total_violations(self) -> int:
+        return sum(self.violations.values())
+
+    def summary(self) -> dict:
+        return {
+            "seed": self.seed,
+            "converged": self.converged,
+            "steps": self.steps,
+            "violations": dict(self.violations),
+            "total_violations": self.total_violations,
+            "fired": dict(self.fired),
+            "async_engaged": dict(self.async_engaged),
+            "completeness_aborts": self.completeness_aborts,
+            "final_digest": self.final_digest,
+            "wall_s": round(self.wall_s, 3),
+        }
+
+
+class _WorkerSlot:
+    """One worker identity's lifecycle across kills and restarts."""
+
+    def __init__(self, identity: str) -> None:
+        self.identity = identity
+        self.worker = None
+        self.alive = False
+        self.restart_at: Optional[int] = None
+        self.ticks = 0
+        self.aborts = 0
+        #: Lifetime completeness aborts summed over dead incarnations
+        #: (each restart builds a fresh manager whose counter restarts).
+        self.aborts_retired = 0
+        #: Same for checkpoint escalations: an incarnation that
+        #: escalated and was then killed must still fail the
+        #: no-spurious-escalation invariant.
+        self.escalations_retired = 0
+
+
+class ChaosFleetHarness:
+    """Build a fleet (cluster, sim, rollout, N shard workers, one
+    orchestrator), run it under a :class:`FaultSchedule`, check the
+    global invariants. One harness per run — it owns plan + clock
+    installation and tears everything down."""
+
+    def __init__(self, config: ChaosConfig) -> None:
+        self.cfg = config
+        self.clock = ChaosClock()
+        self.cluster: FakeCluster = None  # type: ignore[assignment]
+        self.sim: DaemonSetSimulator = None  # type: ignore[assignment]
+        self.workload: Optional[CheckpointingWorkloadSimulator] = None
+        self.hub = None
+        self.server = None
+        self.orch = None
+        self.slots: dict[str, _WorkerSlot] = {}
+        self.budget = 0
+
+    # -- construction ------------------------------------------------------
+    def _client_for(self, identity: str) -> Client:
+        if self.server is not None:
+            from ..kube.rest import RestClient, RestConfig
+
+            inner: Client = RestClient(RestConfig(server=self.server.url))
+        else:
+            inner = self.cluster
+        return PartitionedClient(inner, identity)
+
+    def _build_cluster(self) -> None:
+        if self.cfg.wire:
+            from ..kube.apiserver import LocalApiServer
+
+            self.server = LocalApiServer().start()
+            self.cluster = self.server.cluster
+        else:
+            self.cluster = FakeCluster()
+        for name in self.cfg.node_names():
+            node = Node.new(name)
+            node.set_ready(True)
+            self.cluster.create(node)
+        self.sim = DaemonSetSimulator(
+            self.cluster, name="driver", namespace=NS, match_labels=LABELS
+        )
+        self.sim.settle()
+        rollout = make_fleet_rollout(
+            ROLLOUT, self.cfg.pool_names(), self.cfg.budget
+        )
+        self.budget = rollout_spec(rollout).resolved_budget()
+        self.cluster.create(KubeObject(rollout))
+        if self.cfg.checkpoint:
+            self.workload = CheckpointingWorkloadSimulator(
+                self.cluster, KEYS, pod_labels={"app": "trainer"}
+            )
+
+    def _policy(self) -> DriverUpgradePolicySpec:
+        kwargs: dict[str, Any] = {}
+        if self.cfg.checkpoint:
+            kwargs["drain"] = DrainSpec(
+                enable=True, force=True, timeout_seconds=30
+            )
+            kwargs["checkpoint"] = CheckpointSpec(
+                enable=True,
+                pod_selector="app=trainer",
+                timeout_seconds=self.cfg.checkpoint_timeout_s,
+            )
+        return DriverUpgradePolicySpec(
+            auto_upgrade=True,
+            max_parallel_upgrades=0,
+            # The GRANT is the budget in the fleet shape
+            # (docs/fleet-control-plane.md).
+            max_unavailable=IntOrString("100%"),
+            **kwargs,
+        )
+
+    def _start_worker(self, identity: str):
+        from ..fleet.worker import FleetWorkerConfig, ShardWorker
+
+        worker = ShardWorker(
+            self._client_for(identity),
+            FleetWorkerConfig(
+                identity=identity,
+                shards=self.cfg.shards,
+                namespace=NS,
+                driver_labels=LABELS,
+                pool_of=pool_of,
+                rollout_name=ROLLOUT,
+                workers=tuple(self.cfg.identities()),
+                lease_duration_s=3.0,
+                renew_deadline_s=2.0,
+                retry_period_s=0.5,
+                watch_hub=self.hub,
+            ),
+            now_fn=self.clock.now,
+            wall_fn=self.clock.wall,
+        )
+        # Tag the informers so a watch-hold fault can target exactly
+        # this worker's streams (kube/informer.py chaos_tag).
+        for informer in worker.source._informers.values():
+            informer.chaos_tag = identity
+        worker.start(sync_timeout=30)
+        return worker
+
+    def _build_fleet(self) -> None:
+        from ..fleet.orchestrator import FleetOrchestrator
+
+        if self.cfg.hub:
+            from ..kube.watchhub import WatchHub
+
+            # The hub rides its own (never-partitioned) client: it
+            # models the co-hosted fan-out process, whose upstream is a
+            # separate connection from each worker's request path.
+            self.hub = WatchHub(self.cluster)
+        for identity in self.cfg.identities():
+            slot = _WorkerSlot(identity)
+            slot.worker = self._start_worker(identity)
+            slot.alive = True
+            self.slots[identity] = slot
+        self.orch = FleetOrchestrator(
+            self._client_for(ORCH_IDENTITY), ROLLOUT
+        )
+
+    # -- settle barrier ----------------------------------------------------
+    def _informer_settled(self, informer) -> bool:
+        expected = {
+            (obj.namespace, obj.name): str(obj.resource_version)
+            for obj in self.cluster.list(
+                informer.kind,
+                namespace=informer.namespace,
+                label_selector=informer.label_selector,
+                field_selector=informer.field_selector,
+            )
+        }
+        with informer._lock:
+            have = {
+                key: str(
+                    (raw.get("metadata") or {}).get("resourceVersion", "")
+                )
+                for key, raw in informer._store.items()
+            }
+        if have != expected:
+            return False
+        pending, gone = informer.pending_dispatch()
+        return not pending and not gone
+
+    def _settled(self, plan: FaultPlan) -> bool:
+        for slot in self.slots.values():
+            if not slot.alive:
+                continue
+            for informer in slot.worker.source._informers.values():
+                if plan.held_watch(slot.identity, informer.kind):
+                    continue  # lagging by schedule — exempt until heal
+                if not self._informer_settled(informer):
+                    return False
+        return True
+
+    def settle(self, plan: FaultPlan, timeout: float = 30.0) -> bool:
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            if self._settled(plan):
+                return True
+            _time.sleep(0.002)
+        return False
+
+    # -- observations ------------------------------------------------------
+    def _disrupted_pools(self) -> set[str]:
+        out = set()
+        for raw in self.cluster.list_peek("Node"):
+            node = Node(raw)
+            if node.unschedulable or not node.is_ready():
+                out.add(pool_of(node.name))
+        return out
+
+    def _ledger_phases(self) -> dict[str, list[str]]:
+        raw = self.cluster.peek(FLEET_ROLLOUT_KIND, ROLLOUT) or {}
+        return {
+            "granted": sorted(pools_in_phase(raw, POOL_GRANTED)),
+            "done": sorted(pools_in_phase(raw, POOL_DONE)),
+        }
+
+    def _node_record(self, name: str) -> tuple:
+        raw = self.cluster.peek("Node", name) or {}
+        node = Node(raw)
+        pod_raw = self.cluster.peek("Pod", self.sim.pod_name(name), NS) or {}
+        pod_hash = (
+            (pod_raw.get("metadata") or {}).get("labels") or {}
+        ).get("controller-revision-hash", "")
+        return (
+            node.labels.get(KEYS.state_label, ""),
+            bool(node.unschedulable),
+            node.is_ready(),
+            pod_hash,
+        )
+
+    def _pool_rolled(self, pool: str) -> bool:
+        """Cluster-truth check behind the no-grant-retired-unrolled
+        invariant: at the instant a pool flips ``done`` every node must
+        be upgrade-done, schedulable, ready, and running a pod at the
+        CURRENT template hash."""
+        names = [
+            f"{pool}-h{h}" for h in range(self.cfg.hosts)
+        ]
+        for name in names:
+            state, unsched, ready, pod_hash = self._node_record(name)
+            if state != str(UpgradeState.DONE) or unsched or not ready:
+                return False
+            if pod_hash != self.sim.current_hash:
+                return False
+        return True
+
+    def _converged(self, phases: dict) -> bool:
+        if len(phases["done"]) != self.cfg.pools:
+            return False
+        for name in self.cfg.node_names():
+            state, unsched, ready, pod_hash = self._node_record(name)
+            if state != str(UpgradeState.DONE) or unsched or not ready:
+                return False
+        return self.sim.all_pods_ready_and_current()
+
+    def final_digest(self) -> str:
+        payload = {
+            "nodes": {
+                name: self._node_record(name)
+                for name in self.cfg.node_names()
+            },
+            "ledger": self._ledger_phases(),
+        }
+        return hashlib.sha256(
+            json.dumps(payload, sort_keys=True).encode()
+        ).hexdigest()
+
+    # -- driver events -----------------------------------------------------
+    def _kill(self, identity: str, restart_at: Optional[int]) -> None:
+        slot = self.slots[identity]
+        if not slot.alive:
+            return
+        log.info("chaos: killing worker %s (restart_at=%s)",
+                 identity, restart_at)
+        # A crash releases nothing: the leases go stale and are either
+        # resumed by the restarted identity or stolen by a survivor.
+        mgr = slot.worker.mgr
+        slot.aborts_retired += mgr.completeness_aborts_total
+        slot.escalations_retired += (
+            mgr.common.checkpoint_manager.totals()["escalations"]
+        )
+        slot.worker.stop(release=False)
+        slot.worker = None
+        slot.alive = False
+        slot.restart_at = restart_at
+
+    def _try_restart(self, identity: str) -> None:
+        slot = self.slots[identity]
+        try:
+            slot.worker = self._start_worker(identity)
+            slot.alive = True
+            slot.restart_at = None
+            log.info("chaos: restarted worker %s", identity)
+        except Exception as e:  # noqa: BLE001 - retried next step
+            # A restart into a still-armed partition (or any transient)
+            # retries next step — a crashed-then-crashing process.
+            log.warning("chaos: restart of %s failed (%s); retrying",
+                        identity, e)
+            if slot.worker is not None:
+                try:
+                    slot.worker.stop(release=False)
+                except Exception:  # noqa: BLE001 - teardown best-effort
+                    log.exception(
+                        "chaos: half-started %s teardown failed", identity
+                    )
+                slot.worker = None
+
+    def _apply_driver_events(self, step: int, plan: FaultPlan) -> None:
+        for spec in self.schedule.faults:
+            if spec.point == POINT_WORKER_KILL and spec.step == step:
+                if self.slots[spec.target].alive:
+                    plan.record_driver_fire(POINT_WORKER_KILL)
+                restart_at = (
+                    None if spec.param == "perma"
+                    else step + max(1, spec.duration)
+                )
+                self._kill(spec.target, restart_at)
+            elif spec.point == POINT_WIRE_KILL and (
+                spec.step <= step < spec.step + max(1, spec.duration)
+            ):
+                if self.server is not None:
+                    if self.server.kill_connections():
+                        plan.record_driver_fire(POINT_WIRE_KILL)
+        for slot in self.slots.values():
+            if (
+                not slot.alive
+                and slot.restart_at is not None
+                and step >= slot.restart_at
+            ):
+                self._try_restart(slot.identity)
+
+    # -- the run -----------------------------------------------------------
+    def run(self, schedule: FaultSchedule) -> ChaosResult:
+        started = _time.perf_counter()
+        self.schedule = schedule
+        plan = FaultPlan(schedule)
+        # Track what THIS run installed: a failed second install (some
+        # other owner's plan/clock already registered) must not have
+        # the finally below tear down state it never owned.
+        plan_installed = clock_installed = False
+        violations = {
+            "budget": 0,
+            "grant_retired_unrolled": 0,
+            "node_lost_or_cordoned": 0,
+            "incremental_vs_full": 0,
+            "checkpoint_spurious_escalations": 0,
+            "settle_timeouts": 0,
+            "not_converged": 0,
+            "completeness_races_unbounded": 0,
+            "audit_errors": 0,
+        }
+        trace: list[dict] = []
+        converged = False
+        steps = 0
+        policy = self._policy()
+        try:
+            # Install inside the try: a failed clock install (someone
+            # else's clock registered) must still roll back the plan
+            # this run DID install — and only that.
+            faultpoints.install_plan(plan)
+            plan_installed = True
+            faultpoints.install_clock(self.clock)
+            clock_installed = True
+            self._build_cluster()
+            self._build_fleet()
+            plan.begin_step(-1)
+            if not self.settle(plan):
+                violations["settle_timeouts"] += 1
+            self.sim.set_template_hash("v2")
+            prev_done: set[str] = set()
+            last_armed = schedule.last_armed_step()
+            for step in range(self.cfg.resolved_max_steps()):
+                steps = step + 1
+                plan.begin_step(step)
+                self._apply_driver_events(step, plan)
+                self.sim.step()
+                if self.workload is not None:
+                    self.workload.step()
+                self.orch.tick()
+                for identity in self.cfg.identities():
+                    slot = self.slots[identity]
+                    if not slot.alive:
+                        continue
+                    slot.ticks += 1
+                    try:
+                        slot.worker.tick(policy)
+                    except (ApiError, BuildStateError):
+                        # The documented tick contract: a pass aborts,
+                        # the next one resumes from labels. Counted —
+                        # the bounded-race invariant below.
+                        slot.aborts += 1
+                self.sim.step()
+                if not self.settle(plan):
+                    violations["settle_timeouts"] += 1
+                disrupted = self._disrupted_pools()
+                if len(disrupted) > self.budget:
+                    violations["budget"] += 1
+                phases = self._ledger_phases()
+                newly_done = set(phases["done"]) - prev_done
+                for pool in newly_done:
+                    node_count = self.cfg.hosts  # nodes per pool
+                    if node_count and not self._pool_rolled(pool):
+                        violations["grant_retired_unrolled"] += 1
+                prev_done = set(phases["done"])
+                trace.append({
+                    "step": step,
+                    "disrupted": sorted(disrupted),
+                    "granted": phases["granted"],
+                    "done": phases["done"],
+                    "alive": sorted(
+                        s.identity
+                        for s in self.slots.values() if s.alive
+                    ),
+                    "fired": plan.sync_fire_counts(),
+                })
+                self.clock.advance(self.cfg.step_dt)
+                if step >= last_armed and self._converged(phases):
+                    converged = True
+                    break
+            if not converged:
+                violations["not_converged"] += 1
+            # -- post-heal invariants (the chaos contract's second half:
+            # after every heal, the world must read consistent) --------
+            if converged:
+                if not self.settle(plan):
+                    violations["settle_timeouts"] += 1
+                for slot in self.slots.values():
+                    if not slot.alive:
+                        continue
+                    try:
+                        violations["incremental_vs_full"] += (
+                            slot.worker.mgr.audit_incremental(NS, LABELS)
+                        )
+                    except BuildStateError:
+                        # The audit's own completeness walk raced an
+                        # in-flight delivery (only reachable after a
+                        # settle timeout): a violation with a name, not
+                        # a crashed corpus — the seed stays reportable.
+                        violations["audit_errors"] += 1
+                    if slot.worker.mgr.completeness_aborts_total >= max(
+                        1, slot.ticks
+                    ):
+                        # Every pass aborting = the wedge the counted
+                        # signal exists to catch; tolerated aborts must
+                        # stay a bounded minority.
+                        violations["completeness_races_unbounded"] += 1
+                for name in self.cfg.node_names():
+                    state, unsched, ready, _ = self._node_record(name)
+                    if state != str(UpgradeState.DONE) or unsched or (
+                        not ready
+                    ):
+                        violations["node_lost_or_cordoned"] += 1
+                if self.cfg.checkpoint:
+                    for slot in self.slots.values():
+                        # Dead incarnations count too (_kill retired
+                        # their totals): a spurious escalation must not
+                        # vanish with the process that made it.
+                        violations["checkpoint_spurious_escalations"] += (
+                            slot.escalations_retired
+                        )
+                        if not slot.alive:
+                            continue
+                        totals = (
+                            slot.worker.mgr.common.checkpoint_manager
+                            .totals()
+                        )
+                        violations["checkpoint_spurious_escalations"] += (
+                            totals["escalations"]
+                        )
+            completeness_aborts = sum(
+                s.aborts_retired
+                + (
+                    s.worker.mgr.completeness_aborts_total
+                    if s.alive else 0
+                )
+                for s in self.slots.values()
+            )
+            digest = self.final_digest()
+            return ChaosResult(
+                seed=schedule.seed,
+                converged=converged,
+                steps=steps,
+                violations=violations,
+                trace=trace,
+                fired=plan.sync_fire_counts(),
+                async_engaged=plan.async_points_engaged(),
+                completeness_aborts=completeness_aborts,
+                final_digest=digest,
+                schedule_json=schedule.to_json(),
+                wall_s=_time.perf_counter() - started,
+            )
+        finally:
+            if plan_installed:
+                faultpoints.clear_plan()
+            if clock_installed:
+                faultpoints.clear_clock()
+            self._teardown()
+
+    def _teardown(self) -> None:
+        for slot in self.slots.values():
+            if slot.worker is not None:
+                try:
+                    slot.worker.stop(release=False)
+                except Exception:  # noqa: BLE001 - teardown best-effort
+                    log.exception("chaos: worker %s teardown failed",
+                                  slot.identity)
+        if self.hub is not None:
+            self.hub.stop()
+        if self.server is not None:
+            self.server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Entry points (tools/chaos_run.py + tests + bench)
+# ---------------------------------------------------------------------------
+
+
+def run_schedule(schedule: FaultSchedule) -> ChaosResult:
+    """Run one schedule on a fresh fleet (the repro path: a schedule
+    JSON is a complete recipe — config rides inside it)."""
+    return ChaosFleetHarness(schedule.config).run(schedule)
+
+
+def run_seed(seed: int, config: Optional[ChaosConfig] = None) -> ChaosResult:
+    return run_schedule(generate_schedule(seed, config or ChaosConfig()))
+
+
+def run_corpus(
+    seeds: range,
+    config: Optional[ChaosConfig] = None,
+    on_result: Optional[Callable[[ChaosResult], None]] = None,
+) -> dict:
+    """Explore one seed per schedule; returns the corpus summary the CI
+    gate floors (``chaos_smoke.schedules_explored``,
+    ``chaos_smoke.invariant_violations``)."""
+    cfg = config or ChaosConfig()
+    results: list[ChaosResult] = []
+    fired_points: set[str] = set()
+    for seed in seeds:
+        result = run_seed(seed, cfg)
+        results.append(result)
+        fired_points.update(p for p, n in result.fired.items() if n)
+        fired_points.update(
+            p for p, ok in result.async_engaged.items() if ok
+        )
+        if on_result is not None:
+            on_result(result)
+    return {
+        "schedules_explored": len(results),
+        "invariant_violations": sum(r.total_violations for r in results),
+        "not_converged": sum(0 if r.converged else 1 for r in results),
+        "fault_points_fired": sorted(fired_points),
+        "completeness_aborts": sum(
+            r.completeness_aborts for r in results
+        ),
+        "failing_seeds": [
+            r.seed for r in results
+            if r.total_violations or not r.converged
+        ],
+        "wall_s": round(sum(r.wall_s for r in results), 3),
+        "violations_by_kind": {
+            k: sum(r.violations.get(k, 0) for r in results)
+            for k in (results[0].violations if results else {})
+        },
+    }

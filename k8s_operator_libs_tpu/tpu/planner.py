@@ -348,7 +348,8 @@ class SliceAwareRequestorManager(RequestorNodeStateManager):
             # node's health score so the external operator can order
             # degraded-first too.
             self.create_or_update_node_maintenance(
-                ns, policy, health=state.health_of(ns.node.name)
+                ns, policy, health=state.health_of(ns.node.name),
+                sick_links=state.sick_links_of(ns.node.name),
             )
             common.provider.change_node_upgrade_annotation(
                 ns.node, common.keys.requestor_mode_annotation, TRUE_STRING

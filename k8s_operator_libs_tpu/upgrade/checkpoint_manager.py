@@ -165,6 +165,22 @@ class CheckpointManager:
         # annotation, and the escalation path still needs the id to
         # harvest the acks that did land.
         epoch = node.annotations.get(clock_key)
+        acked = self._acked(pods, epoch)
+        if epoch and len(acked) == len(pods):
+            # Every selected pod already acked this epoch — the
+            # checkpoint IS complete, whatever the clock says. A worker
+            # restarted mid-arc (chaos schedule: killed between the acks
+            # landing and the gate pass) re-enters here AFTER the
+            # deadline; the durable epoch id is exactly what makes the
+            # re-entry idempotent, so a lapsed clock must not turn a
+            # finished checkpoint into an escalated (cold-restart)
+            # drain. Pinned in test_checkpoint_drain.py.
+            self._complete_gate(
+                node, acked, next_state,
+                f"All {len(acked)} workload checkpoints found complete on "
+                "re-entry; proceeding with a checkpoint-coordinated drain",
+            )
+            return
         expired = advance_durable_clock(
             self._provider, node, clock_key, spec.timeout_seconds
         )
@@ -194,17 +210,30 @@ class CheckpointManager:
                 node.name, len(acked), len(pods), epoch,
             )
             return
-        self._record_manifest(node, acked)
-        self._provider.change_node_upgrade_annotation(
-            node, clock_key, NULL_STRING
-        )
-        self._advance(node, next_state)
-        self._count("completions")
-        self._event(
-            node, "Normal",
+        self._complete_gate(
+            node, acked, next_state,
             f"All {len(acked)} workload checkpoints complete; proceeding "
             "with a checkpoint-coordinated drain",
         )
+
+    def _complete_gate(
+        self,
+        node: Node,
+        acked: list[Pod],
+        next_state: UpgradeState,
+        message: str,
+    ) -> None:
+        """THE gate-completion sequence, shared by the normal path and
+        the post-restart re-entry: manifest FIRST (an abort between the
+        two re-enters with the manifest already durable), then clock
+        retirement, then the state advance."""
+        self._record_manifest(node, acked)
+        self._provider.change_node_upgrade_annotation(
+            node, self._keys.checkpoint_start_annotation, NULL_STRING
+        )
+        self._advance(node, next_state)
+        self._count("completions")
+        self._event(node, "Normal", message)
 
     def _acked(self, pods: list[Pod], epoch: Optional[str]) -> list[Pod]:
         if not epoch:

@@ -85,6 +85,19 @@ class ClusterUpgradeState:
     #: configured — the planner orders by name and the quarantine arc is
     #: inert, and a non-telemetry pool pays zero for the feature.
     node_health: Optional[Mapping[str, NodeHealth]] = None
+    #: Lazy memo behind :meth:`sick_links_of`: the folded link topology
+    #: plus the health map it was folded from. Keyed by IDENTITY of
+    #: ``node_health`` (the health source re-attaches the same frozen
+    #: dict on settled passes, a fresh one after deltas), so per-node
+    #: callers in the requestor/planner start loops pay ONE fold per
+    #: snapshot instead of one per node. Never part of equality/repr —
+    #: a cache, not state.
+    _link_fold: Optional[dict] = field(
+        default=None, repr=False, compare=False
+    )
+    _link_fold_src: Optional[Mapping[str, NodeHealth]] = field(
+        default=None, repr=False, compare=False
+    )
 
     def nodes_in(self, state: UpgradeState) -> list[NodeUpgradeState]:
         return self.node_states.get(state, [])
@@ -111,6 +124,28 @@ class ClusterUpgradeState:
         if self.node_health is None:
             return None
         return self.node_health.get(node_name)
+
+    def sick_links_of(self, node_name: str) -> list:
+        """The node's sick incident links over the folded fleet topology
+        — what the requestor stamps into
+        ``NodeMaintenance.spec.nodeHealth.worstLinks`` so an external
+        maintenance operator sees the planner's localization. Empty
+        without a telemetry plane or with all links ok. The fold runs
+        once per attached health map (see ``_link_fold``); each call
+        then extracts in O(links)."""
+        if self.node_health is None:
+            return []
+        from ..api.telemetry_v1alpha1 import (
+            fold_link_topology,
+            sick_links_from_topology,
+        )
+
+        if self._link_fold is None or (
+            self._link_fold_src is not self.node_health
+        ):
+            self._link_fold = fold_link_topology(self.node_health)
+            self._link_fold_src = self.node_health
+        return sick_links_from_topology(node_name, self._link_fold)
 
 
 class CommonUpgradeManager:

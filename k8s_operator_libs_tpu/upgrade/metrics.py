@@ -221,6 +221,11 @@ _PASS_GAUGES = [
      "Lifetime fraction of passes served from deltas without a full "
      "rebuild",
      "delta_hit_rate"),
+    ("pass_aborted_completeness_races",
+     "Lifetime passes aborted by the snapshot completeness invariant "
+     "racing an in-flight pod delivery (bounded-race signal; a wedge "
+     "shows as this climbing every pass)",
+     "aborted_completeness_races"),
 ]
 
 #: Checkpoint-coordinated drain gauges (docs/checkpoint-drain.md), read

@@ -15,6 +15,7 @@ from ..api.upgrade_v1alpha1 import PodDeletionSpec, WaitForCompletionSpec
 from ..kube.client import Client, NotFoundError
 from ..kube.drain import DrainConfig, DrainError, DrainHelper
 from ..kube.objects import ControllerRevision, DaemonSet, Node, Pod
+from ..utils.faultpoints import wall_now
 from ..utils.log import get_logger
 from .consts import NULL_STRING, UpgradeKeys, UpgradeState
 from .state_provider import NodeUpgradeStateProvider
@@ -354,9 +355,10 @@ class PodManager:
         next_state: UpgradeState = UpgradeState.POD_DELETION_REQUIRED,
     ) -> None:
         """Start or check the durable start-time annotation
-        (reference: :331-368)."""
+        (reference: :331-368). Wall time via ``faultpoints.wall_now`` —
+        the chaos harness drives this deadline with a virtual clock."""
         key = self._keys.wait_for_pod_completion_start_annotation
-        now = int(time.time())
+        now = int(wall_now())
         start_raw = node.annotations.get(key)
         if start_raw is None:
             self._provider.change_node_upgrade_annotation(node, key, str(now))

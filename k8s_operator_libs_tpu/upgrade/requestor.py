@@ -184,6 +184,7 @@ class RequestorNodeStateManager:
         node_name: str,
         policy: Optional[DriverUpgradePolicySpec],
         health=None,
+        sick_links=None,
     ) -> NodeMaintenance:
         """Build the CR from the upgrade policy
         (reference: upgrade_requestor.go:161-180, 497-524).
@@ -192,14 +193,29 @@ class RequestorNodeStateManager:
         wired — ROADMAP 4c) is surfaced as ``spec.nodeHealth`` so the
         external maintenance operator can order its own queue
         degraded-first; absent telemetry leaves the field off entirely —
-        an operator must distinguish "healthy" from "unmeasured"."""
+        an operator must distinguish "healthy" from "unmeasured".
+        ``sick_links`` (``ClusterUpgradeState.sick_links_of`` — the
+        folded-topology localization, ROADMAP item 5 follow-on) rides
+        along as ``nodeHealth.worstLinks`` so the operator sees WHICH
+        fabric links degraded the score, not just that something did;
+        omitted when empty (all links ok, or no link telemetry). A
+        PEER-ONLY node (no report of its own, but a neighbor observed
+        a sick link to it — the fold degrades it anyway) carries
+        worstLinks WITHOUT score/trend: the localization must not
+        vanish with the missing report, and the absent scalar still
+        reads "unmeasured", never "healthy"."""
         nm = NodeMaintenance.new(
             self.node_maintenance_name(node_name), namespace=self.opts.namespace
         )
         nm.requestor_id = self.opts.requestor_id
         nm.node_name = node_name
-        if health is not None:
-            nm.node_health = {"score": health.score, "trend": health.trend}
+        if health is not None or sick_links:
+            payload = {}
+            if health is not None:
+                payload = {"score": health.score, "trend": health.trend}
+            if sick_links:
+                payload["worstLinks"] = [dict(link) for link in sick_links]
+            nm.node_health = payload
         if policy is not None:
             drain: dict = {}
             if policy.drain is not None:
@@ -234,9 +250,12 @@ class RequestorNodeStateManager:
         node_state: NodeUpgradeState,
         policy: Optional[DriverUpgradePolicySpec],
         health=None,
+        sick_links=None,
     ) -> None:
         """(reference: upgrade_requestor.go:185-201)"""
-        nm = self.new_node_maintenance(node_state.node.name, policy, health)
+        nm = self.new_node_maintenance(
+            node_state.node.name, policy, health, sick_links=sick_links
+        )
         node_state.node_maintenance = nm
         try:
             self.client.create(nm)
@@ -262,6 +281,7 @@ class RequestorNodeStateManager:
         node_state: NodeUpgradeState,
         policy: Optional[DriverUpgradePolicySpec],
         health=None,
+        sick_links=None,
     ) -> None:
         """Shared-requestor append protocol
         (reference: upgrade_requestor.go:320-368): with the default name
@@ -273,7 +293,9 @@ class RequestorNodeStateManager:
             == DEFAULT_NODE_MAINTENANCE_NAME_PREFIX
         )
         if existing is None or not shared_naming:
-            self._create_node_maintenance(node_state, policy, health)
+            self._create_node_maintenance(
+                node_state, policy, health, sick_links=sick_links
+            )
             return
         nm = NodeMaintenance(existing.raw)
         if nm.requestor_id == self.opts.requestor_id:
@@ -382,7 +404,8 @@ class RequestorNodeStateManager:
                     node.name,
                 )
             self.create_or_update_node_maintenance(
-                ns, policy, health=state.health_of(node.name)
+                ns, policy, health=state.health_of(node.name),
+                sick_links=state.sick_links_of(node.name),
             )
             common.provider.change_node_upgrade_annotation(
                 node, common.keys.requestor_mode_annotation, TRUE_STRING

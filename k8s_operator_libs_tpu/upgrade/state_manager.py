@@ -152,6 +152,13 @@ class PassStats:
     checkpoints_completed_total: int = 0
     checkpoint_restores_verified_total: int = 0
     checkpoint_restore_escalations_total: int = 0
+    #: Lifetime count of passes aborted by the snapshot completeness
+    #: invariant (BuildStateError) — the documented race between the
+    #: check and an in-flight kubelet pod delivery. The tick contract
+    #: tolerates the abort (the next pass's full rebuild resumes); this
+    #: counter makes the tolerance a SIGNAL the chaos harness can bound:
+    #: a wedged pool shows up as every pass aborting, not as silence.
+    aborted_completeness_races: int = 0
 
 
 class ClusterUpgradeStateManager:
@@ -216,6 +223,9 @@ class ClusterUpgradeStateManager:
         # the delta hit-rate gauge (reconcile thread only).
         self._incremental_builds = 0
         self._incremental_hits = 0
+        #: Lifetime completeness-invariant aborts (see
+        #: PassStats.aborted_completeness_races). Reconcile thread only.
+        self.completeness_aborts_total = 0
         #: True once any pass saw the checkpoint arc (enabled policy or a
         #: node in the bucket). Gates the per-pass checkpoint accounting:
         #: a settled zero-work pass on a non-checkpointing pool must not
@@ -402,14 +412,28 @@ class ClusterUpgradeStateManager:
             snapshot_cached=source.cached, snapshot_incremental=incremental
         )
         self.last_pass_stats = stats
-        if incremental:
-            state = self._build_state_incremental(
-                namespace, driver_labels, source, stats
-            )
-        else:
-            self._reset_pass_caches()
-            state = self._build_state_full(namespace, driver_labels, source)
-            state.dirty_nodes = None
+        stats.aborted_completeness_races = self.completeness_aborts_total
+        try:
+            if incremental:
+                state = self._build_state_incremental(
+                    namespace, driver_labels, source, stats
+                )
+            else:
+                self._reset_pass_caches()
+                state = self._build_state_full(
+                    namespace, driver_labels, source
+                )
+                state.dirty_nodes = None
+        except BuildStateError:
+            # Count the documented completeness race (an in-flight
+            # kubelet pod delivery vs the desired-count check) before
+            # re-raising: the caller's loop tolerates the abort, the
+            # counter proves it stays BOUNDED (gauge
+            # tpu_operator_upgrade_pass_aborted_completeness_races).
+            self.completeness_aborts_total += 1
+            stats.aborted_completeness_races = self.completeness_aborts_total
+            stats.snapshot_s = time.perf_counter() - start
+            raise
         if self.health_source is not None:
             # Memoized mapping: a settled pool re-attaches the same
             # frozen dict — a counter compare, no copy, no reads.
@@ -590,6 +614,44 @@ class ClusterUpgradeStateManager:
             self._incremental_hits / self._incremental_builds, 6
         )
         return state
+
+    def audit_incremental(
+        self, namespace: str, driver_labels: Mapping[str, str]
+    ) -> int:
+        """Non-consuming incremental==full identity check: classify the
+        world afresh (reference-shaped full walk over the source's
+        stores) and count nodes whose classification disagrees with the
+        incremental book. Unlike the ``verify_every_n`` audit this
+        neither consumes the delta stream nor repairs — it is a PURE
+        read for settled moments: the chaos harness's end-of-run
+        invariant (docs/chaos-harness.md) and tests. 0 for
+        non-incremental sources or before the first prime; calling it
+        mid-churn counts in-flight deliveries as divergences, so settle
+        first. A book with a PENDING delta — unconsumed node marks, or
+        a full invalidation (e.g. a fleet worker that lost every shard
+        and will rebuild on its next owned tick) — is skipped, not
+        failed: the system never serves that book without consuming
+        the delta first, so its staleness is the contract, not a
+        tracking bug."""
+        source = self.snapshot_source
+        if not isinstance(source, IncrementalSnapshotSource):
+            return 0
+        if source.cached_state() is None:
+            return 0
+        pending = source.dirty()
+        if pending.full or pending.nodes:
+            return 0
+        expected = _assignment_shape(source.assignment())
+        assignment: dict = {}
+        self._build_state_full(
+            namespace, dict(driver_labels), source, assignment=assignment
+        )
+        actual = _assignment_shape(assignment)
+        return sum(
+            1
+            for name in set(expected) | set(actual)
+            if expected.get(name) != actual.get(name)
+        )
 
     def _apply_delta(
         self,

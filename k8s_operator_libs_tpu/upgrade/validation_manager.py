@@ -20,11 +20,11 @@ node fails after the timeout instead of hanging.
 
 from __future__ import annotations
 
-import time
 from typing import Callable, Optional
 
 from ..kube.client import Client
 from ..kube.objects import Node, Pod
+from ..utils.faultpoints import wall_now
 from ..utils.log import get_logger
 from .consts import NULL_STRING, UpgradeKeys, UpgradeState
 from .state_provider import NodeUpgradeStateProvider
@@ -46,8 +46,12 @@ def advance_durable_clock(
     139-175), shared by every annotation-clocked step (validation here,
     post-maintenance in upgrade/requestor.py): stamp the start time on
     first sight, reset an unparseable value, and on expiry clear the clock
-    and return True — the caller applies its own expiry consequences."""
-    now = int(time.time())
+    and return True — the caller applies its own expiry consequences.
+
+    Reads :func:`~..utils.faultpoints.wall_now` (``time.time`` unless a
+    chaos clock is installed) so deadline escalation is schedule-driven
+    under the chaos harness — virtual time, not test-host sleeps."""
+    now = int(wall_now())
     start_raw = node.annotations.get(key)
     if start_raw is None:
         provider.change_node_upgrade_annotation(node, key, str(now))

@@ -1,0 +1,178 @@
+"""Process-wide fault-point registry + virtual clock for the chaos
+harness (ROADMAP item 6; docs/chaos-harness.md).
+
+The deterministic schedule driver (``testing/chaos.py``) needs two
+things from production code:
+
+* **named fault points** — call sites on the coordination surfaces
+  whose *schedules* break fleets (lease protocol rounds, grant-ledger
+  writes, watch delivery, hub fan-out) consult :func:`fault_point`
+  before acting. With no plan installed (every production deployment,
+  every non-chaos test) the consult is one module-global ``None`` check
+  — no locks, no allocation, no behavior change;
+* **virtualized timers** — annotation-clocked deadlines (checkpoint
+  escalation, validation timeout, pod-completion waits) read
+  :func:`wall_now` instead of ``time.time`` so a schedule can *drive*
+  expiry by advancing a :class:`ChaosClock` instead of sleeping through
+  wall-clock timeouts. Components that already take injected
+  ``now_fn``/``wall_fn`` (LeaderElector, ShardWorker, the quarantine
+  manager) keep that idiom; this hook exists for the durable-clock
+  helpers whose call sites have no injection seam.
+
+This module is a LEAF: stdlib only, imported by ``kube/`` and
+``upgrade/`` call sites — the full harness (schedule generation,
+invariant checks, the fleet driver) lives in ``testing/chaos.py`` and
+installs into this registry at run time. Keeping the registry here
+avoids the ``kube -> testing -> kube`` import cycle the hooks would
+otherwise create.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+#: Action kinds a plan may answer a consult with. Call sites interpret:
+#: ``deny``     — fail this protocol round benignly (lease round returns
+#:                False, exactly as a lost update race would);
+#: ``raise``    — raise ``FaultAction.exc`` at the call site (injected
+#:                Conflict/ServerTimeout on a ledger write);
+#: ``hold``     — block delivery while the plan keeps answering hold
+#:                (a lagging watch stream: events queue upstream, the
+#:                consumer's view goes stale, heal releases in order);
+#: ``overflow`` — force the hub subscriber buffers over their bound
+#:                (stale -> journal self-resume, the replay path).
+DENY = "deny"
+RAISE = "raise"
+HOLD = "hold"
+OVERFLOW = "overflow"
+
+
+@dataclass
+class FaultAction:
+    """One consult's verdict. ``exc`` is pre-built by the plan (the
+    registry itself never imports error types — leaf module)."""
+
+    kind: str
+    exc: Optional[BaseException] = None
+
+
+class ChaosClock:
+    """Virtual monotonic + wall time, advanced only by the schedule
+    driver — lease expiry, failover probes, and durable-clock deadlines
+    all move when the SCHEDULE says time passed, never because the test
+    host was slow. Thread-safe: watch/pump threads read it while the
+    driver advances."""
+
+    def __init__(
+        self, start: float = 100.0, wall_start: float = 1_700_000_000.0
+    ) -> None:
+        self._lock = threading.Lock()
+        self._mono = float(start)
+        self._wall = float(wall_start)
+
+    def now(self) -> float:
+        """Monotonic reading (LeaderElector/ShardWorker ``now_fn``)."""
+        with self._lock:
+            return self._mono
+
+    def wall(self) -> float:
+        """Wall reading (``wall_fn`` + the durable-clock helpers)."""
+        with self._lock:
+            return self._wall
+
+    def advance(self, dt: float) -> None:
+        with self._lock:
+            self._mono += dt
+            self._wall += dt
+
+
+# -- the process-wide registry ---------------------------------------------
+# One plan + one clock at a time: the chaos driver owns the whole
+# process for a run (it drives every worker in it). Installation is a
+# plain attribute swap — consults are lock-free reads of one global.
+_plan: Optional[Any] = None
+_clock: Optional[ChaosClock] = None
+
+
+def install_plan(plan: Any) -> None:
+    """Install a plan object exposing ``consult(point, ctx) ->
+    Optional[FaultAction]``. Refuses to stack plans — overlapping chaos
+    runs in one process would attribute faults to the wrong schedule."""
+    global _plan
+    if _plan is not None and plan is not None:
+        raise RuntimeError("a fault plan is already installed")
+    _plan = plan
+
+
+def clear_plan() -> None:
+    global _plan
+    _plan = None
+
+
+def install_clock(clock: Optional[ChaosClock]) -> None:
+    """Install the virtual clock behind :func:`wall_now`/:func:`mono_now`.
+    Same no-stacking rule as plans."""
+    global _clock
+    if _clock is not None and clock is not None:
+        raise RuntimeError("a chaos clock is already installed")
+    _clock = clock
+
+
+def clear_clock() -> None:
+    global _clock
+    _clock = None
+
+
+def plan_active() -> bool:
+    """True while a fault plan is installed — the cheap pre-check for
+    call sites whose CONTEXT computation is itself nontrivial (e.g. a
+    per-frame subscriber scan): gate the work on this, then consult.
+    Plain consults don't need it; ``fault_point`` is already one
+    global read when no plan is installed."""
+    return _plan is not None
+
+
+def fault_point(point: str, **ctx: Any) -> Optional[FaultAction]:
+    """Consult the installed plan at a named fault point. ``ctx`` names
+    the site's coordinates (lease name, worker identity, informer kind,
+    ...) so a schedule can target ONE participant. Returns None — act
+    normally — for every consult when no plan is installed."""
+    plan = _plan
+    if plan is None:
+        return None
+    return plan.consult(point, ctx)
+
+
+def wall_now() -> float:
+    """``time.time`` unless a chaos clock is installed — THE wall-time
+    source for annotation-backed durable clocks (validation timeout,
+    checkpoint deadline, pod-completion wait), so deadline escalation is
+    schedule-driven under chaos and real-time everywhere else."""
+    clock = _clock
+    return time.time() if clock is None else clock.wall()
+
+
+def mono_now() -> float:
+    """``time.monotonic`` unless a chaos clock is installed."""
+    clock = _clock
+    return time.monotonic() if clock is None else clock.now()
+
+
+def chaos_hold(
+    point: str,
+    should_abort: Callable[[], bool],
+    poll_s: float = 0.002,
+    **ctx: Any,
+) -> None:
+    """Block while the plan answers ``hold`` at ``point`` — the
+    delivery-lag primitive (a held watch stream). Returns immediately
+    when no plan is installed; ``should_abort`` (the caller's stop
+    signal) always wins so a held thread can still shut down."""
+    while not should_abort():
+        act = fault_point(point, **ctx)
+        if act is None or act.kind != HOLD:
+            return
+        time.sleep(poll_s)

@@ -280,3 +280,60 @@ class TestNodeMaintenanceHealth:
         nm = self._requestor().new_node_maintenance("node-1", policy=None)
         assert nm.node_health is None
         assert "nodeHealth" not in nm.spec
+
+    def test_worst_links_round_trip_on_the_cr(self):
+        """ISSUE 13 satellite (ROADMAP item 5 follow-on): the folded
+        sick-link list rides ``spec.nodeHealth.worstLinks`` so an
+        external maintenance operator sees the planner's link
+        localization — including a link only the PEER reported."""
+        from k8s_operator_libs_tpu.api import parse_node_health
+        from k8s_operator_libs_tpu.api.telemetry_v1alpha1 import (
+            make_node_health_report,
+            sick_links_for,
+        )
+        from k8s_operator_libs_tpu.kube import NodeMaintenance
+
+        # node-2 reports the sick link; node-1 never mentions it — the
+        # symmetric fold degrades BOTH endpoints.
+        reporter = parse_node_health(make_node_health_report(
+            "node-2", {"ring_allreduce": True}, {},
+            # The probe-tier observation shape: slow + starved grades
+            # the link degraded (grade_link).
+            links={"node-1": {"ok": True, "latency_s": 5.0,
+                              "gbytes_per_s": 1.0}},
+        ))
+        silent = parse_node_health(make_node_health_report(
+            "node-1", {"ring_allreduce": True}, {},
+        ))
+        health_map = {"node-1": silent, "node-2": reporter}
+        links = sick_links_for("node-1", health_map)
+        assert links == [{
+            "peer": "node-2", "verdict": "degraded",
+            "gbytesPerS": 1.0, "latencyS": 5.0,
+        }]
+        nm = self._requestor().new_node_maintenance(
+            "node-1", policy=None, health=silent, sick_links=links
+        )
+        assert nm.worst_links == links
+        assert nm.node_health["worstLinks"] == links
+        again = NodeMaintenance(dict(nm.raw))
+        assert again.worst_links == links
+        # All-ok links stay absent: absence == nothing sick to report.
+        healthy = self._requestor().new_node_maintenance(
+            "node-2", policy=None, health=reporter,
+            sick_links=sick_links_for("node-3", {}),
+        )
+        assert healthy.worst_links == []
+        assert "worstLinks" not in (healthy.node_health or {})
+        # A truly PEER-ONLY node (no report of its own at all — the
+        # fold degrades it from the neighbor's observation alone) still
+        # carries the localization, with NO score/trend: the missing
+        # scalar must keep reading "unmeasured", never "healthy".
+        peer_only_links = sick_links_for("node-1", {"node-2": reporter})
+        assert peer_only_links and peer_only_links[0]["peer"] == "node-2"
+        peer_only = self._requestor().new_node_maintenance(
+            "node-1", policy=None, health=None, sick_links=peer_only_links
+        )
+        assert peer_only.worst_links == peer_only_links
+        assert "score" not in peer_only.node_health
+        assert "trend" not in peer_only.node_health

@@ -1,0 +1,451 @@
+"""Deterministic chaos harness (ISSUE 13; docs/chaos-harness.md).
+
+What must hold:
+
+* **fault points fire at the named points** — one dedicated pin per
+  schedule-drivable point (lease round, grant write, status write,
+  watch delivery, hub replay, wire connection, worker kill, partition):
+  the fault provably engages the instrumented site, and disarming it
+  restores normal behavior;
+* **byte-determinism** — same seed ⇒ same schedule JSON ⇒ same step
+  trace ⇒ same final cluster state (the run-twice pin), which is what
+  makes ``python -m tools.chaos_run --seed S`` a one-command repro;
+* **global invariants under schedules** — a seeded corpus over the
+  fleet e2e converges with ZERO violations: budget, no grant retired
+  unrolled, no node lost, completeness bounded, incremental==full;
+* **targeted scenarios** — worker killed between grant and pool-done
+  fails over and converges; a worker restarted mid-checkpoint arc
+  re-enters idempotently (zero spurious escalations); a hub subscriber
+  overflowing during a grant write self-resumes with no gap.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from k8s_operator_libs_tpu.api import make_fleet_rollout
+from k8s_operator_libs_tpu.kube import (
+    FakeCluster,
+    Informer,
+    LeaderElector,
+    LeaderElectionConfig,
+    Node,
+    WatchHub,
+)
+from k8s_operator_libs_tpu.kube.client import ApiError
+from k8s_operator_libs_tpu.kube.objects import KubeObject
+from k8s_operator_libs_tpu.testing.chaos import (
+    POINT_GRANT_WRITE,
+    POINT_HUB_REPLAY,
+    POINT_LEASE,
+    POINT_PARTITION,
+    POINT_STATUS_WRITE,
+    POINT_WATCH,
+    POINT_WIRE_KILL,
+    POINT_WORKER_KILL,
+    ChaosConfig,
+    FaultPlan,
+    FaultSchedule,
+    FaultSpec,
+    PartitionedClient,
+    generate_schedule,
+    run_corpus,
+    run_schedule,
+    run_seed,
+)
+from k8s_operator_libs_tpu.utils import faultpoints
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    """A crashed assertion must not leak a plan/clock into later tests
+    (the registry refuses to stack — a leak would fail every
+    chaos-adjacent test in the session)."""
+    yield
+    faultpoints.clear_plan()
+    faultpoints.clear_clock()
+
+
+def install(schedule: FaultSchedule, step: int) -> FaultPlan:
+    plan = FaultPlan(schedule)
+    plan.begin_step(step)
+    faultpoints.install_plan(plan)
+    return plan
+
+
+def one_fault(spec: FaultSpec, **config) -> FaultSchedule:
+    return FaultSchedule(
+        seed=0, config=ChaosConfig(**config), faults=[spec]
+    )
+
+
+# ---------------------------------------------------------------------------
+# Fault-point pins: each fault provably fires at its named site
+# ---------------------------------------------------------------------------
+
+
+class TestFaultPoints:
+    def test_lease_fault_denies_the_protocol_round(self):
+        """``lease.round`` in LeaderElector.try_acquire_or_renew: an
+        armed schedule fails the round (no Lease write happens at all);
+        disarmed, the same elector acquires."""
+        cluster = FakeCluster()
+        elector = LeaderElector(
+            cluster,
+            LeaderElectionConfig(
+                name="fleet-shard-00", namespace="kube-system",
+                identity="w0",
+            ),
+        )
+        plan = install(
+            one_fault(FaultSpec(
+                step=5, point=POINT_LEASE, duration=3,
+                target="fleet-shard-00",
+            )),
+            step=5,
+        )
+        assert elector.try_acquire_or_renew() is False
+        assert plan.fired[POINT_LEASE] == 1
+        assert cluster.get_or_none("Lease", "fleet-shard-00",
+                                   "kube-system") is None, (
+            "a denied round must not have touched the apiserver"
+        )
+        # Another shard's lease is untargeted — same step, acquires.
+        other = LeaderElector(
+            cluster,
+            LeaderElectionConfig(
+                name="fleet-shard-01", namespace="kube-system",
+                identity="w0",
+            ),
+        )
+        assert other.try_acquire_or_renew() is True
+        plan.begin_step(8)  # window closed: the fault heals
+        assert elector.try_acquire_or_renew() is True
+
+    def test_grant_write_fault_fires_in_the_orchestrator(self):
+        """``fleet.grant_write`` fires between the grant decision and
+        the ledger write: with conflicts armed the round is lost (no
+        grants land), healed it grants."""
+        from k8s_operator_libs_tpu.api import pools_in_phase
+        from k8s_operator_libs_tpu.api.fleet_v1alpha1 import POOL_GRANTED
+        from k8s_operator_libs_tpu.fleet import FleetOrchestrator
+
+        cluster = FakeCluster()
+        cluster.create(KubeObject(
+            make_fleet_rollout("roll", ["p0", "p1"], 1)
+        ))
+        plan = install(
+            one_fault(FaultSpec(
+                step=0, point=POINT_GRANT_WRITE, duration=1,
+                error="conflict",
+            )),
+            step=0,
+        )
+        orch = FleetOrchestrator(cluster, "roll")
+        assert orch.tick() == {"error": "conflict"}
+        assert plan.fired[POINT_GRANT_WRITE] >= 1
+        raw = cluster.get("FleetRollout", "roll").raw
+        assert pools_in_phase(raw, POOL_GRANTED) == [], (
+            "the faulted write must not have moved the ledger"
+        )
+        plan.begin_step(1)
+        assert orch.tick()["granted"] == 1
+
+    def test_status_write_fault_fires_in_the_done_report(self):
+        """``fleet.status_write`` fires inside the worker's pool-done
+        report; completion is level-derived, so the roll still
+        converges once the window closes."""
+        cfg = ChaosConfig(pools=4, workers=2, shards=2, fault_window=20)
+        schedule = FaultSchedule(seed=0, config=cfg, faults=[
+            FaultSpec(step=4, point=POINT_STATUS_WRITE, duration=6,
+                      error="server_timeout"),
+        ])
+        result = run_schedule(schedule)
+        assert result.fired.get(POINT_STATUS_WRITE, 0) >= 1, (
+            "the fault window never overlapped a done report — dead "
+            "schedule"
+        )
+        assert result.converged and result.total_violations == 0
+
+    def test_watch_hold_lags_exactly_the_targeted_informer(self):
+        """``watch.deliver`` holds ONE tagged informer's delivery: its
+        store lags the cluster while held, a peer informer of the same
+        kind stays current, and heal releases the queued events in
+        order."""
+        cluster = FakeCluster()
+        held = Informer(cluster, "Node")
+        held.chaos_tag = "w0"
+        peer = Informer(cluster, "Node")
+        peer.chaos_tag = "w1"
+        plan = install(
+            one_fault(FaultSpec(
+                step=1, point=POINT_WATCH, duration=1, target="w0",
+                param="Node",
+            )),
+            step=1,
+        )
+        with held, peer:
+            held.wait_for_sync(5)
+            peer.wait_for_sync(5)
+            node = Node.new("n0")
+            cluster.create(node)
+            deadline = time.monotonic() + 5
+            while peer.get("n0") is None:
+                assert time.monotonic() < deadline, "peer never caught up"
+                time.sleep(0.005)
+            assert held.get("n0") is None, (
+                "the held informer saw the event through the hold"
+            )
+            plan.begin_step(2)  # heal
+            deadline = time.monotonic() + 5
+            while held.get("n0") is None:
+                assert time.monotonic() < deadline, (
+                    "heal never released the held delivery"
+                )
+                time.sleep(0.005)
+
+    def test_hub_overflow_forces_the_stale_resume_path(self):
+        """``watchhub.deliver`` overflow: the subscriber's buffer is
+        dropped mid-stream and it self-resumes over the hub journal —
+        no event lost, ``stale_resumes`` counted, upstream untouched."""
+        cluster = FakeCluster()
+        for i in range(3):
+            cluster.create(Node.new(f"seed-{i}"))
+        hub = WatchHub(cluster, idle_linger_s=0.0)
+        plan = install(
+            one_fault(FaultSpec(
+                step=2, point=POINT_HUB_REPLAY, duration=1, param="Node",
+                count=1,
+            )),
+            step=0,
+        )
+        got: list[str] = []
+        done = threading.Event()
+
+        def consume():
+            rv = cluster.current_resource_version()
+            for event_type, obj in hub.watch(
+                "Node", resource_version=rv, timeout_seconds=30
+            ):
+                if event_type == "BOOKMARK":
+                    continue
+                got.append(obj.name)
+                if len(got) >= 6:
+                    done.set()
+                    return
+
+        thread = threading.Thread(target=consume, daemon=True)
+        thread.start()
+        with hub:
+            for i in range(3):
+                cluster.create(Node.new(f"pre-{i}"))
+            deadline = time.monotonic() + 5
+            while len(got) < 3 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            plan.begin_step(2)  # the next frames overflow the buffer
+            for i in range(3):
+                cluster.create(Node.new(f"post-{i}"))
+            assert done.wait(10), f"subscriber stalled after {got}"
+        assert got == [f"pre-{i}" for i in range(3)] + [
+            f"post-{i}" for i in range(3)
+        ], "self-resume lost or reordered events"
+        assert plan.fired.get(POINT_HUB_REPLAY, 0) >= 1
+
+    def test_partition_blackholes_one_identity(self):
+        """``wire.partition`` blackholes exactly the targeted client;
+        the cluster and other identities stay reachable, and heal
+        restores the path."""
+        cluster = FakeCluster()
+        cluster.create(Node.new("n0"))
+        cut = PartitionedClient(cluster, "w0")
+        ok = PartitionedClient(cluster, "w1")
+        plan = install(
+            one_fault(FaultSpec(
+                step=3, point=POINT_PARTITION, duration=2, target="w0",
+            )),
+            step=3,
+        )
+        with pytest.raises(ApiError, match="partition"):
+            cut.get("Node", "n0")
+        with pytest.raises(ApiError, match="partition"):
+            cut.update_status(cluster.get("Node", "n0"))
+        assert ok.get("Node", "n0").name == "n0"
+        assert plan.fired[POINT_PARTITION] == 2
+        plan.begin_step(5)
+        assert cut.get("Node", "n0").name == "n0"
+
+    def test_worker_kill_fails_over_and_converges(self):
+        """``worker_kill`` (no restart): the dead worker's shards go
+        stale, the survivor steals them via the lease path, and the
+        roll completes with the budget intact — the grant stays charged
+        across the handoff."""
+        cfg = ChaosConfig(pools=6, workers=2, shards=2, fault_window=30)
+        schedule = FaultSchedule(seed=0, config=cfg, faults=[
+            FaultSpec(step=6, point=POINT_WORKER_KILL, duration=1,
+                      target="w0", param="perma"),
+        ])
+        result = run_schedule(schedule)
+        assert result.fired.get(POINT_WORKER_KILL) == 1
+        assert result.converged and result.total_violations == 0
+        # The kill left w0 out of every later step's alive set.
+        killed_steps = [t for t in result.trace if t["alive"] == ["w1"]]
+        assert killed_steps, "w0 was never actually down"
+
+    def test_worker_restart_resumes_the_same_identity(self):
+        cfg = ChaosConfig(pools=4, workers=2, shards=2, fault_window=30)
+        schedule = FaultSchedule(seed=0, config=cfg, faults=[
+            FaultSpec(step=5, point=POINT_WORKER_KILL, duration=8,
+                      target="w1", param="restart"),
+        ])
+        result = run_schedule(schedule)
+        assert result.converged and result.total_violations == 0
+        alive_sets = [tuple(t["alive"]) for t in result.trace]
+        assert ("w0",) in alive_sets, "w1 was never down"
+        assert alive_sets[-1] == ("w0", "w1"), "w1 never came back"
+
+    def test_wire_kill_fires_against_a_real_server(self):
+        """``wire_kill`` aborts every live connection of a
+        LocalApiServer mid-roll; the PR 9/11 resume paths absorb it and
+        the roll converges."""
+        cfg = ChaosConfig(pools=4, workers=2, shards=2, wire=True,
+                          fault_window=20)
+        schedule = FaultSchedule(seed=0, config=cfg, faults=[
+            FaultSpec(step=5, point=POINT_WIRE_KILL, duration=1),
+        ])
+        result = run_schedule(schedule)
+        assert result.fired.get(POINT_WIRE_KILL, 0) >= 1, (
+            "no live connections were killed — dead fault"
+        )
+        assert result.converged and result.total_violations == 0
+
+
+# ---------------------------------------------------------------------------
+# Determinism
+# ---------------------------------------------------------------------------
+
+
+class TestDeterminism:
+    def test_schedule_json_round_trip_is_byte_stable(self):
+        cfg = ChaosConfig(pools=8, workers=2, shards=4)
+        schedule = generate_schedule(42, cfg)
+        text = schedule.to_json()
+        again = FaultSchedule.from_json(text)
+        assert again.to_json() == text
+        assert generate_schedule(42, cfg).to_json() == text
+        assert generate_schedule(43, cfg).to_json() != text
+
+    def test_run_twice_same_trace_same_final_state(self):
+        """The acceptance pin: same seed ⇒ same schedule JSON ⇒ same
+        step trace (every observable, every step) ⇒ same final cluster
+        state digest."""
+        schedule = generate_schedule(
+            11, ChaosConfig(pools=8, workers=2, shards=4)
+        )
+        first = run_schedule(schedule)
+        second = run_schedule(schedule)
+        assert first.schedule_json == second.schedule_json
+        assert first.trace == second.trace
+        assert first.final_digest == second.final_digest
+        assert first.fired == second.fired
+        assert first.converged and second.converged
+
+
+# ---------------------------------------------------------------------------
+# Corpus: global invariants under seeded schedules
+# ---------------------------------------------------------------------------
+
+
+class TestCorpus:
+    def test_seeded_corpus_holds_every_invariant(self):
+        summary = run_corpus(
+            range(4), ChaosConfig(pools=8, workers=2, shards=4)
+        )
+        assert summary["schedules_explored"] == 4
+        assert summary["invariant_violations"] == 0, summary
+        assert summary["not_converged"] == 0
+        assert summary["fault_points_fired"], "no fault ever fired"
+
+    @pytest.mark.slow
+    def test_wider_corpus_with_hub(self):
+        summary = run_corpus(
+            range(8),
+            ChaosConfig(pools=12, workers=2, shards=4, hub=True),
+        )
+        assert summary["invariant_violations"] == 0, summary
+        assert summary["not_converged"] == 0
+
+    def test_checkpoint_restart_schedule_no_spurious_escalation(self):
+        """Satellite pin (ISSUE 13): a worker killed mid-
+        ``checkpoint-required`` arc and restarted later re-enters via
+        the durable epoch-id path — the roll completes with ZERO
+        escalations (the workloads all ack; only a wedged workload may
+        escalate, and there is none)."""
+        cfg = ChaosConfig(pools=4, workers=2, shards=2, checkpoint=True,
+                          fault_window=30)
+        schedule = FaultSchedule(seed=0, config=cfg, faults=[
+            FaultSpec(step=8, point=POINT_WORKER_KILL, duration=12,
+                      target="w0", param="restart"),
+        ])
+        result = run_schedule(schedule)
+        assert result.converged
+        assert result.violations["checkpoint_spurious_escalations"] == 0
+        assert result.total_violations == 0
+
+    def test_completeness_aborts_are_counted_not_silent(self):
+        """Satellite pin: the corpus result surfaces the tolerated
+        BuildStateError aborts as a number (PassStats promoted them to
+        a counted signal), and the bounded-race invariant is part of
+        every run's violation set."""
+        result = run_seed(0, ChaosConfig(pools=8, workers=2, shards=4))
+        assert "completeness_races_unbounded" in result.violations
+        assert result.completeness_aborts >= 0  # counted, maybe zero
+
+
+# ---------------------------------------------------------------------------
+# Registry hygiene
+# ---------------------------------------------------------------------------
+
+
+class TestRegistry:
+    def test_plans_do_not_stack(self):
+        plan = install(
+            one_fault(FaultSpec(step=0, point=POINT_LEASE)), step=0
+        )
+        assert plan is not None
+        with pytest.raises(RuntimeError, match="already installed"):
+            faultpoints.install_plan(object())
+        faultpoints.clear_plan()
+        faultpoints.install_plan(plan)  # fine after clear
+
+    def test_no_plan_means_no_behavior_change(self):
+        faultpoints.clear_plan()
+        assert faultpoints.fault_point("lease.round", name="x") is None
+        before = time.time()
+        assert abs(faultpoints.wall_now() - before) < 5.0
+
+    def test_clock_drives_wall_now(self):
+        clock = faultpoints.ChaosClock(wall_start=123.0)
+        faultpoints.install_clock(clock)
+        assert faultpoints.wall_now() == 123.0
+        clock.advance(7.0)
+        assert faultpoints.wall_now() == 130.0
+        faultpoints.clear_clock()
+
+    def test_harness_rolls_back_only_its_own_installs(self):
+        """A run refused by the no-stacking rule (someone else's clock
+        is registered — a test fixture, say) must leave the OWNER's
+        clock installed and its own half-installed plan rolled back."""
+        mine = faultpoints.ChaosClock(wall_start=55.0)
+        faultpoints.install_clock(mine)
+        schedule = generate_schedule(
+            0, ChaosConfig(pools=2, workers=1, shards=1)
+        )
+        with pytest.raises(RuntimeError, match="already installed"):
+            run_schedule(schedule)
+        # The owner's clock survived; the refused run's plan did not.
+        assert faultpoints.wall_now() == 55.0
+        assert faultpoints.fault_point("lease.round", name="x") is None
+        faultpoints.clear_clock()

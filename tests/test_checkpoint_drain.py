@@ -116,29 +116,19 @@ def drive(cluster, sim, workload, mgr, policy, max_passes=60,
     raise AssertionError("roll did not converge")
 
 
-class FakeClock:
-    """Controllable stand-in for the durable-clock module's ``time``."""
-
-    def __init__(self, start=1_000_000.0):
-        self.now = start
-
-    def time(self):
-        return self.now
-
-    def advance(self, seconds):
-        self.now += seconds
-
-
 @pytest.fixture
-def clock(monkeypatch):
-    fake = FakeClock()
-    # advance_durable_clock lives in validation_manager; checkpoint and
-    # pod managers import the helper, which resolves time via that
-    # module's globals.
-    monkeypatch.setattr(
-        "k8s_operator_libs_tpu.upgrade.validation_manager.time", fake
-    )
-    return fake
+def clock():
+    # The durable-clock helpers (advance_durable_clock, the
+    # pod-completion wait) read wall time through the process-wide
+    # faultpoints seam — the same virtual clock the chaos harness
+    # installs (docs/chaos-harness.md), so these tests drive deadlines
+    # the way a chaos schedule does.
+    from k8s_operator_libs_tpu.utils import faultpoints
+
+    fake = faultpoints.ChaosClock(wall_start=1_000_000.0)
+    faultpoints.install_clock(fake)
+    yield fake
+    faultpoints.clear_clock()
 
 
 class TestHappyArc:
@@ -362,6 +352,73 @@ class TestDeadlineEscalation:
         )
         assert manifest == {f"{TRAIN_NS}/acker": 7}
         assert cm.totals()["escalations"] == 1
+
+    def test_restart_past_deadline_with_full_acks_does_not_escalate(
+        self, clock
+    ):
+        """ISSUE 13 satellite pin (found by the chaos worker-restart
+        schedule): a worker killed after every ack LANDED and restarted
+        after the deadline must re-enter via the durable epoch id and
+        COMPLETE the gate — the checkpoint is done, whatever the clock
+        says. Before the fix, the expiry check ran first and a finished
+        checkpoint was escalated into a cold-restart drain, stamping
+        the escalated annotation that then haunted the restore gate."""
+        cluster = FakeCluster()
+        cluster.create(make_node("node-0"))
+        sim = DaemonSetSimulator(
+            cluster, name="driver", namespace=NS, match_labels=LABELS
+        )
+        sim.settle()
+        mgr = ClusterUpgradeStateManager(
+            cluster, DEVICE, runner=TaskRunner(inline=True)
+        )
+        cm = mgr.common.checkpoint_manager
+        spec = CheckpointSpec(
+            enable=True, pod_selector=TRAIN_SELECTOR, timeout_seconds=5
+        )
+        pod = Pod.new("victim", namespace=TRAIN_NS)
+        pod.node_name = "node-0"
+        pod.labels.update({"app": "trainer"})
+        pod.phase = "Running"
+        cluster.create(pod)
+        node = Node(cluster.get("Node", "node-0").raw)
+        cm.coordinate(node, spec, UpgradeState.DRAIN_REQUIRED)
+        epoch = node.annotations[KEYS.checkpoint_start_annotation]
+        cluster.create(KubeObject(make_workload_checkpoint(
+            "victim", TRAIN_NS, "node-0", step=9, request_id=epoch
+        )))
+        cluster.patch(
+            "Pod", "victim", TRAIN_NS,
+            patch={"metadata": {"annotations": {
+                KEYS.checkpoint_complete_annotation: epoch,
+                KEYS.checkpoint_step_annotation: "9",
+            }}},
+        )
+        # The worker dies here. The RESTARTED worker's first pass runs
+        # long after the deadline — a fresh manager, the same durable
+        # state.
+        clock.advance(600)
+        restarted = ClusterUpgradeStateManager(
+            cluster, DEVICE, runner=TaskRunner(inline=True)
+        ).common.checkpoint_manager
+        node = Node(cluster.get("Node", "node-0").raw)
+        restarted.coordinate(node, spec, UpgradeState.DRAIN_REQUIRED)
+        node = Node(cluster.get("Node", "node-0").raw)
+        assert node.labels[KEYS.state_label] == str(
+            UpgradeState.DRAIN_REQUIRED
+        )
+        assert restarted.totals()["escalations"] == 0
+        assert restarted.totals()["completions"] == 1
+        assert (
+            KEYS.checkpoint_escalated_annotation not in node.annotations
+        ), "a complete checkpoint must never wear the escalated mark"
+        manifest = json.loads(
+            node.annotations[KEYS.checkpoint_manifest_annotation]
+        )
+        assert manifest == {f"{TRAIN_NS}/victim": 9}
+        # The durable clock retired with the gate: nothing left to
+        # spuriously expire a later arc.
+        assert KEYS.checkpoint_start_annotation not in node.annotations
 
     def test_disabled_spec_advances_parked_nodes(self):
         """Checkpointing withdrawn mid-roll: nodes already parked in

@@ -407,20 +407,17 @@ def test_checkpoint_requests_not_duplicated_across_aborted_passes():
     assert mgr.common.checkpoint_manager.totals()["requests"] == 2
 
 
-def test_lost_ack_hits_deadline_escalation_not_a_hang(monkeypatch):
+def test_lost_ack_hits_deadline_escalation_not_a_hang(request):
     """The ISSUE 6 acceptance pin: a workload that never acks (lost
     checkpoint-complete) escalates at the deadline and the roll
     completes — under fault injection on the node patches too."""
-    class FakeTime:
-        now = 1_000_000.0
+    from k8s_operator_libs_tpu.utils import faultpoints
 
-        @classmethod
-        def time(cls):
-            return cls.now
-
-    monkeypatch.setattr(
-        "k8s_operator_libs_tpu.upgrade.validation_manager.time", FakeTime
-    )
+    # Durable clocks read wall time through the faultpoints seam (the
+    # chaos harness's virtual-clock hook) — drive it directly.
+    fake_time = faultpoints.ChaosClock(wall_start=1_000_000.0)
+    faultpoints.install_clock(fake_time)
+    request.addfinalizer(faultpoints.clear_clock)
     cluster, sim, workload, mgr = build_checkpoint_harness(
         node_count=2, nonacking=("node-0",)
     )
@@ -439,7 +436,7 @@ def test_lost_ack_hits_deadline_escalation_not_a_hang(monkeypatch):
     for i in range(80):
         if i == 3:
             cluster.add_reactor("patch", "Node", fault)
-        FakeTime.now += 3  # wall clock marches toward the deadline
+        fake_time.advance(3)  # wall clock marches toward the deadline
         try:
             workload.step()
         except ApiError:

@@ -392,8 +392,18 @@ class TestShardScope:
             # the node would silently escape management otherwise.
             cluster.delete("Pod", sim.pod_name(f"{in_scope}-h0"), NS)
             self._wait_dirty(worker.source, f"{in_scope}-h0")
+            aborts_before = worker.mgr.completeness_aborts_total
             with pytest.raises(BuildStateError):
                 worker.mgr.build_state(NS, LABELS)
+            # The tolerated race is a COUNTED signal now (ISSUE 13):
+            # PassStats carries the lifetime total so the chaos harness
+            # (and the pass gauge) can assert it stays bounded instead
+            # of silently swallowing every abort.
+            assert worker.mgr.completeness_aborts_total == aborts_before + 1
+            assert (
+                worker.mgr.last_pass_stats.aborted_completeness_races
+                == worker.mgr.completeness_aborts_total
+            )
         finally:
             worker.stop()
 

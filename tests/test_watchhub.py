@@ -842,3 +842,54 @@ class TestHubWireMetrics:
                 assert "tpu_operator_wire_apf_admitted_total" in rendered
             finally:
                 inf.stop()
+
+
+class TestHubUnderScheduledLag:
+    """ISSUE 13 satellite: WatchHub under SCHEDULED lag — a subscriber
+    whose buffer overflows *while the grant ledger is moving* must
+    self-resume from the hub journal and converge with zero invariant
+    violations. Three seeded schedules, each arming the ``hub_replay``
+    fault at a different phase of the roll (grant burst, mid-roll,
+    completion reporting), driven by the deterministic chaos harness
+    (docs/chaos-harness.md) over hub-fed fleet workers."""
+
+    @pytest.mark.parametrize(
+        "seed,overflow_step,duration",
+        [
+            (101, 3, 2),    # the first grant burst
+            (102, 12, 3),   # mid-roll churn
+            (103, 22, 2),   # completion-report window
+        ],
+    )
+    def test_overflow_during_grant_write_converges(
+        self, seed, overflow_step, duration
+    ):
+        from k8s_operator_libs_tpu.testing.chaos import (
+            POINT_GRANT_WRITE,
+            POINT_HUB_REPLAY,
+            ChaosConfig,
+            FaultSchedule,
+            FaultSpec,
+            run_schedule,
+        )
+
+        cfg = ChaosConfig(
+            pools=6, workers=2, shards=2, hub=True, fault_window=40
+        )
+        schedule = FaultSchedule(seed=seed, config=cfg, faults=[
+            # The overflow drops every subscriber's buffer while the
+            # ledger/labels are moving: the stale self-resume must
+            # replay the deltas the dropped buffer lost. A grant-write
+            # conflict rides the same window (it only fires if a grant
+            # write actually lands there — chaos, not a precondition).
+            FaultSpec(step=overflow_step, point=POINT_HUB_REPLAY,
+                      duration=duration, count=2),
+            FaultSpec(step=overflow_step, point=POINT_GRANT_WRITE,
+                      duration=1, error="conflict", count=1),
+        ])
+        result = run_schedule(schedule)
+        assert result.converged, f"seed {seed} never converged"
+        assert result.total_violations == 0, result.violations
+        assert result.async_engaged[POINT_HUB_REPLAY], (
+            "the overflow window never saw a frame — dead schedule"
+        )

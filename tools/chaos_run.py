@@ -1,0 +1,185 @@
+"""Chaos corpus runner — seeded fleet-scale fault schedules in CI
+(docs/chaos-harness.md; the runtime analogue of ``tools/analyze``).
+
+Explore a corpus::
+
+    python -m tools.chaos_run --seeds 200
+
+Reproduce one failing seed, capturing its schedule as an artifact::
+
+    python -m tools.chaos_run --seed 17 --schedule-json out.json
+
+Replay a captured schedule file (config rides inside it)::
+
+    python -m tools.chaos_run --replay out.json
+
+Prove byte-determinism of a seed (run twice, compare traces)::
+
+    python -m tools.chaos_run --seed 17 --verify-determinism
+
+Exit status is nonzero on ANY invariant violation or non-convergence —
+the CI ``chaos`` job runs a fixed-seed corpus with no flake budget.
+The last stdout line is always one JSON summary object.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def build_config(args):
+    from k8s_operator_libs_tpu.testing.chaos import ChaosConfig
+
+    return ChaosConfig(
+        pools=args.pools,
+        hosts=args.hosts,
+        workers=args.workers,
+        shards=args.shards,
+        budget=args.budget,
+        hub=args.hub,
+        checkpoint=args.checkpoint,
+        wire=args.wire,
+        max_steps=args.max_steps,
+    )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--seeds", type=int, default=0,
+                        help="corpus mode: run seeds [start, start+N)")
+    parser.add_argument("--start-seed", type=int, default=0)
+    parser.add_argument("--seed", type=int, default=None,
+                        help="run exactly one seed")
+    parser.add_argument("--schedule-json", default="",
+                        help="write the seed's schedule JSON here "
+                             "(the repro artifact)")
+    parser.add_argument("--replay", default="",
+                        help="run a schedule JSON file instead of a seed")
+    parser.add_argument("--verify-determinism", action="store_true",
+                        help="run each schedule twice and require "
+                             "identical traces + final state")
+    parser.add_argument("--pools", type=int, default=64)
+    parser.add_argument("--hosts", type=int, default=1)
+    parser.add_argument("--workers", type=int, default=2)
+    parser.add_argument("--shards", type=int, default=4)
+    parser.add_argument("--budget", default="25%")
+    parser.add_argument("--max-steps", type=int, default=0)
+    parser.add_argument("--hub", action="store_true",
+                        help="co-hosted workers behind one WatchHub "
+                             "(arms the hub_replay fault point)")
+    parser.add_argument("--checkpoint", action="store_true",
+                        help="checkpoint-coordinated drains + victim "
+                             "workloads (arms the worker-restart-mid-"
+                             "checkpoint scenario)")
+    parser.add_argument("--wire", action="store_true",
+                        help="run over a LocalApiServer (arms wire_kill)")
+    parser.add_argument("--quiet", action="store_true")
+    args = parser.parse_args(argv)
+
+    import logging
+
+    logging.basicConfig(
+        level=logging.ERROR if args.quiet else logging.WARNING
+    )
+    from k8s_operator_libs_tpu.testing.chaos import (
+        FaultSchedule,
+        generate_schedule,
+        run_corpus,
+        run_schedule,
+    )
+
+    def run_once(schedule) -> dict:
+        result = run_schedule(schedule)
+        if args.verify_determinism:
+            second = run_schedule(schedule)
+            deterministic = (
+                result.final_digest == second.final_digest
+                and result.trace == second.trace
+            )
+        else:
+            deterministic = None
+        summary = result.summary()
+        if deterministic is not None:
+            summary["deterministic_replay"] = deterministic
+        return summary
+
+    if args.replay:
+        with open(args.replay, encoding="utf-8") as f:
+            schedule = FaultSchedule.from_json(f.read())
+        summary = run_once(schedule)
+        print(json.dumps(summary, sort_keys=True))
+        ok = summary["converged"] and not summary["total_violations"]
+        ok = ok and summary.get("deterministic_replay", True)
+        return 0 if ok else 1
+
+    config = build_config(args)
+
+    if args.seed is not None:
+        schedule = generate_schedule(args.seed, config)
+        if args.schedule_json:
+            with open(args.schedule_json, "w", encoding="utf-8") as f:
+                f.write(schedule.to_json())
+            print(f"schedule written to {args.schedule_json}",
+                  file=sys.stderr)
+        summary = run_once(schedule)
+        print(json.dumps(summary, sort_keys=True))
+        ok = summary["converged"] and not summary["total_violations"]
+        ok = ok and summary.get("deterministic_replay", True)
+        return 0 if ok else 1
+
+    if args.seeds <= 0:
+        parser.error("one of --seeds, --seed, --replay is required")
+    if args.verify_determinism:
+        # Corpus mode never re-runs schedules; silently ignoring the
+        # flag would let a nondeterminism regression pass a run the
+        # operator believes replay-verified.
+        parser.error(
+            "--verify-determinism applies to --seed/--replay only "
+            "(the run-twice check doubles corpus cost; verify a "
+            "specific seed instead)"
+        )
+
+    def progress(result) -> None:
+        line = {
+            "seed": result.seed,
+            "converged": result.converged,
+            "violations": result.total_violations,
+            "steps": result.steps,
+            "wall_s": round(result.wall_s, 3),
+        }
+        print(json.dumps(line, sort_keys=True), file=sys.stderr)
+
+    summary = run_corpus(
+        range(args.start_seed, args.start_seed + args.seeds),
+        config,
+        on_result=progress,
+    )
+    print(json.dumps(summary, sort_keys=True))
+    failed = summary["invariant_violations"] or summary["not_converged"]
+    if failed and summary["failing_seeds"]:
+        seed = summary["failing_seeds"][0]
+        # Echo the corpus's config flags: regenerating the seed under a
+        # DIFFERENT config is a different schedule, not a repro.
+        flags = [
+            f"--pools {args.pools}", f"--hosts {args.hosts}",
+            f"--workers {args.workers}", f"--shards {args.shards}",
+            f"--budget {args.budget}",
+        ]
+        if args.max_steps:
+            flags.append(f"--max-steps {args.max_steps}")
+        for switch in ("hub", "checkpoint", "wire"):
+            if getattr(args, switch):
+                flags.append(f"--{switch}")
+        print(
+            "reproduce with: python -m tools.chaos_run "
+            f"--seed {seed} {' '.join(flags)} "
+            f"--schedule-json chaos-seed-{seed}.json",
+            file=sys.stderr,
+        )
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
