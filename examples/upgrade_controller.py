@@ -223,6 +223,14 @@ def main(argv: list[str] | None = None) -> int:
         default="",
         help="Lease name (default: upgrade-controller-<device>)",
     )
+    parser.add_argument(
+        "--trace-export",
+        default="",
+        metavar="PATH",
+        help="install the rollout tracer (docs/tracing.md) for this "
+        "controller's lifetime and export the span trace JSONL to PATH "
+        "on exit — inspect with `python -m tools.trace_view PATH`",
+    )
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO, format="%(levelname)s %(message)s")
 
@@ -247,6 +255,12 @@ def main(argv: list[str] | None = None) -> int:
     metrics_server = None
     queue = None
     worker = None
+    tracer = None
+    if args.trace_export:
+        from k8s_operator_libs_tpu.utils import tracing
+
+        tracer = tracing.Tracer()
+        tracing.install_tracer(tracer)
     try:
         device = DeviceClass.tpu() if args.device == "tpu" else DeviceClass.nvidia()
         policy = load_policy(args.policy)
@@ -559,6 +573,15 @@ def main(argv: list[str] | None = None) -> int:
             metrics_server.stop()
         if elector is not None:
             elector.stop()
+        if tracer is not None:
+            from k8s_operator_libs_tpu.utils import tracing
+
+            tracing.clear_tracer()
+            count = tracer.export_jsonl(args.trace_export)
+            print(
+                f"trace: {count} spans exported to {args.trace_export}",
+                file=sys.stderr,
+            )
 
 
 def _reconcile_loop(

@@ -42,6 +42,7 @@ from ..api.fleet_v1alpha1 import (
 )
 from ..api.telemetry_v1alpha1 import trend_value
 from ..kube.client import ApiError, Client, ConflictError
+from ..utils import tracing
 from ..utils.faultpoints import fault_point
 from ..utils.log import get_logger
 
@@ -196,7 +197,22 @@ class FleetOrchestrator:
         """One grant round; returns a summary of the ledger after it."""
         self.ticks += 1
         try:
-            summary = self._grant_round()
+            # Grant attribution (docs/tracing.md): one span per round;
+            # the ledger write made under it stamps this trace as the
+            # write origin, so a worker's next pass LINKS back here —
+            # the grant → delta → reconcile causal chain.
+            with tracing.span(
+                "fleet.grant_round", category="grant",
+                rollout=self.rollout_name,
+            ) as grant_span:
+                summary = self._grant_round()
+                if grant_span is not None:
+                    grant_span.attrs.update(
+                        grants=len(summary.get("new_grants", []) or []),
+                        pending=summary.get("pending", 0),
+                    )
+                    for pool in summary.get("new_grants", []) or []:
+                        tracing.add_event("fleet.grant", pool=pool)
             if "error" not in summary and "missing" not in summary:
                 self.last_summary = dict(summary)
             return summary

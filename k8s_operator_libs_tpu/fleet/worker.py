@@ -53,6 +53,7 @@ from ..upgrade.inplace import InplaceNodeStateManager
 from ..upgrade.snapshot import DEFAULT_RESYNC_PERIOD_S
 from ..upgrade.state_manager import ClusterUpgradeStateManager
 from ..upgrade.task_runner import TaskRunner
+from ..utils import tracing
 from ..utils.faultpoints import fault_point
 from ..utils.log import get_logger
 from .hashring import HashRing
@@ -240,23 +241,25 @@ class GrantGatedInplaceManager(InplaceNodeStateManager):
             return
         granted = self.granted()
         started: dict[str, int] = {}
-        for ns in candidates:
-            node = ns.node
-            if common.is_upgrade_requested(node):
-                common.provider.change_node_upgrade_annotation(
-                    node, common.keys.upgrade_requested_annotation, NULL_STRING
+        with common._bucket_scope("upgrade-start", len(candidates)):
+            for ns in candidates:
+                node = ns.node
+                if common.is_upgrade_requested(node):
+                    common.provider.change_node_upgrade_annotation(
+                        node, common.keys.upgrade_requested_annotation,
+                        NULL_STRING,
+                    )
+                if self.pool_of(node.name) not in granted:
+                    continue  # waits for its grant (polling); no delta
+                if common.skip_node_upgrade(node):
+                    log.info("node %s is marked to skip upgrades", node.name)
+                    continue
+                common.provider.change_node_upgrade_state(
+                    node, UpgradeState.CORDON_REQUIRED
                 )
-            if self.pool_of(node.name) not in granted:
-                continue  # waits for its grant; no delta needed (polling)
-            if common.skip_node_upgrade(node):
-                log.info("node %s is marked to skip upgrades", node.name)
-                continue
-            common.provider.change_node_upgrade_state(
-                node, UpgradeState.CORDON_REQUIRED
-            )
-            started[self.pool_of(node.name)] = (
-                started.get(self.pool_of(node.name), 0) + 1
-            )
+                started[self.pool_of(node.name)] = (
+                    started.get(self.pool_of(node.name), 0) + 1
+                )
         if started:
             log.info(
                 "fleet planner: started %s (granted=%d pools)",
@@ -321,6 +324,11 @@ class ShardWorker:
         self.mgr.snapshot_source = self.source
         self.mgr.provider.set_write_through(self.source.record_write)
         self.mgr.common.pod_manager.revision_source = self.source
+        # Pass spans carry the worker identity (docs/tracing.md): co-
+        # hosted workers' otherwise identical pass spans stay
+        # distinguishable in a trace export — and the deterministic
+        # normalization needs it to disambiguate same-shaped children.
+        self.mgr.trace_attrs = {"worker": config.identity}
         if config.rollout_name:
             if self.mgr.options.use_maintenance_operator:
                 # The orchestrator dispatches upgrade-required processing
@@ -601,6 +609,11 @@ class ShardWorker:
         if not done:
             return []
 
+        report_scope = tracing.span(
+            "fleet.report_done", category="grant",
+            worker=self.config.identity, pools=sorted(done),
+        )
+
         def report() -> None:
             act = fault_point(
                 "fleet.status_write",
@@ -630,7 +643,8 @@ class ShardWorker:
                 self.client.update_status(obj)
 
         try:
-            retry_on_conflict(report)
+            with report_scope:
+                retry_on_conflict(report)
         except ApiError as e:
             # Reported again next tick — completion is level-derived
             # from node labels + pod currency, not from this write.

@@ -61,6 +61,8 @@ from .wire import (
     encode_watch_frame,
     negotiate_encoding,
 )
+from ..utils import tracing
+from ..utils.faultpoints import wall_now
 
 _PATH_RE = re.compile(
     r"^/(?:api|apis)(?:/(?P<group>[^/]+(?:\.[^/]+)*))?/(?P<version>v[^/]+)"
@@ -934,53 +936,123 @@ class LocalApiServer:
                 if request_log is not None:
                     request_log.append((req.method, req.path, dict(req.query)))
                 scheduler = self._apf_scheduler
+                # Server-side trace context (docs/tracing.md): a request
+                # carrying a traceparent joins the CLIENT's trace — its
+                # server span decomposes client-observed latency into
+                # APF queue wait (the child span below) vs dispatch, and
+                # any cluster write made during dispatch records this
+                # trace as its write origin. One global read when off.
+                tracer = tracing.tracer()
+                server_span = None
+                if tracer is not None:
+                    trace_ctx = tracing.parse_traceparent(
+                        req.header("traceparent")
+                    )
+                    server_span = tracer.start_span(
+                        "server.request", category="wire",
+                        trace_id=trace_ctx[0] if trace_ctx else None,
+                        # "" (not None): an uncontexted request is a
+                        # fresh root, never a child of a leaked span.
+                        parent_id=trace_ctx[1] if trace_ctx else "",
+                        attrs={"method": req.method, "path": req.path},
+                    )
                 try:
-                    if scheduler is not None:
-                        flow = classify_flow(req.method, req.path)
-                        result = await scheduler.submit(
-                            flow, lambda: self._dispatcher.dispatch(req)
+                    try:
+                        if scheduler is not None:
+                            flow = classify_flow(req.method, req.path)
+                            enqueued = (
+                                wall_now() if server_span is not None
+                                else 0.0
+                            )
+                            dispatched = [0.0]
+
+                            def _dispatch_traced(
+                                req=req, server_span=server_span,
+                                dispatched=dispatched,
+                            ):
+                                dispatched[0] = wall_now()
+                                with tracing.use_span(server_span):
+                                    return self._dispatcher.dispatch(req)
+
+                            try:
+                                result = await scheduler.submit(
+                                    flow, _dispatch_traced
+                                )
+                            finally:
+                                # Record the queue wait even when
+                                # dispatch RAISED (routine 404/409 —
+                                # error-heavy storms are exactly where
+                                # queue attribution matters); a shed
+                                # never dispatched, so dispatched[0]
+                                # stays 0 and nothing is recorded.
+                                if server_span is not None and (
+                                    dispatched[0]
+                                ):
+                                    server_span.attrs["flow"] = flow
+                                    tracer.add_span(
+                                        "apf.queue", category="queue",
+                                        start=enqueued,
+                                        end=dispatched[0],
+                                        parent=server_span,
+                                        attrs={"flow": flow},
+                                    )
+                        else:
+                            with tracing.use_span(server_span):
+                                result = self._dispatcher.dispatch(req)
+                    except _ApfShed:
+                        # Shed, not queued: the flow is over its depth.
+                        # The client backs off per Retry-After and
+                        # retries; the connection stays healthy
+                        # (keep-alive preserved).
+                        if server_span is not None:
+                            server_span.attrs["status"] = 429
+                        await self._write_response(
+                            writer, 429,
+                            _status_body(
+                                429, "TooManyRequests",
+                                "request shed by priority-and-fairness; "
+                                "retry after backoff",
+                            ),
+                            "json", keep_alive=req.keep_alive,
+                            extra_headers={
+                                "Retry-After": f"{self.apf.retry_after_s:g}"
+                            },
                         )
+                        if not req.keep_alive:
+                            return
+                        continue
+                    except ApiError as e:
+                        result = _Response(
+                            e.status,
+                            _status_body(e.status, e.reason, e.message),
+                        )
+                    except Exception as e:  # noqa: BLE001 - surfaced as 500
+                        result = _Response(
+                            500, _status_body(500, "InternalError", str(e))
+                        )
+                    if isinstance(result, _WatchParams):
+                        if server_span is not None:
+                            # The span measures dispatch, not the stream's
+                            # lifetime; end it before streaming (end_span
+                            # is idempotent for the finally below).
+                            server_span.attrs["status"] = "watch"
+                            tracer.end_span(server_span)
+                        await self._stream_watch(writer, req, result)
                     else:
-                        result = self._dispatcher.dispatch(req)
-                except _ApfShed:
-                    # Shed, not queued: the flow is over its depth. The
-                    # client backs off per Retry-After and retries; the
-                    # connection stays healthy (keep-alive preserved).
-                    await self._write_response(
-                        writer, 429,
-                        _status_body(
-                            429, "TooManyRequests",
-                            "request shed by priority-and-fairness; "
-                            "retry after backoff",
-                        ),
-                        "json", keep_alive=req.keep_alive,
-                        extra_headers={
-                            "Retry-After": f"{self.apf.retry_after_s:g}"
-                        },
-                    )
-                    if not req.keep_alive:
-                        return
-                    continue
-                except ApiError as e:
-                    result = _Response(
-                        e.status, _status_body(e.status, e.reason, e.message)
-                    )
-                except Exception as e:  # noqa: BLE001 - surfaced as 500
-                    result = _Response(
-                        500, _status_body(500, "InternalError", str(e))
-                    )
-                if isinstance(result, _WatchParams):
-                    await self._stream_watch(writer, req, result)
-                else:
-                    encoding = (
-                        "json"
-                        if accepts_table(req.header("Accept"))
-                        else negotiate_encoding(req.header("Accept"))
-                    )
-                    await self._write_response(
-                        writer, result.status, result.body, encoding,
-                        keep_alive=req.keep_alive,
-                    )
+                        if server_span is not None:
+                            server_span.attrs["status"] = result.status
+                        encoding = (
+                            "json"
+                            if accepts_table(req.header("Accept"))
+                            else negotiate_encoding(req.header("Accept"))
+                        )
+                        await self._write_response(
+                            writer, result.status, result.body, encoding,
+                            keep_alive=req.keep_alive,
+                        )
+                finally:
+                    if server_span is not None:
+                        tracer.end_span(server_span)
                 if not req.keep_alive:
                     return
         except (ConnectionError, asyncio.IncompleteReadError):

@@ -48,6 +48,7 @@ from .objects import (
 )
 from .resources import resource_for_kind
 from .selectors import LabelSelector, parse_field_selector, parse_selector
+from ..utils import tracing
 from .ssa import reassign_on_write, server_side_apply
 from .structural import (
     error_root_field,
@@ -1090,6 +1091,13 @@ class FakeCluster(Client):
             rv = int((snapshot.get("metadata") or {}).get("resourceVersion"))
         except (TypeError, ValueError):
             rv = next(self._rv)  # defensive: journal stays ordered
+        # Trace write-origin hook (docs/tracing.md): remember which trace
+        # performed this write, keyed by rv. Informer deliveries — over a
+        # direct watch, a hub resume replay, or a reconnected wire stream
+        # alike — link their span to it, so a reconcile pass can be
+        # traced back to the write that woke it. One global read when
+        # tracing is off.
+        tracing.record_write_origin(rv)
         self._history.append((rv, event, snapshot, old_snapshot))
         for fn in list(self._watchers):
             fn(event, snapshot, old_snapshot)

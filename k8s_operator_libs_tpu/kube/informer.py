@@ -42,6 +42,7 @@ from typing import Callable, Mapping, Optional
 from .client import Client, WatchExpiredError
 from .objects import KubeObject, deep_copy_json, wrap
 from .selectors import parse_selector
+from ..utils import tracing
 from ..utils.faultpoints import chaos_hold
 from ..utils.log import get_logger
 
@@ -472,6 +473,47 @@ class Informer:
         return (meta.get("namespace", ""), meta.get("name", ""))
 
     def _dispatch(self, event: str, raw: dict, old: Optional[dict]) -> None:
+        tracer = tracing.tracer()
+        if tracer is None:
+            # THE hot path: one global read, nothing else.
+            self._dispatch_inner(event, raw, old)
+            return
+        # Delivery attribution (docs/tracing.md): the span JOINS the
+        # originating write's trace (write-origin book, keyed by rv —
+        # which survives watch windows, killed connections, and hub
+        # journal replays) and STARTS at the write's wall time, so its
+        # duration IS the write→dispatched delivery lag. Handlers run
+        # inside it: a dirty-mark made by a snapshot-source handler
+        # records this trace as a wake of the next reconcile pass.
+        meta = raw.get("metadata") or {}
+        rv = str(meta.get("resourceVersion", ""))
+        origin = tracer.write_origin(rv)
+        attrs = {
+            "kind": self.kind, "name": meta.get("name", ""),
+            "event": event, "rv": rv,
+        }
+        if self.chaos_tag:
+            # Consumer identity: two co-hosted workers deliver the SAME
+            # origin-less rv as otherwise byte-identical root spans —
+            # the tag keeps the deterministic export's content ordering
+            # stable (docs/tracing.md, determinism under chaos).
+            attrs["consumer"] = self.chaos_tag
+        deliver_span = tracer.start_span(
+            "informer.deliver", category="wire",
+            trace_id=origin[0] if origin else None,
+            parent_id=origin[1] if origin else "",
+            start=origin[2] if origin else None,
+            attrs=attrs,
+        )
+        try:
+            with tracing.use_span(deliver_span):
+                self._dispatch_inner(event, raw, old)
+        finally:
+            tracer.end_span(deliver_span)
+
+    def _dispatch_inner(
+        self, event: str, raw: dict, old: Optional[dict]
+    ) -> None:
         obj = wrap(raw)
         old_obj = wrap(old) if old is not None else None
         with self._dispatch_lock:

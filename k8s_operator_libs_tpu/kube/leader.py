@@ -43,6 +43,7 @@ from typing import Any, Callable, Optional
 
 from .client import ApiError, Client, ConflictError, NotFoundError
 from .objects import Lease
+from ..utils import tracing
 from ..utils.faultpoints import fault_point
 
 log = logging.getLogger(__name__)
@@ -178,6 +179,20 @@ class LeaderElector:
         """One protocol round; returns True iff this identity holds the
         lease afterwards. Never raises on API errors (a flaky apiserver
         must surface as lost renewals, not a crashed elector)."""
+        cfg = self.config
+        # Lease attribution (docs/tracing.md): one span per protocol
+        # round — a roll stalled behind failover shows as a run of
+        # held=False lease spans. Null scope when tracing is off.
+        with tracing.span(
+            "lease.round", category="lease",
+            lease=cfg.name, identity=cfg.identity,
+        ) as round_span:
+            held = self._try_acquire_or_renew()
+            if round_span is not None:
+                round_span.attrs["held"] = held
+            return held
+
+    def _try_acquire_or_renew(self) -> bool:
         cfg = self.config
         if fault_point("lease.round", name=cfg.name,
                        identity=cfg.identity) is not None:

@@ -60,6 +60,7 @@ from .client import (
 )
 from .objects import KubeObject, wrap
 from .resources import ResourceInfo, resource_for_kind
+from ..utils import tracing
 from .wire import (
     CLIENT_ACCEPT_COMPACT,
     COMPACT_CONTENT_TYPE,
@@ -850,6 +851,13 @@ class RestClient(Client):
             headers["Content-Type"] = content_type
         if self.config.token:
             headers["Authorization"] = f"Bearer {self.config.token}"
+        # Wire-propagated trace context (docs/tracing.md): every request
+        # made under an active span carries the W3C-style traceparent,
+        # so the server's span — and the write origin it records — joins
+        # the caller's trace. One global read when tracing is off.
+        traceparent = tracing.traceparent()
+        if traceparent is not None:
+            headers["traceparent"] = traceparent
         return headers
 
     def _encode_write_body(
@@ -882,39 +890,60 @@ class RestClient(Client):
         if body is not None:
             data, content_type = self._encode_write_body(body, content_type)
         shed_retries = max(0, int(self.config.too_many_requests_retries))
-        for attempt in range(shed_retries + 1):
-            try:
-                status, rheaders, payload = self._call(
-                    self._transport.request(
-                        method, url, self._headers(data, content_type), data
+        # ONE logical request span regardless of transparent retries
+        # (docs/tracing.md): each shed retry gets a child attempt span,
+        # so a trace shows "one request, N shed attempts" — never N
+        # unrelated requests. Null scope when tracing is off.
+        with tracing.span(
+            "http.request", category="wire", method=method, path=path
+        ) as request_span:
+            for attempt in range(shed_retries + 1):
+                attempt_scope = (
+                    tracing.span("http.attempt", category="wire",
+                                 attempt=attempt)
+                    if request_span is not None and attempt > 0
+                    else tracing.use_span(None)
+                )
+                with attempt_scope:
+                    try:
+                        status, rheaders, payload = self._call(
+                            self._transport.request(
+                                method, url,
+                                self._headers(data, content_type), data,
+                            )
+                        )
+                    except _TransportError as e:
+                        raise ApiError(f"{method} {url}: {e}") from None
+                response_ct = rheaders.get("content-type")
+                if is_compact_content_type(response_ct):
+                    self._server_speaks_compact = True
+                if request_span is not None:
+                    request_span.attrs["status"] = status
+                if status == 429:
+                    # Shed by the server's priority-and-fairness layer:
+                    # honor Retry-After with a bounded transparent retry —
+                    # the typed-error retry path the APF contract names
+                    # (docs/wire-path.md). Safe for any verb: a shed
+                    # request never entered the server's dispatch.
+                    retry_after = _retry_after_seconds(
+                        rheaders, self.config.retry_after_cap_s
                     )
-                )
-            except _TransportError as e:
-                raise ApiError(f"{method} {url}: {e}") from None
-            response_ct = rheaders.get("content-type")
-            if is_compact_content_type(response_ct):
-                self._server_speaks_compact = True
-            if status == 429:
-                # Shed by the server's priority-and-fairness layer:
-                # honor Retry-After with a bounded transparent retry —
-                # the typed-error retry path the APF contract names
-                # (docs/wire-path.md). Safe for any verb: a shed request
-                # never entered the server's dispatch.
-                retry_after = _retry_after_seconds(
-                    rheaders, self.config.retry_after_cap_s
-                )
-                if attempt < shed_retries:
-                    time.sleep(retry_after)
-                    continue
-                error = self._api_error(status, payload, response_ct)
-                if isinstance(error, TooManyRequestsError):
-                    error.retry_after_s = retry_after
-                raise error
-            if status >= 400:
-                raise self._api_error(status, payload, response_ct)
-            if not payload:
-                return {}
-            return decode_body(payload, response_ct)
+                    if attempt < shed_retries:
+                        with tracing.span(
+                            "http.backoff", category="queue",
+                            retry_after=retry_after,
+                        ):
+                            time.sleep(retry_after)
+                        continue
+                    error = self._api_error(status, payload, response_ct)
+                    if isinstance(error, TooManyRequestsError):
+                        error.retry_after_s = retry_after
+                    raise error
+                if status >= 400:
+                    raise self._api_error(status, payload, response_ct)
+                if not payload:
+                    return {}
+                return decode_body(payload, response_ct)
         raise AssertionError("unreachable")  # pragma: no cover
 
     @staticmethod

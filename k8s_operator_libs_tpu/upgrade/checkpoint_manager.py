@@ -58,6 +58,7 @@ from ..api.upgrade_v1alpha1 import (
 )
 from ..kube.client import Client
 from ..kube.objects import Node, Pod
+from ..utils import tracing
 from ..utils.log import get_logger
 from .consts import NULL_STRING, TRUE_STRING, UpgradeKeys, UpgradeState
 from .state_provider import NodeUpgradeStateProvider
@@ -203,6 +204,14 @@ class CheckpointManager:
                     },
                 )
                 self._count("requests")
+                # Flight recorder: the request leg of the request→ack→
+                # manifest arc, on the checkpoint bucket span.
+                tracing.add_event(
+                    "checkpoint.request",
+                    node=node.name,
+                    pod=f"{pod.namespace}/{pod.name}",
+                    epoch=epoch,
+                )
         acked = self._acked(pods, epoch)
         if len(acked) < len(pods):
             log.info(
@@ -233,6 +242,9 @@ class CheckpointManager:
         )
         self._advance(node, next_state)
         self._count("completions")
+        tracing.add_event(
+            "checkpoint.complete", node=node.name, acked=len(acked)
+        )
         self._event(node, "Normal", message)
 
     def _acked(self, pods: list[Pod], epoch: Optional[str]) -> list[Pod]:
@@ -277,6 +289,10 @@ class CheckpointManager:
         )
         self._advance(node, next_state)
         self._count("escalations")
+        tracing.add_event(
+            "checkpoint.escalate",
+            node=node.name, acked=len(acked), pods=len(pods),
+        )
         log.warning(
             "checkpoint deadline expired on node %s (%d/%d acks); "
             "escalating to a plain drain",
@@ -354,6 +370,10 @@ class CheckpointManager:
         if not missing:
             self._clear_restore_state(node)
             self._count("restores_verified")
+            tracing.add_event(
+                "checkpoint.restore_verified",
+                node=node.name, checkpoints=len(manifest),
+            )
             log.info(
                 "node %s: %d checkpoint(s) verified restorable; uncordon "
                 "may proceed", node.name, len(manifest),

@@ -8,6 +8,7 @@ orchestrator compose on top.
 
 from __future__ import annotations
 
+import time
 from collections import defaultdict
 from dataclasses import dataclass, field
 from typing import Callable, Mapping, Optional, Sequence, TypeVar
@@ -22,6 +23,7 @@ from ..api.upgrade_v1alpha1 import (
 )
 from ..kube.client import Client
 from ..kube.objects import DaemonSet, KubeObject, Node, Pod
+from ..utils import tracing
 from ..utils.log import get_logger
 from .consts import (
     IDLE_STATES,
@@ -44,6 +46,17 @@ from .validation_manager import ValidationManager
 log = get_logger("upgrade.common")
 
 T = TypeVar("T")
+
+#: Bucket-label prefix -> trace attribution category (docs/tracing.md).
+#: Anything unlisted is reconcile work; ``what`` labels like
+#: ``classify[unknown]`` map through their prefix.
+_BUCKET_CATEGORIES = {
+    "checkpoint": "checkpoint",
+    "validation": "probe",
+    "drain-sched": "drain",
+    "pod-deletion": "drain",
+    "wait-for-jobs-poll": "drain",
+}
 
 
 @dataclass
@@ -200,6 +213,21 @@ class CommonUpgradeManager:
         self.apply_width = apply_width
         self.pod_deletion_enabled = False
         self.validation_enabled = False
+        #: Per-bucket apply timings for the CURRENT pass (bucket label ->
+        #: seconds). Reset by the orchestrator at apply_state entry,
+        #: snapshotted into ``PassStats.bucket_seconds`` in its finally —
+        #: the gauge-side twin of the pass span's bucket children
+        #: (``tpu_operator_upgrade_pass_bucket_seconds``). Reconcile
+        #: thread only (buckets join before the next one starts); empty
+        #: buckets record nothing, so a settled pass leaves it empty.
+        self.bucket_seconds: dict[str, float] = {}
+        #: Lazy pass-span trigger (docs/tracing.md): set by the
+        #: orchestrator when tracing is on and the settled snapshot
+        #: opened no pass span — the FIRST non-empty bucket calls it
+        #: (then it self-clears), so a pass whose only work is a polling
+        #: bucket still gets a span while a fully settled pass touches
+        #: nothing: zero buckets run, zero spans, zero allocations.
+        self.on_first_bucket = None
         #: Reference parity default (common_manager.go:714-731): nodes in
         #: the two maintenance states do NOT count as managed/in-progress
         #: — so base requestor mode does not reserve budget for them (the
@@ -330,6 +358,45 @@ class CommonUpgradeManager:
     # ------------------------------------------------------------------
     # Per-state processors
     # ------------------------------------------------------------------
+    class _BucketScope:
+        """Times one non-empty apply bucket into ``bucket_seconds`` and
+        — when tracing is on — wraps it in a child span of the pass span
+        (docs/tracing.md). Instantiated only for non-empty buckets, so a
+        settled pass allocates nothing here."""
+
+        __slots__ = ("_common", "_what", "_span_scope", "_t0")
+
+        def __init__(self, common, what: str, count: int) -> None:
+            self._common = common
+            self._what = what
+            self._span_scope = tracing.span(
+                f"bucket.{what}", category=_BUCKET_CATEGORIES.get(
+                    what.split("[", 1)[0], "reconcile"
+                ), bucket=what, nodes=count,
+            )
+            self._t0 = 0.0
+
+        def __enter__(self) -> "CommonUpgradeManager._BucketScope":
+            trigger = self._common.on_first_bucket
+            if trigger is not None:
+                # First real work this pass: open the lazy pass span so
+                # this bucket span parents into it (thread-current).
+                trigger()
+            self._span_scope.__enter__()
+            self._t0 = time.perf_counter()
+            return self
+
+        def __exit__(self, *exc) -> None:
+            elapsed = time.perf_counter() - self._t0
+            seconds = self._common.bucket_seconds
+            self._common.bucket_seconds[self._what] = (
+                seconds.get(self._what, 0.0) + elapsed
+            )
+            self._span_scope.__exit__(*exc)
+
+    def _bucket_scope(self, what: str, count: int) -> "_BucketScope":
+        return self._BucketScope(self, what, count)
+
     def _for_each(
         self,
         what: str,
@@ -350,7 +417,8 @@ class CommonUpgradeManager:
         ]
         if not tasks:
             return
-        errors = self.runner.run_bucket(tasks, width=self.apply_width)
+        with self._bucket_scope(what, len(tasks)):
+            errors = self.runner.run_bucket(tasks, width=self.apply_width)
         failures = [
             (tasks[i][0], e) for i, e in enumerate(errors) if e is not None
         ]
@@ -480,17 +548,18 @@ class CommonUpgradeManager:
         nodes = [ns.node for ns in state.nodes_in(UpgradeState.WAIT_FOR_JOBS_REQUIRED)]
         if not nodes:
             return
-        self.pod_manager.schedule_check_on_pod_completion(
-            PodManagerConfig(
-                nodes=nodes,
-                wait_for_completion_spec=wait_spec,
-                completion_next_state=(
-                    UpgradeState.CHECKPOINT_REQUIRED
-                    if checkpoint_enabled
-                    else UpgradeState.POD_DELETION_REQUIRED
-                ),
+        with self._bucket_scope("wait-for-jobs-poll", len(nodes)):
+            self.pod_manager.schedule_check_on_pod_completion(
+                PodManagerConfig(
+                    nodes=nodes,
+                    wait_for_completion_spec=wait_spec,
+                    completion_next_state=(
+                        UpgradeState.CHECKPOINT_REQUIRED
+                        if checkpoint_enabled
+                        else UpgradeState.POD_DELETION_REQUIRED
+                    ),
+                )
             )
-        )
 
     def process_checkpoint_required_nodes(
         self,
@@ -654,13 +723,14 @@ class CommonUpgradeManager:
             return
         if not nodes:
             return
-        self.pod_manager.schedule_pod_eviction(
-            PodManagerConfig(
-                nodes=nodes,
-                deletion_spec=deletion_spec or PodDeletionSpec(),
-                drain_enabled=drain_enabled,
+        with self._bucket_scope("pod-deletion", len(nodes)):
+            self.pod_manager.schedule_pod_eviction(
+                PodManagerConfig(
+                    nodes=nodes,
+                    deletion_spec=deletion_spec or PodDeletionSpec(),
+                    drain_enabled=drain_enabled,
+                )
             )
-        )
 
     def process_drain_nodes(
         self, state: ClusterUpgradeState, drain_spec: Optional[DrainSpec]
@@ -674,9 +744,10 @@ class CommonUpgradeManager:
             return
         if not nodes:
             return
-        self.drain_manager.schedule_nodes_drain(
-            DrainConfiguration(spec=drain_spec, nodes=nodes)
-        )
+        with self._bucket_scope("drain-sched", len(nodes)):
+            self.drain_manager.schedule_nodes_drain(
+                DrainConfiguration(spec=drain_spec, nodes=nodes)
+            )
 
     def process_pod_restart_nodes(self, state: ClusterUpgradeState) -> None:
         """Restart out-of-sync driver pods; unblock safe load; advance
@@ -797,14 +868,20 @@ class CommonUpgradeManager:
         ICI health gate runs collectives on the probe devices) and the
         slice-scoped gate memoizes per-slice results — concurrent hook
         invocations would race the devices for no read/write-path win."""
-        for ns in state.nodes_in(UpgradeState.VALIDATION_REQUIRED):
-            # The driver may have restarted after reaching this state and be
-            # blocked on safe load again (reference: :578-585).
-            self.safe_load_manager.unblock_loading(ns.node)
-            if not self.validation_manager.validate(ns.node):
-                log.info("validation not complete on node %s", ns.node.name)
-                continue
-            self.update_node_to_uncordon_or_done_state(ns)
+        node_states = state.nodes_in(UpgradeState.VALIDATION_REQUIRED)
+        if not node_states:
+            return
+        with self._bucket_scope("validation", len(node_states)):
+            for ns in node_states:
+                # The driver may have restarted after reaching this state
+                # and be blocked on safe load again (reference: :578-585).
+                self.safe_load_manager.unblock_loading(ns.node)
+                if not self.validation_manager.validate(ns.node):
+                    log.info(
+                        "validation not complete on node %s", ns.node.name
+                    )
+                    continue
+                self.update_node_to_uncordon_or_done_state(ns)
 
     def update_node_to_uncordon_or_done_state(
         self, node_state: NodeUpgradeState

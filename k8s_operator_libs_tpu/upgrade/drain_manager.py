@@ -15,6 +15,7 @@ from ..api.upgrade_v1alpha1 import DrainSpec
 from ..kube.client import Client
 from ..kube.drain import DrainConfig, DrainError, DrainHelper
 from ..kube.objects import Node
+from ..utils import tracing
 from ..utils.log import get_logger
 from .consts import TRUE_STRING, UpgradeKeys, UpgradeState
 from .state_provider import NodeUpgradeStateProvider
@@ -82,18 +83,28 @@ class DrainManager:
         self, helper: DrainHelper, drain_cfg: DrainConfig, node: Node
     ) -> None:
         def task() -> None:
-            try:
-                helper.drain(node.name, drain_cfg)
-            except DrainError as e:
-                log.error("drain of node %s failed: %s", node.name, e)
-                self._provider.change_node_upgrade_state(node, UpgradeState.FAILED)
-                self._event(node, "Warning", f"Failed to drain the node, {e}")
-                return
-            log.info("drained node %s", node.name)
-            self._event(node, "Normal", "Successfully drained the node")
-            self._provider.change_node_upgrade_state(
-                node, UpgradeState.POD_RESTART_REQUIRED
-            )
+            # The drain WAIT is its own span (category "drain"): the
+            # task runs async after the scheduling pass — TaskRunner
+            # carried the pass/bucket span context here, so this span
+            # still parents into the pass that scheduled it.
+            with tracing.span("drain.node", category="drain",
+                              node=node.name):
+                try:
+                    helper.drain(node.name, drain_cfg)
+                except DrainError as e:
+                    log.error("drain of node %s failed: %s", node.name, e)
+                    self._provider.change_node_upgrade_state(
+                        node, UpgradeState.FAILED
+                    )
+                    self._event(
+                        node, "Warning", f"Failed to drain the node, {e}"
+                    )
+                    return
+                log.info("drained node %s", node.name)
+                self._event(node, "Normal", "Successfully drained the node")
+                self._provider.change_node_upgrade_state(
+                    node, UpgradeState.POD_RESTART_REQUIRED
+                )
 
         if self._runner.submit(node.name, task):
             self._event(node, "Normal", self._drain_flavor(node))

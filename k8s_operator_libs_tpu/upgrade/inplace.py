@@ -70,30 +70,34 @@ class InplaceNodeStateManager:
             total,
             max_unavailable,
         )
-        for ns in state.nodes_in(UpgradeState.UPGRADE_REQUIRED):
-            node = ns.node
-            if common.is_upgrade_requested(node):
-                # Clear the one-shot request annotation (reference: :72-80).
-                common.provider.change_node_upgrade_annotation(
-                    node, common.keys.upgrade_requested_annotation, NULL_STRING
-                )
-            if common.skip_node_upgrade(node):
-                log.info("node %s is marked to skip upgrades", node.name)
-                continue
-            if available <= 0:
-                # Budget exhausted: only already-cordoned nodes proceed —
-                # upgrading them adds no new unavailability
-                # (reference: :87-97).
-                if not node.unschedulable:
+        candidates = state.nodes_in(UpgradeState.UPGRADE_REQUIRED)
+        with common._bucket_scope("upgrade-start", len(candidates)):
+            for ns in candidates:
+                node = ns.node
+                if common.is_upgrade_requested(node):
+                    # Clear the one-shot request annotation
+                    # (reference: :72-80).
+                    common.provider.change_node_upgrade_annotation(
+                        node, common.keys.upgrade_requested_annotation,
+                        NULL_STRING,
+                    )
+                if common.skip_node_upgrade(node):
+                    log.info("node %s is marked to skip upgrades", node.name)
                     continue
-                log.info(
-                    "node %s already cordoned, proceeding despite budget",
-                    node.name,
+                if available <= 0:
+                    # Budget exhausted: only already-cordoned nodes
+                    # proceed — upgrading them adds no new unavailability
+                    # (reference: :87-97).
+                    if not node.unschedulable:
+                        continue
+                    log.info(
+                        "node %s already cordoned, proceeding despite "
+                        "budget", node.name,
+                    )
+                common.provider.change_node_upgrade_state(
+                    node, UpgradeState.CORDON_REQUIRED
                 )
-            common.provider.change_node_upgrade_state(
-                node, UpgradeState.CORDON_REQUIRED
-            )
-            available -= 1
+                available -= 1
 
     def process_node_maintenance_required_nodes(
         self, state: ClusterUpgradeState

@@ -280,6 +280,13 @@ class UpgradeMetrics:
         self._device = device_label or manager.keys.device.name
         self._lock = threading.Lock()
         self._values: dict[str, "int | float"] = {}
+        #: bucket label -> wall seconds from the most recent pass that
+        #: ran any apply bucket (``PassStats.bucket_seconds``). Updated
+        #: only when non-empty so a settled pool keeps exporting the
+        #: last roll activity's timings with a stable label set —
+        #: the gauge-side twin of the pass span's bucket children
+        #: (docs/tracing.md).
+        self._bucket_seconds: dict[str, float] = {}
         self._reconcile_passes = 0
         #: Entry-order tickets for observe(): values are computed outside
         #: the lock, so two concurrent observes can reach the commit in
@@ -309,6 +316,7 @@ class UpgradeMetrics:
         # Phase accounting rides along when the manager records it (the
         # orchestrator does; bare CommonUpgradeManager doubles don't).
         pass_stats = getattr(self._manager, "last_pass_stats", None)
+        bucket_seconds: dict[str, float] = {}
         if pass_stats is not None:
             for suffix, _, attr in _ALL_PASS_GAUGES:
                 raw = getattr(pass_stats, attr, 0)
@@ -318,31 +326,54 @@ class UpgradeMetrics:
                     values[suffix] = round(raw, 6)
                 else:
                     values[suffix] = raw
+            bucket_seconds = {
+                bucket: round(float(seconds), 6)
+                for bucket, seconds in getattr(
+                    pass_stats, "bucket_seconds", {}
+                ).items()
+            }
         with self._lock:
             self._reconcile_passes += 1
             if ticket > self._committed:
                 self._committed = ticket
                 self._values.update(values)
+                if bucket_seconds:
+                    self._bucket_seconds = bucket_seconds
 
     def render(self) -> str:
         label = prom_label("device", self._device)
         with self._lock:
             rows = [
-                (suffix, "gauge", help_text, self._values.get(suffix, 0))
+                (suffix, "gauge", help_text,
+                 [(label, self._values.get(suffix, 0))])
                 for suffix, help_text, _ in _GAUGES
             ]
             # Phase gauges only once a pass recorded them — an exporter
             # over a bare manager double stays byte-stable.
             rows.extend(
-                (suffix, "gauge", help_text, self._values[suffix])
+                (suffix, "gauge", help_text, [(label, self._values[suffix])])
                 for suffix, help_text, _ in _ALL_PASS_GAUGES
                 if suffix in self._values
             )
+            if self._bucket_seconds:
+                rows.append((
+                    "pass_bucket_seconds", "gauge",
+                    "Per-bucket apply wall seconds of the most recent "
+                    "pass that ran any bucket (the gauge twin of the "
+                    "pass span's bucket children; docs/tracing.md)",
+                    [
+                        (merge_label(label, "bucket", bucket), seconds)
+                        for bucket, seconds in sorted(
+                            self._bucket_seconds.items()
+                        )
+                    ],
+                ))
             rows.append(
                 ("reconcile_passes_total", "counter",
-                 "Reconcile passes observed", self._reconcile_passes)
+                 "Reconcile passes observed", [(label,
+                                                self._reconcile_passes)])
             )
-        return render_rows(_PREFIX, label, rows)
+        return render_samples(_PREFIX, rows)
 
 
 _WIRE_PREFIX = "tpu_operator_wire"

@@ -15,6 +15,7 @@ from ..api.upgrade_v1alpha1 import PodDeletionSpec, WaitForCompletionSpec
 from ..kube.client import Client, NotFoundError
 from ..kube.drain import DrainConfig, DrainError, DrainHelper
 from ..kube.objects import ControllerRevision, DaemonSet, Node, Pod
+from ..utils import tracing
 from ..utils.faultpoints import wall_now
 from ..utils.log import get_logger
 from .consts import NULL_STRING, UpgradeKeys, UpgradeState
@@ -181,6 +182,15 @@ class PodManager:
                 log.info("node %s already getting pods deleted, skipping", node.name)
 
     def _evict_one(
+        self, node: Node, spec: PodDeletionSpec, config: PodManagerConfig
+    ) -> None:
+        # Eviction-wait attribution (docs/tracing.md): like the drain
+        # task, this async wait gets its own span parented into the
+        # scheduling pass (TaskRunner carried the context here).
+        with tracing.span("evict.node", category="drain", node=node.name):
+            self._evict_one_inner(node, spec, config)
+
+    def _evict_one_inner(
         self, node: Node, spec: PodDeletionSpec, config: PodManagerConfig
     ) -> None:
         assert self._filter is not None

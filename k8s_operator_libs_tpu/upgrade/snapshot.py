@@ -50,6 +50,7 @@ from ..kube.objects import (
     Node,
     Pod,
 )
+from ..utils import tracing
 from ..utils.log import get_logger
 
 if TYPE_CHECKING:  # avoid a snapshot <-> common_manager import cycle
@@ -486,6 +487,13 @@ class IncrementalSnapshotSource(InformerSnapshotSource):
         #: deltas — the completeness invariant's O(#DS) read on delta
         #: passes (the full path counts by scanning the pod list).
         self._ds_pod_counts: dict[str, int] = {}
+        #: Trace ids of the writes whose deltas dirtied this book since
+        #: the last consuming pass (docs/tracing.md): informer dispatch
+        #: runs handlers inside the delivery span, which joined the
+        #: originating write's trace — so the next pass span can LINK to
+        #: the writes that woke it. Bounded; empty whenever tracing is
+        #: off (current_trace_id is one global read then).
+        self._wake_traces: list[str] = []
         # Cached classification (reconcile thread only; see class doc).
         self._state: Optional["ClusterUpgradeState"] = None
         self._assignment: dict[
@@ -515,6 +523,20 @@ class IncrementalSnapshotSource(InformerSnapshotSource):
     def _mark_node_locked(self, name: str) -> None:
         self._mark_seq += 1
         self._dirty[name] = self._mark_seq
+        trace_id = tracing.current_trace_id()
+        if trace_id is not None and len(self._wake_traces) < 64 and (
+            trace_id not in self._wake_traces
+        ):
+            self._wake_traces.append(trace_id)
+
+    def consume_wake_traces(self) -> list[str]:
+        """Drain the wake-trace book (the reconcile thread's pass-span
+        linker). Always cheap: empty unless tracing marked anything."""
+        with self._delta_lock:
+            if not self._wake_traces:
+                return []
+            out, self._wake_traces = self._wake_traces, []
+            return out
 
     def invalidate(self) -> None:
         """Force the next pass to reclassify everything. Called for
@@ -525,6 +547,13 @@ class IncrementalSnapshotSource(InformerSnapshotSource):
         with self._delta_lock:
             self._full_epoch += 1
             self._full_invalidations += 1
+            trace_id = tracing.current_trace_id()
+            if trace_id is not None and len(self._wake_traces) < 64 and (
+                trace_id not in self._wake_traces
+            ):
+                # A rollout delta (DS/ControllerRevision write) wakes a
+                # full rebuild: the rebuild's pass links to it too.
+                self._wake_traces.append(trace_id)
 
     def _on_node_event(self, event_type: str, obj, old) -> None:
         self._mark_node(obj.name)

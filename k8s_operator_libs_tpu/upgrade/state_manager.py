@@ -24,12 +24,14 @@ O(pool)-per-pass cost; docs/reconcile-data-path.md):
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Mapping, Optional
 
 from ..api.upgrade_v1alpha1 import DriverUpgradePolicySpec
 from ..kube.client import Client
 from ..kube.objects import DaemonSet, Node, Pod
+from ..utils import tracing
+from ..utils.faultpoints import wall_now
 from ..utils.log import get_logger
 from .common_manager import (
     ClusterUpgradeState,
@@ -159,6 +161,11 @@ class PassStats:
     #: counter makes the tolerance a SIGNAL the chaos harness can bound:
     #: a wedged pool shows up as every pass aborting, not as silence.
     aborted_completeness_races: int = 0
+    #: Per-bucket apply wall seconds this pass (bucket label -> s) —
+    #: the gauge-side twin of the pass span's bucket children, exported
+    #: as ``tpu_operator_upgrade_pass_bucket_seconds{bucket=...}``.
+    #: Empty on a settled pass (only non-empty buckets record).
+    bucket_seconds: dict = field(default_factory=dict)
 
 
 class ClusterUpgradeStateManager:
@@ -232,6 +239,24 @@ class ClusterUpgradeStateManager:
         #: pay counter snapshots for a feature it never used, and once
         #: the arc WAS used the lifetime gauges keep exporting.
         self._checkpoint_seen = False
+        # Rollout tracing (docs/tracing.md): the pass span is opened
+        # LAZILY — build_state opens it for any non-settled snapshot,
+        # apply_state opens it when a settled snapshot still has in-
+        # progress nodes (polling buckets mid-roll). A settled pool's
+        # pass therefore emits ZERO spans even with tracing enabled —
+        # the hot path costs one tracer() global read (pinned by
+        # settled_pool_noop + tests/test_tracing.py).
+        self._pass_span = None
+        self._pass_activation = None
+        self._pass_seq = 0
+        # Stable bound-method reference for common.on_first_bucket — a
+        # plain attribute store per pass, never a fresh closure on the
+        # settled hot path.
+        self._lazy_open = self._lazy_open_pass_span
+        #: Extra attrs stamped on every pass span — the fleet worker
+        #: sets {"worker": identity} so co-hosted workers' otherwise
+        #: identical pass spans stay distinguishable in a trace export.
+        self.trace_attrs: dict = {}
 
     def with_snapshot_from_informers(
         self,
@@ -401,10 +426,58 @@ class ClusterUpgradeStateManager:
     # ------------------------------------------------------------------
     # BuildState (reference: upgrade_state.go:99-164)
     # ------------------------------------------------------------------
+    # -- rollout tracing (docs/tracing.md) ---------------------------------
+    def _open_pass_span(self, t, start_wall: float) -> None:
+        if self._pass_span is not None:
+            self._close_pass_span(None)
+        attrs: dict = {"pass": self._pass_seq}
+        attrs.update(self.trace_attrs)
+        self._pass_span = t.start_span(
+            "reconcile.pass", category="reconcile",
+            start=start_wall, attrs=attrs,
+        )
+        self._pass_activation = tracing.activate(self._pass_span)
+
+    def _lazy_open_pass_span(self) -> None:
+        """First-bucket trigger (see ``CommonUpgradeManager.
+        on_first_bucket``): a settled snapshot opened no pass span, but
+        a polling bucket is about to do real work — open the span now so
+        the bucket parents into it."""
+        self.common.on_first_bucket = None
+        t = tracing.tracer()
+        if t is not None and self._pass_span is None:
+            self._open_pass_span(t, wall_now())
+
+    def _close_pass_span(self, stats: Optional[PassStats]) -> None:
+        span = self._pass_span
+        if span is None:
+            return
+        self._pass_span = None
+        activation, self._pass_activation = self._pass_activation, None
+        if activation is not None:
+            activation.close()
+        if stats is not None:
+            span.attrs.update(
+                full_rebuild=stats.full_rebuild,
+                dirty=stats.dirty_node_count,
+                reclassified=stats.nodes_reclassified,
+                writes=stats.writes_issued,
+            )
+        t = tracing.tracer()
+        if t is not None:
+            t.end_span(span)
+
     def build_state(
         self, namespace: str, driver_labels: Mapping[str, str]
     ) -> ClusterUpgradeState:
         start = time.perf_counter()
+        tracer = tracing.tracer()
+        if tracer is not None and self._pass_span is not None:
+            # A pass whose apply never ran (caller error between build
+            # and apply) must not leak an open span into this one.
+            self._close_pass_span(None)
+        trace_start = wall_now() if tracer is not None else 0.0
+        self._pass_seq += 1
         source = self.snapshot_source
         source.consume_reads()  # drop reads accrued outside a pass
         incremental = bool(getattr(source, "incremental", False))
@@ -440,6 +513,16 @@ class ClusterUpgradeStateManager:
             state.node_health = self.health_source.snapshot()
         stats.reads_issued = source.consume_reads()
         stats.snapshot_s = time.perf_counter() - start
+        if tracer is not None and not stats.snapshot_skipped:
+            # Non-settled snapshot: open the pass span covering both
+            # phases and link it to the traces of the writes whose watch
+            # deltas woke it (the causal chain grant -> write -> delta
+            # -> this pass).
+            self._open_pass_span(tracer, trace_start)
+            consume_wakes = getattr(source, "consume_wake_traces", None)
+            if callable(consume_wakes):
+                for trace_id in consume_wakes():
+                    tracer.add_link(self._pass_span, trace_id)
         return state
 
     def _reset_pass_caches(self) -> None:
@@ -760,6 +843,7 @@ class ClusterUpgradeStateManager:
             raise ValueError("currentState should not be empty")
         if policy is None or not policy.auto_upgrade:
             log.info("driver auto upgrade is disabled, skipping")
+            self._close_pass_span(self.last_pass_stats)
             return
         log.info(
             "node states: %s",
@@ -772,6 +856,20 @@ class ClusterUpgradeStateManager:
         common = self.common
         stats = self.last_pass_stats
         start = time.perf_counter()
+        tracer = tracing.tracer()
+        # Lazy pass span (docs/tracing.md): a settled snapshot opened no
+        # span in build_state, but a POLLING bucket (drain, checkpoint,
+        # validation) may still do real work this pass — the first
+        # non-empty bucket's scope opens the span via this trigger. A
+        # fully settled pool runs zero buckets, so it opens nothing and
+        # allocates nothing: the zero-span settled contract.
+        common.on_first_bucket = (
+            self._lazy_open
+            if tracer is not None and self._pass_span is None
+            else None
+        )
+        if common.bucket_seconds:
+            common.bucket_seconds = {}
         issued_before, skipped_before = self.provider.write_counts()
         errors_before = self.runner.bucket_failures
         checkpoint_enabled = (
@@ -835,6 +933,9 @@ class ClusterUpgradeStateManager:
             stats.writes_skipped = skipped_after - skipped_before
             stats.node_errors = self.runner.bucket_failures - errors_before
             stats.apply_s = time.perf_counter() - start
+            stats.bucket_seconds = dict(common.bucket_seconds)
+            common.on_first_bucket = None
+            self._close_pass_span(stats)
             if checkpoint_before is not None:
                 ckpt = common.checkpoint_manager.totals()
                 stats.checkpoint_requests_issued = (

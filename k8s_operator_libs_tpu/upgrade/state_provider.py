@@ -42,6 +42,7 @@ from typing import Callable, Optional, Protocol, Union
 
 from ..kube.client import Client
 from ..kube.objects import KubeObject, Node
+from ..utils import tracing
 from ..utils.log import get_logger
 from ..utils.sync import KeyedMutex
 from .consts import NULL_STRING, UpgradeKeys, UpgradeState
@@ -138,7 +139,8 @@ class NodeUpgradeStateProvider:
         new_state = UpgradeState(new_state)
         value: Optional[str] = str(new_state) if new_state != UpgradeState.UNKNOWN else None
         with self._mutex.locked(node.name):
-            if node.labels.get(self._keys.state_label) == value:
+            previous = node.labels.get(self._keys.state_label)
+            if previous == value:
                 # No-op coalescing: the label already holds the target
                 # value (None == absent). The provider is the single
                 # writer of this key, so the in-memory node is
@@ -170,6 +172,21 @@ class NodeUpgradeStateProvider:
                 node.labels.pop(self._keys.state_label, None)
             else:
                 node.labels[self._keys.state_label] = value
+            # Flight-recorder hook (docs/tracing.md): every real state
+            # transition becomes an event on the CURRENT span — the
+            # bucket that caused it (TaskRunner propagates the bucket
+            # span into fan-out workers), whose parent is the pass. One
+            # global read when tracing is off; coalesced no-ops above
+            # never report (they transitioned nothing).
+            cause = tracing.current_span()
+            if cause is not None:
+                tracing.add_event(
+                    "state.transition",
+                    node=node.name,
+                    frm=previous or "",
+                    to=value or "",
+                    cause=cause.name,
+                )
         if self._recorder is not None:
             self._recorder.eventf(
                 node,

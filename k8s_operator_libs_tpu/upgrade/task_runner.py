@@ -25,6 +25,7 @@ import threading
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Callable, Optional, Sequence
 
+from ..utils import tracing
 from ..utils.log import get_logger
 from ..utils.sync import StringSet
 
@@ -107,6 +108,11 @@ class TaskRunner:
             for i, (key, fn) in enumerate(tasks):
                 guarded(i, key, fn)
             return results
+        # Span-context propagation (docs/tracing.md): fan-out workers
+        # inherit the caller's current span (the bucket span), so a
+        # state transition made on a worker thread attaches its event to
+        # the bucket that caused it. One global read when tracing is off.
+        trace_ctx = tracing.current_span()
         # The persistent bucket pool is sized max_workers; a narrower
         # per-call width is enforced by a semaphore (an idle worker
         # parked on it costs nothing — run_bucket joins before
@@ -122,7 +128,8 @@ class TaskRunner:
 
         def gated(index: int, key: str, fn: Callable[[], None]) -> None:
             with gate:
-                guarded(index, key, fn)
+                with tracing.use_span(trace_ctx):
+                    guarded(index, key, fn)
 
         futures = [
             executor.submit(gated, i, key, fn)
@@ -150,10 +157,15 @@ class TaskRunner:
             finally:
                 self._in_progress.remove(key)
             return True
+        # Fire-and-forget tasks (drain, eviction waits) carry the
+        # scheduling pass's span context so their own spans parent to
+        # the pass that scheduled them — even when they outlive it.
+        trace_ctx = tracing.current_span()
 
         def run() -> None:
             try:
-                fn()
+                with tracing.use_span(trace_ctx):
+                    fn()
             except Exception:  # tasks own their error handling; never bubble
                 log.exception("task %s raised unexpectedly", key)
             finally:

@@ -24,6 +24,7 @@ from typing import Callable, Optional
 
 from ..kube.client import Client
 from ..kube.objects import Node, Pod
+from ..utils import tracing
 from ..utils.faultpoints import wall_now
 from ..utils.log import get_logger
 from .consts import NULL_STRING, UpgradeKeys, UpgradeState
@@ -138,6 +139,18 @@ class ValidationManager:
         it before the other gates keeps a deferral — up to the restore
         deadline — from re-executing the device-bound hook and pod
         provisioning once per pass for nothing."""
+        # Probe attribution (docs/tracing.md): one span per validation
+        # attempt — the battery/gate wait is where post-upgrade wall
+        # time goes on TPU pools. Null-scope when tracing is off.
+        with tracing.span(
+            "validate.node", category="probe", node=node.name
+        ) as probe_span:
+            ok = self._validate(node)
+            if probe_span is not None:
+                probe_span.attrs["passed"] = ok
+            return ok
+
+    def _validate(self, node: Node) -> bool:
         if not self._restore_ok(node):
             # Deferred, not failed: the restore gate degrades on its own
             # durable deadline. Retire any previously stamped validation

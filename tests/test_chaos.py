@@ -352,6 +352,39 @@ class TestDeterminism:
         assert first.fired == second.fired
         assert first.converged and second.converged
 
+    def test_run_twice_same_span_trace_bytes(self):
+        """ISSUE 14 extension: with the rollout TRACER installed (the
+        ``tools/chaos_run.py --trace-json`` shape), the run-twice pin
+        extends to BYTE-identical normalized span exports — timestamps
+        come from the ChaosClock, ids are renumbered in content order,
+        and spans stamped after the virtual clock retires (teardown
+        runs on real time) are excluded by the same cutoff chaos_run
+        applies."""
+        from k8s_operator_libs_tpu.utils import tracing
+
+        schedule = generate_schedule(
+            5, ChaosConfig(pools=6, workers=2, shards=2)
+        )
+
+        def traced_blob() -> tuple[bytes, int]:
+            tracer = tracing.Tracer()
+            tracing.install_tracer(tracer)
+            try:
+                result = run_schedule(schedule)
+            finally:
+                tracing.clear_tracer()
+            assert result.converged and not result.total_violations
+            blob = tracer.export_bytes(
+                end_before=tracing.CHAOS_EXPORT_CUTOFF
+            )
+            return blob, blob.count(b"\n")
+
+        first_blob, first_count = traced_blob()
+        second_blob, second_count = traced_blob()
+        assert first_count == second_count
+        assert first_count > 50  # the roll actually traced
+        assert first_blob == second_blob
+
 
 # ---------------------------------------------------------------------------
 # Corpus: global invariants under seeded schedules

@@ -91,6 +91,48 @@ class TestRender:
         assert len(names) == 6
         assert len(set(names)) == 6
 
+    def test_pass_bucket_seconds_exposition_byte_exact(self):
+        """ISSUE 14 satellite: per-bucket apply timings surface as the
+        ``tpu_operator_upgrade_pass_bucket_seconds{bucket=...}`` gauge
+        family — the gauge-side twin of the pass span's bucket children
+        (docs/tracing.md). Pinned byte-exact: the multi-label splice
+        (device + bucket) must render spec-escaped and sorted."""
+        _, _, mgr = make_harness(nodes=1)
+        metrics = UpgradeMetrics(mgr)
+        state = mgr.build_state(NS, LABELS)
+        mgr.last_pass_stats.bucket_seconds = {
+            "cordon": 0.25,
+            "classify[unknown]": 0.0125,
+        }
+        metrics.observe(state)
+        text = metrics.render()
+        lines = text.splitlines()
+        start = lines.index(
+            "# HELP tpu_operator_upgrade_pass_bucket_seconds Per-bucket "
+            "apply wall seconds of the most recent pass that ran any "
+            "bucket (the gauge twin of the pass span's bucket children; "
+            "docs/tracing.md)"
+        )
+        assert lines[start + 1] == (
+            "# TYPE tpu_operator_upgrade_pass_bucket_seconds gauge"
+        )
+        assert lines[start + 2] == (
+            "tpu_operator_upgrade_pass_bucket_seconds"
+            '{device="tpu",bucket="classify[unknown]"} 0.0125'
+        )
+        assert lines[start + 3] == (
+            "tpu_operator_upgrade_pass_bucket_seconds"
+            '{device="tpu",bucket="cordon"} 0.25'
+        )
+        # A settled pass (empty dict) keeps the LAST roll activity's
+        # timings exporting with a stable label set.
+        mgr.last_pass_stats.bucket_seconds = {}
+        metrics.observe(state)
+        assert (
+            "tpu_operator_upgrade_pass_bucket_seconds"
+            '{device="tpu",bucket="cordon"} 0.25'
+        ) in metrics.render()
+
     def test_label_values_are_escaped(self):
         from k8s_operator_libs_tpu.tpu.monitor import MonitorMetrics
         from k8s_operator_libs_tpu.upgrade.metrics import prom_label

@@ -1,0 +1,652 @@
+"""End-to-end rollout tracing (ISSUE 14; docs/tracing.md).
+
+Covers the leaf span library (``utils/tracing.py``), the settled-pass
+zero-span contract, the causal chain through the reconcile pass (bucket
+spans, state-transition events with cause, wake-trace links), wire
+propagation (traceparent over keep-alive reuse, pipelined request_many,
+the 429 transparent retry, APF queue-wait decomposition, killed-
+connection watch/hub resume keeping write-origin ids), the
+deterministic export normalization, and the ``tools/trace_view``
+flight recorder / attribution math.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from k8s_operator_libs_tpu.api import DriverUpgradePolicySpec
+from k8s_operator_libs_tpu.kube import (
+    FakeCluster,
+    LocalApiServer,
+    Node,
+    RestClient,
+    RestConfig,
+    WatchHub,
+)
+from k8s_operator_libs_tpu.kube.informer import Informer
+from k8s_operator_libs_tpu.kube.sim import DaemonSetSimulator
+from k8s_operator_libs_tpu.upgrade.consts import DeviceClass
+from k8s_operator_libs_tpu.upgrade.state_manager import (
+    BuildStateError,
+    ClusterUpgradeStateManager,
+)
+from k8s_operator_libs_tpu.upgrade.task_runner import TaskRunner
+from k8s_operator_libs_tpu.utils import tracing
+from k8s_operator_libs_tpu.utils.intstr import IntOrString
+
+NS = "kube-system"
+LABELS = {"app": "driver"}
+POLICY = DriverUpgradePolicySpec(
+    auto_upgrade=True,
+    max_parallel_upgrades=0,
+    max_unavailable=IntOrString("100%"),
+)
+
+
+@pytest.fixture
+def tracer():
+    t = tracing.Tracer()
+    tracing.install_tracer(t)
+    try:
+        yield t
+    finally:
+        tracing.clear_tracer()
+
+
+def make_node(name: str) -> Node:
+    node = Node.new(name)
+    node.set_ready(True)
+    return node
+
+
+def make_harness(nodes=3, incremental=True):
+    cluster = FakeCluster()
+    for i in range(nodes):
+        cluster.create(make_node(f"node-{i}"))
+    sim = DaemonSetSimulator(
+        cluster, name="driver", namespace=NS, match_labels=LABELS
+    )
+    sim.settle()
+    mgr = ClusterUpgradeStateManager(
+        cluster, DeviceClass.tpu(), runner=TaskRunner(inline=True)
+    )
+    source = mgr.with_snapshot_from_informers(
+        NS, LABELS, resync_period_s=0.0, incremental=incremental
+    )
+    return cluster, sim, mgr, source
+
+
+def one_pass(mgr) -> bool:
+    try:
+        mgr.apply_state(mgr.build_state(NS, LABELS), POLICY)
+        return True
+    except BuildStateError:
+        return False  # the documented completeness race; retried
+
+
+def roll_to_done(cluster, sim, mgr, deadline_s=30.0) -> None:
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        sim.step()
+        one_pass(mgr)
+        sim.step()
+        # Pods must be CURRENT, not only labels done: right after a
+        # template bump, a pass running before the ControllerRevision
+        # delta lands classifies against the stale hash (the documented
+        # level-driven under-roll, healed by the delta) — labels alone
+        # would read converged transiently.
+        if sim.all_pods_ready_and_current() and all(
+            n.labels.get(mgr.keys.state_label) == "upgrade-done"
+            for n in cluster.list("Node")
+        ):
+            return
+        time.sleep(0.01)
+    raise AssertionError("roll did not converge")
+
+
+def settle(mgr, sim, deadline_s=15.0) -> None:
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        sim.step()
+        if one_pass(mgr) and mgr.last_pass_stats.snapshot_skipped:
+            return
+        time.sleep(0.01)
+    raise AssertionError("pool did not settle")
+
+
+class TestSpanLibrary:
+    def test_disabled_path_is_null_singleton(self):
+        assert tracing.tracer() is None
+        scope_a = tracing.span("x", category="wire")
+        scope_b = tracing.span("y")
+        assert scope_a is scope_b  # the zero-allocation singleton
+        with scope_a as span:
+            assert span is None
+        tracing.add_event("nothing")  # no-op, no raise
+        assert tracing.traceparent() is None
+        assert tracing.current_span() is None
+
+    def test_install_refuses_stacking(self, tracer):
+        with pytest.raises(RuntimeError):
+            tracing.install_tracer(tracing.Tracer())
+
+    def test_span_ids_parentage_events(self, tracer):
+        with tracing.span("parent", category="reconcile", k="v") as parent:
+            assert len(parent.trace_id) == 32
+            assert len(parent.span_id) == 16
+            with tracing.span("child", category="wire") as child:
+                assert child.trace_id == parent.trace_id
+                assert child.parent_id == parent.span_id
+                tracing.add_event("evt", node="n1")
+        records = tracer.records()
+        assert [r["name"] for r in records] == ["child", "parent"]
+        child_rec = records[0]
+        assert child_rec["events"][0]["name"] == "evt"
+        assert child_rec["events"][0]["attrs"] == {"node": "n1"}
+        assert records[1]["attrs"] == {"k": "v"}
+
+    def test_ring_is_bounded(self):
+        t = tracing.Tracer(capacity=8)
+        for i in range(20):
+            t.end_span(t.start_span(f"s{i}"))
+        assert len(t.records()) == 8
+        assert t.records()[0]["name"] == "s12"
+        assert t.finished == 20
+
+    def test_use_span_propagates_across_threads(self, tracer):
+        seen = []
+
+        def worker(span):
+            with tracing.use_span(span):
+                tracing.add_event("cross-thread", who="worker")
+                seen.append(tracing.current_trace_id())
+
+        with tracing.span("bucket") as span:
+            thread = threading.Thread(target=worker, args=(span,))
+            thread.start()
+            thread.join()
+        assert seen == [span.trace_id]
+        assert tracer.records()[0]["events"][0]["attrs"]["who"] == "worker"
+
+    def test_traceparent_roundtrip_and_malformed(self, tracer):
+        with tracing.span("s"):
+            header = tracing.traceparent()
+        trace_id, span_id = tracing.parse_traceparent(header)
+        assert len(trace_id) == 32 and len(span_id) == 16
+        for bad in ("", "junk", "00-short-x-01", "01-" + "a" * 32 + "-" +
+                    "b" * 16 + "-01", "00-" + "g" * 32 + "-" + "b" * 16 +
+                    "-01"):
+            assert tracing.parse_traceparent(bad) is None
+
+    def test_write_origin_book_bounded(self):
+        t = tracing.Tracer(origin_capacity=4)
+        for rv in range(10):
+            t.record_write_origin(str(rv), "t", "s")
+        assert t.write_origin("0") is None
+        assert t.write_origin("9") is not None
+
+    def test_normalize_renumbers_by_content(self):
+        # Two tracers allocate ids in opposite order; same content must
+        # export the same bytes.
+        def build(order):
+            t = tracing.Tracer()
+            spans = {}
+            for name in order:
+                spans[name] = t.start_span(name, category="wire",
+                                           start=1.0)
+            for name in reversed(order):
+                t.end_span(spans[name], end=2.0)
+            return tracing.normalize_records(t.records())
+
+        a = build(["alpha", "beta"])
+        b = build(["beta", "alpha"])
+        assert json.dumps(a, sort_keys=True) == json.dumps(
+            b, sort_keys=True
+        )
+
+    def test_normalize_sorts_events(self):
+        # Same-timestamp events (the chaos clock's shape: everything in
+        # one step shares one virtual instant) sort by content.
+        record = {
+            "trace": "t", "span": "s", "parent": "", "name": "s",
+            "category": "", "start": 1.0, "end": 2.0, "attrs": {},
+            "events": [
+                {"ts": 1.0, "name": "b", "attrs": {"node": "2"}},
+                {"ts": 1.0, "name": "a", "attrs": {"node": "1"}},
+            ],
+            "links": [],
+        }
+        events = tracing.normalize_records([record])[0]["events"]
+        assert [e["name"] for e in events] == ["a", "b"]
+
+
+class TestSettledZeroSpan:
+    """The ISSUE 14 settled-pass pin: with tracing ENABLED, a settled
+    pool's pass emits zero spans (the settled_pool_noop bench hard-
+    asserts the same plus the <10% overhead bound)."""
+
+    def test_settled_passes_emit_zero_spans(self, tracer):
+        cluster, sim, mgr, source = make_harness(nodes=3)
+        try:
+            sim.set_template_hash("v2")
+            roll_to_done(cluster, sim, mgr)
+            settle(mgr, sim)
+            time.sleep(0.2)  # drain stray watch echoes
+            one_pass(mgr)
+            started_before = tracer.started
+            for _ in range(10):
+                assert one_pass(mgr)
+                assert mgr.last_pass_stats.snapshot_skipped
+            assert tracer.started == started_before
+            assert mgr.last_pass_stats.bucket_seconds == {}
+        finally:
+            source.stop()
+
+    def test_rolling_pass_emits_pass_and_bucket_spans(self, tracer):
+        cluster, sim, mgr, source = make_harness(nodes=2)
+        try:
+            sim.set_template_hash("v2")
+            roll_to_done(cluster, sim, mgr)
+        finally:
+            source.stop()
+        records = tracer.records()
+        names = {r["name"] for r in records}
+        assert "reconcile.pass" in names
+        assert any(n.startswith("bucket.") for n in names)
+        # Bucket spans parent into their pass span.
+        passes = {r["span"]: r for r in records
+                  if r["name"] == "reconcile.pass"}
+        buckets = [r for r in records if r["name"].startswith("bucket.")]
+        assert buckets
+        assert all(b["parent"] in passes for b in buckets)
+        # And PassStats carried the gauge twin.
+        cordon = [b for b in buckets if b["name"] == "bucket.cordon"]
+        assert cordon, names
+
+    def test_state_transitions_ride_bucket_spans_with_cause(self, tracer):
+        cluster, sim, mgr, source = make_harness(nodes=2)
+        try:
+            sim.set_template_hash("v2")
+            roll_to_done(cluster, sim, mgr)
+        finally:
+            source.stop()
+        transitions = [
+            (record, event)
+            for record in tracer.records()
+            for event in record["events"]
+            if event["name"] == "state.transition"
+        ]
+        assert transitions
+        by_node: dict[str, list] = {}
+        for record, event in transitions:
+            attrs = event["attrs"]
+            assert attrs["cause"]  # every transition names its cause
+            by_node.setdefault(attrs["node"], []).append(attrs)
+        journey = [t["to"] for t in by_node["node-0"]]
+        assert journey[-1] == "upgrade-done"
+        assert "cordon-required" in journey
+
+    def test_pass_links_to_waking_write(self, tracer):
+        """The causal chain: a write made under trace T dirties a node
+        through the informer delta; the NEXT pass span links to T."""
+        cluster, sim, mgr, source = make_harness(nodes=2)
+        try:
+            sim.set_template_hash("v2")
+            roll_to_done(cluster, sim, mgr)
+            settle(mgr, sim)
+            time.sleep(0.2)
+            one_pass(mgr)
+            with tracing.span("external.write", category="grant") as ext:
+                external_trace = ext.trace_id
+                cluster.patch(
+                    "Node", "node-0",
+                    patch={"metadata": {"labels": {"poke": "1"}}},
+                )
+            deadline = time.time() + 10
+            linked = None
+            while time.time() < deadline and linked is None:
+                one_pass(mgr)
+                for record in tracer.records():
+                    if record["name"] == "reconcile.pass" and (
+                        external_trace in record["links"]
+                    ):
+                        linked = record
+                        break
+                time.sleep(0.02)
+            assert linked is not None, "no pass linked the waking write"
+        finally:
+            source.stop()
+
+
+class TestWirePropagation:
+    def test_keepalive_reuse_carries_traceparent(self, tracer):
+        """N requests on ONE pooled connection: every server span joins
+        the client's trace — context survives connection reuse."""
+        with LocalApiServer() as server:
+            client = RestClient(RestConfig(server=server.url))
+            try:
+                with tracing.span("client.op") as op:
+                    for i in range(5):
+                        client.create(make_node(f"w{i}"))
+                    client.list("Node")
+                # The LAST response can reach the client a beat before
+                # the server coroutine's finally ends its span.
+                deadline = time.time() + 5
+                server_spans = []
+                while time.time() < deadline and len(server_spans) < 6:
+                    server_spans = [
+                        r for r in tracer.records()
+                        if r["name"] == "server.request"
+                    ]
+                    time.sleep(0.01)
+                assert len(server_spans) >= 6
+                assert all(
+                    r["trace"] == op.trace_id for r in server_spans
+                )
+                stats = client.transport_stats()
+                assert stats["connections_opened"] == 1  # reuse proven
+            finally:
+                client.close()
+
+    def test_pipelined_request_many_carries_traceparent(self, tracer):
+        with LocalApiServer() as server:
+            client = RestClient(RestConfig(server=server.url))
+            try:
+                for i in range(3):
+                    client.create(make_node(f"p{i}"))
+                with tracing.span("seed") as seed:
+                    primed = client.prime_list_cache(
+                        [("Node", "", None, None),
+                         ("Pod", NS, None, None)]
+                    )
+                assert primed == 2
+                deadline = time.time() + 5
+                piped = []
+                while time.time() < deadline and len(piped) < 2:
+                    piped = [
+                        r for r in tracer.records()
+                        if r["name"] == "server.request"
+                        and r["trace"] == seed.trace_id
+                    ]
+                    time.sleep(0.01)
+                assert len(piped) == 2  # both pipelined LISTs joined
+            finally:
+                client.close()
+
+    def test_apf_queue_wait_is_child_span(self, tracer):
+        with LocalApiServer() as server:  # APF on by default
+            client = RestClient(RestConfig(server=server.url))
+            try:
+                with tracing.span("client.op") as op:
+                    client.create(make_node("apf-node"))
+                deadline = time.time() + 5
+                server_spans = []
+                while time.time() < deadline and not server_spans:
+                    server_spans = [
+                        r for r in tracer.records()
+                        if r["name"] == "server.request"
+                        and r["trace"] == op.trace_id
+                    ]
+                    time.sleep(0.01)
+                assert server_spans
+                queue_spans = [
+                    r for r in tracer.records()
+                    if r["name"] == "apf.queue"
+                    and r["trace"] == op.trace_id
+                ]
+                assert queue_spans, "queue wait not decomposed"
+                by_id = {r["span"]: r for r in tracer.records()}
+                for q in queue_spans:
+                    parent = by_id[q["parent"]]
+                    assert parent["name"] == "server.request"
+                    assert q["attrs"]["flow"] == "reconcile"
+            finally:
+                client.close()
+
+    def test_429_retries_are_children_of_one_logical_span(self, tracer):
+        """Stub server: 429 + Retry-After once, then 200 — the
+        transparent retry emits ONE logical request span with the retry
+        attempt (and its backoff) as children."""
+        sock = socket.socket()
+        sock.bind(("127.0.0.1", 0))
+        sock.listen(1)
+        port = sock.getsockname()[1]
+        hits = []
+
+        def serve():
+            for attempt in range(2):
+                conn, _ = sock.accept()
+                data = b""
+                while b"\r\n\r\n" not in data:
+                    data += conn.recv(65536)
+                hits.append(data)
+                if attempt == 0:
+                    body = json.dumps({
+                        "kind": "Status", "reason": "TooManyRequests",
+                        "message": "shed", "code": 429,
+                    }).encode()
+                    head = (
+                        "HTTP/1.1 429 Too Many Requests\r\n"
+                        "Retry-After: 0.05\r\n"
+                        f"Content-Length: {len(body)}\r\n"
+                        "Content-Type: application/json\r\n\r\n"
+                    ).encode()
+                else:
+                    body = json.dumps({
+                        "kind": "Node",
+                        "metadata": {"name": "ok", "resourceVersion": "1"},
+                    }).encode()
+                    head = (
+                        "HTTP/1.1 200 OK\r\n"
+                        f"Content-Length: {len(body)}\r\n"
+                        "Content-Type: application/json\r\n\r\n"
+                    ).encode()
+                conn.sendall(head + body)
+                conn.close()
+            sock.close()
+
+        thread = threading.Thread(target=serve, daemon=True)
+        thread.start()
+        client = RestClient(
+            RestConfig(server=f"http://127.0.0.1:{port}")
+        )
+        try:
+            obj = client.get("Node", "ok")
+            assert obj.name == "ok"
+        finally:
+            client.close()
+        thread.join(timeout=5)
+        # Both attempts carried a traceparent (the wire contract) ...
+        assert all(b"traceparent:" in hit.lower() for hit in hits)
+        records = tracer.records()
+        logical = [r for r in records if r["name"] == "http.request"]
+        assert len(logical) == 1
+        assert logical[0]["attrs"]["status"] == 200
+        attempts = [r for r in records if r["name"] == "http.attempt"]
+        assert len(attempts) == 1
+        assert attempts[0]["parent"] == logical[0]["span"]
+        backoffs = [r for r in records if r["name"] == "http.backoff"]
+        assert len(backoffs) == 1
+        assert backoffs[0]["category"] == "queue"
+        # ... and the retry's traceparent named the ATTEMPT span, so
+        # the server can distinguish the attempts within one trace.
+        tp_first = [line for line in hits[0].split(b"\r\n")
+                    if line.lower().startswith(b"traceparent:")][0]
+        tp_second = [line for line in hits[1].split(b"\r\n")
+                     if line.lower().startswith(b"traceparent:")][0]
+        assert tp_first != tp_second
+        assert logical[0]["trace"] in tp_first.decode()
+        assert logical[0]["trace"] in tp_second.decode()
+
+    def test_killed_connection_watch_resume_keeps_origins(self, tracer):
+        """Write origins are keyed by rv: a watch stream killed and
+        RESUMED (no re-list) still delivers the post-kill writes with
+        their originating trace ids."""
+        with LocalApiServer() as server:
+            client = RestClient(RestConfig(server=server.url))
+            server.cluster.create(make_node("w0"))
+            informer = Informer(client, "Node")
+            informer.start()
+            try:
+                assert informer.wait_for_sync(10)
+                with tracing.span("writer.one") as one:
+                    server.cluster.patch(
+                        "Node", "w0",
+                        patch={"metadata": {"labels": {"a": "1"}}},
+                    )
+                assert server.kill_connections() >= 1
+                with tracing.span("writer.two") as two:
+                    patched = server.cluster.patch(
+                        "Node", "w0",
+                        patch={"metadata": {"labels": {"a": "2"}}},
+                    )
+                rv = patched.resource_version
+                deadline = time.time() + 10
+                deliveries = []
+                while time.time() < deadline and not deliveries:
+                    deliveries = [
+                        r for r in tracer.records()
+                        if r["name"] == "informer.deliver"
+                        and r["attrs"].get("rv") == rv
+                    ]
+                    time.sleep(0.02)
+                assert deliveries, "post-kill write never delivered"
+                assert deliveries[0]["trace"] == two.trace_id
+                assert deliveries[0]["trace"] != one.trace_id
+            finally:
+                informer.stop()
+                client.close()
+
+    def test_hub_resume_frames_keep_origins(self, tracer):
+        """A hub subscriber forced stale self-resumes over the hub
+        journal; the replayed frames still deliver with the originating
+        writes' trace ids (the origin book is keyed by rv, not by the
+        stream that carried the frame)."""
+        cluster = FakeCluster()
+        cluster.create(make_node("h0"))
+        hub = WatchHub(cluster, buffer_limit=2, idle_linger_s=0.0)
+        informer = Informer(cluster, "Node", stream_source=hub)
+        informer.start()
+        try:
+            assert informer.wait_for_sync(10)
+            # A burst larger than the subscriber buffer forces the
+            # stale -> journal self-resume path for the later writes.
+            traces = {}
+            for i in range(8):
+                with tracing.span(f"writer.{i}") as w:
+                    patched = cluster.patch(
+                        "Node", "h0",
+                        patch={"metadata": {"labels": {"i": str(i)}}},
+                    )
+                    traces[patched.resource_version] = w.trace_id
+            deadline = time.time() + 10
+            last_rv = max(traces, key=int)
+            while time.time() < deadline:
+                delivered = {
+                    r["attrs"]["rv"]: r["trace"]
+                    for r in tracer.records()
+                    if r["name"] == "informer.deliver"
+                    and r["attrs"].get("rv") in traces
+                }
+                if last_rv in delivered:
+                    break
+                time.sleep(0.02)
+            assert last_rv in delivered, "burst never fully delivered"
+            for rv, trace_id in delivered.items():
+                assert trace_id == traces[rv]
+        finally:
+            informer.stop()
+            hub.stop()
+
+
+class TestBucketSecondsStats:
+    def test_pass_stats_carry_bucket_seconds(self):
+        cluster, sim, mgr, source = make_harness(nodes=2)
+        try:
+            sim.set_template_hash("v2")
+            seen: set[str] = set()
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                sim.step()
+                one_pass(mgr)
+                seen.update(mgr.last_pass_stats.bucket_seconds)
+                sim.step()
+                if sim.all_pods_ready_and_current() and all(
+                    n.labels.get(mgr.keys.state_label) == "upgrade-done"
+                    for n in cluster.list("Node")
+                ):
+                    break
+                time.sleep(0.01)
+            assert "cordon" in seen
+            assert any(s.startswith("classify[") for s in seen)
+            assert all(
+                v >= 0.0
+                for v in mgr.last_pass_stats.bucket_seconds.values()
+            )
+        finally:
+            source.stop()
+
+
+class TestTraceView:
+    def _spans(self):
+        return [
+            {"trace": "t1", "span": "a", "parent": "", "name": "pass",
+             "category": "reconcile", "start": 0.0, "end": 10.0,
+             "attrs": {"pass": 1, "worker": "w0"}, "events": [],
+             "links": []},
+            {"trace": "t1", "span": "b", "parent": "a",
+             "name": "bucket.drain-sched", "category": "drain",
+             "start": 2.0, "end": 6.0, "attrs": {},
+             "events": [
+                 {"ts": 2.5, "name": "state.transition",
+                  "attrs": {"node": "n1", "frm": "a", "to": "b",
+                            "cause": "bucket.drain-sched"}},
+             ], "links": ["t9"]},
+            {"trace": "t2", "span": "c", "parent": "", "name": "q",
+             "category": "queue", "start": 12.0, "end": 14.0,
+             "attrs": {}, "events": [], "links": []},
+        ]
+
+    def test_attribution_deepest_span_wins(self):
+        from tools.trace_view import attribution
+
+        result = attribution(self._spans())
+        categories = result["categories"]
+        # 0-2 reconcile, 2-6 drain (deeper), 6-10 reconcile, 10-12
+        # idle, 12-14 queue.
+        assert categories["reconcile"] == pytest.approx(6.0)
+        assert categories["drain"] == pytest.approx(4.0)
+        assert categories["queue"] == pytest.approx(2.0)
+        assert categories["idle"] == pytest.approx(2.0)
+        assert result["coverage"] == pytest.approx(12.0 / 14.0)
+
+    def test_node_journey_resolves_pass_and_links(self):
+        from tools.trace_view import node_journey
+
+        spans = self._spans()
+        spans[0]["name"] = "reconcile.pass"
+        spans[0]["links"] = ["t9"]  # the pass's wake links
+        journey = node_journey(spans, "n1")
+        assert len(journey) == 1
+        leg = journey[0]
+        assert leg["cause"] == "bucket.drain-sched"
+        assert leg["pass"] == 1
+        assert leg["worker"] == "w0"
+        assert leg["woken_by"] == ["t9"]
+
+    def test_cli_assert_coverage(self, tmp_path):
+        from tools.trace_view import main
+
+        path = tmp_path / "trace.jsonl"
+        with open(path, "w") as f:
+            for span in self._spans():
+                f.write(json.dumps(span) + "\n")
+        assert main([str(path), "--assert-coverage", "0.5"]) == 0
+        assert main([str(path), "--assert-coverage", "0.99"]) == 1
+        assert main([str(path), "--node", "n1"]) == 0
+        assert main([str(path), "--json"]) == 0

@@ -60,6 +60,13 @@ def main(argv=None) -> int:
     parser.add_argument("--verify-determinism", action="store_true",
                         help="run each schedule twice and require "
                              "identical traces + final state")
+    parser.add_argument("--trace-json", default="",
+                        help="run with the rollout tracer installed and "
+                             "write the causal span trace (normalized "
+                             "JSONL, docs/tracing.md) here — the repro "
+                             "artifact's flight recorder; with "
+                             "--verify-determinism the run-twice check "
+                             "extends to byte-identical trace exports")
     parser.add_argument("--pools", type=int, default=64)
     parser.add_argument("--hosts", type=int, default=1)
     parser.add_argument("--workers", type=int, default=2)
@@ -90,17 +97,48 @@ def main(argv=None) -> int:
         run_schedule,
     )
 
+    def run_traced(schedule):
+        """run_schedule under a fresh tracer; returns (result, trace
+        bytes). The export is NORMALIZED (content-ordered ids) and
+        excludes spans stamped after the harness retired its virtual
+        clock (teardown runs on real time — by then the deterministic
+        record is complete), so the same seed exports the same bytes."""
+        from k8s_operator_libs_tpu.utils import tracing
+
+        tracer = tracing.Tracer()
+        tracing.install_tracer(tracer)
+        try:
+            result = run_schedule(schedule)
+        finally:
+            tracing.clear_tracer()
+        return result, tracer.export_bytes(
+            end_before=tracing.CHAOS_EXPORT_CUTOFF
+        )
+
     def run_once(schedule) -> dict:
-        result = run_schedule(schedule)
+        if args.trace_json:
+            result, trace_blob = run_traced(schedule)
+        else:
+            result, trace_blob = run_schedule(schedule), None
         if args.verify_determinism:
-            second = run_schedule(schedule)
+            if args.trace_json:
+                second, second_blob = run_traced(schedule)
+            else:
+                second, second_blob = run_schedule(schedule), None
             deterministic = (
                 result.final_digest == second.final_digest
                 and result.trace == second.trace
+                and trace_blob == second_blob
             )
         else:
             deterministic = None
         summary = result.summary()
+        if trace_blob is not None:
+            with open(args.trace_json, "wb") as f:
+                f.write(trace_blob)
+            summary["trace_spans"] = trace_blob.count(b"\n")
+            summary["trace_json"] = args.trace_json
+            print(f"trace written to {args.trace_json}", file=sys.stderr)
         if deterministic is not None:
             summary["deterministic_replay"] = deterministic
         return summary
