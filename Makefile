@@ -40,10 +40,12 @@ lint: analyze
 
 # Domain-aware static analysis over the package (exit 1 on any finding
 # not covered by tools/analyze_baseline.json). --stats prints the
-# call-graph coverage line (files, functions, call edges, lock sites) so
-# CI logs show analysis-coverage drift over time.
+# call-graph coverage line (files, functions, call edges, lock sites,
+# coroutines/await edges) so CI logs show analysis-coverage drift over
+# time. Scope includes the chaos driver and the flight-recorder CLI —
+# correctness infrastructure is analyzed like shipped code (ISSUE 15).
 analyze:
-	$(PYTHON) tools/analyze.py k8s_operator_libs_tpu --stats $(ANALYZE_FLAGS)
+	$(PYTHON) tools/analyze.py k8s_operator_libs_tpu tools/chaos_run.py tools/trace_view.py --stats $(ANALYZE_FLAGS)
 
 test:
 	$(PYTHON) -m pytest tests/ -x -q

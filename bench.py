@@ -523,9 +523,23 @@ def run_http_wire_roll() -> dict:
     connections (reuse ratio >= 20 requests/connection), not one TCP
     setup per request. The absolute floor lives in the CI bench-smoke
     gate (tools/bench_smoke_baseline.json: http_wire_roll.passes_per_s).
-    """
-    from k8s_operator_libs_tpu.kube import LocalApiServer, RestClient, RestConfig
 
+    Since ISSUE 15 the shared client wire loop runs under the
+    loop-stall watchdog (kube/loopwatch.py — the runtime twin of the
+    ASY601 static pass): the roll hard-asserts ZERO heartbeat stalls
+    over threshold, so a blocking call sneaking onto the loop (a sync
+    sleep, a stray blocking queue op) fails the bench even when the
+    wall time would still pass its floor.
+    """
+    from k8s_operator_libs_tpu.kube import (
+        LocalApiServer,
+        RestClient,
+        RestConfig,
+        install_wire_loop_watchdog,
+    )
+
+    watchdog = install_wire_loop_watchdog()  # applies default threshold
+    watchdog.reset()
     with LocalApiServer() as srv:
         _, sim = build_pool(cluster=srv.cluster)
         client = RestClient(RestConfig(server=srv.url))
@@ -555,6 +569,14 @@ def run_http_wire_roll() -> dict:
             f"requests over {server_connections} connections (the "
             "keep-alive pool is the speedup; its loss is a regression)"
         )
+    wire_loop = watchdog.stats()
+    if wire_loop["stalls_over_threshold"]:
+        raise RuntimeError(
+            f"http_wire_roll: {wire_loop['stalls_over_threshold']} wire-"
+            f"loop stall(s) over {wire_loop['threshold_s']}s (max "
+            f"{wire_loop['max_stall_s']}s) — something blocked the "
+            "shared event loop (the ASY601 hazard, at runtime)"
+        )
     return {
         "wall_s": round(elapsed, 3),
         "passes": passes,
@@ -576,6 +598,7 @@ def run_http_wire_roll() -> dict:
             "encoding": "json (loopback: CPU-bound, not byte-bound; "
                         "see wire_encoding section)",
         },
+        "wire_loop": wire_loop,
     }
 
 
@@ -2329,7 +2352,13 @@ def run_report_storm(
       nothing) while the lease flow shed zero;
     * **bounded reconcile latency** — the node-patch p99 stays under
       1s under full telemetry saturation (CI floor pins the measured
-      figure at tools/bench_smoke_baseline.json: report_storm.*).
+      figure at tools/bench_smoke_baseline.json: report_storm.*);
+    * **zero event-loop stalls** (ISSUE 15) — the server loop and the
+      shared client wire loop both run under the stall watchdog
+      (kube/loopwatch.py): a storm must saturate through QUEUES and
+      sheds, never by blocking a loop. The storm threshold (1s) is
+      above the GIL-scheduling jitter ~66 busy threads can impose on a
+      loop thread's heartbeat, and far below any genuine blocking call.
     """
     import threading
 
@@ -2338,6 +2367,7 @@ def run_report_storm(
         RestClient,
         RestConfig,
         TooManyRequestsError,
+        install_wire_loop_watchdog,
         wrap,
     )
     from k8s_operator_libs_tpu.kube.apiserver import ApfConfig, FlowConfig
@@ -2356,7 +2386,14 @@ def run_report_storm(
     # the concurrency unit a storm actually multiplies) sheds instead
     # of queueing without limit.
     apf.flows["telemetry"] = FlowConfig(queue_depth=8, concurrency=1)
-    with LocalApiServer(apf=apf) as srv:
+    stall_threshold_s = 1.0
+    wire_watchdog = install_wire_loop_watchdog(
+        threshold_s=stall_threshold_s
+    )
+    wire_watchdog.reset()
+    with LocalApiServer(
+        apf=apf, stall_watchdog_threshold_s=stall_threshold_s
+    ) as srv:
         srv.cluster.create(wrap({
             "kind": "Lease",
             "apiVersion": "coordination.k8s.io/v1",
@@ -2465,9 +2502,21 @@ def run_report_storm(
         for thread in threads:
             thread.join(timeout=10)
         stats = srv.apf_stats()
+        server_loop = srv.loop_stall_stats()
+    wire_loop = wire_watchdog.stats()
 
     if errors:
         raise RuntimeError(f"report_storm: unexpected errors: {errors[:5]}")
+    for loop_name, loop_stats in (("server", server_loop),
+                                  ("wire", wire_loop)):
+        if loop_stats.get("stalls_over_threshold"):
+            raise RuntimeError(
+                f"report_storm: {loop_stats['stalls_over_threshold']} "
+                f"{loop_name}-loop stall(s) over "
+                f"{loop_stats['threshold_s']}s under the storm (max "
+                f"{loop_stats['max_stall_s']}s) — saturation must shed "
+                "through the APF queues, never block an event loop"
+            )
     missed = sum(1 for gap in renew_gaps if gap > lease_deadline_s)
     sheds = stats["telemetry"]["shed_429_total"]
     attempts = sum(telemetry_attempts)
@@ -2514,6 +2563,8 @@ def run_report_storm(
         "reconcile_p99_s": round(p99, 4),
         "lease_sheds_429": stats["lease"]["shed_429_total"],
         "apf_flows": stats,
+        "server_loop_stalls": server_loop,
+        "wire_loop_stalls": wire_loop,
     }
 
 

@@ -33,6 +33,11 @@ from .drain import DrainConfig, DrainError, DrainHelper, DrainTimeoutError
 from .events import EventRecorder, FakeRecorder
 from .resources import ResourceInfo, register_resource, resource_for_kind
 from .rest import RestClient, RestConfig, RestConfigError
+from .loopwatch import (
+    LoopStallWatchdog,
+    install_wire_loop_watchdog,
+    wire_loop_stall_stats,
+)
 from .apiserver import LocalApiServer
 from .informer import Informer
 from .watchhub import WatchHub
@@ -80,6 +85,9 @@ __all__ = [
     "Lease",
     "Informer",
     "LocalApiServer",
+    "LoopStallWatchdog",
+    "install_wire_loop_watchdog",
+    "wire_loop_stall_stats",
     "WatchHub",
     "ApplyConflictError",
     "json_patch",

@@ -51,6 +51,7 @@ from typing import Any, Optional
 
 from .client import ApiError, BadRequestError, WatchExpiredError
 from .fake import FakeCluster, WatchFrameSource
+from .loopwatch import LoopStallWatchdog
 from .objects import wrap
 from .resources import ResourceInfo, resource_for_plural
 from .table import accepts_table, render_table
@@ -727,6 +728,7 @@ class LocalApiServer:
         keyfile: str = "",
         bookmark_interval_s: float = 15.0,
         apf: Optional[ApfConfig] = None,
+        stall_watchdog_threshold_s: float = 0.0,
     ) -> None:
         self.cluster = cluster if cluster is not None else FakeCluster()
         self.token = token
@@ -735,6 +737,11 @@ class LocalApiServer:
         #: ``ApfConfig(enabled=False)`` for the raw dispatch path.
         self.apf = apf if apf is not None else ApfConfig()
         self._apf_scheduler: Optional[_ApfScheduler] = None
+        #: > 0 starts a :class:`~.loopwatch.LoopStallWatchdog` on the
+        #: server loop — the runtime proof that no handler blocks it
+        #: (ASY601's twin; read via :meth:`loop_stall_stats`).
+        self.stall_watchdog_threshold_s = float(stall_watchdog_threshold_s)
+        self._stall_watchdog: Optional[LoopStallWatchdog] = None
         #: Cadence of BOOKMARK events on watches that opted in via
         #: ``allowWatchBookmarks=true`` (the real server sends them about
         #: once a minute; tests shrink this to exercise the path).
@@ -783,6 +790,13 @@ class LocalApiServer:
             }
             for flow, stats in scheduler.stats.items()
         }
+
+    def loop_stall_stats(self) -> dict:
+        """Server-loop stall watchdog stats (``{}`` when the watchdog is
+        off) — the ``tpu_operator_wire_loop_stall_*`` feed for the
+        server side, and the ``report_storm`` bench's hard-zero."""
+        watchdog = self._stall_watchdog
+        return watchdog.stats() if watchdog is not None else {}
 
     def start_request_log(self) -> list:
         """Begin recording ``(method, path, query)`` per request served
@@ -837,6 +851,10 @@ class LocalApiServer:
                 self._port = self._server.sockets[0].getsockname()[1]
                 if self.apf.enabled:
                     self._apf_scheduler = _ApfScheduler(self.apf, loop)
+                if self.stall_watchdog_threshold_s > 0:
+                    self._stall_watchdog = LoopStallWatchdog(
+                        loop, threshold_s=self.stall_watchdog_threshold_s
+                    ).start()
             except BaseException as e:  # noqa: BLE001 - surfaced to start()
                 self._startup_error = e
                 return

@@ -500,12 +500,17 @@ class _Transport:
         return _Conn(reader, writer)
 
     def _release(self, conn: _Conn) -> None:
+        """Return a connection to the idle pool. Runs on the wire loop
+        only (the pool is loop-bound state; ASY604's affinity
+        convention, docs/static-analysis.md)."""
         if self.closed:
             conn.abort()
         else:
             self._idle.append(conn)
 
     def _discard(self, conn: _Conn) -> None:
+        """Abort a connection instead of pooling it. Runs on the wire
+        loop only, like every pool method."""
         conn.abort()
 
     async def close(self) -> None:
@@ -661,7 +666,7 @@ class _Transport:
                     # point; it had no transport to abort — honor the
                     # flag here.
                     self._discard(conn)
-                    out.put(("end", None))
+                    out.put_nowait(("end", None))
                     return
             data = self._request_bytes("GET", target, headers, None)
             conn.writer.write(data)
@@ -683,7 +688,7 @@ class _Transport:
                 else:
                     self._discard(conn)
                 conn = None
-                out.put((
+                out.put_nowait((
                     "httperror",
                     (status, rheaders.get("content-type"), payload),
                 ))
@@ -693,7 +698,7 @@ class _Transport:
                 if handle.cancelled:
                     self._discard(conn)
                     conn = None
-                    out.put(("end", None))
+                    out.put_nowait(("end", None))
                     return
             decoder = FrameDecoder(rheaders.get("content-type"))
             chunked = "chunked" in rheaders.get(
@@ -733,12 +738,12 @@ class _Transport:
                 self.bytes_received += len(piece)
                 for event in decoder.feed(piece):
                     self.watch_frames_received += 1
-                    out.put(("event", event))
-            out.put(("end", None))
+                    out.put_nowait(("event", event))
+            out.put_nowait(("end", None))
         except asyncio.CancelledError:
             if conn is not None:
                 self._discard(conn)
-            out.put(("end", None))
+            out.put_nowait(("end", None))
             raise
         except (
             OSError, asyncio.TimeoutError,
@@ -747,14 +752,14 @@ class _Transport:
             if conn is not None:
                 self._discard(conn)
             if handle is not None and handle.cancelled:
-                out.put(("end", None))
+                out.put_nowait(("end", None))
             else:
-                out.put(("error",
+                out.put_nowait(("error",
                          _TransportError(str(e) or type(e).__name__)))
         except Exception as e:  # noqa: BLE001 - surfaced to the consumer
             if conn is not None:
                 self._discard(conn)
-            out.put(("error", e))
+            out.put_nowait(("error", e))
 
 
 class RestClient(Client):

@@ -390,16 +390,24 @@ class WireMetrics:
       depths (max exported), stale self-resumes, per-scope subscriber
       gauges;
     * **APF** (from ``LocalApiServer.apf_stats()``): per-flow queue
-      depth, admitted/shed totals (a shed IS a 429), high-water depth.
+      depth, admitted/shed totals (a shed IS a 429), high-water depth;
+    * **loop stall watchdog** — pass either a
+      ``kube.loopwatch.LoopStallWatchdog`` (its ``stats()`` shape) or a
+      ``LocalApiServer`` directly (its ``loop_stall_stats()`` shape) as
+      ``loop_watchdog=``: heartbeat-measured event-loop stalls over
+      threshold and the worst observed stall, the runtime twin of the
+      ASY601 static pass (docs/static-analysis.md "Async discipline").
+      An apiserver with the watchdog off renders nothing (empty stats).
 
-    Both halves are optional and duck-typed (any object with the same
+    All halves are optional and duck-typed (any object with the same
     ``stats()``/``apf_stats()`` shape works), so the collector can sit
     beside a client-only process (hub, no server) or a server-only one.
     """
 
-    def __init__(self, hub=None, apiserver=None) -> None:
+    def __init__(self, hub=None, apiserver=None, loop_watchdog=None) -> None:
         self._hub = hub
         self._apiserver = apiserver
+        self._loop_watchdog = loop_watchdog
 
     def render(self) -> str:
         out: list[str] = []
@@ -464,6 +472,26 @@ class WireMetrics:
                  "Requests shed as 429 + Retry-After per flow",
                  [(label, s["shed_429_total"]) for label, s in labeled]),
             ]))
+        if self._loop_watchdog is not None:
+            source = getattr(
+                self._loop_watchdog, "loop_stall_stats", None
+            ) or self._loop_watchdog.stats
+            stats = source()
+            if stats:
+                out.append(render_rows(_WIRE_PREFIX, "", [
+                    ("loop_stall_total", "counter",
+                     "Event-loop heartbeat wakeups that arrived over the "
+                     "stall threshold late (each one is a window in "
+                     "which a callback held the loop)",
+                     stats["stalls_over_threshold"]),
+                    ("loop_stall_max_seconds", "gauge",
+                     "Worst observed event-loop stall since the "
+                     "watchdog started (heartbeat lateness, seconds)",
+                     stats["max_stall_s"]),
+                    ("loop_stall_threshold_seconds", "gauge",
+                     "Configured stall threshold of the loop watchdog",
+                     stats["threshold_s"]),
+                ]))
         return "".join(out)
 
 

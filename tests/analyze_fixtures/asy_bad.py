@@ -1,0 +1,96 @@
+"""Seeded ASY6xx violations — the event-loop discipline bad twin.
+
+Every shape here must be CAUGHT (tests/test_analyze.py pins code and
+count); the clean twin (asy_clean.py) holds the sanctioned forms. This
+file is parsed by the analyzer, never imported or executed.
+"""
+
+import asyncio
+import queue
+import threading
+import time
+
+
+def fetch_sync(url):
+    """The blocking leaf a coroutine must never reach, two frames up."""
+    time.sleep(0.1)
+    return url
+
+
+def traced(fn):
+    return fn
+
+
+class WirePump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._frames: queue.Queue = queue.Queue()
+
+    async def pump(self):
+        # ASY601: direct blocking call in a coroutine.
+        time.sleep(0.5)
+        # ASY601: sync queue put — blocks the loop when the queue fills.
+        self._frames.put("frame")
+
+    async def refresh(self):
+        # ASY601 (transitive): the sync helper sleeps one frame down.
+        return fetch_sync("/nodes")
+
+    async def roll(self):
+        # ASY602: coroutine called but never awaited (object discarded).
+        self.pump()
+        # ASY602: fire-and-forget task — the handle is dropped.
+        asyncio.create_task(self.refresh())
+
+    async def guarded(self):
+        with self._lock:
+            # ASY603: threading lock held across the suspension point.
+            await asyncio.sleep(0)
+
+    async def stream(self):
+        with self._lock:
+            # ASY603 via the implicit awaits of `async with`.
+            async with self._session():
+                pass
+
+    def _session(self):
+        return None
+
+    async def frames(self):
+        # Async GENERATORS are loop code too: ASY601 applies inside.
+        while True:
+            time.sleep(0.01)
+            yield "frame"
+
+
+class Decorated:
+    @traced
+    async def slow(self):
+        # ASY601: the decorator must not hide the async def.
+        time.sleep(0.2)
+
+
+class Scheduler:
+    def __init__(self):
+        self._loop = asyncio.new_event_loop()
+
+    def kick(self):
+        def wake():
+            # ASY601: `wake` runs ON the loop (call_soon_threadsafe
+            # dispatch), no matter that `kick` is a thread method.
+            time.sleep(0.1)
+
+        self._loop.call_soon_threadsafe(wake)
+
+
+class Pool:
+    def __init__(self):
+        self._idle = []
+
+    async def acquire(self):
+        return self._idle.pop()
+
+    def release(self, conn):
+        # ASY604: the idle pool is loop-bound (acquire mutates it on
+        # the loop) but this plain thread method mutates it directly.
+        self._idle.append(conn)

@@ -1,0 +1,88 @@
+"""Clean twin of asy_bad.py — sanctioned async shapes that must stay
+silent under every ASY6xx (and every other) pass.
+
+Parsed by the analyzer, never imported or executed.
+"""
+
+import asyncio
+import queue
+import threading
+
+
+class CleanPump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._alock = asyncio.Lock()
+        self._frames: asyncio.Queue = asyncio.Queue()
+        self._out: queue.Queue = queue.Queue()
+        self._loop = asyncio.new_event_loop()
+        self._tasks = []
+
+    async def pump(self):
+        # Awaited asyncio primitives are suspensions, not blocks.
+        await asyncio.sleep(0)
+        frame = await self._frames.get()
+        # Non-blocking handoff to the sync consumer side.
+        self._out.put_nowait(frame)
+        return frame
+
+    async def guarded(self):
+        # The asyncio lock across an await is the sanctioned form
+        # (ASY603 tracks threading locks only).
+        async with self._alock:
+            await asyncio.sleep(0)
+
+    async def try_lock(self):
+        # Non-blocking acquire is loop-safe.
+        if self._lock.acquire(blocking=False):
+            self._lock.release()
+
+    async def spawn(self):
+        # Task handle retained: no GC-cancellation hazard.
+        task = asyncio.create_task(self.pump())
+        self._tasks.append(task)
+        await task
+
+    async def frames(self):
+        # Clean async generator: awaits only.
+        while True:
+            item = await self._frames.get()
+            if item is None:
+                return
+            yield item
+
+    def _wake(self):
+        """Runs on the wire loop (call_soon_threadsafe below) — the
+        loop-affinity docstring convention; its body is non-blocking."""
+        self._out.put_nowait(None)
+
+    def kick(self):
+        self._loop.call_soon_threadsafe(self._wake)
+
+    async def drain(self):
+        # Loop-side mutation of loop-bound state.
+        self._tasks.clear()
+
+    def push(self, task):
+        # ASY604's own recommended fix: a lambda dispatched to the loop
+        # mutates loop-bound state ON the loop — never a finding.
+        self._loop.call_soon_threadsafe(lambda: self._tasks.append(task))
+
+
+class SyncFacade:
+    """The sync side of the boundary: blocking HERE is fine — these
+    methods run on plain threads, never on the loop."""
+
+    def __init__(self):
+        self._loop = asyncio.new_event_loop()
+        self._done: queue.Queue = queue.Queue()
+
+    def call(self, coro):
+        # run_coroutine_threadsafe boundary: the future is retained and
+        # the PARKED side is the calling thread, not the loop.
+        future = asyncio.run_coroutine_threadsafe(coro, self._loop)
+        return future.result(5)
+
+    def next_frame(self):
+        # Sync consumer of the loop's put_nowait handoff.
+        return self._done.get(timeout=1)

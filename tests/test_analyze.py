@@ -382,10 +382,13 @@ def test_write_baseline_keeps_out_of_scope_entries(tmp_path):
 # -- the acceptance criterion itself --------------------------------------
 
 def test_package_gate_is_clean_via_entrypoint():
-    """`python tools/analyze.py k8s_operator_libs_tpu` (what make lint and
-    CI run) exits 0 against the checked-in baseline."""
+    """`python tools/analyze.py k8s_operator_libs_tpu tools/chaos_run.py
+    tools/trace_view.py` (what make lint and CI run — the chaos driver
+    and flight recorder are in scope since ISSUE 15) exits 0 against
+    the checked-in baseline."""
     proc = subprocess.run(
-        [sys.executable, "tools/analyze.py", "k8s_operator_libs_tpu"],
+        [sys.executable, "tools/analyze.py", "k8s_operator_libs_tpu",
+         "tools/chaos_run.py", "tools/trace_view.py"],
         cwd=REPO, capture_output=True, text=True, timeout=120,
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
@@ -998,3 +1001,419 @@ def test_dryrun_defining_a_callback_is_not_mutating(tmp_path):
         "            self.install_callback()\n"
     )
     assert run_analysis([str(mod)]) == []
+
+
+# -- asyncio discipline (ASY601-ASY604) ------------------------------------
+
+def test_asy_bad_fixture_flags_all_seeded_violations():
+    findings = run_analysis([str(FIXTURES / "asy_bad.py")])
+    assert codes(findings) == {"ASY601", "ASY602", "ASY603", "ASY604"}
+    by_code = {}
+    for f in findings:
+        by_code.setdefault(f.code, []).append(f)
+    # pump (sleep + queue put), refresh (transitive), the async
+    # generator, the decorated coroutine, the dispatched callback.
+    assert len(by_code["ASY601"]) == 6
+    assert len(by_code["ASY602"]) == 2
+    assert len(by_code["ASY603"]) == 2
+    assert len(by_code["ASY604"]) == 1
+
+
+def test_asy_clean_twin_silent():
+    assert run_analysis([str(FIXTURES / "asy_clean.py")]) == []
+
+
+def _asy(tmp_path, source: str):
+    mod = tmp_path / "mod.py"
+    mod.write_text(source)
+    return run_analysis([str(mod)])
+
+
+def test_asy601_direct_blocking_in_coroutine(tmp_path):
+    findings = _asy(
+        tmp_path,
+        "import time\n\n\n"
+        "async def pump():\n"
+        "    time.sleep(0.1)\n",
+    )
+    assert codes(findings) == {"ASY601"}
+    assert "time.sleep" in findings[0].message
+
+
+def test_asy601_transitive_chain_carries_witness(tmp_path):
+    findings = _asy(
+        tmp_path,
+        "import time\n\n\n"
+        "def backoff():\n"
+        "    time.sleep(1)\n"
+        "\n\n"
+        "def fetch():\n"
+        "    return backoff()\n"
+        "\n\n"
+        "async def refresh():\n"
+        "    return fetch()\n",
+    )
+    assert codes(findings) == {"ASY601"}
+    assert "fetch -> backoff" in findings[0].message
+
+
+def test_asy601_awaited_asyncio_primitives_are_suspensions(tmp_path):
+    findings = _asy(
+        tmp_path,
+        "import asyncio\n\n\n"
+        "class Hub:\n"
+        "    def __init__(self):\n"
+        "        self._wake = asyncio.Event()\n"
+        "        self._q: asyncio.Queue = asyncio.Queue()\n"
+        "\n"
+        "    async def drain(self):\n"
+        "        await self._wake.wait()\n"
+        "        item = await self._q.get()\n"
+        "        await asyncio.sleep(0)\n"
+        "        return item\n",
+    )
+    assert findings == [], [str(f) for f in findings]
+
+
+def test_asy601_sync_client_facade_reached_from_coroutine(tmp_path):
+    # The ISSUE 15 headline hazard: a coroutine calling the sync Client
+    # facade parks the loop in Future.result over ITSELF — deadlock.
+    findings = _asy(
+        tmp_path,
+        "import asyncio\n\n\n"
+        "class Facade:\n"
+        "    def __init__(self):\n"
+        "        self._loop = asyncio.new_event_loop()\n"
+        "\n"
+        "    def _call(self, coro):\n"
+        "        future = asyncio.run_coroutine_threadsafe(\n"
+        "            coro, self._loop)\n"
+        "        return future.result(10)\n"
+        "\n"
+        "    def get(self, name):\n"
+        "        return self._call(name)\n"
+        "\n\n"
+        "class Handler:\n"
+        "    def __init__(self, client: Facade):\n"
+        "        self._client = client\n"
+        "\n"
+        "    async def handle(self):\n"
+        "        return self._client.get('node-1')\n",
+    )
+    assert codes(findings) == {"ASY601"}
+    assert "result" in findings[0].message
+    assert "Facade.get -> Facade._call" in findings[0].message
+
+
+def test_asy601_async_callee_reports_once(tmp_path):
+    # The blocking coroutine is its own reporting point; awaiting it
+    # must not duplicate the finding at every caller.
+    findings = _asy(
+        tmp_path,
+        "import time\n\n\n"
+        "class C:\n"
+        "    async def leaf(self):\n"
+        "        time.sleep(1)\n"
+        "\n"
+        "    async def outer(self):\n"
+        "        await self.leaf()\n",
+    )
+    assert [f.code for f in findings] == ["ASY601"]
+    assert findings[0].scope == "C.leaf"
+
+
+def test_asy601_call_soon_threadsafe_method_reference(tmp_path):
+    # A bound-method reference dispatched to the loop is loop-affine:
+    # its body is held to coroutine discipline.
+    findings = _asy(
+        tmp_path,
+        "import asyncio\n"
+        "import time\n\n\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._loop = asyncio.new_event_loop()\n"
+        "\n"
+        "    def _cb(self):\n"
+        "        time.sleep(0.1)\n"
+        "\n"
+        "    def kick(self):\n"
+        "        self._loop.call_soon_threadsafe(self._cb)\n",
+    )
+    assert codes(findings) == {"ASY601"}
+    assert findings[0].scope == "C._cb"
+
+
+def test_asy601_lock_acquire_nonblocking_is_clean(tmp_path):
+    findings = _asy(
+        tmp_path,
+        "import threading\n\n\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "\n"
+        "    async def try_once(self):\n"
+        "        if self._lock.acquire(blocking=False):\n"
+        "            self._lock.release()\n"
+        "\n"
+        "    async def block(self):\n"
+        "        self._lock.acquire()\n"
+        "        self._lock.release()\n",
+    )
+    assert [f.code for f in findings] == ["ASY601"]
+    assert findings[0].scope == "C.block"
+
+
+def test_asy602_bare_coroutine_call(tmp_path):
+    findings = _asy(
+        tmp_path,
+        "async def job():\n"
+        "    return 1\n"
+        "\n\n"
+        "async def main():\n"
+        "    job()\n",
+    )
+    assert codes(findings) == {"ASY602"}
+    assert "'job'" in findings[0].message
+
+
+def test_asy602_retained_and_awaited_forms_clean(tmp_path):
+    findings = _asy(
+        tmp_path,
+        "import asyncio\n\n\n"
+        "async def job():\n"
+        "    return 1\n"
+        "\n\n"
+        "async def main():\n"
+        "    await job()\n"
+        "    task = asyncio.create_task(job())\n"
+        "    await task\n",
+    )
+    assert findings == [], [str(f) for f in findings]
+
+
+def test_asy602_dropped_run_coroutine_threadsafe_future(tmp_path):
+    findings = _asy(
+        tmp_path,
+        "import asyncio\n\n\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._loop = asyncio.new_event_loop()\n"
+        "\n"
+        "    async def pump(self):\n"
+        "        return 1\n"
+        "\n"
+        "    def fire(self):\n"
+        "        asyncio.run_coroutine_threadsafe(self.pump(), self._loop)\n",
+    )
+    assert codes(findings) == {"ASY602"}
+    assert "run_coroutine_threadsafe" in findings[0].message
+
+
+def test_asy603_lock_released_before_await_is_clean(tmp_path):
+    findings = _asy(
+        tmp_path,
+        "import asyncio\n"
+        "import threading\n\n\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._n = 0\n"
+        "\n"
+        "    async def ok(self):\n"
+        "        with self._lock:\n"
+        "            self._n += 1\n"
+        "        await asyncio.sleep(0)\n",
+    )
+    assert findings == [], [str(f) for f in findings]
+
+
+def test_asy603_async_for_implicit_await_under_lock(tmp_path):
+    findings = _asy(
+        tmp_path,
+        "import threading\n\n\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "\n"
+        "    async def drain(self, source):\n"
+        "        with self._lock:\n"
+        "            async for _ in source:\n"
+        "                pass\n",
+    )
+    assert codes(findings) == {"ASY603"}
+
+
+def test_asy603_module_level_lock_identity(tmp_path):
+    findings = _asy(
+        tmp_path,
+        "import asyncio\n"
+        "import threading\n\n"
+        "_REG = threading.Lock()\n\n\n"
+        "async def publish():\n"
+        "    with _REG:\n"
+        "        await asyncio.sleep(0)\n",
+    )
+    assert codes(findings) == {"ASY603"}
+    assert "_REG" in findings[0].message
+
+
+def test_asy604_docstring_convention_silences(tmp_path):
+    bad = (
+        "class Pool:\n"
+        "    def __init__(self):\n"
+        "        self._idle = []\n"
+        "\n"
+        "    async def acquire(self):\n"
+        "        return self._idle.pop()\n"
+        "\n"
+        "    def release(self, conn):\n"
+        "        self._idle.append(conn)\n"
+    )
+    findings = _asy(tmp_path, bad)
+    assert codes(findings) == {"ASY604"}
+    good = bad.replace(
+        "    def release(self, conn):\n",
+        "    def release(self, conn):\n"
+        '        """Runs on the wire loop only."""\n',
+    )
+    mod = tmp_path / "good.py"
+    mod.write_text(good)
+    assert run_analysis([str(mod)]) == []
+
+
+def test_asy604_dispatched_callback_is_loop_context(tmp_path):
+    # A call_soon_threadsafe-dispatched nested def marks the state it
+    # mutates loop-bound; a plain thread mutation of the same attr fires.
+    findings = _asy(
+        tmp_path,
+        "import asyncio\n\n\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._loop = asyncio.new_event_loop()\n"
+        "        self._buf = []\n"
+        "\n"
+        "    def push(self, item):\n"
+        "        def _put():\n"
+        "            self._buf.append(item)\n"
+        "        self._loop.call_soon_threadsafe(_put)\n"
+        "\n"
+        "    def drop(self):\n"
+        "        self._buf.clear()\n",
+    )
+    assert codes(findings) == {"ASY604"}
+    assert findings[0].scope == "C.drop"
+
+
+def test_asy604_dispatched_lambda_is_loop_context(tmp_path):
+    # The pass's own recommended fix — routing the write through
+    # call_soon_threadsafe with a LAMBDA — must never fire; and a plain
+    # (undispatched) lambda's body runs at an unknown time on an
+    # unknown thread, so it claims neither context (like a nested def).
+    findings = _asy(
+        tmp_path,
+        "import asyncio\n\n\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._loop = asyncio.new_event_loop()\n"
+        "        self._buf = []\n"
+        "        self._cbs = []\n"
+        "\n"
+        "    async def drain(self):\n"
+        "        self._buf.clear()\n"
+        "\n"
+        "    def push(self, item):\n"
+        "        self._loop.call_soon_threadsafe(\n"
+        "            lambda: self._buf.append(item))\n"
+        "\n"
+        "    def defer(self, item):\n"
+        "        self._cbs.append(lambda: self._buf.append(item))\n",
+    )
+    # push() is clean; defer()'s lambda claims no context, but its
+    # OWN self._cbs.append is a plain thread mutation of thread-only
+    # state — also clean (no loop-side writer of _cbs).
+    assert findings == []
+
+
+def test_asy_noqa_suppresses(tmp_path):
+    findings = _asy(
+        tmp_path,
+        "import time\n\n\n"
+        "async def pump():\n"
+        "    time.sleep(0.1)  # noqa: ASY601\n",
+    )
+    assert findings == []
+
+
+def test_lck102_asyncio_sleep_under_lock_is_asy603_not_lck102(tmp_path):
+    # Suspending under a threading lock is ASY603's finding; the sync
+    # blocking classifiers must not double-report asyncio awaitable
+    # factories as thread-blocking calls.
+    findings = _asy(
+        tmp_path,
+        "import asyncio\n"
+        "import threading\n\n\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "\n"
+        "    async def bad(self):\n"
+        "        with self._lock:\n"
+        "            await asyncio.sleep(0)\n",
+    )
+    assert codes(findings) == {"ASY603"}
+
+
+def test_cli_stats_include_async_coverage(capsys):
+    rc = cli.main([str(FIXTURES / "asy_bad.py"), "--baseline", "-",
+                   "--stats"])
+    assert rc == 1
+    err = capsys.readouterr().err
+    line = next(ln for ln in err.splitlines()
+                if ln.startswith("analyze stats:"))
+    assert "coroutines=" in line and "await_edges=" in line
+    assert "loop_affine=" in line
+
+
+def test_sarif_rules_include_asy_family(tmp_path, capsys):
+    sarif_file = tmp_path / "report.sarif"
+    rc = cli.main([str(FIXTURES / "asy_bad.py"), "--baseline", "-",
+                   "--sarif", str(sarif_file)])
+    assert rc == 1
+    capsys.readouterr()
+    doc = json.loads(sarif_file.read_text())
+    rule_ids = {r["id"] for r in doc["runs"][0]["tool"]["driver"]["rules"]}
+    assert {"ASY601", "ASY602", "ASY603", "ASY604"} <= rule_ids
+    assert {res["ruleId"] for res in doc["runs"][0]["results"]} == {
+        "ASY601", "ASY602", "ASY603", "ASY604"
+    }
+
+
+def test_package_is_asy_clean():
+    """The shipped wire path is provably loop-disciplined: zero ASY6xx
+    findings outside the baseline (today: zero, period — the watch_pump
+    put_nowait fix and the pool's loop-affinity docstrings landed with
+    the pass). Regresses loudly if a blocking call, an unawaited
+    coroutine, a lock-across-await, or a cross-thread mutation of
+    loop-bound state enters kube/rest.py, kube/apiserver.py, or
+    anything else on the loop."""
+    findings = run_analysis(
+        [str(REPO / "k8s_operator_libs_tpu")],
+        pass_names=["asyncio-discipline", "loop-affinity"],
+    )
+    assert findings == [], [str(f) for f in findings]
+
+
+def test_pr12_14_modules_are_exc_key_clean():
+    """EXC401/KEY301 sweep over the chaos/tracing/faultpoints modules
+    (in analyze scope since ISSUE 15): clean, no baseline entries."""
+    targets = [
+        str(REPO / "k8s_operator_libs_tpu" / "utils" / "tracing.py"),
+        str(REPO / "k8s_operator_libs_tpu" / "utils" / "faultpoints.py"),
+        str(REPO / "k8s_operator_libs_tpu" / "testing" / "chaos.py"),
+        str(REPO / "tools" / "chaos_run.py"),
+        str(REPO / "tools" / "trace_view.py"),
+    ]
+    findings = run_analysis(
+        targets, pass_names=["swallowed-exception", "literal-key"]
+    )
+    assert findings == [], [str(f) for f in findings]

@@ -843,6 +843,23 @@ class TestHubWireMetrics:
             finally:
                 inf.stop()
 
+    def test_loop_stall_watchdog_metrics_render(self, server, client):
+        # ISSUE 15: the tpu_operator_wire_loop_stall_* counter/max pair
+        # (the ASY601 runtime twin) rides the same WireMetrics family.
+        from k8s_operator_libs_tpu.kube import install_wire_loop_watchdog
+
+        watchdog = install_wire_loop_watchdog()
+        watchdog.reset()
+        assert wait_until(lambda: watchdog.heartbeats > 0)
+        rendered = WireMetrics(
+            apiserver=server, loop_watchdog=watchdog
+        ).render()
+        assert "tpu_operator_wire_loop_stall_total 0" in rendered
+        assert "tpu_operator_wire_loop_stall_max_seconds" in rendered
+        assert "tpu_operator_wire_loop_stall_threshold_seconds" in rendered
+        # Duck-typed: the apiserver's own watchdog stats render too.
+        assert "tpu_operator_wire_apf_queue_depth" in rendered
+
 
 class TestHubUnderScheduledLag:
     """ISSUE 13 satellite: WatchHub under SCHEDULED lag — a subscriber

@@ -496,3 +496,145 @@ class TestTableWatch:
                 assert event["object"]["kind"] == "Node"
             finally:
                 conn.close()
+
+
+class TestLoopStallWatchdog:
+    """kube/loopwatch.py — the runtime twin of the ASY601 static pass
+    (ISSUE 15): heartbeat-measured event-loop stalls."""
+
+    @staticmethod
+    def _running_loop():
+        import asyncio
+
+        loop = asyncio.new_event_loop()
+        thread = threading.Thread(target=loop.run_forever, daemon=True)
+        thread.start()
+        return loop
+
+    def test_detects_seeded_stall(self):
+        from k8s_operator_libs_tpu.kube import LoopStallWatchdog
+
+        loop = self._running_loop()
+        try:
+            watchdog = LoopStallWatchdog(
+                loop, threshold_s=0.1, interval_s=0.01
+            ).start()
+            assert wait_until(lambda: watchdog.heartbeats > 0)
+            # The ASY601 bug, committed at runtime: a blocking sleep
+            # lands on the loop and holds it past the threshold.
+            loop.call_soon_threadsafe(lambda: time.sleep(0.3))
+            assert wait_until(
+                lambda: watchdog.stalls_over_threshold >= 1, timeout=5
+            )
+            assert watchdog.max_stall_s >= 0.2
+            stats = watchdog.stats()
+            assert stats["threshold_s"] == 0.1
+            assert stats["stalls_over_threshold"] >= 1
+            watchdog.stop()
+        finally:
+            loop.call_soon_threadsafe(loop.stop)
+
+    def test_clean_loop_counts_zero_and_reset_zeroes(self):
+        from k8s_operator_libs_tpu.kube import LoopStallWatchdog
+
+        loop = self._running_loop()
+        try:
+            watchdog = LoopStallWatchdog(
+                loop, threshold_s=1.0, interval_s=0.01
+            ).start()
+            assert wait_until(lambda: watchdog.heartbeats > 5)
+            assert watchdog.stalls_over_threshold == 0
+            watchdog.reset()
+            assert wait_until(lambda: watchdog.heartbeats > 0)
+            assert watchdog.stalls_over_threshold == 0
+            watchdog.stop()
+        finally:
+            loop.call_soon_threadsafe(loop.stop)
+
+    def test_wire_loop_install_is_idempotent(self):
+        from k8s_operator_libs_tpu.kube import (
+            install_wire_loop_watchdog,
+            wire_loop_stall_stats,
+        )
+
+        first = install_wire_loop_watchdog()
+        second = install_wire_loop_watchdog(threshold_s=2.5)
+        assert first is second
+        # The advertised tuning knob works regardless of install order:
+        # a re-install applies the requested threshold to the live
+        # watchdog (both knobs are read per heartbeat).
+        assert second.threshold_s == 2.5
+        install_wire_loop_watchdog()  # defaults restored for the suite
+        assert wait_until(lambda: first.heartbeats > 0)
+        stats = wire_loop_stall_stats()
+        assert stats["threshold_s"] == first.threshold_s
+        assert "stalls_over_threshold" in stats
+
+    def test_clean_roundtrips_do_not_stall_the_wire_loop(self):
+        from k8s_operator_libs_tpu.kube import install_wire_loop_watchdog
+
+        watchdog = install_wire_loop_watchdog()
+        watchdog.reset()
+        with LocalApiServer() as srv:
+            client = RestClient(RestConfig(server=srv.url))
+            try:
+                for i in range(20):
+                    srv.cluster.create(make_node(f"wd-{i}"))
+                assert len(client.list("Node")) == 20
+            finally:
+                client.close()
+        assert wait_until(lambda: watchdog.heartbeats > 0)
+        assert watchdog.stalls_over_threshold == 0
+
+    def test_apiserver_stall_watchdog_opt_in(self):
+        with LocalApiServer(stall_watchdog_threshold_s=0.5) as srv:
+            client = RestClient(RestConfig(server=srv.url))
+            try:
+                srv.cluster.create(make_node("wd-server"))
+                assert client.get("Node", "wd-server").name == "wd-server"
+                assert wait_until(
+                    lambda: srv.loop_stall_stats().get("heartbeats", 0) > 0
+                )
+                stats = srv.loop_stall_stats()
+                assert stats["threshold_s"] == 0.5
+                assert stats["stalls_over_threshold"] == 0
+                # The server itself is a valid WireMetrics loop_watchdog
+                # (duck-typed on loop_stall_stats).
+                from k8s_operator_libs_tpu.upgrade.metrics import (
+                    WireMetrics,
+                )
+
+                rendered = WireMetrics(loop_watchdog=srv).render()
+                assert "tpu_operator_wire_loop_stall_total 0" in rendered
+            finally:
+                client.close()
+        # Off by default: no watchdog, empty stats.
+        with LocalApiServer() as srv2:
+            assert srv2.loop_stall_stats() == {}
+
+
+class TestWatchFrameBuffering:
+    def test_frames_buffer_while_consumer_is_busy(self):
+        """Pin of the ISSUE 15 ASY601 fix: watch_pump hands frames to
+        the consumer with put_nowait (the frame queue is unbounded), so
+        a busy consumer backs frames up client-side without ever
+        blocking the shared wire loop — and loses none of them."""
+        with LocalApiServer() as srv:
+            client = RestClient(RestConfig(server=srv.url))
+            try:
+                _, rv = client.list_with_revision("Node")
+                stream = client.watch(
+                    "Node", timeout_seconds=10, resource_version=rv
+                )
+                srv.cluster.create(make_node("slow-0"))
+                event_type, obj = next(stream)
+                assert (event_type, obj.name) == ("ADDED", "slow-0")
+                # Flood while the consumer sleeps: the pump keeps
+                # draining the socket into the client-side queue.
+                for i in range(1, 50):
+                    srv.cluster.create(make_node(f"slow-{i}"))
+                time.sleep(0.5)
+                names = [next(stream)[1].name for _ in range(49)]
+                assert names == [f"slow-{i}" for i in range(1, 50)]
+            finally:
+                client.close()

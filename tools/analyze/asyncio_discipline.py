@@ -1,0 +1,452 @@
+"""Event-loop discipline — the ASY6xx family.
+
+The production data plane rides single-threaded asyncio event loops
+behind sync facades: the client wire loop (``kube/rest.py``), the
+LocalApiServer loop (``kube/apiserver.py``), and everything PR 11/14
+hung off them (watch hub upstreams, the APF scheduler, trace
+propagation). One blocking call reachable on a loop stalls every
+connection, every watch window, and every APF flow at once — and no
+test reliably catches it, because the stall is load-dependent. These
+passes prove the property statically (docs/static-analysis.md "Async
+discipline"); the runtime twin is the wire-loop stall watchdog
+(``kube/loopwatch.py``).
+
+* **ASY601** — a blocking call transitively reachable inside a
+  coroutine (or any loop-affine function — see
+  ``callgraph.loop_affine_doc``): ``time.sleep``, sync socket/file/
+  subprocess I/O, ``queue.Queue.get/put``, un-awaited
+  ``wait``/``wait_for``/``sleep``/``join``, ``Lock.acquire`` without
+  ``blocking=False``, ``Future.result`` — and, transitively, the sync
+  ``Client`` facade itself (it parks in ``Future.result`` over the wire
+  loop, so a coroutine calling it would deadlock the loop on itself).
+  Blocking facts seed in sync functions and propagate up sync call
+  chains only: a coroutine reports its OWN body and its sync callees —
+  an async callee is its own reporting point, so one bug reports once.
+* **ASY602** — a coroutine invoked as a bare expression statement (the
+  coroutine object is discarded without ever running), or a
+  ``create_task``/``ensure_future``/``run_coroutine_threadsafe`` whose
+  handle is dropped: the loop keeps only a weak reference to tasks, so
+  GC can cancel a fire-and-forget task mid-flight.
+* **ASY603** — a ``threading`` lock held across an ``await`` (including
+  the implicit awaits of ``async with``/``async for``). The lock
+  identity model is lock_discipline's; the suspension point turns a
+  bounded critical section into an unbounded one — every other loop
+  callback runs while the lock is held, and any of them touching the
+  same lock deadlocks the loop.
+* **ASY604** — loop-bound state (an attribute mutated on the event
+  loop: in a coroutine, a loop-affine-documented method, or a
+  ``call_soon_threadsafe``-dispatched callback) also mutated from a
+  plain thread method without going through
+  ``call_soon_threadsafe``/``run_coroutine_threadsafe``. The loop-side
+  mutation declares single-threaded ownership; the thread-side mutation
+  breaks it. The fix is either the threadsafe dispatch or — for a sync
+  helper that only ever runs on the loop — the loop-affinity docstring
+  convention, which is checkable exactly like the caller-holds-lock
+  convention.
+
+Known approximations (docs/static-analysis.md): ``with lock:`` on a
+loop path is NOT ASY601 (acquiring a briefly-held threading lock from
+the loop is the fake-cluster dispatch design; holders are separately
+held to LCK102/111 never-block-under-lock discipline, which bounds the
+wait). Awaited calls are never blocking (awaiting suspends). Reads of
+loop-bound state from threads are tolerated (the codebase's GIL-atomic
+counter convention). A coroutine object retained but never awaited is
+not detected (only the discarded-expression shape is).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .callgraph import (
+    CORO_DISPATCH_NAMES,
+    LOOP_DISPATCH_ARG,
+    CallGraph,
+    FunctionInfo,
+    get_callgraph,
+    loop_affine_doc,
+)
+from .core import AnalysisPass, Project, register
+from .interproc import (
+    EXT_BLOCKING_PREFIXES,
+    MAX_CHAIN,
+    _Engine,
+    _own_body_calls,
+)
+from .lock_discipline import _dotted, dotted_blocking_reason
+
+#: Method names that block when NOT awaited (on asyncio primitives the
+#: awaited form is the non-blocking one; on threading primitives there
+#: is no awaited form at all).
+_TIMING_METHODS = {"sleep", "wait", "wait_for"}
+
+
+def _is_false(expr: ast.expr) -> bool:
+    return isinstance(expr, ast.Constant) and expr.value is False
+
+
+def _async_blocking_reason(
+    graph: CallGraph,
+    fi: FunctionInfo,
+    call: ast.Call,
+    env: dict[str, str],
+    awaited: set[int],
+) -> str:
+    """Blocking verdict for one call as seen FROM AN EVENT LOOP — the
+    async sibling of ``dotted_blocking_reason``. Differences from the
+    lock-discipline classifier: everything ``asyncio.*`` (by dotted name
+    or receiver type) is a suspension, never a block; an awaited call is
+    sanctioned (awaiting IS the non-blocking form); ``Condition.wait``
+    has no own-lock exemption (releasing the lock does not unblock the
+    loop's thread); and the taxonomy adds ``queue.Queue.get/put``,
+    ``Lock.acquire(blocking=True)`` and ``Future.result``."""
+    name = _dotted(call.func)
+    if name.startswith("asyncio."):
+        return ""
+    reason = dotted_blocking_reason(name)
+    if reason:
+        return reason
+    last = (call.func.attr if isinstance(call.func, ast.Attribute)
+            else name.rsplit(".", 1)[-1] if name else "")
+    ext = graph.ext_receiver(fi, call, env)
+    if ext:
+        if ext == "asyncio.run_coroutine_threadsafe" and last == "result":
+            # The one asyncio-typed receiver that BLOCKS: the returned
+            # future is a concurrent.futures.Future — result() parks the
+            # calling thread, and on the loop that is a self-deadlock
+            # (the sync-facade hazard).
+            return f"{ext}.result"
+        if ext.startswith("asyncio."):
+            return ""
+        for prefix in EXT_BLOCKING_PREFIXES:
+            if ext.startswith(prefix):
+                method = (call.func.attr
+                          if isinstance(call.func, ast.Attribute) else "")
+                return f"{ext}.{method}"
+    if id(call) in awaited:
+        return ""
+    if last in _TIMING_METHODS:
+        return name or last
+    if last == "join":
+        return "" if call.args else (name or "join")  # sep.join(parts)
+    if last == "acquire":
+        nonblocking = any(
+            kw.arg == "blocking" and _is_false(kw.value)
+            for kw in call.keywords
+        ) or bool(call.args and _is_false(call.args[0]))
+        return "" if nonblocking else (name or "acquire")
+    if last == "result" and len(call.args) <= 1:
+        return name or "Future.result"
+    if last in ("get", "put"):
+        source = ext or name
+        if "Queue" in source or source.startswith("queue."):
+            return f"{source}.{last}" if ext else source
+    return ""
+
+
+def _own_stmts(func_node):
+    """Statements in a function's own body, pruning nested ``def``
+    bodies (they are indexed and checked as their own functions)."""
+    stack = list(func_node.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.stmt):
+                stack.append(child)
+            elif isinstance(child, (ast.ExceptHandler, ast.match_case)):
+                stack.extend(child.body)
+
+
+@register
+class AsyncioDisciplinePass(AnalysisPass):
+    name = "asyncio-discipline"
+    codes = ("ASY601", "ASY602", "ASY603")
+
+    def run(self, project: Project) -> None:
+        graph = get_callgraph(project)
+        engine = _Engine.for_project(project)
+        self._envs: dict[str, dict[str, str]] = {}
+        own_sites: dict[str, list[tuple[ast.Call, str]]] = {}
+        own_table: dict[str, dict[str, tuple[str, ...]]] = {}
+        for fid, fi in graph.functions.items():
+            env = graph.local_env(fi)
+            self._envs[fid] = env
+            awaited = {
+                id(node.value)
+                for node in ast.walk(fi.node)
+                if isinstance(node, ast.Await)
+            }
+            sites: list[tuple[ast.Call, str]] = []
+            table: dict[str, tuple[str, ...]] = {}
+            for call in _own_body_calls(fi.node):
+                reason = _async_blocking_reason(graph, fi, call, env,
+                                                awaited)
+                if reason:
+                    sites.append((call, reason))
+                    table.setdefault(reason, (fid,))
+            own_sites[fid] = sites
+            own_table[fid] = table
+        sync_facts = self._propagate_sync(graph, own_table)
+        self._check_blocking(graph, engine, own_sites, sync_facts)
+        self._check_never_awaited(graph, engine)
+        self._check_lock_across_await(engine)
+
+    # -- ASY601 ------------------------------------------------------------
+    @staticmethod
+    def _propagate_sync(
+        graph: CallGraph,
+        own_table: dict[str, dict[str, tuple[str, ...]]],
+    ) -> dict[str, dict[str, tuple[str, ...]]]:
+        """Fixpoint of blocking facts over SYNC functions only. Async
+        functions neither accumulate nor forward facts — each coroutine
+        is its own reporting point, so a blocking call deep in a shared
+        async helper reports once (there), not at every awaiter."""
+        sync = {
+            fid for fid, fi in graph.functions.items() if not fi.is_async
+        }
+        facts = {fid: dict(own_table[fid]) for fid in sync}
+        callers: dict[str, set[str]] = {}
+        for fid in sync:
+            for _, callees in graph.calls.get(fid, ()):
+                for callee in callees:
+                    callers.setdefault(callee, set()).add(fid)
+        work = list(sync)
+        pending = set(work)
+        while work:
+            fid = work.pop()
+            pending.discard(fid)
+            table = facts[fid]
+            changed = False
+            for _, callees in graph.calls.get(fid, ()):
+                for callee in callees:
+                    for reason, chain in facts.get(callee, {}).items():
+                        if reason not in table:
+                            table[reason] = ((fid,) + chain)[:MAX_CHAIN]
+                            changed = True
+            if changed:
+                for caller in callers.get(fid, ()):
+                    if caller not in pending:
+                        pending.add(caller)
+                        work.append(caller)
+        return facts
+
+    def _check_blocking(self, graph, engine, own_sites, sync_facts) -> None:
+        for fid in sorted(graph.loop_affine_fids):
+            fi = graph.functions[fid]
+            kind = "coroutine" if fi.is_async else "loop-affine function"
+            reported: set[int] = set()
+            for call, reason in own_sites.get(fid, ()):
+                if id(call) in reported:
+                    continue
+                reported.add(id(call))
+                self.add(
+                    fi.module, call, "ASY601",
+                    f"blocking call '{reason}' on the event loop — "
+                    f"{kind} '{fi.qualname}' stalls every task on the "
+                    f"loop while it blocks",
+                )
+            for node, callees in graph.calls.get(fid, ()):
+                if id(node) in reported:
+                    continue
+                for callee in callees:
+                    if callee in graph.loop_affine_fids:
+                        continue  # its own reporting point
+                    table = sync_facts.get(callee)
+                    if not table:
+                        continue
+                    reason, chain = sorted(table.items())[0]
+                    reported.add(id(node))
+                    self.add(
+                        fi.module, node, "ASY601",
+                        f"call to '{engine.qualname(callee)}' can block "
+                        f"('{reason}' via {engine.chain_text(chain)}) on "
+                        f"the event loop — {kind} '{fi.qualname}' must "
+                        f"never block",
+                    )
+                    break
+
+    # -- ASY602 ------------------------------------------------------------
+    def _check_never_awaited(self, graph: CallGraph, engine) -> None:
+        for fid, fi in graph.functions.items():
+            env = self._envs[fid]
+            for stmt in _own_stmts(fi.node):
+                if not (isinstance(stmt, ast.Expr)
+                        and isinstance(stmt.value, ast.Call)):
+                    continue
+                call = stmt.value
+                callees = graph.resolve_call(fi, call, env)
+                async_callees = [
+                    c for c in callees if graph.functions[c].is_async
+                ]
+                if async_callees:
+                    self.add(
+                        fi.module, call, "ASY602",
+                        f"coroutine '{engine.qualname(async_callees[0])}' "
+                        f"is called but never awaited — the coroutine "
+                        f"object is discarded without running",
+                    )
+                    continue
+                name = (call.func.attr
+                        if isinstance(call.func, ast.Attribute)
+                        else call.func.id
+                        if isinstance(call.func, ast.Name) else "")
+                if name in CORO_DISPATCH_NAMES:
+                    self.add(
+                        fi.module, call, "ASY602",
+                        f"task created by '{name}' without retaining the "
+                        f"returned handle — the loop holds tasks only "
+                        f"weakly, so GC can cancel a fire-and-forget task "
+                        f"mid-flight (and a dropped future loses its "
+                        f"exception)",
+                    )
+
+    # -- ASY603 ------------------------------------------------------------
+    def _check_lock_across_await(self, engine) -> None:
+        for fid, summary in engine.summaries.items():
+            reported: set[int] = set()
+            for fact in summary.awaits:
+                if id(fact.node) in reported:
+                    continue
+                reported.add(id(fact.node))
+                locks = ", ".join(sorted({ref.lock for ref in fact.held}))
+                self.add(
+                    summary.fi.module, fact.node, "ASY603",
+                    f"threading lock '{locks}' held across an await in "
+                    f"'{summary.fi.qualname}' — the suspension point "
+                    f"leaves the lock held while the loop runs arbitrary "
+                    f"other callbacks (unbounded critical section)",
+                )
+
+
+# ---------------------------------------------------------------------------
+# ASY604 — loop-bound state touched from a non-loop thread
+# ---------------------------------------------------------------------------
+
+#: Container-mutator method names counted as mutations of ``self.X``
+#: when called as ``self.X.append(...)`` etc. — loop-bound state is
+#: mostly deques/sets/dicts, and LCK101-style assignment tracking alone
+#: would miss every one of them.
+MUTATOR_METHODS = {
+    "append", "appendleft", "add", "insert", "extend", "update",
+    "setdefault", "pop", "popleft", "popitem", "remove", "discard",
+    "clear",
+}
+
+
+def _self_attr_target(node: ast.expr) -> str:
+    """'attr' for ``self.attr`` or ``self.attr[...]`` targets."""
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return ""
+
+
+@register
+class LoopAffinityPass(AnalysisPass):
+    """ASY604: per class, partition methods into loop contexts (async
+    defs, loop-affine-documented methods, ``call_soon*``-dispatched
+    nested defs) and thread contexts (everything else but
+    ``__init__``/``__new__``), then flag thread-context mutations of
+    any attribute the loop context also mutates."""
+
+    name = "loop-affinity"
+    codes = ("ASY604",)
+
+    def run(self, project: Project) -> None:
+        graph = get_callgraph(project)
+        for info in graph.classes.values():
+            self._check_class(graph, info)
+
+    def _check_class(self, graph: CallGraph, info) -> None:
+        loop_sites: dict[str, list[ast.AST]] = {}
+        thread_sites: dict[str, list[ast.AST]] = {}
+
+        def record(attr: str, node: ast.AST, on_loop: bool) -> None:
+            (loop_sites if on_loop else thread_sites).setdefault(
+                attr, []
+            ).append(node)
+
+        def scan(body, on_loop: bool, owner_fid: str) -> None:
+            stack = list(body)
+            while stack:
+                node = stack.pop()
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    nested_fid = f"{owner_fid}.{node.name}"
+                    if nested_fid in graph.loop_dispatched:
+                        # call_soon_threadsafe(cb): the body runs on the
+                        # loop regardless of the scheduling thread.
+                        scan(node.body, True, nested_fid)
+                    # Other nested defs run at an unknown time on an
+                    # unknown thread: skipped, like lock_discipline.
+                    continue
+                if isinstance(node, ast.Lambda):
+                    # Deferred code, same rule as nested defs — a
+                    # dispatched lambda's body was already scanned as
+                    # loop context at its call site below. Defaults
+                    # evaluate eagerly, so they keep this context.
+                    stack.extend(node.args.defaults)
+                    stack.extend(d for d in node.args.kw_defaults
+                                 if d is not None)
+                    continue
+                if isinstance(node, ast.Call):
+                    func = node.func
+                    name = (func.attr
+                            if isinstance(func, ast.Attribute) else "")
+                    index = LOOP_DISPATCH_ARG.get(name)
+                    if (index is not None and index < len(node.args)
+                            and isinstance(node.args[index], ast.Lambda)):
+                        # call_soon_threadsafe(lambda: ...): the lambda
+                        # body runs ON the loop — the pass's own
+                        # recommended fix must not trigger the finding
+                        # (named callbacks get this via loop_dispatched).
+                        scan([node.args[index].body], True, owner_fid)
+                if isinstance(node, (ast.Assign, ast.AugAssign,
+                                     ast.AnnAssign)):
+                    targets = (node.targets if isinstance(node, ast.Assign)
+                               else [node.target])
+                    for target in targets:
+                        attr = _self_attr_target(target)
+                        if attr:
+                            record(attr, target, on_loop)
+                elif isinstance(node, ast.Delete):
+                    for target in node.targets:
+                        attr = _self_attr_target(target)
+                        if attr:
+                            record(attr, target, on_loop)
+                elif isinstance(node, ast.Call):
+                    func = node.func
+                    if (isinstance(func, ast.Attribute)
+                            and func.attr in MUTATOR_METHODS):
+                        attr = _self_attr_target(func.value)
+                        if attr:
+                            record(attr, node, on_loop)
+                stack.extend(ast.iter_child_nodes(node))
+
+        for method in info.methods.values():
+            if method.name in ("__init__", "__new__"):
+                continue  # construction happens-before publication
+            on_loop = (
+                method.is_async
+                or loop_affine_doc(method.node)
+                or method.fid in graph.loop_dispatched
+            )
+            scan(method.node.body, on_loop, method.fid)
+
+        for attr in sorted(set(loop_sites) & set(thread_sites)):
+            for site in thread_sites[attr]:
+                self.add(
+                    info.module, site, "ASY604",
+                    f"attribute 'self.{attr}' of class '{info.name}' is "
+                    f"loop-bound (mutated on the event loop elsewhere) "
+                    f"but mutated from a non-loop thread here — route "
+                    f"the write through call_soon_threadsafe/"
+                    f"run_coroutine_threadsafe, or document the method "
+                    f"loop-affine if it only ever runs on the loop",
+                )

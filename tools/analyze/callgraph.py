@@ -1,5 +1,5 @@
 """Project-wide call graph for the interprocedural passes (LCK110/111,
-DRY501).
+DRY501, ASY6xx).
 
 The graph is deliberately *name-and-annotation driven* — no execution, no
 imports of the analyzed code. Resolution sources, in order of trust:
@@ -22,6 +22,24 @@ imports of the analyzed code. Resolution sources, in order of trust:
   name ends in ``_locked`` and is defined exactly once project-wide
   resolves to that definition.
 
+The graph also carries the **async dimension** the ASY6xx passes
+consume (docs/static-analysis.md "Async discipline"):
+
+* every ``async def`` is recorded as a coroutine; resolved call edges
+  made directly under an ``await`` are counted as *await edges*;
+* asyncio dispatch is modeled: a function reference handed to
+  ``loop.call_soon_threadsafe``/``call_soon``/``call_later``/``call_at``
+  is resolved (the callback runs ON the loop even when scheduled from a
+  thread), and a coroutine built inline inside
+  ``asyncio.create_task``/``ensure_future``/``run_coroutine_threadsafe``
+  is already an ordinary call edge of the scheduling function;
+* **loop affinity** is inferred from three sources: being a coroutine,
+  being dispatched to a loop via ``call_soon*``, or the docstring
+  convention (``"runs on the wire loop"`` / ``"loop-thread only"`` —
+  the async twin of the caller-holds-lock convention): the declaration
+  stays greppable AND checkable, because a loop-affine function is then
+  held to the same never-block discipline as a coroutine.
+
 Everything else is *unresolved* and dropped (an under-approximation the
 passes document): ``getattr`` dispatch, callables passed as values
 (thread targets, handlers, reactors), and properties. External receivers
@@ -32,6 +50,7 @@ blocking heuristics can classify I/O on them.
 from __future__ import annotations
 
 import ast
+import re
 from dataclasses import dataclass, field
 from typing import Iterator, Optional, Union
 
@@ -42,6 +61,43 @@ FuncNode = Union[ast.FunctionDef, ast.AsyncFunctionDef]
 
 #: threading factories that create a lock-like object.
 LOCK_FACTORY_NAMES = {"Lock", "RLock", "Condition"}
+
+#: Loop-scheduling methods whose CALLBACK argument runs on the event
+#: loop: name -> index of the callable argument.
+LOOP_DISPATCH_ARG = {
+    "call_soon_threadsafe": 0,
+    "call_soon": 0,
+    "call_later": 1,
+    "call_at": 1,
+}
+
+#: Coroutine-dispatch entry points (the coroutine argument is usually an
+#: inline ``f(...)`` call, which is already a plain call edge of the
+#: scheduling function; a bare function reference is resolved here).
+CORO_DISPATCH_NAMES = {
+    "create_task", "ensure_future", "run_coroutine_threadsafe",
+}
+
+#: Docstring phrases declaring the loop-affinity convention — the async
+#: twin of lock_discipline's caller-holds-lock docstring convention. A
+#: sync helper that mutates loop-bound state (ASY604) or is reachable
+#: from a coroutine is DOCUMENTED as loop-hosted with one of these, and
+#: the ASY6xx passes then hold it to coroutine discipline.
+LOOP_AFFINE_RE = re.compile(
+    r"runs? on the [\w-]*\s*(wire |event |server )?loop"
+    r"|loop[- ]thread only"
+    r"|on the loop thread"
+    r"|loop[- ]affine",
+    re.IGNORECASE,
+)
+
+
+def loop_affine_doc(func: FuncNode) -> bool:
+    """True when the function's docstring declares loop affinity."""
+    doc = ast.get_docstring(func)
+    if not doc:
+        return False
+    return LOOP_AFFINE_RE.search(re.sub(r"\s+", " ", doc)) is not None
 
 
 @dataclass
@@ -93,6 +149,12 @@ class FunctionInfo:
     def display_name(self) -> str:
         return self.qualname
 
+    @property
+    def is_async(self) -> bool:
+        """True for ``async def`` — the function body runs on an event
+        loop and must never block (the ASY6xx contract)."""
+        return isinstance(self.node, ast.AsyncFunctionDef)
+
 
 class CallGraph:
     """Build once per :class:`Project`; shared by every interprocedural
@@ -117,7 +179,19 @@ class CallGraph:
         self._locked_defs: dict[str, list[str]] = {}
         self.unresolved_calls = 0
         self.resolved_edges = 0
+        #: Resolved call edges made directly under an ``await``.
+        self.await_edges = 0
+        #: fids dispatched to an event loop via call_soon*/call_later —
+        #: they run ON the loop no matter which thread scheduled them.
+        self.loop_dispatched: set[str] = set()
         self._build()
+        #: Coroutines + loop-dispatched callbacks + docstring-declared
+        #: loop-affine helpers: the set the ASY6xx passes hold to the
+        #: never-block-the-loop discipline.
+        self.loop_affine_fids: set[str] = {
+            fid for fid, fi in self.functions.items()
+            if fi.is_async or loop_affine_doc(fi.node)
+        } | self.loop_dispatched
 
     # -- construction ------------------------------------------------------
     def _build(self) -> None:
@@ -502,17 +576,73 @@ class CallGraph:
         self, fi: FunctionInfo
     ) -> list[tuple[ast.Call, tuple[str, ...]]]:
         env = self.local_env(fi)
+        awaited = {
+            id(node.value)
+            for node in ast.walk(fi.node)
+            if isinstance(node, ast.Await)
+        }
         out: list[tuple[ast.Call, tuple[str, ...]]] = []
         for node in ast.walk(fi.node):
             if not isinstance(node, ast.Call):
                 continue
+            self._collect_loop_dispatch(fi, node, env)
             fids = self.resolve_call(fi, node, env)
+            if not fids:
+                # A bare coroutine-function reference handed to
+                # create_task/ensure_future/run_coroutine_threadsafe is
+                # an execution edge of the scheduling function (an
+                # inline ``f(...)`` argument is already a plain edge).
+                name = (node.func.attr
+                        if isinstance(node.func, ast.Attribute)
+                        else node.func.id
+                        if isinstance(node.func, ast.Name) else "")
+                if name in CORO_DISPATCH_NAMES and node.args:
+                    fids = self.resolve_func_ref(fi, node.args[0], env)
             if fids:
                 self.resolved_edges += len(fids)
+                if id(node) in awaited:
+                    self.await_edges += len(fids)
                 out.append((node, tuple(fids)))
             else:
                 self.unresolved_calls += 1
         return out
+
+    def _collect_loop_dispatch(
+        self, fi: FunctionInfo, call: ast.Call, env: dict[str, str]
+    ) -> None:
+        """Record functions handed to ``loop.call_soon_threadsafe`` & co
+        — their bodies run on the loop, so loop affinity (and the
+        never-block discipline) follows the reference, not the call
+        site's thread."""
+        func = call.func
+        name = func.attr if isinstance(func, ast.Attribute) else ""
+        index = LOOP_DISPATCH_ARG.get(name)
+        if index is None or index >= len(call.args):
+            return
+        for fid in self.resolve_func_ref(fi, call.args[index], env):
+            self.loop_dispatched.add(fid)
+
+    def resolve_func_ref(
+        self, fi: FunctionInfo, expr: ast.expr, env: dict[str, str]
+    ) -> list[str]:
+        """Resolve a bare function REFERENCE (not a call): a local name
+        bound to a nested def / aliased method, a module-level function,
+        or ``self.method``."""
+        if isinstance(expr, ast.Name):
+            bound = env.get(expr.id, "")
+            if bound.startswith("bound:"):
+                return [f for f in bound[6:].split(",")
+                        if f in self.functions]
+            entry = self.symbols[fi.module.display].get(expr.id)
+            if entry is not None and entry[0] == "func":
+                return [entry[1]]
+            return []
+        if isinstance(expr, ast.Attribute):
+            tkey = self._expr_type(fi.module, expr, env, fi.cls)
+            if tkey is not None and tkey.startswith("bound:"):
+                return [f for f in tkey[6:].split(",")
+                        if f in self.functions]
+        return []
 
     def resolve_call(self, fi: FunctionInfo, call: ast.Call,
                      env: dict[str, str]) -> list[str]:
@@ -592,6 +722,11 @@ class CallGraph:
             "call_edges": self.resolved_edges,
             "unresolved_calls": self.unresolved_calls,
             "lock_sites": lock_sites,
+            "coroutines": sum(
+                1 for fi in self.functions.values() if fi.is_async
+            ),
+            "await_edges": self.await_edges,
+            "loop_affine": len(self.loop_affine_fids),
         }
 
 

@@ -48,7 +48,7 @@ def main(argv=None) -> int:
         prog="analyze",
         description="domain-aware static analysis (lock discipline, "
         "state-machine exhaustiveness, literal keys, swallowed "
-        "exceptions)",
+        "exceptions, event-loop/asyncio discipline)",
     )
     parser.add_argument("paths", nargs="+", help="files or directories")
     parser.add_argument(

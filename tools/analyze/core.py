@@ -246,6 +246,7 @@ def all_passes() -> list[Type[AnalysisPass]]:
     from . import literal_key  # noqa: F401
     from . import swallowed_exception  # noqa: F401
     from . import interproc  # noqa: F401
+    from . import asyncio_discipline  # noqa: F401
 
     return list(_REGISTRY)
 

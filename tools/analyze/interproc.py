@@ -47,6 +47,7 @@ from .lock_discipline import (
     _caller_holds_lock,
     _dotted,
     calls_outside_lambdas as _calls_outside_lambdas,
+    nodes_outside_lambdas as _nodes_outside_lambdas,
     dotted_blocking_reason,
 )
 
@@ -107,11 +108,24 @@ class Acquisition:
 
 
 @dataclass
+class AwaitFact:
+    """One ``await`` (or implicit ``async with``/``async for`` await)
+    reached while threading locks are held — ASY603's raw material: the
+    suspension point turns a bounded critical section into an unbounded
+    one (the lock stays held while the loop runs arbitrary other
+    callbacks)."""
+
+    node: ast.AST
+    held: tuple[LockRef, ...]
+
+
+@dataclass
 class Summary:
     fi: FunctionInfo
     acquisitions: list[Acquisition] = field(default_factory=list)
     calls: list[CallFact] = field(default_factory=list)
     blocking: list[BlockFact] = field(default_factory=list)
+    awaits: list[AwaitFact] = field(default_factory=list)
 
 
 # ---------------------------------------------------------------------------
@@ -207,6 +221,10 @@ class _SummaryBuilder:
             reason = dotted_blocking_reason(name)
             if reason:
                 return reason, None
+            if name.startswith("asyncio."):
+                # Awaitable factories (asyncio.sleep/wait_for) never
+                # block a thread; lock-across-await is ASY603's.
+                return "", None
             last = name.rsplit(".", 1)[-1]
             if last in BLOCKING_METHODS or last == "wait_for":
                 if last == "join" and call.args:
@@ -263,6 +281,11 @@ class _SummaryBuilder:
 
     def _visit_stmt(self, fi, stmt, held, env, lock_env, summary) -> None:
         if isinstance(stmt, ast.With) or isinstance(stmt, ast.AsyncWith):
+            if isinstance(stmt, ast.AsyncWith) and held:
+                # __aenter__/__aexit__ are implicit awaits; entering an
+                # async context while a threading lock is held suspends
+                # under it.
+                summary.awaits.append(AwaitFact(stmt, held))
             entered = held
             for item in stmt.items:
                 self._visit_expr(fi, item.context_expr, held, env, lock_env,
@@ -281,6 +304,9 @@ class _SummaryBuilder:
             # its body is summarized separately (the call graph indexes
             # it), never under this function's locks.
             return
+        if isinstance(stmt, ast.AsyncFor) and held:
+            # Each iteration awaits __anext__ with the locks still held.
+            summary.awaits.append(AwaitFact(stmt, held))
         for child in ast.iter_child_nodes(stmt):
             if isinstance(child, ast.stmt):
                 self._visit_stmt(fi, child, held, env, lock_env, summary)
@@ -290,14 +316,19 @@ class _SummaryBuilder:
                 self._walk(fi, child.body, held, env, lock_env, summary)
 
     def _visit_expr(self, fi, expr, held, env, lock_env, summary) -> None:
-        for node in _calls_outside_lambdas(expr):
-            callees = tuple(self.graph.resolve_call(fi, node, env))
-            if callees:
-                summary.calls.append(CallFact(node, callees, held))
-            reason, exempt = self._blocking_reason(fi, node, env)
-            if reason:
-                summary.blocking.append(
-                    BlockFact(node, reason, exempt, held))
+        # One walk collects calls AND awaits; lambda bodies are pruned
+        # (deferred code never inherits the lock context).
+        for node in _nodes_outside_lambdas(expr):
+            if isinstance(node, ast.Await) and held:
+                summary.awaits.append(AwaitFact(node, held))
+            if isinstance(node, ast.Call):
+                callees = tuple(self.graph.resolve_call(fi, node, env))
+                if callees:
+                    summary.calls.append(CallFact(node, callees, held))
+                reason, exempt = self._blocking_reason(fi, node, env)
+                if reason:
+                    summary.blocking.append(
+                        BlockFact(node, reason, exempt, held))
 
 
 def _bare(class_key: str) -> str:
@@ -307,18 +338,9 @@ def _bare(class_key: str) -> str:
 def _own_body_calls(func_node):
     """Call nodes in a function's own body, pruning nested ``def``s and
     lambda bodies (deferred code; indexed and summarized separately)."""
-    stack = list(func_node.body)
-    while stack:
-        node = stack.pop()
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        if isinstance(node, ast.Lambda):
-            stack.extend(node.args.defaults)
-            stack.extend(d for d in node.args.kw_defaults if d is not None)
-            continue
+    for node in _nodes_outside_lambdas(func_node.body, prune_defs=True):
         if isinstance(node, ast.Call):
             yield node
-        stack.extend(ast.iter_child_nodes(node))
 
 
 # ---------------------------------------------------------------------------

@@ -98,22 +98,33 @@ def dotted_blocking_reason(name: str) -> str:
     return ""
 
 
-def calls_outside_lambdas(expr: ast.AST):
-    """Call nodes in ``expr``, pruning lambda BODIES: a lambda runs at
-    an unknown time on an unknown thread — exactly like a nested
-    ``def``, its body must not inherit the enclosing lock context.
-    Default-argument expressions DO evaluate eagerly at definition time,
-    so they stay in scope."""
-    stack = [expr]
+def nodes_outside_lambdas(root, *, prune_defs: bool = False):
+    """Every node under ``root`` (a node or a list of nodes) with lambda
+    BODIES pruned — and nested ``def`` bodies too when ``prune_defs``:
+    deferred code runs at an unknown time on an unknown thread, so it
+    must not inherit the enclosing lock/loop context. Default-argument
+    expressions DO evaluate eagerly at definition time, so they stay in
+    scope. The single authority for the pruning rule — every
+    lock/async walk that needs it filters this iterator."""
+    stack = list(root) if isinstance(root, list) else [root]
     while stack:
         node = stack.pop()
+        if prune_defs and isinstance(node, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef)):
+            continue
         if isinstance(node, ast.Lambda):
             stack.extend(node.args.defaults)
             stack.extend(d for d in node.args.kw_defaults if d is not None)
             continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def calls_outside_lambdas(expr: ast.AST):
+    """Call nodes in ``expr``, lambda bodies pruned."""
+    for node in nodes_outside_lambdas(expr):
         if isinstance(node, ast.Call):
             yield node
-        stack.extend(ast.iter_child_nodes(node))
 
 
 def _dotted(node: ast.expr) -> str:
@@ -292,6 +303,12 @@ class _ClassAnalyzer:
         reason = dotted_blocking_reason(name)
         if reason:
             return reason
+        if name.startswith("asyncio."):
+            # asyncio.sleep/wait_for return awaitables — they never
+            # block the calling thread. Suspending under a threading
+            # lock is a real hazard, but it is ASY603's (lock held
+            # across an await), not a thread-blocking one.
+            return ""
         last = name.rsplit(".", 1)[-1]
         if last in BLOCKING_METHODS:
             if self._is_own_condition_wait(call):

@@ -34,6 +34,10 @@ RULE_TEXT = {
     "KEY301": "upgrade label/annotation key literal outside the builders",
     "EXC401": "swallowed exception in a reconcile/manager path",
     "DRY501": "cluster mutation reachable on a dry_run path",
+    "ASY601": "blocking call transitively reachable on the event loop",
+    "ASY602": "coroutine never awaited / task handle not retained",
+    "ASY603": "threading lock held across an await",
+    "ASY604": "loop-bound state mutated from a non-loop thread",
 }
 
 
