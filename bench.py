@@ -2089,6 +2089,7 @@ def run_trace_attribution(
     shards: int = 4,
     trace_path: str = "",
     min_coverage: float = 0.9,
+    batch_writes: bool = False,
 ) -> dict:
     """ISSUE 14 headline — end-to-end rollout tracing on a
     fleet_64_pools-shaped roll (docs/tracing.md): 64 pools over a real
@@ -2170,6 +2171,7 @@ def run_trace_attribution(
                     lease_duration_s=5.0,
                     renew_deadline_s=3.0,
                     retry_period_s=0.5,
+                    batch_writes=batch_writes,
                 ),
             )
             worker.start(sync_timeout=60)
@@ -2316,6 +2318,7 @@ def run_trace_attribution(
         "pools": pools,
         "nodes": pools * hosts_per_pool,
         "workers": n_workers,
+        "batch_writes": batch_writes,
         "roll_wall_s": round(roll_end - roll_start, 3),
         "spans_exported": exported,
         "trace_path": path,
@@ -2835,6 +2838,472 @@ def run_chaos_smoke(
     }
 
 
+def run_write_batching(
+    slices: int = 16,
+    hosts_per_slice: int = 4,
+    apply_width: int = 16,
+    max_round_trip_ratio: float = 0.5,
+) -> dict:
+    """ISSUE 16 headline — the batched/coalesced write path
+    (docs/reconcile-data-path.md, "The write path"): the same 64-node
+    roll over a real LocalApiServer wire twice, serial (every provider
+    PATCH its own round trip, the pre-batching behavior) vs batched
+    (same-node label+annotation mutations coalesced into one merge
+    PATCH, a bucket fan-out's independent-node PATCHes pipelined
+    through ``RestClient.patch_many``). Write round trips are counted
+    AT THE SERVER via the wire log: a PATCH that arrived while earlier
+    bytes of the same connection burst were still buffered rode an
+    in-flight round trip and is not charged a new one.
+
+    Hard-asserted:
+
+    * **round-trip ratio** — batched round trips <= ``max_round_trip_
+      ratio`` x serial (the >=2x acceptance line; the CI floor pins the
+      measured ratio at tools/bench_smoke_baseline.json);
+    * **terminal-sequence identity** — every node walks the IDENTICAL
+      (from, to) state sequence in both rolls (batching is a transport
+      optimization, never a semantic one; tests/test_write_batching.py
+      pins the same at apply widths 1 and 8);
+    * **full adoption** — with the batcher installed every issued write
+      went through it (no silent fallback to the serial path).
+    """
+    from k8s_operator_libs_tpu.kube import LocalApiServer, RestClient, RestConfig
+    from k8s_operator_libs_tpu.upgrade import StateOptions
+    from k8s_operator_libs_tpu.utils import tracing
+
+    def one_roll(batched: bool) -> dict:
+        tracer = tracing.Tracer()
+        with LocalApiServer() as srv:
+            _, sim = build_pool(
+                cluster=srv.cluster, slices=slices,
+                hosts_per_slice=hosts_per_slice,
+            )
+            client = RestClient(RestConfig(server=srv.url))
+            mgr = ClusterUpgradeStateManager(
+                client, DEVICE, runner=TaskRunner(),
+                options=StateOptions(
+                    apply_width=apply_width, batch_writes=batched
+                ),
+            )
+            policy = DriverUpgradePolicySpec(
+                auto_upgrade=True,
+                max_parallel_upgrades=0,
+                max_unavailable=IntOrString("100%"),
+            )
+            sim.set_template_hash("libtpu-v2")
+            srv.start_wire_log()
+            tracing.install_tracer(tracer)
+            try:
+                start = time.perf_counter()
+                passes = drive_to_convergence(srv.cluster, sim, mgr, policy)
+                wall = time.perf_counter() - start
+            finally:
+                tracing.clear_tracer()
+            wire = srv.stop_wire_log()
+            stats = mgr.provider.write_stats()
+            batch_stats = mgr.enable_write_batching().stats() if batched \
+                else {}
+            client.close()
+        patches = [piped for method, _path, piped in wire
+                   if method == "PATCH"]
+        round_trips = sum(1 for piped in patches if not piped)
+        # Terminal sequences from the provider's state.transition events
+        # (the flight-recorder source of truth), ordered per node.
+        sequences: dict = {}
+        for record in tracer.records():
+            for event in record["events"]:
+                if event["name"] != "state.transition":
+                    continue
+                attrs = event["attrs"]
+                sequences.setdefault(attrs["node"], []).append(
+                    (event["ts"], attrs["frm"], attrs["to"])
+                )
+        for node, legs in sequences.items():
+            legs.sort()
+            sequences[node] = [(frm, to) for _ts, frm, to in legs]
+        out = {
+            "wall_s": round(wall, 3),
+            "passes": passes,
+            "patches_total": len(patches),
+            "writes_per_roll": round_trips,
+            "writes_issued": stats["issued"],
+            "writes_skipped": stats["skipped"],
+            "writes_coalesced": stats["coalesced"],
+            "writes_batched": stats["batched"],
+            "_sequences": sequences,
+        }
+        if batched:
+            out["batches_flushed"] = batch_stats["batches_flushed"]
+            out["writes_flushed"] = batch_stats["writes_flushed"]
+            out["max_batch"] = batch_stats["max_batch"]
+        return out
+
+    serial = one_roll(batched=False)
+    batched = one_roll(batched=True)
+    seq_serial = serial.pop("_sequences")
+    seq_batched = batched.pop("_sequences")
+    if seq_serial != seq_batched:
+        diverged = sorted(
+            node for node in set(seq_serial) | set(seq_batched)
+            if seq_serial.get(node) != seq_batched.get(node)
+        )
+        raise RuntimeError(
+            "write_batching: batched and serial rolls walked different "
+            f"state sequences on {len(diverged)} node(s) "
+            f"(first: {diverged[0]}: {seq_serial.get(diverged[0])} vs "
+            f"{seq_batched.get(diverged[0])}) — batching changed "
+            "semantics, not just transport"
+        )
+    if batched["writes_batched"] != batched["writes_issued"]:
+        raise RuntimeError(
+            "write_batching: only "
+            f"{batched['writes_batched']}/{batched['writes_issued']} "
+            "issued writes went through the installed batcher — the "
+            "serial fallback leaked into the batched roll"
+        )
+    ratio = round(
+        batched["writes_per_roll"] / max(1, serial["writes_per_roll"]), 3
+    )
+    if ratio > max_round_trip_ratio:
+        raise RuntimeError(
+            f"write_batching: batched roll paid {ratio}x the serial "
+            f"write round trips (<= {max_round_trip_ratio} required: "
+            f"{batched['writes_per_roll']} vs "
+            f"{serial['writes_per_roll']} non-pipelined PATCHes at the "
+            "server) — coalescing/pipelining stopped paying"
+        )
+    return {
+        "nodes": slices * hosts_per_slice,
+        "apply_width": apply_width,
+        "transport": "http (LocalApiServer, asyncio wire path)",
+        "serial": serial,
+        "batched": batched,
+        "round_trip_ratio_batched_vs_serial": ratio,
+        "terminal_sequences_identical": 1.0,  # hard-asserted above
+        "sequenced_nodes": len(seq_serial),
+    }
+
+
+def run_grant_latency(
+    pools: int = 8,
+    hosts_per_pool: int = 2,
+    trials: int = 3,
+    legacy_poll_interval_s: float = 0.05,
+) -> dict:
+    """ISSUE 16 — event-driven wakeups vs the fixed cadence they
+    replace (fleet/wakeup.py): grant -> first-cordon latency on a real
+    wire. The polled twin ticks the shard worker every
+    ``legacy_poll_interval_s`` (the old control-loop cadence); the
+    event twin parks the worker on a :class:`WatchWake` over
+    FleetRollout and ticks one watch delivery after the orchestrator's
+    grant write lands — and the orchestrator itself ticks off a
+    FleetRollout/NodeHealthReport wake instead of a sleep loop.
+
+    Hard-asserted: the event-driven median beats one legacy poll
+    interval (the acceptance line; the CI floor pins the measured
+    median at tools/bench_smoke_baseline.json), the event loop was
+    actually WOKEN by deliveries (not the fallback timeout), and at
+    least one wake carried the granting write's trace id (the PR-14
+    wake->action edge, measured, not assumed).
+    """
+    import threading
+
+    from k8s_operator_libs_tpu.api import (
+        DriverUpgradePolicySpec as _Policy,
+        make_fleet_rollout,
+    )
+    from k8s_operator_libs_tpu.fleet import (
+        FleetOrchestrator,
+        FleetWorkerConfig,
+        ShardWorker,
+        WatchWake,
+        shard_id,
+    )
+    from k8s_operator_libs_tpu.kube import LocalApiServer, RestClient, RestConfig
+    from k8s_operator_libs_tpu.kube.objects import KubeObject
+    from k8s_operator_libs_tpu.upgrade.consts import UpgradeState
+    from k8s_operator_libs_tpu.utils import tracing
+
+    pool_names = [f"s{i}" for i in range(pools)]
+    shards = 2
+
+    def pool_of(node_name: str) -> str:
+        return node_name.split("-")[0]
+
+    def one_trial(event_driven: bool) -> dict:
+        tracer = tracing.Tracer()
+        with LocalApiServer() as srv:
+            _, sim = build_pool(
+                cluster=srv.cluster, slices=pools,
+                hosts_per_slice=hosts_per_pool,
+            )
+            srv.cluster.create(KubeObject(
+                make_fleet_rollout("fleet-roll", pool_names, "25%")
+            ))
+            client = RestClient(RestConfig(server=srv.url))
+            worker = ShardWorker(
+                client,
+                FleetWorkerConfig(
+                    identity="worker-0",
+                    shards=shards,
+                    namespace=NS,
+                    driver_labels=DS_LABELS,
+                    pool_of=pool_of,
+                    rollout_name="fleet-roll",
+                    preferred_shards=[shard_id(j) for j in range(shards)],
+                    lease_duration_s=5.0,
+                    renew_deadline_s=3.0,
+                    retry_period_s=0.5,
+                ),
+            )
+            orch_client = RestClient(RestConfig(server=srv.url))
+            orchestrator = FleetOrchestrator(orch_client, "fleet-roll")
+            policy = _Policy(
+                auto_upgrade=True,
+                max_parallel_upgrades=0,
+                max_unavailable=IntOrString("100%"),
+            )
+            stop = threading.Event()
+            wake = orch_wake = None
+            wake_trace_count = 0
+            worker_thread = None
+            tracing.install_tracer(tracer)
+            try:
+                worker.start(sync_timeout=60)
+                deadline = time.time() + 60
+                while worker.owned_shards() != set(
+                    shard_id(j) for j in range(shards)
+                ):
+                    worker.tick(policy)
+                    if time.time() > deadline:
+                        raise RuntimeError(
+                            "grant_latency: shard claims never settled"
+                        )
+                    time.sleep(0.01)
+                # Classify up to the grant gate BEFORE the measurement:
+                # nodes sit in upgrade-required awaiting the grant, so
+                # the measured edge is purely grant -> cordon.
+                sim.set_template_hash("libtpu-v2")
+                for _ in range(3):
+                    sim.step()
+                    worker.tick(policy)
+
+                def node_state(name):
+                    raw = srv.cluster.peek("Node", name) or {}
+                    return ((raw.get("metadata") or {}).get(
+                        "labels") or {}).get(KEYS.state_label)
+
+                if any(
+                    node_state(n) == UpgradeState.CORDON_REQUIRED.value
+                    for n in srv.cluster.object_names("Node")
+                ):
+                    raise RuntimeError(
+                        "grant_latency: a node reached cordon-required "
+                        "before any grant was issued"
+                    )
+
+                if event_driven:
+                    wake = WatchWake(client, ["FleetRollout"])
+                    orch_wake = WatchWake(
+                        orch_client, ["FleetRollout", "NodeHealthReport"]
+                    )
+
+                def run_worker() -> None:
+                    nonlocal wake_trace_count
+                    while not stop.is_set():
+                        if event_driven:
+                            if not wake.wait(0.5):
+                                continue
+                            traces = wake.consume_traces()
+                            wake_trace_count += len(traces)
+                            worker.tick(policy, wake_traces=traces)
+                        else:
+                            if stop.wait(legacy_poll_interval_s):
+                                return
+                            worker.tick(policy)
+
+                worker_thread = threading.Thread(
+                    target=run_worker, daemon=True, name="grant-latency"
+                )
+                worker_thread.start()
+                # Issue the grant. The orchestrator side is event-driven
+                # too in the event twin: between attempts it parks on
+                # its own wake instead of sleeping a cadence.
+                deadline = time.time() + 30
+                while True:
+                    sim.step()
+                    t_grant = time.perf_counter()
+                    orchestrator.tick(
+                        wake_traces=orch_wake.consume_traces()
+                        if orch_wake is not None else None
+                    )
+                    if orchestrator.grants_issued > 0:
+                        break
+                    if time.time() > deadline:
+                        raise RuntimeError(
+                            "grant_latency: orchestrator never granted"
+                        )
+                    if orch_wake is not None:
+                        orch_wake.wait(0.05)
+                    else:
+                        time.sleep(legacy_poll_interval_s)
+                deadline = time.time() + 30
+                while not any(
+                    node_state(n) == UpgradeState.CORDON_REQUIRED.value
+                    for n in srv.cluster.object_names("Node")
+                ):
+                    if time.time() > deadline:
+                        raise RuntimeError(
+                            "grant_latency: no node reached "
+                            "cordon-required after the grant"
+                        )
+                    time.sleep(0.0005)
+                latency = time.perf_counter() - t_grant
+                return {
+                    "latency_s": latency,
+                    "wakes": wake.wakes if wake is not None else 0,
+                    "deliveries": (
+                        wake.deliveries if wake is not None else 0
+                    ),
+                    "wake_trace_links": wake_trace_count,
+                }
+            finally:
+                stop.set()
+                tracing.clear_tracer()
+                if wake is not None:
+                    wake.stop()
+                if orch_wake is not None:
+                    orch_wake.stop()
+                if worker_thread is not None:
+                    worker_thread.join(timeout=10)
+                worker.stop()
+                client.close()
+                orch_client.close()
+
+    def run_mode(event_driven: bool) -> dict:
+        runs = [one_trial(event_driven) for _ in range(trials)]
+        return {
+            "median_grant_to_first_cordon_s": round(
+                statistics.median(r["latency_s"] for r in runs), 4
+            ),
+            "max_grant_to_first_cordon_s": round(
+                max(r["latency_s"] for r in runs), 4
+            ),
+            "trials": [round(r["latency_s"], 4) for r in runs],
+            "watch_deliveries": sum(r["deliveries"] for r in runs),
+            "watch_wakes": sum(r["wakes"] for r in runs),
+            "wake_trace_links": sum(r["wake_trace_links"] for r in runs),
+        }
+
+    polled = run_mode(event_driven=False)
+    event = run_mode(event_driven=True)
+    grant_to_first_cordon_s = event["median_grant_to_first_cordon_s"]
+    if grant_to_first_cordon_s >= legacy_poll_interval_s:
+        raise RuntimeError(
+            "grant_latency: event-driven grant->cordon took "
+            f"{grant_to_first_cordon_s}s — not below one legacy poll "
+            f"interval ({legacy_poll_interval_s}s); the wakeup path "
+            "degenerated to polling"
+        )
+    if not event["watch_wakes"]:
+        raise RuntimeError(
+            "grant_latency: the event twin was never woken by a watch "
+            "delivery — every tick came from the fallback timeout"
+        )
+    if not event["wake_trace_links"]:
+        raise RuntimeError(
+            "grant_latency: no wake carried the granting write's trace "
+            "id — the wake->action edge (fleet/wakeup.py -> PR-14 "
+            "write-origin book) is broken"
+        )
+    return {
+        "pools": pools,
+        "nodes": pools * hosts_per_pool,
+        "legacy_poll_interval_s": legacy_poll_interval_s,
+        "polled": polled,
+        "event_driven": event,
+        "grant_to_first_cordon_s": grant_to_first_cordon_s,
+        "speedup_vs_polled_x": round(
+            polled["median_grant_to_first_cordon_s"]
+            / max(grant_to_first_cordon_s, 1e-6), 2
+        ),
+    }
+
+
+def run_trace_attribution_report(
+    pools: int = 24,
+    hosts_per_pool: int = 2,
+    n_workers: int = 2,
+    artifact: str = "BENCH_ATTRIB_PR16.json",
+    min_coverage: float = 0.9,
+) -> dict:
+    """ISSUE 16 — the attribution flywheel: a traced fleet roll WITH
+    write batching on, its wall time attributed and RANKED by category
+    (grant / lease / queue / wire / drain / checkpoint / write / ...),
+    committed as the ``BENCH_ATTRIB_PR16.json`` artifact so the next
+    optimization round starts from measured cost, not intuition.
+
+    The CI floor (tools/bench_smoke_baseline.json) pins the top-ranked
+    category's RANK (``category_rank.<top>`` stays 1) and the coverage
+    floor rides :func:`run_trace_attribution`'s >=90% hard assert. The
+    ``write`` category must be present — batching is on, so its flush
+    spans are part of the story being ranked.
+    """
+    base = run_trace_attribution(
+        pools=pools,
+        hosts_per_pool=hosts_per_pool,
+        n_workers=n_workers,
+        trace_path=os.environ.get(
+            "BENCH_ATTRIB_TRACE_PATH", "trace-attrib-report.jsonl"
+        ),
+        min_coverage=min_coverage,
+        batch_writes=True,
+    )
+    categories = {
+        cat: secs for cat, secs in base["category_seconds"].items()
+        if secs and cat != "idle"  # idle is absence-of-span, not a cost
+    }
+    if "write" not in categories:
+        raise RuntimeError(
+            "trace_attribution_report: no 'write' category seconds in a "
+            "batched roll — the write.flush spans vanished from the "
+            "attribution"
+        )
+    total = sum(categories.values()) or 1.0
+    ranked = sorted(categories.items(), key=lambda kv: (-kv[1], kv[0]))
+    report = {
+        "shape": {
+            "pools": pools,
+            "nodes": pools * hosts_per_pool,
+            "workers": n_workers,
+            "batch_writes": True,
+        },
+        "roll_wall_s": base["roll_wall_s"],
+        "coverage": base["critical_path_coverage"],
+        "idle_s": base["idle_s"],
+        "ranked": [
+            {
+                "category": cat,
+                "seconds": round(secs, 4),
+                "share": round(secs / total, 4),
+            }
+            for cat, secs in ranked
+        ],
+        "category_rank": {
+            cat: i + 1 for i, (cat, _secs) in enumerate(ranked)
+        },
+        "top_category": ranked[0][0],
+        "top_share": round(ranked[0][1] / total, 4),
+    }
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), artifact
+    )
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return {**report, "artifact": artifact}
+
+
 #: JAX-free sections runnable standalone via ``--sections a,b`` — the CI
 #: smoke job runs the state-machine microbench (+ snapshot reads) per-PR
 #: so control-plane perf is visible without a full bench artifact.
@@ -2857,6 +3326,9 @@ SECTIONS = {
     "bad_link_roll": run_bad_link_roll,
     "fleet_64_pools": run_fleet_64_pools,
     "trace_attribution": run_trace_attribution,
+    "write_batching": run_write_batching,
+    "grant_latency": run_grant_latency,
+    "trace_attribution_report": run_trace_attribution_report,
     "report_storm": run_report_storm,
     "chaos_smoke": run_chaos_smoke,
     "ring_bandwidth": run_ring_bandwidth,

@@ -15,6 +15,7 @@ from .hashring import HashRing, stable_hash
 from .metrics import FleetMetrics
 from .orchestrator import FleetHealthAggregator, FleetOrchestrator
 from .scope import ShardScopedSnapshotSource
+from .wakeup import WatchWake
 from .worker import (
     FleetWorkerConfig,
     GrantGatedInplaceManager,
@@ -33,6 +34,7 @@ __all__ = [
     "ShardScopedSnapshotSource",
     "ShardWorker",
     "TickStats",
+    "WatchWake",
     "shard_id",
     "stable_hash",
 ]

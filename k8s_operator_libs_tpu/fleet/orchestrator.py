@@ -29,7 +29,7 @@ orchestrator consumes the resulting degraded-first queue when granting.
 from __future__ import annotations
 
 import threading
-from typing import Any, Callable, Iterable, Optional
+from typing import Any, Callable, Iterable, Optional, Sequence
 
 from ..api.fleet_v1alpha1 import (
     FLEET_ROLLOUT_KIND,
@@ -193,8 +193,15 @@ class FleetOrchestrator:
         #: scrape (fleet/metrics.py).
         self.last_summary: dict[str, Any] = {}
 
-    def tick(self) -> dict[str, Any]:
-        """One grant round; returns a summary of the ledger after it."""
+    def tick(
+        self, wake_traces: Optional[Sequence[str]] = None
+    ) -> dict[str, Any]:
+        """One grant round; returns a summary of the ledger after it.
+
+        ``wake_traces`` carries the trace ids of the watch deliveries
+        that woke an event-driven caller (fleet/wakeup.py): the grant
+        span LINKS to them, extending the PR-14 causal chain one hop
+        upstream — completion report → delivery → this grant round."""
         self.ticks += 1
         try:
             # Grant attribution (docs/tracing.md): one span per round;
@@ -205,6 +212,11 @@ class FleetOrchestrator:
                 "fleet.grant_round", category="grant",
                 rollout=self.rollout_name,
             ) as grant_span:
+                if grant_span is not None and wake_traces:
+                    tracer = tracing.tracer()
+                    if tracer is not None:
+                        for trace_id in wake_traces:
+                            tracer.add_link(grant_span, trace_id)
                 summary = self._grant_round()
                 if grant_span is not None:
                     grant_span.attrs.update(

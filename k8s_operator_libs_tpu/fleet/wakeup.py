@@ -1,0 +1,155 @@
+"""Event-driven tick wakeups for the fleet control plane.
+
+The grant→first-cordon chain used to pay one fixed poll interval per
+hop: a worker reported a completion, the orchestrator noticed it on its
+NEXT cadence tick, granted, and each worker noticed the grant on ITS
+next cadence tick. :class:`WatchWake` replaces the cadence with watch
+delivery — one daemon thread per kind follows the stream (a
+``WatchHub`` subscription when the fleet shares one, a plain client
+watch otherwise) and releases waiters the moment a frame lands, so a
+tick starts one delivery after its cause instead of up to one poll
+interval later.
+
+Wake→action links ride the PR-14 wake-trace edges: each delivery's
+``resourceVersion`` is looked up in the tracer's write-origin book, and
+the originating trace ids are handed to the woken tick —
+``FleetOrchestrator.tick(wake_traces=...)`` links its grant span to
+them, and a worker feeds them to
+``IncrementalSnapshotSource.note_wake_trace`` so its pass span links
+back to the grant. The chain is measured, not assumed
+(docs/tracing.md; the ``grant_latency`` bench floors it).
+
+The loops here are wall-clock threads, so the deterministic chaos
+harness does not use them — it drives ticks synchronously. Wakeups are
+opt-in wiring for the bench, the example CLI, and real deployments.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence
+
+from ..kube.client import Client, WatchExpiredError
+from ..utils import tracing
+from ..utils.log import get_logger
+
+log = get_logger("fleet.wakeup")
+
+#: Bounded watch windows keep the threads responsive to stop();
+#: re-watching from the last seen revision sees no gap (journal resume).
+WATCH_WINDOW_SECONDS = 5
+
+
+class WatchWake:
+    """Wake an event-driven tick loop on watch delivery for any of
+    ``kinds``. One instance per tick loop; ``wait()`` from the loop
+    thread, everything else is internal.
+
+    The wake is level-triggered (an Event, not a queue): N deliveries
+    between two waits coalesce into ONE wake, which is exactly the
+    reconcile contract — a tick re-derives everything from current
+    state, so it needs to know *that* something changed, never *what*.
+    """
+
+    def __init__(
+        self,
+        client: Client,
+        kinds: Sequence[str],
+        namespace: str = "",
+        window_seconds: int = WATCH_WINDOW_SECONDS,
+    ) -> None:
+        self._client = client
+        self._namespace = namespace
+        self._window = window_seconds
+        self._event = threading.Event()
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._traces: list[str] = []
+        #: Deliveries observed / wakes granted (a wake can carry many
+        #: deliveries) — the grant_latency bench's sanity counters.
+        self.deliveries = 0
+        self.wakes = 0
+        self._threads = [
+            threading.Thread(
+                target=self._follow,
+                args=(kind,),
+                name=f"watch-wake-{kind.lower()}",
+                daemon=True,
+            )
+            for kind in kinds
+        ]
+        for t in self._threads:
+            t.start()
+
+    # -- consumer side ------------------------------------------------------
+    def wait(self, timeout: Optional[float]) -> bool:
+        """Block until a delivery lands (or ``timeout``, the fallback
+        cadence — wakeups REPLACE the fast poll, the slow resync stays
+        as the safety net). True = woken by a delivery."""
+        fired = self._event.wait(timeout)
+        if fired:
+            self._event.clear()
+            self.wakes += 1
+        return fired
+
+    def consume_traces(self) -> list[str]:
+        """Drain the trace ids of the writes whose deliveries woke us
+        since the last drain (empty whenever tracing is off)."""
+        with self._lock:
+            if not self._traces:
+                return []
+            out, self._traces = self._traces, []
+            return out
+
+    def stop(self) -> None:
+        self._stop.set()
+        # Don't join: the threads exit at their next window boundary
+        # (bounded by window_seconds) and are daemons regardless.
+
+    # -- follower thread ----------------------------------------------------
+    def _follow(self, kind: str) -> None:
+        resource_version: Optional[str] = None
+        while not self._stop.is_set():
+            try:
+                for _etype, obj in self._client.watch(
+                    kind,
+                    namespace=self._namespace,
+                    timeout_seconds=self._window,
+                    resource_version=resource_version,
+                    allow_bookmarks=True,
+                ):
+                    rv = obj.resource_version
+                    if rv:
+                        resource_version = rv
+                    if _etype == "BOOKMARK":
+                        continue  # resume-point only, nothing changed
+                    self.deliveries += 1
+                    self._note_origin(rv)
+                    self._event.set()
+                    if self._stop.is_set():
+                        return
+            except WatchExpiredError:
+                # Fell out of the journal window: restart from now. The
+                # skipped deltas still wake the loop (this IS a wake —
+                # state moved), and ticks re-derive from current state.
+                resource_version = None
+                self._event.set()
+            except Exception as e:  # noqa: BLE001 - keep the loop alive
+                if self._stop.is_set():
+                    return
+                log.warning("watch-wake %s: stream failed: %s", kind, e)
+                resource_version = None
+                # Back off one window so a hard-down server isn't spun on.
+                self._stop.wait(self._window)
+
+    def _note_origin(self, rv: str) -> None:
+        tracer = tracing.tracer()
+        if tracer is None or not rv:
+            return
+        origin = tracer.write_origin(rv)
+        if origin is None:
+            return
+        trace_id = origin[0]
+        with self._lock:
+            if len(self._traces) < 64 and trace_id not in self._traces:
+                self._traces.append(trace_id)

@@ -51,7 +51,7 @@ from ..kube.leader import LeaderElectionConfig, LeaderElector
 from ..upgrade.consts import NULL_STRING, DeviceClass, UpgradeState
 from ..upgrade.inplace import InplaceNodeStateManager
 from ..upgrade.snapshot import DEFAULT_RESYNC_PERIOD_S
-from ..upgrade.state_manager import ClusterUpgradeStateManager
+from ..upgrade.state_manager import ClusterUpgradeStateManager, StateOptions
 from ..upgrade.task_runner import TaskRunner
 from ..utils import tracing
 from ..utils.faultpoints import fault_point
@@ -123,6 +123,13 @@ class FleetWorkerConfig:
     #: writes, and lease traffic.
     watch_hub: Optional[Any] = None
     device: Optional[DeviceClass] = None
+    #: Route this worker's provider writes through the group-commit
+    #: batching tier (upgrade/write_batch.py) and fan buckets out with
+    #: ``apply_width`` threads so independent-node PATCHes ride one
+    #: pipelined round trip. Ignored when an explicit ``manager`` is
+    #: passed — its own StateOptions govern then.
+    batch_writes: bool = False
+    apply_width: int = 8
 
     def resolved_failover_probe_s(self) -> float:
         return (
@@ -244,18 +251,32 @@ class GrantGatedInplaceManager(InplaceNodeStateManager):
         with common._bucket_scope("upgrade-start", len(candidates)):
             for ns in candidates:
                 node = ns.node
-                if common.is_upgrade_requested(node):
-                    common.provider.change_node_upgrade_annotation(
-                        node, common.keys.upgrade_requested_annotation,
-                        NULL_STRING,
-                    )
+                # The ack of an explicit upgrade request rides the start
+                # transition's PATCH when the node starts this pass (the
+                # hot path); a node whose pool lacks a grant (or that
+                # skips) still gets the ack on its own write, as before.
+                ack = (
+                    {common.keys.upgrade_requested_annotation: NULL_STRING}
+                    if common.is_upgrade_requested(node)
+                    else {}
+                )
                 if self.pool_of(node.name) not in granted:
-                    continue  # waits for its grant (polling); no delta
+                    if ack:
+                        common.provider.change_node_upgrade_annotation(
+                            node, common.keys.upgrade_requested_annotation,
+                            NULL_STRING,
+                        )
+                    continue  # waits for its grant; no delta
                 if common.skip_node_upgrade(node):
+                    if ack:
+                        common.provider.change_node_upgrade_annotation(
+                            node, common.keys.upgrade_requested_annotation,
+                            NULL_STRING,
+                        )
                     log.info("node %s is marked to skip upgrades", node.name)
                     continue
-                common.provider.change_node_upgrade_state(
-                    node, UpgradeState.CORDON_REQUIRED
+                common.provider.change_node_state_and_annotations(
+                    node, UpgradeState.CORDON_REQUIRED, ack
                 )
                 started[self.pool_of(node.name)] = (
                     started.get(self.pool_of(node.name), 0) + 1
@@ -315,11 +336,24 @@ class ShardWorker:
             watch_hub=config.watch_hub,
         )
         if manager is None:
-            manager = ClusterUpgradeStateManager(
-                client,
-                config.device or DeviceClass.tpu(),
-                runner=TaskRunner(inline=True),
-            )
+            if config.batch_writes:
+                # Batching needs a real fan-out to coalesce across nodes
+                # (a serial caller stages batches of one), so the threaded
+                # runner replaces the inline default here.
+                manager = ClusterUpgradeStateManager(
+                    client,
+                    config.device or DeviceClass.tpu(),
+                    runner=TaskRunner(),
+                    options=StateOptions(
+                        apply_width=config.apply_width, batch_writes=True
+                    ),
+                )
+            else:
+                manager = ClusterUpgradeStateManager(
+                    client,
+                    config.device or DeviceClass.tpu(),
+                    runner=TaskRunner(inline=True),
+                )
         self.mgr = manager
         self.mgr.snapshot_source = self.source
         self.mgr.provider.set_write_through(self.source.record_write)
@@ -454,11 +488,21 @@ class ShardWorker:
         return frozenset(pools_in_phase(raw, POOL_GRANTED))
 
     # -- the tick ----------------------------------------------------------
-    def tick(self, policy) -> TickStats:
+    def tick(
+        self, policy, wake_traces: Optional[Sequence[str]] = None
+    ) -> TickStats:
         """Campaign, scope, reconcile, report — one idempotent round.
         Reconcile errors propagate (the caller's loop owns retry policy,
         the build/apply contract); lease and ledger I/O degrade to a
-        skipped sub-step, never a crashed worker."""
+        skipped sub-step, never a crashed worker.
+
+        ``wake_traces``: trace ids of the watch deliveries that woke an
+        event-driven caller (fleet/wakeup.py) — typically the
+        orchestrator's grant write. They enter the snapshot source's
+        wake book so this tick's pass span links grant → pass."""
+        if wake_traces:
+            for trace_id in wake_traces:
+                self.source.note_wake_trace(trace_id)
         now = self._now()
         held = frozenset(
             shard

@@ -772,6 +772,7 @@ class LocalApiServer:
         #: counts (aggregate watch bytes must not multiply with workers).
         self.watch_bytes_sent = 0
         self._request_log: Optional[list] = None
+        self._wire_log: Optional[list] = None
 
     def apf_stats(self) -> dict[str, dict[str, int]]:
         """Per-flow priority-and-fairness counters: current queue depth,
@@ -809,6 +810,24 @@ class LocalApiServer:
 
     def stop_request_log(self) -> list:
         log, self._request_log = self._request_log, None
+        return log if log is not None else []
+
+    def start_wire_log(self) -> list:
+        """Begin recording ``(method, path, pipelined)`` per request served,
+        where ``pipelined`` means the request's bytes were ALREADY buffered
+        on the connection when the previous response finished — i.e. it
+        rode a pipelined burst and cost no extra round trip. A roll's
+        write round trips are therefore its non-pipelined writes (the
+        first request of each burst), which is what the ``write_batching``
+        bench floors. Conservative in the honest direction: a request the
+        client pipelined but the kernel hadn't delivered yet counts as a
+        round trip. Returns the live list; ``stop_wire_log()`` detaches."""
+        log: list = []
+        self._wire_log = log
+        return log
+
+    def stop_wire_log(self) -> list:
+        log, self._wire_log = self._wire_log, None
         return log if log is not None else []
 
     # -- lifecycle ---------------------------------------------------------
@@ -936,8 +955,16 @@ class LocalApiServer:
     ) -> None:
         self.connections_opened += 1
         self._writers.add(writer)
+        served_on_connection = 0
         try:
             while True:
+                # Sampled BEFORE the read blocks: bytes already buffered
+                # while a previous response was in flight mean this next
+                # request was pipelined — it shares the earlier request's
+                # round trip (start_wire_log docstring).
+                pipelined = served_on_connection > 0 and bool(
+                    getattr(reader, "_buffer", b"")
+                )
                 try:
                     req = await _read_request(reader, writer)
                 except BadRequestError as e:
@@ -950,9 +977,13 @@ class LocalApiServer:
                 if req is None:
                     return
                 self.requests_served += 1
+                served_on_connection += 1
                 request_log = self._request_log
                 if request_log is not None:
                     request_log.append((req.method, req.path, dict(req.query)))
+                wire_log = self._wire_log
+                if wire_log is not None:
+                    wire_log.append((req.method, req.path, pipelined))
                 scheduler = self._apf_scheduler
                 # Server-side trace context (docs/tracing.md): a request
                 # carrying a traceparent joins the CLIENT's trace — its

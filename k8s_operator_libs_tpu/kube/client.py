@@ -11,7 +11,7 @@ REST client for real clusters reads the apiserver directly.
 from __future__ import annotations
 
 import abc
-from typing import Any, Mapping, Optional
+from typing import Any, Mapping, Optional, Sequence
 
 from .objects import KubeObject
 
@@ -213,6 +213,43 @@ class Client(abc.ABC):
         node_upgrade_state_provider.go:80-82),
         ``"json"`` = RFC 6902 JSON patch (``patch`` is the operation
         *array*, client-go's types.JSONPatchType)."""
+
+    def patch_many(
+        self,
+        kind: str,
+        patches: Sequence[tuple[str, Mapping[str, Any] | list[Any], str]],
+        namespace: str = "",
+        field_manager: str = "",
+        dry_run: bool = False,
+    ) -> "list[KubeObject | Exception]":
+        """Patch a batch of same-kind objects with per-item error
+        isolation: ``patches`` is a sequence of ``(name, patch,
+        patch_type)`` triples and the result list holds, slot for slot,
+        the patched object or the exception that item raised — a failed
+        item never fails its batchmates (the write-batching tier's
+        contract, docs/reconcile-data-path.md "The write path").
+
+        This base implementation is a serial loop over :meth:`patch`,
+        so every Client gets the semantics; RestClient overrides it to
+        pipeline the batch on one connection (one write round trip for
+        N independent PATCHes)."""
+        results: list[KubeObject | Exception] = []
+        for name, patch, patch_type in patches:
+            try:
+                results.append(
+                    self.patch(
+                        kind,
+                        name,
+                        namespace=namespace,
+                        patch=patch,
+                        patch_type=patch_type,
+                        field_manager=field_manager,
+                        dry_run=dry_run,
+                    )
+                )
+            except Exception as e:  # noqa: BLE001 - per-item isolation
+                results.append(e)
+        return results
 
     def apply(
         self,

@@ -106,10 +106,15 @@ POINT_HUB_REPLAY = "hub_replay"      # kube/watchhub.py forced overflow
 POINT_PARTITION = "partition"        # per-client request blackholing
 POINT_WORKER_KILL = "worker_kill"    # driver: stop + optional restart
 POINT_WIRE_KILL = "wire_kill"        # driver: LocalApiServer.kill_connections
+#: One PATCH in a pipelined write batch fails mid-flush while its
+#: batchmates land (upgrade/write_batch.py consults this per entry) —
+#: the partial-batch shape a real apiserver produces under contention.
+POINT_WRITE_BATCH = "write_batch_partial"
 
 ALL_POINTS = (
     POINT_LEASE, POINT_GRANT_WRITE, POINT_STATUS_WRITE, POINT_WATCH,
     POINT_HUB_REPLAY, POINT_PARTITION, POINT_WORKER_KILL, POINT_WIRE_KILL,
+    POINT_WRITE_BATCH,
 )
 
 SCHEDULE_VERSION = 1
@@ -182,6 +187,12 @@ class ChaosConfig:
     checkpoint: bool = False    # checkpoint-coordinated drains + victims
     checkpoint_timeout_s: int = 120
     wire: bool = False          # run over a LocalApiServer (wire mode)
+    #: Route worker provider writes through the group-commit batching
+    #: tier (upgrade/write_batch.py). The harness stays on the inline
+    #: runner, so every stage is a deterministic batch of one — what's
+    #: exercised is the stage→flush→rejoin machinery and the
+    #: ``write_batch_partial`` fault point, not wall-clock pipelining.
+    batch_writes: bool = True
 
     def resolved_max_steps(self) -> int:
         return self.max_steps or (240 + 5 * self.pools)
@@ -264,6 +275,8 @@ def generate_schedule(seed: int, config: ChaosConfig) -> FaultSchedule:
         points.append(POINT_HUB_REPLAY)
     if cfg.wire:
         points.append(POINT_WIRE_KILL)
+    if cfg.batch_writes:
+        points.append(POINT_WRITE_BATCH)
     identities = cfg.identities()
     faults: list[FaultSpec] = []
     perma_killed: set[str] = set()
@@ -357,6 +370,15 @@ def generate_schedule(seed: int, config: ChaosConfig) -> FaultSchedule:
             faults.append(FaultSpec(
                 step=step, point=point, duration=rng.randint(1, 2),
             ))
+        elif point == POINT_WRITE_BATCH:
+            # Empty target = any node's slot in any flush; a node target
+            # narrows to that node's entries only.
+            faults.append(FaultSpec(
+                step=step, point=point, duration=rng.randint(1, 6),
+                target=rng.choice(["", *cfg.node_names()[:4]]),
+                error=rng.choice(("conflict", "server_timeout")),
+                count=rng.randint(1, 4),
+            ))
     faults.sort(key=lambda f: (f.step, f.point, f.target, f.param))
     return FaultSchedule(seed=seed, config=cfg, faults=faults)
 
@@ -440,6 +462,12 @@ class FaultPlan:
         elif spec.point == POINT_HUB_REPLAY and point == "watchhub.deliver":
             if spec.param in ("", ctx.get("kind")):
                 return FaultAction(OVERFLOW)
+        elif (
+            spec.point == POINT_WRITE_BATCH
+            and point == "upgrade.write_batch_partial"
+        ):
+            if spec.target in ("", ctx.get("node")):
+                return FaultAction(RAISE, self._error_for(spec))
         elif spec.point == POINT_PARTITION and point == "wire.partition":
             if spec.target == ctx.get("identity"):
                 return FaultAction(
@@ -512,8 +540,8 @@ class PartitionedClient:
 
     _INTERCEPTED = frozenset({
         "get", "get_or_none", "list", "list_with_revision", "list_delta",
-        "watch", "create", "update", "update_status", "patch", "apply",
-        "delete", "delete_collection", "delete_if_exists", "evict",
+        "watch", "create", "update", "update_status", "patch", "patch_many",
+        "apply", "delete", "delete_collection", "delete_if_exists", "evict",
         "discover",
     })
 
@@ -696,6 +724,12 @@ class ChaosFleetHarness:
             now_fn=self.clock.now,
             wall_fn=self.clock.wall,
         )
+        if self.cfg.batch_writes:
+            # Batching on the INLINE runner: deterministic batches of
+            # one, exercising the stage→flush→rejoin path and the
+            # write_batch_partial fault point under every interleaving
+            # (ChaosConfig.batch_writes doc).
+            worker.mgr.enable_write_batching()
         # Tag the informers so a watch-hold fault can target exactly
         # this worker's streams (kube/informer.py chaos_tag).
         for informer in worker.source._informers.values():
@@ -947,6 +981,15 @@ class ChaosFleetHarness:
                     slot = self.slots[identity]
                     if not slot.alive:
                         continue
+                    # Quiesce watch delivery before every tick: the
+                    # sim/orchestrator writes above (and the previous
+                    # worker's apply writes) are otherwise mid-flight,
+                    # and whether one lands DURING this build_state
+                    # decides a completeness abort by thread timing —
+                    # the one wall-clock race the run-twice pin could
+                    # lose (observed ~2% of pairs before this barrier).
+                    if not self.settle(plan):
+                        violations["settle_timeouts"] += 1
                     slot.ticks += 1
                     try:
                         slot.worker.tick(policy)

@@ -445,16 +445,15 @@ class CommonUpgradeManager:
                 ns.node
             )
             if (not synced and not orphaned) or waiting_safe_load or upgrade_requested:
-                if ns.node.unschedulable:
-                    # Track that the node started cordoned so the upgrade
-                    # ends without uncordoning it (reference: :250-264).
-                    self.provider.change_node_upgrade_annotation(
-                        ns.node,
-                        self.keys.initial_state_annotation,
-                        TRUE_STRING,
-                    )
-                self.provider.change_node_upgrade_state(
-                    ns.node, UpgradeState.UPGRADE_REQUIRED
+                # One coalesced PATCH: the state transition plus (for a
+                # node that started cordoned) the initial-state marker the
+                # upgrade ends without uncordoning (reference: :250-264).
+                self.provider.change_node_state_and_annotations(
+                    ns.node,
+                    UpgradeState.UPGRADE_REQUIRED,
+                    {self.keys.initial_state_annotation: TRUE_STRING}
+                    if ns.node.unschedulable
+                    else {},
                 )
                 log.info("node %s requires upgrade", ns.node.name)
                 return
@@ -846,11 +845,15 @@ class CommonUpgradeManager:
             new_state = UpgradeState.UNCORDON_REQUIRED
             if self.keys.initial_state_annotation in ns.node.annotations:
                 new_state = UpgradeState.DONE
-            self.provider.change_node_upgrade_state(ns.node, new_state)
-            if new_state == UpgradeState.DONE:
-                self.provider.change_node_upgrade_annotation(
-                    ns.node, self.keys.initial_state_annotation, NULL_STRING
-                )
+            # One coalesced PATCH: the recovery transition plus (on the
+            # done path) retiring the initial-state marker.
+            self.provider.change_node_state_and_annotations(
+                ns.node,
+                new_state,
+                {self.keys.initial_state_annotation: NULL_STRING}
+                if new_state == UpgradeState.DONE
+                else {},
+            )
 
         # Dirty-filtered: recovery is a pure reaction to the driver pod
         # coming back in sync — a watched Pod delta dirties the node.
@@ -899,19 +902,19 @@ class CommonUpgradeManager:
                     node.name,
                 )
                 new_state = UpgradeState.DONE
-        self.provider.change_node_upgrade_state(node, new_state)
-        # Retire the checkpoint arc's escalation marker: the upgrade this
+        # One coalesced PATCH for the transition plus its marker cleanup:
+        # retire the checkpoint arc's escalation marker — the upgrade this
         # escalation belonged to is over (a no-op skip when absent, which
-        # is every non-checkpoint roll). The manifest itself is cleared by
+        # is every non-checkpoint roll; the manifest itself is cleared by
         # the restore gate — this only covers the zero-ack escalation
-        # path, which never recorded one.
-        self.provider.change_node_upgrade_annotation(
-            node, self.keys.checkpoint_escalated_annotation, NULL_STRING
-        )
+        # path, which never recorded one) — and, when the node ends done
+        # or runs requestor-mode, the initial-state marker too.
+        annotations = {self.keys.checkpoint_escalated_annotation: NULL_STRING}
         if new_state == UpgradeState.DONE or in_requestor_mode:
-            self.provider.change_node_upgrade_annotation(
-                node, self.keys.initial_state_annotation, NULL_STRING
-            )
+            annotations[self.keys.initial_state_annotation] = NULL_STRING
+        self.provider.change_node_state_and_annotations(
+            node, new_state, annotations
+        )
 
     def is_node_in_requestor_mode(self, node: Node) -> bool:
         """Key presence, any value (reference: util.go:134-138)."""

@@ -193,6 +193,14 @@ _PASS_GAUGES = [
     ("pass_writes_skipped",
      "No-op patches coalesced away during the last apply",
      "writes_skipped"),
+    ("pass_writes_coalesced",
+     "Extra keys that rode an issued patch instead of their own "
+     "during the last apply (same-node label+annotation coalescing)",
+     "writes_coalesced"),
+    ("pass_writes_batched",
+     "Patches routed through the write-batching tier during the last "
+     "apply (0 with batching off)",
+     "writes_batched"),
     ("pass_node_errors",
      "Per-node failures isolated inside buckets during the last apply",
      "node_errors"),

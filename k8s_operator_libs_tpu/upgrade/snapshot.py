@@ -529,6 +529,20 @@ class IncrementalSnapshotSource(InformerSnapshotSource):
         ):
             self._wake_traces.append(trace_id)
 
+    def note_wake_trace(self, trace_id: Optional[str]) -> None:
+        """Record an EXTERNAL wake cause for the next pass — the
+        event-driven tick loop (fleet/wakeup.py) passes the trace of the
+        watch delivery that woke it, so the pass span links to the grant
+        (or report) that caused the wake even when the delivery itself
+        dirtied nothing this source watches."""
+        if trace_id is None:
+            return
+        with self._delta_lock:
+            if len(self._wake_traces) < 64 and (
+                trace_id not in self._wake_traces
+            ):
+                self._wake_traces.append(trace_id)
+
     def consume_wake_traces(self) -> list[str]:
         """Drain the wake-trace book (the reconcile thread's pass-span
         linker). Always cheap: empty unless tracing marked anything."""

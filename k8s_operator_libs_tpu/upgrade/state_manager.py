@@ -53,6 +53,7 @@ from .snapshot import (
 )
 from .state_provider import NodeUpgradeStateProvider
 from .task_runner import TaskRunner
+from .write_batch import WriteBatcher
 from .validation_manager import ValidationHook, ValidationManager
 
 log = get_logger("upgrade.state_manager")
@@ -94,6 +95,14 @@ class StateOptions:
     #: (cordon, wait-for-jobs, pod-deletion scheduling, uncordon, ...).
     #: 1 = fully serial; the runner's inline mode is serial regardless.
     apply_width: int = 8
+    #: Route provider writes through the group-commit batching tier
+    #: (upgrade/write_batch.py): a bucket fan-out's independent-node
+    #: PATCHes ride one pipelined round trip. Only pays off when the
+    #: runner actually fans out (width > 1, non-inline) — a serial
+    #: caller degenerates to batches of one.
+    batch_writes: bool = False
+    #: Largest single pipelined flush when ``batch_writes`` is on.
+    write_batch_max: int = 64
 
 
 @dataclass
@@ -114,6 +123,11 @@ class PassStats:
     #: late writes land in whichever pass is open when they finish.
     writes_issued: int = 0
     writes_skipped: int = 0
+    #: Extra keys that rode an issued PATCH instead of their own (the
+    #: same-node label+annotation coalescing), and PATCHes that went
+    #: through the write-batching tier (0 with batching off).
+    writes_coalesced: int = 0
+    writes_batched: int = 0
     #: Per-node failures isolated inside buckets this pass.
     node_errors: int = 0
     #: True when the snapshot came from an IncrementalSnapshotSource —
@@ -213,6 +227,9 @@ class ClusterUpgradeStateManager:
         self.client = client
         self.recorder = recorder
         self.runner = runner
+        self._batcher: Optional[WriteBatcher] = None
+        if self.options.batch_writes:
+            self.enable_write_batching(self.options.write_batch_max)
         self.snapshot_source: SnapshotSource = (
             snapshot_source
             if snapshot_source is not None
@@ -257,6 +274,19 @@ class ClusterUpgradeStateManager:
         #: sets {"worker": identity} so co-hosted workers' otherwise
         #: identical pass spans stay distinguishable in a trace export.
         self.trace_attrs: dict = {}
+
+    def enable_write_batching(self, max_batch: int = 64) -> WriteBatcher:
+        """Install the group-commit write tier (upgrade/write_batch.py):
+        the provider's PATCHes stage OUTSIDE the keyed mutex and a bucket
+        fan-out's independent-node writes ride one pipelined round trip
+        (RestClient.patch_many). Idempotent; returns the batcher so
+        callers can read its flush stats."""
+        batcher = self._batcher
+        if batcher is None:
+            batcher = WriteBatcher(self.client, max_batch=max_batch)
+            self._batcher = batcher
+            self.provider.set_batcher(batcher)
+        return batcher
 
     def with_snapshot_from_informers(
         self,
@@ -870,7 +900,7 @@ class ClusterUpgradeStateManager:
         )
         if common.bucket_seconds:
             common.bucket_seconds = {}
-        issued_before, skipped_before = self.provider.write_counts()
+        writes_before = self.provider.write_stats()
         errors_before = self.runner.bucket_failures
         checkpoint_enabled = (
             policy.checkpoint is not None and policy.checkpoint.enable
@@ -928,9 +958,15 @@ class ClusterUpgradeStateManager:
                 invalidate()
             raise
         finally:
-            issued_after, skipped_after = self.provider.write_counts()
-            stats.writes_issued = issued_after - issued_before
-            stats.writes_skipped = skipped_after - skipped_before
+            writes_after = self.provider.write_stats()
+            stats.writes_issued = writes_after["issued"] - writes_before["issued"]
+            stats.writes_skipped = writes_after["skipped"] - writes_before["skipped"]
+            stats.writes_coalesced = (
+                writes_after["coalesced"] - writes_before["coalesced"]
+            )
+            stats.writes_batched = (
+                writes_after["batched"] - writes_before["batched"]
+            )
             stats.node_errors = self.runner.bucket_failures - errors_before
             stats.apply_s = time.perf_counter() - start
             stats.bucket_seconds = dict(common.bucket_seconds)

@@ -12,16 +12,17 @@ invariants hold:
 2. **Read-your-writes against a stale cache** — after patching, the provider
    blocks until its own cached reader reflects the write. The reference
    polls every 1 s up to 10 s (reference: :92-117, the "cache coherence"
-   comment); here the wait is event-driven — the provider wakes as soon as
-   the cache syncs — which removes up to ~1 s of dead time per state
-   transition, the reference's single biggest latency contributor
-   (SURVEY.md §3.3).
+   comment); here the PATCH response plus the write-through hook make the
+   cached reader coherent by construction, so the wait degenerates to a
+   response check on every wired configuration (docs/reconcile-data-path.md,
+   "The write path").
 
 Deleting an annotation is requested by writing the value ``"null"``, which
 becomes a JSON ``null`` in the merge patch (reference: :138-216).
 
-Two write-path optimizations on top of the reference shape (both pinned by
-tests/test_concurrent_apply.py):
+Three write-path optimizations on top of the reference shape (the first two
+pinned by tests/test_concurrent_apply.py, the third by
+tests/test_write_batching.py):
 
 * **No-op coalescing** — when the in-memory node already holds the target
   label/annotation value, the PATCH (and its read-back wait) is skipped
@@ -33,12 +34,25 @@ tests/test_concurrent_apply.py):
   an informer-backed snapshot store observes the provider's own writes
   immediately instead of waiting on the watch (read-your-writes for the
   next ``build_state``; see upgrade/snapshot.py).
+* **Key coalescing + write batching** — one node's same-pass label and
+  annotation mutations merge into a single PATCH
+  (:meth:`change_node_state_and_annotations`), and with a
+  :class:`~.write_batch.WriteBatcher` installed, independent nodes' PATCHes
+  from a bucket fan-out ride one pipelined round trip. The keyed mutex is
+  NEVER held across the batch flush: the critical section splits into
+  stage-side (no-op filter + optimistic in-memory apply, under the mutex),
+  the flush (outside any lock), and the rejoin (count/write-through/
+  visibility/events, under the mutex again). A concurrent same-node writer
+  observes the optimistic value — exactly the value it would observe after
+  the flush — and the pass-abort path rolls the optimistic apply back and
+  invalidates the snapshot, so a failed flush heals like any other write
+  error.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Callable, Optional, Protocol, Union
+from typing import Callable, Mapping, Optional, Protocol, Union
 
 from ..kube.client import Client
 from ..kube.objects import KubeObject, Node
@@ -46,6 +60,7 @@ from ..utils import tracing
 from ..utils.log import get_logger
 from ..utils.sync import KeyedMutex
 from .consts import NULL_STRING, UpgradeKeys, UpgradeState
+from .write_batch import WriteBatcher
 
 log = get_logger("upgrade.state_provider")
 
@@ -79,9 +94,12 @@ class NodeUpgradeStateProvider:
         self._timeout = cache_sync_timeout
         self._mutex = KeyedMutex()
         self._write_through: Optional[Callable[[KubeObject], None]] = None
+        self._batcher: Optional[WriteBatcher] = None
         self._counter_lock = threading.Lock()
         self._writes_issued = 0
         self._writes_skipped = 0
+        self._writes_coalesced = 0
+        self._writes_batched = 0
 
     # -- write accounting / snapshot wiring --------------------------------
     def set_write_through(
@@ -91,6 +109,13 @@ class NodeUpgradeStateProvider:
         patched object — the informer-backed snapshot store's
         read-your-writes path."""
         self._write_through = fn
+
+    def set_batcher(self, batcher: Optional[WriteBatcher]) -> None:
+        """Install (or with ``None``, remove) the write-batching tier:
+        subsequent writes stage through ``batcher`` outside the keyed
+        mutex instead of patching inline under it. The batcher must wrap
+        the same logical apiserver as this provider's client."""
+        self._batcher = batcher
 
     @property
     def writes_issued(self) -> int:
@@ -107,12 +132,30 @@ class NodeUpgradeStateProvider:
         with self._counter_lock:
             return self._writes_issued, self._writes_skipped
 
-    def _count_write(self, skipped: bool) -> None:
+    def write_stats(self) -> dict[str, int]:
+        """All write counters in one consistent read: ``issued`` PATCHes,
+        ``skipped`` no-ops, ``coalesced`` extra keys that rode an issued
+        PATCH instead of their own, ``batched`` PATCHes that went through
+        the batching tier."""
+        with self._counter_lock:
+            return {
+                "issued": self._writes_issued,
+                "skipped": self._writes_skipped,
+                "coalesced": self._writes_coalesced,
+                "batched": self._writes_batched,
+            }
+
+    def _count_write(
+        self, skipped: bool, coalesced: int = 0, batched: bool = False
+    ) -> None:
         with self._counter_lock:
             if skipped:
                 self._writes_skipped += 1
             else:
                 self._writes_issued += 1
+                self._writes_coalesced += coalesced
+                if batched:
+                    self._writes_batched += 1
 
     # -- reads -------------------------------------------------------------
     def get_node(self, name: str) -> Node:
@@ -138,56 +181,13 @@ class NodeUpgradeStateProvider:
         (reference: :72-134)."""
         new_state = UpgradeState(new_state)
         value: Optional[str] = str(new_state) if new_state != UpgradeState.UNKNOWN else None
-        with self._mutex.locked(node.name):
-            previous = node.labels.get(self._keys.state_label)
-            if previous == value:
-                # No-op coalescing: the label already holds the target
-                # value (None == absent). The provider is the single
-                # writer of this key, so the in-memory node is
-                # authoritative — skip the PATCH and its read-back wait.
-                self._count_write(skipped=True)
-                return
-            # Strategic merge patch, matching the reference's label write
-            # (node_upgrade_state_provider.go:80-82); annotations below use
-            # RFC 7386 merge patch (:147-150). For string-map writes the two
-            # coincide — tests/test_patch_semantics.py pins the equivalence.
-            patched = self._client.patch(
-                "Node",
-                node.name,
-                patch={"metadata": {"labels": {self._keys.state_label: value}}},
-                patch_type="strategic",
-            )
-            self._count_write(skipped=False)
-            if self._write_through is not None and patched is not None:
-                self._write_through(patched)
-            self._await_visible(
-                node.name,
-                lambda n: (n.metadata.get("labels") or {}).get(self._keys.state_label)
-                == value,
-                what=f"state={new_state or '<cleared>'}",
-                result=patched,
-            )
-            # Keep the caller's in-memory object coherent with what was written.
-            if value is None:
-                node.labels.pop(self._keys.state_label, None)
-            else:
-                node.labels[self._keys.state_label] = value
-            # Flight-recorder hook (docs/tracing.md): every real state
-            # transition becomes an event on the CURRENT span — the
-            # bucket that caused it (TaskRunner propagates the bucket
-            # span into fan-out workers), whose parent is the pass. One
-            # global read when tracing is off; coalesced no-ops above
-            # never report (they transitioned nothing).
-            cause = tracing.current_span()
-            if cause is not None:
-                tracing.add_event(
-                    "state.transition",
-                    node=node.name,
-                    frm=previous or "",
-                    to=value or "",
-                    cause=cause.name,
-                )
-        if self._recorder is not None:
+        applied, _ = self._write_keys(
+            node,
+            labels={self._keys.state_label: value},
+            annotations={},
+            what=f"state={new_state or '<cleared>'}",
+        )
+        if applied and self._recorder is not None:
             self._recorder.eventf(
                 node,
                 "Normal",
@@ -202,31 +202,13 @@ class NodeUpgradeStateProvider:
         """Patch (or with ``"null"``, delete) a node annotation and wait for
         cache visibility (reference: :138-216)."""
         patch_value: Optional[str] = None if value == NULL_STRING else value
-        with self._mutex.locked(node.name):
-            if node.annotations.get(key) == patch_value:
-                # No-op coalescing: deleting an absent key or re-writing
-                # the held value — skip the PATCH (see the label path).
-                self._count_write(skipped=True)
-                return
-            patched = self._client.patch(
-                "Node",
-                node.name,
-                patch={"metadata": {"annotations": {key: patch_value}}},
-            )
-            self._count_write(skipped=False)
-            if self._write_through is not None and patched is not None:
-                self._write_through(patched)
-            self._await_visible(
-                node.name,
-                lambda n: (n.metadata.get("annotations") or {}).get(key) == patch_value,
-                what=f"annotation {key}={value}",
-                result=patched,
-            )
-            if patch_value is None:
-                node.annotations.pop(key, None)
-            else:
-                node.annotations[key] = patch_value
-        if self._recorder is not None:
+        _, applied = self._write_keys(
+            node,
+            labels={},
+            annotations={key: patch_value},
+            what=f"annotation {key}={value}",
+        )
+        if applied and self._recorder is not None:
             self._recorder.eventf(
                 node,
                 "Normal",
@@ -236,16 +218,259 @@ class NodeUpgradeStateProvider:
                 value,
             )
 
+    def change_node_state_and_annotations(
+        self,
+        node: Node,
+        new_state: Union[UpgradeState, str],
+        annotations: Mapping[str, str],
+    ) -> None:
+        """Coalesced write: one PATCH carries the node's state-label
+        transition AND the given annotation writes/deletes (``"null"``
+        values delete, as in :meth:`change_node_upgrade_annotation`).
+        Call sites that used to issue back-to-back single-key writes for
+        the same node (classify, uncordon-or-done, failure recovery) go
+        through here so one node costs one write per pass step. No-op
+        keys are filtered per key — a PATCH is issued only for keys that
+        actually change, and none at all when every key is settled."""
+        new_state = UpgradeState(new_state)
+        value: Optional[str] = str(new_state) if new_state != UpgradeState.UNKNOWN else None
+        ann = {
+            k: (None if v == NULL_STRING else v) for k, v in annotations.items()
+        }
+        applied_labels, applied_ann = self._write_keys(
+            node,
+            labels={self._keys.state_label: value},
+            annotations=ann,
+            what=f"state={new_state or '<cleared>'}"
+            + (f"+annotations {','.join(sorted(ann))}" if ann else ""),
+        )
+        if self._recorder is None:
+            return
+        if applied_labels:
+            self._recorder.eventf(
+                node,
+                "Normal",
+                self._keys.event_reason(),
+                "Node upgrade state set to %s",
+                str(new_state) or "<cleared>",
+            )
+        for key in applied_ann:
+            self._recorder.eventf(
+                node,
+                "Normal",
+                self._keys.event_reason(),
+                "Node upgrade annotation %s set to %s",
+                key,
+                annotations[key],
+            )
+
+    # -- the combined write core -------------------------------------------
+    def _write_keys(
+        self,
+        node: Node,
+        labels: Mapping[str, Optional[str]],
+        annotations: Mapping[str, Optional[str]],
+        what: str,
+    ) -> tuple[dict[str, Optional[str]], dict[str, Optional[str]]]:
+        """Write the given label/annotation targets (``None`` = delete) in
+        ONE PATCH, serialized per node, and return the
+        ``(labels, annotations)`` that actually changed (no-op keys are
+        filtered out; both empty = nothing was written).
+
+        Serial path (no batcher): the PATCH, write-through, and visibility
+        check all run under the keyed mutex — the pre-batching behavior,
+        byte for byte. Batched path: the mutex is NEVER held across the
+        flush (LCK111 discipline; tests/analyze_fixtures/batch_*.py pin
+        the twin). The in-memory node is updated optimistically inside the
+        first critical section so a concurrent same-node writer's no-op
+        check observes the pending value; a failed flush rolls back any
+        key still holding our optimistic value and re-raises, and the
+        pass-abort path invalidates the snapshot, which heals the
+        remaining window like any other write error."""
+        with self._mutex.locked(node.name):
+            lab_changes = {
+                k: v for k, v in labels.items() if node.labels.get(k) != v
+            }
+            ann_changes = {
+                k: v
+                for k, v in annotations.items()
+                if node.annotations.get(k) != v
+            }
+            if not lab_changes and not ann_changes:
+                # No-op coalescing: every key already holds its target
+                # value (None == absent). The provider is the single
+                # writer of these keys, so the in-memory node is
+                # authoritative — skip the PATCH and its visibility wait,
+                # and never reach the batching tier.
+                self._count_write(skipped=True)
+                return {}, {}
+            prev_labels = {k: node.labels.get(k) for k in lab_changes}
+            prev_annotations = {
+                k: node.annotations.get(k) for k in ann_changes
+            }
+            meta: dict = {}
+            if lab_changes:
+                meta["labels"] = dict(lab_changes)
+            if ann_changes:
+                meta["annotations"] = dict(ann_changes)
+            patch = {"metadata": meta}
+            # Strategic merge patch for the pure label write, matching the
+            # reference (node_upgrade_state_provider.go:80-82); anything
+            # touching annotations uses RFC 7386 merge patch (:147-150).
+            # For string-map writes the two coincide —
+            # tests/test_patch_semantics.py pins the equivalence.
+            patch_type = (
+                "strategic" if lab_changes and not ann_changes else "merge"
+            )
+            batcher = self._batcher
+            if batcher is None:
+                patched = self._client.patch(
+                    "Node", node.name, patch=patch, patch_type=patch_type
+                )
+                self._commit_write(
+                    node, patched, lab_changes, ann_changes, prev_labels,
+                    what, batched=False,
+                )
+                return lab_changes, ann_changes
+            # Batched: fold the target values into the in-memory node NOW,
+            # under the mutex, so the single-writer no-op invariant keeps
+            # holding while the mutex is released for the flush.
+            self._apply_in_memory(node, lab_changes, ann_changes)
+        try:
+            # OUTSIDE the keyed mutex: the stage may carry a whole batch's
+            # round trip, and holding this node's mutex across it would
+            # serialize unrelated same-node readers behind batchmates.
+            patched = batcher.stage(
+                "Node", node.name, patch, patch_type=patch_type
+            )
+        except BaseException:
+            with self._mutex.locked(node.name):
+                self._rollback_write(
+                    node, lab_changes, ann_changes, prev_labels,
+                    prev_annotations,
+                )
+            raise
+        with self._mutex.locked(node.name):
+            self._commit_write(
+                node, patched, lab_changes, ann_changes, prev_labels,
+                what, batched=True,
+            )
+        return lab_changes, ann_changes
+
+    def _commit_write(
+        self,
+        node: Node,
+        patched: Optional[KubeObject],
+        lab_changes: Mapping[str, Optional[str]],
+        ann_changes: Mapping[str, Optional[str]],
+        prev_labels: Mapping[str, Optional[str]],
+        what: str,
+        batched: bool,
+    ) -> None:
+        """Runs inside the caller's keyed-mutex critical section for this
+        node. Count the write, feed the write-through, verify visibility,
+        fold the written values into the caller's in-memory node, and
+        report the state-label transition."""
+        self._count_write(
+            skipped=False,
+            coalesced=len(lab_changes) + len(ann_changes) - 1,
+            batched=batched,
+        )
+        if self._write_through is not None and patched is not None:
+            self._write_through(patched)
+
+        def check(n) -> bool:
+            meta = n.metadata
+            labs = meta.get("labels") or {}
+            anns = meta.get("annotations") or {}
+            return all(
+                labs.get(k) == v for k, v in lab_changes.items()
+            ) and all(anns.get(k) == v for k, v in ann_changes.items())
+
+        self._await_visible(node.name, check, what=what, result=patched)
+        # Keep the caller's in-memory object coherent with what was
+        # written (idempotent — the batched path already applied it
+        # optimistically before the flush).
+        self._apply_in_memory(node, lab_changes, ann_changes)
+        state_label = self._keys.state_label
+        if state_label in lab_changes:
+            # Flight-recorder hook (docs/tracing.md): every real state
+            # transition becomes an event on the CURRENT span — the
+            # bucket that caused it (TaskRunner propagates the bucket
+            # span into fan-out workers), whose parent is the pass. One
+            # global read when tracing is off; coalesced no-ops above
+            # never report (they transitioned nothing).
+            cause = tracing.current_span()
+            if cause is not None:
+                tracing.add_event(
+                    "state.transition",
+                    node=node.name,
+                    frm=prev_labels.get(state_label) or "",
+                    to=lab_changes[state_label] or "",
+                    cause=cause.name,
+                )
+
+    @staticmethod
+    def _apply_in_memory(
+        node: Node,
+        lab_changes: Mapping[str, Optional[str]],
+        ann_changes: Mapping[str, Optional[str]],
+    ) -> None:
+        for k, v in lab_changes.items():
+            if v is None:
+                node.labels.pop(k, None)
+            else:
+                node.labels[k] = v
+        for k, v in ann_changes.items():
+            if v is None:
+                node.annotations.pop(k, None)
+            else:
+                node.annotations[k] = v
+
+    @staticmethod
+    def _rollback_write(
+        node: Node,
+        lab_changes: Mapping[str, Optional[str]],
+        ann_changes: Mapping[str, Optional[str]],
+        prev_labels: Mapping[str, Optional[str]],
+        prev_annotations: Mapping[str, Optional[str]],
+    ) -> None:
+        """Runs inside the caller's keyed-mutex critical section for this
+        node. Undo the optimistic in-memory apply after a failed flush —
+        but only for keys STILL holding our optimistic value; a concurrent
+        writer that moved a key on since owns it now and must not be
+        clobbered."""
+        for k, v in lab_changes.items():
+            if node.labels.get(k) == v:
+                prev = prev_labels.get(k)
+                if prev is None:
+                    node.labels.pop(k, None)
+                else:
+                    node.labels[k] = prev
+        for k, v in ann_changes.items():
+            if node.annotations.get(k) == v:
+                prev = prev_annotations.get(k)
+                if prev is None:
+                    node.annotations.pop(k, None)
+                else:
+                    node.annotations[k] = prev
+
     # -- internals ---------------------------------------------------------
     def _await_visible(
         self, node_name: str, predicate, what: str, result=None
     ) -> None:
-        # When the reader IS the writing client there is no cache that
-        # could lag: the patch RESPONSE is the authoritative post-write
-        # object, and checking it is strictly stronger than re-reading
-        # (it verifies what the write actually produced, without paying
-        # another round trip per state transition).
-        if result is not None and self._reader is self._client:
+        # Read-your-writes by construction, no read-back: when the reader
+        # IS the writing client there is no cache that could lag, and when
+        # the write-through hook is wired the cached reader was handed the
+        # patch RESPONSE under this same mutex hold — in both cases the
+        # response is the authoritative post-write object and checking it
+        # is strictly stronger than re-reading (it verifies what the write
+        # actually produced, without another round trip per transition).
+        # tests/test_write_batching.py pins the no-read-back property with
+        # a dead-watch reader, the PR-4 pattern.
+        if result is not None and (
+            self._reader is self._client or self._write_through is not None
+        ):
             if not predicate(result):
                 raise StateWriteError(
                     f"write of {what} on node {node_name} did not produce "

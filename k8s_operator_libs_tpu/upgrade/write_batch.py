@@ -1,0 +1,200 @@
+"""WriteBatcher — leader-based group commit for provider writes.
+
+The write half of the reconcile data path (docs/reconcile-data-path.md
+"The write path"): :class:`~.state_provider.NodeUpgradeStateProvider`
+stages each node's PATCH here instead of issuing it inline, and
+whichever caller finds no flush in progress becomes the **leader**: it
+swaps out everything staged so far, flushes the batch through
+``Client.patch_many`` (pipelined on RestClient — one write round trip
+for N independent-node PATCHes), distributes the per-slot results, and
+drains anything that accumulated during the flush before stepping down.
+Classic database group commit: the batch window is the flush RTT
+itself, so batching is self-clocking — no timers, no background thread,
+and a single-threaded caller degenerates to exactly the serial path
+(every stage is a batch of one), which keeps the chaos harness's
+deterministic schedules deterministic.
+
+Contract highlights:
+
+* **Never called under the keyed mutex.** The provider stages OUTSIDE
+  its per-node critical section (LCK111 discipline — a stage can block
+  for a whole batch flush, and a held per-node mutex would serialize
+  every other node behind this one's round trip). Pinned by the
+  analyzer fixture twin (tests/analyze_fixtures/batch_*.py).
+* **Per-entry error isolation.** A slot's failure (Conflict,
+  ServerTimeout, the ``upgrade.write_batch_partial`` chaos point) is
+  raised to that slot's caller only; batchmates complete normally.
+* **Global FIFO.** Flushes are serialized by the leader flag and
+  entries flush in stage order, so two same-node writes staged in
+  order are applied by the server in that order even across batches.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Mapping
+
+from ..kube.client import Client
+from ..utils import tracing
+from ..utils.faultpoints import fault_point
+from ..utils.log import get_logger
+
+log = get_logger("upgrade.write_batch")
+
+#: Chaos consult point (docs/chaos-harness.md): one PATCH in a
+#: pipelined batch fails mid-flush while its batchmates land.
+WRITE_BATCH_FAULT_POINT = "upgrade.write_batch_partial"
+
+#: Backstop for a follower waiting on its flush result. Generous: the
+#: leader's flush is bounded by the client's own wire timeouts, so this
+#: only fires if the leader thread died unrecoverably.
+STAGE_TIMEOUT_SECONDS = 120.0
+
+
+class WriteBatchError(Exception):
+    """A staged write never received its flush result (leader died or
+    the stage timeout elapsed) — ambiguous outcome, like a wire error."""
+
+
+class _Entry:
+    __slots__ = ("kind", "namespace", "name", "patch", "patch_type",
+                 "event", "result")
+
+    def __init__(
+        self,
+        kind: str,
+        namespace: str,
+        name: str,
+        patch: Mapping[str, Any],
+        patch_type: str,
+    ) -> None:
+        self.kind = kind
+        self.namespace = namespace
+        self.name = name
+        self.patch = patch
+        self.patch_type = patch_type
+        self.event = threading.Event()
+        self.result: Any = None  # KubeObject or BaseException
+
+
+class WriteBatcher:
+    """Stage-and-flush write coalescer over one :class:`Client`.
+
+    Thread-safe; create one per provider (the provider is already the
+    single writer of the keys it manages, the batcher just carries its
+    fan-out). ``max_batch`` bounds one pipelined burst so a huge bucket
+    cannot exceed what APF admits in one window."""
+
+    def __init__(self, client: Client, max_batch: int = 64) -> None:
+        self._client = client
+        self._max_batch = max(1, int(max_batch))
+        self._lock = threading.Lock()
+        self._pending: list[_Entry] = []
+        self._flushing = False
+        # Lifetime counters (PassStats/metrics read them via stats()).
+        self._batches_flushed = 0
+        self._writes_flushed = 0
+        self._max_batch_seen = 0
+
+    def stats(self) -> dict[str, int]:
+        with self._lock:
+            return {
+                "batches_flushed": self._batches_flushed,
+                "writes_flushed": self._writes_flushed,
+                "max_batch": self._max_batch_seen,
+            }
+
+    # -- the one public operation ------------------------------------------
+    def stage(
+        self,
+        kind: str,
+        name: str,
+        patch: Mapping[str, Any],
+        patch_type: str = "merge",
+        namespace: str = "",
+    ) -> Any:
+        """Stage one PATCH and block until its result is known: returns
+        the patched object, or raises this slot's error. The calling
+        thread may become the flush leader and carry batchmates' writes
+        on its own round trip."""
+        entry = _Entry(kind, namespace, name, patch, patch_type)
+        with self._lock:
+            self._pending.append(entry)
+            leader = not self._flushing
+            if leader:
+                self._flushing = True
+        if leader:
+            self._drain()
+        else:
+            if not entry.event.wait(STAGE_TIMEOUT_SECONDS):
+                entry.result = WriteBatchError(
+                    f"staged write for {kind}/{name} never flushed "
+                    f"within {STAGE_TIMEOUT_SECONDS}s"
+                )
+        if isinstance(entry.result, BaseException):
+            raise entry.result
+        return entry.result
+
+    # -- leader internals ---------------------------------------------------
+    def _drain(self) -> None:
+        """Flush staged batches until none remain, then step down. On an
+        unexpected flush error every in-flight AND still-pending entry is
+        failed loudly — a follower must never hang on a dead leader."""
+        while True:
+            with self._lock:
+                if not self._pending:
+                    self._flushing = False
+                    return
+                batch = self._pending[: self._max_batch]
+                del self._pending[: len(batch)]
+                self._max_batch_seen = max(self._max_batch_seen, len(batch))
+            try:
+                self._flush(batch)
+            except BaseException as e:
+                with self._lock:
+                    leftovers, self._pending = self._pending, []
+                    self._flushing = False
+                for entry in batch + leftovers:
+                    if not entry.event.is_set():
+                        entry.result = WriteBatchError(
+                            f"batch flush failed: {type(e).__name__}: {e}"
+                        )
+                        entry.event.set()
+                raise
+
+    def _flush(self, batch: list[_Entry]) -> None:
+        """One pipelined burst: consult the chaos point per entry, group
+        survivors by (kind, namespace) preserving stage order, issue
+        ``patch_many`` per group, distribute results slot by slot."""
+        live: list[_Entry] = []
+        for entry in batch:
+            act = fault_point(
+                WRITE_BATCH_FAULT_POINT, node=entry.name, kind=entry.kind
+            )
+            if act is not None and act.exc is not None:
+                # Chaos: this slot fails mid-flush (Conflict /
+                # ServerTimeout) while its batchmates proceed — the
+                # partial-batch shape a real apiserver produces.
+                entry.result = act.exc
+                entry.event.set()
+                continue
+            live.append(entry)
+        groups: dict[tuple[str, str], list[_Entry]] = {}
+        for entry in live:
+            groups.setdefault((entry.kind, entry.namespace), []).append(entry)
+        with tracing.span(
+            "write.flush", category="write",
+            writes=len(live), staged=len(batch),
+        ):
+            for (kind, namespace), entries in groups.items():
+                results = self._client.patch_many(
+                    kind,
+                    [(e.name, e.patch, e.patch_type) for e in entries],
+                    namespace=namespace,
+                )
+                for entry, result in zip(entries, results):
+                    entry.result = result
+                    entry.event.set()
+        with self._lock:
+            self._batches_flushed += 1
+            self._writes_flushed += len(live)

@@ -63,6 +63,7 @@ CATEGORIES = (
     "drain",      # node drain / eviction waits
     "checkpoint", # checkpoint request→ack→manifest arcs
     "probe",      # validation batteries / restore gates
+    "write",      # provider write-batch flushes (upgrade/write_batch.py)
 )
 
 #: Default ring capacity: a 64-pool roll at 2 workers produces a few

@@ -1,0 +1,56 @@
+"""Seeded write-batching violation: the batch flush — a pipelined wire
+round trip that can also park the thread on a follower's event — runs
+INSIDE the per-node keyed mutex. This is exactly the shape the
+provider's split critical section exists to avoid (stage outside, rejoin
+inside); LCK111 must flag the blocking chain with the keyed identity.
+
+Analyzer fixture — analyzed as text by tests/test_analyze.py, never
+imported.
+"""
+
+import threading
+import time
+from contextlib import contextmanager
+
+
+class KeyedMutex:
+    def __init__(self):
+        self._guard = threading.Lock()
+        self._locks = {}
+
+    @contextmanager
+    def locked(self, key):
+        with self._guard:
+            lock = self._locks.setdefault(key, threading.Lock())
+        lock.acquire()
+        try:
+            yield
+        finally:
+            lock.release()
+
+
+class Batcher:
+    def __init__(self):
+        self._pending = []
+
+    def stage(self, name, patch):
+        self._pending.append((name, patch))
+        return self._flush()
+
+    def _flush(self):
+        batch, self._pending = self._pending, []
+        time.sleep(0.001)  # the pipelined wire round trip
+        return len(batch)
+
+
+class BadBatchedWriter:
+    def __init__(self):
+        self._mutex = KeyedMutex()
+        self._batcher = Batcher()
+
+    def write(self, name, patch):
+        with self._mutex.locked(name):
+            # LCK111: stage -> _flush blocks while the node's keyed
+            # mutex is held — every same-node writer stalls behind the
+            # whole batch's round trip.
+            return self._batcher.stage(name, patch)

@@ -1,0 +1,66 @@
+"""Clean twin of batch_bad.py: the provider's split critical section.
+The keyed mutex covers only non-blocking work — the optimistic
+in-memory apply before the flush and the bookkeeping rejoin after it —
+while the batch flush (the wire round trip) runs outside any lock.
+LCK111 must stay silent.
+
+Analyzer fixture — analyzed as text by tests/test_analyze.py, never
+imported.
+"""
+
+import threading
+import time
+from contextlib import contextmanager
+
+
+class KeyedMutex:
+    def __init__(self):
+        self._guard = threading.Lock()
+        self._locks = {}
+
+    @contextmanager
+    def locked(self, key):
+        with self._guard:
+            lock = self._locks.setdefault(key, threading.Lock())
+        lock.acquire()
+        try:
+            yield
+        finally:
+            lock.release()
+
+
+class Batcher:
+    def __init__(self):
+        self._pending = []
+
+    def stage(self, name, patch):
+        self._pending.append((name, patch))
+        return self._flush()
+
+    def _flush(self):
+        batch, self._pending = self._pending, []
+        time.sleep(0.001)  # the pipelined wire round trip
+        return len(batch)
+
+
+class CleanBatchedWriter:
+    def __init__(self):
+        self._mutex = KeyedMutex()
+        self._batcher = Batcher()
+        self._values = {}
+
+    def write(self, name, patch):
+        with self._mutex.locked(name):
+            self._apply(name, patch)  # optimistic, in-memory only
+        # The flush happens OUTSIDE the keyed mutex: same-node writers
+        # observe the optimistic value instead of stalling on the wire.
+        result = self._batcher.stage(name, patch)
+        with self._mutex.locked(name):
+            self._rejoin(name, result)  # non-blocking bookkeeping
+        return result
+
+    def _apply(self, name, patch):
+        self._values[name] = patch
+
+    def _rejoin(self, name, result):
+        self._values[name] = (self._values.get(name), result)

@@ -554,6 +554,25 @@ def test_keyed_mutex_direct_blocking_reported(tmp_path):
     assert "KeyedMutex[Writer._mutex]" in findings[0].message
 
 
+def test_batch_flush_under_keyed_mutex_flagged():
+    """The write-batching discipline (docs/reconcile-data-path.md, "The
+    write path"): a batch flush reachable inside the per-node keyed
+    mutex is an LCK111 with the keyed identity — the exact regression
+    the provider's split critical section prevents."""
+    findings = run_analysis([str(FIXTURES / "batch_bad.py")])
+    assert codes(findings) == {"LCK111"}
+    assert len(findings) == 1
+    msg = findings[0].message
+    assert "Batcher.stage -> Batcher._flush" in msg
+    assert "KeyedMutex[BadBatchedWriter._mutex]" in msg
+
+
+def test_batch_flush_outside_keyed_mutex_clean():
+    """The sanctioned shape — optimistic apply under the mutex, flush
+    outside, bookkeeping rejoin back under it — stays silent."""
+    assert run_analysis([str(FIXTURES / "batch_clean.py")]) == []
+
+
 def test_package_transitive_blocking_all_baselined():
     """Every LCK111 the package produces today is the state provider's
     deliberate hold-the-keyed-mutex-across-the-write contract — each is

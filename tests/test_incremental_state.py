@@ -37,6 +37,7 @@ from k8s_operator_libs_tpu.upgrade import (
     UpgradeKeys,
     UpgradeState,
 )
+from k8s_operator_libs_tpu.upgrade.consts import NULL_STRING
 from k8s_operator_libs_tpu.upgrade.state_manager import StateOptions
 from k8s_operator_libs_tpu.utils import IntOrString
 from builders import make_node, make_pod
@@ -66,9 +67,15 @@ def build_cluster(node_count=6):
 
 
 def incremental_manager(
-    cluster, verify_every_n=0, width=None, runner=None, watch_hub=None
+    cluster, verify_every_n=0, width=None, runner=None, watch_hub=None,
+    batch_writes=False,
 ):
-    options = StateOptions(apply_width=width) if width else None
+    options = None
+    if width or batch_writes:
+        options = StateOptions(
+            apply_width=width or StateOptions().apply_width,
+            batch_writes=batch_writes,
+        )
     mgr = ClusterUpgradeStateManager(
         cluster, DEVICE,
         runner=runner or TaskRunner(inline=True),
@@ -207,11 +214,24 @@ class TestEquivalenceFuzzer:
 
         self._fuzz(seed, hub_factory=WatchHub)
 
-    def _fuzz(self, seed, hub_factory=None):
+    @pytest.mark.parametrize("seed", [5, 3031])
+    def test_incremental_matches_full_rebuild_batched_writes(self, seed):
+        """The same equivalence fuzz with the write-batching tier live
+        (ISSUE 16): the incremental manager's provider stages through
+        the group-commit WriteBatcher (optimistic in-memory apply,
+        flush outside the keyed mutex, write-through on rejoin) and a
+        dedicated op drives coalesced state+annotation writes through
+        it mid-fuzz — delta bookkeeping must stay shape-for-shape
+        equal to the stateless rebuild throughout."""
+        self._fuzz(seed, batch_writes=True)
+
+    def _fuzz(self, seed, hub_factory=None, batch_writes=False):
         rng = random.Random(seed)
         cluster, sim = build_cluster(node_count=6)
         hub = hub_factory(cluster) if hub_factory is not None else None
-        mgr_inc, source = incremental_manager(cluster, watch_hub=hub)
+        mgr_inc, source = incremental_manager(
+            cluster, watch_hub=hub, batch_writes=batch_writes
+        )
         mgr_full = full_manager(cluster)
         extra_nodes: list[str] = []
         rollouts = 0
@@ -284,12 +304,29 @@ class TestEquivalenceFuzzer:
                              "ControllerRevision"):
                     source.informer(kind).resync_once()
 
+            def provider_write(_):
+                # The batched write path end to end: coalesced
+                # state+annotation PATCH staged through the batcher
+                # outside the keyed mutex, write-through into the
+                # informer store on rejoin. The incremental book must
+                # absorb it exactly as it absorbs a raw cluster.update.
+                name = f"node-{rng.randrange(6)}"
+                node = Node(cluster.get("Node", name).raw)
+                key = KEYS.upgrade_requested_annotation
+                mgr_inc.provider.change_node_state_and_annotations(
+                    node,
+                    UpgradeState(rng.choice(self.STATES)),
+                    {key: rng.choice(["true", NULL_STRING])},
+                )
+
             ops = [
                 flip_state_label, flip_state_label, flip_cordon,
                 flip_request_annotation, rollout, kubelet_step,
                 kubelet_step, delete_driver_pod, churn_node,
                 watch_restart, resync_sweep,
             ]
+            if batch_writes:
+                ops += [provider_write, provider_write]
             for step in range(50):
                 rng.choice(ops)(step)
                 assert wait_until(
@@ -299,6 +336,11 @@ class TestEquivalenceFuzzer:
                 got = build_shape(mgr_inc)
                 assert got == expected, (
                     f"seed={seed} step={step}: incremental diverged"
+                )
+            if batch_writes:
+                stats = mgr_inc.enable_write_batching().stats()
+                assert stats["writes_flushed"] > 0, (
+                    f"seed={seed}: batched fuzz never flushed a write"
                 )
         finally:
             source.stop()

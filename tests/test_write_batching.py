@@ -1,0 +1,547 @@
+"""The write path (ISSUE 16 tentpole, docs/reconcile-data-path.md
+"The write path"): coalesced same-node PATCHes, the group-commit
+WriteBatcher, and the visibility contract.
+
+Contract pins, each proven against the wire (recorded patch bodies or
+the fake client's call log), not inferred from counters alone:
+
+* a same-node label+annotation write is ONE merge PATCH whose body is
+  byte-pinned — the coalescing tier, upstream of batching;
+* a full roll produces identical per-node state-label sequences with
+  batching on and off, at apply width 1 and 8 — batching changes the
+  wire shape, never the semantics;
+* no-op coalescing short-circuits BEFORE the batching tier — a settled
+  key never wakes the batcher;
+* with the write-through wired, a write needs ZERO read-backs even when
+  every watch is dead (the PR-4 pattern: visibility comes from the
+  PATCH response, not a poll);
+* WriteBatcher itself: per-slot error isolation under the
+  ``upgrade.write_batch_partial`` chaos point, follower resolution when
+  the leader dies mid-flush, FIFO order across batches, and honest
+  flush counters.
+"""
+
+import threading
+
+import pytest
+
+from k8s_operator_libs_tpu.api import DriverUpgradePolicySpec
+from k8s_operator_libs_tpu.kube import FakeCluster, Node
+from k8s_operator_libs_tpu.kube.client import ConflictError
+from k8s_operator_libs_tpu.kube.sim import DaemonSetSimulator
+from k8s_operator_libs_tpu.upgrade import (
+    ClusterUpgradeStateManager,
+    DeviceClass,
+    StateOptions,
+    TaskRunner,
+    UpgradeKeys,
+    UpgradeState,
+)
+from k8s_operator_libs_tpu.upgrade.consts import NULL_STRING
+from k8s_operator_libs_tpu.upgrade.state_provider import (
+    NodeUpgradeStateProvider,
+)
+from k8s_operator_libs_tpu.upgrade.write_batch import (
+    WriteBatchError,
+    WriteBatcher,
+)
+from k8s_operator_libs_tpu.utils import IntOrString
+from k8s_operator_libs_tpu.utils.faultpoints import (
+    FaultAction,
+    clear_plan,
+    install_plan,
+)
+from builders import make_node
+
+DEVICE = DeviceClass.tpu()
+KEYS = UpgradeKeys(DEVICE)
+NS = "driver-ns"
+LABELS = {"app": "driver"}
+ANN = "example.com/upgrade-requested"
+
+POLICY = DriverUpgradePolicySpec(
+    auto_upgrade=True,
+    max_parallel_upgrades=0,
+    max_unavailable=IntOrString("100%"),
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_registry():
+    yield
+    clear_plan()
+
+
+class RecordingClient:
+    """Pass-through over FakeCluster that captures PATCH bodies —
+    the fake's call log records (verb, kind, name) only, and byte-
+    pinning the coalesced body needs the actual wire payload."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.patches = []       # (name, patch, patch_type)
+        self.patch_many_calls = []  # list of [(name, patch, patch_type)]
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def patch(self, kind, name, namespace="", patch=None,
+              patch_type="merge", **kw):
+        self.patches.append((name, patch, patch_type))
+        return self._inner.patch(
+            kind, name, namespace=namespace, patch=patch,
+            patch_type=patch_type, **kw
+        )
+
+    def patch_many(self, kind, patches, namespace="", **kw):
+        self.patch_many_calls.append(list(patches))
+        return self._inner.patch_many(
+            kind, patches, namespace=namespace, **kw
+        )
+
+
+def make_provider(client, **kw):
+    return NodeUpgradeStateProvider(client, KEYS, **kw)
+
+
+class TestCoalescedPatchBody:
+    def test_label_and_annotation_is_one_merge_patch(self):
+        """The headline coalescing pin: state + set + delete ride ONE
+        RFC 7386 merge PATCH, body byte-pinned."""
+        cluster = FakeCluster()
+        cluster.create(make_node("n1", annotations={"doomed": "x"}))
+        client = RecordingClient(cluster)
+        p = make_provider(client)
+        node = p.get_node("n1")
+        p.change_node_state_and_annotations(
+            node,
+            UpgradeState.CORDON_REQUIRED,
+            {ANN: "true", "doomed": NULL_STRING},
+        )
+        assert len(client.patches) == 1, (
+            f"expected ONE coalesced PATCH, saw {len(client.patches)}"
+        )
+        name, patch, patch_type = client.patches[0]
+        assert name == "n1"
+        assert patch_type == "merge"
+        assert patch == {
+            "metadata": {
+                "labels": {KEYS.state_label: "cordon-required"},
+                "annotations": {ANN: "true", "doomed": None},
+            }
+        }
+        stored = cluster.get("Node", "n1")
+        assert stored.labels[KEYS.state_label] == "cordon-required"
+        assert stored.annotations[ANN] == "true"
+        assert "doomed" not in stored.annotations
+        # One write issued, two extra keys coalesced onto it.
+        stats = p.write_stats()
+        assert stats["issued"] == 1
+        assert stats["coalesced"] == 2
+
+    def test_label_only_write_stays_strategic(self):
+        """The pure label write keeps the reference's strategic merge
+        patch shape — coalescing must not change the pre-existing wire
+        bytes of single-key writes."""
+        cluster = FakeCluster()
+        cluster.create(make_node("n1"))
+        client = RecordingClient(cluster)
+        p = make_provider(client)
+        p.change_node_upgrade_state(
+            p.get_node("n1"), UpgradeState.UPGRADE_REQUIRED
+        )
+        assert client.patches == [(
+            "n1",
+            {"metadata": {"labels": {KEYS.state_label: "upgrade-required"}}},
+            "strategic",
+        )]
+
+    def test_settled_keys_filtered_from_coalesced_body(self):
+        """Per-key no-op filtering: only keys that CHANGE appear in the
+        body; a fully settled write never reaches the wire."""
+        cluster = FakeCluster()
+        cluster.create(make_node(
+            "n1",
+            labels={KEYS.state_label: "cordon-required"},
+        ))
+        client = RecordingClient(cluster)
+        p = make_provider(client)
+        node = p.get_node("n1")
+        # State already settled -> only the annotation is in the body.
+        p.change_node_state_and_annotations(
+            node, UpgradeState.CORDON_REQUIRED, {ANN: "true"}
+        )
+        assert client.patches == [(
+            "n1", {"metadata": {"annotations": {ANN: "true"}}}, "merge",
+        )]
+        # Everything settled -> no PATCH at all.
+        p.change_node_state_and_annotations(
+            node, UpgradeState.CORDON_REQUIRED, {ANN: "true"}
+        )
+        assert len(client.patches) == 1
+        assert p.write_stats()["skipped"] == 1
+
+
+class TestNoOpSkipsBeforeBatching:
+    def test_settled_write_never_wakes_the_batcher(self):
+        """No-op coalescing sits UPSTREAM of the batching tier: a
+        settled key is answered from the in-memory node, it must not
+        stage (and block on) a batch flush."""
+        cluster = FakeCluster()
+        cluster.create(make_node("n1"))
+        batcher = WriteBatcher(cluster)
+        p = make_provider(cluster)
+        p.set_batcher(batcher)
+        node = p.get_node("n1")
+        p.change_node_upgrade_state(node, UpgradeState.CORDON_REQUIRED)
+        assert batcher.stats()["writes_flushed"] == 1
+        # The repeat is settled: skipped, and the batcher never consulted.
+        p.change_node_upgrade_state(node, UpgradeState.CORDON_REQUIRED)
+        assert batcher.stats() == {
+            "batches_flushed": 1, "writes_flushed": 1, "max_batch": 1,
+        }
+        stats = p.write_stats()
+        assert stats == {
+            "issued": 1, "skipped": 1, "coalesced": 0, "batched": 1,
+        }
+
+
+class TestDeadWatchNoReadBack:
+    @pytest.mark.parametrize("batched", [False, True])
+    def test_write_issues_zero_reads_with_informers_stopped(self, batched):
+        """The PR-4 dead-watch pattern, extended to the write path: with
+        the write-through wired, visibility comes from the PATCH
+        response — stop every informer (dead watch), write, and the
+        write must complete with ZERO Node reads AND be visible in the
+        next snapshot. A read-back poll regression fails both ways."""
+        cluster = FakeCluster()
+        for i in range(2):
+            cluster.create(make_node(f"node-{i}"))
+        sim = DaemonSetSimulator(
+            cluster, name="driver", namespace=NS, match_labels=LABELS
+        )
+        sim.settle()
+        mgr = ClusterUpgradeStateManager(
+            cluster,
+            DEVICE,
+            runner=TaskRunner(inline=True),
+            options=StateOptions(batch_writes=batched),
+        )
+        source = mgr.with_snapshot_from_informers(
+            NS, LABELS, resync_period_s=0.0
+        )
+        source.stop()  # watch dead; only the write-through can update it
+        node = Node(cluster.get("Node", "node-0").raw)
+        log = cluster.start_call_log()
+        try:
+            mgr.provider.change_node_upgrade_state(
+                node, UpgradeState.CORDON_REQUIRED
+            )
+            reads = [c for c in log if c[0] in ("get", "list")]
+            assert reads == [], (
+                f"write issued read-backs despite the write-through: {reads}"
+            )
+            assert [c[0] for c in log] == ["patch"]
+        finally:
+            cluster.stop_call_log()
+        assert (
+            source.nodes()["node-0"].labels[KEYS.state_label]
+            == "cordon-required"
+        )
+
+
+def _roll(width, batched, node_count=6):
+    """One full v2 roll; returns per-node state-label sequences as
+    observed by the cluster journal (the ground truth a watcher sees)."""
+    runner = (
+        TaskRunner(max_workers=width) if width > 1
+        else TaskRunner(inline=True)
+    )
+    cluster = FakeCluster()
+    for i in range(node_count):
+        cluster.create(make_node(f"node-{i}"))
+    sim = DaemonSetSimulator(
+        cluster, name="driver", namespace=NS, match_labels=LABELS
+    )
+    sim.settle()
+    mgr = ClusterUpgradeStateManager(
+        cluster,
+        DEVICE,
+        runner=runner,
+        options=StateOptions(apply_width=width, batch_writes=batched),
+    )
+    transitions = {}
+    lock = threading.Lock()
+
+    def record(event, obj, old):
+        if obj.get("kind") != "Node":
+            return
+        label = (obj["metadata"].get("labels") or {}).get(KEYS.state_label)
+        old_label = (
+            ((old or {}).get("metadata") or {}).get("labels") or {}
+        ).get(KEYS.state_label)
+        if label != old_label:
+            with lock:
+                transitions.setdefault(
+                    obj["metadata"]["name"], []
+                ).append(label)
+
+    cluster.subscribe(record)
+    sim.set_template_hash("v2")
+    for _ in range(80):
+        sim.step()
+        mgr.apply_state(mgr.build_state(NS, LABELS), POLICY)
+        sim.step()
+        done = all(
+            Node(cluster.get("Node", f"node-{i}").raw).labels.get(
+                KEYS.state_label
+            ) == "upgrade-done"
+            for i in range(node_count)
+        )
+        if done and sim.all_pods_ready_and_current():
+            break
+    else:
+        raise AssertionError(
+            f"width={width} batched={batched} roll did not converge"
+        )
+    if width > 1:
+        runner.wait_idle(timeout=10)
+        runner.shutdown()
+    if batched:
+        flush_stats = mgr.enable_write_batching().stats()
+        assert flush_stats["writes_flushed"] > 0, (
+            "batched roll never flushed through the batcher"
+        )
+    return transitions
+
+
+class TestTerminalSequencesWithBatching:
+    """Batching changes the wire shape (fewer round trips), never the
+    semantics: the per-node state-label sequence of a full roll is
+    IDENTICAL with batching on and off — at serial width and fanned out."""
+
+    def test_identical_at_width_1(self):
+        serial = _roll(width=1, batched=False)
+        batched = _roll(width=1, batched=True)
+        assert set(serial) == set(batched)
+        for name in serial:
+            assert serial[name] == batched[name], (
+                f"{name}: {serial[name]} != {batched[name]}"
+            )
+
+    def test_identical_at_width_8(self):
+        serial = _roll(width=1, batched=False)
+        batched = _roll(width=8, batched=True)
+        assert set(serial) == set(batched)
+        for name in serial:
+            assert serial[name] == batched[name], (
+                f"{name}: {serial[name]} != {batched[name]}"
+            )
+
+
+class _TargetedPartialPlan:
+    """A minimal chaos plan: fail exactly the named node's slot at the
+    ``upgrade.write_batch_partial`` point, once."""
+
+    def __init__(self, node):
+        self.node = node
+        self.fired = 0
+
+    def consult(self, point, ctx):
+        if point == "upgrade.write_batch_partial" and (
+            ctx.get("node") == self.node
+        ):
+            self.fired += 1
+            return FaultAction(
+                kind="raise",
+                exc=ConflictError(f"injected conflict on {self.node}"),
+            )
+        return None
+
+
+class _GateClient:
+    """patch_many blocks until released, then optionally explodes —
+    lets a test park a leader mid-flush while followers stage."""
+
+    def __init__(self, inner, explode=False):
+        self._inner = inner
+        self.explode = explode
+        self.entered = threading.Event()
+        self.release = threading.Event()
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def patch_many(self, kind, patches, namespace="", **kw):
+        self.entered.set()
+        assert self.release.wait(10), "gate never released"
+        if self.explode:
+            raise RuntimeError("leader flush exploded")
+        return self._inner.patch_many(
+            kind, patches, namespace=namespace, **kw
+        )
+
+
+class TestWriteBatcherUnit:
+    def test_single_threaded_degenerates_to_batches_of_one(self):
+        """The self-clocking contract: with no concurrency every stage
+        is leader of its own batch — byte-equal to the serial path,
+        which is what keeps chaos schedules deterministic."""
+        cluster = FakeCluster()
+        for i in range(3):
+            cluster.create(make_node(f"n{i}"))
+        batcher = WriteBatcher(cluster)
+        for i in range(3):
+            out = batcher.stage(
+                "Node", f"n{i}",
+                {"metadata": {"labels": {"k": f"v{i}"}}},
+            )
+            assert out.labels["k"] == f"v{i}"
+        assert batcher.stats() == {
+            "batches_flushed": 3, "writes_flushed": 3, "max_batch": 1,
+        }
+
+    def test_partial_batch_fault_isolates_to_one_slot(self):
+        """The ``write_batch_partial`` chaos point: one slot's injected
+        Conflict surfaces to THAT caller only; batchmates land, and the
+        failed slot never reaches the wire."""
+        cluster = FakeCluster()
+        for name in ("good-0", "bad", "good-1"):
+            cluster.create(make_node(name))
+        plan = _TargetedPartialPlan("bad")
+        install_plan(plan)
+        gate = _GateClient(cluster)
+        batcher = WriteBatcher(gate)
+        results = {}
+
+        def stage(name):
+            try:
+                results[name] = batcher.stage(
+                    "Node", name, {"metadata": {"labels": {"k": "v"}}}
+                )
+            except BaseException as e:
+                results[name] = e
+
+        # Park a throwaway leader in the gate so the three interesting
+        # writes accumulate into ONE pending batch.
+        cluster.create(make_node("decoy"))
+        leader = threading.Thread(target=stage, args=("decoy",))
+        leader.start()
+        assert gate.entered.wait(5)
+        threads = [
+            threading.Thread(target=stage, args=(name,))
+            for name in ("good-0", "bad", "good-1")
+        ]
+        for t in threads:
+            t.start()
+        for _ in range(1000):
+            with batcher._lock:
+                if len(batcher._pending) == 3:
+                    break
+            threading.Event().wait(0.002)
+        gate.release.set()
+        leader.join(10)
+        for t in threads:
+            t.join(10)
+        assert isinstance(results["bad"], ConflictError)
+        for name in ("good-0", "good-1"):
+            assert results[name].labels["k"] == "v", results[name]
+        assert plan.fired == 1
+        # The faulted slot never hit the apiserver.
+        assert "k" not in cluster.get("Node", "bad").labels
+        # Counters: the 3-batch flushed 2 live writes.
+        stats = batcher.stats()
+        assert stats["max_batch"] == 3
+        assert stats["writes_flushed"] == 3  # decoy + the two survivors
+
+    def test_leader_death_resolves_followers_loudly(self):
+        """A follower must never hang on a dead leader: when the flush
+        itself explodes, the leader re-raises the real error and every
+        staged follower gets a WriteBatchError — ambiguous outcome,
+        same contract as a wire error."""
+        cluster = FakeCluster()
+        cluster.create(make_node("a"))
+        cluster.create(make_node("b"))
+        gate = _GateClient(cluster, explode=True)
+        batcher = WriteBatcher(gate)
+        results = {}
+
+        def stage(name):
+            try:
+                results[name] = batcher.stage(
+                    "Node", name, {"metadata": {"labels": {"k": "v"}}}
+                )
+            except BaseException as e:
+                results[name] = e
+
+        leader = threading.Thread(target=stage, args=("a",))
+        leader.start()
+        assert gate.entered.wait(5)
+        follower = threading.Thread(target=stage, args=("b",))
+        follower.start()
+        for _ in range(1000):
+            with batcher._lock:
+                if len(batcher._pending) == 1:
+                    break
+            threading.Event().wait(0.002)
+        gate.release.set()
+        leader.join(10)
+        follower.join(10)
+        assert isinstance(results["a"], RuntimeError)
+        assert isinstance(results["b"], WriteBatchError)
+        # The batcher healed: the next stage elects a fresh leader.
+        gate.explode = False
+        gate.release.set()
+        out = batcher.stage(
+            "Node", "b", {"metadata": {"labels": {"k": "v"}}}
+        )
+        assert out.labels["k"] == "v"
+
+    def test_fifo_order_across_batches(self):
+        """Stage order is flush order, even when writes span batches —
+        two same-node writes staged in order must be applied in order."""
+        cluster = FakeCluster()
+        cluster.create(make_node("n1"))
+        applied = []
+        real_patch_many = cluster.patch_many
+
+        class Spy:
+            def __getattr__(self, name):
+                return getattr(cluster, name)
+
+            def patch_many(self, kind, patches, namespace="", **kw):
+                applied.extend(name for name, _, _ in patches)
+                return real_patch_many(
+                    kind, patches, namespace=namespace, **kw
+                )
+
+        batcher = WriteBatcher(Spy(), max_batch=2)
+        for i in range(5):
+            batcher.stage(
+                "Node", "n1", {"metadata": {"labels": {"seq": str(i)}}}
+            )
+        assert applied == ["n1"] * 5
+        assert cluster.get("Node", "n1").labels["seq"] == "4"
+
+    def test_provider_rolls_back_in_memory_on_flush_failure(self):
+        """The batched provider path applies optimistically under the
+        mutex; a failed flush must restore the caller's node so the
+        in-memory single-writer view never lies about the apiserver."""
+        cluster = FakeCluster()
+        cluster.create(make_node("n1"))
+        plan = _TargetedPartialPlan("n1")
+        install_plan(plan)
+        p = make_provider(cluster)
+        p.set_batcher(WriteBatcher(cluster))
+        node = p.get_node("n1")
+        with pytest.raises(ConflictError):
+            p.change_node_upgrade_state(node, UpgradeState.CORDON_REQUIRED)
+        assert KEYS.state_label not in node.labels
+        assert KEYS.state_label not in cluster.get("Node", "n1").labels
+        # And the write is retryable once chaos clears.
+        clear_plan()
+        p.change_node_upgrade_state(node, UpgradeState.CORDON_REQUIRED)
+        assert (
+            cluster.get("Node", "n1").labels[KEYS.state_label]
+            == "cordon-required"
+        )

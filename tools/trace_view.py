@@ -37,7 +37,7 @@ from typing import Any, Iterable, Optional
 #: literal here so the tool reads exports from any build).
 KNOWN_CATEGORIES = (
     "grant", "lease", "reconcile", "wire", "queue", "drain",
-    "checkpoint", "probe",
+    "checkpoint", "probe", "write",
 )
 
 
