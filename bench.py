@@ -2838,6 +2838,85 @@ def run_chaos_smoke(
     }
 
 
+def run_policy_matrix(
+    pools: int = 64, workers: int = 2, shards: int = 4
+) -> dict:
+    """ISSUE 17 — verified policy plugins on a 64-pool fleet roll
+    (docs/policy-plugins.md): the same fault-free deterministic
+    schedule (chaos harness with zero faults drawn — the virtual-clock
+    fleet e2e, not a wall-clock rig) rolled once per headline
+    composition: the default policy, the maintenance-window plugin
+    (registry default full-day windows — the no-op configuration CI
+    can assert against), and the cost-tier plugin. Hard-asserted:
+    ZERO budget violations in every cell (no registered composition
+    may widen a disruption past the grant budget — the floor at
+    tools/bench_smoke_baseline.json pins it), every cell converges,
+    and the plugin cells pay no more steps than the default (shipped
+    plugins inherit DefaultPolicy: at least as strict, never wider).
+    ``default_passes_per_s`` (worker reconcile passes over the
+    default-policy roll) is floored in the baseline within tolerance
+    of the PR 16 fleet figures."""
+    from k8s_operator_libs_tpu.policy import for_spec
+    from k8s_operator_libs_tpu.testing.chaos import (
+        ChaosConfig,
+        run_seed,
+    )
+
+    started = time.perf_counter()
+    compositions = (
+        ("default",),
+        ("maintenance-window",),
+        ("cost-tiers",),
+    )
+    cells = {}
+    for comp in compositions:
+        # Resolve through the registry first: a bench cell running an
+        # unregistered name would measure a stack trace.
+        for_spec(comp)
+        cfg = ChaosConfig(
+            pools=pools, workers=workers, shards=shards,
+            faults_min=0, faults_max=0, policy=comp,
+        )
+        result = run_seed(0, cfg)
+        if result.total_violations:
+            raise RuntimeError(
+                f"policy_matrix: composition {'+'.join(comp)} violated "
+                f"invariants: {result.violations}"
+            )
+        if not result.converged:
+            raise RuntimeError(
+                f"policy_matrix: composition {'+'.join(comp)} did not "
+                "converge"
+            )
+        cells["+".join(comp)] = {
+            "steps": result.steps,
+            "budget_violations": result.violations["budget"],
+            "passes_per_s": round(
+                result.steps * workers / result.wall_s, 2
+            ) if result.wall_s else 0.0,
+            "wall_s": round(result.wall_s, 3),
+        }
+    default_cell = cells["default"]
+    for name, cell in cells.items():
+        if cell["steps"] > default_cell["steps"]:
+            raise RuntimeError(
+                f"policy_matrix: {name} took {cell['steps']} steps vs "
+                f"default's {default_cell['steps']} — a shipped plugin "
+                "widened the roll instead of tightening it"
+            )
+    return {
+        "pools": pools,
+        "workers": workers,
+        "compositions": len(cells),
+        "budget_violations": max(
+            c["budget_violations"] for c in cells.values()
+        ),
+        "default_passes_per_s": default_cell["passes_per_s"],
+        "wall_s": round(time.perf_counter() - started, 3),
+        **cells,
+    }
+
+
 def run_write_batching(
     slices: int = 16,
     hosts_per_slice: int = 4,
@@ -3331,6 +3410,7 @@ SECTIONS = {
     "trace_attribution_report": run_trace_attribution_report,
     "report_storm": run_report_storm,
     "chaos_smoke": run_chaos_smoke,
+    "policy_matrix": run_policy_matrix,
     "ring_bandwidth": run_ring_bandwidth,
     "http_wire_roll": run_http_wire_roll,
     "wire_encoding": run_wire_encoding,

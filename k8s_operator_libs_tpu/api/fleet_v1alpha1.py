@@ -56,7 +56,16 @@ DEFAULT_MAX_UNAVAILABLE_POOLS = "25%"
 class FleetRolloutSpec:
     """Parsed + validated spec. ``pools`` is the explicit roll set —
     the orchestrator never discovers pools on its own (an operator must
-    not silently widen a rollout because a node grew a label)."""
+    not silently widen a rollout because a node grew a label).
+
+    A spec pool entry is either a plain name (the pre-policy wire
+    shape, still the canonical serialization) or a mapping
+    ``{"name": ..., "policy": [...]}`` selecting a per-pool
+    policy-plugin composition by registry name
+    (docs/policy-plugins.md). The parsed form keeps ``pools`` as plain
+    names — every existing consumer iterates names — with the policy
+    selections alongside in ``pool_policies``.
+    """
 
     pools: list[str] = field(default_factory=list)
     #: None = unlimited (every pool may be in flight at once — the
@@ -64,6 +73,9 @@ class FleetRolloutSpec:
     max_unavailable_pools: Optional[IntOrString] = field(
         default_factory=lambda: IntOrString(DEFAULT_MAX_UNAVAILABLE_POOLS)
     )
+    #: pool -> policy composition (registry names, first = most
+    #: significant). Pools absent here run the "default" policy.
+    pool_policies: dict[str, tuple[str, ...]] = field(default_factory=dict)
 
     def __post_init__(self) -> None:
         if not self.pools:
@@ -73,6 +85,21 @@ class FleetRolloutSpec:
                              "non-empty strings")
         if len(set(self.pools)) != len(self.pools):
             raise ValueError("FleetRollout spec.pools must not repeat a pool")
+        self.pool_policies = {
+            pool: tuple(names)
+            for pool, names in self.pool_policies.items()
+            if names
+        }
+        unknown = sorted(set(self.pool_policies) - set(self.pools))
+        if unknown:
+            raise ValueError(
+                "FleetRollout spec names a policy for pool(s) outside "
+                f"the roll set: {unknown!r}"
+            )
+
+    def policy_for(self, pool: str) -> tuple[str, ...]:
+        """The pool's policy composition; empty = default policy."""
+        return self.pool_policies.get(pool, ())
 
     def resolved_budget(self) -> int:
         """The global budget in POOL units, scaled against the roll set
@@ -88,7 +115,17 @@ class FleetRolloutSpec:
         return max(1, min(scaled, total))
 
     def to_dict(self) -> dict[str, Any]:
-        out: dict[str, Any] = {"pools": list(self.pools)}
+        # A pool with a policy serializes as the mapping entry; plain
+        # pools stay plain strings, so a policy-free spec round-trips
+        # to the exact pre-policy JSON.
+        out: dict[str, Any] = {
+            "pools": [
+                {"name": p, "policy": list(self.pool_policies[p])}
+                if p in self.pool_policies
+                else p
+                for p in self.pools
+            ]
+        }
         out["maxUnavailablePools"] = (
             self.max_unavailable_pools.value
             if self.max_unavailable_pools is not None
@@ -106,9 +143,21 @@ class FleetRolloutSpec:
             max_unavailable = IntOrString.parse(raw) if raw is not None else None
         else:
             max_unavailable = IntOrString(DEFAULT_MAX_UNAVAILABLE_POOLS)
+        pools: list[str] = []
+        pool_policies: dict[str, tuple[str, ...]] = {}
+        for entry in d.get("pools") or []:
+            if isinstance(entry, Mapping):
+                name = entry.get("name")
+                pools.append(name if isinstance(name, str) else "")
+                names = tuple(entry.get("policy") or ())
+                if names and isinstance(name, str):
+                    pool_policies[name] = names
+            else:
+                pools.append(entry)
         return FleetRolloutSpec(
-            pools=list(d.get("pools") or []),
+            pools=pools,
             max_unavailable_pools=max_unavailable,
+            pool_policies=pool_policies,
         )
 
 
@@ -116,6 +165,7 @@ def make_fleet_rollout(
     name: str,
     pools: list[str],
     max_unavailable_pools: Any = DEFAULT_MAX_UNAVAILABLE_POOLS,
+    pool_policies: Optional[Mapping[str, Any]] = None,
 ) -> dict[str, Any]:
     """Raw FleetRollout object (validated through the spec dataclass)."""
     spec = FleetRolloutSpec(
@@ -125,6 +175,10 @@ def make_fleet_rollout(
             if max_unavailable_pools is not None
             else None
         ),
+        pool_policies={
+            pool: tuple(names)
+            for pool, names in (pool_policies or {}).items()
+        },
     )
     return {
         "apiVersion": FLEET_ROLLOUT_API_VERSION,
@@ -154,12 +208,27 @@ def pool_phase(raw: Mapping[str, Any], pool: str) -> str:
     return phase if phase in POOL_PHASES else POOL_PENDING
 
 
+def spec_pool_names(raw: Mapping[str, Any]) -> list[str]:
+    """Spec pool names in spec order, tolerating both wire shapes (a
+    plain name or a ``{"name": ..., "policy": [...]}`` entry)."""
+    out = []
+    for entry in (raw.get("spec") or {}).get("pools") or []:
+        if isinstance(entry, Mapping):
+            name = entry.get("name")
+            if isinstance(name, str):
+                out.append(name)
+        else:
+            out.append(entry)
+    return out
+
+
 def pools_in_phase(raw: Mapping[str, Any], phase: str) -> list[str]:
     """Spec pools currently in ``phase``, in spec order. Keyed off the
     SPEC (not the status map) so a stale status entry for a pool no
     longer in the roll set can never count against the budget."""
-    spec_pools = (raw.get("spec") or {}).get("pools") or []
-    return [p for p in spec_pools if pool_phase(raw, p) == phase]
+    return [
+        p for p in spec_pool_names(raw) if pool_phase(raw, p) == phase
+    ]
 
 
 def set_pool_phase(

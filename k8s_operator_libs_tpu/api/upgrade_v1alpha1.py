@@ -340,9 +340,21 @@ class DriverUpgradePolicySpec:
     drain: Optional[DrainSpec] = None
     checkpoint: Optional[CheckpointSpec] = None
     quarantine: Optional[QuarantineSpec] = None
+    #: Policy-plugin composition (docs/policy-plugins.md): registry
+    #: names, applied in order (first = most significant). Empty means
+    #: the "default" policy — the pre-plugin behavior, byte-identical.
+    #: Validated against the registry at composition time (the spec
+    #: layer stays kube-shaped and registry-free).
+    policy: tuple[str, ...] = ()
 
     def __post_init__(self) -> None:
         _require_non_negative("maxParallelUpgrades", self.max_parallel_upgrades)
+        object.__setattr__(self, "policy", tuple(self.policy))
+        if any(not n or not isinstance(n, str) for n in self.policy):
+            raise ValueError(
+                "policy entries must be non-empty registry names, got "
+                f"{self.policy!r}"
+            )
 
     def resolved_max_unavailable(self, total_nodes: int) -> int:
         """Scale ``max_unavailable`` against the cluster size, rounding up,
@@ -387,6 +399,7 @@ class DriverUpgradePolicySpec:
                 if d.get("quarantine") is not None
                 else None
             ),
+            policy=tuple(d.get("policy") or ()),
         )
 
     def to_dict(self) -> dict[str, Any]:
@@ -411,4 +424,8 @@ class DriverUpgradePolicySpec:
             out["checkpoint"] = self.checkpoint.to_dict()
         if self.quarantine is not None:
             out["quarantine"] = self.quarantine.to_dict()
+        # Omitted when empty: a default-policy spec round-trips to the
+        # exact pre-plugin JSON (byte-stability the wire tests pin).
+        if self.policy:
+            out["policy"] = list(self.policy)
         return out

@@ -145,19 +145,40 @@ class FleetHealthAggregator:
             out[pool] = (score, trend)
         return out
 
-    def ordered(self, pools: Iterable[str]) -> list[str]:
-        """``pools`` in degraded-first order: ascending worst-member
-        score (no telemetry = fully healthy 100), degrading trend
-        breaking score ties, then name — the planner's
-        ``ordered_candidates`` key (tpu/planner.py), applied at pool
-        grain."""
+    def candidate_views(self, pools: Iterable[str]) -> list[Any]:
+        """Each pool reduced to the policy view: worst-member score and
+        trend from the fold (no telemetry = fully healthy 100), the
+        cost tier parsed from the pool name. ``disrupted`` is uniformly
+        False — granted pools never re-enter the pending set, so the
+        default plugin's disrupted-first key component is constant here
+        and the pool order stays the pre-plugin ``(score, trend,
+        pool)`` byte-identically."""
+        from ..policy import CandidateView, tier_of
+
         health = self.pool_health()
+        return [
+            CandidateView(
+                name=pool,
+                score=health.get(pool, (100.0, 0))[0],
+                trend=health.get(pool, (100.0, 0))[1],
+                tier=tier_of(pool),
+            )
+            for pool in pools
+        ]
 
-        def key(pool: str):
-            score, trend = health.get(pool, (100.0, 0))
-            return (score, trend, pool)
+    def ordered(
+        self, pools: Iterable[str], plugin: Optional[Any] = None
+    ) -> list[str]:
+        """``pools`` in degraded-first order, delegated to the policy
+        plugin's ``order`` (docs/policy-plugins.md): the default keys
+        on ascending worst-member score, degrading trend breaking
+        score ties, then name — the planner's ``ordered_candidates``
+        key (tpu/planner.py), applied at pool grain."""
+        from ..policy import for_spec
 
-        return sorted(pools, key=key)
+        if plugin is None:
+            plugin = for_spec(())
+        return [view.name for view in plugin.order(self.candidate_views(pools))]
 
 
 class FleetOrchestrator:
@@ -176,10 +197,17 @@ class FleetOrchestrator:
         client: Client,
         rollout_name: str,
         aggregator: Optional[FleetHealthAggregator] = None,
+        policy: Sequence[str] = (),
     ) -> None:
         self.client = client
         self.rollout_name = rollout_name
         self.aggregator = aggregator
+        #: Rollout-level policy composition (registry names,
+        #: docs/policy-plugins.md) ordering the pending queue and
+        #: gating grants; a pool with its own ``spec.pools[].policy``
+        #: entry overrides it for that pool's admit. Empty = default
+        #: policy, byte-identical to the pre-plugin grant behavior.
+        self.policy = tuple(policy)
         #: Pools granted by THIS instance, in grant order — bench/debug
         #: introspection (the durable record is the CR's grantedSeq).
         self.grant_order: list[str] = []
@@ -241,8 +269,11 @@ class FleetOrchestrator:
 
     def _grant_round(self) -> dict[str, Any]:
         from ..kube.client import retry_on_conflict
+        from ..policy import BudgetView, CandidateView, for_spec, tier_of
+        from ..utils.faultpoints import wall_now
 
         summary: dict[str, Any] = {}
+        plugin = for_spec(self.policy)
 
         def attempt() -> None:
             obj = self.client.get_or_none(FLEET_ROLLOUT_KIND, self.rollout_name)
@@ -257,12 +288,54 @@ class FleetOrchestrator:
             pending = pools_in_phase(raw, POOL_PENDING)
             budget = spec.resolved_budget()
             slots = budget - len(granted)
-            order = (
-                self.aggregator.ordered(pending)
-                if self.aggregator is not None
-                else sorted(pending)
+            if self.aggregator is not None:
+                order = self.aggregator.ordered(pending, plugin=plugin)
+            else:
+                # No health fold wired: every view reads fully healthy,
+                # so the default plugin's order is plain name order —
+                # the pre-plugin ``sorted(pending)`` byte-identically.
+                order = [
+                    view.name
+                    for view in plugin.order(
+                        [
+                            CandidateView(name=pool, tier=tier_of(pool))
+                            for pool in pending
+                        ]
+                    )
+                ]
+            # Per-grant admission (docs/policy-plugins.md): a pool with
+            # its own spec.pools[].policy composition overrides the
+            # rollout-level one for its OWN gate. The default admit is
+            # unconditional, so a policy-free rollout grants exactly
+            # the pre-plugin prefix order[:slots].
+            view = BudgetView(
+                total=len(spec.pools),
+                in_progress=len(granted),
+                unavailable=len(granted),
+                candidates=len(pending),
+                max_parallel=0,
+                max_unavailable=budget,
+                now=wall_now(),
             )
-            grants = order[: max(0, slots)] if pending else []
+            grants: list[str] = []
+            for pool in order:
+                if len(grants) >= max(0, slots):
+                    break
+                gate = (
+                    for_spec(spec.policy_for(pool))
+                    if spec.policy_for(pool)
+                    else plugin
+                )
+                decision = gate.admit(
+                    CandidateView(name=pool, tier=tier_of(pool)), view
+                )
+                if not decision.allowed:
+                    log.info(
+                        "fleet orchestrator: pool %s refused by policy "
+                        "%s: %s", pool, gate.name, decision.reason,
+                    )
+                    continue
+                grants.append(pool)
             denied = len(pending) - len(grants)
             summary.clear()
             summary.update(

@@ -370,11 +370,20 @@ class ShardWorker:
                 # which would silently bypass grant gating — every pool
                 # would start at once and the global budget would hold
                 # nothing. Refuse loudly instead of disrupting a fleet.
-                raise ValueError(
-                    "fleet grant gating (rollout_name) does not compose "
-                    "with requestor/maintenance-operator mode yet; run "
-                    "fleet workers in in-place mode"
+                # The two modes are registered policies with a declared
+                # conflict (policy/registry.py CONFLICTS), so the
+                # registry's composition validator is the one place the
+                # refusal — and its typed PolicyCompositionError naming
+                # the clashing policies — lives.
+                from ..policy import validate_composition
+
+                validate_composition(
+                    ("fleet-grant-gate", "requestor-delegation")
                 )
+                raise AssertionError(
+                    "policy registry failed to refuse fleet-grant-gate "
+                    "+ requestor-delegation"
+                )  # pragma: no cover — validate_composition raises
             self.mgr.inplace = GrantGatedInplaceManager(
                 self.mgr.common, self._pool_of, self.granted_pools
             )

@@ -1,0 +1,63 @@
+"""Verified policy plugins (docs/policy-plugins.md).
+
+Public surface of the policy tier: the pure-function protocol and its
+frozen views (:mod:`.api`), the explicit registry + composition
+combinator (:mod:`.registry`), the byte-identical default
+(:mod:`.defaults`), and the shipped plugins (:mod:`.plugins`).
+Importing this package registers every shipped policy — call sites
+resolve a spec's composition with :func:`for_spec` and never touch the
+classes directly.
+"""
+
+from .api import (
+    ALLOW,
+    DEFAULT_TIER,
+    Budget,
+    BudgetView,
+    CandidateView,
+    Decision,
+    UpgradePolicy,
+    tier_of,
+)
+from .defaults import DEFAULT_POLICY_NAME, DefaultPolicy
+from .plugins import (
+    CostTierPolicy,
+    FleetGrantGatePolicy,
+    MaintenanceWindowPolicy,
+    RequestorDelegationPolicy,
+)
+from .registry import (
+    CONFLICTS,
+    PolicyCompositionError,
+    compose,
+    for_spec,
+    register_policy,
+    registered_policies,
+    standard_compositions,
+    validate_composition,
+)
+
+__all__ = [
+    "ALLOW",
+    "DEFAULT_TIER",
+    "Budget",
+    "BudgetView",
+    "CandidateView",
+    "Decision",
+    "UpgradePolicy",
+    "tier_of",
+    "DEFAULT_POLICY_NAME",
+    "DefaultPolicy",
+    "CostTierPolicy",
+    "FleetGrantGatePolicy",
+    "MaintenanceWindowPolicy",
+    "RequestorDelegationPolicy",
+    "CONFLICTS",
+    "PolicyCompositionError",
+    "compose",
+    "for_spec",
+    "register_policy",
+    "registered_policies",
+    "standard_compositions",
+    "validate_composition",
+]

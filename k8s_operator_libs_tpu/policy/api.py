@@ -1,0 +1,145 @@
+"""Policy-plugin contract: pure functions over frozen snapshot views.
+
+The paper's state machine is only as trustworthy as its budget math,
+and before this package that math was hardcoded in three places
+(``upgrade/common_manager.py`` admission, ``tpu/planner.py`` slice
+ordering, ``fleet/orchestrator.py`` grant ordering) — every new
+customer scenario was a fork, not a plugin (ROADMAP item 3). NCCLbpf
+(PAPERS.md) shows the winning shape: policies ship as small composable
+programs that a *verifier* proves safe before they ever run. The
+verifier here is the POL7xx analyzer family
+(``tools/analyze/policy_discipline.py``, docs/policy-plugins.md); this
+module is the contract it verifies:
+
+* every policy method is a **pure function of its view arguments** —
+  no client/provider calls, no clock, no RNG (POL701), no cross-call
+  state on ``self`` or module globals (POL703);
+* the views are **frozen dataclasses** built by the calling tier from
+  its already-held snapshot — a policy cannot read the cluster, only
+  the slice of it the caller froze for it;
+* nondeterministic inputs a policy legitimately needs (wall time, for
+  maintenance windows) are *injected through the view* (``BudgetView
+  .now``) so the policy itself stays replayable.
+
+The same three methods serve all three tiers; only the meaning of a
+"candidate" changes with the grain: a node (upgrade tier), a slice
+(TPU planner tier), a pool (fleet tier).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Protocol, Sequence, runtime_checkable
+
+
+@dataclass(frozen=True)
+class Decision:
+    """An admit verdict. ``reason`` is operator-facing and only
+    meaningful on a deny — the log line that answers "why did this
+    candidate not start this pass"."""
+
+    allowed: bool
+    reason: str = ""
+
+
+#: The unconditional admit — policies with no per-candidate opinion
+#: return this singleton.
+ALLOW = Decision(True)
+
+
+@dataclass(frozen=True)
+class Budget:
+    """The budget verdict: how many fresh disruptions this pass may
+    start, and the resolved unavailability cap that produced it (the
+    cap is runtime information — percent policies scale against the
+    pool — that the planner log must carry for slots=0 debugging)."""
+
+    available: int
+    max_unavailable: int
+
+
+@dataclass(frozen=True)
+class BudgetView:
+    """Frozen budget inputs, in the calling tier's units (nodes for the
+    upgrade tier, slices for the planner, pools for the fleet).
+
+    ``now`` is the one legitimately nondeterministic input: wall-clock
+    seconds injected by the CALLER (``utils.faultpoints.wall_now`` in
+    production, the virtual chaos clock under test) so a clock-aware
+    policy (maintenance windows) never calls ``time`` itself — that
+    would fire POL701 and break chaos replay.
+    """
+
+    total: int
+    in_progress: int
+    unavailable: int
+    candidates: int
+    max_parallel: int
+    max_unavailable: int
+    now: float = 0.0
+
+
+@dataclass(frozen=True)
+class CandidateView:
+    """One orderable/admittable unit: a node, a slice, or a pool,
+    reduced to the health facts every tier already derives. ``tier``
+    is the rollout class for cost/priority policies — parsed from the
+    candidate name by :func:`tier_of` at view-build time so the policy
+    itself stays a pure function of the view."""
+
+    name: str
+    score: float = 100.0
+    trend: int = 0
+    disrupted: bool = False
+    tier: int = 0
+
+
+#: Rollout-class prefix: candidates named ``tier<k>-...`` belong to
+#: cost/priority class ``k`` (lower rolls first under the tiered
+#: policy); anything else is class DEFAULT_TIER (after every explicit
+#: class).
+DEFAULT_TIER = 1_000_000
+
+
+def tier_of(name: str) -> int:
+    """Parse the rollout class from a candidate name. Pure string math
+    — view-construction helper, also usable inside policies."""
+    if name.startswith("tier"):
+        digits = ""
+        for ch in name[4:]:
+            if ch.isdigit():
+                digits += ch
+            else:
+                break
+        if digits and len(name) > 4 + len(digits) and name[4 + len(digits)] == "-":
+            return int(digits)
+    return DEFAULT_TIER
+
+
+@runtime_checkable
+class UpgradePolicy(Protocol):
+    """The plugin protocol. Implementations MUST be pure: every method
+    a deterministic function of its arguments (the POL7xx analyzer
+    proves this statically; the chaos ``policy_matrix`` corpus proves
+    the composed behavior dynamically — docs/policy-plugins.md)."""
+
+    #: Registry name (set by ``@register_policy``).
+    name: str
+
+    def admit(self, candidate: CandidateView, view: BudgetView) -> Decision:
+        """Per-candidate gate: may THIS candidate start a disruption
+        under THIS budget view? Must return a Decision on every path
+        (POL705)."""
+        ...
+
+    def order(
+        self, candidates: Sequence[CandidateView]
+    ) -> list[CandidateView]:
+        """Roll order, most-urgent first. Must be a stable reordering
+        of ``candidates`` (stability is what makes lexicographic
+        composition well-defined)."""
+        ...
+
+    def budget(self, view: BudgetView) -> Budget:
+        """How many fresh disruptions this pass may start."""
+        ...

@@ -1,0 +1,69 @@
+"""The default policy: today's hardcoded behavior, verbatim.
+
+``DefaultPolicy`` reproduces — byte-identically, pinned by the
+terminal-sequence-identity fuzzer at widths 1 and 8
+(tests/test_incremental_state.py) — the math the three tiers carried
+inline before the plugin refactor:
+
+* :meth:`DefaultPolicy.budget` is ``GetUpgradesAvailable``
+  (reference: common_manager.go:748-776): parallel-slot limit, then
+  the unavailability clamp counting units already unavailable plus
+  units about to be disrupted;
+* :meth:`DefaultPolicy.order` is the degraded-first key
+  (ISSUE 8; Guard, PAPERS.md): already-disrupted first, then
+  ascending health score, degrading trend breaking ties, then name —
+  ``SliceAssessment.ordered_candidates`` at slice grain,
+  ``FleetHealthAggregator.ordered`` at pool grain (where every
+  candidate is built ``disrupted=False`` so the first key component
+  is constant and the pool key ``(score, trend, pool)`` survives
+  unchanged);
+* :meth:`DefaultPolicy.admit` is the unconditional ALLOW — the
+  pre-plugin tiers had no per-candidate gate.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from .api import ALLOW, Budget, BudgetView, CandidateView, Decision
+from .registry import register_policy
+
+#: The registry name every empty policy spec resolves to. The
+#: registration below spells the literal out — POL704's
+#: registration-completeness check (and the registry's explicitness
+#: convention) only recognizes literal names.
+DEFAULT_POLICY_NAME = "default"
+
+
+@register_policy("default")
+class DefaultPolicy:
+    """Pre-plugin behavior as a plugin (see module docstring)."""
+
+    def admit(self, candidate: CandidateView, view: BudgetView) -> Decision:
+        return ALLOW
+
+    def order(
+        self, candidates: Sequence[CandidateView]
+    ) -> list[CandidateView]:
+        return sorted(
+            candidates,
+            key=lambda c: (not c.disrupted, c.score, c.trend, c.name),
+        )
+
+    def budget(self, view: BudgetView) -> Budget:
+        if view.max_parallel == 0:
+            available = view.candidates
+        else:
+            available = view.max_parallel - view.in_progress
+        if available > view.max_unavailable:
+            available = view.max_unavailable
+        if view.unavailable >= view.max_unavailable:
+            available = 0
+        elif (
+            view.max_unavailable < view.total
+            and view.unavailable + available > view.max_unavailable
+        ):
+            available = view.max_unavailable - view.unavailable
+        return Budget(
+            available=available, max_unavailable=view.max_unavailable
+        )

@@ -1,0 +1,101 @@
+"""Shipped non-default policies, each through the full gauntlet:
+POL-verified (tools/analyze/policy_discipline.py), fuzzer-proven
+(tests/test_incremental_state.py plugin-composition mode), and
+interleaving-proven (chaos ``policy_matrix`` corpus). Every plugin
+inherits :class:`DefaultPolicy`, so it is at least as strict as the
+pre-plugin behavior — a shipped policy can tighten the budget or
+reorder candidates, never widen a disruption window.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from .api import ALLOW, Budget, BudgetView, CandidateView, Decision
+from .defaults import DefaultPolicy
+from .registry import register_policy
+
+
+@register_policy("maintenance-window")
+class MaintenanceWindowPolicy(DefaultPolicy):
+    """Roll only inside configured wall-clock windows.
+
+    ``windows`` is a tuple of ``(start_hour, end_hour)`` pairs in UTC
+    hours-of-day, half-open, wrapping midnight when ``start > end``
+    (``(22, 6)`` is the classic overnight window). The registry
+    default is the full day — window-less until configured — so the
+    registered name composes as a no-op and stays chaos-deterministic.
+
+    The clock is **injected**: the caller stamps wall time onto the
+    view (``BudgetView.now`` — ``utils.faultpoints.wall_now`` in
+    production, the virtual chaos clock under test), so this class
+    never calls ``time`` itself. That is what keeps POL701 green and
+    the policy replayable: re-running a chaos seed re-presents the
+    same ``now`` and gets the same decisions.
+    """
+
+    def __init__(
+        self, windows: Sequence[tuple[float, float]] = ((0.0, 24.0),)
+    ) -> None:
+        self.windows = tuple((float(a), float(b)) for a, b in windows)
+
+    def _open_at(self, now: float) -> bool:
+        hour = (now % 86400.0) / 3600.0
+        for start, end in self.windows:
+            if start <= end:
+                if start <= hour < end:
+                    return True
+            elif hour >= start or hour < end:
+                return True
+        return False
+
+    def admit(self, candidate: CandidateView, view: BudgetView) -> Decision:
+        if self._open_at(view.now):
+            return ALLOW
+        return Decision(
+            False,
+            f"outside maintenance windows {self.windows!r} "
+            f"(now={view.now:.0f})",
+        )
+
+    def budget(self, view: BudgetView) -> Budget:
+        base = super().budget(view)
+        if self._open_at(view.now):
+            return base
+        return Budget(available=0, max_unavailable=base.max_unavailable)
+
+
+@register_policy("cost-tiers")
+class CostTierPolicy(DefaultPolicy):
+    """Cost/priority tiers: ordered rollout classes sharing ONE budget.
+
+    Candidates carry their rollout class on ``CandidateView.tier``
+    (parsed from a ``tier<k>-`` name prefix by ``api.tier_of`` at
+    view-build time; unclassed candidates sort after every explicit
+    class). Lower classes roll first; WITHIN a class the default
+    degraded-first order still applies — the outer sort is stable over
+    ``super().order``. The budget is untouched: tiers share the one
+    clamp, they do not partition it.
+    """
+
+    def order(
+        self, candidates: Sequence[CandidateView]
+    ) -> list[CandidateView]:
+        return sorted(super().order(candidates), key=lambda c: c.tier)
+
+
+@register_policy("fleet-grant-gate")
+class FleetGrantGatePolicy(DefaultPolicy):
+    """Marker policy: this pool's rolls are gated by FleetRollout
+    grants (fleet/worker.py waits for the ledger before cordoning).
+    Behaviorally the default; its registry presence is what lets the
+    composition validator refuse pairings that cannot hold — see
+    ``registry.CONFLICTS``."""
+
+
+@register_policy("requestor-delegation")
+class RequestorDelegationPolicy(DefaultPolicy):
+    """Marker policy: cordon authority is delegated to an external
+    maintenance operator (upgrade/requestor.py). Conflicts with
+    ``fleet-grant-gate`` — two masters over one node's cordon is the
+    split-brain the validator exists to refuse."""

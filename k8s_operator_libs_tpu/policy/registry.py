@@ -1,0 +1,180 @@
+"""Policy registry, composition combinator, and composition validator.
+
+Registration is EXPLICIT — ``@register_policy("name")`` with a string
+literal, never discovery by subclass scan — for the same reason the
+FleetRollout spec names its pools explicitly: an operator must not
+silently widen what can run because a class appeared on the import
+path. The literal-name shape is also what makes the POL704
+registration-completeness check statically decidable
+(tools/analyze/policy_discipline.py).
+
+Composition semantics (docs/policy-plugins.md):
+
+* **admit** — intersection: every member must allow; the first deny
+  wins and its reason is the composed reason.
+* **order** — lexicographic chaining: the LAST-listed policy sorts
+  first and each earlier policy re-sorts the result, so (every member
+  being a stable reordering) the first-listed policy is the most
+  significant key and later policies break its ties.
+* **budget** — componentwise min: the composed budget can only be as
+  generous as its stingiest member (a composition must never admit a
+  disruption some member would have refused).
+
+Some registered names are mutually exclusive — ``fleet-grant-gate``
+composed with ``requestor-delegation`` would have the fleet ledger
+and a maintenance operator both claiming cordon authority over one
+node (fleet/worker.py refuses exactly this). Those pairs are declared
+in :data:`CONFLICTS` and :func:`validate_composition` raises the typed
+:class:`PolicyCompositionError` naming the clashing policies.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Sequence
+
+from .api import Budget, BudgetView, CandidateView, Decision, UpgradePolicy
+
+_REGISTRY: dict[str, type] = {}
+#: Composition cache: names tuple -> composed instance. Policies are
+#: stateless pure-function bundles (POL703), so one instance per
+#: composition serves every caller.
+_COMPOSED: dict[tuple[str, ...], UpgradePolicy] = {}
+
+#: Declared mutually-exclusive pairs (see module docstring).
+CONFLICTS: frozenset[frozenset[str]] = frozenset(
+    {frozenset({"fleet-grant-gate", "requestor-delegation"})}
+)
+
+
+class PolicyCompositionError(ValueError):
+    """A policy composition that must not run: unknown/duplicate names
+    or a declared conflict. ``policies`` carries the offending names so
+    callers (and their error messages) stay structured — the
+    fleet-worker refusal of requestor mode under grant gating raises
+    this instead of a bare string (tests/test_policy.py pins it)."""
+
+    def __init__(self, message: str, policies: Iterable[str] = ()) -> None:
+        super().__init__(message)
+        self.policies = tuple(policies)
+
+
+def register_policy(name: str) -> Callable[[type], type]:
+    """Class decorator registering ``cls`` under ``name``. The name is
+    the spec-facing handle (``DriverUpgradePolicySpec.policy``,
+    ``FleetRollout.spec.pools[].policy`` select by it)."""
+
+    def deco(cls: type) -> type:
+        existing = _REGISTRY.get(name)
+        if existing is not None and existing is not cls:
+            raise ValueError(
+                f"policy name {name!r} already registered by "
+                f"{existing.__name__}"
+            )
+        cls.name = name  # type: ignore[attr-defined]
+        _REGISTRY[name] = cls
+        _COMPOSED.clear()
+        return cls
+
+    return deco
+
+
+def registered_policies() -> dict[str, type]:
+    """Snapshot of the registry (name -> class)."""
+    return dict(_REGISTRY)
+
+
+def validate_composition(names: Sequence[str]) -> tuple[str, ...]:
+    """Reject unknown names, duplicates, and declared conflicts;
+    returns the validated tuple. This is THE composition gate — every
+    path from a spec to a running composition goes through it."""
+    names = tuple(names)
+    unknown = [n for n in names if n not in _REGISTRY]
+    if unknown:
+        raise PolicyCompositionError(
+            f"unknown policy name(s) {unknown!r}; registered: "
+            f"{sorted(_REGISTRY)}",
+            policies=unknown,
+        )
+    if len(set(names)) != len(names):
+        dupes = sorted({n for n in names if names.count(n) > 1})
+        raise PolicyCompositionError(
+            f"policy composition repeats {dupes!r}", policies=dupes
+        )
+    for pair in CONFLICTS:
+        if pair <= set(names):
+            clash = tuple(sorted(pair))
+            raise PolicyCompositionError(
+                f"policies {clash[0]!r} and {clash[1]!r} do not compose: "
+                "fleet grant gating and requestor/maintenance-operator "
+                "delegation would both claim cordon authority over one "
+                "node",
+                policies=clash,
+            )
+    return names
+
+
+class _ComposedPolicy:
+    """The composition combinator (semantics: module docstring). Not a
+    registered policy itself — compositions are selected by listing
+    member names, never by a composite name."""
+
+    def __init__(self, members: Sequence[UpgradePolicy]) -> None:
+        self.members = tuple(members)
+        self.name = "+".join(m.name for m in self.members)
+
+    def admit(self, candidate: CandidateView, view: BudgetView) -> Decision:
+        for member in self.members:
+            decision = member.admit(candidate, view)
+            if not decision.allowed:
+                return decision
+        return Decision(True)
+
+    def order(
+        self, candidates: Sequence[CandidateView]
+    ) -> list[CandidateView]:
+        ordered = list(candidates)
+        for member in reversed(self.members):
+            ordered = member.order(ordered)
+        return ordered
+
+    def budget(self, view: BudgetView) -> Budget:
+        budgets = [m.budget(view) for m in self.members]
+        return Budget(
+            available=min(b.available for b in budgets),
+            max_unavailable=min(b.max_unavailable for b in budgets),
+        )
+
+
+def compose(names: Sequence[str]) -> UpgradePolicy:
+    """Validated composition of registered policies; an empty sequence
+    resolves to the default policy (the pre-plugin behavior)."""
+    names = tuple(names) or ("default",)
+    validate_composition(names)
+    if len(names) == 1:
+        return _REGISTRY[names[0]]()
+    return _ComposedPolicy([_REGISTRY[n]() for n in names])
+
+
+def for_spec(names: Sequence[str]) -> UpgradePolicy:
+    """Memoized :func:`compose` — the call sites on the reconcile hot
+    path (admission math runs every pass over every pool) resolve
+    their spec's composition through here."""
+    key = tuple(names)
+    cached = _COMPOSED.get(key)
+    if cached is None:
+        cached = _COMPOSED[key] = compose(key)
+    return cached
+
+
+def standard_compositions() -> tuple[tuple[str, ...], ...]:
+    """The shipped compositions the proof harnesses sweep: the fuzzer's
+    plugin-composition mode and the chaos ``policy_matrix`` corpus both
+    run every entry (docs/chaos-harness.md). Single-member entries
+    cover each shipped plugin alone; the pairs prove composition."""
+    return (
+        ("default",),
+        ("maintenance-window",),
+        ("cost-tiers",),
+        ("default", "maintenance-window"),
+        ("cost-tiers", "maintenance-window"),
+    )

@@ -58,8 +58,8 @@ import hashlib
 import json
 import random
 import time as _time
-from dataclasses import asdict, dataclass, field
-from typing import Any, Callable, Mapping, Optional
+from dataclasses import asdict, dataclass, field, replace
+from typing import Any, Callable, Mapping, Optional, Sequence
 
 from ..api.fleet_v1alpha1 import (
     FLEET_ROLLOUT_KIND,
@@ -193,6 +193,16 @@ class ChaosConfig:
     #: exercised is the stage→flush→rejoin machinery and the
     #: ``write_batch_partial`` fault point, not wall-clock pipelining.
     batch_writes: bool = True
+    #: Registered policy composition the pools' upgrade policy runs
+    #: (docs/policy-plugins.md); empty = the default policy. The
+    #: ``policy_matrix`` corpus (run_policy_matrix) sweeps the shipped
+    #: compositions over one seed corpus.
+    policy: tuple = ()
+
+    def __post_init__(self) -> None:
+        # JSON round-trips the composition as a list; coerce back so a
+        # reloaded schedule config compares (and re-serializes) equal.
+        self.policy = tuple(self.policy)
 
     def resolved_max_steps(self) -> int:
         return self.max_steps or (240 + 5 * self.pools)
@@ -700,6 +710,7 @@ class ChaosFleetHarness:
             # The GRANT is the budget in the fleet shape
             # (docs/fleet-control-plane.md).
             max_unavailable=IntOrString("100%"),
+            policy=self.cfg.policy,
             **kwargs,
         )
 
@@ -1171,4 +1182,52 @@ def run_corpus(
             k: sum(r.violations.get(k, 0) for r in results)
             for k in (results[0].violations if results else {})
         },
+    }
+
+
+def run_policy_matrix(
+    seeds: range,
+    config: Optional[ChaosConfig] = None,
+    compositions: Optional[Sequence[tuple]] = None,
+    on_result: Optional[Callable[[ChaosResult], None]] = None,
+) -> dict:
+    """The ``policy_matrix`` corpus (docs/chaos-harness.md): sweep the
+    shipped policy compositions (policy/registry.py
+    ``standard_compositions``) over one seed corpus — every
+    (composition, seed) cell replays the same schedule shape with the
+    pools' upgrade policy composed per docs/policy-plugins.md. The CI
+    bench gate floors the aggregate ``budget_violations`` at hard zero:
+    no registered composition may widen a disruption past the grant
+    budget under ANY explored interleaving."""
+    from ..policy import standard_compositions, validate_composition
+
+    cfg = config or ChaosConfig()
+    comps = tuple(
+        tuple(c) for c in (
+            compositions if compositions is not None
+            else standard_compositions()
+        )
+    )
+    for comp in comps:
+        validate_composition(comp or ("default",))
+    cells: dict[str, dict] = {}
+    for comp in comps:
+        cells["+".join(comp) or "default"] = run_corpus(
+            seeds, replace(cfg, policy=comp), on_result=on_result
+        )
+    summaries = list(cells.values())
+    return {
+        "compositions": len(comps),
+        "schedules_explored": sum(
+            c["schedules_explored"] for c in summaries
+        ),
+        "invariant_violations": sum(
+            c["invariant_violations"] for c in summaries
+        ),
+        "budget_violations": sum(
+            c["violations_by_kind"].get("budget", 0) for c in summaries
+        ),
+        "not_converged": sum(c["not_converged"] for c in summaries),
+        "wall_s": round(sum(c["wall_s"] for c in summaries), 3),
+        "cells": cells,
     }

@@ -34,11 +34,14 @@ the pipeline per pass.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import TYPE_CHECKING, Optional
 
 from ..api.telemetry_v1alpha1 import fold_link_topology, trend_value
 from ..api.upgrade_v1alpha1 import DriverUpgradePolicySpec
 from ..utils.log import get_logger
+
+if TYPE_CHECKING:
+    from ..policy import BudgetView, CandidateView, UpgradePolicy
 from ..upgrade.common_manager import ClusterUpgradeState, NodeUpgradeState
 from ..upgrade.consts import NULL_STRING, TRUE_STRING, UpgradeState
 from ..upgrade.inplace import InplaceNodeStateManager
@@ -103,28 +106,45 @@ class SliceAssessment:
     #: first").
     worst_links: dict[str, tuple] = field(default_factory=dict)
 
-    def budget(self, policy: DriverUpgradePolicySpec) -> tuple[int, int]:
+    def budget_view(self, policy: DriverUpgradePolicySpec) -> "BudgetView":
+        """Freeze this assessment's budget inputs in SLICE units for
+        the policy plugin (docs/policy-plugins.md) — same view shape
+        the upgrade tier builds in node units
+        (``CommonUpgradeManager.budget_view``), with the clock
+        injected here so clock-aware policies stay POL701-pure."""
+        from ..policy import BudgetView
+        from ..utils.faultpoints import wall_now
+
+        return BudgetView(
+            total=self.total_slices,
+            in_progress=len(self.in_progress),
+            unavailable=len(self.disrupted),
+            candidates=len(self.candidates),
+            max_parallel=policy.max_parallel_upgrades,
+            max_unavailable=policy.resolved_max_unavailable(
+                self.total_slices
+            ),
+            now=wall_now(),
+        )
+
+    def budget(
+        self,
+        policy: DriverUpgradePolicySpec,
+        plugin: Optional["UpgradePolicy"] = None,
+    ) -> tuple[int, int]:
         """Upgrade-start slots in SLICE units (shape parity with
-        GetUpgradesAvailable, common_manager.go:748-776). Returns
-        ``(available, resolved_max_unavailable)`` — the resolved cap is
-        runtime information (percent policies scale against the pool) the
+        GetUpgradesAvailable, common_manager.go:748-776), delegated to
+        the policy plugin — ``DefaultPolicy.budget`` is the pre-plugin
+        clamp verbatim. Returns ``(available,
+        resolved_max_unavailable)`` — the resolved cap is runtime
+        information (percent policies scale against the pool) the
         planner log must carry for slots=0 debugging."""
-        max_unavailable = policy.resolved_max_unavailable(self.total_slices)
-        if policy.max_parallel_upgrades == 0:
-            available = len(self.candidates)
-        else:
-            available = policy.max_parallel_upgrades - len(self.in_progress)
-        if available > max_unavailable:
-            available = max_unavailable
-        currently_unavailable = len(self.disrupted)
-        if currently_unavailable >= max_unavailable:
-            available = 0
-        elif (
-            max_unavailable < self.total_slices
-            and currently_unavailable + available > max_unavailable
-        ):
-            available = max_unavailable - currently_unavailable
-        return available, max_unavailable
+        from ..policy import for_spec
+
+        if plugin is None:
+            plugin = for_spec(policy.policy)
+        verdict = plugin.budget(self.budget_view(policy))
+        return verdict.available, verdict.max_unavailable
 
     def effective_score(self, slice_id: str) -> float:
         """Ordering score: a monitor-flagged wounded slice reads 0 (a
@@ -142,24 +162,38 @@ class SliceAssessment:
             self.link_scores.get(slice_id, 100.0),
         )
 
-    def ordered_candidates(self):
+    def candidate_views(self) -> list["CandidateView"]:
+        """Each candidate slice reduced to the policy view: effective
+        score (wounded/link/telemetry merge), worst trend, disruption,
+        and the cost tier parsed from the slice id."""
+        from ..policy import CandidateView, tier_of
+
+        return [
+            CandidateView(
+                name=slice_id,
+                score=self.effective_score(slice_id),
+                trend=self.trends.get(slice_id, 0),
+                disrupted=slice_id in self.disrupted,
+                tier=tier_of(slice_id),
+            )
+            for slice_id in self.candidates
+        ]
+
+    def ordered_candidates(self, plugin: Optional["UpgradePolicy"] = None):
         """Degraded-first generalization of drain-the-wounded-first
-        (ISSUE 8; Guard, PAPERS.md): already-disrupted slices first
-        (their collective is down anyway — finishing them is free), then
-        ascending health score (wounded = 0, telemetry stragglers next,
-        fully healthy = 100 last), degrading trend breaking score ties
-        (a slice still getting worse rolls before one holding steady),
-        then name. With no telemetry plane wired every score is 100 and
-        this is exactly the old wounded-first ordering."""
-        return sorted(
-            self.candidates.items(),
-            key=lambda item: (
-                item[0] not in self.disrupted,
-                self.effective_score(item[0]),
-                self.trends.get(item[0], 0),
-                item[0],
-            ),
-        )
+        (ISSUE 8; Guard, PAPERS.md), delegated to the policy plugin's
+        ``order``. The default plugin keys on (already-disrupted first,
+        ascending effective score, degrading trend, name) — with no
+        telemetry plane wired every score is 100 and this is exactly
+        the old wounded-first ordering."""
+        from ..policy import for_spec
+
+        if plugin is None:
+            plugin = for_spec(())
+        return [
+            (view.name, self.candidates[view.name])
+            for view in plugin.order(self.candidate_views())
+        ]
 
 
 def assess_slices(
@@ -251,15 +285,30 @@ def start_slices_within_budget(
     already-disrupted slices exempt from the budget. ``start_slice(ns)``
     is the per-node start action (cordon-required label for in-place, CR
     creation + maintenance-required for requestor)."""
+    from ..policy import for_spec
+
+    plugin = for_spec(policy.policy)
     assessment = assess_slices(detector, state)
-    available, max_unavailable = assessment.budget(policy)
+    available, max_unavailable = assessment.budget(policy, plugin=plugin)
+    budget_view = assessment.budget_view(policy)
+    admitted = {
+        view.name
+        for view in assessment.candidate_views()
+        if plugin.admit(view, budget_view).allowed
+    }
     log.info(
         "%s: slices=%d in_progress=%d disrupted=%d max_unavailable=%d "
-        "slots=%d",
+        "slots=%d policy=%s",
         log_label, assessment.total_slices, len(assessment.in_progress),
-        len(assessment.disrupted), max_unavailable, available,
+        len(assessment.disrupted), max_unavailable, available, plugin.name,
     )
-    for slice_id, members in assessment.ordered_candidates():
+    for slice_id, members in assessment.ordered_candidates(plugin=plugin):
+        if slice_id not in admitted:
+            log.info(
+                "%s: slice %s refused by policy %s",
+                log_label, slice_id, plugin.name,
+            )
+            continue
         # Per-node bookkeeping shared with the base planners.
         startable: list[NodeUpgradeState] = []
         for ns in members:

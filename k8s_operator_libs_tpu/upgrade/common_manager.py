@@ -11,7 +11,14 @@ from __future__ import annotations
 import time
 from collections import defaultdict
 from dataclasses import dataclass, field
-from typing import Callable, Mapping, Optional, Sequence, TypeVar
+from typing import (
+    TYPE_CHECKING,
+    Callable,
+    Mapping,
+    Optional,
+    Sequence,
+    TypeVar,
+)
 
 from ..api.telemetry_v1alpha1 import NodeHealth, effective_scores
 from ..api.upgrade_v1alpha1 import (
@@ -42,6 +49,9 @@ from .safe_driver_load import SafeDriverLoadManager
 from .state_provider import NodeUpgradeStateProvider
 from .task_runner import TaskRunner
 from .validation_manager import ValidationManager
+
+if TYPE_CHECKING:
+    from ..policy import BudgetView, UpgradePolicy
 
 log = get_logger("upgrade.common")
 
@@ -272,34 +282,51 @@ class CommonUpgradeManager:
                     count += 1
         return count
 
+    def budget_view(
+        self,
+        state: ClusterUpgradeState,
+        max_parallel_upgrades: int,
+        max_unavailable: int,
+    ) -> "BudgetView":
+        """Freeze the snapshot's budget inputs for the policy plugin
+        (docs/policy-plugins.md): the counters GetUpgradesAvailable
+        read inline, plus the injected clock — the policy itself may
+        never call ``time`` (POL701), so the manager stamps wall time
+        (the virtual chaos clock under test) onto the view here."""
+        from ..policy import BudgetView
+        from ..utils.faultpoints import wall_now
+
+        return BudgetView(
+            total=self.get_total_managed_nodes(state),
+            in_progress=self.get_upgrades_in_progress(state),
+            unavailable=self.get_current_unavailable_nodes(state)
+            + len(state.nodes_in(UpgradeState.CORDON_REQUIRED)),
+            candidates=len(state.nodes_in(UpgradeState.UPGRADE_REQUIRED)),
+            max_parallel=max_parallel_upgrades,
+            max_unavailable=max_unavailable,
+            now=wall_now(),
+        )
+
     def get_upgrades_available(
         self,
         state: ClusterUpgradeState,
         max_parallel_upgrades: int,
         max_unavailable: int,
+        plugin: Optional["UpgradePolicy"] = None,
     ) -> int:
-        """Budget math (reference: :748-776): parallel-slot limit, then the
-        unavailability clamp counting nodes already unavailable plus nodes
-        about to be cordoned."""
-        in_progress = self.get_upgrades_in_progress(state)
-        total = self.get_total_managed_nodes(state)
-        if max_parallel_upgrades == 0:
-            available = len(state.nodes_in(UpgradeState.UPGRADE_REQUIRED))
-        else:
-            available = max_parallel_upgrades - in_progress
-        current_unavailable = self.get_current_unavailable_nodes(state) + len(
-            state.nodes_in(UpgradeState.CORDON_REQUIRED)
-        )
-        if available > max_unavailable:
-            available = max_unavailable
-        if current_unavailable >= max_unavailable:
-            available = 0
-        elif (
-            max_unavailable < total
-            and current_unavailable + available > max_unavailable
-        ):
-            available = max_unavailable - current_unavailable
-        return available
+        """Budget math (reference: :748-776), delegated to the policy
+        plugin: parallel-slot limit, then the unavailability clamp
+        counting nodes already unavailable plus nodes about to be
+        cordoned — ``DefaultPolicy.budget`` verbatim. ``plugin`` is a
+        resolved composition (``policy.for_spec``); None means the
+        default policy, byte-identical to the pre-plugin inline math
+        (pinned by the roll-equivalence fuzzer)."""
+        from ..policy import for_spec
+
+        if plugin is None:
+            plugin = for_spec(())
+        view = self.budget_view(state, max_parallel_upgrades, max_unavailable)
+        return plugin.budget(view).available
 
     # ------------------------------------------------------------------
     # Node predicates

@@ -11,6 +11,7 @@ from __future__ import annotations
 from typing import Protocol
 
 from ..api.upgrade_v1alpha1 import DriverUpgradePolicySpec
+from ..policy import CandidateView, for_spec, tier_of
 from ..utils.log import get_logger
 from .common_manager import ClusterUpgradeState, CommonUpgradeManager
 from .consts import NULL_STRING, UpgradeState
@@ -55,11 +56,16 @@ class InplaceNodeStateManager:
         common = self.common
         if not state.nodes_in(UpgradeState.UPGRADE_REQUIRED):
             return
+        # The admission/unavailability math lives in the policy plugin
+        # (docs/policy-plugins.md); an empty spec composition is the
+        # default policy — the pre-plugin math, byte-identical.
+        plugin = for_spec(policy.policy)
         total = common.get_total_managed_nodes(state)
         max_unavailable = policy.resolved_max_unavailable(total)
-        available = common.get_upgrades_available(
+        view = common.budget_view(
             state, policy.max_parallel_upgrades, max_unavailable
         )
+        available = plugin.budget(view).available
         log.info(
             "upgrade slots: in_progress=%d max_parallel=%d available=%d "
             "unavailable=%d total=%d max_unavailable=%d",
@@ -83,6 +89,20 @@ class InplaceNodeStateManager:
                     )
                 if common.skip_node_upgrade(node):
                     log.info("node %s is marked to skip upgrades", node.name)
+                    continue
+                decision = plugin.admit(
+                    CandidateView(
+                        name=node.name,
+                        disrupted=bool(node.unschedulable),
+                        tier=tier_of(node.name),
+                    ),
+                    view,
+                )
+                if not decision.allowed:
+                    log.info(
+                        "node %s refused by policy %s: %s",
+                        node.name, plugin.name, decision.reason,
+                    )
                     continue
                 if available <= 0:
                     # Budget exhausted: only already-cordoned nodes

@@ -372,10 +372,13 @@ class RequestorNodeStateManager:
         common = self.common
         available: Optional[int] = None
         if self.opts.use_post_maintenance:
+            from ..policy import for_spec
+
             total = common.get_total_managed_nodes(state)
             max_unavailable = policy.resolved_max_unavailable(total)
             available = common.get_upgrades_available(
-                state, policy.max_parallel_upgrades, max_unavailable
+                state, policy.max_parallel_upgrades, max_unavailable,
+                plugin=for_spec(policy.policy),
             )
             log.info(
                 "requestor upgrade slots: in_progress=%d max_parallel=%d "

@@ -1,0 +1,115 @@
+"""Seeded POL7xx violations: registered policies breaking every leg of
+the plugin discipline (docs/policy-plugins.md).
+
+* ``MutatorPolicy`` — admit reaches a cluster mutation one call below
+  (only transitive propagation sees it), order reads the wall clock,
+  budget rolls an RNG, and admit returns a truthy stand-in instead of
+  a Decision.
+* ``StashPolicy`` — admit stashes cross-call state on ``self`` and in
+  a module-level container, order declares ``global`` and spins a
+  ``while`` loop, budget recurses through a helper; admit also has a
+  bare return and can fall off the end.
+* ``ShadowPolicy`` — implements the full protocol but is never
+  registered (dead policy).
+* ``GhostPolicy`` — registered under a name quoted nowhere else (no
+  spec or composition can ever select it).
+"""
+
+import random
+import time
+
+
+def register_policy(name):
+    def wrap(cls):
+        cls.name = name
+        return cls
+
+    return wrap
+
+
+class Client:
+    def update_status(self, obj):
+        ...
+
+
+#: Second quoted occurrences for the names whose POL704 leg-2 check
+#: should stay silent (the seeded leg-2 violation is GhostPolicy's).
+COMPOSITIONS = (("mutator-policy", "stash-policy"),)
+
+_SEEN: dict = {}
+_TICKS = 0
+
+
+@register_policy("mutator-policy")
+class MutatorPolicy:
+    def __init__(self, client):
+        self.client = client
+
+    def admit(self, candidate, view):
+        self._push(candidate)  # POL701: mutation one call below
+        return True  # POL705: truthy stand-in, not a Decision
+
+    def _push(self, candidate):
+        self.client.update_status(candidate)  # POL701: direct mutation
+
+    def order(self, candidates):
+        now = time.time()  # POL701: clock read
+        return sorted(candidates, key=lambda c: (c.score, now))
+
+    def budget(self, view):
+        return random.random()  # POL701: RNG call
+
+
+@register_policy("stash-policy")
+class StashPolicy:
+    def admit(self, candidate, view):
+        self._last = candidate.name  # POL703: self-stash
+        self._cache[candidate.name] = view.now  # POL703: self container
+        _SEEN[candidate.name] = view.now  # POL703: module-level store
+        if candidate.disrupted:
+            return  # POL705: bare return
+        # POL705: falls off the end (implicit None)
+
+    def order(self, candidates):
+        global _TICKS  # POL703: global declaration
+        _TICKS += 1
+        out = []
+        i = 0
+        while i < len(candidates):  # POL702: while loop
+            out.append(candidates[i])
+            i += 1
+        return out
+
+    def budget(self, view):
+        return self._spin(view, 0)  # POL702: recursion reachable
+
+    def _spin(self, view, depth):
+        if depth > 3:
+            return view
+        return self._spin(view, depth + 1)  # POL702: recursion
+
+
+class ShadowPolicy:  # POL704: full protocol, never registered
+    def admit(self, candidate, view):
+        return None
+
+    def order(self, candidates):
+        return list(candidates)
+
+    def budget(self, view):
+        return view
+
+
+@register_policy("ghost-policy")  # POL704: name referenced nowhere else
+class GhostPolicy:
+    def admit(self, candidate, view):
+        return ALLOW
+
+    def order(self, candidates):
+        return list(candidates)
+
+    def budget(self, view):
+        return view
+
+
+ALLOW = object()

@@ -1,0 +1,57 @@
+"""Clean twin: a registered policy obeying every POL7xx leg — pure
+functions of the frozen views, ``for`` over finite snapshots, no
+cross-call state, name referenced by a composition spec, and admit
+returning a Decision on every path (time arrives through the injected
+``view.now``, never a clock call).
+"""
+
+
+def register_policy(name):
+    def wrap(cls):
+        cls.name = name
+        return cls
+
+    return wrap
+
+
+class Decision:
+    def __init__(self, allowed, reason=""):
+        self.allowed = allowed
+        self.reason = reason
+
+
+ALLOW = Decision(True)
+
+#: The registered name's second quoted occurrence — the composition
+#: spec POL704 leg 2 demands (an unreferenced name is unselectable).
+COMPOSITIONS = (("window-clean",),)
+
+
+@register_policy("window-clean")
+class WindowCleanPolicy:
+    def __init__(self, start_hour=8.0, end_hour=18.0):
+        # Construction wires configuration; the decision methods below
+        # never touch it mutably again.
+        self._start = start_hour
+        self._end = end_hour
+
+    def admit(self, candidate, view):
+        hour = (view.now % 86400.0) / 3600.0
+        if candidate.disrupted:
+            return ALLOW
+        if hour < self._start or hour >= self._end:
+            return Decision(False, "outside the maintenance window")
+        return ALLOW
+
+    def order(self, candidates):
+        return sorted(
+            candidates,
+            key=lambda c: (not c.disrupted, c.score, c.trend, c.name),
+        )
+
+    def budget(self, view):
+        available = view.candidates
+        for cap in (view.max_unavailable, view.total):
+            if available > cap:
+                available = cap
+        return available

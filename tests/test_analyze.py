@@ -1436,3 +1436,72 @@ def test_pr12_14_modules_are_exc_key_clean():
         targets, pass_names=["swallowed-exception", "literal-key"]
     )
     assert findings == [], [str(f) for f in findings]
+
+
+# -- policy discipline (POL701-POL705) -------------------------------------
+
+def test_pol_bad_fixture_flags_all_seeded_violations():
+    findings = run_analysis([str(FIXTURES / "policy_bad.py")])
+    assert codes(findings) == {
+        "POL701", "POL702", "POL703", "POL704", "POL705"
+    }
+    by_code = {}
+    for f in findings:
+        by_code.setdefault(f.code, []).append(f)
+    # admit (transitive), _push (direct), order (clock), budget (RNG).
+    assert len(by_code["POL701"]) == 4
+    # The while loop, plus the _spin self-recursion seen from budget
+    # and from _spin itself.
+    assert len(by_code["POL702"]) == 3
+    # self-stash, self-held container, module-level store, global.
+    assert len(by_code["POL703"]) == 4
+    # Dead ShadowPolicy + unreferenced 'ghost-policy'.
+    assert len(by_code["POL704"]) == 2
+    # Truthy stand-in, bare return, fall-through.
+    assert len(by_code["POL705"]) == 3
+    # The transitive-mutator finding names its witness chain.
+    transitive = [f for f in by_code["POL701"]
+                  if "MutatorPolicy.admit" in f.message]
+    assert transitive and "-> MutatorPolicy._push" in transitive[0].message
+
+
+def test_pol_clean_twin_silent():
+    assert run_analysis([str(FIXTURES / "policy_clean.py")]) == []
+
+
+def test_package_is_pol_clean():
+    """Every registered policy the package ships (default,
+    maintenance-window, cost-tiers, and the two composition markers) is
+    provably pure, bounded, stateless, reachable, and total: zero
+    POL7xx findings, no baseline entries."""
+    findings = run_analysis(
+        [str(REPO / "k8s_operator_libs_tpu")],
+        pass_names=["policy-discipline"],
+    )
+    assert findings == [], [str(f) for f in findings]
+
+
+def test_cli_stats_include_policy_coverage(capsys):
+    rc = cli.main([str(FIXTURES / "policy_bad.py"), "--baseline", "-",
+                   "--stats"])
+    assert rc == 1
+    err = capsys.readouterr().err
+    line = next(ln for ln in err.splitlines()
+                if ln.startswith("analyze stats:"))
+    # Three registered classes in the fixture (the dead ShadowPolicy
+    # does not count — it is exactly what the counter must not see).
+    assert "policies=3" in line
+
+
+def test_sarif_rules_include_pol_family(tmp_path, capsys):
+    sarif_file = tmp_path / "report.sarif"
+    rc = cli.main([str(FIXTURES / "policy_bad.py"), "--baseline", "-",
+                   "--sarif", str(sarif_file)])
+    assert rc == 1
+    capsys.readouterr()
+    doc = json.loads(sarif_file.read_text())
+    rule_ids = {r["id"] for r in doc["runs"][0]["tool"]["driver"]["rules"]}
+    assert {"POL701", "POL702", "POL703", "POL704", "POL705"} <= rule_ids
+    assert {res["ruleId"] for res in doc["runs"][0]["results"]} == {
+        "POL701", "POL702", "POL703", "POL704", "POL705"
+    }

@@ -401,6 +401,42 @@ class TestCorpus:
         assert summary["not_converged"] == 0
         assert summary["fault_points_fired"], "no fault ever fired"
 
+    def test_policy_matrix_zero_budget_violations(self):
+        """ISSUE 17 ``policy_matrix`` configuration: every shipped
+        policy composition (policy/registry.py standard_compositions)
+        over a small seed corpus — no composition may widen a
+        disruption past the grant budget under any explored
+        interleaving, and every cell converges."""
+        from k8s_operator_libs_tpu.testing.chaos import run_policy_matrix
+
+        summary = run_policy_matrix(
+            range(2), ChaosConfig(pools=4, workers=2, shards=2)
+        )
+        assert summary["compositions"] == 5
+        assert summary["schedules_explored"] == 10
+        assert summary["budget_violations"] == 0, summary
+        assert summary["invariant_violations"] == 0, summary
+        assert summary["not_converged"] == 0
+        assert set(summary["cells"]) == {
+            "default", "maintenance-window", "cost-tiers",
+            "default+maintenance-window",
+            "cost-tiers+maintenance-window",
+        }
+
+    def test_policy_rides_the_schedule_json(self):
+        """A schedule captured from a policy-composed config replays
+        with the composition intact (the config — policy included — is
+        the repro recipe), byte-stably."""
+        cfg = ChaosConfig(
+            pools=4, workers=2, shards=2,
+            policy=("default", "maintenance-window"),
+        )
+        schedule = generate_schedule(7, cfg)
+        text = schedule.to_json()
+        again = FaultSchedule.from_json(text)
+        assert again.config.policy == ("default", "maintenance-window")
+        assert again.to_json() == text
+
     @pytest.mark.slow
     def test_wider_corpus_with_hub(self):
         summary = run_corpus(

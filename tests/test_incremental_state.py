@@ -902,18 +902,44 @@ class TestRollEquivalence:
         cluster.subscribe(record)
         return transitions
 
+    def _watch_unavailability(self, cluster, watermark):
+        """Record the high-water mark of concurrently-unschedulable
+        nodes into ``watermark['max']`` — the observable half of the
+        maxUnavailable invariant the policy compositions must
+        preserve."""
+        unsched: set = set()
+        lock = threading.Lock()
+
+        def record(event, obj, old):
+            if obj.get("kind") != "Node":
+                return
+            name = obj["metadata"]["name"]
+            with lock:
+                if (obj.get("spec") or {}).get("unschedulable"):
+                    unsched.add(name)
+                else:
+                    unsched.discard(name)
+                watermark["max"] = max(
+                    watermark.get("max", 0), len(unsched)
+                )
+
+        cluster.subscribe(record)
+
     def _roll(self, incremental, width=1, threaded=False,
-              checkpoint=False, nodes=None):
+              checkpoint=False, nodes=None, policy=None, watermark=None):
         cluster = FakeCluster()
         nodes = nodes if nodes is not None else self.NODES
         for i in range(nodes):
             cluster.create(make_node(f"node-{i}"))
+        if watermark is not None:
+            self._watch_unavailability(cluster, watermark)
         sim = DaemonSetSimulator(
             cluster, name="driver", namespace=NS, match_labels=LABELS
         )
         sim.settle()
         workload = None
-        policy = POLICY
+        if policy is None:
+            policy = POLICY
         if checkpoint:
             from k8s_operator_libs_tpu.api import CheckpointSpec, DrainSpec
             from k8s_operator_libs_tpu.kube.sim import (
@@ -1019,3 +1045,50 @@ class TestRollEquivalence:
             assert inc[name] == reference[name], (
                 f"{name}: {inc[name]} != {reference[name]}"
             )
+
+
+class TestPluginCompositionRolls:
+    """ISSUE 17 plugin-composition mode: every shipped composition
+    (policy/registry.py ``standard_compositions``) through the
+    roll-equivalence harness. Two properties per composition: the
+    incremental source's terminal per-node state sequences are
+    identical to the stateless full rebuild's under the composed
+    policy, and the roll never exceeds the spec's maxUnavailable
+    budget (observed as the high-water mark of concurrently
+    unschedulable nodes). POL7xx proves the members pure/total
+    statically; this proves the composed dynamics."""
+
+    NODES = 32
+    BUDGET = 8  # 25% of 32
+
+    def test_every_standard_composition_equivalent_and_within_budget(self):
+        from k8s_operator_libs_tpu.policy import standard_compositions
+
+        harness = TestRollEquivalence()
+        for comp in standard_compositions():
+            policy = DriverUpgradePolicySpec(
+                auto_upgrade=True,
+                max_parallel_upgrades=0,
+                max_unavailable=IntOrString("25%"),
+                policy=comp,
+            )
+            wm_full: dict = {}
+            wm_inc: dict = {}
+            reference = harness._roll(
+                incremental=False, width=1, nodes=self.NODES,
+                policy=policy, watermark=wm_full,
+            )
+            inc = harness._roll(
+                incremental=True, width=1, nodes=self.NODES,
+                policy=policy, watermark=wm_inc,
+            )
+            assert set(reference) == set(inc), comp
+            for name in reference:
+                assert inc[name] == reference[name], (
+                    f"{comp}: {name}: {inc[name]} != {reference[name]}"
+                )
+            for label, wm in (("full", wm_full), ("incremental", wm_inc)):
+                assert 0 < wm["max"] <= self.BUDGET, (
+                    f"{comp}: {label} roll disrupted {wm.get('max')} "
+                    f"nodes concurrently (budget {self.BUDGET})"
+                )

@@ -1,0 +1,313 @@
+"""The policy-plugin contract (k8s_operator_libs_tpu/policy/).
+
+Pins the properties the three consuming tiers and the proof harnesses
+rely on: the default policy is BYTE-IDENTICAL to the pre-plugin inline
+math (the fuzzer pins the end-to-end half of that at widths 1 and 8);
+composition semantics are first-deny-wins / lexicographic order /
+componentwise-min budget; the shipped plugins behave as documented in
+docs/policy-plugins.md; and the registry's composition validator is the
+one place the fleet-vs-requestor refusal lives — raising the typed
+:class:`PolicyCompositionError` instead of a bare string.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from k8s_operator_libs_tpu.policy import (
+    ALLOW,
+    DEFAULT_TIER,
+    Budget,
+    BudgetView,
+    CandidateView,
+    CostTierPolicy,
+    Decision,
+    DefaultPolicy,
+    MaintenanceWindowPolicy,
+    PolicyCompositionError,
+    UpgradePolicy,
+    compose,
+    for_spec,
+    register_policy,
+    registered_policies,
+    standard_compositions,
+    tier_of,
+    validate_composition,
+)
+
+
+def view(**kw) -> BudgetView:
+    base = dict(total=10, in_progress=0, unavailable=0, candidates=10,
+                max_parallel=0, max_unavailable=3, now=0.0)
+    base.update(kw)
+    return BudgetView(**base)
+
+
+def at_hour(hour: float) -> float:
+    return hour * 3600.0
+
+
+# -- registry & validation -------------------------------------------------
+
+def test_shipped_policies_are_registered():
+    names = set(registered_policies())
+    assert {"default", "maintenance-window", "cost-tiers",
+            "fleet-grant-gate", "requestor-delegation"} <= names
+
+
+def test_register_rejects_name_collision():
+    with pytest.raises(ValueError, match="already registered"):
+        @register_policy("default")
+        class Impostor:  # noqa: POL704 — never registered (collision)
+            pass
+
+
+def test_unknown_name_is_typed_error():
+    with pytest.raises(PolicyCompositionError) as exc:
+        validate_composition(("default", "no-such-policy"))
+    assert exc.value.policies == ("no-such-policy",)
+    assert isinstance(exc.value, ValueError)  # old except-clauses survive
+
+
+def test_duplicate_names_are_typed_error():
+    with pytest.raises(PolicyCompositionError) as exc:
+        validate_composition(("default", "default"))
+    assert exc.value.policies == ("default",)
+
+
+def test_declared_conflict_is_typed_error():
+    with pytest.raises(PolicyCompositionError) as exc:
+        validate_composition(("fleet-grant-gate", "requestor-delegation"))
+    assert exc.value.policies == (
+        "fleet-grant-gate", "requestor-delegation"
+    )
+
+
+def test_empty_spec_resolves_to_default():
+    plugin = compose(())
+    assert plugin.name == "default"
+    assert isinstance(plugin, DefaultPolicy)
+
+
+def test_for_spec_memoizes():
+    assert for_spec(("default",)) is for_spec(("default",))
+    assert for_spec(()) is for_spec(())
+
+
+def test_standard_compositions_all_valid():
+    for comp in standard_compositions():
+        plugin = compose(comp)
+        assert isinstance(plugin, UpgradePolicy)
+
+
+# -- default policy: byte-identity with the pre-plugin math ----------------
+
+def reference_upgrades_available(total, in_progress, unavailable,
+                                 candidates, max_parallel,
+                                 max_unavailable):
+    """The inline math the tiers carried before the plugin refactor
+    (GetUpgradesAvailable, common_manager.go:748-776), transcribed
+    verbatim as the oracle."""
+    if max_parallel == 0:
+        upgrades_available = candidates
+    else:
+        upgrades_available = max_parallel - in_progress
+    if upgrades_available > max_unavailable:
+        upgrades_available = max_unavailable
+    if unavailable >= max_unavailable:
+        upgrades_available = 0
+    elif (max_unavailable < total
+          and unavailable + upgrades_available > max_unavailable):
+        upgrades_available = max_unavailable - unavailable
+    return upgrades_available
+
+
+def test_default_budget_matches_pre_plugin_math_exhaustively():
+    plugin = DefaultPolicy()
+    for total in (1, 4, 16):
+        for in_progress in (0, 1, 5):
+            for unavailable in (0, 1, 3, 7):
+                for candidates in (0, 2, 16):
+                    for max_parallel in (0, 1, 4):
+                        for max_unavailable in (1, 3, 16):
+                            v = view(
+                                total=total, in_progress=in_progress,
+                                unavailable=unavailable,
+                                candidates=candidates,
+                                max_parallel=max_parallel,
+                                max_unavailable=max_unavailable,
+                            )
+                            assert plugin.budget(v) == Budget(
+                                available=reference_upgrades_available(
+                                    total, in_progress, unavailable,
+                                    candidates, max_parallel,
+                                    max_unavailable,
+                                ),
+                                max_unavailable=max_unavailable,
+                            )
+
+
+def test_default_admit_is_unconditional():
+    assert DefaultPolicy().admit(CandidateView("n"), view()) is ALLOW
+
+
+def test_default_order_is_degraded_first():
+    healthy = CandidateView("b", score=100.0)
+    degraded = CandidateView("a", score=40.0, trend=2)
+    disrupted = CandidateView("c", score=90.0, disrupted=True)
+    assert DefaultPolicy().order([healthy, degraded, disrupted]) == [
+        disrupted, degraded, healthy
+    ]
+
+
+# -- maintenance-window plugin ---------------------------------------------
+
+def test_window_registry_default_is_full_day_noop():
+    plugin = compose(("maintenance-window",))
+    for hour in (0.0, 6.0, 12.0, 23.99):
+        assert plugin.admit(CandidateView("n"),
+                            view(now=at_hour(hour))).allowed
+        assert plugin.budget(view(now=at_hour(hour))).available > 0
+
+
+def test_window_denies_outside_and_allows_inside():
+    plugin = MaintenanceWindowPolicy(windows=((2.0, 6.0),))
+    inside = plugin.admit(CandidateView("n"), view(now=at_hour(3)))
+    assert inside.allowed
+    outside = plugin.admit(CandidateView("n"), view(now=at_hour(12)))
+    assert not outside.allowed
+    assert "outside maintenance windows" in outside.reason
+    # Half-open: the end hour is already closed.
+    assert not plugin.admit(CandidateView("n"),
+                            view(now=at_hour(6))).allowed
+    assert plugin.admit(CandidateView("n"), view(now=at_hour(2))).allowed
+
+
+def test_window_wraps_midnight():
+    plugin = MaintenanceWindowPolicy(windows=((22.0, 6.0),))
+    assert plugin.admit(CandidateView("n"), view(now=at_hour(23))).allowed
+    assert plugin.admit(CandidateView("n"), view(now=at_hour(3))).allowed
+    assert not plugin.admit(CandidateView("n"),
+                            view(now=at_hour(12))).allowed
+
+
+def test_window_budget_zero_when_closed_base_when_open():
+    plugin = MaintenanceWindowPolicy(windows=((2.0, 6.0),))
+    open_v = view(now=at_hour(3))
+    closed_v = view(now=at_hour(12))
+    assert plugin.budget(open_v) == DefaultPolicy().budget(open_v)
+    assert plugin.budget(closed_v) == Budget(
+        available=0, max_unavailable=3
+    )
+
+
+# -- cost/priority tiers ---------------------------------------------------
+
+def test_tier_of_parses_class_prefix():
+    assert tier_of("tier0-pool-a") == 0
+    assert tier_of("tier12-host-3") == 12
+    assert tier_of("tiered-pool") == DEFAULT_TIER  # no digits
+    assert tier_of("tier3x") == DEFAULT_TIER  # no dash after digits
+    assert tier_of("pool-a") == DEFAULT_TIER
+
+
+def test_cost_tiers_order_is_tier_then_degraded_first():
+    a = CandidateView("tier1-a", score=100.0, tier=1)
+    b = CandidateView("tier0-b", score=100.0, tier=0)
+    c = CandidateView("tier1-c", score=10.0, tier=1)  # degraded
+    d = CandidateView("plain-d", score=0.0, tier=DEFAULT_TIER)
+    assert CostTierPolicy().order([a, b, c, d]) == [b, c, a, d]
+
+
+# -- composition semantics -------------------------------------------------
+
+class _DenyAll:
+    name = "deny-all"
+
+    def admit(self, candidate, v):
+        return Decision(False, "deny-all says no")
+
+    def order(self, candidates):
+        return list(candidates)
+
+    def budget(self, v):
+        return Budget(available=1, max_unavailable=1)
+
+
+def test_composed_admit_first_deny_wins():
+    plugin = compose(("maintenance-window", "default"))
+    # Full-day default window: both allow.
+    assert plugin.admit(CandidateView("n"), view()).allowed
+    from k8s_operator_libs_tpu.policy.registry import _ComposedPolicy
+    denying = _ComposedPolicy([_DenyAll(), DefaultPolicy()])
+    decision = denying.admit(CandidateView("n"), view())
+    assert not decision.allowed and decision.reason == "deny-all says no"
+
+
+def test_composed_order_first_listed_is_most_significant():
+    plugin = compose(("cost-tiers", "default"))
+    low_tier_healthy = CandidateView("tier0-a", score=100.0, tier=0)
+    high_tier_degraded = CandidateView("tier9-b", score=1.0, tier=9)
+    # Tier dominates despite the worse health score downstream.
+    assert plugin.order([high_tier_degraded, low_tier_healthy]) == [
+        low_tier_healthy, high_tier_degraded
+    ]
+
+
+def test_composed_budget_is_componentwise_min():
+    from k8s_operator_libs_tpu.policy.registry import _ComposedPolicy
+    composed = _ComposedPolicy(
+        [MaintenanceWindowPolicy(windows=((2.0, 6.0),)), DefaultPolicy()]
+    )
+    closed_v = view(now=at_hour(12))
+    assert composed.budget(closed_v).available == 0  # window wins
+    open_v = view(now=at_hour(3))
+    assert composed.budget(open_v) == DefaultPolicy().budget(open_v)
+
+
+def test_composed_name_joins_members():
+    assert compose(
+        ("default", "maintenance-window")
+    ).name == "default+maintenance-window"
+
+
+# -- the fleet-vs-requestor refusal is the validator's ---------------------
+
+def test_worker_refusal_raises_typed_composition_error():
+    """Regression for the PR-13 bare-string refusal: grant gating plus
+    maintenance-operator delegation must refuse via the registry's
+    composition validator, with the conflicting policy names carried
+    structurally on the exception."""
+    from k8s_operator_libs_tpu.fleet import FleetWorkerConfig, ShardWorker
+    from k8s_operator_libs_tpu.kube import FakeCluster
+    from k8s_operator_libs_tpu.upgrade import (
+        ClusterUpgradeStateManager,
+        DeviceClass,
+        TaskRunner,
+    )
+    from k8s_operator_libs_tpu.upgrade.requestor import (
+        RequestorOptions,
+        enable_requestor_mode,
+    )
+
+    cluster = FakeCluster()
+    mgr = ClusterUpgradeStateManager(
+        cluster, DeviceClass.tpu(), runner=TaskRunner(inline=True)
+    )
+    enable_requestor_mode(
+        mgr, RequestorOptions(use_maintenance_operator=True)
+    )
+    with pytest.raises(PolicyCompositionError) as exc:
+        ShardWorker(
+            cluster,
+            FleetWorkerConfig(
+                identity="x", shards=1, namespace="driver-ns",
+                driver_labels={"app": "driver"},
+                rollout_name="fleet-roll",
+            ),
+            manager=mgr,
+        )
+    assert exc.value.policies == (
+        "fleet-grant-gate", "requestor-delegation"
+    )
+    assert "do not compose" in str(exc.value)

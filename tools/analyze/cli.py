@@ -147,7 +147,12 @@ def main(argv=None) -> int:
 
     report = _report_json(new, baselined, stale, args.paths)
     if args.stats:
+        from .policy_discipline import registered_policies
+
         stats = get_callgraph(project).stats()
+        # Policy-package coverage (docs/policy-plugins.md): how many
+        # registered policies the POL7xx family verified this run.
+        stats["policies"] = len(registered_policies(project))
         stats["findings"] = len(new) + len(baselined)
         report["stats"] = stats
         line = " ".join(f"{k}={v}" for k, v in stats.items())

@@ -1,0 +1,442 @@
+"""POL7xx — policy-plugin discipline (docs/policy-plugins.md).
+
+The policy package (``k8s_operator_libs_tpu/policy/``) promises that
+every registered plugin is a bundle of pure functions over frozen
+snapshot views — that promise is what lets the three tiers run
+arbitrary registered compositions inside their reconcile loops without
+new side-effect or replay hazards. NCCLbpf (PAPERS.md) ships the same
+shape: policies are small programs a VERIFIER proves safe before they
+run. This pass is that verifier, riding the PR-3 call graph and the
+DRY501 taint machinery (interproc.py):
+
+* **POL701** purity — a registered policy method transitively reaching
+  a client/provider mutator, the clock, or an RNG. A policy can never
+  write the cluster or be nondeterministic; clock-aware policies take
+  time through the injected ``BudgetView.now``.
+* **POL702** bounded iteration — ``while`` loops in a policy method
+  (snapshot views are finite collections; iterate them with ``for``),
+  or recursion through the call graph reachable from a policy method.
+* **POL703** snapshot discipline — a policy method stashing cross-call
+  state (``self.x = ...`` outside ``__init__``, ``global``/
+  ``nonlocal``, stores into module-level containers). Policies must be
+  replayable: same views in, same decisions out, every time.
+* **POL704** registration completeness — a class implementing the full
+  protocol (``admit``/``order``/``budget``) absent from the registry
+  (dead policy), or a registered name whose string appears nowhere
+  outside its own registration (no spec, fixture, or doc can ever
+  select it).
+* **POL705** decision totality — ``admit`` must return a ``Decision``
+  on every path (STM203-style exhaustiveness: no bare ``return``, no
+  fall-through, no truthy stand-ins).
+
+Registration is statically decidable because it is syntactically
+explicit — ``@register_policy("<literal>")`` (policy/registry.py); the
+registry rejects computed names by convention and this pass only
+recognizes literal ones.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from .core import AnalysisPass, ParsedModule, Project, register
+from .interproc import (
+    MAX_CHAIN,
+    _Engine,
+    _own_body_calls,
+    DryRunPurityPass,
+)
+from .lock_discipline import _dotted
+
+#: Dotted-call texts that read the clock — nondeterministic inputs a
+#: policy must take through the injected view (``BudgetView.now``), not
+#: fetch itself. ``wall_now``/``mono_now`` are the project's own clock
+#: indirection (utils/faultpoints.py) — virtualized under chaos, but
+#: still a clock read the replay contract forbids inside a policy.
+CLOCK_CALLS = {
+    "time.time", "time.monotonic", "time.perf_counter", "time.sleep",
+    "datetime.now", "datetime.utcnow", "datetime.datetime.now",
+    "datetime.datetime.utcnow", "date.today", "datetime.date.today",
+    "wall_now", "mono_now", "faultpoints.wall_now", "faultpoints.mono_now",
+}
+
+#: The policy protocol's method names — a class defining ALL of them
+#: implements the protocol (POL704's dead-policy leg).
+PROTOCOL_METHODS = ("admit", "order", "budget")
+
+#: Decision-shaped terminal names for POL705 (the decision enum's
+#: members plus the constructor/factory spellings).
+DECISION_NAMES = {"Decision", "ALLOW", "DENY", "allow", "deny"}
+
+
+def _registration_name(node: ast.ClassDef) -> Optional[tuple[str, int]]:
+    """(registered name, decorator line) when the class carries a
+    literal ``@register_policy("name")`` decorator."""
+    for deco in node.decorator_list:
+        if not isinstance(deco, ast.Call):
+            continue
+        func = deco.func
+        fname = func.id if isinstance(func, ast.Name) else (
+            func.attr if isinstance(func, ast.Attribute) else "")
+        if fname != "register_policy":
+            continue
+        if deco.args and isinstance(deco.args[0], ast.Constant) \
+                and isinstance(deco.args[0].value, str):
+            return deco.args[0].value, deco.lineno
+    return None
+
+
+def _class_defs(module: ParsedModule) -> Iterator[ast.ClassDef]:
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.ClassDef):
+            yield node
+
+
+def registered_policies(
+    project: Project,
+) -> list[tuple[ParsedModule, ast.ClassDef, str]]:
+    """Every literally-registered policy class in the project — also
+    the ``--stats`` coverage counter's source (cli.py), so the stats
+    line and this pass can never disagree about what is registered."""
+    out = []
+    for module in project.modules:
+        for node in _class_defs(module):
+            reg = _registration_name(node)
+            if reg is not None:
+                out.append((module, node, reg[0]))
+    return out
+
+
+def _method_defs(node: ast.ClassDef) -> dict[str, ast.AST]:
+    return {
+        child.name: child
+        for child in node.body
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+
+
+def _module_level_names(module: ParsedModule) -> set[str]:
+    names: set[str] = set()
+    for stmt in module.tree.body:
+        targets: list[ast.expr] = []
+        if isinstance(stmt, ast.Assign):
+            targets = list(stmt.targets)
+        elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            targets = [stmt.target]
+        for target in targets:
+            if isinstance(target, ast.Name):
+                names.add(target.id)
+    return names
+
+
+def _always_exits(stmts: list[ast.stmt]) -> bool:
+    """Conservative must-return/raise analysis (POL705): True when
+    control cannot fall off the end of ``stmts``."""
+    for stmt in stmts:
+        if isinstance(stmt, (ast.Return, ast.Raise)):
+            return True
+        if isinstance(stmt, ast.If) and stmt.orelse \
+                and _always_exits(stmt.body) and _always_exits(stmt.orelse):
+            return True
+        if isinstance(stmt, ast.With) and _always_exits(stmt.body):
+            return True
+        if isinstance(stmt, ast.Match) and stmt.cases \
+                and any(isinstance(c.pattern, ast.MatchAs)
+                        and c.pattern.pattern is None for c in stmt.cases) \
+                and all(_always_exits(c.body) for c in stmt.cases):
+            return True
+    return False
+
+
+@register
+class PolicyDisciplinePass(AnalysisPass):
+    name = "policy-discipline"
+    codes = ("POL701", "POL702", "POL703", "POL704", "POL705")
+
+    def run(self, project: Project) -> None:
+        engine = _Engine.for_project(project)
+        registered = registered_policies(project)
+        registered_names = {name for _, _, name in registered}
+
+        #: fid -> (module, method def) for every method defined on a
+        #: registered policy class — the verification surface.
+        policy_methods: dict[str, tuple[ParsedModule, ast.AST]] = {}
+        for module, node, _name in registered:
+            key_prefix = f"{module.display}::"
+            for mname, mdef in _method_defs(node).items():
+                fid = f"{key_prefix}{module.scope_at(mdef.lineno)}"
+                policy_methods[fid] = (module, mdef)
+
+        self._check_purity(engine, policy_methods)
+        self._check_bounded(engine, policy_methods)
+        self._check_snapshot_discipline(project, policy_methods)
+        self._check_registration(project, registered, registered_names)
+        self._check_totality(registered)
+
+    # -- POL701 — purity ---------------------------------------------------
+    def _impure_reason(self, engine: "_Engine", dp: DryRunPurityPass,
+                       family: set[str], summary) -> Optional[str]:
+        """Why this function is impure on its OWN (non-transitive) —
+        seeds for the up-callgraph fixpoint."""
+        for fact in summary.calls:
+            if dp._verb_call(engine, fact.node, fact.callees, family):
+                verb = (fact.node.func.attr
+                        if isinstance(fact.node.func, ast.Attribute)
+                        else "write")
+                return f"cluster mutation '{verb}'"
+        for node in _own_body_calls(summary.fi.node):
+            dotted = _dotted(node.func) or ""
+            if dp._verb_call(engine, node, (), family):
+                verb = (node.func.attr
+                        if isinstance(node.func, ast.Attribute) else "write")
+                return f"cluster mutation '{verb}'"
+            if dotted in CLOCK_CALLS:
+                return f"clock read '{dotted}'"
+            if dotted.startswith("random.") or dotted in (
+                    "uuid.uuid4", "secrets.token_hex", "os.urandom"):
+                return f"RNG call '{dotted}'"
+        return None
+
+    def _check_purity(self, engine, policy_methods) -> None:
+        dp = DryRunPurityPass()
+        family = dp._client_family(engine)
+        seed: dict[str, dict] = {}
+        for fid, summary in engine.summaries.items():
+            table: dict[tuple, tuple[str, tuple[str, ...]]] = {}
+            reason = self._impure_reason(engine, dp, family, summary)
+            if reason is not None:
+                table[()] = (reason, (fid,))
+            seed[fid] = table
+        facts = engine.propagate(
+            seed,
+            lambda fid, v: (v[0], ((fid,) + v[1])[:MAX_CHAIN]),
+        )
+        for fid, (module, mdef) in sorted(policy_methods.items()):
+            hit = facts.get(fid, {}).get(())
+            if hit is None:
+                continue
+            reason, chain = hit
+            self.add(
+                module, mdef, "POL701",
+                f"policy method is impure: {reason} reachable via "
+                f"{engine.chain_text(chain)} — policies must be pure "
+                f"functions of their views (inject time through "
+                f"BudgetView.now)",
+            )
+
+    # -- POL702 — bounded iteration ----------------------------------------
+    def _check_bounded(self, engine, policy_methods) -> None:
+        for fid, (module, mdef) in sorted(policy_methods.items()):
+            for node in ast.walk(mdef):
+                if isinstance(node, ast.While):
+                    self.add(
+                        module, node, "POL702",
+                        "unbounded iteration: 'while' in a policy method "
+                        "— iterate the (finite) snapshot views with "
+                        "'for' instead",
+                    )
+            cycle = self._cycle_from(engine, fid)
+            if cycle is not None:
+                self.add(
+                    module, mdef, "POL702",
+                    f"unbounded recursion reachable from policy method: "
+                    f"{engine.chain_text(tuple(cycle))} -> "
+                    f"{engine.qualname(cycle[0])}",
+                )
+
+    @staticmethod
+    def _cycle_from(engine, start: str) -> Optional[list[str]]:
+        """First call-graph cycle reachable from ``start`` (DFS with an
+        explicit stack — analysis code must not recurse)."""
+        path: list[str] = []
+        on_path: set[str] = set()
+        done: set[str] = set()
+        work: list[tuple[str, int]] = [(start, 0)]
+        while work:
+            fid, idx = work[-1]
+            if idx == 0:
+                path.append(fid)
+                on_path.add(fid)
+            summary = engine.summaries.get(fid)
+            callees: list[str] = []
+            if summary is not None:
+                for fact in summary.calls:
+                    callees.extend(fact.callees)
+            advanced = False
+            for i in range(idx, len(callees)):
+                callee = callees[i]
+                if callee in on_path:
+                    j = path.index(callee)
+                    return path[j:][:MAX_CHAIN]
+                if callee not in done and callee in engine.summaries:
+                    work[-1] = (fid, i + 1)
+                    work.append((callee, 0))
+                    advanced = True
+                    break
+            if advanced:
+                continue
+            work.pop()
+            on_path.discard(fid)
+            done.add(fid)
+            path.pop()
+        return None
+
+    # -- POL703 — snapshot discipline --------------------------------------
+    def _check_snapshot_discipline(self, project, policy_methods) -> None:
+        module_globals = {
+            module.display: _module_level_names(module)
+            for module in project.modules
+        }
+        for fid, (module, mdef) in sorted(policy_methods.items()):
+            if getattr(mdef, "name", "") == "__init__":
+                # Construction wires configuration (window tables, tier
+                # maps); the replay contract binds the DECISION methods.
+                continue
+            globals_here = module_globals.get(module.display, set())
+            for node in ast.walk(mdef):
+                if isinstance(node, (ast.Global, ast.Nonlocal)):
+                    self.add(
+                        module, node, "POL703",
+                        f"policy method declares "
+                        f"'{'global' if isinstance(node, ast.Global) else 'nonlocal'}"
+                        f" {', '.join(node.names)}' — policies may read "
+                        "only their view parameters",
+                    )
+                    continue
+                targets: list[ast.expr] = []
+                if isinstance(node, ast.Assign):
+                    targets = list(node.targets)
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [node.target]
+                for target in targets:
+                    if isinstance(target, ast.Attribute) and isinstance(
+                            target.value, ast.Name) and target.value.id in (
+                            "self", "cls"):
+                        self.add(
+                            module, node, "POL703",
+                            f"policy method stashes cross-call state "
+                            f"('self.{target.attr} = ...') — decisions "
+                            "must be replayable from the views alone",
+                        )
+                    elif isinstance(target, (ast.Subscript, ast.Attribute)):
+                        root = target
+                        while isinstance(root, (ast.Subscript, ast.Attribute)):
+                            root = root.value
+                        if isinstance(root, ast.Name) \
+                                and root.id in ("self", "cls"):
+                            self.add(
+                                module, node, "POL703",
+                                "policy method stashes cross-call state "
+                                "in a self-held container — decisions "
+                                "must be replayable from the views alone",
+                            )
+                        elif isinstance(root, ast.Name) \
+                                and root.id in globals_here:
+                            self.add(
+                                module, node, "POL703",
+                                f"policy method mutates module-level "
+                                f"state '{root.id}' — decisions must be "
+                                "replayable from the views alone",
+                            )
+
+    # -- POL704 — registration completeness --------------------------------
+    def _check_registration(self, project, registered, registered_names):
+        # Leg 1: protocol implementors absent from the registry. The
+        # protocol class itself, Protocol subclasses, and private
+        # combinator classes (the composition wrapper) are exempt.
+        registered_nodes = {id(node) for _, node, _ in registered}
+        for module in project.modules:
+            for node in _class_defs(module):
+                if id(node) in registered_nodes:
+                    continue
+                if node.name.startswith("_"):
+                    continue
+                base_names = {
+                    b.id if isinstance(b, ast.Name)
+                    else b.attr if isinstance(b, ast.Attribute) else ""
+                    for b in node.bases
+                }
+                if "Protocol" in base_names or node.name == "UpgradePolicy":
+                    continue
+                methods = _method_defs(node)
+                if all(m in methods for m in PROTOCOL_METHODS):
+                    self.add(
+                        module, node, "POL704",
+                        f"class '{node.name}' implements the policy "
+                        "protocol (admit/order/budget) but is not "
+                        "registered — dead policy no spec can select "
+                        "(add @register_policy or prefix with '_')",
+                    )
+        # Leg 2: registered names nothing references. One quoted
+        # occurrence is the registration itself; a name with no OTHER
+        # occurrence (spec fixture, composition list, conflict table,
+        # doc) is unreachable from any spec.
+        for module, node, name in registered:
+            occurrences = 0
+            for m in project.modules:
+                occurrences += m.source.count(f'"{name}"')
+                occurrences += m.source.count(f"'{name}'")
+            if occurrences <= 1:
+                self.add(
+                    module, node, "POL704",
+                    f"registered policy name '{name}' is unreferenced "
+                    "outside its own registration — no spec, "
+                    "composition, or doc selects it",
+                )
+
+    # -- POL705 — decision totality ----------------------------------------
+    def _decision_shaped(self, expr: ast.expr,
+                         shaped_locals: set[str]) -> bool:
+        if isinstance(expr, ast.Call):
+            func = expr.func
+            fname = func.id if isinstance(func, ast.Name) else (
+                func.attr if isinstance(func, ast.Attribute) else "")
+            return fname in DECISION_NAMES
+        if isinstance(expr, ast.Name):
+            return expr.id in DECISION_NAMES or expr.id in shaped_locals
+        if isinstance(expr, ast.Attribute):
+            return expr.attr in DECISION_NAMES
+        if isinstance(expr, ast.IfExp):
+            return (self._decision_shaped(expr.body, shaped_locals)
+                    and self._decision_shaped(expr.orelse, shaped_locals))
+        return False
+
+    def _check_totality(self, registered) -> None:
+        for module, node, name in registered:
+            admit = _method_defs(node).get("admit")
+            if admit is None:
+                continue
+            shaped_locals: set[str] = set()
+            for sub in ast.walk(admit):
+                if isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                        and isinstance(sub.targets[0], ast.Name) \
+                        and self._decision_shaped(sub.value, shaped_locals):
+                    shaped_locals.add(sub.targets[0].id)
+            returns = [
+                sub for sub in ast.walk(admit)
+                if isinstance(sub, ast.Return)
+            ]
+            for ret in returns:
+                if ret.value is None:
+                    self.add(
+                        module, ret, "POL705",
+                        f"policy '{name}': admit has a bare return — "
+                        "every path must return a Decision "
+                        "(ALLOW or Decision(False, reason))",
+                    )
+                elif not self._decision_shaped(ret.value, shaped_locals):
+                    self.add(
+                        module, ret, "POL705",
+                        f"policy '{name}': admit returns a "
+                        "non-Decision value — truthy stand-ins break "
+                        "the composition combinator's deny "
+                        "short-circuit",
+                    )
+            if not _always_exits(admit.body):
+                self.add(
+                    module, admit, "POL705",
+                    f"policy '{name}': admit can fall off the end "
+                    "(implicit None) — every path must return a "
+                    "Decision",
+                )

@@ -38,6 +38,11 @@ RULE_TEXT = {
     "ASY602": "coroutine never awaited / task handle not retained",
     "ASY603": "threading lock held across an await",
     "ASY604": "loop-bound state mutated from a non-loop thread",
+    "POL701": "policy method reaches a mutator, clock, or RNG (impure)",
+    "POL702": "unbounded iteration/recursion in a policy method",
+    "POL703": "policy stashes cross-call state outside its views",
+    "POL704": "unregistered protocol implementor / unreferenced name",
+    "POL705": "admit does not return a Decision on every path",
 }
 
 

@@ -42,6 +42,7 @@ def build_config(args):
         checkpoint=args.checkpoint,
         wire=args.wire,
         max_steps=args.max_steps,
+        policy=tuple(args.policy or ()),
     )
 
 
@@ -82,6 +83,16 @@ def main(argv=None) -> int:
                              "checkpoint scenario)")
     parser.add_argument("--wire", action="store_true",
                         help="run over a LocalApiServer (arms wire_kill)")
+    parser.add_argument("--policy", action="append", default=None,
+                        metavar="NAME",
+                        help="compose this registered upgrade policy "
+                             "into the pools' spec (repeatable; "
+                             "docs/policy-plugins.md)")
+    parser.add_argument("--policy-matrix", action="store_true",
+                        help="corpus mode: sweep the shipped policy "
+                             "compositions (standard_compositions) over "
+                             "the seed corpus; fails on any budget "
+                             "violation")
     parser.add_argument("--quiet", action="store_true")
     args = parser.parse_args(argv)
 
@@ -189,6 +200,25 @@ def main(argv=None) -> int:
         }
         print(json.dumps(line, sort_keys=True), file=sys.stderr)
 
+    if args.policy_matrix:
+        if args.policy:
+            parser.error(
+                "--policy-matrix sweeps the shipped compositions; it "
+                "does not compose with --policy"
+            )
+        from k8s_operator_libs_tpu.testing.chaos import run_policy_matrix
+
+        summary = run_policy_matrix(
+            range(args.start_seed, args.start_seed + args.seeds),
+            config,
+            on_result=progress,
+        )
+        print(json.dumps(summary, sort_keys=True))
+        failed = (
+            summary["invariant_violations"] or summary["not_converged"]
+        )
+        return 1 if failed else 0
+
     summary = run_corpus(
         range(args.start_seed, args.start_seed + args.seeds),
         config,
@@ -210,6 +240,8 @@ def main(argv=None) -> int:
         for switch in ("hub", "checkpoint", "wire"):
             if getattr(args, switch):
                 flags.append(f"--{switch}")
+        for name in args.policy or ():
+            flags.append(f"--policy {name}")
         print(
             "reproduce with: python -m tools.chaos_run "
             f"--seed {seed} {' '.join(flags)} "
