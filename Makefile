@@ -44,8 +44,13 @@ lint: analyze
 # coroutines/await edges) so CI logs show analysis-coverage drift over
 # time. Scope includes the chaos driver and the flight-recorder CLI —
 # correctness infrastructure is analyzed like shipped code (ISSUE 15).
+# The second line gates bench.py on the lifecycle pass alone (LIF8xx,
+# baseline disabled): every informer/worker/hub/server the bench
+# sections acquire must release on all paths (docs/daemon-lifecycle.md),
+# while bench's non-lifecycle debt stays out of the full-pass scope.
 analyze:
 	$(PYTHON) tools/analyze.py k8s_operator_libs_tpu tools/chaos_run.py tools/trace_view.py --stats $(ANALYZE_FLAGS)
+	$(PYTHON) tools/analyze.py k8s_operator_libs_tpu bench.py --select lifecycle-discipline --baseline -
 
 test:
 	$(PYTHON) -m pytest tests/ -x -q

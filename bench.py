@@ -1810,56 +1810,60 @@ def run_fleet_64_pools(
 
             budget = rollout_spec(rollout).resolved_budget()  # 16 at 64
             aggregator = FleetHealthAggregator(pool_of)
-            hub = hub_client = None
-            if use_hub:
-                # ONE hub (own client) multiplexing every co-hosted
-                # worker's watches: upstream streams stop scaling with
-                # worker count (docs/wire-path.md "Watch hub").
-                hub_client = RestClient(RestConfig(server=srv.url))
-                hub = WatchHub(hub_client)
+            hub = hub_client = orch_client = None
             workers, clients = [], []
-            for i in range(n_workers):
-                client = RestClient(RestConfig(server=srv.url))
-                worker = ShardWorker(
-                    client,
-                    FleetWorkerConfig(
-                        identity=f"worker-{i}",
-                        shards=shards,
-                        namespace=NS,
-                        driver_labels=DS_LABELS,
-                        pool_of=pool_of,
-                        rollout_name="fleet-roll",
-                        # Round-robin preference: deterministic balance
-                        # for the scaling comparison.
-                        preferred_shards=[
-                            shard_id(j)
-                            for j in range(shards)
-                            if j % n_workers == i
-                        ],
-                        lease_duration_s=5.0,
-                        renew_deadline_s=3.0,
-                        retry_period_s=0.5,
-                        with_health=True,
-                        watch_hub=hub,
-                    ),
-                )
-                worker.start(sync_timeout=60)
-                aggregator.add_source(worker.health)
-                workers.append(worker)
-                clients.append(client)
-            orch_client = RestClient(RestConfig(server=srv.url))
-            orchestrator = FleetOrchestrator(
-                orch_client, "fleet-roll", aggregator=aggregator
-            )
-            policy = _Policy(
-                auto_upgrade=True,
-                max_parallel_upgrades=0,
-                # Permissive per-pool budget: the GRANT is the budget in
-                # the fleet shape (docs/fleet-control-plane.md).
-                max_unavailable=IntOrString("100%"),
-            )
             stop = threading.Event()
+            # Acquisitions live INSIDE the try: a failed start of
+            # worker N must still drain workers 0..N-1 and the hub
+            # (LIF802 — the informer-leak review class, now a pass).
             try:
+                if use_hub:
+                    # ONE hub (own client) multiplexing every co-hosted
+                    # worker's watches: upstream streams stop scaling
+                    # with worker count (docs/wire-path.md "Watch hub").
+                    hub_client = RestClient(RestConfig(server=srv.url))
+                    hub = WatchHub(hub_client)
+                for i in range(n_workers):
+                    client = RestClient(RestConfig(server=srv.url))
+                    worker = ShardWorker(
+                        client,
+                        FleetWorkerConfig(
+                            identity=f"worker-{i}",
+                            shards=shards,
+                            namespace=NS,
+                            driver_labels=DS_LABELS,
+                            pool_of=pool_of,
+                            rollout_name="fleet-roll",
+                            # Round-robin preference: deterministic
+                            # balance for the scaling comparison.
+                            preferred_shards=[
+                                shard_id(j)
+                                for j in range(shards)
+                                if j % n_workers == i
+                            ],
+                            lease_duration_s=5.0,
+                            renew_deadline_s=3.0,
+                            retry_period_s=0.5,
+                            with_health=True,
+                            watch_hub=hub,
+                        ),
+                    )
+                    clients.append(client)
+                    workers.append(worker)
+                    worker.start(sync_timeout=60)
+                    aggregator.add_source(worker.health)
+                orch_client = RestClient(RestConfig(server=srv.url))
+                orchestrator = FleetOrchestrator(
+                    orch_client, "fleet-roll", aggregator=aggregator
+                )
+                policy = _Policy(
+                    auto_upgrade=True,
+                    max_parallel_upgrades=0,
+                    # Permissive per-pool budget: the GRANT is the
+                    # budget in the fleet shape
+                    # (docs/fleet-control-plane.md).
+                    max_unavailable=IntOrString("100%"),
+                )
                 # Settle: every shard claimed and every straggler report
                 # folded before the first grant round (deadline-driven).
                 deadline = time.time() + 60
@@ -2021,7 +2025,8 @@ def run_fleet_64_pools(
                     client.close()
                 if hub_client is not None:
                     hub_client.close()
-                orch_client.close()
+                if orch_client is not None:
+                    orch_client.close()
 
     configs = {f"workers_{n}": one_config(n) for n in worker_counts}
     configs[f"workers_{worker_counts[-1]}_hub"] = one_config(
@@ -2153,41 +2158,44 @@ def run_trace_attribution(
         rollout = make_fleet_rollout("fleet-roll", pool_names, "25%")
         srv.cluster.create(KubeObject(rollout))
         workers, clients = [], []
-        for i in range(n_workers):
-            client = RestClient(RestConfig(server=srv.url))
-            worker = ShardWorker(
-                client,
-                FleetWorkerConfig(
-                    identity=f"worker-{i}",
-                    shards=shards,
-                    namespace=NS,
-                    driver_labels=DS_LABELS,
-                    pool_of=pool_of,
-                    rollout_name="fleet-roll",
-                    preferred_shards=[
-                        shard_id(j) for j in range(shards)
-                        if j % n_workers == i
-                    ],
-                    lease_duration_s=5.0,
-                    renew_deadline_s=3.0,
-                    retry_period_s=0.5,
-                    batch_writes=batch_writes,
-                ),
-            )
-            worker.start(sync_timeout=60)
-            workers.append(worker)
-            clients.append(client)
-        orch_client = RestClient(RestConfig(server=srv.url))
-        orchestrator = FleetOrchestrator(orch_client, "fleet-roll")
-        policy = _Policy(
-            auto_upgrade=True,
-            max_parallel_upgrades=0,
-            max_unavailable=IntOrString("100%"),
-        )
+        orch_client = None
         stop = threading.Event()
         tracer = tracing.Tracer()
         installed = False
+        # Acquisitions inside the try: a failed start of worker N must
+        # still drain workers 0..N-1 (LIF802).
         try:
+            for i in range(n_workers):
+                client = RestClient(RestConfig(server=srv.url))
+                worker = ShardWorker(
+                    client,
+                    FleetWorkerConfig(
+                        identity=f"worker-{i}",
+                        shards=shards,
+                        namespace=NS,
+                        driver_labels=DS_LABELS,
+                        pool_of=pool_of,
+                        rollout_name="fleet-roll",
+                        preferred_shards=[
+                            shard_id(j) for j in range(shards)
+                            if j % n_workers == i
+                        ],
+                        lease_duration_s=5.0,
+                        renew_deadline_s=3.0,
+                        retry_period_s=0.5,
+                        batch_writes=batch_writes,
+                    ),
+                )
+                clients.append(client)
+                workers.append(worker)
+                worker.start(sync_timeout=60)
+            orch_client = RestClient(RestConfig(server=srv.url))
+            orchestrator = FleetOrchestrator(orch_client, "fleet-roll")
+            policy = _Policy(
+                auto_upgrade=True,
+                max_parallel_upgrades=0,
+                max_unavailable=IntOrString("100%"),
+            )
             # Settle the shard claims BEFORE installing the tracer so
             # the trace window is the roll, not the lease warm-up.
             deadline = time.time() + 60
@@ -2280,7 +2288,8 @@ def run_trace_attribution(
                 worker.stop()
             for client in clients:
                 client.close()
-            orch_client.close()
+            if orch_client is not None:
+                orch_client.close()
 
     path = trace_path or os.environ.get(
         "BENCH_TRACE_PATH", "trace-fleet-roll.jsonl"
@@ -3250,12 +3259,19 @@ def run_grant_latency(
                 stop.set()
                 tracing.clear_tracer()
                 if wake is not None:
+                    # Release a wait parked on the fallback cadence so
+                    # the worker thread notices stop now.
+                    wake.poke()
+                if worker_thread is not None:
+                    worker_thread.join(timeout=10)
+                # Reverse dependency order (LIF804): the consumers
+                # (worker thread, worker) drain BEFORE the wakes that
+                # feed them, the wakes before their client.
+                worker.stop()
+                if wake is not None:
                     wake.stop()
                 if orch_wake is not None:
                     orch_wake.stop()
-                if worker_thread is not None:
-                    worker_thread.join(timeout=10)
-                worker.stop()
                 client.close()
                 orch_client.close()
 

@@ -17,14 +17,13 @@ from __future__ import annotations
 import argparse
 import logging
 import os
-import signal
 import sys
-import time
 
 # Allow running straight from a checkout without installation.
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from k8s_operator_libs_tpu.api import DriverUpgradePolicySpec
+from k8s_operator_libs_tpu.runtime import FuncComponent, Supervisor
 from k8s_operator_libs_tpu.upgrade import (
     ClusterUpgradeStateManager,
     DeviceClass,
@@ -74,6 +73,7 @@ def build_demo(args):
     )
 
     cluster = FakeCluster()
+    node_names = []
     for i in range(4):
         node = Node.new(
             f"v5e-16-pool-{i}",
@@ -85,6 +85,21 @@ def build_demo(args):
         )
         node.set_ready(True)
         cluster.create(node)
+        node_names.append(node.name)
+    if args.fleet_rollout:
+        # Fleet demo: seed the FleetRollout ledger the orchestrator
+        # grants from. The worker's default pool_of is node-name =
+        # pool-key, so each host is its own "pool"; a 50% budget makes
+        # the grant waves visible — two pools roll, their completions
+        # free budget, the orchestrator grants the next two.
+        from k8s_operator_libs_tpu.api import make_fleet_rollout
+        from k8s_operator_libs_tpu.kube.objects import KubeObject
+
+        cluster.create(
+            KubeObject(
+                make_fleet_rollout(args.fleet_rollout, node_names, "50%")
+            )
+        )
     sim = DaemonSetSimulator(
         cluster,
         name="libtpu-installer",
@@ -206,6 +221,18 @@ def main(argv: list[str] | None = None) -> int:
         "policy budget",
     )
     parser.add_argument(
+        "--orchestrate",
+        action="store_true",
+        help="also run the fleet orchestrator in this process as a "
+        "supervised daemon (docs/daemon-lifecycle.md): campaigns for "
+        "the 'fleet-orchestrator' Lease and, while leading, issues "
+        "pool-roll grants against --fleet-rollout's global disruption "
+        "budget. Run it on any number of replicas — only the lease "
+        "holder ticks, and a stopped holder releases the lease eagerly "
+        "so a standby takes over with zero TTL wait. Requires "
+        "--fleet-rollout",
+    )
+    parser.add_argument(
         "--leader-elect",
         action="store_true",
         help="campaign for a coordination.k8s.io Lease before reconciling "
@@ -232,27 +259,27 @@ def main(argv: list[str] | None = None) -> int:
         "on exit — inspect with `python -m tools.trace_view PATH`",
     )
     args = parser.parse_args(argv)
+    if args.orchestrate and not args.fleet_rollout:
+        parser.error("--orchestrate requires --fleet-rollout")
     logging.basicConfig(level=logging.INFO, format="%(levelname)s %(message)s")
 
-    # Graceful termination, installed before anything acquires resources:
-    # a terminating controller pod (kubelet sends SIGTERM) must release
-    # its Lease on the way down so a standby takes over immediately. The
-    # handler raises SystemExit; the try/finally around the campaign and
-    # reconcile loop does the one cleanup.
-    def _on_signal(signum, frame):
-        print(
-            f"received signal {signum}; shutting down gracefully",
-            file=sys.stderr,
-        )
-        raise SystemExit(0)
+    # Graceful termination as data, not control flow
+    # (docs/daemon-lifecycle.md): the Supervisor owns every background
+    # component this process acquires and drains them in reverse
+    # dependency order, each under a bounded budget. SIGTERM/SIGINT are
+    # routed to a plain Event — the handler takes no locks and touches
+    # no loop (the LIF805 contract) — and the reconcile loop observes
+    # ``stop_requested`` and returns; the one finally below runs the
+    # drain. A terminating controller pod (kubelet sends SIGTERM)
+    # releases its Leases EAGERLY on the way down, so a standby takes
+    # over immediately instead of waiting out the lease TTL.
+    sup = Supervisor(drain_timeout_s=30.0, component_timeout_s=10.0)
+    sup.install_signal_handlers()
 
-    signal.signal(signal.SIGTERM, _on_signal)
-
-    # One try spanning ALL resource acquisition, so the SIGTERM
-    # handler's SystemExit always reaches the finally below.
-    informers = []
+    # One try spanning ALL resource acquisition: components are adopted
+    # into the supervisor the moment they start, so a signal landing
+    # mid-setup still drains everything acquired so far.
     elector = None
-    metrics_server = None
     queue = None
     worker = None
     tracer = None
@@ -413,6 +440,16 @@ def main(argv: list[str] | None = None) -> int:
             )
 
             queue = RateLimitingQueue(default_controller_rate_limiter())
+            # The queue consumes informer deltas: it drains FIRST, so
+            # nothing enqueues into a half-stopped trigger path.
+            queue_deps = ["shard-worker" if worker is not None
+                          else "snapshot-source"]
+            if args.requestor:
+                queue_deps.append("nm-informer")
+            sup.adopt(
+                FuncComponent("workqueue", stop=queue.shutdown),
+                depends_on=queue_deps,
+            )
 
             def enqueue_node(event_type, obj, old):
                 queue.add(obj.name)
@@ -479,7 +516,7 @@ def main(argv: list[str] | None = None) -> int:
             snapshot_source.informer("Pod").add_event_handler(enqueue_pod_node)
             for kind in ("DaemonSet", "ControllerRevision"):
                 snapshot_source.informer(kind).add_event_handler(enqueue_world)
-            informers = []
+            nm_informer = None
             if args.requestor:
                 nm_informer = Informer(client, "NodeMaintenance")
                 nm_informer.add_event_handler(maintenance_enqueue)
@@ -487,11 +524,15 @@ def main(argv: list[str] | None = None) -> int:
                 # deltas: map each CR to its node's dirty mark (a CR the
                 # mapping cannot place degrades to a full invalidation).
                 snapshot_source.mark_dirty_on(nm_informer, nm_node_names)
-                informers.append(nm_informer)
-            # Start all, THEN wait: sequential start+wait would serialize the
-            # sync latency across informers.
-            for informer in informers:
-                informer.start()
+                nm_informer.start()
+                # The source consumes its dirty marks, so the source
+                # stops before it; the elector (if any) outlives both.
+                sup.adopt(
+                    FuncComponent("nm-informer", stop=nm_informer.stop),
+                    depends_on=(
+                        ["leader-elector"] if args.leader_elect else []
+                    ),
+                )
             # start() blocks until the snapshot stores are seeded — a
             # snapshot taken before sync would be empty, not stale.
             if worker is not None:
@@ -501,20 +542,69 @@ def main(argv: list[str] | None = None) -> int:
                 mgr.snapshot_source = snapshot_source
                 mgr.provider.set_write_through(snapshot_source.record_write)
                 mgr.common.pod_manager.revision_source = snapshot_source
-                informers.append(snapshot_source)  # stopped with the rest
-            for informer in informers:
-                if informer is snapshot_source:
-                    continue
-                if not informer.wait_for_sync(timeout=30):
-                    logging.warning(
-                        "%s informer did not sync within 30s; reconciles may "
-                        "miss its triggers until it catches up", informer.kind,
-                    )
+                source_deps = ["nm-informer"] if args.requestor else []
+                if args.leader_elect:
+                    source_deps.append("leader-elector")
+                sup.adopt(
+                    FuncComponent(
+                        "snapshot-source", stop=snapshot_source.stop
+                    ),
+                    depends_on=source_deps,
+                )
+            if nm_informer is not None and not nm_informer.wait_for_sync(
+                timeout=30
+            ):
+                logging.warning(
+                    "%s informer did not sync within 30s; reconciles may "
+                    "miss its triggers until it catches up", nm_informer.kind,
+                )
 
-        if worker is not None and not worker.source.started:
-            # Fleet mode without --watch: the scoped source still needs
-            # its informers up before the first tick snapshots.
-            worker.start(sync_timeout=30)
+        if worker is not None:
+            if not worker.source.started:
+                # Fleet mode without --watch: the scoped source still needs
+                # its informers up before the first tick snapshots.
+                worker.start(sync_timeout=30)
+            # worker.stop() releases the per-shard Leases eagerly
+            # (standbys take over with zero TTL wait) and stops the
+            # scoped source + health informer.
+            worker_deps = ["nm-informer"] if (
+                args.watch and not args.demo and args.requestor
+            ) else []
+            if args.leader_elect:
+                worker_deps.append("leader-elector")
+            sup.adopt(
+                FuncComponent("shard-worker", stop=worker.stop),
+                depends_on=worker_deps,
+            )
+
+        if args.orchestrate:
+            import socket
+
+            from k8s_operator_libs_tpu.runtime import OrchestratorDaemon
+
+            identity = (
+                args.leader_elect_id or f"{socket.gethostname()}_{os.getpid()}"
+            )
+            # OrchestratorDaemon is a Component outright: its own
+            # 'fleet-orchestrator' leader election, watch-driven tick
+            # wakeups, one non-daemon tick-loop thread — stop() drains
+            # them in reverse dependency order and releases the lease
+            # eagerly.
+            orchestrator = OrchestratorDaemon(
+                client,
+                args.fleet_rollout,
+                namespace=args.namespace,
+                identity=identity,
+                # The demo's reconcile loop runs at full tilt; grant
+                # rounds must keep pace or passes burn waiting.
+                interval_s=0.1 if args.demo else min(args.interval, 2.0),
+                use_wakeups=not args.demo,
+            )
+            orchestrator.start()
+            sup.adopt(orchestrator)
+            print(
+                f"fleet orchestrator: campaigning as {identity!r}", flush=True
+            )
 
         metrics = None
         if args.metrics_port:
@@ -525,6 +615,7 @@ def main(argv: list[str] | None = None) -> int:
                 metrics, port=args.metrics_port, host=args.metrics_host
             ).start()
             print(f"metrics: {metrics_server.url}")
+            sup.adopt(FuncComponent("metrics", stop=metrics_server.stop))
 
         if args.leader_elect:
             import socket
@@ -546,33 +637,32 @@ def main(argv: list[str] | None = None) -> int:
                     identity=identity,
                 ),
             ).start()
+            # No depends_on: everything that consumes leadership names
+            # this component, so the elector drains LAST — the lease is
+            # released eagerly only after the work it gated has stopped.
+            sup.adopt(FuncComponent("leader-elector", stop=elector.stop))
             print(f"leader election: campaigning as {identity!r}", flush=True)
-            elector.wait_for_leadership()
+            while not elector.wait_for_leadership(timeout=0.5):
+                if sup.stop_requested:
+                    return 0
             print("leader election: leading; starting reconciles", flush=True)
 
         return _reconcile_loop(
             args, mgr, policy, selector, elector, queue,
             metrics, sim, maintenance_sim, validation_pod_sim,
-            worker=worker,
+            worker=worker, sup=sup,
         )
     finally:
         # Every exit path — convergence, --once, lease lost, SIGTERM
-        # (even mid-setup), unhandled error — stops the informers, the
-        # workqueue, and the metrics server and releases the Lease
-        # (release is a no-op when this replica never held or no longer
-        # holds it).
-        if queue is not None:
-            queue.shutdown()
-        for informer in informers:
-            informer.stop()
-        if worker is not None:
-            # Releases the per-shard Leases (standbys take over
-            # immediately) and stops the scoped source + health informer.
-            worker.stop()
-        if metrics_server is not None:
-            metrics_server.stop()
-        if elector is not None:
-            elector.stop()
+        # (even mid-setup), unhandled error — drains whatever the
+        # supervisor adopted: consumers before producers (the LIF804
+        # stop order), each release under a bounded budget, every
+        # non-daemon thread joined with a deadline, Leases released
+        # eagerly (release is a no-op when this replica never held or
+        # no longer holds one). The tracer flushes after every
+        # span-producing component has stopped.
+        sup.stop()
+        sup.restore_signal_handlers()
         if tracer is not None:
             from k8s_operator_libs_tpu.utils import tracing
 
@@ -587,10 +677,17 @@ def main(argv: list[str] | None = None) -> int:
 def _reconcile_loop(
     args, mgr, policy, selector, elector, queue,
     metrics, sim, maintenance_sim, validation_pod_sim,
-    worker=None,
+    worker=None, sup=None,
 ):
     passes = 0
-    max_demo_passes = 100  # a 4-node roll converges in <15; 100 = stuck
+    # A 4-node roll converges in <40 passes; the fleet demo spends extra
+    # passes between grant waves (the orchestrator ticks on its own
+    # clock), so its stuck-roll ceiling is looser.
+    max_demo_passes = 300 if args.fleet_rollout else 100
+    # The fleet demo paces its passes slightly so grant rounds (issued
+    # by the orchestrator daemon's thread) land between them; the plain
+    # demo spins at full speed as before.
+    demo_pause = 0.02 if args.fleet_rollout else 0.0
     consecutive_failures = 0
     #: Workqueue keys the CURRENT pass is reconciling (watch mode). A
     #: whole-world pass covers every key, so one batch drain per pass;
@@ -600,6 +697,13 @@ def _reconcile_loop(
     #: whole-loop delay.
     keys: list = []
     while True:
+        if sup is not None and sup.stop_requested:
+            # SIGTERM/SIGINT landed (or request_stop() was called): the
+            # handler only set the event — THIS is where the daemon
+            # acts on it, from ordinary code. The caller's finally runs
+            # the supervised drain.
+            print("shutdown requested; draining", file=sys.stderr)
+            return 0
         if elector is not None and not elector.is_leader():
             # controller-runtime semantics: a deposed leader must never
             # keep reconciling — exit and let the restart policy
@@ -631,7 +735,7 @@ def _reconcile_loop(
                         f"pass {passes}: no shards owned "
                         f"(campaigning for {sorted(worker.shards)})"
                     )
-                    time.sleep(args.interval if sim is None else 0.0)
+                    sup.wait(args.interval if sim is None else demo_pause)
                     continue
             else:
                 state = mgr.build_state(args.namespace, selector)
@@ -678,7 +782,10 @@ def _reconcile_loop(
                 f"(retry #{consecutive_failures} in {delay:.1f}s): {e}",
                 file=sys.stderr,
             )
-            time.sleep(0.0 if sim is not None else delay)
+            # The backoff sleep doubles as the shutdown wait: a signal
+            # mid-backoff wakes it immediately instead of riding out
+            # the delay.
+            sup.wait(0.0 if sim is not None else delay)
             continue
         consecutive_failures = 0
         if queue is not None:
@@ -729,7 +836,7 @@ def _reconcile_loop(
             # periodic resync fallback: reconcile anyway.
             keys = queue.get_batch(timeout=args.interval)
         else:
-            time.sleep(args.interval if sim is None else 0.0)
+            sup.wait(args.interval if sim is None else demo_pause)
 
 
 if __name__ == "__main__":

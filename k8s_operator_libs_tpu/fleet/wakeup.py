@@ -27,11 +27,13 @@ opt-in wiring for the bench, the example CLI, and real deployments.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Optional, Sequence
 
 from ..kube.client import Client, WatchExpiredError
 from ..utils import tracing
 from ..utils.log import get_logger
+from ..utils.lifecycle import lifecycle_resource
 
 log = get_logger("fleet.wakeup")
 
@@ -40,6 +42,7 @@ log = get_logger("fleet.wakeup")
 WATCH_WINDOW_SECONDS = 5
 
 
+@lifecycle_resource(acquire="__init__", release="stop")
 class WatchWake:
     """Wake an event-driven tick loop on watch delivery for any of
     ``kinds``. One instance per tick loop; ``wait()`` from the loop
@@ -101,10 +104,26 @@ class WatchWake:
             out, self._traces = self._traces, []
             return out
 
-    def stop(self) -> None:
+    def poke(self) -> None:
+        """Release the current :meth:`wait` immediately without a
+        delivery — the supervisor's drain uses this so a loop parked on
+        the fallback cadence notices stop now, not one interval later."""
+        self._event.set()
+
+    def stop(self, join_timeout: Optional[float] = None) -> None:
         self._stop.set()
-        # Don't join: the threads exit at their next window boundary
-        # (bounded by window_seconds) and are daemons regardless.
+        if join_timeout is not None:
+            # A drained daemon must show ZERO watch traffic after stop
+            # returns: joining (bounded) closes the race where a
+            # follower passed its stop check just before the flag set
+            # and would issue one more window.
+            deadline = time.monotonic() + join_timeout
+            for t in self._threads:
+                t.join(timeout=max(0.0, deadline - time.monotonic()))
+            return
+        # Without a join budget don't join: the threads exit at their
+        # next window boundary (bounded by window_seconds) and are
+        # daemons regardless.
 
     # -- follower thread ----------------------------------------------------
     def _follow(self, kind: str) -> None:

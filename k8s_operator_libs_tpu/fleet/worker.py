@@ -56,6 +56,7 @@ from ..upgrade.task_runner import TaskRunner
 from ..utils import tracing
 from ..utils.faultpoints import fault_point
 from ..utils.log import get_logger
+from ..utils.lifecycle import lifecycle_resource
 from .hashring import HashRing
 from .scope import ShardScopedSnapshotSource
 
@@ -300,6 +301,7 @@ class TickStats:
     state: Any = None
 
 
+@lifecycle_resource(acquire="start", release="stop")
 class ShardWorker:
     """One fleet worker: shard leases + scoped reconciles + grant I/O.
 

@@ -64,6 +64,7 @@ from .wire import (
 )
 from ..utils import tracing
 from ..utils.faultpoints import wall_now
+from ..utils.lifecycle import lifecycle_resource
 
 _PATH_RE = re.compile(
     r"^/(?:api|apis)(?:/(?P<group>[^/]+(?:\.[^/]+)*))?/(?P<version>v[^/]+)"
@@ -706,6 +707,7 @@ async def _read_request(
     return _Request(method.upper(), target, headers, body, keep_alive)
 
 
+@lifecycle_resource(acquire="start", release=("stop", "shutdown"))
 class LocalApiServer:
     """Serve a FakeCluster on 127.0.0.1; use as a context manager in tests.
 
@@ -905,6 +907,11 @@ class LocalApiServer:
         """Stop serving (acceptor, live connections, loop thread) but
         leave the cluster alone — the socketserver-era split callers use
         to revive a server over the same store."""
+        watchdog = self._stall_watchdog
+        if watchdog is not None:
+            # Before loop.stop(): the cancel must be queued while the
+            # loop still drains callbacks (LIF801). Stats stay readable.
+            watchdog.stop()
         loop = self._loop
         if loop is not None and not loop.is_closed():
             loop.call_soon_threadsafe(loop.stop)
@@ -1352,13 +1359,16 @@ def main() -> None:  # pragma: no cover - manual demo entry point
     )
     args = parser.parse_args()
     server = LocalApiServer(port=args.port, token=args.token).start()
-    if args.kubeconfig:
-        server.write_kubeconfig(args.kubeconfig)
-        print(f"kubeconfig written to {args.kubeconfig}")
-    print(f"serving in-memory cluster at {server.url}")
     try:
-        server.serve_forever()
-    except KeyboardInterrupt:
+        if args.kubeconfig:
+            server.write_kubeconfig(args.kubeconfig)
+            print(f"kubeconfig written to {args.kubeconfig}")
+        print(f"serving in-memory cluster at {server.url}")
+        try:
+            server.serve_forever()
+        except KeyboardInterrupt:
+            pass
+    finally:
         server.stop()
 
 

@@ -45,6 +45,7 @@ from .selectors import parse_selector
 from ..utils import tracing
 from ..utils.faultpoints import chaos_hold
 from ..utils.log import get_logger
+from ..utils.lifecycle import lifecycle_resource
 
 log = get_logger("kube.informer")
 
@@ -52,6 +53,7 @@ log = get_logger("kube.informer")
 EventHandler = Callable[[str, KubeObject, Optional[KubeObject]], None]
 
 
+@lifecycle_resource(acquire="start", release="stop")
 class Informer:
     def __init__(
         self,

@@ -45,6 +45,7 @@ from .client import ApiError, Client, ConflictError, NotFoundError
 from .objects import Lease
 from ..utils import tracing
 from ..utils.faultpoints import fault_point
+from ..utils.lifecycle import lifecycle_resource
 
 log = logging.getLogger(__name__)
 
@@ -97,6 +98,7 @@ class _ObservedRecord:
     exists: bool = False
 
 
+@lifecycle_resource(acquire="start", release="stop")
 class LeaderElector:
     """Campaign for, hold, and release a Lease.
 

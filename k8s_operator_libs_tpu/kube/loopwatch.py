@@ -30,6 +30,8 @@ import asyncio
 import threading
 from typing import Optional
 
+from ..utils.lifecycle import lifecycle_resource
+
 #: Default stall threshold: far above GIL/scheduler jitter (tens of ms
 #: even on loaded CI runners), far below any genuine blocking call on
 #: the wire path (transport timeouts are seconds).
@@ -39,6 +41,7 @@ DEFAULT_STALL_THRESHOLD_S = 0.5
 DEFAULT_HEARTBEAT_INTERVAL_S = 0.02
 
 
+@lifecycle_resource(acquire="start", release="stop")
 class LoopStallWatchdog:
     """Heartbeat-gap stall detector for one event loop.
 

@@ -50,6 +50,7 @@ from .client import Client, WatchExpiredError
 from .objects import wrap
 from ..utils.faultpoints import OVERFLOW, fault_point, plan_active
 from ..utils.log import get_logger
+from ..utils.lifecycle import lifecycle_resource
 
 log = get_logger("kube.watchhub")
 
@@ -324,6 +325,7 @@ class _Upstream:
         return True
 
 
+@lifecycle_resource(acquire="__init__", release="stop")
 class WatchHub:
     """Multiplex upstream watch streams to in-process subscribers.
 

@@ -1,0 +1,33 @@
+"""Supervised daemon runtime (docs/daemon-lifecycle.md).
+
+The deployable-process layer ROADMAP item 1a asks for: every background
+component behind one :class:`Component` protocol, owned by a
+:class:`Supervisor` that starts producers-first, stops consumers-first
+(the LIF804 stop-order DAG), handles SIGTERM/SIGINT by only setting an
+event (LIF805), drains within per-component budgets, and releases held
+Leases eagerly on clean stop. The LIF8xx analyzer
+(tools/analyze/lifecycle_discipline.py) statically verifies the same
+contracts this package upholds by construction.
+"""
+
+from .component import (
+    Component,
+    FuncComponent,
+    ThreadComponent,
+    lifecycle_resource,
+    registered_resources,
+)
+from .daemon import OrchestratorDaemon
+from .supervisor import StopReport, Supervisor, SupervisorError
+
+__all__ = [
+    "Component",
+    "FuncComponent",
+    "OrchestratorDaemon",
+    "StopReport",
+    "Supervisor",
+    "SupervisorError",
+    "ThreadComponent",
+    "lifecycle_resource",
+    "registered_resources",
+]

@@ -1,0 +1,147 @@
+"""The fleet orchestrator as a supervised daemon (ROADMAP item 1a).
+
+:class:`OrchestratorDaemon` packages a :class:`FleetOrchestrator`
+behind the Component protocol: its own ``fleet-orchestrator`` leader
+election (exactly one grant-issuer per fleet, N standbys), watch-driven
+tick wakeups (fleet/wakeup.py), and one non-daemon tick-loop thread.
+Deploy shape: N worker processes (``examples/upgrade_controller.py
+--shards N --shard-index i``) plus any number of orchestrator replicas
+(``--orchestrate``) against one apiserver — replicas campaign for the
+lease and only the holder ticks, so an orchestrator crash fails over
+like a worker crash: the successor resumes from the FleetRollout
+ledger, nothing else.
+
+Stop order inside :meth:`stop` is the reverse dependency DAG (LIF804):
+the tick loop (consumer) first, then the wakeup streams that feed it,
+then the lease — released EAGERLY so a successor acquires immediately
+instead of waiting out the TTL (docs/daemon-lifecycle.md).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional, Sequence
+
+from ..fleet.orchestrator import FleetHealthAggregator, FleetOrchestrator
+from ..fleet.wakeup import WATCH_WINDOW_SECONDS, WatchWake
+from ..kube.client import Client
+from ..kube.leader import LeaderElectionConfig, LeaderElector
+from ..utils.log import get_logger
+from .component import lifecycle_resource
+
+log = get_logger("runtime.daemon")
+
+__all__ = ["OrchestratorDaemon"]
+
+
+@lifecycle_resource(acquire="start", release="stop")
+class OrchestratorDaemon:
+    """Supervised, leader-elected FleetOrchestrator tick loop."""
+
+    def __init__(
+        self,
+        client: Client,
+        rollout_name: str,
+        namespace: str = "default",
+        identity: str = "",
+        interval_s: float = 2.0,
+        aggregator: Optional[FleetHealthAggregator] = None,
+        policy: Sequence[str] = (),
+        lease_name: str = "fleet-orchestrator",
+        lease_duration_s: float = 15.0,
+        renew_deadline_s: float = 10.0,
+        retry_period_s: float = 2.0,
+        use_wakeups: bool = True,
+        wake_window_s: int = WATCH_WINDOW_SECONDS,
+        join_timeout_s: float = 10.0,
+    ) -> None:
+        self.name = "fleet-orchestrator"
+        self._client = client
+        self._namespace = namespace
+        self._interval_s = interval_s
+        self._use_wakeups = use_wakeups
+        self._wake_window_s = wake_window_s
+        self._join_timeout_s = join_timeout_s
+        self.orchestrator = FleetOrchestrator(
+            client, rollout_name, aggregator=aggregator, policy=policy
+        )
+        self.elector = LeaderElector(
+            client,
+            LeaderElectionConfig(
+                name=lease_name,
+                namespace=namespace,
+                identity=identity or f"orchestrator-{os.getpid()}",
+                lease_duration_s=lease_duration_s,
+                renew_deadline_s=renew_deadline_s,
+                retry_period_s=retry_period_s,
+            ),
+        )
+        self._stop_event = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._wake: Optional[WatchWake] = None
+        #: Ticks issued while holding the lease — liveness introspection.
+        self.led_ticks = 0
+
+    # -- Component ----------------------------------------------------------
+    def start(self) -> "OrchestratorDaemon":
+        if self._thread is not None:
+            raise RuntimeError("orchestrator daemon already started")
+        self.elector.start()
+        if self._use_wakeups:
+            self._wake = WatchWake(
+                self._client,
+                ("FleetRollout",),
+                namespace=self._namespace,
+                window_seconds=self._wake_window_s,
+            )
+        self._stop_event.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="fleet-orchestrator", daemon=False
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: Optional[float] = None) -> None:
+        """Reverse-DAG drain: tick loop, then wakeup streams, then the
+        lease — released eagerly so a standby acquires with zero TTL
+        wait."""
+        budget = self._join_timeout_s if timeout is None else timeout
+        self._stop_event.set()
+        wake = self._wake
+        if wake is not None:
+            wake.poke()  # release a wait() in progress immediately
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=budget)
+        self._thread = None
+        if wake is not None:
+            wake.stop()
+        self._wake = None
+        self.elector.stop(release=True)
+
+    def healthy(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    # -- the loop -----------------------------------------------------------
+    def is_leader(self) -> bool:
+        return self.elector.is_leader()
+
+    def _loop(self) -> None:
+        while not self._stop_event.is_set():
+            if self.elector.is_leader():
+                traces = (
+                    self._wake.consume_traces()
+                    if self._wake is not None else []
+                )
+                self.orchestrator.tick(wake_traces=traces or None)
+                self.led_ticks += 1
+            if self._stop_event.is_set():
+                return
+            if self._wake is not None:
+                # Event-driven cadence: a ledger delivery (or a stop
+                # poke) releases the wait early; interval is the resync
+                # safety net, exactly the worker loop's contract.
+                self._wake.wait(self._interval_s)
+            else:
+                self._stop_event.wait(self._interval_s)

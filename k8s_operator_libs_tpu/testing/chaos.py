@@ -105,6 +105,12 @@ POINT_WATCH = "watch"                # kube/informer.py delivery hold
 POINT_HUB_REPLAY = "hub_replay"      # kube/watchhub.py forced overflow
 POINT_PARTITION = "partition"        # per-client request blackholing
 POINT_WORKER_KILL = "worker_kill"    # driver: stop + optional restart
+#: Graceful termination mid-roll (kubelet SIGTERM → the supervised
+#: drain, docs/daemon-lifecycle.md): the worker stops through its real
+#: stop path and RELEASES its leases eagerly, so survivors take over
+#: with zero TTL wait — the handoff the supervised runtime promises,
+#: under the same invariants the crash (worker_kill) point checks.
+POINT_SIGTERM = "sigterm"            # driver: graceful stop + optional restart
 POINT_WIRE_KILL = "wire_kill"        # driver: LocalApiServer.kill_connections
 #: One PATCH in a pipelined write batch fails mid-flush while its
 #: batchmates land (upgrade/write_batch.py consults this per entry) —
@@ -113,8 +119,8 @@ POINT_WRITE_BATCH = "write_batch_partial"
 
 ALL_POINTS = (
     POINT_LEASE, POINT_GRANT_WRITE, POINT_STATUS_WRITE, POINT_WATCH,
-    POINT_HUB_REPLAY, POINT_PARTITION, POINT_WORKER_KILL, POINT_WIRE_KILL,
-    POINT_WRITE_BATCH,
+    POINT_HUB_REPLAY, POINT_PARTITION, POINT_WORKER_KILL, POINT_SIGTERM,
+    POINT_WIRE_KILL, POINT_WRITE_BATCH,
 )
 
 SCHEDULE_VERSION = 1
@@ -279,7 +285,7 @@ def generate_schedule(seed: int, config: ChaosConfig) -> FaultSchedule:
     cfg = config
     points = [
         POINT_LEASE, POINT_GRANT_WRITE, POINT_STATUS_WRITE,
-        POINT_WATCH, POINT_PARTITION, POINT_WORKER_KILL,
+        POINT_WATCH, POINT_PARTITION, POINT_WORKER_KILL, POINT_SIGTERM,
     ]
     if cfg.hub:
         points.append(POINT_HUB_REPLAY)
@@ -348,7 +354,12 @@ def generate_schedule(seed: int, config: ChaosConfig) -> FaultSchedule:
             faults.append(FaultSpec(
                 step=step, point=point, duration=duration, target=target,
             ))
-        elif point == POINT_WORKER_KILL:
+        elif point in (POINT_WORKER_KILL, POINT_SIGTERM):
+            # Same envelope for both exits: at most workers-1 down at
+            # once and no restart bracketed by its own partition. The
+            # points differ only in HOW the worker leaves — a crash
+            # (leases expire) vs the supervised graceful stop (leases
+            # released eagerly, the zero-TTL handoff).
             alive = [
                 i for i in identities
                 if i not in perma_killed
@@ -877,20 +888,27 @@ class ChaosFleetHarness:
         ).hexdigest()
 
     # -- driver events -----------------------------------------------------
-    def _kill(self, identity: str, restart_at: Optional[int]) -> None:
+    def _kill(
+        self, identity: str, restart_at: Optional[int],
+        graceful: bool = False,
+    ) -> None:
         slot = self.slots[identity]
         if not slot.alive:
             return
-        log.info("chaos: killing worker %s (restart_at=%s)",
+        log.info("chaos: %s worker %s (restart_at=%s)",
+                 "gracefully stopping" if graceful else "killing",
                  identity, restart_at)
         # A crash releases nothing: the leases go stale and are either
         # resumed by the restarted identity or stolen by a survivor.
+        # The graceful (sigterm) exit is the supervised drain instead:
+        # leases released EAGERLY, so survivors take over with zero TTL
+        # wait (docs/daemon-lifecycle.md) — same invariants either way.
         mgr = slot.worker.mgr
         slot.aborts_retired += mgr.completeness_aborts_total
         slot.escalations_retired += (
             mgr.common.checkpoint_manager.totals()["escalations"]
         )
-        slot.worker.stop(release=False)
+        slot.worker.stop(release=graceful)
         slot.worker = None
         slot.alive = False
         slot.restart_at = restart_at
@@ -918,14 +936,19 @@ class ChaosFleetHarness:
 
     def _apply_driver_events(self, step: int, plan: FaultPlan) -> None:
         for spec in self.schedule.faults:
-            if spec.point == POINT_WORKER_KILL and spec.step == step:
+            if spec.point in (
+                POINT_WORKER_KILL, POINT_SIGTERM
+            ) and spec.step == step:
                 if self.slots[spec.target].alive:
-                    plan.record_driver_fire(POINT_WORKER_KILL)
+                    plan.record_driver_fire(spec.point)
                 restart_at = (
                     None if spec.param == "perma"
                     else step + max(1, spec.duration)
                 )
-                self._kill(spec.target, restart_at)
+                self._kill(
+                    spec.target, restart_at,
+                    graceful=spec.point == POINT_SIGTERM,
+                )
             elif spec.point == POINT_WIRE_KILL and (
                 spec.step <= step < spec.step + max(1, spec.duration)
             ):

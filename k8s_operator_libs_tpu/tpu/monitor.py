@@ -827,8 +827,9 @@ def main(argv: Optional[list[str]] = None) -> int:
         metrics_server = MetricsServer(
             metrics, port=args.metrics_port, host=args.metrics_host
         ).start()
-        log.info("metrics: %s", metrics_server.url)
     try:
+        if metrics_server is not None:
+            log.info("metrics: %s", metrics_server.url)
         if args.once:
             report = monitor.check_once()
             return 0 if report is None or report.ok else 1

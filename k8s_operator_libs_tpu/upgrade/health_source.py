@@ -43,6 +43,7 @@ from ..kube.client import Client
 from ..kube.informer import Informer
 from ..kube.objects import KubeObject
 from ..utils.log import get_logger
+from ..utils.lifecycle import lifecycle_resource
 from .metrics import (
     DEFAULT_LATENCY_BUCKETS,
     DEFAULT_LINK_LATENCY_BUCKETS,
@@ -82,6 +83,7 @@ def report_concerned_nodes(obj) -> list:
     return names
 
 
+@lifecycle_resource(acquire="start", release="stop")
 class HealthSource:
     """One informer over ``NodeHealthReport``, folded into a per-node
     :class:`NodeHealth` map under a leaf lock.

@@ -18,6 +18,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Protocol
 
 from ..utils.log import get_logger
+from ..utils.lifecycle import lifecycle_resource
 
 
 class Renderable(Protocol):
@@ -503,6 +504,7 @@ class WireMetrics:
         return "".join(out)
 
 
+@lifecycle_resource(acquire="start", release="stop")
 class MetricsServer(ThreadingHTTPServer):
     """``GET /metrics`` over stdlib HTTP; use as a context manager.
 

@@ -52,6 +52,7 @@ from ..kube.objects import (
 )
 from ..utils import tracing
 from ..utils.log import get_logger
+from ..utils.lifecycle import lifecycle_resource
 
 if TYPE_CHECKING:  # avoid a snapshot <-> common_manager import cycle
     from .common_manager import ClusterUpgradeState, NodeUpgradeState
@@ -189,6 +190,7 @@ class ClientSnapshotSource:
         ]
 
 
+@lifecycle_resource(acquire="start", release="stop")
 class InformerSnapshotSource:
     """Informer-backed snapshots: list once, watch forever, resync as the
     safety net; every ``build_state`` is then a local-store read.

@@ -1,0 +1,251 @@
+"""Seeded LIF8xx violations: every leg of the lifecycle discipline
+(docs/daemon-lifecycle.md) broken once, with exact per-code counts
+pinned by test_analyze.py.
+
+* LIF801 ×3 — ``LeakyOwner`` starts a Pump its stop() never releases
+  (the release is behind a helper that forgets); ``NoShutdownOwner``
+  acquires with no shutdown method at all; ``DeepOwner`` releases one
+  of its two pumps and leaks the other.
+* LIF802 ×3 — a local Stream never released, one acquired in the gap
+  BEFORE the protecting try/finally (the bench-informer bug class),
+  and one whose release a raising call can skip (no finally).
+* LIF803 ×3 — ``NeverJoins`` starts a non-daemon thread its stop()
+  never joins; ``fire_and_forget`` leaks a local non-daemon thread;
+  ``JoinsUnbounded`` joins with no timeout on the shutdown path.
+* LIF804 ×1 — a frame stopping the WatchHub before the Informer it
+  feeds (release order must reverse the dependency DAG).
+* LIF805 ×3 — signal handlers that block, take a lock, and touch the
+  event loop (a handler may only set an event).
+"""
+
+import signal
+import threading
+import time
+
+
+def lifecycle_resource(acquire="start", release="stop"):
+    def deco(cls):
+        return cls
+
+    return deco
+
+
+@lifecycle_resource(acquire="start", release="stop")
+class Pump:
+    def start(self):
+        ...
+
+    def stop(self):
+        ...
+
+
+@lifecycle_resource(acquire="__init__", release=("stop", "close"))
+class Stream:
+    def __init__(self, client):
+        self.client = client
+
+    def read(self):
+        ...
+
+    def stop(self):
+        ...
+
+    def close(self):
+        ...
+
+
+@lifecycle_resource(acquire="__init__", release="stop")
+class WatchHub:
+    def __init__(self, client):
+        self.client = client
+
+    def stop(self):
+        ...
+
+
+@lifecycle_resource(acquire="start", release="stop")
+class Informer:
+    def __init__(self, hub):
+        self.hub = hub
+
+    def start(self):
+        ...
+
+    def stop(self):
+        ...
+
+
+def prime(stream):
+    ...
+
+
+def pump_once(stream):
+    ...
+
+
+def risky(stream):
+    ...
+
+
+def poll(informer):
+    ...
+
+
+def noop():
+    ...
+
+
+# -- LIF801: owned resources with no reachable release ---------------------
+
+
+class LeakyOwner:
+    def __init__(self):
+        self._pump = Pump()
+        self._running = False
+
+    def start(self):
+        self._pump.start()  # LIF801: stop() never reaches _pump.stop()
+        self._running = True
+
+    def stop(self):
+        self._halt()
+
+    def _halt(self):
+        self._running = False  # forgets the pump
+
+
+class NoShutdownOwner:
+    def __init__(self):
+        self._pump = Pump()
+
+    def start(self):
+        self._pump.start()  # LIF801: no shutdown method anywhere
+
+
+class DeepOwner:
+    def __init__(self):
+        self._a = Pump()
+        self._b = Pump()
+
+    def start(self):
+        self._a.start()
+        self._b.start()  # LIF801: stop() releases _a but leaks _b
+
+    def stop(self):
+        self._a.stop()
+
+
+# -- LIF802: same-frame exception-path leaks -------------------------------
+
+
+def leak_local(client):
+    stream = Stream(client)  # LIF802: never released, never escapes
+    stream.read()
+
+
+def gap_before_try(client):
+    stream = Stream(client)  # LIF802: prime() can raise in the gap
+    prime(stream)
+    try:
+        pump_once(stream)
+    finally:
+        stream.close()
+
+
+def release_not_in_finally(client):
+    stream = Stream(client)  # LIF802: risky() can skip the release
+    risky(stream)
+    stream.stop()
+
+
+# -- LIF803: unjoined / unbounded threads ----------------------------------
+
+
+class NeverJoins:
+    def __init__(self):
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(  # LIF803: stop() never joins
+            target=self._run, name="never-joined"
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+
+    def _run(self):
+        self._stop.wait(1.0)
+
+
+class JoinsUnbounded:
+    def __init__(self):
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, name="unbounded")
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join()  # LIF803: no timeout — unbounded shutdown
+
+    def _run(self):
+        self._stop.wait(1.0)
+
+
+def fire_and_forget(work):
+    worker = threading.Thread(target=work)  # LIF803: never joined
+    worker.start()
+
+
+# -- LIF804: releases out of dependency order ------------------------------
+
+
+def stop_order_violation(client):
+    hub = informer = None
+    try:
+        hub = WatchHub(client)
+        informer = Informer(hub)
+        informer.start()
+        poll(informer)
+    finally:
+        hub.stop()  # LIF804: the hub feeds the informer — stop it last
+        informer.stop()
+
+
+# -- LIF805: signal handlers doing more than setting an event --------------
+
+
+class BlockingHandler:
+    def install(self):
+        signal.signal(signal.SIGTERM, self._on_term)  # LIF805: blocks
+
+    def _on_term(self, signum, frame):
+        time.sleep(0.1)
+
+
+class LockingHandler:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.drained = False
+
+    def install(self):
+        signal.signal(signal.SIGINT, self._on_int)  # LIF805: takes lock
+
+    def _on_int(self, signum, frame):
+        with self._lock:
+            self.drained = True
+
+
+class LoopTouchHandler:
+    def __init__(self, loop):
+        self._loop = loop
+
+    def install(self):
+        signal.signal(signal.SIGTERM, self._on_term)  # LIF805: loop
+
+    def _on_term(self, signum, frame):
+        self._loop.call_soon_threadsafe(noop)

@@ -1,0 +1,218 @@
+"""The lifecycle_bad.py twins done right — every legal shape the
+LIF8xx pass must stay silent on (docs/daemon-lifecycle.md).
+
+Covers: release via helper + alias one call below the shutdown method
+(the propagation positive), multi-release kinds, acquire-inside-try,
+ownership escape by return, ``with``-scoped resources, a daemon thread
+legitimately unjoined, bounded joins through an alias, releases in
+reverse dependency order, and an event-only signal handler.
+"""
+
+import signal
+import threading
+from typing import Optional
+
+
+def lifecycle_resource(acquire="start", release="stop"):
+    def deco(cls):
+        return cls
+
+    return deco
+
+
+@lifecycle_resource(acquire="start", release="stop")
+class Pump:
+    def start(self):
+        ...
+
+    def stop(self):
+        ...
+
+
+@lifecycle_resource(acquire="__init__", release=("stop", "close"))
+class Stream:
+    def __init__(self, client):
+        self.client = client
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def read(self):
+        ...
+
+    def stop(self):
+        ...
+
+    def close(self):
+        ...
+
+
+@lifecycle_resource(acquire="__init__", release="stop")
+class WatchHub:
+    def __init__(self, client):
+        self.client = client
+
+    def stop(self):
+        ...
+
+
+@lifecycle_resource(acquire="start", release="stop")
+class Informer:
+    def __init__(self, hub):
+        self.hub = hub
+
+    def start(self):
+        ...
+
+    def stop(self):
+        ...
+
+
+def prime(stream):
+    ...
+
+
+def pump_once(stream):
+    ...
+
+
+def poll(informer):
+    ...
+
+
+# -- owned resources, released through a helper and an alias ----------------
+
+
+class CleanOwner:
+    def __init__(self, client):
+        self._client = client
+        self._pump = Pump()
+        self._stream: Optional[Stream] = None
+
+    def start(self):
+        self._pump.start()
+        self._stream = Stream(self._client)
+
+    def stop(self):
+        self._drain()
+
+    def _drain(self):
+        pump = self._pump
+        pump.stop()
+        stream = self._stream
+        if stream is not None:
+            stream.close()
+        self._stream = None
+
+
+# -- frame-local resources, exception-safe ----------------------------------
+
+
+def drains_in_finally(client):
+    stream = Stream(client)
+    try:
+        pump_once(stream)
+    finally:
+        stream.close()
+
+
+def acquired_inside_try(client):
+    stream = None
+    try:
+        stream = Stream(client)
+        prime(stream)
+        pump_once(stream)
+    finally:
+        if stream is not None:
+            stream.stop()
+
+
+def returns_ownership(client):
+    stream = Stream(client)
+    prime(stream)
+    return stream
+
+
+def with_scoped(client):
+    stream = Stream(client)
+    with stream:
+        pump_once(stream)
+
+
+# -- threads: bounded joins, daemons exempt ----------------------------------
+
+
+class CleanLoop:
+    def __init__(self):
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, name="clean-loop")
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=5.0)
+        self._thread = None
+
+    def _run(self):
+        self._stop.wait(1.0)
+
+
+class DaemonHeartbeat:
+    def __init__(self):
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._beat, name="heartbeat", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        ...
+
+    def _beat(self):
+        ...
+
+
+def joined_locally(work):
+    worker = threading.Thread(target=work)
+    worker.start()
+    worker.join(timeout=10.0)
+
+
+# -- releases in reverse dependency order ------------------------------------
+
+
+def stop_order_correct(client):
+    hub = informer = None
+    try:
+        hub = WatchHub(client)
+        informer = Informer(hub)
+        informer.start()
+        poll(informer)
+    finally:
+        informer.stop()
+        hub.stop()
+
+
+# -- signal handler: event-only ----------------------------------------------
+
+
+class CleanDaemon:
+    def __init__(self):
+        self._stop_event = threading.Event()
+
+    def install(self):
+        signal.signal(signal.SIGTERM, self._on_signal)
+        signal.signal(signal.SIGINT, self._on_signal)
+
+    def _on_signal(self, signum, frame):
+        self._stop_event.set()

@@ -1505,3 +1505,89 @@ def test_sarif_rules_include_pol_family(tmp_path, capsys):
     assert {res["ruleId"] for res in doc["runs"][0]["results"]} == {
         "POL701", "POL702", "POL703", "POL704", "POL705"
     }
+
+
+# -- lifecycle discipline (LIF8xx) -----------------------------------------
+
+def test_lif_bad_fixture_flags_all_seeded_violations():
+    findings = run_analysis([str(FIXTURES / "lifecycle_bad.py")])
+    assert codes(findings) == {
+        "LIF801", "LIF802", "LIF803", "LIF804", "LIF805"
+    }
+    by_code = {}
+    for f in findings:
+        by_code.setdefault(f.code, []).append(f)
+    # Leaked informer, thread never joined on shutdown, transitively
+    # acquired server with no release path.
+    assert len(by_code["LIF801"]) == 3
+    # Mid-frame raise skips the stop, early return skips it, and the
+    # except-reraise path without a finally.
+    assert len(by_code["LIF802"]) == 3
+    # Non-daemon thread never joined, join() without timeout, and the
+    # loop-spawned batch joined without a bound.
+    assert len(by_code["LIF803"]) == 3
+    # Producer stopped before its consumer (hub before informer).
+    assert len(by_code["LIF804"]) == 1
+    # Lock acquisition, blocking I/O, and a join reachable from the
+    # registered signal handler.
+    assert len(by_code["LIF805"]) == 3
+    assert len(findings) == 13
+
+
+def test_lif_clean_twin_silent():
+    assert run_analysis([str(FIXTURES / "lifecycle_clean.py")]) == []
+
+
+def test_package_is_lif_clean():
+    """Every background resource the package ships (informers, watch
+    hub pumps, electors, servers, the runtime/ supervision tree) has a
+    verified shutdown path: zero LIF8xx findings, no baseline
+    entries."""
+    findings = run_analysis(
+        [str(REPO / "k8s_operator_libs_tpu")],
+        pass_names=["lifecycle-discipline"],
+    )
+    assert findings == [], [str(f) for f in findings]
+
+
+def test_bench_is_lif_clean():
+    """The LIF802 sweep (the PR-7 degraded_first_roll informer-leak
+    class): bench.py's harness sections acquire informers, workers,
+    hubs, and servers — all of them now release in finally. Analyzed
+    WITH the package in scope so cross-module acquire/release pairs
+    resolve."""
+    findings = run_analysis(
+        [str(REPO / "k8s_operator_libs_tpu"), str(REPO / "bench.py")],
+        pass_names=["lifecycle-discipline"],
+    )
+    bench_findings = [f for f in findings if "bench.py" in f.path]
+    assert bench_findings == [], [str(f) for f in bench_findings]
+
+
+def test_cli_stats_include_resource_coverage(capsys, monkeypatch):
+    # Relative path from the repo root so the checked-in baseline's
+    # path keys match (the same shape `make analyze` runs).
+    monkeypatch.chdir(REPO)
+    rc = cli.main(["k8s_operator_libs_tpu", "--stats"])
+    assert rc == 0
+    err = capsys.readouterr().err
+    line = next(ln for ln in err.splitlines()
+                if ln.startswith("analyze stats:"))
+    # The registered (acquire, release) resource classes the lifecycle
+    # pass verifies — @lifecycle_resource registrations plus the
+    # built-in registry.
+    assert "resources=13" in line
+
+
+def test_sarif_rules_include_lif_family(tmp_path, capsys):
+    sarif_file = tmp_path / "report.sarif"
+    rc = cli.main([str(FIXTURES / "lifecycle_bad.py"), "--baseline", "-",
+                   "--sarif", str(sarif_file)])
+    assert rc == 1
+    capsys.readouterr()
+    doc = json.loads(sarif_file.read_text())
+    rule_ids = {r["id"] for r in doc["runs"][0]["tool"]["driver"]["rules"]}
+    assert {"LIF801", "LIF802", "LIF803", "LIF804", "LIF805"} <= rule_ids
+    assert {res["ruleId"] for res in doc["runs"][0]["results"]} == {
+        "LIF801", "LIF802", "LIF803", "LIF804", "LIF805"
+    }

@@ -42,6 +42,7 @@ from k8s_operator_libs_tpu.testing.chaos import (
     POINT_HUB_REPLAY,
     POINT_LEASE,
     POINT_PARTITION,
+    POINT_SIGTERM,
     POINT_STATUS_WRITE,
     POINT_WATCH,
     POINT_WIRE_KILL,
@@ -305,6 +306,76 @@ class TestFaultPoints:
         alive_sets = [tuple(t["alive"]) for t in result.trace]
         assert ("w0",) in alive_sets, "w1 was never down"
         assert alive_sets[-1] == ("w0", "w1"), "w1 never came back"
+
+    def test_sigterm_graceful_handoff_converges(self):
+        """``sigterm`` (graceful-stop-mid-roll, the supervised drain of
+        docs/daemon-lifecycle.md): the worker leaves through its REAL
+        stop path — leases released eagerly, informers drained — and
+        the survivor takes over its shards with zero TTL wait. Same
+        invariants as the crash point: budget intact, no grant retired
+        unrolled, no node lost across the handoff."""
+        cfg = ChaosConfig(pools=6, workers=2, shards=2, fault_window=30)
+        schedule = FaultSchedule(seed=0, config=cfg, faults=[
+            FaultSpec(step=6, point=POINT_SIGTERM, duration=1,
+                      target="w0", param="perma"),
+        ])
+        result = run_schedule(schedule)
+        assert result.fired.get(POINT_SIGTERM) == 1
+        assert result.converged, result.summary()
+        assert result.violations["budget"] == 0
+        assert result.violations["grant_retired_unrolled"] == 0
+        assert result.violations["node_lost_or_cordoned"] == 0
+        assert result.total_violations == 0, result.summary()
+        stopped_steps = [t for t in result.trace if t["alive"] == ["w1"]]
+        assert stopped_steps, "w0 was never actually stopped"
+
+    def test_sigterm_restart_rejoins_the_fleet(self):
+        """A SIGTERM'd worker restarted later (the kubelet-restarts-the-
+        pod shape) re-campaigns and rejoins; in the window between, the
+        survivor owns the released shards immediately (no stale-lease
+        wait — the eager-release difference from worker_kill)."""
+        cfg = ChaosConfig(pools=4, workers=2, shards=2, fault_window=30)
+        schedule = FaultSchedule(seed=0, config=cfg, faults=[
+            FaultSpec(step=5, point=POINT_SIGTERM, duration=8,
+                      target="w1", param="restart"),
+        ])
+        result = run_schedule(schedule)
+        assert result.converged and result.total_violations == 0
+        alive_sets = [tuple(t["alive"]) for t in result.trace]
+        assert ("w0",) in alive_sets, "w1 was never down"
+        assert alive_sets[-1] == ("w0", "w1"), "w1 never came back"
+
+    def test_sigterm_schedule_is_deterministic(self):
+        """The graceful exit rides the same determinism contract as
+        every other point: same schedule ⇒ same step trace ⇒ same final
+        cluster digest (the eager lease releases are driver-stepped
+        writes, not wall-clock races)."""
+        cfg = ChaosConfig(pools=4, workers=2, shards=2, fault_window=30)
+        schedule = FaultSchedule(seed=0, config=cfg, faults=[
+            FaultSpec(step=4, point=POINT_SIGTERM, duration=6,
+                      target="w0", param="restart"),
+        ])
+        first = run_schedule(schedule)
+        second = run_schedule(schedule)
+        assert first.converged and second.converged
+        assert first.trace == second.trace
+        assert first.final_digest == second.final_digest
+        assert first.fired == second.fired
+
+    def test_generate_schedule_draws_sigterm(self):
+        """The generator's envelope covers the new point: some seed
+        draws it, always with a live target and the kill-point exclusion
+        rules (someone survives)."""
+        cfg = ChaosConfig(pools=4, workers=2, shards=2)
+        drawn = []
+        for seed in range(80):
+            for spec in generate_schedule(seed, cfg).faults:
+                if spec.point == POINT_SIGTERM:
+                    drawn.append(spec)
+        assert drawn, "no seed in 0..79 ever drew a sigterm fault"
+        for spec in drawn:
+            assert spec.target in cfg.identities()
+            assert spec.param in ("perma", "restart")
 
     def test_wire_kill_fires_against_a_real_server(self):
         """``wire_kill`` aborts every live connection of a

@@ -1,0 +1,425 @@
+"""Daemon lifecycle end to end: SIGTERM under load, multi-process
+``--orchestrate``.
+
+Two drills over a real LocalApiServer (docs/daemon-lifecycle.md):
+
+* **Shutdown under load** — SIGTERM one ShardWorker plus the elected
+  orchestrator mid-64-pool-roll. The supervised drain must join every
+  non-daemon thread within the drain deadline, release every held Lease
+  eagerly (a successor orchestrator acquires with zero TTL wait), and
+  the roll must converge under the survivors with zero global-budget
+  violations. After EVERYTHING stops, a request-log quiet window pins
+  that no component leaks background traffic past its stop.
+
+* **The ROADMAP 1a deployment shape** — N worker processes + 1 elected
+  orchestrator replica against one apiserver, as real subprocesses of
+  ``examples/upgrade_controller.py`` over a written kubeconfig; SIGTERM
+  ends both with rc 0 and released leases.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+from k8s_operator_libs_tpu.api import (
+    DriverUpgradePolicySpec,
+    make_fleet_rollout,
+    pools_in_phase,
+    rollout_spec,
+)
+from k8s_operator_libs_tpu.fleet import FleetWorkerConfig, ShardWorker, shard_id
+from k8s_operator_libs_tpu.kube import (
+    LocalApiServer,
+    Node,
+    RestClient,
+    RestConfig,
+)
+from k8s_operator_libs_tpu.kube.objects import KubeObject
+from k8s_operator_libs_tpu.kube.sim import DaemonSetSimulator
+from k8s_operator_libs_tpu.parallel.topology import (
+    GKE_NODEPOOL_LABEL,
+    GKE_TPU_ACCELERATOR_LABEL,
+    GKE_TPU_TOPOLOGY_LABEL,
+)
+from k8s_operator_libs_tpu.runtime import (
+    FuncComponent,
+    OrchestratorDaemon,
+    Supervisor,
+    ThreadComponent,
+)
+from k8s_operator_libs_tpu.utils import IntOrString
+from k8s_operator_libs_tpu.utils.jaxenv import hermetic_cpu_env
+
+NS = "kube-system"
+DS_LABELS = {"app": "libtpu-installer"}
+ROLLOUT = "fleet-roll"
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CLI = os.path.join(REPO, "examples", "upgrade_controller.py")
+
+
+def pool_of(node_name: str) -> str:
+    return node_name.split("-")[0]
+
+
+def seed_fleet(cluster, pools: int, budget: str):
+    """``pools`` single-host pools + libtpu DaemonSet + FleetRollout."""
+    pool_names = [f"s{i}" for i in range(pools)]
+    for pool in pool_names:
+        node = Node.new(
+            f"{pool}-h0",
+            labels={
+                GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+                GKE_TPU_TOPOLOGY_LABEL: "4x4",
+                GKE_NODEPOOL_LABEL: pool,
+            },
+        )
+        node.set_ready(True)
+        cluster.create(node)
+    sim = DaemonSetSimulator(
+        cluster, name="libtpu-installer", namespace=NS,
+        match_labels=DS_LABELS, initial_hash="libtpu-v1",
+    )
+    sim.settle()
+    rollout = make_fleet_rollout(ROLLOUT, pool_names, budget)
+    cluster.create(KubeObject(rollout))
+    return pool_names, sim, rollout_spec(rollout).resolved_budget()
+
+
+def disrupted_pools(cluster) -> set:
+    out = set()
+    for name in cluster.object_names("Node"):
+        raw = cluster.peek("Node", name) or {}
+        if (raw.get("spec") or {}).get("unschedulable"):
+            out.add(pool_of(name))
+    return out
+
+
+def lease_holder(cluster, name: str) -> str:
+    raw = cluster.peek("Lease", name, NS) or {}
+    return (raw.get("spec") or {}).get("holderIdentity") or ""
+
+
+class TestShutdownUnderLoad:
+    """Satellite pin: SIGTERM a ShardWorker + the orchestrator
+    mid-64-pool-roll; bounded drain, eager releases, survivor
+    convergence, zero budget violations, quiet wire after stop."""
+
+    POOLS = 64
+    SHARDS = 4
+    POLICY = DriverUpgradePolicySpec(
+        auto_upgrade=True,
+        max_parallel_upgrades=0,
+        # The GRANT is the budget in the fleet shape.
+        max_unavailable=IntOrString("100%"),
+    )
+
+    def _worker(self, srv, clients, index: int) -> ShardWorker:
+        client = RestClient(RestConfig(server=srv.url))
+        clients.append(client)
+        return ShardWorker(
+            client,
+            FleetWorkerConfig(
+                identity=f"worker-{index}",
+                shards=self.SHARDS,
+                namespace=NS,
+                driver_labels=DS_LABELS,
+                pool_of=pool_of,
+                rollout_name=ROLLOUT,
+                preferred_shards=[
+                    shard_id(j) for j in range(self.SHARDS) if j % 2 == index
+                ],
+                lease_duration_s=5.0,
+                renew_deadline_s=3.0,
+                retry_period_s=0.5,
+                # Fast reclaim of the victim's (eagerly released) shard
+                # leases — the survivor probes them at this cadence.
+                failover_probe_s=0.5,
+            ),
+        )
+
+    def test_sigterm_mid_roll(self):
+        with LocalApiServer() as srv:
+            _, sim, budget = seed_fleet(srv.cluster, self.POOLS, "25%")
+            clients = []
+            stop_survivor = threading.Event()
+            survivor_thread = None
+            successor = None
+            drained = False
+            w0 = self._worker(srv, clients, 0)
+            w1 = self._worker(srv, clients, 1)
+            sup = Supervisor(drain_timeout_s=20.0, component_timeout_s=10.0)
+            sup.install_signal_handlers()
+            try:
+                w0.start(sync_timeout=60)
+                w1.start(sync_timeout=60)
+                # Settle: every shard claimed before the roll begins.
+                deadline = time.time() + 60
+                while True:
+                    w0.tick(self.POLICY)
+                    w1.tick(self.POLICY)
+                    if len(w0.owned_shards() | w1.owned_shards()) \
+                            == self.SHARDS:
+                        break
+                    assert time.time() < deadline, "shards never settled"
+                    time.sleep(0.02)
+                victim_shards = set(w0.owned_shards())
+
+                # The victim half, supervised: worker core, its tick
+                # loop (consumer drains first), and the orchestrator.
+                sup.adopt(FuncComponent("worker0", stop=w0.stop))
+
+                def run_victim(stop_event):
+                    while not stop_event.is_set():
+                        try:
+                            w0.tick(self.POLICY)
+                        except Exception:  # noqa: BLE001 - retried
+                            pass
+                        stop_event.wait(0.005)
+
+                loop0 = ThreadComponent(
+                    "worker0-loop", run_victim, join_timeout_s=10.0
+                )
+                sup.add(loop0, depends_on=["worker0"])
+                orch_client = RestClient(RestConfig(server=srv.url))
+                clients.append(orch_client)
+                orch = OrchestratorDaemon(
+                    orch_client, ROLLOUT, namespace=NS,
+                    identity="orch-victim", interval_s=0.05,
+                    lease_duration_s=5.0, renew_deadline_s=3.0,
+                    retry_period_s=0.1, use_wakeups=False,
+                    join_timeout_s=10.0,
+                )
+                orch.start()
+                sup.adopt(orch)
+                sup.start()
+
+                # The survivor ticks on its own (unsupervised) thread.
+                def run_survivor():
+                    while not stop_survivor.is_set():
+                        try:
+                            w1.tick(self.POLICY)
+                        except Exception:  # noqa: BLE001 - retried
+                            pass
+                        stop_survivor.wait(0.005)
+
+                survivor_thread = threading.Thread(
+                    target=run_survivor, daemon=True, name="survivor-loop"
+                )
+                survivor_thread.start()
+
+                deadline = time.time() + 30
+                while not orch.is_leader():
+                    assert time.time() < deadline, "orchestrator never led"
+                    time.sleep(0.02)
+
+                # Begin the roll; SIGTERM lands mid-flight, with grants
+                # outstanding and pools genuinely disrupted.
+                sim.set_template_hash("libtpu-v2")
+                deadline = time.time() + 120
+                while (orch.orchestrator.grants_issued < budget // 2
+                       or not disrupted_pools(srv.cluster)):
+                    sim.step()
+                    assert time.time() < deadline, "roll never got underway"
+                    time.sleep(0.01)
+
+                os.kill(os.getpid(), signal.SIGTERM)
+                assert sup.wait(timeout=10), "SIGTERM never set the event"
+                began = time.monotonic()
+                reports = sup.stop()
+                elapsed = time.monotonic() - began
+                drained = True
+
+                # Bounded drain, every stop clean, consumers first.
+                assert elapsed < 20.0, f"drain took {elapsed:.1f}s"
+                assert [r.name for r in reports] == [
+                    "worker0-loop", "fleet-orchestrator", "worker0"
+                ]
+                assert all(r.ok for r in reports), reports
+                # Every victim non-daemon thread joined.
+                leftover = [
+                    t.name for t in threading.enumerate()
+                    if t.name in ("worker0-loop", "fleet-orchestrator")
+                ]
+                assert not leftover, f"threads survived the drain: {leftover}"
+
+                # Eager releases: the orchestrator Lease and every shard
+                # lease the victim held are EMPTY right now — no TTL ran.
+                assert lease_holder(srv.cluster, "fleet-orchestrator") == ""
+                for shard in victim_shards:
+                    assert lease_holder(srv.cluster, f"fleet-{shard}") == "", (
+                        f"victim shard lease {shard} not released eagerly"
+                    )
+
+                # A successor orchestrator acquires in a retry period,
+                # far under the 5s lease TTL: zero TTL wait.
+                succ_client = RestClient(RestConfig(server=srv.url))
+                clients.append(succ_client)
+                successor = OrchestratorDaemon(
+                    succ_client, ROLLOUT, namespace=NS,
+                    identity="orch-successor", interval_s=0.02,
+                    lease_duration_s=5.0, renew_deadline_s=3.0,
+                    retry_period_s=0.05, use_wakeups=False,
+                    join_timeout_s=10.0,
+                )
+                began = time.monotonic()
+                successor.start()
+                deadline = time.time() + 10
+                while not successor.is_leader():
+                    assert time.time() < deadline, "successor never led"
+                    time.sleep(0.01)
+                takeover = time.monotonic() - began
+                assert takeover < 3.0, (
+                    f"takeover took {takeover:.2f}s — waited out the TTL?"
+                )
+
+                # The roll converges under the survivors; the global
+                # budget holds through the handoff (sampled every step).
+                violations = 0
+                deadline = time.time() + 240
+                while True:
+                    sim.step()
+                    if len(disrupted_pools(srv.cluster)) > budget:
+                        violations += 1
+                    ledger = srv.cluster.peek("FleetRollout", ROLLOUT)
+                    done = len(pools_in_phase(ledger or {}, "done"))
+                    if done == self.POOLS:
+                        break
+                    assert time.time() < deadline, (
+                        f"roll did not converge under survivors "
+                        f"({done}/{self.POOLS} done)"
+                    )
+                    time.sleep(0.005)
+                assert violations == 0
+                assert sim.all_pods_ready_and_current()
+            finally:
+                sup.restore_signal_handlers()
+                stop_survivor.set()
+                if survivor_thread is not None:
+                    survivor_thread.join(timeout=10)
+                if successor is not None:
+                    successor.stop()
+                w1.stop()
+                if not drained:
+                    sup.stop()
+                for client in clients:
+                    client.close()
+
+            # Quiet window: with every component stopped, the wire goes
+            # silent — zero requests means zero leaked background
+            # threads anywhere in the tree (informers, hub pumps,
+            # electors, tick loops).
+            request_log = srv.start_request_log()
+            time.sleep(0.4)
+            srv.stop_request_log()
+            assert request_log == [], (
+                f"traffic after stop returned: {request_log[:10]}"
+            )
+
+
+class TestOrchestrateMultiProcess:
+    """ROADMAP 1a verbatim: N ``--shards`` worker processes + one
+    ``--orchestrate`` replica against one apiserver — real
+    subprocesses over a written kubeconfig."""
+
+    def test_two_workers_one_orchestrator_roll_and_sigterm(self, tmp_path):
+        with LocalApiServer() as srv:
+            kubeconfig = srv.write_kubeconfig(str(tmp_path / "kubeconfig"))
+            # 4 nodes, each its own pool (the CLI worker's default
+            # pool_of is node-name = pool-key); 50% budget = two grant
+            # waves.
+            node_names = []
+            for i in range(4):
+                node = Node.new(
+                    f"fleet-node-{i}",
+                    labels={
+                        GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+                        GKE_TPU_TOPOLOGY_LABEL: "4x4",
+                        GKE_NODEPOOL_LABEL: "fleet-pool",
+                    },
+                )
+                node.set_ready(True)
+                srv.cluster.create(node)
+                node_names.append(node.name)
+            sim = DaemonSetSimulator(
+                srv.cluster, name="libtpu-installer", namespace=NS,
+                match_labels=DS_LABELS, initial_hash="libtpu-v1",
+            )
+            sim.settle()
+            srv.cluster.create(
+                KubeObject(make_fleet_rollout(ROLLOUT, node_names, "50%"))
+            )
+            sim.set_template_hash("libtpu-v2")  # the update to roll
+
+            env = hermetic_cpu_env(4)
+            env["KUBECONFIG"] = kubeconfig
+            procs = []
+            try:
+                for i in range(2):
+                    flags = [
+                        "--shards", "2", "--shard-index", str(i),
+                        "--fleet-rollout", ROLLOUT,
+                        "--interval", "0.2",
+                        "--leader-elect-id", f"proc-{i}",
+                    ]
+                    if i == 0:
+                        flags.append("--orchestrate")
+                    procs.append(subprocess.Popen(
+                        [sys.executable, CLI, *flags],
+                        env=env, stdout=subprocess.PIPE,
+                        stderr=subprocess.STDOUT, text=True,
+                    ))
+
+                # Drive the DaemonSet sim while the two processes roll
+                # the fleet grant wave by grant wave.
+                deadline = time.time() + 150
+                while True:
+                    sim.step()
+                    for proc in procs:
+                        if proc.poll() is not None:
+                            out, _ = proc.communicate(timeout=10)
+                            raise AssertionError(
+                                f"worker exited early (rc={proc.returncode})"
+                                f": {out[-1500:]}"
+                            )
+                    ledger = srv.cluster.peek("FleetRollout", ROLLOUT)
+                    if len(pools_in_phase(ledger or {}, "done")) == 4:
+                        break
+                    assert time.time() < deadline, (
+                        "fleet roll did not converge; ledger="
+                        f"{(ledger or {}).get('status')}"
+                    )
+                    time.sleep(0.05)
+                assert sim.all_pods_ready_and_current()
+                # Exactly the replica that campaigned holds the
+                # orchestrator lease.
+                assert lease_holder(
+                    srv.cluster, "fleet-orchestrator"
+                ) == "proc-0"
+
+                for proc in procs:
+                    proc.send_signal(signal.SIGTERM)
+                outs = []
+                for proc in procs:
+                    out, _ = proc.communicate(timeout=60)
+                    outs.append(out)
+                for proc, out in zip(procs, outs):
+                    assert proc.returncode == 0, out[-1500:]
+                    assert "shutdown requested; draining" in out
+                assert "fleet orchestrator: campaigning as 'proc-0'" \
+                    in outs[0]
+
+                # Eager releases on the way down: orchestrator AND both
+                # shard leases are empty the moment the processes exit.
+                assert lease_holder(srv.cluster, "fleet-orchestrator") == ""
+                for shard in ("shard-00", "shard-01"):
+                    assert lease_holder(
+                        srv.cluster, f"fleet-{shard}"
+                    ) == "", f"{shard} lease not released"
+            finally:
+                for proc in procs:
+                    if proc.poll() is None:
+                        proc.kill()

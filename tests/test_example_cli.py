@@ -80,3 +80,24 @@ def test_demo_fleet_mode_single_shard():
     assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-1000:]
     assert "rolling upgrade complete" in proc.stdout
     assert "shards=shard-00" in proc.stdout
+
+
+def test_demo_fleet_orchestrate():
+    """--orchestrate runs the FleetOrchestrator as a supervised daemon
+    inside the same process: it campaigns for the 'fleet-orchestrator'
+    Lease and issues grants from the FleetRollout ledger, without which
+    the budget-gated roll cannot converge (the demo wedges if the
+    orchestrator never grants — pinned by the flag-wiring review)."""
+    proc = run_demo(
+        "--shards", "1", "--shard-index", "0",
+        "--fleet-rollout", "demo-roll", "--orchestrate",
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-1000:]
+    assert "fleet orchestrator: campaigning" in proc.stdout
+    assert "rolling upgrade complete" in proc.stdout
+
+
+def test_orchestrate_requires_fleet_rollout():
+    proc = run_demo("--orchestrate", timeout=60)
+    assert proc.returncode == 2
+    assert "--orchestrate requires --fleet-rollout" in proc.stderr

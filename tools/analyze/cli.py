@@ -147,12 +147,16 @@ def main(argv=None) -> int:
 
     report = _report_json(new, baselined, stale, args.paths)
     if args.stats:
+        from .lifecycle_discipline import project_resource_classes
         from .policy_discipline import registered_policies
 
         stats = get_callgraph(project).stats()
         # Policy-package coverage (docs/policy-plugins.md): how many
         # registered policies the POL7xx family verified this run.
         stats["policies"] = len(registered_policies(project))
+        # Lifecycle coverage (docs/daemon-lifecycle.md): how many
+        # tracked background-resource classes LIF8xx verified this run.
+        stats["resources"] = len(project_resource_classes(project))
         stats["findings"] = len(new) + len(baselined)
         report["stats"] = stats
         line = " ".join(f"{k}={v}" for k, v in stats.items())

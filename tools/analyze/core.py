@@ -248,6 +248,7 @@ def all_passes() -> list[Type[AnalysisPass]]:
     from . import interproc  # noqa: F401
     from . import asyncio_discipline  # noqa: F401
     from . import policy_discipline  # noqa: F401
+    from . import lifecycle_discipline  # noqa: F401
 
     return list(_REGISTRY)
 

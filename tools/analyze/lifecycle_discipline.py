@@ -1,0 +1,1007 @@
+"""LIF8xx — lifecycle discipline (docs/daemon-lifecycle.md).
+
+A deployable daemon's densest latent-bug class is background resources
+— informer watch threads, WatchHub pumps, LeaderElector campaigns,
+MetricsServer listeners, the LocalApiServer wire loop — started in one
+place and stopped (or leaked) somewhere else. PR 15 proved the event
+loops non-blocking (ASY6xx) and PR 17 proved policies pure (POL7xx);
+this pass rides the same PR-3 call graph to prove *ownership and
+shutdown*:
+
+* **LIF801** leaked resource — a class acquires a tracked background
+  resource into ``self.<attr>`` (calls its acquire method, or
+  constructs a kind whose construction IS the acquisition) but no
+  shutdown-named method (``stop``/``close``/``shutdown``/…)
+  transitively reaches the matching release, with witness chains.
+* **LIF802** stop-not-in-finally — acquire and release in the same
+  frame where an exception path skips the release: no protecting
+  ``finally``, or raising statements in the gap between the
+  acquisition and the ``try`` whose ``finally`` releases (the PR-7
+  bench-informer bug class, as a pass instead of a review catch).
+* **LIF803** unbounded threads — a non-daemon ``threading.Thread``
+  started but never joined on any shutdown path, or a thread
+  ``join()`` WITHOUT a timeout reachable from a shutdown method
+  (unbounded shutdown).
+* **LIF804** stop-order violation — releases in one frame must reverse
+  the documented dependency DAG (docs/static-analysis.md): stopping
+  the hub before the informer it feeds, the apiserver before its
+  consumers, orphans in-flight streams mid-drain.
+* **LIF805** signal-handler discipline — no blocking call, lock
+  acquisition, or event-loop touch reachable from a registered signal
+  handler; a handler may only set an event (the Supervisor's
+  construction, runtime/supervisor.py).
+
+The resource registry is statically decidable because registration is
+syntactically explicit: the builtin table below names the package's
+own kinds, and ``@lifecycle_resource(acquire="...", release="...")``
+(k8s_operator_libs_tpu/utils/lifecycle.py) extends it with LITERAL
+method names — the POL704 registration pattern. Computed names are
+invisible by design.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from .callgraph import (
+    CORO_DISPATCH_NAMES,
+    LOOP_DISPATCH_ARG,
+    CallGraph,
+    ClassInfo,
+    FunctionInfo,
+)
+from .core import AnalysisPass, ParsedModule, Project, register
+from .interproc import MAX_CHAIN, _Engine, _own_body_calls
+from .lock_discipline import _dotted
+
+#: Method names that make a method a *shutdown path* — the owner-side
+#: surface LIF801/LIF803 verify releases from.
+SHUTDOWN_NAMES = (
+    "stop", "close", "shutdown", "teardown", "_teardown",
+    "__exit__", "__aexit__", "aclose",
+)
+
+#: The package's own background-resource kinds: bare class name ->
+#: (acquire method names, release method names). ``__init__`` as an
+#: acquire means construction itself starts the background footprint.
+#: Mirrors the runtime registrations in k8s_operator_libs_tpu (each
+#: class carries the same pairs on its @lifecycle_resource decorator);
+#: the builtin table lets bench/example/test code analyze correctly
+#: even when the package sources are outside the analysis scope.
+BUILTIN_RESOURCES: dict[str, tuple[tuple[str, ...], tuple[str, ...]]] = {
+    "Informer": (("start",), ("stop",)),
+    "WatchHub": (("__init__",), ("stop",)),
+    "MetricsServer": (("start",), ("stop",)),
+    "LocalApiServer": (("start",), ("stop", "shutdown")),
+    "LoopStallWatchdog": (("start",), ("stop",)),
+    "LeaderElector": (("start",), ("stop",)),
+    "ShardWorker": (("start",), ("stop",)),
+    "WatchWake": (("__init__",), ("stop",)),
+    "HealthSource": (("start",), ("stop",)),
+    "InformerSnapshotSource": (("start",), ("stop",)),
+    "Supervisor": (("start",), ("stop",)),
+    "ThreadComponent": (("start",), ("stop",)),
+    "OrchestratorDaemon": (("start",), ("stop",)),
+}
+
+#: The stop-order DAG (docs/daemon-lifecycle.md): (consumer, producer)
+#: pairs — the consumer's release must precede its producer's in any
+#: frame releasing both, because a live consumer re-subscribes to /
+#: keeps requesting from a producer torn down under it.
+STOP_ORDER_EDGES: tuple[tuple[str, str], ...] = (
+    ("InformerSnapshotSource", "Informer"),
+    ("HealthSource", "Informer"),
+    ("Informer", "WatchHub"),
+    ("ShardWorker", "WatchHub"),
+    ("InformerSnapshotSource", "WatchHub"),
+    ("HealthSource", "WatchHub"),
+    ("ShardWorker", "WatchWake"),
+    ("OrchestratorDaemon", "WatchWake"),
+    ("Informer", "LocalApiServer"),
+    ("WatchHub", "LocalApiServer"),
+    ("WatchWake", "LocalApiServer"),
+    ("InformerSnapshotSource", "LocalApiServer"),
+    ("HealthSource", "LocalApiServer"),
+    ("ShardWorker", "LocalApiServer"),
+    ("LeaderElector", "LocalApiServer"),
+    ("OrchestratorDaemon", "LocalApiServer"),
+)
+
+#: Event-loop touchpoints a signal handler must never reach (LIF805):
+#: scheduling onto a loop from a handler re-enters loop machinery at an
+#: arbitrary bytecode boundary.
+LOOP_TOUCH_NAMES = (
+    frozenset(LOOP_DISPATCH_ARG)
+    | frozenset(CORO_DISPATCH_NAMES)
+    | {"run_until_complete", "run_forever", "add_signal_handler"}
+)
+
+
+# ---------------------------------------------------------------------------
+# Registry scanning (shared with cli.py --stats)
+# ---------------------------------------------------------------------------
+
+
+def _literal_names(expr: ast.expr) -> Optional[tuple[str, ...]]:
+    """A literal method-name spec: ``"stop"`` or ``("stop", "close")``.
+    None when computed — invisible to the verifier, so not registered."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return (expr.value,)
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        out = []
+        for elt in expr.elts:
+            if not (isinstance(elt, ast.Constant)
+                    and isinstance(elt.value, str)):
+                return None
+            out.append(elt.value)
+        return tuple(out)
+    return None
+
+
+def _decorator_registration(
+    node: ast.ClassDef,
+) -> Optional[tuple[tuple[str, ...], tuple[str, ...]]]:
+    """(acquires, releases) when the class carries a literal
+    ``@lifecycle_resource(...)`` decorator."""
+    for deco in node.decorator_list:
+        if isinstance(deco, (ast.Name, ast.Attribute)):
+            fname = deco.id if isinstance(deco, ast.Name) else deco.attr
+            if fname == "lifecycle_resource":
+                return (("start",), ("stop",))
+            continue
+        if not isinstance(deco, ast.Call):
+            continue
+        func = deco.func
+        fname = func.id if isinstance(func, ast.Name) else (
+            func.attr if isinstance(func, ast.Attribute) else "")
+        if fname != "lifecycle_resource":
+            continue
+        spec = {"acquire": ("start",), "release": ("stop",)}
+        positions = ("acquire", "release")
+        ok = len(deco.args) <= 2
+        for i, arg in enumerate(deco.args[:2]):
+            names = _literal_names(arg)
+            if names is None:
+                ok = False
+                break
+            spec[positions[i]] = names
+        for kw in deco.keywords:
+            names = _literal_names(kw.value)
+            if kw.arg not in positions or names is None:
+                ok = False
+                break
+            spec[kw.arg] = names
+        if ok:
+            return (spec["acquire"], spec["release"])
+    return None
+
+
+def _class_defs(module: ParsedModule) -> Iterator[ast.ClassDef]:
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.ClassDef):
+            yield node
+
+
+def tracked_resources(
+    project: Project,
+) -> dict[str, tuple[tuple[str, ...], tuple[str, ...]]]:
+    """Bare class name -> (acquires, releases): the builtin table plus
+    every literal ``@lifecycle_resource`` registration in the project
+    (in-project registrations win)."""
+    out = dict(BUILTIN_RESOURCES)
+    for module in project.modules:
+        for node in _class_defs(module):
+            reg = _decorator_registration(node)
+            if reg is not None:
+                out[node.name] = reg
+    return out
+
+
+def project_resource_classes(
+    project: Project,
+) -> list[tuple[ParsedModule, ast.ClassDef, str]]:
+    """Tracked-resource classes DEFINED in the analyzed project — the
+    ``--stats`` ``resources=N`` coverage counter's source (cli.py), so
+    the stats line and this pass can never disagree about what is
+    tracked."""
+    tracked = tracked_resources(project)
+    out = []
+    for module in project.modules:
+        for node in _class_defs(module):
+            if node.name in tracked:
+                out.append((module, node, node.name))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# AST helpers
+# ---------------------------------------------------------------------------
+
+_SCOPE_BOUNDARY = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                   ast.ClassDef)
+
+
+def _own_nodes(func_node: ast.AST) -> Iterator[ast.AST]:
+    """Every node in this frame, excluding nested def/lambda/class
+    bodies (their lifecycles are their own frames' business)."""
+    stack: list[ast.AST] = list(ast.iter_child_nodes(func_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, _SCOPE_BOUNDARY):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _is_self_attr(node: ast.expr) -> bool:
+    return (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self")
+
+
+def _thread_ctor(expr: ast.expr) -> Optional[ast.Call]:
+    """The call when ``expr`` constructs a ``threading.Thread``."""
+    if not isinstance(expr, ast.Call):
+        return None
+    dotted = _dotted(expr.func)
+    if dotted == "threading.Thread" or dotted == "Thread":
+        return expr
+    return None
+
+
+def _thread_is_daemon(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+            return bool(kw.value.value)
+    return False
+
+
+def _receiver_of(call: ast.Call) -> Optional[ast.expr]:
+    if isinstance(call.func, ast.Attribute):
+        return call.func.value
+    return None
+
+
+def _mentions_name(node: ast.AST, name: str) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id == name:
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# The pass
+# ---------------------------------------------------------------------------
+
+
+@register
+class LifecycleDisciplinePass(AnalysisPass):
+    name = "lifecycle-discipline"
+    codes = ("LIF801", "LIF802", "LIF803", "LIF804", "LIF805")
+
+    def run(self, project: Project) -> None:
+        engine = _Engine.for_project(project)
+        graph = engine.graph
+        self._resources = tracked_resources(project)
+        self._thread_attrs = self._collect_thread_attrs(graph)
+        facts = self._release_facts(engine, graph)
+        self._check_owned(engine, graph, facts)
+        self._check_frames(graph)
+        self._check_shutdown_joins(graph)
+        self._check_signal_handlers(engine, graph)
+
+    # -- typing helpers -----------------------------------------------------
+    def _kind_of_typekey(self, graph: CallGraph,
+                         tkey: Optional[str]) -> Optional[str]:
+        """Tracked-resource kind (bare registry name) for a type key,
+        searching the MRO so subclasses inherit their base's pair."""
+        if not tkey or not tkey.startswith("class:"):
+            return None
+        for ck in graph._mro(tkey[len("class:"):]):
+            name = graph.classes[ck].name
+            if name in self._resources:
+                return name
+        return None
+
+    def _ctor_kind(self, call: ast.Call) -> Optional[str]:
+        """Syntactic fallback when the constructed class is OUTSIDE the
+        analysis scope (bench/tests importing the package): match the
+        constructor's bare name — or a chained acquire on one, like
+        ``ShardWorker(...).start()`` — against the registry."""
+        func = call.func
+        if isinstance(func, ast.Name) and func.id in self._resources:
+            return func.id
+        if isinstance(func, ast.Attribute):
+            if func.attr in self._resources:
+                return func.attr
+            if isinstance(func.value, ast.Call):
+                inner = self._ctor_kind(func.value)
+                if inner is not None \
+                        and func.attr in self._resources[inner][0]:
+                    return inner
+        return None
+
+    def _owner_attr(
+        self, graph: CallGraph, cls: Optional[ClassInfo], attr: str
+    ) -> Optional[tuple[str, str]]:
+        """(defining class key, kind) when ``self.<attr>`` on ``cls``
+        holds a tracked resource — mirrors ``_expr_type``'s first-hit
+        MRO walk so obligations and release facts always agree."""
+        if cls is None:
+            return None
+        for ck in graph._mro(cls.key):
+            ci = graph.classes[ck]
+            if attr in ci.attr_types:
+                kind = self._kind_of_typekey(graph, ci.attr_types[attr])
+                if kind is None:
+                    return None
+                return ck, kind
+        return None
+
+    def _thread_owner(
+        self, graph: CallGraph, cls: Optional[ClassInfo], attr: str
+    ) -> Optional[str]:
+        """Defining class key when ``self.<attr>`` is a thread attr."""
+        if cls is None:
+            return None
+        for ck in graph._mro(cls.key):
+            if attr in self._thread_attrs.get(ck, {}):
+                return ck
+        return None
+
+    @staticmethod
+    def _aliases(fi: FunctionInfo) -> dict[str, str]:
+        """local name -> self attr, for ``x = self._thing`` bindings —
+        the stop-method idiom (grab under lock, release outside)."""
+        out: dict[str, str] = {}
+        for node in _own_nodes(fi.node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and _is_self_attr(node.value):
+                out[node.targets[0].id] = node.value.attr
+        return out
+
+    def _call_attr_target(
+        self, fi: FunctionInfo, call: ast.Call, aliases: dict[str, str]
+    ) -> Optional[str]:
+        """The self-attr a method call targets: ``self.X.m()`` or
+        ``x.m()`` where ``x = self.X``."""
+        recv = _receiver_of(call)
+        if recv is None:
+            return None
+        if _is_self_attr(recv):
+            return recv.attr
+        if isinstance(recv, ast.Name) and recv.id in aliases:
+            return aliases[recv.id]
+        return None
+
+    # -- thread attrs -------------------------------------------------------
+    def _collect_thread_attrs(
+        self, graph: CallGraph
+    ) -> dict[str, dict[str, tuple[bool, ast.AST, bool]]]:
+        """class key -> attr -> (daemon, assignment node, started):
+        every ``self.X = threading.Thread(...)`` in the project, plus
+        whether any method actually starts it."""
+        out: dict[str, dict[str, tuple[bool, ast.AST, bool]]] = {}
+        for key in sorted(graph.classes):
+            ci = graph.classes[key]
+            attrs: dict[str, tuple[bool, ast.AST, bool]] = {}
+            for method in ci.methods.values():
+                for node in _own_nodes(method.node):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    ctor = _thread_ctor(node.value)
+                    if ctor is None:
+                        continue
+                    for target in node.targets:
+                        if _is_self_attr(target):
+                            daemon = _thread_is_daemon(ctor)
+                            prev = attrs.get(target.attr)
+                            # Non-daemon observations win: the
+                            # obligation exists if ANY path starts a
+                            # non-daemon thread under this attr.
+                            if prev is None or (prev[0] and not daemon):
+                                attrs[target.attr] = (daemon, node, False)
+            if not attrs:
+                continue
+            for method in ci.methods.values():
+                aliases = self._aliases(method)
+                for node in _own_nodes(method.node):
+                    if isinstance(node, ast.Call) and isinstance(
+                            node.func, ast.Attribute) \
+                            and node.func.attr == "start":
+                        attr = self._call_attr_target(method, node, aliases)
+                        if attr in attrs:
+                            daemon, site, _ = attrs[attr]
+                            attrs[attr] = (daemon, site, True)
+            out[key] = attrs
+        return out
+
+    # -- release facts (the up-callgraph fixpoint) --------------------------
+    def _release_facts(
+        self, engine: "_Engine", graph: CallGraph
+    ) -> dict[str, dict]:
+        """fid -> {("rel"|"join", owner class key, attr): witness chain}
+        — which owned resources each function (transitively) releases."""
+        seed: dict[str, dict] = {}
+        for fid in engine.summaries:
+            fi = graph.functions[fid]
+            table: dict[tuple[str, str, str], tuple[str, ...]] = {}
+            aliases = self._aliases(fi)
+            for node in _own_nodes(fi.node):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)):
+                    continue
+                attr = self._call_attr_target(fi, node, aliases)
+                if attr is None:
+                    continue
+                owned = self._owner_attr(graph, fi.cls, attr)
+                if owned is not None:
+                    owner_key, kind = owned
+                    if node.func.attr in self._resources[kind][1]:
+                        table.setdefault(("rel", owner_key, attr), (fid,))
+                if node.func.attr == "join":
+                    tkey = self._thread_owner(graph, fi.cls, attr)
+                    if tkey is not None:
+                        table.setdefault(("join", tkey, attr), (fid,))
+            seed[fid] = table
+        return engine.propagate(
+            seed, lambda fid, chain: ((fid,) + chain)[:MAX_CHAIN]
+        )
+
+    # -- LIF801 / LIF803 (owned attrs) --------------------------------------
+    def _shutdown_fids(self, graph: CallGraph, ci: ClassInfo) -> list[str]:
+        own = [
+            m.fid for name, m in sorted(ci.methods.items())
+            if name in SHUTDOWN_NAMES
+        ]
+        if own:
+            return own
+        inherited: list[str] = []
+        for name in SHUTDOWN_NAMES:
+            for fid in graph.resolve_method(ci.key, name, dispatch=False):
+                if fid not in inherited:
+                    inherited.append(fid)
+        return inherited
+
+    def _acquire_events(
+        self, graph: CallGraph, ci: ClassInfo
+    ) -> dict[tuple[str, str], tuple[str, ast.AST]]:
+        """(owner key, attr) -> (kind, first acquire site) for every
+        resource this class acquires into a self attr."""
+        events: dict[tuple[str, str], tuple[str, ast.AST]] = {}
+        for _name, method in sorted(ci.methods.items()):
+            env = graph.local_env(method)
+            aliases = self._aliases(method)
+            for node in _own_nodes(method.node):
+                if isinstance(node, ast.Call) and isinstance(
+                        node.func, ast.Attribute):
+                    attr = self._call_attr_target(method, node, aliases)
+                    if attr is None:
+                        continue
+                    owned = self._owner_attr(graph, ci, attr)
+                    if owned is None:
+                        continue
+                    owner_key, kind = owned
+                    acquires = self._resources[kind][0]
+                    if node.func.attr in acquires:
+                        events.setdefault((owner_key, attr), (kind, node))
+                elif isinstance(node, ast.Assign):
+                    value = node.value
+                    if not isinstance(value, ast.Call):
+                        continue
+                    tkey = graph._expr_type(ci.module, value, env, ci)
+                    kind = self._kind_of_typekey(graph, tkey)
+                    if kind is None:
+                        continue
+                    acquires = self._resources[kind][0]
+                    chained = (isinstance(value.func, ast.Attribute)
+                               and value.func.attr in acquires)
+                    constructed = "__init__" in acquires
+                    if not (chained or constructed):
+                        continue
+                    for target in node.targets:
+                        if _is_self_attr(target):
+                            owned = self._owner_attr(graph, ci, target.attr)
+                            if owned is not None:
+                                events.setdefault(
+                                    (owned[0], target.attr), (kind, node))
+        return events
+
+    def _check_owned(self, engine: "_Engine", graph: CallGraph,
+                     facts: dict[str, dict]) -> None:
+        shutdown_list = "/".join(n for n in SHUTDOWN_NAMES[:3])
+        for key in sorted(graph.classes):
+            ci = graph.classes[key]
+            events = self._acquire_events(graph, ci)
+            threads = {
+                attr: spec
+                for attr, spec in self._thread_attrs.get(key, {}).items()
+                if not spec[0] and spec[2]  # non-daemon AND started
+            }
+            if not events and not threads:
+                continue
+            shutdown = self._shutdown_fids(graph, ci)
+            for (owner_key, attr), (kind, node) in sorted(
+                    events.items(), key=lambda kv: kv[0]):
+                releases = "/".join(self._resources[kind][1])
+                if not shutdown:
+                    self.add(
+                        ci.module, node, "LIF801",
+                        f"class '{ci.name}' acquires {kind} in "
+                        f"'self.{attr}' but defines no shutdown method "
+                        f"({shutdown_list}/...) that could release it",
+                    )
+                    continue
+                if any(("rel", owner_key, attr) in facts.get(fid, {})
+                       for fid in shutdown):
+                    continue
+                names = ", ".join(engine.qualname(f) for f in shutdown)
+                self.add(
+                    ci.module, node, "LIF801",
+                    f"leaked {kind}: 'self.{attr}' is acquired here but "
+                    f"'self.{attr}.{releases}()' is not reachable from "
+                    f"any shutdown method of '{ci.name}' ({names})",
+                )
+            for attr, (daemon, node, _started) in sorted(threads.items()):
+                if not shutdown:
+                    self.add(
+                        ci.module, node, "LIF803",
+                        f"non-daemon thread 'self.{attr}' is started but "
+                        f"'{ci.name}' defines no shutdown method that "
+                        f"could join it",
+                    )
+                    continue
+                owner = self._thread_owner(graph, ci, attr) or key
+                if any(("join", owner, attr) in facts.get(fid, {})
+                       for fid in shutdown):
+                    continue
+                names = ", ".join(engine.qualname(f) for f in shutdown)
+                self.add(
+                    ci.module, node, "LIF803",
+                    f"non-daemon thread 'self.{attr}' is not joined on "
+                    f"any shutdown path of '{ci.name}' ({names}) — the "
+                    f"process cannot exit until it does",
+                )
+
+    # -- LIF802 / LIF804 / local-thread LIF803 (same-frame analysis) --------
+    def _frame_tries(
+        self, fi: FunctionInfo
+    ) -> list[tuple[ast.Try, tuple[int, int], tuple[int, int]]]:
+        out = []
+        for node in _own_nodes(fi.node):
+            if isinstance(node, ast.Try) and node.finalbody:
+                body_span = (
+                    node.body[0].lineno,
+                    node.body[-1].end_lineno or node.body[-1].lineno,
+                )
+                final_span = (
+                    node.finalbody[0].lineno,
+                    node.finalbody[-1].end_lineno
+                    or node.finalbody[-1].lineno,
+                )
+                out.append((node, body_span, final_span))
+        return out
+
+    @staticmethod
+    def _raisers_between(fi: FunctionInfo, lo: int, hi: int,
+                         exclude: set[int]) -> list[ast.AST]:
+        """Raise-capable nodes strictly between lines ``lo`` and ``hi``
+        (calls, raises, asserts), excluding specific node ids."""
+        out = []
+        for node in _own_nodes(fi.node):
+            if not isinstance(node, (ast.Call, ast.Raise, ast.Assert)):
+                continue
+            if id(node) in exclude:
+                continue
+            line = getattr(node, "lineno", 0)
+            if lo < line < hi:
+                out.append(node)
+        return out
+
+    def _frame_param_names(self, fi: FunctionInfo) -> set[str]:
+        args = fi.node.args
+        return {
+            a.arg for a in (list(args.posonlyargs) + list(args.args)
+                            + list(args.kwonlyargs))
+        }
+
+    def _local_escapes(self, fi: FunctionInfo, name: str,
+                       exclude: set[int]) -> bool:
+        """Ownership leaves the frame: passed as an argument, returned,
+        yielded, stored into an attribute/container, or aliased."""
+        for node in _own_nodes(fi.node):
+            if id(node) in exclude:
+                continue
+            if isinstance(node, ast.Call):
+                for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                    if _mentions_name(arg, name):
+                        return True
+            elif isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+                if node.value is not None and _mentions_name(node.value, name):
+                    return True
+            elif isinstance(node, ast.Assign):
+                if _mentions_name(node.value, name):
+                    return True
+            elif isinstance(node, (ast.List, ast.Tuple, ast.Set, ast.Dict)):
+                if _mentions_name(node, name):
+                    return True
+        return False
+
+    def _in_with(self, fi: FunctionInfo, name: str) -> bool:
+        for node in _own_nodes(fi.node):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if _mentions_name(item.context_expr, name):
+                        return True
+        return False
+
+    def _check_frames(self, graph: CallGraph) -> None:
+        for fid in sorted(graph.functions):
+            fi = graph.functions[fid]
+            self._check_one_frame(graph, fi)
+
+    def _frame_locals(
+        self, graph: CallGraph, fi: FunctionInfo
+    ) -> tuple[dict[str, tuple[str, ast.AST]], dict[str, str]]:
+        """(acquired, local kinds): ``acquired`` maps local name ->
+        (kind, acquire site) for resources acquired in this frame
+        (constructed __init__-kinds, chained ``.start()`` constructions,
+        or acquire calls on a typed local); ``local kinds`` types every
+        local bound to a tracked kind, including via the syntactic
+        constructor fallback."""
+        env = graph.local_env(fi)
+        acquired: dict[str, tuple[str, ast.AST]] = {}
+        local_kinds: dict[str, str] = {}
+        # Two phases because _own_nodes is not in source order: bind
+        # constructions first, then acquire-calls can consult them.
+        for node in _own_nodes(fi.node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Call):
+                tkey = graph._expr_type(fi.module, node.value, env, fi.cls)
+                kind = self._kind_of_typekey(graph, tkey)
+                if kind is None:
+                    kind = self._ctor_kind(node.value)
+                if kind is None:
+                    continue
+                local_kinds.setdefault(node.targets[0].id, kind)
+                acquires = self._resources[kind][0]
+                chained = (isinstance(node.value.func, ast.Attribute)
+                           and node.value.func.attr in acquires)
+                if "__init__" in acquires or chained:
+                    acquired.setdefault(node.targets[0].id, (kind, node))
+        for node in _own_nodes(fi.node):
+            if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute) \
+                    and isinstance(node.func.value, ast.Name):
+                recv = node.func.value.id
+                kind = self._kind_of_typekey(graph, env.get(recv))
+                if kind is None:
+                    kind = local_kinds.get(recv)
+                if kind is None:
+                    continue
+                if node.func.attr in self._resources[kind][0]:
+                    acquired.setdefault(recv, (kind, node))
+        return acquired, local_kinds
+
+    def _check_one_frame(self, graph: CallGraph, fi: FunctionInfo) -> None:
+        acquired, local_kinds = self._frame_locals(graph, fi)
+        params = self._frame_param_names(fi)
+        tries = self._frame_tries(fi)
+        env = graph.local_env(fi)
+        aliases = self._aliases(fi)
+
+        # Release events for LIF804 ordering: kind + line, locals AND
+        # self attrs, in source order.
+        order_events: list[tuple[int, str, ast.AST]] = []
+
+        release_sites: dict[str, list[ast.Call]] = {}
+        for node in _own_nodes(fi.node):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            recv = node.func.value
+            kind = None
+            if isinstance(recv, ast.Name):
+                if recv.id in aliases:
+                    owned = self._owner_attr(graph, fi.cls, aliases[recv.id])
+                    kind = owned[1] if owned else None
+                else:
+                    kind = self._kind_of_typekey(graph, env.get(recv.id))
+                    if kind is None:
+                        kind = local_kinds.get(recv.id)
+                if kind and node.func.attr in self._resources[kind][1]:
+                    if recv.id in acquired:
+                        release_sites.setdefault(recv.id, []).append(node)
+                    order_events.append((node.lineno, kind, node))
+            elif _is_self_attr(recv):
+                owned = self._owner_attr(graph, fi.cls, recv.attr)
+                if owned is not None:
+                    kind = owned[1]
+                    if node.func.attr in self._resources[kind][1]:
+                        order_events.append((node.lineno, kind, node))
+
+        # -- LIF802: exception-safe release of frame-local resources --------
+        for name in sorted(acquired):
+            kind, site = acquired[name]
+            if self._in_with(fi, name):
+                continue  # context manager owns the release
+            releases = release_sites.get(name, [])
+            rel_names = "/".join(self._resources[kind][1])
+            if not releases:
+                if name in params:
+                    continue  # caller owns it
+                exclude = {id(site)}
+                if isinstance(site, ast.Assign):
+                    exclude.add(id(site.value))
+                if not self._local_escapes(fi, name, exclude):
+                    self.add(
+                        fi.module, site, "LIF802",
+                        f"local {kind} '{name}' acquired here is never "
+                        f"released in this frame (expected "
+                        f"'{name}.{rel_names}()') and never escapes",
+                    )
+                continue
+            self._check_release_safety(
+                fi, name, kind, site, releases, tries)
+
+        # -- LIF803: local non-daemon threads --------------------------------
+        self._check_local_threads(fi, params)
+
+        # -- LIF804: stop-order within the frame -----------------------------
+        reported: set[tuple[str, str]] = set()
+        order_events.sort(key=lambda e: e[0])
+        for i, (line_p, kind_p, node_p) in enumerate(order_events):
+            for line_c, kind_c, _node_c in order_events[i + 1:]:
+                if kind_c == kind_p:
+                    continue
+                if (kind_c, kind_p) in STOP_ORDER_EDGES \
+                        and (kind_p, kind_c) not in reported:
+                    reported.add((kind_p, kind_c))
+                    self.add(
+                        fi.module, node_p, "LIF804",
+                        f"stop-order violation: {kind_p} is released "
+                        f"here (line {line_p}) before the {kind_c} that "
+                        f"consumes it (line {line_c}) — release order "
+                        f"must reverse the dependency DAG "
+                        f"(docs/daemon-lifecycle.md)",
+                    )
+
+    def _check_release_safety(
+        self, fi: FunctionInfo, name: str, kind: str, site: ast.AST,
+        releases: list[ast.Call],
+        tries: list[tuple[ast.Try, tuple[int, int], tuple[int, int]]],
+    ) -> None:
+        acq_end = getattr(site, "end_lineno", None) or site.lineno
+        exclude = {id(r) for r in releases}
+        if isinstance(site, ast.Assign):
+            exclude.add(id(site.value))
+        best: Optional[tuple[str, ast.AST, int]] = None
+        for rel in releases:
+            protecting = None
+            for t, body_span, final_span in tries:
+                if final_span[0] <= rel.lineno <= final_span[1]:
+                    protecting = (t, body_span)
+                    break
+            if protecting is not None:
+                t, body_span = protecting
+                if body_span[0] <= site.lineno <= body_span[1]:
+                    return  # acquired inside the try: finally covers it
+                gap = self._raisers_between(fi, acq_end, t.lineno, exclude)
+                if not gap:
+                    return
+                if best is None or best[0] != "gap":
+                    best = ("gap", rel, len(gap))
+            else:
+                between = self._raisers_between(
+                    fi, acq_end, rel.lineno, exclude)
+                if not between:
+                    return
+                if best is None:
+                    best = ("bare", rel, len(between))
+        if best is None:
+            return
+        mode, rel, raising = best
+        verb = rel.func.attr if isinstance(rel.func, ast.Attribute) else "stop"
+        if mode == "gap":
+            self.add(
+                fi.module, site, "LIF802",
+                f"{kind} '{name}' is acquired {raising} raising "
+                f"statement(s) BEFORE the try whose finally releases it "
+                f"— an exception in the gap leaks it (move the "
+                f"acquisition inside the try, or the release into an "
+                f"outer finally)",
+            )
+        else:
+            self.add(
+                fi.module, site, "LIF802",
+                f"release '{name}.{verb}()' is not exception-safe: "
+                f"{raising} raising statement(s) between acquire and "
+                f"release can skip it — move the release into a finally",
+            )
+
+    def _check_local_threads(self, fi: FunctionInfo,
+                             params: set[str]) -> None:
+        threads: dict[str, tuple[ast.AST, ast.Call]] = {}
+        for node in _own_nodes(fi.node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                ctor = _thread_ctor(node.value)
+                if ctor is not None and not _thread_is_daemon(ctor):
+                    threads[node.targets[0].id] = (node, ctor)
+        if not threads:
+            return
+        started: set[str] = set()
+        joined: set[str] = set()
+        daemon_later: set[str] = set()
+        for node in _own_nodes(fi.node):
+            if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute) \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id in threads:
+                if node.func.attr == "start":
+                    started.add(node.func.value.id)
+                elif node.func.attr == "join":
+                    joined.add(node.func.value.id)
+            elif isinstance(node, ast.Assign) \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Attribute) \
+                    and node.targets[0].attr == "daemon" \
+                    and isinstance(node.targets[0].value, ast.Name) \
+                    and node.targets[0].value.id in threads \
+                    and isinstance(node.value, ast.Constant) \
+                    and bool(node.value.value):
+                daemon_later.add(node.targets[0].value.id)
+        for name in sorted(threads):
+            site, ctor = threads[name]
+            if name not in started or name in joined \
+                    or name in daemon_later or name in params:
+                continue
+            exclude = {id(site), id(ctor)}
+            if self._local_escapes(fi, name, exclude):
+                continue
+            self.add(
+                fi.module, site, "LIF803",
+                f"non-daemon thread '{name}' is started in this frame "
+                f"but never joined (and never escapes) — it outlives "
+                f"the frame with nothing owning its shutdown",
+            )
+
+    # -- LIF803: join-without-timeout on shutdown paths ----------------------
+    def _shutdown_reachable(self, graph: CallGraph) -> set[str]:
+        roots = [
+            fid for fid, fi in graph.functions.items()
+            if fi.name in SHUTDOWN_NAMES
+        ]
+        seen = set(roots)
+        work = list(roots)
+        while work:
+            fid = work.pop()
+            for _call, callees in graph.calls.get(fid, ()):
+                for callee in callees:
+                    if callee not in seen:
+                        seen.add(callee)
+                        work.append(callee)
+        return seen
+
+    def _is_thread_ref(self, graph: CallGraph, fi: FunctionInfo,
+                       recv: ast.expr, env: dict[str, str],
+                       aliases: dict[str, str]) -> bool:
+        if isinstance(recv, ast.Name):
+            if recv.id in aliases:
+                return self._thread_owner(
+                    graph, fi.cls, aliases[recv.id]) is not None
+            tkey = env.get(recv.id, "")
+            return tkey.startswith("ext:") and tkey.endswith(".Thread")
+        if _is_self_attr(recv):
+            return self._thread_owner(graph, fi.cls, recv.attr) is not None
+        return False
+
+    def _check_shutdown_joins(self, graph: CallGraph) -> None:
+        reachable = self._shutdown_reachable(graph)
+        for fid in sorted(reachable):
+            fi = graph.functions.get(fid)
+            if fi is None:
+                continue
+            env = graph.local_env(fi)
+            aliases = self._aliases(fi)
+            for node in _own_nodes(fi.node):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "join"):
+                    continue
+                if node.args or any(kw.arg == "timeout"
+                                    for kw in node.keywords):
+                    continue
+                if self._is_thread_ref(graph, fi, node.func.value, env,
+                                       aliases):
+                    self.add(
+                        fi.module, node, "LIF803",
+                        "thread join() without a timeout on the "
+                        "shutdown path — a wedged thread makes shutdown "
+                        "unbounded; pass timeout= and report overruns",
+                    )
+
+    # -- LIF805: signal-handler discipline -----------------------------------
+    def _loop_touch(
+        self, engine: "_Engine", graph: CallGraph, start: str
+    ) -> Optional[tuple[str, tuple[str, ...]]]:
+        """(touch name, witness chain) when an event-loop touchpoint is
+        reachable from ``start`` (BFS with parent links, no recursion)."""
+        parent: dict[str, Optional[str]] = {start: None}
+        work = [start]
+        while work:
+            fid = work.pop(0)
+            fi = graph.functions.get(fid)
+            if fi is not None:
+                for call in _own_body_calls(fi.node):
+                    name = (call.func.attr
+                            if isinstance(call.func, ast.Attribute)
+                            else call.func.id
+                            if isinstance(call.func, ast.Name) else "")
+                    if name in LOOP_TOUCH_NAMES:
+                        chain: list[str] = [fid]
+                        while parent[chain[-1]] is not None:
+                            chain.append(parent[chain[-1]])
+                        return name, tuple(reversed(chain))[:MAX_CHAIN]
+            for _call, callees in graph.calls.get(fid, ()):
+                for callee in callees:
+                    if callee not in parent:
+                        parent[callee] = fid
+                        work.append(callee)
+        return None
+
+    def _check_signal_handlers(self, engine: "_Engine",
+                               graph: CallGraph) -> None:
+        for fid in sorted(graph.functions):
+            fi = graph.functions[fid]
+            env = graph.local_env(fi)
+            for node in _own_nodes(fi.node):
+                if not isinstance(node, ast.Call) or len(node.args) < 2:
+                    continue
+                dotted = _dotted(node.func)
+                is_reg = (dotted.endswith("signal.signal")
+                          or dotted == "signal"
+                          and isinstance(node.func, ast.Name))
+                is_loop_reg = (isinstance(node.func, ast.Attribute)
+                               and node.func.attr == "add_signal_handler")
+                if not (is_reg or is_loop_reg):
+                    continue
+                for hfid in graph.resolve_func_ref(fi, node.args[1], env):
+                    self._check_handler(engine, graph, fi, node, hfid)
+
+    def _check_handler(self, engine: "_Engine", graph: CallGraph,
+                       fi: FunctionInfo, node: ast.Call,
+                       hfid: str) -> None:
+        handler = engine.qualname(hfid)
+        blocking = engine.trans_blocking.get(hfid, {})
+        for (reason, _exempt), chain in sorted(blocking.items()):
+            self.add(
+                fi.module, node, "LIF805",
+                f"signal handler '{handler}' reaches blocking call "
+                f"'{reason}' via {engine.chain_text(chain)} — a handler "
+                f"may only set an event (runtime/supervisor.py)",
+            )
+            break  # one blocking witness per handler is enough
+        acquires = engine.trans_acquires.get(hfid, {})
+        for lock, (_reentrant, chain) in sorted(acquires.items()):
+            self.add(
+                fi.module, node, "LIF805",
+                f"signal handler '{handler}' acquires lock '{lock}' via "
+                f"{engine.chain_text(chain)} — handlers interrupt "
+                f"arbitrary bytecode, including the holder's critical "
+                f"section (deadlock)",
+            )
+            break
+        touch = self._loop_touch(engine, graph, hfid)
+        if touch is not None:
+            name, chain = touch
+            self.add(
+                fi.module, node, "LIF805",
+                f"signal handler '{handler}' touches the event loop "
+                f"('{name}') via {engine.chain_text(chain)} — dispatch "
+                f"from the main loop after the event, never from the "
+                f"handler",
+            )

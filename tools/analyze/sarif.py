@@ -43,6 +43,11 @@ RULE_TEXT = {
     "POL703": "policy stashes cross-call state outside its views",
     "POL704": "unregistered protocol implementor / unreferenced name",
     "POL705": "admit does not return a Decision on every path",
+    "LIF801": "background resource acquired with no release reachable from shutdown",
+    "LIF802": "resource release skippable by an exception path (not in finally)",
+    "LIF803": "non-daemon thread not joined / join without timeout on shutdown",
+    "LIF804": "release order violates the stop-order dependency DAG",
+    "LIF805": "signal handler reaches a blocking call, lock, or event loop",
 }
 
 
