@@ -2087,6 +2087,384 @@ def run_fleet_64_pools(
     }
 
 
+def run_fleet_512_pools(
+    pools: int = 512,
+    hosts_per_pool: int = 4,
+    relay_workers: int = 4,
+    min_scaling_x: float = 2.0,
+    max_watch_bytes_ratio: float = 1.3,
+    min_trace_coverage: float = 0.9,
+    converge_deadline_s: float = 900.0,
+) -> dict:
+    """ISSUE 19 headline — the relay tier at 8x the fleet_64 scale: 512
+    pools / 2048 nodes rolled by REAL worker PROCESSES
+    (examples/upgrade_controller.py subprocesses over a written
+    kubeconfig), once from 1 direct worker and once from
+    ``relay_workers`` processes whose watch streams all ride ONE
+    host-local WatchRelay socket (kube/relay.py). The orchestrator runs
+    supervised inside process 0 (``--orchestrate``).
+
+    Hard-asserted (the CI floors pin the measured figures at
+    tools/bench_smoke_baseline.json: fleet_512_pools.*):
+
+    * **zero global-budget violations** — no sample ever observes more
+      than maxUnavailablePools=25% (128) pools disrupted, in either
+      configuration;
+    * **process scaling** — ``relay_workers`` processes achieve >=
+      ``min_scaling_x`` aggregate passes/s vs 1 process (passes summed
+      from each worker's ``--stats-json`` dump: the aggregate
+      wire-I/O-bound throughput probe that shows process scaling even
+      on single-core CI machines, where wall-clock cannot);
+    * **relay upstream attribution, hard-1** — the relay holds EXACTLY
+      one live upstream watch stream per informer kind, however many
+      worker processes subscribe, and the primary's request log shows
+      ZERO bypass opens: every watch open on a relay-served kind is
+      attributable to the hub's own open counter (sequential re-opens
+      are overflow-shed windows of the same logical stream — the
+      server ends a lagging watch at ``_WATCH_QUEUE_LIMIT`` and the
+      hub resumes from its cursor);
+    * **watch bytes** — the relay configuration's server-side watch
+      bytes stay within ``max_watch_bytes_ratio`` of the ONE-worker
+      figure (fan-out happens at the relay, paid once upstream — and
+      the relay's upstream rides the compact encoding);
+    * **zero event-loop stalls** — the apiserver loop runs under the
+      stall watchdog in both configurations;
+    * **trace attribution through the relay** — the in-process
+      trace_attribution sub-config re-runs with every watch stream on a
+      real relay socket and must keep critical-path coverage >=
+      ``min_trace_coverage`` (traceparent/rv-origin survive the hop).
+    """
+    import shutil
+    import signal as _signal
+    import subprocess
+    import tempfile
+    import threading
+
+    from k8s_operator_libs_tpu.api import (
+        make_fleet_rollout,
+        pools_in_phase,
+        rollout_spec,
+    )
+    from k8s_operator_libs_tpu.kube import (
+        LocalApiServer,
+        RestConfig,
+        WatchRelay,
+    )
+    from k8s_operator_libs_tpu.kube.objects import KubeObject
+    from k8s_operator_libs_tpu.utils.jaxenv import hermetic_cpu_env
+
+    cli = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "examples", "upgrade_controller.py")
+    pool_names = [f"s{i}" for i in range(pools)]
+    #: The informer kinds whose streams ride the relay — the kinds the
+    #: hard-1 upstream attribution is over (the orchestrator's
+    #: FleetRollout wake stream is direct by design and excluded).
+    relay_kinds = ("nodes", "pods", "daemonsets", "controllerrevisions")
+
+    def pool_of(node_name: str) -> str:
+        return node_name.rsplit("-", 1)[0]
+
+    def one_config(n_workers: int, use_relay: bool) -> dict:
+        workdir = tempfile.mkdtemp(prefix="fleet512-")
+        relay = None
+        procs: list = []
+        try:
+            with LocalApiServer(stall_watchdog_threshold_s=1.0) as srv:
+                request_log = srv.start_request_log()
+                _, sim = build_pool(
+                    cluster=srv.cluster, slices=pools,
+                    hosts_per_slice=hosts_per_pool,
+                )
+                rollout = make_fleet_rollout(
+                    "fleet-roll", pool_names, "25%"
+                )
+                budget = rollout_spec(rollout).resolved_budget()
+                srv.cluster.create(KubeObject(rollout))
+                if use_relay:
+                    relay = WatchRelay(
+                        RestConfig(server=srv.url)
+                    ).start()
+                kubeconfig = srv.write_kubeconfig(
+                    os.path.join(workdir, "kubeconfig")
+                )
+                env = hermetic_cpu_env(4)
+                env["KUBECONFIG"] = kubeconfig
+                stats_paths = []
+                log_paths = []
+                started = time.perf_counter()
+                for i in range(n_workers):
+                    stats_path = os.path.join(workdir, f"stats-{i}.json")
+                    stats_paths.append(stats_path)
+                    flags = [
+                        "--shards", str(n_workers),
+                        "--shard-index", str(i),
+                        "--fleet-rollout", "fleet-roll",
+                        "--pool-prefix-sep", "-",
+                        "--interval", "0.02",
+                        "--leader-elect-id", f"proc-{i}",
+                        "--stats-json", stats_path,
+                    ]
+                    if use_relay:
+                        flags += ["--watch-relay", relay.url]
+                    if i == 0:
+                        flags.append("--orchestrate")
+                    # Worker output goes to a FILE, never a pipe: at 512
+                    # pools the per-pass INFO logging overflows an
+                    # unread 64KB pipe buffer and wedges the worker on a
+                    # blocking write mid-roll (0/512 done at any
+                    # deadline — measured the hard way).
+                    log_path = os.path.join(workdir, f"worker-{i}.log")
+                    log_paths.append(log_path)
+                    with open(log_path, "w") as log_f:
+                        procs.append(subprocess.Popen(
+                            [sys.executable, cli, *flags],
+                            env=env, stdout=log_f,
+                            stderr=subprocess.STDOUT, text=True,
+                        ))
+
+                def log_tail(i: int, n: int = 1500) -> str:
+                    try:
+                        with open(log_paths[i]) as f:
+                            return f.read()[-n:]
+                    except OSError:
+                        return "<no worker log>"
+                sim.set_template_hash("libtpu-v2")
+                violations = 0
+                max_disrupted = 0
+                samples = 0
+                deadline = started + converge_deadline_s
+                while True:
+                    sim.step()
+                    for w, proc in enumerate(procs):
+                        if proc.poll() is not None:
+                            raise RuntimeError(
+                                "fleet_512_pools: worker exited early "
+                                f"(rc={proc.returncode}): {log_tail(w)}"
+                            )
+                    disrupted = set()
+                    for name in srv.cluster.object_names("Node"):
+                        raw = srv.cluster.peek("Node", name) or {}
+                        if (raw.get("spec") or {}).get("unschedulable"):
+                            disrupted.add(pool_of(name))
+                    samples += 1
+                    max_disrupted = max(max_disrupted, len(disrupted))
+                    if len(disrupted) > budget:
+                        violations += 1
+                    ledger = srv.cluster.peek("FleetRollout", "fleet-roll")
+                    done = len(pools_in_phase(ledger or {}, "done"))
+                    if done == pools:
+                        break
+                    if time.perf_counter() > deadline:
+                        raise RuntimeError(
+                            "fleet_512_pools: roll did not converge "
+                            f"({done}/{pools} done at "
+                            f"{n_workers} workers, relay={use_relay})"
+                        )
+                    time.sleep(0.02)
+                wall = time.perf_counter() - started
+                if not sim.all_pods_ready_and_current():
+                    raise RuntimeError(
+                        "fleet_512_pools: ledger done but driver pods "
+                        "are not current"
+                    )
+                relay_stats = None
+                for proc in procs:
+                    proc.send_signal(_signal.SIGTERM)
+                total_passes = 0
+                per_worker_passes = []
+                fallbacks = 0
+                for w, (proc, stats_path) in enumerate(
+                    zip(procs, stats_paths)
+                ):
+                    proc.wait(timeout=60)
+                    if proc.returncode != 0:
+                        raise RuntimeError(
+                            "fleet_512_pools: worker exited "
+                            f"rc={proc.returncode}: {log_tail(w)}"
+                        )
+                    with open(stats_path) as f:
+                        stats = json.load(f)
+                    per_worker_passes.append(stats["passes"])
+                    total_passes += stats["passes"]
+                    if "relay" in stats:
+                        fallbacks += stats["relay"]["fallbacks_to_direct"]
+                if relay is not None:
+                    # Stats AFTER every worker exited (the hub may
+                    # re-open windows while they drain — the bypass
+                    # accounting below compares against the request
+                    # log, which records through the drain), and stop
+                    # BEFORE the server closes (a relay outliving its
+                    # upstream would spin reconnect warnings).
+                    relay_stats = relay.stats()
+                    relay.stop()
+                srv.stop_request_log()
+                loop_stalls = srv.loop_stall_stats()
+                if loop_stalls.get("stalls_over_threshold"):
+                    raise RuntimeError(
+                        "fleet_512_pools: "
+                        f"{loop_stalls['stalls_over_threshold']} server "
+                        "loop stall(s) over "
+                        f"{loop_stalls['threshold_s']}s — the read path "
+                        "must scale through replicas/queues, never by "
+                        "blocking the loop"
+                    )
+                if violations:
+                    raise RuntimeError(
+                        f"fleet_512_pools: {violations} samples exceeded "
+                        f"the global budget ({max_disrupted} > {budget} "
+                        "pools)"
+                    )
+                watch_opens: dict = {}
+                for method, req_path, query in request_log:
+                    if method == "GET" and query.get("watch") in (
+                        "true", "1"
+                    ):
+                        plural = req_path.rstrip("/").rsplit("/", 1)[-1]
+                        watch_opens[plural] = (
+                            watch_opens.get(plural, 0) + 1
+                        )
+                relay_streams = {
+                    kind: watch_opens.get(kind, 0)
+                    for kind in relay_kinds
+                }
+                if use_relay:
+                    # Hard-1 is on LIVE streams: the hub owns exactly
+                    # one upstream stream per scope at any moment.
+                    # Sequential re-opens in the request log are
+                    # overflow-shed windows of that SAME logical stream
+                    # (the server ends a lagging watch at
+                    # _WATCH_QUEUE_LIMIT and the hub resumes from its
+                    # cursor — designed load-shedding, not fan-out), so
+                    # the request-log proof is zero BYPASS: every watch
+                    # open per kind is attributable to the hub's own
+                    # open counter — no worker process ever opened a
+                    # direct upstream watch on a relay-served kind.
+                    plural_of = {
+                        "Node": "nodes", "Pod": "pods",
+                        "DaemonSet": "daemonsets",
+                        "ControllerRevision": "controllerrevisions",
+                    }
+                    live_per_kind = dict.fromkeys(relay_kinds, 0)
+                    hub_opens = dict.fromkeys(relay_kinds, 0)
+                    scopes = relay_stats["hub"]["scopes"]
+                    for scope_stats in scopes.values():
+                        plural = plural_of.get(scope_stats["kind"])
+                        if plural in live_per_kind:
+                            live_per_kind[plural] += 1
+                            hub_opens[plural] += scope_stats[
+                                "upstream_watches_opened"
+                            ]
+                    if any(v != 1 for v in live_per_kind.values()):
+                        raise RuntimeError(
+                            "fleet_512_pools: relay config held "
+                            f"{live_per_kind} live upstream watch "
+                            "streams — expected exactly 1 per kind "
+                            f"from {n_workers} worker processes"
+                        )
+                    bypass = {
+                        kind: relay_streams[kind] - hub_opens[kind]
+                        for kind in relay_kinds
+                        if relay_streams[kind] != hub_opens[kind]
+                    }
+                    if bypass:
+                        raise RuntimeError(
+                            "fleet_512_pools: server saw upstream "
+                            "watch opens the relay did not make "
+                            f"(kind: extra) {bypass} — a worker "
+                            "process bypassed the relay"
+                        )
+                    relay_streams = live_per_kind
+                    if not relay_stats["streams_total"]:
+                        raise RuntimeError(
+                            "fleet_512_pools: no subscriber stream "
+                            "ever rode the relay"
+                        )
+                return {
+                    "workers": n_workers,
+                    "relay": use_relay,
+                    "wall_s": round(wall, 3),
+                    "aggregate_passes": total_passes,
+                    "aggregate_passes_per_s": round(
+                        total_passes / wall, 1
+                    ),
+                    "per_worker_passes": per_worker_passes,
+                    "budget_pools": budget,
+                    "max_disrupted_pools_at_once": max_disrupted,
+                    "budget_violations": violations,
+                    "budget_samples": samples,
+                    "upstream_watch_streams_per_kind": relay_streams,
+                    "watch_bytes_sent": srv.watch_bytes_sent,
+                    "relay_fallbacks_to_direct": fallbacks,
+                    "relay_stats": relay_stats,
+                    "server_loop_stalls": loop_stalls,
+                }
+        finally:
+            for proc in procs:
+                if proc.poll() is None:
+                    proc.kill()
+            if relay is not None:
+                relay.stop()
+            shutil.rmtree(workdir, ignore_errors=True)
+
+    base = one_config(1, use_relay=False)
+    peak = one_config(relay_workers, use_relay=True)
+    scaling = round(
+        peak["aggregate_passes_per_s"] / base["aggregate_passes_per_s"], 2
+    ) if base["aggregate_passes_per_s"] else 0.0
+    if scaling < min_scaling_x:
+        raise RuntimeError(
+            f"fleet_512_pools: {relay_workers} worker processes scaled "
+            f"only {scaling}x over 1 (aggregate passes/s) — the "
+            "cross-process relay tier stopped paying for itself"
+        )
+    watch_bytes_ratio = round(
+        peak["watch_bytes_sent"] / base["watch_bytes_sent"], 3
+    ) if base["watch_bytes_sent"] else 0.0
+    if watch_bytes_ratio > max_watch_bytes_ratio:
+        raise RuntimeError(
+            f"fleet_512_pools: relay config at {relay_workers} processes "
+            f"paid {watch_bytes_ratio}x the 1-worker watch bytes "
+            f"(<= {max_watch_bytes_ratio}x required: the relay stopped "
+            "multiplexing)"
+        )
+    # Attribution through the relay hop, at the in-process scale the
+    # tracer instruments (subprocesses cannot share one tracer).
+    trace = run_trace_attribution(
+        pools=64, hosts_per_pool=2, use_relay=True,
+        min_coverage=min_trace_coverage,
+        trace_path=os.environ.get(
+            "BENCH_TRACE_PATH_RELAY", "trace-fleet-roll-relay.jsonl"
+        ),
+    )
+    return {
+        "pools": pools,
+        "nodes": pools * hosts_per_pool,
+        "transport": "http (LocalApiServer; every worker a REAL "
+                     "subprocess of examples/upgrade_controller.py; "
+                     "relay config streams via kube/relay.py)",
+        "budget_violations": max(
+            base["budget_violations"], peak["budget_violations"]
+        ),
+        "process_scaling_vs_1": scaling,
+        "relay_upstream_watch_streams_per_kind": max(
+            peak["upstream_watch_streams_per_kind"].values()
+        ),
+        "relay_watch_bytes_ratio_vs_1w": watch_bytes_ratio,
+        "relay_trace_coverage": trace["critical_path_coverage"],
+        "server_loop_stalls_over_threshold": (
+            base["server_loop_stalls"].get("stalls_over_threshold", 0)
+            + peak["server_loop_stalls"].get("stalls_over_threshold", 0)
+        ),
+        "workers_1_direct": base,
+        f"workers_{relay_workers}_relay": peak,
+        "trace_attribution_relay": trace,
+        "note": "aggregate passes/s counts each process's reconcile "
+                "over ITS OWN shards (smaller scope per pass + "
+                "overlapped wire I/O at N processes) — the equal-units "
+                "comparison is per-config wall_s",
+    }
+
+
 def run_trace_attribution(
     pools: int = 64,
     hosts_per_pool: int = 2,
@@ -2095,6 +2473,7 @@ def run_trace_attribution(
     trace_path: str = "",
     min_coverage: float = 0.9,
     batch_writes: bool = False,
+    use_relay: bool = False,
 ) -> dict:
     """ISSUE 14 headline — end-to-end rollout tracing on a
     fleet_64_pools-shaped roll (docs/tracing.md): 64 pools over a real
@@ -2114,6 +2493,12 @@ def run_trace_attribution(
       passes on a live worker's manager emit zero new spans even with
       the tracer still installed (the lazy pass-span contract at fleet
       scale; the settled_pool_noop section pins the same + overhead).
+
+    With ``use_relay`` every worker's watch streams ride a real
+    WatchRelay socket (kube/relay.py) instead of direct upstream
+    connections — the same coverage/journey/wake-link bars then prove
+    traceparent and rv-origin attribution SURVIVE the relay hop (the
+    fleet_512_pools section runs this shape and floors its coverage).
     """
     import threading
 
@@ -2158,6 +2543,8 @@ def run_trace_attribution(
         rollout = make_fleet_rollout("fleet-roll", pool_names, "25%")
         srv.cluster.create(KubeObject(rollout))
         workers, clients = [], []
+        relay = None
+        relay_sources: list = []
         orch_client = None
         stop = threading.Event()
         tracer = tracing.Tracer()
@@ -2165,8 +2552,18 @@ def run_trace_attribution(
         # Acquisitions inside the try: a failed start of worker N must
         # still drain workers 0..N-1 (LIF802).
         try:
+            if use_relay:
+                from k8s_operator_libs_tpu.kube import WatchRelay
+
+                relay = WatchRelay(RestConfig(server=srv.url)).start()
             for i in range(n_workers):
                 client = RestClient(RestConfig(server=srv.url))
+                watch_hub = None
+                if relay is not None:
+                    from k8s_operator_libs_tpu.kube import RelayWatchSource
+
+                    watch_hub = RelayWatchSource(relay.url, direct=client)
+                    relay_sources.append(watch_hub)
                 worker = ShardWorker(
                     client,
                     FleetWorkerConfig(
@@ -2184,6 +2581,7 @@ def run_trace_attribution(
                         renew_deadline_s=3.0,
                         retry_period_s=0.5,
                         batch_writes=batch_writes,
+                        watch_hub=watch_hub,
                     ),
                 )
                 clients.append(client)
@@ -2253,6 +2651,12 @@ def run_trace_attribution(
             stop.set()
             for thread in threads:
                 thread.join(timeout=10)
+            relay_stats = relay.stats() if relay is not None else None
+            if relay is not None and not relay_stats["streams_total"]:
+                raise RuntimeError(
+                    "trace_attribution: use_relay set but no stream ever "
+                    "rode the relay — the traced roll bypassed it"
+                )
 
             # Settled-pass hard-0: let watch echoes land, reach a
             # settled pass, then count spans across 20 more.
@@ -2286,6 +2690,10 @@ def run_trace_attribution(
                 tracing.clear_tracer()
             for worker in workers:
                 worker.stop()
+            for source in relay_sources:
+                source.close()
+            if relay is not None:
+                relay.stop()
             for client in clients:
                 client.close()
             if orch_client is not None:
@@ -2338,6 +2746,10 @@ def run_trace_attribution(
         "flight_recorder_node": node,
         "flight_recorder_transitions": len(journey),
         "flight_recorder_states": to_states,
+        "use_relay": use_relay,
+        "relay_streams_total": (
+            relay_stats["streams_total"] if relay_stats else 0
+        ),
     }
 
 
@@ -2346,6 +2758,8 @@ def run_report_storm(
     writer_threads: int = 64,
     storm_seconds: float = 6.0,
     lease_deadline_s: float = 2.0,
+    read_replicas: int = 0,
+    failover_mid_storm: bool = False,
 ) -> dict:
     """ISSUE 11 — priority-and-fairness under a telemetry storm: a
     simulated thousand-node monitor fleet saturates the apiserver with
@@ -2371,6 +2785,17 @@ def run_report_storm(
       sheds, never by blocking a loop. The storm threshold (1s) is
       above the GIL-scheduling jitter ~66 busy threads can impose on a
       loop thread's heartbeat, and far below any genuine blocking call.
+
+    The multi-server shape (``read_replicas`` > 0, the
+    ``report_storm_multi_server`` section): the lease renewer and the
+    reconciler spread their GETs across read-only replicas of the
+    primary's journal (``RestConfig.read_servers``) while every write
+    stays ordered on the primary — and with ``failover_mid_storm`` one
+    replica is STOPPED halfway through the storm. Hard-asserted on top
+    of the single-server bars: reads actually routed through replicas,
+    the dead replica's in-flight reads failed over to the primary
+    inline (``read_failovers`` ≥ 1), and the zero-missed-renewals /
+    reconcile-p99 bars hold straight through the failover.
     """
     import threading
 
@@ -2406,6 +2831,10 @@ def run_report_storm(
     with LocalApiServer(
         apf=apf, stall_watchdog_threshold_s=stall_threshold_s
     ) as srv:
+        replicas = [
+            srv.read_replica().start() for _ in range(read_replicas)
+        ]
+        read_urls = tuple(r.url for r in replicas)
         srv.cluster.create(wrap({
             "kind": "Lease",
             "apiVersion": "coordination.k8s.io/v1",
@@ -2460,9 +2889,22 @@ def run_report_storm(
 
         renew_gaps: list = []
         renew_latencies: list = []
+        #: Summed transport stats of the replica-reading clients (the
+        #: lease renewer + the reconciler): proves reads ROUTED through
+        #: replicas and failed over when one died.
+        read_stats = {"read_requests_sent": 0, "read_failovers": 0}
+        read_stats_lock = threading.Lock()
+
+        def fold_read_stats(client) -> None:
+            stats = client.transport_stats()
+            with read_stats_lock:
+                for key in read_stats:
+                    read_stats[key] += int(stats.get(key, 0))
 
         def lease_renewer() -> None:
-            client = RestClient(RestConfig(server=srv.url))
+            client = RestClient(
+                RestConfig(server=srv.url, read_servers=read_urls)
+            )
             last_success = time.monotonic()
             try:
                 while not stop.is_set():
@@ -2478,17 +2920,26 @@ def run_report_storm(
             except Exception as e:  # noqa: BLE001 - surfaced below
                 errors.append(f"lease: {e!r}")
             finally:
+                fold_read_stats(client)
                 client.close()
 
         reconcile_latencies: list = []
 
         def reconciler() -> None:
-            client = RestClient(RestConfig(server=srv.url))
+            client = RestClient(
+                RestConfig(server=srv.url, read_servers=read_urls)
+            )
             i = 0
             try:
                 while not stop.is_set():
                     i += 1
                     started = time.perf_counter()
+                    if read_replicas:
+                        # The read-modify-write reconcile shape: the
+                        # read rides a replica, the write the primary —
+                        # both legs inside the measured latency, so the
+                        # p99 bar covers the failover path too.
+                        client.get("Node", "recon-node")
                     client.patch("Node", "recon-node", patch={
                         "metadata": {"labels": {"pass": str(i)}}
                     })
@@ -2499,6 +2950,7 @@ def run_report_storm(
             except Exception as e:  # noqa: BLE001 - surfaced below
                 errors.append(f"reconcile: {e!r}")
             finally:
+                fold_read_stats(client)
                 client.close()
 
         threads = [
@@ -2509,12 +2961,23 @@ def run_report_storm(
         threads.append(threading.Thread(target=reconciler, daemon=True))
         for thread in threads:
             thread.start()
-        time.sleep(storm_seconds)
+        if failover_mid_storm and replicas:
+            # The drill's namesake: kill a replica while the storm is
+            # at full saturation — in-flight reads must fail over to
+            # the primary inline, renewals and reconciles unbroken.
+            time.sleep(storm_seconds / 2)
+            replicas[0].stop()
+            time.sleep(storm_seconds / 2)
+        else:
+            time.sleep(storm_seconds)
         stop.set()
         for thread in threads:
             thread.join(timeout=10)
         stats = srv.apf_stats()
         server_loop = srv.loop_stall_stats()
+        replica_requests_served = sum(r.requests_served for r in replicas)
+        for replica in replicas:
+            replica.stop()
     wire_loop = wire_watchdog.stats()
 
     if errors:
@@ -2547,6 +3010,23 @@ def run_report_storm(
         )
     if not reconcile_latencies or not renew_gaps:
         raise RuntimeError("report_storm: a measured loop never ran")
+    if read_replicas:
+        if not read_stats["read_requests_sent"]:
+            raise RuntimeError(
+                "report_storm: read replicas configured but no read "
+                "ever routed through one — dead read path"
+            )
+        if not replica_requests_served:
+            raise RuntimeError(
+                "report_storm: replicas served zero requests — the "
+                "client-side read counter lied"
+            )
+    if failover_mid_storm and not read_stats["read_failovers"]:
+        raise RuntimeError(
+            "report_storm: a replica died mid-storm but no client ever "
+            "failed a read over to the primary — the failover path "
+            "never ran"
+        )
     reconcile_sorted = sorted(reconcile_latencies)
 
     def percentile(values: list, q: float) -> float:
@@ -2577,6 +3057,13 @@ def run_report_storm(
         "apf_flows": stats,
         "server_loop_stalls": server_loop,
         "wire_loop_stalls": wire_loop,
+        "read_replicas": read_replicas,
+        "replica_failover_mid_storm": bool(
+            failover_mid_storm and replicas
+        ),
+        "reads_via_replicas": read_stats["read_requests_sent"],
+        "replica_requests_served": replica_requests_served,
+        "read_failovers": read_stats["read_failovers"],
     }
 
 
@@ -3420,11 +3907,15 @@ SECTIONS = {
     "degraded_first_roll": run_degraded_first_roll,
     "bad_link_roll": run_bad_link_roll,
     "fleet_64_pools": run_fleet_64_pools,
+    "fleet_512_pools": run_fleet_512_pools,
     "trace_attribution": run_trace_attribution,
     "write_batching": run_write_batching,
     "grant_latency": run_grant_latency,
     "trace_attribution_report": run_trace_attribution_report,
     "report_storm": run_report_storm,
+    "report_storm_multi_server": lambda: run_report_storm(
+        read_replicas=2, failover_mid_storm=True
+    ),
     "chaos_smoke": run_chaos_smoke,
     "policy_matrix": run_policy_matrix,
     "ring_bandwidth": run_ring_bandwidth,

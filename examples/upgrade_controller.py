@@ -15,9 +15,11 @@ each uncordon — the BASELINE config #5 shape, watchable from a terminal.
 from __future__ import annotations
 
 import argparse
+import json
 import logging
 import os
 import sys
+import time
 
 # Allow running straight from a checkout without installation.
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -221,6 +223,16 @@ def main(argv: list[str] | None = None) -> int:
         "policy budget",
     )
     parser.add_argument(
+        "--pool-prefix-sep",
+        default="",
+        metavar="SEP",
+        help="with --shards: map a node NAME to its pool key by taking "
+        "everything before the LAST occurrence of SEP (e.g. '-' maps "
+        "s12-h3 to pool s12) — the pure-string pool partition every "
+        "worker and the orchestrator must agree on. Empty (default) = "
+        "node name is the pool key, the finest grain",
+    )
+    parser.add_argument(
         "--orchestrate",
         action="store_true",
         help="also run the fleet orchestrator in this process as a "
@@ -257,6 +269,25 @@ def main(argv: list[str] | None = None) -> int:
         help="install the rollout tracer (docs/tracing.md) for this "
         "controller's lifetime and export the span trace JSONL to PATH "
         "on exit — inspect with `python -m tools.trace_view PATH`",
+    )
+    parser.add_argument(
+        "--watch-relay",
+        default="",
+        metavar="URL",
+        help="route this worker's watch streams through a WatchRelay at "
+        "URL (docs/wire-path.md): N workers on one host share ONE "
+        "upstream watch stream per kind instead of N. The relay speaks "
+        "the ordinary watch wire protocol, so a dead relay degrades "
+        "this worker to direct upstream watches for a bounded window "
+        "and then retries — never silence",
+    )
+    parser.add_argument(
+        "--stats-json",
+        default="",
+        metavar="PATH",
+        help="write pass-count/wall-time/transport stats JSON to PATH on "
+        "exit — the bench harness sums passes across worker processes "
+        "to measure aggregate scaling",
     )
     args = parser.parse_args(argv)
     if args.orchestrate and not args.fleet_rollout:
@@ -306,6 +337,18 @@ def main(argv: list[str] | None = None) -> int:
                     f"no cluster access configured ({e}); use --demo for the "
                     "in-memory pool"
                 )
+
+        relay_source = None
+        if args.watch_relay and not args.demo:
+            from k8s_operator_libs_tpu.kube import RelayWatchSource
+
+            # All informers below stream through the relay (one upstream
+            # watch per kind, shared across every worker process on the
+            # host); writes and LISTs keep going direct. The source owns
+            # a transport to the relay, so the drain closes it only
+            # after the consumers that name it in depends_on stop.
+            relay_source = RelayWatchSource(args.watch_relay, direct=client)
+            sup.adopt(FuncComponent("relay-source", stop=relay_source.close))
 
         mgr = ClusterUpgradeStateManager(
             client, device, runner=TaskRunner(inline=args.demo)
@@ -407,10 +450,16 @@ def main(argv: list[str] | None = None) -> int:
             identity = (
                 args.leader_elect_id or f"{socket.gethostname()}_{os.getpid()}"
             )
+            sep = args.pool_prefix_sep
+
+            def pool_of(name: str, _sep: str = sep) -> str:
+                return name.rsplit(_sep, 1)[0] if _sep else name
+
             worker = ShardWorker(
                 client,
                 FleetWorkerConfig(
                     identity=identity,
+                    pool_of=pool_of,
                     shards=args.shards,
                     namespace=args.namespace,
                     driver_labels=selector,
@@ -418,6 +467,7 @@ def main(argv: list[str] | None = None) -> int:
                     preferred_shards=[shard_id(args.shard_index % args.shards)],
                     lease_namespace=args.namespace,
                     verify_every_n=args.verify_every_n,
+                    watch_hub=relay_source,
                 ),
                 manager=mgr,
             )
@@ -505,6 +555,7 @@ def main(argv: list[str] | None = None) -> int:
                     args.namespace,
                     selector,
                     verify_every_n=args.verify_every_n,
+                    watch_hub=relay_source,
                 )
             # ControllerRevision is the rollout trigger itself: a driver
             # image bump lands as a new revision — with only Node/Pod
@@ -545,6 +596,8 @@ def main(argv: list[str] | None = None) -> int:
                 source_deps = ["nm-informer"] if args.requestor else []
                 if args.leader_elect:
                     source_deps.append("leader-elector")
+                if relay_source is not None:
+                    source_deps.append("relay-source")
                 sup.adopt(
                     FuncComponent(
                         "snapshot-source", stop=snapshot_source.stop
@@ -572,6 +625,10 @@ def main(argv: list[str] | None = None) -> int:
             ) else []
             if args.leader_elect:
                 worker_deps.append("leader-elector")
+            if relay_source is not None:
+                # The worker's informers pull streams from the relay
+                # source; close the source only after they stop.
+                worker_deps.append("relay-source")
             sup.adopt(
                 FuncComponent("shard-worker", stop=worker.stop),
                 depends_on=worker_deps,
@@ -650,7 +707,7 @@ def main(argv: list[str] | None = None) -> int:
         return _reconcile_loop(
             args, mgr, policy, selector, elector, queue,
             metrics, sim, maintenance_sim, validation_pod_sim,
-            worker=worker, sup=sup,
+            worker=worker, sup=sup, relay_source=relay_source,
         )
     finally:
         # Every exit path — convergence, --once, lease lost, SIGTERM
@@ -677,7 +734,40 @@ def main(argv: list[str] | None = None) -> int:
 def _reconcile_loop(
     args, mgr, policy, selector, elector, queue,
     metrics, sim, maintenance_sim, validation_pod_sim,
-    worker=None, sup=None,
+    worker=None, sup=None, relay_source=None,
+):
+    # The stats file is written on EVERY exit path (convergence, --once,
+    # SIGTERM, lease lost, error): the bench harness reads it to sum
+    # passes across worker processes — an aggregate-throughput scaling
+    # probe that works on single-core machines where wall-clock cannot
+    # show process scaling.
+    stats: dict = {"passes": 0}
+    started = time.monotonic()
+    try:
+        return _reconcile_passes(
+            args, mgr, policy, selector, elector, queue,
+            metrics, sim, maintenance_sim, validation_pod_sim,
+            worker, sup, stats,
+        )
+    finally:
+        if args.stats_json:
+            payload = {
+                "passes": stats["passes"],
+                "wall_s": time.monotonic() - started,
+            }
+            transport_stats = getattr(mgr.client, "transport_stats", None)
+            if callable(transport_stats):
+                payload["transport"] = transport_stats()
+            if relay_source is not None:
+                payload["relay"] = relay_source.stats()
+            with open(args.stats_json, "w") as f:
+                json.dump(payload, f, indent=2, sort_keys=True)
+
+
+def _reconcile_passes(
+    args, mgr, policy, selector, elector, queue,
+    metrics, sim, maintenance_sim, validation_pod_sim,
+    worker, sup, stats,
 ):
     passes = 0
     # A 4-node roll converges in <40 passes; the fleet demo spends extra
@@ -711,6 +801,7 @@ def _reconcile_loop(
             print("leader election: lease lost; exiting", file=sys.stderr)
             return 3
         passes += 1
+        stats["passes"] = passes
         if sim is not None and passes > max_demo_passes:
             print(
                 f"demo: did not converge within {max_demo_passes} passes",

@@ -40,6 +40,7 @@ from .loopwatch import (
 )
 from .apiserver import LocalApiServer
 from .informer import Informer
+from .relay import RelayWatchSource, WatchRelay
 from .watchhub import WatchHub
 from .leader import LeaderElectionConfig, LeaderElector
 from .controller import Controller, Request, Result
@@ -89,6 +90,8 @@ __all__ = [
     "install_wire_loop_watchdog",
     "wire_loop_stall_stats",
     "WatchHub",
+    "WatchRelay",
+    "RelayWatchSource",
     "ApplyConflictError",
     "json_patch",
     "merge_patch",

@@ -731,9 +731,17 @@ class LocalApiServer:
         bookmark_interval_s: float = 15.0,
         apf: Optional[ApfConfig] = None,
         stall_watchdog_threshold_s: float = 0.0,
+        read_only: bool = False,
     ) -> None:
         self.cluster = cluster if cluster is not None else FakeCluster()
         self.token = token
+        #: Read replica mode (docs/wire-path.md "Read replicas"): serve
+        #: GET/HEAD — LIST, delta-LIST, watch — and refuse writes with
+        #: 405, keeping every mutation ordered on the primary. Replicas
+        #: share the primary's cluster journal (see :meth:`read_replica`),
+        #: so a watch served here carries the same revisions in the same
+        #: order the primary assigned.
+        self.read_only = bool(read_only)
         #: Priority-and-fairness: per-flow FIFO queues + shedding. On by
         #: default with storm-sized bounds (see ApfConfig); pass
         #: ``ApfConfig(enabled=False)`` for the raw dispatch path.
@@ -924,7 +932,27 @@ class LocalApiServer:
 
     def stop(self) -> None:
         self.shutdown()
-        self.cluster.close()
+        # A read replica never owns the journal: closing the shared
+        # cluster would take the primary (and its watches) down with it.
+        if not self.read_only:
+            self.cluster.close()
+
+    def read_replica(self, port: int = 0) -> "LocalApiServer":
+        """A NOT-yet-started read-only replica over this server's
+        cluster journal. Sharing the journal object is the in-process
+        stand-in for journal replication: the replica serves LIST,
+        delta-LIST, and watch windows with the primary's revision
+        order, while every write it receives is refused with 405.
+        Clients spread reads via ``RestConfig.read_servers`` and fail
+        over to the primary when a replica dies mid-storm."""
+        return LocalApiServer(
+            cluster=self.cluster,
+            port=port,
+            token=self.token,
+            bookmark_interval_s=self.bookmark_interval_s,
+            apf=self.apf,
+            read_only=True,
+        )
 
     def serve_forever(self) -> None:  # pragma: no cover - CLI entry path
         """Block until interrupted (the __main__ demo path)."""
@@ -991,6 +1019,19 @@ class LocalApiServer:
                 wire_log = self._wire_log
                 if wire_log is not None:
                     wire_log.append((req.method, req.path, pipelined))
+                if self.read_only and req.method not in ("GET", "HEAD"):
+                    await self._write_response(
+                        writer, 405,
+                        _status_body(
+                            405, "MethodNotAllowed",
+                            "read-only replica: send writes to the "
+                            "primary apiserver",
+                        ),
+                        "json", keep_alive=req.keep_alive,
+                    )
+                    if not req.keep_alive:
+                        return
+                    continue
                 scheduler = self._apf_scheduler
                 # Server-side trace context (docs/tracing.md): a request
                 # carrying a traceparent joins the CLIENT's trace — its

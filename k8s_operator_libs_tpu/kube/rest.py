@@ -80,6 +80,12 @@ _SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 #: reflector shape (it picks 5-10 min per window for the same reason).
 DEFAULT_WATCH_TIMEOUT_SECONDS = 300
 
+#: How long a read replica stays out of the rotation after failing a
+#: request. Short on purpose: a replica restart should rejoin within a
+#: lease period, and while it is down every read costs one extra
+#: attempt at most (the inline failover to the primary).
+_READ_DOWN_SECONDS = 5.0
+
 
 class RestConfigError(Exception):
     pass
@@ -110,6 +116,15 @@ class RestConfig:
     #: Python codec cost — the right default on real networks with big
     #: lists, not on loopback (see docs/wire-path.md).
     wire_encoding: str = "json"
+    #: Read-replica endpoints (docs/wire-path.md "Read replicas"):
+    #: extra server URLs that serve GET-only traffic — LIST, delta-LIST,
+    #: and watch windows — while every write stays on ``server`` (the
+    #: primary, where revision order is made). Reads round-robin over
+    #: the healthy replicas; a replica that fails a request is marked
+    #: down for a short window and the request transparently FAILS OVER
+    #: to the primary, so a replica death costs one retry, not a
+    #: missed lease renewal. Replicas share the primary's TLS material.
+    read_servers: tuple = ()
     #: How many times a request shed by the server's priority-and-
     #: fairness layer (429 + Retry-After) is transparently retried after
     #: sleeping the advertised backoff, before TooManyRequestsError
@@ -171,6 +186,10 @@ class RestConfig:
             token=token,
             ca_file=os.path.join(_SA_DIR, "ca.crt"),
             namespace=namespace,
+            # Cross-process by definition (pod → apiserver): the compact
+            # codec's 0.40x bytes are real money here, and negotiation
+            # keeps JSON-only servers working unchanged.
+            wire_encoding="compact",
         )
 
     @classmethod
@@ -207,6 +226,10 @@ class RestConfig:
                 cluster.get("insecure-skip-tls-verify", False)
             ),
             namespace=ctx.get("namespace", "default"),
+            # Kubeconfig = a real network hop (same posture as
+            # in_cluster): compact is the negotiated default, JSON the
+            # fallback for servers that never learned it.
+            wire_encoding="compact",
         )
         if not cfg.server:
             raise RestConfigError(f"cluster in {path} has no server")
@@ -783,6 +806,34 @@ class RestClient(Client):
             self._host if self._https else None,
             timeout,
         )
+        #: Read-replica transports (RestConfig.read_servers): GETs and
+        #: watch windows round-robin here; writes never do. Each entry
+        #: is [transport, down_until_monotonic] — a failed read marks
+        #: its replica down for _READ_DOWN_SECONDS and fails over to
+        #: the primary transport inline.
+        self._read_transports: list[list] = []
+        for read_server in config.read_servers:
+            rparsed = urllib.parse.urlsplit(read_server)
+            if not rparsed.hostname:
+                raise RestConfigError(
+                    f"invalid read server URL {read_server!r}"
+                )
+            rhttps = rparsed.scheme == "https"
+            self._read_transports.append([
+                _Transport(
+                    rparsed.hostname,
+                    rparsed.port or (443 if rhttps else 80),
+                    self._ssl if rhttps else None,
+                    rparsed.hostname if rhttps else None,
+                    timeout,
+                ),
+                0.0,
+            ])
+        self._read_rr = 0
+        self._read_lock = threading.Lock()
+        #: Reads that failed on a replica and were retried on the
+        #: primary — the counter the multi-server report_storm floors.
+        self.read_failovers = 0
         #: Accept header per the configured wire encoding; JSON unless
         #: the caller opted into compact (see RestConfig.wire_encoding).
         self._accept = (
@@ -826,11 +877,41 @@ class RestClient(Client):
 
     def close(self) -> None:
         """Close pooled connections and temp credential files."""
+        for entry in self._read_transports:
+            try:
+                self._call(entry[0].close())
+            except (_TransportError, RuntimeError):  # loop already gone
+                pass
         try:
             self._call(self._transport.close())
         except (_TransportError, RuntimeError):  # loop already gone
             pass
         self.config.close()
+
+    # -- read-replica routing ------------------------------------------------
+    def _pick_read_transport(self) -> Optional["_Transport"]:
+        """Next healthy replica transport (round-robin), or None when
+        there are no replicas or all are marked down (reads then go to
+        the primary like any write)."""
+        if not self._read_transports:
+            return None
+        now = time.monotonic()
+        with self._read_lock:
+            n = len(self._read_transports)
+            for offset in range(n):
+                entry = self._read_transports[(self._read_rr + offset) % n]
+                if entry[1] <= now:
+                    self._read_rr = (self._read_rr + offset + 1) % n
+                    return entry[0]
+        return None
+
+    def _mark_read_down(self, transport: "_Transport") -> None:
+        now = time.monotonic()
+        with self._read_lock:
+            for entry in self._read_transports:
+                if entry[0] is transport:
+                    entry[1] = now + _READ_DOWN_SECONDS
+            self.read_failovers += 1
 
     def transport_stats(self) -> dict[str, int | bool]:
         """Wire-path counters (the attribution the bench publishes):
@@ -846,6 +927,13 @@ class RestClient(Client):
             "bytes_received": t.bytes_received,
             "watch_frames_received": t.watch_frames_received,
             "server_speaks_compact": self._server_speaks_compact,
+            "read_requests_sent": sum(
+                entry[0].requests_sent for entry in self._read_transports
+            ),
+            "read_bytes_received": sum(
+                entry[0].bytes_received for entry in self._read_transports
+            ),
+            "read_failovers": self.read_failovers,
         }
 
     def _headers(
@@ -909,16 +997,38 @@ class RestClient(Client):
                     if request_span is not None and attempt > 0
                     else tracing.use_span(None)
                 )
+                # GETs ride a read replica when one is healthy; a
+                # replica failure marks it down and retries the SAME
+                # request on the primary before surfacing anything —
+                # replica death costs one extra attempt, never a missed
+                # renewal (docs/wire-path.md "Read replicas").
+                read_transport = (
+                    self._pick_read_transport() if method == "GET" else None
+                )
                 with attempt_scope:
                     try:
                         status, rheaders, payload = self._call(
-                            self._transport.request(
+                            (read_transport or self._transport).request(
                                 method, url,
                                 self._headers(data, content_type), data,
                             )
                         )
                     except _TransportError as e:
-                        raise ApiError(f"{method} {url}: {e}") from None
+                        if read_transport is None:
+                            raise ApiError(f"{method} {url}: {e}") from None
+                        self._mark_read_down(read_transport)
+                        try:
+                            status, rheaders, payload = self._call(
+                                self._transport.request(
+                                    method, url,
+                                    self._headers(data, content_type),
+                                    data,
+                                )
+                            )
+                        except _TransportError as e2:
+                            raise ApiError(
+                                f"{method} {url}: {e2}"
+                            ) from None
                 response_ct = rheaders.get("content-type")
                 if is_compact_content_type(response_ct):
                     self._server_speaks_compact = True
@@ -1264,8 +1374,15 @@ class RestClient(Client):
         # (timeout_seconds is always set by this point — see above).
         read_timeout = timeout_seconds + self.timeout
         frames: queue_mod.Queue = queue_mod.Queue()
+        # Watch windows are reads: ride a healthy replica when one is
+        # configured. A mid-window failure marks the replica down and
+        # surfaces like any broken watch — the caller (informer/hub)
+        # re-establishes, and the next window lands on the primary (or
+        # the next healthy replica).
+        read_transport = self._pick_read_transport()
+        watch_transport = read_transport or self._transport
         future = asyncio.run_coroutine_threadsafe(
-            self._transport.watch_pump(
+            watch_transport.watch_pump(
                 url, headers, frames, handle, read_timeout
             ),
             _get_wire_loop(),
@@ -1300,6 +1417,8 @@ class RestClient(Client):
                 else:  # "error"
                     if handle is not None and handle.cancelled:
                         return
+                    if read_transport is not None:
+                        self._mark_read_down(read_transport)
                     raise ApiError(f"GET {url}: {payload}")
         finally:
             if not future.done():

@@ -116,11 +116,22 @@ POINT_WIRE_KILL = "wire_kill"        # driver: LocalApiServer.kill_connections
 #: batchmates land (upgrade/write_batch.py consults this per entry) —
 #: the partial-batch shape a real apiserver produces under contention.
 POINT_WRITE_BATCH = "write_batch_partial"
+#: The host-local WatchRelay (kube/relay.py) loses every subscriber
+#: connection mid-stream (driver: WatchRelay.kill_connections) — each
+#: worker's RelayWatchSource must degrade to a bounded direct-watch
+#: window and then re-adopt the relay, never going silent.
+POINT_RELAY_KILL = "relay_kill"      # driver: WatchRelay.kill_connections
+#: A read replica dies mid-storm and is revived on the same port at the
+#: window's end (driver: replica stop + rebind) — clients must fail the
+#: in-flight read over to the primary inline and keep every watch and
+#: lease renewal flowing.
+POINT_REPLICA_FAILOVER = "replica_failover"
 
 ALL_POINTS = (
     POINT_LEASE, POINT_GRANT_WRITE, POINT_STATUS_WRITE, POINT_WATCH,
     POINT_HUB_REPLAY, POINT_PARTITION, POINT_WORKER_KILL, POINT_SIGTERM,
-    POINT_WIRE_KILL, POINT_WRITE_BATCH,
+    POINT_WIRE_KILL, POINT_WRITE_BATCH, POINT_RELAY_KILL,
+    POINT_REPLICA_FAILOVER,
 )
 
 SCHEDULE_VERSION = 1
@@ -193,6 +204,16 @@ class ChaosConfig:
     checkpoint: bool = False    # checkpoint-coordinated drains + victims
     checkpoint_timeout_s: int = 120
     wire: bool = False          # run over a LocalApiServer (wire mode)
+    #: Co-hosted workers stream their watches through one WatchRelay
+    #: (kube/relay.py) instead of per-process upstream streams — the
+    #: cross-process sibling of ``hub`` — and arm ``relay_kill``. In
+    #: wire mode the relay's upstream is the LocalApiServer socket
+    #: (compact-encoded); otherwise it sits directly on the fake.
+    relay: bool = False
+    #: With ``wire``: start N read-only replicas over the primary's
+    #: journal, spread worker reads across them via
+    #: ``RestConfig.read_servers``, and arm ``replica_failover``.
+    replicas: int = 0
     #: Route worker provider writes through the group-commit batching
     #: tier (upgrade/write_batch.py). The harness stays on the inline
     #: runner, so every stage is a deterministic batch of one — what's
@@ -291,6 +312,10 @@ def generate_schedule(seed: int, config: ChaosConfig) -> FaultSchedule:
         points.append(POINT_HUB_REPLAY)
     if cfg.wire:
         points.append(POINT_WIRE_KILL)
+    if cfg.relay:
+        points.append(POINT_RELAY_KILL)
+    if cfg.wire and cfg.replicas:
+        points.append(POINT_REPLICA_FAILOVER)
     if cfg.batch_writes:
         points.append(POINT_WRITE_BATCH)
     identities = cfg.identities()
@@ -390,6 +415,21 @@ def generate_schedule(seed: int, config: ChaosConfig) -> FaultSchedule:
         elif point == POINT_WIRE_KILL:
             faults.append(FaultSpec(
                 step=step, point=point, duration=rng.randint(1, 2),
+            ))
+        elif point == POINT_RELAY_KILL:
+            # Same envelope as wire_kill: the relay's subscriber
+            # connections die for the window; the relay itself stays
+            # up, so resumes race fallbacks — both paths must converge.
+            faults.append(FaultSpec(
+                step=step, point=point, duration=rng.randint(1, 2),
+            ))
+        elif point == POINT_REPLICA_FAILOVER:
+            # The replica is DOWN for the window and revived (same
+            # port) at its end — long enough that reads actually route
+            # around it, bounded so the revival is exercised too.
+            faults.append(FaultSpec(
+                step=step, point=point, duration=rng.randint(3, 10),
+                target=str(rng.randrange(cfg.replicas)),
             ))
         elif point == POINT_WRITE_BATCH:
             # Empty target = any node's slot in any flush; a node target
@@ -664,6 +704,9 @@ class ChaosFleetHarness:
         self.workload: Optional[CheckpointingWorkloadSimulator] = None
         self.hub = None
         self.server = None
+        self.relay = None
+        self.replicas: list = []
+        self._relay_sources: list = []
         self.orch = None
         self.slots: dict[str, _WorkerSlot] = {}
         self.budget = 0
@@ -673,7 +716,10 @@ class ChaosFleetHarness:
         if self.server is not None:
             from ..kube.rest import RestClient, RestConfig
 
-            inner: Client = RestClient(RestConfig(server=self.server.url))
+            inner: Client = RestClient(RestConfig(
+                server=self.server.url,
+                read_servers=tuple(r.url for r in self.replicas),
+            ))
         else:
             inner = self.cluster
         return PartitionedClient(inner, identity)
@@ -684,8 +730,28 @@ class ChaosFleetHarness:
 
             self.server = LocalApiServer().start()
             self.cluster = self.server.cluster
+            # Read replicas share the primary's journal (the in-process
+            # stand-in for journal replication); every client built
+            # after this spreads its reads across them.
+            self.replicas = [
+                self.server.read_replica().start()
+                for _ in range(self.cfg.replicas)
+            ]
         else:
             self.cluster = FakeCluster()
+        if self.cfg.relay:
+            from ..kube.relay import WatchRelay
+            from ..kube.rest import RestConfig
+
+            # In wire mode the relay is a real upstream subscriber
+            # (compact-encoded socket client); on the fake it sits
+            # directly on the cluster — either way its subscribers
+            # speak the ordinary watch wire protocol to its socket.
+            upstream = (
+                RestConfig(server=self.server.url)
+                if self.server is not None else self.cluster
+            )
+            self.relay = WatchRelay(upstream).start()
         for name in self.cfg.node_names():
             node = Node.new(name)
             node.set_ready(True)
@@ -728,8 +794,21 @@ class ChaosFleetHarness:
     def _start_worker(self, identity: str):
         from ..fleet.worker import FleetWorkerConfig, ShardWorker
 
+        client = self._client_for(identity)
+        watch_hub = self.hub
+        if self.relay is not None:
+            from ..kube.relay import RelayWatchSource
+
+            # Per-worker source: fallback windows (and their counters)
+            # are this worker's own, exactly as in separate processes.
+            # Virtual-clock mono keeps the retry-the-relay decision a
+            # function of the schedule step, not host speed.
+            watch_hub = RelayWatchSource(
+                self.relay.url, direct=client, mono=self.clock.now,
+            )
+            self._relay_sources.append(watch_hub)
         worker = ShardWorker(
-            self._client_for(identity),
+            client,
             FleetWorkerConfig(
                 identity=identity,
                 shards=self.cfg.shards,
@@ -741,7 +820,7 @@ class ChaosFleetHarness:
                 lease_duration_s=3.0,
                 renew_deadline_s=2.0,
                 retry_period_s=0.5,
-                watch_hub=self.hub,
+                watch_hub=watch_hub,
             ),
             now_fn=self.clock.now,
             wall_fn=self.clock.wall,
@@ -955,6 +1034,30 @@ class ChaosFleetHarness:
                 if self.server is not None:
                     if self.server.kill_connections():
                         plan.record_driver_fire(POINT_WIRE_KILL)
+            elif spec.point == POINT_RELAY_KILL and (
+                spec.step <= step < spec.step + max(1, spec.duration)
+            ):
+                if self.relay is not None:
+                    if self.relay.kill_connections():
+                        plan.record_driver_fire(POINT_RELAY_KILL)
+            elif spec.point == POINT_REPLICA_FAILOVER:
+                idx = int(spec.target or 0)
+                if not (0 <= idx < len(self.replicas)):
+                    continue
+                if spec.step == step:
+                    # The replica dies mid-storm: in-flight reads fail
+                    # over to the primary inline; the client marks it
+                    # down and routes around it.
+                    self.replicas[idx].stop()
+                    plan.record_driver_fire(POINT_REPLICA_FAILOVER)
+                elif step == spec.step + max(1, spec.duration):
+                    # Revive on the SAME port — clients hold the URL —
+                    # and let the down-mark expiry re-adopt it.
+                    assert self.server is not None
+                    port = self.replicas[idx].server_address[1]
+                    self.replicas[idx] = self.server.read_replica(
+                        port=port
+                    ).start()
         for slot in self.slots.values():
             if (
                 not slot.alive
@@ -1147,8 +1250,17 @@ class ChaosFleetHarness:
                 except Exception:  # noqa: BLE001 - teardown best-effort
                     log.exception("chaos: worker %s teardown failed",
                                   slot.identity)
+        for source in self._relay_sources:
+            try:
+                source.close()
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                log.exception("chaos: relay source teardown failed")
+        if self.relay is not None:
+            self.relay.stop()
         if self.hub is not None:
             self.hub.stop()
+        for replica in self.replicas:
+            replica.stop()
         if self.server is not None:
             self.server.stop()
 

@@ -400,6 +400,13 @@ class WireMetrics:
       gauges;
     * **APF** (from ``LocalApiServer.apf_stats()``): per-flow queue
       depth, admitted/shed totals (a shed IS a 429), high-water depth;
+    * **relay** (from ``WatchRelay.stats()``, as ``relay=``) and its
+      client half (``RelayWatchSource.stats()``, as ``relay_source=``):
+      ``tpu_operator_wire_relay_*`` — live subscriber connections,
+      shared streams per scope, upstream vs fanned-out bytes (the
+      cross-process fan-out multiplier the relay exists to buy), and
+      the fallback-to-direct count (each one is a window a subscriber
+      rode the degraded path — docs/wire-path.md "Relay");
     * **loop stall watchdog** — pass either a
       ``kube.loopwatch.LoopStallWatchdog`` (its ``stats()`` shape) or a
       ``LocalApiServer`` directly (its ``loop_stall_stats()`` shape) as
@@ -413,10 +420,19 @@ class WireMetrics:
     beside a client-only process (hub, no server) or a server-only one.
     """
 
-    def __init__(self, hub=None, apiserver=None, loop_watchdog=None) -> None:
+    def __init__(
+        self,
+        hub=None,
+        apiserver=None,
+        loop_watchdog=None,
+        relay=None,
+        relay_source=None,
+    ) -> None:
         self._hub = hub
         self._apiserver = apiserver
         self._loop_watchdog = loop_watchdog
+        self._relay = relay
+        self._relay_source = relay_source
 
     def render(self) -> str:
         out: list[str] = []
@@ -460,6 +476,67 @@ class WireMetrics:
                          stats["scopes"].items()
                      )
                  ]),
+            ]))
+        if self._relay is not None:
+            stats = self._relay.stats()
+            out.append(render_rows(_WIRE_PREFIX, "", [
+                ("relay_clients", "gauge",
+                 "Live subscriber connections on the relay",
+                 stats["clients_active"]),
+                ("relay_streams_total", "counter",
+                 "Watch streams the relay has served",
+                 stats["streams_total"]),
+                ("relay_streams_compact_total", "counter",
+                 "Relay streams served with the compact codec (the "
+                 "negotiated default on relay connections)",
+                 stats["streams_compact"]),
+                ("relay_upstream_bytes_total", "counter",
+                 "Bytes received on the relay's shared upstream streams",
+                 stats["upstream_bytes"]),
+                ("relay_fanout_bytes_total", "counter",
+                 "Bytes fanned out to relay subscribers (the "
+                 "cross-process multiplier over upstream bytes)",
+                 stats["bytes_fanned_out"]),
+                ("relay_refused_requests_total", "counter",
+                 "Non-watch requests refused with 400 (LISTs and "
+                 "writes belong on the apiserver)",
+                 stats["refused_requests"]),
+            ]))
+            out.append(render_samples(_WIRE_PREFIX, [
+                ("relay_scope_streams", "gauge",
+                 "Shared upstream streams per relay scope (the hard-1 "
+                 "the fleet bench asserts per kind)",
+                 [
+                     (prom_label("scope", scope_name),
+                      1 if scope["subscribers"] else 0)
+                     for scope_name, scope in sorted(
+                         stats["hub"].get("scopes", {}).items()
+                     )
+                 ]),
+                ("relay_scope_subscribers", "gauge",
+                 "Relay-side subscribers per scope",
+                 [
+                     (prom_label("scope", scope_name),
+                      scope["subscribers"])
+                     for scope_name, scope in sorted(
+                         stats["hub"].get("scopes", {}).items()
+                     )
+                 ]),
+            ]))
+        if self._relay_source is not None:
+            stats = self._relay_source.stats()
+            out.append(render_rows(_WIRE_PREFIX, "", [
+                ("relay_windows_total", "counter",
+                 "Watch windows this process served through the relay",
+                 stats["relay_windows"]),
+                ("relay_direct_windows_total", "counter",
+                 "Watch windows served DIRECT from the apiserver (the "
+                 "degraded path while the relay is down)",
+                 stats["direct_windows"]),
+                ("relay_fallback_to_direct_total", "counter",
+                 "Relay failures that opened a bounded direct-watch "
+                 "fallback window",
+                 stats["fallbacks_to_direct"]),
             ]))
         if self._apiserver is not None:
             flows = self._apiserver.apf_stats()

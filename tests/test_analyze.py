@@ -1576,7 +1576,7 @@ def test_cli_stats_include_resource_coverage(capsys, monkeypatch):
     # The registered (acquire, release) resource classes the lifecycle
     # pass verifies — @lifecycle_resource registrations plus the
     # built-in registry.
-    assert "resources=13" in line
+    assert "resources=14" in line
 
 
 def test_sarif_rules_include_lif_family(tmp_path, capsys):

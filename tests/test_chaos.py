@@ -42,6 +42,8 @@ from k8s_operator_libs_tpu.testing.chaos import (
     POINT_HUB_REPLAY,
     POINT_LEASE,
     POINT_PARTITION,
+    POINT_RELAY_KILL,
+    POINT_REPLICA_FAILOVER,
     POINT_SIGTERM,
     POINT_STATUS_WRITE,
     POINT_WATCH,
@@ -391,6 +393,66 @@ class TestFaultPoints:
             "no live connections were killed — dead fault"
         )
         assert result.converged and result.total_violations == 0
+
+    def test_relay_kill_degrades_to_direct_and_converges(self):
+        """``relay_kill`` tears down every subscriber stream of the
+        host-local WatchRelay mid-roll; each worker's RelayWatchSource
+        degrades to a bounded direct-watch window (never silence) and
+        the roll converges with zero violations."""
+        cfg = ChaosConfig(pools=4, workers=2, shards=2, relay=True,
+                          fault_window=20)
+        schedule = FaultSchedule(seed=0, config=cfg, faults=[
+            FaultSpec(step=5, point=POINT_RELAY_KILL, duration=1),
+        ])
+        result = run_schedule(schedule)
+        assert result.fired.get(POINT_RELAY_KILL, 0) >= 1, (
+            "no relay subscriber streams were killed — dead fault"
+        )
+        assert result.converged and result.total_violations == 0
+
+    def test_replica_failover_mid_roll_converges(self):
+        """``replica_failover`` stops a read replica mid-roll (reads
+        fail over to the primary inline) and revives it on the same
+        port at the window's end — zero violations either side."""
+        cfg = ChaosConfig(pools=4, workers=2, shards=2, wire=True,
+                          replicas=2, fault_window=20)
+        schedule = FaultSchedule(seed=0, config=cfg, faults=[
+            FaultSpec(step=5, point=POINT_REPLICA_FAILOVER, duration=4,
+                      target="1"),
+        ])
+        result = run_schedule(schedule)
+        assert result.fired.get(POINT_REPLICA_FAILOVER, 0) == 1
+        assert result.converged and result.total_violations == 0
+
+    def test_generate_schedule_draws_the_relay_and_replica_points(self):
+        """The new points join the seeded corpus exactly when their
+        fleet shape is on — and byte-stable through the schedule JSON
+        (the repro artifact contract)."""
+        relay_cfg = ChaosConfig(pools=4, relay=True)
+        wire_cfg = ChaosConfig(pools=4, wire=True, replicas=2)
+        drew_relay = drew_failover = False
+        for seed in range(40):
+            relay_sched = generate_schedule(seed, relay_cfg)
+            wire_sched = generate_schedule(seed, wire_cfg)
+            drew_relay = drew_relay or any(
+                f.point == POINT_RELAY_KILL for f in relay_sched.faults
+            )
+            drew_failover = drew_failover or any(
+                f.point == POINT_REPLICA_FAILOVER
+                for f in wire_sched.faults
+            )
+            for sched in (relay_sched, wire_sched):
+                text = sched.to_json()
+                assert FaultSchedule.from_json(text).to_json() == text
+        assert drew_relay and drew_failover
+        # Off-shape configs never draw them: a replayed pre-relay
+        # schedule is byte-identical to what its seed drew then.
+        base = ChaosConfig(pools=4)
+        for seed in range(40):
+            assert not any(
+                f.point in (POINT_RELAY_KILL, POINT_REPLICA_FAILOVER)
+                for f in generate_schedule(seed, base).faults
+            )
 
 
 # ---------------------------------------------------------------------------

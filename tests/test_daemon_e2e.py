@@ -423,3 +423,125 @@ class TestOrchestrateMultiProcess:
                 for proc in procs:
                     if proc.poll() is None:
                         proc.kill()
+
+
+class TestOrchestrateThroughRelay:
+    """ROADMAP 2's deployment shape: the worker processes stream their
+    watches through a host-local WatchRelay (``--watch-relay``), so the
+    apiserver carries ONE upstream watch stream per kind for the whole
+    host instead of one per process — and killing the relay mid-roll
+    degrades every worker to direct upstream watches (bounded fallback,
+    never silence): the roll still converges."""
+
+    def test_relay_backed_roll_survives_relay_kill(self, tmp_path):
+        from k8s_operator_libs_tpu.kube import WatchRelay
+
+        with LocalApiServer() as srv:
+            kubeconfig = srv.write_kubeconfig(str(tmp_path / "kubeconfig"))
+            node_names = []
+            for i in range(4):
+                node = Node.new(
+                    f"relay-node-{i}",
+                    labels={
+                        GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+                        GKE_TPU_TOPOLOGY_LABEL: "4x4",
+                        GKE_NODEPOOL_LABEL: "relay-pool",
+                    },
+                )
+                node.set_ready(True)
+                srv.cluster.create(node)
+                node_names.append(node.name)
+            sim = DaemonSetSimulator(
+                srv.cluster, name="libtpu-installer", namespace=NS,
+                match_labels=DS_LABELS, initial_hash="libtpu-v1",
+            )
+            sim.settle()
+            srv.cluster.create(
+                KubeObject(make_fleet_rollout(ROLLOUT, node_names, "50%"))
+            )
+            sim.set_template_hash("libtpu-v2")
+
+            relay = WatchRelay(RestConfig(server=srv.url)).start()
+            env = hermetic_cpu_env(4)
+            env["KUBECONFIG"] = kubeconfig
+            procs = []
+            stats_paths = []
+            try:
+                for i in range(2):
+                    stats_path = str(tmp_path / f"stats-{i}.json")
+                    stats_paths.append(stats_path)
+                    flags = [
+                        "--shards", "2", "--shard-index", str(i),
+                        "--fleet-rollout", ROLLOUT,
+                        "--interval", "0.2",
+                        "--leader-elect-id", f"proc-{i}",
+                        "--watch-relay", relay.url,
+                        "--stats-json", stats_path,
+                    ]
+                    if i == 0:
+                        flags.append("--orchestrate")
+                    procs.append(subprocess.Popen(
+                        [sys.executable, CLI, *flags],
+                        env=env, stdout=subprocess.PIPE,
+                        stderr=subprocess.STDOUT, text=True,
+                    ))
+
+                relay_killed = False
+                deadline = time.time() + 150
+                while True:
+                    sim.step()
+                    for proc in procs:
+                        if proc.poll() is not None:
+                            out, _ = proc.communicate(timeout=10)
+                            raise AssertionError(
+                                f"worker exited early (rc={proc.returncode})"
+                                f": {out[-1500:]}"
+                            )
+                    ledger = srv.cluster.peek("FleetRollout", ROLLOUT)
+                    done = len(pools_in_phase(ledger or {}, "done"))
+                    if not relay_killed and done >= 1:
+                        # Mid-roll: the relay MUST have been carrying
+                        # streams (the workers found it), and its death
+                        # must not stall the remaining grant waves.
+                        assert relay.stats()["streams_total"] > 0, (
+                            "workers never streamed through the relay"
+                        )
+                        relay.stop()
+                        relay_killed = True
+                    if done == 4:
+                        break
+                    assert time.time() < deadline, (
+                        "relay-backed fleet roll did not converge; "
+                        f"relay_killed={relay_killed} ledger="
+                        f"{(ledger or {}).get('status')}"
+                    )
+                    time.sleep(0.05)
+                assert relay_killed
+                assert sim.all_pods_ready_and_current()
+
+                for proc in procs:
+                    proc.send_signal(signal.SIGTERM)
+                outs = []
+                for proc in procs:
+                    out, _ = proc.communicate(timeout=60)
+                    outs.append(out)
+                for proc, out in zip(procs, outs):
+                    assert proc.returncode == 0, out[-1500:]
+                    assert "shutdown requested; draining" in out
+
+                # --stats-json lands on every exit path; the fallback
+                # counters prove the degradation ran (relay windows
+                # before the kill, direct windows after it).
+                import json as _json
+
+                for path in stats_paths:
+                    with open(path) as f:
+                        stats = _json.load(f)
+                    assert stats["passes"] > 0
+                    assert stats["relay"]["fallbacks_to_direct"] >= 1, stats
+                    assert stats["relay"]["direct_windows"] >= 1, stats
+            finally:
+                relay.stop()
+                for proc in procs:
+                    if proc.poll() is None:
+                        proc.kill()

@@ -1,0 +1,506 @@
+"""Cross-process watch relay + read-replica routing (ISSUE 19;
+docs/wire-path.md "Relay" / "Read replicas").
+
+The relay's whole contract is that it is indistinguishable from the
+apiserver on the watch wire surface, so every protocol test here runs
+a REAL RestClient against a real WatchRelay socket: shared upstream
+streams (exactly one per kind at N subscribers — the primary's request
+log is the counting hook), journal-backed mid-stream joins, cursor
+expiry → 410, kill → resume-with-watch-not-LIST, and the bounded
+fallback-to-direct degradation of :class:`RelayWatchSource`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from builders import make_node
+from k8s_operator_libs_tpu.kube import (
+    Informer,
+    LocalApiServer,
+    RelayWatchSource,
+    RestClient,
+    RestConfig,
+    WatchExpiredError,
+    WatchRelay,
+)
+from k8s_operator_libs_tpu.kube.client import ApiError
+
+
+def wait_until(predicate, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def watch_requests(log, plural="nodes"):
+    return [
+        entry for entry in log
+        if entry[0] == "GET" and plural in entry[1]
+        and entry[2].get("watch") == "true"
+    ]
+
+
+def list_requests(log, plural="nodes"):
+    return [
+        entry for entry in log
+        if entry[0] == "GET" and plural in entry[1]
+        and entry[2].get("watch") != "true"
+    ]
+
+
+class _Consumer:
+    """Drain a watch generator on a thread (a live subscriber that
+    keeps its scope open while the test drives other subscribers)."""
+
+    def __init__(self, client, **kwargs):
+        self.events = []
+        self._seen = threading.Event()
+        self._done = threading.Event()
+
+        def _run():
+            try:
+                for event_type, obj in client.watch("Node", **kwargs):
+                    self.events.append((event_type, obj.name))
+                    self._seen.set()
+            except ApiError:
+                pass
+            finally:
+                self._done.set()
+
+        self._thread = threading.Thread(target=_run, daemon=True)
+        self._thread.start()
+
+    def wait_events(self, n, timeout=10.0):
+        return wait_until(lambda: len(self.events) >= n, timeout)
+
+    def join(self, timeout=10.0):
+        self._done.wait(timeout)
+
+
+class TestRelayProtocol:
+    def test_relay_is_just_another_watch_server(self):
+        """A stock RestClient pointed at the relay sees the same frames
+        a direct watch sees — compact-negotiated by default on the
+        relay hop — and N subscribers cost ONE upstream stream."""
+        with LocalApiServer() as server:
+            direct = RestClient(RestConfig(server=server.url))
+            relay = WatchRelay(RestConfig(server=server.url)).start()
+            subs = []
+            try:
+                direct.create(make_node("seed-0"))
+                log = server.start_request_log()
+                consumers = []
+                for _ in range(3):
+                    sub = RestClient(RestConfig(
+                        server=relay.url, wire_encoding="compact"
+                    ))
+                    subs.append(sub)
+                    consumers.append(_Consumer(
+                        sub, timeout_seconds=30, resource_version="0",
+                        allow_bookmarks=False,
+                    ))
+                for consumer in consumers:
+                    assert consumer.wait_events(1)  # replayed ADDED
+                direct.create(make_node("seed-1"))
+                for consumer in consumers:
+                    assert consumer.wait_events(2)
+                    assert consumer.events[:2] == [
+                        ("ADDED", "seed-0"), ("ADDED", "seed-1")
+                    ]
+                # THE tentpole assert at unit scale: 3 subscribers, one
+                # upstream stream for the kind.
+                assert len(watch_requests(log)) == 1
+                assert relay.stats()["hub"]["upstream_streams"] == 1
+                assert relay.stats()["clients_total"] == 3
+                # Compact rode both hops: every subscriber stream was
+                # served compact (the RestConfig default asked for it)
+                # and the relay's upstream hop negotiated it too.
+                assert relay.stats()["streams_compact"] == 3
+                assert relay.stats()["upstream_bytes"] > 0
+            finally:
+                server.stop_request_log()
+                relay.stop()
+                for sub in subs:
+                    sub.close()
+                direct.close()
+
+    def test_non_watch_requests_refused(self):
+        """LISTs and writes do NOT belong on the relay: 400 with a
+        Status body, connection kept alive for the next watch."""
+        with LocalApiServer() as server:
+            relay = WatchRelay(RestConfig(server=server.url)).start()
+            sub = RestClient(RestConfig(server=relay.url))
+            try:
+                with pytest.raises(ApiError, match="watch streams only"):
+                    sub.list("Node")
+                with pytest.raises(ApiError):
+                    sub.create(make_node("rejected"))
+                assert relay.stats()["refused_requests"] == 2
+                # The connection survived the refusal: a watch on the
+                # same client still works.
+                assert list(sub.watch("Node", timeout_seconds=0)) == []
+            finally:
+                relay.stop()
+                sub.close()
+
+    def test_mid_stream_join_from_cursor(self):
+        """A second subscriber joining with an older resourceVersion is
+        served from the relay's JOURNAL — the missed events replay with
+        no new upstream stream and no LIST."""
+        with LocalApiServer() as server:
+            direct = RestClient(RestConfig(server=server.url))
+            relay = WatchRelay(RestConfig(server=server.url)).start()
+            sub_a = RestClient(RestConfig(server=relay.url))
+            sub_b = RestClient(RestConfig(server=relay.url))
+            try:
+                first = direct.create(make_node("j-0"))
+                log = server.start_request_log()
+                consumer = _Consumer(
+                    sub_a, timeout_seconds=30, resource_version="0"
+                )
+                assert consumer.wait_events(1)
+                for i in range(1, 4):
+                    direct.create(make_node(f"j-{i}"))
+                assert consumer.wait_events(4)
+                # B joins from the FIRST event's cursor: everything
+                # after it replays from the journal.
+                replayed = []
+                for event_type, obj in sub_b.watch(
+                    "Node", timeout_seconds=1,
+                    resource_version=first.resource_version,
+                ):
+                    replayed.append(obj.name)
+                assert replayed == ["j-1", "j-2", "j-3"]
+                assert len(watch_requests(log)) == 1
+                assert list_requests(log) == []
+            finally:
+                server.stop_request_log()
+                relay.stop()
+                sub_a.close()
+                sub_b.close()
+                direct.close()
+
+    def test_relay_kill_resumes_with_watch_not_list(self):
+        """relay_kill's unit shape: an informer streaming through the
+        relay loses its connection, resumes from its cursor THROUGH the
+        relay — zero new LISTs, zero new upstream streams, no events
+        lost."""
+        with LocalApiServer() as server:
+            direct = RestClient(RestConfig(server=server.url))
+            relay = WatchRelay(RestConfig(server=server.url)).start()
+            stream = RestClient(RestConfig(server=relay.url))
+            informer = None
+            try:
+                direct.create(make_node("k-0"))
+                informer = Informer(
+                    direct, "Node", stream_source=stream,
+                    watch_timeout_seconds=30,
+                ).start()
+                assert wait_until(lambda: len(informer.list()) == 1)
+                log = server.start_request_log()
+                assert relay.kill_connections() >= 1
+                direct.create(make_node("k-1"))
+                assert wait_until(lambda: len(informer.list()) == 2)
+                assert list_requests(log) == []
+                # The resume rode the relay's EXISTING upstream stream:
+                # nothing new was opened against the primary.
+                assert len(watch_requests(log)) == 0
+            finally:
+                server.stop_request_log()
+                if informer is not None:
+                    informer.stop()
+                relay.stop()
+                stream.close()
+                direct.close()
+
+    def test_laggard_cursor_expiry_is_a_410(self):
+        """A cursor that fell off the relay's journal gets
+        WatchExpiredError — the SAME re-list signal the apiserver
+        sends, so informer delta re-list logic needs no fork."""
+        with LocalApiServer() as server:
+            direct = RestClient(RestConfig(server=server.url))
+            relay = WatchRelay(
+                RestConfig(server=server.url), journal_window=3
+            ).start()
+            sub_a = RestClient(RestConfig(server=relay.url))
+            sub_b = RestClient(RestConfig(server=relay.url))
+            try:
+                stale = direct.create(make_node("lag-0"))
+                consumer = _Consumer(
+                    sub_a, timeout_seconds=30, resource_version="0"
+                )
+                assert consumer.wait_events(1)
+                # Rotate the journal far past the stale cursor.
+                for i in range(1, 9):
+                    direct.create(make_node(f"lag-{i}"))
+                assert consumer.wait_events(9)
+                with pytest.raises(WatchExpiredError):
+                    list(sub_b.watch(
+                        "Node", timeout_seconds=2,
+                        resource_version=stale.resource_version,
+                    ))
+            finally:
+                relay.stop()
+                sub_a.close()
+                sub_b.close()
+                direct.close()
+
+    def test_component_protocol_and_idempotent_stop(self):
+        relay = WatchRelay(RestConfig(server="http://127.0.0.1:1"))
+        assert relay.name == "watch-relay"
+        assert not relay.healthy()
+        relay.start()
+        assert relay.healthy()
+        relay.stop()
+        assert not relay.healthy()
+        relay.stop()  # idempotent
+
+
+class TestRelayWatchSource:
+    def test_falls_back_to_direct_when_relay_dies(self):
+        """Relay death is degradation, not silence: the source resumes
+        DIRECT upstream watches from its last delivered revision inside
+        the same window."""
+        with LocalApiServer() as server:
+            direct = RestClient(RestConfig(server=server.url))
+            relay = WatchRelay(RestConfig(server=server.url)).start()
+            source = RelayWatchSource(relay.url, direct=direct)
+            try:
+                direct.create(make_node("f-0"))
+                events = []
+                gen = source.watch(
+                    "Node", timeout_seconds=30, resource_version="0"
+                )
+                event_type, obj = next(gen)
+                events.append((event_type, obj.name))
+                relay.stop()  # the relay process dies mid-stream
+                direct.create(make_node("f-1"))
+                event_type, obj = next(gen)
+                events.append((event_type, obj.name))
+                gen.close()
+                assert events == [("ADDED", "f-0"), ("ADDED", "f-1")]
+                assert source.stats()["fallbacks_to_direct"] == 1
+                assert source.stats()["direct_windows"] == 1
+            finally:
+                relay.stop()
+                source.close()
+                direct.close()
+
+    def test_retries_relay_after_fallback_window(self):
+        """The degradation is BOUNDED: once the fallback window lapses,
+        the next window probes the relay again and the shared-stream
+        economics return."""
+        with LocalApiServer() as server:
+            direct = RestClient(RestConfig(server=server.url))
+            relay = WatchRelay(RestConfig(server=server.url))
+            clock = [0.0]
+            source = RelayWatchSource(
+                "http://127.0.0.1:1",  # nothing listens: relay is down
+                direct=direct,
+                fallback_window_s=30.0,
+                mono=lambda: clock[0],
+            )
+            try:
+                direct.create(make_node("r-0"))
+                assert [
+                    obj.name for _, obj in source.watch(
+                        "Node", timeout_seconds=1, resource_version="0"
+                    )
+                ] == ["r-0"]
+                assert source.stats()["fallbacks_to_direct"] == 1
+                # Still inside the window: straight to direct, no probe.
+                list(source.watch("Node", timeout_seconds=0))
+                assert source.stats()["fallbacks_to_direct"] == 1
+                assert source.stats()["direct_windows"] == 2
+                # Window lapses and the relay is back (same port story):
+                # the next window rides it.
+                relay.start()
+                source._relay_client.close()
+                source._relay_client = RestClient(
+                    RestConfig(server=relay.url)
+                )
+                clock[0] = 31.0
+                assert [
+                    obj.name for _, obj in source.watch(
+                        "Node", timeout_seconds=1, resource_version="0"
+                    )
+                ] == ["r-0"]
+                assert source.stats()["relay_windows"] == 1
+            finally:
+                relay.stop()
+                source.close()
+                direct.close()
+
+    def test_expiry_propagates_untouched(self):
+        """WatchExpiredError is the protocol's re-list signal, NOT a
+        relay failure — it must reach the informer, never trigger
+        fallback."""
+        with LocalApiServer() as server:
+            direct = RestClient(RestConfig(server=server.url))
+            relay = WatchRelay(
+                RestConfig(server=server.url), journal_window=2
+            ).start()
+            source = RelayWatchSource(relay.url, direct=direct)
+            sub = RestClient(RestConfig(server=relay.url))
+            try:
+                stale = direct.create(make_node("e-0"))
+                consumer = _Consumer(
+                    sub, timeout_seconds=30, resource_version="0"
+                )
+                assert consumer.wait_events(1)
+                for i in range(1, 8):
+                    direct.create(make_node(f"e-{i}"))
+                assert consumer.wait_events(8)
+                with pytest.raises(WatchExpiredError):
+                    list(source.watch(
+                        "Node", timeout_seconds=2,
+                        resource_version=stale.resource_version,
+                    ))
+                assert source.stats()["fallbacks_to_direct"] == 0
+            finally:
+                relay.stop()
+                source.close()
+                sub.close()
+                direct.close()
+
+
+class TestRelayWireMetrics:
+    def test_relay_gauges_render_on_the_wire_family(self):
+        """``tpu_operator_wire_relay_*`` rides the existing WireMetrics
+        collector: server half from WatchRelay.stats(), client half
+        from RelayWatchSource.stats() (docs/wire-path.md gauge table)."""
+        from k8s_operator_libs_tpu.upgrade.metrics import WireMetrics
+
+        with LocalApiServer() as server:
+            direct = RestClient(RestConfig(server=server.url))
+            relay = WatchRelay(RestConfig(server=server.url)).start()
+            source = RelayWatchSource(relay.url, direct=direct)
+            try:
+                direct.create(make_node("m-0"))
+                assert [
+                    obj.name for _, obj in source.watch(
+                        "Node", timeout_seconds=1, resource_version="0"
+                    )
+                ] == ["m-0"]
+                rendered = WireMetrics(
+                    relay=relay, relay_source=source
+                ).render()
+                for suffix in (
+                    "relay_clients",
+                    "relay_streams_total",
+                    "relay_streams_compact_total",
+                    "relay_upstream_bytes_total",
+                    "relay_fanout_bytes_total",
+                    "relay_scope_streams",
+                    "relay_windows_total",
+                    "relay_fallback_to_direct_total",
+                ):
+                    assert f"tpu_operator_wire_{suffix}" in rendered
+                assert (
+                    'relay_scope_streams{scope="Node"} 0' in rendered
+                    or 'relay_scope_streams{scope="Node"} 1' in rendered
+                )
+                assert "relay_fallback_to_direct_total 0" in rendered
+            finally:
+                relay.stop()
+                source.close()
+                direct.close()
+
+
+class TestReadReplicas:
+    def test_reads_round_robin_writes_stay_primary(self):
+        with LocalApiServer() as server:
+            rep1 = server.read_replica().start()
+            rep2 = server.read_replica().start()
+            client = RestClient(RestConfig(
+                server=server.url, read_servers=(rep1.url, rep2.url)
+            ))
+            try:
+                for i in range(4):
+                    client.create(make_node(f"rr-{i}"))  # writes: primary
+                for _ in range(4):
+                    assert len(client.list("Node")) == 4
+                assert rep1.requests_served == 2
+                assert rep2.requests_served == 2
+                # The primary served exactly the 4 writes.
+                assert server.requests_served == 4
+            finally:
+                client.close()
+                rep2.stop()
+                rep1.stop()
+
+    def test_replica_refuses_writes_with_405(self):
+        with LocalApiServer() as server:
+            replica = server.read_replica().start()
+            direct = RestClient(RestConfig(server=replica.url))
+            try:
+                with pytest.raises(ApiError, match="read-only replica"):
+                    direct.create(make_node("nope"))
+                # Reads are untouched — including watch windows, which
+                # carry the primary's revision order (shared journal).
+                assert direct.list("Node") == []
+                assert list(direct.watch("Node", timeout_seconds=0)) == []
+            finally:
+                direct.close()
+                replica.stop()
+
+    def test_replica_death_fails_over_to_primary(self):
+        """A dead replica costs one inline retry, never an error: the
+        read lands on the primary and the replica sits out the
+        rotation."""
+        with LocalApiServer() as server:
+            replica = server.read_replica().start()
+            client = RestClient(RestConfig(
+                server=server.url, read_servers=(replica.url,)
+            ))
+            try:
+                client.create(make_node("fo-0"))
+                assert len(client.list("Node")) == 1  # via replica
+                replica.shutdown()
+                for _ in range(3):
+                    assert len(client.list("Node")) == 1  # failover
+                stats = client.transport_stats()
+                assert stats["read_failovers"] == 1
+                assert client.read_failovers == 1
+            finally:
+                client.close()
+                replica.stop()
+
+    def test_watch_windows_ride_replicas(self):
+        with LocalApiServer() as server:
+            replica = server.read_replica().start()
+            client = RestClient(RestConfig(
+                server=server.url, read_servers=(replica.url,)
+            ))
+            try:
+                client.create(make_node("wr-0"))
+                events = [
+                    (event_type, obj.name)
+                    for event_type, obj in client.watch(
+                        "Node", timeout_seconds=1, resource_version="0"
+                    )
+                ]
+                assert events == [("ADDED", "wr-0")]
+                assert replica.watch_streams == 1
+                assert server.watch_streams == 0
+            finally:
+                client.close()
+                replica.stop()
+
+    def test_replica_never_closes_the_shared_journal(self):
+        with LocalApiServer() as server:
+            replica = server.read_replica().start()
+            client = RestClient(RestConfig(server=server.url))
+            try:
+                client.create(make_node("shared-0"))
+                replica.stop()  # must NOT close the primary's cluster
+                assert len(client.list("Node")) == 1
+            finally:
+                client.close()

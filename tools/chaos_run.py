@@ -41,6 +41,8 @@ def build_config(args):
         hub=args.hub,
         checkpoint=args.checkpoint,
         wire=args.wire,
+        relay=args.relay,
+        replicas=args.replicas,
         max_steps=args.max_steps,
         policy=tuple(args.policy or ()),
     )
@@ -83,6 +85,12 @@ def main(argv=None) -> int:
                              "checkpoint scenario)")
     parser.add_argument("--wire", action="store_true",
                         help="run over a LocalApiServer (arms wire_kill)")
+    parser.add_argument("--relay", action="store_true",
+                        help="co-hosted workers stream watches through "
+                             "one WatchRelay (arms relay_kill)")
+    parser.add_argument("--replicas", type=int, default=0,
+                        help="with --wire: N read replicas over the "
+                             "primary's journal (arms replica_failover)")
     parser.add_argument("--policy", action="append", default=None,
                         metavar="NAME",
                         help="compose this registered upgrade policy "
@@ -237,9 +245,11 @@ def main(argv=None) -> int:
         ]
         if args.max_steps:
             flags.append(f"--max-steps {args.max_steps}")
-        for switch in ("hub", "checkpoint", "wire"):
+        for switch in ("hub", "checkpoint", "wire", "relay"):
             if getattr(args, switch):
                 flags.append(f"--{switch}")
+        if args.replicas:
+            flags.append(f"--replicas {args.replicas}")
         for name in args.policy or ():
             flags.append(f"--policy {name}")
         print(
